@@ -1,0 +1,23 @@
+"""firedancer_tpu — a TPU-native framework with the capabilities of Firedancer.
+
+A from-scratch rebuild of the Firedancer transaction pipeline (reference:
+/root/reference, a C17 Solana validator) designed TPU-first:
+
+- ``ballet``   — protocol math & wire formats. Pure-Python bit-exact oracles
+  (Ed25519, SHA-512), transaction parsing, base58, pack scheduling. Mirrors
+  the role of the reference's ``src/ballet`` (fd_ballet.h).
+- ``ops``      — JAX/XLA/Pallas device kernels: batched GF(2^255-19) field
+  arithmetic, batched SHA-512, curve25519 group ops, batched Ed25519 verify.
+  This replaces the reference's AVX2 backends (src/ballet/ed25519/avx/) with
+  batch-axis data parallelism on the MXU/VPU.
+- ``tango``    — shared-memory tile messaging: mcache/dcache/fseq/cnc/tcache
+  semantics (reference: src/tango/fd_tango_base.h).
+- ``disco``    — tiles (long-running actors): verify/dedup/pack and the
+  fd_tpu shim that bridges rings to device batches (reference: src/disco,
+  src/wiredancer/c/wd_f1.c for the offload pattern).
+- ``parallel`` — multi-chip sharding: Mesh + shard_map data-parallel verify
+  lanes over ICI, counters reduced with psum.
+- ``utils``    — logging, rng, small helpers (reference: src/util).
+"""
+
+__version__ = "0.1.0"

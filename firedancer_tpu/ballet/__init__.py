@@ -1,0 +1,7 @@
+"""ballet — protocol math & wire formats (CPU oracles + parsers).
+
+Role mirrors the reference's ``src/ballet`` (fd_ballet.h): standalone,
+stateless implementations of every Solana-ecosystem standard the pipeline
+needs. Everything here is plain CPU Python/NumPy and serves as the bit-exact
+oracle for the JAX/TPU kernels in ``firedancer_tpu.ops``.
+"""

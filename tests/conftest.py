@@ -1,0 +1,20 @@
+"""Test harness config.
+
+Mirrors the reference's test strategy (SURVEY.md section 4): deterministic
+seeds, CPU-hosted runs. We force an 8-device virtual CPU platform so the
+multi-chip sharding paths (firedancer_tpu.parallel) are exercised the same
+way the driver's dryrun_multichip does, without real TPU hardware.
+
+Set FD_TPU_TESTS=1 to run tests against the real attached accelerator
+instead (slower first-compile, used for on-device validation).
+"""
+
+import os
+
+if os.environ.get("FD_TPU_TESTS", "0").lower() not in ("1", "true"):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()

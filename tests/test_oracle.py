@@ -1,0 +1,170 @@
+"""Oracle correctness: RFC 8032 test vectors + edge-case semantics.
+
+The RFC 8032 section 7.1 vectors are public IETF test data. Edge-case tests
+pin the three semantic decisions documented in
+firedancer_tpu/ballet/ed25519/oracle.py (range check, donna decompress,
+1-point byte-compare acceptance).
+"""
+
+import hashlib
+
+import pytest
+
+from firedancer_tpu.ballet.ed25519 import (
+    FD_ED25519_ERR_MSG,
+    FD_ED25519_ERR_PUBKEY,
+    FD_ED25519_ERR_SIG,
+    FD_ED25519_SUCCESS,
+    L,
+    P,
+    keypair_from_seed,
+    point_compress,
+    point_decompress,
+    sign,
+    verify,
+)
+
+# RFC 8032 section 7.1 (TEST 1-3, TEST SHA(abc)): (seed, pub, msg, sig), hex.
+RFC8032_VECTORS = [
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+    (
+        "833fe62409237b9d62ec77587520911e9a759cec1d19755b7da901b96dca3d42",
+        "ec172b93ad5e563bf4932c70e1245034c35467ef2efd4d64ebf819683467e2bf",
+        "sha512:abc",
+        "dc2a4459e7369633a52b1bf277839a00201009a3efbf3ecb69bea2186c26b589"
+        "09351fc9ac90b3ecfdfbc7c66431e0303dca179c138ac17ad9bef1177331a704",
+    ),
+]
+
+
+def _msg_bytes(m: str) -> bytes:
+    if m.startswith("sha512:"):
+        return hashlib.sha512(m.split(":", 1)[1].encode()).digest()
+    return bytes.fromhex(m)
+
+
+@pytest.mark.parametrize("seed,pub,msg,sig", RFC8032_VECTORS)
+def test_rfc8032_sign_and_verify(seed, pub, msg, sig):
+    seed_b = bytes.fromhex(seed)
+    pub_b = bytes.fromhex(pub)
+    msg_b = _msg_bytes(msg)
+    sig_b = bytes.fromhex(sig)
+    _, _, pub_actual = keypair_from_seed(seed_b)
+    assert pub_actual == pub_b
+    assert sign(msg_b, seed_b) == sig_b
+    assert verify(msg_b, sig_b, pub_b) == FD_ED25519_SUCCESS
+
+
+def test_reject_wrong_message():
+    seed = bytes(range(32))
+    _, _, pub = keypair_from_seed(seed)
+    sig = sign(b"hello", seed)
+    assert verify(b"hello", sig, pub) == FD_ED25519_SUCCESS
+    assert verify(b"hullo", sig, pub) == FD_ED25519_ERR_MSG
+
+
+def test_reject_flipped_bits():
+    seed = bytes(range(32))
+    _, _, pub = keypair_from_seed(seed)
+    msg = b"bitflip sweep"
+    sig = sign(msg, seed)
+    for byte_idx in (0, 15, 31, 32, 47):
+        bad = bytearray(sig)
+        bad[byte_idx] ^= 1
+        assert verify(msg, bytes(bad), pub) != FD_ED25519_SUCCESS
+
+
+def test_s_range_check():
+    """s >= L rejected (upstream semantics; malleability defense)."""
+    seed = bytes(range(32))
+    _, _, pub = keypair_from_seed(seed)
+    msg = b"malleability"
+    sig = sign(msg, seed)
+    s = int.from_bytes(sig[32:], "little")
+    # s + L is a mathematically-equivalent but non-canonical scalar.
+    mall = sig[:32] + ((s + L) % 2**256).to_bytes(32, "little")
+    assert verify(msg, mall, pub) == FD_ED25519_ERR_SIG
+
+
+def test_range_check_quirk():
+    """Pin the documented divergence from the fork at fd_ed25519_user.c:379.
+
+    Construct s with s[31] == 0x10 and s[16:31] not all zero (so s >= L).
+    The reference fork returns SUCCESS without verifying; we (and upstream)
+    reject with ERR_SIG.
+    """
+    s = bytearray(32)
+    s[31] = 0x10
+    s[20] = 0x01  # inside s[16:31], nonzero -> the quirk branch
+    sig = bytes(32) + bytes(s)
+    assert int.from_bytes(bytes(s), "little") >= L
+    pub = point_compress((0, 1))
+    assert verify(b"x", sig, pub) == FD_ED25519_ERR_SIG
+
+
+def test_s_just_below_l_not_rejected_by_range():
+    """s = L - 1 passes the range check (fails later with ERR_MSG)."""
+    seed = bytes(range(32))
+    _, _, pub = keypair_from_seed(seed)
+    sig = bytes(32) + (L - 1).to_bytes(32, "little")
+    assert verify(b"x", sig, pub) == FD_ED25519_ERR_MSG
+
+
+def test_bad_pubkey_rejected():
+    """A y with no valid x on the curve -> ERR_PUBKEY."""
+    # Find a y that fails decompression.
+    for y in range(2, 50):
+        enc = y.to_bytes(32, "little")
+        if point_decompress(enc) is None:
+            assert verify(b"x", bytes(64), enc) == FD_ED25519_ERR_PUBKEY
+            return
+    pytest.fail("no non-curve y found in sweep")
+
+
+def test_noncanonical_y_accepted_donna():
+    """Donna semantics: y >= p accepted and reduced (decision 2).
+
+    Only y in [p, 2^255) encodes non-canonically, i.e. reduced y in [0, 19).
+    y = 0 is on the curve (x^2 = -1 has a root mod p).
+    """
+    pt_canonical = point_decompress((0).to_bytes(32, "little"))
+    pt_noncanon = point_decompress(P.to_bytes(32, "little"))
+    assert pt_canonical is not None and pt_noncanon is not None
+    assert pt_canonical == pt_noncanon
+    assert pt_canonical[1] == 0
+
+
+def test_x_zero_sign_one_accepted_donna():
+    """x == 0 with sign bit 1: donna accepts (RFC strict would reject)."""
+    enc = bytearray((1).to_bytes(32, "little"))  # y = 1 -> x = 0 (identity)
+    enc[31] |= 0x80
+    pt = point_decompress(bytes(enc))
+    assert pt == (0, 1)
+
+
+def test_compress_decompress_roundtrip():
+    seed = b"\x07" * 32
+    _, _, pub = keypair_from_seed(seed)
+    pt = point_decompress(pub)
+    assert pt is not None
+    assert point_compress(pt) == pub
