@@ -1,0 +1,249 @@
+"""Batched GF(2^255-19) field arithmetic for TPU (JAX/XLA).
+
+Design (TPU-first, not a port of the reference's 10-limb 25.5-bit scheme in
+/root/reference/src/ballet/ed25519/ref/fd_ed25519_fe.c):
+
+- **Radix 2^8, 32 limbs, signed int32.** TPU integer units are 32-bit; there
+  is no 64x64->128 multiply. 8-bit limbs keep schoolbook products and their
+  32-term convolution sums comfortably inside int32 (bound analysis below).
+- **Limb-major layout ``(32, *batch)``.** The batch axis rides the TPU's
+  128-wide lane dimension; the 32-limb axis is the sublane dimension. This is
+  the lane-transposed layout the reference uses for its 4-way AVX SHA-512
+  batch (fd_sha512_batch_avx.c), scaled to TPU width.
+- **Multiplication = outer product + one-hot fold matmul.** The 32x32 limb
+  outer product is flattened and contracted with a constant (32, 1024)
+  matrix T where T[k, 32*i+j] = [i+j==k] + 38*[i+j==k+32] (2^256 = 38 mod p).
+  XLA maps the contraction onto the MXU/VPU; no scalar loops.
+- **Lazy carries, signed limbs.** Public ops maintain the invariant
+  |limb| <= 512. Subtraction just goes negative (arithmetic shifts make the
+  carry identity c == (c>>8)*256 + (c&255) hold for negatives); canonical
+  form is only computed at byte boundaries (fe_to_bytes / parity / iszero),
+  via short lax.scan carry chains.
+
+Bound analysis (why 4 vectorized carry passes after mul):
+  inputs |a|,|b| <= 1024 -> |conv sum| <= 32*38*2^20 = 2^30.25 < 2^31.
+  pass1 -> limb0 <~ 2^25.6, rest <~ 2^20.3; pass2 -> <~ 2^18; pass3 -> <~
+  2^10.2; pass4 -> <= 293 < 512. Add/sub of invariant-bounded inputs stay
+  within +-1024, so any two public-op results can be multiplied directly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+P = 2**255 - 19
+LIMB_BITS = 8
+NLIMBS = 32
+_MASK = (1 << LIMB_BITS) - 1
+
+# d = -121665/121666 mod p (twisted Edwards constant), sqrt(-1) mod p.
+D_INT = (-121665 * pow(121666, P - 2, P)) % P
+SQRT_M1_INT = pow(2, (P - 1) // 4, P)
+
+
+def _fold_matrix() -> np.ndarray:
+    """T[k, 32*i+j] so that (T @ flat_outer(a,b))[k] = (a*b mod-ish p)[k]."""
+    t = np.zeros((NLIMBS, NLIMBS * NLIMBS), np.int32)
+    for i in range(NLIMBS):
+        for j in range(NLIMBS):
+            k = i + j
+            if k < NLIMBS:
+                t[k, NLIMBS * i + j] += 1
+            else:
+                t[k - NLIMBS, NLIMBS * i + j] += 38
+    return t
+
+
+_T_FOLD = jnp.asarray(_fold_matrix())
+
+# Canonical limbs of p, as a (32, 1) column for broadcasting.
+_P_LIMBS = jnp.asarray(
+    [(P >> (8 * i)) & 0xFF for i in range(NLIMBS)], jnp.int32
+).reshape(NLIMBS, 1)
+
+
+def int_to_limbs(x: int, batch_shape=()) -> jnp.ndarray:
+    """Python int -> (32, *batch) limb array (test/constant helper)."""
+    x %= P
+    limbs = np.asarray([(x >> (8 * i)) & 0xFF for i in range(NLIMBS)], np.int32)
+    out = np.broadcast_to(limbs.reshape((NLIMBS,) + (1,) * len(batch_shape)),
+                          (NLIMBS,) + tuple(batch_shape))
+    return jnp.asarray(out)
+
+
+def limbs_to_int(x) -> list[int]:
+    """(32, *batch) limb array -> list of python ints (test helper)."""
+    arr = np.asarray(x).reshape(NLIMBS, -1).astype(object)
+    vals = [int(sum(int(arr[i, b]) << (8 * i) for i in range(NLIMBS)) % P)
+            for b in range(arr.shape[1])]
+    return vals
+
+
+def fe_from_bytes(b: jnp.ndarray, mask_high_bit: bool = True) -> jnp.ndarray:
+    """(*batch, 32) uint8 -> (32, *batch) int32 limbs.
+
+    mask_high_bit drops bit 255 (the x-sign bit of a point encoding), the
+    behavior of the reference's fe_frombytes. Values >= p are accepted
+    (donna semantics) and reduced lazily.
+    """
+    x = jnp.moveaxis(b.astype(jnp.int32), -1, 0)
+    if mask_high_bit:
+        x = x.at[NLIMBS - 1].set(x[NLIMBS - 1] & 0x7F)
+    return x
+
+
+def _carry_pass(x: jnp.ndarray, passes: int) -> jnp.ndarray:
+    """Vectorized lazy carry: wraps the top limb's carry into limb 0 (x38)."""
+    for _ in range(passes):
+        lo = x & _MASK
+        hi = x >> LIMB_BITS  # arithmetic shift: exact for signed limbs
+        x = lo + jnp.concatenate([38 * hi[NLIMBS - 1:], hi[:NLIMBS - 1]], axis=0)
+    return x
+
+
+def fe_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _carry_pass(a + b, 1)
+
+
+def fe_sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _carry_pass(a - b, 1)
+
+
+def fe_neg(a: jnp.ndarray) -> jnp.ndarray:
+    return _carry_pass(-a, 1)
+
+
+def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply. Inputs may have |limb| up to 1024."""
+    batch_shape = a.shape[1:]
+    outer = a[:, None] * b[None, :]                     # (32, 32, *batch)
+    flat = outer.reshape((NLIMBS * NLIMBS,) + batch_shape)
+    folded = jnp.tensordot(_T_FOLD, flat, axes=1)       # (32, *batch)
+    return _carry_pass(folded, 4)
+
+
+def fe_sq(a: jnp.ndarray) -> jnp.ndarray:
+    return fe_mul(a, a)
+
+
+def fe_mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small nonneg python int (|k| * 1024 * 39 < 2^31)."""
+    return _carry_pass(_carry_pass(a * k, 1), 1)
+
+
+def _seq_carry(x: jnp.ndarray):
+    """Exact sequential carry over the limb axis via lax.scan.
+
+    Returns (canonical limbs in [0, 255], top carry). Works for signed
+    inputs; the top carry may be negative.
+    """
+
+    def step(carry, limb):
+        t = limb + carry
+        lo = t & _MASK
+        return t >> LIMB_BITS, lo
+
+    top, lo = jax.lax.scan(step, jnp.zeros(x.shape[1:], jnp.int32), x)
+    return lo, top
+
+
+def _canonicalize(x: jnp.ndarray) -> jnp.ndarray:
+    """Reduce limbs to the canonical representative in [0, p).
+
+    Sequential scan + two wrap fix-ups (top carry c contributes 38*c at limb
+    0 since 2^256 = 38 mod p), then two conditional subtractions of p.
+    Input invariant |limb| <= 1024 keeps every scan carry tiny.
+    """
+    lo, c = _seq_carry(x)
+    for _ in range(2):
+        lo = lo.at[0].add(38 * c)
+        lo, c = _seq_carry(lo)
+    # Now 0 <= value < 2^256 (< 2p + 38): subtract p up to twice.
+    for _ in range(2):
+        d, borrow = _seq_carry(lo - _P_LIMBS)
+        lo = jnp.where(borrow < 0, lo, d)
+    return lo
+
+
+def fe_to_bytes(x: jnp.ndarray) -> jnp.ndarray:
+    """(32, *batch) limbs -> (*batch, 32) uint8, canonical mod p."""
+    return jnp.moveaxis(_canonicalize(x), 0, -1).astype(jnp.uint8)
+
+
+def fe_canonical_limbs(x: jnp.ndarray) -> jnp.ndarray:
+    return _canonicalize(x)
+
+
+def fe_is_negative(x: jnp.ndarray) -> jnp.ndarray:
+    """Parity of the canonical representative (ref's fe_isnegative)."""
+    return (_canonicalize(x)[0] & 1).astype(jnp.bool_)
+
+
+def fe_is_zero(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(_canonicalize(x) == 0, axis=0)
+
+
+def fe_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(_canonicalize(a) == _canonicalize(b), axis=0)
+
+
+def fe_select(mask: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lane-wise select: mask True -> a, False -> b. mask shape = batch."""
+    return jnp.where(mask[None], a, b)
+
+
+def fe_one(batch_shape=()) -> jnp.ndarray:
+    return int_to_limbs(1, batch_shape)
+
+
+def fe_zero(batch_shape=()) -> jnp.ndarray:
+    return jnp.zeros((NLIMBS,) + tuple(batch_shape), jnp.int32)
+
+
+def _pow_ladder(z: jnp.ndarray):
+    """Shared addition-chain prefix: returns (z^(2^250 - 1), z^11, z^2).
+
+    The classic curve25519 chain (public structure, e.g. RFC 7748 impls).
+    """
+
+    def sqn(x, n):
+        for _ in range(n):
+            x = fe_sq(x)
+        return x
+
+    z2 = fe_sq(z)                      # 2
+    z9 = fe_mul(sqn(z2, 2), z)         # 9
+    z11 = fe_mul(z9, z2)               # 11
+    z_5_0 = fe_mul(fe_sq(z11), z9)     # 2^5 - 2^0 = 31
+    z_10_0 = fe_mul(sqn(z_5_0, 5), z_5_0)      # 2^10 - 1
+    z_20_0 = fe_mul(sqn(z_10_0, 10), z_10_0)   # 2^20 - 1
+    z_40_0 = fe_mul(sqn(z_20_0, 20), z_20_0)   # 2^40 - 1
+    z_50_0 = fe_mul(sqn(z_40_0, 10), z_10_0)   # 2^50 - 1
+    z_100_0 = fe_mul(sqn(z_50_0, 50), z_50_0)  # 2^100 - 1
+    z_200_0 = fe_mul(sqn(z_100_0, 100), z_100_0)  # 2^200 - 1
+    z_250_0 = fe_mul(sqn(z_200_0, 50), z_50_0)    # 2^250 - 1
+    return z_250_0, z11, z2
+
+
+def fe_invert(z: jnp.ndarray) -> jnp.ndarray:
+    """z^(p-2) = z^(2^255 - 21)."""
+    z_250_0, z11, _ = _pow_ladder(z)
+    x = z_250_0
+    for _ in range(5):
+        x = fe_sq(x)
+    return fe_mul(x, z11)              # 2^255 - 32 + 11 = 2^255 - 21
+
+
+def fe_pow22523(z: jnp.ndarray) -> jnp.ndarray:
+    """z^((p-5)/8) = z^(2^252 - 3)."""
+    z_250_0, _, _ = _pow_ladder(z)
+    x = fe_sq(fe_sq(z_250_0))
+    return fe_mul(x, z)                # 2^252 - 4 + 1 = 2^252 - 3
+
+
+FE_D = int_to_limbs(D_INT, (1,))
+FE_SQRT_M1 = int_to_limbs(SQRT_M1_INT, (1,))

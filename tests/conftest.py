@@ -5,6 +5,13 @@ seeds, CPU-hosted runs. We force an 8-device virtual CPU platform so the
 multi-chip sharding paths (firedancer_tpu.parallel) are exercised the same
 way the driver's dryrun_multichip does, without real TPU hardware.
 
+IMPORTANT (environment quirk): this image's sitecustomize registers the
+"axon" TPU-tunnel PJRT plugin in every Python process and force-sets
+``jax_platforms="axon,cpu"`` via jax.config — which overrides the
+JAX_PLATFORMS env var. Tests must run CPU-only (the TPU tunnel serializes
+across processes and a wedged claim hangs backend init for minutes), so we
+override the *config*, not just the env, before any backend initializes.
+
 Set FD_TPU_TESTS=1 to run tests against the real attached accelerator
 instead (slower first-compile, used for on-device validation).
 """
@@ -12,9 +19,13 @@ instead (slower first-compile, used for on-device validation).
 import os
 
 if os.environ.get("FD_TPU_TESTS", "0").lower() not in ("1", "true"):
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,120 @@
+"""GF(2^255-19) JAX field arithmetic vs Python bigint oracle."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from firedancer_tpu.ops import fe25519 as fe
+
+P = fe.P
+rng = random.Random(0xF1EDDA)
+
+
+def _rand_ints(n):
+    vals = [0, 1, 2, 19, P - 1, P - 19, P // 2, 2**255 - 20]
+    vals += [rng.randrange(P) for _ in range(n - len(vals))]
+    return vals
+
+
+def _pack(vals):
+    """ints -> (32, B) limb array."""
+    return jnp.stack([fe.int_to_limbs(v) for v in vals], axis=-1)
+
+
+def _unpack(x):
+    return fe.limbs_to_int(x)
+
+
+B = 16
+A_INTS = _rand_ints(B)
+B_INTS = list(reversed(_rand_ints(B)))
+A = _pack(A_INTS)
+BV = _pack(B_INTS)
+
+
+def test_roundtrip_bytes():
+    raw = np.asarray(
+        [rng.randrange(2**256).to_bytes(32, "little") for _ in range(B)]
+    )
+    byts = jnp.asarray(np.frombuffer(b"".join(raw.tolist()), np.uint8).reshape(B, 32))
+    x = fe.fe_from_bytes(byts, mask_high_bit=True)
+    got = _unpack(x)
+    for g, r in zip(got, raw.tolist()):
+        expect = (int.from_bytes(r, "little") & ((1 << 255) - 1)) % P
+        assert g == expect
+    # to_bytes canonicalizes
+    out = np.asarray(fe.fe_to_bytes(x))
+    for row, g in zip(out, got):
+        assert int.from_bytes(row.tobytes(), "little") == g
+
+
+@pytest.mark.parametrize("op,pyop", [
+    (fe.fe_add, lambda a, b: (a + b) % P),
+    (fe.fe_sub, lambda a, b: (a - b) % P),
+    (fe.fe_mul, lambda a, b: (a * b) % P),
+])
+def test_binary_ops(op, pyop):
+    got = _unpack(op(A, BV))
+    for g, a, b in zip(got, A_INTS, B_INTS):
+        assert g == pyop(a, b)
+
+
+def test_neg_sq():
+    assert _unpack(fe.fe_neg(A)) == [(-a) % P for a in A_INTS]
+    assert _unpack(fe.fe_sq(A)) == [a * a % P for a in A_INTS]
+
+
+def test_invert():
+    nz = _pack([max(a, 1) for a in A_INTS])
+    got = _unpack(fe.fe_invert(nz))
+    for g, a in zip(got, [max(a, 1) for a in A_INTS]):
+        assert g == pow(a, P - 2, P)
+
+
+def test_pow22523():
+    got = _unpack(fe.fe_pow22523(A))
+    for g, a in zip(got, A_INTS):
+        assert g == pow(a, (P - 5) // 8, P)
+
+
+def test_invariant_bound_under_chains():
+    """|limb| <= 1024 must hold after arbitrary public-op chains."""
+    x, y = A, BV
+    for i in range(6):
+        x = fe.fe_sub(fe.fe_zero(x.shape[1:]), x)
+        y = fe.fe_sub(x, y)
+        x = fe.fe_mul(x, y)
+        assert int(jnp.max(jnp.abs(x))) <= 1024, f"iter {i}"
+        assert int(jnp.max(jnp.abs(y))) <= 1024, f"iter {i}"
+    # Still correct after the stress chain
+    ref_x, ref_y = A_INTS, B_INTS
+    for _ in range(6):
+        ref_x = [(-a) % P for a in ref_x]
+        ref_y = [(a - b) % P for a, b in zip(ref_x, ref_y)]
+        ref_x = [a * b % P for a, b in zip(ref_x, ref_y)]
+    assert _unpack(x) == ref_x
+    assert _unpack(y) == ref_y
+
+
+def test_parity_and_zero():
+    par = np.asarray(fe.fe_is_negative(A))
+    for p_, a in zip(par, A_INTS):
+        assert bool(p_) == bool(a & 1)
+    z = fe.fe_sub(A, A)
+    assert bool(np.all(np.asarray(fe.fe_is_zero(z))))
+    assert not bool(np.any(np.asarray(fe.fe_is_zero(_pack([1] * B)))))
+
+
+def test_mul_small():
+    got = _unpack(fe.fe_mul_small(A, 121666))
+    for g, a in zip(got, A_INTS):
+        assert g == a * 121666 % P
+
+
+def test_constants():
+    assert _unpack(fe.FE_D) == [fe.D_INT]
+    assert _unpack(fe.FE_SQRT_M1) == [fe.SQRT_M1_INT]
+    assert (fe.SQRT_M1_INT**2) % P == P - 1
