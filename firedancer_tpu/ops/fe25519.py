@@ -29,8 +29,6 @@ Bound analysis (why 4 vectorized carry passes after mul):
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -131,8 +129,14 @@ def fe_sq(a: jnp.ndarray) -> jnp.ndarray:
 
 
 def fe_mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
-    """Multiply by a small nonneg python int (|k| * 1024 * 39 < 2^31)."""
-    return _carry_pass(_carry_pass(a * k, 1), 1)
+    """Multiply by a small nonneg python int k < 2^17.
+
+    |a*k| < 1024 * 2^17 = 2^27 fits int32; four carry passes restore the
+    |limb| <= 512 invariant (same pass-count analysis as fe_mul).
+    """
+    if not 0 <= k < (1 << 17):
+        raise ValueError("fe_mul_small requires 0 <= k < 2^17")
+    return _carry_pass(a * k, 4)
 
 
 def _seq_carry(x: jnp.ndarray):
@@ -246,4 +250,5 @@ def fe_pow22523(z: jnp.ndarray) -> jnp.ndarray:
 
 
 FE_D = int_to_limbs(D_INT, (1,))
+FE_D2 = int_to_limbs(2 * D_INT % P, (1,))
 FE_SQRT_M1 = int_to_limbs(SQRT_M1_INT, (1,))

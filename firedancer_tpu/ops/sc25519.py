@@ -1,0 +1,90 @@
+"""Batched scalar arithmetic mod L = 2^252 + 27742... for TPU.
+
+Replaces the reference's fd_ed25519_sc_reduce
+(/root/reference/src/ballet/ed25519/fd_ed25519_user.c:414, impl in
+fd_curve25519_scalar.c-style code) with a batch Barrett reduction in
+radix-2^8 int32 limbs — byte-aligned shifts only, no 64-bit arithmetic,
+sequential exactness confined to short lax.scan carry chains.
+
+Barrett with b = 2^8, k = 32 (b^k = 2^256 > L):
+    mu = floor(b^(2k) / L)            (33 limbs, precomputed)
+    q1 = floor(x / b^(k-1))           (drop 31 limbs)
+    q3 = floor(q1 * mu / b^(k+1))     (conv + drop 33 limbs)
+    r  = (x - q3*L) mod b^(k+1)       in [0, 3L)
+then two conditional subtractions of L. Valid for any x < b^(2k) = 2^512,
+which covers the 64-byte SHA-512 output.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import fe25519
+
+L = 2**252 + 27742317777372353535851937790883648493
+_MU = (2**512) // L  # 259-bit
+
+_L_LIMBS33 = jnp.asarray(
+    [(L >> (8 * i)) & 0xFF for i in range(33)], jnp.int32
+).reshape(33, 1)
+_MU_LIMBS = np.asarray([( _MU >> (8 * i)) & 0xFF for i in range(33)], np.int32)
+
+
+def _conv_matrix(n_in: int, n_out: int, weights: np.ndarray) -> jnp.ndarray:
+    """T[k, i] = weights[k - i] — contraction computes conv(x, weights)."""
+    t = np.zeros((n_out, n_in), np.int32)
+    for i in range(n_in):
+        for j in range(len(weights)):
+            if i + j < n_out:
+                t[i + j, i] = weights[j]
+    return jnp.asarray(t)
+
+
+_T_MU = _conv_matrix(33, 66, _MU_LIMBS)                 # q1(33) -> q1*mu(66)
+_T_L = _conv_matrix(33, 33, np.asarray(
+    [(L >> (8 * i)) & 0xFF for i in range(33)], np.int32))  # q3*L mod b^33
+
+
+# Exact base-256 carry chain shared with the field module (one impl).
+_seq_carry = fe25519._seq_carry
+
+
+def sc_reduce64(hash_bytes: jnp.ndarray) -> jnp.ndarray:
+    """(*batch, 64) uint8 little-endian -> canonical (*batch, 32) uint8 mod L."""
+    x = jnp.moveaxis(hash_bytes.astype(jnp.int32), -1, 0)   # (64, B) canonical
+    q1 = x[31:]                                              # (33, B)
+    q2 = jnp.tensordot(_T_MU, q1, axes=1)                    # (66, B), <= 2^21.1
+    q2, _ = _seq_carry(q2)                                   # canonical
+    q3 = q2[33:]                                             # (33, B) = floor(q1*mu/b^33)
+    q3l = jnp.tensordot(_T_L, q3, axes=1)                    # (33, B) mod b^33
+    q3l, _ = _seq_carry(q3l)
+    # r = (x - q3*L) mod b^33: borrow-propagating subtract, final borrow
+    # discarded (that IS the mod-b^33 wrap).
+    r, _ = _seq_carry(x[:33] - q3l)
+    # r in [0, 3L): subtract L at most twice.
+    for _ in range(2):
+        d, borrow = _seq_carry(r - _L_LIMBS33)
+        r = jnp.where(borrow[None] < 0, r, d)
+    return jnp.moveaxis(r[:32], 0, -1).astype(jnp.uint8)
+
+
+def sc_check_range(s_bytes: jnp.ndarray) -> jnp.ndarray:
+    """Vectorized s < L check on (*batch, 32) uint8 little-endian scalars.
+
+    Upstream semantics (reject s >= L) — see the oracle module docstring for
+    the documented divergence from the fork's quirk at
+    fd_ed25519_user.c:379.
+    """
+    l_bytes = jnp.asarray([(L >> (8 * i)) & 0xFF for i in range(32)],
+                          jnp.int32)
+    s = s_bytes.astype(jnp.int32)
+    # Lexicographic compare from the most significant byte down.
+    lt = jnp.zeros(s.shape[:-1], jnp.bool_)
+    decided = jnp.zeros(s.shape[:-1], jnp.bool_)
+    for i in range(31, -1, -1):
+        b = s[..., i]
+        lb = l_bytes[i]
+        lt = jnp.where(~decided & (b < lb), True, lt)
+        decided = decided | (b != lb)
+    return lt

@@ -1,0 +1,241 @@
+"""Batched SHA-512 for TPU (JAX/XLA), 64-bit words as uint32 hi/lo pairs.
+
+Role: the TPU replacement for the reference's AVX2-asm SHA-512 core and its
+4-way batched API (/root/reference/src/ballet/sha512/fd_sha512.h:221-251,
+fd_sha512_batch_avx.c) — the batch axis here is the TPU lane axis instead of
+4 AVX lanes.
+
+TPU-first decisions:
+- **No 64-bit integers.** TPU int64 is emulated and slow; every 64-bit word
+  is an explicit (hi, lo) pair of uint32 arrays, with ripple-carry adds and
+  pairwise rotates. All ops are VPU-friendly elementwise uint32.
+- **Lane-major batch.** Words have shape (*, B): the batch dimension rides
+  the 128-wide lane axis (same layout rationale as fe25519).
+- **Variable message length via masking, not bucketing.** All lanes run
+  max_blocks compression rounds; a lane's state only updates while
+  block_idx < its block count. Padding (0x80 marker + 128-bit big-endian
+  bit length) is placed arithmetically from per-lane lengths, so the whole
+  batch is one jit with static shapes. This is the batch-uniform control
+  flow the TPU mandates (SURVEY.md section 7 "uniform control flow").
+
+Message-schedule and round structure follow FIPS 180-4.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+U32 = jnp.uint32
+
+# FIPS 180-4 SHA-512 round constants (first 64 bits of fractional parts of
+# cube roots of the first 80 primes) and initial hash state.
+_K = [
+    0x428A2F98D728AE22, 0x7137449123EF65CD, 0xB5C0FBCFEC4D3B2F, 0xE9B5DBA58189DBBC,
+    0x3956C25BF348B538, 0x59F111F1B605D019, 0x923F82A4AF194F9B, 0xAB1C5ED5DA6D8118,
+    0xD807AA98A3030242, 0x12835B0145706FBE, 0x243185BE4EE4B28C, 0x550C7DC3D5FFB4E2,
+    0x72BE5D74F27B896F, 0x80DEB1FE3B1696B1, 0x9BDC06A725C71235, 0xC19BF174CF692694,
+    0xE49B69C19EF14AD2, 0xEFBE4786384F25E3, 0x0FC19DC68B8CD5B5, 0x240CA1CC77AC9C65,
+    0x2DE92C6F592B0275, 0x4A7484AA6EA6E483, 0x5CB0A9DCBD41FBD4, 0x76F988DA831153B5,
+    0x983E5152EE66DFAB, 0xA831C66D2DB43210, 0xB00327C898FB213F, 0xBF597FC7BEEF0EE4,
+    0xC6E00BF33DA88FC2, 0xD5A79147930AA725, 0x06CA6351E003826F, 0x142929670A0E6E70,
+    0x27B70A8546D22FFC, 0x2E1B21385C26C926, 0x4D2C6DFC5AC42AED, 0x53380D139D95B3DF,
+    0x650A73548BAF63DE, 0x766A0ABB3C77B2A8, 0x81C2C92E47EDAEE6, 0x92722C851482353B,
+    0xA2BFE8A14CF10364, 0xA81A664BBC423001, 0xC24B8B70D0F89791, 0xC76C51A30654BE30,
+    0xD192E819D6EF5218, 0xD69906245565A910, 0xF40E35855771202A, 0x106AA07032BBD1B8,
+    0x19A4C116B8D2D0C8, 0x1E376C085141AB53, 0x2748774CDF8EEB99, 0x34B0BCB5E19B48A8,
+    0x391C0CB3C5C95A63, 0x4ED8AA4AE3418ACB, 0x5B9CCA4F7763E373, 0x682E6FF3D6B2B8A3,
+    0x748F82EE5DEFB2FC, 0x78A5636F43172F60, 0x84C87814A1F0AB72, 0x8CC702081A6439EC,
+    0x90BEFFFA23631E28, 0xA4506CEBDE82BDE9, 0xBEF9A3F7B2C67915, 0xC67178F2E372532B,
+    0xCA273ECEEA26619C, 0xD186B8C721C0C207, 0xEADA7DD6CDE0EB1E, 0xF57D4F7FEE6ED178,
+    0x06F067AA72176FBA, 0x0A637DC5A2C898A6, 0x113F9804BEF90DAE, 0x1B710B35131C471B,
+    0x28DB77F523047D84, 0x32CAAB7B40C72493, 0x3C9EBE0A15C9BEBC, 0x431D67C49C100D4C,
+    0x4CC5D4BECB3E42B6, 0x597F299CFC657E2A, 0x5FCB6FAB3AD6FAEC, 0x6C44198C4A475817,
+]
+_IV = [
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B, 0xA54FF53A5F1D36F1,
+    0x510E527FADE682D1, 0x9B05688C2B3E6C1F, 0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+]
+
+_K_HI = jnp.asarray(np.asarray([k >> 32 for k in _K], np.uint32))
+_K_LO = jnp.asarray(np.asarray([k & 0xFFFFFFFF for k in _K], np.uint32))
+_IV_HI = np.asarray([v >> 32 for v in _IV], np.uint32)
+_IV_LO = np.asarray([v & 0xFFFFFFFF for v in _IV], np.uint32)
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < al).astype(U32)
+    return ah + bh + carry, lo
+
+
+def _rotr64(h, l, n):
+    n = n % 64
+    if n == 0:
+        return h, l
+    if n < 32:
+        nh = (h >> n) | (l << (32 - n))
+        nl = (l >> n) | (h << (32 - n))
+        return nh, nl
+    if n == 32:
+        return l, h
+    m = n - 32
+    nh = (l >> m) | (h << (32 - m))
+    nl = (h >> m) | (l << (32 - m))
+    return nh, nl
+
+
+def _shr64(h, l, n):
+    if n < 32:
+        return h >> n, (l >> n) | (h << (32 - n))
+    if n == 32:
+        return jnp.zeros_like(h), h
+    return jnp.zeros_like(h), h >> (n - 32)
+
+
+def _xor3(a, b, c):
+    return a ^ b ^ c
+
+
+def _compress_block(state, w_hi, w_lo):
+    """One SHA-512 compression: state (8,2,B) uint32, block words (16, B)."""
+
+    def big_sigma0(h, l):
+        return _xor3_pair(_rotr64(h, l, 28), _rotr64(h, l, 34), _rotr64(h, l, 39))
+
+    def big_sigma1(h, l):
+        return _xor3_pair(_rotr64(h, l, 14), _rotr64(h, l, 18), _rotr64(h, l, 41))
+
+    def small_sigma0(h, l):
+        return _xor3_pair(_rotr64(h, l, 1), _rotr64(h, l, 8), _shr64(h, l, 7))
+
+    def small_sigma1(h, l):
+        return _xor3_pair(_rotr64(h, l, 19), _rotr64(h, l, 61), _shr64(h, l, 6))
+
+    def _xor3_pair(p0, p1, p2):
+        return _xor3(p0[0], p1[0], p2[0]), _xor3(p0[1], p1[1], p2[1])
+
+    # Extend 16 -> 80 schedule words with a scan carrying a 16-word window.
+    def extend(window, _):
+        wh, wl = window  # (16, B) each
+        s0 = small_sigma0(wh[1], wl[1])
+        s1 = small_sigma1(wh[14], wl[14])
+        nh, nl = _add64(wh[0], wl[0], s0[0], s0[1])
+        nh, nl = _add64(nh, nl, wh[9], wl[9])
+        nh, nl = _add64(nh, nl, s1[0], s1[1])
+        new_h = jnp.concatenate([wh[1:], nh[None]], axis=0)
+        new_l = jnp.concatenate([wl[1:], nl[None]], axis=0)
+        return (new_h, new_l), (nh, nl)
+
+    (_, _), (ext_h, ext_l) = jax.lax.scan(extend, (w_hi, w_lo), None, length=64)
+    sched_h = jnp.concatenate([w_hi, ext_h], axis=0)  # (80, B)
+    sched_l = jnp.concatenate([w_lo, ext_l], axis=0)
+
+    def round_fn(abcdefgh, inputs):
+        kh, kl, wh, wl = inputs
+        a_h, a_l, b_h, b_l, c_h, c_l, d_h, d_l, e_h, e_l, f_h, f_l, g_h, g_l, h_h, h_l = abcdefgh
+        s1 = big_sigma1(e_h, e_l)
+        ch_h = (e_h & f_h) ^ (~e_h & g_h)
+        ch_l = (e_l & f_l) ^ (~e_l & g_l)
+        t1h, t1l = _add64(h_h, h_l, s1[0], s1[1])
+        t1h, t1l = _add64(t1h, t1l, ch_h, ch_l)
+        t1h, t1l = _add64(t1h, t1l, kh, kl)
+        t1h, t1l = _add64(t1h, t1l, wh, wl)
+        s0 = big_sigma0(a_h, a_l)
+        maj_h = (a_h & b_h) ^ (a_h & c_h) ^ (b_h & c_h)
+        maj_l = (a_l & b_l) ^ (a_l & c_l) ^ (b_l & c_l)
+        t2h, t2l = _add64(s0[0], s0[1], maj_h, maj_l)
+        ne_h, ne_l = _add64(d_h, d_l, t1h, t1l)
+        na_h, na_l = _add64(t1h, t1l, t2h, t2l)
+        return (na_h, na_l, a_h, a_l, b_h, b_l, c_h, c_l,
+                ne_h, ne_l, e_h, e_l, f_h, f_l, g_h, g_l), None
+
+    batch = w_hi.shape[1:]
+    init = tuple(
+        jnp.broadcast_to(state[i // 2, i % 2], batch)
+        for i in range(16)
+    )
+    k_h = jnp.broadcast_to(_K_HI[:, None], (80,) + batch) if batch else _K_HI
+    k_l = jnp.broadcast_to(_K_LO[:, None], (80,) + batch) if batch else _K_LO
+    final, _ = jax.lax.scan(round_fn, init, (k_h, k_l, sched_h, sched_l))
+
+    out = []
+    for i in range(8):
+        sh, sl = _add64(state[i, 0], state[i, 1], final[2 * i], final[2 * i + 1])
+        out.append(jnp.stack([sh, sl]))
+    return jnp.stack(out)  # (8, 2, B)
+
+
+def _bytes_to_words(block_bytes):
+    """(16*8, B) uint8 big-endian -> two (16, B) uint32 arrays."""
+    b = block_bytes.astype(U32).reshape((16, 8) + block_bytes.shape[1:])
+    hi = (b[:, 0] << 24) | (b[:, 1] << 16) | (b[:, 2] << 8) | b[:, 3]
+    lo = (b[:, 4] << 24) | (b[:, 5] << 16) | (b[:, 6] << 8) | b[:, 7]
+    return hi, lo
+
+
+def sha512_batch(msgs: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Batched SHA-512 of variable-length messages.
+
+    msgs: (B, max_len) uint8, each row's message in bytes [0, lengths[b]).
+    lengths: (B,) int32 byte lengths (<= max_len).
+    Returns (B, 64) uint8 digests.
+
+    All lanes run ceil((max_len+17)/128) compressions; per-lane block counts
+    mask the state updates.
+    """
+    bsz, max_len = msgs.shape
+    max_blocks = (max_len + 17 + 127) // 128
+    total = max_blocks * 128
+    lengths = lengths.astype(jnp.int32)
+
+    # Build padded buffer (total, B): message | 0x80 | zeros | 128-bit bitlen.
+    data = jnp.moveaxis(msgs.astype(U32), -1, 0)  # (max_len, B)
+    if total > max_len:
+        data = jnp.concatenate(
+            [data, jnp.zeros((total - max_len, bsz), U32)], axis=0
+        )
+    pos = jnp.arange(total, dtype=jnp.int32)[:, None]          # (total, 1)
+    ln = lengths[None, :]                                       # (1, B)
+    data = jnp.where(pos < ln, data, 0)
+    data = jnp.where(pos == ln, 0x80, data)
+    # Per-lane final block and big-endian length field (bit length < 2^32+3
+    # for any practical max_len, but compute full 64 bits of it anyway).
+    nblocks = (lengths + 17 + 127) // 128                       # (B,)
+    len_start = nblocks * 128 - 8                               # low 8 bytes
+    # 64-bit bit length as a uint32 hi/lo pair (lengths up to 2^32 bytes);
+    # the upper 8 bytes of the 128-bit field stay zero.
+    bitlen_lo = lengths.astype(U32) << 3
+    bitlen_hi = lengths.astype(U32) >> 29
+    # byte k of the 8-byte big-endian field at offset len_start + k
+    k = pos - len_start[None, :]
+    word = jnp.where(k < 4, bitlen_hi[None, :], bitlen_lo[None, :])
+    shift = (3 - (k & 3)) * 8
+    lenbyte = jnp.where(
+        (k >= 0) & (k < 8),
+        (word >> jnp.clip(shift, 0, 31)) & 0xFF,
+        0,
+    ).astype(U32)
+    data = data | lenbyte
+
+    state = jnp.broadcast_to(
+        jnp.stack([jnp.stack([_IV_HI[i], _IV_LO[i]]) for i in range(8)])[..., None],
+        (8, 2, bsz),
+    ).astype(U32)
+
+    def per_block(state, i):
+        block = jax.lax.dynamic_slice_in_dim(data, i * 128, 128, axis=0)
+        w_hi, w_lo = _bytes_to_words(block)
+        new_state = _compress_block(state, w_hi, w_lo)
+        active = (i < nblocks)[None, None, :]
+        return jnp.where(active, new_state, state), None
+
+    state, _ = jax.lax.scan(per_block, state, jnp.arange(max_blocks))
+
+    # state (8, 2, B) -> (B, 64) big-endian bytes
+    words = state.transpose(2, 0, 1)  # (B, 8, 2) hi/lo
+    shifts = jnp.asarray([24, 16, 8, 0], U32)
+    hi_b = (words[:, :, 0:1] >> shifts[None, None, :]) & 0xFF
+    lo_b = (words[:, :, 1:2] >> shifts[None, None, :]) & 0xFF
+    return jnp.concatenate([hi_b, lo_b], axis=-1).reshape(bsz, 64).astype(jnp.uint8)

@@ -1,0 +1,175 @@
+"""Curve group ops, scalar reduction, and batched verify vs the oracle."""
+
+import hashlib
+import random
+
+import numpy as np
+import jax.numpy as jnp
+
+from firedancer_tpu.ballet import ed25519 as oracle
+from firedancer_tpu.ops import curve25519 as ge
+from firedancer_tpu.ops import fe25519 as fe
+from firedancer_tpu.ops import sc25519 as sc
+from firedancer_tpu.ops.verify import verify_batch
+
+rng = random.Random(0xC0FFEE)
+P = oracle.P
+L = oracle.L
+
+
+def _rand_points(n):
+    """n random curve points (as oracle affine pairs + encodings)."""
+    pts, encs = [], []
+    while len(pts) < n:
+        seed = rng.randrange(2**256).to_bytes(32, "big")
+        _, _, pub = oracle.keypair_from_seed(seed[:32])
+        pt = oracle.point_decompress(pub)
+        pts.append(pt)
+        encs.append(pub)
+    return pts, encs
+
+
+def _enc_batch(encs):
+    return jnp.asarray(np.frombuffer(b"".join(encs), np.uint8).reshape(len(encs), 32))
+
+
+def test_decompress_compress_roundtrip():
+    pts, encs = _rand_points(8)
+    batch = _enc_batch(encs)
+    p, ok = ge.decompress(batch)
+    assert bool(np.all(np.asarray(ok)))
+    out = np.asarray(ge.compress(p))
+    for row, enc in zip(out, encs):
+        assert bytes(row.tobytes()) == enc
+
+
+def test_decompress_rejects_noncurve():
+    bad = []
+    y = 2
+    while len(bad) < 4:
+        enc = y.to_bytes(32, "little")
+        if oracle.point_decompress(enc) is None:
+            bad.append(enc)
+        y += 1
+    _, ok = ge.decompress(_enc_batch(bad))
+    assert not bool(np.any(np.asarray(ok)))
+
+
+def test_point_add_double_vs_oracle():
+    pts, encs = _rand_points(4)
+    p, _ = ge.decompress(_enc_batch(encs))
+    s = ge.point_add(p, p)
+    d = ge.point_double(p)
+    sum_enc = np.asarray(ge.compress(s))
+    dbl_enc = np.asarray(ge.compress(d))
+    for i, pt in enumerate(pts):
+        expect = oracle.point_compress(oracle.point_add(pt, pt))
+        assert bytes(sum_enc[i].tobytes()) == expect
+        assert bytes(dbl_enc[i].tobytes()) == expect
+
+
+def test_sc_reduce64():
+    raws = [rng.randrange(2**512).to_bytes(64, "little") for _ in range(16)]
+    raws += [(L - 1).to_bytes(64, "little"), L.to_bytes(64, "little"),
+             (2 * L).to_bytes(64, "little"), bytes(64),
+             (2**512 - 1).to_bytes(64, "little")]
+    batch = jnp.asarray(np.frombuffer(b"".join(raws), np.uint8).reshape(-1, 64))
+    out = np.asarray(sc.sc_reduce64(batch))
+    for row, raw in zip(out, raws):
+        assert int.from_bytes(row.tobytes(), "little") == \
+            int.from_bytes(raw, "little") % L
+
+
+def test_sc_check_range():
+    cases = [0, 1, L - 1, L, L + 1, 2**252, 2**256 - 1,
+             L + (1 << 200), L - (1 << 200)]
+    batch = jnp.asarray(np.frombuffer(
+        b"".join(c.to_bytes(32, "little") for c in cases), np.uint8
+    ).reshape(-1, 32))
+    got = np.asarray(sc.sc_check_range(batch))
+    for g, c in zip(got, cases):
+        assert bool(g) == (c < L), hex(c)
+
+
+def test_double_scalarmult_vs_oracle():
+    pts, encs = _rand_points(4)
+    p, _ = ge.decompress(_enc_batch(encs))
+    hs = [rng.randrange(L) for _ in range(4)]
+    ss = [rng.randrange(L) for _ in range(4)]
+    h_b = jnp.asarray(np.frombuffer(
+        b"".join(h.to_bytes(32, "little") for h in hs), np.uint8).reshape(4, 32))
+    s_b = jnp.asarray(np.frombuffer(
+        b"".join(s.to_bytes(32, "little") for s in ss), np.uint8).reshape(4, 32))
+    r = ge.double_scalarmult(h_b, p, s_b)
+    out = np.asarray(ge.compress(r))
+    for i, pt in enumerate(pts):
+        expect = oracle.point_compress(
+            oracle.point_add(
+                oracle.scalarmult(hs[i], pt),
+                oracle.scalarmult(ss[i], oracle.B),
+            )
+        )
+        assert bytes(out[i].tobytes()) == expect, f"lane {i}"
+
+
+def _make_verify_batch(cases):
+    """cases: list of (msg, sig, pub). Returns padded arrays."""
+    max_len = max(len(m) for m, _, _ in cases)
+    msgs = np.zeros((len(cases), max(max_len, 1)), np.uint8)
+    lens = np.zeros(len(cases), np.int32)
+    sigs = np.zeros((len(cases), 64), np.uint8)
+    pubs = np.zeros((len(cases), 32), np.uint8)
+    for i, (m, s, p) in enumerate(cases):
+        msgs[i, : len(m)] = np.frombuffer(m, np.uint8)
+        lens[i] = len(m)
+        sigs[i] = np.frombuffer(s, np.uint8)
+        pubs[i] = np.frombuffer(p, np.uint8)
+    return (jnp.asarray(msgs), jnp.asarray(lens), jnp.asarray(sigs),
+            jnp.asarray(pubs))
+
+
+def test_verify_batch_matches_oracle():
+    cases = []
+    # Valid signatures with varied message lengths.
+    for i in range(6):
+        seed = bytes([i + 1]) * 32
+        _, _, pub = oracle.keypair_from_seed(seed)
+        msg = bytes(rng.randrange(256) for _ in range(rng.randrange(200)))
+        cases.append((msg, oracle.sign(msg, seed), pub))
+    # Tampered message.
+    m, s, p = cases[0]
+    cases.append((m + b"!", s, p))
+    # Flipped sig bits (r and s halves).
+    bad = bytearray(cases[1][1]); bad[3] ^= 4
+    cases.append((cases[1][0], bytes(bad), cases[1][2]))
+    bad = bytearray(cases[2][1]); bad[40] ^= 1
+    cases.append((cases[2][0], bytes(bad), cases[2][2]))
+    # s >= L (malleability) and the fork-quirk region.
+    m, s, p = cases[3]
+    s_int = int.from_bytes(s[32:], "little")
+    cases.append((m, s[:32] + ((s_int + L) % 2**256).to_bytes(32, "little"), p))
+    quirk = bytearray(32); quirk[31] = 0x10; quirk[20] = 1
+    cases.append((m, s[:32] + bytes(quirk), p))
+    # Bad pubkey (not on curve).
+    y = 2
+    while oracle.point_decompress(y.to_bytes(32, "little")) is not None:
+        y += 1
+    cases.append((b"msg", bytes(64), y.to_bytes(32, "little")))
+    # Wrong key for a valid sig.
+    cases.append((cases[4][0], cases[4][1], cases[5][2]))
+
+    got = np.asarray(verify_batch(*_make_verify_batch(cases)))
+    for i, (m, s, p) in enumerate(cases):
+        expect = oracle.verify(m, s, p)
+        assert int(got[i]) == expect, f"case {i}: got {got[i]} want {expect}"
+
+
+def test_verify_batch_rfc8032():
+    from tests.test_oracle import RFC8032_VECTORS, _msg_bytes
+
+    cases = [
+        (_msg_bytes(msg), bytes.fromhex(sig), bytes.fromhex(pub))
+        for _, pub, msg, sig in RFC8032_VECTORS
+    ]
+    got = np.asarray(verify_batch(*_make_verify_batch(cases)))
+    assert np.all(got == 0), got

@@ -112,6 +112,12 @@ def test_mul_small():
     got = _unpack(fe.fe_mul_small(A, 121666))
     for g, a in zip(got, A_INTS):
         assert g == a * 121666 % P
+    # Invariant holds after chaining (regression: was 2 carry passes).
+    x = fe.fe_mul_small(fe.fe_mul_small(A, 121666), 121666)
+    assert int(jnp.max(jnp.abs(x))) <= 1024
+    got2 = _unpack(fe.fe_mul(x, BV))
+    for g, a, b in zip(got2, A_INTS, B_INTS):
+        assert g == a * 121666 * 121666 * b % P
 
 
 def test_constants():
