@@ -1,0 +1,104 @@
+"""Benchmark: batched Ed25519 verify throughput on the attached device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Baseline: 1,000,000 verifies/s — one wiredancer FPGA card / 33 Skylake
+cores (reference src/wiredancer/README.md:65-66; BASELINE.md).
+
+Methodology mirrors the reference's test_ed25519 bench harness
+(ballet/ed25519/test_ed25519.c:713-780): warmup, then timed repetitions of
+the full verify (SHA-512 + decompress + double-scalar-mul + compare), with
+correctness asserted on the results. Message size models a typical Solana
+transaction payload (~192 bytes of signed message; MTU is 1232).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _gen_inputs(batch: int, msg_len: int, cache_path: str):
+    """Generate (or load cached) valid signature batches."""
+    if os.path.exists(cache_path):
+        z = np.load(cache_path)
+        if z["msgs"].shape == (batch, msg_len):
+            return z["msgs"], z["lens"], z["sigs"], z["pubs"]
+    from firedancer_tpu.ballet import ed25519 as oracle
+
+    rng = np.random.RandomState(42)
+    n_uniq = 64  # distinct signatures, tiled to the batch
+    msgs = np.zeros((batch, msg_len), np.uint8)
+    lens = np.full(batch, msg_len, np.int32)
+    sigs = np.zeros((batch, 64), np.uint8)
+    pubs = np.zeros((batch, 32), np.uint8)
+    uniq = []
+    for i in range(n_uniq):
+        seed = rng.randint(0, 256, 32, dtype=np.uint8).tobytes()
+        _, _, pub = oracle.keypair_from_seed(seed)
+        m = rng.randint(0, 256, msg_len, dtype=np.uint8)
+        uniq.append((m, oracle.sign(m.tobytes(), seed), pub))
+    for b in range(batch):
+        m, sig, pub = uniq[b % n_uniq]
+        msgs[b] = m
+        sigs[b] = np.frombuffer(sig, np.uint8)
+        pubs[b] = np.frombuffer(pub, np.uint8)
+    np.savez(cache_path, msgs=msgs, lens=lens, sigs=sigs, pubs=pubs)
+    return msgs, lens, sigs, pubs
+
+
+def main():
+    batch = int(os.environ.get("FD_BENCH_BATCH", "8192"))
+    msg_len = int(os.environ.get("FD_BENCH_MSG_LEN", "192"))
+    reps = int(os.environ.get("FD_BENCH_REPS", "10"))
+
+    import jax
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops.verify import verify_batch
+
+    dev = jax.devices()[0]
+    msgs, lens, sigs, pubs = _gen_inputs(
+        batch, msg_len, os.path.join(os.path.dirname(__file__), ".bench_cache.npz")
+    )
+    args = tuple(
+        jax.device_put(jnp.asarray(a), dev) for a in (msgs, lens, sigs, pubs)
+    )
+
+    fn = jax.jit(verify_batch)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    out.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    if not bool((np.asarray(out) == 0).all()):
+        print(json.dumps({"metric": "ed25519_verify_throughput", "value": 0,
+                          "unit": "verifies/s", "vs_baseline": 0.0,
+                          "error": "correctness check failed"}))
+        sys.exit(1)
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    rate = batch * reps / dt
+
+    print(json.dumps({
+        "metric": "ed25519_verify_throughput",
+        "value": round(rate, 1),
+        "unit": "verifies/s",
+        "vs_baseline": round(rate / 1_000_000, 4),
+        "batch": batch,
+        "msg_len": msg_len,
+        "reps": reps,
+        "device": str(dev),
+        "compile_s": round(compile_s, 1),
+        "ms_per_batch": round(1e3 * dt / reps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()
