@@ -1,0 +1,54 @@
+"""Fixed-size base58 encode/decode for 32- and 64-byte values.
+
+Role of the reference's ballet/base58 (fd_base58.h): Solana addresses
+(32 B) and signatures (64 B) in the Bitcoin base58 alphabet. Python big
+ints make the radix conversion trivial; leading-zero handling matches the
+standard ('1' per leading zero byte).
+"""
+
+from __future__ import annotations
+
+ALPHABET = "123456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz"
+_INDEX = {c: i for i, c in enumerate(ALPHABET)}
+
+
+def encode(data: bytes) -> str:
+    n_zeros = len(data) - len(data.lstrip(b"\x00"))
+    num = int.from_bytes(data, "big")
+    out = []
+    while num:
+        num, rem = divmod(num, 58)
+        out.append(ALPHABET[rem])
+    return "1" * n_zeros + "".join(reversed(out))
+
+
+def decode(s: str, expected_len: int | None = None) -> bytes:
+    num = 0
+    for c in s:
+        if c not in _INDEX:
+            raise ValueError(f"invalid base58 char {c!r}")
+        num = num * 58 + _INDEX[c]
+    n_zeros = len(s) - len(s.lstrip("1"))
+    body = num.to_bytes((num.bit_length() + 7) // 8, "big") if num else b""
+    out = b"\x00" * n_zeros + body
+    if expected_len is not None and len(out) != expected_len:
+        raise ValueError(f"decoded {len(out)} bytes, expected {expected_len}")
+    return out
+
+
+def encode32(data: bytes) -> str:
+    assert len(data) == 32
+    return encode(data)
+
+
+def encode64(data: bytes) -> str:
+    assert len(data) == 64
+    return encode(data)
+
+
+def decode32(s: str) -> bytes:
+    return decode(s, 32)
+
+
+def decode64(s: str) -> bytes:
+    return decode(s, 64)

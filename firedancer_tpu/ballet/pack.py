@@ -1,0 +1,179 @@
+"""Block packing: reward-ordered transaction scheduling with account locks.
+
+Role of the reference's fd_pack (/root/reference/src/ballet/pack/fd_pack.c):
+keep a bounded max-heap of pending transactions ordered by estimated
+rewards-per-compute-unit, and schedule the best transaction whose account
+locks don't conflict with anything in flight on any bank thread
+(fd_pack.c:446-461,520-545 conflict rule: a writer conflicts with any other
+use; readers only conflict with writers). Completed transactions release
+their locks.
+
+This CPU implementation is the admissibility oracle for the XLA batched
+graph-coloring scheduler (firedancer_tpu.ops.pack_gc, the BASELINE.json
+stretch goal): any schedule the device version emits must also be accepted
+by this one.
+
+A compute-unit estimator mirrors fd_est_tbl.h's EMA histogram in spirit:
+per-program exponential moving average with a default prior.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class PackTxn:
+    """Scheduling view of a transaction."""
+
+    txn_id: int
+    rewards: int                  # lamports (priority fee + base)
+    est_cus: int                  # estimated compute units
+    writable: frozenset[bytes]    # write-locked account keys
+    readonly: frozenset[bytes]    # read-locked account keys
+
+    @property
+    def score(self) -> float:
+        return self.rewards / max(self.est_cus, 1)
+
+
+class CuEstimator:
+    """Per-program EMA of observed compute units (fd_est_tbl analog)."""
+
+    DEFAULT = 200_000
+    ALPHA = 0.25
+
+    def __init__(self):
+        self._ema: dict[bytes, float] = {}
+
+    def estimate(self, program_keys) -> int:
+        total = 0
+        for k in program_keys:
+            total += int(self._ema.get(k, self.DEFAULT))
+        return max(total, 1)
+
+    def observe(self, program_key: bytes, actual_cus: int) -> None:
+        prev = self._ema.get(program_key, float(self.DEFAULT))
+        self._ema[program_key] = (1 - self.ALPHA) * prev + self.ALPHA * actual_cus
+
+
+class Pack:
+    """Bounded pending heap + per-bank in-flight lock tracking."""
+
+    def __init__(self, bank_cnt: int, depth: int = 4096,
+                 max_cu_per_bank: int = 12_000_000):
+        self.bank_cnt = bank_cnt
+        self.depth = depth
+        self.max_cu_per_bank = max_cu_per_bank
+        self._heap: list[tuple[float, int, PackTxn]] = []  # (-score, seq, txn)
+        self._seq = itertools.count()
+        self._inflight: list[dict[int, PackTxn]] = [dict() for _ in range(bank_cnt)]
+        self._bank_cu: list[int] = [0] * bank_cnt
+        self._write_locks: dict[bytes, int] = {}   # key -> holder txn_id
+        self._read_locks: dict[bytes, int] = {}    # key -> reader count
+        # Diag counters (cnc-style).
+        self.insert_cnt = 0
+        self.drop_cnt = 0
+        self.schedule_cnt = 0
+        self.conflict_skip_cnt = 0
+
+    def pending_cnt(self) -> int:
+        return len(self._heap)
+
+    def insert(self, txn: PackTxn) -> bool:
+        """Queue a transaction; evicts the worst if at depth. False = dropped."""
+        self.insert_cnt += 1
+        if len(self._heap) >= self.depth:
+            worst_idx = max(range(len(self._heap)), key=lambda i: self._heap[i][0])
+            if -self._heap[worst_idx][0] >= txn.score:
+                self.drop_cnt += 1
+                return False
+            self._heap[worst_idx] = self._heap[-1]
+            self._heap.pop()
+            heapq.heapify(self._heap)
+            self.drop_cnt += 1
+        heapq.heappush(self._heap, (-txn.score, next(self._seq), txn))
+        return True
+
+    def _conflicts(self, txn: PackTxn) -> bool:
+        for k in txn.writable:
+            if k in self._write_locks or self._read_locks.get(k, 0) > 0:
+                return True
+        for k in txn.readonly:
+            if k in self._write_locks:
+                return True
+        return False
+
+    def schedule(self, bank_idx: int, scan_limit: int = 64) -> PackTxn | None:
+        """Pop the best non-conflicting pending txn onto bank_idx.
+
+        Scans up to scan_limit heap entries (the reference similarly bounds
+        its search); skipped entries are re-queued.
+        """
+        if self._bank_cu[bank_idx] >= self.max_cu_per_bank:
+            return None
+        skipped = []
+        chosen = None
+        for _ in range(min(scan_limit, len(self._heap))):
+            neg, seq, txn = heapq.heappop(self._heap)
+            if self._bank_cu[bank_idx] + txn.est_cus > self.max_cu_per_bank:
+                skipped.append((neg, seq, txn))
+                continue
+            if self._conflicts(txn):
+                self.conflict_skip_cnt += 1
+                skipped.append((neg, seq, txn))
+                continue
+            chosen = txn
+            break
+        for item in skipped:
+            heapq.heappush(self._heap, item)
+        if chosen is None:
+            return None
+        for k in chosen.writable:
+            self._write_locks[k] = chosen.txn_id
+        for k in chosen.readonly:
+            self._read_locks[k] = self._read_locks.get(k, 0) + 1
+        self._inflight[bank_idx][chosen.txn_id] = chosen
+        self._bank_cu[bank_idx] += chosen.est_cus
+        self.schedule_cnt += 1
+        return chosen
+
+    def complete(self, bank_idx: int, txn_id: int, actual_cus: int | None = None):
+        txn = self._inflight[bank_idx].pop(txn_id)
+        for k in txn.writable:
+            del self._write_locks[k]
+        for k in txn.readonly:
+            n = self._read_locks[k] - 1
+            if n:
+                self._read_locks[k] = n
+            else:
+                del self._read_locks[k]
+        if actual_cus is not None:
+            self._bank_cu[bank_idx] += actual_cus - txn.est_cus
+
+    def end_block(self):
+        """Reset per-block CU budgets (locks persist only via in-flight)."""
+        self._bank_cu = [0] * self.bank_cnt
+
+
+def validate_schedule(batches: list[list[PackTxn]]) -> bool:
+    """Admissibility check: within each parallel batch, no lock conflicts.
+
+    Used to validate device-generated (graph-coloring) schedules against the
+    reference conflict rule.
+    """
+    for batch in batches:
+        writes: set[bytes] = set()
+        reads: set[bytes] = set()
+        for t in batch:
+            for k in t.writable:
+                if k in writes or k in reads:
+                    return False
+            for k in t.readonly:
+                if k in writes:
+                    return False
+            writes |= t.writable
+            reads |= t.readonly
+    return True

@@ -29,3 +29,12 @@ if os.environ.get("FD_TPU_TESTS", "0").lower() not in ("1", "true"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the big verify graph dominates suite time.
+import jax as _jax
+
+_jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache"),
+)
+_jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

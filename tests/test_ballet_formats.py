@@ -1,0 +1,240 @@
+"""txn parser, pack scheduler, base58, tcache."""
+
+import random
+
+import pytest
+
+from firedancer_tpu.ballet import base58
+from firedancer_tpu.ballet import ed25519 as oracle
+from firedancer_tpu.ballet.pack import CuEstimator, Pack, PackTxn, validate_schedule
+from firedancer_tpu.ballet.txn import (
+    TxnParseError,
+    build_txn,
+    parse_txn,
+    read_compact_u16,
+    write_compact_u16,
+)
+from firedancer_tpu.tango.tcache import TCache
+
+rng = random.Random(0x7A7)
+
+
+# ---------- compact-u16 ----------
+
+def test_compact_u16_roundtrip():
+    for v in [0, 1, 0x7F, 0x80, 0x3FFF, 0x4000, 0xFFFF, 1232]:
+        enc = write_compact_u16(v)
+        got, off = read_compact_u16(enc, 0)
+        assert (got, off) == (v, len(enc))
+
+
+def test_compact_u16_rejects_nonminimal():
+    with pytest.raises(TxnParseError):
+        read_compact_u16(b"\x80\x00", 0)   # 0 encoded in 2 bytes
+    with pytest.raises(TxnParseError):
+        read_compact_u16(b"\xff\xff\x04", 0)  # > 0xFFFF
+
+
+# ---------- txn ----------
+
+def _legacy_txn(n_signers=1, n_extra=2, data_sz=24):
+    seeds = [bytes([i + 1]) * 32 for i in range(n_signers)]
+    extra = [bytes([0x40 + i]) * 32 for i in range(n_extra)]
+    instrs = [(n_signers, list(range(n_signers + n_extra)),
+               bytes(rng.randrange(256) for _ in range(data_sz)))]
+    return build_txn(signer_seeds=seeds, extra_accounts=extra,
+                     n_readonly_unsigned=1, instrs=instrs), seeds, extra
+
+
+def test_parse_legacy_roundtrip():
+    wire, seeds, extra = _legacy_txn(n_signers=2, n_extra=3)
+    d = parse_txn(wire)
+    assert d.version == -1
+    assert d.signature_cnt == 2
+    assert d.acct_cnt == 5
+    assert d.num_readonly_unsigned == 1
+    pubs = [oracle.keypair_from_seed(s)[2] for s in seeds]
+    for i, p in enumerate(pubs):
+        assert d.account(wire, i) == p
+    assert d.account(wire, 2) == extra[0]
+    assert len(d.instrs) == 1
+    assert d.instrs[0].program_id_index == 2
+    assert d.total_sz == len(wire)
+
+
+def test_parse_v0_with_luts():
+    wire = build_txn(
+        signer_seeds=[b"\x09" * 32],
+        extra_accounts=[b"\x55" * 32],
+        instrs=[(1, [0], b"hi")],
+        version=0,
+        addr_luts=[(b"\x77" * 32, [1, 2], [3])],
+    )
+    d = parse_txn(wire)
+    assert d.version == 0
+    assert len(d.addr_luts) == 1
+    lut = d.addr_luts[0]
+    assert wire[lut.table_key_off : lut.table_key_off + 32] == b"\x77" * 32
+    assert lut.writable_cnt == 2 and lut.readonly_cnt == 1
+
+
+def test_signatures_verify_against_message():
+    wire, seeds, _ = _legacy_txn(n_signers=2)
+    d = parse_txn(wire)
+    for sig, pub, msg in d.verify_items(wire):
+        assert oracle.verify(msg, sig, pub) == 0
+
+
+def test_writable_classification():
+    # 3 signers (1 readonly-signed), 3 extra (1 readonly-unsigned)
+    wire = build_txn(
+        signer_seeds=[bytes([i + 1]) * 32 for i in range(3)],
+        extra_accounts=[bytes([0x60 + i]) * 32 for i in range(3)],
+        n_readonly_signed=1,
+        n_readonly_unsigned=1,
+        instrs=[],
+    )
+    d = parse_txn(wire)
+    assert [d.is_writable(i) for i in range(6)] == [
+        True, True, False,   # signers: last is readonly
+        True, True, False,   # unsigned: last is readonly
+    ]
+
+
+def test_parse_truncation_sweep():
+    """Every strict prefix must error, never crash (fuzz_txn_parse analog)."""
+    wire, _, _ = _legacy_txn(n_signers=1, n_extra=1)
+    parse_txn(wire)
+    for cut in range(len(wire)):
+        with pytest.raises(TxnParseError):
+            parse_txn(wire[:cut])
+
+
+def test_parse_garbage_fuzz():
+    for _ in range(300):
+        n = rng.randrange(0, 300)
+        blob = bytes(rng.randrange(256) for _ in range(n))
+        try:
+            parse_txn(blob)
+        except TxnParseError:
+            pass  # errors fine; crashes not
+
+
+def test_parse_trailing_bytes_rejected():
+    wire, _, _ = _legacy_txn()
+    with pytest.raises(TxnParseError):
+        parse_txn(wire + b"\x00")
+
+
+# ---------- pack ----------
+
+def _ptxn(i, rewards, cus, w, r=()):
+    return PackTxn(i, rewards, cus,
+                   frozenset(bytes([x]) * 32 for x in w),
+                   frozenset(bytes([x]) * 32 for x in r))
+
+
+def test_pack_priority_order():
+    p = Pack(bank_cnt=1)
+    p.insert(_ptxn(1, rewards=100, cus=100, w=[1]))
+    p.insert(_ptxn(2, rewards=900, cus=100, w=[2]))
+    p.insert(_ptxn(3, rewards=500, cus=100, w=[3]))
+    order = [p.schedule(0).txn_id for _ in range(3)]
+    assert order == [2, 3, 1]
+
+
+def test_pack_write_write_conflict():
+    p = Pack(bank_cnt=2)
+    p.insert(_ptxn(1, 900, 100, w=[7]))
+    p.insert(_ptxn(2, 800, 100, w=[7]))
+    p.insert(_ptxn(3, 700, 100, w=[8]))
+    a = p.schedule(0)
+    b = p.schedule(1)
+    assert a.txn_id == 1
+    assert b.txn_id == 3          # txn 2 blocked by write lock on 7
+    p.complete(0, 1)
+    assert p.schedule(0).txn_id == 2
+
+
+def test_pack_read_write_conflict():
+    p = Pack(bank_cnt=2)
+    p.insert(_ptxn(1, 900, 100, w=[], r=[5]))
+    p.insert(_ptxn(2, 800, 100, w=[5]))
+    p.insert(_ptxn(3, 700, 100, w=[], r=[5]))
+    assert p.schedule(0).txn_id == 1
+    assert p.schedule(1).txn_id == 3    # read-read OK
+    assert p.schedule(1) is None        # writer blocked by readers
+    p.complete(0, 1)
+    p.complete(1, 3)
+    assert p.schedule(0).txn_id == 2
+
+
+def test_pack_depth_eviction():
+    p = Pack(bank_cnt=1, depth=2)
+    p.insert(_ptxn(1, 100, 100, w=[1]))
+    p.insert(_ptxn(2, 200, 100, w=[2]))
+    assert p.insert(_ptxn(3, 50, 100, w=[3])) is False   # worse than all
+    assert p.insert(_ptxn(4, 300, 100, w=[4])) is True   # evicts txn 1
+    ids = {p.schedule(0).txn_id for _ in range(2)}
+    assert ids == {2, 4}
+
+
+def test_pack_cu_budget():
+    p = Pack(bank_cnt=1, max_cu_per_bank=250)
+    p.insert(_ptxn(1, 900, 200, w=[1]))
+    p.insert(_ptxn(2, 800, 200, w=[2]))
+    assert p.schedule(0).txn_id == 1
+    assert p.schedule(0) is None  # over budget
+    p.end_block()
+    assert p.schedule(0).txn_id == 2
+
+
+def test_validate_schedule():
+    good = [[_ptxn(1, 1, 1, w=[1]), _ptxn(2, 1, 1, w=[2], r=[3])],
+            [_ptxn(3, 1, 1, w=[1])]]
+    bad = [[_ptxn(1, 1, 1, w=[1]), _ptxn(2, 1, 1, w=[], r=[1])]]
+    assert validate_schedule(good)
+    assert not validate_schedule(bad)
+
+
+def test_cu_estimator_ema():
+    est = CuEstimator()
+    k = b"\x01" * 32
+    assert est.estimate([k]) == CuEstimator.DEFAULT
+    est.observe(k, 0)
+    assert est.estimate([k]) < CuEstimator.DEFAULT
+
+
+# ---------- base58 ----------
+
+def test_base58_known():
+    # Well-known value: 32 zero bytes -> 32 '1's
+    assert base58.encode32(bytes(32)) == "1" * 32
+    assert base58.decode32("1" * 32) == bytes(32)
+
+
+def test_base58_roundtrip():
+    for n in (32, 64):
+        for _ in range(20):
+            b = bytes(rng.randrange(256) for _ in range(n))
+            assert base58.decode(base58.encode(b), n) == b
+
+
+def test_base58_rejects():
+    with pytest.raises(ValueError):
+        base58.decode("0OIl")
+    with pytest.raises(ValueError):
+        base58.decode32("1")
+
+
+# ---------- tcache ----------
+
+def test_tcache_dedup_and_eviction():
+    tc = TCache(depth=3)
+    assert not tc.insert(1)
+    assert not tc.insert(2)
+    assert not tc.insert(3)
+    assert tc.insert(1)           # dup
+    assert not tc.insert(4)       # evicts 1 (oldest; dup hit didn't refresh)
+    assert not tc.insert(1)       # 1 was evicted
+    assert tc.hit_cnt == 1 and tc.miss_cnt == 5
