@@ -1,0 +1,285 @@
+"""Python bindings for the native tango rings (ctypes over libfdtango.so).
+
+Python tiles (the TPU shim, monitors, tests) join the same shared-memory
+workspace files the native tiles use. The native library implements the
+actual publish/consume protocols (seqlock discipline lives in C++,
+native/tango.cc); Python calls through ctypes, which is fine off the
+nanosecond path — the hot Python-side consumer is the TPU batch shim, which
+drains frags in batches.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from dataclasses import dataclass
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "build",
+    "libfdtango.so",
+)
+
+POLL_EMPTY = 0
+POLL_FRAG = 1
+POLL_OVERRUN = 2
+
+CTL_SOM = 1
+CTL_EOM = 2
+CTL_ERR = 4
+
+CNC_BOOT = 0
+CNC_RUN = 1
+CNC_HALT = 2
+CNC_FAIL = 3
+
+# fseq diag slots (fd_fseq.h:57-63 ABI analog)
+DIAG_PUB_CNT = 0
+DIAG_PUB_SZ = 1
+DIAG_FILT_CNT = 2
+DIAG_FILT_SZ = 3
+DIAG_OVRNP_CNT = 4
+DIAG_OVRNR_CNT = 5
+DIAG_SLOW_CNT = 6
+
+
+def _build_lib():
+    native_dir = os.path.join(os.path.dirname(_LIB_PATH), os.pardir, "native")
+    subprocess.run(["make", "-s"], cwd=os.path.abspath(native_dir), check=True)
+
+
+def load_lib() -> ctypes.CDLL:
+    if not os.path.exists(_LIB_PATH):
+        _build_lib()
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.fd_wksp_create.restype = ctypes.c_void_p
+    lib.fd_wksp_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.fd_wksp_join.restype = ctypes.c_void_p
+    lib.fd_wksp_join.argtypes = [ctypes.c_char_p]
+    lib.fd_wksp_leave.argtypes = [ctypes.c_void_p]
+    lib.fd_wksp_alloc.restype = ctypes.c_uint64
+    lib.fd_wksp_alloc.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_uint64, ctypes.c_uint64]
+    lib.fd_wksp_query.restype = ctypes.c_uint64
+    lib.fd_wksp_query.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.POINTER(ctypes.c_uint64)]
+    lib.fd_wksp_laddr.restype = ctypes.c_void_p
+    lib.fd_wksp_laddr.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.fd_mcache_footprint.restype = ctypes.c_uint64
+    lib.fd_mcache_footprint.argtypes = [ctypes.c_uint64]
+    lib.fd_mcache_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.fd_mcache_depth.restype = ctypes.c_uint64
+    lib.fd_mcache_depth.argtypes = [ctypes.c_void_p]
+    lib.fd_mcache_seq_next.restype = ctypes.c_uint64
+    lib.fd_mcache_seq_next.argtypes = [ctypes.c_void_p]
+    lib.fd_mcache_publish.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint32,
+        ctypes.c_uint16, ctypes.c_uint16, ctypes.c_uint32, ctypes.c_uint32]
+    lib.fd_mcache_poll.restype = ctypes.c_int
+    lib.fd_mcache_poll.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.POINTER(ctypes.c_uint64 * 4)]
+    lib.fd_fseq_footprint.restype = ctypes.c_uint64
+    lib.fd_fseq_init.argtypes = [ctypes.c_void_p]
+    lib.fd_fseq_update.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.fd_fseq_query.restype = ctypes.c_uint64
+    lib.fd_fseq_query.argtypes = [ctypes.c_void_p]
+    lib.fd_fseq_diag_add.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                     ctypes.c_uint64]
+    lib.fd_fseq_diag_get.restype = ctypes.c_uint64
+    lib.fd_fseq_diag_get.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.fd_cnc_footprint.restype = ctypes.c_uint64
+    lib.fd_cnc_init.argtypes = [ctypes.c_void_p]
+    lib.fd_cnc_signal.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.fd_cnc_signal_query.restype = ctypes.c_uint64
+    lib.fd_cnc_signal_query.argtypes = [ctypes.c_void_p]
+    lib.fd_cnc_heartbeat.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.fd_cnc_heartbeat_query.restype = ctypes.c_uint64
+    lib.fd_cnc_heartbeat_query.argtypes = [ctypes.c_void_p]
+    lib.fd_cnc_diag_add.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                    ctypes.c_uint64]
+    lib.fd_cnc_diag_get.restype = ctypes.c_uint64
+    lib.fd_cnc_diag_get.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.fd_dcache_next_chunk.restype = ctypes.c_uint32
+    lib.fd_dcache_next_chunk.argtypes = [ctypes.c_uint32, ctypes.c_uint32,
+                                         ctypes.c_uint32, ctypes.c_uint32]
+    return lib
+
+
+_lib = None
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        _lib = load_lib()
+    return _lib
+
+
+@dataclass
+class Frag:
+    seq: int
+    sig: int
+    chunk: int
+    sz: int
+    ctl: int
+    tsorig: int
+    tspub: int
+
+
+class Workspace:
+    """A named-allocation shared-memory file (wksp + pod-lite)."""
+
+    def __init__(self, handle: int):
+        self._h = handle
+
+    @classmethod
+    def create(cls, path: str, size: int) -> "Workspace":
+        h = lib().fd_wksp_create(path.encode(), size)
+        if not h:
+            raise OSError(f"wksp create failed: {path}")
+        return cls(h)
+
+    @classmethod
+    def join(cls, path: str) -> "Workspace":
+        h = lib().fd_wksp_join(path.encode())
+        if not h:
+            raise OSError(f"wksp join failed: {path}")
+        return cls(h)
+
+    def leave(self):
+        lib().fd_wksp_leave(self._h)
+        self._h = None
+
+    def alloc(self, name: str, sz: int, align: int = 64) -> int:
+        off = lib().fd_wksp_alloc(self._h, name.encode(), sz, align)
+        if not off:
+            raise MemoryError(f"wksp alloc failed: {name}")
+        return off
+
+    def query(self, name: str) -> tuple[int, int]:
+        sz = ctypes.c_uint64()
+        off = lib().fd_wksp_query(self._h, name.encode(), ctypes.byref(sz))
+        if not off:
+            raise KeyError(name)
+        return off, sz.value
+
+    def laddr(self, off: int) -> int:
+        return lib().fd_wksp_laddr(self._h, off)
+
+    def view(self, name: str) -> memoryview:
+        off, sz = self.query(name)
+        addr = self.laddr(off)
+        return (ctypes.c_char * sz).from_address(addr)
+
+
+class MCache:
+    def __init__(self, wksp: Workspace, name: str, depth: int | None = None,
+                 create: bool = False):
+        if create:
+            assert depth is not None and depth & (depth - 1) == 0
+            fp = lib().fd_mcache_footprint(depth)
+            off = wksp.alloc(name, fp)
+            self._mem = wksp.laddr(off)
+            lib().fd_mcache_init(self._mem, depth)
+        else:
+            off, _ = wksp.query(name)
+            self._mem = wksp.laddr(off)
+        self.depth = lib().fd_mcache_depth(self._mem)
+
+    def seq_next(self) -> int:
+        return lib().fd_mcache_seq_next(self._mem)
+
+    def publish(self, seq: int, sig: int, chunk: int, sz: int, ctl: int,
+                tsorig: int = 0, tspub: int = 0):
+        lib().fd_mcache_publish(self._mem, seq, sig, chunk, sz, ctl,
+                                tsorig, tspub)
+
+    def poll(self, seq: int) -> tuple[int, Frag | None]:
+        out = (ctypes.c_uint64 * 4)()
+        r = lib().fd_mcache_poll(self._mem, seq, ctypes.byref(out))
+        if r != POLL_FRAG:
+            return r, None
+        sig, b, ts, s = out
+        return r, Frag(seq=s, sig=sig, chunk=(b >> 32) & 0xFFFFFFFF,
+                       sz=(b >> 16) & 0xFFFF, ctl=b & 0xFFFF,
+                       tsorig=(ts >> 32) & 0xFFFFFFFF, tspub=ts & 0xFFFFFFFF)
+
+
+class DCache:
+    """Payload region; numpy/memoryview access by chunk index."""
+
+    def __init__(self, wksp: Workspace, name: str, data_sz: int | None = None,
+                 create: bool = False):
+        if create:
+            assert data_sz is not None and data_sz % 64 == 0
+            off = wksp.alloc(name, data_sz)
+        else:
+            off, data_sz = wksp.query(name)
+        self._buf = (ctypes.c_char * data_sz).from_address(wksp.laddr(off))
+        self.data_sz = data_sz
+        self.chunk_cnt = data_sz // 64
+
+    def write(self, chunk: int, data: bytes):
+        o = chunk * 64
+        self._buf[o : o + len(data)] = data
+
+    def read(self, chunk: int, sz: int) -> bytes:
+        o = chunk * 64
+        return bytes(self._buf[o : o + sz])
+
+    def next_chunk(self, chunk: int, sz: int, mtu: int) -> int:
+        return lib().fd_dcache_next_chunk(chunk, sz, (mtu + 63) // 64,
+                                          self.chunk_cnt)
+
+
+class FSeq:
+    def __init__(self, wksp: Workspace, name: str, create: bool = False):
+        if create:
+            off = wksp.alloc(name, lib().fd_fseq_footprint())
+            self._mem = wksp.laddr(off)
+            lib().fd_fseq_init(self._mem)
+        else:
+            off, _ = wksp.query(name)
+            self._mem = wksp.laddr(off)
+
+    def update(self, seq: int):
+        lib().fd_fseq_update(self._mem, seq)
+
+    def query(self) -> int:
+        return lib().fd_fseq_query(self._mem)
+
+    def diag_add(self, idx: int, delta: int):
+        lib().fd_fseq_diag_add(self._mem, idx, delta)
+
+    def diag(self, idx: int) -> int:
+        return lib().fd_fseq_diag_get(self._mem, idx)
+
+
+class Cnc:
+    def __init__(self, wksp: Workspace, name: str, create: bool = False):
+        if create:
+            off = wksp.alloc(name, lib().fd_cnc_footprint())
+            self._mem = wksp.laddr(off)
+            lib().fd_cnc_init(self._mem)
+        else:
+            off, _ = wksp.query(name)
+            self._mem = wksp.laddr(off)
+
+    def signal(self, sig: int):
+        lib().fd_cnc_signal(self._mem, sig)
+
+    def signal_query(self) -> int:
+        return lib().fd_cnc_signal_query(self._mem)
+
+    def heartbeat(self, now: int):
+        lib().fd_cnc_heartbeat(self._mem, now)
+
+    def heartbeat_query(self) -> int:
+        return lib().fd_cnc_heartbeat_query(self._mem)
+
+    def diag_add(self, idx: int, delta: int):
+        lib().fd_cnc_diag_add(self._mem, idx, delta)
+
+    def diag(self, idx: int) -> int:
+        return lib().fd_cnc_diag_get(self._mem, idx)

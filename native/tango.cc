@@ -1,0 +1,310 @@
+// tango — shared-memory tile messaging for the TPU-native firedancer.
+//
+// Role of the reference's src/tango layer (fd_tango_base.h, mcache/, dcache/,
+// fseq/, cnc/): single-writer lock-free rings carrying fragment metadata
+// (mcache) and payload bytes (dcache) between host tiles, with consumer
+// progress (fseq), command-and-control (cnc), and overrun detection by
+// sequence-number gaps — "lossy by design", credits only where loss is
+// unacceptable. The design here is written fresh in C++17 with C11-style
+// atomics via <atomic>; the contract (not the code) follows the reference:
+//
+//   frag_meta: 32 bytes {seq, sig, chunk, sz, ctl, tsorig, tspub}
+//     published with release semantics on the seq word; readers load seq
+//     (acquire), copy the body, re-load seq, and retry/flag on mismatch.
+//   mcache: power-of-2 depth array of frag_meta, line = seq & (depth-1).
+//     The producer OVERWRITES without waiting: a lapped reader detects the
+//     gap because the stored seq jumped by depth.
+//   dcache: flat payload region addressed by 64-byte "chunk" granules.
+//   fseq:  consumer-published progress seq + diag counters
+//          (pub/filt/ovrnp/ovrnr/slow — fd_fseq.h:57-63 ABI analog).
+//   cnc:   BOOT/RUN/HALT/FAIL signal word + heartbeat + 64-byte diag.
+//
+// All objects live inside one mmap'd "workspace" file with a tiny named-
+// allocation table, so (a) any process can join by path, (b) the file IS a
+// checkpoint of the IPC universe (the reference's wksp property,
+// fd_funk.h:136-140), and (c) Python joins the same memory via mmap through
+// the ctypes wrapper (firedancer_tpu/tango/rings.py).
+//
+// Exposed as a C ABI for ctypes; native tiles link it directly.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <new>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------- workspace
+
+static constexpr uint64_t WKSP_MAGIC = 0xFD7A9005EC7A11ULL;
+static constexpr uint32_t WKSP_MAX_ALLOCS = 256;
+static constexpr uint32_t WKSP_NAME_MAX = 40;
+
+struct wksp_alloc_ent {
+  char name[WKSP_NAME_MAX];
+  uint64_t off;
+  uint64_t sz;
+};
+
+struct wksp_hdr {
+  uint64_t magic;
+  uint64_t total_sz;
+  std::atomic<uint64_t> used;      // bump allocator high-water mark
+  std::atomic<uint32_t> alloc_cnt;
+  uint32_t pad;
+  wksp_alloc_ent allocs[WKSP_MAX_ALLOCS];
+};
+
+struct wksp_join {
+  void* base;
+  uint64_t sz;
+  int fd;
+};
+
+static uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+// Create (or truncate) a workspace file of total_sz bytes and map it.
+wksp_join* fd_wksp_create(const char* path, uint64_t total_sz) {
+  int fd = ::open(path, O_RDWR | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return nullptr;
+  if (::ftruncate(fd, (off_t)total_sz) != 0) { ::close(fd); return nullptr; }
+  void* base = ::mmap(nullptr, total_sz, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) { ::close(fd); return nullptr; }
+  auto* h = new (base) wksp_hdr();
+  h->magic = WKSP_MAGIC;
+  h->total_sz = total_sz;
+  h->used.store(align_up(sizeof(wksp_hdr), 64), std::memory_order_relaxed);
+  h->alloc_cnt.store(0, std::memory_order_release);
+  auto* j = new wksp_join{base, total_sz, fd};
+  return j;
+}
+
+wksp_join* fd_wksp_join(const char* path) {
+  int fd = ::open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (::fstat(fd, &st) != 0) { ::close(fd); return nullptr; }
+  void* base = ::mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                      MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) { ::close(fd); return nullptr; }
+  auto* h = (wksp_hdr*)base;
+  if (h->magic != WKSP_MAGIC) { ::munmap(base, (size_t)st.st_size); ::close(fd); return nullptr; }
+  return new wksp_join{base, (uint64_t)st.st_size, fd};
+}
+
+void fd_wksp_leave(wksp_join* j) {
+  if (!j) return;
+  ::munmap(j->base, j->sz);
+  ::close(j->fd);
+  delete j;
+}
+
+// Allocate `sz` bytes under `name`; returns offset or 0 on failure.
+// Single-threaded setup-phase API (topology build), like configure/frank.c.
+uint64_t fd_wksp_alloc(wksp_join* j, const char* name, uint64_t sz, uint64_t align) {
+  auto* h = (wksp_hdr*)j->base;
+  if (align < 64) align = 64;
+  uint32_t n = h->alloc_cnt.load(std::memory_order_acquire);
+  if (n >= WKSP_MAX_ALLOCS) return 0;
+  uint64_t off = align_up(h->used.load(std::memory_order_relaxed), align);
+  if (off + sz > h->total_sz) return 0;
+  h->used.store(off + sz, std::memory_order_relaxed);
+  wksp_alloc_ent* e = &h->allocs[n];
+  std::strncpy(e->name, name, WKSP_NAME_MAX - 1);
+  e->name[WKSP_NAME_MAX - 1] = 0;
+  e->off = off;
+  e->sz = sz;
+  std::memset((char*)j->base + off, 0, sz);
+  h->alloc_cnt.store(n + 1, std::memory_order_release);
+  return off;
+}
+
+uint64_t fd_wksp_query(wksp_join* j, const char* name, uint64_t* sz_out) {
+  auto* h = (wksp_hdr*)j->base;
+  uint32_t n = h->alloc_cnt.load(std::memory_order_acquire);
+  for (uint32_t i = 0; i < n; i++) {
+    if (!std::strncmp(h->allocs[i].name, name, WKSP_NAME_MAX)) {
+      if (sz_out) *sz_out = h->allocs[i].sz;
+      return h->allocs[i].off;
+    }
+  }
+  return 0;
+}
+
+void* fd_wksp_laddr(wksp_join* j, uint64_t off) { return (char*)j->base + off; }
+
+// ---------------------------------------------------------------- frag meta
+
+// 32-byte metadata record. seq is the synchronization word.
+struct frag_meta {
+  std::atomic<uint64_t> seq;
+  uint64_t sig;
+  uint32_t chunk;
+  uint16_t sz;
+  uint16_t ctl;
+  uint32_t tsorig;
+  uint32_t tspub;
+};
+static_assert(sizeof(frag_meta) == 32, "frag_meta must be 32 bytes");
+
+// ctl bits (fd_tango_base.h SOM/EOM/ERR analog)
+static constexpr uint16_t CTL_SOM = 1u << 0;
+static constexpr uint16_t CTL_EOM = 1u << 1;
+static constexpr uint16_t CTL_ERR = 1u << 2;
+
+// mcache = header {depth, seq0, pad} + frag_meta[depth]
+struct mcache_hdr {
+  uint64_t depth;                       // power of 2
+  std::atomic<uint64_t> seq_next;       // producer's next seq (monotonic)
+  char pad[48];
+};
+
+uint64_t fd_mcache_footprint(uint64_t depth) {
+  return sizeof(mcache_hdr) + depth * sizeof(frag_meta);
+}
+
+void fd_mcache_init(void* mem, uint64_t depth) {
+  auto* h = new (mem) mcache_hdr();
+  h->depth = depth;
+  h->seq_next.store(0, std::memory_order_release);
+  auto* line = (frag_meta*)((char*)mem + sizeof(mcache_hdr));
+  for (uint64_t i = 0; i < depth; i++)
+    line[i].seq.store(~0ULL, std::memory_order_relaxed);  // "never published"
+}
+
+uint64_t fd_mcache_depth(void* mem) { return ((mcache_hdr*)mem)->depth; }
+
+uint64_t fd_mcache_seq_next(void* mem) {
+  return ((mcache_hdr*)mem)->seq_next.load(std::memory_order_acquire);
+}
+
+// Producer: publish frag `seq` (must equal seq_next). Body stores first,
+// then the seq word with release order — readers that observe seq==expected
+// are guaranteed a coherent body.
+void fd_mcache_publish(void* mem, uint64_t seq, uint64_t sig, uint32_t chunk,
+                       uint16_t sz, uint16_t ctl, uint32_t tsorig, uint32_t tspub) {
+  auto* h = (mcache_hdr*)mem;
+  auto* line = (frag_meta*)((char*)mem + sizeof(mcache_hdr));
+  frag_meta* m = &line[seq & (h->depth - 1)];
+  // Seqlock write protocol: invalidate the line, full fence so the body
+  // stores cannot hoist above the sentinel, write body, then publish the
+  // new seq with release (ordering the body before it).
+  m->seq.store(~0ULL, std::memory_order_release);
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  m->sig = sig;
+  m->chunk = chunk;
+  m->sz = sz;
+  m->ctl = ctl;
+  m->tsorig = tsorig;
+  m->tspub = tspub;
+  m->seq.store(seq, std::memory_order_release);
+  h->seq_next.store(seq + 1, std::memory_order_release);
+}
+
+// Consumer poll results
+enum { POLL_EMPTY = 0, POLL_FRAG = 1, POLL_OVERRUN = 2 };
+
+// Try to consume frag `seq`. On FRAG, *out receives a coherent copy.
+// On OVERRUN the caller was lapped: it should resync to seq_next.
+int fd_mcache_poll(void* mem, uint64_t seq, uint64_t* out /*4 u64: sig,chunk|sz|ctl,tsorig|tspub, seq*/) {
+  auto* h = (mcache_hdr*)mem;
+  auto* line = (frag_meta*)((char*)mem + sizeof(mcache_hdr));
+  frag_meta* m = &line[seq & (h->depth - 1)];
+  uint64_t s0 = m->seq.load(std::memory_order_acquire);
+  if (s0 == seq) {
+    uint64_t sig = m->sig;
+    uint64_t b = ((uint64_t)m->chunk << 32) | ((uint64_t)m->sz << 16) | m->ctl;
+    uint64_t ts = ((uint64_t)m->tsorig << 32) | m->tspub;
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    uint64_t s1 = m->seq.load(std::memory_order_acquire);
+    if (s1 == seq) {
+      out[0] = sig; out[1] = b; out[2] = ts; out[3] = seq;
+      return POLL_FRAG;
+    }
+    return POLL_OVERRUN;  // overwritten mid-copy
+  }
+  if (s0 == ~0ULL || s0 < seq) {
+    // Sentinel (publish in progress) or an older lap still in the line:
+    // frag seq is not visible in this line YET. Return EMPTY even if
+    // seq_next says the producer moved past seq — the line write may
+    // simply not be in our view yet (the seq load predates the seq_next
+    // load), and declaring overrun here would be a false positive. A true
+    // overrun always becomes visible as s0 > seq on a later poll.
+    return POLL_EMPTY;
+  }
+  return POLL_OVERRUN;  // line holds a newer seq: lapped
+}
+
+// ---------------------------------------------------------------- fseq / cnc
+
+struct fseq_obj {
+  std::atomic<uint64_t> seq;     // consumer progress
+  uint64_t diag[7];              // PUB_CNT, PUB_SZ, FILT_CNT, FILT_SZ,
+                                 // OVRNP_CNT, OVRNR_CNT, SLOW_CNT
+};
+
+uint64_t fd_fseq_footprint() { return sizeof(fseq_obj); }
+void fd_fseq_init(void* mem) { new (mem) fseq_obj(); }
+void fd_fseq_update(void* mem, uint64_t seq) {
+  ((fseq_obj*)mem)->seq.store(seq, std::memory_order_release);
+}
+uint64_t fd_fseq_query(void* mem) {
+  return ((fseq_obj*)mem)->seq.load(std::memory_order_acquire);
+}
+void fd_fseq_diag_add(void* mem, uint32_t idx, uint64_t delta) {
+  __atomic_fetch_add(&((fseq_obj*)mem)->diag[idx], delta, __ATOMIC_RELAXED);
+}
+uint64_t fd_fseq_diag_get(void* mem, uint32_t idx) {
+  return __atomic_load_n(&((fseq_obj*)mem)->diag[idx], __ATOMIC_RELAXED);
+}
+
+// cnc: signal word + heartbeat + diag region
+enum { CNC_BOOT = 0, CNC_RUN = 1, CNC_HALT = 2, CNC_FAIL = 3 };
+
+struct cnc_obj {
+  std::atomic<uint64_t> signal;
+  std::atomic<uint64_t> heartbeat;
+  uint64_t diag[8];
+};
+
+uint64_t fd_cnc_footprint() { return sizeof(cnc_obj); }
+void fd_cnc_init(void* mem) { new (mem) cnc_obj(); }
+void fd_cnc_signal(void* mem, uint64_t sig) {
+  ((cnc_obj*)mem)->signal.store(sig, std::memory_order_release);
+}
+uint64_t fd_cnc_signal_query(void* mem) {
+  return ((cnc_obj*)mem)->signal.load(std::memory_order_acquire);
+}
+void fd_cnc_heartbeat(void* mem, uint64_t now) {
+  ((cnc_obj*)mem)->heartbeat.store(now, std::memory_order_release);
+}
+uint64_t fd_cnc_heartbeat_query(void* mem) {
+  return ((cnc_obj*)mem)->heartbeat.load(std::memory_order_acquire);
+}
+void fd_cnc_diag_add(void* mem, uint32_t idx, uint64_t delta) {
+  __atomic_fetch_add(&((cnc_obj*)mem)->diag[idx], delta, __ATOMIC_RELAXED);
+}
+uint64_t fd_cnc_diag_get(void* mem, uint32_t idx) {
+  return __atomic_load_n(&((cnc_obj*)mem)->diag[idx], __ATOMIC_RELAXED);
+}
+
+// ---------------------------------------------------------------- dcache
+
+// Payload region addressed in 64-byte chunks; helper computing the next
+// write position after a frag of sz bytes, wrapping to 0 whenever a
+// maximum-size (mtu) frag would no longer fit (compact ring layout,
+// fd_dcache_compact_next analog).
+uint32_t fd_dcache_next_chunk(uint32_t chunk, uint32_t sz, uint32_t mtu_chunks,
+                              uint32_t data_sz_chunks) {
+  uint32_t next = chunk + ((sz + 63u) >> 6);
+  if (next + mtu_chunks > data_sz_chunks) next = 0;
+  return next;
+}
+
+}  // extern "C"

@@ -1,0 +1,174 @@
+// Multi-process tango ring stress test (test_frag_tx/rx analog).
+//
+// Forks one producer and N consumers over a shared workspace file. The
+// producer publishes `cnt` frags whose payloads carry a checksum of
+// (seq, sig); reliable consumers are flow-controlled via their fseq
+// (producer respects credits, so they must see EVERY frag intact);
+// an unreliable consumer runs with random stalls and must account for
+// every frag as either received-intact or counted-overrun.
+//
+// Exit code 0 = all invariants held.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <sys/wait.h>
+#include <unistd.h>
+
+extern "C" {
+struct wksp_join;
+wksp_join* fd_wksp_create(const char*, uint64_t);
+wksp_join* fd_wksp_join(const char*);
+void fd_wksp_leave(wksp_join*);
+uint64_t fd_wksp_alloc(wksp_join*, const char*, uint64_t, uint64_t);
+uint64_t fd_wksp_query(wksp_join*, const char*, uint64_t*);
+void* fd_wksp_laddr(wksp_join*, uint64_t);
+uint64_t fd_mcache_footprint(uint64_t);
+void fd_mcache_init(void*, uint64_t);
+uint64_t fd_mcache_seq_next(void*);
+void fd_mcache_publish(void*, uint64_t, uint64_t, uint32_t, uint16_t, uint16_t,
+                       uint32_t, uint32_t);
+int fd_mcache_poll(void*, uint64_t, uint64_t*);
+uint64_t fd_fseq_footprint();
+void fd_fseq_init(void*);
+void fd_fseq_update(void*, uint64_t);
+uint64_t fd_fseq_query(void*);
+void fd_fseq_diag_add(void*, uint32_t, uint64_t);
+uint64_t fd_fseq_diag_get(void*, uint32_t);
+uint32_t fd_dcache_next_chunk(uint32_t, uint32_t, uint32_t, uint32_t);
+}
+
+enum { POLL_EMPTY = 0, POLL_FRAG = 1, POLL_OVERRUN = 2 };
+enum { DIAG_PUB_CNT = 0, DIAG_PUB_SZ = 1, DIAG_OVRNR = 5 };
+
+static constexpr uint64_t DEPTH = 128;
+static constexpr uint32_t MTU = 1280;
+static constexpr uint32_t MTU_CHUNKS = (MTU + 63) / 64;
+static constexpr uint32_t DATA_CHUNKS = 4096;
+
+static uint64_t mix(uint64_t x) {  // cheap payload checksum seed
+  x ^= x >> 33; x *= 0xFF51AFD7ED558CCDULL;
+  x ^= x >> 33; x *= 0xC4CEB9FE1A85EC53ULL;
+  return x ^ (x >> 33);
+}
+
+int producer(const char* path, uint64_t cnt, int n_reliable) {
+  wksp_join* w = fd_wksp_join(path);
+  void* mc = fd_wksp_laddr(w, fd_wksp_query(w, "mcache", nullptr));
+  uint8_t* dc = (uint8_t*)fd_wksp_laddr(w, fd_wksp_query(w, "dcache", nullptr));
+  void* fs[8];
+  for (int i = 0; i < n_reliable; i++) {
+    char name[32];
+    snprintf(name, sizeof name, "fseq%d", i);
+    fs[i] = fd_wksp_laddr(w, fd_wksp_query(w, name, nullptr));
+  }
+  uint32_t chunk = 0;
+  for (uint64_t seq = 0; seq < cnt; seq++) {
+    // Flow control: reliable consumers must be within DEPTH-4 frags.
+    for (;;) {
+      uint64_t min_seen = ~0ULL;
+      for (int i = 0; i < n_reliable; i++) {
+        uint64_t s = fd_fseq_query(fs[i]);
+        if (s < min_seen) min_seen = s;
+      }
+      if (n_reliable == 0 || seq < min_seen + DEPTH - 4) break;
+      usleep(50);
+    }
+    uint16_t sz = (uint16_t)(64 + (mix(seq) % 512));
+    uint64_t sig = mix(seq ^ 0xABCD);
+    uint64_t* payload = (uint64_t*)(dc + (uint64_t)chunk * 64);
+    for (uint32_t k = 0; k < sz / 8; k++) payload[k] = mix(seq * 1315423911u + k);
+    fd_mcache_publish(mc, seq, sig, chunk, sz, 3 /*SOM|EOM*/, (uint32_t)seq, 0);
+    chunk = fd_dcache_next_chunk(chunk, sz, MTU_CHUNKS, DATA_CHUNKS);
+  }
+  fd_wksp_leave(w);
+  return 0;
+}
+
+int consumer(const char* path, uint64_t cnt, int idx, bool reliable) {
+  wksp_join* w = fd_wksp_join(path);
+  void* mc = fd_wksp_laddr(w, fd_wksp_query(w, "mcache", nullptr));
+  uint8_t* dc = (uint8_t*)fd_wksp_laddr(w, fd_wksp_query(w, "dcache", nullptr));
+  char name[32];
+  snprintf(name, sizeof name, "fseq%d", idx);
+  void* fs = fd_wksp_laddr(w, fd_wksp_query(w, name, nullptr));
+
+  uint64_t seq = 0, got = 0, ovrn = 0, bad = 0;
+  uint64_t out[4];
+  uint64_t spin = 0;
+  while (seq < cnt) {
+    int r = fd_mcache_poll(mc, seq, out);
+    if (r == POLL_EMPTY) {
+      if (++spin > 2'000'000'000ULL) { fprintf(stderr, "c%d stuck at %lu\n", idx, seq); return 3; }
+      continue;
+    }
+    spin = 0;
+    if (r == POLL_OVERRUN) {
+      uint64_t next = fd_mcache_seq_next(mc);
+      ovrn += next - seq < cnt - seq ? next - seq : cnt - seq;
+      fd_fseq_diag_add(fs, DIAG_OVRNR, 1);
+      seq = next;
+      if (reliable) { fprintf(stderr, "reliable c%d overrun at %lu!\n", idx, seq); return 2; }
+      fd_fseq_update(fs, seq);
+      continue;
+    }
+    // FRAG: validate checksum if the payload region is still coherent.
+    uint64_t sig = out[0];
+    uint32_t chunk = (uint32_t)(out[1] >> 32);
+    uint16_t sz = (uint16_t)(out[1] >> 16);
+    if (sig != mix(seq ^ 0xABCD)) bad++;
+    if (reliable) {
+      // Payload must be intact for flow-controlled consumers.
+      uint64_t* payload = (uint64_t*)(dc + (uint64_t)chunk * 64);
+      for (uint32_t k = 0; k < sz / 8; k++)
+        if (payload[k] != mix(seq * 1315423911u + k)) { bad++; break; }
+    } else if (idx & 1) {
+      usleep(mix(seq) % 200);  // stall to force laps
+    }
+    got++;
+    seq++;
+    fd_fseq_update(fs, seq);
+    fd_fseq_diag_add(fs, DIAG_PUB_CNT, 1);
+  }
+  bool ok = (bad == 0) && (reliable ? (got == cnt && ovrn == 0) : (got + ovrn == cnt));
+  fprintf(stderr, "consumer %d (%s): got=%lu ovrn=%lu bad=%lu -> %s\n", idx,
+          reliable ? "reliable" : "unreliable", got, ovrn, bad, ok ? "OK" : "FAIL");
+  fd_wksp_leave(w);
+  return ok ? 0 : 1;
+}
+
+int main(int argc, char** argv) {
+  const char* path = argc > 1 ? argv[1] : "/tmp/fd_tango_stress.wksp";
+  uint64_t cnt = argc > 2 ? strtoull(argv[2], nullptr, 10) : 200000;
+  int n_reliable = 2, n_unreliable = 2;
+  int n_total = n_reliable + n_unreliable;
+
+  wksp_join* w = fd_wksp_create(path, 1ULL << 22);
+  fd_mcache_init(fd_wksp_laddr(w, fd_wksp_alloc(w, "mcache", fd_mcache_footprint(DEPTH), 64)), DEPTH);
+  fd_wksp_alloc(w, "dcache", (uint64_t)DATA_CHUNKS * 64, 64);
+  for (int i = 0; i < n_total; i++) {
+    char name[32];
+    snprintf(name, sizeof name, "fseq%d", i);
+    fd_fseq_init(fd_wksp_laddr(w, fd_wksp_alloc(w, name, fd_fseq_footprint(), 64)));
+  }
+  fd_wksp_leave(w);
+
+  pid_t pids[16];
+  int n = 0;
+  for (int i = 0; i < n_reliable; i++)
+    if (!(pids[n++] = fork())) _exit(consumer(path, cnt, i, true));
+  for (int i = 0; i < n_unreliable; i++)
+    if (!(pids[n++] = fork())) _exit(consumer(path, cnt, n_reliable + i, false));
+  if (!(pids[n++] = fork())) _exit(producer(path, cnt, n_reliable));
+
+  int rc = 0, st;
+  for (int i = 0; i < n; i++) {
+    waitpid(pids[i], &st, 0);
+    if (!WIFEXITED(st) || WEXITSTATUS(st)) rc = 1;
+  }
+  fprintf(stderr, "tango_stress: %s\n", rc ? "FAIL" : "PASS");
+  return rc;
+}

@@ -1,0 +1,156 @@
+"""tango rings: native library contract + Python<->C++ multi-process IPC."""
+
+import multiprocessing as mp
+import os
+import subprocess
+import tempfile
+
+import pytest
+
+from firedancer_tpu.tango.rings import (
+    CNC_RUN,
+    DIAG_PUB_CNT,
+    POLL_EMPTY,
+    POLL_FRAG,
+    POLL_OVERRUN,
+    Cnc,
+    DCache,
+    FSeq,
+    MCache,
+    Workspace,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def wksp_path(tmp_path):
+    return str(tmp_path / "test.wksp")
+
+
+def test_workspace_create_join_query(wksp_path):
+    w = Workspace.create(wksp_path, 1 << 20)
+    off = w.alloc("thing", 256)
+    assert off % 64 == 0
+    w2 = Workspace.join(wksp_path)
+    off2, sz2 = w2.query("thing")
+    assert (off2, sz2) == (off, 256)
+    with pytest.raises(KeyError):
+        w2.query("missing")
+    w.leave()
+    w2.leave()
+
+
+def test_mcache_publish_poll(wksp_path):
+    w = Workspace.create(wksp_path, 1 << 20)
+    mc = MCache(w, "mc", depth=8, create=True)
+    r, _ = mc.poll(0)
+    assert r == POLL_EMPTY
+    mc.publish(0, sig=0xDEAD, chunk=3, sz=100, ctl=3, tsorig=42, tspub=43)
+    r, f = mc.poll(0)
+    assert r == POLL_FRAG
+    assert (f.sig, f.chunk, f.sz, f.ctl, f.tsorig, f.tspub) == \
+        (0xDEAD, 3, 100, 3, 42, 43)
+    # Overrun: wrap depth+ past seq 0
+    for s in range(1, 10):
+        mc.publish(s, sig=s, chunk=0, sz=8, ctl=3)
+    r, _ = mc.poll(1)  # line 1 now holds seq 9
+    assert r == POLL_OVERRUN
+    assert mc.seq_next() == 10
+    w.leave()
+
+
+def test_dcache_roundtrip_and_wrap(wksp_path):
+    w = Workspace.create(wksp_path, 1 << 20)
+    dc = DCache(w, "dc", data_sz=64 * 64, create=True)
+    dc.write(5, b"hello world")
+    assert dc.read(5, 11) == b"hello world"
+    nxt = dc.next_chunk(0, sz=100, mtu=1232)
+    assert nxt == 2
+    # Near the end, a full-MTU frag can't fit: wrap to 0.
+    assert dc.next_chunk(60, sz=64, mtu=1232) == 0
+    w.leave()
+
+
+def test_fseq_cnc(wksp_path):
+    w = Workspace.create(wksp_path, 1 << 20)
+    fs = FSeq(w, "fs", create=True)
+    fs.update(7)
+    assert fs.query() == 7
+    fs.diag_add(DIAG_PUB_CNT, 3)
+    assert fs.diag(DIAG_PUB_CNT) == 3
+    cnc = Cnc(w, "cnc", create=True)
+    cnc.signal(CNC_RUN)
+    assert cnc.signal_query() == CNC_RUN
+    cnc.heartbeat(123456)
+    assert cnc.heartbeat_query() == 123456
+    w.leave()
+
+
+def _py_producer(path, cnt):
+    w = Workspace.join(path)
+    mc = MCache(w, "mc")
+    dc = DCache(w, "dc")
+    fs = FSeq(w, "fs")
+    chunk = 0
+    for seq in range(cnt):
+        payload = seq.to_bytes(8, "little") * 8
+        # flow control: stay within depth-2 of the consumer
+        while seq >= fs.query() + mc.depth - 2:
+            pass
+        dc.write(chunk, payload)
+        mc.publish(seq, sig=seq ^ 0x5555, chunk=chunk, sz=64, ctl=3)
+        chunk = dc.next_chunk(chunk, 64, 1232)
+    w.leave()
+
+
+def test_python_producer_consumer_processes(wksp_path):
+    """Python producer process -> Python consumer (reliable, zero loss)."""
+    w = Workspace.create(wksp_path, 1 << 20)
+    MCache(w, "mc", depth=16, create=True)
+    DCache(w, "dc", data_sz=64 * 256, create=True)
+    FSeq(w, "fs", create=True)
+
+    cnt = 2000
+    p = mp.get_context("spawn").Process(target=_py_producer, args=(wksp_path, cnt))
+    p.start()
+    wc = Workspace.join(wksp_path)
+    mc = MCache(wc, "mc")
+    dc = DCache(wc, "dc")
+    fs = FSeq(wc, "fs")
+    got = 0
+    seq = 0
+    spins = 0
+    while seq < cnt:
+        r, f = mc.poll(seq)
+        if r == POLL_EMPTY:
+            spins += 1
+            assert spins < 50_000_000, f"stuck at {seq}"
+            continue
+        assert r == POLL_FRAG, f"reliable consumer overrun at {seq}"
+        assert f.sig == seq ^ 0x5555
+        payload = dc.read(f.chunk, f.sz)
+        assert payload == seq.to_bytes(8, "little") * 8
+        got += 1
+        seq += 1
+        fs.update(seq)
+    p.join(timeout=30)
+    assert p.exitcode == 0
+    assert got == cnt
+    w.leave()
+    wc.leave()
+
+
+def test_native_stress_binary():
+    """The C++ multi-process stress test (reliable + unreliable consumers)."""
+    binary = os.path.join(REPO, "build", "tango_stress")
+    if not os.path.exists(binary):
+        subprocess.run(["make", "-s"], cwd=os.path.join(REPO, "native"),
+                       check=True)
+    with tempfile.TemporaryDirectory() as d:
+        r = subprocess.run(
+            [binary, os.path.join(d, "s.wksp"), "100000"],
+            capture_output=True, timeout=120, text=True,
+        )
+    assert r.returncode == 0, r.stderr
+    assert "PASS" in r.stderr
