@@ -4,4 +4,20 @@ Role mirrors the reference's ``src/ballet`` (fd_ballet.h): standalone,
 stateless implementations of every Solana-ecosystem standard the pipeline
 needs. Everything here is plain CPU Python/NumPy and serves as the bit-exact
 oracle for the JAX/TPU kernels in ``firedancer_tpu.ops``.
+
+Components (reference parity, SURVEY.md §2.3):
+  ed25519   sign/verify/keygen oracle        (ballet/ed25519/)
+  sha256    streaming SHA-256                (ballet/sha256/)
+  keccak256 Keccak-256, Ethereum padding     (ballet/keccak256/)
+  blake3    BLAKE3 tree hash                 (ballet/blake3/)
+  chacha20  block fn + ChaCha20Rng           (ballet/chacha20/)
+  base58    32/64-byte encode/decode         (ballet/base58/)
+  bmtree    SHA-256 merkle commitments       (ballet/bmtree/)
+  poh       proof-of-history hashchain       (ballet/poh/)
+  shred     shred wire format                (ballet/shred/)
+  txn       transaction parser + compact_u16 (ballet/txn/)
+  pack      block packing scheduler          (ballet/pack/)
+  murmur3   murmur3_32                       (ballet/murmur3/)
+  hmac      HMAC-SHA{256,384,512}            (ballet/hmac/)
+  hexutil   hex decode                       (ballet/hex/)
 """

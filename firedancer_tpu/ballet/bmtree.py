@@ -1,0 +1,131 @@
+"""Binary SHA-256 Merkle tree vector commitments (Solana protocol).
+
+Role parity with the reference's fd_bmtree20/fd_bmtree32
+(/root/reference/src/ballet/bmtree/fd_bmtree_tmpl.c): leaf nodes are
+SHA-256(0x00 || data), branch nodes SHA-256(0x01 || left || right), hashes
+truncated to 20 (shred) or 32 bytes; a layer's trailing odd node is merged
+with a duplicate of itself (fd_bmtree_tmpl.c:460-495 ascent logic).
+
+Besides the streaming commit (root only, O(log n) memory) this module adds
+the derived operations the reference documents as TODO (fd_bmtree_tmpl.c
+"Example derived methods"): full-tree build, inclusion-proof generation and
+verification.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Sequence
+
+_LEAF_PREFIX = b"\x00"
+_BRANCH_PREFIX = b"\x01"
+
+
+def _sha(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def hash_leaf(data: bytes, hash_sz: int = 32) -> bytes:
+    return _sha(_LEAF_PREFIX + data)[:hash_sz]
+
+
+def merge(a: bytes, b: bytes, hash_sz: int = 32) -> bytes:
+    return _sha(_BRANCH_PREFIX + a[:hash_sz] + b[:hash_sz])[:hash_sz]
+
+
+class BmtreeCommit:
+    """Streaming commitment: append leaf nodes, fini -> root.
+
+    Keeps one buffered node per layer (the reference's node_buf), so
+    memory is O(log n) for n leaves.
+    """
+
+    def __init__(self, hash_sz: int = 32) -> None:
+        assert hash_sz in (20, 32)
+        self.hash_sz = hash_sz
+        self.leaf_cnt = 0
+        self._buf: List[bytes] = []  # buffered left-sibling per layer
+
+    def append_leaf_data(self, data: bytes) -> "BmtreeCommit":
+        return self.append(hash_leaf(data, self.hash_sz))
+
+    def append(self, node: bytes) -> "BmtreeCommit":
+        layer = 0
+        cnt = self.leaf_cnt + 1
+        # Carry: merge whenever this completes a pair at a layer.
+        while (cnt & 1) == 0:
+            node = merge(self._buf[layer], node, self.hash_sz)
+            layer += 1
+            cnt >>= 1
+        if layer == len(self._buf):
+            self._buf.append(node)
+        else:
+            self._buf[layer] = node
+        self.leaf_cnt += 1
+        return self
+
+    def fini(self) -> bytes:
+        assert self.leaf_cnt > 0
+        # Ascend from the lowest populated layer, duplicating odd nodes.
+        cnt = self.leaf_cnt
+        layer = (cnt & -cnt).bit_length() - 1  # first layer with odd count
+        node = self._buf[layer]
+        layer_cnt = cnt >> layer
+        while layer_cnt > 1:
+            if layer_cnt & 1:
+                node = merge(node, node, self.hash_sz)  # single child: dup
+            else:
+                node = merge(self._buf[layer], node, self.hash_sz)
+            layer += 1
+            layer_cnt = (layer_cnt + 1) >> 1
+        return node
+
+
+def build_tree(leaves: Sequence[bytes], hash_sz: int = 32) -> List[List[bytes]]:
+    """Full tree as layers[0]=leaf nodes ... layers[-1]=[root]."""
+    assert leaves
+    layers = [[hash_leaf(d, hash_sz) for d in leaves]]
+    while len(layers[-1]) > 1:
+        cur = layers[-1]
+        nxt = []
+        for i in range(0, len(cur), 2):
+            left = cur[i]
+            right = cur[i + 1] if i + 1 < len(cur) else cur[i]
+            nxt.append(merge(left, right, hash_sz))
+        layers.append(nxt)
+    return layers
+
+
+def root(leaves: Sequence[bytes], hash_sz: int = 32) -> bytes:
+    return build_tree(leaves, hash_sz)[-1][0]
+
+
+def inclusion_proof(
+    layers: List[List[bytes]], leaf_idx: int
+) -> List[bytes]:
+    """Sibling path from leaf to root (excludes the root)."""
+    proof = []
+    idx = leaf_idx
+    for layer in layers[:-1]:
+        sib = idx ^ 1
+        proof.append(layer[sib] if sib < len(layer) else layer[idx])
+        idx >>= 1
+    return proof
+
+
+def verify_inclusion(
+    leaf_data: bytes,
+    leaf_idx: int,
+    proof: Sequence[bytes],
+    expected_root: bytes,
+    hash_sz: int = 32,
+) -> bool:
+    node = hash_leaf(leaf_data, hash_sz)
+    idx = leaf_idx
+    for sib in proof:
+        if idx & 1:
+            node = merge(sib, node, hash_sz)
+        else:
+            node = merge(node, sib, hash_sz)
+        idx >>= 1
+    return node == expected_root

@@ -1,0 +1,120 @@
+"""ChaCha20 block function + ChaCha20-based RNG (Solana protocol RNG).
+
+Role parity with the reference's fd_chacha20 / fd_chacha20rng
+(/root/reference/src/ballet/chacha20/fd_chacha20.h, fd_chacha20rng.h):
+the block function per RFC 7539 and the rand_chacha-compatible RNG used
+for Solana leader schedules/shuffles (ChaCha20Rng::from_seed semantics —
+zero nonce, block counter from 0, little-endian u64 draws), including the
+widening-multiply rejection sampler `ulong_roll` (Uniform<u64> compatible).
+"""
+
+from __future__ import annotations
+
+import struct
+
+FD_CHACHA20_BLOCK_SZ = 64
+_MASK32 = 0xFFFFFFFF
+_SIGMA = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)  # "expand 32-byte k"
+_MASK64 = (1 << 64) - 1
+
+
+def _rotl(v: int, n: int) -> int:
+    return ((v << n) | (v >> (32 - n))) & _MASK32
+
+
+def _quarter(s, a, b, c, d):
+    s[a] = (s[a] + s[b]) & _MASK32
+    s[d] = _rotl(s[d] ^ s[a], 16)
+    s[c] = (s[c] + s[d]) & _MASK32
+    s[b] = _rotl(s[b] ^ s[c], 12)
+    s[a] = (s[a] + s[b]) & _MASK32
+    s[d] = _rotl(s[d] ^ s[a], 8)
+    s[c] = (s[c] + s[d]) & _MASK32
+    s[b] = _rotl(s[b] ^ s[c], 7)
+
+
+def chacha20_block(key: bytes, block_idx: int, nonce: bytes) -> bytes:
+    """One 64-byte keystream block (RFC 7539 §2.3; 32-bit counter)."""
+    assert len(key) == 32 and len(nonce) == 12
+    init = list(_SIGMA) + list(struct.unpack("<8I", key)) + [
+        block_idx & _MASK32
+    ] + list(struct.unpack("<3I", nonce))
+    s = list(init)
+    for _ in range(10):
+        _quarter(s, 0, 4, 8, 12)
+        _quarter(s, 1, 5, 9, 13)
+        _quarter(s, 2, 6, 10, 14)
+        _quarter(s, 3, 7, 11, 15)
+        _quarter(s, 0, 5, 10, 15)
+        _quarter(s, 1, 6, 11, 12)
+        _quarter(s, 2, 7, 8, 13)
+        _quarter(s, 3, 4, 9, 14)
+    out = [(s[i] + init[i]) & _MASK32 for i in range(16)]
+    return struct.pack("<16I", *out)
+
+
+def chacha20_encrypt(key: bytes, nonce: bytes, counter: int, data: bytes) -> bytes:
+    """XOR data with the keystream starting at block `counter`."""
+    out = bytearray(len(data))
+    for off in range(0, len(data), FD_CHACHA20_BLOCK_SZ):
+        ks = chacha20_block(key, counter + off // FD_CHACHA20_BLOCK_SZ, nonce)
+        seg = data[off : off + FD_CHACHA20_BLOCK_SZ]
+        out[off : off + len(seg)] = bytes(a ^ b for a, b in zip(seg, ks))
+    return bytes(out)
+
+
+_ZERO_NONCE = b"\x00" * 12
+
+
+class ChaCha20Rng:
+    """rand_chacha::ChaCha20Rng-compatible RNG (fd_chacha20rng parity)."""
+
+    __slots__ = ("_key", "_buf", "_off", "_idx")
+
+    def __init__(self, seed: bytes) -> None:
+        self.init(seed)
+
+    def init(self, seed: bytes) -> "ChaCha20Rng":
+        assert len(seed) == 32
+        self._key = bytes(seed)
+        self._buf = b""
+        self._off = 0
+        self._idx = 0
+        return self
+
+    def _refill(self) -> None:
+        blocks = [
+            chacha20_block(self._key, self._idx + i, _ZERO_NONCE) for i in range(4)
+        ]
+        self._idx += 4
+        self._buf = self._buf[self._off :] + b"".join(blocks)
+        self._off = 0
+
+    def ulong(self) -> int:
+        """Next u64, little-endian off the keystream."""
+        if len(self._buf) - self._off < 8:
+            self._refill()
+        v = int.from_bytes(self._buf[self._off : self._off + 8], "little")
+        self._off += 8
+        return v
+
+    def ulong_roll(self, n: int) -> int:
+        """Uniform in [0, n) — rand Uniform<u64> widening-multiply rejection
+        (matches fd_chacha20rng_ulong_roll, fd_chacha20rng.h:126-150)."""
+        assert 0 < n <= _MASK64 + 1
+        z = ((_MASK64 - n + 1) % n)
+        zone = _MASK64 - z
+        while True:
+            v = self.ulong()
+            res = v * n
+            lo = res & _MASK64
+            if lo <= zone:
+                return res >> 64
+
+    def shuffle(self, items: list) -> list:
+        """Fisher-Yates using ulong_roll (leader-schedule shuffle order)."""
+        items = list(items)
+        for i in range(len(items) - 1, 0, -1):
+            j = self.ulong_roll(i + 1)
+            items[i], items[j] = items[j], items[i]
+        return items

@@ -1,0 +1,31 @@
+"""Hex decode helper (fd_hex parity: /root/reference/src/ballet/hex/).
+
+Decodes like the reference: stops at the first non-hex character and
+reports how many full bytes were decoded.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+_HEX = {c: i for i, c in enumerate("0123456789abcdef")}
+_HEX.update({c: i for i, c in enumerate("0123456789ABCDEF")})
+
+
+def hex_decode(s: str, max_bytes: int = 1 << 30) -> Tuple[bytes, int]:
+    """Decode hex pairs; returns (bytes, count decoded). Stops early on a
+    non-hex char or an odd trailing nibble (partial byte is dropped)."""
+    out = bytearray()
+    i = 0
+    while i + 1 < len(s) and len(out) < max_bytes:
+        hi = _HEX.get(s[i])
+        lo = _HEX.get(s[i + 1])
+        if hi is None or lo is None:
+            break
+        out.append((hi << 4) | lo)
+        i += 2
+    return bytes(out), len(out)
+
+
+def hex_encode(data: bytes) -> str:
+    return data.hex()

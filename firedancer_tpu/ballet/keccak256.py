@@ -1,0 +1,110 @@
+"""Keccak-256 (pre-NIST padding, Ethereum-compatible).
+
+Role parity with the reference's fd_keccak256
+(/root/reference/src/ballet/keccak256/fd_keccak256.{h,c}): the hash behind
+Solana's keccak256 syscall. Note this is *Keccak* padding (0x01 domain
+byte), not SHA3-256 (0x06) — hashlib.sha3_256 is NOT a substitute, which
+is why this is a from-scratch Keccak-f[1600] implementation.
+
+Rate 136 bytes (capacity 512), 24 rounds, 64-bit lanes, little-endian.
+"""
+
+from __future__ import annotations
+
+FD_KECCAK256_HASH_SZ = 32
+_RATE = 136
+_MASK64 = (1 << 64) - 1
+
+# Keccak-f[1600] round constants (from the LFSR defined in FIPS 202 §3.2.5).
+_RC = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A, 0x8000000080008000,
+    0x000000000000808B, 0x0000000080000001, 0x8000000080008081, 0x8000000000008009,
+    0x000000000000008A, 0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089, 0x8000000000008003,
+    0x8000000000008002, 0x8000000000000080, 0x000000000000800A, 0x800000008000000A,
+    0x8000000080008081, 0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+
+# Rotation offsets r[x][y] (FIPS 202 Table 2), flattened index = x + 5*y.
+_ROT = [
+    0, 1, 62, 28, 27,
+    36, 44, 6, 55, 20,
+    3, 10, 43, 25, 39,
+    41, 45, 15, 21, 8,
+    18, 2, 61, 56, 14,
+]
+
+
+def _rotl(v: int, n: int) -> int:
+    n &= 63
+    return ((v << n) | (v >> (64 - n))) & _MASK64
+
+
+def _keccak_f(a: list) -> None:
+    for rc in _RC:
+        # theta
+        c = [a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rotl(c[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            for y in range(5):
+                a[x + 5 * y] ^= d[x]
+        # rho + pi
+        b = [0] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl(a[x + 5 * y], _ROT[x + 5 * y])
+        # chi
+        for y in range(5):
+            row = b[5 * y : 5 * y + 5]
+            for x in range(5):
+                a[x + 5 * y] = row[x] ^ ((~row[(x + 1) % 5]) & row[(x + 2) % 5])
+        # iota
+        a[0] ^= rc
+
+
+class Keccak256:
+    """Streaming Keccak-256: init -> append* -> fini (fd lifecycle)."""
+
+    __slots__ = ("_state", "_buf")
+
+    def __init__(self) -> None:
+        self.init()
+
+    def init(self) -> "Keccak256":
+        self._state = [0] * 25
+        self._buf = b""
+        return self
+
+    def append(self, data: bytes) -> "Keccak256":
+        buf = self._buf + data
+        off = 0
+        view = memoryview(buf)
+        while len(buf) - off >= _RATE:
+            self._absorb(view[off : off + _RATE])
+            off += _RATE
+        self._buf = bytes(view[off:])
+        return self
+
+    def _absorb(self, block: bytes) -> None:
+        for i in range(_RATE // 8):
+            self._state[i] ^= int.from_bytes(block[8 * i : 8 * i + 8], "little")
+        _keccak_f(self._state)
+
+    def fini(self) -> bytes:
+        # Keccak padding: 0x01 ... 0x80 (multirate, pre-NIST domain byte).
+        pad_len = _RATE - len(self._buf)
+        if pad_len == 1:
+            block = self._buf + b"\x81"
+        else:
+            block = self._buf + b"\x01" + b"\x00" * (pad_len - 2) + b"\x80"
+        self._absorb(block)
+        out = b"".join(
+            self._state[i].to_bytes(8, "little") for i in range(4)
+        )
+        self.init()
+        return out
+
+
+def keccak256(data: bytes) -> bytes:
+    """One-shot Keccak-256."""
+    return Keccak256().append(data).fini()

@@ -1,0 +1,44 @@
+"""Murmur3-32 hash.
+
+Role parity with the reference's fd_murmur3
+(/root/reference/src/ballet/murmur3/fd_murmur3.{h,c}): the 32-bit
+MurmurHash3 used to derive sBPF call destinations from symbol hashes.
+"""
+
+from __future__ import annotations
+
+_M32 = 0xFFFFFFFF
+
+
+def _rotl(v: int, n: int) -> int:
+    return ((v << n) | (v >> (32 - n))) & _M32
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    h = seed & _M32
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    n = len(data)
+    for i in range(0, n - 3, 4):
+        k = int.from_bytes(data[i : i + 4], "little")
+        k = (k * c1) & _M32
+        k = _rotl(k, 15)
+        k = (k * c2) & _M32
+        h ^= k
+        h = _rotl(h, 13)
+        h = (h * 5 + 0xE6546B64) & _M32
+    tail = data[n & ~3 :]
+    k = 0
+    for i, b in enumerate(tail):
+        k |= b << (8 * i)
+    if k:
+        k = (k * c1) & _M32
+        k = _rotl(k, 15)
+        k = (k * c2) & _M32
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M32
+    h ^= h >> 16
+    return h

@@ -1,0 +1,60 @@
+"""Proof-of-History hashchain (CPU path).
+
+Role parity with the reference's fd_poh
+(/root/reference/src/ballet/poh/fd_poh.h: fd_poh_append(state, n) recursive
+SHA-256 + fd_poh_mixin): state' = SHA-256(state) iterated, and
+state' = SHA-256(state || mixin) to fold in an entry hash.
+
+The batched/TPU path (verify many entry segments in parallel) lives in
+firedancer_tpu.ops.sha256.poh_append_batch — the serial-per-chain,
+parallel-across-chains structure is the same trick the tree uses for
+entry verification.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional, Sequence, Tuple
+
+
+class Poh:
+    """PoH state: 32-byte rolling hash."""
+
+    __slots__ = ("state",)
+
+    def __init__(self, seed: bytes = b"\x00" * 32) -> None:
+        assert len(seed) == 32
+        self.state = bytes(seed)
+
+    def append(self, n: int) -> "Poh":
+        s = self.state
+        for _ in range(n):
+            s = hashlib.sha256(s).digest()
+        self.state = s
+        return self
+
+    def mixin(self, mix: bytes) -> "Poh":
+        assert len(mix) == 32
+        self.state = hashlib.sha256(self.state + mix).digest()
+        return self
+
+
+def verify_entries(
+    seed: bytes,
+    entries: Sequence[Tuple[int, Optional[bytes], bytes]],
+) -> bool:
+    """Check a chain of (num_hashes, mixin_or_None, expected_state) entries.
+
+    Each entry advances the chain num_hashes-1 appends followed by either a
+    mixin (transaction entry) or one more append (tick), then must equal
+    expected_state.
+    """
+    poh = Poh(seed)
+    for num_hashes, mix, expected in entries:
+        if mix is None:
+            poh.append(num_hashes)
+        else:
+            poh.append(num_hashes - 1).mixin(mix)
+        if poh.state != expected:
+            return False
+    return True

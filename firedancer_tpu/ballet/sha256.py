@@ -1,0 +1,42 @@
+"""SHA-256 streaming API (CPU oracle path).
+
+Mirrors the reference's fd_sha256 lifecycle
+(/root/reference/src/ballet/sha256/fd_sha256.h: init/append/fini plus a
+one-shot fd_sha256_hash). The reference's hot core is SHA-NI assembly
+(fd_sha256_core_shaext.S); our CPU backend is hashlib (OpenSSL's asm core),
+which plays the same role — the batched TPU path lives in
+firedancer_tpu.ops.sha256 and is the analog of the AVX 8-way batch API
+(fd_sha256_batch_avx.c).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+FD_SHA256_HASH_SZ = 32
+FD_SHA256_BLOCK_SZ = 64
+
+
+class Sha256:
+    """Streaming SHA-256: init -> append* -> fini (reference lifecycle)."""
+
+    __slots__ = ("_h",)
+
+    def __init__(self) -> None:
+        self._h = hashlib.sha256()
+
+    def init(self) -> "Sha256":
+        self._h = hashlib.sha256()
+        return self
+
+    def append(self, data: bytes) -> "Sha256":
+        self._h.update(data)
+        return self
+
+    def fini(self) -> bytes:
+        return self._h.digest()
+
+
+def sha256(data: bytes) -> bytes:
+    """One-shot hash (fd_sha256_hash equivalent)."""
+    return hashlib.sha256(data).digest()

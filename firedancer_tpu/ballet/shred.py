@@ -1,0 +1,190 @@
+"""Solana shred wire format: parse/build/validate.
+
+Role parity with the reference's fd_shred
+(/root/reference/src/ballet/shred/fd_shred.h): 1228-byte shreds with an
+83-byte common header (signature, variant, slot, idx, version,
+fec_set_idx), a 5-byte data or 6-byte coding header, payload, and for
+merkle variants a trailing inclusion proof of 20-byte nodes.
+
+Layout offsets (fd_shred.h struct fd_shred, packed little-endian):
+  0x00 signature[64] | 0x40 variant | 0x41 slot u64 | 0x49 idx u32 |
+  0x4d version u16 | 0x4f fec_set_idx u32 |
+  data: 0x53 parent_off u16, 0x55 flags u8, 0x56 size u16   (hdr 0x58)
+  code: 0x53 data_cnt u16, 0x55 code_cnt u16, 0x57 idx u16  (hdr 0x59)
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import List, Optional
+
+FD_SHRED_SZ = 1228
+FD_SHRED_DATA_HEADER_SZ = 0x58
+FD_SHRED_CODE_HEADER_SZ = 0x59
+FD_SHRED_MERKLE_NODE_SZ = 20
+
+FD_SHRED_TYPE_LEGACY_DATA = 0xA
+FD_SHRED_TYPE_LEGACY_CODE = 0x5
+FD_SHRED_TYPE_MERKLE_DATA = 0x8
+FD_SHRED_TYPE_MERKLE_CODE = 0x4
+
+FD_SHRED_DATA_REF_TICK_MASK = 0x3F
+FD_SHRED_DATA_FLAG_SLOT_COMPLETE = 0x80
+FD_SHRED_DATA_FLAG_FEC_SET_COMPLETE = 0x40
+
+
+def shred_type(variant: int) -> int:
+    return variant >> 4
+
+
+def shred_variant(type_: int, merkle_cnt: int = 0) -> int:
+    """Encode the variant byte (fd_shred.h fd_shred_variant)."""
+    low = (merkle_cnt - 1) & 0xF
+    if type_ in (FD_SHRED_TYPE_LEGACY_DATA, FD_SHRED_TYPE_LEGACY_CODE):
+        low = type_ ^ 0xF
+    return ((type_ << 4) | low) & 0xFF
+
+
+def shred_merkle_cnt(variant: int) -> int:
+    t = shred_type(variant)
+    if t not in (FD_SHRED_TYPE_MERKLE_DATA, FD_SHRED_TYPE_MERKLE_CODE):
+        return 0
+    return (variant & 0xF) + 1
+
+
+def shred_header_sz(variant: int) -> int:
+    t = shred_type(variant)
+    if t in (FD_SHRED_TYPE_MERKLE_DATA, FD_SHRED_TYPE_LEGACY_DATA):
+        return FD_SHRED_DATA_HEADER_SZ
+    if t in (FD_SHRED_TYPE_MERKLE_CODE, FD_SHRED_TYPE_LEGACY_CODE):
+        return FD_SHRED_CODE_HEADER_SZ
+    return 0
+
+
+def shred_merkle_sz(variant: int) -> int:
+    return shred_merkle_cnt(variant) * FD_SHRED_MERKLE_NODE_SZ
+
+
+@dataclass
+class Shred:
+    signature: bytes
+    variant: int
+    slot: int
+    idx: int
+    version: int
+    fec_set_idx: int
+    # data header
+    parent_off: int = 0
+    flags: int = 0
+    size: int = 0
+    # code header
+    data_cnt: int = 0
+    code_cnt: int = 0
+    code_idx: int = 0
+    payload: bytes = b""
+    merkle_proof: Optional[List[bytes]] = None
+
+    @property
+    def type(self) -> int:
+        return shred_type(self.variant)
+
+    @property
+    def is_data(self) -> bool:
+        return self.type in (FD_SHRED_TYPE_LEGACY_DATA, FD_SHRED_TYPE_MERKLE_DATA)
+
+    @property
+    def ref_tick(self) -> int:
+        return self.flags & FD_SHRED_DATA_REF_TICK_MASK
+
+    @property
+    def slot_complete(self) -> bool:
+        return bool(self.flags & FD_SHRED_DATA_FLAG_SLOT_COMPLETE)
+
+    @property
+    def data(self) -> bytes:
+        """Data-shred payload trimmed to the size field (the payload
+        attribute is the full fixed-extent region, fd_shred_payload_sz)."""
+        assert self.is_data
+        hdr_sz = shred_header_sz(self.variant)
+        merkle_sz = shred_merkle_sz(self.variant)
+        return self.payload[: max(0, self.size - hdr_sz - merkle_sz)]
+
+
+def parse(buf: bytes) -> Optional[Shred]:
+    """Parse + validate an untrusted shred (fd_shred_parse semantics).
+
+    Returns None on malformed input.
+    """
+    if len(buf) < 0x53:
+        return None
+    variant = buf[0x40]
+    t = shred_type(variant)
+    hdr_sz = shred_header_sz(variant)
+    if hdr_sz == 0 or len(buf) < hdr_sz:
+        return None
+    # Legacy variants must carry the fixed low-nibble pattern.
+    if t in (FD_SHRED_TYPE_LEGACY_DATA, FD_SHRED_TYPE_LEGACY_CODE):
+        if (variant & 0xF) != (t ^ 0xF):
+            return None
+    slot, idx, version, fec_set_idx = struct.unpack_from("<QIHI", buf, 0x41)
+    s = Shred(
+        signature=bytes(buf[:0x40]),
+        variant=variant,
+        slot=slot,
+        idx=idx,
+        version=version,
+        fec_set_idx=fec_set_idx,
+    )
+    # Payload region and merkle proof are at FIXED offsets within the
+    # 1228-byte shred regardless of the data `size` field
+    # (fd_shred.h:230-243 fd_shred_payload_sz / fd_shred_merkle_off).
+    merkle_sz = shred_merkle_sz(variant)
+    if len(buf) < FD_SHRED_SZ:
+        return None
+    if s.is_data:
+        s.parent_off, s.flags, s.size = struct.unpack_from("<HBH", buf, 0x53)
+        # size covers headers (+ merkle proof) and must fit the shred.
+        if s.size < hdr_sz + merkle_sz or s.size > FD_SHRED_SZ:
+            return None
+    else:
+        s.data_cnt, s.code_cnt, s.code_idx = struct.unpack_from("<HHH", buf, 0x53)
+        if s.data_cnt == 0 or s.code_cnt == 0:
+            return None
+        if s.code_idx >= s.code_cnt:
+            return None
+    s.payload = bytes(buf[hdr_sz : FD_SHRED_SZ - merkle_sz])
+    proof_bytes = buf[FD_SHRED_SZ - merkle_sz : FD_SHRED_SZ]
+    if merkle_sz:
+        s.merkle_proof = [
+            bytes(proof_bytes[i : i + FD_SHRED_MERKLE_NODE_SZ])
+            for i in range(0, merkle_sz, FD_SHRED_MERKLE_NODE_SZ)
+        ]
+    return s
+
+
+def build(s: Shred) -> bytes:
+    """Serialize a Shred to wire bytes (inverse of parse, for tests/gen).
+
+    Payload is padded into the fixed-extent region; the merkle proof goes
+    at the fixed tail offset (fd_shred_merkle_off). For data shreds the
+    size field is computed from the un-padded payload length.
+    """
+    hdr_sz = shred_header_sz(s.variant)
+    assert hdr_sz
+    merkle = b"".join(s.merkle_proof or [])
+    assert len(merkle) == shred_merkle_sz(s.variant)
+    buf = bytearray(FD_SHRED_SZ)
+    buf[:0x40] = s.signature.ljust(0x40, b"\x00")[:0x40]
+    buf[0x40] = s.variant
+    struct.pack_into("<QIHI", buf, 0x41, s.slot, s.idx, s.version, s.fec_set_idx)
+    if s.is_data:
+        size = s.size or (hdr_sz + len(s.payload) + len(merkle))
+        struct.pack_into("<HBH", buf, 0x53, s.parent_off, s.flags, size)
+    else:
+        struct.pack_into("<HHH", buf, 0x53, s.data_cnt, s.code_cnt, s.code_idx)
+    end = FD_SHRED_SZ - len(merkle)
+    pay = s.payload.ljust(end - hdr_sz, b"\x00")[: end - hdr_sz]
+    buf[hdr_sz:end] = pay
+    buf[end:] = merkle
+    return bytes(buf)

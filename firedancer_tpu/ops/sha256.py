@@ -1,0 +1,196 @@
+"""Batched SHA-256 and PoH chains on TPU (JAX/XLA).
+
+Role: TPU analog of the reference's 8-way AVX SHA-256 batch API
+(/root/reference/src/ballet/sha256/fd_sha256_batch_avx.c) and of the PoH
+hashchain (/root/reference/src/ballet/poh/fd_poh.h). SHA-256 words are
+native uint32, so unlike the SHA-512 kernel no hi/lo pairing is needed —
+everything is elementwise uint32 on the VPU with the batch riding the
+128-wide lane axis (lane-major (..., B) layout).
+
+PoH is serial within a chain but embarrassingly parallel across chains:
+poh_append_batch runs B independent hashchains in lockstep, which is how a
+slot's entry hashes are verified in parallel (each entry's segment is one
+lane; the per-lane `n` masks shorter segments).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+U32 = jnp.uint32
+
+# FIPS 180-4 SHA-256 round constants / IV.
+_K = [
+    0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+    0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+    0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+    0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+    0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+    0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+    0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+    0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+    0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+    0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+    0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+]
+_IV = [
+    0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+    0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+]
+_K_ARR = jnp.asarray(np.asarray(_K, np.uint32))
+_IV_ARR = np.asarray(_IV, np.uint32)
+
+
+def _rotr(x, n):
+    return (x >> n) | (x << (32 - n))
+
+
+def _compress_block(state, w):
+    """One SHA-256 compression. state: (8, B) uint32, w: (16, B) uint32."""
+
+    def extend(window, _):
+        s0 = _rotr(window[1], 7) ^ _rotr(window[1], 18) ^ (window[1] >> 3)
+        s1 = _rotr(window[14], 17) ^ _rotr(window[14], 19) ^ (window[14] >> 10)
+        nw = window[0] + s0 + window[9] + s1
+        return jnp.concatenate([window[1:], nw[None]], axis=0), nw
+
+    _, ext = jax.lax.scan(extend, w, None, length=48)
+    sched = jnp.concatenate([w, ext], axis=0)  # (64, B)
+
+    def round_fn(vs, inputs):
+        k, wt = inputs
+        a, b, c, d, e, f, g, h = vs
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        return (t1 + t2, a, b, c, d + t1, e, f, g), None
+
+    batch = w.shape[1:]
+    init = tuple(state[i] for i in range(8))
+    k_b = jnp.broadcast_to(_K_ARR[:, None], (64,) + batch) if batch else _K_ARR
+    final, _ = jax.lax.scan(round_fn, init, (k_b, sched))
+    return jnp.stack([state[i] + final[i] for i in range(8)])
+
+
+def _bytes_to_words(block_bytes):
+    """(64, B) uint8 big-endian -> (16, B) uint32."""
+    b = block_bytes.astype(U32).reshape((16, 4) + block_bytes.shape[1:])
+    return (b[:, 0] << 24) | (b[:, 1] << 16) | (b[:, 2] << 8) | b[:, 3]
+
+
+def _state_to_bytes(state):
+    """(8, B) uint32 -> (B, 32) uint8 big-endian."""
+    words = jnp.moveaxis(state, 0, -1)  # (B, 8)
+    shifts = jnp.asarray([24, 16, 8, 0], U32)
+    by = (words[..., None] >> shifts[None, None, :]) & 0xFF
+    return by.reshape(words.shape[:-1] + (32,)).astype(jnp.uint8)
+
+
+def _bytes_to_state(digests):
+    """(B, 32) uint8 -> (8, B) uint32 big-endian words."""
+    b = digests.astype(U32).reshape(digests.shape[:-1] + (8, 4))
+    words = (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
+    return jnp.moveaxis(words, -1, 0)
+
+
+def sha256_batch(msgs: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Batched SHA-256 of variable-length rows (same contract as
+    ops.sha512.sha512_batch: (B, max_len) uint8 + (B,) lengths -> (B, 32))."""
+    bsz, max_len = msgs.shape
+    max_blocks = (max_len + 9 + 63) // 64
+    total = max_blocks * 64
+    lengths = lengths.astype(jnp.int32)
+
+    data = jnp.moveaxis(msgs.astype(U32), -1, 0)  # (max_len, B)
+    if total > max_len:
+        data = jnp.concatenate([data, jnp.zeros((total - max_len, bsz), U32)], 0)
+    pos = jnp.arange(total, dtype=jnp.int32)[:, None]
+    ln = lengths[None, :]
+    data = jnp.where(pos < ln, data, 0)
+    data = jnp.where(pos == ln, 0x80, data)
+    nblocks = (lengths + 9 + 63) // 64
+    len_start = nblocks * 64 - 8
+    bitlen_lo = lengths.astype(U32) << 3
+    bitlen_hi = lengths.astype(U32) >> 29
+    k = pos - len_start[None, :]
+    word = jnp.where(k < 4, bitlen_hi[None, :], bitlen_lo[None, :])
+    shift = (3 - (k & 3)) * 8
+    lenbyte = jnp.where(
+        (k >= 0) & (k < 8), (word >> jnp.clip(shift, 0, 31)) & 0xFF, 0
+    ).astype(U32)
+    data = data | lenbyte
+
+    state = jnp.broadcast_to(_IV_ARR[:, None], (8, bsz)).astype(U32)
+
+    def per_block(state, i):
+        block = jax.lax.dynamic_slice_in_dim(data, i * 64, 64, axis=0)
+        new_state = _compress_block(state, _bytes_to_words(block))
+        active = (i < nblocks)[None, :]
+        return jnp.where(active, new_state, state), None
+
+    state, _ = jax.lax.scan(per_block, state, jnp.arange(max_blocks))
+    return _state_to_bytes(state)
+
+
+# --- PoH on TPU ------------------------------------------------------------
+# A PoH step hashes a fixed 32-byte state: exactly one padded block
+# (state | 0x80 | zeros | bitlen=256), so the padding is a compile-time
+# constant and each step is a single compression.
+
+_PAD32 = np.zeros((8,), np.uint32)
+_PAD32[0] = 0x80000000
+_PAD32_TAIL = np.concatenate([_PAD32[:7], np.asarray([256], np.uint32)])
+
+
+def _poh_step(state):
+    """(8, B) -> (8, B): one sha256(state) iteration."""
+    bsz = state.shape[1]
+    pad = jnp.broadcast_to(
+        jnp.asarray(_PAD32_TAIL)[:, None], (8, bsz)
+    ).astype(U32)
+    w = jnp.concatenate([state, pad], axis=0)  # (16, B)
+    return _compress_block(
+        jnp.broadcast_to(_IV_ARR[:, None], (8, bsz)).astype(U32), w
+    )
+
+
+def poh_append_batch(states: jnp.ndarray, n: jnp.ndarray, max_n: int) -> jnp.ndarray:
+    """Advance B independent PoH chains by n[b] hashes each.
+
+    states: (B, 32) uint8; n: (B,) int32 (n[b] <= max_n, static bound).
+    Returns (B, 32) uint8. All lanes run max_n steps; lanes stop updating
+    once their count is reached (batch-uniform control flow).
+    """
+    st = _bytes_to_state(states)
+    n = n.astype(jnp.int32)
+
+    def step(st, i):
+        new = _poh_step(st)
+        return jnp.where((i < n)[None, :], new, st), None
+
+    st, _ = jax.lax.scan(step, st, jnp.arange(max_n))
+    return _state_to_bytes(st)
+
+
+def poh_mixin_batch(states: jnp.ndarray, mixins: jnp.ndarray) -> jnp.ndarray:
+    """state' = sha256(state || mixin) per lane.
+
+    states, mixins: (B, 32) uint8 -> (B, 32) uint8. The 64-byte message
+    fills one block; padding is a second, constant block.
+    """
+    bsz = states.shape[0]
+    w1 = jnp.concatenate(
+        [_bytes_to_state(states), _bytes_to_state(mixins)], axis=0
+    )  # (16, B)
+    iv = jnp.broadcast_to(_IV_ARR[:, None], (8, bsz)).astype(U32)
+    mid = _compress_block(iv, w1)
+    pad = np.zeros((16,), np.uint32)
+    pad[0] = 0x80000000
+    pad[15] = 512
+    w2 = jnp.broadcast_to(jnp.asarray(pad)[:, None], (16, bsz)).astype(U32)
+    return _state_to_bytes(_compress_block(mid, w2))
