@@ -1,0 +1,7 @@
+"""disco — tiles (long-running actors over tango) + topology + monitor.
+
+Role mirrors the reference's src/disco + src/app/frank: the tile run-loop
+blueprint, the concrete hot-path tiles (replay/verify/dedup/pack/sink),
+the topology builder (configure `frank` stage analog) and the monitor
+dashboard. See tiles.py, pipeline.py, monitor.py.
+"""

@@ -1,0 +1,129 @@
+"""monitor — diag-counter snapshots + dashboard rendering.
+
+Role parity with the reference's fd_frank_mon
+(/root/reference/src/app/frank/fd_frank_mon.c): join every tile's cnc and
+every link's fseq from the pod, snapshot the standardized diag counter
+slots, and render heartbeat age / backpressure / filter counts / per-link
+rates. snapshot() returns plain dicts (the programmatic surface the tests
+and bench use); render() produces the ANSI dashboard string.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from firedancer_tpu.tango import tempo
+from firedancer_tpu.tango.rings import (
+    DIAG_FILT_CNT,
+    DIAG_FILT_SZ,
+    DIAG_OVRNP_CNT,
+    DIAG_OVRNR_CNT,
+    DIAG_PUB_CNT,
+    DIAG_PUB_SZ,
+    DIAG_SLOW_CNT,
+    Cnc,
+    FSeq,
+    MCache,
+    Workspace,
+)
+from firedancer_tpu.utils.pod import Pod
+
+_SIGNAL_NAMES = {0: "boot", 1: "run", 2: "halt", 3: "fail"}
+
+
+def snapshot(wksp: Workspace, pod: Pod) -> Dict[str, Dict[str, int]]:
+    """One diag snapshot of every tile cnc + link fseq named in the pod."""
+    out: Dict[str, Dict[str, int]] = {}
+    fd = pod.subpod("firedancer")
+    for name, sub in sorted(fd.to_dict().items()):
+        if not isinstance(sub, dict):
+            continue
+        if "cnc" in sub:
+            cnc = Cnc(wksp, sub["cnc"])
+            out[f"tile.{name}"] = {
+                "signal": cnc.signal_query(),
+                "heartbeat": cnc.heartbeat_query(),
+                "in_backp": cnc.diag(0),
+                "backp_cnt": cnc.diag(1),
+                "ha_filt_cnt": cnc.diag(2),
+                "ha_filt_sz": cnc.diag(3),
+                "sv_filt_cnt": cnc.diag(4),
+                "sv_filt_sz": cnc.diag(5),
+            }
+        if "fseq" in sub:
+            fs = FSeq(wksp, sub["fseq"])
+            mc = MCache(wksp, sub["mcache"]) if "mcache" in sub else None
+            d = {
+                "seq": fs.query(),
+                "pub_cnt": fs.diag(DIAG_PUB_CNT),
+                "pub_sz": fs.diag(DIAG_PUB_SZ),
+                "filt_cnt": fs.diag(DIAG_FILT_CNT),
+                "filt_sz": fs.diag(DIAG_FILT_SZ),
+                "ovrnp_cnt": fs.diag(DIAG_OVRNP_CNT),
+                "ovrnr_cnt": fs.diag(DIAG_OVRNR_CNT),
+                "slow_cnt": fs.diag(DIAG_SLOW_CNT),
+            }
+            if mc is not None:
+                d["tx_seq"] = mc.seq_next()
+            out[f"link.{name}"] = d
+    return out
+
+
+def render(
+    snap: Dict[str, Dict[str, int]],
+    prev: Optional[Dict[str, Dict[str, int]]] = None,
+    dt_s: float = 1.0,
+    ansi: bool = True,
+) -> str:
+    """ANSI dashboard: tiles (state, heartbeat age, backpressure, filters)
+    then links (seq progress, rates vs the prev snapshot)."""
+    now = tempo.tickcount()
+    bold = "\x1b[1m" if ansi else ""
+    dim = "\x1b[2m" if ansi else ""
+    rst = "\x1b[0m" if ansi else ""
+    lines = []
+    lines.append(
+        f"{bold}{'TILE':<14}{'state':>6}{'hb-age-ms':>11}{'backp':>8}"
+        f"{'ha-filt':>9}{'sv-filt':>9}{rst}"
+    )
+    for name, d in sorted(snap.items()):
+        if not name.startswith("tile."):
+            continue
+        hb_age = (now - d["heartbeat"]) / 1e6 if d["heartbeat"] else -1
+        lines.append(
+            f"{name[5:]:<14}{_SIGNAL_NAMES.get(d['signal'], '?'):>6}"
+            f"{hb_age:>11.1f}{d['backp_cnt']:>8}"
+            f"{d['ha_filt_cnt']:>9}{d['sv_filt_cnt']:>9}"
+        )
+    lines.append("")
+    lines.append(
+        f"{bold}{'LINK':<16}{'tx_seq':>9}{'rx_seq':>9}{'pub/s':>10}"
+        f"{'MB/s':>8}{'filt':>7}{'ovrn':>6}{'slow':>6}{rst}"
+    )
+    for name, d in sorted(snap.items()):
+        if not name.startswith("link."):
+            continue
+        p = (prev or {}).get(name, {})
+        rate = (d["pub_cnt"] - p.get("pub_cnt", 0)) / max(dt_s, 1e-9)
+        mbps = (d["pub_sz"] - p.get("pub_sz", 0)) / max(dt_s, 1e-9) / 1e6
+        ovrn = d["ovrnp_cnt"] + d["ovrnr_cnt"]
+        lines.append(
+            f"{name[5:]:<16}{d.get('tx_seq', 0):>9}{d['seq']:>9}"
+            f"{rate:>10.0f}{mbps:>8.2f}{d['filt_cnt']:>7}{ovrn:>6}"
+            f"{d['slow_cnt']:>6}"
+        )
+    return "\n".join(lines)
+
+
+def watch(wksp: Workspace, pod: Pod, interval_s: float = 1.0,
+          iterations: int = 0) -> None:
+    """Live dashboard loop (fdctl monitor analog). iterations=0 -> forever."""
+    prev = None
+    i = 0
+    while not iterations or i < iterations:
+        snap = snapshot(wksp, pod)
+        print("\x1b[2J\x1b[H" + render(snap, prev, interval_s))
+        prev = snap
+        time.sleep(interval_s)
+        i += 1

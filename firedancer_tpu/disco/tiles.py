@@ -1,0 +1,562 @@
+"""disco tiles — long-running actors over tango rings.
+
+Role parity with the reference's disco/frank layer: the generic tile
+run-loop blueprint (housekeeping / backpressure / frag drain, modeled on
+/root/reference/src/disco/mux/fd_mux.h:56-175 and
+app/frank/fd_frank_verify.c:140-207), plus the concrete tiles of the hot
+path: replay (pcap/synthetic source, disco/replay/), verify (sigverify —
+the TPU offload point, app/frank/load/fd_frank_verify_synth_load.c),
+dedup (tcache on meta sig, disco/dedup/), pack (account-lock scheduling
+into bank lanes, app/frank/fd_frank_pack.c), and a sink (bank stub).
+
+Tiles here are Python threads/processes joined to the same native
+shared-memory rings (native/tango.cc via tango.rings); the hot math is
+batched onto the device inside VerifyTile. Frag payloads on the
+replay->verify link are whole Solana transaction wire bytes; the verify
+tile parses in-tile exactly like the reference quic tile does
+(fd_quic_tile.c:492 fd_txn_parse into the dcache slot).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from firedancer_tpu.ballet import ed25519 as oracle
+from firedancer_tpu.ballet.txn import TxnParseError, parse_txn
+from firedancer_tpu.tango import tempo
+from firedancer_tpu.tango.fctl import make_fctl_for_fseqs
+from firedancer_tpu.tango.rings import (
+    CNC_BOOT,
+    CNC_HALT,
+    CNC_RUN,
+    DIAG_FILT_CNT,
+    DIAG_FILT_SZ,
+    DIAG_OVRNR_CNT,
+    DIAG_PUB_CNT,
+    DIAG_PUB_SZ,
+    DIAG_SLOW_CNT,
+    POLL_EMPTY,
+    POLL_FRAG,
+    POLL_OVERRUN,
+    Cnc,
+    DCache,
+    FSeq,
+    Frag,
+    MCache,
+    Workspace,
+)
+from firedancer_tpu.tango.tcache import TCache
+from firedancer_tpu.utils.rng import Rng
+
+# cnc diag slots (frank/fd_frank.h:20-36 ABI analog)
+CNC_DIAG_IN_BACKP = 0
+CNC_DIAG_BACKP_CNT = 1
+CNC_DIAG_HA_FILT_CNT = 2
+CNC_DIAG_HA_FILT_SZ = 3
+CNC_DIAG_SV_FILT_CNT = 4
+CNC_DIAG_SV_FILT_SZ = 5
+
+CTL_SOM_EOM = 3
+
+FD_TPU_MTU = 1232  # disco/quic/fd_quic.h:46-47
+
+_U64 = (1 << 64) - 1
+
+
+@dataclass
+class LinkNames:
+    """Workspace object names for one mcache/dcache/fseq link."""
+
+    mcache: str
+    dcache: str
+    fseq: str
+
+
+class InLink:
+    """Consumer side of a link: poll frags in seq order, detect overruns."""
+
+    def __init__(self, wksp: Workspace, names: LinkNames):
+        self.mcache = MCache(wksp, names.mcache)
+        self.dcache = DCache(wksp, names.dcache)
+        self.fseq = FSeq(wksp, names.fseq)
+        self.seq = 0
+
+    def poll(self):
+        """Returns (status, frag, payload_bytes_or_None)."""
+        r, f = self.mcache.poll(self.seq)
+        if r == POLL_EMPTY:
+            return r, None, None
+        if r == POLL_OVERRUN:
+            # Jump forward to the oldest frag still in the ring; only the
+            # frags actually skipped over count as lost.
+            new_seq = self.mcache.seq_next()
+            new_pos = max(new_seq - self.mcache.depth + 1, self.seq + 1)
+            self.fseq.diag_add(DIAG_OVRNR_CNT, new_pos - self.seq)
+            self.seq = new_pos
+            return r, None, None
+        payload = self.dcache.read(f.chunk, f.sz)
+        return r, f, payload
+
+    def advance(self):
+        self.seq += 1
+
+    def housekeep(self):
+        self.fseq.update(self.seq)
+
+
+class OutLink:
+    """Producer side: dcache chunk walk + mcache publish + credit control."""
+
+    def __init__(
+        self,
+        wksp: Workspace,
+        names: LinkNames,
+        mtu: int = FD_TPU_MTU,
+        reliable_fseqs: Optional[Sequence[FSeq]] = None,
+    ):
+        self.mcache = MCache(wksp, names.mcache)
+        self.dcache = DCache(wksp, names.dcache)
+        self.mtu = mtu
+        self.seq = self.mcache.seq_next()
+        self.chunk = 0
+        self.fctl = make_fctl_for_fseqs(
+            self.mcache.depth, reliable_fseqs or [], cr_burst=1
+        )
+        self.cr_avail = 0
+
+    def housekeep(self):
+        self.cr_avail = self.fctl.tx_cr_update(self.cr_avail, self.seq)
+
+    def can_publish(self) -> bool:
+        if self.cr_avail > 0:
+            return True
+        self.housekeep()
+        return self.cr_avail > 0
+
+    def publish(self, payload: bytes, sig: int, tsorig: int = 0) -> None:
+        """Copy payload into the dcache and publish its frag meta."""
+        assert len(payload) <= self.mtu
+        self.dcache.write(self.chunk, payload)
+        tspub = tempo.tickcount() & 0xFFFFFFFF
+        self.mcache.publish(
+            self.seq, sig, self.chunk, len(payload), CTL_SOM_EOM, tsorig, tspub
+        )
+        self.chunk = self.dcache.next_chunk(self.chunk, len(payload), self.mtu)
+        self.seq += 1
+        self.cr_avail = max(0, self.cr_avail - 1)
+
+
+class Tile:
+    """Generic run loop: housekeeping on jittered intervals + frag drain.
+
+    Subclasses implement on_frag(frag, payload) and optionally on_idle().
+    """
+
+    name = "tile"
+
+    def __init__(
+        self,
+        wksp: Workspace,
+        cnc_name: str,
+        in_link: Optional[InLink] = None,
+        out_link: Optional[OutLink] = None,
+        lazy_ns: Optional[int] = None,
+        seed: int = 0,
+    ):
+        self.wksp = wksp
+        self.cnc = Cnc(wksp, cnc_name)
+        self.in_link = in_link
+        self.out_link = out_link
+        self.rng = Rng(seq=seed)
+        depth = in_link.mcache.depth if in_link else (
+            out_link.mcache.depth if out_link else 128
+        )
+        lazy = lazy_ns if lazy_ns is not None else tempo.lazy_default(depth)
+        self._async_min = tempo.async_min(lazy)
+        self._last_in_backp = 0
+        self.halted = False
+
+    # -- overridables ----------------------------------------------------
+
+    def on_frag(self, frag: Frag, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def on_idle(self) -> None:
+        """Called when the input is empty (flush partial batches etc.)."""
+
+    def on_housekeep(self) -> None:
+        """Extra per-tile housekeeping."""
+
+    def done(self) -> bool:
+        """Source tiles return True when exhausted."""
+        return False
+
+    # -- run loop --------------------------------------------------------
+
+    def housekeep(self, now: int) -> None:
+        self.cnc.heartbeat(now)
+        if self.in_link:
+            self.in_link.housekeep()
+        if self.out_link:
+            self.out_link.housekeep()
+            # Mirror the fctl backpressure gauge into the cnc diag
+            # (IN_BACKP slot, frank/fd_frank.h:20-36 semantics).
+            backp = 1 if self.out_link.fctl.in_backpressure else 0
+            if backp != self._last_in_backp:
+                self.cnc.diag_add(
+                    CNC_DIAG_IN_BACKP, (backp - self._last_in_backp) & _U64
+                )
+                self._last_in_backp = backp
+        self.on_housekeep()
+
+    def run(self, max_ns: int = 30_000_000_000) -> None:
+        """Run until HALT signal, done(), or max_ns wall time."""
+        self.cnc.signal(CNC_RUN)
+        start = tempo.tickcount()
+        then = start
+        idle_spins = 0
+        while True:
+            now = tempo.tickcount()
+            if now >= then:
+                self.housekeep(now)
+                if self.cnc.signal_query() == CNC_HALT:
+                    break
+                if now - start > max_ns:
+                    break
+                then = now + tempo.async_reload(self.rng, self._async_min)
+            if self.done():
+                if self.cnc.signal_query() == CNC_HALT:
+                    break
+                time.sleep(50e-6)
+                continue
+            if self.in_link is None:
+                self.step()
+                continue
+            r, frag, payload = self.in_link.poll()
+            if r == POLL_FRAG:
+                self.on_frag(frag, payload)
+                self.in_link.advance()
+                idle_spins = 0
+            elif r == POLL_EMPTY:
+                self.on_idle()
+                idle_spins += 1
+                if idle_spins > 64:
+                    time.sleep(20e-6)  # FD_SPIN_PAUSE analog
+            # POLL_OVERRUN: InLink.poll already repositioned + counted.
+        # drain housekeeping one last time so diags/fseq are current
+        self.housekeep(tempo.tickcount())
+        self.halted = True
+        self.cnc.signal(CNC_BOOT)
+
+    def step(self) -> None:
+        """Source tiles (no in_link) override or rely on done()."""
+        time.sleep(50e-6)
+
+
+class ReplayTile(Tile):
+    """Source: publishes a list of payloads downstream with flow control
+    (disco/replay/fd_replay.c analog; feed it utils.pcap.read_all(path))."""
+
+    name = "replay"
+
+    def __init__(self, wksp, cnc_name, out_link, payloads: List[bytes], **kw):
+        super().__init__(wksp, cnc_name, out_link=out_link, **kw)
+        self.payloads = payloads
+        self.pos = 0
+        self.pub_cnt = 0
+        self.pub_sz = 0
+
+    def done(self) -> bool:
+        return self.pos >= len(self.payloads)
+
+    def step(self) -> None:
+        if not self.out_link.can_publish():
+            self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
+            time.sleep(20e-6)
+            return
+        payload = self.payloads[self.pos]
+        # meta sig for downstream filtering: first signature bytes (the
+        # txn's dedup identity), matching verify-tile tag semantics.
+        sig64 = int.from_bytes(payload[1:9], "little") if len(payload) > 8 else 0
+        self.out_link.publish(payload, sig64)
+        self.pos += 1
+        self.pub_cnt += 1
+        self.pub_sz += len(payload)
+
+
+def _txn_batch_arrays(items, max_len: int):
+    """Pack (sig, pub, msg) tuples into padded arrays for verify_batch."""
+    n = len(items)
+    msgs = np.zeros((n, max_len), np.uint8)
+    lens = np.zeros(n, np.int32)
+    sigs = np.zeros((n, 64), np.uint8)
+    pubs = np.zeros((n, 32), np.uint8)
+    for i, (sig, pub, msg) in enumerate(items):
+        m = np.frombuffer(msg, np.uint8)[:max_len]
+        msgs[i, : len(m)] = m
+        lens[i] = len(m)
+        sigs[i] = np.frombuffer(sig, np.uint8)
+        pubs[i] = np.frombuffer(pub, np.uint8)
+    return msgs, lens, sigs, pubs
+
+
+class VerifyTile(Tile):
+    """Sigverify: parse txn in-tile, ha-dedup, verify signatures, forward.
+
+    backend='oracle' verifies per-txn on CPU (the bit-exact reference
+    path); backend='tpu' accumulates a batch and dispatches the fused
+    verify_batch XLA program (the wiredancer-style offload — batch is the
+    SIMD lane axis). Failed/parse-error/duplicate txns are dropped and
+    counted in the cnc diag (SV/HA filter slots).
+    """
+
+    name = "verify"
+
+    def __init__(
+        self,
+        wksp,
+        cnc_name,
+        in_link,
+        out_link,
+        backend: str = "oracle",
+        batch: int = 128,
+        max_msg_len: int = FD_TPU_MTU,
+        tcache_depth: int = 4096,
+        **kw,
+    ):
+        super().__init__(wksp, cnc_name, in_link=in_link, out_link=out_link, **kw)
+        assert backend in ("oracle", "tpu")
+        self.backend = backend
+        self.batch = batch
+        self.max_msg_len = max_msg_len
+        self.ha_tcache = TCache(tcache_depth)
+        self._pending: list = []  # (payload, frag, verify items)
+        self._verify_batch_fn = None
+        if backend == "tpu":
+            import jax
+            import jax.numpy as jnp
+
+            from firedancer_tpu.ops.verify import verify_batch
+
+            self._verify_batch_fn = jax.jit(verify_batch)
+            # Pre-warm: compile the fixed (batch, max_msg_len) shape now so
+            # the run loop never stalls on first-flush compilation (the
+            # persistent jax compilation cache makes this fast after the
+            # first ever build of this shape).
+            self._verify_batch_fn(
+                jnp.zeros((batch, max_msg_len), jnp.uint8),
+                jnp.zeros((batch,), jnp.int32),
+                jnp.zeros((batch, 64), jnp.uint8),
+                jnp.zeros((batch, 32), jnp.uint8),
+            ).block_until_ready()
+
+    def on_frag(self, frag: Frag, payload: bytes) -> None:
+        try:
+            txn = parse_txn(payload)
+        except TxnParseError:
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, 1)
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, len(payload))
+            return
+        # High-availability dup filter before paying for the verify
+        # (synth-load FD_TCACHE_INSERT ha_tag analog).
+        ha_tag = int.from_bytes(txn.signature(payload, 0)[:8], "little")
+        if self.ha_tcache.insert(ha_tag):
+            self.cnc.diag_add(CNC_DIAG_HA_FILT_CNT, 1)
+            self.cnc.diag_add(CNC_DIAG_HA_FILT_SZ, len(payload))
+            return
+        items = list(txn.verify_items(payload))
+        if self.backend == "oracle":
+            ok = all(
+                oracle.verify(msg, sig, pub) == 0 for (sig, pub, msg) in items
+            )
+            self._finish(payload, ok)
+        else:
+            self._pending.append((payload, items))
+            if len(self._pending) >= self.batch:
+                self._flush()
+
+    def on_idle(self) -> None:
+        if self._pending:
+            self._flush()
+
+    def _flush(self) -> None:
+        import jax.numpy as jnp
+
+        todo = self._pending
+        self._pending = []
+        flat = []
+        spans = []
+        for payload, items in todo:
+            spans.append((len(flat), len(items)))
+            flat.extend(items)
+        # Pad the lane count to the fixed batch so jit compiles once.
+        n = len(flat)
+        padded = flat + [(b"\x00" * 64, b"\x00" * 32, b"")] * (
+            (-n) % self.batch
+        )
+        statuses = np.empty(len(padded), np.int32)
+        for off in range(0, len(padded), self.batch):
+            msgs, lens, sigs, pubs = _txn_batch_arrays(
+                padded[off : off + self.batch], self.max_msg_len
+            )
+            out = self._verify_batch_fn(
+                jnp.asarray(msgs), jnp.asarray(lens), jnp.asarray(sigs),
+                jnp.asarray(pubs),
+            )
+            statuses[off : off + self.batch] = np.asarray(out)
+        # A message longer than the staging width cannot be verified on
+        # device; fail it rather than trusting a truncated hash.
+        for i, (_, _, msg) in enumerate(flat):
+            if len(msg) > self.max_msg_len:
+                statuses[i] = -3  # FD_ED25519_ERR_MSG
+        for (payload, _), (start, cnt) in zip(todo, spans):
+            ok = bool((statuses[start : start + cnt] == 0).all()) and cnt > 0
+            self._finish(payload, ok)
+
+    def _finish(self, payload: bytes, ok: bool) -> None:
+        if not ok:
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, 1)
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, len(payload))
+            return
+        while not self.out_link.can_publish():
+            if self.cnc.signal_query() == CNC_HALT:
+                return
+            self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
+            time.sleep(20e-6)
+        sig64 = int.from_bytes(payload[1:9], "little")
+        self.out_link.publish(payload, sig64)
+        self.in_link.fseq.diag_add(DIAG_PUB_CNT, 1)
+        self.in_link.fseq.diag_add(DIAG_PUB_SZ, len(payload))
+
+
+class DedupTile(Tile):
+    """tcache dedup on the frag meta sig (disco/dedup/fd_dedup.c)."""
+
+    name = "dedup"
+
+    def __init__(self, wksp, cnc_name, in_link, out_link,
+                 tcache_depth: int = 4096, **kw):
+        super().__init__(wksp, cnc_name, in_link=in_link, out_link=out_link, **kw)
+        self.tcache = TCache(tcache_depth)
+
+    def on_frag(self, frag: Frag, payload: bytes) -> None:
+        if self.tcache.insert(frag.sig):
+            self.in_link.fseq.diag_add(DIAG_FILT_CNT, 1)
+            self.in_link.fseq.diag_add(DIAG_FILT_SZ, frag.sz)
+            return
+        while not self.out_link.can_publish():
+            if self.cnc.signal_query() == CNC_HALT:
+                return
+            self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
+            time.sleep(20e-6)
+        self.out_link.publish(payload, frag.sig, tsorig=frag.tsorig)
+        self.in_link.fseq.diag_add(DIAG_PUB_CNT, 1)
+        self.in_link.fseq.diag_add(DIAG_PUB_SZ, frag.sz)
+
+
+class PackTile(Tile):
+    """Account-lock conflict scheduling into bank lanes
+    (app/frank/fd_frank_pack.c + ballet/pack semantics). Scheduled txns
+    are published downstream with the bank index in the high sig bits;
+    completion is immediate (the sink stands in for bank execution)."""
+
+    name = "pack"
+
+    def __init__(self, wksp, cnc_name, in_link, out_link, bank_cnt: int = 4,
+                 **kw):
+        from firedancer_tpu.ballet.pack import CuEstimator, Pack
+
+        super().__init__(wksp, cnc_name, in_link=in_link, out_link=out_link, **kw)
+        self.pack = Pack(bank_cnt=bank_cnt)
+        self.est = CuEstimator()
+        self.bank_cnt = bank_cnt
+        self._next_txn_id = 0
+        self._payloads: dict = {}
+        self._rr_bank = 0
+
+    def on_frag(self, frag: Frag, payload: bytes) -> None:
+        from firedancer_tpu.ballet.pack import PackTxn
+
+        try:
+            txn = parse_txn(payload)
+        except TxnParseError:
+            self.in_link.fseq.diag_add(DIAG_FILT_CNT, 1)
+            return
+        writable = frozenset(
+            txn.account(payload, i)
+            for i in range(txn.acct_cnt)
+            if txn.is_writable(i)
+        )
+        readonly = frozenset(
+            txn.account(payload, i)
+            for i in range(txn.acct_cnt)
+            if not txn.is_writable(i)
+        )
+        programs = [
+            txn.account(payload, ix.program_id_index) for ix in txn.instrs
+        ]
+        tid = self._next_txn_id
+        self._next_txn_id += 1
+        pt = PackTxn(
+            txn_id=tid,
+            rewards=5000 + len(payload),  # base fee stand-in
+            est_cus=self.est.estimate(programs),
+            writable=writable,
+            readonly=readonly,
+        )
+        self._payloads[tid] = payload
+        self.pack.insert(pt)
+        self._drain()
+
+    def on_idle(self) -> None:
+        self._drain()
+
+    def _drain(self) -> None:
+        """Schedule as many non-conflicting txns as possible, rotating
+        banks after each success; stop after a full cycle of failures."""
+        misses = 0
+        while misses < self.bank_cnt:
+            bank = self._rr_bank
+            self._rr_bank = (self._rr_bank + 1) % self.bank_cnt
+            txn = self.pack.schedule(bank)
+            if txn is None:
+                misses += 1
+                continue
+            misses = 0
+            payload = self._payloads.pop(txn.txn_id)
+            dropped = False
+            while not self.out_link.can_publish():
+                if self.cnc.signal_query() == CNC_HALT:
+                    dropped = True
+                    break
+                self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
+                time.sleep(20e-6)
+            if not dropped:
+                sig = (bank << 48) | (txn.txn_id & 0xFFFFFFFFFFFF)
+                self.out_link.publish(payload, sig)
+            # Bank execution is immediate in the slice: release locks.
+            self.pack.complete(bank, txn.txn_id)
+
+
+class SinkTile(Tile):
+    """Terminal consumer (bank stub): counts everything it receives."""
+
+    name = "sink"
+
+    def __init__(self, wksp, cnc_name, in_link, **kw):
+        super().__init__(wksp, cnc_name, in_link=in_link, **kw)
+        self.recv_cnt = 0
+        self.recv_sz = 0
+        self.bank_hist: dict = {}
+
+    def on_frag(self, frag: Frag, payload: bytes) -> None:
+        self.recv_cnt += 1
+        self.recv_sz += frag.sz
+        bank = frag.sig >> 48
+        self.bank_hist[bank] = self.bank_hist.get(bank, 0) + 1
+        self.in_link.fseq.diag_add(DIAG_PUB_CNT, 1)
+        self.in_link.fseq.diag_add(DIAG_PUB_SZ, frag.sz)

@@ -1,0 +1,5 @@
+"""flamenco — Solana runtime components.
+
+Role mirrors the reference's src/flamenco (SURVEY.md §2.6): the sBPF
+virtual machine (vm/), and bincode type serialization (types/).
+"""

@@ -1,0 +1,20 @@
+"""flamenco/vm — the sBPF virtual machine.
+
+Role mirrors the reference's src/flamenco/vm: instruction encode/decode
+(sbpf.py — ballet/sbpf/fd_sbpf_instr.h analog), static validation +
+interpreter with the 4-region memory map, CU metering, call stack and
+syscall registry (interp.py — fd_vm_interp.c / fd_vm_context.h), the
+syscall library (syscalls.py — fd_vm_syscalls.c), and the disassembler
+(disasm.py — fd_vm_disasm.c).
+"""
+
+from .sbpf import Instr, asm, decode_program, encode_program  # noqa: F401
+from .interp import (  # noqa: F401
+    VmContext,
+    VmFault,
+    HEAP_START,
+    INPUT_START,
+    PROGRAM_START,
+    STACK_START,
+)
+from .disasm import disasm, disasm_program  # noqa: F401

@@ -1,0 +1,188 @@
+"""sBPF instruction encoding/decoding + a tiny assembler for tests.
+
+Encoding per the reference's ballet/sbpf/fd_sbpf_instr.h: 8-byte slots,
+little-endian — opcode u8 | dst:4 src:4 | offset i16 | imm u32. `lddw`
+(opcode 0x18) consumes two slots, the second carrying the high 32
+immediate bits (FD_SBPF_OP_ADDL_IMM, opcode 0).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+# opcode classes (fd_sbpf_opcodes.h)
+CLS_LD, CLS_LDX, CLS_ST, CLS_STX, CLS_ALU, CLS_JMP, CLS_JMP32, CLS_ALU64 = range(8)
+
+OP_LDDW = 0x18
+OP_ADDL_IMM = 0x00
+OP_CALL = 0x85
+OP_CALLX = 0x8D
+OP_EXIT = 0x95
+
+_SIZE_BYTES = {0x00: 4, 0x08: 2, 0x10: 1, 0x18: 8}  # W H B DW (bits 3-4)
+
+_ALU_NAMES = {
+    0x0: "add", 0x1: "sub", 0x2: "mul", 0x3: "div", 0x4: "or", 0x5: "and",
+    0x6: "lsh", 0x7: "rsh", 0x8: "neg", 0x9: "mod", 0xA: "xor", 0xB: "mov",
+    0xC: "arsh", 0xD: "end",
+}
+_JMP_NAMES = {
+    0x0: "ja", 0x1: "jeq", 0x2: "jgt", 0x3: "jge", 0x4: "jset", 0x5: "jne",
+    0x6: "jsgt", 0x7: "jsge", 0x8: "call", 0x9: "exit", 0xA: "jlt",
+    0xB: "jle", 0xC: "jslt", 0xD: "jsle",
+}
+
+
+@dataclass(frozen=True)
+class Instr:
+    opcode: int
+    dst: int
+    src: int
+    offset: int  # signed 16-bit
+    imm: int     # unsigned 32-bit view (sign-extend per-op at use)
+
+    @property
+    def op_class(self) -> int:
+        return self.opcode & 0x7
+
+    @property
+    def is_reg_src(self) -> bool:
+        return bool(self.opcode & 0x8)
+
+    @property
+    def alu_op(self) -> int:
+        return self.opcode >> 4
+
+    @property
+    def mem_size(self) -> int:
+        return _SIZE_BYTES[self.opcode & 0x18]
+
+    def encode(self) -> bytes:
+        return struct.pack(
+            "<BBhI",
+            self.opcode,
+            (self.src << 4) | self.dst,
+            self.offset,
+            self.imm & 0xFFFFFFFF,
+        )
+
+
+def decode_instr(slot: bytes) -> Instr:
+    opcode, regs, offset, imm = struct.unpack("<BBhI", slot)
+    return Instr(opcode, regs & 0xF, regs >> 4, offset, imm)
+
+
+def decode_program(text: bytes) -> List[Instr]:
+    assert len(text) % 8 == 0, "text must be 8-byte aligned"
+    return [decode_instr(text[i : i + 8]) for i in range(0, len(text), 8)]
+
+
+def encode_program(instrs: Sequence[Instr]) -> bytes:
+    return b"".join(i.encode() for i in instrs)
+
+
+# --- tiny assembler ---------------------------------------------------------
+
+_ALU_OPS = {v: k for k, v in _ALU_NAMES.items()}
+_JMP_OPS = {v: k for k, v in _JMP_NAMES.items()}
+_SIZES = {"b": 0x10, "h": 0x08, "w": 0x00, "dw": 0x18}
+
+
+def _reg(tok: str) -> int:
+    assert tok.startswith("r"), tok
+    return int(tok[1:])
+
+
+def asm(source: str) -> List[Instr]:
+    """Assemble a minimal sBPF text form (for tests/fixtures).
+
+    Syntax per line (commas optional):
+      mov64 r1, 5       / add64 r1, r2     (ALU64; 32-bit forms: mov32 ...)
+      ldxdw r1, [r2+8]  / stdw [r1+0], 99  / stxw [r1+4], r2
+      lddw r1, 0x123456789abcdef0
+      jeq r1, r2, +3    / ja +1            / jne r1, 0, -2
+      call 0xdeadbeef   / callx r3         / exit
+    """
+    out: List[Instr] = []
+    for raw in source.strip().splitlines():
+        line = raw.split("//")[0].split(";")[0].strip().replace(",", " ")
+        if not line:
+            continue
+        toks = line.split()
+        op = toks[0]
+        if op == "exit":
+            out.append(Instr(OP_EXIT, 0, 0, 0, 0))
+        elif op == "call":
+            out.append(Instr(OP_CALL, 0, 0, 0, int(toks[1], 0) & 0xFFFFFFFF))
+        elif op == "callx":
+            out.append(Instr(OP_CALLX, 0, 0, 0, _reg(toks[1])))
+        elif op == "lddw":
+            v = int(toks[2], 0) & 0xFFFFFFFFFFFFFFFF
+            out.append(Instr(OP_LDDW, _reg(toks[1]), 0, 0, v & 0xFFFFFFFF))
+            out.append(Instr(OP_ADDL_IMM, 0, 0, 0, v >> 32))
+        elif op == "ja":
+            out.append(Instr(0x05, 0, 0, int(toks[1], 0), 0))
+        elif op[:-2] in _ALU_OPS and op[-2:] in ("64", "32"):
+            mode = _ALU_OPS[op[:-2]]
+            cls = CLS_ALU64 if op.endswith("64") else CLS_ALU
+            dst = _reg(toks[1])
+            if mode == 0x8:  # neg: unary
+                out.append(Instr(cls | (mode << 4), dst, 0, 0, 0))
+            elif len(toks) > 2 and toks[2].startswith("r"):
+                out.append(
+                    Instr(cls | 0x8 | (mode << 4), dst, _reg(toks[2]), 0, 0)
+                )
+            else:
+                out.append(
+                    Instr(cls | (mode << 4), dst, 0, 0,
+                          int(toks[2], 0) & 0xFFFFFFFF)
+                )
+        elif op.startswith("ldx"):
+            sz = _SIZES[op[3:]]
+            dst = _reg(toks[1])
+            mem = toks[2].strip("[]")
+            base, _, off = mem.partition("+")
+            out.append(
+                Instr(CLS_LDX | sz | 0x60, dst, _reg(base), int(off or 0, 0), 0)
+            )
+        elif op.startswith("stx"):
+            sz = _SIZES[op[3:]]
+            mem = toks[1].strip("[]")
+            base, _, off = mem.partition("+")
+            out.append(
+                Instr(CLS_STX | sz | 0x60, _reg(base), _reg(toks[2]),
+                      int(off or 0, 0), 0)
+            )
+        elif op.startswith("st"):
+            sz = _SIZES[op[2:]]
+            mem = toks[1].strip("[]")
+            base, _, off = mem.partition("+")
+            out.append(
+                Instr(CLS_ST | sz | 0x60, _reg(base), 0, int(off or 0, 0),
+                      int(toks[2], 0) & 0xFFFFFFFF)
+            )
+        elif op in ("jmp",):
+            out.append(Instr(0x05, 0, 0, int(toks[1], 0), 0))
+        elif op[:-2] in _JMP_OPS and op[-2:] == "32":
+            mode = _JMP_OPS[op[:-2]]
+            dst = _reg(toks[1])
+            if toks[2].startswith("r"):
+                out.append(Instr(CLS_JMP32 | 0x8 | (mode << 4), dst,
+                                 _reg(toks[2]), int(toks[3], 0), 0))
+            else:
+                out.append(Instr(CLS_JMP32 | (mode << 4), dst, 0,
+                                 int(toks[3], 0), int(toks[2], 0) & 0xFFFFFFFF))
+        elif op in _JMP_OPS:
+            mode = _JMP_OPS[op]
+            dst = _reg(toks[1])
+            if toks[2].startswith("r"):
+                out.append(Instr(CLS_JMP | 0x8 | (mode << 4), dst,
+                                 _reg(toks[2]), int(toks[3], 0), 0))
+            else:
+                out.append(Instr(CLS_JMP | (mode << 4), dst, 0,
+                                 int(toks[3], 0), int(toks[2], 0) & 0xFFFFFFFF))
+        else:
+            raise ValueError(f"cannot assemble: {raw!r}")
+    return out

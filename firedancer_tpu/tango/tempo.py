@@ -1,0 +1,70 @@
+"""tempo — clock models and housekeeping pacing.
+
+Role parity with the reference's fd_tempo
+(/root/reference/src/tango/tempo/fd_tempo.h): tickcount<->wallclock
+calibration, the `lazy` default housekeeping interval as a function of
+ring depth, and jittered async timers so a fleet of tiles doesn't
+heartbeat in lockstep (thundering-herd avoidance).
+
+Python's clocks: time.perf_counter_ns is the invariant tickcount analog,
+time.time_ns the wallclock.
+"""
+
+from __future__ import annotations
+
+import time
+
+from firedancer_tpu.utils.rng import Rng
+
+
+def tickcount() -> int:
+    return time.perf_counter_ns()
+
+
+def wallclock() -> int:
+    return time.time_ns()
+
+
+def lazy_default(depth: int) -> int:
+    """Default housekeeping interval in ns for a ring of `depth` frags
+    (fd_tempo_lazy_default shape: ~depth microseconds / 9, clamped) —
+    frequent enough that a consumer lapping the ring is detected, rare
+    enough to stay off the hot path."""
+    lazy = (int(depth) * 1000) // 9
+    return max(1_000, min(lazy, 1_000_000_000))
+
+
+def async_min(lazy: int) -> int:
+    """Largest power of 2 <= max(1, lazy/2): the minimum async interval
+    such that jittered reloads average near `lazy`."""
+    m = max(1, lazy // 2)
+    return 1 << (m.bit_length() - 1)
+
+
+def async_reload(rng: Rng, amin: int) -> int:
+    """Uniform in [amin, 2*amin): the jittered next-housekeeping delta."""
+    return amin + rng.roll(amin)
+
+
+class Clock:
+    """Tick->wallclock affine model (fd_tempo_observe/ns_per_tick analog).
+
+    For Python both clocks are ns already, but the model keeps the
+    calibration discipline (and absorbs perf_counter's arbitrary epoch).
+    """
+
+    def __init__(self) -> None:
+        self.recalibrate()
+
+    def recalibrate(self) -> None:
+        t0 = tickcount()
+        w0 = wallclock()
+        t1 = tickcount()
+        self._tick0 = (t0 + t1) // 2
+        self._wall0 = w0
+
+    def wall_from_tick(self, tick: int) -> int:
+        return self._wall0 + (tick - self._tick0)
+
+    def now(self) -> int:
+        return self.wall_from_tick(tickcount())

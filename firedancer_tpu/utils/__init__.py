@@ -1,0 +1,12 @@
+"""utils — host runtime utilities (the reference's src/util analog).
+
+Components (SURVEY.md §2.1 parity):
+  pod   hierarchical typed key-val config tree  (util/pod/)
+  rng   counter-based splittable PRNG           (util/rng/)
+  log   two-stream leveled logging              (util/log/)
+  env   cmdline/env flag stripping              (util/env/)
+  pcap  pcap fixture reader/writer              (util/net/fd_pcap.h)
+
+The shared-memory side (workspace/alloc) is native C++
+(native/tango.cc) exposed via firedancer_tpu.tango.rings.
+"""

@@ -1,0 +1,53 @@
+"""env — command-line / environment flag stripping.
+
+Role parity with the reference's fd_env
+(/root/reference/src/util/env/fd_env.h: fd_env_strip_cmdline_*): every
+test/tool binary pulls named flags out of argv with a typed default,
+consuming them so downstream parsers see a clean argv. Environment
+variables (upper-cased, dots→underscores) take effect when the flag is
+absent from argv.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+def _env_key(key: str) -> str:
+    return key.lstrip("-").replace("-", "_").replace(".", "_").upper()
+
+
+def strip_cmdline_str(
+    argv: List[str], key: str, default: Optional[str] = None
+) -> Optional[str]:
+    """Remove `key value` pairs from argv; returns the LAST value given,
+    else $KEY from the environment, else default."""
+    val = None
+    i = 0
+    while i < len(argv):
+        if argv[i] == key and i + 1 < len(argv):
+            val = argv[i + 1]
+            del argv[i : i + 2]
+        else:
+            i += 1
+    if val is None:
+        val = os.environ.get(_env_key(key), None)
+    return default if val is None else val
+
+
+def strip_cmdline_int(argv: List[str], key: str, default: int = 0) -> int:
+    v = strip_cmdline_str(argv, key, None)
+    return default if v is None else int(v, 0)
+
+
+def strip_cmdline_float(argv: List[str], key: str, default: float = 0.0) -> float:
+    v = strip_cmdline_str(argv, key, None)
+    return default if v is None else float(v)
+
+
+def strip_cmdline_bool(argv: List[str], key: str, default: bool = False) -> bool:
+    v = strip_cmdline_str(argv, key, None)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")

@@ -1,0 +1,105 @@
+"""log — two-stream logging (permanent file + summarized stderr).
+
+Role parity with the reference's fd_log
+(/root/reference/src/util/log/fd_log.h:23-40): levels
+DEBUG < INFO < NOTICE < WARNING < ERR < CRIT < ALERT < EMERG; the
+*ephemeral* stream (stderr) shows NOTICE+ by default while the
+*permanent* stream (a log file) records everything; ERR and above exit
+the process, CRIT+ also dumps a backtrace. Line format mirrors
+fd_log.h:153-157: level, timestamp, group:tid, file(line), message.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import sys
+import threading
+import traceback
+
+DEBUG, INFO, NOTICE, WARNING, ERR, CRIT, ALERT, EMERG = range(8)
+_NAMES = ["DEBUG", "INFO", "NOTICE", "WARNING", "ERR", "CRIT", "ALERT", "EMERG"]
+
+_lock = threading.Lock()
+_file = None
+_file_level = DEBUG
+_stderr_level = NOTICE
+_group = "fd"
+
+
+def boot(
+    log_path: str | None = None,
+    stderr_level: int = NOTICE,
+    file_level: int = DEBUG,
+    group: str | None = None,
+) -> None:
+    """Initialize logging (fd_boot analog). log_path=None disables the
+    permanent stream; '' picks a default under /tmp."""
+    global _file, _stderr_level, _file_level, _group
+    with _lock:
+        _stderr_level = stderr_level
+        _file_level = file_level
+        if group:
+            _group = group
+        if log_path is not None:
+            if log_path == "":
+                log_path = f"/tmp/fd_tpu_{os.getpid()}.log"
+            _file = open(log_path, "a", buffering=1)
+
+
+def halt() -> None:
+    global _file
+    with _lock:
+        if _file:
+            _file.close()
+            _file = None
+
+
+def _emit(level: int, msg: str, depth: int = 2) -> None:
+    frame = sys._getframe(depth)
+    fname = os.path.basename(frame.f_code.co_filename)
+    line = frame.f_lineno
+    now = datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S.%f")
+    tid = threading.get_native_id()
+    text = (
+        f"{_NAMES[level]:7s} {now} {_group}:{tid} {fname}({line}): {msg}"
+    )
+    with _lock:
+        if _file and level >= _file_level:
+            _file.write(text + "\n")
+        if level >= _stderr_level:
+            print(text, file=sys.stderr)
+    if level >= CRIT:
+        with _lock:
+            tb = "".join(traceback.format_stack(frame))
+            if _file:
+                _file.write(tb)
+            print(tb, file=sys.stderr)
+    if level >= ERR:
+        raise SystemExit(1)
+
+
+def debug(msg: str) -> None:
+    _emit(DEBUG, msg)
+
+
+def info(msg: str) -> None:
+    _emit(INFO, msg)
+
+
+def notice(msg: str) -> None:
+    _emit(NOTICE, msg)
+
+
+def warning(msg: str) -> None:
+    _emit(WARNING, msg)
+
+
+def err(msg: str) -> None:
+    """Logs and exits (fd_log ERR semantics)."""
+    _emit(ERR, msg)
+
+
+def crit(msg: str) -> None:
+    """Logs with backtrace and exits."""
+    _emit(CRIT, msg)

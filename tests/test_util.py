@@ -1,0 +1,206 @@
+"""Tests for the util layer (pod, rng, env, log, pcap) and tango
+fctl/tempo."""
+
+import io
+import os
+
+import pytest
+
+from firedancer_tpu.tango import tempo
+from firedancer_tpu.tango.fctl import Fctl
+from firedancer_tpu.utils import env, pcap
+from firedancer_tpu.utils.pod import Pod
+from firedancer_tpu.utils.rng import Rng
+
+
+# --- pod -------------------------------------------------------------------
+
+def test_pod_insert_query_paths():
+    pod = Pod()
+    pod.insert_cstr("firedancer.verify.v0.mcache", "gaddr:100")
+    pod.insert_ulong("firedancer.verify.v0.depth", 128)
+    pod.insert("firedancer.blob", b"\x01\x02")
+    assert pod.query_cstr("firedancer.verify.v0.mcache") == "gaddr:100"
+    assert pod.query_ulong("firedancer.verify.v0.depth") == 128
+    assert pod.query("firedancer.blob") == b"\x01\x02"
+    assert pod.query("missing.path") is None
+    assert pod.query_ulong("missing", 7) == 7
+    assert "firedancer.verify.v0.depth" in pod
+    sub = pod.subpod("firedancer.verify")
+    assert sub.query_ulong("v0.depth") == 128
+
+
+def test_pod_serialize_roundtrip():
+    pod = Pod()
+    pod.insert_cstr("a.b.c", "hello")
+    pod.insert_ulong("a.b.n", 2**63 + 5)
+    pod.insert("x", b"\xff" * 10)
+    blob = pod.serialize()
+    back = Pod.deserialize(blob)
+    assert back.to_dict() == pod.to_dict()
+    assert list(back.iter_leaves()) == [
+        ("a.b.c", "hello"),
+        ("a.b.n", 2**63 + 5),
+        ("x", b"\xff" * 10),
+    ]
+
+
+def test_pod_remove():
+    pod = Pod()
+    pod.insert_ulong("a.b", 1)
+    assert pod.remove("a.b")
+    assert not pod.remove("a.b")
+    assert pod.query("a.b") is None
+
+
+# --- rng -------------------------------------------------------------------
+
+def test_rng_deterministic_and_split():
+    a = Rng(seq=1, idx=0)
+    b = Rng(seq=1, idx=0)
+    assert [a.ulong() for _ in range(5)] == [b.ulong() for _ in range(5)]
+    # distinct seqs give distinct streams
+    c = Rng(seq=2, idx=0)
+    assert [Rng(seq=1, idx=0).ulong()] != [c.ulong()]
+    # counter-based: seekable
+    d = Rng(seq=1, idx=3)
+    a2 = Rng(seq=1, idx=0)
+    a2.ulong(), a2.ulong(), a2.ulong()
+    assert d.ulong() == a2.ulong()
+
+
+def test_rng_roll_unbiased_range():
+    r = Rng(seq=42)
+    for n in (1, 2, 7, 1000):
+        for _ in range(200):
+            assert 0 <= r.roll(n) < n
+
+
+def test_rng_floats():
+    r = Rng(seq=9)
+    vals = [r.float01() for _ in range(1000)]
+    assert all(0.0 <= v < 1.0 for v in vals)
+    assert 0.4 < sum(vals) / len(vals) < 0.6
+    exps = [r.float_exp() for _ in range(2000)]
+    assert all(v >= 0 for v in exps)
+    assert 0.9 < sum(exps) / len(exps) < 1.1
+
+
+# --- env -------------------------------------------------------------------
+
+def test_env_strip_cmdline():
+    argv = ["prog", "--depth", "128", "--name", "x", "--depth", "256", "pos"]
+    assert env.strip_cmdline_int(argv, "--depth", 0) == 256  # last wins
+    assert argv == ["prog", "--name", "x", "pos"]
+    assert env.strip_cmdline_str(argv, "--name", "d") == "x"
+    assert env.strip_cmdline_str(argv, "--gone", "d") == "d"
+    assert argv == ["prog", "pos"]
+
+
+def test_env_fallback_to_environ(monkeypatch):
+    monkeypatch.setenv("TILE_CPUS", "5")
+    argv = ["prog"]
+    assert env.strip_cmdline_int(argv, "--tile-cpus", 1) == 5
+    assert env.strip_cmdline_bool(argv, "--missing-flag", True) is True
+
+
+# --- log -------------------------------------------------------------------
+
+def test_log_levels_and_err_exits(tmp_path, capsys):
+    from firedancer_tpu.utils import log
+
+    path = str(tmp_path / "t.log")
+    log.boot(log_path=path, stderr_level=log.NOTICE)
+    log.debug("quiet")
+    log.notice("loud")
+    assert "loud" in capsys.readouterr().err
+    with pytest.raises(SystemExit):
+        log.err("fatal")
+    log.halt()
+    content = open(path).read()
+    assert "quiet" in content and "loud" in content and "fatal" in content
+    assert "NOTICE" in content and "test_util.py" in content
+
+
+# --- pcap ------------------------------------------------------------------
+
+def test_pcap_roundtrip(tmp_path):
+    path = str(tmp_path / "x.pcap")
+    payloads = [b"a" * 10, b"b" * 100, b"", b"\x00\xff" * 600]
+    with pcap.PcapWriter(path) as w:
+        for i, p in enumerate(payloads):
+            w.write(p, ts_sec=i, ts_usec=i * 10)
+    with pcap.PcapReader(path) as r:
+        assert r.linktype == pcap.LINKTYPE_USER0
+        recs = list(r)
+    assert [p for _, _, p in recs] == payloads
+    assert [s for s, _, _ in recs] == [0, 1, 2, 3]
+    assert pcap.read_all(path) == payloads
+
+
+def test_pcap_rejects_garbage(tmp_path):
+    path = str(tmp_path / "bad.pcap")
+    with open(path, "wb") as f:
+        f.write(b"notapcapfileheader123456")
+    with pytest.raises(ValueError):
+        pcap.PcapReader(path)
+
+
+# --- tempo -----------------------------------------------------------------
+
+def test_tempo_lazy_and_async():
+    assert tempo.lazy_default(128) >= 1_000
+    assert tempo.lazy_default(1 << 30) == 1_000_000_000
+    amin = tempo.async_min(tempo.lazy_default(128))
+    assert amin & (amin - 1) == 0  # pow2
+    r = Rng(seq=1)
+    for _ in range(100):
+        d = tempo.async_reload(r, amin)
+        assert amin <= d < 2 * amin
+    c = tempo.Clock()
+    t = c.now()
+    assert abs(t - tempo.wallclock()) < 50_000_000  # within 50ms
+
+
+# --- fctl ------------------------------------------------------------------
+
+def test_fctl_credit_flow():
+    depth = 8
+    rx_seq = [0]
+    f = Fctl(depth=depth, cr_burst=1)
+    f.rx_add(lambda: rx_seq[0])
+    tx_seq = 0
+    cr = f.tx_cr_update(0, tx_seq)
+    assert cr == depth  # consumer caught up: full credits
+    # publish depth frags without consumer progress -> credits exhausted
+    tx_seq += depth
+    cr -= depth
+    cr = f.tx_cr_update(cr, tx_seq)
+    assert cr == 0 and f.in_backpressure
+    # consumer advances partially but below resume threshold: stay backp
+    rx_seq[0] = 1
+    cr = f.tx_cr_update(cr, tx_seq)
+    assert f.in_backpressure
+    # consumer catches up past resume threshold
+    rx_seq[0] = tx_seq
+    cr = f.tx_cr_update(cr, tx_seq)
+    assert cr == depth and not f.in_backpressure
+    assert f.backp_cnt == 1
+
+
+def test_fctl_slowest_of_many():
+    f = Fctl(depth=16, cr_burst=1)
+    seqs = [[10], [4], [16]]
+    slow_hits = [0, 0, 0]
+    for i, s in enumerate(seqs):
+        f.rx_add(
+            (lambda s=s: s[0]),
+            (lambda d, i=i: slow_hits.__setitem__(i, slow_hits[i] + d)),
+        )
+    cr = f.tx_cr_update(0, 16)
+    # slowest consumer at seq 4: credits = 16 - (16-4) = 4
+    assert cr == 4
+    # drain credits; slowest triggers backpressure attribution
+    cr = f.tx_cr_update(0, 20)
+    assert cr == 0 and f.in_backpressure
+    assert slow_hits[1] == 1 and slow_hits[0] == 0 and slow_hits[2] == 0
