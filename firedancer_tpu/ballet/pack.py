@@ -82,6 +82,9 @@ class Pack:
     def pending_cnt(self) -> int:
         return len(self._heap)
 
+    def inflight_cnt(self) -> int:
+        return sum(len(b) for b in self._inflight)
+
     def insert(self, txn: PackTxn) -> bool:
         """Queue a transaction; evicts the worst if at depth. False = dropped."""
         self.insert_cnt += 1

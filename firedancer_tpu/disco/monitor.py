@@ -41,15 +41,24 @@ def snapshot(wksp: Workspace, pod: Pod) -> Dict[str, Dict[str, int]]:
             continue
         if "cnc" in sub:
             cnc = Cnc(wksp, sub["cnc"])
+            from firedancer_tpu.disco.tiles import (
+                CNC_DIAG_BACKP_CNT,
+                CNC_DIAG_HA_FILT_CNT,
+                CNC_DIAG_HA_FILT_SZ,
+                CNC_DIAG_IN_BACKP,
+                CNC_DIAG_SV_FILT_CNT,
+                CNC_DIAG_SV_FILT_SZ,
+            )
+
             out[f"tile.{name}"] = {
                 "signal": cnc.signal_query(),
                 "heartbeat": cnc.heartbeat_query(),
-                "in_backp": cnc.diag(0),
-                "backp_cnt": cnc.diag(1),
-                "ha_filt_cnt": cnc.diag(2),
-                "ha_filt_sz": cnc.diag(3),
-                "sv_filt_cnt": cnc.diag(4),
-                "sv_filt_sz": cnc.diag(5),
+                "in_backp": cnc.diag(CNC_DIAG_IN_BACKP),
+                "backp_cnt": cnc.diag(CNC_DIAG_BACKP_CNT),
+                "ha_filt_cnt": cnc.diag(CNC_DIAG_HA_FILT_CNT),
+                "ha_filt_sz": cnc.diag(CNC_DIAG_HA_FILT_SZ),
+                "sv_filt_cnt": cnc.diag(CNC_DIAG_SV_FILT_CNT),
+                "sv_filt_sz": cnc.diag(CNC_DIAG_SV_FILT_SZ),
             }
         if "fseq" in sub:
             fs = FSeq(wksp, sub["fseq"])

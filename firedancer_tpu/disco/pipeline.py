@@ -99,7 +99,6 @@ class PipelineResult:
 def run_pipeline(
     topo: Topology,
     payloads: List[bytes],
-    expect_cnt: Optional[int] = None,
     verify_backend: str = "oracle",
     verify_batch: int = 128,
     verify_max_msg_len: Optional[int] = None,
@@ -109,9 +108,9 @@ def run_pipeline(
     """Join tiles to the topology, run them on threads, wait for the sink
     to drain, HALT everything, and return counts + diag snapshot.
 
-    expect_cnt: frags the sink must receive before shutdown (defaults to
-    the number of unique payloads — with duplicates in the input the
-    caller must pass the post-dedup count).
+    Shutdown is quiescence-based (source exhausted + every link drained);
+    filtered frags never reach the sink, so the caller asserts on
+    PipelineResult.recv_cnt rather than passing an expected count in.
     """
     pod = topo.pod
     wksp = Workspace.join(topo.wksp_path)
@@ -154,8 +153,14 @@ def run_pipeline(
     )
     tiles = [replay, verify, dedup, pack, sink]
 
+    # Tiles run until HALT; max_ns is a hung-pipeline safety net and must
+    # outlast the supervisor's own timeout or slow runs silently truncate.
+    tile_max_ns = int((timeout_s + 30.0) * 1e9)
     threads = [
-        threading.Thread(target=t.run, name=t.name, daemon=True) for t in tiles
+        threading.Thread(
+            target=t.run, args=(tile_max_ns,), name=t.name, daemon=True
+        )
+        for t in tiles
     ]
     t0 = time.perf_counter()
     for th in threads:
@@ -173,9 +178,6 @@ def run_pipeline(
             and sink.in_link.seq >= pack.out_link.seq
         )
 
-    # quiesced() alone proves the stream fully drained (filtered frags
-    # never reach the sink, so a sink-count target is not a shutdown
-    # condition; expect_cnt is only the caller's assertion input).
     deadline = t0 + timeout_s
     while time.perf_counter() < deadline:
         if quiesced():

@@ -519,13 +519,28 @@ class PackTile(Tile):
         """Schedule as many non-conflicting txns as possible, rotating
         banks after each success; stop after a full cycle of failures."""
         misses = 0
+        block_ended = False
         while misses < self.bank_cnt:
             bank = self._rr_bank
             self._rr_bank = (self._rr_bank + 1) % self.bank_cnt
             txn = self.pack.schedule(bank)
             if txn is None:
                 misses += 1
+                if misses >= self.bank_cnt and not block_ended:
+                    # All banks refused. With nothing in flight the only
+                    # cause is exhausted per-block CU budgets: in the
+                    # reference a new PoH slot resets them; the slice has
+                    # no PoH clock, so end the block here to avoid a
+                    # permanent scheduling wedge.
+                    if (
+                        self.pack.pending_cnt() > 0
+                        and self.pack.inflight_cnt() == 0
+                    ):
+                        self.pack.end_block()
+                        block_ended = True
+                        misses = 0
                 continue
+            block_ended = False
             misses = 0
             payload = self._payloads.pop(txn.txn_id)
             dropped = False

@@ -9,12 +9,3 @@ syscall library (syscalls.py — fd_vm_syscalls.c), and the disassembler
 """
 
 from .sbpf import Instr, asm, decode_program, encode_program  # noqa: F401
-from .interp import (  # noqa: F401
-    VmContext,
-    VmFault,
-    HEAP_START,
-    INPUT_START,
-    PROGRAM_START,
-    STACK_START,
-)
-from .disasm import disasm, disasm_program  # noqa: F401

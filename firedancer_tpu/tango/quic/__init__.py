@@ -1,0 +1,21 @@
+"""QUIC v1 (RFC 9000/9001) — the TPU-native equivalent of the reference's
+tango/quic layer (/root/reference/src/tango/quic/): wire codecs, packet
+protection, a from-scratch TLS 1.3 handshake over CRYPTO frames, connection
+state machine, and stream reassembly, speaking the Solana TPU ALPN.
+
+The reference's split is mirrored by module:
+  wire.py          <- templ/fd_quic_templ.h + fd_quic_proto.{h,c} (codecs)
+  crypto_suites.py <- crypto/fd_quic_crypto_suites.{h,c} (AEAD + HP + keys)
+  tls.py           <- tls/fd_quic_tls.{h,c} (handshake engine; here built
+                      from scratch on ballet aes/hkdf/x25519/x509 instead of
+                      delegating to a TLS library)
+  conn.py          <- fd_quic_conn.{h,c} + fd_quic_stream.* (per-conn state)
+  quic.py          <- fd_quic.{h,c} (top object: conn map, aio, service loop)
+"""
+
+def __getattr__(name):
+    if name in ("Quic", "QuicConfig"):
+        from firedancer_tpu.tango.quic import quic as _q
+
+        return getattr(_q, name)
+    raise AttributeError(name)

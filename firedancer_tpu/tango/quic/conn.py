@@ -1,0 +1,625 @@
+"""QUIC connection state machine: packet numbers, ACKs, CRYPTO + streams.
+
+Role parity with /root/reference/src/tango/quic/fd_quic_conn.{h,c},
+fd_quic_stream.*, and the ack/loss tracking of fd_quic_pkt_meta.*: three
+packet-number spaces (initial/handshake/app) each with their own keys, ACK
+range tracking, CRYPTO-stream reassembly feeding the TLS engine, stream
+reassembly delivering completed unidirectional streams (one Solana txn per
+stream, the TPU convention), simple PTO-style retransmission, and datagram
+assembly with long-header coalescing + client-Initial padding.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from firedancer_tpu.tango.quic import wire
+from firedancer_tpu.tango.quic.crypto_suites import (
+    AEAD_OVERHEAD,
+    PacketKeys,
+    QuicCryptoError,
+    initial_secrets,
+    protect_packet,
+    unprotect_header,
+)
+from firedancer_tpu.tango.quic.tls import (
+    LEVEL_APP,
+    LEVEL_HANDSHAKE,
+    LEVEL_INITIAL,
+    TlsConfig,
+    TlsEndpoint,
+    TlsError,
+)
+
+MAX_DATAGRAM = 1200  # conservative pre-PMTUD budget (RFC 9000 §14.1)
+CID_LEN = 8
+
+# transport parameter ids (RFC 9000 §18.2)
+TP_ORIGINAL_DCID = 0x00
+TP_MAX_IDLE_TIMEOUT = 0x01
+TP_MAX_UDP_PAYLOAD = 0x03
+TP_INITIAL_MAX_DATA = 0x04
+TP_INITIAL_MAX_STREAM_DATA_BIDI_LOCAL = 0x05
+TP_INITIAL_MAX_STREAM_DATA_BIDI_REMOTE = 0x06
+TP_INITIAL_MAX_STREAM_DATA_UNI = 0x07
+TP_INITIAL_MAX_STREAMS_BIDI = 0x08
+TP_INITIAL_MAX_STREAMS_UNI = 0x09
+TP_INITIAL_SCID = 0x0F
+
+_LEVEL_TO_PKT = {
+    LEVEL_INITIAL: wire.PKT_INITIAL,
+    LEVEL_HANDSHAKE: wire.PKT_HANDSHAKE,
+}
+
+
+def encode_transport_params(params: Dict[int, object]) -> bytes:
+    out = bytearray()
+    for tid, val in params.items():
+        out += wire.varint_encode(tid)
+        if isinstance(val, bytes):
+            out += wire.varint_encode(len(val))
+            out += val
+        else:
+            body = wire.varint_encode(int(val))
+            out += wire.varint_encode(len(body))
+            out += body
+    return bytes(out)
+
+
+def parse_transport_params(buf: bytes) -> Dict[int, bytes]:
+    out: Dict[int, bytes] = {}
+    off = 0
+    while off < len(buf):
+        tid, off = wire.varint_decode(buf, off)
+        ln, off = wire.varint_decode(buf, off)
+        out[tid] = bytes(buf[off : off + ln])
+        off += ln
+    return out
+
+
+def tp_varint(params: Dict[int, bytes], tid: int, default: int = 0) -> int:
+    v = params.get(tid)
+    if v is None:
+        return default
+    return wire.varint_decode(v, 0)[0]
+
+
+@dataclass
+class _SentPacket:
+    time: float
+    ack_eliciting: bool
+    crypto: List[Tuple[int, bytes]] = field(default_factory=list)
+    streams: List[Tuple[int, int, bytes, bool]] = field(default_factory=list)
+    handshake_done: bool = False
+
+
+class _PnSpace:
+    """One packet-number space: keys, ACK state, CRYPTO buffers, loss."""
+
+    def __init__(self) -> None:
+        self.keys_tx: Optional[PacketKeys] = None
+        self.keys_rx: Optional[PacketKeys] = None
+        self.next_pn = 0
+        self.largest_rx = -1
+        self.largest_acked = -1
+        # received pn ranges as a sorted (desc) list of [lo, hi]
+        self.rx_ranges: List[List[int]] = []
+        self.ack_needed = False
+        # crypto stream tx: queue of (offset, bytes) not yet sent
+        self.crypto_tx: List[Tuple[int, bytes]] = []
+        self.crypto_tx_off = 0
+        # crypto stream rx reassembly
+        self.crypto_rx: Dict[int, bytes] = {}
+        self.crypto_rx_off = 0
+        self.sent: Dict[int, _SentPacket] = {}
+        self.dropped = False
+
+    def record_rx(self, pn: int) -> bool:
+        """Track a received pn. -> False if duplicate."""
+        for r in self.rx_ranges:
+            if r[0] <= pn <= r[1]:
+                return False
+        self.largest_rx = max(self.largest_rx, pn)
+        self.rx_ranges.append([pn, pn])
+        self.rx_ranges.sort(key=lambda r: -r[1])
+        # merge adjacent
+        merged: List[List[int]] = []
+        for r in self.rx_ranges:
+            if merged and r[1] >= merged[-1][0] - 1:
+                merged[-1][0] = min(merged[-1][0], r[0])
+            else:
+                merged.append(r)
+        self.rx_ranges = merged[:32]  # bound state like the reference
+        return True
+
+    def ack_frame(self) -> Optional[bytes]:
+        if not self.rx_ranges:
+            return None
+        first = self.rx_ranges[0]
+        ranges: List[Tuple[int, int]] = []
+        prev_lo = first[0]
+        for r in self.rx_ranges[1:]:
+            gap = prev_lo - r[1] - 2
+            ranges.append((gap, r[1] - r[0]))
+            prev_lo = r[0]
+        return wire.encode_ack(first[1], 0, first[1] - first[0], ranges)
+
+    def queue_crypto(self, data: bytes) -> None:
+        self.crypto_tx.append((self.crypto_tx_off, data))
+        self.crypto_tx_off += len(data)
+
+    def on_ack(self, f: wire.Frame) -> List[int]:
+        """Remove acked packets from the sent map; -> acked pns."""
+        acked: List[int] = []
+        hi = f.fields["largest"]
+        lo = hi - f.fields["first_range"]
+        spans = [(lo, hi)]
+        for gap, rng in f.ack_ranges:
+            hi = lo - gap - 2
+            lo = hi - rng
+            spans.append((lo, hi))
+        for lo, hi in spans:
+            for pn in list(self.sent.keys()):
+                if lo <= pn <= hi:
+                    del self.sent[pn]
+                    acked.append(pn)
+            self.largest_acked = max(self.largest_acked, hi)
+        return acked
+
+    def drop_keys(self) -> None:
+        self.keys_tx = None
+        self.keys_rx = None
+        self.sent.clear()
+        self.crypto_tx.clear()
+        self.dropped = True
+
+
+class _RecvStream:
+    __slots__ = ("chunks", "fin_size", "delivered")
+
+    def __init__(self) -> None:
+        self.chunks: Dict[int, bytes] = {}
+        self.fin_size: Optional[int] = None
+        self.delivered = False
+
+    def add(self, off: int, data: bytes, fin: bool) -> None:
+        if data:
+            self.chunks[off] = data
+        if fin:
+            self.fin_size = off + len(data)
+
+    def complete(self) -> Optional[bytes]:
+        if self.fin_size is None or self.delivered:
+            return None
+        out = bytearray()
+        off = 0
+        while off < self.fin_size:
+            chunk = self.chunks.get(off)
+            if chunk is None:
+                # tolerate overlapping retransmits: scan for a covering chunk
+                found = None
+                for o, c in self.chunks.items():
+                    if o <= off < o + len(c):
+                        found = c[off - o :]
+                        break
+                if found is None:
+                    return None
+                chunk = found
+            out += chunk
+            off += len(chunk)
+        self.delivered = True
+        return bytes(out[: self.fin_size])
+
+
+class QuicConn:
+    """A single QUIC connection (client or server role)."""
+
+    PTO = 0.25  # seconds; simple fixed probe timeout
+
+    def __init__(
+        self,
+        is_server: bool,
+        identity_seed: bytes,
+        peer_addr,
+        alpns: Tuple[bytes, ...] = (b"solana-tpu",),
+        orig_dcid: Optional[bytes] = None,
+        idle_timeout: float = 10.0,
+        on_stream: Optional[Callable[[int, bytes], None]] = None,
+        now: float = 0.0,
+        initial_max_streams_uni: int = 2048,
+        initial_max_data: int = 1 << 24,
+    ):
+        self.is_server = is_server
+        self.peer_addr = peer_addr
+        self.scid = os.urandom(CID_LEN)
+        self.on_stream = on_stream
+        self.established = False
+        self.closed = False
+        self.close_reason: Optional[str] = None
+        self.idle_timeout = idle_timeout
+        self._last_activity = now
+        self._hs_done_pending = False
+        self._hs_done_sent = False
+        self._max_streams_uni = initial_max_streams_uni
+        self._streams_consumed = 0
+        self._max_data = initial_max_data
+        self._rx_data_total = 0
+
+        self.spaces = [_PnSpace(), _PnSpace(), _PnSpace()]
+        if is_server:
+            assert orig_dcid is not None
+            self.dcid = b""  # learned from the client's first Initial (scid)
+            self.orig_dcid = orig_dcid
+            ckeys, skeys = initial_secrets(orig_dcid)
+            self.spaces[LEVEL_INITIAL].keys_rx = ckeys
+            self.spaces[LEVEL_INITIAL].keys_tx = skeys
+        else:
+            self.dcid = os.urandom(CID_LEN)
+            self.orig_dcid = self.dcid
+            ckeys, skeys = initial_secrets(self.dcid)
+            self.spaces[LEVEL_INITIAL].keys_tx = ckeys
+            self.spaces[LEVEL_INITIAL].keys_rx = skeys
+
+        tp: Dict[int, object] = {
+            TP_MAX_IDLE_TIMEOUT: int(idle_timeout * 1000),
+            TP_MAX_UDP_PAYLOAD: 1452,
+            TP_INITIAL_MAX_DATA: initial_max_data,
+            TP_INITIAL_MAX_STREAM_DATA_BIDI_LOCAL: 1 << 20,
+            TP_INITIAL_MAX_STREAM_DATA_BIDI_REMOTE: 1 << 20,
+            TP_INITIAL_MAX_STREAM_DATA_UNI: 1 << 20,
+            TP_INITIAL_MAX_STREAMS_BIDI: 128,
+            TP_INITIAL_MAX_STREAMS_UNI: initial_max_streams_uni,
+            TP_INITIAL_SCID: self.scid,
+        }
+        if is_server:
+            tp[TP_ORIGINAL_DCID] = orig_dcid
+        self.tls = TlsEndpoint(
+            TlsConfig(
+                is_server=is_server,
+                identity_seed=identity_seed,
+                alpns=alpns,
+                transport_params=encode_transport_params(tp),
+            )
+        )
+        self.peer_tp: Dict[int, bytes] = {}
+        # streams
+        self._recv_streams: Dict[int, _RecvStream] = {}
+        self._next_uni_stream = 2 if not is_server else 3
+        self._send_queue: List[Tuple[int, int, bytes, bool]] = []
+
+        if not is_server:
+            self.tls.start()
+            self._drain_tls()
+
+    # ---------------------------------------------------------------- rx ---
+
+    def recv_datagram(self, data: bytes, now: float) -> None:
+        self._last_activity = now
+        off = 0
+        while off < len(data) and not self.closed:
+            first = data[off]
+            if wire.is_long_header(first):
+                try:
+                    hdr = wire.parse_long_header(data, off)
+                except wire.QuicWireError:
+                    return
+                pkt_end = hdr.hdr_end + hdr.length
+                if hdr.version != wire.QUIC_VERSION_1 or pkt_end > len(data):
+                    return
+                if hdr.pkt_type == wire.PKT_INITIAL:
+                    level = LEVEL_INITIAL
+                elif hdr.pkt_type == wire.PKT_HANDSHAKE:
+                    level = LEVEL_HANDSHAKE
+                else:
+                    off = pkt_end  # 0-RTT/Retry unsupported: skip
+                    continue
+                if not self.dcid:
+                    self.dcid = hdr.scid  # learn the peer's chosen cid
+                self._decrypt_and_process(
+                    data, off, hdr.hdr_end, pkt_end, level, now
+                )
+                off = pkt_end
+            else:
+                level = LEVEL_APP
+                try:
+                    hdr_s = wire.parse_short_header(data, CID_LEN, off)
+                except wire.QuicWireError:
+                    return
+                self._decrypt_and_process(
+                    data, off, hdr_s.hdr_end, len(data), level, now
+                )
+                off = len(data)
+
+    def _decrypt_and_process(
+        self, data: bytes, pkt_start: int, pn_off: int, pkt_end: int,
+        level: int, now: float,
+    ) -> None:
+        space = self.spaces[level]
+        if space.keys_rx is None:
+            return  # keys not yet available (or dropped); packet is lost
+        pkt = bytearray(data[pkt_start:pkt_end])
+        rel_pn_off = pn_off - pkt_start
+        try:
+            pn_len, tpn = unprotect_header(space.keys_rx, pkt, rel_pn_off)
+            pn = wire.pn_decode(tpn, pn_len, space.largest_rx)
+            header = bytes(pkt[: rel_pn_off + pn_len])
+            payload = space.keys_rx.open(
+                header, pn, bytes(pkt[rel_pn_off + pn_len :])
+            )
+        except QuicCryptoError:
+            return  # undecryptable: drop silently (RFC 9001 §9.3)
+        if not space.record_rx(pn):
+            return  # duplicate
+        try:
+            frames = wire.parse_frames(payload)
+        except wire.QuicWireError:
+            self.abort(0x0A, "frame encoding error")
+            return
+        ack_eliciting = False
+        for f in frames:
+            if f.ftype not in (wire.FRAME_ACK,):
+                ack_eliciting = True
+            self._on_frame(level, f, now)
+        if ack_eliciting:
+            space.ack_needed = True
+
+    def _on_frame(self, level: int, f: wire.Frame, now: float) -> None:
+        space = self.spaces[level]
+        t = f.ftype
+        if t == wire.FRAME_ACK:
+            space.on_ack(f)
+        elif t == wire.FRAME_CRYPTO:
+            self._on_crypto(level, f.fields["offset"], f.data)
+        elif wire.FRAME_STREAM_BASE <= t <= wire.FRAME_STREAM_BASE | 7:
+            self._on_stream_frame(f)
+        elif t == wire.FRAME_HANDSHAKE_DONE:
+            if not self.is_server:
+                self.established = True
+                self.spaces[LEVEL_HANDSHAKE].drop_keys()
+        elif t in (wire.FRAME_CONN_CLOSE_QUIC, wire.FRAME_CONN_CLOSE_APP):
+            self.closed = True
+            self.close_reason = f.data.decode("utf-8", "replace")
+        # MAX_DATA/MAX_STREAMS/NEW_CONNECTION_ID etc: tracked loosely; the
+        # TPU role never hits the limits within a connection's lifetime.
+
+    def _on_crypto(self, level: int, offset: int, data: bytes) -> None:
+        space = self.spaces[level]
+        if offset + len(data) <= space.crypto_rx_off:
+            return  # fully duplicate
+        space.crypto_rx[offset] = data
+        # feed contiguous bytes to TLS
+        progressed = True
+        while progressed:
+            progressed = False
+            for off, chunk in sorted(space.crypto_rx.items()):
+                if off <= space.crypto_rx_off < off + len(chunk):
+                    take = chunk[space.crypto_rx_off - off :]
+                    try:
+                        self.tls.consume(level, take)
+                    except TlsError as e:
+                        self.abort(0x0128, f"tls: {e}")
+                        return
+                    space.crypto_rx_off = off + len(chunk)
+                    del space.crypto_rx[off]
+                    progressed = True
+                    break
+                if off + len(chunk) <= space.crypto_rx_off:
+                    del space.crypto_rx[off]
+                    progressed = True
+                    break
+        self._drain_tls()
+
+    def _on_stream_frame(self, f: wire.Frame) -> None:
+        sid = f.fields["stream_id"]
+        st = self._recv_streams.get(sid)
+        if st is None:
+            st = self._recv_streams[sid] = _RecvStream()
+        if st.delivered:
+            return
+        st.add(f.fields["offset"], f.data, bool(f.fields["fin"]))
+        self._rx_data_total += len(f.data)
+        done = st.complete()
+        if done is not None:
+            self._streams_consumed += 1
+            if self.on_stream is not None:
+                self.on_stream(sid, done)
+            # retire reassembly state; keep the tombstone for dup filtering
+            st.chunks.clear()
+
+    # --------------------------------------------------------------- tls ---
+
+    def _drain_tls(self) -> None:
+        for level, msg in self.tls.take_output():
+            self.spaces[level].queue_crypto(msg)
+        if (
+            self.tls.hs_secrets is not None
+            and self.spaces[LEVEL_HANDSHAKE].keys_tx is None
+        ):
+            c, s = self.tls.hs_secrets
+            ck, sk = PacketKeys.from_secret(c), PacketKeys.from_secret(s)
+            hs = self.spaces[LEVEL_HANDSHAKE]
+            if self.is_server:
+                hs.keys_rx, hs.keys_tx = ck, sk
+            else:
+                hs.keys_rx, hs.keys_tx = sk, ck
+        if (
+            self.tls.app_secrets is not None
+            and self.spaces[LEVEL_APP].keys_tx is None
+        ):
+            c, s = self.tls.app_secrets
+            ck, sk = PacketKeys.from_secret(c), PacketKeys.from_secret(s)
+            ap = self.spaces[LEVEL_APP]
+            if self.is_server:
+                ap.keys_rx, ap.keys_tx = ck, sk
+            else:
+                ap.keys_rx, ap.keys_tx = sk, ck
+        if self.tls.peer_transport_params is not None and not self.peer_tp:
+            self.peer_tp = parse_transport_params(
+                self.tls.peer_transport_params
+            )
+        if self.tls.handshake_complete and self.is_server and not self.established:
+            self.established = True
+            self._hs_done_pending = True
+            self.spaces[LEVEL_INITIAL].drop_keys()
+            self.spaces[LEVEL_HANDSHAKE].drop_keys()
+
+    # ---------------------------------------------------------------- tx ---
+
+    def send_stream(self, data: bytes, fin: bool = True) -> int:
+        """Open a new unidirectional stream carrying `data` (one txn)."""
+        sid = self._next_uni_stream
+        self._next_uni_stream += 4
+        self._send_queue.append((sid, 0, data, fin))
+        return sid
+
+    def pending_datagrams(self, now: float) -> List[bytes]:
+        """Assemble everything sendable into coalesced datagrams."""
+        out: List[bytes] = []
+        segments: List[bytes] = []
+        pad_initial = False
+        for level in (LEVEL_INITIAL, LEVEL_HANDSHAKE, LEVEL_APP):
+            space = self.spaces[level]
+            if space.keys_tx is None or space.dropped:
+                continue
+            frames: List[bytes] = []
+            sent = _SentPacket(time=now, ack_eliciting=False)
+            if space.ack_needed:
+                ack = space.ack_frame()
+                if ack:
+                    frames.append(ack)
+                space.ack_needed = False
+            budget = MAX_DATAGRAM - 96  # header + AEAD margin
+            while space.crypto_tx and budget > 24:
+                off, data = space.crypto_tx.pop(0)
+                room = budget - 12
+                if len(data) > room:
+                    space.crypto_tx.insert(0, (off + room, data[room:]))
+                    data = data[:room]
+                frames.append(wire.encode_crypto(off, data))
+                sent.crypto.append((off, data))
+                sent.ack_eliciting = True
+                budget -= 12 + len(data)
+            if level == LEVEL_APP:
+                if self._hs_done_pending:
+                    frames.append(bytes([wire.FRAME_HANDSHAKE_DONE]))
+                    sent.handshake_done = True
+                    sent.ack_eliciting = True
+                    self._hs_done_pending = False
+                while self._send_queue and budget > 32:
+                    sid, off, data, fin = self._send_queue.pop(0)
+                    room = budget - 16
+                    if len(data) > room:
+                        self._send_queue.insert(
+                            0, (sid, off + room, data[room:], fin)
+                        )
+                        data, fin_now = data[:room], False
+                    else:
+                        fin_now = fin
+                    frames.append(
+                        wire.encode_stream(sid, off, data, fin_now)
+                    )
+                    sent.streams.append((sid, off, data, fin_now))
+                    sent.ack_eliciting = True
+                    budget -= 16 + len(data)
+            if not frames:
+                continue
+            payload = b"".join(frames)
+            # the header-protection sample needs pn_len+payload+tag >= 20
+            # bytes past the pn offset: pad tiny payloads (PADDING frames)
+            if len(payload) < 8:
+                payload += bytes(8 - len(payload))
+            pn = space.next_pn
+            space.next_pn += 1
+            pn_len = 2
+            if level == LEVEL_APP:
+                header = wire.encode_short_header(self.dcid, pn, pn_len)
+            else:
+                header = wire.encode_long_header(
+                    _LEVEL_TO_PKT[level],
+                    self.dcid if self.dcid else self.orig_dcid,
+                    self.scid,
+                    pn,
+                    pn_len,
+                    len(payload) + AEAD_OVERHEAD,
+                    token=b"",
+                )
+                if level == LEVEL_INITIAL and not self.is_server:
+                    pad_initial = True
+            if sent.ack_eliciting:
+                space.sent[pn] = sent
+            segments.append(
+                protect_packet(space.keys_tx, header, pn, pn_len, payload)
+            )
+        if not segments:
+            return out
+        datagram = b"".join(segments)
+        if pad_initial and len(datagram) < 1200:
+            # client Initial datagrams must be >=1200B (RFC 9000 §14.1):
+            # pre-pad the *first* segment's payload is complex post-AEAD, so
+            # append PADDING inside a trailing app/hs segment if one exists;
+            # otherwise rebuild with padding. Simplest correct approach:
+            # append raw zero bytes is NOT valid post-protection, so instead
+            # re-emit padding as a separate Initial packet is overkill —
+            # we pad by constructing the datagram again below.
+            datagram = self._pad_initial_datagram(segments, now)
+        out.append(datagram)
+        return out
+
+    def _pad_initial_datagram(self, segments: List[bytes], now: float) -> bytes:
+        """Pad a client datagram containing an Initial to 1200B by sending
+        an extra PADDING-only Initial packet sized to fill the gap."""
+        space = self.spaces[LEVEL_INITIAL]
+        if space.keys_tx is None:
+            return b"".join(segments)
+        gap = 1200 - sum(len(s) for s in segments)
+        pn = space.next_pn
+        space.next_pn += 1
+        pn_len = 2
+        # long header for dcid/scid as in normal initial
+        overhead = 7 + 1 + len(self.dcid or self.orig_dcid) + 1 + len(self.scid) + 1 + 2 + pn_len + AEAD_OVERHEAD
+        pad_len = max(8, gap - overhead)
+        payload = bytes(pad_len)  # PADDING frames
+        header = wire.encode_long_header(
+            wire.PKT_INITIAL,
+            self.dcid if self.dcid else self.orig_dcid,
+            self.scid,
+            pn,
+            pn_len,
+            len(payload) + AEAD_OVERHEAD,
+        )
+        segments.append(
+            protect_packet(space.keys_tx, header, pn, pn_len, payload)
+        )
+        return b"".join(segments)
+
+    # ------------------------------------------------------------ service --
+
+    def service(self, now: float) -> List[bytes]:
+        """Timers: idle timeout + PTO retransmission. -> datagrams to send."""
+        if self.closed:
+            return []
+        if now - self._last_activity > self.idle_timeout:
+            self.closed = True
+            self.close_reason = "idle timeout"
+            return []
+        for space in self.spaces:
+            if space.dropped:
+                continue
+            for pn in list(space.sent.keys()):
+                sp = space.sent[pn]
+                if now - sp.time > self.PTO:
+                    del space.sent[pn]
+                    for off, data in sp.crypto:
+                        space.crypto_tx.insert(0, (off, data))
+                    for s in sp.streams:
+                        self._send_queue.insert(0, s)
+                    if sp.handshake_done:
+                        self._hs_done_pending = True
+        return self.pending_datagrams(now)
+
+    def abort(self, error: int, reason: str) -> None:
+        self.closed = True
+        self.close_reason = reason

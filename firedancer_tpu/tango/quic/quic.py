@@ -1,0 +1,173 @@
+"""Top-level QUIC endpoint: connection map, datagram routing, service loop.
+
+Role parity with /root/reference/src/tango/quic/fd_quic.{h,c}: the object an
+aio backend feeds datagrams into (fd_quic_process_packet) and that produces
+datagrams out through an aio tx callback, managing server-side connection
+creation keyed by destination connection id and driving per-conn timers via
+service() (fd_quic_service). Transport is pluggable: anything that can call
+`rx()` with (peer_addr, datagram) and accept `tx(peer_addr, datagram)`
+callbacks works — UDP sockets (tango/udpsock), in-process paired wires for
+tests (the reference's fd_quic_test_helpers virtual pairs), or pcap replay.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from firedancer_tpu.tango.quic import wire
+from firedancer_tpu.tango.quic.conn import CID_LEN, QuicConn
+
+
+@dataclass
+class QuicConfig:
+    is_server: bool
+    identity_seed: bytes
+    alpns: Tuple[bytes, ...] = (b"solana-tpu",)
+    idle_timeout: float = 10.0
+    max_conns: int = 1024
+    initial_max_streams_uni: int = 2048
+
+
+class Quic:
+    """A QUIC endpoint (one server or one client side)."""
+
+    def __init__(
+        self,
+        cfg: QuicConfig,
+        tx: Callable[[object, bytes], None],
+        on_stream: Optional[Callable[[QuicConn, int, bytes], None]] = None,
+        on_conn_new: Optional[Callable[[QuicConn], None]] = None,
+        on_conn_closed: Optional[Callable[[QuicConn], None]] = None,
+    ):
+        self.cfg = cfg
+        self._tx = tx
+        self._on_stream = on_stream
+        self._on_conn_new = on_conn_new
+        self._on_conn_closed = on_conn_closed
+        self._conns_by_cid: Dict[bytes, QuicConn] = {}
+        self.conns: List[QuicConn] = []
+        # metrics (reference: fd_quic_metrics)
+        self.metrics = {
+            "rx_datagrams": 0,
+            "tx_datagrams": 0,
+            "conns_created": 0,
+            "conns_closed": 0,
+            "streams_completed": 0,
+            "rx_dropped": 0,
+        }
+
+    # ------------------------------------------------------------- client --
+
+    def connect(self, peer_addr, now: float = 0.0) -> QuicConn:
+        assert not self.cfg.is_server
+        conn = QuicConn(
+            is_server=False,
+            identity_seed=self.cfg.identity_seed,
+            peer_addr=peer_addr,
+            alpns=self.cfg.alpns,
+            idle_timeout=self.cfg.idle_timeout,
+            on_stream=None,
+            now=now,
+        )
+        self._register(conn)
+        self._flush(conn, now)
+        return conn
+
+    # ----------------------------------------------------------------- rx --
+
+    def rx(self, peer_addr, datagram: bytes, now: float) -> None:
+        """Feed one received UDP datagram into the endpoint."""
+        self.metrics["rx_datagrams"] += 1
+        if not datagram:
+            return
+        conn = self._route(datagram)
+        if conn is None:
+            if not self.cfg.is_server or not wire.is_long_header(datagram[0]):
+                self.metrics["rx_dropped"] += 1
+                return
+            try:
+                hdr = wire.parse_long_header(datagram)
+            except wire.QuicWireError:
+                self.metrics["rx_dropped"] += 1
+                return
+            if (
+                hdr.pkt_type != wire.PKT_INITIAL
+                or hdr.version != wire.QUIC_VERSION_1
+                or len(self.conns) >= self.cfg.max_conns
+            ):
+                self.metrics["rx_dropped"] += 1
+                return
+            conn = QuicConn(
+                is_server=True,
+                identity_seed=self.cfg.identity_seed,
+                peer_addr=peer_addr,
+                alpns=self.cfg.alpns,
+                orig_dcid=hdr.dcid,
+                idle_timeout=self.cfg.idle_timeout,
+                on_stream=None,
+                now=now,
+                initial_max_streams_uni=self.cfg.initial_max_streams_uni,
+            )
+            self._register(conn)
+            self._conns_by_cid[hdr.dcid] = conn  # route follow-up initials
+            if self._on_conn_new is not None:
+                self._on_conn_new(conn)
+        conn.peer_addr = peer_addr
+        conn.recv_datagram(datagram, now)
+        self._flush(conn, now)
+
+    def _route(self, datagram: bytes) -> Optional[QuicConn]:
+        if wire.is_long_header(datagram[0]):
+            try:
+                hdr = wire.parse_long_header(datagram)
+            except wire.QuicWireError:
+                return None
+            return self._conns_by_cid.get(hdr.dcid)
+        if 1 + CID_LEN > len(datagram):
+            return None
+        return self._conns_by_cid.get(datagram[1 : 1 + CID_LEN])
+
+    # ------------------------------------------------------------ service --
+
+    def service(self, now: float) -> None:
+        """Drive timers on every connection; reap closed conns."""
+        for conn in list(self.conns):
+            for dg in conn.service(now):
+                self._tx(conn.peer_addr, dg)
+                self.metrics["tx_datagrams"] += 1
+            if conn.closed:
+                self._unregister(conn)
+
+    # ------------------------------------------------------------ helpers --
+
+    def _register(self, conn: QuicConn) -> None:
+        self.conns.append(conn)
+        self._conns_by_cid[conn.scid] = conn
+        self.metrics["conns_created"] += 1
+        conn.on_stream = self._make_stream_cb(conn)
+
+    def _make_stream_cb(self, conn: QuicConn):
+        def cb(sid: int, data: bytes) -> None:
+            self.metrics["streams_completed"] += 1
+            if self._on_stream is not None:
+                self._on_stream(conn, sid, data)
+
+        return cb
+
+    def _unregister(self, conn: QuicConn) -> None:
+        if conn in self.conns:
+            self.conns.remove(conn)
+            self.metrics["conns_closed"] += 1
+            if self._on_conn_closed is not None:
+                self._on_conn_closed(conn)
+        for cid in [k for k, v in self._conns_by_cid.items() if v is conn]:
+            del self._conns_by_cid[cid]
+
+    def _flush(self, conn: QuicConn, now: float) -> None:
+        for dg in conn.pending_datagrams(now):
+            self._tx(conn.peer_addr, dg)
+            self.metrics["tx_datagrams"] += 1
+        if conn.closed:
+            self._unregister(conn)

@@ -1,0 +1,64 @@
+"""udpsock — plain UDP socket aio backend.
+
+Role parity with /root/reference/src/tango/udpsock/fd_udpsock.{h,c}: the
+development fallback for the XDP kernel-bypass path. A nonblocking UDP
+socket drained in bursts into an rx callback, with an Aio-shaped tx side.
+(The reference's AF_XDP path, tango/xdp/fd_xsk.*, has no TPU-host
+equivalent here: kernel bypass NICs are out of scope for the dev loop; the
+architecture keeps the same aio seam so one can be slotted in.)
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Callable, List, Optional, Tuple
+
+from firedancer_tpu.tango.aio import Aio, Packet
+
+MTU = 2048
+RX_BURST = 64
+
+
+class UdpSock:
+    """Nonblocking UDP socket with aio-style burst service."""
+
+    def __init__(self, bind_addr: Tuple[str, int] = ("127.0.0.1", 0)):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setblocking(False)
+        self._sock.bind(bind_addr)
+        self.local_addr = self._sock.getsockname()
+        self.metrics = {"rx_pkts": 0, "tx_pkts": 0, "tx_fails": 0}
+
+    def aio_tx(self) -> Aio:
+        def send(batch: List[Packet]) -> int:
+            n = 0
+            for addr, payload in batch:
+                try:
+                    self._sock.sendto(payload, addr)
+                    self.metrics["tx_pkts"] += 1
+                    n += 1
+                except (BlockingIOError, OSError):
+                    self.metrics["tx_fails"] += 1
+            return n
+
+        return Aio(send)
+
+    def service_rx(
+        self, on_packet: Callable[[Tuple[str, int], bytes], None]
+    ) -> int:
+        """Drain up to RX_BURST datagrams into on_packet. -> count."""
+        n = 0
+        for _ in range(RX_BURST):
+            try:
+                data, addr = self._sock.recvfrom(MTU)
+            except BlockingIOError:
+                break
+            except OSError:
+                break
+            self.metrics["rx_pkts"] += 1
+            on_packet(addr, data)
+            n += 1
+        return n
+
+    def close(self) -> None:
+        self._sock.close()

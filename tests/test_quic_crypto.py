@@ -1,0 +1,224 @@
+"""Crypto primitives behind QUIC/TLS: AES, AES-GCM, HKDF, X25519, X.509.
+
+Vector sources: FIPS-197 (AES), NIST GCM spec test cases, RFC 5869 (HKDF),
+RFC 7748 (X25519), RFC 8448 (TLS 1.3 traces, via expand_label), plus
+randomized cross-checks against the `cryptography` package as an oracle
+(mirroring the reference's OPENSSL_COMPARE gate in
+ballet/ed25519/test_ed25519.c:580-592).
+"""
+
+import os
+
+import pytest
+
+from firedancer_tpu.ballet.aes import Aes, AesGcm
+from firedancer_tpu.ballet.hkdf import hkdf_expand, hkdf_expand_label, hkdf_extract
+from firedancer_tpu.ballet.ed25519.x25519 import x25519, x25519_public
+from firedancer_tpu.ballet import x509
+
+
+def h(s: str) -> bytes:
+    return bytes.fromhex(s)
+
+
+# ----------------------------------------------------------------- AES -----
+
+def test_aes128_fips197():
+    a = Aes(h("000102030405060708090a0b0c0d0e0f"))
+    out = a.encrypt_block(h("00112233445566778899aabbccddeeff"))
+    assert out == h("69c4e0d86a7b0430d8cdb78070b4c55a")
+
+
+def test_aes256_fips197():
+    a = Aes(h("000102030405060708090a0b0c0d0e0f101112131415161718191a1b1c1d1e1f"))
+    out = a.encrypt_block(h("00112233445566778899aabbccddeeff"))
+    assert out == h("8ea2b7ca516745bfeafc49904b496089")
+
+
+def test_aes_random_vs_oracle():
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    rnd = os.urandom
+    for ksz in (16, 32):
+        for _ in range(20):
+            key, blk = rnd(ksz), rnd(16)
+            ours = Aes(key).encrypt_block(blk)
+            enc = Cipher(algorithms.AES(key), modes.ECB()).encryptor()
+            assert ours == enc.update(blk) + enc.finalize()
+
+
+# ------------------------------------------------------------- AES-GCM -----
+
+def test_gcm_nist_case3():
+    key = h("feffe9928665731c6d6a8f9467308308")
+    iv = h("cafebabefacedbaddecaf888")
+    pt = h(
+        "d9313225f88406e5a55909c5aff5269a86a7a9531534f7da2e4c303d8a318a72"
+        "1c3c0c95956809532fcf0e2449a6b525b16aedf5aa0de657ba637b391aafd255"
+    )
+    sealed = AesGcm(key).seal(iv, pt, b"")
+    assert sealed[:-16] == h(
+        "42831ec2217774244b7221b784d0d49ce3aa212f2c02a4e035c17e2329aca12e"
+        "21d514b25466931c7d8f6a5aac84aa051ba30b396a0aac973d58e091473f5985"
+    )
+    assert sealed[-16:] == h("4d5c2af327cd64a62cf35abd2ba6fab4")
+
+
+def test_gcm_nist_case4_aad():
+    key = h("feffe9928665731c6d6a8f9467308308")
+    iv = h("cafebabefacedbaddecaf888")
+    pt = h(
+        "d9313225f88406e5a55909c5aff5269a86a7a9531534f7da2e4c303d8a318a72"
+        "1c3c0c95956809532fcf0e2449a6b525b16aedf5aa0de657ba637b39"
+    )
+    aad = h("feedfacedeadbeeffeedfacedeadbeefabaddad2")
+    g = AesGcm(key)
+    sealed = g.seal(iv, pt, aad)
+    assert sealed[-16:] == h("5bc94fbc3221a5db94fae95ae7121a47")
+    # round trip + tamper detection
+    assert g.open(iv, sealed, aad) == pt
+    bad = bytearray(sealed)
+    bad[3] ^= 1
+    with pytest.raises(ValueError):
+        g.open(iv, bytes(bad), aad)
+
+
+def test_gcm_empty_pt():
+    key = h("00000000000000000000000000000000")
+    iv = h("000000000000000000000000")
+    sealed = AesGcm(key).seal(iv, b"", b"")
+    assert sealed == h("58e2fccefa7e3061367f1d57a4e7455a")
+
+
+def test_gcm_random_vs_oracle():
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM as Oracle
+
+    for _ in range(10):
+        key = os.urandom(16)
+        iv = os.urandom(12)
+        pt = os.urandom(int.from_bytes(os.urandom(1), "big") + 1)
+        aad = os.urandom(17)
+        ours = AesGcm(key).seal(iv, pt, aad)
+        assert ours == Oracle(key).encrypt(iv, pt, aad)
+        assert AesGcm(key).open(iv, ours, aad) == pt
+
+
+# ---------------------------------------------------------------- HKDF -----
+
+def test_hkdf_rfc5869_case1():
+    ikm = bytes([0x0B] * 22)
+    salt = h("000102030405060708090a0b0c")
+    info = h("f0f1f2f3f4f5f6f7f8f9")
+    prk = hkdf_extract(salt, ikm)
+    assert prk == h(
+        "077709362c2e32df0ddc3f0dc47bba6390b6c73bb50f9c3122ec844ad7c2b3e5"
+    )
+    okm = hkdf_expand(prk, info, 42)
+    assert okm == h(
+        "3cb25f25faacd57a90434f64d0362f2a2d2d0a90cf1a5a4c5db02d56ecc4c5bf"
+        "34007208d5b887185865"
+    )
+
+
+def test_hkdf_expand_label_quic_initial():
+    """RFC 9001 Appendix A.1 initial secrets."""
+    dcid = h("8394c8f03e515708")
+    salt = h("38762cf7f55934b34d179ae6a4c80cadccbb7f0a")
+    initial = hkdf_extract(salt, dcid)
+    assert initial == h(
+        "7db5df06e7a69e432496adedb00851923595221596ae2ae9fb8115c1e9ed0a44"
+    )
+    client = hkdf_expand_label(initial, b"client in", b"", 32)
+    assert client == h(
+        "c00cf151ca5be075ed0ebfb5c80323c42d6b7db67881289af4008f1f6c357aea"
+    )
+    server = hkdf_expand_label(initial, b"server in", b"", 32)
+    assert server == h(
+        "3c199828fd139efd216c155ad844cc81fb82fa8d7446fa7d78be803acdda951b"
+    )
+    key = hkdf_expand_label(client, b"quic key", b"", 16)
+    iv = hkdf_expand_label(client, b"quic iv", b"", 12)
+    hp = hkdf_expand_label(client, b"quic hp", b"", 16)
+    assert key == h("1f369613dd76d5467730efcbe3b1a22d")
+    assert iv == h("fa044b2f42a3fd3b46fb255c")
+    assert hp == h("9f50449e04a0e810283a1e9933adedd2")
+
+
+# -------------------------------------------------------------- X25519 -----
+
+def test_x25519_rfc7748_vector1():
+    k = h("a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4")
+    u = h("e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c")
+    assert x25519(k, u) == h(
+        "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552"
+    )
+
+
+def test_x25519_dh():
+    a_priv = h("77076d0a7318a57d3c16c17251b26645df4c2f87ebc0992ab177fba51db92c2a")
+    b_priv = h("5dab087e624a8a4b79e17f8b83800ee66f3bb1292618b6fd1c2f8b27ff88e0eb")
+    a_pub = x25519_public(a_priv)
+    b_pub = x25519_public(b_priv)
+    assert a_pub == h(
+        "8520f0098930a754748b7ddcb43ef75a0dbf3a0d26381af4eba4a98eaa9b4e6a"
+    )
+    assert b_pub == h(
+        "de9edb7d7b7dc1b4d35b61c2ece435373f8343c85b78674dadfc7e146f882b4f"
+    )
+    shared = h("4a5d9d5ba4ce2de1728e3bf480350f25e07e21c947d19e3376f09b3c1e161742")
+    assert x25519(a_priv, b_pub) == shared
+    assert x25519(b_priv, a_pub) == shared
+
+
+def test_x25519_vs_oracle():
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+    )
+    from cryptography.hazmat.primitives import serialization
+
+    for _ in range(5):
+        sk = os.urandom(32)
+        ours = x25519_public(sk)
+        theirs = (
+            X25519PrivateKey.from_private_bytes(sk)
+            .public_key()
+            .public_bytes(
+                serialization.Encoding.Raw, serialization.PublicFormat.Raw
+            )
+        )
+        assert ours == theirs
+
+
+# ---------------------------------------------------------------- X509 -----
+
+def test_x509_roundtrip():
+    seed = bytes(range(32))
+    cert = x509.generate_self_signed(seed, cn="test-node")
+    from firedancer_tpu.ballet.ed25519 import oracle
+
+    _, _, pub = oracle.keypair_from_seed(seed)
+    assert x509.extract_ed25519_pubkey(cert) == pub
+    assert x509.verify_self_signed(cert)
+    # tampering breaks the signature
+    bad = bytearray(cert)
+    bad[len(bad) // 2] ^= 1
+    assert not x509.verify_self_signed(bytes(bad))
+
+
+def test_x509_parses_with_oracle_library():
+    from cryptography import x509 as cx509
+
+    seed = os.urandom(32)
+    cert = cx509.load_der_x509_certificate(
+        __import__("firedancer_tpu.ballet.x509", fromlist=["x"]).generate_self_signed(
+            seed
+        )
+    )
+    from cryptography.hazmat.primitives import serialization
+
+    pub = cert.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+    from firedancer_tpu.ballet.ed25519 import oracle
+
+    assert pub == oracle.keypair_from_seed(seed)[2]
