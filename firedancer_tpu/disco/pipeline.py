@@ -43,7 +43,7 @@ from .tiles import (
 )
 
 LINKS = ("replay_verify", "verify_dedup", "dedup_pack", "pack_sink")
-TILES = ("replay", "verify", "dedup", "pack", "sink")
+TILES = ("replay", "verify", "dedup", "pack", "sink", "quic")
 
 
 @dataclass
@@ -96,24 +96,26 @@ class PipelineResult:
     elapsed_s: float
 
 
-def run_pipeline(
-    topo: Topology,
-    payloads: List[bytes],
-    verify_backend: str = "oracle",
-    verify_batch: int = 128,
-    verify_max_msg_len: Optional[int] = None,
-    bank_cnt: int = 4,
-    timeout_s: float = 60.0,
+def _run_tiles(
+    wksp,
+    pod: Pod,
+    source,
+    source_done,
+    verify_backend: str,
+    verify_batch: int,
+    verify_max_msg_len: Optional[int],
+    bank_cnt: int,
+    timeout_s: float,
+    pre_wait=None,
 ) -> PipelineResult:
-    """Join tiles to the topology, run them on threads, wait for the sink
-    to drain, HALT everything, and return counts + diag snapshot.
+    """Shared runner: wire source -> verify -> dedup -> pack -> sink, drive
+    the tiles on threads until quiescence or timeout, HALT, snapshot.
 
-    Shutdown is quiescence-based (source exhausted + every link drained);
-    filtered frags never reach the sink, so the caller asserts on
-    PipelineResult.recv_cnt rather than passing an expected count in.
+    `source` is an already-constructed source tile publishing on the
+    replay_verify link; `source_done()` is its exhaustion predicate;
+    `pre_wait()` (optional) runs after threads start (e.g. spawn a client)
+    and returns a cleanup callable invoked after HALT.
     """
-    pod = topo.pod
-    wksp = Workspace.join(topo.wksp_path)
     mtu = pod.query_ulong("firedancer.mtu", FD_TPU_MTU)
 
     def in_link(link):
@@ -124,11 +126,6 @@ def run_pipeline(
         return OutLink(wksp, _link_names(pod, link), mtu=mtu,
                        reliable_fseqs=[fs])
 
-    replay = ReplayTile(
-        wksp, pod.query_cstr("firedancer.replay.cnc"),
-        out_link=out_link("replay_verify", "replay_verify"),
-        payloads=payloads,
-    )
     verify = VerifyTile(
         wksp, pod.query_cstr("firedancer.verify.cnc"),
         in_link=in_link("replay_verify"),
@@ -151,7 +148,7 @@ def run_pipeline(
         wksp, pod.query_cstr("firedancer.sink.cnc"),
         in_link=in_link("pack_sink"),
     )
-    tiles = [replay, verify, dedup, pack, sink]
+    tiles = [source, verify, dedup, pack, sink]
 
     # Tiles run until HALT; max_ns is a hung-pipeline safety net and must
     # outlast the supervisor's own timeout or slow runs silently truncate.
@@ -165,12 +162,13 @@ def run_pipeline(
     t0 = time.perf_counter()
     for th in threads:
         th.start()
+    post_wait = pre_wait() if pre_wait is not None else None
 
     def quiesced() -> bool:
         """Source exhausted and every link fully drained end to end."""
         return (
-            replay.pos >= len(payloads)
-            and verify.in_link.seq >= replay.out_link.seq
+            source_done()
+            and verify.in_link.seq >= source.out_link.seq
             and not verify._pending
             and dedup.in_link.seq >= verify.out_link.seq
             and pack.in_link.seq >= dedup.out_link.seq
@@ -189,6 +187,8 @@ def run_pipeline(
         t.cnc.signal(CNC_HALT)
     for th in threads:
         th.join(timeout=10.0)
+    if post_wait is not None:
+        post_wait()
     elapsed = time.perf_counter() - t0
 
     from firedancer_tpu.disco.monitor import snapshot
@@ -203,3 +203,81 @@ def run_pipeline(
     )
     wksp.leave()
     return res
+
+
+def run_pipeline(
+    topo: Topology,
+    payloads: List[bytes],
+    verify_backend: str = "oracle",
+    verify_batch: int = 128,
+    verify_max_msg_len: Optional[int] = None,
+    bank_cnt: int = 4,
+    timeout_s: float = 60.0,
+) -> PipelineResult:
+    """Replay-sourced pipeline: payload list -> verify -> dedup -> pack -> sink.
+
+    Shutdown is quiescence-based (source exhausted + every link drained);
+    filtered frags never reach the sink, so the caller asserts on
+    PipelineResult.recv_cnt rather than passing an expected count in.
+    """
+    pod = topo.pod
+    wksp = Workspace.join(topo.wksp_path)
+    mtu = pod.query_ulong("firedancer.mtu", FD_TPU_MTU)
+    fs = FSeq(wksp, pod.query_cstr("firedancer.replay_verify.fseq"))
+    replay = ReplayTile(
+        wksp, pod.query_cstr("firedancer.replay.cnc"),
+        out_link=OutLink(wksp, _link_names(pod, "replay_verify"), mtu=mtu,
+                         reliable_fseqs=[fs]),
+        payloads=payloads,
+    )
+    return _run_tiles(
+        wksp, pod, replay, lambda: replay.pos >= len(payloads),
+        verify_backend, verify_batch, verify_max_msg_len, bank_cnt, timeout_s,
+    )
+
+
+def run_quic_pipeline(
+    topo: Topology,
+    client_fn,
+    n_txns: int,
+    identity_seed: bytes = b"\x11" * 32,
+    verify_backend: str = "oracle",
+    verify_batch: int = 128,
+    verify_max_msg_len: Optional[int] = None,
+    bank_cnt: int = 4,
+    timeout_s: float = 60.0,
+) -> PipelineResult:
+    """Full ingest path: QUIC server tile -> verify -> dedup -> pack -> sink.
+
+    The quic tile binds an ephemeral localhost UDP port; `client_fn` is
+    called on a helper thread with the listen address and must deliver
+    `n_txns` transactions over QUIC (one per unidirectional stream). The
+    run ends when the quic tile has published n_txns frags and every
+    downstream link has drained (or on timeout).
+    """
+    from firedancer_tpu.disco.quic_tile import QuicTile
+
+    pod = topo.pod
+    wksp = Workspace.join(topo.wksp_path)
+    mtu = pod.query_ulong("firedancer.mtu", FD_TPU_MTU)
+    fs = FSeq(wksp, pod.query_cstr("firedancer.replay_verify.fseq"))
+    quic = QuicTile(
+        wksp, pod.query_cstr("firedancer.quic.cnc"),
+        out_link=OutLink(wksp, _link_names(pod, "replay_verify"), mtu=mtu,
+                         reliable_fseqs=[fs]),
+        identity_seed=identity_seed,
+        stop_after=n_txns,
+    )
+
+    def pre_wait():
+        client = threading.Thread(
+            target=client_fn, args=(quic.listen_addr,), daemon=True
+        )
+        client.start()
+        return lambda: client.join(timeout=5.0)
+
+    return _run_tiles(
+        wksp, pod, quic, quic.done,
+        verify_backend, verify_batch, verify_max_msg_len, bank_cnt, timeout_s,
+        pre_wait=pre_wait,
+    )

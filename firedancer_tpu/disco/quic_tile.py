@@ -1,0 +1,100 @@
+"""QUIC ingest tile: UDP/QUIC server -> txn frag stream.
+
+Role parity with /root/reference/src/disco/quic/fd_quic_tile.c: the tile's
+run loop services the packet transport and the QUIC endpoint back to back
+(fd_quic_tile.c:449-452 drives fd_xsk_aio_service + fd_quic_service), and
+every completed unidirectional stream — one Solana transaction per stream,
+the TPU convention — is published into the outgoing mcache/dcache for the
+verify tile. The reference parses the txn in-tile into the dcache slot
+(fd_quic_tile.c:492); here parse stays in the verify tile (it must re-parse
+for sigverify anyway), and oversized/empty streams are dropped at ingest
+with the same effect as the reference's parse-failure drop. Transport is
+the udpsock aio backend (the reference's XDP path has no host-kernel-bypass
+equivalent in this environment; the aio seam is where one would plug in).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Deque, Optional, Tuple
+
+from firedancer_tpu.disco.tiles import (
+    CNC_DIAG_BACKP_CNT,
+    CNC_DIAG_SV_FILT_CNT,
+    CNC_DIAG_SV_FILT_SZ,
+    FD_TPU_MTU,
+    Tile,
+    meta_sig,
+)
+from firedancer_tpu.tango.quic.quic import Quic, QuicConfig
+from firedancer_tpu.tango.udpsock import UdpSock
+
+
+class QuicTile(Tile):
+    """Source tile: accepts QUIC connections, emits one frag per txn."""
+
+    name = "quic"
+
+    def __init__(
+        self,
+        wksp,
+        cnc_name,
+        out_link,
+        identity_seed: bytes,
+        bind_addr: Tuple[str, int] = ("127.0.0.1", 0),
+        idle_timeout: float = 10.0,
+        stop_after: Optional[int] = None,
+        **kw,
+    ):
+        super().__init__(wksp, cnc_name, out_link=out_link, **kw)
+        self.sock = UdpSock(bind_addr)
+        self.listen_addr = self.sock.local_addr
+        self._tx_aio = self.sock.aio_tx()
+        self.quic = Quic(
+            QuicConfig(
+                is_server=True,
+                identity_seed=identity_seed,
+                idle_timeout=idle_timeout,
+            ),
+            tx=lambda addr, dg: self._tx_aio.send_one(addr, dg),
+            on_stream=self._on_stream,
+        )
+        self._ready: Deque[bytes] = deque()
+        self._t0 = time.monotonic()
+        self.pub_cnt = 0
+        self.pub_sz = 0
+        self.stop_after = stop_after  # for bounded test runs
+
+    # -------------------------------------------------------------- quic ---
+
+    def _on_stream(self, conn, stream_id: int, data: bytes) -> None:
+        if not data or len(data) > min(FD_TPU_MTU, self.out_link.mtu):
+            # same effect as the reference's in-tile parse-failure drop
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, 1)
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, len(data))
+            return
+        self._ready.append(data)
+
+    def done(self) -> bool:
+        return self.stop_after is not None and self.pub_cnt >= self.stop_after
+
+    # -------------------------------------------------------------- loop ---
+
+    def step(self) -> None:
+        now = time.monotonic() - self._t0
+        self.sock.service_rx(lambda addr, d: self.quic.rx(addr, d, now))
+        self.quic.service(now)
+        while self._ready:
+            if not self.out_link.can_publish():
+                self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
+                return  # keep servicing the socket; retry next step
+            payload = self._ready.popleft()
+            self.out_link.publish(payload, meta_sig(payload))
+            self.pub_cnt += 1
+            self.pub_sz += len(payload)
+        if not self.quic.conns and not self._ready:
+            time.sleep(0.0005)  # idle: no conns to service
+
+    def on_halt(self) -> None:
+        self.sock.close()

@@ -67,6 +67,13 @@ FD_TPU_MTU = 1232  # disco/quic/fd_quic.h:46-47
 _U64 = (1 << 64) - 1
 
 
+def meta_sig(payload: bytes) -> int:
+    """Frag meta sig: first 8 bytes of the txn's first Ed25519 signature
+    (the dedup identity) — the layout every publisher and the dedup tile
+    must agree on (byte 0 is the compact signature count)."""
+    return int.from_bytes(payload[1:9], "little") if len(payload) > 8 else 0
+
+
 @dataclass
 class LinkNames:
     """Workspace object names for one mcache/dcache/fseq link."""
@@ -215,6 +222,17 @@ class Tile:
 
     def run(self, max_ns: int = 30_000_000_000) -> None:
         """Run until HALT signal, done(), or max_ns wall time."""
+        try:
+            self._run_loop(max_ns)
+        finally:
+            # teardown must happen even if step()/on_frag() raised, or
+            # sockets leak and the supervisor spins until its timeout
+            self.housekeep(tempo.tickcount())
+            self.on_halt()
+            self.halted = True
+            self.cnc.signal(CNC_BOOT)
+
+    def _run_loop(self, max_ns: int) -> None:
         self.cnc.signal(CNC_RUN)
         start = tempo.tickcount()
         then = start
@@ -247,10 +265,9 @@ class Tile:
                 if idle_spins > 64:
                     time.sleep(20e-6)  # FD_SPIN_PAUSE analog
             # POLL_OVERRUN: InLink.poll already repositioned + counted.
-        # drain housekeeping one last time so diags/fseq are current
-        self.housekeep(tempo.tickcount())
-        self.halted = True
-        self.cnc.signal(CNC_BOOT)
+
+    def on_halt(self) -> None:
+        """Tile-specific teardown (close sockets etc)."""
 
     def step(self) -> None:
         """Source tiles (no in_link) override or rely on done()."""
@@ -279,10 +296,7 @@ class ReplayTile(Tile):
             time.sleep(20e-6)
             return
         payload = self.payloads[self.pos]
-        # meta sig for downstream filtering: first signature bytes (the
-        # txn's dedup identity), matching verify-tile tag semantics.
-        sig64 = int.from_bytes(payload[1:9], "little") if len(payload) > 8 else 0
-        self.out_link.publish(payload, sig64)
+        self.out_link.publish(payload, meta_sig(payload))
         self.pos += 1
         self.pub_cnt += 1
         self.pub_sz += len(payload)
@@ -427,8 +441,7 @@ class VerifyTile(Tile):
                 return
             self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
             time.sleep(20e-6)
-        sig64 = int.from_bytes(payload[1:9], "little")
-        self.out_link.publish(payload, sig64)
+        self.out_link.publish(payload, meta_sig(payload))
         self.in_link.fseq.diag_add(DIAG_PUB_CNT, 1)
         self.in_link.fseq.diag_add(DIAG_PUB_SZ, len(payload))
 
