@@ -87,6 +87,19 @@ def _link_names(pod: Pod, link: str) -> LinkNames:
     )
 
 
+def _make_out_link(wksp, pod: Pod, link: str, consumer_fseq_link: str,
+                   mtu: int) -> OutLink:
+    """Producer-side link: publish ring + the reliable consumer's fseq."""
+    fs = FSeq(wksp, pod.query_cstr(f"firedancer.{consumer_fseq_link}.fseq"))
+    return OutLink(wksp, _link_names(pod, link), mtu=mtu, reliable_fseqs=[fs])
+
+
+def _make_source_out_link(wksp, pod: Pod) -> OutLink:
+    """The pipeline source's out link (replay_verify, self-consumed fseq)."""
+    mtu = pod.query_ulong("firedancer.mtu", FD_TPU_MTU)
+    return _make_out_link(wksp, pod, "replay_verify", "replay_verify", mtu)
+
+
 @dataclass
 class PipelineResult:
     recv_cnt: int
@@ -122,9 +135,7 @@ def _run_tiles(
         return InLink(wksp, _link_names(pod, link))
 
     def out_link(link, consumer_fseq_link):
-        fs = FSeq(wksp, pod.query_cstr(f"firedancer.{consumer_fseq_link}.fseq"))
-        return OutLink(wksp, _link_names(pod, link), mtu=mtu,
-                       reliable_fseqs=[fs])
+        return _make_out_link(wksp, pod, link, consumer_fseq_link, mtu)
 
     verify = VerifyTile(
         wksp, pod.query_cstr("firedancer.verify.cnc"),
@@ -222,16 +233,13 @@ def run_pipeline(
     """
     pod = topo.pod
     wksp = Workspace.join(topo.wksp_path)
-    mtu = pod.query_ulong("firedancer.mtu", FD_TPU_MTU)
-    fs = FSeq(wksp, pod.query_cstr("firedancer.replay_verify.fseq"))
     replay = ReplayTile(
         wksp, pod.query_cstr("firedancer.replay.cnc"),
-        out_link=OutLink(wksp, _link_names(pod, "replay_verify"), mtu=mtu,
-                         reliable_fseqs=[fs]),
+        out_link=_make_source_out_link(wksp, pod),
         payloads=payloads,
     )
     return _run_tiles(
-        wksp, pod, replay, lambda: replay.pos >= len(payloads),
+        wksp, pod, replay, replay.done,
         verify_backend, verify_batch, verify_max_msg_len, bank_cnt, timeout_s,
     )
 
@@ -259,12 +267,9 @@ def run_quic_pipeline(
 
     pod = topo.pod
     wksp = Workspace.join(topo.wksp_path)
-    mtu = pod.query_ulong("firedancer.mtu", FD_TPU_MTU)
-    fs = FSeq(wksp, pod.query_cstr("firedancer.replay_verify.fseq"))
     quic = QuicTile(
         wksp, pod.query_cstr("firedancer.quic.cnc"),
-        out_link=OutLink(wksp, _link_names(pod, "replay_verify"), mtu=mtu,
-                         reliable_fseqs=[fs]),
+        out_link=_make_source_out_link(wksp, pod),
         identity_seed=identity_seed,
         stop_after=n_txns,
     )

@@ -226,11 +226,15 @@ class Tile:
             self._run_loop(max_ns)
         finally:
             # teardown must happen even if step()/on_frag() raised, or
-            # sockets leak and the supervisor spins until its timeout
-            self.housekeep(tempo.tickcount())
-            self.on_halt()
-            self.halted = True
-            self.cnc.signal(CNC_BOOT)
+            # sockets leak and the supervisor spins until its timeout;
+            # on_halt() runs first so a failing final housekeep (broken
+            # shared state) can't skip the socket teardown
+            try:
+                self.on_halt()
+            finally:
+                self.halted = True
+                self.housekeep(tempo.tickcount())
+                self.cnc.signal(CNC_BOOT)
 
     def _run_loop(self, max_ns: int) -> None:
         self.cnc.signal(CNC_RUN)

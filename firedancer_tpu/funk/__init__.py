@@ -1,0 +1,280 @@
+"""funk — fork-aware record database for accounts/ledger state.
+
+Role parity with the reference's fd_funk (/root/reference/src/funk/
+fd_funk.h:28-90): a key→value store whose updates are staged in a tree of
+in-preparation *transactions* forking off the last-published root. A
+transaction can fork children (speculative forks of a fork), be cancelled
+(dropping it and all descendants), or be published (folding it — and all
+its ancestors — into the root, cancelling every competing sibling fork).
+Reads inside a transaction fall through to the nearest ancestor holding
+the record, ending at the published root.
+
+The reference backs everything with a workspace so the wksp file doubles
+as an on-disk checkpoint (fd_funk.h:136-140). Here the same contract is
+kept with an explicit checkpoint/restore pair over a compact binary image
+(length-prefixed records; the published root only — in-preparation
+transactions are by definition speculative and are not checkpointed,
+matching the reference where unpublished txns are lost on crash).
+
+Records are (xid, key) → val as in fd_funk_rec; vals are opaque bytes
+(fd_funk_val). Keys are bytes up to 64 B (FD_FUNK_REC_KEY_FOOTPRINT
+analog); xids are caller-chosen opaque ints (the reference uses 32-byte
+xids; Solana uses slot numbers — an int is the idiomatic form here).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+ROOT_XID = 0  # the last-published root (fd_funk_txn_xid root analog)
+
+_TOMBSTONE = None  # staged removal marker
+
+
+class FunkError(Exception):
+    pass
+
+
+@dataclass
+class _Txn:
+    """One in-preparation transaction (fd_funk_txn_t analog)."""
+
+    xid: int
+    parent: int  # parent xid (ROOT_XID if forked off the root)
+    children: List[int] = field(default_factory=list)
+    # staged writes: key -> bytes, or _TOMBSTONE for staged removal
+    recs: Dict[bytes, Optional[bytes]] = field(default_factory=dict)
+    frozen: bool = False  # True once it has children (fd_funk freezes parents)
+
+
+class Funk:
+    """Fork-aware record database.
+
+    Lifecycle mirrors fd_funk_txn_{prepare,cancel,publish} and
+    fd_funk_rec_{query,insert,remove} + fd_funk_val_{read,write}.
+    """
+
+    MAX_KEY = 64
+
+    def __init__(self) -> None:
+        self._root: Dict[bytes, bytes] = {}
+        self._txns: Dict[int, _Txn] = {}
+        self._next_auto_xid = 1
+
+    # -- transaction tree ----------------------------------------------
+
+    def txn_prepare(self, parent: int = ROOT_XID, xid: Optional[int] = None) -> int:
+        """Fork a new in-preparation txn off `parent`. Returns its xid."""
+        if parent != ROOT_XID and parent not in self._txns:
+            raise FunkError(f"unknown parent xid {parent}")
+        if xid is None:
+            while self._next_auto_xid in self._txns or self._next_auto_xid == ROOT_XID:
+                self._next_auto_xid += 1
+            xid = self._next_auto_xid
+        if xid == ROOT_XID or xid in self._txns:
+            raise FunkError(f"xid {xid} already in use")
+        self._txns[xid] = _Txn(xid=xid, parent=parent)
+        if parent != ROOT_XID:
+            p = self._txns[parent]
+            p.children.append(xid)
+            p.frozen = True  # writes to a forked-from txn are disallowed
+        return xid
+
+    def txn_cancel(self, xid: int) -> int:
+        """Cancel a txn and all its descendants. Returns count cancelled."""
+        txn = self._txns.get(xid)
+        if txn is None:
+            raise FunkError(f"unknown xid {xid}")
+        n = self._cancel_subtree(xid)
+        if txn.parent != ROOT_XID and txn.parent in self._txns:
+            p = self._txns[txn.parent]
+            p.children.remove(xid)
+            if not p.children:
+                p.frozen = False
+        return n
+
+    def _cancel_subtree(self, xid: int) -> int:
+        n = 0
+        stack = [xid]
+        while stack:
+            txn = self._txns.pop(stack.pop())
+            stack.extend(txn.children)
+            n += 1
+        return n
+
+    def txn_publish(self, xid: int) -> int:
+        """Publish `xid` and its unpublished ancestors into the root.
+
+        Every txn that is not a descendant of `xid` (i.e. every competing
+        history — siblings of `xid` and of each folded ancestor, plus their
+        subtrees) is cancelled; `xid`'s own descendants survive, re-parented
+        to the new root, exactly as fd_funk_txn_publish documents
+        (fd_funk.h:60-78). Returns the number of txns published.
+        """
+        if xid not in self._txns:
+            raise FunkError(f"unknown xid {xid}")
+        chain = list(reversed(self.txn_ancestry(xid)[:-1]))  # root-side first
+        survivors = self._descendants(xid)
+        # Fold the chain into the root, oldest first.
+        for level in chain:
+            for key, val in self._txns[level].recs.items():
+                if val is _TOMBSTONE:
+                    self._root.pop(key, None)
+                else:
+                    self._root[key] = val
+        # xid's children fork off now-published state: re-parent to root.
+        for c in self._txns[xid].children:
+            self._txns[c].parent = ROOT_XID
+        # Drop the published chain and cancel all competing histories.
+        for level in chain:
+            del self._txns[level]
+        for t in [t for t in list(self._txns) if t not in survivors]:
+            if t in self._txns:
+                del self._txns[t]
+        return len(chain)
+
+    def _descendants(self, xid: int) -> set:
+        """xids of `xid`'s strict descendants (subtree minus `xid`)."""
+        out: set = set()
+        stack = list(self._txns[xid].children)
+        while stack:
+            c = stack.pop()
+            out.add(c)
+            stack.extend(self._txns[c].children)
+        return out
+
+    def txn_is_frozen(self, xid: int) -> bool:
+        if xid == ROOT_XID:
+            return bool(self._txns)  # root is frozen while any txn is in prep
+        txn = self._txns.get(xid)
+        if txn is None:
+            raise FunkError(f"unknown xid {xid}")
+        return txn.frozen
+
+    def txn_ancestry(self, xid: int) -> List[int]:
+        """xid's ancestor chain, nearest first, ending at ROOT_XID."""
+        out = []
+        cur = xid
+        while cur != ROOT_XID:
+            t = self._txns.get(cur)
+            if t is None:
+                raise FunkError(f"unknown xid {cur}")
+            out.append(cur)
+            cur = t.parent
+        out.append(ROOT_XID)
+        return out
+
+    @property
+    def txn_cnt(self) -> int:
+        return len(self._txns)
+
+    # -- records ---------------------------------------------------------
+
+    def _check_key(self, key: bytes) -> None:
+        if not isinstance(key, bytes) or not key or len(key) > self.MAX_KEY:
+            raise FunkError(f"bad key (1..{self.MAX_KEY} bytes required)")
+
+    def write(self, xid: int, key: bytes, val: bytes) -> None:
+        """Stage (xid==ROOT_XID: apply directly) a record write."""
+        self._check_key(key)
+        if xid == ROOT_XID:
+            if self._txns:
+                raise FunkError("root is frozen while txns are in preparation")
+            self._root[key] = bytes(val)
+            return
+        txn = self._txns.get(xid)
+        if txn is None:
+            raise FunkError(f"unknown xid {xid}")
+        if txn.frozen:
+            raise FunkError(f"xid {xid} is frozen (has children)")
+        txn.recs[key] = bytes(val)
+
+    def remove(self, xid: int, key: bytes) -> None:
+        """Stage a record removal (tombstone), or remove from root."""
+        self._check_key(key)
+        if xid == ROOT_XID:
+            if self._txns:
+                raise FunkError("root is frozen while txns are in preparation")
+            self._root.pop(key, None)
+            return
+        txn = self._txns.get(xid)
+        if txn is None:
+            raise FunkError(f"unknown xid {xid}")
+        if txn.frozen:
+            raise FunkError(f"xid {xid} is frozen (has children)")
+        txn.recs[key] = _TOMBSTONE
+
+    def read(self, xid: int, key: bytes) -> Optional[bytes]:
+        """Read a record as seen from `xid`: falls through the ancestor
+        chain to the published root (fd_funk_rec_query_global analog)."""
+        self._check_key(key)
+        if xid != ROOT_XID:
+            for a in self.txn_ancestry(xid)[:-1]:
+                txn = self._txns[a]
+                if key in txn.recs:
+                    v = txn.recs[key]
+                    return None if v is _TOMBSTONE else v
+        return self._root.get(key)
+
+    def keys(self, xid: int = ROOT_XID) -> Iterator[bytes]:
+        """All live keys as seen from `xid`, in sorted order."""
+        staged: Dict[bytes, Optional[bytes]] = {}
+        if xid != ROOT_XID:
+            for a in reversed(self.txn_ancestry(xid)[:-1]):
+                staged.update(self._txns[a].recs)
+        live = dict(self._root)
+        for k, v in staged.items():
+            if v is _TOMBSTONE:
+                live.pop(k, None)
+            else:
+                live[k] = v
+        return iter(sorted(live))
+
+    @property
+    def rec_cnt(self) -> int:
+        return len(self._root)
+
+    # -- checkpoint / restore --------------------------------------------
+    # Image format: magic, rec_cnt, then per record: klen u16, key, vlen
+    # u32, val. Only the published root is persisted (speculative state is
+    # crash-discardable by design, fd_funk.h:136-140).
+
+    _MAGIC = b"FDFUNK01"
+
+    def checkpoint(self, path: str) -> int:
+        """Write the published root to `path`. Returns records written."""
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(self._MAGIC)
+            f.write(struct.pack("<Q", len(self._root)))
+            for k in sorted(self._root):
+                v = self._root[k]
+                f.write(struct.pack("<H", len(k)))
+                f.write(k)
+                f.write(struct.pack("<I", len(v)))
+                f.write(v)
+        os.replace(tmp, path)
+        return len(self._root)
+
+    @classmethod
+    def restore(cls, path: str) -> "Funk":
+        def must_read(f, n: int) -> bytes:
+            b = f.read(n)
+            if len(b) != n:
+                raise FunkError(f"{path}: truncated checkpoint image")
+            return b
+
+        funk = cls()
+        with open(path, "rb") as f:
+            if f.read(8) != cls._MAGIC:
+                raise FunkError(f"{path}: bad magic")
+            (n,) = struct.unpack("<Q", must_read(f, 8))
+            for _ in range(n):
+                (klen,) = struct.unpack("<H", must_read(f, 2))
+                k = must_read(f, klen)
+                (vlen,) = struct.unpack("<I", must_read(f, 4))
+                funk._root[k] = must_read(f, vlen)
+        return funk

@@ -1,0 +1,152 @@
+"""funk fork-aware DB tests (reference: src/funk/test_funk*.c semantics)."""
+
+import os
+
+import pytest
+
+from firedancer_tpu.funk import ROOT_XID, Funk, FunkError
+
+
+def test_root_write_read_remove():
+    f = Funk()
+    f.write(ROOT_XID, b"k1", b"v1")
+    f.write(ROOT_XID, b"k2", b"v2")
+    assert f.read(ROOT_XID, b"k1") == b"v1"
+    assert f.rec_cnt == 2
+    f.remove(ROOT_XID, b"k1")
+    assert f.read(ROOT_XID, b"k1") is None
+    assert f.rec_cnt == 1
+
+
+def test_key_validation():
+    f = Funk()
+    with pytest.raises(FunkError):
+        f.write(ROOT_XID, b"", b"v")
+    with pytest.raises(FunkError):
+        f.write(ROOT_XID, b"x" * 65, b"v")
+
+
+def test_txn_read_through_ancestry():
+    f = Funk()
+    f.write(ROOT_XID, b"a", b"root")
+    f.write(ROOT_XID, b"b", b"root")
+    t1 = f.txn_prepare()
+    f.write(t1, b"a", b"t1")
+    t2 = f.txn_prepare(parent=t1)
+    f.write(t2, b"b", b"t2")
+    # t2 sees its own write, t1's write, and root fall-through.
+    assert f.read(t2, b"a") == b"t1"
+    assert f.read(t2, b"b") == b"t2"
+    assert f.read(t1, b"b") == b"root"
+    # Root unchanged while speculative.
+    assert f.read(ROOT_XID, b"a") == b"root"
+
+
+def test_txn_tombstone_shadows_ancestor():
+    f = Funk()
+    f.write(ROOT_XID, b"a", b"root")
+    t1 = f.txn_prepare()
+    f.remove(t1, b"a")
+    assert f.read(t1, b"a") is None
+    assert f.read(ROOT_XID, b"a") == b"root"
+    f.txn_publish(t1)
+    assert f.read(ROOT_XID, b"a") is None
+
+
+def test_frozen_parent_rejects_writes():
+    f = Funk()
+    t1 = f.txn_prepare()
+    f.write(t1, b"a", b"1")
+    t2 = f.txn_prepare(parent=t1)
+    assert f.txn_is_frozen(t1)
+    with pytest.raises(FunkError):
+        f.write(t1, b"a", b"2")
+    # Root frozen while txns in preparation.
+    with pytest.raises(FunkError):
+        f.write(ROOT_XID, b"r", b"v")
+    f.txn_cancel(t2)
+    assert not f.txn_is_frozen(t1)
+    f.write(t1, b"a", b"2")  # unfrozen again
+
+
+def test_cancel_subtree():
+    f = Funk()
+    t1 = f.txn_prepare()
+    t2 = f.txn_prepare(parent=t1)
+    t3 = f.txn_prepare(parent=t2)
+    assert f.txn_cnt == 3
+    assert f.txn_cancel(t1) == 3
+    assert f.txn_cnt == 0
+
+
+def test_publish_folds_chain_and_cancels_competitors():
+    f = Funk()
+    f.write(ROOT_XID, b"x", b"0")
+    # Two competing forks off root; a deeper chain on fork A.
+    a = f.txn_prepare(xid=10)
+    b = f.txn_prepare(xid=20)
+    f.write(a, b"x", b"A")
+    f.write(b, b"x", b"B")
+    a2 = f.txn_prepare(parent=a, xid=11)
+    f.write(a2, b"y", b"A2")
+    a2_sib = f.txn_prepare(parent=a, xid=12)  # competing child of a
+    # A speculative child of the published txn survives.
+    a3 = f.txn_prepare(parent=a2, xid=13)
+    f.write(a3, b"z", b"A3")
+
+    assert f.txn_publish(a2) == 2  # folds a then a2
+    # Folded values visible at root.
+    assert f.read(ROOT_XID, b"x") == b"A"
+    assert f.read(ROOT_XID, b"y") == b"A2"
+    # Competitors gone (b and a2_sib), survivor a3 re-parented to root.
+    assert f.txn_cnt == 1
+    assert f.txn_ancestry(a3) == [a3, ROOT_XID]
+    assert f.read(a3, b"z") == b"A3"
+    assert f.read(a3, b"x") == b"A"  # falls through to new root
+    with pytest.raises(FunkError):
+        f.txn_ancestry(b)
+
+
+def test_publish_ordering_last_writer_wins():
+    f = Funk()
+    t1 = f.txn_prepare()
+    f.write(t1, b"k", b"old")
+    t2 = f.txn_prepare(parent=t1)
+    f.write(t2, b"k", b"new")
+    f.txn_publish(t2)
+    assert f.read(ROOT_XID, b"k") == b"new"
+
+
+def test_keys_view_merges_ancestry():
+    f = Funk()
+    f.write(ROOT_XID, b"a", b"1")
+    f.write(ROOT_XID, b"b", b"1")
+    t = f.txn_prepare()
+    f.write(t, b"c", b"1")
+    f.remove(t, b"a")
+    assert list(f.keys(t)) == [b"b", b"c"]
+    assert list(f.keys()) == [b"a", b"b"]
+
+
+def test_checkpoint_restore_roundtrip(tmp_path):
+    f = Funk()
+    for i in range(100):
+        f.write(ROOT_XID, f"key{i}".encode(), os.urandom(i % 32 + 1))
+    path = str(tmp_path / "funk.ckpt")
+    assert f.checkpoint(path) == 100
+    g = Funk.restore(path)
+    assert g.rec_cnt == 100
+    for k in f.keys():
+        assert g.read(ROOT_XID, k) == f.read(ROOT_XID, k)
+
+
+def test_checkpoint_excludes_speculative(tmp_path):
+    f = Funk()
+    f.write(ROOT_XID, b"a", b"1")
+    t = f.txn_prepare()
+    f.write(t, b"spec", b"1")
+    path = str(tmp_path / "funk2.ckpt")
+    f.checkpoint(path)
+    g = Funk.restore(path)
+    assert g.read(ROOT_XID, b"spec") is None
+    assert g.read(ROOT_XID, b"a") == b"1"
