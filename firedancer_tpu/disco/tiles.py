@@ -233,8 +233,10 @@ class Tile:
                 self.on_halt()
             finally:
                 self.halted = True
-                self.housekeep(tempo.tickcount())
-                self.cnc.signal(CNC_BOOT)
+                try:
+                    self.housekeep(tempo.tickcount())
+                finally:
+                    self.cnc.signal(CNC_BOOT)
 
     def _run_loop(self, max_ns: int) -> None:
         self.cnc.signal(CNC_RUN)

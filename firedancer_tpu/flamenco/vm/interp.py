@@ -1,0 +1,585 @@
+"""sBPF virtual machine: interpreter, memory map, syscalls, CU metering.
+
+Role parity with the reference's flamenco VM (/root/reference/src/flamenco/
+vm/): fd_vm_interp.c (computed-goto interpreter → a dispatch dict here),
+fd_vm_context.h:28-35 (4-region 32-bit virtual memory map: program/stack/
+heap/input at 0x1/2/3/4_00000000), fd_vm_context.h:49 (syscall fn-pointer
+registry keyed by murmur3_32 of the syscall name), fd_vm_stack.c (frame
+stack: r6-r9 + return address saved per call, shadow frames of
+FRAME_SZ bytes), fd_vm_log_collector.c (bounded log byte sink), and
+compute-unit metering (one CU per instruction, syscalls charge extra).
+
+This VM runs on the host — it is control-plane work (program loading/
+execution for the runtime), not TPU math; the TPU framework keeps it in
+Python since per-program throughput is bounded by account IO, not
+interpretation. The instruction encoding/assembler lives in sbpf.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from firedancer_tpu.ballet.murmur3 import murmur3_32
+from firedancer_tpu.flamenco.vm.sbpf import (
+    CLS_ALU,
+    CLS_ALU64,
+    CLS_JMP,
+    CLS_JMP32,
+    CLS_LD,
+    CLS_LDX,
+    CLS_ST,
+    CLS_STX,
+    Instr,
+    OP_ADDL_IMM,
+    OP_CALL,
+    OP_CALLX,
+    OP_EXIT,
+    OP_LDDW,
+    decode_program,
+)
+
+_U64 = (1 << 64) - 1
+_U32 = (1 << 32) - 1
+
+# Memory map region bases (fd_vm_context.h:28-35)
+MM_PROGRAM = 0x1_00000000
+MM_STACK = 0x2_00000000
+MM_HEAP = 0x3_00000000
+MM_INPUT = 0x4_00000000
+_MM_MASK = 0xFFFFFFFF
+
+STACK_FRAME_SZ = 0x1000
+STACK_FRAME_MAX = 64
+HEAP_SZ_DEFAULT = 32 * 1024
+LOG_MAX_DEFAULT = 10 * 1024
+
+# Error codes (fd_vm_context.h execution result space)
+ERR_SIGSEGV = "sigsegv"
+ERR_SIGILL = "sigill"
+ERR_SIGDIV = "sigdiv"
+ERR_CALL_DEPTH = "call depth exceeded"
+ERR_COMPUTE = "compute budget exhausted"
+ERR_SYSCALL = "syscall error"
+ERR_BAD_CALL = "unknown call target"
+
+
+class VmError(Exception):
+    def __init__(self, code: str, detail: str = ""):
+        super().__init__(f"{code}{': ' + detail if detail else ''}")
+        self.code = code
+
+
+def syscall_hash(name: bytes) -> int:
+    """Syscall registry key: murmur3_32(name, seed=0) (fd_vm_syscalls.c)."""
+    return murmur3_32(name, 0)
+
+
+@dataclass
+class LogCollector:
+    """Bounded byte sink (fd_vm_log_collector.c): silently truncates."""
+
+    max_sz: int = LOG_MAX_DEFAULT
+    buf: bytearray = field(default_factory=bytearray)
+    lines: List[bytes] = field(default_factory=list)
+
+    def append(self, msg: bytes) -> None:
+        room = self.max_sz - len(self.buf)
+        if room > 0:
+            take = msg[:room]
+            self.buf.extend(take)
+            self.lines.append(bytes(take))
+
+
+@dataclass
+class _Frame:
+    ret_pc: int
+    saved_regs: Tuple[int, int, int, int]  # r6..r9
+    frame_ptr: int
+
+
+class Vm:
+    """One sBPF execution context (fd_vm_exec_context_t analog).
+
+    `rodata` is the full program image (vaddr MM_PROGRAM); `text_off`/
+    `text_cnt` delimit the executable instruction window inside it, as in
+    the reference where .text lives inside the loaded segment.
+    """
+
+    def __init__(
+        self,
+        rodata: bytes,
+        *,
+        text_off: int = 0,
+        text_cnt: Optional[int] = None,
+        entry_pc: int = 0,
+        input_mem: bytes = b"",
+        heap_sz: int = HEAP_SZ_DEFAULT,
+        compute_budget: int = 200_000,
+        calldests: Optional[Dict[int, int]] = None,
+        syscalls: Optional[Dict[int, Tuple[str, Callable]]] = None,
+    ) -> None:
+        if text_off % 8 or text_off > len(rodata):
+            raise VmError(ERR_SIGILL, "misaligned text")
+        self.rodata = bytes(rodata)
+        text = self.rodata[text_off:]
+        max_cnt = len(text) // 8
+        self.text_cnt = max_cnt if text_cnt is None else min(text_cnt, max_cnt)
+        self.text_off = text_off
+        self.instrs = decode_program(text[: self.text_cnt * 8])
+        self.entry_pc = entry_pc
+        self.stack = bytearray(STACK_FRAME_SZ * STACK_FRAME_MAX)
+        self.heap = bytearray(heap_sz)
+        self.input = bytearray(input_mem)
+        self.cu = compute_budget
+        self.compute_budget = compute_budget
+        self.calldests = dict(calldests or {})
+        self.syscalls = dict(syscalls or {})
+        self.log = LogCollector()
+        self.frames: List[_Frame] = []
+        self.reg = [0] * 11
+        self.pc = entry_pc
+
+    # -- syscall registration -------------------------------------------
+
+    def register_syscall(self, name: bytes, fn: Callable) -> int:
+        """fn(vm, r1..r5) -> r0. Raises VmError to abort."""
+        h = syscall_hash(name)
+        self.syscalls[h] = (name.decode(), fn)
+        return h
+
+    # -- memory map ------------------------------------------------------
+
+    def _region(self, vaddr: int) -> Tuple[Optional[bytearray], int, bool]:
+        """(backing, offset, writable) for vaddr; backing None = unmapped."""
+        region = vaddr & ~_MM_MASK
+        off = vaddr & _MM_MASK
+        if region == MM_PROGRAM:
+            return self.rodata, off, False  # type: ignore[return-value]
+        if region == MM_STACK:
+            return self.stack, off, True
+        if region == MM_HEAP:
+            return self.heap, off, True
+        if region == MM_INPUT:
+            return self.input, off, True
+        return None, 0, False
+
+    def translate(self, vaddr: int, sz: int, write: bool) -> Tuple[bytearray, int]:
+        backing, off, writable = self._region(vaddr)
+        if backing is None or off + sz > len(backing) or sz < 0:
+            raise VmError(ERR_SIGSEGV, f"vaddr=0x{vaddr:x} sz={sz}")
+        if write and not writable:
+            raise VmError(ERR_SIGSEGV, f"write to RO vaddr=0x{vaddr:x}")
+        return backing, off  # type: ignore[return-value]
+
+    def mem_read(self, vaddr: int, sz: int) -> bytes:
+        backing, off = self.translate(vaddr, sz, write=False)
+        return bytes(backing[off : off + sz])
+
+    def mem_write(self, vaddr: int, data: bytes) -> None:
+        backing, off = self.translate(vaddr, len(data), write=True)
+        backing[off : off + len(data)] = data
+
+    def read_cstr(self, vaddr: int, max_sz: int = 4096) -> bytes:
+        """Read a NUL- or region-bounded string (for log/panic syscalls)."""
+        backing, off, _ = self._region(vaddr)
+        if backing is None:
+            raise VmError(ERR_SIGSEGV, f"vaddr=0x{vaddr:x}")
+        end = min(len(backing), off + max_sz)
+        chunk = bytes(backing[off:end])
+        nul = chunk.find(b"\0")
+        return chunk if nul < 0 else chunk[:nul]
+
+    # -- CU metering ------------------------------------------------------
+
+    def consume(self, n: int) -> None:
+        self.cu -= n
+        if self.cu < 0:
+            self.cu = 0
+            raise VmError(ERR_COMPUTE)
+
+    @property
+    def cu_used(self) -> int:
+        return self.compute_budget - self.cu
+
+    # -- execution --------------------------------------------------------
+
+    def run(self, *args: int) -> int:
+        """Execute from entry_pc; args land in r1..r5. Returns r0.
+
+        Raises VmError on any fault (the reference's FD_VM_ERR_* space).
+        """
+        self.reg = [0] * 11
+        for i, a in enumerate(args[:5]):
+            self.reg[1 + i] = a & _U64
+        # r10 = frame pointer, read-only, top of first stack frame
+        self.reg[10] = MM_STACK + STACK_FRAME_SZ
+        self.frames = []
+        self.pc = self.entry_pc
+        reg = self.reg
+        n = self.text_cnt
+        while True:
+            if not (0 <= self.pc < n):
+                raise VmError(ERR_SIGILL, f"pc={self.pc} out of text")
+            ins = self.instrs[self.pc]
+            self.consume(1)
+            op = ins.opcode
+            cls = op & 0x7
+
+            if cls == CLS_ALU64 or cls == CLS_ALU:
+                self._alu(ins, is64=(cls == CLS_ALU64))
+            elif cls == CLS_LDX:
+                sz = ins.mem_size
+                addr = (reg[ins.src] + ins.offset) & _U64
+                reg[ins.dst] = int.from_bytes(self.mem_read(addr, sz), "little")
+            elif cls == CLS_STX:
+                sz = ins.mem_size
+                addr = (reg[ins.dst] + ins.offset) & _U64
+                self.mem_write(addr, (reg[ins.src] & _U64).to_bytes(8, "little")[:sz])
+            elif cls == CLS_ST:
+                sz = ins.mem_size
+                addr = (reg[ins.dst] + ins.offset) & _U64
+                self.mem_write(addr, (ins.imm & _U64).to_bytes(8, "little")[:sz])
+            elif cls == CLS_LD:
+                if op != OP_LDDW or self.pc + 1 >= n:
+                    raise VmError(ERR_SIGILL, f"opcode=0x{op:02x}")
+                hi = self.instrs[self.pc + 1]
+                if hi.opcode != OP_ADDL_IMM:
+                    raise VmError(ERR_SIGILL, "lddw second slot")
+                reg[ins.dst] = (ins.imm | (hi.imm << 32)) & _U64
+                self.pc += 1
+            elif cls == CLS_JMP or cls == CLS_JMP32:
+                if op == OP_CALL:
+                    self._call_imm(ins)  # manages pc itself
+                    continue
+                elif op == OP_CALLX:
+                    self._call_pc(reg[ins.imm & 0xF])
+                    continue
+                elif op == OP_EXIT:
+                    if not self.frames:
+                        return reg[0]
+                    fr = self.frames.pop()
+                    reg[6:10] = list(fr.saved_regs)
+                    reg[10] = fr.frame_ptr
+                    self.pc = fr.ret_pc
+                    continue
+                else:
+                    self._jump(ins, is64=(cls == CLS_JMP))
+                    continue
+            else:
+                raise VmError(ERR_SIGILL, f"opcode=0x{op:02x}")
+            self.pc += 1
+
+    # -- ALU --------------------------------------------------------------
+
+    @staticmethod
+    def _sx(v: int, bits: int) -> int:
+        m = 1 << (bits - 1)
+        return (v & ((1 << bits) - 1)) ^ m
+
+    def _alu(self, ins: Instr, is64: bool) -> None:
+        reg = self.reg
+        mask = _U64 if is64 else _U32
+        bits = 64 if is64 else 32
+        a = reg[ins.dst] & mask
+        b = (reg[ins.src] & mask) if ins.is_reg_src else (ins.imm & _U32)
+        if not is64:
+            b &= mask
+        elif not ins.is_reg_src:
+            # imm is sign-extended to 64 bits for ALU64 (fd_vm_interp.c)
+            b = ins.imm if ins.imm < (1 << 31) else ins.imm | (_U64 << 32) & _U64
+            b &= _U64
+        mode = ins.alu_op
+        if mode == 0x0:
+            r = a + b
+        elif mode == 0x1:
+            r = a - b
+        elif mode == 0x2:
+            r = a * b
+        elif mode == 0x3:
+            if b == 0:
+                raise VmError(ERR_SIGDIV)
+            r = a // b
+        elif mode == 0x4:
+            r = a | b
+        elif mode == 0x5:
+            r = a & b
+        elif mode == 0x6:
+            r = a << (b & (bits - 1))
+        elif mode == 0x7:
+            r = a >> (b & (bits - 1))
+        elif mode == 0x8:
+            r = -a
+        elif mode == 0x9:
+            if b == 0:
+                raise VmError(ERR_SIGDIV)
+            r = a % b
+        elif mode == 0xA:
+            r = a ^ b
+        elif mode == 0xB:
+            r = b
+        elif mode == 0xC:
+            sa = a - (1 << bits) if a >> (bits - 1) else a
+            r = sa >> (b & (bits - 1))
+        elif mode == 0xD:  # end (byteswap); imm = 16/32/64
+            w = ins.imm
+            if w not in (16, 32, 64):
+                raise VmError(ERR_SIGILL, "end width")
+            nbytes = w // 8
+            raw = (reg[ins.dst] & _U64).to_bytes(8, "little")[:nbytes]
+            if ins.is_reg_src or is64:  # be: swap; le: truncate (LE host)
+                r = int.from_bytes(raw, "big")
+            else:
+                r = int.from_bytes(raw, "little")
+            reg[ins.dst] = r
+            return
+        else:
+            raise VmError(ERR_SIGILL, f"alu mode {mode}")
+        reg[ins.dst] = r & mask
+
+    # -- jumps ------------------------------------------------------------
+
+    def _jump(self, ins: Instr, is64: bool) -> None:
+        reg = self.reg
+        mask = _U64 if is64 else _U32
+        bits = 64 if is64 else 32
+        a = reg[ins.dst] & mask
+        b = (reg[ins.src] & mask) if ins.is_reg_src else (ins.imm & _U32)
+        if is64 and not ins.is_reg_src:
+            b = ins.imm if ins.imm < (1 << 31) else (ins.imm | ((_U64 << 32) & _U64))
+            b &= _U64
+        sa = a - (1 << bits) if a >> (bits - 1) else a
+        sb = b - (1 << bits) if b >> (bits - 1) else b
+        mode = ins.alu_op
+        taken = {
+            0x0: True,
+            0x1: a == b,
+            0x2: a > b,
+            0x3: a >= b,
+            0x4: bool(a & b),
+            0x5: a != b,
+            0x6: sa > sb,
+            0x7: sa >= sb,
+            0xA: a < b,
+            0xB: a <= b,
+            0xC: sa < sb,
+            0xD: sa <= sb,
+        }.get(mode)
+        if taken is None:
+            raise VmError(ERR_SIGILL, f"jmp mode {mode}")
+        self.pc += 1 + (ins.offset if taken else 0)
+
+    # -- calls ------------------------------------------------------------
+
+    def _push_frame(self) -> None:
+        if len(self.frames) >= STACK_FRAME_MAX - 1:
+            raise VmError(ERR_CALL_DEPTH)
+        self.frames.append(
+            _Frame(
+                ret_pc=self.pc + 1,
+                saved_regs=tuple(self.reg[6:10]),  # type: ignore[arg-type]
+                frame_ptr=self.reg[10],
+            )
+        )
+        self.reg[10] += STACK_FRAME_SZ
+
+    def _call_imm(self, ins: Instr) -> None:
+        h = ins.imm
+        sc = self.syscalls.get(h)
+        if sc is not None:
+            name, fn = sc
+            r = fn(self, *self.reg[1:6])
+            self.reg[0] = (r or 0) & _U64
+            self.pc += 1
+            return
+        target = self.calldests.get(h)
+        if target is None:
+            # PC-relative internal call (imm = signed slot delta), the
+            # form our assembler and simple programs emit.
+            delta = ins.imm if ins.imm < (1 << 31) else ins.imm - (1 << 32)
+            target = self.pc + 1 + delta
+            if not (0 <= target < self.text_cnt):
+                raise VmError(ERR_BAD_CALL, f"imm=0x{ins.imm:x}")
+        self._push_frame()
+        self.pc = target
+
+    def _call_pc(self, target_va: int) -> None:
+        # callx target is a program vaddr of an instruction slot
+        off = target_va - MM_PROGRAM - self.text_off
+        if off % 8 or not (0 <= off // 8 < self.text_cnt):
+            raise VmError(ERR_BAD_CALL, f"callx 0x{target_va:x}")
+        self._push_frame()
+        self.pc = off // 8
+
+
+# -- builtin syscalls (fd_vm_syscalls.c subset) ---------------------------
+
+
+def _sys_abort(vm: Vm, *_a) -> int:
+    raise VmError(ERR_SYSCALL, "abort")
+
+
+def _sys_panic(vm: Vm, msg_va, msg_len, line, col, _r5) -> int:
+    msg = vm.mem_read(msg_va, min(msg_len, 1024)) if msg_len else b""
+    raise VmError(ERR_SYSCALL, f"panic: {msg.decode(errors='replace')} @ {line}:{col}")
+
+
+def _sys_log(vm: Vm, msg_va, msg_len, *_r) -> int:
+    vm.consume(max(100, msg_len))
+    vm.log.append(vm.mem_read(msg_va, msg_len))
+    return 0
+
+
+def _sys_log_64(vm: Vm, r1, r2, r3, r4, r5) -> int:
+    vm.consume(100)
+    vm.log.append(
+        f"0x{r1:x}, 0x{r2:x}, 0x{r3:x}, 0x{r4:x}, 0x{r5:x}".encode()
+    )
+    return 0
+
+
+def _sys_log_compute_units(vm: Vm, *_r) -> int:
+    vm.consume(100)
+    vm.log.append(f"consumed {vm.cu_used} of {vm.compute_budget}".encode())
+    return 0
+
+
+def _sys_memcpy(vm: Vm, dst, src, n, *_r) -> int:
+    vm.consume(max(10, n // 250))
+    if n:
+        # overlap check (reference errors on overlapping memcpy)
+        if max(dst, src) < min(dst, src) + n:
+            raise VmError(ERR_SYSCALL, "memcpy overlap")
+        vm.mem_write(dst, vm.mem_read(src, n))
+    return 0
+
+
+def _sys_memmove(vm: Vm, dst, src, n, *_r) -> int:
+    vm.consume(max(10, n // 250))
+    if n:
+        vm.mem_write(dst, vm.mem_read(src, n))
+    return 0
+
+
+def _sys_memset(vm: Vm, dst, c, n, *_r) -> int:
+    vm.consume(max(10, n // 250))
+    if n:
+        vm.mem_write(dst, bytes([c & 0xFF]) * n)
+    return 0
+
+
+def _sys_memcmp(vm: Vm, a_va, b_va, n, out_va, _r5) -> int:
+    vm.consume(max(10, n // 250))
+    a = vm.mem_read(a_va, n)
+    b = vm.mem_read(b_va, n)
+    r = 0
+    for x, y in zip(a, b):
+        if x != y:
+            r = x - y
+            break
+    vm.mem_write(out_va, (r & _U32).to_bytes(4, "little"))
+    return 0
+
+
+def _sys_sha256(vm: Vm, slices_va, n_slices, out_va, *_r) -> int:
+    from firedancer_tpu.ballet.sha256 import sha256
+
+    vm.consume(85 + 2 * n_slices)
+    data = b""
+    for i in range(n_slices):
+        ptr = int.from_bytes(vm.mem_read(slices_va + 16 * i, 8), "little")
+        ln = int.from_bytes(vm.mem_read(slices_va + 16 * i + 8, 8), "little")
+        vm.consume(ln // 2)
+        data += vm.mem_read(ptr, ln)
+    vm.mem_write(out_va, sha256(data))
+    return 0
+
+
+BUILTIN_SYSCALLS = {
+    b"abort": _sys_abort,
+    b"sol_panic_": _sys_panic,
+    b"sol_log_": _sys_log,
+    b"sol_log_64_": _sys_log_64,
+    b"sol_log_compute_units_": _sys_log_compute_units,
+    b"sol_memcpy_": _sys_memcpy,
+    b"sol_memmove_": _sys_memmove,
+    b"sol_memset_": _sys_memset,
+    b"sol_memcmp_": _sys_memcmp,
+    b"sol_sha256": _sys_sha256,
+}
+
+
+def make_vm(rodata: bytes, **kw) -> Vm:
+    """Vm with the builtin syscall set registered."""
+    vm = Vm(rodata, **kw)
+    for name, fn in BUILTIN_SYSCALLS.items():
+        vm.register_syscall(name, fn)
+    return vm
+
+
+# -- disassembler (fd_vm_disasm.c analog) ---------------------------------
+
+_ALU_NAMES = {
+    0x0: "add", 0x1: "sub", 0x2: "mul", 0x3: "div", 0x4: "or", 0x5: "and",
+    0x6: "lsh", 0x7: "rsh", 0x8: "neg", 0x9: "mod", 0xA: "xor", 0xB: "mov",
+    0xC: "arsh", 0xD: "end",
+}
+_JMP_NAMES = {
+    0x0: "ja", 0x1: "jeq", 0x2: "jgt", 0x3: "jge", 0x4: "jset", 0x5: "jne",
+    0x6: "jsgt", 0x7: "jsge", 0xA: "jlt", 0xB: "jle", 0xC: "jslt",
+    0xD: "jsle",
+}
+_SIZE_SUFFIX = {1: "b", 2: "h", 4: "w", 8: "dw"}
+
+
+def disasm_one(ins: Instr, nxt: Optional[Instr] = None) -> str:
+    op, cls = ins.opcode, ins.op_class
+    if op == OP_EXIT:
+        return "exit"
+    if op == OP_CALL:
+        return f"call 0x{ins.imm:x}"
+    if op == OP_CALLX:
+        return f"callx r{ins.imm & 0xF}"
+    if op == OP_LDDW:
+        v = ins.imm | ((nxt.imm if nxt else 0) << 32)
+        return f"lddw r{ins.dst}, 0x{v:x}"
+    if cls in (CLS_ALU, CLS_ALU64):
+        name = _ALU_NAMES.get(ins.alu_op, "?")
+        w = "64" if cls == CLS_ALU64 else "32"
+        if ins.alu_op == 0x8:
+            return f"{name}{w} r{ins.dst}"
+        if ins.alu_op == 0xD:
+            return f"{'be' if ins.is_reg_src or cls == CLS_ALU64 else 'le'}{ins.imm} r{ins.dst}"
+        src = f"r{ins.src}" if ins.is_reg_src else f"{ins.imm}"
+        return f"{name}{w} r{ins.dst}, {src}"
+    if cls == CLS_LDX:
+        return f"ldx{_SIZE_SUFFIX[ins.mem_size]} r{ins.dst}, [r{ins.src}{ins.offset:+d}]"
+    if cls == CLS_STX:
+        return f"stx{_SIZE_SUFFIX[ins.mem_size]} [r{ins.dst}{ins.offset:+d}], r{ins.src}"
+    if cls == CLS_ST:
+        return f"st{_SIZE_SUFFIX[ins.mem_size]} [r{ins.dst}{ins.offset:+d}], {ins.imm}"
+    if cls in (CLS_JMP, CLS_JMP32):
+        name = _JMP_NAMES.get(ins.alu_op)
+        if name is None:
+            return f".8byte 0x{ins.opcode:02x}"
+        w = "" if cls == CLS_JMP else "32"
+        if name == "ja":
+            return f"ja {ins.offset:+d}"
+        src = f"r{ins.src}" if ins.is_reg_src else f"{ins.imm}"
+        return f"{name}{w} r{ins.dst}, {src}, {ins.offset:+d}"
+    return f".8byte 0x{ins.opcode:02x}"
+
+
+def disasm(text: bytes) -> List[str]:
+    instrs = decode_program(text)
+    out = []
+    skip = False
+    for i, ins in enumerate(instrs):
+        if skip:
+            skip = False
+            continue
+        nxt = instrs[i + 1] if i + 1 < len(instrs) else None
+        out.append(f"{i:6d}: {disasm_one(ins, nxt)}")
+        if ins.opcode == OP_LDDW:
+            skip = True
+    return out

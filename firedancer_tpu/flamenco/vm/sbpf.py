@@ -124,6 +124,10 @@ def asm(source: str) -> List[Instr]:
             out.append(Instr(OP_ADDL_IMM, 0, 0, 0, v >> 32))
         elif op == "ja":
             out.append(Instr(0x05, 0, 0, int(toks[1], 0), 0))
+        elif op[:2] in ("be", "le") and op[2:] in ("16", "32", "64"):
+            # end (byteswap): be = 0xDC (src-bit set), le = 0xD4
+            opc = 0xDC if op[:2] == "be" else 0xD4
+            out.append(Instr(opc, _reg(toks[1]), 0, 0, int(op[2:])))
         elif op[:-2] in _ALU_OPS and op[-2:] in ("64", "32"):
             mode = _ALU_OPS[op[:-2]]
             cls = CLS_ALU64 if op.endswith("64") else CLS_ALU

@@ -1,0 +1,73 @@
+"""fd_vm_tool analog: disassemble / trace / run sBPF programs from the CLI.
+
+Reference: flamenco/vm's fd_vm_tool CLI. Usage:
+
+  python -m firedancer_tpu.flamenco.vm.tool disasm <prog.so|prog.bin>
+  python -m firedancer_tpu.flamenco.vm.tool run <prog.so|prog.bin> \
+      [--input HEX] [--budget N] [--arg N ...]
+
+ELF images (magic 0x7f 'ELF') go through the sbpf loader; anything else
+is treated as raw text (8-byte instruction slots).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _load(path: str):
+    from firedancer_tpu.ballet.sbpf_loader import load_program
+
+    data = open(path, "rb").read()
+    if data[:4] == b"\x7fELF":
+        return load_program(data)
+    from firedancer_tpu.ballet.sbpf_loader import SbpfProgram
+
+    return SbpfProgram(rodata=data, text_off=0, text_cnt=len(data) // 8,
+                       entry_pc=0)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="fd_vm_tool")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    d = sub.add_parser("disasm")
+    d.add_argument("path")
+    r = sub.add_parser("run")
+    r.add_argument("path")
+    r.add_argument("--input", default="", help="input region contents (hex)")
+    r.add_argument("--budget", type=int, default=200_000)
+    r.add_argument("--arg", type=lambda s: int(s, 0), action="append",
+                   default=[], help="r1..r5 arguments")
+    args = p.parse_args(argv)
+
+    prog = _load(args.path)
+    if args.cmd == "disasm":
+        from firedancer_tpu.flamenco.vm.interp import disasm
+
+        text = prog.rodata[prog.text_off : prog.text_off + prog.text_cnt * 8]
+        for line in disasm(text):
+            print(line)
+        return 0
+
+    from firedancer_tpu.flamenco.vm.interp import VmError
+
+    vm = prog.make_vm(
+        input_mem=bytes.fromhex(args.input),
+        compute_budget=args.budget,
+    )
+    try:
+        r0 = vm.run(*args.arg)
+        status = 0
+        print(f"r0 = 0x{r0:x}")
+    except VmError as e:
+        status = 1
+        print(f"fault: {e}", file=sys.stderr)
+    print(f"cu_used = {vm.cu_used}")
+    for line in vm.log.lines:
+        print(f"log: {line.decode(errors='replace')}")
+    return status
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

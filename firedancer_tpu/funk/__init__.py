@@ -131,9 +131,8 @@ class Funk:
         # Drop the published chain and cancel all competing histories.
         for level in chain:
             del self._txns[level]
-        for t in [t for t in list(self._txns) if t not in survivors]:
-            if t in self._txns:
-                del self._txns[t]
+        for t in [t for t in self._txns if t not in survivors]:
+            del self._txns[t]
         return len(chain)
 
     def _descendants(self, xid: int) -> set:
@@ -274,6 +273,8 @@ class Funk:
             (n,) = struct.unpack("<Q", must_read(f, 8))
             for _ in range(n):
                 (klen,) = struct.unpack("<H", must_read(f, 2))
+                if not 1 <= klen <= cls.MAX_KEY:
+                    raise FunkError(f"{path}: bad key length {klen}")
                 k = must_read(f, klen)
                 (vlen,) = struct.unpack("<I", must_read(f, 4))
                 funk._root[k] = must_read(f, vlen)

@@ -1,0 +1,435 @@
+"""sBPF VM + ELF loader tests (reference: flamenco/vm/test_vm_interp.c,
+ballet/sbpf/test_sbpf_loader.c semantics)."""
+
+import struct
+
+import pytest
+
+from firedancer_tpu.ballet.sbpf_loader import (
+    EM_BPF,
+    MM_PROGRAM,
+    R_BPF_64_32,
+    R_BPF_64_64,
+    SbpfLoaderError,
+    load_program,
+    name_hash,
+    pc_hash,
+)
+from firedancer_tpu.flamenco.vm.interp import (
+    ERR_CALL_DEPTH,
+    ERR_COMPUTE,
+    ERR_SIGDIV,
+    ERR_SIGSEGV,
+    MM_HEAP,
+    MM_INPUT,
+    MM_STACK,
+    Vm,
+    VmError,
+    disasm,
+    make_vm,
+)
+from firedancer_tpu.flamenco.vm.sbpf import asm, encode_program
+
+
+def run_asm(src: str, *args, **kw):
+    vm = make_vm(encode_program(asm(src)), **kw)
+    return vm.run(*args), vm
+
+
+def test_alu_basic():
+    r0, _ = run_asm(
+        """
+        mov64 r1, 7
+        mov64 r2, 5
+        add64 r1, r2
+        mul64 r1, 3
+        sub64 r1, 6
+        mov64 r0, r1
+        exit
+        """
+    )
+    assert r0 == (7 + 5) * 3 - 6
+
+
+def test_alu32_truncates():
+    r0, _ = run_asm(
+        """
+        mov64 r1, 0xFFFFFFFF
+        add32 r1, 1
+        mov64 r0, r1
+        exit
+        """
+    )
+    assert r0 == 0  # 32-bit wrap, zero-extended
+
+
+def test_alu64_imm_sign_extends():
+    r0, _ = run_asm(
+        """
+        mov64 r0, 0
+        sub64 r0, 1
+        exit
+        """
+    )
+    assert r0 == (1 << 64) - 1
+
+
+def test_div_mod_and_sigdiv():
+    r0, _ = run_asm(
+        """
+        mov64 r1, 17
+        mov64 r2, 5
+        mov64 r0, r1
+        div64 r0, r2
+        mod64 r1, r2
+        add64 r0, r1
+        exit
+        """
+    )
+    assert r0 == 17 // 5 + 17 % 5
+    with pytest.raises(VmError) as e:
+        run_asm("mov64 r0, 1\nmov64 r1, 0\ndiv64 r0, r1\nexit")
+    assert e.value.code == ERR_SIGDIV
+
+
+def test_shifts_and_arsh():
+    r0, _ = run_asm(
+        """
+        mov64 r1, 1
+        lsh64 r1, 63
+        arsh64 r1, 63
+        mov64 r0, r1
+        exit
+        """
+    )
+    assert r0 == (1 << 64) - 1  # sign fill
+    r0, _ = run_asm("mov64 r1, 0x80\nrsh64 r1, 4\nmov64 r0, r1\nexit")
+    assert r0 == 8
+
+
+def test_lddw():
+    r0, _ = run_asm("lddw r0, 0x123456789abcdef0\nexit")
+    assert r0 == 0x123456789ABCDEF0
+
+
+def test_jumps_loop():
+    # sum 1..10 with a jlt loop
+    r0, _ = run_asm(
+        """
+        mov64 r1, 0
+        mov64 r0, 0
+        jge r1, 10, +3
+        add64 r1, 1
+        add64 r0, r1
+        ja -4
+        exit
+        """
+    )
+    assert r0 == sum(range(1, 11))
+
+
+def test_signed_jumps():
+    r0, _ = run_asm(
+        """
+        mov64 r1, 0
+        sub64 r1, 5
+        mov64 r0, 0
+        jsgt r1, 0, +1
+        mov64 r0, 1
+        exit
+        """
+    )
+    assert r0 == 1  # -5 not > 0 signed
+
+
+def test_stack_heap_input_rw():
+    r0, vm = run_asm(
+        f"""
+        stdw [r10+-8], 0x1122
+        ldxdw r3, [r10+-8]
+        lddw r4, 0x{MM_HEAP:x}
+        stxdw [r4+0], r3
+        ldxdw r0, [r4+0]
+        exit
+        """
+    )
+    assert r0 == 0x1122
+
+
+def test_input_region_args():
+    vm = make_vm(
+        encode_program(asm("ldxdw r0, [r1+0]\nexit")),
+        input_mem=struct.pack("<Q", 0xDEAD),
+    )
+    assert vm.run(MM_INPUT) == 0xDEAD
+
+
+def test_program_region_readonly():
+    with pytest.raises(VmError) as e:
+        run_asm(f"lddw r1, 0x{MM_PROGRAM:x}\nstdw [r1+0], 1\nexit")
+    assert e.value.code == ERR_SIGSEGV
+
+
+def test_oob_access_sigsegv():
+    with pytest.raises(VmError) as e:
+        run_asm(f"lddw r1, 0x{MM_STACK + 0x7000000:x}\nldxdw r0, [r1+0]\nexit")
+    assert e.value.code == ERR_SIGSEGV
+
+
+def test_internal_call_and_frames():
+    # call +N is pc-relative; callee clobbers r6, caller's r6 restored
+    r0, vm = run_asm(
+        """
+        mov64 r6, 11
+        call 2
+        add64 r0, r6
+        exit
+        mov64 r6, 99
+        mov64 r0, 31
+        exit
+        """
+    )
+    assert r0 == 42
+    assert not vm.frames
+
+
+def test_call_depth_limit():
+    with pytest.raises(VmError) as e:
+        run_asm("call -1\nexit")  # call to itself -> infinite recursion
+    assert e.value.code in (ERR_CALL_DEPTH,)
+
+
+def test_compute_budget_exhausted():
+    with pytest.raises(VmError) as e:
+        run_asm("ja -1\nexit", compute_budget=1000)
+    assert e.value.code == ERR_COMPUTE
+
+
+def test_cu_accounting():
+    _, vm = run_asm("mov64 r0, 1\nexit")
+    assert vm.cu_used == 2
+
+
+def test_syscall_log_and_log64():
+    src = f"""
+    lddw r1, 0x{MM_HEAP:x}
+    lddw r2, 0x6f6c6c6568
+    stxdw [r1+0], r2
+    mov64 r2, 5
+    call 0x{name_hash(b"sol_log_"):x}
+    mov64 r1, 1
+    mov64 r2, 2
+    mov64 r3, 3
+    mov64 r4, 4
+    mov64 r5, 5
+    call 0x{name_hash(b"sol_log_64_"):x}
+    mov64 r0, 0
+    exit
+    """
+    r0, vm = run_asm(src)
+    assert r0 == 0
+    assert vm.log.lines[0] == b"hello"
+    assert b"0x1, 0x2" in vm.log.lines[1]
+
+
+def test_syscall_memset_memcpy_memcmp():
+    src = f"""
+    lddw r1, 0x{MM_HEAP:x}
+    mov64 r2, 0xAB
+    mov64 r3, 16
+    call 0x{name_hash(b"sol_memset_"):x}
+    lddw r1, 0x{MM_HEAP + 64:x}
+    lddw r2, 0x{MM_HEAP:x}
+    mov64 r3, 16
+    call 0x{name_hash(b"sol_memcpy_"):x}
+    lddw r1, 0x{MM_HEAP:x}
+    lddw r2, 0x{MM_HEAP + 64:x}
+    mov64 r3, 16
+    lddw r4, 0x{MM_HEAP + 128:x}
+    call 0x{name_hash(b"sol_memcmp_"):x}
+    lddw r1, 0x{MM_HEAP + 128:x}
+    ldxw r0, [r1+0]
+    exit
+    """
+    r0, vm = run_asm(src)
+    assert r0 == 0
+    assert vm.heap[:16] == b"\xab" * 16 == vm.heap[64:80]
+
+
+def test_syscall_sha256():
+    from firedancer_tpu.ballet.sha256 import sha256
+
+    # one slice {ptr, len} at heap+0 describing 3 bytes at heap+64
+    src = f"""
+    lddw r1, 0x{MM_HEAP + 64:x}
+    stdw [r1+0], 0x636261
+    lddw r1, 0x{MM_HEAP:x}
+    lddw r2, 0x{MM_HEAP + 64:x}
+    stxdw [r1+0], r2
+    stdw [r1+8], 3
+    mov64 r2, 1
+    lddw r3, 0x{MM_HEAP + 128:x}
+    call 0x{name_hash(b"sol_sha256"):x}
+    mov64 r0, 0
+    exit
+    """
+    _, vm = run_asm(src)
+    assert bytes(vm.heap[128:160]) == sha256(b"abc")
+
+
+def test_syscall_abort():
+    with pytest.raises(VmError):
+        run_asm(f"call 0x{name_hash(b'abort'):x}\nexit")
+
+
+def test_endian_ops():
+    r0, _ = run_asm("lddw r1, 0x1122334455667788\nbe64 r1\nmov64 r0, r1\nexit")
+    assert r0 == 0x8877665544332211
+    r0, _ = run_asm("lddw r1, 0x1122334455667788\nle32 r1\nmov64 r0, r1\nexit")
+    assert r0 == 0x55667788
+
+
+def test_disasm_mnemonics():
+    text = encode_program(
+        asm(
+            """
+            mov64 r1, 5
+            ldxdw r2, [r1+8]
+            jeq r1, r2, +1
+            call 0x11223344
+            exit
+            """
+        )
+    )
+    out = "\n".join(disasm(text))
+    for frag in ("mov64 r1, 5", "ldxdw r2, [r1+8]", "jeq r1, r2, +1",
+                 "call 0x11223344", "exit"):
+        assert frag in out
+
+
+# -- minimal ELF builder for loader tests ---------------------------------
+
+
+def build_elf(text: bytes, rodata: bytes = b"", syms=(), rels=()):
+    """Create a minimal sBPF ELF64.
+
+    syms: (name, value_fileoff, is_func, defined)
+    rels: (r_offset_fileoff, type, sym_index_1based)
+    Layout: ehdr | .text @0x120 | .rodata | .symtab | .strtab | shdrs
+    vaddr == file offset throughout (flat placement).
+    """
+    text_off = 0x120
+    ro_off = text_off + len(text)
+    # strtab
+    names = [b""] + [s[0] for s in syms]
+    strtab = b"\0"
+    name_off = {}
+    for nm in names[1:]:
+        name_off[nm] = len(strtab)
+        strtab += nm + b"\0"
+    # symtab: null + entries
+    symtab = b"\0" * 24
+    for nm, value, is_func, defined in syms:
+        info = 0x12 if is_func else 0x10  # GLOBAL<<4 | (FUNC|NOTYPE)
+        shndx = 1 if defined else 0
+        symtab += struct.pack("<IBBHQQ", name_off[nm], info, 0, shndx, value, 0)
+    reltab = b"".join(
+        struct.pack("<QQ", off, (sym_idx << 32) | ty) for off, ty, sym_idx in rels
+    )
+    sym_off = ro_off + len(rodata)
+    str_off = sym_off + len(symtab)
+    rel_off = str_off + len(strtab)
+    shstr_off = rel_off + len(reltab)
+    shstrtab = b"\0.text\0.rodata\0.symtab\0.strtab\0.rel.text\0.shstrtab\0"
+    sh_off = shstr_off + len(shstrtab)
+
+    def shdr(nm, ty, addr, off, size, link=0, info=0, ent=0):
+        return struct.pack("<IIQQQQIIQQ", nm, ty, 0, addr, off, size, link,
+                           info, 8, ent)
+
+    shdrs = b"".join([
+        shdr(0, 0, 0, 0, 0),                                   # NULL
+        shdr(1, 1, text_off, text_off, len(text)),             # .text
+        shdr(7, 1, ro_off, ro_off, len(rodata)),               # .rodata
+        shdr(15, 2, 0, sym_off, len(symtab), link=4, ent=24),  # .symtab
+        shdr(23, 3, 0, str_off, len(strtab)),                  # .strtab
+        shdr(31, 9, 0, rel_off, len(reltab), link=3, info=1, ent=16),  # .rel.text
+        shdr(41, 3, 0, shstr_off, len(shstrtab)),              # .shstrtab
+    ])
+    ehdr = struct.pack(
+        "<4sBBBBB7xHHIQQQIHHHHHH",
+        b"\x7fELF", 2, 1, 1, 0, 0,
+        ET := 3, EM_BPF, 1,
+        text_off,          # e_entry -> first text slot
+        0, sh_off,
+        0, 64, 0, 0, 64, 7, 6,
+    )
+    img = bytearray(ehdr)
+    img += b"\0" * (text_off - len(img))
+    img += text + rodata + symtab + strtab + reltab + shstrtab + shdrs
+    return bytes(img)
+
+
+def test_loader_basic_entry_and_run():
+    text = encode_program(asm("mov64 r0, 77\nexit"))
+    prog = load_program(build_elf(text))
+    assert prog.text_cnt == 2 and prog.entry_pc == 0
+    assert prog.make_vm().run() == 77
+
+
+def test_loader_call_reloc_internal():
+    # slot0: call helper (imm patched by reloc), slot1: exit
+    # helper at slot2: mov64 r0, 55; exit
+    text = encode_program(
+        asm("call -1\nexit\nmov64 r0, 55\nexit")
+    )
+    text_off = 0x120
+    helper_off = text_off + 2 * 8
+    elf = build_elf(
+        text,
+        syms=[(b"helper", helper_off, True, True)],
+        rels=[(text_off + 0, R_BPF_64_32, 1)],
+    )
+    prog = load_program(elf)
+    assert pc_hash(2) in prog.calldests
+    assert prog.make_vm().run() == 55
+
+
+def test_loader_call_reloc_syscall():
+    text = encode_program(asm("call -1\nmov64 r0, 9\nexit"))
+    text_off = 0x120
+    elf = build_elf(
+        text,
+        syms=[(b"sol_log_compute_units_", 0, True, False)],
+        rels=[(text_off, R_BPF_64_32, 1)],
+    )
+    prog = load_program(elf)
+    vm = prog.make_vm()
+    assert vm.run() == 9
+    assert b"consumed" in vm.log.lines[0]
+
+
+def test_loader_lddw_reloc_rodata():
+    # lddw r1, <rodata file offset>; ldxw r0 [r1]; exit — reloc rebases to vaddr
+    rodata = struct.pack("<I", 0xCAFEBABE)
+    text = encode_program(asm("lddw r1, 0\nldxw r0, [r1+0]\nexit"))
+    text_off = 0x120
+    ro_fileoff = text_off + len(text)
+    # seed the lddw imm with the file offset (addend), reloc adds MM_PROGRAM
+    text = bytearray(text)
+    struct.pack_into("<I", text, 4, ro_fileoff)
+    elf = build_elf(
+        bytes(text),
+        rodata=rodata,
+        syms=[(b"ro", 0, False, True)],
+        rels=[(text_off, R_BPF_64_64, 1)],
+    )
+    prog = load_program(elf)
+    assert prog.make_vm().run() == 0xCAFEBABE
+
+
+def test_loader_rejects_garbage():
+    with pytest.raises(SbpfLoaderError):
+        load_program(b"not an elf")
+    with pytest.raises(SbpfLoaderError):
+        load_program(b"\x7fELF" + b"\0" * 100)
