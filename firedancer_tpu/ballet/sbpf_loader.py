@@ -181,9 +181,9 @@ def load_program(elf: bytes) -> SbpfProgram:
     calldests: Dict[int, int] = {}
 
     def sym_pc(sym: _Sym) -> int:
-        """Instruction slot index of a function symbol."""
-        # st_value is a vaddr == file offset for sBPF's flat placement
-        off = sym.value - text.addr + text.offset if sym.value < text.offset else sym.value
+        """Instruction slot index of a function symbol (st_value is a
+        section vaddr; flat sBPF ELFs set sh_addr == sh_offset)."""
+        off = sym.value - text.addr + text.offset
         if off < text.offset or off >= text.offset + text.size or off % 8:
             raise SbpfLoaderError(f"func sym {sym.name!r} outside .text")
         return (off - text.offset) // 8
@@ -218,12 +218,14 @@ def load_program(elf: bytes) -> SbpfProgram:
                 calldests,
             )
 
-    # entrypoint: e_entry vaddr, else the `entrypoint` symbol, else slot 0
+    # entrypoint: e_entry vaddr (invalid -> reject, as the reference
+    # loader does), else the `entrypoint` symbol, else slot 0
     entry_pc = 0
     if e_entry:
         off = e_entry - text.addr + text.offset
-        if text.offset <= off < text.offset + text.size and off % 8 == 0:
-            entry_pc = (off - text.offset) // 8
+        if not (text.offset <= off < text.offset + text.size) or off % 8:
+            raise SbpfLoaderError(f"e_entry 0x{e_entry:x} outside .text")
+        entry_pc = (off - text.offset) // 8
     else:
         for sym in syms:
             if sym.name == b"entrypoint" and sym.is_func:
@@ -291,7 +293,7 @@ def _apply_reloc(
         if sym is None:
             raise SbpfLoaderError("R_BPF_64_32 without symbol")
         if sym.shndx != 0 and sym.is_func:
-            off = sym.value - text.addr + text.offset if sym.value < text.offset else sym.value
+            off = sym.value - text.addr + text.offset
             if off % 8 or not (text.offset <= off < text.offset + text.size):
                 raise SbpfLoaderError(f"call target {sym.name!r} outside .text")
             pc = (off - text.offset) // 8

@@ -1,0 +1,197 @@
+"""bincode wire primitives (fd_bincode.h analog).
+
+Solana's bincode layer: little-endian fixed-width integers, bool as one
+byte (0/1 strict), Option as a one-byte tag, Vec/String with a u64
+length prefix, and the "short_vec" compact-u16 length used by
+transaction wire formats (ballet/txn/fd_compact_u16.h). Decoders take
+(buf, off) and return (value, new_off); encoders append to a bytearray.
+All decode errors raise BincodeError (fd_bincode_decode err space).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, List, Optional, Tuple
+
+
+class BincodeError(Exception):
+    pass
+
+
+def _need(buf: bytes, off: int, n: int) -> None:
+    if off + n > len(buf):
+        raise BincodeError(f"underflow at {off}+{n} > {len(buf)}")
+
+
+# -- fixed-width ints ---------------------------------------------------
+
+def _mk_int(fmt: str, n: int):
+    st = struct.Struct(fmt)
+
+    def dec(buf: bytes, off: int) -> Tuple[int, int]:
+        _need(buf, off, n)
+        return st.unpack_from(buf, off)[0], off + n
+
+    def enc(out: bytearray, v: int) -> None:
+        out += st.pack(v)
+
+    return dec, enc
+
+
+decode_u8, encode_u8 = _mk_int("<B", 1)
+decode_u16, encode_u16 = _mk_int("<H", 2)
+decode_u32, encode_u32 = _mk_int("<I", 4)
+decode_u64, encode_u64 = _mk_int("<Q", 8)
+decode_i8, encode_i8 = _mk_int("<b", 1)
+decode_i16, encode_i16 = _mk_int("<h", 2)
+decode_i32, encode_i32 = _mk_int("<i", 4)
+decode_i64, encode_i64 = _mk_int("<q", 8)
+decode_f64, encode_f64 = _mk_int("<d", 8)
+
+
+def decode_u128(buf: bytes, off: int) -> Tuple[int, int]:
+    _need(buf, off, 16)
+    return int.from_bytes(buf[off : off + 16], "little"), off + 16
+
+
+def encode_u128(out: bytearray, v: int) -> None:
+    out += (v & ((1 << 128) - 1)).to_bytes(16, "little")
+
+
+def decode_bool(buf: bytes, off: int) -> Tuple[bool, int]:
+    v, off = decode_u8(buf, off)
+    if v > 1:
+        raise BincodeError(f"bad bool {v}")
+    return bool(v), off
+
+
+def encode_bool(out: bytearray, v: bool) -> None:
+    out.append(1 if v else 0)
+
+
+# -- bytes / string -----------------------------------------------------
+
+def decode_fixed(n: int):
+    def dec(buf: bytes, off: int) -> Tuple[bytes, int]:
+        _need(buf, off, n)
+        return bytes(buf[off : off + n]), off + n
+
+    return dec
+
+
+def encode_fixed(out: bytearray, v: bytes) -> None:
+    out += v
+
+
+decode_pubkey = decode_fixed(32)
+decode_hash = decode_fixed(32)
+decode_signature = decode_fixed(64)
+
+
+def decode_bytes(buf: bytes, off: int) -> Tuple[bytes, int]:
+    n, off = decode_u64(buf, off)
+    _need(buf, off, n)
+    return bytes(buf[off : off + n]), off + n
+
+
+def encode_bytes(out: bytearray, v: bytes) -> None:
+    encode_u64(out, len(v))
+    out += v
+
+
+def decode_string(buf: bytes, off: int) -> Tuple[str, int]:
+    b, off = decode_bytes(buf, off)
+    try:
+        return b.decode("utf-8"), off
+    except UnicodeDecodeError as e:
+        raise BincodeError(f"bad utf-8: {e}") from None
+
+
+def encode_string(out: bytearray, v: str) -> None:
+    encode_bytes(out, v.encode("utf-8"))
+
+
+# -- compact-u16 (short_vec length, fd_compact_u16.h) -------------------
+
+def decode_compact_u16(buf: bytes, off: int) -> Tuple[int, int]:
+    v = shift = 0
+    for i in range(3):
+        _need(buf, off, 1)
+        b = buf[off]
+        off += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            if v > 0xFFFF or (i > 0 and b == 0):
+                raise BincodeError("non-canonical compact_u16")
+            return v, off
+        shift += 7
+    raise BincodeError("compact_u16 too long")
+
+
+def encode_compact_u16(out: bytearray, v: int) -> None:
+    if not 0 <= v <= 0xFFFF:
+        raise BincodeError(f"compact_u16 range: {v}")
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+# -- combinators --------------------------------------------------------
+
+def decode_option(inner: Callable):
+    def dec(buf: bytes, off: int):
+        tag, off = decode_u8(buf, off)
+        if tag == 0:
+            return None, off
+        if tag != 1:
+            raise BincodeError(f"bad option tag {tag}")
+        return inner(buf, off)
+
+    return dec
+
+
+def encode_option(inner: Callable):
+    def enc(out: bytearray, v) -> None:
+        if v is None:
+            out.append(0)
+        else:
+            out.append(1)
+            inner(out, v)
+
+    return enc
+
+
+def decode_vec(inner: Callable, length_dec: Callable = decode_u64):
+    def dec(buf: bytes, off: int):
+        n, off = length_dec(buf, off)
+        if n > len(buf):  # cheap DoS guard: can't have more items than bytes
+            raise BincodeError(f"vec length {n} exceeds buffer")
+        out: List = []
+        for _ in range(n):
+            v, off = inner(buf, off)
+            out.append(v)
+        return out, off
+
+    return dec
+
+
+def encode_vec(inner: Callable, length_enc: Callable = encode_u64):
+    def enc(out: bytearray, vs) -> None:
+        length_enc(out, len(vs))
+        for v in vs:
+            inner(out, v)
+
+    return enc
+
+
+def decode_short_vec(inner: Callable):
+    return decode_vec(inner, length_dec=decode_compact_u16)
+
+
+def encode_short_vec(inner: Callable):
+    return encode_vec(inner, length_enc=encode_compact_u16)

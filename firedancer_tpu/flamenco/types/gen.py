@@ -1,0 +1,295 @@
+"""bincode type codegen (gen_stubs.py analog).
+
+Reads fd_types.json (schema of Solana bincode types, mirroring the
+reference's src/flamenco/types/fd_types.json) and emits a Python module
+of dataclasses with decode/encode/size/walk, the same function family
+the reference generates into fd_types.{h,c}. The generated module is
+checked in (generated.py); tests regenerate and diff to catch drift.
+
+  python -m firedancer_tpu.flamenco.types.gen            # regen in place
+  python -m firedancer_tpu.flamenco.types.gen --check    # drift check
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+SCHEMA_PATH = os.path.join(_HERE, "fd_types.json")
+OUT_PATH = os.path.join(_HERE, "generated.py")
+
+_PRIMS = {
+    "u8", "u16", "u32", "u64", "u128", "i8", "i16", "i32", "i64", "f64",
+    "bool", "string", "bytes",
+}
+_FIXED = {"pubkey": 32, "hash": 32, "signature": 64}
+
+
+def _camel(name: str) -> str:
+    return "".join(p.capitalize() for p in name.split("_"))
+
+
+def _parse_ty(ty: str) -> Tuple[str, ...]:
+    """'vec<option<u64>>' -> ('vec', 'option<u64>'); 'array<hash,4>' ->
+    ('array', 'hash', '4'); 'u64' -> ('prim', 'u64'); etc."""
+    if "<" in ty:
+        head, inner = ty.split("<", 1)
+        inner = inner[: inner.rfind(">")]
+        if head == "array":
+            elem, n = inner.rsplit(",", 1)
+            return ("array", elem.strip(), n.strip())
+        return (head, inner.strip())
+    if ty in _PRIMS:
+        return ("prim", ty)
+    if ty in _FIXED:
+        return ("fixed", ty)
+    return ("struct", ty)
+
+
+def _dec_expr(ty: str, known: Dict[str, str]) -> str:
+    """Expression decoding type `ty` from (buf, off): evaluates to
+    '(value, off)'."""
+    kind = _parse_ty(ty)
+    if kind[0] == "prim":
+        return f"bc.decode_{kind[1]}(buf, off)"
+    if kind[0] == "fixed":
+        return f"bc.decode_{kind[1]}(buf, off)"
+    if kind[0] == "option":
+        return f"bc.decode_option(lambda b, o: {_dec_lambda(kind[1], known)}(b, o))(buf, off)"
+    if kind[0] == "vec":
+        return f"bc.decode_vec(lambda b, o: {_dec_lambda(kind[1], known)}(b, o))(buf, off)"
+    if kind[0] == "short_vec":
+        return f"bc.decode_short_vec(lambda b, o: {_dec_lambda(kind[1], known)}(b, o))(buf, off)"
+    if kind[0] == "array":
+        return f"_decode_array(lambda b, o: {_dec_lambda(kind[1], known)}(b, o), {kind[2]})(buf, off)"
+    if kind[0] == "struct":
+        if kind[1] not in known:
+            raise ValueError(f"unknown type {ty!r}")
+        return f"{known[kind[1]]}.decode(buf, off)"
+    raise ValueError(f"bad type {ty!r}")
+
+
+def _dec_lambda(ty: str, known: Dict[str, str]) -> str:
+    """Callable expression for inner decoders."""
+    kind = _parse_ty(ty)
+    if kind[0] in ("prim", "fixed"):
+        return f"bc.decode_{kind[1]}"
+    if kind[0] == "struct":
+        if kind[1] not in known:
+            raise ValueError(f"unknown type {ty!r}")
+        return f"{known[kind[1]]}.decode"
+    # nested combinator: wrap via the expr form
+    return f"(lambda b, o: {_dec_expr(ty, known)})"
+
+
+def _enc_stmts(ty: str, val: str, known: Dict[str, str], indent: str) -> List[str]:
+    kind = _parse_ty(ty)
+    if kind[0] in ("prim",):
+        return [f"{indent}bc.encode_{kind[1]}(out, {val})"]
+    if kind[0] == "fixed":
+        n = _FIXED[kind[1]]
+        return [
+            f"{indent}if len({val}) != {n}:",
+            f"{indent}    raise bc.BincodeError('expected {n} bytes for {kind[1]}')",
+            f"{indent}bc.encode_fixed(out, {val})",
+        ]
+    if kind[0] == "option":
+        inner = _enc_stmts(kind[1], f"{val}", known, indent + "    ")
+        return (
+            [f"{indent}if {val} is None:", f"{indent}    out.append(0)",
+             f"{indent}else:", f"{indent}    out.append(1)"] + inner
+        )
+    if kind[0] in ("vec", "short_vec"):
+        lenc = "bc.encode_u64" if kind[0] == "vec" else "bc.encode_compact_u16"
+        inner = _enc_stmts(kind[1], "_it", known, indent + "    ")
+        return (
+            [f"{indent}{lenc}(out, len({val}))",
+             f"{indent}for _it in {val}:"] + inner
+        )
+    if kind[0] == "array":
+        inner = _enc_stmts(kind[1], "_it", known, indent + "    ")
+        return (
+            [f"{indent}if len({val}) != {kind[2]}:",
+             f"{indent}    raise bc.BincodeError('expected {kind[2]} elements')",
+             f"{indent}for _it in {val}:"] + inner
+        )
+    if kind[0] == "struct":
+        return [f"{indent}{val}.encode_into(out)"]
+    raise ValueError(f"bad type {ty!r}")
+
+
+def _default_expr(ty: str) -> str:
+    """Expression yielding a fresh default value for `ty`."""
+    kind = _parse_ty(ty)
+    if kind[0] == "prim":
+        return {
+            "bool": "False", "f64": "0.0", "string": "''", "bytes": "b''",
+        }.get(kind[1], "0")
+    if kind[0] == "fixed":
+        return f"b'\\0' * {_FIXED[kind[1]]}"
+    if kind[0] == "option":
+        return "None"
+    if kind[0] in ("vec", "short_vec"):
+        return "[]"
+    if kind[0] == "array":
+        return f"[{_default_expr(kind[1])} for _ in range({kind[2]})]"
+    if kind[0] == "struct":
+        return f"{_camel(kind[1])}()"
+    raise ValueError(ty)
+
+
+def _py_default(ty: str) -> str:
+    kind = _parse_ty(ty)
+    if kind[0] in ("prim", "fixed", "option"):
+        return _default_expr(ty)
+    return f"field(default_factory=lambda: {_default_expr(ty)})"
+
+
+def _gen_struct(t: dict, known: Dict[str, str]) -> List[str]:
+    cls = _camel(t["name"])
+    L = ["", "", "@dataclass", f"class {cls}:",
+         f'    """{t["name"]} (fd_types.json)."""', ""]
+    for f in t["fields"]:
+        L.append(f"    {f['name']}: object = {_py_default(f['type'])}")
+    # decode
+    L += ["", "    @classmethod",
+          "    def decode(cls, buf, off=0):", "        self = cls()"]
+    for f in t["fields"]:
+        L.append(f"        self.{f['name']}, off = {_dec_expr(f['type'], known)}")
+    L.append("        return self, off")
+    # encode
+    L += ["", "    def encode_into(self, out):"]
+    if not t["fields"]:
+        L.append("        pass")
+    for f in t["fields"]:
+        L += _enc_stmts(f["type"], f"self.{f['name']}", known, "        ")
+    L += ["", "    def encode(self):", "        out = bytearray()",
+          "        self.encode_into(out)", "        return bytes(out)"]
+    L += ["", "    def size(self):", "        return len(self.encode())"]
+    # walk
+    L += ["", "    def walk(self, fn, path=''):"]
+    for f in t["fields"]:
+        kind = _parse_ty(f["type"])
+        fp = f"(path + '.{f['name']}') if path else '{f['name']}'"
+        if kind[0] == "struct":
+            L.append(f"        self.{f['name']}.walk(fn, {fp})")
+        else:
+            L.append(f"        fn({fp}, self.{f['name']})")
+    if not t["fields"]:
+        L.append("        pass")
+    return L
+
+
+def _gen_enum(t: dict, known: Dict[str, str]) -> List[str]:
+    cls = _camel(t["name"])
+    L = ["", "", "@dataclass", f"class {cls}:",
+         f'    """{t["name"]} (enum, u32 LE discriminant)."""', ""]
+    for i, v in enumerate(t["variants"]):
+        L.append(f"    {v['name'].upper()} = {i}")
+    L += ["", "    discriminant: int = 0",
+          "    value: object = None  # variant payload tuple or None"]
+    # decode
+    L += ["", "    @classmethod", "    def decode(cls, buf, off=0):",
+          "        self = cls()",
+          "        self.discriminant, off = bc.decode_u32(buf, off)"]
+    for i, v in enumerate(t["variants"]):
+        fields = v.get("fields", [])
+        L.append(f"        {'if' if i == 0 else 'elif'} self.discriminant == {i}:")
+        if not fields:
+            L.append("            self.value = None")
+        else:
+            names = []
+            for f in fields:
+                L.append(f"            _{f['name']}, off = {_dec_expr(f['type'], known)}")
+                names.append(f"_{f['name']}")
+            L.append(f"            self.value = ({', '.join(names)},)")
+    L += ["        else:",
+          "            raise bc.BincodeError("
+          f"f'bad {t['name']} discriminant {{self.discriminant}}')",
+          "        return self, off"]
+    # encode
+    L += ["", "    def encode_into(self, out):",
+          "        bc.encode_u32(out, self.discriminant)"]
+    for i, v in enumerate(t["variants"]):
+        fields = v.get("fields", [])
+        if not fields:
+            continue
+        L.append(f"        if self.discriminant == {i}:")
+        for j, f in enumerate(fields):
+            L += _enc_stmts(f["type"], f"self.value[{j}]", known, "            ")
+    L += ["", "    def encode(self):", "        out = bytearray()",
+          "        self.encode_into(out)", "        return bytes(out)",
+          "", "    def size(self):", "        return len(self.encode())",
+          "", "    def walk(self, fn, path=''):",
+          "        fn((path + '.discriminant') if path else 'discriminant',"
+          " self.discriminant)",
+          "        if self.value is not None:",
+          "            fn((path + '.value') if path else 'value', self.value)"]
+    return L
+
+
+def generate(schema: dict) -> str:
+    known: Dict[str, str] = {}
+    body: List[str] = []
+    for t in schema["types"]:
+        known[t["name"]] = _camel(t["name"])
+    for t in schema["types"]:
+        if t["kind"] == "struct":
+            body += _gen_struct(t, known)
+        elif t["kind"] == "enum":
+            body += _gen_enum(t, known)
+        else:
+            raise ValueError(f"bad kind {t['kind']!r}")
+    all_names = ", ".join(f'"{known[t["name"]]}"' for t in schema["types"])
+    header = [
+        '"""GENERATED by firedancer_tpu.flamenco.types.gen — DO NOT EDIT.',
+        "",
+        "Solana bincode types from fd_types.json (fd_types.{h,c} analog).",
+        "Regenerate: python -m firedancer_tpu.flamenco.types.gen",
+        '"""',
+        "",
+        "from dataclasses import dataclass, field",
+        "",
+        "import firedancer_tpu.flamenco.types.bincode as bc",
+        "",
+        f"__all__ = [{all_names}]",
+        "",
+        "",
+        "def _decode_array(inner, n):",
+        "    def dec(buf, off):",
+        "        out = []",
+        "        for _ in range(n):",
+        "            v, off = inner(buf, off)",
+        "            out.append(v)",
+        "        return out, off",
+        "    return dec",
+    ]
+    return "\n".join(header + body) + "\n"
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--check", action="store_true")
+    args = p.parse_args(argv)
+    with open(SCHEMA_PATH) as f:
+        schema = json.load(f)
+    src = generate(schema)
+    if args.check:
+        with open(OUT_PATH) as f:
+            if f.read() != src:
+                print("generated.py is stale; rerun the generator")
+                return 1
+        print("generated.py up to date")
+        return 0
+    with open(OUT_PATH, "w") as f:
+        f.write(src)
+    print(f"wrote {OUT_PATH}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

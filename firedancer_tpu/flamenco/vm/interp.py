@@ -127,6 +127,7 @@ class Vm:
         self.text_cnt = max_cnt if text_cnt is None else min(text_cnt, max_cnt)
         self.text_off = text_off
         self.instrs = decode_program(text[: self.text_cnt * 8])
+        self._validate()
         self.entry_pc = entry_pc
         self.stack = bytearray(STACK_FRAME_SZ * STACK_FRAME_MAX)
         self.heap = bytearray(heap_sz)
@@ -139,6 +140,20 @@ class Vm:
         self.frames: List[_Frame] = []
         self.reg = [0] * 11
         self.pc = entry_pc
+
+    def _validate(self) -> None:
+        """Static register/opcode checks (the reference's validate pass):
+        src in r0..r10; dst writable classes limited to r0..r9 (r10 is the
+        read-only frame pointer, usable only as a load/store base)."""
+        for i, ins in enumerate(self.instrs):
+            cls = ins.op_class
+            if ins.src > 10:
+                raise VmError(ERR_SIGILL, f"pc={i}: src r{ins.src}")
+            writes_dst = cls in (CLS_ALU, CLS_ALU64, CLS_LDX, CLS_LD)
+            if ins.dst > (9 if writes_dst else 10):
+                raise VmError(ERR_SIGILL, f"pc={i}: dst r{ins.dst}")
+            if ins.opcode == OP_CALLX and (ins.imm & 0xF) > 10:
+                raise VmError(ERR_SIGILL, f"pc={i}: callx r{ins.imm & 0xF}")
 
     # -- syscall registration -------------------------------------------
 
@@ -272,11 +287,6 @@ class Vm:
 
     # -- ALU --------------------------------------------------------------
 
-    @staticmethod
-    def _sx(v: int, bits: int) -> int:
-        m = 1 << (bits - 1)
-        return (v & ((1 << bits) - 1)) ^ m
-
     def _alu(self, ins: Instr, is64: bool) -> None:
         reg = self.reg
         mask = _U64 if is64 else _U32
@@ -384,6 +394,17 @@ class Vm:
         self.reg[10] += STACK_FRAME_SZ
 
     def _call_imm(self, ins: Instr) -> None:
+        # src distinguishes the two call forms (as in the reference/rbpf):
+        # src=1 -> pc-relative internal call (imm = signed slot delta);
+        # src=0 -> imm is a murmur3 hash: syscall, else calldests entry.
+        if ins.src == 1:
+            delta = ins.imm if ins.imm < (1 << 31) else ins.imm - (1 << 32)
+            target = self.pc + 1 + delta
+            if not (0 <= target < self.text_cnt):
+                raise VmError(ERR_BAD_CALL, f"rel imm=0x{ins.imm:x}")
+            self._push_frame()
+            self.pc = target
+            return
         h = ins.imm
         sc = self.syscalls.get(h)
         if sc is not None:
@@ -394,12 +415,7 @@ class Vm:
             return
         target = self.calldests.get(h)
         if target is None:
-            # PC-relative internal call (imm = signed slot delta), the
-            # form our assembler and simple programs emit.
-            delta = ins.imm if ins.imm < (1 << 31) else ins.imm - (1 << 32)
-            target = self.pc + 1 + delta
-            if not (0 <= target < self.text_cnt):
-                raise VmError(ERR_BAD_CALL, f"imm=0x{ins.imm:x}")
+            raise VmError(ERR_BAD_CALL, f"imm=0x{ins.imm:x}")
         self._push_frame()
         self.pc = target
 
@@ -519,16 +535,8 @@ def make_vm(rodata: bytes, **kw) -> Vm:
 
 # -- disassembler (fd_vm_disasm.c analog) ---------------------------------
 
-_ALU_NAMES = {
-    0x0: "add", 0x1: "sub", 0x2: "mul", 0x3: "div", 0x4: "or", 0x5: "and",
-    0x6: "lsh", 0x7: "rsh", 0x8: "neg", 0x9: "mod", 0xA: "xor", 0xB: "mov",
-    0xC: "arsh", 0xD: "end",
-}
-_JMP_NAMES = {
-    0x0: "ja", 0x1: "jeq", 0x2: "jgt", 0x3: "jge", 0x4: "jset", 0x5: "jne",
-    0x6: "jsgt", 0x7: "jsge", 0xA: "jlt", 0xB: "jle", 0xC: "jslt",
-    0xD: "jsle",
-}
+from firedancer_tpu.flamenco.vm.sbpf import _ALU_NAMES, _JMP_NAMES  # noqa: E402
+
 _SIZE_SUFFIX = {1: "b", 2: "h", 4: "w", 8: "dw"}
 
 

@@ -115,7 +115,13 @@ def asm(source: str) -> List[Instr]:
         if op == "exit":
             out.append(Instr(OP_EXIT, 0, 0, 0, 0))
         elif op == "call":
-            out.append(Instr(OP_CALL, 0, 0, 0, int(toks[1], 0) & 0xFFFFFFFF))
+            # sign-prefixed operand = pc-relative internal call (src=1);
+            # bare operand = murmur3 hash form (src=0, syscall/calldest)
+            rel = toks[1][0] in "+-"
+            out.append(
+                Instr(OP_CALL, 0, 1 if rel else 0, 0,
+                      int(toks[1], 0) & 0xFFFFFFFF)
+            )
         elif op == "callx":
             out.append(Instr(OP_CALLX, 0, 0, 0, _reg(toks[1])))
         elif op == "lddw":

@@ -38,7 +38,7 @@ def main(argv=None) -> int:
     r.add_argument("--input", default="", help="input region contents (hex)")
     r.add_argument("--budget", type=int, default=200_000)
     r.add_argument("--arg", type=lambda s: int(s, 0), action="append",
-                   default=[], help="r1..r5 arguments")
+                   default=None, help="r1..r5 arguments")
     args = p.parse_args(argv)
 
     prog = _load(args.path)
@@ -57,7 +57,7 @@ def main(argv=None) -> int:
         compute_budget=args.budget,
     )
     try:
-        r0 = vm.run(*args.arg)
+        r0 = vm.run(*(args.arg or []))
         status = 0
         print(f"r0 = 0x{r0:x}")
     except VmError as e:

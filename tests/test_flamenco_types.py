@@ -1,0 +1,207 @@
+"""flamenco/types bincode + codegen tests (reference: src/flamenco/types
+test strategy — roundtrip generated codecs, fixed wire vectors)."""
+
+import json
+import random
+
+import pytest
+
+import firedancer_tpu.flamenco.types.bincode as bc
+import firedancer_tpu.flamenco.types.generated as gen
+from firedancer_tpu.flamenco.types.gen import SCHEMA_PATH, generate, _camel
+
+
+def test_generated_not_stale():
+    with open(SCHEMA_PATH) as f:
+        schema = json.load(f)
+    with open(gen.__file__.rstrip("c")) as f:
+        assert f.read() == generate(schema), "generated.py is stale"
+
+
+# -- primitives ---------------------------------------------------------
+
+
+def test_int_roundtrip_and_bounds():
+    out = bytearray()
+    bc.encode_u64(out, 2**64 - 1)
+    v, off = bc.decode_u64(bytes(out), 0)
+    assert v == 2**64 - 1 and off == 8
+    with pytest.raises(bc.BincodeError):
+        bc.decode_u64(b"\x01" * 7, 0)
+
+
+def test_bool_strict():
+    assert bc.decode_bool(b"\x01", 0) == (True, 1)
+    assert bc.decode_bool(b"\x00", 0) == (False, 1)
+    with pytest.raises(bc.BincodeError):
+        bc.decode_bool(b"\x02", 0)
+
+
+def test_option_tags():
+    dec = bc.decode_option(bc.decode_u32)
+    assert dec(b"\x00", 0) == (None, 1)
+    assert dec(b"\x01\x05\x00\x00\x00", 0) == (5, 5)
+    with pytest.raises(bc.BincodeError):
+        dec(b"\x07", 0)
+
+
+def test_compact_u16_canonical():
+    for v in (0, 1, 0x7F, 0x80, 0x3FFF, 0x4000, 0xFFFF):
+        out = bytearray()
+        bc.encode_compact_u16(out, v)
+        got, off = bc.decode_compact_u16(bytes(out), 0)
+        assert got == v and off == len(out)
+    # non-canonical: 0x80 0x00 encodes 0 with a trailing zero byte
+    with pytest.raises(bc.BincodeError):
+        bc.decode_compact_u16(b"\x80\x00", 0)
+    # > 0xFFFF
+    with pytest.raises(bc.BincodeError):
+        bc.decode_compact_u16(b"\xff\xff\x7f", 0)
+
+
+def test_vec_length_guard():
+    # u64 length far beyond buffer size must fail fast, not allocate
+    evil = (2**48).to_bytes(8, "little")
+    with pytest.raises(bc.BincodeError):
+        bc.decode_vec(bc.decode_u8)(evil, 0)
+
+
+def test_string_utf8():
+    out = bytearray()
+    bc.encode_string(out, "héllo")
+    s, _ = bc.decode_string(bytes(out), 0)
+    assert s == "héllo"
+    with pytest.raises(bc.BincodeError):
+        bc.decode_string(b"\x02\x00\x00\x00\x00\x00\x00\x00\xff\xfe", 0)
+
+
+# -- known wire vectors -------------------------------------------------
+
+
+def test_fee_calculator_wire():
+    fc = gen.FeeCalculator(lamports_per_signature=5000)
+    assert fc.encode() == (5000).to_bytes(8, "little")
+
+
+def test_epoch_schedule_wire():
+    es = gen.EpochSchedule(
+        slots_per_epoch=432000, leader_schedule_slot_offset=432000,
+        warmup=False, first_normal_epoch=0, first_normal_slot=0,
+    )
+    b = es.encode()
+    assert len(b) == 8 + 8 + 1 + 8 + 8
+    assert b[16] == 0  # warmup bool
+    es2, off = gen.EpochSchedule.decode(b)
+    assert off == len(b) and es2.slots_per_epoch == 432000
+
+
+def test_enum_wire_and_bad_discriminant():
+    ss = gen.StakeState(discriminant=gen.StakeState.UNINITIALIZED)
+    assert ss.encode() == b"\x00\x00\x00\x00"
+    with pytest.raises(bc.BincodeError):
+        gen.StakeState.decode(b"\x09\x00\x00\x00")
+
+
+def test_pubkey_length_enforced():
+    acct = gen.SolanaAccount(owner=b"\x01" * 31)
+    with pytest.raises(bc.BincodeError):
+        acct.encode()
+
+
+# -- schema-driven random roundtrips ------------------------------------
+
+
+def _rand_value(ty, schema_by_name, rng):
+    if "<" in ty:
+        head, inner = ty.split("<", 1)
+        inner = inner[: inner.rfind(">")]
+        if head == "option":
+            return None if rng.random() < 0.3 else _rand_value(inner, schema_by_name, rng)
+        if head in ("vec", "short_vec"):
+            return [_rand_value(inner, schema_by_name, rng)
+                    for _ in range(rng.randrange(0, 4))]
+        if head == "array":
+            elem, n = inner.rsplit(",", 1)
+            return [_rand_value(elem.strip(), schema_by_name, rng)
+                    for _ in range(int(n))]
+    if ty.startswith("u") and ty[1:].isdigit():
+        return rng.randrange(0, 2 ** int(ty[1:]))
+    if ty.startswith("i") and ty[1:].isdigit():
+        n = int(ty[1:])
+        return rng.randrange(-(2 ** (n - 1)), 2 ** (n - 1))
+    if ty == "f64":
+        return float(rng.randrange(-(10**6), 10**6))
+    if ty == "bool":
+        return bool(rng.getrandbits(1))
+    if ty == "string":
+        return "".join(chr(rng.randrange(32, 127)) for _ in range(rng.randrange(8)))
+    if ty == "bytes":
+        return bytes(rng.randrange(256) for _ in range(rng.randrange(16)))
+    if ty in ("pubkey", "hash"):
+        return bytes(rng.randrange(256) for _ in range(32))
+    if ty == "signature":
+        return bytes(rng.randrange(256) for _ in range(64))
+    return _rand_obj(schema_by_name[ty], schema_by_name, rng)
+
+
+def _rand_obj(t, schema_by_name, rng):
+    cls = getattr(gen, _camel(t["name"]))
+    if t["kind"] == "enum":
+        i = rng.randrange(len(t["variants"]))
+        v = t["variants"][i]
+        payload = None
+        if v.get("fields"):
+            payload = tuple(
+                _rand_value(f["type"], schema_by_name, rng) for f in v["fields"]
+            )
+        return cls(discriminant=i, value=payload)
+    obj = cls()
+    for f in t["fields"]:
+        setattr(obj, f["name"], _rand_value(f["type"], schema_by_name, rng))
+    return obj
+
+
+def _eq(a, b):
+    if hasattr(a, "__dataclass_fields__"):
+        return type(a) is type(b) and all(
+            _eq(getattr(a, f), getattr(b, f)) for f in a.__dataclass_fields__
+        )
+    if isinstance(a, (list, tuple)):
+        return len(a) == len(b) and all(_eq(x, y) for x, y in zip(a, b))
+    return a == b
+
+
+def test_all_types_random_roundtrip():
+    with open(SCHEMA_PATH) as f:
+        schema = json.load(f)
+    by_name = {t["name"]: t for t in schema["types"]}
+    rng = random.Random(1234)
+    for t in schema["types"]:
+        for _ in range(20):
+            obj = _rand_obj(t, by_name, rng)
+            b = obj.encode()
+            obj2, off = type(obj).decode(b)
+            assert off == len(b), t["name"]
+            assert _eq(obj, obj2), t["name"]
+            assert obj.size() == len(b)
+
+
+def test_decode_rejects_trailing_garbage_sensitivity():
+    # decode returns consumed offset; truncated input must raise
+    es = gen.EpochSchedule(slots_per_epoch=1)
+    b = es.encode()
+    with pytest.raises(bc.BincodeError):
+        gen.EpochSchedule.decode(b[:-1])
+
+
+def test_walk_visits_leaves():
+    vs = gen.VoteLockout(slot=9, confirmation_count=3)
+    seen = {}
+    vs.walk(lambda p, v: seen.__setitem__(p, v))
+    assert seen == {"slot": 9, "confirmation_count": 3}
+    # nested struct paths
+    ha = gen.HashAge(fee_calculator=gen.FeeCalculator(lamports_per_signature=7),
+                     hash_index=1, timestamp=2)
+    seen = {}
+    ha.walk(lambda p, v: seen.__setitem__(p, v))
+    assert seen["fee_calculator.lamports_per_signature"] == 7

@@ -181,7 +181,7 @@ def test_internal_call_and_frames():
     r0, vm = run_asm(
         """
         mov64 r6, 11
-        call 2
+        call +2
         add64 r0, r6
         exit
         mov64 r6, 99
@@ -191,6 +191,32 @@ def test_internal_call_and_frames():
     )
     assert r0 == 42
     assert not vm.frames
+
+
+def test_unknown_hash_call_faults():
+    from firedancer_tpu.flamenco.vm.interp import ERR_BAD_CALL
+
+    with pytest.raises(VmError) as e:
+        run_asm("call 0x12345678\nexit")
+    assert e.value.code == ERR_BAD_CALL
+
+
+def test_static_validation_rejects_bad_regs():
+    from firedancer_tpu.flamenco.vm.interp import ERR_SIGILL
+    from firedancer_tpu.flamenco.vm.sbpf import Instr
+
+    # dst=12 on a mov64 (writes dst) must be rejected at load time
+    bad = encode_program([Instr(0xB7, 12, 0, 0, 5), Instr(0x95, 0, 0, 0, 0)])
+    with pytest.raises(VmError) as e:
+        make_vm(bad)
+    assert e.value.code == ERR_SIGILL
+    # writes to r10 (frame pointer) rejected too
+    bad = encode_program([Instr(0xB7, 10, 0, 0, 5), Instr(0x95, 0, 0, 0, 0)])
+    with pytest.raises(VmError):
+        make_vm(bad)
+    # r10 as a store base is fine (covered elsewhere); src up to r10 ok
+    ok = encode_program(asm("stdw [r10+-8], 1\nmov64 r0, 0\nexit"))
+    make_vm(ok)
 
 
 def test_call_depth_limit():
@@ -381,7 +407,7 @@ def test_loader_call_reloc_internal():
     # slot0: call helper (imm patched by reloc), slot1: exit
     # helper at slot2: mov64 r0, 55; exit
     text = encode_program(
-        asm("call -1\nexit\nmov64 r0, 55\nexit")
+        asm("call 0\nexit\nmov64 r0, 55\nexit")
     )
     text_off = 0x120
     helper_off = text_off + 2 * 8
@@ -396,7 +422,7 @@ def test_loader_call_reloc_internal():
 
 
 def test_loader_call_reloc_syscall():
-    text = encode_program(asm("call -1\nmov64 r0, 9\nexit"))
+    text = encode_program(asm("call 0\nmov64 r0, 9\nexit"))
     text_off = 0x120
     elf = build_elf(
         text,
