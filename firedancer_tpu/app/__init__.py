@@ -1,0 +1,1 @@
+"""app — operator binaries (fdctl/fddev analogs, reference src/app/)."""

@@ -1,0 +1,110 @@
+"""Operator configuration (fdctl config.c + default.toml analog).
+
+Three config tiers mirror the reference (/root/reference/src/app/fdctl/
+config.c, config/default.toml): (1) built-in defaults below; (2) an
+operator TOML file — path from the CLI or the FIREDANCER_CONFIG_TOML env
+var — whose keys override defaults; (3) the runtime pod tree published by
+`configure` that tiles query by path. Unknown TOML keys are rejected,
+as the reference's parser does, so typos fail loudly.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import tomllib
+from typing import Any, Dict, Optional
+
+ENV_CONFIG = "FIREDANCER_CONFIG_TOML"
+
+DEFAULTS: Dict[str, Any] = {
+    "name": "fd1",
+    "scratch_directory": "/tmp/firedancer_tpu",
+    "layout": {
+        # tile counts (default.toml [layout]); verify lanes are the vmap
+        # batch axis on TPU rather than N processes, but the knob remains
+        "verify_tile_count": 1,
+        "depth": 128,          # mcache depth per link
+        "mtu": 1232,           # FD_TPU_MTU
+        "wksp_sz": 1 << 24,
+    },
+    "tiles": {
+        "verify": {
+            "backend": "oracle",   # oracle | tpu
+            "batch": 128,
+            "max_msg_len": 0,      # 0 = mtu
+            "tcache_depth": 4096,
+        },
+        "pack": {
+            "bank_cnt": 4,
+        },
+        "quic": {
+            "listen_port": 0,      # 0 = ephemeral
+            "identity_seed_path": "",  # set by keygen/configure
+        },
+    },
+    "development": {
+        "synth": {
+            "txn_cnt": 64,
+            "dup_frac": 0.1,
+            "bad_frac": 0.1,
+            "seed": 42,
+        },
+        "timeout_s": 60.0,
+    },
+    "log": {
+        "path": "",            # "" = stderr only
+        "level": "INFO",
+    },
+}
+
+
+class ConfigError(Exception):
+    pass
+
+
+def _merge(base: Dict[str, Any], over: Dict[str, Any], path: str = "") -> None:
+    for k, v in over.items():
+        where = f"{path}.{k}" if path else k
+        if k not in base:
+            raise ConfigError(f"unknown config key: {where}")
+        if isinstance(base[k], dict):
+            if not isinstance(v, dict):
+                raise ConfigError(f"{where}: expected a table")
+            _merge(base[k], v, where)
+        else:
+            if isinstance(base[k], float) and isinstance(v, int) and not isinstance(v, bool):
+                v = float(v)  # int -> float widening is the one tolerated coercion
+            if type(base[k]) is not type(v):
+                raise ConfigError(
+                    f"{where}: expected {type(base[k]).__name__}, "
+                    f"got {type(v).__name__}"
+                )
+            base[k] = v
+
+
+def load_config(path: Optional[str] = None) -> Dict[str, Any]:
+    """defaults <- TOML file (arg, else $FIREDANCER_CONFIG_TOML)."""
+    cfg = copy.deepcopy(DEFAULTS)
+    path = path or os.environ.get(ENV_CONFIG) or None
+    if path:
+        with open(path, "rb") as f:
+            try:
+                over = tomllib.load(f)
+            except tomllib.TOMLDecodeError as e:
+                raise ConfigError(f"{path}: {e}") from None
+        _merge(cfg, over)
+    return cfg
+
+
+def wksp_path(cfg: Dict[str, Any]) -> str:
+    return os.path.join(cfg["scratch_directory"], f"{cfg['name']}.wksp")
+
+
+def pod_path(cfg: Dict[str, Any]) -> str:
+    return os.path.join(cfg["scratch_directory"], f"{cfg['name']}.pod")
+
+
+def identity_key_path(cfg: Dict[str, Any]) -> str:
+    p = cfg["tiles"]["quic"]["identity_seed_path"]
+    return p or os.path.join(cfg["scratch_directory"], "identity.json")

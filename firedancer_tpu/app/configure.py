@@ -1,0 +1,176 @@
+"""Idempotent configure stages (fdctl configure stage framework analog).
+
+Reference: /root/reference/src/app/fdctl/configure/configure.c — each
+stage has init/check/fini; `configure init all` walks the stages in
+order, skipping those whose check already passes; fini tears down in
+reverse. Stages here:
+
+  scratch    — the scratch directory (large_pages/shmem stand-in: on a
+               TPU host there are no hugetlbfs mounts to manage; the
+               workspace file is plain mmap-able storage)
+  keys       — ed25519 identity keypair (fdctl keygen analog)
+  workspace  — the workspace file + every ring + the pod blob
+               (workspace + frank stages: configure/frank.c:195-266)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from firedancer_tpu.app import config as cfgmod
+
+
+@dataclass
+class Stage:
+    name: str
+    init: Callable[[Dict[str, Any]], None]
+    check: Callable[[Dict[str, Any]], bool]  # True = already configured
+    fini: Callable[[Dict[str, Any]], None]
+
+
+# -- scratch ------------------------------------------------------------
+
+
+def _scratch_init(cfg) -> None:
+    os.makedirs(cfg["scratch_directory"], exist_ok=True)
+
+
+def _scratch_check(cfg) -> bool:
+    return os.path.isdir(cfg["scratch_directory"])
+
+
+def _scratch_fini(cfg) -> None:
+    shutil.rmtree(cfg["scratch_directory"], ignore_errors=True)
+
+
+# -- keys ---------------------------------------------------------------
+
+
+def keygen(path: str, seed: Optional[bytes] = None) -> bytes:
+    """Write a Solana-style keypair JSON (64 ints: seed ‖ pubkey).
+
+    fdctl keygen analog (app/fdctl/keygen.c). Returns the pubkey.
+    """
+    from firedancer_tpu.ballet import ed25519 as oracle
+
+    seed = seed if seed is not None else os.urandom(32)
+    _, _, pub = oracle.keypair_from_seed(seed)
+    with open(path, "w") as f:
+        json.dump(list(seed + pub), f)
+    os.chmod(path, 0o600)
+    return pub
+
+
+def read_keypair(path: str):
+    """(seed, pubkey) from a keypair JSON; validates the pair."""
+    from firedancer_tpu.ballet import ed25519 as oracle
+
+    with open(path) as f:
+        raw = bytes(json.load(f))
+    if len(raw) != 64:
+        raise ValueError(f"{path}: expected 64 bytes, got {len(raw)}")
+    seed, pub = raw[:32], raw[32:]
+    _, _, derived = oracle.keypair_from_seed(seed)
+    if derived != pub:
+        raise ValueError(f"{path}: pubkey does not match seed")
+    return seed, pub
+
+
+def _keys_init(cfg) -> None:
+    keygen(cfgmod.identity_key_path(cfg))
+
+
+def _keys_check(cfg) -> bool:
+    path = cfgmod.identity_key_path(cfg)
+    if not os.path.exists(path):
+        return False
+    try:
+        read_keypair(path)
+        return True
+    except (ValueError, json.JSONDecodeError):
+        return False
+
+
+def _keys_fini(cfg) -> None:
+    path = cfgmod.identity_key_path(cfg)
+    if os.path.exists(path):
+        os.unlink(path)
+
+
+# -- workspace (rings + pod) --------------------------------------------
+
+
+def _workspace_init(cfg) -> None:
+    from firedancer_tpu.disco.pipeline import build_topology
+
+    layout = cfg["layout"]
+    topo = build_topology(
+        cfgmod.wksp_path(cfg),
+        depth=layout["depth"],
+        mtu=layout["mtu"],
+        wksp_sz=layout["wksp_sz"],
+    )
+    with open(cfgmod.pod_path(cfg), "wb") as f:
+        f.write(topo.pod.serialize())
+
+
+def _workspace_check(cfg) -> bool:
+    from firedancer_tpu.utils.pod import Pod
+
+    wksp, podf = cfgmod.wksp_path(cfg), cfgmod.pod_path(cfg)
+    if not (os.path.exists(wksp) and os.path.exists(podf)):
+        return False
+    try:
+        pod = Pod.deserialize(open(podf, "rb").read())
+        return pod.query_ulong("firedancer.mtu", 0) == cfg["layout"]["mtu"]
+    except Exception:
+        return False
+
+
+def _workspace_fini(cfg) -> None:
+    for p in (cfgmod.wksp_path(cfg), cfgmod.pod_path(cfg)):
+        if os.path.exists(p):
+            os.unlink(p)
+
+
+STAGES: List[Stage] = [
+    Stage("scratch", _scratch_init, _scratch_check, _scratch_fini),
+    Stage("keys", _keys_init, _keys_check, _keys_fini),
+    Stage("workspace", _workspace_init, _workspace_check, _workspace_fini),
+]
+
+
+def configure_cmd(
+    command: str, cfg: Dict[str, Any], stages: Optional[List[str]] = None,
+    log=print,
+) -> bool:
+    """`configure {init,check,fini} [stage...|all]`. Returns success."""
+    sel = [s for s in STAGES if stages is None or s.name in stages]
+    if stages is not None:
+        unknown = set(stages) - {s.name for s in STAGES}
+        if unknown:
+            raise ValueError(f"unknown stages: {sorted(unknown)}")
+    ok = True
+    if command == "init":
+        for s in sel:
+            if s.check(cfg):
+                log(f"configure: {s.name}: already configured, skipping")
+            else:
+                log(f"configure: {s.name}: init")
+                s.init(cfg)
+    elif command == "check":
+        for s in sel:
+            good = s.check(cfg)
+            log(f"configure: {s.name}: {'ok' if good else 'NOT configured'}")
+            ok &= good
+    elif command == "fini":
+        for s in reversed(sel):
+            log(f"configure: {s.name}: fini")
+            s.fini(cfg)
+    else:
+        raise ValueError(f"bad configure command {command!r}")
+    return ok

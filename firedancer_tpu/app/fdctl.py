@@ -1,0 +1,173 @@
+"""fdctl — production CLI (reference: app/fdctl/main.c command table).
+
+  fdctl [--config cfg.toml] configure {init,check,fini} [stage...|all]
+  fdctl [--config cfg.toml] run [--source {synth,pcap}] [--pcap FILE]
+  fdctl [--config cfg.toml] monitor [--once] [--interval S]
+  fdctl [--config cfg.toml] keygen [--out PATH]
+
+`run` drives the tile pipeline (source -> verify -> dedup -> pack ->
+sink) against the workspace/pod created by `configure init all` and
+prints a JSON result line. The synthetic source mirrors the reference's
+synth-load harness (frank/load/fd_frank_verify_synth_load.c: duplicate
+and corrupt-signature fractions are configurable in [development.synth]).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+from firedancer_tpu.app import config as cfgmod
+from firedancer_tpu.app.configure import STAGES, configure_cmd, keygen
+
+
+def synth_payloads(cfg: Dict[str, Any]) -> List[bytes]:
+    """Synthetic transaction load from [development.synth]."""
+    import numpy as np
+
+    from firedancer_tpu.ballet.txn import build_txn
+
+    s = cfg["development"]["synth"]
+    rng = np.random.RandomState(s["seed"])
+    n = s["txn_cnt"]
+    txns = []
+    for i in range(n):
+        txns.append(
+            build_txn(
+                signer_seeds=[bytes([i & 0xFF, (i >> 8) & 0xFF, s["seed"] & 0xFF]) + bytes(29)],
+                extra_accounts=[rng.randint(0, 256, 32, dtype=np.uint8).tobytes()],
+                n_readonly_unsigned=1,
+                instrs=[(1, [0], b"synth%d" % i)],
+                recent_blockhash=rng.randint(0, 256, 32, dtype=np.uint8).tobytes(),
+            )
+        )
+    out = list(txns)
+    out += [txns[int(rng.randint(0, n))] for _ in range(int(n * s["dup_frac"]))]
+    for _ in range(int(n * s["bad_frac"])):
+        t = bytearray(txns[int(rng.randint(0, n))])
+        t[5] ^= 0xFF  # corrupt a signature byte
+        out.append(bytes(t))
+    return out
+
+
+def _load_topo(cfg: Dict[str, Any]):
+    from firedancer_tpu.disco.pipeline import Topology
+    from firedancer_tpu.utils.pod import Pod
+
+    with open(cfgmod.pod_path(cfg), "rb") as f:
+        pod = Pod.deserialize(f.read())
+    return Topology(
+        wksp_path=cfgmod.wksp_path(cfg),
+        depth=cfg["layout"]["depth"],
+        mtu=cfg["layout"]["mtu"],
+        pod=pod,
+    )
+
+
+def cmd_run(cfg: Dict[str, Any], args) -> int:
+    from firedancer_tpu.disco.pipeline import run_pipeline
+
+    if args.source == "synth":
+        payloads = synth_payloads(cfg)
+    elif args.source == "pcap":
+        if not args.pcap:
+            print("run --source pcap requires --pcap FILE", file=sys.stderr)
+            return 1
+        from firedancer_tpu.utils.pcap import PcapReader
+
+        payloads = [pkt for _, _, pkt in PcapReader(args.pcap)]
+    else:
+        print(f"unknown source {args.source!r}", file=sys.stderr)
+        return 1
+
+    tiles_cfg = cfg["tiles"]
+    res = run_pipeline(
+        _load_topo(cfg),
+        payloads,
+        verify_backend=tiles_cfg["verify"]["backend"],
+        verify_batch=tiles_cfg["verify"]["batch"],
+        verify_max_msg_len=tiles_cfg["verify"]["max_msg_len"] or None,
+        bank_cnt=tiles_cfg["pack"]["bank_cnt"],
+        timeout_s=cfg["development"]["timeout_s"],
+    )
+    print(json.dumps({
+        "sent": len(payloads),
+        "recv_cnt": res.recv_cnt,
+        "recv_sz": res.recv_sz,
+        "bank_hist": {str(k): v for k, v in sorted(res.bank_hist.items())},
+        "elapsed_s": round(res.elapsed_s, 3),
+        "verify_sv_filt": res.diag.get("tile.verify", {}).get("sv_filt_cnt", 0),
+        "verify_ha_filt": res.diag.get("tile.verify", {}).get("ha_filt_cnt", 0),
+    }))
+    return 0
+
+
+def cmd_monitor(cfg: Dict[str, Any], args) -> int:
+    from firedancer_tpu.disco.monitor import render, snapshot, watch
+    from firedancer_tpu.tango.rings import Workspace
+
+    topo = _load_topo(cfg)
+    wksp = Workspace.join(topo.wksp_path)
+    try:
+        if args.once:
+            print(render(snapshot(wksp, topo.pod), ansi=not args.no_ansi))
+        else:
+            watch(wksp, topo.pod, interval_s=args.interval,
+                  iterations=args.iters or 0)
+    finally:
+        wksp.leave()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="fdctl")
+    p.add_argument("--config", help="operator TOML (or $FIREDANCER_CONFIG_TOML)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pc = sub.add_parser("configure")
+    pc.add_argument("action", choices=("init", "check", "fini"))
+    pc.add_argument("stages", nargs="*", default=[],
+                    help=f"stages ({', '.join(s.name for s in STAGES)}) or 'all'")
+
+    pr = sub.add_parser("run")
+    pr.add_argument("--source", default="synth", choices=("synth", "pcap"))
+    pr.add_argument("--pcap")
+
+    pm = sub.add_parser("monitor")
+    pm.add_argument("--once", action="store_true")
+    pm.add_argument("--no-ansi", action="store_true")
+    pm.add_argument("--interval", type=float, default=1.0)
+    pm.add_argument("--iters", type=int, default=None)
+
+    pk = sub.add_parser("keygen")
+    pk.add_argument("--out", default=None)
+
+    args = p.parse_args(argv)
+    cfg = cfgmod.load_config(args.config)
+
+    if args.cmd == "configure":
+        stages = None if (not args.stages or args.stages == ["all"]) else args.stages
+        ok = configure_cmd(args.action, cfg, stages)
+        return 0 if ok else 1
+    if args.cmd == "run":
+        return cmd_run(cfg, args)
+    if args.cmd == "monitor":
+        return cmd_monitor(cfg, args)
+    if args.cmd == "keygen":
+        import os
+
+        path = args.out or cfgmod.identity_key_path(cfg)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        pub = keygen(path)
+        print(f"wrote {path} (pubkey {pub.hex()})")
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:  # stdout piped into head etc.
+        raise SystemExit(0)

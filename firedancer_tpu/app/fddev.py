@@ -1,0 +1,41 @@
+"""fddev — developer CLI (reference: app/fddev/dev.c:31-51).
+
+`fddev dev` = configure init all + run in one step, against a throwaway
+scratch directory by default — the reference's one-command dev loop
+(its netns/cluster stages are kernel/cluster-specific; the TPU-native
+dev loop exercises the same tile graph with the synthetic load).
+
+  fddev [--config cfg.toml] dev [--source {synth,pcap}] [--pcap FILE] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from firedancer_tpu.app import config as cfgmod
+from firedancer_tpu.app import fdctl
+from firedancer_tpu.app.configure import configure_cmd
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="fddev")
+    p.add_argument("--config")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pd = sub.add_parser("dev")
+    pd.add_argument("--source", default="synth", choices=("synth", "pcap"))
+    pd.add_argument("--pcap")
+    pd.add_argument("--keep", action="store_true",
+                    help="keep the workspace after the run")
+    args = p.parse_args(argv)
+
+    cfg = cfgmod.load_config(args.config)
+    configure_cmd("init", cfg, None)
+    try:
+        return fdctl.cmd_run(cfg, args)
+    finally:
+        if not args.keep:
+            configure_cmd("fini", cfg, None)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -48,31 +48,23 @@ def _parse_ty(ty: str) -> Tuple[str, ...]:
     return ("struct", ty)
 
 
-def _dec_expr(ty: str, known: Dict[str, str]) -> str:
-    """Expression decoding type `ty` from (buf, off): evaluates to
-    '(value, off)'."""
-    kind = _parse_ty(ty)
-    if kind[0] == "prim":
-        return f"bc.decode_{kind[1]}(buf, off)"
-    if kind[0] == "fixed":
-        return f"bc.decode_{kind[1]}(buf, off)"
-    if kind[0] == "option":
-        return f"bc.decode_option(lambda b, o: {_dec_lambda(kind[1], known)}(b, o))(buf, off)"
-    if kind[0] == "vec":
-        return f"bc.decode_vec(lambda b, o: {_dec_lambda(kind[1], known)}(b, o))(buf, off)"
-    if kind[0] == "short_vec":
-        return f"bc.decode_short_vec(lambda b, o: {_dec_lambda(kind[1], known)}(b, o))(buf, off)"
-    if kind[0] == "array":
-        return f"_decode_array(lambda b, o: {_dec_lambda(kind[1], known)}(b, o), {kind[2]})(buf, off)"
-    if kind[0] == "struct":
-        if kind[1] not in known:
-            raise ValueError(f"unknown type {ty!r}")
-        return f"{known[kind[1]]}.decode(buf, off)"
-    raise ValueError(f"bad type {ty!r}")
+# Composed combinator decoders are hoisted to module-level constants
+# (built once at import, after all classes are defined) instead of being
+# rebuilt per decode call. _consts maps the building expression to its
+# constant name; generate() resets it per run and emits the table last.
+_consts: Dict[str, str] = {}
 
 
-def _dec_lambda(ty: str, known: Dict[str, str]) -> str:
-    """Callable expression for inner decoders."""
+def _const(expr: str) -> str:
+    name = _consts.get(expr)
+    if name is None:
+        name = f"_D{len(_consts)}"
+        _consts[expr] = name
+    return name
+
+
+def _dec_callable(ty: str, known: Dict[str, str]) -> str:
+    """Callable expression decoding type `ty`: f(buf, off)->(value, off)."""
     kind = _parse_ty(ty)
     if kind[0] in ("prim", "fixed"):
         return f"bc.decode_{kind[1]}"
@@ -80,8 +72,22 @@ def _dec_lambda(ty: str, known: Dict[str, str]) -> str:
         if kind[1] not in known:
             raise ValueError(f"unknown type {ty!r}")
         return f"{known[kind[1]]}.decode"
-    # nested combinator: wrap via the expr form
-    return f"(lambda b, o: {_dec_expr(ty, known)})"
+    if kind[0] == "option":
+        return _const(f"bc.decode_option({_dec_callable(kind[1], known)})")
+    if kind[0] == "vec":
+        return _const(f"bc.decode_vec({_dec_callable(kind[1], known)})")
+    if kind[0] == "short_vec":
+        return _const(f"bc.decode_short_vec({_dec_callable(kind[1], known)})")
+    if kind[0] == "array":
+        return _const(
+            f"_decode_array({_dec_callable(kind[1], known)}, {kind[2]})"
+        )
+    raise ValueError(f"bad type {ty!r}")
+
+
+def _dec_expr(ty: str, known: Dict[str, str]) -> str:
+    """Expression decoding type `ty` from (buf, off) to '(value, off)'."""
+    return f"{_dec_callable(ty, known)}(buf, off)"
 
 
 def _enc_stmts(ty: str, val: str, known: Dict[str, str], indent: str) -> List[str]:
@@ -209,14 +215,23 @@ def _gen_enum(t: dict, known: Dict[str, str]) -> List[str]:
           "            raise bc.BincodeError("
           f"f'bad {t['name']} discriminant {{self.discriminant}}')",
           "        return self, off"]
-    # encode
+    # encode (strict: unknown discriminant / missing payload raise, the
+    # mirror of decode's discriminant check)
     L += ["", "    def encode_into(self, out):",
+          f"        if not 0 <= self.discriminant < {len(t['variants'])}:",
+          "            raise bc.BincodeError("
+          f"f'bad {t['name']} discriminant {{self.discriminant}}')",
           "        bc.encode_u32(out, self.discriminant)"]
     for i, v in enumerate(t["variants"]):
         fields = v.get("fields", [])
         if not fields:
             continue
         L.append(f"        if self.discriminant == {i}:")
+        L.append(f"            if self.value is None or len(self.value) != {len(fields)}:")
+        L.append(
+            f"                raise bc.BincodeError('{t['name']} variant "
+            f"{v['name']} needs a {len(fields)}-tuple payload')"
+        )
         for j, f in enumerate(fields):
             L += _enc_stmts(f["type"], f"self.value[{j}]", known, "            ")
     L += ["", "    def encode(self):", "        out = bytearray()",
@@ -231,6 +246,7 @@ def _gen_enum(t: dict, known: Dict[str, str]) -> List[str]:
 
 
 def generate(schema: dict) -> str:
+    _consts.clear()
     known: Dict[str, str] = {}
     body: List[str] = []
     for t in schema["types"]:
@@ -242,6 +258,11 @@ def generate(schema: dict) -> str:
             body += _gen_enum(t, known)
         else:
             raise ValueError(f"bad kind {t['kind']!r}")
+    if _consts:
+        body += ["", "",
+                 "# composed decoders, built once at import "
+                 "(classes above are defined by now)"]
+        body += [f"{name} = {expr}" for expr, name in _consts.items()]
     all_names = ", ".join(f'"{known[t["name"]]}"' for t in schema["types"])
     header = [
         '"""GENERATED by firedancer_tpu.flamenco.types.gen — DO NOT EDIT.',

@@ -152,8 +152,8 @@ class Vm:
             writes_dst = cls in (CLS_ALU, CLS_ALU64, CLS_LDX, CLS_LD)
             if ins.dst > (9 if writes_dst else 10):
                 raise VmError(ERR_SIGILL, f"pc={i}: dst r{ins.dst}")
-            if ins.opcode == OP_CALLX and (ins.imm & 0xF) > 10:
-                raise VmError(ERR_SIGILL, f"pc={i}: callx r{ins.imm & 0xF}")
+            if ins.opcode == OP_CALLX and ins.imm > 10:
+                raise VmError(ERR_SIGILL, f"pc={i}: callx r{ins.imm}")
 
     # -- syscall registration -------------------------------------------
 
@@ -268,7 +268,7 @@ class Vm:
                     self._call_imm(ins)  # manages pc itself
                     continue
                 elif op == OP_CALLX:
-                    self._call_pc(reg[ins.imm & 0xF])
+                    self._call_pc(reg[ins.imm])
                     continue
                 elif op == OP_EXIT:
                     if not self.frames:
@@ -394,17 +394,12 @@ class Vm:
         self.reg[10] += STACK_FRAME_SZ
 
     def _call_imm(self, ins: Instr) -> None:
-        # src distinguishes the two call forms (as in the reference/rbpf):
-        # src=1 -> pc-relative internal call (imm = signed slot delta);
-        # src=0 -> imm is a murmur3 hash: syscall, else calldests entry.
-        if ins.src == 1:
-            delta = ins.imm if ins.imm < (1 << 31) else ins.imm - (1 << 32)
-            target = self.pc + 1 + delta
-            if not (0 <= target < self.text_cnt):
-                raise VmError(ERR_BAD_CALL, f"rel imm=0x{ins.imm:x}")
-            self._push_frame()
-            self.pc = target
-            return
+        # imm is a murmur3 hash: syscall, else calldests entry (the
+        # reference's hash-based call ABI). Compilers emit internal calls
+        # with src=1 (BPF_PSEUDO_CALL) but the loader still patches imm
+        # to a registered pc hash, so the hash lookup runs first; the
+        # pc-relative interpretation (imm = signed slot delta) is the
+        # src=1 fallback for hand-assembled programs.
         h = ins.imm
         sc = self.syscalls.get(h)
         if sc is not None:
@@ -414,6 +409,11 @@ class Vm:
             self.pc += 1
             return
         target = self.calldests.get(h)
+        if target is None and ins.src == 1:
+            delta = ins.imm if ins.imm < (1 << 31) else ins.imm - (1 << 32)
+            target = self.pc + 1 + delta
+            if not (0 <= target < self.text_cnt):
+                raise VmError(ERR_BAD_CALL, f"rel imm=0x{ins.imm:x}")
         if target is None:
             raise VmError(ERR_BAD_CALL, f"imm=0x{ins.imm:x}")
         self._push_frame()

@@ -1,0 +1,166 @@
+"""app layer tests: config tiers, configure stages, fdctl/fddev CLIs
+(reference: app/fdctl config.c + configure.c + run flow)."""
+
+import json
+import os
+
+import pytest
+
+from firedancer_tpu.app import config as cfgmod
+from firedancer_tpu.app.configure import (
+    STAGES,
+    configure_cmd,
+    keygen,
+    read_keypair,
+)
+from firedancer_tpu.app import fdctl, fddev
+
+
+@pytest.fixture
+def cfg(tmp_path):
+    c = cfgmod.load_config()
+    c["scratch_directory"] = str(tmp_path / "scratch")
+    c["layout"]["depth"] = 64
+    c["layout"]["wksp_sz"] = 1 << 22
+    c["development"]["synth"]["txn_cnt"] = 12
+    c["development"]["timeout_s"] = 60.0
+    return c
+
+
+def test_config_defaults_and_toml_override(tmp_path):
+    toml = tmp_path / "op.toml"
+    toml.write_text(
+        'name = "x9"\n[layout]\nverify_tile_count = 4\n'
+        '[tiles.verify]\nbackend = "tpu"\n'
+    )
+    cfg = cfgmod.load_config(str(toml))
+    assert cfg["name"] == "x9"
+    assert cfg["layout"]["verify_tile_count"] == 4
+    assert cfg["tiles"]["verify"]["backend"] == "tpu"
+    # untouched defaults survive
+    assert cfg["tiles"]["pack"]["bank_cnt"] == 4
+
+
+def test_config_env_override(tmp_path, monkeypatch):
+    toml = tmp_path / "env.toml"
+    toml.write_text('name = "fromenv"\n')
+    monkeypatch.setenv(cfgmod.ENV_CONFIG, str(toml))
+    assert cfgmod.load_config()["name"] == "fromenv"
+
+
+def test_config_rejects_unknown_key(tmp_path):
+    toml = tmp_path / "bad.toml"
+    toml.write_text("[layout]\nnot_a_knob = 1\n")
+    with pytest.raises(cfgmod.ConfigError, match="layout.not_a_knob"):
+        cfgmod.load_config(str(toml))
+
+
+def test_keygen_roundtrip(tmp_path):
+    path = str(tmp_path / "id.json")
+    pub = keygen(path, seed=b"\x07" * 32)
+    seed, pub2 = read_keypair(path)
+    assert pub == pub2 and seed == b"\x07" * 32
+    # corrupted file rejected
+    raw = json.load(open(path))
+    raw[40] ^= 0xFF
+    json.dump(raw, open(path, "w"))
+    with pytest.raises(ValueError):
+        read_keypair(path)
+
+
+def test_configure_init_check_fini(cfg):
+    logs = []
+    assert not configure_cmd("check", cfg, None, log=logs.append)
+    configure_cmd("init", cfg, None, log=logs.append)
+    assert configure_cmd("check", cfg, None, log=logs.append)
+    assert os.path.exists(cfgmod.wksp_path(cfg))
+    assert os.path.exists(cfgmod.pod_path(cfg))
+    read_keypair(cfgmod.identity_key_path(cfg))
+    # init again: all stages skip
+    logs.clear()
+    configure_cmd("init", cfg, None, log=logs.append)
+    assert all("skipping" in l for l in logs)
+    configure_cmd("fini", cfg, None, log=logs.append)
+    assert not os.path.exists(cfgmod.wksp_path(cfg))
+
+
+def test_configure_stage_selection(cfg):
+    configure_cmd("init", cfg, ["scratch", "keys"])
+    assert os.path.exists(cfgmod.identity_key_path(cfg))
+    assert not os.path.exists(cfgmod.wksp_path(cfg))
+    with pytest.raises(ValueError, match="unknown stages"):
+        configure_cmd("init", cfg, ["bogus"])
+
+
+def test_fdctl_run_synth_end_to_end(cfg, capsys, monkeypatch, tmp_path):
+    # write the cfg as TOML so the CLI path (load_config) is exercised
+    toml = tmp_path / "cli.toml"
+    toml.write_text(
+        f'scratch_directory = "{cfg["scratch_directory"]}"\n'
+        "[layout]\ndepth = 64\nwksp_sz = 4194304\n"
+        "[development]\ntimeout_s = 60.0\n"
+        "[development.synth]\ntxn_cnt = 12\ndup_frac = 0.25\nbad_frac = 0.25\n"
+    )
+    assert fdctl.main(["--config", str(toml), "configure", "init", "all"]) == 0
+    assert fdctl.main(["--config", str(toml), "run", "--source", "synth"]) == 0
+    out = capsys.readouterr().out
+    res = json.loads(out.strip().splitlines()[-1])
+    assert res["sent"] == 12 + 3 + 3
+    assert res["recv_cnt"] == 12          # dups + bad filtered
+    assert res["verify_sv_filt"] >= 3
+    assert res["verify_ha_filt"] >= 3
+    # monitor one-shot renders tiles and links
+    assert fdctl.main(["--config", str(toml), "monitor", "--once",
+                       "--no-ansi"]) == 0
+    mon = capsys.readouterr().out
+    assert "tile.verify" in mon or "verify" in mon
+    assert fdctl.main(["--config", str(toml), "configure", "fini", "all"]) == 0
+
+
+def test_fdctl_run_pcap_source(cfg, capsys, tmp_path):
+    from firedancer_tpu.utils.pcap import PcapWriter
+
+    payloads = fdctl.synth_payloads(cfg)[:8]
+    pcap = str(tmp_path / "txs.pcap")
+    with PcapWriter(pcap) as w:
+        for pl in payloads:
+            w.write(pl)
+    configure_cmd("init", cfg, None)
+    try:
+        assert fdctl.cmd_run(
+            cfg,
+            type("A", (), {"source": "pcap", "pcap": pcap})(),
+        ) == 0
+        res = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert res["sent"] == 8 and res["recv_cnt"] == 8
+    finally:
+        configure_cmd("fini", cfg, None)
+
+
+def test_fddev_dev_one_command(cfg, capsys, tmp_path, monkeypatch):
+    toml = tmp_path / "dev.toml"
+    toml.write_text(
+        f'scratch_directory = "{cfg["scratch_directory"]}"\n'
+        "[layout]\ndepth = 64\nwksp_sz = 4194304\n"
+        "[development]\ntimeout_s = 60.0\n"
+        "[development.synth]\ntxn_cnt = 6\ndup_frac = 0.0\nbad_frac = 0.0\n"
+    )
+    assert fddev.main(["--config", str(toml), "dev"]) == 0
+    out = capsys.readouterr().out
+    res = json.loads(next(l for l in out.splitlines() if l.startswith("{")))
+    assert res["recv_cnt"] == 6
+    # --keep off by default: workspace cleaned up
+    assert not os.path.exists(cfgmod.wksp_path(cfg))
+
+
+def test_config_rejects_type_mismatch(tmp_path):
+    toml = tmp_path / "mistyped.toml"
+    toml.write_text("[layout]\ndepth = true\n")
+    with pytest.raises(cfgmod.ConfigError, match="expected int"):
+        cfgmod.load_config(str(toml))
+    toml.write_text("name = 42\n")
+    with pytest.raises(cfgmod.ConfigError, match="expected str"):
+        cfgmod.load_config(str(toml))
+    # int -> float widening allowed
+    toml.write_text("[development]\ntimeout_s = 5\n")
+    assert cfgmod.load_config(str(toml))["development"]["timeout_s"] == 5.0

@@ -205,3 +205,11 @@ def test_walk_visits_leaves():
     seen = {}
     ha.walk(lambda p, v: seen.__setitem__(p, v))
     assert seen["fee_calculator.lamports_per_signature"] == 7
+
+
+def test_enum_encode_strict():
+    with pytest.raises(bc.BincodeError):
+        gen.StakeState(discriminant=99).encode()
+    # fields-variant without payload raises BincodeError, not TypeError
+    with pytest.raises(bc.BincodeError):
+        gen.StakeState(discriminant=gen.StakeState.INITIALIZED).encode()

@@ -459,3 +459,33 @@ def test_loader_rejects_garbage():
         load_program(b"not an elf")
     with pytest.raises(SbpfLoaderError):
         load_program(b"\x7fELF" + b"\0" * 100)
+
+
+def test_loader_internal_call_with_pseudo_call_src():
+    """Compiler-emitted internal calls keep src=1 after relocation; the
+    hash lookup must still win over the relative fallback."""
+    from firedancer_tpu.flamenco.vm.sbpf import Instr
+
+    # call (src=1, imm patched by reloc) ; exit ; helper: mov64 r0,55 ; exit
+    instrs = [Instr(0x85, 0, 1, 0, 0), Instr(0x95, 0, 0, 0, 0),
+              Instr(0xB7, 0, 0, 0, 55), Instr(0x95, 0, 0, 0, 0)]
+    text = encode_program(instrs)
+    text_off = 0x120
+    helper_off = text_off + 2 * 8
+    elf = build_elf(
+        text,
+        syms=[(b"helper", helper_off, True, True)],
+        rels=[(text_off + 0, R_BPF_64_32, 1)],
+    )
+    prog = load_program(elf)
+    assert prog.make_vm().run() == 55
+
+
+def test_callx_reg_out_of_range_rejected():
+    from firedancer_tpu.flamenco.vm.interp import ERR_SIGILL
+    from firedancer_tpu.flamenco.vm.sbpf import Instr, OP_CALLX
+
+    bad = encode_program([Instr(OP_CALLX, 0, 0, 0, 16), Instr(0x95, 0, 0, 0, 0)])
+    with pytest.raises(VmError) as e:
+        make_vm(bad)
+    assert e.value.code == ERR_SIGILL
