@@ -39,8 +39,7 @@ DEFAULTS: Dict[str, Any] = {
             "bank_cnt": 4,
         },
         "quic": {
-            "listen_port": 0,      # 0 = ephemeral
-            "identity_seed_path": "",  # set by keygen/configure
+            "identity_seed_path": "",  # "" = generated under scratch
         },
     },
     "development": {
@@ -51,10 +50,6 @@ DEFAULTS: Dict[str, Any] = {
             "seed": 42,
         },
         "timeout_s": 60.0,
-    },
-    "log": {
-        "path": "",            # "" = stderr only
-        "level": "INFO",
     },
 }
 

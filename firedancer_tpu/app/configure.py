@@ -44,7 +44,12 @@ def _scratch_check(cfg) -> bool:
 
 
 def _scratch_fini(cfg) -> None:
-    shutil.rmtree(cfg["scratch_directory"], ignore_errors=True)
+    # Only remove the directory itself (and only if the other stages left
+    # it empty) — never recursively delete an operator-pointed path.
+    try:
+        os.rmdir(cfg["scratch_directory"])
+    except OSError:
+        pass
 
 
 # -- keys ---------------------------------------------------------------
@@ -81,7 +86,12 @@ def read_keypair(path: str):
 
 
 def _keys_init(cfg) -> None:
-    keygen(cfgmod.identity_key_path(cfg))
+    path = cfgmod.identity_key_path(cfg)
+    if os.path.exists(path):
+        # exists but failed check: refuse to overwrite what we didn't make
+        raise ValueError(f"{path}: exists but is not a valid keypair; "
+                         "remove it or point identity_seed_path elsewhere")
+    keygen(path)
 
 
 def _keys_check(cfg) -> bool:
@@ -96,6 +106,10 @@ def _keys_check(cfg) -> bool:
 
 
 def _keys_fini(cfg) -> None:
+    # Operator-provided keys (identity_seed_path set in the TOML) are not
+    # ours to delete; only the default generated identity is removed.
+    if cfg["tiles"]["quic"]["identity_seed_path"]:
+        return
     path = cfgmod.identity_key_path(cfg)
     if os.path.exists(path):
         os.unlink(path)
@@ -113,6 +127,7 @@ def _workspace_init(cfg) -> None:
         depth=layout["depth"],
         mtu=layout["mtu"],
         wksp_sz=layout["wksp_sz"],
+        verify_lanes=layout["verify_tile_count"],
     )
     with open(cfgmod.pod_path(cfg), "wb") as f:
         f.write(topo.pod.serialize())
@@ -126,7 +141,16 @@ def _workspace_check(cfg) -> bool:
         return False
     try:
         pod = Pod.deserialize(open(podf, "rb").read())
-        return pod.query_ulong("firedancer.mtu", 0) == cfg["layout"]["mtu"]
+        layout = cfg["layout"]
+        # every layout knob recorded in the pod must match, or a config
+        # edit + re-init would silently keep the stale topology
+        return (
+            pod.query_ulong("firedancer.mtu", 0) == layout["mtu"]
+            and pod.query_ulong("firedancer.replay_verify.depth", 0)
+            == layout["depth"]
+            and pod.query_ulong("firedancer.layout.verify_lane_cnt", 0)
+            == layout["verify_tile_count"]
+        )
     except Exception:
         return False
 

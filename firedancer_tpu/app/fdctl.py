@@ -91,6 +91,7 @@ def cmd_run(cfg: Dict[str, Any], args) -> int:
         verify_max_msg_len=tiles_cfg["verify"]["max_msg_len"] or None,
         bank_cnt=tiles_cfg["pack"]["bank_cnt"],
         timeout_s=cfg["development"]["timeout_s"],
+        tcache_depth=tiles_cfg["verify"]["tcache_depth"],
     )
     print(json.dumps({
         "sent": len(payloads),

@@ -32,13 +32,23 @@ from firedancer_tpu.utils.pod import Pod
 _SIGNAL_NAMES = {0: "boot", 1: "run", 2: "halt", 3: "fail"}
 
 
+def _walk_objects(tree: dict, prefix: str = ""):
+    """Yield (dotted_name, subdict) for every nested pod node that names a
+    cnc or link (lane links like replay_verify.v1 nest one level down)."""
+    for name, sub in sorted(tree.items()):
+        if not isinstance(sub, dict):
+            continue
+        dotted = f"{prefix}.{name}" if prefix else name
+        if "cnc" in sub or "fseq" in sub:
+            yield dotted, sub
+        yield from _walk_objects(sub, dotted)
+
+
 def snapshot(wksp: Workspace, pod: Pod) -> Dict[str, Dict[str, int]]:
     """One diag snapshot of every tile cnc + link fseq named in the pod."""
     out: Dict[str, Dict[str, int]] = {}
     fd = pod.subpod("firedancer")
-    for name, sub in sorted(fd.to_dict().items()):
-        if not isinstance(sub, dict):
-            continue
+    for name, sub in _walk_objects(fd.to_dict()):
         if "cnc" in sub:
             cnc = Cnc(wksp, sub["cnc"])
             from firedancer_tpu.disco.tiles import (

@@ -54,27 +54,45 @@ class Topology:
     pod: Pod = field(default_factory=Pod)
 
 
+def lane_link(link: str, lane: int) -> str:
+    """Pod/wksp name of a per-lane link: lane 0 keeps the unsuffixed name,
+    lane i>0 is `<link>.v<i>` (configure/frank.c's verify.v%i naming)."""
+    return link if lane == 0 else f"{link}.v{lane}"
+
+
 def build_topology(
     wksp_path: str, depth: int = 128, mtu: int = FD_TPU_MTU,
-    wksp_sz: int = 1 << 24,
+    wksp_sz: int = 1 << 24, verify_lanes: int = 1,
 ) -> Topology:
-    """Create workspace + all rings; record names/params in the pod."""
+    """Create workspace + all rings; record names/params in the pod.
+
+    verify_lanes > 1 adds per-lane replay_verify/verify_dedup links and
+    verify cncs (the reference's verify_tile_count data parallelism,
+    configure/frank.c:215-224): source fans out round-robin, dedup muxes
+    the lanes back in.
+    """
     topo = Topology(wksp_path=wksp_path, depth=depth, mtu=mtu)
     wksp = Workspace.create(wksp_path, wksp_sz)
     mtu_chunks = (mtu + 63) // 64
     dcache_sz = 64 * mtu_chunks * (depth + 2)  # room for depth in-flight frags
-    for link in LINKS:
-        MCache(wksp, f"{link}.mcache", depth=depth, create=True)
-        DCache(wksp, f"{link}.dcache", data_sz=dcache_sz, create=True)
-        FSeq(wksp, f"{link}.fseq", create=True)
-        topo.pod.insert_cstr(f"firedancer.{link}.mcache", f"{link}.mcache")
-        topo.pod.insert_cstr(f"firedancer.{link}.dcache", f"{link}.dcache")
-        topo.pod.insert_cstr(f"firedancer.{link}.fseq", f"{link}.fseq")
-        topo.pod.insert_ulong(f"firedancer.{link}.depth", depth)
-    for tile in TILES:
+    links = [(l, 0) for l in LINKS]
+    links += [(l, i) for l in ("replay_verify", "verify_dedup")
+              for i in range(1, verify_lanes)]
+    for link, lane in links:
+        name = lane_link(link, lane)
+        MCache(wksp, f"{name}.mcache", depth=depth, create=True)
+        DCache(wksp, f"{name}.dcache", data_sz=dcache_sz, create=True)
+        FSeq(wksp, f"{name}.fseq", create=True)
+        topo.pod.insert_cstr(f"firedancer.{name}.mcache", f"{name}.mcache")
+        topo.pod.insert_cstr(f"firedancer.{name}.dcache", f"{name}.dcache")
+        topo.pod.insert_cstr(f"firedancer.{name}.fseq", f"{name}.fseq")
+        topo.pod.insert_ulong(f"firedancer.{name}.depth", depth)
+    tiles = list(TILES) + [f"verify.v{i}" for i in range(1, verify_lanes)]
+    for tile in tiles:
         Cnc(wksp, f"{tile}.cnc", create=True)
         topo.pod.insert_cstr(f"firedancer.{tile}.cnc", f"{tile}.cnc")
     topo.pod.insert_ulong("firedancer.mtu", mtu)
+    topo.pod.insert_ulong("firedancer.layout.verify_lane_cnt", verify_lanes)
     wksp.leave()
     return topo
 
@@ -94,10 +112,16 @@ def _make_out_link(wksp, pod: Pod, link: str, consumer_fseq_link: str,
     return OutLink(wksp, _link_names(pod, link), mtu=mtu, reliable_fseqs=[fs])
 
 
-def _make_source_out_link(wksp, pod: Pod) -> OutLink:
-    """The pipeline source's out link (replay_verify, self-consumed fseq)."""
+def _make_source_out_link(wksp, pod: Pod, lane: int = 0) -> OutLink:
+    """A pipeline source's out link (replay_verify lane, self-consumed fseq)."""
     mtu = pod.query_ulong("firedancer.mtu", FD_TPU_MTU)
-    return _make_out_link(wksp, pod, "replay_verify", "replay_verify", mtu)
+    name = lane_link("replay_verify", lane)
+    return _make_out_link(wksp, pod, name, name, mtu)
+
+
+def _make_source_out_links(wksp, pod: Pod) -> List[OutLink]:
+    lanes = pod.query_ulong("firedancer.layout.verify_lane_cnt", 1)
+    return [_make_source_out_link(wksp, pod, i) for i in range(lanes)]
 
 
 @dataclass
@@ -120,6 +144,7 @@ def _run_tiles(
     bank_cnt: int,
     timeout_s: float,
     pre_wait=None,
+    tcache_depth: int = 4096,
 ) -> PipelineResult:
     """Shared runner: wire source -> verify -> dedup -> pack -> sink, drive
     the tiles on threads until quiescence or timeout, HALT, snapshot.
@@ -130,6 +155,7 @@ def _run_tiles(
     and returns a cleanup callable invoked after HALT.
     """
     mtu = pod.query_ulong("firedancer.mtu", FD_TPU_MTU)
+    lanes = pod.query_ulong("firedancer.layout.verify_lane_cnt", 1)
 
     def in_link(link):
         return InLink(wksp, _link_names(pod, link))
@@ -137,17 +163,24 @@ def _run_tiles(
     def out_link(link, consumer_fseq_link):
         return _make_out_link(wksp, pod, link, consumer_fseq_link, mtu)
 
-    verify = VerifyTile(
-        wksp, pod.query_cstr("firedancer.verify.cnc"),
-        in_link=in_link("replay_verify"),
-        out_link=out_link("verify_dedup", "verify_dedup"),
-        backend=verify_backend, batch=verify_batch,
-        max_msg_len=verify_max_msg_len or mtu,
-    )
+    verifies = [
+        VerifyTile(
+            wksp,
+            pod.query_cstr(f"firedancer.{lane_link('verify', i)}.cnc"),
+            in_link=in_link(lane_link("replay_verify", i)),
+            out_link=out_link(lane_link("verify_dedup", i),
+                              lane_link("verify_dedup", i)),
+            backend=verify_backend, batch=verify_batch,
+            max_msg_len=verify_max_msg_len or mtu,
+            tcache_depth=tcache_depth,
+        )
+        for i in range(lanes)
+    ]
     dedup = DedupTile(
         wksp, pod.query_cstr("firedancer.dedup.cnc"),
-        in_link=in_link("verify_dedup"),
+        in_links=[in_link(lane_link("verify_dedup", i)) for i in range(lanes)],
         out_link=out_link("dedup_pack", "dedup_pack"),
+        tcache_depth=tcache_depth,
     )
     pack = PackTile(
         wksp, pod.query_cstr("firedancer.pack.cnc"),
@@ -159,7 +192,7 @@ def _run_tiles(
         wksp, pod.query_cstr("firedancer.sink.cnc"),
         in_link=in_link("pack_sink"),
     )
-    tiles = [source, verify, dedup, pack, sink]
+    tiles = [source, *verifies, dedup, pack, sink]
 
     # Tiles run until HALT; max_ns is a hung-pipeline safety net and must
     # outlast the supervisor's own timeout or slow runs silently truncate.
@@ -175,14 +208,20 @@ def _run_tiles(
         th.start()
     post_wait = pre_wait() if pre_wait is not None else None
 
+    src_outs = getattr(source, "out_links", None) or [source.out_link]
+
     def quiesced() -> bool:
         """Source exhausted and every link fully drained end to end."""
+        if not source_done():
+            return False
+        for i, v in enumerate(verifies):
+            src_seq = src_outs[i].seq if i < len(src_outs) else 0
+            if v.in_link.seq < src_seq or v._pending:
+                return False
+            if dedup.in_links[i].seq < v.out_link.seq:
+                return False
         return (
-            source_done()
-            and verify.in_link.seq >= source.out_link.seq
-            and not verify._pending
-            and dedup.in_link.seq >= verify.out_link.seq
-            and pack.in_link.seq >= dedup.out_link.seq
+            pack.in_link.seq >= dedup.out_link.seq
             and pack.pack.pending_cnt() == 0
             and sink.in_link.seq >= pack.out_link.seq
         )
@@ -224,6 +263,7 @@ def run_pipeline(
     verify_max_msg_len: Optional[int] = None,
     bank_cnt: int = 4,
     timeout_s: float = 60.0,
+    tcache_depth: int = 4096,
 ) -> PipelineResult:
     """Replay-sourced pipeline: payload list -> verify -> dedup -> pack -> sink.
 
@@ -235,12 +275,13 @@ def run_pipeline(
     wksp = Workspace.join(topo.wksp_path)
     replay = ReplayTile(
         wksp, pod.query_cstr("firedancer.replay.cnc"),
-        out_link=_make_source_out_link(wksp, pod),
+        out_links=_make_source_out_links(wksp, pod),
         payloads=payloads,
     )
     return _run_tiles(
         wksp, pod, replay, replay.done,
         verify_backend, verify_batch, verify_max_msg_len, bank_cnt, timeout_s,
+        tcache_depth=tcache_depth,
     )
 
 

@@ -171,15 +171,26 @@ class Tile:
         cnc_name: str,
         in_link: Optional[InLink] = None,
         out_link: Optional[OutLink] = None,
+        in_links: Optional[List[InLink]] = None,
         lazy_ns: Optional[int] = None,
         seed: int = 0,
     ):
+        if in_link is not None and in_links is not None:
+            raise ValueError("pass in_link or in_links, not both")
         self.wksp = wksp
         self.cnc = Cnc(wksp, cnc_name)
-        self.in_link = in_link
+        # Multi-input tiles (the mux pattern, mux/fd_mux.h:56-175) poll
+        # every in-link round-robin; in_link stays as the first for the
+        # common single-input case.
+        self.in_links: List[InLink] = (
+            list(in_links) if in_links is not None
+            else ([in_link] if in_link is not None else [])
+        )
+        self.in_link = self.in_links[0] if self.in_links else None
+        self.in_cur = self.in_link  # link of the frag being processed
         self.out_link = out_link
         self.rng = Rng(seq=seed)
-        depth = in_link.mcache.depth if in_link else (
+        depth = self.in_links[0].mcache.depth if self.in_links else (
             out_link.mcache.depth if out_link else 128
         )
         lazy = lazy_ns if lazy_ns is not None else tempo.lazy_default(depth)
@@ -206,8 +217,8 @@ class Tile:
 
     def housekeep(self, now: int) -> None:
         self.cnc.heartbeat(now)
-        if self.in_link:
-            self.in_link.housekeep()
+        for il in self.in_links:
+            il.housekeep()
         if self.out_link:
             self.out_link.housekeep()
             # Mirror the fctl backpressure gauge into the cnc diag
@@ -257,37 +268,82 @@ class Tile:
                     break
                 time.sleep(50e-6)
                 continue
-            if self.in_link is None:
+            if not self.in_links:
                 self.step()
                 continue
-            r, frag, payload = self.in_link.poll()
-            if r == POLL_FRAG:
-                self.on_frag(frag, payload)
-                self.in_link.advance()
+            progressed = False
+            for il in self.in_links:
+                r, frag, payload = il.poll()
+                if r == POLL_FRAG:
+                    self.in_cur = il
+                    self.on_frag(frag, payload)
+                    il.advance()
+                    progressed = True
+                # POLL_OVERRUN: InLink.poll repositioned + counted.
+            if progressed:
                 idle_spins = 0
-            elif r == POLL_EMPTY:
+            else:
                 self.on_idle()
                 idle_spins += 1
                 if idle_spins > 64:
                     time.sleep(20e-6)  # FD_SPIN_PAUSE analog
-            # POLL_OVERRUN: InLink.poll already repositioned + counted.
 
     def on_halt(self) -> None:
         """Tile-specific teardown (close sockets etc)."""
+
+    def publish_backp(self, payload: bytes, sig: int, tsorig: int = 0,
+                      count_diag: bool = True) -> bool:
+        """Publish downstream, spinning through backpressure (counted in
+        the cnc BACKP diag) until credits arrive or HALT. Returns False if
+        the frag was dropped because HALT arrived first."""
+        while not self.out_link.can_publish():
+            if self.cnc.signal_query() == CNC_HALT:
+                return False
+            self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
+            time.sleep(20e-6)
+        self.out_link.publish(payload, sig, tsorig=tsorig)
+        if count_diag and self.in_cur is not None:
+            self.in_cur.fseq.diag_add(DIAG_PUB_CNT, 1)
+            self.in_cur.fseq.diag_add(DIAG_PUB_SZ, len(payload))
+        return True
 
     def step(self) -> None:
         """Source tiles (no in_link) override or rely on done()."""
         time.sleep(50e-6)
 
 
+class MuxTile(Tile):
+    """N-in -> 1-out frag multiplexer (disco/mux/fd_mux.c analog): forwards
+    every input frag downstream in arrival order, preserving sig/tsorig.
+    The generic multi-input run loop in Tile *is* the mux blueprint; this
+    tile is the identity instance of it."""
+
+    name = "mux"
+
+    def __init__(self, wksp, cnc_name, in_links: List[InLink], out_link, **kw):
+        super().__init__(wksp, cnc_name, in_links=in_links, out_link=out_link,
+                         **kw)
+
+    def on_frag(self, frag: Frag, payload: bytes) -> None:
+        self.publish_backp(payload, frag.sig, tsorig=frag.tsorig)
+
+
 class ReplayTile(Tile):
     """Source: publishes a list of payloads downstream with flow control
-    (disco/replay/fd_replay.c analog; feed it utils.pcap.read_all(path))."""
+    (disco/replay/fd_replay.c analog; feed it utils.pcap.read_all(path)).
+    With several out_links (one per verify lane) payloads round-robin
+    across lanes — the data-parallel ingest fan-out the reference gets
+    from N flow-steered quic+verify tile pairs (config verify_tile_count,
+    configure/frank.c:215-224)."""
 
     name = "replay"
 
-    def __init__(self, wksp, cnc_name, out_link, payloads: List[bytes], **kw):
-        super().__init__(wksp, cnc_name, out_link=out_link, **kw)
+    def __init__(self, wksp, cnc_name, out_link=None, payloads: List[bytes] = (),
+                 out_links: Optional[List[OutLink]] = None, **kw):
+        if (out_link is None) == (out_links is None):
+            raise ValueError("pass exactly one of out_link / out_links")
+        self.out_links = list(out_links) if out_links else [out_link]
+        super().__init__(wksp, cnc_name, out_link=self.out_links[0], **kw)
         self.payloads = payloads
         self.pos = 0
         self.pub_cnt = 0
@@ -296,13 +352,19 @@ class ReplayTile(Tile):
     def done(self) -> bool:
         return self.pos >= len(self.payloads)
 
+    def housekeep(self, now: int) -> None:
+        super().housekeep(now)
+        for ol in self.out_links[1:]:
+            ol.housekeep()
+
     def step(self) -> None:
-        if not self.out_link.can_publish():
+        lane = self.out_links[self.pos % len(self.out_links)]
+        if not lane.can_publish():
             self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
             time.sleep(20e-6)
             return
         payload = self.payloads[self.pos]
-        self.out_link.publish(payload, meta_sig(payload))
+        lane.publish(payload, meta_sig(payload))
         self.pos += 1
         self.pub_cnt += 1
         self.pub_sz += len(payload)
@@ -442,14 +504,7 @@ class VerifyTile(Tile):
             self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, 1)
             self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, len(payload))
             return
-        while not self.out_link.can_publish():
-            if self.cnc.signal_query() == CNC_HALT:
-                return
-            self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
-            time.sleep(20e-6)
-        self.out_link.publish(payload, meta_sig(payload))
-        self.in_link.fseq.diag_add(DIAG_PUB_CNT, 1)
-        self.in_link.fseq.diag_add(DIAG_PUB_SZ, len(payload))
+        self.publish_backp(payload, meta_sig(payload))
 
 
 class DedupTile(Tile):
@@ -457,24 +512,20 @@ class DedupTile(Tile):
 
     name = "dedup"
 
-    def __init__(self, wksp, cnc_name, in_link, out_link,
-                 tcache_depth: int = 4096, **kw):
-        super().__init__(wksp, cnc_name, in_link=in_link, out_link=out_link, **kw)
+    def __init__(self, wksp, cnc_name, in_link=None, out_link=None,
+                 tcache_depth: int = 4096, in_links=None, **kw):
+        # The reference dedup is mux+tcache (dedup/fd_dedup.h:57-80):
+        # several verify lanes fan in here via in_links.
+        super().__init__(wksp, cnc_name, in_link=in_link, out_link=out_link,
+                         in_links=in_links, **kw)
         self.tcache = TCache(tcache_depth)
 
     def on_frag(self, frag: Frag, payload: bytes) -> None:
         if self.tcache.insert(frag.sig):
-            self.in_link.fseq.diag_add(DIAG_FILT_CNT, 1)
-            self.in_link.fseq.diag_add(DIAG_FILT_SZ, frag.sz)
+            self.in_cur.fseq.diag_add(DIAG_FILT_CNT, 1)
+            self.in_cur.fseq.diag_add(DIAG_FILT_SZ, frag.sz)
             return
-        while not self.out_link.can_publish():
-            if self.cnc.signal_query() == CNC_HALT:
-                return
-            self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
-            time.sleep(20e-6)
-        self.out_link.publish(payload, frag.sig, tsorig=frag.tsorig)
-        self.in_link.fseq.diag_add(DIAG_PUB_CNT, 1)
-        self.in_link.fseq.diag_add(DIAG_PUB_SZ, frag.sz)
+        self.publish_backp(payload, frag.sig, tsorig=frag.tsorig)
 
 
 class PackTile(Tile):
@@ -503,7 +554,7 @@ class PackTile(Tile):
         try:
             txn = parse_txn(payload)
         except TxnParseError:
-            self.in_link.fseq.diag_add(DIAG_FILT_CNT, 1)
+            self.in_cur.fseq.diag_add(DIAG_FILT_CNT, 1)
             return
         writable = frozenset(
             txn.account(payload, i)
@@ -562,16 +613,8 @@ class PackTile(Tile):
             block_ended = False
             misses = 0
             payload = self._payloads.pop(txn.txn_id)
-            dropped = False
-            while not self.out_link.can_publish():
-                if self.cnc.signal_query() == CNC_HALT:
-                    dropped = True
-                    break
-                self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
-                time.sleep(20e-6)
-            if not dropped:
-                sig = (bank << 48) | (txn.txn_id & 0xFFFFFFFFFFFF)
-                self.out_link.publish(payload, sig)
+            sig = (bank << 48) | (txn.txn_id & 0xFFFFFFFFFFFF)
+            self.publish_backp(payload, sig, count_diag=False)
             # Bank execution is immediate in the slice: release locks.
             self.pack.complete(bank, txn.txn_id)
 
@@ -592,5 +635,5 @@ class SinkTile(Tile):
         self.recv_sz += frag.sz
         bank = frag.sig >> 48
         self.bank_hist[bank] = self.bank_hist.get(bank, 0) + 1
-        self.in_link.fseq.diag_add(DIAG_PUB_CNT, 1)
-        self.in_link.fseq.diag_add(DIAG_PUB_SZ, frag.sz)
+        self.in_cur.fseq.diag_add(DIAG_PUB_CNT, 1)
+        self.in_cur.fseq.diag_add(DIAG_PUB_SZ, frag.sz)

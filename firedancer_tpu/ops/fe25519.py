@@ -43,20 +43,16 @@ D_INT = (-121665 * pow(121666, P - 2, P)) % P
 SQRT_M1_INT = pow(2, (P - 1) // 4, P)
 
 
-def _fold_matrix() -> np.ndarray:
-    """T[k, 32*i+j] so that (T @ flat_outer(a,b))[k] = (a*b mod-ish p)[k]."""
-    t = np.zeros((NLIMBS, NLIMBS * NLIMBS), np.int32)
-    for i in range(NLIMBS):
-        for j in range(NLIMBS):
-            k = i + j
-            if k < NLIMBS:
-                t[k, NLIMBS * i + j] += 1
-            else:
-                t[k - NLIMBS, NLIMBS * i + j] += 38
-    return t
-
-
-_T_FOLD = jnp.asarray(_fold_matrix())
+# fe_mul gather schedule: c_m = sum_i a_i * bext_{IDX[i,m]} where
+# bext = [b ; 38*b] (2^256 = 38 mod p). Term (i, j=(m-i) mod 32) lands in
+# c_m directly when i <= m (k = i+j = m) and via the 38-weighted wrap when
+# i > m (k = m+32). One static gather + a 32-term reduction replaces the
+# dense (32, 1024) fold matmul (32x fewer MACs).
+_IDX_MUL = np.zeros((NLIMBS, NLIMBS), np.int32)
+for _i in range(NLIMBS):
+    for _m in range(NLIMBS):
+        _IDX_MUL[_i, _m] = (_m - _i) % NLIMBS + (NLIMBS if _i > _m else 0)
+_IDX_MUL = jnp.asarray(_IDX_MUL)
 
 # Canonical limbs of p, as a (32, 1) column for broadcasting.
 _P_LIMBS = jnp.asarray(
@@ -117,10 +113,9 @@ def fe_neg(a: jnp.ndarray) -> jnp.ndarray:
 
 def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Field multiply. Inputs may have |limb| up to 1024."""
-    batch_shape = a.shape[1:]
-    outer = a[:, None] * b[None, :]                     # (32, 32, *batch)
-    flat = outer.reshape((NLIMBS * NLIMBS,) + batch_shape)
-    folded = jnp.tensordot(_T_FOLD, flat, axes=1)       # (32, *batch)
+    bext = jnp.concatenate([b, 38 * b], axis=0)         # (64, *batch)
+    gathered = bext[_IDX_MUL]                           # (32, 32, *batch)
+    folded = jnp.sum(a[:, None] * gathered, axis=0)     # (32, *batch)
     return _carry_pass(folded, 4)
 
 
