@@ -93,14 +93,19 @@ def cmd_run(cfg: Dict[str, Any], args) -> int:
         timeout_s=cfg["development"]["timeout_s"],
         tcache_depth=tiles_cfg["verify"]["tcache_depth"],
     )
+    # filters are counted per verify lane (tile.verify, tile.verify.v1...)
+    sv_filt = sum(d.get("sv_filt_cnt", 0) for name, d in res.diag.items()
+                  if name.startswith("tile.verify"))
+    ha_filt = sum(d.get("ha_filt_cnt", 0) for name, d in res.diag.items()
+                  if name.startswith("tile.verify"))
     print(json.dumps({
         "sent": len(payloads),
         "recv_cnt": res.recv_cnt,
         "recv_sz": res.recv_sz,
         "bank_hist": {str(k): v for k, v in sorted(res.bank_hist.items())},
         "elapsed_s": round(res.elapsed_s, 3),
-        "verify_sv_filt": res.diag.get("tile.verify", {}).get("sv_filt_cnt", 0),
-        "verify_ha_filt": res.diag.get("tile.verify", {}).get("ha_filt_cnt", 0),
+        "verify_sv_filt": sv_filt,
+        "verify_ha_filt": ha_filt,
     }))
     return 0
 

@@ -272,6 +272,7 @@ class Tile:
                 self.step()
                 continue
             progressed = False
+            overrun = False
             for il in self.in_links:
                 r, frag, payload = il.poll()
                 if r == POLL_FRAG:
@@ -279,8 +280,11 @@ class Tile:
                     self.on_frag(frag, payload)
                     il.advance()
                     progressed = True
-                # POLL_OVERRUN: InLink.poll repositioned + counted.
-            if progressed:
+                elif r == POLL_OVERRUN:
+                    # InLink.poll repositioned + counted; the consumer is
+                    # behind, so keep polling hot — never throttle it.
+                    overrun = True
+            if progressed or overrun:
                 idle_spins = 0
             else:
                 self.on_idle()

@@ -41,8 +41,14 @@ def identity(batch_shape):
     )
 
 
-def point_add(p, q):
-    """Unified extended-coordinates addition (complete for a=-1, d nonsq)."""
+def point_add(p, q, need_t: bool = True):
+    """Unified extended-coordinates addition (complete for a=-1, d nonsq).
+
+    need_t=False skips the T-coordinate product (one fe_mul) when the
+    consumer is a doubling or compress — the same elision wiredancer's
+    fixed pipeline hardwires and the reference's p1p1->p2 conversions get
+    for free (fd_ed25519_private.h reprs).
+    """
     x1, y1, z1, t1 = p
     x2, y2, z2, t2 = q
     a = fe.fe_mul(fe.fe_sub(y1, x1), fe.fe_sub(y2, x2))
@@ -53,11 +59,13 @@ def point_add(p, q):
     f = fe.fe_sub(d_, c)
     g = fe.fe_add(d_, c)
     h = fe.fe_add(b, a)
-    return fe.fe_mul(e, f), fe.fe_mul(g, h), fe.fe_mul(f, g), fe.fe_mul(e, h)
+    t = fe.fe_mul(e, h) if need_t else None
+    return fe.fe_mul(e, f), fe.fe_mul(g, h), fe.fe_mul(f, g), t
 
 
-def point_double(p):
-    """dbl-2008-hwcd with a=-1."""
+def point_double(p, need_t: bool = True):
+    """dbl-2008-hwcd with a=-1. Input T is never read; need_t=False skips
+    producing it (doubling chains only need T on the last step)."""
     x1, y1, z1, _ = p
     a = fe.fe_sq(x1)
     b = fe.fe_sq(y1)
@@ -67,7 +75,8 @@ def point_double(p):
     g = fe.fe_add(d_, b)
     f = fe.fe_sub(g, c)
     h = fe.fe_sub(d_, b)
-    return fe.fe_mul(e, f), fe.fe_mul(g, h), fe.fe_mul(f, g), fe.fe_mul(e, h)
+    t = fe.fe_mul(e, h) if need_t else None
+    return fe.fe_mul(e, f), fe.fe_mul(g, h), fe.fe_mul(f, g), t
 
 
 def point_neg(p):
@@ -177,13 +186,15 @@ def _base_point_table() -> tuple:
 _B_TABLE = _base_point_table()
 
 
-def double_scalarmult(h_bytes, a_point, s_bytes):
+def double_scalarmult(h_bytes, a_point, s_bytes, n_windows: int = 64):
     """R = h*A + s*Base, batch-uniform fixed windows.
 
     h_bytes, s_bytes: (*batch, 32) uint8 little-endian scalars (< 2^256; for
     verify they are canonical mod L). a_point: decompressed batch point.
     Replaces ge_double_scalarmult_vartime (ref/fd_ed25519_ge.c:468) with a
     fixed schedule: 64 windows x (4 doublings + 2 table adds).
+    n_windows < 64 processes only the MSB-side windows (test harness knob
+    for cross-checking the Pallas kernel without 64 interpreted rounds).
     """
     batch = a_point[0].shape[1:]
     hw = _windows_from_bytes(h_bytes)                         # (64, *batch)
@@ -194,16 +205,23 @@ def double_scalarmult(h_bytes, a_point, s_bytes):
 
     idx16 = jnp.arange(16, dtype=jnp.int32)
 
-    def step(r, wins):
+    def step(r3, wins):
         whi, wsi = wins
-        for _ in range(4):
-            r = point_double(r)
+        r = (*r3, None)  # T is never read by doublings
+        for _ in range(3):
+            r = point_double(r, need_t=False)
+        r = point_double(r, need_t=True)
         oh_h = (idx16[:, None] == whi[None, :]).astype(jnp.int32)
-        r = point_add(r, _table_lookup(a_table, oh_h))
+        r = point_add(r, _table_lookup(a_table, oh_h), need_t=True)
         oh_s = (idx16[:, None] == wsi[None, :]).astype(jnp.int32)
-        r = point_add(r, _table_lookup(b_table, oh_s))
-        return r, None
+        x, y, z, _ = point_add(r, _table_lookup(b_table, oh_s), need_t=False)
+        return (x, y, z), None
 
     # MSB-first over the 64 windows.
-    r, _ = jax.lax.scan(step, identity(batch), (hw[::-1], sw[::-1]))
-    return r
+    ident = identity(batch)
+    r3, _ = jax.lax.scan(
+        step, ident[:3], (hw[::-1][:n_windows], sw[::-1][:n_windows])
+    )
+    # T of the result is never used (compress reads X/Y/Z only); return a
+    # placeholder zero so the point stays a uniform 4-tuple.
+    return (*r3, fe.fe_zero(batch))

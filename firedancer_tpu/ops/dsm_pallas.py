@@ -1,0 +1,183 @@
+"""Pallas TPU kernel for the Ed25519 double-scalar-mult hot loop.
+
+R = h*(-A) + s*B is ~85% of the verify FLOPs (64 windows x (4 doublings
++ 2 table adds), each point op ~8 field muls). The XLA graph streams
+every (32, B) intermediate through HBM; this kernel instead pins one
+batch tile of lanes in VMEM for the whole loop — point state, the
+16-entry per-lane A table, and the shared B table all stay on chip, so
+the VPU runs at arithmetic speed instead of HBM bandwidth.
+
+Same fixed-window schedule as curve25519.double_scalarmult (the XLA
+reference path, kept for CPU/dryrun and as the correctness oracle);
+field ops come from fe25519 (fe_mul_unrolled — static slices, no
+gather). Reference for the schedule: wiredancer SV1's fully-pipelined
+fixed window mul (src/wiredancer/README.md:128) vs the CPU's vartime
+sliding window (ref/fd_ed25519_ge.c:468).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import curve25519 as ge
+from . import fe25519 as fe
+
+NLIMBS = fe.NLIMBS
+LANES = 512  # batch tile per program (VMEM working set ~= 3 MB)
+
+
+def _fe_mul(a, b):
+    return fe.fe_mul_unrolled(a, b)
+
+
+def _point_add(p, q, need_t=True):
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = _fe_mul(fe.fe_sub(y1, x1), fe.fe_sub(y2, x2))
+    b = _fe_mul(fe.fe_add(y1, x1), fe.fe_add(y2, x2))
+    c = _fe_mul(_fe_mul(t1, t2), fe.FE_D2)
+    zz = _fe_mul(z1, z2)
+    d_ = fe.fe_add(zz, zz)
+    e = fe.fe_sub(b, a)
+    f = fe.fe_sub(d_, c)
+    g = fe.fe_add(d_, c)
+    h = fe.fe_add(b, a)
+    t = _fe_mul(e, h) if need_t else None
+    return _fe_mul(e, f), _fe_mul(g, h), _fe_mul(f, g), t
+
+
+def _point_double(p, need_t=True):
+    x1, y1, z1, _ = p
+    a = _fe_mul(x1, x1)
+    b = _fe_mul(y1, y1)
+    zz = _fe_mul(z1, z1)
+    c = fe.fe_add(zz, zz)
+    d_ = fe.fe_neg(a)
+    e = fe.fe_sub(fe.fe_sub(_fe_mul(fe.fe_add(x1, y1), fe.fe_add(x1, y1)), a), b)
+    g = fe.fe_add(d_, b)
+    f = fe.fe_sub(g, c)
+    h = fe.fe_sub(d_, b)
+    t = _fe_mul(e, h) if need_t else None
+    return _fe_mul(e, f), _fe_mul(g, h), _fe_mul(f, g), t
+
+
+def _identity(lanes):
+    one = (jax.lax.broadcasted_iota(jnp.int32, (NLIMBS, lanes), 0) == 0)
+    one = one.astype(jnp.int32)
+    zero = jnp.zeros((NLIMBS, lanes), jnp.int32)
+    return (zero, one, one, zero)
+
+
+def _lookup(table, w_row):
+    """table: list of 16 points; w_row: (1, L) window values 0..15."""
+    coords = []
+    for c in range(4):
+        acc = jnp.zeros_like(table[0][c])
+        for t in range(16):
+            sel = (w_row == t).astype(jnp.int32)      # (1, L)
+            acc = acc + table[t][c] * sel
+        coords.append(acc)
+    return tuple(coords)
+
+
+def _dsm_kernel(ax, ay, az, at, hw, sw, btab, ox, oy, oz, *, n_windows=64):
+    lanes = ax.shape[1]
+    a_pt = (ax[...], ay[...], az[...], at[...])
+
+    # per-lane A table: [0]=identity, [1]=A, [j]=dbl/add chain (VMEM)
+    a_table = [_identity(lanes), a_pt]
+    for j in range(2, 16):
+        if j % 2 == 0:
+            a_table.append(_point_double(a_table[j // 2]))
+        else:
+            a_table.append(_point_add(a_table[j - 1], a_pt))
+
+    # shared B table: btab is (32, 64) — column 4*t+c = coord c of t*B
+    b_table = []
+    for t in range(16):
+        coords = tuple(
+            jnp.broadcast_to(btab[:, 4 * t + c][:, None], (NLIMBS, lanes))
+            for c in range(4)
+        )
+        b_table.append(coords)
+
+    def body(wi, r3):
+        import jax.experimental.pallas as pl
+
+        r = (*r3, None)
+        for _ in range(3):
+            r = _point_double(r, need_t=False)
+        r = _point_double(r, need_t=True)
+        idx = 63 - wi
+        wh = hw[pl.ds(idx, 1), :]                     # (1, L)
+        ws = sw[pl.ds(idx, 1), :]
+        r = _point_add(r, _lookup(a_table, wh), need_t=True)
+        x, y, z, _ = _point_add(r, _lookup(b_table, ws), need_t=False)
+        return (x, y, z)
+
+    # MSB-first: wi=0 processes window 63, matching the XLA scan order.
+    r3 = jax.lax.fori_loop(0, n_windows, body, _identity(lanes)[:3])
+    ox[...] = r3[0]
+    oy[...] = r3[1]
+    oz[...] = r3[2]
+
+
+@functools.lru_cache(maxsize=1)
+def _btab_const() -> np.ndarray:
+    """(32, 64) int32: column 4*t+c holds limb vector of coord c of t*B."""
+    from firedancer_tpu.ballet.ed25519 import oracle as _oracle
+
+    P = fe.P
+    pts = [(0, 1)]
+    for _ in range(15):
+        pts.append(_oracle.point_add(pts[-1], _oracle.B) if pts[-1] != (0, 1)
+                   else _oracle.B)
+    out = np.zeros((NLIMBS, 64), np.int32)
+    for t, (x, y) in enumerate(pts):
+        for c, val in enumerate((x, y, 1, x * y % P)):
+            for i in range(NLIMBS):
+                out[i, 4 * t + c] = (val >> (8 * i)) & 0xFF
+    return out
+
+
+def double_scalarmult_pallas(h_bytes, a_point, s_bytes, interpret=False,
+                             n_windows: int = 64):
+    """Drop-in replacement for curve25519.double_scalarmult on TPU.
+
+    h_bytes/s_bytes: (B, 32) uint8; a_point: (4 x (32, B)) int32 limbs.
+    Returns (X, Y, Z, T=0) with B padded internally to a LANES multiple.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    hw = ge._windows_from_bytes(h_bytes)      # (64, B)
+    sw = ge._windows_from_bytes(s_bytes)
+    bsz = hw.shape[1]
+    lanes = min(LANES, bsz)
+    pad = (-bsz) % lanes
+    if pad:
+        hw = jnp.pad(hw, ((0, 0), (0, pad)))
+        sw = jnp.pad(sw, ((0, 0), (0, pad)))
+        a_point = tuple(jnp.pad(c, ((0, 0), (0, pad))) for c in a_point)
+    n = (bsz + pad) // lanes
+
+    spec_fe = pl.BlockSpec((NLIMBS, lanes), lambda i: (0, i))
+    spec_w = pl.BlockSpec((64, lanes), lambda i: (0, i))
+    spec_btab = pl.BlockSpec((NLIMBS, 64), lambda i: (0, 0))
+    out_shape = jax.ShapeDtypeStruct((NLIMBS, bsz + pad), jnp.int32)
+
+    x, y, z = pl.pallas_call(
+        functools.partial(_dsm_kernel, n_windows=n_windows),
+        grid=(n,),
+        in_specs=[spec_fe] * 4 + [spec_w, spec_w, spec_btab],
+        out_specs=[spec_fe] * 3,
+        out_shape=[out_shape] * 3,
+        interpret=interpret,
+    )(*a_point, hw, sw, jnp.asarray(_btab_const()))
+    if pad:
+        x, y, z = x[:, :bsz], y[:, :bsz], z[:, :bsz]
+    return (x, y, z, fe.fe_zero((bsz,)))

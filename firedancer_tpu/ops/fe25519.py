@@ -43,16 +43,6 @@ D_INT = (-121665 * pow(121666, P - 2, P)) % P
 SQRT_M1_INT = pow(2, (P - 1) // 4, P)
 
 
-# fe_mul gather schedule: c_m = sum_i a_i * bext_{IDX[i,m]} where
-# bext = [b ; 38*b] (2^256 = 38 mod p). Term (i, j=(m-i) mod 32) lands in
-# c_m directly when i <= m (k = i+j = m) and via the 38-weighted wrap when
-# i > m (k = m+32). One static gather + a 32-term reduction replaces the
-# dense (32, 1024) fold matmul (32x fewer MACs).
-_IDX_MUL = np.zeros((NLIMBS, NLIMBS), np.int32)
-for _i in range(NLIMBS):
-    for _m in range(NLIMBS):
-        _IDX_MUL[_i, _m] = (_m - _i) % NLIMBS + (NLIMBS if _i > _m else 0)
-_IDX_MUL = jnp.asarray(_IDX_MUL)
 
 # Canonical limbs of p, as a (32, 1) column for broadcasting.
 _P_LIMBS = jnp.asarray(
@@ -111,12 +101,41 @@ def fe_neg(a: jnp.ndarray) -> jnp.ndarray:
     return _carry_pass(-a, 1)
 
 
+# fe_mul schedule: c_m = sum_i a_i * bext[32-i+m] with bext = [38*b ; b]
+# (2^256 = 38 mod p): for i <= m that picks b_{m-i} (k = i+j = m), for
+# i > m the 38-weighted wrap b_{m-i+32} (k = m+32).
+_IDX_MUL = np.zeros((NLIMBS, NLIMBS), np.int32)
+for _i in range(NLIMBS):
+    for _m in range(NLIMBS):
+        _IDX_MUL[_i, _m] = NLIMBS - _i + _m
+_IDX_MUL = jnp.asarray(_IDX_MUL)
+
+
 def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Field multiply. Inputs may have |limb| up to 1024."""
-    bext = jnp.concatenate([b, 38 * b], axis=0)         # (64, *batch)
+    """Field multiply. Inputs may have |limb| up to 1024.
+
+    One static gather + a 32-term weighted reduce (the XLA/HLO-compact
+    form; fe_mul_unrolled is the same schedule for Pallas kernels).
+    """
+    bext = jnp.concatenate([38 * b, b], axis=0)         # (64, *batch)
     gathered = bext[_IDX_MUL]                           # (32, 32, *batch)
     folded = jnp.sum(a[:, None] * gathered, axis=0)     # (32, *batch)
     return _carry_pass(folded, 4)
+
+
+def fe_mul_unrolled(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """fe_mul as 32 static-sliced multiply-adds — no gather, Pallas-safe.
+
+    Emits ~64 HLO ops per multiply, so it is only used inside Pallas
+    kernels where gathers are unavailable and unrolling is free (the
+    kernel body is compiled once per block shape, not inlined ~3k times
+    like the XLA graph's muls are).
+    """
+    bext = jnp.concatenate([38 * b, b], axis=0)         # (64, *batch)
+    acc = a[0:1] * bext[NLIMBS:2 * NLIMBS]
+    for i in range(1, NLIMBS):
+        acc = acc + a[i:i + 1] * bext[NLIMBS - i:2 * NLIMBS - i]
+    return _carry_pass(acc, 4)
 
 
 def fe_sq(a: jnp.ndarray) -> jnp.ndarray:

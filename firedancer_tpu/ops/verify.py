@@ -28,6 +28,32 @@ FD_ED25519_ERR_PUBKEY = -2
 FD_ED25519_ERR_MSG = -3
 
 
+def _dsm_auto():
+    """Pick the double-scalarmult implementation for this process's
+    backend: the Pallas VMEM-resident kernel on TPU, the XLA graph
+    elsewhere (CPU tests, multichip dryrun)."""
+    import os
+
+    impl = os.environ.get("FD_DSM_IMPL", "auto")
+    if impl == "xla":
+        return ge.double_scalarmult
+    if impl == "pallas":
+        from .dsm_pallas import double_scalarmult_pallas
+
+        return double_scalarmult_pallas
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "cpu"
+    # Pallas kernel only for TPU-family backends (the kernel is built on
+    # pallas.tpu BlockSpecs/VMEM); everything else takes the XLA graph.
+    if platform in ("tpu", "axon"):
+        from .dsm_pallas import double_scalarmult_pallas
+
+        return double_scalarmult_pallas
+    return ge.double_scalarmult
+
+
 def verify_batch(
     msgs: jnp.ndarray,
     msg_lengths: jnp.ndarray,
@@ -63,7 +89,7 @@ def verify_batch(
     h64 = sha512_batch(hash_in, msg_lengths.astype(jnp.int32) + 64)
     h_bytes = sc.sc_reduce64(h64)
 
-    r_prime = ge.double_scalarmult(h_bytes, neg_a, s_bytes)
+    r_prime = _dsm_auto()(h_bytes, neg_a, s_bytes)
     r_enc = ge.compress(r_prime)
     r_match = jnp.all(r_enc == r_bytes, axis=-1)
 

@@ -1,0 +1,107 @@
+"""ar archive reader (fd_ar analog, reference src/util/archive/fd_ar.h).
+
+Reads classic System V `ar` archives (the format of .a static libraries
+and some fixture bundles): 8-byte magic, then 60-byte member headers
+(name 16, mtime 12, uid 6, gid 6, mode 8, size 10, fmag 2) with 2-byte
+alignment padding between members. GNU long-name tables (`//` member,
+`/N` references) are resolved; the symbol index (`/`) is skipped, same
+as the reference reader.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Tuple
+
+MAGIC = b"!<arch>\n"
+_HDR_SZ = 60
+_FMAG = b"`\n"
+
+
+class ArError(Exception):
+    pass
+
+
+@dataclass
+class ArMember:
+    name: str
+    mtime: int
+    uid: int
+    gid: int
+    mode: int
+    data: bytes
+
+
+def _parse_int(field: bytes, default: int = 0) -> int:
+    """Decimal header field (sizes/ids/mtime; mode is parsed as octal at
+    the call site)."""
+    s = field.decode("ascii", errors="replace").strip()
+    if not s:
+        return default
+    try:
+        return int(s)
+    except ValueError:
+        raise ArError(f"bad numeric field {field!r}") from None
+
+
+def iter_members(blob: bytes) -> Iterator[ArMember]:
+    """Yield every regular member of an ar archive image."""
+    if not blob.startswith(MAGIC):
+        raise ArError("bad ar magic")
+    off = len(MAGIC)
+    longnames: Optional[bytes] = None
+    while off < len(blob):
+        if off + _HDR_SZ > len(blob):
+            raise ArError("truncated member header")
+        hdr = blob[off : off + _HDR_SZ]
+        if hdr[58:60] != _FMAG:
+            raise ArError(f"bad member magic at offset {off}")
+        raw_name = hdr[0:16].rstrip()
+        size = _parse_int(hdr[48:58])
+        data_off = off + _HDR_SZ
+        if data_off + size > len(blob):
+            raise ArError("truncated member data")
+        data = blob[data_off : data_off + size]
+        off = data_off + size + (size & 1)  # members are 2-byte aligned
+
+        if raw_name == b"/":               # symbol index: skip
+            continue
+        if raw_name == b"//":              # GNU long-name table
+            longnames = data
+            continue
+        if raw_name.startswith(b"/") and raw_name[1:].isdigit():
+            if longnames is None:
+                raise ArError("long-name reference without // table")
+            start = int(raw_name[1:])
+            end = longnames.find(b"\n", start)
+            name = longnames[start : end if end >= 0 else len(longnames)]
+            name = name.rstrip(b"/").decode()
+        else:
+            name = raw_name.rstrip(b"/").decode()
+        yield ArMember(
+            name=name,
+            mtime=_parse_int(hdr[16:28]),
+            uid=_parse_int(hdr[28:34]),
+            gid=_parse_int(hdr[34:40]),
+            mode=int(hdr[40:48].decode().strip() or "0", 8),
+            data=data,
+        )
+
+
+def read_archive(path: str) -> List[ArMember]:
+    with open(path, "rb") as f:
+        return list(iter_members(f.read()))
+
+
+def write_archive(path: str, members: List[Tuple[str, bytes]]) -> None:
+    """Minimal ar writer (short names only) for tests/fixtures."""
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        for name, data in members:
+            nm = (name + "/").encode()
+            if len(nm) > 16:
+                raise ArError(f"name too long for short form: {name}")
+            hdr = b"%-16s%-12d%-6d%-6d%-8s%-10d" % (nm, 0, 0, 0, b"644", len(data))
+            f.write(hdr + _FMAG + data)
+            if len(data) & 1:
+                f.write(b"\n")

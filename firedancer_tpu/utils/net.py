@@ -1,0 +1,155 @@
+"""Network header structs + checksums (reference src/util/net/: fd_eth.h,
+fd_ip4.h, fd_udp.h).
+
+Pack/parse for Ethernet II, IPv4 (no options fast path, options
+tolerated on parse), and UDP, plus the internet checksum and the
+UDP/IPv4 pseudo-header checksum. These are the frame codecs the XDP/
+raw-socket ingest path and the pcap fixtures use.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+ETH_TYPE_IP4 = 0x0800
+ETH_TYPE_ARP = 0x0806
+ETH_HDR_SZ = 14
+IP4_HDR_SZ = 20
+UDP_HDR_SZ = 8
+IP4_PROTO_UDP = 17
+
+
+class NetError(Exception):
+    pass
+
+
+def ip_checksum(data: bytes, init: int = 0) -> int:
+    """Internet (ones-complement) checksum (fd_ip4.h fd_ip4_hdr_check)."""
+    s = init
+    if len(data) & 1:
+        data = data + b"\0"
+    for i in range(0, len(data), 2):
+        s += (data[i] << 8) | data[i + 1]
+    while s >> 16:
+        s = (s & 0xFFFF) + (s >> 16)
+    return (~s) & 0xFFFF
+
+
+@dataclass
+class EthHdr:
+    dst: bytes = b"\xff" * 6
+    src: bytes = b"\x00" * 6
+    ethertype: int = ETH_TYPE_IP4
+
+    def pack(self) -> bytes:
+        return self.dst + self.src + struct.pack(">H", self.ethertype)
+
+    @classmethod
+    def parse(cls, b: bytes) -> Tuple["EthHdr", bytes]:
+        if len(b) < ETH_HDR_SZ:
+            raise NetError("short ethernet frame")
+        (et,) = struct.unpack_from(">H", b, 12)
+        return cls(dst=b[0:6], src=b[6:12], ethertype=et), b[ETH_HDR_SZ:]
+
+
+@dataclass
+class Ip4Hdr:
+    src: bytes = b"\x7f\x00\x00\x01"
+    dst: bytes = b"\x7f\x00\x00\x01"
+    protocol: int = IP4_PROTO_UDP
+    ttl: int = 64
+    ident: int = 0
+    tos: int = 0
+    total_len: int = 0   # filled by pack if 0 given payload_len
+
+    def pack(self, payload_len: int) -> bytes:
+        total = self.total_len or (IP4_HDR_SZ + payload_len)
+        hdr = struct.pack(
+            ">BBHHHBBH4s4s",
+            0x45, self.tos, total, self.ident, 0, self.ttl,
+            self.protocol, 0, self.src, self.dst,
+        )
+        ck = ip_checksum(hdr)
+        return hdr[:10] + struct.pack(">H", ck) + hdr[12:]
+
+    @classmethod
+    def parse(cls, b: bytes, verify_checksum: bool = True) -> Tuple["Ip4Hdr", bytes]:
+        if len(b) < IP4_HDR_SZ:
+            raise NetError("short ipv4 header")
+        vihl, tos, total, ident, _frag, ttl, proto, ck = struct.unpack_from(
+            ">BBHHHBBH", b, 0
+        )
+        if vihl >> 4 != 4:
+            raise NetError(f"not ipv4 (version {vihl >> 4})")
+        ihl = (vihl & 0xF) * 4
+        if ihl < IP4_HDR_SZ or len(b) < ihl or total < ihl or len(b) < total:
+            raise NetError("bad ipv4 lengths")
+        if verify_checksum and ip_checksum(b[:ihl]) != 0:
+            raise NetError("ipv4 header checksum mismatch")
+        hdr = cls(src=b[12:16], dst=b[16:20], protocol=proto, ttl=ttl,
+                  ident=ident, tos=tos, total_len=total)
+        return hdr, b[ihl:total]
+
+
+@dataclass
+class UdpHdr:
+    sport: int = 0
+    dport: int = 0
+
+    def pack(self, payload: bytes, src_ip: bytes, dst_ip: bytes,
+             checksum: bool = True) -> bytes:
+        length = UDP_HDR_SZ + len(payload)
+        hdr = struct.pack(">HHHH", self.sport, self.dport, length, 0)
+        if checksum:
+            pseudo = src_ip + dst_ip + struct.pack(">BBH", 0, IP4_PROTO_UDP,
+                                                   length)
+            ck = ip_checksum(pseudo + hdr + payload)
+            ck = ck or 0xFFFF  # 0 means "no checksum" on the wire
+            hdr = hdr[:6] + struct.pack(">H", ck)
+        return hdr
+
+    @classmethod
+    def parse(cls, b: bytes, src_ip: Optional[bytes] = None,
+              dst_ip: Optional[bytes] = None,
+              verify_checksum: bool = False) -> Tuple["UdpHdr", bytes]:
+        if len(b) < UDP_HDR_SZ:
+            raise NetError("short udp header")
+        sport, dport, length, ck = struct.unpack_from(">HHHH", b, 0)
+        if length < UDP_HDR_SZ or len(b) < length:
+            raise NetError("bad udp length")
+        payload = b[UDP_HDR_SZ:length]
+        if verify_checksum and ck and src_ip and dst_ip:
+            pseudo = src_ip + dst_ip + struct.pack(">BBH", 0, IP4_PROTO_UDP,
+                                                   length)
+            if ip_checksum(pseudo + b[:length]) not in (0,):
+                raise NetError("udp checksum mismatch")
+        return cls(sport=sport, dport=dport), payload
+
+
+def build_udp_frame(payload: bytes, *, src_ip: bytes, dst_ip: bytes,
+                    sport: int, dport: int,
+                    eth_src: bytes = b"\x00" * 6,
+                    eth_dst: bytes = b"\xff" * 6) -> bytes:
+    """Full eth/ip4/udp frame around `payload` (TX path helper)."""
+    udp = UdpHdr(sport=sport, dport=dport).pack(payload, src_ip, dst_ip)
+    ip = Ip4Hdr(src=src_ip, dst=dst_ip).pack(len(udp) + len(payload))
+    eth = EthHdr(dst=eth_dst, src=eth_src).pack()
+    return eth + ip + udp + payload
+
+
+def parse_udp_frame(frame: bytes, verify_checksum: bool = True):
+    """eth/ip4/udp frame -> (EthHdr, Ip4Hdr, UdpHdr, payload).
+
+    Raises NetError for anything that is not a well-formed UDP/IPv4
+    frame (the RX-path filter, fd_xsk_aio-style).
+    """
+    eth, rest = EthHdr.parse(frame)
+    if eth.ethertype != ETH_TYPE_IP4:
+        raise NetError(f"not ipv4 ethertype 0x{eth.ethertype:04x}")
+    ip, rest = Ip4Hdr.parse(rest, verify_checksum=verify_checksum)
+    if ip.protocol != IP4_PROTO_UDP:
+        raise NetError(f"not udp (proto {ip.protocol})")
+    udp, payload = UdpHdr.parse(rest, ip.src, ip.dst)
+    return eth, ip, udp, payload

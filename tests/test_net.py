@@ -1,0 +1,84 @@
+"""util/net header codec tests (reference src/util/net fd_eth/ip4/udp)."""
+
+import struct
+
+import pytest
+
+from firedancer_tpu.utils.net import (
+    ETH_TYPE_IP4,
+    EthHdr,
+    Ip4Hdr,
+    NetError,
+    UdpHdr,
+    build_udp_frame,
+    ip_checksum,
+    parse_udp_frame,
+)
+
+
+def test_ip_checksum_known_vector():
+    # classic RFC1071 example header
+    hdr = bytes.fromhex("4500003c1c4640004006" + "0000" + "ac100a63ac100a0c")
+    ck = ip_checksum(hdr)
+    full = hdr[:10] + struct.pack(">H", ck) + hdr[12:]
+    assert ip_checksum(full) == 0
+
+
+def test_udp_frame_roundtrip():
+    payload = b"solana txn bytes" * 10
+    frame = build_udp_frame(
+        payload,
+        src_ip=bytes([10, 0, 0, 1]), dst_ip=bytes([10, 0, 0, 2]),
+        sport=4242, dport=8003,
+    )
+    eth, ip, udp, got = parse_udp_frame(frame)
+    assert got == payload
+    assert eth.ethertype == ETH_TYPE_IP4
+    assert ip.src == bytes([10, 0, 0, 1]) and ip.protocol == 17
+    assert udp.sport == 4242 and udp.dport == 8003
+
+
+def test_parse_rejects_corruption():
+    payload = b"x" * 32
+    frame = bytearray(build_udp_frame(
+        payload, src_ip=b"\x7f\0\0\x01", dst_ip=b"\x7f\0\0\x01",
+        sport=1, dport=2))
+    # corrupt the IPv4 header checksum area
+    frame[24] ^= 0xFF
+    with pytest.raises(NetError):
+        parse_udp_frame(bytes(frame))
+    # truncated frame
+    with pytest.raises(NetError):
+        parse_udp_frame(bytes(frame[:20]))
+    # non-IP ethertype passes through as NetError
+    frame2 = bytearray(build_udp_frame(
+        payload, src_ip=b"\x7f\0\0\x01", dst_ip=b"\x7f\0\0\x01",
+        sport=1, dport=2))
+    frame2[12:14] = b"\x08\x06"  # ARP
+    with pytest.raises(NetError):
+        parse_udp_frame(bytes(frame2))
+
+
+def test_ipv4_options_tolerated():
+    # hand-build a 24-byte IHL=6 header with one option word
+    payload = b"hi"
+    udp = UdpHdr(sport=7, dport=9).pack(payload, b"\x01\x02\x03\x04",
+                                        b"\x05\x06\x07\x08")
+    total = 24 + len(udp) + len(payload)
+    hdr = struct.pack(
+        ">BBHHHBBH4s4s4s",
+        0x46, 0, total, 0, 0, 64, 17, 0,
+        b"\x01\x02\x03\x04", b"\x05\x06\x07\x08", b"\x01\x01\x01\x01",
+    )
+    ck = ip_checksum(hdr)
+    hdr = hdr[:10] + struct.pack(">H", ck) + hdr[12:]
+    ip, rest = Ip4Hdr.parse(hdr + udp + payload)
+    udp_h, got = UdpHdr.parse(rest)
+    assert got == payload and udp_h.dport == 9
+
+
+def test_udp_zero_checksum_wire_convention():
+    # a computed checksum of 0 must be emitted as 0xFFFF
+    udp = UdpHdr(sport=0, dport=0).pack(b"", b"\0\0\0\0", b"\0\0\0\0")
+    (ck,) = struct.unpack_from(">H", udp, 6)
+    assert ck != 0
