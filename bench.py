@@ -9,12 +9,21 @@ Methodology mirrors the reference's test_ed25519 bench harness
 the full verify (SHA-512 + decompress + double-scalar-mul + compare), with
 correctness asserted on the results. Message size models a typical Solana
 transaction payload (~192 bytes of signed message; MTU is 1232).
+
+Robustness (round-2 hardening): this environment's TPU tunnel serializes
+across processes and a wedged claim hangs backend init indefinitely — a
+hang cannot be interrupted in-process. So the default mode is an
+ORCHESTRATOR that runs the actual measurement in a worker subprocess with a
+hard timeout, retries a bounded number of times, then falls back to a
+CPU-pinned worker so a real (if modest) number always lands. On total
+failure it still emits a single JSON error line, never a raw traceback.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -23,7 +32,7 @@ import numpy as np
 
 def _gen_inputs(batch: int, msg_len: int, cache_path: str):
     """Generate (or load cached) valid signature batches."""
-    if os.path.exists(cache_path):
+    if cache_path and os.path.exists(cache_path):
         z = np.load(cache_path)
         if z["msgs"].shape == (batch, msg_len):
             return z["msgs"], z["lens"], z["sigs"], z["pubs"]
@@ -46,24 +55,44 @@ def _gen_inputs(batch: int, msg_len: int, cache_path: str):
         msgs[b] = m
         sigs[b] = np.frombuffer(sig, np.uint8)
         pubs[b] = np.frombuffer(pub, np.uint8)
-    np.savez(cache_path, msgs=msgs, lens=lens, sigs=sigs, pubs=pubs)
+    if cache_path:
+        np.savez(cache_path, msgs=msgs, lens=lens, sigs=sigs, pubs=pubs)
     return msgs, lens, sigs, pubs
 
 
-def main():
-    batch = int(os.environ.get("FD_BENCH_BATCH", "8192"))
+def worker(cpu: bool) -> int:
+    """Measure on the attached device (or pinned CPU); print the JSON line."""
+    if cpu:
+        # Pin BEFORE importing jax — sitecustomize force-registers the axon
+        # TPU plugin via jax.config (see tests/conftest.py), so override the
+        # config, not just the env.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        batch = int(os.environ.get("FD_BENCH_BATCH_CPU", "2048"))
+        reps = int(os.environ.get("FD_BENCH_REPS_CPU", "3"))
+    else:
+        batch = int(os.environ.get("FD_BENCH_BATCH", "8192"))
+        reps = int(os.environ.get("FD_BENCH_REPS", "10"))
     msg_len = int(os.environ.get("FD_BENCH_MSG_LEN", "192"))
-    reps = int(os.environ.get("FD_BENCH_REPS", "10"))
 
     import jax
     import jax.numpy as jnp
 
+    if cpu:
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     from firedancer_tpu.ops.verify import verify_batch
 
     dev = jax.devices()[0]
-    msgs, lens, sigs, pubs = _gen_inputs(
-        batch, msg_len, os.path.join(os.path.dirname(__file__), ".bench_cache.npz")
+    print(f"bench worker: device={dev} batch={batch} reps={reps}", file=sys.stderr)
+    cache = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), f".bench_cache_{batch}_{msg_len}.npz"
     )
+    msgs, lens, sigs, pubs = _gen_inputs(batch, msg_len, cache)
     args = tuple(
         jax.device_put(jnp.asarray(a), dev) for a in (msgs, lens, sigs, pubs)
     )
@@ -77,7 +106,7 @@ def main():
         print(json.dumps({"metric": "ed25519_verify_throughput", "value": 0,
                           "unit": "verifies/s", "vs_baseline": 0.0,
                           "error": "correctness check failed"}))
-        sys.exit(1)
+        return 1
 
     t0 = time.perf_counter()
     for _ in range(reps):
@@ -86,7 +115,7 @@ def main():
     dt = time.perf_counter() - t0
     rate = batch * reps / dt
 
-    print(json.dumps({
+    rec = {
         "metric": "ed25519_verify_throughput",
         "value": round(rate, 1),
         "unit": "verifies/s",
@@ -97,8 +126,77 @@ def main():
         "device": str(dev),
         "compile_s": round(compile_s, 1),
         "ms_per_batch": round(1e3 * dt / reps, 2),
+    }
+    if cpu:
+        rec["cpu_fallback"] = True
+    print(json.dumps(rec))
+    return 0
+
+
+def _run_worker(cpu: bool, timeout_s: float) -> dict | None:
+    """Spawn a worker subprocess; return its parsed JSON line or None."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
+    if cpu:
+        cmd.append("--cpu")
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        print(f"bench: worker timed out after {timeout_s:.0f}s "
+              f"(cpu={cpu})", file=sys.stderr)
+        return None
+    if proc.stderr:
+        sys.stderr.write(proc.stderr[-2000:])
+    if proc.returncode != 0:
+        # A failing worker (e.g. correctness check failed) must count as a
+        # failed attempt — retry / fall back rather than relaying its JSON.
+        print(f"bench: worker rc={proc.returncode} (cpu={cpu})",
+              file=sys.stderr)
+        return None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    print(f"bench: worker rc={proc.returncode}, no JSON line", file=sys.stderr)
+    return None
+
+
+def main() -> int:
+    attempts = int(os.environ.get("FD_BENCH_RETRIES", "2"))
+    attempt_timeout = float(os.environ.get("FD_BENCH_ATTEMPT_TIMEOUT", "480"))
+    errors = []
+    for i in range(attempts):
+        rec = _run_worker(cpu=False, timeout_s=attempt_timeout)
+        if rec is not None:
+            print(json.dumps(rec))
+            return 0
+        errors.append(f"tpu attempt {i + 1} failed/timed out")
+        if i + 1 < attempts:
+            time.sleep(15.0)
+    # TPU unreachable (wedged tunnel): land a CPU-pinned number so the round
+    # still records a real measurement, flagged as a fallback.
+    rec = _run_worker(cpu=True, timeout_s=float(
+        os.environ.get("FD_BENCH_CPU_TIMEOUT", "900")))
+    if rec is not None:
+        rec["error"] = "; ".join(errors) + " (tpu backend unavailable)"
+        print(json.dumps(rec))
+        return 0
+    print(json.dumps({
+        "metric": "ed25519_verify_throughput",
+        "value": 0,
+        "unit": "verifies/s",
+        "vs_baseline": 0.0,
+        "error": "; ".join(errors) + "; cpu fallback also failed",
     }))
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        sys.exit(worker(cpu="--cpu" in sys.argv))
+    sys.exit(main())

@@ -161,8 +161,24 @@ def _parse_syms(elf: bytes, symtab: _Shdr, shdrs: List[_Shdr]) -> List[_Sym]:
     return syms
 
 
-def load_program(elf: bytes) -> SbpfProgram:
-    """Validate, place, and relocate an sBPF ELF (fd_sbpf_program_load)."""
+def load_program(
+    elf: bytes, syscall_hashes: Optional[set] = None
+) -> SbpfProgram:
+    """Validate, place, and relocate an sBPF ELF (fd_sbpf_program_load).
+
+    syscall_hashes: known syscall-name hashes; any calldest whose pc hash
+    collides with one is rejected at load time, matching the reference's
+    REQUIRE (fd_sbpf_loader.c:923-938 rejects hash collisions between
+    registered calldests and the syscall registry). None -> the builtin
+    VM syscall set.
+    """
+    if syscall_hashes is None:
+        from firedancer_tpu.flamenco.vm.interp import (
+            BUILTIN_SYSCALLS,
+            syscall_hash,
+        )
+
+        syscall_hashes = {syscall_hash(n) for n in BUILTIN_SYSCALLS}
     shdrs, e_entry = _parse_shdrs(elf)
     text = next((s for s in shdrs if s.name == ".text"), None)
     if text is None or text.size == 0 or text.size % 8:
@@ -217,6 +233,13 @@ def load_program(elf: bytes) -> SbpfProgram:
                 rel_syms[r_sym] if r_sym < len(rel_syms) else None,
                 calldests,
             )
+
+    collisions = set(calldests) & syscall_hashes
+    if collisions:
+        raise SbpfLoaderError(
+            f"calldest pc hash collides with syscall hash: "
+            f"{sorted(hex(h) for h in collisions)}"
+        )
 
     # entrypoint: e_entry vaddr (invalid -> reject, as the reference
     # loader does), else the `entrypoint` symbol, else slot 0

@@ -131,6 +131,12 @@ class PipelineResult:
     bank_hist: Dict[int, int]
     diag: Dict[str, Dict[str, int]]
     elapsed_s: float
+    # End-to-end latency (source stamp -> sink), ns; 0 if no samples.
+    latency_p50_ns: int = 0
+    latency_p99_ns: int = 0
+    # Per-verify-lane async offload shim counters (batches dispatched,
+    # max-wait flushes, in-flight-cap stalls).
+    verify_stats: List[Dict[str, int]] = field(default_factory=list)
 
 
 def _run_tiles(
@@ -216,7 +222,7 @@ def _run_tiles(
             return False
         for i, v in enumerate(verifies):
             src_seq = src_outs[i].seq if i < len(src_outs) else 0
-            if v.in_link.seq < src_seq or v._pending:
+            if v.in_link.seq < src_seq or v._pending or v._inflight:
                 return False
             if dedup.in_links[i].seq < v.out_link.seq:
                 return False
@@ -235,8 +241,13 @@ def _run_tiles(
     # without the kill-the-namespace part).
     for t in tiles:
         t.cnc.signal(CNC_HALT)
+    # Tiles may still be draining async device batches in on_halt; the
+    # workspace must stay mapped until every tile thread is dead (a write
+    # into an unmapped dcache is a segfault, not an error). tile_max_ns
+    # bounds how long a wedged tile can hold us here.
+    join_deadline = time.perf_counter() + timeout_s + 35.0
     for th in threads:
-        th.join(timeout=10.0)
+        th.join(timeout=max(0.1, join_deadline - time.perf_counter()))
     if post_wait is not None:
         post_wait()
     elapsed = time.perf_counter() - t0
@@ -244,14 +255,26 @@ def _run_tiles(
     from firedancer_tpu.disco.monitor import snapshot
 
     diag = snapshot(wksp, pod)
+    lat = sorted(sink.latencies_ns)
     res = PipelineResult(
         recv_cnt=sink.recv_cnt,
         recv_sz=sink.recv_sz,
         bank_hist=dict(sink.bank_hist),
         diag=diag,
         elapsed_s=elapsed,
+        latency_p50_ns=lat[len(lat) // 2] if lat else 0,
+        latency_p99_ns=lat[(len(lat) * 99) // 100] if lat else 0,
+        verify_stats=[
+            {
+                "batches": v.stat_batches,
+                "flush_timeout": v.stat_flush_timeout,
+                "inflight_stall": v.stat_inflight_stall,
+            }
+            for v in verifies
+        ],
     )
-    wksp.leave()
+    if all(not th.is_alive() for th in threads):
+        wksp.leave()  # else: leak the mapping rather than segfault a thread
     return res
 
 

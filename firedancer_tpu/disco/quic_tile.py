@@ -27,6 +27,7 @@ from firedancer_tpu.disco.tiles import (
     Tile,
     meta_sig,
 )
+from firedancer_tpu.tango import tempo
 from firedancer_tpu.tango.quic.quic import Quic, QuicConfig
 from firedancer_tpu.tango.udpsock import UdpSock
 
@@ -90,7 +91,8 @@ class QuicTile(Tile):
                 self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
                 return  # keep servicing the socket; retry next step
             payload = self._ready.popleft()
-            self.out_link.publish(payload, meta_sig(payload))
+            self.out_link.publish(payload, meta_sig(payload),
+                                  tsorig=tempo.tickcount() & 0xFFFFFFFF)
             self.pub_cnt += 1
             self.pub_sz += len(payload)
         if not self.quic.conns and not self._ready:

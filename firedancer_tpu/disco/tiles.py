@@ -368,7 +368,8 @@ class ReplayTile(Tile):
             time.sleep(20e-6)
             return
         payload = self.payloads[self.pos]
-        lane.publish(payload, meta_sig(payload))
+        lane.publish(payload, meta_sig(payload),
+                     tsorig=tempo.tickcount() & 0xFFFFFFFF)
         self.pos += 1
         self.pub_cnt += 1
         self.pub_sz += len(payload)
@@ -390,14 +391,31 @@ def _txn_batch_arrays(items, max_len: int):
     return msgs, lens, sigs, pubs
 
 
+@dataclass
+class _InflightBatch:
+    """One dispatched device batch awaiting completion (the software analog
+    of a wiredancer DMA slot, wd_f1.c:327-408: request pushed async, result
+    later completed into the consumer mcache keyed by seq)."""
+
+    out: object                    # jax.Array of statuses, dispatched async
+    todo: list                     # [(payload, n_items, tsorig)] whole txns
+    oversize: list                 # per-lane True if msg exceeded staging
+    t_dispatch: int                # tickcount at dispatch (diag)
+
+
 class VerifyTile(Tile):
     """Sigverify: parse txn in-tile, ha-dedup, verify signatures, forward.
 
     backend='oracle' verifies per-txn on CPU (the bit-exact reference
     path); backend='tpu' accumulates a batch and dispatches the fused
-    verify_batch XLA program (the wiredancer-style offload — batch is the
-    SIMD lane axis). Failed/parse-error/duplicate txns are dropped and
-    counted in the cnc diag (SV/HA filter slots).
+    verify_batch XLA program ASYNCHRONOUSLY (the wiredancer offload shim,
+    wd_f1.c:327-408): up to `inflight` batches are in flight on the device
+    while the tile keeps draining its in-ring; completions are polled
+    non-blockingly (jax async dispatch + Array.is_ready) and published
+    into the out mcache in dispatch order. A partial batch older than
+    `max_wait_us` is flushed so trickle traffic has bounded latency.
+    Failed/parse-error/duplicate txns are dropped and counted in the cnc
+    diag (SV/HA filter slots).
     """
 
     name = "verify"
@@ -412,6 +430,8 @@ class VerifyTile(Tile):
         batch: int = 128,
         max_msg_len: int = FD_TPU_MTU,
         tcache_depth: int = 4096,
+        inflight: int = 2,
+        max_wait_us: int = 500,
         **kw,
     ):
         super().__init__(wksp, cnc_name, in_link=in_link, out_link=out_link, **kw)
@@ -420,14 +440,24 @@ class VerifyTile(Tile):
         self.batch = batch
         self.max_msg_len = max_msg_len
         self.ha_tcache = TCache(tcache_depth)
-        self._pending: list = []  # (payload, frag, verify items)
+        self.inflight_max = max(1, inflight)
+        self.max_wait_ns = max_wait_us * 1_000
+        self._pending: list = []       # [(payload, items, tsorig)]
+        self._pending_lanes = 0
+        self._pending_since = 0        # tickcount of oldest pending txn
+        self._inflight: list = []      # FIFO of _InflightBatch
         self._verify_batch_fn = None
+        # dispatch/completion stats (read by monitor/bench)
+        self.stat_batches = 0
+        self.stat_flush_timeout = 0
+        self.stat_inflight_stall = 0
         if backend == "tpu":
             import jax
             import jax.numpy as jnp
 
             from firedancer_tpu.ops.verify import verify_batch
 
+            self._jnp = jnp
             self._verify_batch_fn = jax.jit(verify_batch)
             # Pre-warm: compile the fixed (batch, max_msg_len) shape now so
             # the run loop never stalls on first-flush compilation (the
@@ -459,56 +489,126 @@ class VerifyTile(Tile):
             ok = all(
                 oracle.verify(msg, sig, pub) == 0 for (sig, pub, msg) in items
             )
-            self._finish(payload, ok)
-        else:
-            self._pending.append((payload, items))
-            if len(self._pending) >= self.batch:
-                self._flush()
+            self._finish(payload, ok, tsorig=frag.tsorig)
+            return
+        if len(items) > self.batch:
+            # A txn with more sigs than device lanes (can't happen under
+            # the MTU, but don't trust the wire): verify on the oracle.
+            ok = all(
+                oracle.verify(msg, sig, pub) == 0 for (sig, pub, msg) in items
+            )
+            self._finish(payload, ok, tsorig=frag.tsorig)
+            return
+        if not self._pending:
+            self._pending_since = tempo.tickcount()
+        self._pending.append((payload, items, frag.tsorig))
+        self._pending_lanes += len(items)
+        if self._pending_lanes >= self.batch:
+            self._dispatch()
+        self._complete(block=False)
 
     def on_idle(self) -> None:
+        if self._inflight:
+            self._complete(block=False)
         if self._pending:
-            self._flush()
+            if self._pending_lanes >= self.batch:
+                self._dispatch()
+            elif tempo.tickcount() - self._pending_since >= self.max_wait_ns:
+                self.stat_flush_timeout += 1
+                self._dispatch(force=True)
 
-    def _flush(self) -> None:
-        import jax.numpy as jnp
+    def on_housekeep(self) -> None:
+        # The housekeeping interval is the latency backstop when the tile
+        # sits in the frag-drain fast path and never goes idle.
+        if self._pending and (
+            tempo.tickcount() - self._pending_since >= self.max_wait_ns
+        ):
+            self.stat_flush_timeout += 1
+            self._dispatch(force=True)
 
-        todo = self._pending
-        self._pending = []
-        flat = []
-        spans = []
-        for payload, items in todo:
-            spans.append((len(flat), len(items)))
-            flat.extend(items)
-        # Pad the lane count to the fixed batch so jit compiles once.
-        n = len(flat)
-        padded = flat + [(b"\x00" * 64, b"\x00" * 32, b"")] * (
-            (-n) % self.batch
-        )
-        statuses = np.empty(len(padded), np.int32)
-        for off in range(0, len(padded), self.batch):
+    def on_halt(self) -> None:
+        # Drain device work so no async computation outlives the tile;
+        # results are published best-effort (publish_backp drops on HALT).
+        if self._pending and self.backend == "tpu":
+            self._dispatch(force=True)
+        self._complete(block=True, drain_all=True)
+
+    # -- async offload shim ----------------------------------------------
+
+    def _dispatch(self, force: bool = False) -> None:
+        """Ship pending txns to the device as fixed-shape batches without
+        waiting for results (jax dispatches asynchronously). Whole txns
+        only per batch — a txn's sigs never straddle two batches, so each
+        completion is self-contained. Unless force, a trailing partial
+        batch stays pending (it ships on batch-full or max-wait)."""
+        jnp = self._jnp
+        while self._pending and (force or self._pending_lanes >= self.batch):
+            # Txns stay in _pending until the in-flight record exists: the
+            # supervisor's quiescence check reads `_pending or _inflight`
+            # from another thread, and a batch held only in locals would be
+            # invisible to it — HALT could race in and drop the batch.
+            take = 0
+            flat = []
+            for _, items, _ in self._pending:
+                if len(flat) + len(items) > self.batch:
+                    break
+                flat.extend(items)
+                take += 1
+            todo = [
+                (payload, len(items), tsorig)
+                for payload, items, tsorig in self._pending[:take]
+            ]
+            # Back-pressure the shim, not the device: cap in-flight batches
+            # (wiredancer polls the DMA fill level, wd_f1.c:352-358).
+            while len(self._inflight) >= self.inflight_max:
+                self.stat_inflight_stall += 1
+                self._complete(block=True)
+            pad = [(b"\x00" * 64, b"\x00" * 32, b"")] * (self.batch - len(flat))
             msgs, lens, sigs, pubs = _txn_batch_arrays(
-                padded[off : off + self.batch], self.max_msg_len
+                flat + pad, self.max_msg_len
             )
             out = self._verify_batch_fn(
                 jnp.asarray(msgs), jnp.asarray(lens), jnp.asarray(sigs),
                 jnp.asarray(pubs),
             )
-            statuses[off : off + self.batch] = np.asarray(out)
-        # A message longer than the staging width cannot be verified on
-        # device; fail it rather than trusting a truncated hash.
-        for i, (_, _, msg) in enumerate(flat):
-            if len(msg) > self.max_msg_len:
-                statuses[i] = -3  # FD_ED25519_ERR_MSG
-        for (payload, _), (start, cnt) in zip(todo, spans):
-            ok = bool((statuses[start : start + cnt] == 0).all()) and cnt > 0
-            self._finish(payload, ok)
+            # A message longer than the staging width cannot be verified on
+            # device; fail it rather than trusting a truncated hash.
+            oversize = [len(msg) > self.max_msg_len for (_, _, msg) in flat]
+            self._inflight.append(_InflightBatch(
+                out=out, todo=todo, oversize=oversize,
+                t_dispatch=tempo.tickcount(),
+            ))
+            self.stat_batches += 1
+            del self._pending[:take]
+            self._pending_lanes -= len(flat)
+            if self._pending:
+                self._pending_since = tempo.tickcount()
 
-    def _finish(self, payload: bytes, ok: bool) -> None:
+    def _complete(self, block: bool, drain_all: bool = False) -> None:
+        """Retire finished device batches in dispatch order, publishing
+        results downstream (the completion half of the wiredancer shim)."""
+        while self._inflight:
+            ib = self._inflight[0]
+            if not block and not ib.out.is_ready():
+                return
+            statuses = np.asarray(ib.out)  # blocks only if not ready
+            self._inflight.pop(0)
+            off = 0
+            for payload, cnt, tsorig in ib.todo:
+                lane = statuses[off : off + cnt]
+                over = any(ib.oversize[off : off + cnt])
+                ok = cnt > 0 and not over and bool((lane == 0).all())
+                self._finish(payload, ok, tsorig=tsorig)
+                off += cnt
+            if not drain_all:
+                return  # retire at most one per call; keep the loop hot
+
+    def _finish(self, payload: bytes, ok: bool, tsorig: int = 0) -> None:
         if not ok:
             self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, 1)
             self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, len(payload))
             return
-        self.publish_backp(payload, meta_sig(payload))
+        self.publish_backp(payload, meta_sig(payload), tsorig=tsorig)
 
 
 class DedupTile(Tile):
@@ -550,6 +650,7 @@ class PackTile(Tile):
         self.bank_cnt = bank_cnt
         self._next_txn_id = 0
         self._payloads: dict = {}
+        self._tsorig: dict = {}
         self._rr_bank = 0
 
     def on_frag(self, frag: Frag, payload: bytes) -> None:
@@ -583,6 +684,7 @@ class PackTile(Tile):
             readonly=readonly,
         )
         self._payloads[tid] = payload
+        self._tsorig[tid] = frag.tsorig
         self.pack.insert(pt)
         self._drain()
 
@@ -618,7 +720,8 @@ class PackTile(Tile):
             misses = 0
             payload = self._payloads.pop(txn.txn_id)
             sig = (bank << 48) | (txn.txn_id & 0xFFFFFFFFFFFF)
-            self.publish_backp(payload, sig, count_diag=False)
+            self.publish_backp(payload, sig, count_diag=False,
+                               tsorig=self._tsorig.pop(txn.txn_id, 0))
             # Bank execution is immediate in the slice: release locks.
             self.pack.complete(bank, txn.txn_id)
 
@@ -633,11 +736,27 @@ class SinkTile(Tile):
         self.recv_cnt = 0
         self.recv_sz = 0
         self.bank_hist: dict = {}
+        # End-to-end latency samples (ns, 32-bit wrap-safe under ~4.29 s):
+        # source tsorig stamp -> sink arrival. Feeds the p50/p99 the bench
+        # and replay gate report. Bounded reservoir (algorithm R) so a
+        # long soak stays at constant memory.
+        self.latencies_ns: list = []
+        self.latency_sample_cap = 65536
+        self._latency_seen = 0
 
     def on_frag(self, frag: Frag, payload: bytes) -> None:
         self.recv_cnt += 1
         self.recv_sz += frag.sz
         bank = frag.sig >> 48
         self.bank_hist[bank] = self.bank_hist.get(bank, 0) + 1
+        if frag.tsorig:
+            lat = (tempo.tickcount() - frag.tsorig) & 0xFFFFFFFF
+            self._latency_seen += 1
+            if len(self.latencies_ns) < self.latency_sample_cap:
+                self.latencies_ns.append(lat)
+            else:
+                j = self.rng.roll(self._latency_seen)
+                if j < self.latency_sample_cap:
+                    self.latencies_ns[j] = lat
         self.in_cur.fseq.diag_add(DIAG_PUB_CNT, 1)
         self.in_cur.fseq.diag_add(DIAG_PUB_SZ, frag.sz)

@@ -195,16 +195,6 @@ class Vm:
         backing, off = self.translate(vaddr, len(data), write=True)
         backing[off : off + len(data)] = data
 
-    def read_cstr(self, vaddr: int, max_sz: int = 4096) -> bytes:
-        """Read a NUL- or region-bounded string (for log/panic syscalls)."""
-        backing, off, _ = self._region(vaddr)
-        if backing is None:
-            raise VmError(ERR_SIGSEGV, f"vaddr=0x{vaddr:x}")
-        end = min(len(backing), off + max_sz)
-        chunk = bytes(backing[off:end])
-        nul = chunk.find(b"\0")
-        return chunk if nul < 0 else chunk[:nul]
-
     # -- CU metering ------------------------------------------------------
 
     def consume(self, n: int) -> None:

@@ -222,6 +222,8 @@ def double_scalarmult(h_bytes, a_point, s_bytes, n_windows: int = 64):
     r3, _ = jax.lax.scan(
         step, ident[:3], (hw[::-1][:n_windows], sw[::-1][:n_windows])
     )
-    # T of the result is never used (compress reads X/Y/Z only); return a
-    # placeholder zero so the point stays a uniform 4-tuple.
-    return (*r3, fe.fe_zero(batch))
+    # T of the result is never computed (compress reads X/Y/Z only).
+    # Return None as a sentinel rather than a plausible-looking zero so any
+    # future consumer that feeds this into point_add (which reads T) fails
+    # loudly instead of silently computing a wrong point.
+    return (*r3, None)

@@ -157,6 +157,10 @@ def double_scalarmult_pallas(h_bytes, a_point, s_bytes, interpret=False,
     hw = ge._windows_from_bytes(h_bytes)      # (64, B)
     sw = ge._windows_from_bytes(s_bytes)
     bsz = hw.shape[1]
+    if bsz == 0:
+        # Match the XLA path: an empty batch yields empty limb arrays.
+        empty = jnp.zeros((NLIMBS, 0), jnp.int32)
+        return (empty, empty, empty, None)
     lanes = min(LANES, bsz)
     pad = (-bsz) % lanes
     if pad:
@@ -180,4 +184,6 @@ def double_scalarmult_pallas(h_bytes, a_point, s_bytes, interpret=False,
     )(*a_point, hw, sw, jnp.asarray(_btab_const()))
     if pad:
         x, y, z = x[:, :bsz], y[:, :bsz], z[:, :bsz]
-    return (x, y, z, fe.fe_zero((bsz,)))
+    # T sentinel: None, matching curve25519.double_scalarmult (compress
+    # reads X/Y/Z only; point_add would read T and must fail loudly).
+    return (x, y, z, None)

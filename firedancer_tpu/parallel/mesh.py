@@ -33,6 +33,14 @@ from ..ops.verify import verify_batch
 def make_mesh(n_devices: int | None = None, axis: str = "dp") -> Mesh:
     devs = jax.devices()
     if n_devices is not None:
+        if len(devs) < n_devices:
+            raise RuntimeError(
+                f"make_mesh: requested {n_devices} devices but only "
+                f"{len(devs)} available ({devs[0].platform}); refusing to "
+                "silently shrink the mesh — set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N for a "
+                "virtual CPU mesh"
+            )
         devs = devs[:n_devices]
     import numpy as np
 

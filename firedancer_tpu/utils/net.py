@@ -151,5 +151,6 @@ def parse_udp_frame(frame: bytes, verify_checksum: bool = True):
     ip, rest = Ip4Hdr.parse(rest, verify_checksum=verify_checksum)
     if ip.protocol != IP4_PROTO_UDP:
         raise NetError(f"not udp (proto {ip.protocol})")
-    udp, payload = UdpHdr.parse(rest, ip.src, ip.dst)
+    udp, payload = UdpHdr.parse(rest, ip.src, ip.dst,
+                                verify_checksum=verify_checksum)
     return eth, ip, udp, payload

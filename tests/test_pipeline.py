@@ -71,6 +71,24 @@ def test_pipeline_end_to_end(tmp_path, backend):
             assert d["ovrnr_cnt"] == 0 and d["ovrnp_cnt"] == 0, (name, d)
 
 
+def test_pipeline_async_shim_multibatch(tmp_path):
+    """tpu backend with a small fixed batch: several async batches go in
+    flight (the wiredancer offload shim), the trailing partial batch is
+    flushed by the max-wait timer, and end-to-end latency percentiles are
+    reported from the tsorig stamps."""
+    n = 30
+    _, payloads = _mk_txns(n, 0, 0, seed=7)
+    topo = build_topology(str(tmp_path / "a.wksp"), depth=64)
+    res = run_pipeline(
+        topo, payloads, verify_backend="tpu",
+        verify_batch=8, verify_max_msg_len=192, timeout_s=240.0,
+    )
+    assert res.recv_cnt == n, res.diag
+    vs = res.verify_stats[0]
+    assert vs["batches"] >= 4, vs  # 30 one-sig txns / 8 lanes
+    assert res.latency_p99_ns >= res.latency_p50_ns > 0
+
+
 def test_pipeline_conflicting_accounts_serialize(tmp_path):
     """Txns write-locking one shared account all deliver (locks release),
     and the pack tile never double-schedules a conflict (admissibility is
