@@ -671,15 +671,25 @@ class PackTile(Tile):
             for i in range(txn.acct_cnt)
             if not txn.is_writable(i)
         )
-        programs = [
-            txn.account(payload, ix.program_id_index) for ix in txn.instrs
-        ]
+        from firedancer_tpu.ballet.compute_budget import (
+            estimate_rewards_and_compute,
+        )
+
+        rce = estimate_rewards_and_compute(
+            txn, payload, lamports_per_signature=5000, estimator=self.est
+        )
+        if rce is None:
+            # Malformed ComputeBudgetProgram instruction: whole txn fails
+            # (fd_pack.c:298-299 drops it at insert time).
+            self.in_cur.fseq.diag_add(DIAG_FILT_CNT, 1)
+            return
+        rewards, est_cus, _cu_limit = rce
         tid = self._next_txn_id
         self._next_txn_id += 1
         pt = PackTxn(
             txn_id=tid,
-            rewards=5000 + len(payload),  # base fee stand-in
-            est_cus=self.est.estimate(programs),
+            rewards=rewards,
+            est_cus=est_cus,
             writable=writable,
             readonly=readonly,
         )

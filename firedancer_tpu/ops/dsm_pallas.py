@@ -34,12 +34,14 @@ def _fe_mul(a, b):
     return fe.fe_mul_unrolled(a, b)
 
 
-def _point_add(p, q, need_t=True):
+def _point_add(p, q, d2, need_t=True):
+    """d2 = limbs of 2*d mod p, (NLIMBS, 1) — passed as a kernel input
+    (Pallas rejects kernels that close over constant arrays)."""
     x1, y1, z1, t1 = p
     x2, y2, z2, t2 = q
     a = _fe_mul(fe.fe_sub(y1, x1), fe.fe_sub(y2, x2))
     b = _fe_mul(fe.fe_add(y1, x1), fe.fe_add(y2, x2))
-    c = _fe_mul(_fe_mul(t1, t2), fe.FE_D2)
+    c = _fe_mul(_fe_mul(t1, t2), d2)
     zz = _fe_mul(z1, z2)
     d_ = fe.fe_add(zz, zz)
     e = fe.fe_sub(b, a)
@@ -87,6 +89,8 @@ def _lookup(table, w_row):
 def _dsm_kernel(ax, ay, az, at, hw, sw, btab, ox, oy, oz, *, n_windows=64):
     lanes = ax.shape[1]
     a_pt = (ax[...], ay[...], az[...], at[...])
+    # Column 64 of btab carries the 2*d curve constant (see _btab_const).
+    d2 = btab[:, 64:65]
 
     # per-lane A table: [0]=identity, [1]=A, [j]=dbl/add chain (VMEM)
     a_table = [_identity(lanes), a_pt]
@@ -94,7 +98,7 @@ def _dsm_kernel(ax, ay, az, at, hw, sw, btab, ox, oy, oz, *, n_windows=64):
         if j % 2 == 0:
             a_table.append(_point_double(a_table[j // 2]))
         else:
-            a_table.append(_point_add(a_table[j - 1], a_pt))
+            a_table.append(_point_add(a_table[j - 1], a_pt, d2))
 
     # shared B table: btab is (32, 64) — column 4*t+c = coord c of t*B
     b_table = []
@@ -115,8 +119,8 @@ def _dsm_kernel(ax, ay, az, at, hw, sw, btab, ox, oy, oz, *, n_windows=64):
         idx = 63 - wi
         wh = hw[pl.ds(idx, 1), :]                     # (1, L)
         ws = sw[pl.ds(idx, 1), :]
-        r = _point_add(r, _lookup(a_table, wh), need_t=True)
-        x, y, z, _ = _point_add(r, _lookup(b_table, ws), need_t=False)
+        r = _point_add(r, _lookup(a_table, wh), d2, need_t=True)
+        x, y, z, _ = _point_add(r, _lookup(b_table, ws), d2, need_t=False)
         return (x, y, z)
 
     # MSB-first: wi=0 processes window 63, matching the XLA scan order.
@@ -128,7 +132,9 @@ def _dsm_kernel(ax, ay, az, at, hw, sw, btab, ox, oy, oz, *, n_windows=64):
 
 @functools.lru_cache(maxsize=1)
 def _btab_const() -> np.ndarray:
-    """(32, 64) int32: column 4*t+c holds limb vector of coord c of t*B."""
+    """(32, 65) int32: column 4*t+c holds limb vector of coord c of t*B;
+    column 64 holds the limbs of the 2*d curve constant (threaded into
+    the kernel as data — Pallas kernels cannot capture constant arrays)."""
     from firedancer_tpu.ballet.ed25519 import oracle as _oracle
 
     P = fe.P
@@ -136,11 +142,14 @@ def _btab_const() -> np.ndarray:
     for _ in range(15):
         pts.append(_oracle.point_add(pts[-1], _oracle.B) if pts[-1] != (0, 1)
                    else _oracle.B)
-    out = np.zeros((NLIMBS, 64), np.int32)
+    out = np.zeros((NLIMBS, 65), np.int32)
     for t, (x, y) in enumerate(pts):
         for c, val in enumerate((x, y, 1, x * y % P)):
             for i in range(NLIMBS):
                 out[i, 4 * t + c] = (val >> (8 * i)) & 0xFF
+    d2 = 2 * fe.D_INT % P
+    for i in range(NLIMBS):
+        out[i, 64] = (d2 >> (8 * i)) & 0xFF
     return out
 
 
@@ -171,7 +180,7 @@ def double_scalarmult_pallas(h_bytes, a_point, s_bytes, interpret=False,
 
     spec_fe = pl.BlockSpec((NLIMBS, lanes), lambda i: (0, i))
     spec_w = pl.BlockSpec((64, lanes), lambda i: (0, i))
-    spec_btab = pl.BlockSpec((NLIMBS, 64), lambda i: (0, 0))
+    spec_btab = pl.BlockSpec((NLIMBS, 65), lambda i: (0, 0))
     out_shape = jax.ShapeDtypeStruct((NLIMBS, bsz + pad), jnp.int32)
 
     x, y, z = pl.pallas_call(

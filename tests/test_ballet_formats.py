@@ -238,3 +238,99 @@ def test_tcache_dedup_and_eviction():
     assert not tc.insert(4)       # evicts 1 (oldest; dup hit didn't refresh)
     assert not tc.insert(1)       # 1 was evicted
     assert tc.hit_cnt == 1 and tc.miss_cnt == 5
+
+
+# ---------------------------------------------------------------------------
+# ComputeBudgetProgram parsing (reference fd_compute_budget_program.h)
+
+
+def test_compute_budget_program_id():
+    from firedancer_tpu.ballet.compute_budget import COMPUTE_BUDGET_PROGRAM_ID
+
+    # base58 decode of ComputeBudget111111111111111111111111111111
+    assert COMPUTE_BUDGET_PROGRAM_ID.hex().startswith("0306466fe5211732")
+    assert len(COMPUTE_BUDGET_PROGRAM_ID) == 32
+
+
+def test_compute_budget_state_machine():
+    import struct
+
+    from firedancer_tpu.ballet.compute_budget import ComputeBudgetState
+
+    st = ComputeBudgetState()
+    assert st.parse_instr(b"\x02" + struct.pack("<I", 400_000))
+    assert st.parse_instr(b"\x03" + struct.pack("<Q", 1_000))
+    assert not st.parse_instr(b"\x02" + struct.pack("<I", 1))  # dup
+    rewards, cu = st.finalize(5)
+    assert cu == 400_000
+    assert rewards == (400_000 * 1_000 + 999_999) // 1_000_000
+
+    # RequestUnitsDeprecated sets both CU and the total fee directly.
+    st = ComputeBudgetState()
+    assert st.parse_instr(b"\x00" + struct.pack("<II", 300_000, 77))
+    assert not st.parse_instr(b"\x03" + struct.pack("<Q", 5))  # acts as FEE
+    assert st.finalize(3) == (77, 300_000)
+
+    # Defaults: 200k CU per non-budget instruction, no fee.
+    assert ComputeBudgetState().finalize(4) == (0, 800_000)
+
+    # Heap frames must be 1024-granular.
+    st = ComputeBudgetState()
+    assert not st.parse_instr(b"\x01" + struct.pack("<I", 1000))
+    st = ComputeBudgetState()
+    assert st.parse_instr(b"\x01" + struct.pack("<I", 2048))
+
+    # Unknown tag / short data are malformed.
+    assert not ComputeBudgetState().parse_instr(b"\x07\x00\x00\x00\x00")
+    assert not ComputeBudgetState().parse_instr(b"\x02\x00")
+
+
+def test_compute_budget_fee_saturates():
+    import struct
+
+    from firedancer_tpu.ballet.compute_budget import ComputeBudgetState
+
+    st = ComputeBudgetState()
+    assert st.parse_instr(b"\x02" + struct.pack("<I", 0xFFFFFFFF))
+    assert st.parse_instr(b"\x03" + struct.pack("<Q", 0xFFFFFFFFFFFFFFFF))
+    rewards, _ = st.finalize(2)
+    assert rewards == (1 << 64) - 1  # saturated, not wrapped
+
+
+def test_estimate_rewards_from_txn():
+    import struct
+
+    from firedancer_tpu.ballet.compute_budget import (
+        COMPUTE_BUDGET_PROGRAM_ID,
+        estimate_rewards_and_compute,
+    )
+
+    seed = bytes([9] * 32)
+    payload = build_txn(
+        signer_seeds=[seed],
+        extra_accounts=[COMPUTE_BUDGET_PROGRAM_ID, bytes([3] * 32)],
+        n_readonly_unsigned=2,
+        instrs=[
+            (1, [], b"\x02" + struct.pack("<I", 123_000)),
+            (1, [], b"\x03" + struct.pack("<Q", 2_000_000)),
+            (2, [0], b"payload"),
+        ],
+    )
+    txn = parse_txn(payload)
+    rewards, est_cus, cu_limit = estimate_rewards_and_compute(
+        txn, payload, lamports_per_signature=5000
+    )
+    assert cu_limit == 123_000
+    assert rewards == 5000 + (123_000 * 2_000_000) // 1_000_000
+    assert est_cus == 123_000  # no estimator -> CU limit
+
+    # Malformed budget instruction fails the whole txn.
+    bad = build_txn(
+        signer_seeds=[seed],
+        extra_accounts=[COMPUTE_BUDGET_PROGRAM_ID],
+        n_readonly_unsigned=1,
+        instrs=[(1, [], b"\x09bad")],
+    )
+    assert (
+        estimate_rewards_and_compute(parse_txn(bad), bad) is None
+    )
