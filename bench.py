@@ -60,6 +60,109 @@ def _gen_inputs(batch: int, msg_len: int, cache_path: str):
     return msgs, lens, sigs, pubs
 
 
+def _configure_jax_cache(jax) -> None:
+    """Shared persistent-compile-cache setup for every worker mode.
+
+    (Note: the axon tunnel's remote compiles bypass this cache; it still
+    pays off for CPU-pinned runs and any future local backends.)"""
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def replay_worker() -> int:
+    """The BASELINE correctness gate at scale: a mainnet-shaped corpus
+    through the FULL tile pipeline (replay -> verify[device] -> dedup ->
+    pack -> sink) on the attached device. Asserts the sink receives
+    exactly the unique valid txns (0 mismatches vs the by-construction
+    oracle statuses; see disco/corpus.py for the chain of trust) and
+    reports throughput + end-to-end p50/p99 latency. Prints ONE JSON
+    line like the main worker."""
+    import pickle
+    import tempfile
+
+    import jax
+
+    _configure_jax_cache(jax)
+
+    n = int(os.environ.get("FD_BENCH_REPLAY_N", "100000"))
+    vbatch = int(os.environ.get("FD_BENCH_REPLAY_BATCH", "8192"))
+    seed = 1234
+    # Cache key covers the generator code + txn builder + signer, so a
+    # stale corpus can't silently validate old payload semantics.
+    import hashlib
+    import inspect
+
+    import firedancer_tpu.ballet.txn as txn_mod
+    import firedancer_tpu.disco.corpus as corpus_mod
+    import firedancer_tpu.ops.sign as sign_mod
+
+    code_tag = hashlib.sha256()
+    for m in (corpus_mod, txn_mod, sign_mod):
+        code_tag.update(inspect.getsource(m).encode())
+    cache = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f".bench_corpus_{n}_{seed}_{code_tag.hexdigest()[:12]}.pkl",
+    )
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+
+    t0 = time.perf_counter()
+    if os.path.exists(cache):
+        with open(cache, "rb") as f:
+            corpus = pickle.load(f)
+        gen_s = 0.0
+    else:
+        corpus = mainnet_corpus(n, seed=seed)
+        gen_s = time.perf_counter() - t0
+        with open(cache, "wb") as f:
+            pickle.dump(corpus, f)
+
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    with tempfile.TemporaryDirectory() as d:
+        topo = build_topology(
+            os.path.join(d, "replay.wksp"), depth=4096, wksp_sz=1 << 27
+        )
+        t0 = time.perf_counter()
+        res = run_pipeline(
+            topo,
+            corpus.payloads,
+            verify_backend="tpu",
+            verify_batch=vbatch,
+            timeout_s=float(os.environ.get("FD_BENCH_REPLAY_TIMEOUT", "900")),
+            tcache_depth=1 << 18,  # dedup window must span the corpus
+            # Remote-tunnel dispatch is ~100s of ms per round trip: keep
+            # several batches in flight and let partial batches wait long
+            # enough for the host side to fill them.
+            verify_opts={"inflight": 4, "max_wait_us": 200_000},
+            record_digests=True,
+        )
+        run_s = time.perf_counter() - t0
+    # Content-exact gate (shared helper with tests/test_replay_gate.py).
+    from firedancer_tpu.disco.corpus import sink_mismatch_count
+
+    mismatches = sink_mismatch_count(corpus, res.sink_digests)
+    rec = {
+        "metric": "replay_pipeline_throughput",
+        "value": round(len(corpus.payloads) / run_s, 1),
+        "unit": "txns/s",
+        "vs_baseline": 0.0 if mismatches else 1.0,  # gate: 0 mismatches
+        "corpus": len(corpus.payloads),
+        "unique_ok": corpus.n_unique_ok,
+        "sink_recv": res.recv_cnt,
+        "mismatches": mismatches,
+        "latency_p50_ms": round(res.latency_p50_ns / 1e6, 2),
+        "latency_p99_ms": round(res.latency_p99_ns / 1e6, 2),
+        "gen_s": round(gen_s, 1),
+        "run_s": round(run_s, 1),
+        "verify_stats": res.verify_stats,
+    }
+    print(json.dumps(rec))
+    return 0 if mismatches == 0 else 1
+
+
 def worker(cpu: bool) -> int:
     """Measure on the attached device (or pinned CPU); print the JSON line."""
     if cpu:
@@ -79,11 +182,7 @@ def worker(cpu: bool) -> int:
 
     if cpu:
         jax.config.update("jax_platforms", "cpu")
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    _configure_jax_cache(jax)
 
     from firedancer_tpu.ops.verify import verify_batch
 
@@ -166,6 +265,40 @@ def _run_worker(cpu: bool, timeout_s: float) -> dict | None:
     return None
 
 
+def replay_main() -> int:
+    """Orchestrate the replay gate in a worker subprocess: the TPU tunnel
+    can wedge backend init indefinitely and an in-process hang is
+    uninterruptible (same rationale as main()), so the worker gets a hard
+    timeout and failures land as a JSON error line, never a traceback."""
+    timeout_s = float(os.environ.get("FD_BENCH_REPLAY_TOTAL_TIMEOUT", "3000"))
+    cmd = [sys.executable, os.path.abspath(__file__), "--replay-worker"]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        print(json.dumps({
+            "metric": "replay_pipeline_throughput", "value": 0,
+            "unit": "txns/s", "vs_baseline": 0.0,
+            "error": f"replay worker timed out after {timeout_s:.0f}s",
+        }))
+        return 1
+    if proc.stderr:
+        sys.stderr.write(proc.stderr[-2000:])
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            print(line)
+            return proc.returncode
+    print(json.dumps({
+        "metric": "replay_pipeline_throughput", "value": 0,
+        "unit": "txns/s", "vs_baseline": 0.0,
+        "error": f"replay worker rc={proc.returncode}, no JSON line",
+    }))
+    return 1
+
+
 def main() -> int:
     attempts = int(os.environ.get("FD_BENCH_RETRIES", "2"))
     attempt_timeout = float(os.environ.get("FD_BENCH_ATTEMPT_TIMEOUT", "480"))
@@ -197,6 +330,10 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--replay-worker" in sys.argv:
+        sys.exit(replay_worker())
+    if "--replay" in sys.argv or os.environ.get("FD_BENCH_MODE") == "replay":
+        sys.exit(replay_main())
     if "--worker" in sys.argv:
         sys.exit(worker(cpu="--cpu" in sys.argv))
     sys.exit(main())

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# CI gate: unit/integration tests + native ring stress + fuzz smoke.
+#
+# Mirrors the reference's CI shape (.github/workflows/make_test.yml:
+# build + run-unit-test across machine profiles; fuzz_artifacts.yml for
+# the fuzz targets). This environment has one profile (CPU-hosted JAX,
+# virtual 8-device mesh via tests/conftest.py) — sanitizer profiles are
+# N/A for the Python layer; the native layer builds with -fsanitize when
+# SAN=1.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== native build + stress =="
+if [ "${SAN:-0}" = "1" ]; then
+  make -C native CXXFLAGS="-O1 -g -Wall -Wextra -std=c++17 -fPIC -fsanitize=address,undefined" all
+else
+  make -C native all
+fi
+./build/tango_stress
+
+echo "== pytest =="
+python -m pytest tests/ -x -q
+
+echo "== fuzz smoke (10k iters/target) =="
+python fuzz/run_fuzz.py --iters 10000
+
+echo "== multichip dryrun (8-device CPU mesh) =="
+python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
+
+echo "CI OK"

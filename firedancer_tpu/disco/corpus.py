@@ -1,0 +1,223 @@
+"""Mainnet-shaped transaction corpus generation for the replay gate.
+
+The reference keeps real transaction fixtures (src/ballet/txn/fixtures/)
+and a pcap replay harness (src/disco/replay/fd_replay.h:4-6) for
+deterministic end-to-end runs; this environment has no mainnet pcaps, so
+the corpus is synthesized to the same shape instead:
+
+  * signer-count mix (mostly 1, tail of 2-4 — multisig),
+  * legacy and v0 (address-lookup-table) message formats,
+  * a fraction carrying ComputeBudgetProgram instructions with varied
+    priority fees (what fd_pack orders by),
+  * variable instruction-data sizes (so message lengths vary up to MTU),
+  * exact duplicates (the dedup tile's job),
+  * corrupted signatures / messages (the verify tile's job),
+  * truncated garbage (the parse path's job).
+
+Every valid signature comes from ops.sign.sign_batch — proven bit-exact
+against the RFC 8032 CPU oracle — so each payload's expected verify
+status is known BY CONSTRUCTION and the 100k gate doesn't need 100k
+half-second Python-oracle verifies. tests/test_replay_gate.py still
+spot-checks a random subsample against the live oracle to anchor the
+chain of trust.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+from firedancer_tpu.ballet.txn import build_txn
+
+OK = 0          # expected to verify and reach the sink (unless a dup)
+DUP = 1         # exact duplicate of an earlier payload: dedup drops it
+BAD_SIG = 2     # corrupted signature bytes: verify drops it
+BAD_PARSE = 3   # malformed wire bytes: parse drops it
+
+
+@dataclass
+class Corpus:
+    payloads: list            # wire bytes, shuffled
+    expected: np.ndarray      # per-payload class above (int8)
+    n_unique_ok: int          # distinct valid txns (sink should see these)
+
+
+def _deferred_signer(jobs: list):
+    """build_txn sign_fn that records (msg, seed) and leaves a hole."""
+
+    def sign_fn(msg: bytes, seed: bytes) -> bytes:
+        jobs.append((msg, seed))
+        return b"\x00" * 64
+
+    return sign_fn
+
+
+def _splice_signatures(payload: bytes, sigs: list) -> bytes:
+    """Replace the zero-hole signatures in a built txn."""
+    n = payload[0]
+    assert n < 0x80 and n == len(sigs)  # 1-byte compact-u16 for sig counts
+    out = bytearray(payload)
+    for i, sig in enumerate(sigs):
+        out[1 + 64 * i : 1 + 64 * (i + 1)] = sig
+    return bytes(out)
+
+
+def mainnet_corpus(
+    n: int,
+    seed: int = 0,
+    dup_rate: float = 0.05,
+    corrupt_rate: float = 0.03,
+    parse_err_rate: float = 0.01,
+    v0_rate: float = 0.3,
+    budget_rate: float = 0.6,
+    max_data_sz: int = 700,
+    sign_batch_size: int = 4096,
+) -> Corpus:
+    """Generate n unique valid txns plus dup/corrupt/garbage traffic."""
+    from firedancer_tpu.ballet.compute_budget import COMPUTE_BUDGET_PROGRAM_ID
+
+    rng = np.random.RandomState(seed)
+    jobs: list = []
+    sign_fn = _deferred_signer(jobs)
+    sig_spans: list = []      # payload index -> number of signatures
+    raw: list = []
+
+    # Mainnet-ish signer mix: ~87% single-sig.
+    signer_counts = rng.choice(
+        [1, 2, 3, 4], size=n, p=[0.87, 0.08, 0.03, 0.02]
+    )
+    for i in range(int(n)):
+        n_sign = int(signer_counts[i])
+        seeds = [
+            struct.pack("<IIB", i, j, seed & 0xFF) + bytes(23)
+            for j in range(n_sign)
+        ]
+        extra = [COMPUTE_BUDGET_PROGRAM_ID,
+                 rng.randint(0, 256, 32, dtype=np.uint8).tobytes(),
+                 rng.randint(0, 256, 32, dtype=np.uint8).tobytes()]
+        instrs = []
+        if rng.rand() < budget_rate:
+            instrs.append((n_sign, [],
+                           b"\x02" + struct.pack("<I", int(rng.randint(50_000, 1_400_000)))))
+            instrs.append((n_sign, [],
+                           b"\x03" + struct.pack("<Q", int(rng.randint(0, 3_000_000)))))
+        data_sz = int(rng.randint(8, max_data_sz))
+        instrs.append(
+            (n_sign + 1, [0],
+             rng.randint(0, 256, data_sz, dtype=np.uint8).tobytes())
+        )
+        kw = {}
+        if rng.rand() < v0_rate:
+            kw = dict(
+                version=0,
+                addr_luts=[(
+                    rng.randint(0, 256, 32, dtype=np.uint8).tobytes(),
+                    [int(rng.randint(0, 64))],
+                    [int(rng.randint(0, 64))],
+                )],
+            )
+        p = build_txn(
+            signer_seeds=seeds,
+            extra_accounts=extra,
+            n_readonly_unsigned=len(extra),
+            instrs=instrs,
+            recent_blockhash=rng.randint(0, 256, 32, dtype=np.uint8).tobytes(),
+            sign_fn=sign_fn,
+            **kw,
+        )
+        raw.append(p)
+        sig_spans.append(n_sign)
+
+    # Batch-sign every (msg, seed) job on the device.
+    all_sigs = _sign_jobs(jobs, batch=sign_batch_size)
+    payloads: list = []
+    pos = 0
+    for i, p in enumerate(raw):
+        k = sig_spans[i]
+        payloads.append(_splice_signatures(p, all_sigs[pos : pos + k]))
+        pos += k
+
+    out = [(p, OK) for p in payloads]
+
+    # Exact duplicates (dedup tile traffic).
+    for _ in range(int(n * dup_rate)):
+        out.append((payloads[int(rng.randint(0, n))], DUP))
+
+    # Corrupted signatures (verify tile traffic): flip one sig byte.
+    for _ in range(int(n * corrupt_rate)):
+        t = bytearray(payloads[int(rng.randint(0, n))])
+        t[1 + int(rng.randint(0, 64))] ^= 1 + int(rng.randint(0, 255))
+        out.append((bytes(t), BAD_SIG))
+
+    # Truncated / garbage (parse traffic).
+    for _ in range(int(n * parse_err_rate)):
+        src = payloads[int(rng.randint(0, n))]
+        cut = int(rng.randint(1, max(2, len(src) - 1)))
+        out.append((src[:cut], BAD_PARSE))
+
+    order = rng.permutation(len(out))
+    payloads_shuffled = [out[int(j)][0] for j in order]
+    expected = np.asarray([out[int(j)][1] for j in order], np.int8)
+    # A dup published before its original swaps roles; dedup-by-content
+    # doesn't care which copy survives, so the gate counts classes, and
+    # unique-OK stays n either way.
+    return Corpus(payloads_shuffled, expected, n_unique_ok=n)
+
+
+def expected_sink_digests(corpus: Corpus):
+    """sha256 multiset the sink must receive for a content-exact gate.
+
+    Shared by the checked-in CPU gate (tests/test_replay_gate.py) and the
+    hardware gate (bench.py --replay) so the two cannot drift. Count
+    equality alone would let a wrongly-dropped valid txn cancel against a
+    wrongly-passed corrupt one.
+    """
+    import hashlib
+    from collections import Counter
+
+    return Counter(
+        hashlib.sha256(p).digest()
+        for p, e in zip(corpus.payloads, corpus.expected)
+        if e == OK
+    )
+
+
+def sink_mismatch_count(corpus: Corpus, sink_digests) -> int:
+    """Symmetric difference size between expected and received multisets."""
+    from collections import Counter
+
+    want = expected_sink_digests(corpus)
+    got = Counter(sink_digests or [])
+    return sum((want - got).values()) + sum((got - want).values())
+
+
+def _sign_jobs(jobs: list, batch: int = 4096) -> list:
+    """Batch-sign (msg, seed) jobs with ops.sign; returns 64-byte sigs."""
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops.sign import sign_batch_jit
+
+    sigs: list = []
+    for start in range(0, len(jobs), batch):
+        chunk = jobs[start : start + batch]
+        # Bucket both dims so a handful of XLA program shapes serve every
+        # chunk (each TPU recompile costs minutes): batch padded to the
+        # full batch size, message length to a 256-byte bucket.
+        max_len = -(-max(len(m) for m, _ in chunk) // 256) * 256
+        bsz = batch if len(jobs) > batch else len(chunk)
+        msgs = np.zeros((bsz, max_len), np.uint8)
+        lens = np.zeros(bsz, np.int32)
+        seeds = np.zeros((bsz, 32), np.uint8)
+        for i, (m, s) in enumerate(chunk):
+            msgs[i, : len(m)] = np.frombuffer(m, np.uint8)
+            lens[i] = len(m)
+            seeds[i] = np.frombuffer(s, np.uint8)
+        got = np.asarray(
+            sign_batch_jit(
+                jnp.asarray(msgs), jnp.asarray(lens), jnp.asarray(seeds)
+            )[0]
+        )
+        sigs.extend(got[i].tobytes() for i in range(len(chunk)))
+    return sigs

@@ -137,6 +137,9 @@ class PipelineResult:
     # Per-verify-lane async offload shim counters (batches dispatched,
     # max-wait flushes, in-flight-cap stalls).
     verify_stats: List[Dict[str, int]] = field(default_factory=list)
+    # sha256 digests of sink-received payloads (SinkTile record_digests);
+    # replay gates compare this multiset against the expected corpus.
+    sink_digests: Optional[List[bytes]] = None
 
 
 def _run_tiles(
@@ -151,6 +154,8 @@ def _run_tiles(
     timeout_s: float,
     pre_wait=None,
     tcache_depth: int = 4096,
+    verify_opts: Optional[dict] = None,
+    record_digests: bool = False,
 ) -> PipelineResult:
     """Shared runner: wire source -> verify -> dedup -> pack -> sink, drive
     the tiles on threads until quiescence or timeout, HALT, snapshot.
@@ -179,6 +184,7 @@ def _run_tiles(
             backend=verify_backend, batch=verify_batch,
             max_msg_len=verify_max_msg_len or mtu,
             tcache_depth=tcache_depth,
+            **(verify_opts or {}),
         )
         for i in range(lanes)
     ]
@@ -197,6 +203,7 @@ def _run_tiles(
     sink = SinkTile(
         wksp, pod.query_cstr("firedancer.sink.cnc"),
         in_link=in_link("pack_sink"),
+        record_digests=record_digests,
     )
     tiles = [source, *verifies, dedup, pack, sink]
 
@@ -264,6 +271,7 @@ def _run_tiles(
         elapsed_s=elapsed,
         latency_p50_ns=lat[len(lat) // 2] if lat else 0,
         latency_p99_ns=lat[(len(lat) * 99) // 100] if lat else 0,
+        sink_digests=list(sink.digests) if record_digests else None,
         verify_stats=[
             {
                 "batches": v.stat_batches,
@@ -287,6 +295,8 @@ def run_pipeline(
     bank_cnt: int = 4,
     timeout_s: float = 60.0,
     tcache_depth: int = 4096,
+    verify_opts: Optional[dict] = None,
+    record_digests: bool = False,
 ) -> PipelineResult:
     """Replay-sourced pipeline: payload list -> verify -> dedup -> pack -> sink.
 
@@ -304,7 +314,8 @@ def run_pipeline(
     return _run_tiles(
         wksp, pod, replay, replay.done,
         verify_backend, verify_batch, verify_max_msg_len, bank_cnt, timeout_s,
-        tcache_depth=tcache_depth,
+        tcache_depth=tcache_depth, verify_opts=verify_opts,
+        record_digests=record_digests,
     )
 
 

@@ -15,6 +15,7 @@ equivalent in this environment; the aio seam is where one would plug in).
 
 from __future__ import annotations
 
+import subprocess
 import time
 from collections import deque
 from typing import Deque, Optional, Tuple
@@ -29,7 +30,7 @@ from firedancer_tpu.disco.tiles import (
 )
 from firedancer_tpu.tango import tempo
 from firedancer_tpu.tango.quic.quic import Quic, QuicConfig
-from firedancer_tpu.tango.udpsock import UdpSock
+from firedancer_tpu.tango.udpsock import UdpBatchSock, UdpSock
 
 
 class QuicTile(Tile):
@@ -49,7 +50,18 @@ class QuicTile(Tile):
         **kw,
     ):
         super().__init__(wksp, cnc_name, out_link=out_link, **kw)
-        self.sock = UdpSock(bind_addr)
+        # Batched ingest by default (recvmmsg amortizes the syscall per
+        # 256-datagram burst, the dev-host stand-in for fd_xsk's UMEM
+        # rings); plain recvfrom socket as fallback, LOGGED — a silent
+        # downgrade would hide a large ingest-rate regression.
+        try:
+            self.sock = UdpBatchSock(bind_addr)
+        except (OSError, RuntimeError, subprocess.CalledProcessError) as e:
+            from firedancer_tpu.utils.log import warning
+
+            warning(f"quic tile: batched UDP backend unavailable ({e}); "
+                    "falling back to per-datagram udpsock")
+            self.sock = UdpSock(bind_addr)
         self.listen_addr = self.sock.local_addr
         self._tx_aio = self.sock.aio_tx()
         self.quic = Quic(

@@ -20,6 +20,7 @@ tile parses in-tile exactly like the reference quic tile does
 from __future__ import annotations
 
 import time
+from hashlib import sha256 as _sha256
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence
 
@@ -478,8 +479,13 @@ class VerifyTile(Tile):
             self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, len(payload))
             return
         # High-availability dup filter before paying for the verify
-        # (synth-load FD_TCACHE_INSERT ha_tag analog).
-        ha_tag = int.from_bytes(txn.signature(payload, 0)[:8], "little")
+        # (synth-load FD_TCACHE_INSERT ha_tag analog). The tag covers the
+        # WHOLE payload, not the signature prefix: this filter runs before
+        # sigverify, so a corrupted copy of a pending txn (same signature
+        # bytes, flipped payload byte — or vice versa) must not shadow the
+        # valid original out of the tcache. Signature-keyed dedup is safe
+        # only post-verify (the dedup tile's meta_sig).
+        ha_tag = hash(payload)
         if self.ha_tcache.insert(ha_tag):
             self.cnc.diag_add(CNC_DIAG_HA_FILT_CNT, 1)
             self.cnc.diag_add(CNC_DIAG_HA_FILT_SZ, len(payload))
@@ -741,11 +747,17 @@ class SinkTile(Tile):
 
     name = "sink"
 
-    def __init__(self, wksp, cnc_name, in_link, **kw):
+    def __init__(self, wksp, cnc_name, in_link, record_digests: bool = False,
+                 **kw):
         super().__init__(wksp, cnc_name, in_link=in_link, **kw)
         self.recv_cnt = 0
         self.recv_sz = 0
         self.bank_hist: dict = {}
+        # Optional content audit: sha256 of every received payload, so
+        # replay gates can assert the sink saw EXACTLY the expected txns
+        # (count equality alone would let compensating errors cancel).
+        self.record_digests = record_digests
+        self.digests: list = []
         # End-to-end latency samples (ns, 32-bit wrap-safe under ~4.29 s):
         # source tsorig stamp -> sink arrival. Feeds the p50/p99 the bench
         # and replay gate report. Bounded reservoir (algorithm R) so a
@@ -759,6 +771,8 @@ class SinkTile(Tile):
         self.recv_sz += frag.sz
         bank = frag.sig >> 48
         self.bank_hist[bank] = self.bank_hist.get(bank, 0) + 1
+        if self.record_digests:
+            self.digests.append(_sha256(payload).digest())
         if frag.tsorig:
             lat = (tempo.tickcount() - frag.tsorig) & 0xFFFFFFFF
             self._latency_seen += 1

@@ -1,0 +1,30 @@
+"""Backend selection for ops with both Pallas-TPU and XLA implementations.
+
+One policy, used by verify (DSM kernel) and curve25519 (pow chains):
+an env var forces "xla" or "pallas"; otherwise the Pallas kernel is used
+exactly when the attached backend is a TPU family ("tpu", or this
+image's "axon" tunnel plugin). Pallas kernels here are built on
+pallas.tpu BlockSpecs/VMEM, so every other platform takes the XLA graph.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+TPU_PLATFORMS = ("tpu", "axon")
+
+
+def use_pallas(env_var: str) -> bool:
+    """Decide at trace time whether to use the Pallas implementation."""
+    impl = os.environ.get(env_var, "auto")
+    if impl == "xla":
+        return False
+    if impl == "pallas":
+        return True
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "cpu"
+    return platform in TPU_PLATFORMS

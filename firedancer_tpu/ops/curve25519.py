@@ -32,6 +32,19 @@ P = fe.P
 D_INT = fe.D_INT
 
 
+def _pow_auto():
+    """Backend-select the field power chains (invert, pow22523): the
+    VMEM-resident Pallas kernels on TPU (~5x the XLA graph's per-mul
+    rate, see ops/pow_pallas.py), the XLA chain elsewhere."""
+    from .backend import use_pallas
+
+    if use_pallas("FD_POW_IMPL"):
+        from .pow_pallas import fe_invert_pallas, fe_pow22523_pallas
+
+        return fe_invert_pallas, fe_pow22523_pallas
+    return fe.fe_invert, fe.fe_pow22523
+
+
 def identity(batch_shape):
     return (
         fe.fe_zero(batch_shape),
@@ -103,9 +116,10 @@ def decompress(y_bytes: jnp.ndarray):
     u = fe.fe_sub(fe.fe_sq(y), z)                              # y^2 - 1
     v = fe.fe_add(fe.fe_mul(fe.fe_sq(y), fe.FE_D), z)          # d y^2 + 1
 
+    _, pow22523 = _pow_auto()
     v3 = fe.fe_mul(fe.fe_sq(v), v)
     uv7 = fe.fe_mul(fe.fe_mul(fe.fe_sq(v3), v), u)             # u v^7
-    x = fe.fe_mul(fe.fe_mul(fe.fe_pow22523(uv7), v3), u)       # u v^3 (uv^7)^((p-5)/8)
+    x = fe.fe_mul(fe.fe_mul(pow22523(uv7), v3), u)             # u v^3 (uv^7)^((p-5)/8)
 
     vxx = fe.fe_mul(fe.fe_sq(x), v)
     root_ok = fe.fe_eq(vxx, u)                                 # vx^2 == u
@@ -126,7 +140,8 @@ def decompress(y_bytes: jnp.ndarray):
 def compress(p) -> jnp.ndarray:
     """(X:Y:Z:T) -> canonical 32-byte encoding (*batch, 32) uint8."""
     x, y, z, _ = p
-    zinv = fe.fe_invert(z)
+    invert, _ = _pow_auto()
+    zinv = invert(z)
     ax = fe.fe_mul(x, zinv)
     ay = fe.fe_mul(y, zinv)
     out = fe.fe_to_bytes(ay)

@@ -27,7 +27,7 @@ from . import curve25519 as ge
 from . import fe25519 as fe
 
 NLIMBS = fe.NLIMBS
-LANES = 512  # batch tile per program (VMEM working set ~= 3 MB)
+LANES = 1024  # batch tile per program (measured best on v5e; 512 ~9% slower)
 
 
 def _fe_mul(a, b):

@@ -154,19 +154,25 @@ def fe_mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
 
 
 def _seq_carry(x: jnp.ndarray):
-    """Exact sequential carry over the limb axis via lax.scan.
+    """Exact sequential carry over the limb axis, unrolled at trace time.
 
     Returns (canonical limbs in [0, 255], top carry). Works for signed
-    inputs; the top carry may be negative.
+    inputs (arithmetic shift floors, so limb = 256*(l>>8) + (l&255) holds
+    for negatives too); the top carry may be negative.
+
+    The limb count is static (32-66), so the ripple unrolls into a chain
+    of elementwise ops XLA fuses into a handful of kernels — a lax.scan
+    here costs ~0.2 ms *per step* in while-loop overhead on TPU, which
+    made this carry as expensive as the whole SHA-512 stage.
     """
-
-    def step(carry, limb):
-        t = limb + carry
-        lo = t & _MASK
-        return t >> LIMB_BITS, lo
-
-    top, lo = jax.lax.scan(step, jnp.zeros(x.shape[1:], jnp.int32), x)
-    return lo, top
+    n = x.shape[0]
+    carry = jnp.zeros(x.shape[1:], jnp.int32)
+    outs = []
+    for i in range(n):
+        t = x[i] + carry
+        outs.append(t & _MASK)
+        carry = t >> LIMB_BITS
+    return jnp.stack(outs), carry
 
 
 def _canonicalize(x: jnp.ndarray) -> jnp.ndarray:

@@ -40,12 +40,16 @@ def scalarmult_base(s_bytes: jnp.ndarray) -> tuple:
     """s*B for (B, 32) uint8 scalars (any 256-bit value).
 
     Runs the double-scalarmult with h = 0 so the A-term contributes only
-    identity lookups; the result is the s*B table walk alone.
+    identity lookups; the result is the s*B table walk alone. Uses the
+    backend-selected implementation (Pallas on TPU, XLA elsewhere), same
+    as verify_batch.
     """
+    from .verify import _dsm_auto
+
     bsz = s_bytes.shape[0]
     b_pt, _ = _b_point(bsz)
     zero = jnp.zeros_like(s_bytes)
-    return ge.double_scalarmult(zero, b_pt, s_bytes)
+    return _dsm_auto()(zero, b_pt, s_bytes)
 
 
 def _clamp(a_bytes: jnp.ndarray) -> jnp.ndarray:

@@ -32,22 +32,9 @@ def _dsm_auto():
     """Pick the double-scalarmult implementation for this process's
     backend: the Pallas VMEM-resident kernel on TPU, the XLA graph
     elsewhere (CPU tests, multichip dryrun)."""
-    import os
+    from .backend import use_pallas
 
-    impl = os.environ.get("FD_DSM_IMPL", "auto")
-    if impl == "xla":
-        return ge.double_scalarmult
-    if impl == "pallas":
-        from .dsm_pallas import double_scalarmult_pallas
-
-        return double_scalarmult_pallas
-    try:
-        platform = jax.devices()[0].platform
-    except Exception:
-        platform = "cpu"
-    # Pallas kernel only for TPU-family backends (the kernel is built on
-    # pallas.tpu BlockSpecs/VMEM); everything else takes the XLA graph.
-    if platform in ("tpu", "axon"):
+    if use_pallas("FD_DSM_IMPL"):
         from .dsm_pallas import double_scalarmult_pallas
 
         return double_scalarmult_pallas

@@ -87,6 +87,55 @@ def tp_varint(params: Dict[int, bytes], tid: int, default: int = 0) -> int:
     return wire.varint_decode(v, 0)[0]
 
 
+class RttEstimator:
+    """RFC 9002 RTT estimation + PTO computation (§5.3, §6.2).
+
+    Replaces the fixed 0.25 s probe timeout: smoothed_rtt/rttvar are EWMAs
+    of ack-derived samples (ack_delay-adjusted once min_rtt is known) and
+    the PTO backs off exponentially per probe event. Loss detection uses
+    the packet threshold (kPacketThreshold=3, wired in the ACK handler)
+    plus the PTO; the RFC's time-threshold variant is not implemented.
+    Reference behavior: src/tango/quic/fd_quic_pkt_meta.c + RFC defaults.
+    """
+
+    K_GRANULARITY = 0.001          # kGranularity, seconds
+    MAX_ACK_DELAY = 0.025          # default peer max_ack_delay
+    PTO_BACKOFF_CAP = 6            # 64x max backoff
+
+    def __init__(self, initial_rtt: float = 0.125):
+        self.initial_rtt = initial_rtt
+        self.latest_rtt = 0.0
+        self.smoothed_rtt: Optional[float] = None
+        self.rttvar = 0.0
+        self.min_rtt = 0.0
+        self.pto_count = 0
+
+    def on_sample(self, rtt: float, ack_delay: float = 0.0) -> None:
+        if rtt <= 0:
+            return
+        self.latest_rtt = rtt
+        if self.smoothed_rtt is None:
+            self.smoothed_rtt = rtt
+            self.rttvar = rtt / 2
+            self.min_rtt = rtt
+        else:
+            self.min_rtt = min(self.min_rtt, rtt)
+            adj = rtt
+            if rtt - ack_delay >= self.min_rtt:
+                adj = rtt - ack_delay
+            self.rttvar = 0.75 * self.rttvar + 0.25 * abs(self.smoothed_rtt - adj)
+            self.smoothed_rtt = 0.875 * self.smoothed_rtt + 0.125 * adj
+        self.pto_count = 0
+
+    def pto(self) -> float:
+        if self.smoothed_rtt is None:
+            base = 2 * self.initial_rtt
+        else:
+            base = (self.smoothed_rtt
+                    + max(4 * self.rttvar, self.K_GRANULARITY)
+                    + self.MAX_ACK_DELAY)
+        return base * (1 << min(self.pto_count, self.PTO_BACKOFF_CAP))
+
 @dataclass
 class _SentPacket:
     time: float
@@ -151,9 +200,9 @@ class _PnSpace:
         self.crypto_tx.append((self.crypto_tx_off, data))
         self.crypto_tx_off += len(data)
 
-    def on_ack(self, f: wire.Frame) -> List[int]:
-        """Remove acked packets from the sent map; -> acked pns."""
-        acked: List[int] = []
+    def on_ack(self, f: wire.Frame):
+        """Remove acked packets from the sent map; -> [(pn, _SentPacket)]."""
+        acked = []
         hi = f.fields["largest"]
         lo = hi - f.fields["first_range"]
         spans = [(lo, hi)]
@@ -164,8 +213,7 @@ class _PnSpace:
         for lo, hi in spans:
             for pn in list(self.sent.keys()):
                 if lo <= pn <= hi:
-                    del self.sent[pn]
-                    acked.append(pn)
+                    acked.append((pn, self.sent.pop(pn)))
             self.largest_acked = max(self.largest_acked, hi)
         return acked
 
@@ -217,8 +265,6 @@ class _RecvStream:
 class QuicConn:
     """A single QUIC connection (client or server role)."""
 
-    PTO = 0.25  # seconds; simple fixed probe timeout
-
     def __init__(
         self,
         is_server: bool,
@@ -248,6 +294,7 @@ class QuicConn:
         self._max_data = initial_max_data
         self._rx_data_total = 0
 
+        self.rtt = RttEstimator()
         self.spaces = [_PnSpace(), _PnSpace(), _PnSpace()]
         if is_server:
             assert orig_dcid is not None
@@ -370,7 +417,23 @@ class QuicConn:
         space = self.spaces[level]
         t = f.ftype
         if t == wire.FRAME_ACK:
-            space.on_ack(f)
+            acked = space.on_ack(f)
+            # RTT sample ONLY when the frame's largest-acknowledged packet
+            # is itself newly acked and ack-eliciting (RFC 9002 §5.1) — a
+            # reordered ACK re-listing old ranges must not fold its own
+            # delivery delay into srtt. ack_delay is us << exponent(3).
+            largest = f.fields["largest"]
+            for pn, sp in acked:
+                if pn == largest and sp.ack_eliciting:
+                    ack_delay = f.fields.get("ack_delay", 0) * 8 / 1e6
+                    self.rtt.on_sample(now - sp.time, ack_delay)
+                    break
+            # Packet-threshold loss (RFC 9002 §6.1.1, kPacketThreshold=3):
+            # anything 3+ below the new largest acked is lost NOW - the
+            # fast-retransmit path that does not wait out a PTO.
+            for pn in list(space.sent.keys()):
+                if pn <= space.largest_acked - 3:
+                    self._retransmit(space, pn)
         elif t == wire.FRAME_CRYPTO:
             self._on_crypto(level, f.fields["offset"], f.data)
         elif wire.FRAME_STREAM_BASE <= t <= wire.FRAME_STREAM_BASE | 7:
@@ -597,27 +660,37 @@ class QuicConn:
 
     # ------------------------------------------------------------ service --
 
+    def _retransmit(self, space: "_PnSpace", pn: int) -> None:
+        """Re-queue a sent packet's retransmittable content."""
+        sp = space.sent.pop(pn)
+        for off, data in sp.crypto:
+            space.crypto_tx.insert(0, (off, data))
+        for st in sp.streams:
+            self._send_queue.insert(0, st)
+        if sp.handshake_done:
+            self._hs_done_pending = True
+
     def service(self, now: float) -> List[bytes]:
-        """Timers: idle timeout + PTO retransmission. -> datagrams to send."""
+        """Timers: idle timeout + PTO retransmission (RTT-driven, RFC 9002;
+        the estimator's PTO backs off exponentially while no acks arrive).
+        -> datagrams to send."""
         if self.closed:
             return []
         if now - self._last_activity > self.idle_timeout:
             self.closed = True
             self.close_reason = "idle timeout"
             return []
+        pto = self.rtt.pto()
+        fired = False
         for space in self.spaces:
             if space.dropped:
                 continue
             for pn in list(space.sent.keys()):
-                sp = space.sent[pn]
-                if now - sp.time > self.PTO:
-                    del space.sent[pn]
-                    for off, data in sp.crypto:
-                        space.crypto_tx.insert(0, (off, data))
-                    for s in sp.streams:
-                        self._send_queue.insert(0, s)
-                    if sp.handshake_done:
-                        self._hs_done_pending = True
+                if now - space.sent[pn].time > pto:
+                    self._retransmit(space, pn)
+                    fired = True
+        if fired:
+            self.rtt.pto_count += 1
         return self.pending_datagrams(now)
 
     def abort(self, error: int, reason: str) -> None:

@@ -80,6 +80,8 @@ def is_long_header(first_byte: int) -> bool:
 
 
 def parse_long_header(buf: bytes, off: int = 0) -> LongHeader:
+    if off >= len(buf):
+        raise QuicWireError("empty datagram")
     first = buf[off]
     if not (first & 0x80):
         raise QuicWireError("not a long header")
@@ -151,6 +153,8 @@ def encode_long_header(
 
 
 def parse_short_header(buf: bytes, dcid_len: int, off: int = 0) -> ShortHeader:
+    if off >= len(buf):
+        raise QuicWireError("empty datagram")
     first = buf[off]
     if first & 0x80:
         raise QuicWireError("not a short header")

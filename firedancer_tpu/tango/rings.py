@@ -44,14 +44,25 @@ DIAG_OVRNR_CNT = 5
 DIAG_SLOW_CNT = 6
 
 
-def _build_lib():
-    native_dir = os.path.join(os.path.dirname(_LIB_PATH), os.pardir, "native")
-    subprocess.run(["make", "-s"], cwd=os.path.abspath(native_dir), check=True)
+def ensure_native_built(lib_path: str = _LIB_PATH) -> None:
+    """Build the native tree if lib_path is missing; flock-serialized so
+    concurrent processes can't race partially-written .so files."""
+    if os.path.exists(lib_path):
+        return
+    import fcntl
+
+    build_dir = os.path.dirname(lib_path)
+    os.makedirs(build_dir, exist_ok=True)
+    native_dir = os.path.abspath(
+        os.path.join(build_dir, os.pardir, "native"))
+    with open(os.path.join(build_dir, ".build.lock"), "w") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        if not os.path.exists(lib_path):
+            subprocess.run(["make", "-s"], cwd=native_dir, check=True)
 
 
 def load_lib() -> ctypes.CDLL:
-    if not os.path.exists(_LIB_PATH):
-        _build_lib()
+    ensure_native_built(_LIB_PATH)
     lib = ctypes.CDLL(_LIB_PATH)
     lib.fd_wksp_create.restype = ctypes.c_void_p
     lib.fd_wksp_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]

@@ -1,0 +1,78 @@
+"""Shared mutation-fuzz driver for the wire-facing parsers.
+
+Role of the reference's libFuzzer targets (config/everything.mk:246-253:
+fuzz_txn_parse, fuzz_quic_parse_transport_params, fuzz_pcap...): hammer
+every parser that consumes untrusted bytes and assert the ONLY possible
+outcomes are (a) a successful parse or (b) the parser's declared error
+type — never an unhandled exception, hang, or interpreter crash.
+
+No libFuzzer here (pure Python): the driver is a seeded structure-aware
+mutator — start from valid corpus items, apply byte flips / truncations /
+splices / integer nudges — plus a pure-random lane. Determinism comes
+from the seed so CI failures reproduce.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Iterable, List, Tuple
+
+
+def mutate(rng: random.Random, seed_items: List[bytes], max_len: int = 2048) -> bytes:
+    """One fuzz input: mutated corpus item or random bytes."""
+    mode = rng.randrange(8)
+    if not seed_items or mode == 0:
+        return rng.randbytes(rng.randrange(0, max_len))
+    base = bytearray(rng.choice(seed_items))
+    if mode == 1 and base:  # single byte flip
+        base[rng.randrange(len(base))] ^= 1 << rng.randrange(8)
+    elif mode == 2 and base:  # byte set
+        base[rng.randrange(len(base))] = rng.randrange(256)
+    elif mode == 3:  # truncate
+        base = base[: rng.randrange(len(base) + 1)]
+    elif mode == 4:  # extend with junk
+        base += rng.randbytes(rng.randrange(64))
+    elif mode == 5 and base:  # chunk splice from another item
+        other = rng.choice(seed_items)
+        if other:
+            o = rng.randrange(len(other))
+            d = rng.randrange(len(base))
+            base[d:d + 8] = other[o:o + 8]
+    elif mode == 6 and base:  # integer nudge (length fields love this)
+        i = rng.randrange(len(base))
+        base[i] = (base[i] + rng.choice((1, 0xFF, 0x7F, 0x80))) & 0xFF
+    elif mode == 7 and len(base) > 2:  # swap two spans
+        i, j = sorted(rng.randrange(len(base)) for _ in range(2))
+        base[i], base[j] = base[j], base[i]
+    return bytes(base[:max_len])
+
+
+def run_fuzz(
+    target: Callable[[bytes], None],
+    seed_items: Iterable[bytes],
+    iters: int,
+    seed: int = 0,
+    allowed: Tuple[type, ...] = (),
+) -> int:
+    """Run `target` on `iters` mutated inputs.
+
+    `allowed` exception types are the parser's declared failure modes;
+    anything else re-raises with the offending input attached. Returns the
+    number of inputs that parsed cleanly (coverage signal for tuning).
+    """
+    rng = random.Random(seed)
+    items = list(seed_items)
+    ok = 0
+    for i in range(iters):
+        data = mutate(rng, items)
+        try:
+            target(data)
+            ok += 1
+        except allowed:
+            pass
+        except Exception as e:  # pragma: no cover - the bug finder
+            raise AssertionError(
+                f"fuzz target crashed on iter {i} (seed {seed}): "
+                f"{type(e).__name__}: {e}; input={data.hex()}"
+            ) from e
+    return ok

@@ -1,0 +1,173 @@
+"""Fuzz targets: every parser that eats untrusted wire bytes.
+
+Mirrors the reference's fuzz target set (config/everything.mk:246-253:
+fuzz_txn_parse.c, fuzz_quic_parse_transport_params.c, fuzz_pcap.c,
+fuzz_sbpf_loader.c, fuzz_pcapng.c) plus parsers unique to this codebase
+(bincode types, net headers, QUIC frames).
+
+Each target factory returns (fn, corpus, allowed_exceptions). Run via
+fuzz/run_fuzz.py (long soak) or tests/test_fuzz_smoke.py (CI smoke).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import tempfile
+
+
+def target_txn_parse():
+    from firedancer_tpu.ballet.txn import TxnParseError, build_txn, parse_txn
+
+    corpus = [
+        build_txn(signer_seeds=[bytes([7]) * 32],
+                  extra_accounts=[bytes([1]) * 32],
+                  n_readonly_unsigned=1,
+                  instrs=[(1, [0], b"hello fuzz")]),
+        build_txn(signer_seeds=[bytes([7]) * 32, bytes([8]) * 32],
+                  extra_accounts=[bytes([2]) * 32],
+                  n_readonly_unsigned=1,
+                  version=0,
+                  instrs=[(2, [0, 1], b"x" * 200)],
+                  addr_luts=[(bytes([3]) * 32, [1, 2], [3])]),
+    ]
+
+    def fn(data: bytes) -> None:
+        txn = parse_txn(data)
+        # Parsed txns must expose self-consistent zero-copy views.
+        txn.verify_items(data)
+        for ins in txn.instrs:
+            assert 0 <= ins.data_off <= len(data)
+            assert ins.data_off + ins.data_sz <= len(data)
+
+    return fn, corpus, (TxnParseError,)
+
+
+def target_quic_frames():
+    from firedancer_tpu.tango.quic import wire
+
+    corpus = [
+        wire.encode_crypto(0, b"hello-crypto"),
+        wire.encode_ack(7, 0, 7),
+        wire.encode_stream(3, 0, b"stream-data", fin=True),
+        b"\x01" * 32,
+    ]
+
+    def fn(data: bytes) -> None:
+        wire.parse_frames(data)
+
+    return fn, corpus, (wire.QuicWireError,)
+
+
+def target_quic_transport_params():
+    from firedancer_tpu.tango.quic import conn, wire
+
+    corpus = [
+        conn.encode_transport_params({0x01: 30_000, 0x04: 1 << 20, 0x08: 256}),
+        bytes.fromhex("010480007530040480100000"),
+    ]
+
+    def fn(data: bytes) -> None:
+        conn.parse_transport_params(data)
+
+    return fn, corpus, (wire.QuicWireError,)
+
+
+def target_quic_headers():
+    """Long/short header parse + packet-number decode path."""
+    from firedancer_tpu.tango.quic import wire
+
+    corpus = [
+        wire.encode_long_header(0, b"\x01" * 8, b"\x02" * 8, 0, 1, 32,
+                                token=b""),
+        wire.encode_short_header(b"\x01" * 8, 77, 2) + b"\x00" * 16,
+    ]
+
+    def fn(data: bytes) -> None:
+        try:
+            wire.parse_long_header(data)
+        except wire.QuicWireError:
+            pass
+        wire.parse_short_header(data, 8)
+
+    return fn, corpus, (wire.QuicWireError,)
+
+
+def target_bincode_types():
+    """Generated flamenco type decoders on hostile bytes."""
+    import firedancer_tpu.flamenco.types.bincode as bc
+    import firedancer_tpu.flamenco.types.generated as gen
+
+    classes = [gen.VoteStateVersioned, gen.StakeState, gen.VoteInstruction,
+               gen.SystemProgramInstruction, gen.StakeInstruction,
+               gen.NonceStateVersions, gen.GenesisSolana, gen.SlotHistory]
+    corpus = [bytes(8), b"\x01" + bytes(64), bytes(200),
+              gen.StakeState(discriminant=gen.StakeState.UNINITIALIZED).encode()]
+
+    def fn(data: bytes) -> None:
+        for cls in classes:
+            try:
+                cls.decode(data)
+            except bc.BincodeError:
+                pass
+
+    return fn, corpus, (bc.BincodeError,)
+
+
+def target_pcap():
+    from firedancer_tpu.utils import pcap
+
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "seed.pcap")
+    w = pcap.PcapWriter(path)
+    w.write(b"\x00" * 64)
+    w.close()
+    with open(path, "rb") as f:
+        corpus = [f.read()]
+
+    def fn(data: bytes) -> None:
+        p = os.path.join(d, "fuzz.pcap")
+        with open(p, "wb") as f:
+            f.write(data)
+        try:
+            pcap.read_all(p)
+        except (ValueError, EOFError, struct.error):
+            pass
+
+    return fn, corpus, (ValueError, EOFError)
+
+
+def target_eth_ip_udp():
+    from firedancer_tpu.utils import net
+
+    corpus = [net.build_udp_frame(
+        b"payload", src_ip=b"\x0a\x00\x00\x01", dst_ip=b"\x0a\x00\x00\x02",
+        sport=1000, dport=2000)]
+
+    def fn(data: bytes) -> None:
+        net.parse_udp_frame(data, verify_checksum=True)
+
+    return fn, corpus, (net.NetError,)
+
+
+def target_sbpf_loader():
+    from firedancer_tpu.ballet.sbpf_loader import SbpfLoaderError, load_program
+
+    corpus = [b"\x7fELF\x02\x01\x01\x00" + bytes(120)]
+
+    def fn(data: bytes) -> None:
+        load_program(data)
+
+    return fn, corpus, (SbpfLoaderError,)
+
+
+ALL_TARGETS = {
+    "txn_parse": target_txn_parse,
+    "quic_frames": target_quic_frames,
+    "quic_transport_params": target_quic_transport_params,
+    "quic_headers": target_quic_headers,
+    "bincode_types": target_bincode_types,
+    "pcap": target_pcap,
+    "eth_ip_udp": target_eth_ip_udp,
+    "sbpf_loader": target_sbpf_loader,
+}

@@ -1,0 +1,54 @@
+"""Standalone fuzz driver: long soaks over every wire-facing parser.
+
+  python fuzz/run_fuzz.py [--iters N] [--seed S] [target ...]
+
+Exit 0 = no crashes. Mirrors the reference's `make fuzz` targets
+(config/everything.mk:246-253) without libFuzzer: deterministic seeded
+mutation (fuzz_common.mutate) over checked-in seed corpora.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fuzz_common import run_fuzz  # noqa: E402
+from fuzz_targets import ALL_TARGETS  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("targets", nargs="*", default=[],
+                    help="subset of targets (default: all)")
+    ap.add_argument("--iters", type=int, default=20_000)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    names = args.targets or list(ALL_TARGETS)
+    rc = 0
+    for name in names:
+        if name not in ALL_TARGETS:
+            print(f"unknown target {name!r}; have {sorted(ALL_TARGETS)}")
+            return 2
+        fn, corpus, allowed = ALL_TARGETS[name]()
+        t0 = time.perf_counter()
+        try:
+            ok = run_fuzz(fn, corpus, iters=args.iters, seed=args.seed,
+                          allowed=allowed)
+        except AssertionError as e:
+            print(f"FAIL {name}: {e}")
+            rc = 1
+            continue
+        dt = time.perf_counter() - t0
+        print(f"ok {name}: {args.iters} iters in {dt:.1f}s "
+              f"({args.iters / dt:.0f}/s), {ok} clean parses")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+// udp_batch — batched UDP ingest/egress via recvmmsg/sendmmsg.
+//
+// Role: the environment-appropriate stand-in for the reference's AF_XDP
+// kernel-bypass stack (/root/reference/src/tango/xdp/fd_xsk.h:8-60 —
+// UMEM rings amortize per-packet kernel crossings; recvmmsg amortizes
+// them per-batch, which is as close as a portable dev host gets). Sits
+// behind the same aio seam as the plain udpsock backend, so the QUIC
+// tile swaps backends without change.
+//
+// C ABI (ctypes-consumed by firedancer_tpu/tango/udpsock.py):
+//   fd_udp_recv_batch: drain up to max_pkts datagrams in ONE syscall.
+//     buf       : max_pkts * mtu bytes, packet i at i*mtu
+//     lens[i]   : received length of packet i
+//     addrs[2i] : peer IPv4 (network order), addrs[2i+1]: port (host)
+//     returns #packets, 0 if none ready, -errno on error.
+//   fd_udp_send_batch: send n datagrams in ONE syscall (best effort).
+//     returns #sent, -errno on hard error.
+
+#define _GNU_SOURCE 1
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+
+extern "C" {
+
+int fd_udp_recv_batch(int fd, uint8_t *buf, uint32_t mtu,
+                      uint32_t max_pkts, uint32_t *lens, uint32_t *addrs) {
+  if (max_pkts == 0) return 0;
+  // Stack-bounded batch: clamp to 1024 descriptors (~72 KiB of stack).
+  if (max_pkts > 1024) max_pkts = 1024;
+  mmsghdr msgs[1024];
+  iovec iovs[1024];
+  sockaddr_in peers[1024];
+  std::memset(msgs, 0, sizeof(mmsghdr) * max_pkts);
+  for (uint32_t i = 0; i < max_pkts; i++) {
+    iovs[i].iov_base = buf + (size_t)i * mtu;
+    iovs[i].iov_len = mtu;
+    msgs[i].msg_hdr.msg_iov = &iovs[i];
+    msgs[i].msg_hdr.msg_iovlen = 1;
+    msgs[i].msg_hdr.msg_name = &peers[i];
+    msgs[i].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+  }
+  int n = recvmmsg(fd, msgs, max_pkts, MSG_DONTWAIT, nullptr);
+  if (n < 0) {
+    if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+    return -errno;
+  }
+  for (int i = 0; i < n; i++) {
+    lens[i] = msgs[i].msg_len;
+    addrs[2 * i] = peers[i].sin_addr.s_addr;
+    addrs[2 * i + 1] = ntohs(peers[i].sin_port);
+  }
+  return n;
+}
+
+int fd_udp_send_batch(int fd, const uint8_t *buf, uint32_t mtu,
+                      const uint32_t *lens, const uint32_t *addrs,
+                      uint32_t n_pkts) {
+  if (n_pkts == 0) return 0;
+  if (n_pkts > 1024) n_pkts = 1024;
+  mmsghdr msgs[1024];
+  iovec iovs[1024];
+  sockaddr_in peers[1024];
+  std::memset(msgs, 0, sizeof(mmsghdr) * n_pkts);
+  for (uint32_t i = 0; i < n_pkts; i++) {
+    iovs[i].iov_base = const_cast<uint8_t *>(buf + (size_t)i * mtu);
+    iovs[i].iov_len = lens[i];
+    peers[i].sin_family = AF_INET;
+    peers[i].sin_addr.s_addr = addrs[2 * i];
+    peers[i].sin_port = htons((uint16_t)addrs[2 * i + 1]);
+    msgs[i].msg_hdr.msg_iov = &iovs[i];
+    msgs[i].msg_hdr.msg_iovlen = 1;
+    msgs[i].msg_hdr.msg_name = &peers[i];
+    msgs[i].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+  }
+  int n = sendmmsg(fd, msgs, n_pkts, MSG_DONTWAIT);
+  if (n < 0) {
+    if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+    return -errno;
+  }
+  return n;
+}
+
+}  // extern "C"

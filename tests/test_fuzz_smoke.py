@@ -1,0 +1,45 @@
+"""CI fuzz smoke: a deterministic slice of every fuzz target.
+
+The long soak lives in fuzz/run_fuzz.py; this keeps a bounded version in
+the default test run so parser-robustness regressions (unhandled
+exception types on hostile bytes) fail CI the day they land — the
+reference builds its fuzz targets in a dedicated CI profile
+(config/everything.mk:246-253, fuzz_artifacts.yml).
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir, "fuzz"))
+
+from fuzz_common import mutate, run_fuzz  # noqa: E402
+from fuzz_targets import ALL_TARGETS  # noqa: E402
+
+
+@pytest.mark.parametrize("name", sorted(ALL_TARGETS))
+def test_fuzz_target_smoke(name):
+    fn, corpus, allowed = ALL_TARGETS[name]()
+    # Crash-free on 2000 deterministic mutations.
+    run_fuzz(fn, corpus, iters=2000, seed=42, allowed=allowed)
+
+
+def test_corpus_items_parse_clean():
+    """Every seed corpus item must be accepted by its own target."""
+    for name, factory in ALL_TARGETS.items():
+        fn, corpus, allowed = factory()
+        for item in corpus:
+            try:
+                fn(item)
+            except allowed:
+                # Some corpora intentionally hold near-valid items.
+                pass
+
+
+def test_mutator_determinism():
+    import random
+
+    a = [mutate(random.Random(7), [b"hello world"]) for _ in range(50)]
+    b = [mutate(random.Random(7), [b"hello world"]) for _ in range(50)]
+    assert a == b

@@ -267,3 +267,112 @@ def test_quic_over_udpsock():
     cli_sock.close()
     assert conn.established
     assert set(received) == set(payloads)
+
+
+def test_rtt_estimator_rfc9002():
+    from firedancer_tpu.tango.quic.conn import RttEstimator
+
+    est = RttEstimator(initial_rtt=0.125)
+    # No samples: PTO = 2 * initial_rtt, doubling per probe event.
+    assert est.pto() == pytest.approx(0.25)
+    est.pto_count = 2
+    assert est.pto() == pytest.approx(1.0)
+    est.pto_count = 0
+
+    # First sample initializes srtt/rttvar/min_rtt (RFC 9002 section 5.3).
+    est.on_sample(0.100)
+    assert est.smoothed_rtt == pytest.approx(0.100)
+    assert est.rttvar == pytest.approx(0.050)
+    assert est.min_rtt == pytest.approx(0.100)
+
+    # Steady samples converge srtt and shrink rttvar.
+    for _ in range(50):
+        est.on_sample(0.100)
+    assert est.smoothed_rtt == pytest.approx(0.100, abs=1e-6)
+    assert est.rttvar < 0.001
+    # PTO tracks srtt + 4*rttvar + max_ack_delay.
+    assert 0.100 < est.pto() < 0.150
+
+    # ack_delay is subtracted only when it keeps the sample >= min_rtt.
+    est.on_sample(0.200, ack_delay=0.050)
+    assert est.latest_rtt == pytest.approx(0.200)
+    assert est.smoothed_rtt < 0.110  # adjusted sample 0.150 pulled in slowly
+
+    # A sample resets the PTO backoff.
+    est.pto_count = 3
+    est.on_sample(0.100)
+    assert est.pto_count == 0
+
+
+def test_rtt_adapts_pto_to_wire_latency():
+    """On a slow virtual wire the estimator must learn the RTT, so the
+    PTO ends up latency-proportional instead of the old fixed 0.25 s."""
+    received = []
+    client, server, c2s, s2c = _mk_pair(received)
+    conn = client.connect(("srv", 1), 0.0)
+    # Pump with 50 ms one-way latency: deliver datagrams half a step late.
+    now = 0.0
+    for _ in range(12):
+        now += 0.05
+        while c2s:
+            server.rx(("cli", 1), c2s.pop(0), now)
+        while s2c:
+            client.rx(("srv", 1), s2c.pop(0), now)
+        client.service(now)
+        server.service(now)
+    assert conn.established
+    conn.send_stream(b"ping")
+    client.service(now)
+    for _ in range(6):
+        now += 0.05
+        while c2s:
+            server.rx(("cli", 1), c2s.pop(0), now)
+        while s2c:
+            client.rx(("srv", 1), s2c.pop(0), now)
+        client.service(now)
+        server.service(now)
+    assert conn.rtt.smoothed_rtt is not None
+    # Observed RTT ~= one pump step (50-100 ms with ack scheduling).
+    assert 0.01 < conn.rtt.smoothed_rtt < 0.3
+    assert conn.rtt.pto() < 1.0
+
+
+def test_packet_threshold_fast_retransmit():
+    """A packet 3+ below largest_acked is retransmitted immediately on ACK
+    receipt (RFC 9002 section 6.1.1), without waiting out a PTO."""
+    received = []
+    state = {"drop_next": False, "dropped": 0}
+
+    def drop(d):
+        if state["drop_next"]:
+            state["drop_next"] = False
+            state["dropped"] += 1
+            return True
+        return False
+
+    client, server, c2s, s2c = _mk_pair(received, drop=drop)
+    conn = client.connect(("srv", 1), 0.0)
+    now = _pump(client, server, conn, c2s, s2c, 0.0, steps=8)
+    assert conn.established
+    # Lose exactly one stream packet, then send several more so the acks
+    # advance largest_acked past the hole.
+    state["drop_next"] = True
+    lost = os.urandom(64)
+    conn.send_stream(lost)
+    client.service(now)
+    later = [os.urandom(64) for _ in range(5)]
+    for p in later:
+        conn.send_stream(p)
+        client.service(now)
+    # Pump with TINY time steps (never reaching a PTO of ~0.25 s): only
+    # the packet-threshold path can recover the hole.
+    for _ in range(10):
+        now += 0.001
+        while c2s:
+            server.rx(("cli", 1), c2s.pop(0), now)
+        while s2c:
+            client.rx(("srv", 1), s2c.pop(0), now)
+        client.service(now)
+        server.service(now)
+    assert state["dropped"] == 1
+    assert {d for _, d in received} >= set(later) | {lost}

@@ -1,0 +1,113 @@
+"""Replay oracle gate: mainnet-shaped corpus through the full pipeline.
+
+The BASELINE.json correctness gate is "0 mismatches vs the CPU oracle on
+a 100k-tx mainnet replay". This file is the checked-in, CPU-sized gate
+(the driver's bench runs the 100k version on hardware via
+`FD_BENCH_MODE=replay python bench.py`): a corpus with multisig/v0/
+compute-budget/dup/corrupt/truncated traffic flows replay -> verify
+(device path) -> dedup -> pack -> sink, and the sink must receive
+exactly the unique valid transactions — nothing dropped that the oracle
+accepts, nothing passed that the oracle rejects.
+"""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet import ed25519 as oracle
+from firedancer_tpu.ballet.txn import TxnParseError, parse_txn
+from firedancer_tpu.disco.corpus import BAD_PARSE, BAD_SIG, DUP, OK, mainnet_corpus
+
+
+N = 160  # CPU-sized; the 100k hardware run is bench.py --replay
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    # max_data_sz below the 256-byte length bucket keeps corpus signing
+    # to a single XLA program shape (CPU compiles are the suite's cost).
+    return mainnet_corpus(
+        n=N, seed=11, dup_rate=0.06, corrupt_rate=0.04,
+        parse_err_rate=0.02, sign_batch_size=256, max_data_sz=140,
+    )
+
+
+def test_corpus_shape(corpus):
+    n_ok = int((corpus.expected == OK).sum())
+    assert n_ok == corpus.n_unique_ok == N
+    assert (corpus.expected == DUP).sum() == int(N * 0.06)
+    assert (corpus.expected == BAD_SIG).sum() == int(N * 0.04)
+    assert (corpus.expected == BAD_PARSE).sum() == int(N * 0.02)
+    # Mainnet-ish mix materialized: some multisig, some v0, lengths vary.
+    descs = []
+    for p, e in zip(corpus.payloads, corpus.expected):
+        if e == OK:
+            descs.append(parse_txn(p))
+    assert any(d.signature_cnt > 1 for d in descs)
+    assert any(d.version == 0 for d in descs)
+    assert any(d.version == -1 for d in descs)
+    lens = {len(p) for p in corpus.payloads}
+    assert max(lens) - min(lens) > 100
+
+
+def test_corpus_oracle_spot_check(corpus):
+    """Anchor the by-construction statuses to the live Python oracle."""
+    rng = np.random.RandomState(0)
+    ok_idx = np.flatnonzero(corpus.expected == OK)
+    bad_idx = np.flatnonzero(corpus.expected == BAD_SIG)
+    for i in rng.choice(ok_idx, 6, replace=False):
+        p = corpus.payloads[int(i)]
+        txn = parse_txn(p)
+        for sig, pub, msg in txn.verify_items(p):
+            assert oracle.verify(msg, sig, pub) == 0
+    for i in rng.choice(bad_idx, 3, replace=False):
+        p = corpus.payloads[int(i)]
+        txn = parse_txn(p)
+        assert any(
+            oracle.verify(msg, sig, pub) != 0
+            for sig, pub, msg in txn.verify_items(p)
+        )
+
+
+def test_corpus_parse_errors_reject(corpus):
+    for p, e in zip(corpus.payloads, corpus.expected):
+        if e == BAD_PARSE:
+            with pytest.raises(TxnParseError):
+                parse_txn(p)
+
+
+def test_replay_gate_pipeline(corpus, tmp_path):
+    """The gate: sink receives exactly the unique valid transactions."""
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    from firedancer_tpu.disco.corpus import expected_sink_digests
+
+    topo = build_topology(str(tmp_path / "gate.wksp"), depth=256)
+    res = run_pipeline(
+        topo,
+        corpus.payloads,
+        verify_backend="tpu",
+        verify_batch=128,
+        timeout_s=600.0,
+        record_digests=True,
+    )
+    n_dup = int((corpus.expected == DUP).sum())
+    n_bad = int((corpus.expected == BAD_SIG).sum())
+    n_parse = int((corpus.expected == BAD_PARSE).sum())
+
+    assert res.recv_cnt == corpus.n_unique_ok, res.diag
+    # Content-exact: each unique valid payload received exactly once
+    # (count equality alone would let compensating errors cancel).
+    from collections import Counter
+
+    assert Counter(res.sink_digests) == expected_sink_digests(corpus)
+    # Filter accounting: every non-OK class lands in a filter counter.
+    verify_diag = res.diag["tile.verify"]
+    filt_total = (
+        verify_diag["ha_filt_cnt"]
+        + verify_diag["sv_filt_cnt"]
+        + res.diag["link.verify_dedup"]["filt_cnt"]
+        + res.diag["link.dedup_pack"]["filt_cnt"]
+    )
+    assert filt_total == n_dup + n_bad + n_parse, res.diag
+    # p99 pipeline latency is measured and sane (< 60 s, > 0).
+    assert 0 < res.latency_p99_ns < 60e9
