@@ -163,6 +163,75 @@ def replay_worker() -> int:
     return 0 if mismatches == 0 else 1
 
 
+def pack_worker() -> int:
+    """BASELINE stretch goal bench: account-conflict scheduling as XLA
+    graph coloring on a 64k-txn block (fd_pack.c:446-461 semantics).
+    Validates admissibility against the CPU oracle and compares first-wave
+    rewards-per-CU against the CPU greedy scheduler. ONE JSON line."""
+    import random
+
+    import jax
+
+    _configure_jax_cache(jax)
+
+    from firedancer_tpu.ballet.pack import Pack, PackTxn, validate_schedule
+    from firedancer_tpu.ops.pack_gc import schedule_block
+
+    n = int(os.environ.get("FD_BENCH_PACK_N", "65536"))
+    n_accounts = int(os.environ.get("FD_BENCH_PACK_ACCTS", "16384"))
+    rng = random.Random(7)
+    keys = [i.to_bytes(8, "little") + bytes(24) for i in range(n_accounts)]
+    txns = []
+    for i in range(n):
+        w = frozenset(rng.sample(keys, rng.randint(1, 3)))
+        r = frozenset(k for k in rng.sample(keys, rng.randint(0, 3))
+                      if k not in w)
+        txns.append(PackTxn(txn_id=i, rewards=rng.randint(1_000, 2_000_000),
+                            est_cus=rng.randint(10_000, 1_400_000),
+                            writable=w, readonly=r))
+
+    t0 = time.perf_counter()
+    waves, leftover = schedule_block(txns, n_colors=64, h_bits=8192)
+    sched_s = time.perf_counter() - t0
+    admissible = validate_schedule(waves)
+
+    # CPU greedy wave 0 for the quality comparison.
+    cpu = Pack(bank_cnt=1, depth=n + 1)
+    for t in txns:
+        cpu.insert(t)
+    t0 = time.perf_counter()
+    cpu_wave = []
+    while True:
+        t = cpu.schedule(0, scan_limit=256)
+        if t is None:
+            break
+        cpu_wave.append(t)
+    cpu_s = time.perf_counter() - t0
+
+    def rpc(wave):
+        return (sum(t.rewards for t in wave)
+                / max(sum(t.est_cus for t in wave), 1))
+
+    scheduled = sum(len(w) for w in waves)
+    rec = {
+        "metric": "pack_gc_schedule",
+        "value": round(n / sched_s, 1),
+        "unit": "txns/s",
+        "vs_baseline": 1.0 if admissible else 0.0,  # gate: admissibility
+        "block": n,
+        "scheduled": scheduled,
+        "leftover": len(leftover),
+        "waves": len(waves),
+        "admissible": admissible,
+        "wave0_rewards_per_cu": round(rpc(waves[0]), 4) if waves else 0,
+        "cpu_greedy_rewards_per_cu": round(rpc(cpu_wave), 4),
+        "schedule_s": round(sched_s, 2),
+        "cpu_greedy_s": round(cpu_s, 2),
+    }
+    print(json.dumps(rec))
+    return 0 if admissible else 1
+
+
 def worker(cpu: bool) -> int:
     """Measure on the attached device (or pinned CPU); print the JSON line."""
     if cpu:
@@ -330,6 +399,8 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--pack" in sys.argv:
+        sys.exit(pack_worker())
     if "--replay-worker" in sys.argv:
         sys.exit(replay_worker())
     if "--replay" in sys.argv or os.environ.get("FD_BENCH_MODE") == "replay":

@@ -156,6 +156,7 @@ def _run_tiles(
     tcache_depth: int = 4096,
     verify_opts: Optional[dict] = None,
     record_digests: bool = False,
+    pack_scheduler: str = "greedy",
 ) -> PipelineResult:
     """Shared runner: wire source -> verify -> dedup -> pack -> sink, drive
     the tiles on threads until quiescence or timeout, HALT, snapshot.
@@ -199,6 +200,7 @@ def _run_tiles(
         in_link=in_link("dedup_pack"),
         out_link=out_link("pack_sink", "pack_sink"),
         bank_cnt=bank_cnt,
+        scheduler=pack_scheduler,
     )
     sink = SinkTile(
         wksp, pod.query_cstr("firedancer.sink.cnc"),
@@ -236,6 +238,7 @@ def _run_tiles(
         return (
             pack.in_link.seq >= dedup.out_link.seq
             and pack.pack.pending_cnt() == 0
+            and not pack._gc_pending
             and sink.in_link.seq >= pack.out_link.seq
         )
 
@@ -297,6 +300,7 @@ def run_pipeline(
     tcache_depth: int = 4096,
     verify_opts: Optional[dict] = None,
     record_digests: bool = False,
+    pack_scheduler: str = "greedy",
 ) -> PipelineResult:
     """Replay-sourced pipeline: payload list -> verify -> dedup -> pack -> sink.
 
@@ -315,7 +319,7 @@ def run_pipeline(
         wksp, pod, replay, replay.done,
         verify_backend, verify_batch, verify_max_msg_len, bank_cnt, timeout_s,
         tcache_depth=tcache_depth, verify_opts=verify_opts,
-        record_digests=record_digests,
+        record_digests=record_digests, pack_scheduler=pack_scheduler,
     )
 
 

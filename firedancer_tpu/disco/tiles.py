@@ -214,6 +214,24 @@ class Tile:
         """Source tiles return True when exhausted."""
         return False
 
+    def poll_inputs(self):
+        """One drain round over the in-links. Returns (progressed,
+        overrun). Tiles with a native bulk drain override this."""
+        progressed = False
+        overrun = False
+        for il in self.in_links:
+            r, frag, payload = il.poll()
+            if r == POLL_FRAG:
+                self.in_cur = il
+                self.on_frag(frag, payload)
+                il.advance()
+                progressed = True
+            elif r == POLL_OVERRUN:
+                # InLink.poll repositioned + counted; the consumer is
+                # behind, so keep polling hot — never throttle it.
+                overrun = True
+        return progressed, overrun
+
     # -- run loop --------------------------------------------------------
 
     def housekeep(self, now: int) -> None:
@@ -272,19 +290,7 @@ class Tile:
             if not self.in_links:
                 self.step()
                 continue
-            progressed = False
-            overrun = False
-            for il in self.in_links:
-                r, frag, payload = il.poll()
-                if r == POLL_FRAG:
-                    self.in_cur = il
-                    self.on_frag(frag, payload)
-                    il.advance()
-                    progressed = True
-                elif r == POLL_OVERRUN:
-                    # InLink.poll repositioned + counted; the consumer is
-                    # behind, so keep polling hot — never throttle it.
-                    overrun = True
+            progressed, overrun = self.poll_inputs()
             if progressed or overrun:
                 idle_spins = 0
             else:
@@ -433,6 +439,7 @@ class VerifyTile(Tile):
         tcache_depth: int = 4096,
         inflight: int = 2,
         max_wait_us: int = 500,
+        native_drain: bool = True,
         **kw,
     ):
         super().__init__(wksp, cnc_name, in_link=in_link, out_link=out_link, **kw)
@@ -452,6 +459,22 @@ class VerifyTile(Tile):
         self.stat_batches = 0
         self.stat_flush_timeout = 0
         self.stat_inflight_stall = 0
+        # Native bulk drain (native/verify_drain.cc): one C call per batch
+        # round replaces the per-frag Python poll/parse/copy loop (~30 us
+        # per txn measured; the loop is the host-side throughput cap,
+        # microbench.py ring_tile_hop). Requires the single-in-link tpu
+        # path; per-frag semantics (parse errors, HA dedup, diag
+        # counters) are preserved — parse is differentially fuzz-tested
+        # against ballet/txn.py.
+        self._nd = False
+        from firedancer_tpu.ballet.txn import MAX_SIG_CNT
+
+        if (backend == "tpu" and native_drain and in_link is not None
+                and batch >= MAX_SIG_CNT):
+            # batch >= MAX_SIG_CNT guarantees every parseable txn fits a
+            # fresh batch; smaller batches fall back to the Python path,
+            # which oracles outsized multisig txns instead of dropping.
+            self._nd_setup()
         if backend == "tpu":
             import jax
             import jax.numpy as jnp
@@ -470,6 +493,121 @@ class VerifyTile(Tile):
                 jnp.zeros((batch, 64), jnp.uint8),
                 jnp.zeros((batch, 32), jnp.uint8),
             ).block_until_ready()
+
+    def _nd_setup(self) -> None:
+        import ctypes
+
+        from firedancer_tpu.tango.rings import lib as rings_lib
+
+        self._nd_lib = rings_lib()
+        self._nd_ct = ctypes
+        b, mtu = self.batch, self.max_msg_len
+        self._nd_msgs = np.zeros((b, mtu), np.uint8)
+        self._nd_lens = np.zeros(b, np.uint32)
+        self._nd_sigs = np.zeros((b, 64), np.uint8)
+        self._nd_pubs = np.zeros((b, 32), np.uint8)
+        self._nd_pay = np.zeros(b * FD_TPU_MTU, np.uint8)
+        self._nd_offs = np.zeros(b, np.uint32)
+        self._nd_plens = np.zeros(b, np.uint32)
+        self._nd_psigs = np.zeros(b, np.uint64)
+        self._nd_tlanes = np.zeros(b, np.uint32)
+        self._nd_tsorig = np.zeros(b, np.uint32)
+        self._nd_counters = np.zeros(6, np.uint64)
+        self._nd_prev = np.zeros(6, np.uint64)
+        self._nd_pay_fill = 0
+        self._nd = True
+
+    def poll_inputs(self):
+        if not self._nd:
+            return super().poll_inputs()
+        il = self.in_link
+        ct = self._nd_ct
+        room_lanes = self.batch - self._pending_lanes
+        if room_lanes <= 0:
+            self._dispatch()
+            self._complete(block=False)
+            return False, False
+        lane0 = self._pending_lanes
+        seq = ct.c_uint64(il.seq)
+        n = self._nd_lib.fd_verify_drain(
+            il.mcache._mem, ct.addressof(il.dcache._buf),
+            ct.byref(seq),
+            self.batch - len(self._pending), room_lanes,
+            self.batch, self.max_msg_len,
+            self._nd_msgs.ctypes.data + lane0 * self.max_msg_len,
+            self._nd_lens.ctypes.data + lane0 * 4,
+            self._nd_sigs.ctypes.data + lane0 * 64,
+            self._nd_pubs.ctypes.data + lane0 * 32,
+            self._nd_pay.ctypes.data + self._nd_pay_fill,
+            self._nd_pay.nbytes - self._nd_pay_fill,
+            self._nd_offs.ctypes.data, self._nd_plens.ctypes.data,
+            self._nd_psigs.ctypes.data,
+            self._nd_tlanes.ctypes.data, self._nd_tsorig.ctypes.data,
+            self._nd_counters.ctypes.data,
+        )
+        overrun = False
+        d = self._nd_counters - self._nd_prev
+        self._nd_prev = self._nd_counters.copy()
+        if d[1] or d[3]:  # parse errors + oversize -> sv filter diag
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, int(d[1] + d[3]))
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, int(d[4] + d[5]))
+        if d[2]:
+            il.fseq.diag_add(DIAG_OVRNR_CNT, int(d[2]))
+            overrun = True
+        if n <= 0:
+            il.seq = seq.value
+            return False, overrun
+        if not self._pending:
+            self._pending_since = tempo.tickcount()
+        base = self._nd_pay_fill
+        for i in range(n):
+            off = base + int(self._nd_offs[i])
+            ln = int(self._nd_plens[i])
+            payload = self._nd_pay[off : off + ln].tobytes()
+            cnt = int(self._nd_tlanes[i])
+            if self.ha_tcache.insert(hash(payload)):
+                self.cnc.diag_add(CNC_DIAG_HA_FILT_CNT, 1)
+                self.cnc.diag_add(CNC_DIAG_HA_FILT_SZ, ln)
+                # Lanes stay staged; completion skips publish (None).
+                self._pending.append((None, cnt, 0))
+            else:
+                self._pending.append((payload, cnt, int(self._nd_tsorig[i])))
+            self._nd_pay_fill = off + ln
+            self._pending_lanes += cnt
+        # Advance the consumed-seq marker only AFTER the txns are visible
+        # in _pending: the supervisor's quiescence check reads both from
+        # another thread, and seq-first would open a consumed-but-unqueued
+        # window where the pipeline looks drained and HALT races in.
+        il.seq = seq.value
+        if self._pending_lanes >= self.batch:
+            self._dispatch()
+        self._complete(block=False)
+        return True, overrun
+
+    def _dispatch_native(self, force: bool = False) -> None:
+        jnp = self._jnp
+        if not self._pending:
+            return
+        if not force and self._pending_lanes < self.batch:
+            return
+        while len(self._inflight) >= self.inflight_max:
+            self.stat_inflight_stall += 1
+            self._complete(block=True)
+        out = self._verify_batch_fn(
+            jnp.asarray(self._nd_msgs.copy()),
+            jnp.asarray(self._nd_lens.astype(np.int32)),
+            jnp.asarray(self._nd_sigs.copy()),
+            jnp.asarray(self._nd_pubs.copy()),
+        )
+        todo = self._pending
+        self._pending = []
+        self._pending_lanes = 0
+        self._nd_pay_fill = 0
+        self._inflight.append(_InflightBatch(
+            out=out, todo=todo, oversize=[False] * self.batch,
+            t_dispatch=tempo.tickcount(),
+        ))
+        self.stat_batches += 1
 
     def on_frag(self, frag: Frag, payload: bytes) -> None:
         try:
@@ -542,6 +680,12 @@ class VerifyTile(Tile):
     # -- async offload shim ----------------------------------------------
 
     def _dispatch(self, force: bool = False) -> None:
+        if self._nd:
+            self._dispatch_native(force)
+            return
+        self._dispatch_py(force)
+
+    def _dispatch_py(self, force: bool = False) -> None:
         """Ship pending txns to the device as fixed-shape batches without
         waiting for results (jax dispatches asynchronously). Whole txns
         only per batch — a txn's sigs never straddle two batches, so each
@@ -601,6 +745,9 @@ class VerifyTile(Tile):
             self._inflight.pop(0)
             off = 0
             for payload, cnt, tsorig in ib.todo:
+                if payload is None:  # HA-filtered post-staging (native)
+                    off += cnt
+                    continue
                 lane = statuses[off : off + cnt]
                 over = any(ib.oversize[off : off + cnt])
                 ok = cnt > 0 and not over and bool((lane == 0).all())
@@ -647,13 +794,22 @@ class PackTile(Tile):
     name = "pack"
 
     def __init__(self, wksp, cnc_name, in_link, out_link, bank_cnt: int = 4,
-                 **kw):
+                 scheduler: str = "greedy", gc_block: int = 1024, **kw):
         from firedancer_tpu.ballet.pack import CuEstimator, Pack
 
         super().__init__(wksp, cnc_name, in_link=in_link, out_link=out_link, **kw)
+        if scheduler not in ("greedy", "gc"):
+            raise ValueError(f"unknown pack scheduler {scheduler!r}")
         self.pack = Pack(bank_cnt=bank_cnt)
         self.est = CuEstimator()
         self.bank_cnt = bank_cnt
+        # scheduler="gc": block-batched XLA graph coloring (ops/pack_gc,
+        # the BASELINE stretch) instead of the streaming CPU greedy heap.
+        # Waves are conflict-free parallel batches; txns within a wave
+        # spread round-robin over banks. gc_block bounds batching latency.
+        self.scheduler = scheduler
+        self.gc_block = gc_block
+        self._gc_pending: list = []
         self._next_txn_id = 0
         self._payloads: dict = {}
         self._tsorig: dict = {}
@@ -690,6 +846,14 @@ class PackTile(Tile):
             self.in_cur.fseq.diag_add(DIAG_FILT_CNT, 1)
             return
         rewards, est_cus, _cu_limit = rce
+        if est_cus > self.pack.max_cu_per_bank:
+            # Can never fit any bank/wave budget: no scheduler ever picks
+            # it (the greedy heap would hold it forever; the GC rounds
+            # would re-color it forever). The reference similarly bounds
+            # insertable cost. Drop + count.
+            self.in_cur.fseq.diag_add(DIAG_FILT_CNT, 1)
+            self.in_cur.fseq.diag_add(DIAG_FILT_SZ, len(payload))
+            return
         tid = self._next_txn_id
         self._next_txn_id += 1
         pt = PackTxn(
@@ -701,11 +865,58 @@ class PackTile(Tile):
         )
         self._payloads[tid] = payload
         self._tsorig[tid] = frag.tsorig
+        if self.scheduler == "gc":
+            self._gc_pending.append(pt)
+            if len(self._gc_pending) >= self.gc_block:
+                self._drain_gc()
+            return
         self.pack.insert(pt)
         self._drain()
 
     def on_idle(self) -> None:
+        if self.scheduler == "gc":
+            if self._gc_pending:
+                self._drain_gc()
+            return
         self._drain()
+
+    def _drain_gc(self) -> None:
+        """Schedule the pending block on the device scheduler and publish
+        wave by wave (waves are admissible parallel batches; the CPU
+        Pack/validate_schedule semantics are pinned by tests/test_pack_gc
+        and the bench's admissibility gate)."""
+        from firedancer_tpu.ops.pack_gc import schedule_block
+
+        # _gc_pending stays populated through the (slow: possible XLA
+        # compile) device call and the publishes — the supervisor's
+        # quiescence check reads it from another thread, and a batch held
+        # only in locals would let HALT race in and drop it (same
+        # invariant _dispatch_py documents).
+        from firedancer_tpu.ballet.txn import MAX_ACCT_CNT
+
+        txns = list(self._gc_pending)
+        # Pinned shapes: one compiled program serves every block size in
+        # [1, gc_block] x any account mix (review finding: per-block
+        # shape drift recompiled the scan in the hot path).
+        waves, leftover = schedule_block(
+            txns, pad_to=self.gc_block,
+            max_w=MAX_ACCT_CNT, max_r=MAX_ACCT_CNT)
+        for wave in waves:
+            for txn in wave:
+                # Persistent round-robin: within a wave txns may run in
+                # parallel (no conflicts), across waves banks just take
+                # the next slot — trickle arrivals (1-txn waves) still
+                # spread over all banks.
+                bank = self._rr_bank
+                self._rr_bank = (self._rr_bank + 1) % self.bank_cnt
+                payload = self._payloads.pop(txn.txn_id)
+                sig = (bank << 48) | (txn.txn_id & 0xFFFFFFFFFFFF)
+                self.publish_backp(payload, sig, count_diag=False,
+                                   tsorig=self._tsorig.pop(txn.txn_id, 0))
+        # CU-capped leftovers stay pending; the next round has fresh wave
+        # budgets, so the set strictly shrinks (unschedulably large txns
+        # were rejected at insert time).
+        self._gc_pending = list(leftover)
 
     def _drain(self) -> None:
         """Schedule as many non-conflicting txns as possible, rotating

@@ -140,6 +140,20 @@ def hash_account(key: bytes, h_bits: int = H_BITS_DEFAULT) -> int:
     return h % h_bits
 
 
+class _PadTxn:
+    """Shape-padding placeholder: no locks, zero priority, 1 CU."""
+
+    txn_id = -1
+    rewards = 0
+    est_cus = 1
+    writable = frozenset()
+    readonly = frozenset()
+    score = 0.0
+
+
+PackTxnPad = _PadTxn()
+
+
 def build_arrays(
     txns,
     h_bits: int = H_BITS_DEFAULT,
@@ -174,15 +188,33 @@ def schedule_block(
     n_colors: int = MAX_COLORS_DEFAULT,
     h_bits: int = H_BITS_DEFAULT,
     cu_cap: int = 12_000_000,
+    pad_to: int | None = None,
+    max_w: int | None = None,
+    max_r: int | None = None,
 ):
     """End-to-end host API: PackTxn list -> (waves, leftover).
 
     waves: list of lists of PackTxn, wave k = color k (parallel batch);
     leftover: txns the device left unscheduled this round.
+
+    pad_to / max_w / max_r pin the jitted program's shapes: a streaming
+    caller (the pack tile) feeds ever-varying block sizes and per-block
+    account maxima, and without pinning each new (n, AW, AR) shape costs
+    a fresh XLA compile of the 1000+-step scan. pad_to rounds n up to a
+    multiple (dummy txns have no accounts and zero score, so they color
+    freely and are sliced off the result).
     """
     if not txns:
         return [], []
-    w_idx, r_idx, scores, cus = build_arrays(txns, h_bits)
+    n_real = len(txns)
+    if pad_to:
+        pad = (-n_real) % pad_to
+        if pad:
+            txns = list(txns) + [
+                PackTxnPad for _ in range(pad)
+            ]
+    w_idx, r_idx, scores, cus = build_arrays(txns, h_bits,
+                                             max_w=max_w, max_r=max_r)
     colors = np.asarray(
         pack_schedule(
             jnp.asarray(w_idx),
@@ -196,7 +228,7 @@ def schedule_block(
     )
     waves = [[] for _ in range(n_colors)]
     leftover = []
-    for t, c in zip(txns, colors):
+    for t, c in zip(txns[:n_real], colors[:n_real]):
         if c < 0:
             leftover.append(t)
         else:

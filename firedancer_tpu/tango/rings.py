@@ -114,6 +114,22 @@ def load_lib() -> ctypes.CDLL:
     lib.fd_dcache_next_chunk.restype = ctypes.c_uint32
     lib.fd_dcache_next_chunk.argtypes = [ctypes.c_uint32, ctypes.c_uint32,
                                          ctypes.c_uint32, ctypes.c_uint32]
+    lib.fd_txn_parse_check.restype = ctypes.c_int
+    lib.fd_txn_parse_check.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                                       ctypes.c_void_p]
+    lib.fd_verify_drain.restype = ctypes.c_int
+    lib.fd_verify_drain.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p,                   # mcache, dcache
+        ctypes.POINTER(ctypes.c_uint64),                    # seq_io
+        ctypes.c_uint32, ctypes.c_uint32,                   # txns, room
+        ctypes.c_uint32, ctypes.c_uint32,                   # hard_lanes, mtu
+        ctypes.c_void_p, ctypes.c_void_p,                   # msgs, lens
+        ctypes.c_void_p, ctypes.c_void_p,                   # sigs, pubs
+        ctypes.c_void_p, ctypes.c_uint32,                   # payloads, cap
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,  # offs/lens/sigs
+        ctypes.c_void_p, ctypes.c_void_p,                   # lanes, tsorig
+        ctypes.c_void_p,                                    # counters
+    ]
     return lib
 
 

@@ -1,0 +1,303 @@
+"""Per-component host-path microbenchmarks (K ops/s + ns/op).
+
+The reference's unit tests each end with a bench section logging K/s/core
+and ns/call (e.g. src/ballet/ed25519/test_ed25519.c:713-780 log_bench);
+this is the consolidated equivalent for the host-side components, so the
+per-frag Python/native overhead that bounds pipeline throughput is a
+measured number, not a guess.
+
+  python microbench.py [name ...]     # default: all
+Prints one JSON line per bench: {"bench", "ops_per_s", "ns_per_op", ...}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def _bench(name: str, fn, n: int, unit: str = "op", **extra) -> dict:
+    t0 = time.perf_counter()
+    fn(n)
+    dt = time.perf_counter() - t0
+    rec = {
+        "bench": name,
+        "ops_per_s": round(n / dt, 1),
+        "ns_per_op": round(dt / n * 1e9, 1),
+        "n": n,
+        "unit": unit,
+        **extra,
+    }
+    print(json.dumps(rec))
+    return rec
+
+
+def bench_mcache_publish_poll():
+    """Native ring hop: publish + poll + dcache write/read per frag."""
+    from firedancer_tpu.disco.tiles import InLink, LinkNames, OutLink
+    from firedancer_tpu.tango.rings import POLL_FRAG, Workspace
+
+    with tempfile.TemporaryDirectory() as d:
+        from firedancer_tpu.tango.rings import DCache, FSeq, MCache
+
+        wksp = Workspace.create(os.path.join(d, "w"), 1 << 22)
+        MCache(wksp, "l.mcache", depth=1024, create=True)
+        DCache(wksp, "l.dcache", data_sz=1 << 20, create=True)
+        FSeq(wksp, "l.fseq", create=True)
+        names = LinkNames("l.mcache", "l.dcache", "l.fseq")
+        out = OutLink(wksp, names, mtu=1232)
+        inl = InLink(wksp, names)
+        payload = b"x" * 200
+
+        def run(n):
+            for i in range(n):
+                while not out.can_publish():
+                    inl.housekeep()
+                out.publish(payload, i)
+                r, f, p = inl.poll()
+                assert r == POLL_FRAG and len(p) == 200
+                inl.advance()
+                if i % 512 == 0:
+                    inl.housekeep()
+
+        _bench("mcache_publish_poll", run, 100_000)
+        wksp.leave()
+
+
+def bench_tcache_insert():
+    from firedancer_tpu.tango.tcache import TCache
+
+    tc = TCache(1 << 16)
+
+    def run(n):
+        for i in range(n):
+            tc.insert(i)
+
+    _bench("tcache_insert", run, 200_000)
+
+
+def bench_txn_parse():
+    from firedancer_tpu.ballet.txn import build_txn, parse_txn
+
+    p = build_txn(
+        signer_seeds=[bytes([7]) * 32],
+        extra_accounts=[bytes([1]) * 32, bytes([2]) * 32],
+        n_readonly_unsigned=2,
+        instrs=[(1, [0], b"d" * 64), (2, [0], b"e" * 32)],
+    )
+
+    def run(n):
+        for _ in range(n):
+            parse_txn(p)
+
+    _bench("txn_parse", run, 50_000, payload_sz=len(p))
+
+
+def bench_compute_budget():
+    import struct
+
+    from firedancer_tpu.ballet.compute_budget import (
+        COMPUTE_BUDGET_PROGRAM_ID,
+        estimate_rewards_and_compute,
+    )
+    from firedancer_tpu.ballet.txn import build_txn, parse_txn
+
+    p = build_txn(
+        signer_seeds=[bytes([7]) * 32],
+        extra_accounts=[COMPUTE_BUDGET_PROGRAM_ID, bytes([2]) * 32],
+        n_readonly_unsigned=2,
+        instrs=[(1, [], b"\x02" + struct.pack("<I", 200_000)),
+                (1, [], b"\x03" + struct.pack("<Q", 5_000)),
+                (2, [0], b"d" * 64)],
+    )
+    txn = parse_txn(p)
+
+    def run(n):
+        for _ in range(n):
+            estimate_rewards_and_compute(txn, p)
+
+    _bench("compute_budget_estimate", run, 50_000)
+
+
+def bench_pack_insert_schedule():
+    import random
+
+    from firedancer_tpu.ballet.pack import Pack, PackTxn
+
+    rng = random.Random(0)
+    keys = [i.to_bytes(8, "little") + bytes(24) for i in range(512)]
+    txns = [
+        PackTxn(txn_id=i, rewards=rng.randint(1, 1 << 20),
+                est_cus=rng.randint(1_000, 100_000),
+                writable=frozenset(rng.sample(keys, 2)),
+                readonly=frozenset(rng.sample(keys, 2)))
+        for i in range(4096)
+    ]
+
+    def run(n):
+        done = 0
+        while done < n:
+            pk = Pack(bank_cnt=4, depth=8192)
+            for t in txns:
+                pk.insert(t)
+            for b in range(4):
+                while True:
+                    t = pk.schedule(b)
+                    if t is None:
+                        break
+                    pk.complete(b, t.txn_id)
+                    done += 1
+
+    _bench("pack_insert_schedule", run, 8192)
+
+
+def bench_base58():
+    from firedancer_tpu.ballet import base58
+
+    data = bytes(range(32))
+
+    def run(n):
+        for _ in range(n):
+            base58.encode32(data)
+
+    _bench("base58_encode32", run, 20_000)
+
+
+def bench_ha_tag_hash():
+    """The per-frag verify-tile dedup tag (hash of whole payload)."""
+    p = os.urandom(600)
+
+    def run(n):
+        for _ in range(n):
+            hash(p)  # cached after first call on bytes? no: bytes hash is cached per object
+
+    # bytes objects cache their hash; measure fresh objects instead.
+    payloads = [os.urandom(600) for _ in range(10_000)]
+
+    def run_fresh(n):
+        for i in range(n):
+            hash(payloads[i % len(payloads)])
+
+    _bench("ha_tag_hash600B", run_fresh, 200_000)
+
+
+def bench_ring_pipeline_hop():
+    """Replay tile -> raw consumer over real rings (one thread each):
+    the frag/s ceiling of one Python tile hop."""
+    import threading
+
+    from firedancer_tpu.disco import tiles as T
+    from firedancer_tpu.disco.pipeline import build_topology
+    from firedancer_tpu.tango.rings import POLL_FRAG, Workspace
+
+    with tempfile.TemporaryDirectory() as d:
+        topo = build_topology(os.path.join(d, "w"), depth=1024)
+        wksp = Workspace.join(topo.wksp_path)
+        pod = topo.pod
+        payloads = [bytes([1]) + os.urandom(150) for _ in range(30_000)]
+        names = T.LinkNames("replay_verify.mcache", "replay_verify.dcache",
+                            "replay_verify.fseq")
+        replay = T.ReplayTile(
+            wksp, pod.query_cstr("firedancer.replay.cnc"),
+            out_link=T.OutLink(wksp, names, reliable_fseqs=[]),
+            payloads=payloads)
+        inl = T.InLink(wksp, names)
+        th = threading.Thread(target=replay.run, daemon=True)
+        t0 = time.perf_counter()
+        th.start()
+        got = 0
+        while got < len(payloads) and time.perf_counter() - t0 < 60:
+            r, f, p = inl.poll()
+            if r == POLL_FRAG:
+                got += 1
+                inl.advance()
+                if got % 2048 == 0:
+                    inl.housekeep()
+            else:
+                inl.housekeep()
+        dt = time.perf_counter() - t0
+        replay.cnc.signal(2)  # HALT
+        th.join(timeout=5)
+        print(json.dumps({
+            "bench": "ring_tile_hop", "ops_per_s": round(got / dt, 1),
+            "ns_per_op": round(dt / max(got, 1) * 1e9, 1), "n": got,
+            "unit": "frag",
+        }))
+        wksp.leave()
+
+
+def bench_native_verify_drain():
+    """fd_verify_drain: poll+parse+stage per txn, one C call per batch
+    (the native replacement for the per-frag Python loop above)."""
+    import ctypes
+
+    import numpy as np
+
+    from firedancer_tpu.ballet.txn import build_txn
+    from firedancer_tpu.disco.tiles import LinkNames, OutLink
+    from firedancer_tpu.tango.rings import DCache, FSeq, MCache, Workspace, lib
+
+    with tempfile.TemporaryDirectory() as d:
+        wksp = Workspace.create(os.path.join(d, "w"), 1 << 24)
+        depth = 1024
+        MCache(wksp, "l.mcache", depth=depth, create=True)
+        DCache(wksp, "l.dcache", data_sz=64 * 20 * (depth + 2), create=True)
+        FSeq(wksp, "l.fseq", create=True)
+        out = OutLink(wksp, LinkNames("l.mcache", "l.dcache", "l.fseq"),
+                      mtu=1232)
+        p = build_txn(signer_seeds=[bytes([7]) * 32],
+                      extra_accounts=[bytes([1]) * 32, bytes([2]) * 32],
+                      n_readonly_unsigned=2,
+                      instrs=[(1, [0], b"d" * 64), (2, [0], b"e" * 32)])
+        for i in range(depth):
+            out.publish(p, i)
+        B = depth
+        msgs = np.zeros((B, 1232), np.uint8)
+        lens = np.zeros(B, np.uint32)
+        sigs = np.zeros((B, 64), np.uint8)
+        pubs = np.zeros((B, 32), np.uint8)
+        pay = np.zeros(B * 1232, np.uint8)
+        u32 = lambda: np.zeros(B, np.uint32)
+        offs, plens, tlanes, tsor = u32(), u32(), u32(), u32()
+        psigs = np.zeros(B, np.uint64)
+        ctr = np.zeros(4, np.uint64)
+        mc = MCache(wksp, "l.mcache")
+        dc = DCache(wksp, "l.dcache")
+
+        def run(n):
+            rounds = n // depth
+            for _ in range(rounds):
+                seq = ctypes.c_uint64(0)  # re-drain the same resident frags
+                got = lib().fd_verify_drain(
+                    mc._mem, ctypes.addressof(dc._buf), ctypes.byref(seq),
+                    B, B, B, 1232,
+                    msgs.ctypes.data, lens.ctypes.data, sigs.ctypes.data,
+                    pubs.ctypes.data, pay.ctypes.data, pay.nbytes,
+                    offs.ctypes.data, plens.ctypes.data, psigs.ctypes.data,
+                    tlanes.ctypes.data, tsor.ctypes.data, ctr.ctypes.data)
+                assert got == depth
+
+        _bench("native_verify_drain", run, 100 * depth, payload_sz=len(p))
+        wksp.leave()
+
+
+ALL = {
+    "mcache_publish_poll": bench_mcache_publish_poll,
+    "tcache_insert": bench_tcache_insert,
+    "txn_parse": bench_txn_parse,
+    "compute_budget": bench_compute_budget,
+    "pack_insert_schedule": bench_pack_insert_schedule,
+    "base58": bench_base58,
+    "ha_tag_hash": bench_ha_tag_hash,
+    "ring_pipeline_hop": bench_ring_pipeline_hop,
+    "native_verify_drain": bench_native_verify_drain,
+}
+
+
+if __name__ == "__main__":
+    names = sys.argv[1:] or list(ALL)
+    for name in names:
+        ALL[name]()

@@ -1,0 +1,290 @@
+// verify_drain — native hot loop for the verify tile's ring drain.
+//
+// Role: SURVEY.md §7's "host tiles in C++" for the one loop where Python
+// per-frag overhead actually caps the pipeline (measured ~18 us per ring
+// hop + ~4 us parse + ~10 us array building per txn in microbench.py,
+// vs the reference's sub-us C loop, app/frank/fd_frank_verify.c:140-207).
+// One call drains up to max_txns frags: seqlock'd mcache poll, dcache
+// payload copy, full structural txn parse (exact semantics of
+// ballet/txn.py parse_txn — differentially fuzz-tested), and staging of
+// per-SIGNATURE verify lanes (msg rows, lens, sigs, pubs) laid out
+// exactly as ops.verify.verify_batch consumes them.
+//
+// The Python tile keeps: HA dedup, batch dispatch, completion publish —
+// per-batch costs, not per-frag.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int POLL_EMPTY = 0;
+constexpr int POLL_FRAG = 1;
+constexpr int POLL_OVERRUN = 2;
+
+struct frag_meta {
+  std::atomic<uint64_t> seq;
+  uint64_t sig;
+  uint32_t chunk;
+  uint16_t sz;
+  uint16_t ctl;
+  uint32_t tsorig;
+  uint32_t tspub;
+};
+static_assert(sizeof(frag_meta) == 32, "frag_meta must be 32 bytes");
+
+struct mcache_hdr {
+  uint64_t depth;
+  std::atomic<uint64_t> seq0;
+  uint64_t pad[6];
+};
+
+// ---- txn parse (exact ballet/txn.py semantics) --------------------------
+
+constexpr uint32_t MTU = 1232;
+constexpr uint32_t MAX_SIG_CNT = 19;
+constexpr uint32_t MAX_ACCT_CNT = 35;
+constexpr uint32_t MAX_INSTR_CNT = 355;
+
+// Returns 0 on success with *val/*off updated; -1 on parse error.
+static int cu16(const uint8_t *buf, uint32_t len, uint32_t *off,
+                uint32_t *val) {
+  uint32_t o = *off;
+  if (o >= len) return -1;
+  uint8_t b0 = buf[o];
+  if (b0 < 0x80) { *val = b0; *off = o + 1; return 0; }
+  if (o + 1 >= len) return -1;
+  uint8_t b1 = buf[o + 1];
+  if (b1 < 0x80) {
+    if (b1 == 0) return -1;  // non-minimal
+    *val = (uint32_t)(b0 & 0x7F) | ((uint32_t)b1 << 7);
+    *off = o + 2;
+    return 0;
+  }
+  if (o + 2 >= len) return -1;
+  uint8_t b2 = buf[o + 2];
+  if (b2 > 0x03 || b2 == 0) return -1;  // overflow / non-minimal
+  *val = (uint32_t)(b0 & 0x7F) | ((uint32_t)(b1 & 0x7F) << 7)
+         | ((uint32_t)b2 << 14);
+  *off = o + 3;
+  return 0;
+}
+
+struct txn_view {
+  uint32_t sig_cnt;
+  uint32_t sig_off;
+  uint32_t message_off;
+  uint32_t acct_cnt;
+  uint32_t acct_off;
+};
+
+// Full structural validation; returns 0 ok / -1 malformed.
+static int parse_txn(const uint8_t *buf, uint32_t len, txn_view *tv) {
+  if (len > MTU) return -1;
+  uint32_t off = 0, sig_cnt;
+  if (cu16(buf, len, &off, &sig_cnt)) return -1;
+  if (sig_cnt == 0 || sig_cnt > MAX_SIG_CNT) return -1;
+  tv->sig_cnt = sig_cnt;
+  tv->sig_off = off;
+  off += 64 * sig_cnt;
+  if (off > len) return -1;
+  tv->message_off = off;
+  int version = -1;
+  if (off < len && (buf[off] & 0x80)) {
+    version = buf[off] & 0x7F;
+    if (version != 0) return -1;
+    off += 1;
+  }
+  if (off + 3 > len) return -1;
+  uint8_t n_req = buf[off], n_ro_signed = buf[off + 1],
+          n_ro_unsigned = buf[off + 2];
+  off += 3;
+  if (n_req != sig_cnt) return -1;
+  uint8_t req_floor = n_req ? n_req : 1;
+  if (n_ro_signed >= req_floor) return -1;
+  uint32_t acct_cnt;
+  if (cu16(buf, len, &off, &acct_cnt)) return -1;
+  if (acct_cnt < n_req || acct_cnt > MAX_ACCT_CNT) return -1;
+  if (n_ro_unsigned > acct_cnt - n_req) return -1;
+  tv->acct_cnt = acct_cnt;
+  tv->acct_off = off;
+  off += 32 * acct_cnt;
+  if (off > len) return -1;
+  off += 32;  // blockhash
+  if (off > len) return -1;
+  uint32_t instr_cnt;
+  if (cu16(buf, len, &off, &instr_cnt)) return -1;
+  if (instr_cnt > MAX_INSTR_CNT) return -1;
+  for (uint32_t i = 0; i < instr_cnt; i++) {
+    if (off >= len) return -1;
+    uint8_t prog_idx = buf[off];
+    off += 1;
+    if (prog_idx >= acct_cnt) return -1;
+    uint32_t a_cnt;
+    if (cu16(buf, len, &off, &a_cnt)) return -1;
+    uint32_t a_off = off;
+    off += a_cnt;
+    if (off > len) return -1;
+    if (version == -1) {
+      for (uint32_t k = 0; k < a_cnt; k++)
+        if (buf[a_off + k] >= acct_cnt) return -1;
+    }
+    uint32_t d_sz;
+    if (cu16(buf, len, &off, &d_sz)) return -1;
+    off += d_sz;
+    if (off > len) return -1;
+  }
+  if (version == 0) {
+    uint32_t lut_cnt;
+    if (cu16(buf, len, &off, &lut_cnt)) return -1;
+    for (uint32_t i = 0; i < lut_cnt; i++) {
+      off += 32;
+      if (off > len) return -1;
+      uint32_t w_cnt;
+      if (cu16(buf, len, &off, &w_cnt)) return -1;
+      off += w_cnt;
+      if (off > len) return -1;
+      uint32_t r_cnt;
+      if (cu16(buf, len, &off, &r_cnt)) return -1;
+      off += r_cnt;
+      if (off > len) return -1;
+    }
+  }
+  if (off != len) return -1;  // trailing bytes
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Standalone parser entry (differential testing vs ballet/txn.py):
+// returns 0 ok / -1 malformed; on ok fills out5 = {sig_cnt, sig_off,
+// message_off, acct_cnt, acct_off}.
+int fd_txn_parse_check(const uint8_t *buf, uint32_t len, uint32_t *out5) {
+  txn_view tv;
+  if (parse_txn(buf, len, &tv)) return -1;
+  out5[0] = tv.sig_cnt;
+  out5[1] = tv.sig_off;
+  out5[2] = tv.message_off;
+  out5[3] = tv.acct_cnt;
+  out5[4] = tv.acct_off;
+  return 0;
+}
+
+// Drain up to max_txns frags starting at *seq_io from one in-ring.
+//
+//   mcache/dcache  : ring memory (dcache chunk addressing: 64 B granules)
+//   msgs           : (max_lanes, max_msg_len) row-major u8 staging
+//   lens           : (max_lanes,) u32 message lengths
+//   sigs           : (max_lanes, 64) u8
+//   pubs           : (max_lanes, 32) u8
+//   payloads       : packed payload bytes, txn i at payload_offs[i],
+//                    length payload_lens[i] (capacity payload_cap)
+//   hard_max_lanes : the full batch width (oversize threshold); max_lanes
+//                    is only the REMAINING room in the current batch
+//   txn_lanes      : (max_txns,) u32 — lanes (signatures) of txn i
+//   txn_tsorig     : (max_txns,) u32
+//   counters       : u64[6] {drained_ok, parse_err, overrun, oversize,
+//                    parse_err_bytes, oversize_bytes}
+//
+// A txn with message bytes > max_msg_len is counted oversize and NOT
+// staged (the tile oracles/fails it; cannot happen under the MTU with
+// sane staging widths). Malformed txns are counted parse_err and
+// consumed. Returns the number of staged txns; *seq_io advances past
+// every consumed frag. Stops early when lanes, txn, or payload capacity
+// would overflow, or the ring is empty.
+int fd_verify_drain(void *mcache, void *dcache_base, uint64_t *seq_io,
+                    uint32_t max_txns, uint32_t max_lanes,
+                    uint32_t hard_max_lanes, uint32_t max_msg_len,
+                    uint8_t *msgs, uint32_t *lens, uint8_t *sigs,
+                    uint8_t *pubs,
+                    uint8_t *payloads, uint32_t payload_cap,
+                    uint32_t *payload_offs, uint32_t *payload_lens,
+                    uint64_t *payload_sigs,
+                    uint32_t *txn_lanes, uint32_t *txn_tsorig,
+                    uint64_t *counters) {
+  auto *h = (mcache_hdr *)mcache;
+  auto *line = (frag_meta *)((char *)mcache + sizeof(mcache_hdr));
+  uint64_t seq = *seq_io;
+  uint32_t n_txn = 0, n_lane = 0, pay_off = 0;
+
+  while (n_txn < max_txns) {
+    frag_meta *m = &line[seq & (h->depth - 1)];
+    uint64_t s0 = m->seq.load(std::memory_order_acquire);
+    if (s0 != seq) {
+      if (s0 == ~0ULL || s0 < seq) break;  // empty / publish in progress
+      // Lapped: the line holds seq + k*depth, so the oldest frag still
+      // in the ring is s0 - depth + 1; count everything skipped.
+      uint64_t new_seq = s0 - h->depth + 1;
+      if (new_seq <= seq) new_seq = seq + 1;
+      counters[2] += new_seq - seq;
+      seq = new_seq;
+      continue;
+    }
+    uint64_t sig = m->sig;
+    uint32_t chunk = m->chunk;
+    uint16_t sz = m->sz;
+    uint32_t tsorig = m->tsorig;
+    // Copy the payload out BEFORE revalidating the seqlock.
+    uint8_t tmp[MTU];
+    uint32_t cp = sz <= MTU ? sz : MTU;
+    std::memcpy(tmp, (uint8_t *)dcache_base + (uint64_t)chunk * 64, cp);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    if (m->seq.load(std::memory_order_acquire) != seq) {
+      counters[2] += 1;  // overwritten mid-copy
+      seq += 1;
+      continue;
+    }
+
+    txn_view tv;
+    if (sz > MTU || parse_txn(tmp, cp, &tv)) {
+      counters[1] += 1;  // parse_err: consumed + dropped
+      counters[4] += cp;
+      seq += 1;
+      continue;
+    }
+    uint32_t msg_len = cp - tv.message_off;
+    if (msg_len > max_msg_len || tv.sig_cnt > hard_max_lanes) {
+      // Oversize for the staging SHAPE (never fits any batch): consume
+      // and drop. NOT the remaining-room check below — a multisig txn
+      // that merely doesn't fit the current batch must be deferred, not
+      // dropped (bug found by the replay gate's content audit).
+      counters[3] += 1;
+      counters[5] += cp;
+      seq += 1;
+      continue;
+    }
+    if (tv.sig_cnt > max_lanes - n_lane || pay_off + cp > payload_cap) {
+      break;  // out of batch capacity; leave frag for the next drain
+    }
+    // Stage verify lanes: every signature verifies the same message.
+    for (uint32_t s = 0; s < tv.sig_cnt; s++) {
+      uint32_t l = n_lane + s;
+      std::memcpy(sigs + (uint64_t)l * 64, tmp + tv.sig_off + 64 * s, 64);
+      std::memcpy(pubs + (uint64_t)l * 32, tmp + tv.acct_off + 32 * s, 32);
+      std::memcpy(msgs + (uint64_t)l * max_msg_len, tmp + tv.message_off,
+                  msg_len);
+      // Zero the row tail so stale bytes never leak between batches.
+      std::memset(msgs + (uint64_t)l * max_msg_len + msg_len, 0,
+                  max_msg_len - msg_len);
+      lens[l] = msg_len;
+    }
+    std::memcpy(payloads + pay_off, tmp, cp);
+    payload_offs[n_txn] = pay_off;
+    payload_lens[n_txn] = cp;
+    payload_sigs[n_txn] = sig;
+    txn_lanes[n_txn] = tv.sig_cnt;
+    txn_tsorig[n_txn] = tsorig;
+    pay_off += cp;
+    n_lane += tv.sig_cnt;
+    n_txn += 1;
+    counters[0] += 1;
+    seq += 1;
+  }
+  *seq_io = seq;
+  return (int)n_txn;
+}
+
+}  // extern "C"
