@@ -114,6 +114,14 @@ def load_lib() -> ctypes.CDLL:
     lib.fd_dcache_next_chunk.restype = ctypes.c_uint32
     lib.fd_dcache_next_chunk.argtypes = [ctypes.c_uint32, ctypes.c_uint32,
                                          ctypes.c_uint32, ctypes.c_uint32]
+    lib.fd_wksp_alloc_cnt.restype = ctypes.c_uint32
+    lib.fd_wksp_alloc_cnt.argtypes = [ctypes.c_void_p]
+    lib.fd_wksp_stat.restype = ctypes.c_int
+    lib.fd_wksp_stat.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                 ctypes.c_char_p,
+                                 ctypes.POINTER(ctypes.c_uint64),
+                                 ctypes.POINTER(ctypes.c_uint64)]
+    lib.fd_wksp_usage.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.fd_txn_parse_check.restype = ctypes.c_int
     lib.fd_txn_parse_check.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
                                        ctypes.c_void_p]
@@ -190,6 +198,29 @@ class Workspace:
         if not off:
             raise KeyError(name)
         return off, sz.value
+
+    def alloc_list(self):
+        """[(name, off, sz)] of every named alloc (fd_wksp_ctl query)."""
+        import ctypes as ct
+
+        n = lib().fd_wksp_alloc_cnt(self._h)
+        out = []
+        name = ct.create_string_buffer(64)
+        off = ct.c_uint64()
+        sz = ct.c_uint64()
+        for i in range(n):
+            if lib().fd_wksp_stat(self._h, i, name, ct.byref(off),
+                                  ct.byref(sz)) == 0:
+                out.append((name.value.decode(), off.value, sz.value))
+        return out
+
+    def usage(self):
+        """{total_sz, used, alloc_cnt} summary."""
+        import ctypes as ct
+
+        buf = (ct.c_uint64 * 3)()
+        lib().fd_wksp_usage(self._h, buf)
+        return {"total_sz": buf[0], "used": buf[1], "alloc_cnt": buf[2]}
 
     def laddr(self, off: int) -> int:
         return lib().fd_wksp_laddr(self._h, off)

@@ -1,0 +1,177 @@
+"""Bit manipulation + saturating + wide integer helpers.
+
+Role parity with the reference's util/bits layer (fd_bits.h bit tricks,
+fd_sat.h saturating math, fd_uwide.h 128-bit ops for targets without
+int128). Python ints are unbounded, so the point here is NOT emulating
+word width for arithmetic's sake — it is providing the reference's
+exact wrap/saturate semantics where protocol code depends on them
+(sequence arithmetic, counters, fixed-width wire fields), with the same
+edge-case behavior the reference unit-tests (test_bits.c, test_sat.c).
+"""
+
+from __future__ import annotations
+
+U8_MAX = (1 << 8) - 1
+U16_MAX = (1 << 16) - 1
+U32_MAX = (1 << 32) - 1
+U64_MAX = (1 << 64) - 1
+U128_MAX = (1 << 128) - 1
+
+
+# -- fd_bits.h analogs ----------------------------------------------------
+
+
+def is_pow2(x: int) -> bool:
+    return x > 0 and (x & (x - 1)) == 0
+
+
+def pow2_up(x: int) -> int:
+    """Smallest power of 2 >= x (x >= 1)."""
+    if x < 1:
+        raise ValueError("x >= 1")
+    return 1 << (x - 1).bit_length()
+
+
+def pow2_dn(x: int) -> int:
+    """Largest power of 2 <= x (x >= 1)."""
+    if x < 1:
+        raise ValueError("x >= 1")
+    return 1 << (x.bit_length() - 1)
+
+
+def align_up(x: int, a: int) -> int:
+    if not is_pow2(a):
+        raise ValueError("alignment must be a power of 2")
+    return (x + a - 1) & ~(a - 1)
+
+
+def align_dn(x: int, a: int) -> int:
+    if not is_pow2(a):
+        raise ValueError("alignment must be a power of 2")
+    return x & ~(a - 1)
+
+
+def is_aligned(x: int, a: int) -> bool:
+    return align_dn(x, a) == x
+
+
+def popcnt(x: int) -> int:
+    return x.bit_count()
+
+
+def find_lsb(x: int) -> int:
+    """Index of the least significant set bit (x > 0)."""
+    if x <= 0:
+        raise ValueError("x > 0")
+    return (x & -x).bit_length() - 1
+
+
+def find_msb(x: int) -> int:
+    """Index of the most significant set bit (x > 0)."""
+    if x <= 0:
+        raise ValueError("x > 0")
+    return x.bit_length() - 1
+
+
+def mask_lsb(n: int) -> int:
+    """n low bits set (0 <= n)."""
+    return (1 << n) - 1
+
+
+def extract(x: int, lo: int, hi: int) -> int:
+    """Bits [lo, hi] inclusive, LSB-0 indexing (fd_ulong_extract)."""
+    return (x >> lo) & mask_lsb(hi - lo + 1)
+
+
+def insert(x: int, lo: int, hi: int, y: int) -> int:
+    """Replace bits [lo, hi] of x with y."""
+    m = mask_lsb(hi - lo + 1)
+    return (x & ~(m << lo)) | ((y & m) << lo)
+
+
+def rotate_left(x: int, n: int, width: int = 64) -> int:
+    n %= width
+    m = mask_lsb(width)
+    x &= m
+    return ((x << n) | (x >> (width - n))) & m
+
+
+def rotate_right(x: int, n: int, width: int = 64) -> int:
+    return rotate_left(x, width - (n % width), width)
+
+
+def bswap(x: int, width: int = 64) -> int:
+    return int.from_bytes((x & mask_lsb(width)).to_bytes(width // 8, "little"),
+                          "big")
+
+
+# -- sequence arithmetic (fd_seq.h analog: 64-bit wrapping compares) ------
+
+
+def seq_diff(a: int, b: int) -> int:
+    """Signed distance a-b in 64-bit sequence space."""
+    d = (a - b) & U64_MAX
+    return d - (1 << 64) if d >= (1 << 63) else d
+
+
+def seq_lt(a: int, b: int) -> bool:
+    return seq_diff(a, b) < 0
+
+
+def seq_le(a: int, b: int) -> bool:
+    return seq_diff(a, b) <= 0
+
+
+# -- fd_sat.h analogs -----------------------------------------------------
+
+
+def sat_add_u64(a: int, b: int) -> int:
+    return min(a + b, U64_MAX)
+
+
+def sat_sub_u64(a: int, b: int) -> int:
+    return max(a - b, 0)
+
+
+def sat_mul_u64(a: int, b: int) -> int:
+    return min(a * b, U64_MAX)
+
+
+def sat_add_i64(a: int, b: int) -> int:
+    return max(min(a + b, (1 << 63) - 1), -(1 << 63))
+
+
+def sat_sub_i64(a: int, b: int) -> int:
+    return max(min(a - b, (1 << 63) - 1), -(1 << 63))
+
+
+# -- fd_uwide.h analogs (128-bit as (hi, lo) u64 pairs) -------------------
+
+
+def uwide_add(ah: int, al: int, bh: int, bl: int, carry: int = 0):
+    """(ah:al) + (bh:bl) + carry -> (hi, lo, carry_out), all u64."""
+    t = ((ah << 64) | al) + ((bh << 64) | bl) + carry
+    return (t >> 64) & U64_MAX, t & U64_MAX, t >> 128
+
+
+def uwide_sub(ah: int, al: int, bh: int, bl: int, borrow: int = 0):
+    """(ah:al) - (bh:bl) - borrow -> (hi, lo, borrow_out)."""
+    t = ((ah << 64) | al) - ((bh << 64) | bl) - borrow
+    bo = 1 if t < 0 else 0
+    t &= U128_MAX
+    return (t >> 64) & U64_MAX, t & U64_MAX, bo
+
+
+def uwide_mul(a: int, b: int):
+    """u64 * u64 -> (hi, lo)."""
+    t = (a & U64_MAX) * (b & U64_MAX)
+    return t >> 64, t & U64_MAX
+
+
+def uwide_div(ah: int, al: int, d: int):
+    """(ah:al) / d -> (q_hi, q_lo, remainder); d > 0."""
+    if d <= 0:
+        raise ZeroDivisionError("d > 0")
+    n = (ah << 64) | al
+    q, r = divmod(n, d)
+    return (q >> 64) & U64_MAX, q & U64_MAX, r

@@ -1,0 +1,82 @@
+"""Fixed-point arithmetic (Q34.30) + integer sqrt.
+
+Role parity with the reference's util/math layer (fd_fxp.h: unsigned
+fixed point with 30 fractional bits and explicit rounding families;
+fd_sqrt.h integer sqrt). The reference uses these where floats are
+banned from consensus-relevant code; the semantics (truncate / round
+half up / round away-from-zero variants, saturation) are what its unit
+tests pin, so they are reproduced exactly.
+"""
+
+from __future__ import annotations
+
+from .bits import U64_MAX, sat_add_u64, sat_sub_u64
+
+FRAC_BITS = 30
+ONE = 1 << FRAC_BITS
+
+
+def from_int(x: int) -> int:
+    return x << FRAC_BITS
+
+
+def to_int_rtz(x: int) -> int:
+    """Toward zero (truncate)."""
+    return x >> FRAC_BITS
+
+
+def to_int_rnz(x: int) -> int:
+    """Round half away from zero (nearest, ties up for unsigned)."""
+    return (x + (ONE >> 1)) >> FRAC_BITS
+
+
+def from_float(v: float) -> int:
+    if v < 0:
+        raise ValueError("unsigned fixed point")
+    return int(v * ONE + 0.5)
+
+
+def to_float(x: int) -> float:
+    return x / ONE
+
+
+# Saturating add/sub are the bits-module implementations (one source of
+# truth for the u64 saturation semantics).
+add_sat = sat_add_u64
+sub_sat = sat_sub_u64
+
+
+def mul_rtz(a: int, b: int) -> int:
+    """(a*b)/2^30 toward zero, saturating."""
+    return min((a * b) >> FRAC_BITS, U64_MAX)
+
+
+def mul_rnz(a: int, b: int) -> int:
+    """(a*b)/2^30 nearest (half away from zero), saturating."""
+    return min((a * b + (ONE >> 1)) >> FRAC_BITS, U64_MAX)
+
+
+def div_rtz(a: int, b: int) -> int:
+    if b == 0:
+        raise ZeroDivisionError
+    return min((a << FRAC_BITS) // b, U64_MAX)
+
+
+def div_rnz(a: int, b: int) -> int:
+    if b == 0:
+        raise ZeroDivisionError
+    return min(((a << FRAC_BITS) + (b >> 1)) // b, U64_MAX)
+
+
+def sqrt_rtz(x: int) -> int:
+    """Fixed-point sqrt toward zero: sqrt(x / 2^30) * 2^30."""
+    return isqrt(x << FRAC_BITS)
+
+
+def isqrt(x: int) -> int:
+    """Integer sqrt (floor), any nonneg int (fd_ulong_sqrt analog)."""
+    if x < 0:
+        raise ValueError("nonnegative")
+    import math
+
+    return math.isqrt(x)

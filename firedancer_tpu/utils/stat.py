@@ -1,0 +1,112 @@
+"""Streaming statistics (fd_stat.h analog).
+
+The reference's util/math/fd_stat provides robust streaming estimators
+for tile diagnostics (avg/rms over diag counters, median filtering of
+clock observations in tempo). Here: Welford running mean/variance, EMA,
+min/max tracking, and a fixed-bin histogram with percentile queries —
+the estimators the monitor and bench harnesses consume.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class Welford:
+    """Numerically stable running mean/variance."""
+
+    n: int = 0
+    mean: float = 0.0
+    _m2: float = 0.0
+    min: float = math.inf
+    max: float = -math.inf
+
+    def update(self, x: float) -> None:
+        self.n += 1
+        d = x - self.mean
+        self.mean += d / self.n
+        self._m2 += d * (x - self.mean)
+        if x < self.min:
+            self.min = x
+        if x > self.max:
+            self.max = x
+
+    @property
+    def variance(self) -> float:
+        return self._m2 / self.n if self.n > 1 else 0.0
+
+    @property
+    def stddev(self) -> float:
+        return math.sqrt(self.variance)
+
+
+@dataclass
+class Ema:
+    """Exponential moving average (alpha in (0, 1])."""
+
+    alpha: float
+    value: float = 0.0
+    primed: bool = False
+
+    def update(self, x: float) -> float:
+        if not self.primed:
+            self.value = x
+            self.primed = True
+        else:
+            self.value += self.alpha * (x - self.value)
+        return self.value
+
+
+@dataclass
+class Histogram:
+    """Fixed geometric-bin histogram with percentile queries.
+
+    Bin k covers [min_val * base^k, min_val * base^(k+1)); used for
+    latency distributions where p50/p99 at ~5% resolution beat storing
+    every sample (the monitor's latency views use it).
+    """
+
+    min_val: float = 1.0
+    base: float = 1.05
+    n_bins: int = 512
+    counts: List[int] = field(default_factory=list)
+    total: int = 0
+
+    def __post_init__(self):
+        if not self.counts:
+            self.counts = [0] * self.n_bins
+        self._log_base = math.log(self.base)
+
+    def update(self, x: float) -> None:
+        if x < self.min_val:
+            k = 0
+        else:
+            k = min(int(math.log(x / self.min_val) / self._log_base),
+                    self.n_bins - 1)
+        self.counts[k] += 1
+        self.total += 1
+
+    def percentile(self, p: float) -> float:
+        """Upper edge of the bin holding the p-th percentile (p in [0,100])."""
+        if self.total == 0:
+            return 0.0
+        target = max(1, math.ceil(self.total * p / 100.0))
+        acc = 0
+        for k, c in enumerate(self.counts):
+            acc += c
+            if acc >= target:
+                return self.min_val * (self.base ** (k + 1))
+        return self.min_val * (self.base ** self.n_bins)
+
+
+def median(xs) -> float:
+    """Exact median of a finite sample (fd_stat robust-center analog)."""
+    s = sorted(xs)
+    if not s:
+        raise ValueError("empty")
+    n = len(s)
+    mid = n // 2
+    return float(s[mid]) if n % 2 else (s[mid - 1] + s[mid]) / 2.0

@@ -1,0 +1,140 @@
+"""tpool — low-overhead fork-join thread pool.
+
+Role parity with the reference's util/tpool (fd_tpool.h:806-840:
+exec_all_{rrobin,block,batch,taskq} dispatch over core-pinned worker
+tiles, spin synchronization). Host-side analog: persistent worker
+threads with a per-worker mailbox; the fork-join barrier is an event per
+round, not per task.
+
+Where the GIL caveat matters: pure-Python task bodies serialize; the
+pool still wins for the workloads this framework dispatches — ctypes
+calls (native drain, rings), numpy slicing, device dispatch — which all
+release the GIL. The DEVICE-side fork-join equivalent is shard_map over
+the mesh (parallel/mesh.py); this pool is the host-side half, mirroring
+the reference's split between tpool (cores) and tiles (processes).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Sequence
+
+
+class TPoolError(RuntimeError):
+    pass
+
+
+class TPool:
+    """Persistent fork-join pool. Worker 0 is the caller's thread
+    (fd_tpool semantics: the dispatching tile participates)."""
+
+    def __init__(self, n_workers: int):
+        if n_workers < 1:
+            raise ValueError("n_workers >= 1")
+        self.n_workers = n_workers
+        self._tasks: List[Optional[tuple]] = [None] * n_workers
+        self._go = [threading.Event() for _ in range(n_workers)]
+        self._done = [threading.Event() for _ in range(n_workers)]
+        self._errors: List[Optional[BaseException]] = [None] * n_workers
+        self._halt = False
+        self._threads = [
+            threading.Thread(target=self._worker, args=(i,), daemon=True)
+            for i in range(1, n_workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _worker(self, idx: int) -> None:
+        while True:
+            self._go[idx].wait()
+            self._go[idx].clear()
+            if self._halt:
+                return
+            fn, args = self._tasks[idx]
+            try:
+                fn(*args)
+            except BaseException as e:  # propagate at the join
+                self._errors[idx] = e
+            self._done[idx].set()
+
+    def _fork_join(self, jobs: Sequence[Optional[tuple]]) -> None:
+        """jobs[i] = (fn, args) for worker i (None = idle this round)."""
+        self._errors = [None] * self.n_workers  # no stale carry-over
+        active = []
+        for i in range(1, self.n_workers):
+            if i < len(jobs) and jobs[i] is not None:
+                self._tasks[i] = jobs[i]
+                self._done[i].clear()
+                self._go[i].set()
+                active.append(i)
+        if jobs and jobs[0] is not None:
+            fn, args = jobs[0]
+            try:
+                fn(*args)  # worker 0 = caller
+            except BaseException as e:
+                # Must NOT escape before the barrier: a still-running
+                # worker completing into the next round's cleared event
+                # would silently drop that round's work.
+                self._errors[0] = e
+        for i in active:
+            self._done[i].wait()
+        errs = [e for e in self._errors if e is not None]
+        if errs:
+            raise TPoolError("worker raised") from errs[0]
+
+    # -- dispatch families (fd_tpool_exec_all_* analogs) -----------------
+
+    def exec_all_rrobin(self, fn: Callable, items: Sequence) -> None:
+        """fn(worker_idx, item) — item i handled by worker i % n."""
+        def run(w):
+            for i in range(w, len(items), self.n_workers):
+                fn(w, items[i])
+
+        self._fork_join([(run, (w,)) for w in range(self.n_workers)])
+
+    def exec_all_block(self, fn: Callable, n: int) -> None:
+        """fn(worker_idx, lo, hi) over a contiguous partition of [0, n)."""
+        per = -(-n // self.n_workers)
+        jobs: List[Optional[tuple]] = []
+        for w in range(self.n_workers):
+            lo, hi = min(w * per, n), min((w + 1) * per, n)
+            jobs.append((fn, (w, lo, hi)) if lo < hi else None)
+        self._fork_join(jobs)
+
+    def exec_all_batch(self, fn: Callable, batches: Sequence) -> None:
+        """fn(worker_idx, batch) — batch w to worker w (len <= n_workers)."""
+        if len(batches) > self.n_workers:
+            raise ValueError("more batches than workers")
+        self._fork_join([
+            (fn, (w, batches[w])) if w < len(batches) else None
+            for w in range(self.n_workers)
+        ])
+
+    def exec_all_taskq(self, fn: Callable, items: Sequence) -> None:
+        """fn(worker_idx, item) — dynamic work stealing off one queue
+        (fd_tpool taskq: best for irregular task costs)."""
+        it = iter(range(len(items)))
+        lock = threading.Lock()
+
+        def run(w):
+            while True:
+                with lock:
+                    i = next(it, None)
+                if i is None:
+                    return
+                fn(w, items[i])
+
+        self._fork_join([(run, (w,)) for w in range(self.n_workers)])
+
+    def close(self) -> None:
+        self._halt = True
+        for i in range(1, self.n_workers):
+            self._go[i].set()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def __enter__(self) -> "TPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

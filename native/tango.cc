@@ -139,6 +139,31 @@ uint64_t fd_wksp_query(wksp_join* j, const char* name, uint64_t* sz_out) {
 
 void* fd_wksp_laddr(wksp_join* j, uint64_t off) { return (char*)j->base + off; }
 
+// Admin introspection (fd_wksp_ctl analog): iterate the alloc table.
+uint32_t fd_wksp_alloc_cnt(wksp_join* j) {
+  return ((wksp_hdr*)j->base)->alloc_cnt.load(std::memory_order_acquire);
+}
+
+// Fills name (>= WKSP_NAME_MAX bytes), off, sz for alloc idx; returns 0
+// ok / -1 out of range.
+int fd_wksp_stat(wksp_join* j, uint32_t idx, char* name_out,
+                 uint64_t* off_out, uint64_t* sz_out) {
+  auto* h = (wksp_hdr*)j->base;
+  if (idx >= h->alloc_cnt.load(std::memory_order_acquire)) return -1;
+  std::memcpy(name_out, h->allocs[idx].name, WKSP_NAME_MAX);
+  *off_out = h->allocs[idx].off;
+  *sz_out = h->allocs[idx].sz;
+  return 0;
+}
+
+// Usage summary: {total_sz, used, alloc_cnt}.
+void fd_wksp_usage(wksp_join* j, uint64_t* out3) {
+  auto* h = (wksp_hdr*)j->base;
+  out3[0] = h->total_sz;
+  out3[1] = h->used.load(std::memory_order_relaxed);
+  out3[2] = h->alloc_cnt.load(std::memory_order_acquire);
+}
+
 // ---------------------------------------------------------------- frag meta
 
 // 32-byte metadata record. seq is the synchronization word.

@@ -1,0 +1,95 @@
+"""tpool fork-join tests (reference test_tpool.c shapes: every dispatch
+family, partition correctness, caller participation, error propagation)."""
+
+import threading
+import time
+
+import pytest
+
+from firedancer_tpu.utils.tpool import TPool, TPoolError
+
+
+def test_rrobin_covers_all_items():
+    with TPool(4) as tp:
+        seen = [[] for _ in range(4)]
+        tp.exec_all_rrobin(lambda w, item: seen[w].append(item), list(range(23)))
+        got = sorted(x for s in seen for x in s)
+        assert got == list(range(23))
+        # round-robin assignment: worker w got items w, w+4, ...
+        assert seen[1] == list(range(1, 23, 4))
+
+
+def test_block_partitions():
+    with TPool(3) as tp:
+        out = []
+        lock = threading.Lock()
+
+        def fn(w, lo, hi):
+            with lock:
+                out.append((w, lo, hi))
+
+        tp.exec_all_block(fn, 10)
+        spans = sorted(out, key=lambda t: t[1])
+        assert spans[0][1] == 0 and spans[-1][2] == 10
+        for (a, b) in zip(spans, spans[1:]):
+            assert a[2] == b[1]  # contiguous, non-overlapping
+
+
+def test_caller_participates():
+    with TPool(2) as tp:
+        tids = set()
+        lock = threading.Lock()
+
+        def fn(w, lo, hi):
+            with lock:
+                tids.add(threading.get_ident())
+
+        tp.exec_all_block(fn, 2)
+        assert threading.get_ident() in tids  # worker 0 = caller thread
+        assert len(tids) == 2
+
+
+def test_taskq_dynamic_balance():
+    with TPool(4) as tp:
+        done = []
+        lock = threading.Lock()
+
+        def fn(w, item):
+            if item == 0:
+                time.sleep(0.05)  # one slow task must not serialize the rest
+            with lock:
+                done.append(item)
+
+        t0 = time.monotonic()
+        tp.exec_all_taskq(fn, list(range(40)))
+        assert sorted(done) == list(range(40))
+        assert time.monotonic() - t0 < 0.5
+
+
+def test_error_propagates():
+    with TPool(3) as tp:
+        def fn(w, item):
+            if item == 5:
+                raise ValueError("boom")
+
+        with pytest.raises(TPoolError):
+            tp.exec_all_rrobin(fn, list(range(9)))
+        # pool still usable after a failed round
+        ok = []
+        tp.exec_all_rrobin(lambda w, i: ok.append(i), [1, 2, 3])
+        assert sorted(ok) == [1, 2, 3]
+
+
+def test_batch_dispatch():
+    with TPool(3) as tp:
+        got = {}
+        lock = threading.Lock()
+
+        def fn(w, batch):
+            with lock:
+                got[w] = batch
+
+        tp.exec_all_batch(fn, [[1], [2, 3]])
+        assert got == {0: [1], 1: [2, 3]}
+        with pytest.raises(ValueError):
+            tp.exec_all_batch(fn, [[]] * 4)

@@ -1,0 +1,119 @@
+"""util bits/sat/uwide + fxp + stat tests (reference test_bits.c /
+test_sat.c / test_uwide.c / test_fxp.c / test_stat.c shapes: exact edge
+cases + randomized property sweeps against big-int ground truth)."""
+
+import math
+import random
+
+import pytest
+
+from firedancer_tpu.utils import bits, fxp, stat
+
+
+def test_pow2_align():
+    assert bits.is_pow2(1) and bits.is_pow2(4096)
+    assert not bits.is_pow2(0) and not bits.is_pow2(12)
+    assert bits.pow2_up(1) == 1 and bits.pow2_up(3) == 4
+    assert bits.pow2_dn(5) == 4 and bits.pow2_dn(8) == 8
+    assert bits.align_up(13, 8) == 16 and bits.align_dn(13, 8) == 8
+    assert bits.is_aligned(64, 64) and not bits.is_aligned(65, 64)
+    with pytest.raises(ValueError):
+        bits.align_up(1, 3)
+
+
+def test_bit_scan_and_fields():
+    assert bits.find_lsb(0b1010_0000) == 5
+    assert bits.find_msb(0b1010_0000) == 7
+    assert bits.popcnt(0xFF00FF) == 16
+    x = 0xDEADBEEF
+    assert bits.extract(x, 8, 15) == 0xBE
+    assert bits.insert(x, 8, 15, 0x12) == 0xDEAD12EF
+    assert bits.rotate_left(1, 63) == 1 << 63
+    assert bits.rotate_right(1, 1) == 1 << 63
+    assert bits.bswap(0x0102030405060708) == 0x0807060504030201
+    assert bits.bswap(0x0102, 16) == 0x0201
+
+
+def test_seq_arithmetic_wraps():
+    near_max = bits.U64_MAX
+    assert bits.seq_diff(0, near_max) == 1          # wrapped forward
+    assert bits.seq_lt(near_max, 0)
+    assert bits.seq_le(5, 5)
+    assert bits.seq_diff(near_max, 0) == -1
+
+
+def test_saturating():
+    assert bits.sat_add_u64(bits.U64_MAX, 5) == bits.U64_MAX
+    assert bits.sat_sub_u64(3, 10) == 0
+    assert bits.sat_mul_u64(1 << 40, 1 << 40) == bits.U64_MAX
+    assert bits.sat_add_i64((1 << 63) - 1, 10) == (1 << 63) - 1
+    assert bits.sat_sub_i64(-(1 << 63), 10) == -(1 << 63)
+
+
+def test_uwide_matches_bigint():
+    rng = random.Random(0)
+    for _ in range(500):
+        ah, al, bh, bl = (rng.getrandbits(64) for _ in range(4))
+        hi, lo, c = bits.uwide_add(ah, al, bh, bl)
+        assert ((c << 128) | (hi << 64) | lo) == ((ah << 64) | al) + ((bh << 64) | bl)
+        hi, lo, bo = bits.uwide_sub(ah, al, bh, bl)
+        want = ((ah << 64) | al) - ((bh << 64) | bl)
+        got = (hi << 64) | lo
+        assert got == want % (1 << 128) and bo == (1 if want < 0 else 0)
+        a, b = rng.getrandbits(64), rng.getrandbits(64)
+        hi, lo = bits.uwide_mul(a, b)
+        assert (hi << 64) | lo == a * b
+        d = rng.getrandbits(63) + 1
+        qh, ql, r = bits.uwide_div(ah, al, d)
+        n = (ah << 64) | al
+        assert ((qh << 64) | ql) == n // d and r == n % d
+
+
+def test_fxp_rounding_families():
+    one = fxp.ONE
+    assert fxp.from_int(3) == 3 * one
+    assert fxp.to_int_rtz(fxp.from_float(2.75)) == 2
+    assert fxp.to_int_rnz(fxp.from_float(2.5)) == 3
+    # mul: 1.5 * 2.5 = 3.75
+    a, b = fxp.from_float(1.5), fxp.from_float(2.5)
+    assert fxp.to_float(fxp.mul_rtz(a, b)) == pytest.approx(3.75)
+    # div round-nearest vs truncate differ on 1/3
+    third = fxp.div_rtz(fxp.from_int(1), fxp.from_int(3))
+    assert fxp.to_float(third) == pytest.approx(1 / 3, abs=1e-8)
+    assert fxp.div_rnz(fxp.from_int(1), fxp.from_int(3)) >= third
+    # saturation
+    assert fxp.mul_rtz(fxp.from_int(1 << 40), fxp.from_int(1 << 40)) == bits.U64_MAX
+    assert fxp.isqrt(10**18) == 10**9
+    assert fxp.to_float(fxp.sqrt_rtz(fxp.from_int(4))) == pytest.approx(2.0)
+
+
+def test_welford_and_median():
+    rng = random.Random(1)
+    xs = [rng.gauss(10.0, 2.0) for _ in range(5000)]
+    w = stat.Welford()
+    for x in xs:
+        w.update(x)
+    assert w.n == 5000
+    assert w.mean == pytest.approx(sum(xs) / len(xs))
+    mean = sum(xs) / len(xs)
+    var = sum((x - mean) ** 2 for x in xs) / len(xs)
+    assert w.variance == pytest.approx(var, rel=1e-6)
+    assert w.min == min(xs) and w.max == max(xs)
+    assert stat.median([3, 1, 2]) == 2
+    assert stat.median([4, 1, 3, 2]) == 2.5
+
+
+def test_ema_and_histogram():
+    e = stat.Ema(alpha=0.5)
+    assert e.update(10) == 10        # primes to first sample
+    assert e.update(20) == 15
+    h = stat.Histogram(min_val=1.0, base=1.1, n_bins=256)
+    rng = random.Random(2)
+    xs = [rng.uniform(1, 1000) for _ in range(20000)]
+    for x in xs:
+        h.update(x)
+    xs.sort()
+    for p in (50, 90, 99):
+        exact = xs[int(len(xs) * p / 100) - 1]
+        est = h.percentile(p)
+        assert est == pytest.approx(exact, rel=0.15)

@@ -89,3 +89,49 @@ def test_sandbox_in_subprocess():
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert out["env_gone"] and out["fd_closed"]
     assert out["env_removed"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# ctl CLIs (fd_wksp_ctl / fd_pod_ctl / fd_tango_ctl analogs)
+
+
+def test_ctl_cli_roundtrip(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    from firedancer_tpu.disco.pipeline import build_topology
+
+    wpath = str(tmp_path / "ctl.wksp")
+    topo = build_topology(wpath, depth=64)
+    pod_path = str(tmp_path / "pod.bin")
+    with open(pod_path, "wb") as f:
+        f.write(topo.pod.serialize())
+
+    def run(*a):
+        r = subprocess.run(
+            [sys.executable, "-m", "firedancer_tpu.app.ctl", *a],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        return json.loads(r.stdout)
+
+    usage = run("wksp", "usage", wpath)
+    assert usage["alloc_cnt"] > 10 and usage["used"] < usage["total_sz"]
+    allocs = run("wksp", "list", wpath)
+    names = {a["name"] for a in allocs}
+    assert "replay_verify.mcache" in names
+    q = run("wksp", "query", wpath, "replay_verify.dcache")
+    assert q["sz"] > 0
+    pod = run("pod", "query", pod_path, "firedancer.mtu")
+    assert pod["firedancer.mtu"] == 1232
+    mc = run("tango", "mcache", wpath, "replay_verify.mcache")
+    assert mc["depth"] == 64
+    fs = run("tango", "fseq", wpath, "replay_verify.fseq")
+    assert fs["diag"]["pub_cnt"] == 0
+    cnc = run("tango", "cnc", wpath, "verify.cnc")
+    assert cnc["signal"] == "boot"
+    # unknown name -> error record, nonzero exit
+    r = subprocess.run(
+        [sys.executable, "-m", "firedancer_tpu.app.ctl", "wksp", "query",
+         wpath, "nope"], capture_output=True, text=True)
+    assert r.returncode == 1 and "error" in r.stdout
