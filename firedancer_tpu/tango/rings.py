@@ -114,6 +114,8 @@ def load_lib() -> ctypes.CDLL:
     lib.fd_dcache_next_chunk.restype = ctypes.c_uint32
     lib.fd_dcache_next_chunk.argtypes = [ctypes.c_uint32, ctypes.c_uint32,
                                          ctypes.c_uint32, ctypes.c_uint32]
+    lib.fd_wksp_free.restype = ctypes.c_int
+    lib.fd_wksp_free.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.fd_wksp_alloc_cnt.restype = ctypes.c_uint32
     lib.fd_wksp_alloc_cnt.argtypes = [ctypes.c_void_p]
     lib.fd_wksp_stat.restype = ctypes.c_int
@@ -191,6 +193,14 @@ class Workspace:
         if not off:
             raise MemoryError(f"wksp alloc failed: {name}")
         return off
+
+    def free(self, name: str) -> None:
+        """Release a named allocation for first-fit reuse (fd_wksp_free).
+
+        Caller discipline: nothing may still hold a pointer/view into
+        the region (same contract as the reference)."""
+        if lib().fd_wksp_free(self._h, name.encode()) != 0:
+            raise KeyError(name)
 
     def query(self, name: str) -> tuple[int, int]:
         sz = ctypes.c_uint64()

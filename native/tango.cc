@@ -27,6 +27,8 @@
 //
 // Exposed as a C ABI for ctypes; native tiles link it directly.
 
+#include "tango_abi.h"
+
 #include <atomic>
 #include <cstdint>
 #include <cstring>
@@ -37,29 +39,63 @@
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
+#include <signal.h>
+#include <cerrno>
 
 extern "C" {
 
 // ---------------------------------------------------------------- workspace
 
-static constexpr uint64_t WKSP_MAGIC = 0xFD7A9005EC7A11ULL;
-static constexpr uint32_t WKSP_MAX_ALLOCS = 256;
+// v2: alloc_ent grew a state word, table 1024 slots, hdr lock
+static constexpr uint64_t WKSP_MAGIC = 0xFD7A9005EC7A12ULL;
+static constexpr uint32_t WKSP_MAX_ALLOCS = 1024;   // FD_TILE_MAX-scale topologies
 static constexpr uint32_t WKSP_NAME_MAX = 40;
 
+// state: 0 = slot empty, 1 = live allocation, 2 = freed region
+// available for first-fit reuse (fd_wksp's treap allocator reduced to a
+// table walk — fine at WKSP_MAX_ALLOCS scale, O(n) alloc/free).
 struct wksp_alloc_ent {
   char name[WKSP_NAME_MAX];
   uint64_t off;
   uint64_t sz;
+  uint64_t state;
 };
 
 struct wksp_hdr {
   uint64_t magic;
   uint64_t total_sz;
   std::atomic<uint64_t> used;      // bump allocator high-water mark
-  std::atomic<uint32_t> alloc_cnt;
-  uint32_t pad;
+  std::atomic<uint32_t> alloc_cnt; // slots in use (incl. freed regions)
+  std::atomic<uint32_t> lock;      // alloc/free spinlock (concurrent joins)
   wksp_alloc_ent allocs[WKSP_MAX_ALLOCS];
 };
+
+namespace {
+// Robust cross-process spinlock: the lock word holds the owner PID so a
+// crashed holder (SIGKILL mid-alloc) can be detected via kill(pid, 0)
+// and the lock stolen instead of deadlocking every joined process.
+struct wksp_lock_guard {
+  std::atomic<uint32_t>& l;
+  explicit wksp_lock_guard(std::atomic<uint32_t>& lk) : l(lk) {
+    uint32_t me = (uint32_t)::getpid();
+    for (;;) {
+      uint32_t expect = 0;
+      if (l.compare_exchange_weak(expect, me, std::memory_order_acquire))
+        return;
+      if (expect != 0 && expect != me
+          && ::kill((pid_t)expect, 0) != 0 && errno == ESRCH) {
+        // Owner is dead: steal. The table may be mid-mutation; all
+        // mutations are idempotent-safe for readers (entries flip state
+        // last), matching the reference's crash-only recovery posture.
+        if (l.compare_exchange_strong(expect, me,
+                                      std::memory_order_acquire))
+          return;
+      }
+    }
+  }
+  ~wksp_lock_guard() { l.store(0, std::memory_order_release); }
+};
+}  // namespace
 
 struct wksp_join {
   void* base;
@@ -106,30 +142,113 @@ void fd_wksp_leave(wksp_join* j) {
 }
 
 // Allocate `sz` bytes under `name`; returns offset or 0 on failure.
-// Single-threaded setup-phase API (topology build), like configure/frank.c.
+// First-fit reuse of freed regions, bump allocation otherwise; spinlock
+// serializes concurrent allocators (the reference wksp is fully
+// concurrent via a treap + partition locks; table-walk + one lock is
+// the right size for <=1024 named objects).
 uint64_t fd_wksp_alloc(wksp_join* j, const char* name, uint64_t sz, uint64_t align) {
   auto* h = (wksp_hdr*)j->base;
   if (align < 64) align = 64;
+  wksp_lock_guard g(h->lock);
   uint32_t n = h->alloc_cnt.load(std::memory_order_acquire);
-  if (n >= WKSP_MAX_ALLOCS) return 0;
+  // First fit over freed regions (offset must already satisfy align:
+  // all regions start 64-aligned and align>=64 pow2 regions split fine).
+  uint64_t need = align_up(sz, 64);  // regions live in 64 B granules
+  for (uint32_t i = 0; i < n; i++) {
+    wksp_alloc_ent* e = &h->allocs[i];
+    if (e->state != 2 || e->sz < need) continue;
+    if (align_up(e->off, align) != e->off) continue;
+    std::strncpy(e->name, name, WKSP_NAME_MAX - 1);
+    e->name[WKSP_NAME_MAX - 1] = 0;
+    e->state = 1;
+    // Split a much-larger region so big holes keep serving small allocs
+    // (fit check above is in aligned units, so rem cannot underflow).
+    uint64_t rem = e->sz - need;
+    if (rem >= 4096) {
+      wksp_alloc_ent* f = nullptr;
+      for (uint32_t k = 0; k < n; k++)        // reuse a merged-out slot
+        if (h->allocs[k].state == 0) { f = &h->allocs[k]; break; }
+      if (!f && n < WKSP_MAX_ALLOCS) {
+        f = &h->allocs[n];
+        h->alloc_cnt.store(n + 1, std::memory_order_release);
+      }
+      if (f) {
+        f->name[0] = 0;
+        f->off = e->off + need;
+        f->sz = rem;
+        f->state = 2;
+        e->sz = need;
+      }
+    }
+    std::memset((char*)j->base + e->off, 0, sz);
+    return e->off;
+  }
+  wksp_alloc_ent* e = nullptr;
+  for (uint32_t k = 0; k < n; k++)            // reuse a merged-out slot
+    if (h->allocs[k].state == 0) { e = &h->allocs[k]; break; }
+  if (!e) {
+    if (n >= WKSP_MAX_ALLOCS) return 0;
+    e = &h->allocs[n];
+  }
   uint64_t off = align_up(h->used.load(std::memory_order_relaxed), align);
-  if (off + sz > h->total_sz) return 0;
-  h->used.store(off + sz, std::memory_order_relaxed);
-  wksp_alloc_ent* e = &h->allocs[n];
+  if (off + need > h->total_sz) return 0;
+  h->used.store(off + need, std::memory_order_relaxed);
   std::strncpy(e->name, name, WKSP_NAME_MAX - 1);
   e->name[WKSP_NAME_MAX - 1] = 0;
   e->off = off;
-  e->sz = sz;
+  e->sz = need;                               // aligned-granule sizes
+  e->state = 1;
   std::memset((char*)j->base + off, 0, sz);
-  h->alloc_cnt.store(n + 1, std::memory_order_release);
+  if (e == &h->allocs[n])
+    h->alloc_cnt.store(n + 1, std::memory_order_release);
   return off;
+}
+
+// Free a named allocation: the region becomes first-fit reusable.
+// Returns 0 ok / -1 unknown name. The caller owns lifetime discipline
+// (nothing may hold a laddr into the region, same as fd_wksp_free).
+int fd_wksp_free(wksp_join* j, const char* name) {
+  auto* h = (wksp_hdr*)j->base;
+  wksp_lock_guard g(h->lock);
+  uint32_t n = h->alloc_cnt.load(std::memory_order_acquire);
+  for (uint32_t i = 0; i < n; i++) {
+    wksp_alloc_ent* e = &h->allocs[i];
+    if (e->state == 1 && !std::strncmp(e->name, name, WKSP_NAME_MAX)) {
+      e->state = 2;
+      e->name[0] = 0;
+      // Coalesce with adjacent freed regions in BOTH directions (keeps
+      // long-running alloc/free cycles from fragmenting); merged-out
+      // slots become state 0 and are reused by fd_wksp_alloc.
+      for (uint32_t k = 0; k < n; k++) {
+        wksp_alloc_ent* f = &h->allocs[k];
+        if (k == i || f->state != 2) continue;
+        if (f->off + f->sz == e->off) {         // f | e -> f
+          f->sz += e->sz;
+          e->state = 0;
+          e->sz = 0;
+          e = f;
+          i = k;
+          k = (uint32_t)-1;                     // rescan for more merges
+        } else if (e->off + e->sz == f->off) {  // e | f -> e
+          e->sz += f->sz;
+          f->state = 0;
+          f->sz = 0;
+          k = (uint32_t)-1;
+        }
+      }
+      return 0;
+    }
+  }
+  return -1;
 }
 
 uint64_t fd_wksp_query(wksp_join* j, const char* name, uint64_t* sz_out) {
   auto* h = (wksp_hdr*)j->base;
+  wksp_lock_guard g(h->lock);  // vs concurrent alloc/free mutations
   uint32_t n = h->alloc_cnt.load(std::memory_order_acquire);
   for (uint32_t i = 0; i < n; i++) {
-    if (!std::strncmp(h->allocs[i].name, name, WKSP_NAME_MAX)) {
+    if (h->allocs[i].state == 1
+        && !std::strncmp(h->allocs[i].name, name, WKSP_NAME_MAX)) {
       if (sz_out) *sz_out = h->allocs[i].sz;
       return h->allocs[i].off;
     }
@@ -149,7 +268,9 @@ uint32_t fd_wksp_alloc_cnt(wksp_join* j) {
 int fd_wksp_stat(wksp_join* j, uint32_t idx, char* name_out,
                  uint64_t* off_out, uint64_t* sz_out) {
   auto* h = (wksp_hdr*)j->base;
+  wksp_lock_guard g(h->lock);
   if (idx >= h->alloc_cnt.load(std::memory_order_acquire)) return -1;
+  if (h->allocs[idx].state != 1) return 1;  // skip: freed/empty slot
   std::memcpy(name_out, h->allocs[idx].name, WKSP_NAME_MAX);
   *off_out = h->allocs[idx].off;
   *sz_out = h->allocs[idx].sz;
@@ -167,28 +288,15 @@ void fd_wksp_usage(wksp_join* j, uint64_t* out3) {
 // ---------------------------------------------------------------- frag meta
 
 // 32-byte metadata record. seq is the synchronization word.
-struct frag_meta {
-  std::atomic<uint64_t> seq;
-  uint64_t sig;
-  uint32_t chunk;
-  uint16_t sz;
-  uint16_t ctl;
-  uint32_t tsorig;
-  uint32_t tspub;
-};
-static_assert(sizeof(frag_meta) == 32, "frag_meta must be 32 bytes");
+using fd_tango_abi::frag_meta;  // shared layout: native/tango_abi.h
 
 // ctl bits (fd_tango_base.h SOM/EOM/ERR analog)
 static constexpr uint16_t CTL_SOM = 1u << 0;
 static constexpr uint16_t CTL_EOM = 1u << 1;
 static constexpr uint16_t CTL_ERR = 1u << 2;
 
-// mcache = header {depth, seq0, pad} + frag_meta[depth]
-struct mcache_hdr {
-  uint64_t depth;                       // power of 2
-  std::atomic<uint64_t> seq_next;       // producer's next seq (monotonic)
-  char pad[48];
-};
+// mcache = header {depth, seq_next, pad} + frag_meta[depth]
+using fd_tango_abi::mcache_hdr;
 
 uint64_t fd_mcache_footprint(uint64_t depth) {
   return sizeof(mcache_hdr) + depth * sizeof(frag_meta);
@@ -222,12 +330,12 @@ void fd_mcache_publish(void* mem, uint64_t seq, uint64_t sig, uint32_t chunk,
   // new seq with release (ordering the body before it).
   m->seq.store(~0ULL, std::memory_order_release);
   std::atomic_thread_fence(std::memory_order_seq_cst);
-  m->sig = sig;
-  m->chunk = chunk;
-  m->sz = sz;
-  m->ctl = ctl;
-  m->tsorig = tsorig;
-  m->tspub = tspub;
+  m->sig.store(sig, std::memory_order_relaxed);
+  m->chunk.store(chunk, std::memory_order_relaxed);
+  m->sz.store(sz, std::memory_order_relaxed);
+  m->ctl.store(ctl, std::memory_order_relaxed);
+  m->tsorig.store(tsorig, std::memory_order_relaxed);
+  m->tspub.store(tspub, std::memory_order_relaxed);
   m->seq.store(seq, std::memory_order_release);
   h->seq_next.store(seq + 1, std::memory_order_release);
 }
@@ -243,9 +351,12 @@ int fd_mcache_poll(void* mem, uint64_t seq, uint64_t* out /*4 u64: sig,chunk|sz|
   frag_meta* m = &line[seq & (h->depth - 1)];
   uint64_t s0 = m->seq.load(std::memory_order_acquire);
   if (s0 == seq) {
-    uint64_t sig = m->sig;
-    uint64_t b = ((uint64_t)m->chunk << 32) | ((uint64_t)m->sz << 16) | m->ctl;
-    uint64_t ts = ((uint64_t)m->tsorig << 32) | m->tspub;
+    uint64_t sig = m->sig.load(std::memory_order_relaxed);
+    uint64_t b = ((uint64_t)m->chunk.load(std::memory_order_relaxed) << 32)
+               | ((uint64_t)m->sz.load(std::memory_order_relaxed) << 16)
+               | m->ctl.load(std::memory_order_relaxed);
+    uint64_t ts = ((uint64_t)m->tsorig.load(std::memory_order_relaxed) << 32)
+               | m->tspub.load(std::memory_order_relaxed);
     std::atomic_thread_fence(std::memory_order_seq_cst);
     uint64_t s1 = m->seq.load(std::memory_order_acquire);
     if (s1 == seq) {

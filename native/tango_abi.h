@@ -1,0 +1,38 @@
+// tango_abi.h — shared wire-layout definitions for the native tango TUs.
+//
+// The 32-byte frag_meta layout is the IPC contract between the producer
+// (tango.cc fd_mcache_publish), the generic consumer (tango.cc
+// fd_mcache_poll), and the bulk drain (verify_drain.cc) — one definition
+// so a field or ordering change cannot drift between them.
+#pragma once
+#include <atomic>
+#include <cstdint>
+
+namespace fd_tango_abi {
+
+struct frag_meta {
+  std::atomic<uint64_t> seq;
+  // Body words are relaxed atomics: the seqlock (seq sentinel + fences)
+  // provides the ordering, but plain stores racing a reader's plain
+  // loads are formally UB under the C++ memory model even when the
+  // seqlock retry discards the torn copy — the reference sidesteps this
+  // with atomic 16-byte SSE publishes (fd_tango_base.h:149-203); here
+  // relaxed word atomics give the same TSan-clean guarantee. Layouts
+  // are unchanged (atomics of scalar width are lock-free on x86/arm64).
+  std::atomic<uint64_t> sig;
+  std::atomic<uint32_t> chunk;
+  std::atomic<uint16_t> sz;
+  std::atomic<uint16_t> ctl;
+  std::atomic<uint32_t> tsorig;
+  std::atomic<uint32_t> tspub;
+};
+static_assert(sizeof(frag_meta) == 32, "frag_meta must be 32 bytes");
+
+struct mcache_hdr {
+  uint64_t depth;                       // power of 2
+  std::atomic<uint64_t> seq_next;       // producer's next seq (monotonic)
+  char pad[48];
+};
+static_assert(sizeof(mcache_hdr) == 64, "mcache_hdr must be 64 bytes");
+
+}  // namespace fd_tango_abi

@@ -13,32 +13,16 @@
 // The Python tile keeps: HA dedup, batch dispatch, completion publish —
 // per-batch costs, not per-frag.
 
+#include "tango_abi.h"
+
 #include <atomic>
 #include <cstdint>
 #include <cstring>
 
 namespace {
 
-constexpr int POLL_EMPTY = 0;
-constexpr int POLL_FRAG = 1;
-constexpr int POLL_OVERRUN = 2;
-
-struct frag_meta {
-  std::atomic<uint64_t> seq;
-  uint64_t sig;
-  uint32_t chunk;
-  uint16_t sz;
-  uint16_t ctl;
-  uint32_t tsorig;
-  uint32_t tspub;
-};
-static_assert(sizeof(frag_meta) == 32, "frag_meta must be 32 bytes");
-
-struct mcache_hdr {
-  uint64_t depth;
-  std::atomic<uint64_t> seq0;
-  uint64_t pad[6];
-};
+using fd_tango_abi::frag_meta;
+using fd_tango_abi::mcache_hdr;
 
 // ---- txn parse (exact ballet/txn.py semantics) --------------------------
 
@@ -223,10 +207,10 @@ int fd_verify_drain(void *mcache, void *dcache_base, uint64_t *seq_io,
       seq = new_seq;
       continue;
     }
-    uint64_t sig = m->sig;
-    uint32_t chunk = m->chunk;
-    uint16_t sz = m->sz;
-    uint32_t tsorig = m->tsorig;
+    uint64_t sig = m->sig.load(std::memory_order_relaxed);
+    uint32_t chunk = m->chunk.load(std::memory_order_relaxed);
+    uint16_t sz = m->sz.load(std::memory_order_relaxed);
+    uint32_t tsorig = m->tsorig.load(std::memory_order_relaxed);
     // Copy the payload out BEFORE revalidating the seqlock.
     uint8_t tmp[MTU];
     uint32_t cp = sz <= MTU ? sz : MTU;

@@ -154,3 +154,99 @@ def test_native_stress_binary():
         )
     assert r.returncode == 0, r.stderr
     assert "PASS" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# wksp allocator: free + first-fit reuse (fd_wksp treap-allocator analog)
+
+
+def test_wksp_free_and_reuse(tmp_path):
+    from firedancer_tpu.tango.rings import Workspace
+
+    w = Workspace.create(str(tmp_path / "fr.wksp"), 1 << 20)
+    off_a = w.alloc("a", 8192)
+    w.alloc("b", 1024)
+    used0 = w.usage()["used"]
+    w.free("a")
+    with pytest.raises(KeyError):
+        w.query("a")
+    with pytest.raises(KeyError):
+        w.free("a")  # double free rejected
+    # Reuse: a smaller alloc lands inside the freed region, no new bump.
+    off_c = w.alloc("c", 4096)
+    assert off_c == off_a
+    assert w.usage()["used"] == used0
+    # The split remainder serves another alloc too.
+    off_d = w.alloc("d", 2048)
+    assert off_a < off_d < off_a + 8192
+    assert w.usage()["used"] == used0
+    # Freed-region zeroing: fresh allocs come back zeroed.
+    import ctypes
+
+    buf = (ctypes.c_char * 16).from_address(w.laddr(off_c))
+    assert bytes(buf) == bytes(16)
+    w.leave()
+
+
+def test_wksp_free_coalesce(tmp_path):
+    from firedancer_tpu.tango.rings import Workspace
+
+    w = Workspace.create(str(tmp_path / "co.wksp"), 1 << 20)
+    w.alloc("x", 4096)
+    w.alloc("y", 4096)
+    w.alloc("z", 64)
+    off_x, _ = w.query("x")
+    w.free("x")
+    w.free("y")  # adjacent: coalesces into one 8192 region
+    off_big = w.alloc("big", 8000)
+    assert off_big == off_x
+    w.leave()
+
+
+def test_wksp_many_allocs(tmp_path):
+    from firedancer_tpu.tango.rings import Workspace
+
+    w = Workspace.create(str(tmp_path / "many.wksp"), 1 << 24)
+    # Reference-scale topology: hundreds of named objects + churn.
+    for i in range(500):
+        w.alloc(f"obj{i}", 512)
+    for i in range(0, 500, 2):
+        w.free(f"obj{i}")
+    for i in range(200):
+        w.alloc(f"new{i}", 256)
+    names = {n for n, _, _ in w.alloc_list()}
+    assert "obj1" in names and "new0" in names and "obj0" not in names
+    w.leave()
+
+
+def test_wksp_unaligned_size_split_safe(tmp_path):
+    """Regression: splitting a reused region whose size is not a 64-byte
+    multiple must not underflow into a bogus giant free region."""
+    from firedancer_tpu.tango.rings import Workspace
+
+    w = Workspace.create(str(tmp_path / "ua.wksp"), 1 << 20)
+    w.alloc("a", 100)
+    w.free("a")
+    off_b = w.alloc("b", 70)      # fits the freed region after alignment
+    off_c = w.alloc("c", 8192)    # must NOT overlap b
+    assert off_c >= off_b + 70 or off_c + 8192 <= off_b
+    # usage stays sane (no astronomical free region got created)
+    u = w.usage()
+    assert u["used"] <= u["total_sz"]
+    w.leave()
+
+
+def test_wksp_coalesce_reuses_slots(tmp_path):
+    """Merged-out table slots are recycled: alloc/free churn with
+    coalescing does not leak the 1024-entry table."""
+    from firedancer_tpu.tango.rings import Workspace
+
+    w = Workspace.create(str(tmp_path / "slots.wksp"), 1 << 22)
+    for round_ in range(300):   # >> slot budget if merges leaked slots
+        w.alloc("p", 4096)
+        w.alloc("q", 4096)
+        w.free("p")
+        w.free("q")             # coalesces with p's region
+    w.alloc("final", 8000)
+    assert w.usage()["alloc_cnt"] < 64
+    w.leave()
