@@ -147,6 +147,9 @@ def main(argv=None) -> int:
     pm.add_argument("--interval", type=float, default=1.0)
     pm.add_argument("--iters", type=int, default=None)
 
+    ps = sub.add_parser("security")
+    ps.add_argument("--json", action="store_true")
+
     pk = sub.add_parser("keygen")
     pk.add_argument("--out", default=None)
 
@@ -161,6 +164,11 @@ def main(argv=None) -> int:
         return cmd_run(cfg, args)
     if args.cmd == "monitor":
         return cmd_monitor(cfg, args)
+    if args.cmd == "security":
+        from firedancer_tpu.app.security import report
+
+        print(report(as_json=args.json))
+        return 0
     if args.cmd == "keygen":
         import os
 

@@ -1,0 +1,129 @@
+"""Security / capability reporting (fdctl security.c analog).
+
+The reference's `fdctl` checks, per configure stage, which privileges
+the current process holds vs needs (root or CAP_SYS_ADMIN for
+hugepages, CAP_NET_RAW for XDP, ...) and prints an actionable report
+(app/fdctl/security.c). The same shape here: each requirement knows how
+to probe itself and what would need it, so `fdctl security` (or a
+pre-run check) explains exactly what a non-root operator is missing —
+and what this environment makes N/A (no XDP path, no hugepage mounts).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import resource
+from dataclasses import dataclass
+from typing import Callable, List
+
+
+@dataclass
+class Requirement:
+    name: str
+    needed_for: str
+    ok: bool
+    detail: str
+
+
+def _cap_bits() -> int:
+    """Effective capability bits of this process (0 if unreadable)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("CapEff:"):
+                    return int(line.split()[1], 16)
+    except OSError:
+        pass
+    return 0
+
+
+CAP_NET_RAW = 13
+CAP_SYS_ADMIN = 21
+CAP_SYS_RESOURCE = 24
+CAP_IPC_LOCK = 14
+
+
+def _has_cap(bit: int) -> bool:
+    return os.geteuid() == 0 or bool(_cap_bits() & (1 << bit))
+
+
+def _can_unshare_user() -> bool:
+    """Probe user-namespace availability (sandbox unshare path)."""
+    try:
+        with open("/proc/sys/kernel/unprivileged_userns_clone") as f:
+            if f.read().strip() == "0" and os.geteuid() != 0:
+                return False
+    except OSError:
+        pass  # knob absent: most kernels allow unprivileged userns
+    return True
+
+
+def _memlock_ok() -> bool:
+    soft, _ = resource.getrlimit(resource.RLIMIT_MEMLOCK)
+    return soft == resource.RLIM_INFINITY or soft >= (1 << 26) or \
+        _has_cap(CAP_IPC_LOCK)
+
+
+def _no_new_privs_settable() -> bool:
+    PR_GET_NO_NEW_PRIVS = 39
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        return libc.prctl(PR_GET_NO_NEW_PRIVS, 0, 0, 0, 0) >= 0
+    except OSError:
+        return False
+
+
+def check() -> List[Requirement]:
+    """Probe every privilege the configure/run stages can use."""
+    reqs = [
+        Requirement(
+            "root-or-sys-admin",
+            "hugepage mounts + sysctl stages (N/A here: plain mmap wksp)",
+            _has_cap(CAP_SYS_ADMIN),
+            f"euid={os.geteuid()} capeff={_cap_bits():#x}",
+        ),
+        Requirement(
+            "net-raw",
+            "XDP/AF_XDP kernel bypass (N/A here: recvmmsg batch backend)",
+            _has_cap(CAP_NET_RAW),
+            "needed only for the reference's fd_xsk path",
+        ),
+        Requirement(
+            "memlock",
+            "pinning ring/staging memory (large RLIMIT_MEMLOCK or ipc_lock)",
+            _memlock_ok(),
+            f"rlimit_memlock={resource.getrlimit(resource.RLIMIT_MEMLOCK)}",
+        ),
+        Requirement(
+            "userns",
+            "sandbox namespace isolation (utils/sandbox.unshare_namespaces)",
+            _can_unshare_user(),
+            "unprivileged user namespaces",
+        ),
+        Requirement(
+            "no-new-privs",
+            "sandbox privilege lock (utils/sandbox.no_new_privs)",
+            _no_new_privs_settable(),
+            "prctl(PR_SET_NO_NEW_PRIVS)",
+        ),
+        Requirement(
+            "nofile",
+            "QUIC socket fan-out + workspace files",
+            resource.getrlimit(resource.RLIMIT_NOFILE)[0] >= 1024,
+            f"rlimit_nofile={resource.getrlimit(resource.RLIMIT_NOFILE)}",
+        ),
+    ]
+    return reqs
+
+
+def report(as_json: bool = False) -> str:
+    reqs = check()
+    if as_json:
+        return json.dumps([r.__dict__ for r in reqs])
+    lines = []
+    for r in reqs:
+        lines.append(f"[{'ok' if r.ok else '--'}] {r.name:18s} {r.needed_for}")
+        lines.append(f"     {r.detail}")
+    return "\n".join(lines)

@@ -164,3 +164,31 @@ def test_config_rejects_type_mismatch(tmp_path):
     # int -> float widening allowed
     toml.write_text("[development]\ntimeout_s = 5\n")
     assert cfgmod.load_config(str(toml))["development"]["timeout_s"] == 5.0
+
+
+def test_security_report():
+    """fdctl security (app/fdctl/security.c analog): every probe returns a
+    structured verdict; JSON mode parses; report text lists all reqs."""
+    import json
+
+    from firedancer_tpu.app.security import check, report
+
+    reqs = check()
+    names = {r.name for r in reqs}
+    assert {"root-or-sys-admin", "net-raw", "memlock", "userns",
+            "no-new-privs", "nofile"} <= names
+    for r in reqs:
+        assert isinstance(r.ok, bool) and r.needed_for and r.detail
+    parsed = json.loads(report(as_json=True))
+    assert len(parsed) == len(reqs)
+    txt = report()
+    assert "memlock" in txt and ("[ok]" in txt or "[--]" in txt)
+
+
+def test_fdctl_security_cmd(tmp_path, capsys):
+    from firedancer_tpu.app import fdctl
+
+    rc = fdctl.main(["security"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "userns" in out
