@@ -154,6 +154,15 @@ def main(argv=None) -> int:
     pk.add_argument("--out", default=None)
 
     args = p.parse_args(argv)
+
+    if args.cmd == "security":
+        # The environment-diagnosis command must not require a loadable
+        # config (a broken TOML is often WHY the operator is here).
+        from firedancer_tpu.app.security import report
+
+        print(report(as_json=args.json))
+        return 0
+
     cfg = cfgmod.load_config(args.config)
 
     if args.cmd == "configure":
@@ -164,11 +173,6 @@ def main(argv=None) -> int:
         return cmd_run(cfg, args)
     if args.cmd == "monitor":
         return cmd_monitor(cfg, args)
-    if args.cmd == "security":
-        from firedancer_tpu.app.security import report
-
-        print(report(as_json=args.json))
-        return 0
     if args.cmd == "keygen":
         import os
 

@@ -16,7 +16,7 @@ import json
 import os
 import resource
 from dataclasses import dataclass
-from typing import Callable, List
+from typing import List
 
 
 @dataclass
@@ -41,23 +41,32 @@ def _cap_bits() -> int:
 
 CAP_NET_RAW = 13
 CAP_SYS_ADMIN = 21
-CAP_SYS_RESOURCE = 24
 CAP_IPC_LOCK = 14
 
 
 def _has_cap(bit: int) -> bool:
-    return os.geteuid() == 0 or bool(_cap_bits() & (1 << bit))
+    # Trust CapEff, not euid: root in a capability-dropped container
+    # (default Docker) lacks e.g. CAP_SYS_ADMIN even with euid 0 —
+    # reporting by euid would be exactly the false positive a
+    # capability report exists to prevent. Real root has full CapEff.
+    return bool(_cap_bits() & (1 << bit))
 
 
 def _can_unshare_user() -> bool:
-    """Probe user-namespace availability (sandbox unshare path)."""
-    try:
-        with open("/proc/sys/kernel/unprivileged_userns_clone") as f:
-            if f.read().strip() == "0" and os.geteuid() != 0:
-                return False
-    except OSError:
-        pass  # knob absent: most kernels allow unprivileged userns
-    return True
+    """Probe user-namespace availability by ACTUALLY unsharing in a
+    forked child — distro knobs vary (Debian unprivileged_userns_clone,
+    Ubuntu apparmor_restrict_unprivileged_userns, user.max_user_namespaces)
+    and reading one of them misses the others."""
+    CLONE_NEWUSER = 0x10000000
+    pid = os.fork()
+    if pid == 0:  # child: report via exit status
+        try:
+            libc = ctypes.CDLL(None, use_errno=True)
+            os._exit(0 if libc.unshare(CLONE_NEWUSER) == 0 else 1)
+        except BaseException:
+            os._exit(1)
+    _, status = os.waitpid(pid, 0)
+    return os.waitstatus_to_exitcode(status) == 0
 
 
 def _memlock_ok() -> bool:
