@@ -255,8 +255,15 @@ def worker(cpu: bool) -> int:
 
     from firedancer_tpu.ops.verify import verify_batch
 
+    mode = os.environ.get("FD_BENCH_VERIFY", "direct")
+    if mode not in ("rlc", "direct"):
+        print(json.dumps({"metric": "ed25519_verify_throughput", "value": 0,
+                          "unit": "verifies/s", "vs_baseline": 0.0,
+                          "error": f"unknown FD_BENCH_VERIFY mode {mode!r}"}))
+        return 1
     dev = jax.devices()[0]
-    print(f"bench worker: device={dev} batch={batch} reps={reps}", file=sys.stderr)
+    print(f"bench worker: device={dev} batch={batch} reps={reps} mode={mode}",
+          file=sys.stderr)
     cache = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), f".bench_cache_{batch}_{msg_len}.npz"
     )
@@ -266,21 +273,49 @@ def worker(cpu: bool) -> int:
     )
 
     fn = jax.jit(verify_batch)
+    fell_back = False
+    if mode == "rlc":
+        # RLC batch verification (ops/verify_rlc.py): one MSM pass for a
+        # clean batch, per-lane fallback otherwise. The wrapper returns a
+        # lazy result object; np.asarray forces it.
+        from firedancer_tpu.ops.verify_rlc import make_async_verifier
+
+        direct = fn
+        rlc_fn = make_async_verifier(direct)
+
+        def fn(*a):  # noqa: F811 - intentional mode shadow
+            return rlc_fn(*a)
+
     t0 = time.perf_counter()
     out = fn(*args)
-    out.block_until_ready()
+    res0 = np.asarray(out)
     compile_s = time.perf_counter() - t0
-    if not bool((np.asarray(out) == 0).all()):
+    if mode == "rlc":
+        fell_back = bool(getattr(out, "used_fallback", False))
+    if not bool((res0 == 0).all()) or fell_back:
         print(json.dumps({"metric": "ed25519_verify_throughput", "value": 0,
                           "unit": "verifies/s", "vs_baseline": 0.0,
-                          "error": "correctness check failed"}))
+                          "error": "correctness check failed"
+                                   + (" (rlc fell back)" if fell_back else "")}))
         return 1
 
     t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    out.block_until_ready()
+    outs = [fn(*args) for _ in range(reps)]
+    finals = [np.asarray(o) for o in outs]
     dt = time.perf_counter() - t0
+    bad = any(not bool((f == 0).all()) for f in finals)
+    fell_back = mode == "rlc" and any(
+        getattr(o, "used_fallback", False) for o in outs
+    )
+    if bad or fell_back:
+        # Not an assert: a fallback-tainted timing must never publish as
+        # an "rlc" rate (and must fail over to the direct mode), even
+        # under python -O.
+        print(json.dumps({"metric": "ed25519_verify_throughput", "value": 0,
+                          "unit": "verifies/s", "vs_baseline": 0.0,
+                          "error": "timed reps failed correctness"
+                                   + (" (rlc fell back)" if fell_back else "")}))
+        return 1
     rate = batch * reps / dt
 
     rec = {
@@ -291,6 +326,7 @@ def worker(cpu: bool) -> int:
         "batch": batch,
         "msg_len": msg_len,
         "reps": reps,
+        "mode": mode,
         "device": str(dev),
         "compile_s": round(compile_s, 1),
         "ms_per_batch": round(1e3 * dt / reps, 2),
@@ -301,15 +337,18 @@ def worker(cpu: bool) -> int:
     return 0
 
 
-def _run_worker(cpu: bool, timeout_s: float) -> dict | None:
+def _run_worker(cpu: bool, timeout_s: float, mode: str | None = None) -> dict | None:
     """Spawn a worker subprocess; return its parsed JSON line or None."""
     cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
     if cpu:
         cmd.append("--cpu")
+    env = dict(os.environ)
+    if mode is not None:
+        env["FD_BENCH_VERIFY"] = mode
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout_s,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
         )
     except subprocess.TimeoutExpired:
         print(f"bench: worker timed out after {timeout_s:.0f}s "
@@ -370,16 +409,44 @@ def replay_main() -> int:
 
 def main() -> int:
     attempts = int(os.environ.get("FD_BENCH_RETRIES", "2"))
-    attempt_timeout = float(os.environ.get("FD_BENCH_ATTEMPT_TIMEOUT", "480"))
+    attempt_timeout = float(os.environ.get("FD_BENCH_ATTEMPT_TIMEOUT", "560"))
     errors = []
+    # Mode ladder: the RLC batch-verify fast path is the headline number;
+    # if it fails (wedged tunnel, fallback tripped, compile trouble) the
+    # direct per-lane path still lands a real TPU measurement.
+    modes = ["rlc", "direct"]
+    forced = os.environ.get("FD_BENCH_VERIFY")
+    if forced:
+        if forced not in modes:
+            print(json.dumps({
+                "metric": "ed25519_verify_throughput", "value": 0,
+                "unit": "verifies/s", "vs_baseline": 0.0,
+                "error": f"unknown FD_BENCH_VERIFY mode {forced!r}",
+            }))
+            return 1
+        modes = [forced]
+    # One shared wall-clock budget across the whole mode ladder so adding
+    # modes cannot push the (always-succeeds) CPU fallback past the
+    # driver's patience when the tunnel is wedged.
+    tpu_budget = float(os.environ.get("FD_BENCH_TPU_BUDGET", "1100"))
+    t_start = time.monotonic()
     for i in range(attempts):
-        rec = _run_worker(cpu=False, timeout_s=attempt_timeout)
-        if rec is not None:
-            print(json.dumps(rec))
-            return 0
-        errors.append(f"tpu attempt {i + 1} failed/timed out")
-        if i + 1 < attempts:
-            time.sleep(15.0)
+        for mode in modes:
+            left = tpu_budget - (time.monotonic() - t_start)
+            if left < 60.0:
+                errors.append("tpu budget exhausted")
+                break
+            rec = _run_worker(cpu=False, timeout_s=min(attempt_timeout, left),
+                              mode=mode)
+            if rec is not None:
+                print(json.dumps(rec))
+                return 0
+            errors.append(f"tpu attempt {i + 1} ({mode}) failed/timed out")
+        else:
+            if i + 1 < attempts:
+                time.sleep(15.0)
+            continue
+        break
     # TPU unreachable (wedged tunnel): land a CPU-pinned number so the round
     # still records a real measurement, flagged as a fallback.
     rec = _run_worker(cpu=True, timeout_s=float(

@@ -31,6 +31,8 @@ DEFAULTS: Dict[str, Any] = {
     "tiles": {
         "verify": {
             "backend": "oracle",   # oracle | tpu
+            "mode": "direct",      # direct | rlc (RLC batch verification
+                                   # with per-lane fallback, tpu backend)
             "batch": 128,
             "max_msg_len": 0,      # 0 = mtu
             "tcache_depth": 4096,

@@ -92,6 +92,7 @@ def cmd_run(cfg: Dict[str, Any], args) -> int:
         bank_cnt=tiles_cfg["pack"]["bank_cnt"],
         timeout_s=cfg["development"]["timeout_s"],
         tcache_depth=tiles_cfg["verify"]["tcache_depth"],
+        verify_opts={"verify_mode": tiles_cfg["verify"]["mode"]},
     )
     # filters are counted per verify lane (tile.verify, tile.verify.v1...)
     sv_filt = sum(d.get("sv_filt_cnt", 0) for name, d in res.diag.items()

@@ -28,14 +28,22 @@ class Requirement:
 
 
 def _cap_bits() -> int:
-    """Effective capability bits of this process (0 if unreadable)."""
+    """Effective capability bits of this process.
+
+    When /proc is unavailable (chroot, minimal container) CapEff cannot
+    be read; fall back to euid — real root without /proc should still
+    report its capabilities rather than claim it has none. The euid
+    fallback is ONLY used when the file is unreadable, never to override
+    a readable CapEff (a capability-dropped root container must report
+    what CapEff says).
+    """
     try:
         with open("/proc/self/status") as f:
             for line in f:
                 if line.startswith("CapEff:"):
                     return int(line.split()[1], 16)
     except OSError:
-        pass
+        return (1 << 41) - 1 if os.geteuid() == 0 else 0
     return 0
 
 
@@ -58,10 +66,19 @@ def _can_unshare_user() -> bool:
     Ubuntu apparmor_restrict_unprivileged_userns, user.max_user_namespaces)
     and reading one of them misses the others."""
     CLONE_NEWUSER = 0x10000000
-    pid = os.fork()
+    # Load libc BEFORE forking: dlopen allocates, and doing that in the
+    # child of a threaded process (JAX spins up threads) can deadlock on
+    # a lock some other thread held at fork time.
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        pid = os.fork()
+    except (OSError, MemoryError):
+        # fork can fail under RLIMIT_NPROC / cgroup pids limits — the
+        # very environments this report diagnoses. Report unavailable
+        # rather than crash the whole report.
+        return False
     if pid == 0:  # child: report via exit status
         try:
-            libc = ctypes.CDLL(None, use_errno=True)
             os._exit(0 if libc.unshare(CLONE_NEWUSER) == 0 else 1)
         except BaseException:
             os._exit(1)

@@ -440,11 +440,18 @@ class VerifyTile(Tile):
         inflight: int = 2,
         max_wait_us: int = 500,
         native_drain: bool = True,
+        verify_mode: str = "direct",
         **kw,
     ):
         super().__init__(wksp, cnc_name, in_link=in_link, out_link=out_link, **kw)
         assert backend in ("oracle", "tpu")
+        assert verify_mode in ("direct", "rlc")
+        if verify_mode == "rlc" and backend != "tpu":
+            # Silently running the oracle path while the operator believes
+            # RLC is on would be indistinguishable from "no fallbacks".
+            raise ValueError("verify_mode='rlc' requires backend='tpu'")
         self.backend = backend
+        self.verify_mode = verify_mode
         self.batch = batch
         self.max_msg_len = max_msg_len
         self.ha_tcache = TCache(tcache_depth)
@@ -459,6 +466,7 @@ class VerifyTile(Tile):
         self.stat_batches = 0
         self.stat_flush_timeout = 0
         self.stat_inflight_stall = 0
+        self.stat_rlc_fallback = 0
         # Native bulk drain (native/verify_drain.cc): one C call per batch
         # round replaces the per-frag Python poll/parse/copy loop (~30 us
         # per txn measured; the loop is the host-side throughput cap,
@@ -483,16 +491,25 @@ class VerifyTile(Tile):
 
             self._jnp = jnp
             self._verify_batch_fn = jax.jit(verify_batch)
+            if verify_mode == "rlc":
+                # RLC batch-verify fast pass with lazy per-lane fallback
+                # (ops/verify_rlc.py); clean batches cost one MSM pass.
+                from firedancer_tpu.ops.verify_rlc import make_async_verifier
+
+                self._verify_batch_fn = make_async_verifier(
+                    self._verify_batch_fn
+                )
             # Pre-warm: compile the fixed (batch, max_msg_len) shape now so
             # the run loop never stalls on first-flush compilation (the
             # persistent jax compilation cache makes this fast after the
             # first ever build of this shape).
-            self._verify_batch_fn(
+            out = self._verify_batch_fn(
                 jnp.zeros((batch, max_msg_len), jnp.uint8),
                 jnp.zeros((batch,), jnp.int32),
                 jnp.zeros((batch, 64), jnp.uint8),
                 jnp.zeros((batch, 32), jnp.uint8),
-            ).block_until_ready()
+            )
+            np.asarray(out)  # force both graphs (rlc + fallback) compiled
 
     def _nd_setup(self) -> None:
         import ctypes
@@ -742,6 +759,8 @@ class VerifyTile(Tile):
             if not block and not ib.out.is_ready():
                 return
             statuses = np.asarray(ib.out)  # blocks only if not ready
+            if getattr(ib.out, "used_fallback", False):
+                self.stat_rlc_fallback += 1
             self._inflight.pop(0)
             off = 0
             for payload, cnt, tsorig in ib.todo:

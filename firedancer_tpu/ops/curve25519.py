@@ -64,9 +64,12 @@ def point_add(p, q, need_t: bool = True):
     """
     x1, y1, z1, t1 = p
     x2, y2, z2, t2 = q
+    # 2d constant, rank-adapted so multi-dim batch shapes (e.g. the MSM's
+    # (windows, buckets) lanes) broadcast correctly.
+    d2 = fe.FE_D2.reshape((fe.NLIMBS,) + (1,) * (x1.ndim - 1))
     a = fe.fe_mul(fe.fe_sub(y1, x1), fe.fe_sub(y2, x2))
     b = fe.fe_mul(fe.fe_add(y1, x1), fe.fe_add(y2, x2))
-    c = fe.fe_mul(fe.fe_mul(t1, t2), fe.FE_D2)
+    c = fe.fe_mul(fe.fe_mul(t1, t2), d2)
     d_ = fe.fe_add(fe.fe_mul(z1, z2), fe.fe_mul(z1, z2))
     e = fe.fe_sub(b, a)
     f = fe.fe_sub(d_, c)
@@ -141,7 +144,12 @@ def compress(p) -> jnp.ndarray:
     """(X:Y:Z:T) -> canonical 32-byte encoding (*batch, 32) uint8."""
     x, y, z, _ = p
     invert, _ = _pow_auto()
-    zinv = invert(z)
+    if z.ndim == 2 and z.shape[1] >= 256:
+        # Grouped Montgomery trick: ~3 muls/lane + one power chain per
+        # 64 lanes (Z != 0 mod p always holds for group elements).
+        zinv = fe.fe_invert_batch(z, invert_fn=invert)
+    else:
+        zinv = invert(z)
     ax = fe.fe_mul(x, zinv)
     ay = fe.fe_mul(y, zinv)
     out = fe.fe_to_bytes(ay)

@@ -54,12 +54,12 @@ def _point_add(p, q, d2, need_t=True):
 
 def _point_double(p, need_t=True):
     x1, y1, z1, _ = p
-    a = _fe_mul(x1, x1)
-    b = _fe_mul(y1, y1)
-    zz = _fe_mul(z1, z1)
+    a = fe.fe_sq(x1)
+    b = fe.fe_sq(y1)
+    zz = fe.fe_sq(z1)
     c = fe.fe_add(zz, zz)
     d_ = fe.fe_neg(a)
-    e = fe.fe_sub(fe.fe_sub(_fe_mul(fe.fe_add(x1, y1), fe.fe_add(x1, y1)), a), b)
+    e = fe.fe_sub(fe.fe_sub(fe.fe_sq(fe.fe_add(x1, y1)), a), b)
     g = fe.fe_add(d_, b)
     f = fe.fe_sub(g, c)
     h = fe.fe_sub(d_, b)

@@ -139,7 +139,53 @@ def fe_mul_unrolled(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def fe_sq(a: jnp.ndarray) -> jnp.ndarray:
-    return fe_mul(a, a)
+    """Specialized squaring: 528 limb products vs fe_mul's 1024.
+
+    Difference decomposition — for d = j - i >= 0 the pair product
+    a_i*a_j lands at k = 2i + d, doubled when d > 0:
+      d = 2e:     ev[q] += a[q-e] * (2a)[q+e]   at even k = 2q
+      d = 2e+1:   od[q] += a[q-e] * (2a)[q+e+1] at odd  k = 2q+1
+    Each difference d is one static-sliced vector multiply of length
+    32-d, so the half-triangle costs ~half of fe_mul's full 32x32
+    schoolbook (same trick as the reference's fe_sq vs fe_mul in
+    ref/fd_ed25519_fe.c, re-derived for the limb-major batch layout).
+
+    Bound: the regrouped terms sum to exactly the fe_mul convolution, so
+    the same |a| <= 1024 -> |c_k| < 2^31 analysis and 4-pass carry hold.
+    """
+    batch = a.shape[1:]
+    ad = a + a
+
+    # Mosaic-safe construction: static slices + concatenate only (the
+    # primitive mix fe_mul_unrolled already relies on inside Pallas
+    # kernels) — no scatter (.at[].add), no stack/reshape.
+    def pad_rows(x, lo, hi):
+        parts = []
+        if lo:
+            parts.append(jnp.zeros((lo,) + batch, jnp.int32))
+        parts.append(x)
+        if hi:
+            parts.append(jnp.zeros((hi,) + batch, jnp.int32))
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts, 0)
+
+    ev = a * a                                  # d=0: a_q^2 at k=2q
+    for e in range(1, NLIMBS // 2):             # d = 2e
+        ev = ev + pad_rows(a[: NLIMBS - 2 * e] * ad[2 * e:], e, e)
+    od = None
+    for e in range(NLIMBS // 2):                # d = 2e + 1
+        p = pad_rows(a[: NLIMBS - 1 - 2 * e] * ad[2 * e + 1:], e, e)
+        od = p if od is None else od + p        # (31,) rows: odd k=2q+1
+    # Wrap k >= 32 into k - 32 with weight 38 (2^256 = 38 mod p). od has
+    # 31 rows (max odd k is 61); its high half covers q' = 0..14.
+    half = NLIMBS // 2
+    ce = ev[:half] + 38 * ev[half:]
+    co = od[:half] + 38 * pad_rows(od[half:], 0, 1)
+    rows = []
+    for q in range(half):
+        rows.append(ce[q:q + 1])
+        rows.append(co[q:q + 1])
+    c = jnp.concatenate(rows, axis=0)
+    return _carry_pass(c, 4)
 
 
 def fe_mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
@@ -232,12 +278,19 @@ def _pow_ladder(z: jnp.ndarray):
     """Shared addition-chain prefix: returns (z^(2^250 - 1), z^11, z^2).
 
     The classic curve25519 chain (public structure, e.g. RFC 7748 impls).
+    Long squaring runs go through lax.fori_loop so the traced graph stays
+    small — this XLA chain is the CPU/test/dryrun path (TPU uses the
+    pow_pallas kernels, where the same chain is fully unrolled in-VMEM);
+    per-step loop overhead is irrelevant off-accelerator, compile time of
+    a ~250x-unrolled field-op graph is not.
     """
 
     def sqn(x, n):
-        for _ in range(n):
-            x = fe_sq(x)
-        return x
+        if n <= 5:
+            for _ in range(n):
+                x = fe_sq(x)
+            return x
+        return jax.lax.fori_loop(0, n, lambda i, v: fe_sq(v), x)
 
     z2 = fe_sq(z)                      # 2
     z9 = fe_mul(sqn(z2, 2), z)         # 9
@@ -260,6 +313,48 @@ def fe_invert(z: jnp.ndarray) -> jnp.ndarray:
     for _ in range(5):
         x = fe_sq(x)
     return fe_mul(x, z11)              # 2^255 - 32 + 11 = 2^255 - 21
+
+
+def fe_invert_batch(z: jnp.ndarray, group_log2: int = 6,
+                    invert_fn=None) -> jnp.ndarray:
+    """Batched inversion via a grouped Montgomery product tree.
+
+    z: (32, B) limbs, every lane nonzero mod p. Lanes are grouped in
+    blocks of 2^group_log2; a pairwise product tree reduces each group to
+    one value, ONE power-chain inversion runs on the (B / 2^g)-lane group
+    roots, and inverses propagate back down (inv_a = inv_ab * b). Per-lane
+    cost falls from ~266 multiplies (the z^(p-2) chain) to ~3 tree muls +
+    266 / 2^g — the standard Montgomery-trick amortization, vectorized as
+    a lane-axis tree instead of the reference's sequential scan.
+
+    Caller contract: zero lanes poison their whole group (the group
+    product is 0, and 0^(p-2) = 0 spreads). Curve compress is safe —
+    extended-coordinate Z is never 0 mod p for group elements.
+
+    invert_fn overrides the root inversion (e.g. the Pallas power chain
+    on TPU); defaults to fe_invert.
+    """
+    if z.ndim != 2:
+        raise ValueError("fe_invert_batch expects (NLIMBS, B)")
+    bsz = z.shape[1]
+    if bsz == 0:
+        return z
+    g = group_log2
+    while g > 0 and (bsz % (1 << g) or bsz >> g < 1):
+        g -= 1
+    pairs = []
+    cur = z
+    for _ in range(g):
+        ab = cur.reshape(NLIMBS, -1, 2)
+        a, b = ab[:, :, 0], ab[:, :, 1]
+        pairs.append((a, b))
+        cur = fe_mul(a, b)
+    inv = (invert_fn or fe_invert)(cur)
+    for a, b in reversed(pairs):
+        inv_a = fe_mul(inv, b)
+        inv_b = fe_mul(inv, a)
+        inv = jnp.stack([inv_a, inv_b], axis=2).reshape(NLIMBS, -1)
+    return inv
 
 
 def fe_pow22523(z: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,264 @@
+"""Batched multi-scalar multiplication (Pippenger) on TPU.
+
+Computes sum_i c_i * P_i for a batch of per-lane scalars and points with
+ONE shared doubling chain — the structural cost that per-lane
+double-scalar-mult (ops/curve25519.double_scalarmult) cannot amortize.
+This is the engine behind RLC batch verification (ops/verify_rlc.py).
+
+Shape of the computation (w = 8-bit windows, byte-aligned so digit
+extraction is free):
+
+1. **Bucket fill.** For every window t, lane digits d_i route point P_i
+   into bucket (t, d_i). The fill is batch-uniform: a static number of
+   ROUNDS, each adding one gathered point per (window, bucket) lane —
+   lanes are (n_windows x 256) wide, so every round is one unified
+   point_add across all windows at once. Slot indices are built by a
+   stable argsort per window + rank-within-bucket arithmetic (gathers
+   only, no scatters — TPU-friendly).
+2. **Bucket aggregation.** sum_b b * S_b via bit decomposition:
+   sum_k 2^k (sum over buckets with bit k set), each inner sum a
+   pairwise tree-reduce over the bucket axis — log-depth, batch-uniform.
+3. **Cross-window Horner.** S = 2^8 * S + W_t, MSB-first; (32, 1)-lane
+   elementwise chains that XLA fuses.
+
+Data-dependence escape hatch: the fill uses a STATIC round count
+(max_rounds). If any bucket receives more points (Poisson tail, or
+adversarially-biased digits of h), the fill would be incomplete — the
+function detects this and reports ok=False so the caller falls back to
+the exact per-lane path. Never a wrong result, only a slow path.
+
+Reference basis: Pippenger's algorithm (public-domain technique; cf.
+the batched bucket MSMs in GPU ZK provers), re-shaped for TPU: no
+atomics, no scatters, unified complete adds, one-hot-free gathers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import curve25519 as ge
+from . import fe25519 as fe
+
+W_BITS = 7
+N_BUCKETS = 1 << W_BITS
+# Window counts are chosen so EVERY window of the scalar distribution is
+# either uniform or almost-always-zero — a top window whose digits
+# concentrate on a few NONZERO values overloads those buckets and forces
+# the static-round fill into its fallback (zero digits are free: bucket
+# 0 is never accumulated). 252 = 36*7, so scalars mod L (< 2^252 + eps)
+# are uniform in windows 0..35 and ~always 0 in window 36; RLC z weights
+# are drawn < 2^126 = 2^(18*7) so all 18 windows are uniform.
+WINDOWS_128 = 19   # any 128-bit scalar (window 18 in {0..3})
+WINDOWS_Z = 18     # RLC z weights: uniform < 2^126
+WINDOWS_253 = 37   # scalars mod L
+
+
+def _digits(scalars_bytes: jnp.ndarray, n_windows: int) -> jnp.ndarray:
+    """(B, 32) uint8 -> (n_windows, B) int32 7-bit windows, LSB first."""
+    b = jnp.moveaxis(scalars_bytes.astype(jnp.int32), -1, 0)  # (32, B)
+    zero = jnp.zeros_like(b[0])
+    outs = []
+    for w in range(n_windows):
+        bit = 7 * w
+        i, sh = bit >> 3, bit & 7
+        lo = b[i] if i < 32 else zero
+        hi = b[i + 1] if i + 1 < 32 else zero
+        outs.append(((lo + (hi << 8)) >> sh) & (N_BUCKETS - 1))
+    return jnp.stack(outs)
+
+
+def _reduce_pairs(pt, n):
+    """Tree-reduce a (..., n) bucket axis by pairwise point_add."""
+    while n > 1:
+        half = n // 2
+        a = tuple(c[..., 0::2] for c in pt)
+        b = tuple(c[..., 1::2] for c in pt)
+        pt = ge.point_add(a, b)
+        n = half
+    return pt
+
+
+def _default_rounds(bsz: int) -> int:
+    # Poisson tail bound: with uniform digits each nonzero bucket holds
+    # ~lam = B/(N_BUCKETS-1) points; lam + 7*sqrt(lam) + 8 puts the
+    # per-batch overflow probability below ~1e-7 even across thousands
+    # of buckets. Adversarially-biased digits only cost the fallback.
+    lam = bsz / (N_BUCKETS - 1)
+    return min(int(lam + 7.0 * lam ** 0.5 + 8.0) + 1, bsz)
+
+
+def _staging_indices(scalars_bytes, n_windows: int, bsz: int,
+                     max_rounds: int):
+    """Slot table for the bucket fill: (idx, ok) where idx[t, b, r] is
+    the lane of the r-th point in bucket (t, b) or -1, and ok is False
+    iff some bucket overflowed max_rounds."""
+    nw = n_windows
+    d = _digits(scalars_bytes, nw)                        # (nw, B)
+    order = jnp.argsort(d, axis=1, stable=True)           # (nw, B)
+    sorted_d = jnp.take_along_axis(d, order, axis=1)
+
+    # starts[t, b] = first sorted position of digit b in window t.
+    buckets = jnp.arange(N_BUCKETS, dtype=jnp.int32)
+    starts = jax.vmap(
+        lambda row: jnp.searchsorted(row, buckets, side="left")
+    )(sorted_d)                                           # (nw, 256)
+    ends = jnp.concatenate(
+        [starts[:, 1:], jnp.full((nw, 1), bsz, starts.dtype)], axis=1
+    )
+    counts = ends - starts                                # (nw, 256)
+    ok = jnp.max(jnp.where(buckets[None, :] > 0, counts, 0)) <= max_rounds
+
+    # Slot table: idx[t, b, r] = lane index of the r-th point in bucket
+    # (t, b), or -1. Bucket 0 contributes nothing (digit 0 == identity).
+    r_iota = jnp.arange(max_rounds, dtype=jnp.int32)
+    pos = starts[:, :, None] + r_iota[None, None, :]      # (nw, 256, R)
+    valid = (r_iota[None, None, :] < counts[:, :, None]) & (
+        buckets[None, :, None] > 0
+    )
+    pos_flat = jnp.clip(pos.reshape(nw, -1), 0, bsz - 1)
+    idx = jnp.take_along_axis(order, pos_flat, axis=1).reshape(
+        nw, N_BUCKETS, max_rounds
+    )
+    idx = jnp.where(valid, idx, -1)                       # (nw, 256, R)
+    return idx, ok
+
+
+def msm(scalars_bytes: jnp.ndarray, points, n_windows: int,
+        max_rounds: int | None = None):
+    """sum_i scalars_i * P_i (XLA reference path).
+
+    scalars_bytes: (B, 32) uint8 little-endian (windows beyond
+      n_windows must be zero). points: (X, Y, Z, T) of (32, B) limbs.
+    Returns (point, ok): point is (X, Y, Z, T) of (32, 1) limbs; ok is a
+      () bool — False iff a bucket overflowed max_rounds (result then
+      invalid; caller must use the exact path).
+    """
+    bsz = points[0].shape[1]
+    if max_rounds is None:
+        max_rounds = _default_rounds(bsz)
+    nw = n_windows
+    idx, ok = _staging_indices(scalars_bytes, nw, bsz, max_rounds)
+
+    lanes = nw * N_BUCKETS
+    ident = ge.identity((lanes,))
+
+    def fill_round(r, acc):
+        sel = jax.lax.dynamic_index_in_dim(
+            idx, r, axis=2, keepdims=False
+        ).reshape(lanes)                                   # (L,)
+        m = sel >= 0
+        safe = jnp.clip(sel, 0, bsz - 1)
+        q = tuple(c[:, safe] for c in points)
+        q = ge.point_select(m, q, ident)
+        # Adding the identity is exact under the unified formulas, so a
+        # plain add-then-keep is fine; select keeps masked lanes stable.
+        return ge.point_select(m, ge.point_add(acc, q), acc)
+
+    acc = jax.lax.fori_loop(0, max_rounds, fill_round, ident)
+    s_buckets = tuple(
+        c.reshape(fe.NLIMBS, nw, N_BUCKETS) for c in acc
+    )
+
+    # sum_b b * S_b = sum_k 2^k * (sum_{b: bit k set} S_b). A lax.scan
+    # over the bit masks keeps the traced graph ~W_BITS x smaller than
+    # unrolling (this path must stay compilable on CPU test hosts).
+    buckets = jnp.arange(N_BUCKETS, dtype=jnp.int32)
+    ident_nb = ge.identity((nw, N_BUCKETS))
+    bit_masks = jnp.stack([
+        jnp.broadcast_to((((buckets >> k) & 1) == 1)[None, :],
+                         (nw, N_BUCKETS))
+        for k in range(W_BITS - 1, -1, -1)
+    ])                                                     # (W_BITS, nw, 256)
+
+    def agg_step(carry, bit):
+        masked = ge.point_select(bit, s_buckets, ident_nb)
+        t_k = _reduce_pairs(masked, N_BUCKETS)             # (32, nw, 1)
+        t_k = tuple(c[..., 0] for c in t_k)                # (32, nw)
+        out = ge.point_add(ge.point_double(carry), t_k)
+        return out, None
+
+    w_res, _ = jax.lax.scan(agg_step, ge.identity((nw,)), bit_masks)
+    return _window_horner(w_res, nw), ok
+
+
+def _window_horner(w_res, nw: int):
+    """Combine per-window sums: sum_t 2^(w t) W_t, MSB-first Horner as a
+    lax.scan over windows (graph stays small; lanes are (32, 1))."""
+    res = tuple(c[:, nw - 1:nw] for c in w_res)            # (32, 1)
+    if nw == 1:
+        return res
+    stacked = tuple(
+        jnp.moveaxis(c[:, :nw - 1], 1, 0)[::-1][:, :, None]  # (nw-1, 32, 1)
+        for c in w_res
+    )
+
+    def horner_step(carry, wt):
+        for _ in range(W_BITS):
+            carry = ge.point_double(carry)
+        return ge.point_add(carry, wt), None
+
+    res, _ = jax.lax.scan(horner_step, res, stacked)
+    return res
+
+
+def msm_fast(scalars_bytes: jnp.ndarray, points, n_windows: int,
+             max_rounds: int | None = None, interpret: bool = False):
+    """Kernel-backed msm (same contract as msm()).
+
+    REQUIRES points with Z == 1 (decompress output / affine constants) —
+    the bucket fill uses precomputed niels form (y+x, y-x, 2d*t) with
+    mixed adds, 7 muls instead of 9. Bucket accumulators and the
+    aggregation running sums live in VMEM (ops/msm_pallas.py); the
+    sort/gather staging and final Horner remain XLA.
+    """
+    from . import msm_pallas as mp
+
+    bsz = points[0].shape[1]
+    if max_rounds is None:
+        max_rounds = _default_rounds(bsz)
+    nw = n_windows
+    idx, ok = _staging_indices(scalars_bytes, nw, bsz, max_rounds)
+
+    x, y, z, t = points
+    yp = fe.fe_add(y, x)
+    ym = fe.fe_sub(y, x)
+    t2d = fe.fe_mul(t, fe.FE_D2)
+
+    lanes = nw * N_BUCKETS
+    sel = jnp.transpose(idx, (2, 0, 1)).reshape(max_rounds * lanes)
+    m = (sel >= 0)[None, :]
+    safe = jnp.clip(sel, 0, bsz - 1)
+    one0 = (jnp.arange(fe.NLIMBS, dtype=jnp.int32) == 0)[:, None]
+
+    def stage(src, ident_col):
+        g = jnp.where(m, src[:, safe], ident_col)          # (32, R*L)
+        return jnp.transpose(
+            g.reshape(fe.NLIMBS, max_rounds, lanes), (1, 0, 2)
+        )                                                  # (R, 32, L)
+
+    s_yp = stage(yp, one0.astype(jnp.int32))
+    s_ym = stage(ym, one0.astype(jnp.int32))
+    s_t2d = stage(t2d, 0)
+
+    bx, by, bz, bt = mp.fill_buckets_pallas(
+        s_yp, s_ym, s_t2d, interpret=interpret
+    )
+
+    # (32, L) -> bucket-major (256, 32, nw_pad) for the aggregation walk.
+    nw_pad = max(128, nw)
+    def to_bucket_major(c):
+        c = jnp.transpose(
+            c.reshape(fe.NLIMBS, nw, N_BUCKETS), (2, 0, 1)
+        )
+        if nw_pad != nw:
+            c = jnp.pad(c, ((0, 0), (0, 0), (0, nw_pad - nw)))
+        return c
+
+    w_res = mp.aggregate_buckets_pallas(
+        tuple(to_bucket_major(c) for c in (bx, by, bz, bt)),
+        fe.FE_D2.astype(jnp.int32),
+        interpret=interpret,
+    )
+    w_res = tuple(c[:, :nw] for c in w_res)
+    return _window_horner(w_res, nw), ok

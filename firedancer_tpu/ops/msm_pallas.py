@@ -1,0 +1,200 @@
+"""Pallas TPU kernels for the Pippenger MSM (ops/msm.py fast path).
+
+Two kernels replace the HBM-streamed XLA inner loops:
+
+1. **Bucket fill** (`fill_buckets_pallas`): the (windows x buckets) lane
+   grid lives in VMEM scratch across a sequential grid; every grid step
+   streams one round's gathered points from HBM and performs ONE unified
+   mixed point-add across all lanes. Points arrive in precomputed niels
+   form (y+x, y-x, 2d*t, Z==1), cutting the add to 7 field muls — the
+   same precomputation the reference bakes into its constant base tables
+   (ref/fd_ed25519_ge.c precomp), applied here to runtime points.
+   Invalid slots are staged as the niels identity (1, 1, 0), which the
+   unified formulas absorb exactly — no masks in the hot loop.
+
+2. **Bucket aggregation** (`aggregate_buckets_pallas`): sum_b b * S_b
+   per window via the classic two-running-sums walk (b = 255 .. 1),
+   sequential over the bucket axis but vectorized across windows on the
+   lane axis — 510 point-adds on (32, nw)-lane tiles, microseconds in
+   VMEM versus milliseconds if XLA streamed each through HBM.
+
+The surrounding sort/gather staging and the final cross-window Horner
+stay in XLA (gathers and fused elementwise chains are what XLA is good
+at). See ops/msm.py for the algorithm-level description.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import fe25519 as fe
+
+NLIMBS = fe.NLIMBS
+
+
+def _madd_niels(p, q_niels):
+    """Unified mixed add: p extended (x, y, z, t) + q in niels form
+    (yp = y+x, ym = y-x, t2d = 2d*t), q.Z == 1. 7 field muls."""
+    x1, y1, z1, t1 = p
+    yp2, ym2, t2d2 = q_niels
+    a = fe.fe_mul_unrolled(fe.fe_sub(y1, x1), ym2)
+    b = fe.fe_mul_unrolled(fe.fe_add(y1, x1), yp2)
+    c = fe.fe_mul_unrolled(t1, t2d2)
+    d = fe.fe_add(z1, z1)
+    e = fe.fe_sub(b, a)
+    f = fe.fe_sub(d, c)
+    g = fe.fe_add(d, c)
+    h = fe.fe_add(b, a)
+    return (fe.fe_mul_unrolled(e, f), fe.fe_mul_unrolled(g, h),
+            fe.fe_mul_unrolled(f, g), fe.fe_mul_unrolled(e, h))
+
+
+def _point_add_ext(p, q, d2):
+    """Unified extended add (9 muls); d2 = limbs of 2d, (NLIMBS, 1)."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = fe.fe_mul_unrolled(fe.fe_sub(y1, x1), fe.fe_sub(y2, x2))
+    b = fe.fe_mul_unrolled(fe.fe_add(y1, x1), fe.fe_add(y2, x2))
+    c = fe.fe_mul_unrolled(fe.fe_mul_unrolled(t1, t2), d2)
+    zz = fe.fe_mul_unrolled(z1, z2)
+    d = fe.fe_add(zz, zz)
+    e = fe.fe_sub(b, a)
+    f = fe.fe_sub(d, c)
+    g = fe.fe_add(d, c)
+    h = fe.fe_add(b, a)
+    return (fe.fe_mul_unrolled(e, f), fe.fe_mul_unrolled(g, h),
+            fe.fe_mul_unrolled(f, g), fe.fe_mul_unrolled(e, h))
+
+
+def _identity4(lanes):
+    one = (jax.lax.broadcasted_iota(jnp.int32, (NLIMBS, lanes), 0) == 0)
+    one = one.astype(jnp.int32)
+    zero = jnp.zeros((NLIMBS, lanes), jnp.int32)
+    return (zero, one, one, zero)
+
+
+def fill_buckets_pallas(yp, ym, t2d, lane_tile: int = 2048,
+                        interpret: bool = False):
+    """Accumulate staged niels rounds into bucket points.
+
+    yp/ym/t2d: (R, 32, L) int32 — round r's point for every
+    (window, bucket) lane, identity-staged where the slot is empty.
+    Returns extended bucket points (x, y, z, t), each (32, L).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_rounds, _, n_lanes = yp.shape
+    if n_lanes % lane_tile:
+        lane_tile = n_lanes
+    n_tiles = n_lanes // lane_tile
+
+    def kern(ypr, ymr, t2dr, ox, oy, oz, ot, xs, ys, zs, ts):
+        ri = pl.program_id(1)
+
+        @pl.when(ri == 0)
+        def _init():
+            x0, y0, z0, t0 = _identity4(lane_tile)
+            xs[...] = x0
+            ys[...] = y0
+            zs[...] = z0
+            ts[...] = t0
+
+        p = (xs[...], ys[...], zs[...], ts[...])
+        x, y, z, t = _madd_niels(p, (ypr[0], ymr[0], t2dr[0]))
+        xs[...] = x
+        ys[...] = y
+        zs[...] = z
+        ts[...] = t
+
+        @pl.when(ri == n_rounds - 1)
+        def _emit():
+            ox[...] = x
+            oy[...] = y
+            oz[...] = z
+            ot[...] = t
+
+    spec_in = pl.BlockSpec((1, NLIMBS, lane_tile), lambda i, r: (r, 0, i))
+    spec_out = pl.BlockSpec((NLIMBS, lane_tile), lambda i, r: (0, i))
+    out_shape = jax.ShapeDtypeStruct((NLIMBS, n_lanes), jnp.int32)
+    return pl.pallas_call(
+        kern,
+        grid=(n_tiles, n_rounds),
+        in_specs=[spec_in] * 3,
+        out_specs=[spec_out] * 4,
+        out_shape=[out_shape] * 4,
+        scratch_shapes=[
+            pltpu.VMEM((NLIMBS, lane_tile), jnp.int32) for _ in range(4)
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(yp, ym, t2d)
+
+
+def aggregate_buckets_pallas(buckets, d2_col, interpret: bool = False):
+    """sum_b b * S_b per window, running-sums walk (b = 255 .. 1).
+
+    buckets: (x, y, z, t) each (n_buckets, 32, nw_pad) — bucket-major;
+    the grid walks buckets top-down, streaming one (32, nw_pad) slice
+    per step (auto double-buffered), with the two running sums (S =
+    suffix bucket sum, T = the weighted answer) in VMEM scratch. Bucket
+    0 is never visited (digit 0 contributes identity by construction).
+    d2_col: (32, 1) int32 limbs of 2d (kernels can't capture constants).
+    Returns (x, y, z, t) each (32, nw_pad).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_buckets, _, nw = buckets[0].shape
+    n_steps = n_buckets - 1                    # buckets top .. 1
+
+    def kern(bx, by, bz, bt, d2r, ox, oy, oz, ot, *scr):
+        g = pl.program_id(0)
+        d2 = d2r[...]
+        q = (bx[0], by[0], bz[0], bt[0])
+        sx, sy, sz, st_, tx, ty, tz, tt = scr
+
+        @pl.when(g == 0)
+        def _init():
+            for r, v in zip(scr, q + q):
+                r[...] = v
+
+        @pl.when(g > 0)
+        def _step():
+            s = _point_add_ext((sx[...], sy[...], sz[...], st_[...]), q, d2)
+            t_ = _point_add_ext((tx[...], ty[...], tz[...], tt[...]), s, d2)
+            for r, v in zip(scr, s + t_):
+                r[...] = v
+
+        @pl.when(g == n_steps - 1)
+        def _emit():
+            ox[...] = tx[...]
+            oy[...] = ty[...]
+            oz[...] = tz[...]
+            ot[...] = tt[...]
+
+    spec_b = pl.BlockSpec(
+        (1, NLIMBS, nw), lambda g: (n_buckets - 1 - g, 0, 0)
+    )
+    spec_d2 = pl.BlockSpec((NLIMBS, 1), lambda g: (0, 0))
+    spec_out = pl.BlockSpec((NLIMBS, nw), lambda g: (0, 0))
+    out_shape = jax.ShapeDtypeStruct((NLIMBS, nw), jnp.int32)
+    return pl.pallas_call(
+        kern,
+        grid=(n_steps,),
+        in_specs=[spec_b] * 4 + [spec_d2],
+        out_specs=[spec_out] * 4,
+        out_shape=[out_shape] * 4,
+        scratch_shapes=[
+            pltpu.VMEM((NLIMBS, nw), jnp.int32) for _ in range(8)
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(*buckets, d2_col)

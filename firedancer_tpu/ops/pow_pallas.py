@@ -36,16 +36,16 @@ def _mul(a, b):
 
 def _sqn(x, n):
     for _ in range(n):
-        x = _mul(x, x)
+        x = fe.fe_sq(x)
     return x
 
 
 def _ladder(z):
     """(z^(2^250 - 1), z^11) per fe25519._pow_ladder."""
-    z2 = _mul(z, z)
+    z2 = fe.fe_sq(z)
     z9 = _mul(_sqn(z2, 2), z)
     z11 = _mul(z9, z2)
-    z_5_0 = _mul(_mul(z11, z11), z9)
+    z_5_0 = _mul(fe.fe_sq(z11), z9)
     z_10_0 = _mul(_sqn(z_5_0, 5), z_5_0)
     z_20_0 = _mul(_sqn(z_10_0, 10), z_10_0)
     z_40_0 = _mul(_sqn(z_20_0, 20), z_20_0)

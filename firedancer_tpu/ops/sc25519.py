@@ -69,6 +69,23 @@ def sc_reduce64(hash_bytes: jnp.ndarray) -> jnp.ndarray:
     return jnp.moveaxis(r[:32], 0, -1).astype(jnp.uint8)
 
 
+def sc_sum(s_bytes: jnp.ndarray) -> jnp.ndarray:
+    """Sum of a batch of scalars mod L: (B, 32) uint8 -> (1, 32) uint8.
+
+    Limb-wise int32 sum (exact for B < 2^23), exact carry to a 64-byte
+    integer (< B * L < 2^512 for any practical batch), then the shared
+    Barrett reduction.
+    """
+    x = jnp.sum(s_bytes.astype(jnp.int32), axis=0)[:, None]  # (32, 1)
+    limbs, carry = _seq_carry(x)
+    out = jnp.zeros((64, 1), jnp.int32)
+    out = out.at[:32].set(limbs)
+    out = out.at[32].set(carry & 0xFF)
+    out = out.at[33].set((carry >> 8) & 0xFF)
+    out = out.at[34].set((carry >> 16) & 0xFF)
+    return sc_reduce64(jnp.moveaxis(out, 0, -1).astype(jnp.uint8))
+
+
 def sc_check_range(s_bytes: jnp.ndarray) -> jnp.ndarray:
     """Vectorized s < L check on (*batch, 32) uint8 little-endian scalars.
 

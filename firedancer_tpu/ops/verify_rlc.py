@@ -1,0 +1,261 @@
+"""Batch Ed25519 verification by random linear combination (RLC).
+
+The TPU-first answer to the per-signature cost wall: a single-lane
+verify pays ~256 doublings + 128 table adds in the double-scalar-mult
+(ops/verify.py), and no amount of kernel tuning removes that work. RLC
+batch verification (the standard ed25519 batch equation, e.g.
+ed25519-dalek / RFC 8032 §8.2 discussion) replaces B per-lane
+scalar-mults with ONE multi-scalar-multiplication whose doubling chain
+is shared across the whole batch:
+
+    T = (sum_i z_i s_i mod L) * B  +  sum_i z_i * (-R_i)  +  sum_i (z_i h_i mod L) * (-A_i)
+    batch valid  <=>  T == identity        (soundness 2^-128 per batch)
+
+with z_i fresh random 128-bit scalars chosen AFTER the signatures are
+known. The MSM is computed with Pippenger bucket accumulation
+(ops/msm.py) — bucket fill cost amortizes the doublings over all lanes.
+
+Semantics parity with the reference's byte-compare verify
+(fd_ed25519_user.c:346-433, see ops/verify.py):
+- s range check (ERR_SIG) and A decompress (ERR_PUBKEY) exactly as the
+  per-lane path.
+- The reference compares compress(h*(-A) + s*B) against the r bytes.
+  For that byte-compare to succeed, r MUST be the canonical encoding of
+  a curve point (compress only emits canonical encodings). So lanes
+  whose r bytes fail decompression or are non-canonical are definite
+  ERR_MSG — they are excluded from the combination (z_i = 0) with their
+  status already decided. For the remaining lanes, canonical-encoding
+  injectivity gives: bytes equal <=> R' == R as group elements, which
+  is exactly what the RLC equation tests.
+
+Failure handling is the caller's job (disco/tiles.py): if the batch
+equation fails, at least one lane is bad — re-dispatch the batch on the
+per-lane path. Worst case (adversary salts every batch) costs one extra
+RLC pass (~0.4x a direct pass); the clean-traffic common case runs
+~2-3x faster than per-lane verify.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import curve25519 as ge
+from . import fe25519 as fe
+from . import msm as msm_mod
+from . import sc25519 as sc
+from .sha512 import sha512_batch
+from .sign import _sc_muladd
+from .verify import (
+    FD_ED25519_ERR_MSG,
+    FD_ED25519_ERR_PUBKEY,
+    FD_ED25519_ERR_SIG,
+    FD_ED25519_SUCCESS,
+)
+
+# Canonical little-endian bytes of p, for the r-canonicality compare.
+_P_BYTES = np.array([(fe.P >> (8 * i)) & 0xFF for i in range(32)], np.uint8)
+
+
+def fresh_z(batch: int, rng: np.random.Generator | None = None) -> np.ndarray:
+    """(B, 32) uint8: random 126-bit scalars (top 16 bytes zero), z_i >= 1.
+
+    Host-side entropy: z must be unpredictable to whoever crafted the
+    signatures, so it is drawn per batch, never fixed in the graph.
+    126 bits = 18 exact 7-bit MSM windows (msm.WINDOWS_Z), keeping every
+    window's digit distribution uniform; soundness 2^-126 per batch.
+
+    Default entropy is os.urandom (CSPRNG) — the soundness claim rests
+    on z being unpredictable, which a statistical PRNG does not provide.
+    The rng parameter exists for deterministic tests only.
+    """
+    import os
+
+    z = np.zeros((batch, 32), np.uint8)
+    if rng is None:
+        z[:, :16] = np.frombuffer(
+            os.urandom(batch * 16), np.uint8
+        ).reshape(batch, 16)
+    else:
+        z[:, :16] = rng.integers(0, 256, (batch, 16), dtype=np.uint8)
+    z[:, 15] &= 0x3F
+    z[:, 0] |= 1  # never zero: a zero weight would drop the lane's check
+    return z
+
+
+def _bytes_lt_p(b: jnp.ndarray) -> jnp.ndarray:
+    """(B, 32) uint8 (with bit 255 already masked) < p, lexicographic."""
+    pb = jnp.asarray(_P_BYTES, jnp.int32)
+    x = b.astype(jnp.int32)
+    # Most-significant differing byte decides; scan from byte 31 down.
+    lt = jnp.zeros(b.shape[:-1], jnp.bool_)
+    decided = jnp.zeros(b.shape[:-1], jnp.bool_)
+    for i in range(31, -1, -1):
+        xi, pi = x[..., i], pb[i]
+        lt = jnp.where(~decided & (xi < pi), True, lt)
+        decided = decided | (xi != pi)
+    return lt
+
+
+def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes):
+    """One RLC pass over a batch.
+
+    Args are as ops.verify.verify_batch, plus z_bytes (B, 32) uint8
+    128-bit random weights (from fresh_z).
+
+    Returns (status, definite, batch_ok):
+      status:   (B,) int32 — correct for lanes where definite is True;
+                provisionally SUCCESS elsewhere.
+      definite: (B,) bool — lanes whose status is final regardless of
+                the batch equation (s-range / pubkey / R-encoding fails).
+      batch_ok: () bool — True iff the combined equation holds, i.e.
+                every non-definite lane is genuinely SUCCESS. On False
+                the caller re-runs the per-lane path.
+    """
+    r_bytes = sigs[:, :32]
+    s_bytes = sigs[:, 32:]
+
+    s_ok = sc.sc_check_range(s_bytes)
+
+    # One decompression pass over A and R stacked: same lane-work, half
+    # the traced graph (the power chain appears once).
+    both, both_ok = ge.decompress(jnp.concatenate([pubkeys, r_bytes], axis=0))
+    bsz = pubkeys.shape[0]
+    a_point = tuple(c[:, :bsz] for c in both)
+    r_point = tuple(c[:, bsz:] for c in both)
+    pub_ok = both_ok[:bsz]
+    r_dec_ok = both_ok[bsz:]
+
+    # R must also be canonical, else definite ERR_MSG (see module
+    # docstring). Canonical <=> y < p and not (x == 0 with sign bit set).
+    r_sign = (r_bytes[:, 31] >> 7) == 1
+    r_y_lt_p = _bytes_lt_p(
+        r_bytes.astype(jnp.int32).at[:, 31].set(r_bytes[:, 31] & 0x7F)
+    )
+    r_x_zero = fe.fe_is_zero(r_point[0])
+    r_ok = r_dec_ok & r_y_lt_p & ~(r_x_zero & r_sign)
+
+    h64 = sha512_batch(
+        jnp.concatenate([r_bytes, pubkeys, msgs], axis=1),
+        msg_lengths.astype(jnp.int32) + 64,
+    )
+    h_bytes = sc.sc_reduce64(h64)
+
+    status = jnp.where(
+        ~s_ok,
+        FD_ED25519_ERR_SIG,
+        jnp.where(
+            ~pub_ok,
+            FD_ED25519_ERR_PUBKEY,
+            jnp.where(~r_ok, FD_ED25519_ERR_MSG, FD_ED25519_SUCCESS),
+        ),
+    ).astype(jnp.int32)
+    definite = ~(s_ok & pub_ok & r_ok)
+
+    # Zero out excluded lanes' weights; z=0 contributes the identity.
+    live = ~definite
+    z_live = jnp.where(live[:, None], z_bytes, 0).astype(jnp.uint8)
+
+    # m = z*h mod L; u = sum z*s mod L.
+    m_bytes = _sc_muladd(z_live, h_bytes, jnp.zeros_like(h_bytes))
+    zs = _sc_muladd(z_live, s_bytes, jnp.zeros_like(s_bytes))
+    u_bytes = sc.sc_sum(zs)
+
+    neg_r = ge.point_neg(r_point)
+    neg_a = ge.point_neg(a_point)
+
+    # Fold the u*B term into the 253-bit MSM as one extra lane (point B,
+    # scalar u) — one engine, no separate fixed-base path.
+    from .sign import _b_point
+
+    b_pt, _ = _b_point(1)
+    m_all = jnp.concatenate([m_bytes, u_bytes], axis=0)
+    pts_all = tuple(
+        jnp.concatenate([c_a, c_b], axis=1)
+        for c_a, c_b in zip(neg_a, b_pt)
+    )
+    from .backend import use_pallas
+
+    # Decompressed points have Z == 1, so the niels fast path applies.
+    msm_impl = msm_mod.msm_fast if use_pallas("FD_MSM_IMPL") else msm_mod.msm
+    t1, ok1 = msm_impl(z_live, neg_r, n_windows=msm_mod.WINDOWS_Z)
+    t2, ok2 = msm_impl(m_all, pts_all, n_windows=msm_mod.WINDOWS_253)
+    # T = u*B + sum z(-R) + sum m(-A); identity <=> X == 0 and Y == Z.
+    t = ge.point_add(t1, t2, need_t=False)
+    batch_ok = (
+        fe.fe_is_zero(t[0]) & fe.fe_eq(t[1], t[2]) & ok1 & ok2
+    )
+    return status, definite, batch_ok
+
+
+class RlcAsyncResult:
+    """Duck-types the slice of the jax.Array surface the verify tile's
+    completion shim uses (`is_ready()`, `np.asarray`) over an RLC pass
+    with lazy per-lane fallback.
+
+    The RLC pass and the fallback both dispatch asynchronously; the
+    fallback is only ever dispatched once the RLC verdict is known to be
+    False, so clean batches cost one pass and dirty batches two — the
+    shim's in-flight accounting and ordering are untouched.
+    """
+
+    def __init__(self, rlc_out, fallback_fn, args):
+        self._status, self._definite, self._ok = rlc_out
+        self._fallback_fn = fallback_fn
+        self._args = args
+        self._fb = None
+        self._resolved = None
+        self.used_fallback = False
+
+    def _start_fallback(self):
+        self._fb = self._fallback_fn(*self._args)
+        self._args = None
+        self.used_fallback = True
+
+    def is_ready(self) -> bool:
+        if self._resolved is not None:
+            return True
+        if self._fb is not None:
+            return self._fb.is_ready()
+        if not self._ok.is_ready():
+            return False
+        if bool(self._ok):
+            self._resolved = np.asarray(self._status)
+            return True
+        self._start_fallback()
+        return self._fb.is_ready()
+
+    def __array__(self, dtype=None, copy=None):
+        if self._resolved is None:
+            if self._fb is None:
+                if bool(self._ok):          # blocks on the RLC pass
+                    self._resolved = np.asarray(self._status)
+                else:
+                    self._start_fallback()
+            if self._resolved is None:
+                self._resolved = np.asarray(self._fb)  # blocks on fallback
+        out = self._resolved
+        return out.astype(dtype) if dtype is not None else out
+
+
+def make_async_verifier(fallback_fn, rng: np.random.Generator | None = None,
+                        rlc_fn=None):
+    """A drop-in for jit(verify_batch) with RLC fast-pass semantics.
+
+    Returns fn(msgs, lens, sigs, pubs) -> RlcAsyncResult. fallback_fn is
+    the compiled per-lane verifier used when the batch equation fails;
+    rlc_fn overrides the jitted RLC pass (e.g. a shared compiled
+    instance in tests). Fresh z weights are drawn per call (never baked
+    into the graph).
+    """
+    import jax
+
+    rng = rng or np.random.default_rng()
+    rlc = rlc_fn if rlc_fn is not None else jax.jit(verify_batch_rlc)
+
+    def fn(msgs, lens, sigs, pubs):
+        z = jnp.asarray(fresh_z(msgs.shape[0], rng))
+        out = rlc(msgs, lens, sigs, pubs, z)
+        return RlcAsyncResult(out, fallback_fn, (msgs, lens, sigs, pubs))
+
+    return fn

@@ -295,6 +295,22 @@ class QuicConn:
         self._rx_data_total = 0
 
         self.rtt = RttEstimator()
+        # Key update state (RFC 9001 §6): per-direction phase bits on the
+        # 1-RTT keys; old rx keys are retained one generation for packets
+        # reordered across the update.
+        self.tx_key_phase = 0
+        self.rx_key_phase = 0
+        self._prev_keys_rx: Optional[PacketKeys] = None
+        self._prev_keys_deadline = 0.0   # drop old read keys after ~3 PTO
+        self._next_keys_rx: Optional[PacketKeys] = None  # precomputed (§6.3)
+        self._rx_phase_start_pn = 0      # first pn of the current rx phase
+        # §6.2 MUST NOT initiate again until a packet sent under the
+        # current-phase keys has been ACKNOWLEDGED (tx==rx is not enough:
+        # a responder flips both at once and could re-roll within the
+        # same round trip, desynchronizing generations).
+        self._ku_pending = False
+        self._ku_min_ack_pn = 0
+        self.stat_key_updates = 0
         self.spaces = [_PnSpace(), _PnSpace(), _PnSpace()]
         if is_server:
             assert orig_dcid is not None
@@ -393,9 +409,40 @@ class QuicConn:
             pn_len, tpn = unprotect_header(space.keys_rx, pkt, rel_pn_off)
             pn = wire.pn_decode(tpn, pn_len, space.largest_rx)
             header = bytes(pkt[: rel_pn_off + pn_len])
-            payload = space.keys_rx.open(
-                header, pn, bytes(pkt[rel_pn_off + pn_len :])
-            )
+            ciphertext = bytes(pkt[rel_pn_off + pn_len:])
+            # Key update (RFC 9001 §6): the Key Phase bit (0x04, header-
+            # protected) selects the key generation for short packets.
+            phase = (pkt[0] >> 2) & 1
+            if level == LEVEL_APP and now > self._prev_keys_deadline:
+                self._prev_keys_rx = None  # §6.5: old read keys expire
+            if level == LEVEL_APP and phase != self.rx_key_phase:
+                # §6.3: pick the candidate generation by packet number —
+                # below the current phase's first pn it can only be a
+                # reordered pre-update packet (old keys); at or above, a
+                # peer-initiated update (precomputed next keys, derived
+                # once per generation, not per packet).
+                if pn < self._rx_phase_start_pn and self._prev_keys_rx:
+                    payload = self._prev_keys_rx.open(header, pn, ciphertext)
+                else:
+                    if self._next_keys_rx is None:
+                        self._next_keys_rx = space.keys_rx.next_generation()
+                    payload = self._next_keys_rx.open(header, pn, ciphertext)
+                    # Install the new generation; respond in kind on the
+                    # tx side unless we already initiated this update.
+                    self._prev_keys_rx = space.keys_rx
+                    self._prev_keys_deadline = now + 3 * self.rtt.pto()
+                    space.keys_rx = self._next_keys_rx
+                    self._next_keys_rx = None
+                    self._rx_phase_start_pn = pn
+                    self.rx_key_phase ^= 1
+                    self.stat_key_updates += 1
+                    if self.tx_key_phase != self.rx_key_phase:
+                        space.keys_tx = space.keys_tx.next_generation()
+                        self.tx_key_phase ^= 1
+                        self._ku_pending = True
+                        self._ku_min_ack_pn = space.next_pn
+            else:
+                payload = space.keys_rx.open(header, pn, ciphertext)
         except QuicCryptoError:
             return  # undecryptable: drop silently (RFC 9001 §9.3)
         if not space.record_rx(pn):
@@ -418,6 +465,9 @@ class QuicConn:
         t = f.ftype
         if t == wire.FRAME_ACK:
             acked = space.on_ack(f)
+            if (level == LEVEL_APP and self._ku_pending
+                    and any(pn >= self._ku_min_ack_pn for pn, _ in acked)):
+                self._ku_pending = False  # current phase confirmed (§6.2)
             # RTT sample ONLY when the frame's largest-acknowledged packet
             # is itself newly acked and ack-eliciting (RFC 9002 §5.1) — a
             # reordered ACK re-listing old ranges must not fold its own
@@ -598,7 +648,9 @@ class QuicConn:
             space.next_pn += 1
             pn_len = 2
             if level == LEVEL_APP:
-                header = wire.encode_short_header(self.dcid, pn, pn_len)
+                header = wire.encode_short_header(
+                    self.dcid, pn, pn_len, key_phase=self.tx_key_phase
+                )
             else:
                 header = wire.encode_long_header(
                     _LEVEL_TO_PKT[level],
@@ -692,6 +744,27 @@ class QuicConn:
         if fired:
             self.rtt.pto_count += 1
         return self.pending_datagrams(now)
+
+    def initiate_key_update(self) -> None:
+        """Roll the 1-RTT send keys one generation (RFC 9001 §6.1); the
+        peer detects the flipped Key Phase bit and responds in kind.
+        Only valid once the handshake is confirmed, and not before the
+        peer has answered the previous update (§6.2 MUST NOT — rolling
+        twice within one round trip returns the phase BIT to its old
+        value while the keys advance two generations, silently killing
+        the connection)."""
+        if not self.established:
+            raise RuntimeError("key update before handshake confirmation")
+        if self.tx_key_phase != self.rx_key_phase or self._ku_pending:
+            raise RuntimeError(
+                "previous key update not yet acknowledged by the peer"
+            )
+        space = self.spaces[LEVEL_APP]
+        space.keys_tx = space.keys_tx.next_generation()
+        self.tx_key_phase ^= 1
+        self._ku_pending = True
+        self._ku_min_ack_pn = space.next_pn
+        self.stat_key_updates += 1
 
     def abort(self, error: int, reason: str) -> None:
         self.closed = True

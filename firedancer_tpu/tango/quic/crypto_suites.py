@@ -44,9 +44,19 @@ class PacketKeys:
         )
 
     def next_generation(self) -> "PacketKeys":
-        """Key update (RFC 9001 §6): new secret via "quic ku"."""
+        """Key update (RFC 9001 §6): new secret via "quic ku".
+
+        The header-protection key is NOT updated (§6.1: "The header
+        protection key is not updated") — only the packet protection
+        key and IV rotate.
+        """
         nxt = hkdf_expand_label(self.secret, b"quic ku", b"", 32)
-        return PacketKeys.from_secret(nxt)
+        return PacketKeys(
+            secret=nxt,
+            key=hkdf_expand_label(nxt, b"quic key", b"", 16),
+            iv=hkdf_expand_label(nxt, b"quic iv", b"", 12),
+            hp=self.hp,
+        )
 
     def _nonce(self, pn: int) -> bytes:
         pad = bytes(len(self.iv) - 8) + struct.pack(">Q", pn)

@@ -165,8 +165,9 @@ def parse_short_header(buf: bytes, dcid_len: int, off: int = 0) -> ShortHeader:
     return ShortHeader(dcid=dcid, hdr_end=p + dcid_len, first_byte=first)
 
 
-def encode_short_header(dcid: bytes, pn: int, pn_len: int) -> bytes:
-    first = 0x40 | (pn_len - 1)
+def encode_short_header(dcid: bytes, pn: int, pn_len: int,
+                        key_phase: int = 0) -> bytes:
+    first = 0x40 | ((key_phase & 1) << 2) | (pn_len - 1)
     return bytes([first]) + dcid + pn.to_bytes(pn_len, "big")[-pn_len:]
 
 

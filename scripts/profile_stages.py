@@ -1,0 +1,113 @@
+"""On-chip stage profile for the verify pipeline + VPU roofline probes.
+
+Times each stage of verify_batch independently at the bench batch size so
+optimization effort lands where the milliseconds are. Run on the real TPU:
+    python scripts/profile_stages.py [batch]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def bench_fn(fn, args, reps=5, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x,
+        out,
+    )
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x,
+        out,
+    )
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    dev = jax.devices()[0]
+    print(f"device={dev} batch={batch}")
+
+    from firedancer_tpu.ops import curve25519 as ge
+    from firedancer_tpu.ops import sc25519 as sc
+    from firedancer_tpu.ops.sha512 import sha512_batch
+
+    rng = np.random.RandomState(0)
+    msgs = jnp.asarray(rng.randint(0, 256, (batch, 256), dtype=np.uint8))
+    lens = jnp.full((batch,), 256, jnp.int32)
+    ybytes = jnp.asarray(rng.randint(0, 256, (batch, 32), dtype=np.uint8))
+    sbytes = jnp.asarray(rng.randint(0, 128, (batch, 32), dtype=np.uint8))
+    limbs = jnp.asarray(rng.randint(0, 256, (32, batch), dtype=np.int32))
+
+    # --- roofline probes -------------------------------------------------
+    n_ops = 64
+    def imul_chain(x):
+        acc = x
+        for _ in range(n_ops):
+            acc = acc * x + x
+        return acc
+
+    def fmul_chain(x):
+        acc = x
+        for _ in range(n_ops):
+            acc = acc * x + x
+        return acc
+
+    xi = jnp.asarray(rng.randint(0, 1 << 10, (32, batch), dtype=np.int32))
+    xf = xi.astype(jnp.float32)
+    t = bench_fn(jax.jit(imul_chain), (xi,))
+    rate = n_ops * 32 * batch / t / 1e12
+    print(f"int32 mul+add chain: {t*1e3:8.3f} ms  {rate:.3f} Tmac/s")
+    t = bench_fn(jax.jit(fmul_chain), (xf,))
+    rate = n_ops * 32 * batch / t / 1e12
+    print(f"f32   mul+add chain: {t*1e3:8.3f} ms  {rate:.3f} Tmac/s")
+
+    # --- field op costs --------------------------------------------------
+    from firedancer_tpu.ops import fe25519 as fe
+
+    def mulchain(a, b):
+        for _ in range(8):
+            a = fe.fe_mul(a, b)
+        return a
+
+    t = bench_fn(jax.jit(mulchain), (limbs, limbs))
+    print(f"fe_mul (XLA) x8:     {t*1e3:8.3f} ms  ({t/8*1e6:.1f} us/mul)")
+
+    # --- stages ----------------------------------------------------------
+    t = bench_fn(jax.jit(sha512_batch), (msgs, lens))
+    print(f"sha512 (256B):       {t*1e3:8.3f} ms")
+
+    t = bench_fn(jax.jit(lambda y: ge.decompress(y)), (ybytes,))
+    print(f"decompress:          {t*1e3:8.3f} ms")
+
+    pt, _ = jax.jit(ge.decompress)(ybytes)
+    pt = tuple(jnp.asarray(c) for c in pt)
+
+    from firedancer_tpu.ops.dsm_pallas import double_scalarmult_pallas
+
+    t = bench_fn(
+        jax.jit(double_scalarmult_pallas), (sbytes, pt, sbytes)
+    )
+    print(f"dsm (pallas):        {t*1e3:8.3f} ms")
+
+    t = bench_fn(jax.jit(ge.compress), (pt,))
+    print(f"compress:            {t*1e3:8.3f} ms")
+
+    t = bench_fn(jax.jit(sc.sc_reduce64),
+                 (jnp.concatenate([sbytes, sbytes], axis=1),))
+    print(f"sc_reduce64:         {t*1e3:8.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""On-chip validation of the round-2 kernel changes, in one process.
+
+Order: cheap compile checks first (fe_sq inside pow/dsm kernels must
+lower through Mosaic), then msm kernels vs the XLA reference, then a
+timed RLC verify at bench size. Run on the real TPU:
+    python -u scripts/tpu_validate.py [batch]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    dev = jax.devices()[0]
+    print(f"device={dev}", flush=True)
+
+    from firedancer_tpu.ops import fe25519 as fe
+
+    rng = np.random.RandomState(0)
+
+    # 1. pow kernels (fe_sq heavy) vs python pow.
+    from firedancer_tpu.ops.pow_pallas import (
+        fe_invert_pallas,
+        fe_pow22523_pallas,
+    )
+
+    vals = [rng.randint(1, 2**62) for _ in range(256)]
+    z = jnp.stack([fe.int_to_limbs(v) for v in vals], axis=1).reshape(32, 256)
+    t0 = time.time()
+    inv = fe_invert_pallas(z)
+    got = fe.limbs_to_int(inv)
+    assert got == [pow(v, fe.P - 2, fe.P) for v in vals]
+    p22 = fe_pow22523_pallas(z)
+    got = fe.limbs_to_int(p22)
+    assert got == [pow(v, (fe.P - 5) // 8, fe.P) for v in vals]
+    print(f"1. pow kernels with fe_sq: OK ({time.time()-t0:.1f}s)", flush=True)
+
+    # 2. dsm kernel (fe_sq in point_double) vs oracle, small batch.
+    from firedancer_tpu.ballet.ed25519 import oracle
+    from firedancer_tpu.ops import curve25519 as ge
+    from firedancer_tpu.ops.dsm_pallas import double_scalarmult_pallas
+
+    B = 128
+    pubs = []
+    for i in range(B):
+        _, _, pub = oracle.keypair_from_seed(bytes([i % 250 + 1, 7]) + bytes(30))
+        pubs.append(np.frombuffer(pub, np.uint8))
+    pubs = jnp.asarray(np.stack(pubs))
+    hb = jnp.asarray(rng.randint(0, 256, (B, 32), dtype=np.uint8))
+    sb = jnp.asarray(rng.randint(0, 128, (B, 32), dtype=np.uint8))
+    a_pt, ok = ge.decompress(pubs)
+    assert bool(jnp.all(ok))
+    t0 = time.time()
+    r = double_scalarmult_pallas(hb, a_pt, sb)
+    enc = np.asarray(ge.compress(r))
+    for i in (0, 1, B - 1):
+        h = int.from_bytes(bytes(np.asarray(hb[i])), "little")
+        s = int.from_bytes(bytes(np.asarray(sb[i])), "little")
+        A = oracle.point_decompress(bytes(np.asarray(pubs[i])))
+        want = oracle.point_add(oracle.scalarmult(h, A),
+                                oracle.scalarmult(s, oracle.B))
+        assert bytes(enc[i]) == oracle.point_compress(want), i
+    print(f"2. dsm kernel with fe_sq: OK ({time.time()-t0:.1f}s)", flush=True)
+
+    # 3. msm kernels vs XLA msm.
+    from firedancer_tpu.ops import msm as msm_mod
+
+    n = 512
+    scal = np.zeros((n, 32), np.uint8)
+    scal[:, :31] = rng.randint(0, 256, (n, 31), dtype=np.uint8)
+    scal[:, 31] = rng.randint(0, 16, n, dtype=np.uint8)
+    pts, ok = ge.decompress(jnp.asarray(
+        np.stack([pubs[i % B] for i in range(n)])))
+    t0 = time.time()
+    fast, okf = msm_mod.msm_fast(jnp.asarray(scal), pts,
+                                 n_windows=msm_mod.WINDOWS_253)
+    ref, okr = msm_mod.msm(jnp.asarray(scal), pts,
+                           n_windows=msm_mod.WINDOWS_253)
+    assert bool(okf) and bool(okr)
+    ef = np.asarray(ge.compress(fast))[0]
+    er = np.asarray(ge.compress(ref))[0]
+    assert bytes(ef) == bytes(er)
+    print(f"3. msm kernels vs XLA: OK ({time.time()-t0:.1f}s)", flush=True)
+
+    # 4. timed RLC verify at bench size vs direct path.
+    from firedancer_tpu.ops.verify import verify_batch
+    from firedancer_tpu.ops.verify_rlc import make_async_verifier
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench as bench_mod
+
+    msgs, lens, sigs, pk = bench_mod._gen_inputs(batch, 192, "")
+    args = tuple(jnp.asarray(a) for a in (msgs, lens, sigs, pk))
+    direct = jax.jit(verify_batch)
+    fn = make_async_verifier(direct)
+    t0 = time.time()
+    out = fn(*args)
+    st = np.asarray(out)
+    print(f"4. rlc compile+first: {time.time()-t0:.1f}s fallback={out.used_fallback}",
+          flush=True)
+    assert (st == 0).all() and not out.used_fallback
+    t0 = time.time()
+    reps = 5
+    outs = [fn(*args) for _ in range(reps)]
+    finals = [np.asarray(o) for o in outs]
+    dt = time.time() - t0
+    assert all((f == 0).all() for f in finals)
+    assert not any(o.used_fallback for o in outs)
+    print(f"4. rlc verify: {batch*reps/dt:.0f} verifies/s "
+          f"({1e3*dt/reps:.1f} ms/batch)", flush=True)
+
+    # 5. direct path timing for comparison (fe_sq + batch-invert gains).
+    t0 = time.time()
+    out = direct(*args)
+    out.block_until_ready()
+    print(f"5. direct compile+first: {time.time()-t0:.1f}s", flush=True)
+    t0 = time.time()
+    for _ in range(reps):
+        out = direct(*args)
+    out.block_until_ready()
+    dt = time.time() - t0
+    print(f"5. direct verify: {batch*reps/dt:.0f} verifies/s "
+          f"({1e3*dt/reps:.1f} ms/batch)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -376,3 +376,53 @@ def test_packet_threshold_fast_retransmit():
         server.service(now)
     assert state["dropped"] == 1
     assert {d for _, d in received} >= set(later) | {lost}
+
+
+def test_key_update():
+    """RFC 9001 §6: initiator rolls send keys + Key Phase bit; the peer
+    detects the flip, installs the next generation both ways, and data
+    keeps flowing in both directions (and again after a second update).
+    Header-protection keys never rotate."""
+    received = []
+    client, server, c2s, s2c = _mk_pair(received)
+    conn = client.connect(("srv", 1), 0.0)
+    now = _pump(client, server, conn, c2s, s2c, 0.0, steps=8)
+    assert conn.established
+    sconn = server.conns[0]
+
+    from firedancer_tpu.tango.quic.conn import LEVEL_APP
+
+    hp_before = conn.spaces[LEVEL_APP].keys_tx.hp
+    key_before = conn.spaces[LEVEL_APP].keys_tx.key
+
+    p1 = os.urandom(64)
+    conn.send_stream(p1)
+    _pump(client, server, conn, c2s, s2c, now, steps=4)
+    assert any(d == p1 for _, d in received)
+
+    conn.initiate_key_update()
+    assert conn.tx_key_phase == 1
+    assert conn.spaces[LEVEL_APP].keys_tx.key != key_before
+    assert conn.spaces[LEVEL_APP].keys_tx.hp == hp_before  # hp is stable
+    # §6.2: a second update before the peer answers MUST be refused —
+    # it would silently desynchronize the key generations.
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError):
+        conn.initiate_key_update()
+
+    p2 = os.urandom(64)
+    conn.send_stream(p2)
+    now = _pump(client, server, conn, c2s, s2c, now, steps=6)
+    assert any(d == p2 for _, d in received)
+    # Server detected the flip and answered in the new phase.
+    assert sconn.rx_key_phase == 1 and sconn.tx_key_phase == 1
+    assert sconn.stat_key_updates >= 1
+    # Client keeps receiving the server's new-phase packets (acks flowed),
+    # and a second update also survives.
+    conn.initiate_key_update()
+    p3 = os.urandom(64)
+    conn.send_stream(p3)
+    now = _pump(client, server, conn, c2s, s2c, now, steps=6)
+    assert any(d == p3 for _, d in received)
+    assert sconn.rx_key_phase == 0 and conn.tx_key_phase == 0
