@@ -1,0 +1,145 @@
+"""Multi-host distributed backend: jax.distributed over DCN + ICI.
+
+The reference's cross-host story is sockets/NCCL-style point-to-point
+wiring managed by the application. The TPU-native equivalent is the JAX
+distributed runtime: every host process calls :func:`init_multihost`,
+after which `jax.devices()` enumerates the GLOBAL device set and a
+single `Mesh` spans all hosts — XLA then routes collectives over ICI
+within a slice and DCN (gloo/GRPC on CPU, TPU fabric on pods) across
+hosts. No explicit send/recv is written anywhere in this framework; the
+sharding specs ARE the communication plan.
+
+Mesh convention: axis 0 = 'host' (size = number of processes, DCN),
+axis 1 = 'dp' (devices per host, ICI). The verify step reduces its diag
+counters over BOTH axes, so the cross-host traffic is three scalars per
+step — the batch data itself never crosses hosts (each host feeds its
+local shard from its own ingest tiles, matching the reference's
+host-local tango rings).
+
+Tested with real multi-process CPU meshes (2 processes x 4 virtual
+devices, gloo collectives) in tests/test_multihost.py.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def init_multihost(
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    local_device_count: Optional[int] = None,
+    platform: Optional[str] = None,
+) -> None:
+    """Join this process to the distributed runtime.
+
+    Must run before any JAX backend initializes. coordinator is
+    "host:port" of process 0. local_device_count forces a virtual CPU
+    device count (testing / CPU fleets); leave None on real TPU hosts.
+    """
+    if local_device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{local_device_count}"
+            ).strip()
+    import jax
+
+    if platform is not None:
+        os.environ["JAX_PLATFORMS"] = platform
+        try:
+            jax.config.update("jax_platforms", platform)
+        except Exception:
+            pass
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(axis_names=("host", "dp")):
+    """A (num_hosts, devices_per_host) mesh over the global device set.
+
+    Device order: jax.devices() sorted by (process_index, id) so row i
+    is exactly host i's local devices — the 'host' axis is the DCN axis,
+    'dp' stays on-host (ICI on real hardware).
+    """
+    import jax
+
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    n_hosts = jax.process_count()
+    per_host = len(devs) // n_hosts
+    from jax.sharding import Mesh
+
+    return Mesh(
+        np.asarray(devs).reshape(n_hosts, per_host), axis_names
+    )
+
+
+def verify_step_multihost(mesh):
+    """The sharded verify step over a (host, dp) mesh: batch lanes are
+    data-parallel across BOTH axes; diag counters psum over both (the
+    only cross-host traffic)."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.verify import verify_batch
+
+    axes = mesh.axis_names
+
+    def step(msgs, lens, sigs, pubs):
+        statuses = verify_batch(msgs, lens, sigs, pubs)
+        ok = (statuses == 0).astype(jnp.int32)
+        diag = {
+            "pub_cnt": jax.lax.psum(jnp.sum(ok), axes),
+            "filt_cnt": jax.lax.psum(jnp.sum(1 - ok), axes),
+            "pub_sz": jax.lax.psum(jnp.sum(ok * lens), axes),
+        }
+        return statuses, diag
+
+    spec = P(axes)  # batch axis sharded over host x dp jointly
+    sharded = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=(spec, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def host_local_batch(global_batch_fn, mesh):
+    """Helper for feeding a multihost step: each host materializes ONLY
+    its row of the global batch (jax.make_array_from_process_local_data)
+    so batch bytes never cross DCN.
+
+    global_batch_fn(host_index, per_host_lanes) -> tuple of numpy arrays
+    (msgs, lens, sigs, pubs) for this host's lanes.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def build(per_host_lanes):
+        arrs = global_batch_fn(jax.process_index(), per_host_lanes)
+        spec = P(mesh.axis_names)
+        out = []
+        for a in arrs:
+            global_shape = (per_host_lanes * jax.process_count(),) + a.shape[1:]
+            out.append(jax.make_array_from_process_local_data(
+                NamedSharding(mesh, spec), a, global_shape
+            ))
+        return tuple(out)
+
+    return build

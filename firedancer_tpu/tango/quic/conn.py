@@ -311,6 +311,18 @@ class QuicConn:
         self._ku_pending = False
         self._ku_min_ack_pn = 0
         self.stat_key_updates = 0
+        # Path migration (RFC 9000 §9): a new source address is adopted
+        # only after a PATH_CHALLENGE round trip to it succeeds. One
+        # probe at a time; amplification limits are not modeled (the
+        # probe packet is tiny).
+        self._probe_addr = None
+        self._probe_data: Optional[bytes] = None
+        self._probe_expire = 0.0
+        self._probe_next_tx = 0.0
+        self._path_frames: List[bytes] = []   # queued PATH_RESPONSEs
+        self._last_rx_addr = None
+        self._highest_rx_pn = -1   # §9.3: migrate on newest packet only
+        self.stat_migrations = 0
         self.spaces = [_PnSpace(), _PnSpace(), _PnSpace()]
         if is_server:
             assert orig_dcid is not None
@@ -359,8 +371,10 @@ class QuicConn:
 
     # ---------------------------------------------------------------- rx ---
 
-    def recv_datagram(self, data: bytes, now: float) -> None:
+    def recv_datagram(self, data: bytes, now: float, from_addr=None) -> None:
         self._last_activity = now
+        if from_addr is not None:
+            self._last_rx_addr = from_addr
         off = 0
         while off < len(data) and not self.closed:
             first = data[off]
@@ -447,6 +461,15 @@ class QuicConn:
             return  # undecryptable: drop silently (RFC 9001 §9.3)
         if not space.record_rx(pn):
             return  # duplicate
+        if level == LEVEL_APP and pn > self._highest_rx_pn:
+            self._highest_rx_pn = pn
+            # Authenticated, newest packet from a non-current address:
+            # start path validation (RFC 9000 §9.3 — spoofed packets die
+            # at the AEAD above; reordered old-path packets have lower
+            # pn and must not clobber an in-flight probe).
+            if (self.established and self._last_rx_addr is not None
+                    and self._last_rx_addr != self.peer_addr):
+                self.on_peer_address_change(self._last_rx_addr, now)
         try:
             frames = wire.parse_frames(payload)
         except wire.QuicWireError:
@@ -492,6 +515,21 @@ class QuicConn:
             if not self.is_server:
                 self.established = True
                 self.spaces[LEVEL_HANDSHAKE].drop_keys()
+        elif t == wire.FRAME_PATH_CHALLENGE:
+            # Echo on the active path (RFC 9000 §8.3; single-socket model
+            # approximates "same path" by replying to the current peer).
+            self._path_frames.append(wire.encode_path_frame(
+                wire.FRAME_PATH_RESPONSE,
+                f.fields["data8"].to_bytes(8, "big"),
+            ))
+        elif t == wire.FRAME_PATH_RESPONSE:
+            data = f.fields["data8"].to_bytes(8, "big")
+            if (self._probe_data is not None and data == self._probe_data
+                    and self._last_rx_addr == self._probe_addr):
+                # Path validated: adopt the new address (§9.3).
+                self.peer_addr = self._probe_addr
+                self._probe_addr = self._probe_data = None
+                self.stat_migrations += 1
         elif t in (wire.FRAME_CONN_CLOSE_QUIC, wire.FRAME_CONN_CLOSE_APP):
             self.closed = True
             self.close_reason = f.data.decode("utf-8", "replace")
@@ -621,6 +659,10 @@ class QuicConn:
                     sent.handshake_done = True
                     sent.ack_eliciting = True
                     self._hs_done_pending = False
+                while self._path_frames and budget > 16:
+                    frames.append(self._path_frames.pop(0))
+                    sent.ack_eliciting = True
+                    budget -= 9
                 while self._send_queue and budget > 32:
                     sid, off, data, fin = self._send_queue.pop(0)
                     room = budget - 16
@@ -744,6 +786,42 @@ class QuicConn:
         if fired:
             self.rtt.pto_count += 1
         return self.pending_datagrams(now)
+
+    def on_peer_address_change(self, addr, now: float) -> None:
+        """A post-handshake datagram arrived from an unvalidated address:
+        start (or continue) a PATH_CHALLENGE probe of it. The connection
+        keeps sending to the validated address until the probe round
+        trip completes (RFC 9000 §9.1)."""
+        if addr == self._probe_addr and now < self._probe_expire:
+            return
+        self._probe_addr = addr
+        self._probe_data = os.urandom(8)
+        self._probe_expire = now + 3 * max(self.rtt.pto(), 0.1)
+        self._probe_next_tx = now
+
+    def path_probe_datagrams(self, now: float) -> List[tuple]:
+        """[(addr, datagram)] of PATH_CHALLENGE probes due now; resent
+        once per PTO until the probe validates or expires."""
+        if (self.closed or self._probe_data is None
+                or self.spaces[LEVEL_APP].keys_tx is None):
+            return []
+        if now >= self._probe_expire:
+            self._probe_addr = self._probe_data = None
+            return []
+        if now < self._probe_next_tx:
+            return []
+        self._probe_next_tx = now + max(self.rtt.pto(), 0.05)
+        space = self.spaces[LEVEL_APP]
+        payload = wire.encode_path_frame(
+            wire.FRAME_PATH_CHALLENGE, self._probe_data
+        )
+        pn = space.next_pn
+        space.next_pn += 1
+        header = wire.encode_short_header(
+            self.dcid, pn, 2, key_phase=self.tx_key_phase
+        )
+        return [(self._probe_addr,
+                 protect_packet(space.keys_tx, header, pn, 2, payload))]
 
     def initiate_key_update(self) -> None:
         """Roll the 1-RTT send keys one generation (RFC 9001 §6.1); the

@@ -114,8 +114,15 @@ class Quic:
             self._conns_by_cid[hdr.dcid] = conn  # route follow-up initials
             if self._on_conn_new is not None:
                 self._on_conn_new(conn)
-        conn.peer_addr = peer_addr
-        conn.recv_datagram(datagram, now)
+        if not conn.established:
+            conn.peer_addr = peer_addr   # pre-handshake address learning
+        # Post-handshake address changes are detected INSIDE
+        # recv_datagram, after AEAD authentication succeeds and only for
+        # the highest-numbered packet (RFC 9000 §9.3) — a spoofed or
+        # reordered datagram must not be able to start or clobber a path
+        # probe. Traffic keeps flowing to the validated address until
+        # the PATH_CHALLENGE round trip completes.
+        conn.recv_datagram(datagram, now, from_addr=peer_addr)
         self._flush(conn, now)
 
     def _route(self, datagram: bytes) -> Optional[QuicConn]:
@@ -136,6 +143,9 @@ class Quic:
         for conn in list(self.conns):
             for dg in conn.service(now):
                 self._tx(conn.peer_addr, dg)
+                self.metrics["tx_datagrams"] += 1
+            for addr, dg in conn.path_probe_datagrams(now):
+                self._tx(addr, dg)
                 self.metrics["tx_datagrams"] += 1
             if conn.closed:
                 self._unregister(conn)
@@ -168,6 +178,9 @@ class Quic:
     def _flush(self, conn: QuicConn, now: float) -> None:
         for dg in conn.pending_datagrams(now):
             self._tx(conn.peer_addr, dg)
+            self.metrics["tx_datagrams"] += 1
+        for addr, dg in conn.path_probe_datagrams(now):
+            self._tx(addr, dg)
             self.metrics["tx_datagrams"] += 1
         if conn.closed:
             self._unregister(conn)

@@ -345,6 +345,14 @@ def parse_frames(buf: bytes) -> List[Frame]:
     return frames
 
 
+def encode_path_frame(ftype: int, data8: bytes) -> bytes:
+    """PATH_CHALLENGE / PATH_RESPONSE: type + 8 opaque bytes (RFC 9000
+    §19.17-18)."""
+    assert ftype in (FRAME_PATH_CHALLENGE, FRAME_PATH_RESPONSE)
+    assert len(data8) == 8
+    return bytes([ftype]) + data8
+
+
 def encode_ack(
     largest: int,
     ack_delay: int,

@@ -426,3 +426,75 @@ def test_key_update():
     now = _pump(client, server, conn, c2s, s2c, now, steps=6)
     assert any(d == p3 for _, d in received)
     assert sconn.rx_key_phase == 0 and conn.tx_key_phase == 0
+
+
+def test_connection_migration():
+    """RFC 9000 §9: when the client's source address changes after the
+    handshake, the server probes the new path with PATH_CHALLENGE and
+    only adopts it once the response round trip succeeds; data keeps
+    flowing throughout. An address change with no valid responder (a
+    spoofed source) must NOT redirect the connection."""
+    received = []
+    c2s, s2c = [], []
+    client_addr = ["cli-A"]  # mutable: models a NAT rebind mid-flight
+
+    def tx_c(a, d):
+        c2s.append((client_addr[0], d))
+
+    server_tx = []
+
+    def tx_s(a, d):
+        server_tx.append((a, d))
+        # deliver only what is addressed to the client's CURRENT address
+        if a == client_addr[0]:
+            s2c.append(d)
+
+    client = Quic(
+        QuicConfig(is_server=False, identity_seed=os.urandom(32)), tx=tx_c
+    )
+    server = Quic(
+        QuicConfig(is_server=True, identity_seed=os.urandom(32)),
+        tx=tx_s,
+        on_stream=lambda conn, sid, data: received.append((sid, data)),
+    )
+
+    def pump(now, steps=8, step=0.01):
+        for _ in range(steps):
+            now += step
+            while c2s:
+                a, d = c2s.pop(0)
+                server.rx(a, d, now)
+            while s2c:
+                client.rx(("srv", 1), s2c.pop(0), now)
+            client.service(now)
+            server.service(now)
+        return now
+
+    conn = client.connect(("srv", 1), 0.0)
+    now = pump(0.0)
+    assert conn.established
+    sconn = server.conns[0]
+    assert sconn.peer_addr == "cli-A"
+
+    # NAT rebind: same connection, new source address.
+    client_addr[0] = "cli-B"
+    p = os.urandom(40)
+    conn.send_stream(p)
+    client.service(now)
+    now = pump(now, steps=10)
+    assert any(d == p for _, d in received)
+    # The server probed cli-B and migrated only after validation.
+    assert sconn.stat_migrations == 1
+    assert sconn.peer_addr == "cli-B"
+    assert any(a == "cli-B" for a, _ in server_tx)
+
+    # Spoof attempt: traffic claiming to come from an address that never
+    # answers the challenge must not move the connection.
+    p2 = os.urandom(40)
+    conn.send_stream(p2)
+    client.service(now)
+    while c2s:
+        a, d = c2s.pop(0)
+        server.rx("evil", d, now)  # replayed from a spoofed source
+    now = pump(now, steps=10)
+    assert sconn.peer_addr == "cli-B"  # probe to "evil" never validated
