@@ -337,7 +337,8 @@ def worker(cpu: bool) -> int:
     return 0
 
 
-def _run_worker(cpu: bool, timeout_s: float, mode: str | None = None) -> dict | None:
+def _run_worker(cpu: bool, timeout_s: float, mode: str | None = None,
+                extra_env: dict | None = None) -> dict | None:
     """Spawn a worker subprocess; return its parsed JSON line or None."""
     cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
     if cpu:
@@ -345,6 +346,8 @@ def _run_worker(cpu: bool, timeout_s: float, mode: str | None = None) -> dict | 
     env = dict(os.environ)
     if mode is not None:
         env["FD_BENCH_VERIFY"] = mode
+    if extra_env:
+        env.update(extra_env)
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout_s,
@@ -414,34 +417,41 @@ def main() -> int:
     # Mode ladder: the RLC batch-verify fast path is the headline number;
     # if it fails (wedged tunnel, fallback tripped, compile trouble) the
     # direct per-lane path still lands a real TPU measurement.
-    modes = ["rlc", "direct"]
+    # (mode, extra_env): the last entry is the compat rung — kernels with
+    # the specialized squaring swapped back to plain multiplies, in case
+    # a Mosaic version rejects fe_sq's construction on this machine.
+    modes = [("rlc", None), ("direct", None),
+             ("direct", {"FD_SQ_IMPL": "mul", "FD_MSM_IMPL": "xla"})]
     forced = os.environ.get("FD_BENCH_VERIFY")
     if forced:
-        if forced not in modes:
+        if forced not in ("rlc", "direct"):
             print(json.dumps({
                 "metric": "ed25519_verify_throughput", "value": 0,
                 "unit": "verifies/s", "vs_baseline": 0.0,
                 "error": f"unknown FD_BENCH_VERIFY mode {forced!r}",
             }))
             return 1
-        modes = [forced]
+        modes = [(forced, None)]
     # One shared wall-clock budget across the whole mode ladder so adding
     # modes cannot push the (always-succeeds) CPU fallback past the
     # driver's patience when the tunnel is wedged.
     tpu_budget = float(os.environ.get("FD_BENCH_TPU_BUDGET", "1100"))
     t_start = time.monotonic()
     for i in range(attempts):
-        for mode in modes:
+        for mode, extra in modes:
             left = tpu_budget - (time.monotonic() - t_start)
             if left < 60.0:
                 errors.append("tpu budget exhausted")
                 break
             rec = _run_worker(cpu=False, timeout_s=min(attempt_timeout, left),
-                              mode=mode)
+                              mode=mode, extra_env=extra)
             if rec is not None:
+                if extra:
+                    rec["compat_env"] = extra
                 print(json.dumps(rec))
                 return 0
-            errors.append(f"tpu attempt {i + 1} ({mode}) failed/timed out")
+            errors.append(f"tpu attempt {i + 1} ({mode}"
+                          + (" compat" if extra else "") + ") failed/timed out")
         else:
             if i + 1 < attempts:
                 time.sleep(15.0)

@@ -24,6 +24,8 @@ DEFAULTS: Dict[str, Any] = {
         # tile counts (default.toml [layout]); verify lanes are the vmap
         # batch axis on TPU rather than N processes, but the knob remains
         "verify_tile_count": 1,
+        "tile_cpus": [],       # core pins, topology order (fd_tile
+                               # affinity analog); [] = unpinned
         "depth": 128,          # mcache depth per link
         "mtu": 1232,           # FD_TPU_MTU
         "wksp_sz": 1 << 24,

@@ -93,6 +93,7 @@ def cmd_run(cfg: Dict[str, Any], args) -> int:
         timeout_s=cfg["development"]["timeout_s"],
         tcache_depth=tiles_cfg["verify"]["tcache_depth"],
         verify_opts={"verify_mode": tiles_cfg["verify"]["mode"]},
+        tile_cpus=[int(c) for c in cfg["layout"]["tile_cpus"]] or None,
     )
     # filters are counted per verify lane (tile.verify, tile.verify.v1...)
     sv_filt = sum(d.get("sv_filt_cnt", 0) for name, d in res.diag.items()

@@ -157,6 +157,7 @@ def _run_tiles(
     verify_opts: Optional[dict] = None,
     record_digests: bool = False,
     pack_scheduler: str = "greedy",
+    tile_cpus: Optional[List[int]] = None,
 ) -> PipelineResult:
     """Shared runner: wire source -> verify -> dedup -> pack -> sink, drive
     the tiles on threads until quiescence or timeout, HALT, snapshot.
@@ -208,6 +209,11 @@ def _run_tiles(
         record_digests=record_digests,
     )
     tiles = [source, *verifies, dedup, pack, sink]
+    # Core pinning (reference layout.affinity, fd_tile.h:13): assign the
+    # configured CPU list to tiles in topology order, wrapping if short.
+    if tile_cpus:
+        for i, t in enumerate(tiles):
+            t.cpu_idx = tile_cpus[i % len(tile_cpus)]
 
     # Tiles run until HALT; max_ns is a hung-pipeline safety net and must
     # outlast the supervisor's own timeout or slow runs silently truncate.
@@ -301,6 +307,7 @@ def run_pipeline(
     verify_opts: Optional[dict] = None,
     record_digests: bool = False,
     pack_scheduler: str = "greedy",
+    tile_cpus: Optional[List[int]] = None,
 ) -> PipelineResult:
     """Replay-sourced pipeline: payload list -> verify -> dedup -> pack -> sink.
 
@@ -320,6 +327,7 @@ def run_pipeline(
         verify_backend, verify_batch, verify_max_msg_len, bank_cnt, timeout_s,
         tcache_depth=tcache_depth, verify_opts=verify_opts,
         record_digests=record_digests, pack_scheduler=pack_scheduler,
+        tile_cpus=tile_cpus,
     )
 
 
@@ -333,6 +341,7 @@ def run_quic_pipeline(
     verify_max_msg_len: Optional[int] = None,
     bank_cnt: int = 4,
     timeout_s: float = 60.0,
+    tile_cpus: Optional[List[int]] = None,
 ) -> PipelineResult:
     """Full ingest path: QUIC server tile -> verify -> dedup -> pack -> sink.
 
@@ -363,5 +372,5 @@ def run_quic_pipeline(
     return _run_tiles(
         wksp, pod, quic, quic.done,
         verify_backend, verify_batch, verify_max_msg_len, bank_cnt, timeout_s,
-        pre_wait=pre_wait,
+        pre_wait=pre_wait, tile_cpus=tile_cpus,
     )

@@ -19,6 +19,7 @@ tile parses in-tile exactly like the reference quic tile does
 
 from __future__ import annotations
 
+import os
 import time
 from hashlib import sha256 as _sha256
 from dataclasses import dataclass, field
@@ -198,6 +199,12 @@ class Tile:
         self._async_min = tempo.async_min(lazy)
         self._last_in_backp = 0
         self.halted = False
+        # Optional core pin (fd_tile's dedicated-core model, fd_tile.h:13;
+        # set by the pipeline from the layout.tile_cpus config). Python
+        # threads share the GIL, but pinning still removes migration
+        # jitter from the hot poll loops and matches the reference's
+        # affinity contract for the native drain path.
+        self.cpu_idx: Optional[int] = None
 
     # -- overridables ----------------------------------------------------
 
@@ -252,6 +259,15 @@ class Tile:
 
     def run(self, max_ns: int = 30_000_000_000) -> None:
         """Run until HALT signal, done(), or max_ns wall time."""
+        if self.cpu_idx is not None and hasattr(os, "sched_setaffinity"):
+            # NB Linux inherits the affinity mask into threads created
+            # FROM this thread — lazily-spawned pools (XLA's intra-op
+            # pool, etc.) must already exist. VerifyTile guarantees this
+            # by pre-warming its jit on the constructing (main) thread.
+            try:
+                os.sched_setaffinity(0, {self.cpu_idx})  # calling thread
+            except OSError:
+                pass  # affinity is best-effort (cpuset may forbid it)
         try:
             self._run_loop(max_ns)
         finally:

@@ -16,6 +16,14 @@ import jax
 TPU_PLATFORMS = ("tpu", "axon")
 
 
+def use_specialized_square() -> bool:
+    """FD_SQ_IMPL=mul swaps the specialized fe_sq inside Pallas kernels
+    for a plain multiply — the escape hatch the bench ladder retries
+    with if a Mosaic version rejects fe_sq's slice/concat construction.
+    Centralized here so dsm_pallas and pow_pallas cannot drift."""
+    return os.environ.get("FD_SQ_IMPL", "sq") != "mul"
+
+
 def use_pallas(env_var: str) -> bool:
     """Decide at trace time whether to use the Pallas implementation."""
     impl = os.environ.get(env_var, "auto")

@@ -34,6 +34,16 @@ def _fe_mul(a, b):
     return fe.fe_mul_unrolled(a, b)
 
 
+def _fe_sq(a):
+    """Kernel squaring: specialized fe_sq, or plain multiply under the
+    FD_SQ_IMPL=mul escape hatch (see backend.use_specialized_square)."""
+    from .backend import use_specialized_square
+
+    if use_specialized_square():
+        return fe.fe_sq(a)
+    return fe.fe_mul_unrolled(a, a)
+
+
 def _point_add(p, q, d2, need_t=True):
     """d2 = limbs of 2*d mod p, (NLIMBS, 1) — passed as a kernel input
     (Pallas rejects kernels that close over constant arrays)."""
@@ -54,12 +64,12 @@ def _point_add(p, q, d2, need_t=True):
 
 def _point_double(p, need_t=True):
     x1, y1, z1, _ = p
-    a = fe.fe_sq(x1)
-    b = fe.fe_sq(y1)
-    zz = fe.fe_sq(z1)
+    a = _fe_sq(x1)
+    b = _fe_sq(y1)
+    zz = _fe_sq(z1)
     c = fe.fe_add(zz, zz)
     d_ = fe.fe_neg(a)
-    e = fe.fe_sub(fe.fe_sub(fe.fe_sq(fe.fe_add(x1, y1)), a), b)
+    e = fe.fe_sub(fe.fe_sub(_fe_sq(fe.fe_add(x1, y1)), a), b)
     g = fe.fe_add(d_, b)
     f = fe.fe_sub(g, c)
     h = fe.fe_sub(d_, b)

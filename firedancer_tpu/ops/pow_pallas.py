@@ -34,18 +34,28 @@ def _mul(a, b):
     return fe.fe_mul_unrolled(a, b)
 
 
+def _sq(x):
+    """Kernel squaring with the FD_SQ_IMPL=mul escape hatch (see
+    backend.use_specialized_square)."""
+    from .backend import use_specialized_square
+
+    if use_specialized_square():
+        return fe.fe_sq(x)
+    return _mul(x, x)
+
+
 def _sqn(x, n):
     for _ in range(n):
-        x = fe.fe_sq(x)
+        x = _sq(x)
     return x
 
 
 def _ladder(z):
     """(z^(2^250 - 1), z^11) per fe25519._pow_ladder."""
-    z2 = fe.fe_sq(z)
+    z2 = _sq(z)
     z9 = _mul(_sqn(z2, 2), z)
     z11 = _mul(z9, z2)
-    z_5_0 = _mul(fe.fe_sq(z11), z9)
+    z_5_0 = _mul(_sq(z11), z9)
     z_10_0 = _mul(_sqn(z_5_0, 5), z_5_0)
     z_20_0 = _mul(_sqn(z_10_0, 10), z_10_0)
     z_40_0 = _mul(_sqn(z_20_0, 20), z_20_0)

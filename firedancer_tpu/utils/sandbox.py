@@ -25,6 +25,7 @@ from __future__ import annotations
 import ctypes
 import os
 import resource
+import struct
 from typing import Dict, Iterable, List, Optional
 
 _KEEP_ENV = ("PATH", "HOME", "LANG", "TZ", "PYTHONPATH", "JAX_PLATFORMS",
@@ -99,6 +100,112 @@ def no_new_privs() -> bool:
         return libc.prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) == 0
     except OSError:
         return False
+
+
+# --------------------------------------------------------------- seccomp --
+#
+# Classic-BPF seccomp filter, built and installed from Python via prctl —
+# the analog of the reference's generated policies
+# (src/app/fdctl/run/tiles/generated/*_seccomp.h): arch check, then a
+# syscall-number allowlist, then a configurable default action.
+
+_BPF_LD_W_ABS = 0x20
+_BPF_JEQ_K = 0x15
+_BPF_RET_K = 0x06
+_AUDIT_ARCH_X86_64 = 0xC000003E
+_AUDIT_ARCH_AARCH64 = 0xC00000B7
+SECCOMP_RET_ALLOW = 0x7FFF0000
+SECCOMP_RET_KILL_PROCESS = 0x80000000
+_PR_SET_SECCOMP = 22
+_SECCOMP_MODE_FILTER = 2
+
+# x86_64 syscall numbers for the names tile policies use (unistd_64.h
+# values — public ABI constants). Includes everything modern
+# glibc/CPython issue unconditionally (newfstatat/pread64/rseq/clone3
+# etc.), so "all of SYSCALLS_X86_64 minus X" is a usable base policy.
+SYSCALLS_X86_64 = {
+    "read": 0, "write": 1, "open": 2, "close": 3, "stat": 4, "fstat": 5,
+    "lstat": 6, "poll": 7, "lseek": 8,
+    "mmap": 9, "mprotect": 10, "munmap": 11, "brk": 12,
+    "rt_sigaction": 13, "rt_sigprocmask": 14, "rt_sigreturn": 15,
+    "ioctl": 16, "pread64": 17, "pwrite64": 18, "readv": 19,
+    "writev": 20, "access": 21, "select": 23, "sched_yield": 24,
+    "madvise": 28, "dup": 32, "getpid": 39,
+    "socket": 41, "sendto": 44, "recvfrom": 45, "sendmsg": 46,
+    "recvmsg": 47, "bind": 49, "clone": 56, "exit": 60, "uname": 63,
+    "fcntl": 72, "getcwd": 79, "sigaltstack": 131, "prctl": 157,
+    "gettid": 186, "futex": 202, "getdents64": 217,
+    "set_tid_address": 218, "clock_gettime": 228,
+    "clock_nanosleep": 230, "exit_group": 231, "epoll_wait": 232,
+    "epoll_ctl": 233, "tgkill": 234, "openat": 257, "newfstatat": 262,
+    "set_robust_list": 273, "eventfd2": 290, "epoll_create1": 291,
+    "dup3": 292, "pipe2": 293, "recvmmsg": 299, "prlimit64": 302,
+    "sendmmsg": 307, "getrandom": 318, "membarrier": 324, "statx": 332,
+    "rseq": 334, "clone3": 435, "faccessat2": 439,
+}
+
+
+def seccomp_supported() -> bool:
+    import platform
+    import sys
+
+    return sys.platform.startswith("linux") and \
+        platform.machine() == "x86_64"
+
+
+def install_seccomp_allowlist(allowed, default_errno: int = 1) -> bool:
+    """Install a seccomp-BPF allowlist on the CALLING process/thread.
+
+    allowed: iterable of syscall names (SYSCALLS_X86_64 keys) or raw
+    numbers. Non-listed syscalls fail with errno=default_errno
+    (default EPERM); pass default_errno=None for KILL_PROCESS (the
+    reference's stance — use errno for anything that must stay
+    debuggable). Requires no_new_privs() first. Irreversible.
+
+    Returns False (installing nothing) on non-x86_64/non-Linux hosts —
+    the filter encodes an arch check + arch-specific numbers and a
+    wrong-arch install would kill every syscall.
+    """
+    if not seccomp_supported():
+        return False
+    nrs = sorted({
+        SYSCALLS_X86_64[s] if isinstance(s, str) else int(s)
+        for s in allowed
+    })
+    if default_errno is None:
+        default = SECCOMP_RET_KILL_PROCESS
+    else:
+        default = 0x00050000 | (default_errno & 0xFFFF)
+
+    filt = []
+
+    def ins(code, jt, jf, k):
+        filt.append(struct.pack("<HBBI", code, jt, jf, k & 0xFFFFFFFF))
+
+    # [0] A = seccomp_data.arch; [1] allow-continue if x86_64 else [2] kill
+    ins(_BPF_LD_W_ABS, 0, 0, 4)
+    ins(_BPF_JEQ_K, 1, 0, _AUDIT_ARCH_X86_64)
+    ins(_BPF_RET_K, 0, 0, SECCOMP_RET_KILL_PROCESS)
+    # [3] A = seccomp_data.nr; then JEQ/RET pairs per allowed syscall
+    ins(_BPF_LD_W_ABS, 0, 0, 0)
+    for nr in nrs:
+        ins(_BPF_JEQ_K, 0, 1, nr)
+        ins(_BPF_RET_K, 0, 0, SECCOMP_RET_ALLOW)
+    ins(_BPF_RET_K, 0, 0, default)
+
+    prog_buf = b"".join(filt)
+    buf = ctypes.create_string_buffer(prog_buf, len(prog_buf))
+    # struct sock_fprog { unsigned short len; struct sock_filter *filter; }
+    class _Fprog(ctypes.Structure):
+        _fields_ = [("len", ctypes.c_ushort),
+                    ("filter", ctypes.c_void_p)]
+
+    prog = _Fprog(len(filt), ctypes.cast(buf, ctypes.c_void_p))
+    libc = ctypes.CDLL(None, use_errno=True)
+    if libc.prctl(_PR_SET_SECCOMP, _SECCOMP_MODE_FILTER,
+                  ctypes.byref(prog), 0, 0) != 0:
+        raise OSError(ctypes.get_errno(), "prctl(PR_SET_SECCOMP) failed")
+    return True
 
 
 def sandbox(keep_fds_max: int = 3, keep_env: Iterable[str] = _KEEP_ENV,

@@ -6,6 +6,8 @@ transactions with known-good/bad signatures and duplicates flow the whole
 pipeline; we assert on per-stage diag counters and final bank delivery.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -56,6 +58,9 @@ def test_pipeline_end_to_end(tmp_path, backend):
         verify_max_msg_len=192,
         bank_cnt=4,
         timeout_s=240.0,
+        # Exercise the core-pinning path (best-effort affinity; wraps
+        # over the tile list).
+        tile_cpus=[0, min(1, (os.cpu_count() or 2) - 1)],
     )
     assert res.recv_cnt == n_uniq, res.diag
     # dups are filtered at the verify tile ha-dedup (same sig tag)

@@ -135,3 +135,84 @@ def test_ctl_cli_roundtrip(tmp_path):
         [sys.executable, "-m", "firedancer_tpu.app.ctl", "wksp", "query",
          wpath, "nope"], capture_output=True, text=True)
     assert r.returncode == 1 and "error" in r.stdout
+
+
+def test_seccomp_allowlist_blocks_socket():
+    """Install a real seccomp-BPF allowlist in a child process: normal
+    operation (write/exit) keeps working, a non-listed syscall (socket)
+    fails with EPERM instead of executing. x86_64-only by design."""
+    import subprocess
+    import sys
+
+    from firedancer_tpu.utils.sandbox import seccomp_supported
+
+    if not seccomp_supported():
+        import pytest
+
+        pytest.skip("seccomp filter install is x86_64-Linux-only")
+
+    prog = r"""
+import os, sys
+from firedancer_tpu.utils.sandbox import (
+    install_seccomp_allowlist, no_new_privs, SYSCALLS_X86_64,
+)
+assert no_new_privs()
+# Everything CPython needs to keep running and exit, but NOT socket.
+allowed = [s for s in SYSCALLS_X86_64 if s != "socket"]
+assert install_seccomp_allowlist(allowed)
+import socket
+try:
+    socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+except OSError as e:
+    os.write(1, b"blocked errno=%d\n" % e.errno)
+else:
+    os.write(1, b"NOT BLOCKED\n")
+os.write(1, b"still-alive\n")
+os._exit(0)
+"""
+    r = subprocess.run(
+        [sys.executable, "-c", prog], capture_output=True, text=True,
+        timeout=60,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "blocked errno=1" in r.stdout, r.stdout
+    assert "still-alive" in r.stdout
+    assert "NOT BLOCKED" not in r.stdout
+
+
+def test_seccomp_kill_mode():
+    """default_errno=None: a non-listed syscall kills the process with
+    SIGSYS (the reference's production stance)."""
+    import signal
+    import subprocess
+    import sys
+
+    from firedancer_tpu.utils.sandbox import seccomp_supported
+
+    if not seccomp_supported():
+        import pytest
+
+        pytest.skip("seccomp filter install is x86_64-Linux-only")
+
+    prog = r"""
+import os
+from firedancer_tpu.utils.sandbox import (
+    install_seccomp_allowlist, no_new_privs, SYSCALLS_X86_64,
+)
+assert no_new_privs()
+allowed = [s for s in SYSCALLS_X86_64 if s != "socket"]
+assert install_seccomp_allowlist(allowed, default_errno=None)
+os.write(1, b"armed\n")
+import socket
+socket.socket(socket.AF_INET, socket.SOCK_DGRAM)  # SIGSYS here
+os.write(1, b"UNREACHABLE\n")
+"""
+    r = subprocess.run(
+        [sys.executable, "-c", prog], capture_output=True, text=True,
+        timeout=60,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == -signal.SIGSYS, (r.returncode, r.stderr[-800:])
+    assert "armed" in r.stdout
+    assert "UNREACHABLE" not in r.stdout
