@@ -421,7 +421,7 @@ def main() -> int:
     # the specialized squaring swapped back to plain multiplies, in case
     # a Mosaic version rejects fe_sq's construction on this machine.
     modes = [("rlc", None), ("direct", None),
-             ("direct", {"FD_SQ_IMPL": "mul", "FD_MSM_IMPL": "xla"})]
+             ("direct", {"FD_SQ_IMPL": "mul"})]
     forced = os.environ.get("FD_BENCH_VERIFY")
     if forced:
         if forced not in ("rlc", "direct"):

@@ -1,0 +1,260 @@
+"""Crash-only process supervisor for the tile pipeline.
+
+The process analog of fdctl run's supervision (src/app/fdctl/run/run.c:
+spawn tiles as processes, watch them, restart on failure): each tile is
+its own OS process (disco/worker.py) sharing the workspace file; the
+supervisor monitors process liveness and cnc heartbeats THROUGH the
+workspace, and its recovery policy is crash-only — no in-place repair,
+a misbehaving tile is killed and respawned, resuming from its rings'
+durable cursors (fseq for consumers, mcache seq for producers).
+
+Where the thread runner (pipeline._run_tiles) can inspect tile objects
+for quiescence, the supervisor sees only shared memory: the pipeline is
+quiescent when the source process has exited and every link's consumer
+cursor (fseq) has caught up to its producer cursor (mcache seq) and
+stayed stable across a settle window (covers in-flight verify batches,
+whose max-wait flush bounds how long a partial batch may linger).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from firedancer_tpu.disco.pipeline import (
+    LINKS,
+    PipelineResult,
+    Topology,
+    lane_link,
+)
+from firedancer_tpu.tango.rings import Cnc, FSeq, MCache, Workspace
+
+
+@dataclass
+class TileProc:
+    name: str
+    cmd: List[str]
+    proc: subprocess.Popen
+    restarts: int = 0
+
+
+def _spawn(name: str, wksp_path: str, pod_path: str, opts: dict,
+           max_ns: int, result_path: str,
+           log_dir: str | None = None) -> TileProc:
+    cmd = [
+        sys.executable, "-m", "firedancer_tpu.disco.worker",
+        "--wksp", wksp_path, "--pod", pod_path, "--tile", name,
+        "--opts", json.dumps(opts), "--max-ns", str(max_ns),
+    ]
+    if name == "sink":
+        cmd += ["--result", result_path]
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    stderr = None
+    if log_dir:
+        stderr = open(os.path.join(log_dir, f"{name}.log"), "ab")
+    proc = subprocess.Popen(cmd, cwd=repo, stderr=stderr)
+    if stderr is not None:
+        stderr.close()
+    return TileProc(name=name, cmd=cmd, proc=proc)
+
+
+def run_pipeline_supervised(
+    topo: Topology,
+    payloads: List[bytes],
+    verify_backend: str = "oracle",
+    verify_batch: int = 128,
+    verify_max_msg_len: Optional[int] = None,
+    bank_cnt: int = 4,
+    timeout_s: float = 120.0,
+    tcache_depth: int = 4096,
+    verify_opts: Optional[dict] = None,
+    record_digests: bool = False,
+    heartbeat_timeout_s: float = 5.0,
+    restart: bool = True,
+    fault_hook=None,
+    tile_cpus: Optional[List[int]] = None,
+) -> PipelineResult:
+    """Run the replay pipeline with per-tile processes + supervision.
+
+    fault_hook(tiles: dict[name, TileProc], t_elapsed) is called every
+    monitor pass — tests use it to murder a tile mid-run and assert the
+    crash-only restart heals the pipeline.
+
+    Returns a PipelineResult whose recv/latency fields come from the
+    sink worker's result file and whose diag comes from the shared
+    workspace (monitor.snapshot), same as the thread runner.
+    """
+    pod = topo.pod
+    tmp = tempfile.mkdtemp(prefix="fd_sup_")
+    pod_path = os.path.join(tmp, "topo.pod")
+    with open(pod_path, "wb") as f:
+        f.write(pod.serialize())
+    payloads_path = os.path.join(tmp, "payloads.pkl")
+    with open(payloads_path, "wb") as f:
+        pickle.dump(list(payloads), f)
+    result_path = os.path.join(tmp, "sink.json")
+
+    lanes = pod.query_ulong("firedancer.layout.verify_lane_cnt", 1)
+    tile_names = (
+        ["replay"]
+        + ["verify" if i == 0 else f"verify.v{i}" for i in range(lanes)]
+        + ["dedup", "pack", "sink"]
+    )
+    base_opts = {
+        "verify_backend": verify_backend,
+        "verify_batch": verify_batch,
+        "verify_max_msg_len": verify_max_msg_len,
+        "verify_opts": verify_opts or {},
+        "tcache_depth": tcache_depth,
+        "bank_cnt": bank_cnt,
+        "record_digests": record_digests,
+        "payloads_path": payloads_path,
+    }
+    max_ns = int((timeout_s + 30.0) * 1e9)
+
+    def opts_for(i: int) -> dict:
+        if not tile_cpus:
+            return base_opts
+        return dict(base_opts, cpu_idx=tile_cpus[i % len(tile_cpus)])
+
+    tile_opts = {n: opts_for(i) for i, n in enumerate(tile_names)}
+    tiles: Dict[str, TileProc] = {
+        n: _spawn(n, topo.wksp_path, pod_path, tile_opts[n], max_ns,
+                  result_path, log_dir=tmp)
+        for n in tile_names
+    }
+
+    # Supervisor-side views into the shared rings.
+    wksp = Workspace.join(topo.wksp_path)
+    link_names = [lane_link(l, 0) for l in LINKS]
+    link_names += [lane_link(l, i) for l in ("replay_verify", "verify_dedup")
+                   for i in range(1, lanes)]
+    links = [
+        (MCache(wksp, pod.query_cstr(f"firedancer.{n}.mcache")),
+         FSeq(wksp, pod.query_cstr(f"firedancer.{n}.fseq")))
+        for n in link_names
+    ]
+    src_mcaches = [
+        MCache(wksp, pod.query_cstr(
+            f"firedancer.{lane_link('replay_verify', i)}.mcache"))
+        for i in range(lanes)
+    ]
+    n_payloads = len(payloads)
+    cncs = {n: Cnc(wksp, pod.query_cstr(f"firedancer.{n}.cnc"))
+            for n in tile_names}
+
+    t0 = time.perf_counter()
+    deadline = t0 + timeout_s
+    settle_needed = 5
+    settle = 0
+    last_cursors = None
+    last_beat: Dict[str, tuple] = {}
+    total_restarts = 0
+
+    while time.perf_counter() < deadline:
+        now = time.perf_counter()
+        if fault_hook is not None:
+            fault_hook(tiles, now - t0)
+        # Liveness + heartbeat supervision (crash-only recovery).
+        for name, tp in tiles.items():
+            rc = tp.proc.poll()
+            if rc == 0:
+                # Clean exit: the source when exhausted (and any tile
+                # that saw HALT). Not a fault — and its heartbeat is
+                # legitimately frozen now, so skip that check too.
+                last_beat.pop(name, None)
+                continue
+            dead = rc is not None
+            if not dead:
+                hb = cncs[name].heartbeat_query()
+                seen_at, seen_hb = last_beat.get(name, (now, hb))
+                # hb == seen_hb == 0 means the worker is still BOOTING
+                # (interpreter + imports, easily seconds under load):
+                # give boot a longer grace than a wedged run loop —
+                # killing a booting worker just restarts the boot storm.
+                limit = heartbeat_timeout_s * (4.0 if seen_hb == 0
+                                               else 1.0)
+                if hb != seen_hb:
+                    last_beat[name] = (now, hb)
+                elif now - seen_at > limit:
+                    dead = True  # wedged: kill, then crash-only restart
+                    tp.proc.kill()
+                    tp.proc.wait()
+                    last_beat.pop(name, None)
+                else:
+                    last_beat.setdefault(name, (now, hb))
+            if dead and restart:
+                if tp.proc.poll() is None:
+                    tp.proc.kill()
+                    tp.proc.wait()
+                # Zero the stale heartbeat BEFORE respawning: the cnc
+                # still holds the dead incarnation's stamp, and a fresh
+                # worker must get the 4x BOOT grace, not the run-loop
+                # timeout, or slow boots turn into a respawn storm.
+                cncs[name].heartbeat(0)
+                fresh = _spawn(name, topo.wksp_path, pod_path,
+                               tile_opts[name], max_ns, result_path,
+                               log_dir=tmp)
+                fresh.restarts = tp.restarts + 1
+                tiles[name] = fresh
+                total_restarts += 1
+                last_beat.pop(name, None)
+        # Quiescence: source finished publishing (visible in its out
+        # rings — source tiles spin until HALT, so process exit can't be
+        # the signal) + cursors caught up + stable.
+        src_done = sum(mc.seq_next() for mc in src_mcaches) >= n_payloads
+        cursors = tuple(
+            (mc.seq_next(), fs.query()) for mc, fs in links
+        )
+        drained = all(fs >= mc for mc, fs in cursors)
+        if src_done and drained and cursors == last_cursors:
+            settle += 1
+            if settle >= settle_needed:
+                break
+        else:
+            settle = 0
+        last_cursors = cursors
+        time.sleep(0.05)
+
+    for name, cnc in cncs.items():
+        from firedancer_tpu.disco.tiles import CNC_HALT
+
+        cnc.signal(CNC_HALT)
+    join_deadline = time.perf_counter() + 30.0
+    for tp in tiles.values():
+        try:
+            tp.proc.wait(timeout=max(0.1, join_deadline - time.perf_counter()))
+        except subprocess.TimeoutExpired:
+            tp.proc.kill()
+            tp.proc.wait()
+    elapsed = time.perf_counter() - t0
+
+    from firedancer_tpu.disco.monitor import snapshot
+
+    diag = snapshot(wksp, pod)
+    sink_res = {}
+    if os.path.exists(result_path):
+        with open(result_path) as f:
+            sink_res = json.load(f)
+    res = PipelineResult(
+        recv_cnt=sink_res.get("recv_cnt", 0),
+        recv_sz=sink_res.get("recv_sz", 0),
+        bank_hist={int(k): v for k, v in
+                   (sink_res.get("bank_hist") or {}).items()},
+        diag=diag,
+        elapsed_s=elapsed,
+        latency_p50_ns=sink_res.get("latency_p50_ns", 0),
+        latency_p99_ns=sink_res.get("latency_p99_ns", 0),
+        sink_digests=[bytes.fromhex(d) for d in sink_res["digests"]]
+        if sink_res.get("digests") else None,
+    )
+    res.supervisor_restarts = total_restarts  # type: ignore[attr-defined]
+    return res

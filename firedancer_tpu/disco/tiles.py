@@ -92,7 +92,10 @@ class InLink:
         self.mcache = MCache(wksp, names.mcache)
         self.dcache = DCache(wksp, names.dcache)
         self.fseq = FSeq(wksp, names.fseq)
-        self.seq = 0
+        # Resume from the published consumer progress: 0 on a fresh
+        # fseq, the last-acknowledged seq after a crash-restart (the
+        # supervisor's crash-only recovery relies on this).
+        self.seq = self.fseq.query()
 
     def poll(self):
         """Returns (status, frag, payload_bytes_or_None)."""
@@ -131,7 +134,19 @@ class OutLink:
         self.dcache = DCache(wksp, names.dcache)
         self.mtu = mtu
         self.seq = self.mcache.seq_next()
+        # Restart-safe chunk resume: a respawned producer must continue
+        # the dcache walk where the dead incarnation stopped, or it
+        # would overwrite the payload bytes of still-unconsumed frags
+        # (whose mcache entries remain valid — silent corruption, not an
+        # overrun). The last published frag's own meta records where the
+        # walk was.
         self.chunk = 0
+        if self.seq > 0:
+            r, last = self.mcache.poll(self.seq - 1)
+            if r == POLL_FRAG and last is not None:
+                self.chunk = self.dcache.next_chunk(
+                    last.chunk, last.sz, mtu
+                )
         self.fctl = make_fctl_for_fseqs(
             self.mcache.depth, reliable_fseqs or [], cr_burst=1
         )

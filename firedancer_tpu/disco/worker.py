@@ -1,0 +1,136 @@
+"""Tile worker process: one tile, one OS process, shared-memory rings.
+
+The process analog of the reference's per-tile processes under fdctl run
+(src/app/fdctl/run/run.c): the supervisor (disco/supervisor.py) spawns
+    python -m firedancer_tpu.disco.worker --wksp W --pod P --tile NAME
+per tile; each worker joins the SAME workspace file, reconstructs its
+tile from the pod, and runs until HALT. Crash-only recovery works
+because all durable state is in the workspace: a respawned consumer
+resumes from its fseq, a respawned producer from its mcache seq.
+
+Tile construction mirrors disco/pipeline._run_tiles; keep the two in
+sync when tile parameters change (test_supervisor compares behavior
+end-to-end against the same corpus the thread tests use).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pickle
+import sys
+
+
+def build_tile(wksp, pod, name: str, opts: dict):
+    """Construct tile `name` wired to its pod-declared rings."""
+    from firedancer_tpu.disco.pipeline import (
+        _link_names,
+        _make_out_link,
+        _make_source_out_links,
+        lane_link,
+    )
+    from firedancer_tpu.disco.tiles import (
+        DedupTile,
+        InLink,
+        PackTile,
+        ReplayTile,
+        SinkTile,
+        VerifyTile,
+    )
+
+    mtu = pod.query_ulong("firedancer.mtu", 1232)
+    lanes = pod.query_ulong("firedancer.layout.verify_lane_cnt", 1)
+
+    def in_link(link):
+        return InLink(wksp, _link_names(pod, link))
+
+    if name == "replay":
+        with open(opts["payloads_path"], "rb") as f:
+            payloads = pickle.load(f)
+        return ReplayTile(
+            wksp, pod.query_cstr("firedancer.replay.cnc"),
+            out_links=_make_source_out_links(wksp, pod),
+            payloads=payloads,
+        )
+    if name.startswith("verify"):
+        lane = int(name[8:]) if name.startswith("verify.v") else 0
+        return VerifyTile(
+            wksp, pod.query_cstr(f"firedancer.{name}.cnc"),
+            in_link=in_link(lane_link("replay_verify", lane)),
+            out_link=_make_out_link(
+                wksp, pod, lane_link("verify_dedup", lane),
+                lane_link("verify_dedup", lane), mtu,
+            ),
+            backend=opts.get("verify_backend", "oracle"),
+            batch=opts.get("verify_batch", 128),
+            max_msg_len=opts.get("verify_max_msg_len") or mtu,
+            tcache_depth=opts.get("tcache_depth", 4096),
+            **opts.get("verify_opts", {}),
+        )
+    if name == "dedup":
+        return DedupTile(
+            wksp, pod.query_cstr("firedancer.dedup.cnc"),
+            in_links=[in_link(lane_link("verify_dedup", i))
+                      for i in range(lanes)],
+            out_link=_make_out_link(wksp, pod, "dedup_pack", "dedup_pack",
+                                    mtu),
+            tcache_depth=opts.get("tcache_depth", 4096),
+        )
+    if name == "pack":
+        return PackTile(
+            wksp, pod.query_cstr("firedancer.pack.cnc"),
+            in_link=in_link("dedup_pack"),
+            out_link=_make_out_link(wksp, pod, "pack_sink", "pack_sink",
+                                    mtu),
+            bank_cnt=opts.get("bank_cnt", 4),
+            scheduler=opts.get("pack_scheduler", "greedy"),
+        )
+    if name == "sink":
+        return SinkTile(
+            wksp, pod.query_cstr("firedancer.sink.cnc"),
+            in_link=in_link("pack_sink"),
+            record_digests=opts.get("record_digests", False),
+        )
+    raise ValueError(f"unknown tile {name!r}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--wksp", required=True)
+    ap.add_argument("--pod", required=True)
+    ap.add_argument("--tile", required=True)
+    ap.add_argument("--opts", default="{}")
+    ap.add_argument("--max-ns", type=int, default=600_000_000_000)
+    ap.add_argument("--result", default="")
+    args = ap.parse_args(argv)
+
+    from firedancer_tpu.tango.rings import Workspace
+    from firedancer_tpu.utils.pod import Pod
+
+    wksp = Workspace.join(args.wksp)
+    with open(args.pod, "rb") as f:
+        pod = Pod.deserialize(f.read())
+    opts = json.loads(args.opts)
+
+    tile = build_tile(wksp, pod, args.tile, opts)
+    if opts.get("cpu_idx") is not None:
+        tile.cpu_idx = int(opts["cpu_idx"])
+    tile.run(args.max_ns)
+
+    if args.result and args.tile == "sink":
+        lat = sorted(tile.latencies_ns)
+        with open(args.result, "w") as f:
+            json.dump({
+                "recv_cnt": tile.recv_cnt,
+                "recv_sz": tile.recv_sz,
+                "bank_hist": {str(k): v for k, v in tile.bank_hist.items()},
+                "latency_p50_ns": lat[len(lat) // 2] if lat else 0,
+                "latency_p99_ns": lat[(len(lat) * 99) // 100] if lat else 0,
+                "digests": [d.hex() for d in tile.digests]
+                if getattr(tile, "digests", None) is not None else None,
+            }, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
