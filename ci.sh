@@ -13,10 +13,15 @@ cd "$(dirname "$0")"
 echo "== native build + stress =="
 if [ "${SAN:-0}" = "1" ]; then
   make -C native CXXFLAGS="-O1 -g -Wall -Wextra -std=c++17 -fPIC -fsanitize=address,undefined" all
+elif [ "${TSAN:-0}" = "1" ]; then
+  # Memory-model gate for the lock-free structures (ring publishes,
+  # allocator freelists): the stress binaries under ThreadSanitizer.
+  make -C native CXXFLAGS="-O1 -g -Wall -Wextra -std=c++17 -fPIC -fsanitize=thread" all
 else
   make -C native all
 fi
 ./build/tango_stress
+./build/alloc_stress
 
 echo "== pytest =="
 python -m pytest tests/ -x -q

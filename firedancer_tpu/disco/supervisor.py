@@ -69,6 +69,39 @@ def _spawn(name: str, wksp_path: str, pod_path: str, opts: dict,
 def run_pipeline_supervised(
     topo: Topology,
     payloads: List[bytes],
+    **kwargs,
+) -> PipelineResult:
+    """Run the replay pipeline with per-tile processes + supervision.
+
+    fault_hook(tiles: dict[name, TileProc], t_elapsed) is called every
+    monitor pass — tests use it to murder a tile mid-run and assert the
+    crash-only restart heals the pipeline.
+
+    Delivery semantics through a crash window (matching the reference's
+    lossy-by-design rings, NOT exactly-once): a respawned consumer
+    re-reads from its last PUBLISHED fseq, so frags consumed after the
+    final housekeep are reprocessed — duplicates are filtered where a
+    downstream dedup exists (verify restarts are healed by the dedup
+    tile), and the verify tile holds its fseq back to the last fully
+    verified txn so staged-but-unverified work is never lost.
+
+    Returns a PipelineResult whose recv counters come from the sink's
+    cnc diag (accumulated in shared memory, surviving sink restarts);
+    latency/digests come from the final sink incarnation's result file.
+    """
+    import shutil
+
+    tmp = tempfile.mkdtemp(prefix="fd_sup_")
+    try:
+        return _supervised(topo, payloads, tmp, **kwargs)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _supervised(
+    topo: Topology,
+    payloads: List[bytes],
+    tmp: str,
     verify_backend: str = "oracle",
     verify_batch: int = 128,
     verify_max_msg_len: Optional[int] = None,
@@ -81,19 +114,9 @@ def run_pipeline_supervised(
     restart: bool = True,
     fault_hook=None,
     tile_cpus: Optional[List[int]] = None,
+    jax_platform: Optional[str] = None,
 ) -> PipelineResult:
-    """Run the replay pipeline with per-tile processes + supervision.
-
-    fault_hook(tiles: dict[name, TileProc], t_elapsed) is called every
-    monitor pass — tests use it to murder a tile mid-run and assert the
-    crash-only restart heals the pipeline.
-
-    Returns a PipelineResult whose recv/latency fields come from the
-    sink worker's result file and whose diag comes from the shared
-    workspace (monitor.snapshot), same as the thread runner.
-    """
     pod = topo.pod
-    tmp = tempfile.mkdtemp(prefix="fd_sup_")
     pod_path = os.path.join(tmp, "topo.pod")
     with open(pod_path, "wb") as f:
         f.write(pod.serialize())
@@ -117,6 +140,7 @@ def run_pipeline_supervised(
         "bank_cnt": bank_cnt,
         "record_digests": record_digests,
         "payloads_path": payloads_path,
+        "jax_platform": jax_platform,
     }
     max_ns = int((timeout_s + 30.0) * 1e9)
 
@@ -244,9 +268,16 @@ def run_pipeline_supervised(
     if os.path.exists(result_path):
         with open(result_path) as f:
             sink_res = json.load(f)
+    # Delivery counters come from the pack_sink fseq diag — the sink
+    # accumulates them in SHARED memory on every frag, so they survive
+    # sink crash-restarts; the result file (latency/digests/bank_hist)
+    # only reflects the final sink incarnation and is best-effort.
+    from firedancer_tpu.tango.rings import DIAG_PUB_CNT, DIAG_PUB_SZ
+
+    sink_fseq = FSeq(wksp, pod.query_cstr("firedancer.pack_sink.fseq"))
     res = PipelineResult(
-        recv_cnt=sink_res.get("recv_cnt", 0),
-        recv_sz=sink_res.get("recv_sz", 0),
+        recv_cnt=sink_fseq.diag(DIAG_PUB_CNT),
+        recv_sz=sink_fseq.diag(DIAG_PUB_SZ),
         bank_hist={int(k): v for k, v in
                    (sink_res.get("bank_hist") or {}).items()},
         diag=diag,

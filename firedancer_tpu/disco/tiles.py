@@ -488,10 +488,16 @@ class VerifyTile(Tile):
         self.ha_tcache = TCache(tcache_depth)
         self.inflight_max = max(1, inflight)
         self.max_wait_ns = max_wait_us * 1_000
-        self._pending: list = []       # [(payload, items, tsorig)]
+        self._pending: list = []       # [(payload, items, tsorig, seq_end)]
         self._pending_lanes = 0
         self._pending_since = 0        # tickcount of oldest pending txn
         self._inflight: list = []      # FIFO of _InflightBatch
+        # Crash-consistency cursor: the fseq published to the producer is
+        # held back to the last seq whose txn is FULLY verified (not just
+        # consumed), so a SIGKILL between consume and verify-complete
+        # cannot lose staged txns — the respawned worker re-reads them
+        # (duplicates are healed by the downstream dedup tile).
+        self._acked_seq = self.in_link.seq if self.in_link else 0
         self._verify_batch_fn = None
         # dispatch/completion stats (read by monitor/bench)
         self.stat_batches = 0
@@ -604,22 +610,31 @@ class VerifyTile(Tile):
             overrun = True
         if n <= 0:
             il.seq = seq.value
+            if not self._pending and not self._inflight:
+                self._acked_seq = il.seq  # everything consumed is done
             return False, overrun
         if not self._pending:
             self._pending_since = tempo.tickcount()
+        drain_end = seq.value  # ack target once this round's txns verify
         base = self._nd_pay_fill
         for i in range(n):
             off = base + int(self._nd_offs[i])
             ln = int(self._nd_plens[i])
             payload = self._nd_pay[off : off + ln].tobytes()
             cnt = int(self._nd_tlanes[i])
+            # Ack granularity is the drain round: only the round's LAST
+            # entry carries the post-round seq — a batch boundary inside
+            # the round must not let the ack run past unverified txns.
+            seq_end = drain_end if i == n - 1 else 0
             if self.ha_tcache.insert(hash(payload)):
                 self.cnc.diag_add(CNC_DIAG_HA_FILT_CNT, 1)
                 self.cnc.diag_add(CNC_DIAG_HA_FILT_SZ, ln)
                 # Lanes stay staged; completion skips publish (None).
-                self._pending.append((None, cnt, 0))
+                self._pending.append((None, cnt, 0, seq_end))
             else:
-                self._pending.append((payload, cnt, int(self._nd_tsorig[i])))
+                self._pending.append(
+                    (payload, cnt, int(self._nd_tsorig[i]), seq_end)
+                )
             self._nd_pay_fill = off + ln
             self._pending_lanes += cnt
         # Advance the consumed-seq marker only AFTER the txns are visible
@@ -629,6 +644,8 @@ class VerifyTile(Tile):
         il.seq = seq.value
         if self._pending_lanes >= self.batch:
             self._dispatch()
+        elif self._ring_starved():
+            self._dispatch(force=True)
         self._complete(block=False)
         return True, overrun
 
@@ -657,12 +674,20 @@ class VerifyTile(Tile):
         ))
         self.stat_batches += 1
 
+    def _ack_inline(self, frag: Frag) -> None:
+        """A frag handled to completion inside on_frag (filtered or
+        oracle-verified) is ackable immediately — but only when nothing
+        older is still staged on the device."""
+        if not self._pending and not self._inflight:
+            self._acked_seq = frag.seq + 1
+
     def on_frag(self, frag: Frag, payload: bytes) -> None:
         try:
             txn = parse_txn(payload)
         except TxnParseError:
             self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, 1)
             self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, len(payload))
+            self._ack_inline(frag)
             return
         # High-availability dup filter before paying for the verify
         # (synth-load FD_TCACHE_INSERT ha_tag analog). The tag covers the
@@ -675,6 +700,7 @@ class VerifyTile(Tile):
         if self.ha_tcache.insert(ha_tag):
             self.cnc.diag_add(CNC_DIAG_HA_FILT_CNT, 1)
             self.cnc.diag_add(CNC_DIAG_HA_FILT_SZ, len(payload))
+            self._ack_inline(frag)
             return
         items = list(txn.verify_items(payload))
         if self.backend == "oracle":
@@ -682,6 +708,7 @@ class VerifyTile(Tile):
                 oracle.verify(msg, sig, pub) == 0 for (sig, pub, msg) in items
             )
             self._finish(payload, ok, tsorig=frag.tsorig)
+            self._ack_inline(frag)
             return
         if len(items) > self.batch:
             # A txn with more sigs than device lanes (can't happen under
@@ -690,14 +717,24 @@ class VerifyTile(Tile):
                 oracle.verify(msg, sig, pub) == 0 for (sig, pub, msg) in items
             )
             self._finish(payload, ok, tsorig=frag.tsorig)
+            self._ack_inline(frag)
             return
         if not self._pending:
             self._pending_since = tempo.tickcount()
-        self._pending.append((payload, items, frag.tsorig))
+        self._pending.append((payload, items, frag.tsorig, frag.seq + 1))
         self._pending_lanes += len(items)
         if self._pending_lanes >= self.batch:
             self._dispatch()
         self._complete(block=False)
+
+    def _ring_starved(self) -> bool:
+        """The held-back ack cursor is about to exhaust the producer's
+        credits: flush now rather than letting max-wait decide — a
+        partial batch beats a stalled pipeline."""
+        il = self.in_link
+        return il is not None and (
+            il.seq - self._acked_seq >= max(1, il.mcache.depth - 64)
+        )
 
     def on_idle(self) -> None:
         if self._inflight:
@@ -705,9 +742,22 @@ class VerifyTile(Tile):
         if self._pending:
             if self._pending_lanes >= self.batch:
                 self._dispatch()
+            elif self._ring_starved():
+                self._dispatch(force=True)
             elif tempo.tickcount() - self._pending_since >= self.max_wait_ns:
                 self.stat_flush_timeout += 1
                 self._dispatch(force=True)
+
+    def housekeep(self, now: int) -> None:
+        # Publish the VERIFIED cursor, not the consumed one: a crash
+        # between consume and verify-complete must leave the frags
+        # re-readable for the respawned worker (crash-only recovery).
+        # Flow control self-heals: held-back credits return as batches
+        # complete, and the max-wait flush bounds how long a partial
+        # batch can hold them.
+        self.cnc.heartbeat(now)
+        for il in self.in_links:
+            il.fseq.update(min(self._acked_seq, il.seq))
 
     def on_housekeep(self) -> None:
         # The housekeeping interval is the latency backstop when the tile
@@ -747,14 +797,14 @@ class VerifyTile(Tile):
             # invisible to it — HALT could race in and drop the batch.
             take = 0
             flat = []
-            for _, items, _ in self._pending:
+            for _, items, _, _ in self._pending:
                 if len(flat) + len(items) > self.batch:
                     break
                 flat.extend(items)
                 take += 1
             todo = [
-                (payload, len(items), tsorig)
-                for payload, items, tsorig in self._pending[:take]
+                (payload, len(items), tsorig, seq_end)
+                for payload, items, tsorig, seq_end in self._pending[:take]
             ]
             # Back-pressure the shim, not the device: cap in-flight batches
             # (wiredancer polls the DMA fill level, wd_f1.c:352-358).
@@ -792,9 +842,10 @@ class VerifyTile(Tile):
             statuses = np.asarray(ib.out)  # blocks only if not ready
             if getattr(ib.out, "used_fallback", False):
                 self.stat_rlc_fallback += 1
-            self._inflight.pop(0)
             off = 0
-            for payload, cnt, tsorig in ib.todo:
+            batch_ack = 0
+            for payload, cnt, tsorig, seq_end in ib.todo:
+                batch_ack = max(batch_ack, seq_end)
                 if payload is None:  # HA-filtered post-staging (native)
                     off += cnt
                     continue
@@ -803,6 +854,18 @@ class VerifyTile(Tile):
                 ok = cnt > 0 and not over and bool((lane == 0).all())
                 self._finish(payload, ok, tsorig=tsorig)
                 off += cnt
+            # Pop only AFTER the batch's results are published: the
+            # supervisor's quiescence check reads _inflight from another
+            # thread, and popping first opens a window where the
+            # pipeline looks drained, HALT lands, and publish_backp
+            # drops this batch's output.
+            self._inflight.pop(0)
+            # Batches retire in dispatch order, so the newest seq carried
+            # by this batch is now fully verified and ackable; with the
+            # device idle, everything consumed is.
+            self._acked_seq = max(self._acked_seq, batch_ack)
+            if not self._pending and not self._inflight and self.in_link:
+                self._acked_seq = self.in_link.seq
             if not drain_all:
                 return  # retire at most one per call; keep the loop hot
 

@@ -104,7 +104,47 @@ def main(argv=None) -> int:
     ap.add_argument("--result", default="")
     args = ap.parse_args(argv)
 
-    from firedancer_tpu.tango.rings import Workspace
+    opts_early = json.loads(args.opts)
+    plat = opts_early.get("jax_platform")
+    if plat:
+        # Workers don't run the test conftest, and this image's
+        # sitecustomize force-registers the TPU plugin via jax.config
+        # (overriding the env var) — pin the config BEFORE any backend
+        # can initialize, or a CPU-intended worker hangs on the tunnel.
+        import os as _os
+
+        _os.environ["JAX_PLATFORMS"] = plat
+        if plat == "cpu":
+            # Match the test conftest's 8-device virtual CPU config so
+            # the worker's jit compiles HIT the same persistent cache
+            # (the compile key covers the device topology; a 1-device
+            # worker would re-pay multi-minute compiles every boot).
+            flags = _os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                _os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+    if opts_early.get("verify_backend") == "tpu":
+        # Persistent compile cache: a respawned verify worker must boot
+        # inside the supervisor's heartbeat grace, not re-pay the full
+        # jit compile.
+        import os as _os
+
+        import jax
+
+        repo = _os.path.dirname(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))))
+        jax.config.update("jax_compilation_cache_dir",
+                          _os.path.join(repo, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from firedancer_tpu.tango.rings import Cnc, Workspace
     from firedancer_tpu.utils.pod import Pod
 
     wksp = Workspace.join(args.wksp)
@@ -112,7 +152,30 @@ def main(argv=None) -> int:
         pod = Pod.deserialize(f.read())
     opts = json.loads(args.opts)
 
-    tile = build_tile(wksp, pod, args.tile, opts)
+    # Heartbeat through BOOT: tile construction can legitimately take
+    # minutes (a cold jit compile of the verify graph), far beyond any
+    # sane run-loop heartbeat timeout — a booting-but-alive worker must
+    # look alive to the supervisor, or it gets killed into a respawn
+    # storm that re-pays the compile forever.
+    import threading
+
+    from firedancer_tpu.tango import tempo
+
+    cnc = Cnc(wksp, pod.query_cstr(f"firedancer.{args.tile}.cnc"))
+    boot_done = threading.Event()
+
+    def _boot_beat():
+        while not boot_done.is_set():
+            cnc.heartbeat(tempo.tickcount())
+            boot_done.wait(0.5)
+
+    beat = threading.Thread(target=_boot_beat, daemon=True)
+    beat.start()
+    try:
+        tile = build_tile(wksp, pod, args.tile, opts)
+    finally:
+        boot_done.set()
+        beat.join(timeout=2.0)
     if opts.get("cpu_idx") is not None:
         tile.cpu_idx = int(opts["cpu_idx"])
     tile.run(args.max_ns)

@@ -153,6 +153,57 @@ def lib() -> ctypes.CDLL:
     return _lib
 
 
+class Alloc:
+    """Concurrent sizeclass allocator inside a wksp region (fd_alloc
+    analog; native/alloc.cc). malloc/free return/take workspace offsets
+    so any process sharing the file can pass allocations around."""
+
+    def __init__(self, wksp: "Workspace", name: str,
+                 heap_sz: int | None = None, create: bool = False):
+        L = lib()
+        L.fd_alloc_footprint.restype = ctypes.c_uint64
+        L.fd_alloc_footprint.argtypes = [ctypes.c_uint64]
+        L.fd_alloc_init.restype = ctypes.c_int
+        L.fd_alloc_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        L.fd_alloc_malloc.restype = ctypes.c_uint64
+        L.fd_alloc_malloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        L.fd_alloc_free.restype = ctypes.c_int
+        L.fd_alloc_free.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        L.fd_alloc_in_use.restype = ctypes.c_uint64
+        L.fd_alloc_in_use.argtypes = [ctypes.c_void_p]
+        L.fd_alloc_max_alloc.restype = ctypes.c_uint64
+        if create:
+            assert heap_sz is not None
+            fp = L.fd_alloc_footprint(heap_sz)
+            off = wksp.alloc(name, fp)
+            self._mem = wksp.laddr(off)
+            if L.fd_alloc_init(self._mem, heap_sz) != 0:
+                raise MemoryError("fd_alloc_init failed")
+        else:
+            off, _ = wksp.query(name)
+            self._mem = wksp.laddr(off)
+        self._wksp = wksp
+        self._region_off = off
+
+    def malloc(self, sz: int) -> int:
+        """-> region-relative offset (0 on exhaustion/oversize)."""
+        return lib().fd_alloc_malloc(self._mem, sz)
+
+    def free(self, gaddr: int) -> None:
+        if lib().fd_alloc_free(self._mem, gaddr) != 0:
+            raise ValueError(f"bad free: {gaddr}")
+
+    def in_use(self) -> int:
+        return lib().fd_alloc_in_use(self._mem)
+
+    def max_alloc(self) -> int:
+        return lib().fd_alloc_max_alloc()
+
+    def view(self, gaddr: int, sz: int):
+        """Writable ctypes view of an allocation (slice-assignable)."""
+        return (ctypes.c_ubyte * sz).from_address(self._mem + gaddr)
+
+
 @dataclass
 class Frag:
     seq: int

@@ -1,0 +1,329 @@
+"""Generic high-performance container layer (fd_tmpl analog).
+
+The reference instantiates ~19 template containers (src/util/tmpl/:
+fd_map_dynamic, fd_treap, fd_heap, fd_prq, fd_deque_dynamic, fd_pool,
+...) as macro-generated C. The TPU-native framework mostly leans on
+Python builtins, but the reference semantics that MATTER — bounded
+capacity, O(1)/O(log n) worst cases, explicit eviction, iteration
+stability — are load-bearing for tiles (tcache, pack) and worth a
+purpose-built layer with tests instead of ad-hoc dict/list use.
+
+This module provides the four shapes the tile code actually needs,
+each matching its fd_tmpl counterpart's contract:
+
+- Pool       — fixed-capacity free-list object pool (fd_pool).
+- MapSlot    — bounded open-addressed hash map with linear probing and
+               tombstone-free deletion (fd_map_dynamic's probe/shift
+               delete semantics).
+- Treap      — randomized balanced BST keyed by (key, heap-priority)
+               with O(log n) expected insert/delete/min (fd_treap).
+- PrioQueue  — binary min-heap with O(log n) push/pop and O(1) peek
+               (fd_prq / fd_heap).
+
+All are allocation-free after construction (fixed slabs, index links —
+the shared-memory-compatible style the reference requires), so they
+could later be backed by a workspace region without API change.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Optional, Tuple
+
+
+class Pool:
+    """Fixed-capacity index pool: acquire()/release() in O(1) (fd_pool).
+
+    Indices are stable handles into caller-owned parallel arrays.
+    """
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._next: List[int] = list(range(1, capacity)) + [-1]
+        self._free_head = 0
+        self._used = 0
+
+    def acquire(self) -> int:
+        """-> index, or -1 when exhausted."""
+        idx = self._free_head
+        if idx < 0:
+            return -1
+        self._free_head = self._next[idx]
+        self._next[idx] = -2  # in-use marker (catches double release)
+        self._used += 1
+        return idx
+
+    def release(self, idx: int) -> None:
+        if not 0 <= idx < self.capacity or self._next[idx] != -2:
+            raise ValueError(f"release of non-acquired index {idx}")
+        self._next[idx] = self._free_head
+        self._free_head = idx
+        self._used -= 1
+
+    def used(self) -> int:
+        return self._used
+
+    def avail(self) -> int:
+        return self.capacity - self._used
+
+
+_EMPTY = object()
+
+
+class MapSlot:
+    """Bounded open-addressed hash map, linear probing, backward-shift
+    deletion (no tombstones — fd_map_dynamic's delete semantics, which
+    keep probe chains short no matter the churn).
+
+    Capacity is rounded up to a power of two; insert fails (KeyError)
+    past the load limit rather than growing — bounded memory is the
+    contract, like the reference's shared-memory maps.
+    """
+
+    def __init__(self, capacity: int, load: float = 0.75):
+        # Size the table so `capacity` entries actually FIT under the
+        # load bound (the caller's worst-case count is the contract).
+        cap = 2
+        while int(cap * load) < max(1, capacity):
+            cap <<= 1
+        self._cap = cap
+        self._mask = cap - 1
+        self._max = max(1, int(cap * load))
+        self._keys: List[Any] = [_EMPTY] * cap
+        self._vals: List[Any] = [None] * cap
+        self._cnt = 0
+
+    def __len__(self) -> int:
+        return self._cnt
+
+    def _slot(self, key) -> int:
+        return hash(key) & self._mask
+
+    def insert(self, key, val) -> None:
+        """Insert or overwrite. KeyError at the bounded-capacity limit."""
+        i = self._slot(key)
+        while True:
+            k = self._keys[i]
+            if k is _EMPTY:
+                if self._cnt >= self._max:
+                    raise KeyError("map full")
+                self._keys[i] = key
+                self._vals[i] = val
+                self._cnt += 1
+                return
+            if k == key:
+                self._vals[i] = val
+                return
+            i = (i + 1) & self._mask
+
+    def query(self, key, default=None):
+        i = self._slot(key)
+        while True:
+            k = self._keys[i]
+            if k is _EMPTY:
+                return default
+            if k == key:
+                return self._vals[i]
+            i = (i + 1) & self._mask
+
+    def __contains__(self, key) -> bool:
+        return self.query(key, _EMPTY) is not _EMPTY
+
+    def remove(self, key) -> bool:
+        """Delete with backward shift; True if the key was present."""
+        i = self._slot(key)
+        while True:
+            k = self._keys[i]
+            if k is _EMPTY:
+                return False
+            if k == key:
+                break
+            i = (i + 1) & self._mask
+        # Backward-shift: re-place every element of the contiguous run
+        # after the hole whose home slot is outside (hole, j].
+        j = i
+        while True:
+            j = (j + 1) & self._mask
+            kj = self._keys[j]
+            if kj is _EMPTY:
+                break
+            home = self._slot(kj)
+            # is `home` NOT in the half-open cyclic interval (i, j]?
+            if ((j - home) & self._mask) >= ((j - i) & self._mask):
+                self._keys[i] = kj
+                self._vals[i] = self._vals[j]
+                i = j
+        self._keys[i] = _EMPTY
+        self._vals[i] = None
+        self._cnt -= 1
+        return True
+
+    def items(self) -> Iterator[Tuple[Any, Any]]:
+        for k, v in zip(self._keys, self._vals):
+            if k is not _EMPTY:
+                yield k, v
+
+
+class Treap:
+    """Randomized treap: BST on key, heap on per-node priority, giving
+    O(log n) expected insert/remove/min and in-order iteration
+    (fd_treap — the reference uses it for pack's pending pool).
+
+    Index-linked over fixed slabs (no per-node objects) so it is
+    shared-memory-shaped like the reference's.
+    """
+
+    def __init__(self, capacity: int, seed: int = 1):
+        self._pool = Pool(capacity)
+        cap = capacity
+        self._key: List[Any] = [None] * cap
+        self._val: List[Any] = [None] * cap
+        self._prio: List[int] = [0] * cap
+        self._left: List[int] = [-1] * cap
+        self._right: List[int] = [-1] * cap
+        self._root = -1
+        self._rng = seed or 1
+
+    def _rand(self) -> int:
+        # xorshift64 — deterministic, cheap, good enough for priorities.
+        x = self._rng
+        x ^= (x << 13) & 0xFFFFFFFFFFFFFFFF
+        x ^= x >> 7
+        x ^= (x << 17) & 0xFFFFFFFFFFFFFFFF
+        self._rng = x
+        return x
+
+    def __len__(self) -> int:
+        return self._pool.used()
+
+    def _merge(self, a: int, b: int) -> int:
+        """Merge treaps a (all keys <= b's keys) and b."""
+        if a < 0:
+            return b
+        if b < 0:
+            return a
+        if self._prio[a] < self._prio[b]:
+            self._right[a] = self._merge(self._right[a], b)
+            return a
+        self._left[b] = self._merge(a, self._left[b])
+        return b
+
+    def _split(self, t: int, key) -> Tuple[int, int]:
+        """-> (treap with keys < key, treap with keys >= key)."""
+        if t < 0:
+            return -1, -1
+        if self._key[t] < key:
+            lo, hi = self._split(self._right[t], key)
+            self._right[t] = lo
+            return t, hi
+        lo, hi = self._split(self._left[t], key)
+        self._left[t] = hi
+        return lo, t
+
+    def insert(self, key, val=None) -> int:
+        """-> node index, or -1 when at capacity. Duplicate keys allowed
+        (stored adjacent in key order), like fd_treap."""
+        idx = self._pool.acquire()
+        if idx < 0:
+            return -1
+        self._key[idx] = key
+        self._val[idx] = val
+        self._prio[idx] = self._rand()
+        self._left[idx] = self._right[idx] = -1
+        lo, hi = self._split(self._root, key)
+        self._root = self._merge(self._merge(lo, idx), hi)
+        return idx
+
+    def remove_min(self) -> Optional[Tuple[Any, Any]]:
+        """Pop the smallest key; None when empty."""
+        if self._root < 0:
+            return None
+        t = self._root
+        parent = -1
+        while self._left[t] >= 0:
+            parent = t
+            t = self._left[t]
+        if parent < 0:
+            self._root = self._right[t]
+        else:
+            self._left[parent] = self._right[t]
+        out = (self._key[t], self._val[t])
+        self._key[t] = self._val[t] = None
+        self._pool.release(t)
+        return out
+
+    def min(self) -> Optional[Tuple[Any, Any]]:
+        if self._root < 0:
+            return None
+        t = self._root
+        while self._left[t] >= 0:
+            t = self._left[t]
+        return (self._key[t], self._val[t])
+
+    def __iter__(self) -> Iterator[Tuple[Any, Any]]:
+        stack: List[int] = []
+        t = self._root
+        while stack or t >= 0:
+            while t >= 0:
+                stack.append(t)
+                t = self._left[t]
+            t = stack.pop()
+            yield (self._key[t], self._val[t])
+            t = self._right[t]
+
+
+class PrioQueue:
+    """Bounded binary min-heap (fd_prq): push/pop O(log n), peek O(1).
+
+    push on a full queue returns False (the caller decides whether to
+    evict via pop or drop the new element — fd_prq leaves policy to the
+    user too).
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._h: List[Tuple[Any, Any]] = []
+
+    def __len__(self) -> int:
+        return len(self._h)
+
+    def push(self, key, val=None) -> bool:
+        if len(self._h) >= self.capacity:
+            return False
+        h = self._h
+        h.append((key, val))
+        i = len(h) - 1
+        while i > 0:
+            p = (i - 1) >> 1
+            if h[p][0] <= h[i][0]:
+                break
+            h[p], h[i] = h[i], h[p]
+            i = p
+        return True
+
+    def peek(self) -> Optional[Tuple[Any, Any]]:
+        return self._h[0] if self._h else None
+
+    def pop(self) -> Optional[Tuple[Any, Any]]:
+        h = self._h
+        if not h:
+            return None
+        out = h[0]
+        last = h.pop()
+        if h:
+            h[0] = last
+            i = 0
+            n = len(h)
+            while True:
+                l, r = 2 * i + 1, 2 * i + 2
+                m = i
+                if l < n and h[l][0] < h[m][0]:
+                    m = l
+                if r < n and h[r][0] < h[m][0]:
+                    m = r
+                if m == i:
+                    break
+                h[i], h[m] = h[m], h[i]
+                i = m
+        return out

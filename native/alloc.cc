@@ -1,0 +1,174 @@
+// Concurrent sizeclass allocator over a workspace region (fd_alloc
+// analog, studied behavior from src/util/alloc/fd_alloc.h: sizeclass
+// bins + lock-free free lists + wksp-backed superblocks; independent
+// implementation).
+//
+// Layout inside one named wksp region:
+//   [ alloc_hdr | class heads[NCLASS] | bump heap ... ]
+// Every pointer is a 32-bit OFFSET from the region base (position-
+// independent: any process mapping the wksp at any address can share
+// the allocator). Free lists are Treiber stacks whose heads pack
+// {offset:32, tag:32} in one 64-bit CAS word — the tag defeats ABA.
+//
+// malloc: sizeclass bin pop; on empty, carve a superblock from the
+// bump cursor and split it into blocks for that class. Blocks carry a
+// one-word header with their class index, so free() needs only the
+// pointer. Requests larger than the top class (see fd_alloc_max_alloc)
+// return 0 — callers with jumbo needs use wksp named allocs directly.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+static constexpr uint32_t ALLOC_MAGIC = 0xFDA110C5u;
+static constexpr int NCLASS = 24;
+static constexpr uint64_t SUPER_SZ = 1ull << 16;  // 64 KiB superblocks
+
+// Geometric-ish sizeclasses, 16-byte aligned, up to 48 KiB.
+static const uint32_t kClassSz[NCLASS] = {
+    16,   24,   32,   48,   64,    96,    128,   192,
+    256,  384,  512,  768,  1024,  1536,  2048,  3072,
+    4096, 6144, 8192, 12288, 16384, 24576, 32768, 49152,
+};
+
+struct alloc_hdr {
+  uint32_t magic;
+  uint32_t pad;
+  uint64_t heap_sz;                       // bytes after the header
+  std::atomic<uint64_t> bump;             // next free heap offset
+  std::atomic<uint64_t> head[NCLASS];     // {tag:32 | off:32}, off 0 = null
+  std::atomic<uint64_t> in_use;           // live bytes (diagnostics)
+};
+
+struct blk_hdr {
+  uint32_t cls;       // sizeclass index
+  uint32_t canary;    // guards double-free / wild-free
+};
+static constexpr uint32_t BLK_LIVE = 0xB10CB10Cu;
+static constexpr uint32_t BLK_FREE = 0xF4EEF4EEu;
+
+static inline alloc_hdr* H(void* region) {
+  return reinterpret_cast<alloc_hdr*>(region);
+}
+static inline uint8_t* heap_base(void* region) {
+  return reinterpret_cast<uint8_t*>(region) + sizeof(alloc_hdr);
+}
+
+uint64_t fd_alloc_footprint(uint64_t heap_sz) {
+  return sizeof(alloc_hdr) + heap_sz;
+}
+
+int fd_alloc_init(void* region, uint64_t heap_sz) {
+  if (heap_sz >= (1ull << 32)) return -1;  // offsets are 32-bit
+  auto* h = H(region);
+  std::memset(region, 0, sizeof(alloc_hdr));
+  h->heap_sz = heap_sz;
+  h->bump.store(16, std::memory_order_relaxed);  // off 0 reserved = null
+  h->magic = ALLOC_MAGIC;
+  return 0;
+}
+
+static int class_for(uint64_t sz) {
+  for (int i = 0; i < NCLASS; i++)
+    if (kClassSz[i] >= sz) return i;
+  return -1;
+}
+
+// The freelist "next" link occupies the block's first word — the same
+// word the live-block header reuses for its class index. A popping
+// thread may read it concurrently with the new owner's header write
+// (benign under the tag CAS, but a formal data race), so EVERY access
+// to that word goes through an atomic view. TSan-clean by contract,
+// like the tango ring publishes.
+static inline std::atomic<uint32_t>* word0(uint8_t* base, uint32_t off) {
+  return reinterpret_cast<std::atomic<uint32_t>*>(base + off);
+}
+
+// Pop a block offset from class c; 0 if the list is empty.
+static uint64_t list_pop(alloc_hdr* h, uint8_t* base, int c) {
+  uint64_t cur = h->head[c].load(std::memory_order_acquire);
+  for (;;) {
+    uint32_t off = (uint32_t)cur;
+    if (!off) return 0;
+    uint32_t next = word0(base, off)->load(std::memory_order_relaxed);
+    uint64_t tag = (cur >> 32) + 1;
+    uint64_t want = (tag << 32) | next;
+    if (h->head[c].compare_exchange_weak(cur, want,
+                                         std::memory_order_acq_rel,
+                                         std::memory_order_acquire))
+      return off;
+  }
+}
+
+static void list_push(alloc_hdr* h, uint8_t* base, int c, uint32_t off) {
+  uint64_t cur = h->head[c].load(std::memory_order_acquire);
+  for (;;) {
+    word0(base, off)->store((uint32_t)cur, std::memory_order_relaxed);
+    uint64_t tag = (cur >> 32) + 1;
+    uint64_t want = (tag << 32) | off;
+    if (h->head[c].compare_exchange_weak(cur, want,
+                                         std::memory_order_acq_rel,
+                                         std::memory_order_acquire))
+      return;
+  }
+}
+
+// Returns the offset (from region base) of a usable block of >= sz
+// bytes, or 0 on exhaustion. Thread- and process-safe.
+uint64_t fd_alloc_malloc(void* region, uint64_t sz) {
+  auto* h = H(region);
+  if (h->magic != ALLOC_MAGIC || sz == 0) return 0;
+  int c = class_for(sz + sizeof(blk_hdr));
+  if (c < 0) return 0;  // oversize: not served by the bin allocator
+  uint8_t* base = heap_base(region);
+  uint64_t off = list_pop(h, base, c);
+  if (!off) {
+    // Carve a superblock for this class from the bump region.
+    uint32_t bsz = kClassSz[c];
+    uint64_t n = SUPER_SZ / bsz;
+    if (n == 0) n = 1;
+    uint64_t need = n * (uint64_t)bsz;
+    uint64_t start = h->bump.fetch_add(need, std::memory_order_relaxed);
+    if (start + need > h->heap_sz) {
+      h->bump.fetch_sub(need, std::memory_order_relaxed);
+      return 0;  // heap exhausted
+    }
+    // Keep the first block; push the rest.
+    off = start;
+    for (uint64_t i = 1; i < n; i++)
+      list_push(h, base, c, (uint32_t)(start + i * bsz));
+  }
+  auto* bh = reinterpret_cast<blk_hdr*>(base + off);
+  word0(base, (uint32_t)off)->store((uint32_t)c, std::memory_order_relaxed);
+  bh->canary = BLK_LIVE;
+  h->in_use.fetch_add(kClassSz[c], std::memory_order_relaxed);
+  return (uint64_t)(base - (uint8_t*)region) + off + sizeof(blk_hdr);
+}
+
+// gaddr must be a value returned by fd_alloc_malloc. Returns 0 ok,
+// -1 on corruption / double free.
+int fd_alloc_free(void* region, uint64_t gaddr) {
+  auto* h = H(region);
+  if (h->magic != ALLOC_MAGIC || gaddr < sizeof(alloc_hdr) + sizeof(blk_hdr)
+      || gaddr >= sizeof(alloc_hdr) + h->heap_sz)
+    return -1;
+  uint8_t* base = heap_base(region);
+  uint64_t off = gaddr - sizeof(alloc_hdr) - sizeof(blk_hdr);
+  auto* bh = reinterpret_cast<blk_hdr*>(base + off);
+  uint32_t cls = word0(base, (uint32_t)off)->load(std::memory_order_relaxed);
+  if (bh->canary != BLK_LIVE || cls >= NCLASS) return -1;
+  bh->canary = BLK_FREE;
+  h->in_use.fetch_sub(kClassSz[cls], std::memory_order_relaxed);
+  list_push(h, base, (int)cls, (uint32_t)off);
+  return 0;
+}
+
+uint64_t fd_alloc_in_use(void* region) {
+  return H(region)->in_use.load(std::memory_order_relaxed);
+}
+
+uint64_t fd_alloc_max_alloc() { return kClassSz[NCLASS - 1] - sizeof(blk_hdr); }
+
+}  // extern "C"

@@ -1,0 +1,113 @@
+"""Property tests for the generic container layer (fd_tmpl analogs):
+every structure is differentially tested against a Python reference
+model under randomized operation streams."""
+
+import random
+
+import pytest
+
+from firedancer_tpu.utils.containers import MapSlot, Pool, PrioQueue, Treap
+
+
+def test_pool_acquire_release_cycle():
+    p = Pool(8)
+    idxs = [p.acquire() for _ in range(8)]
+    assert sorted(idxs) == list(range(8))
+    assert p.acquire() == -1
+    assert p.avail() == 0
+    for i in idxs[:4]:
+        p.release(i)
+    assert p.avail() == 4
+    with pytest.raises(ValueError):
+        p.release(idxs[0])  # double release
+    got = {p.acquire() for _ in range(4)}
+    assert got == set(idxs[:4])
+
+
+def test_mapslot_vs_dict_random_ops():
+    rng = random.Random(3)
+    m = MapSlot(256)
+    ref = {}
+    for step in range(20_000):
+        op = rng.random()
+        key = rng.randint(0, 300)
+        if op < 0.5 and len(ref) < 190:  # stay under the load bound
+            m.insert(key, step)
+            ref[key] = step
+        elif op < 0.8:
+            assert m.remove(key) == (key in ref)
+            ref.pop(key, None)
+        else:
+            assert m.query(key, -1) == ref.get(key, -1)
+        if step % 997 == 0:
+            assert len(m) == len(ref)
+            assert dict(m.items()) == ref
+    assert dict(m.items()) == ref
+
+
+def test_mapslot_bounded():
+    m = MapSlot(16, load=0.5)
+    inserted = 0
+    with pytest.raises(KeyError):
+        for k in range(100):
+            m.insert(("k", k), k)
+            inserted += 1
+    assert inserted == len(m)
+
+
+def test_treap_ordered_and_random():
+    rng = random.Random(7)
+    t = Treap(512)
+    ref = []
+    for step in range(6_000):
+        if rng.random() < 0.6 and len(ref) < 512:
+            k = rng.randint(0, 10_000)
+            assert t.insert(k, step) >= 0
+            ref.append(k)
+        elif ref:
+            got = t.remove_min()
+            ref.sort()
+            want = ref.pop(0)
+            assert got[0] == want
+        if step % 501 == 0:
+            assert len(t) == len(ref)
+            assert [k for k, _ in t] == sorted(ref)
+    assert [k for k, _ in t] == sorted(ref)
+
+
+def test_treap_capacity():
+    t = Treap(4)
+    for k in range(4):
+        assert t.insert(k) >= 0
+    assert t.insert(99) == -1
+    assert t.remove_min()[0] == 0
+    assert t.insert(99) >= 0
+
+
+def test_prioqueue_vs_heapq():
+    import heapq
+
+    rng = random.Random(11)
+    q = PrioQueue(128)
+    ref = []
+    for _ in range(10_000):
+        if rng.random() < 0.55 and len(ref) < 128:
+            k = rng.randint(0, 1000)
+            assert q.push(k)
+            heapq.heappush(ref, k)
+        elif ref:
+            assert q.pop()[0] == heapq.heappop(ref)
+        else:
+            assert q.pop() is None
+        if ref:
+            assert q.peek()[0] == ref[0]
+    while ref:
+        assert q.pop()[0] == heapq.heappop(ref)
+
+
+def test_prioqueue_bounded():
+    q = PrioQueue(2)
+    assert q.push(3) and q.push(1)
+    assert not q.push(2)  # full: caller chooses eviction policy
+    assert q.pop()[0] == 1
+    assert q.push(2)

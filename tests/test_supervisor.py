@@ -35,6 +35,44 @@ def test_supervised_pipeline_end_to_end(tmp_path, corpus):
     assert res.supervisor_restarts == 0
 
 
+def test_crash_midflight_staged_batches_not_lost(tmp_path):
+    """Kill the verify tile EARLY, while device batches are staged or in
+    flight (tpu backend, small batches): the held-back ack cursor must
+    leave every consumed-but-unverified txn re-readable, so delivery is
+    still content-exact. This is the window a consumed-seq fseq would
+    lose txns in."""
+    corpus = mainnet_corpus(3000, seed=21, dup_rate=0.0, corrupt_rate=0.0,
+                            parse_err_rate=0.0, max_data_sz=64)
+    topo = build_topology(str(tmp_path / "mid.wksp"), depth=64)
+    state = {"kills": 0}
+    from firedancer_tpu.tango.rings import DIAG_PUB_CNT, FSeq, Workspace
+
+    wksp = Workspace.join(topo.wksp_path)
+    sink_fseq = FSeq(wksp, topo.pod.query_cstr("firedancer.pack_sink.fseq"))
+
+    def fault(tiles, elapsed):
+        # Kill verify once flow has started but well before the corpus
+        # drains — device batches are guaranteed staged or in flight.
+        tp = tiles["verify"]
+        delivered = sink_fseq.diag(DIAG_PUB_CNT)
+        if (state["kills"] == 0 and tp.proc.poll() is None
+                and 10 <= delivered < 2500):
+            os.kill(tp.proc.pid, signal.SIGKILL)
+            state["kills"] += 1
+
+    res = run_pipeline_supervised(
+        topo, corpus.payloads, verify_backend="tpu", verify_batch=128,
+        verify_max_msg_len=192, timeout_s=240.0, fault_hook=fault,
+        record_digests=True, jax_platform="cpu",
+    )
+    assert state["kills"] >= 1
+    assert res.supervisor_restarts >= state["kills"]
+    assert res.recv_cnt == corpus.n_unique_ok, res.diag
+    from firedancer_tpu.disco.corpus import sink_mismatch_count
+
+    assert sink_mismatch_count(corpus, res.sink_digests) == 0
+
+
 def test_crash_only_restart_heals_pipeline(tmp_path, corpus):
     topo = build_topology(str(tmp_path / "crash.wksp"), depth=64)
     state = {"killed": False}
