@@ -23,7 +23,7 @@ fi
 ./build/tango_stress
 ./build/alloc_stress
 
-echo "== pytest =="
+echo "== pytest (full lane; quick lane is: pytest -m 'not slow') =="
 python -m pytest tests/ -x -q
 
 echo "== fuzz smoke (10k iters/target) =="

@@ -44,6 +44,10 @@ DEFAULTS: Dict[str, Any] = {
         },
         "quic": {
             "identity_seed_path": "",  # "" = generated under scratch
+            # Stateless Retry for the public ingest port (RFC 9000
+            # §8.1.2): spoofed-source Initial floods allocate no state.
+            # Costs legitimate clients one extra round trip.
+            "retry": False,
         },
     },
     "development": {

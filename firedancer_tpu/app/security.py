@@ -27,15 +27,46 @@ class Requirement:
     detail: str
 
 
+def _capget_bits() -> int | None:
+    """Effective capability bits via capget(2) — needs no /proc.
+
+    _LINUX_CAPABILITY_VERSION_3 uses two 32-bit data slots (low/high
+    words of the 64-bit sets). Returns None if the call is unavailable.
+    """
+    import ctypes
+
+    class _Hdr(ctypes.Structure):
+        _fields_ = [("version", ctypes.c_uint32), ("pid", ctypes.c_int)]
+
+    class _Data(ctypes.Structure):
+        _fields_ = [
+            ("effective", ctypes.c_uint32),
+            ("permitted", ctypes.c_uint32),
+            ("inheritable", ctypes.c_uint32),
+        ]
+
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        hdr = _Hdr(0x20080522, 0)
+        data = (_Data * 2)()
+        if libc.capget(ctypes.byref(hdr), ctypes.byref(data)) != 0:
+            return None
+        return data[0].effective | (data[1].effective << 32)
+    except Exception:
+        return None
+
+
 def _cap_bits() -> int:
     """Effective capability bits of this process.
 
     When /proc is unavailable (chroot, minimal container) CapEff cannot
-    be read; fall back to euid — real root without /proc should still
-    report its capabilities rather than claim it has none. The euid
-    fallback is ONLY used when the file is unreadable, never to override
-    a readable CapEff (a capability-dropped root container must report
-    what CapEff says).
+    be read; probe capget(2) directly instead. euid is deliberately
+    NOT consulted: euid 0 is routine in capability-dropped containers
+    and user namespaces, and inferring a full mask from it would let
+    requirement checks pass for capabilities the process does not hold
+    (round-2 ADVICE finding). If both probes fail, claim nothing — an
+    under-claim fails loudly at the operation, an over-claim fails
+    silently in production.
     """
     try:
         with open("/proc/self/status") as f:
@@ -43,7 +74,9 @@ def _cap_bits() -> int:
                 if line.startswith("CapEff:"):
                     return int(line.split()[1], 16)
     except OSError:
-        return (1 << 41) - 1 if os.geteuid() == 0 else 0
+        bits = _capget_bits()
+        if bits is not None:
+            return bits
     return 0
 
 
@@ -101,14 +134,44 @@ def _no_new_privs_settable() -> bool:
         return False
 
 
+def _thp_page_size() -> int:
+    """Transparent-hugepage size the wksp's MADV_HUGEPAGE can use
+    (native/tango.cc fd_wksp_page_probe); 0 when THP is off. Falls back
+    to reading /sys directly if the native library is unavailable."""
+    try:
+        from firedancer_tpu.tango.rings import lib
+
+        return int(lib().fd_wksp_page_probe())
+    except Exception:
+        try:
+            with open(
+                "/sys/kernel/mm/transparent_hugepage/enabled"
+            ) as f:
+                if "[never]" in f.read():
+                    return 0
+            with open(
+                "/sys/kernel/mm/transparent_hugepage/hpage_pmd_size"
+            ) as f:
+                return int(f.read().strip())
+        except OSError:
+            return 0
+
+
 def check() -> List[Requirement]:
     """Probe every privilege the configure/run stages can use."""
     reqs = [
         Requirement(
             "root-or-sys-admin",
-            "hugepage mounts + sysctl stages (N/A here: plain mmap wksp)",
+            "hugetlbfs mounts + sysctl stages (reference fd_shmem ladder)",
             _has_cap(CAP_SYS_ADMIN),
             f"euid={os.geteuid()} capeff={_cap_bits():#x}",
+        ),
+        Requirement(
+            "hugepages",
+            "TLB relief for workspace mappings (wksp madvise(MADV_HUGEPAGE))",
+            _thp_page_size() > 0,
+            f"transparent_hugepage pmd size={_thp_page_size()} bytes"
+            " (0 = THP disabled; wksp falls back to base pages)",
         ),
         Requirement(
             "net-raw",

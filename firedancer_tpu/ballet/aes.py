@@ -93,13 +93,30 @@ def _expand_key(key: bytes) -> List[int]:
 
 
 class Aes:
-    """Encrypt-only AES block cipher (the only direction GCM/CTR/HP need)."""
+    """Encrypt-only AES block cipher (the only direction GCM/CTR/HP need).
+
+    AES-128 single blocks (the QUIC header-protection mask — one per
+    packet) take the AES-NI path when available."""
 
     def __init__(self, key: bytes):
-        self._rk = _expand_key(key)
+        self._rk_lazy = None  # key schedule built on first Python-path use
         self._nr = len(key) // 4 + 6
+        self._key = key
+        self._nat = _native_aes() if len(key) == 16 else None
+
+    @property
+    def _rk(self):
+        if self._rk_lazy is None:
+            self._rk_lazy = _expand_key(self._key)
+        return self._rk_lazy
 
     def encrypt_block(self, block: bytes) -> bytes:
+        if self._nat is not None:
+            import ctypes
+
+            out = ctypes.create_string_buffer(16)
+            self._nat.fd_aes128_encrypt_block(self._key, block, out)
+            return out.raw
         rk = self._rk
         s0, s1, s2, s3 = struct.unpack(">4I", block)
         s0 ^= rk[0]
@@ -235,14 +252,73 @@ class _Ghash:
         return y.to_bytes(16, "big")
 
 
+def _native_aes():
+    """ctypes handle to the AES-NI/PCLMUL backend (native/aes_gcm.cc),
+    or None when the library or the CPU features are unavailable. One
+    datagram is ~75 AES blocks; the QUIC tile's throughput ceiling IS
+    this function — the bytecode implementation below stays as the
+    portable fallback and the differential oracle."""
+    global _NATIVE
+    if _NATIVE is not _UNSET:
+        return _NATIVE
+    _NATIVE = None
+    try:
+        import ctypes
+        import os
+
+        lib_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "build", "libfdtango.so")
+        if os.path.exists(lib_path):
+            lib = ctypes.CDLL(lib_path)
+            lib.fd_aes128_has_ni.restype = ctypes.c_int
+            if lib.fd_aes128_has_ni():  # noqa: SIM102
+                lib.fd_aes128_gcm_seal.argtypes = [
+                    ctypes.c_char_p, ctypes.c_char_p,
+                    ctypes.c_char_p, ctypes.c_uint64,
+                    ctypes.c_char_p, ctypes.c_uint64,
+                    ctypes.c_void_p, ctypes.c_void_p]
+                lib.fd_aes128_gcm_open.restype = ctypes.c_int
+                lib.fd_aes128_gcm_open.argtypes = [
+                    ctypes.c_char_p, ctypes.c_char_p,
+                    ctypes.c_char_p, ctypes.c_uint64,
+                    ctypes.c_char_p, ctypes.c_uint64,
+                    ctypes.c_char_p, ctypes.c_void_p]
+                lib.fd_aes128_encrypt_block.argtypes = [
+                    ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p]
+                _NATIVE = lib
+    except (OSError, AttributeError):
+        # AttributeError: a STALE build/libfdtango.so predating the AES
+        # symbols — the contract is "None when unavailable", never an
+        # exception out of every cipher construction.
+        _NATIVE = None
+    return _NATIVE
+
+
+_UNSET = object()
+_NATIVE = _UNSET
+
+
 class AesGcm:
-    """AES-GCM AEAD with a 16-byte tag (the TLS 1.3 / QUIC suite shape)."""
+    """AES-GCM AEAD with a 16-byte tag (the TLS 1.3 / QUIC suite shape).
+
+    AES-128 keys ride the AES-NI native path when available (bit-exact
+    differential test: tests/test_quic_crypto.py); other key sizes and
+    non-NI hosts use the pure-Python implementation."""
 
     TAG_SZ = 16
 
     def __init__(self, key: bytes):
         self._aes = Aes(key)
-        self._ghash = _Ghash(self._aes.encrypt_block(bytes(16)))
+        self._ghash_lazy = None  # table built only on the Python path
+        self._key = key
+        self._nat = _native_aes() if len(key) == 16 else None
+
+    @property
+    def _ghash(self):
+        if self._ghash_lazy is None:
+            self._ghash_lazy = _Ghash(self._aes.encrypt_block(bytes(16)))
+        return self._ghash_lazy
 
     def _j0(self, iv: bytes) -> bytes:
         if len(iv) == 12:
@@ -250,6 +326,15 @@ class AesGcm:
         return self._ghash.digest(b"", iv)
 
     def seal(self, iv: bytes, plaintext: bytes, aad: bytes) -> bytes:
+        if self._nat is not None and len(iv) == 12:
+            import ctypes
+
+            ct = ctypes.create_string_buffer(max(len(plaintext), 1))
+            tag = ctypes.create_string_buffer(16)
+            self._nat.fd_aes128_gcm_seal(
+                self._key, iv, aad, len(aad), plaintext, len(plaintext),
+                ct, tag)
+            return ct.raw[: len(plaintext)] + tag.raw
         j0 = self._j0(iv)
         ctr1 = j0[:12] + struct.pack(">I", struct.unpack(">I", j0[12:])[0] + 1)
         ct = self._aes.ctr_xor(ctr1, plaintext)
@@ -262,6 +347,15 @@ class AesGcm:
         if len(sealed) < self.TAG_SZ:
             raise ValueError("gcm: ciphertext shorter than tag")
         ct, tag = sealed[: -self.TAG_SZ], sealed[-self.TAG_SZ :]
+        if self._nat is not None and len(iv) == 12:
+            import ctypes
+
+            pt = ctypes.create_string_buffer(max(len(ct), 1))
+            rc = self._nat.fd_aes128_gcm_open(
+                self._key, iv, aad, len(aad), ct, len(ct), tag, pt)
+            if rc != 0:
+                raise ValueError("gcm: authentication tag mismatch")
+            return pt.raw[: len(ct)]
         j0 = self._j0(iv)
         s = self._ghash.digest(aad, ct)
         expect = bytes(a ^ b for a, b in zip(self._aes.encrypt_block(j0), s))

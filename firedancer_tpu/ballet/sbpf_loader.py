@@ -2,8 +2,8 @@
 
 Role parity with the reference's ballet/sbpf (/root/reference/src/ballet/
 sbpf/fd_sbpf_loader.h:4-31: section placement + dynamic relocation, plus
-the murmur3-hashed calldests map) and ballet/elf (fd_elf64.h minimal
-ELF64 types/validation).
+the murmur3-hashed calldests map), built on the standalone validated
+ELF64 layer (ballet/elf.py, the fd_elf64.h analog).
 
 Model (matching the reference loader's behavior, which mirrors the
 Solana program loader): the *whole ELF file image* becomes the read-only
@@ -19,27 +19,26 @@ from __future__ import annotations
 
 import struct
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
+from firedancer_tpu.ballet import elf as elf_mod
+from firedancer_tpu.ballet.elf import (  # re-exported for callers
+    EM_BPF,
+    EM_SBPF,
+    ET_DYN,
+    ET_EXEC,
+    R_BPF_64_32,
+    R_BPF_64_64,
+    R_BPF_64_RELATIVE,
+    SHT_DYNSYM,
+    SHT_REL,
+    SHT_STRTAB,
+    SHT_SYMTAB,
+    STT_FUNC,
+)
 from firedancer_tpu.ballet.murmur3 import murmur3_32
 
 MM_PROGRAM = 0x1_00000000
-
-# ELF constants (fd_elf64.h)
-EM_BPF = 247
-EM_SBPF = 263
-ET_DYN = 3
-ET_EXEC = 2
-SHT_SYMTAB = 2
-SHT_STRTAB = 3
-SHT_REL = 9
-SHT_DYNSYM = 11
-STT_FUNC = 2
-
-# sBPF relocation types (fd_sbpf_loader.c)
-R_BPF_64_64 = 1
-R_BPF_64_RELATIVE = 8
-R_BPF_64_32 = 10
 
 
 class SbpfLoaderError(Exception):
@@ -54,32 +53,6 @@ def pc_hash(target_pc: int) -> int:
 def name_hash(name: bytes) -> int:
     """Syscall key: murmur3_32 over the symbol name."""
     return murmur3_32(name, 0)
-
-
-@dataclass
-class _Shdr:
-    name: str
-    sh_type: int
-    flags: int
-    addr: int
-    offset: int
-    size: int
-    link: int
-    info: int
-    entsize: int
-
-
-@dataclass
-class _Sym:
-    name: bytes
-    value: int
-    size: int
-    info: int
-    shndx: int
-
-    @property
-    def is_func(self) -> bool:
-        return (self.info & 0xF) == STT_FUNC
 
 
 @dataclass
@@ -106,61 +79,6 @@ class SbpfProgram:
         return vm
 
 
-def _parse_shdrs(elf: bytes) -> Tuple[List[_Shdr], int]:
-    if len(elf) < 64 or elf[:4] != b"\x7fELF":
-        raise SbpfLoaderError("bad ELF magic")
-    ei_class, ei_data = elf[4], elf[5]
-    if ei_class != 2 or ei_data != 1:
-        raise SbpfLoaderError("need ELF64 little-endian")
-    (e_type, e_machine) = struct.unpack_from("<HH", elf, 16)
-    if e_machine not in (EM_BPF, EM_SBPF):
-        raise SbpfLoaderError(f"bad machine {e_machine}")
-    if e_type not in (ET_DYN, ET_EXEC):
-        raise SbpfLoaderError(f"bad type {e_type}")
-    (e_entry,) = struct.unpack_from("<Q", elf, 24)
-    (e_shoff,) = struct.unpack_from("<Q", elf, 40)
-    (e_shentsize, e_shnum, e_shstrndx) = struct.unpack_from("<HHH", elf, 58)
-    if e_shentsize != 64 or e_shoff + e_shnum * 64 > len(elf):
-        raise SbpfLoaderError("bad section header table")
-    raw = []
-    for i in range(e_shnum):
-        (nm, ty, fl, ad, off, sz, ln, inf, _al, ent) = struct.unpack_from(
-            "<IIQQQQIIQQ", elf, e_shoff + i * 64
-        )
-        raw.append((nm, ty, fl, ad, off, sz, ln, inf, ent))
-    # section name strings
-    if e_shstrndx >= e_shnum:
-        raise SbpfLoaderError("bad shstrndx")
-    stroff, strsz = raw[e_shstrndx][4], raw[e_shstrndx][5]
-    strtab = elf[stroff : stroff + strsz]
-
-    def sname(nm: int) -> str:
-        end = strtab.find(b"\0", nm)
-        return strtab[nm:end].decode(errors="replace")
-
-    shdrs = [
-        _Shdr(sname(nm), ty, fl, ad, off, sz, ln, inf, ent)
-        for (nm, ty, fl, ad, off, sz, ln, inf, ent) in raw
-    ]
-    return shdrs, e_entry
-
-
-def _parse_syms(elf: bytes, symtab: _Shdr, shdrs: List[_Shdr]) -> List[_Sym]:
-    if symtab.link >= len(shdrs):
-        raise SbpfLoaderError("symtab bad strtab link")
-    st = shdrs[symtab.link]
-    strtab = elf[st.offset : st.offset + st.size]
-    syms = []
-    n = symtab.size // 24
-    for i in range(n):
-        (nm, info, _other, shndx, value, size) = struct.unpack_from(
-            "<IBBHQQ", elf, symtab.offset + i * 24
-        )
-        end = strtab.find(b"\0", nm)
-        syms.append(_Sym(strtab[nm:end], value, size, info, shndx))
-    return syms
-
-
 def load_program(
     elf: bytes, syscall_hashes: Optional[set] = None
 ) -> SbpfProgram:
@@ -179,35 +97,47 @@ def load_program(
         )
 
         syscall_hashes = {syscall_hash(n) for n in BUILTIN_SYSCALLS}
-    shdrs, e_entry = _parse_shdrs(elf)
-    text = next((s for s in shdrs if s.name == ".text"), None)
-    if text is None or text.size == 0 or text.size % 8:
+    try:
+        image = elf_mod.Elf64(elf)
+    except elf_mod.ElfError as ex:
+        raise SbpfLoaderError(str(ex)) from ex
+    if image.ehdr.e_machine not in (EM_BPF, EM_SBPF):
+        raise SbpfLoaderError(f"bad machine {image.ehdr.e_machine}")
+    if image.ehdr.e_type not in (ET_DYN, ET_EXEC):
+        raise SbpfLoaderError(f"bad type {image.ehdr.e_type}")
+    shdrs, e_entry = image.shdrs, image.ehdr.e_entry
+    text = image.section_by_name(".text")
+    if text is None or text.sh_size == 0 or text.sh_size % 8:
         raise SbpfLoaderError("missing/odd .text")
-    if text.offset + text.size > len(elf):
+    if text.sh_offset + text.sh_size > len(elf):
         raise SbpfLoaderError(".text out of file bounds")
     rodata = bytearray(elf)
-    text_cnt = text.size // 8
+    text_cnt = text.sh_size // 8
 
     # symbols: prefer .symtab, fall back to .dynsym
     symtab = next((s for s in shdrs if s.sh_type == SHT_SYMTAB), None)
     if symtab is None:
         symtab = next((s for s in shdrs if s.sh_type == SHT_DYNSYM), None)
-    syms = _parse_syms(elf, symtab, shdrs) if symtab else []
+    try:
+        syms = image.symbols(symtab) if symtab else []
+    except elf_mod.ElfError as ex:
+        raise SbpfLoaderError(str(ex)) from ex
 
     calldests: Dict[int, int] = {}
 
-    def sym_pc(sym: _Sym) -> int:
+    def sym_pc(sym: elf_mod.Sym) -> int:
         """Instruction slot index of a function symbol (st_value is a
         section vaddr; flat sBPF ELFs set sh_addr == sh_offset)."""
-        off = sym.value - text.addr + text.offset
-        if off < text.offset or off >= text.offset + text.size or off % 8:
+        off = sym.st_value - text.sh_addr + text.sh_offset
+        if (off < text.sh_offset or off >= text.sh_offset + text.sh_size
+                or off % 8):
             raise SbpfLoaderError(f"func sym {sym.name!r} outside .text")
-        return (off - text.offset) // 8
+        return (off - text.sh_offset) // 8
 
     # register every defined function symbol (fd_sbpf_loader registers
     # calldests for FUNC syms so `call hash` can resolve)
     for sym in syms:
-        if sym.is_func and sym.name and sym.shndx != 0:
+        if sym.is_func and sym.name and sym.st_shndx != 0:
             try:
                 calldests[pc_hash(sym_pc(sym))] = sym_pc(sym)
             except SbpfLoaderError:
@@ -216,15 +146,20 @@ def load_program(
     # apply relocations from every SHT_REL section
     for rel_sec in [s for s in shdrs if s.sh_type == SHT_REL]:
         rel_syms = syms
-        if rel_sec.link < len(shdrs) and shdrs[rel_sec.link].sh_type in (
+        if rel_sec.sh_link < len(shdrs) and shdrs[rel_sec.sh_link].sh_type in (
             SHT_SYMTAB,
             SHT_DYNSYM,
         ):
-            rel_syms = _parse_syms(elf, shdrs[rel_sec.link], shdrs)
-        n = rel_sec.size // 16
+            try:
+                rel_syms = image.symbols(shdrs[rel_sec.sh_link])
+            except elf_mod.ElfError as ex:
+                raise SbpfLoaderError(str(ex)) from ex
+        if rel_sec.sh_offset + rel_sec.sh_size > len(elf):
+            raise SbpfLoaderError("rel section out of bounds")
+        n = rel_sec.sh_size // 16
         for i in range(n):
             (r_offset, r_info) = struct.unpack_from(
-                "<QQ", elf, rel_sec.offset + i * 16
+                "<QQ", elf, rel_sec.sh_offset + i * 16
             )
             r_type = r_info & 0xFFFFFFFF
             r_sym = r_info >> 32
@@ -245,18 +180,18 @@ def load_program(
     # loader does), else the `entrypoint` symbol, else slot 0
     entry_pc = 0
     if e_entry:
-        off = e_entry - text.addr + text.offset
-        if not (text.offset <= off < text.offset + text.size) or off % 8:
+        off = e_entry - text.sh_addr + text.sh_offset
+        if not (text.sh_offset <= off < text.sh_offset + text.sh_size) or off % 8:
             raise SbpfLoaderError(f"e_entry 0x{e_entry:x} outside .text")
-        entry_pc = (off - text.offset) // 8
+        entry_pc = (off - text.sh_offset) // 8
     else:
         for sym in syms:
-            if sym.name == b"entrypoint" and sym.is_func:
+            if sym.name == "entrypoint" and sym.is_func:
                 entry_pc = sym_pc(sym)
                 break
     return SbpfProgram(
         rodata=bytes(rodata),
-        text_off=text.offset,
+        text_off=text.sh_offset,
         text_cnt=text_cnt,
         entry_pc=entry_pc,
         calldests=calldests,
@@ -265,10 +200,10 @@ def load_program(
 
 def _apply_reloc(
     rodata: bytearray,
-    text: _Shdr,
+    text: elf_mod.Shdr,
     r_offset: int,
     r_type: int,
-    sym: Optional[_Sym],
+    sym: Optional[elf_mod.Sym],
     calldests: Dict[int, int],
 ) -> None:
     if r_offset + 8 > len(rodata):
@@ -277,7 +212,7 @@ def _apply_reloc(
     def imm_off(slot_off: int) -> int:
         return slot_off + 4  # imm field at byte 4 of the 8-byte slot
 
-    in_text = text.offset <= r_offset < text.offset + text.size
+    in_text = text.sh_offset <= r_offset < text.sh_offset + text.sh_size
 
     if r_type == R_BPF_64_64:
         # lddw pair: 64-bit sym address split across two imm fields
@@ -289,7 +224,7 @@ def _apply_reloc(
         addend = struct.unpack_from("<I", rodata, lo_off)[0] | (
             struct.unpack_from("<I", rodata, hi_off)[0] << 32
         )
-        va = (MM_PROGRAM + sym.value + addend) & ((1 << 64) - 1)
+        va = (MM_PROGRAM + sym.st_value + addend) & ((1 << 64) - 1)
         struct.pack_into("<I", rodata, lo_off, va & 0xFFFFFFFF)
         struct.pack_into("<I", rodata, hi_off, va >> 32)
     elif r_type == R_BPF_64_RELATIVE:
@@ -315,15 +250,20 @@ def _apply_reloc(
         # in calldests); undefined symbol -> syscall name hash
         if sym is None:
             raise SbpfLoaderError("R_BPF_64_32 without symbol")
-        if sym.shndx != 0 and sym.is_func:
-            off = sym.value - text.addr + text.offset
-            if off % 8 or not (text.offset <= off < text.offset + text.size):
+        if sym.st_shndx != 0 and sym.is_func:
+            off = sym.st_value - text.sh_addr + text.sh_offset
+            if off % 8 or not (
+                text.sh_offset <= off < text.sh_offset + text.sh_size
+            ):
                 raise SbpfLoaderError(f"call target {sym.name!r} outside .text")
-            pc = (off - text.offset) // 8
+            pc = (off - text.sh_offset) // 8
             h = pc_hash(pc)
             calldests[h] = pc
         else:
-            h = name_hash(sym.name)
+            # Hash the RAW strtab bytes, not a UTF-8 round trip: a
+            # non-UTF-8 symbol name must produce the same imm the
+            # reference loader writes (bit-exact image parity).
+            h = name_hash(sym.name_bytes)
         struct.pack_into("<I", rodata, imm_off(r_offset), h)
     else:
         raise SbpfLoaderError(f"unsupported reloc type {r_type}")

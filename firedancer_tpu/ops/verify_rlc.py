@@ -9,11 +9,28 @@ scalar-mults with ONE multi-scalar-multiplication whose doubling chain
 is shared across the whole batch:
 
     T = (sum_i z_i s_i mod L) * B  +  sum_i z_i * (-R_i)  +  sum_i (z_i h_i mod L) * (-A_i)
-    batch valid  <=>  T == identity        (soundness 2^-128 per batch)
+    batch valid  <=>  T == identity  AND  all live R_i, A_i torsion-free
 
-with z_i fresh random 128-bit scalars chosen AFTER the signatures are
+with z_i fresh random 126-bit scalars chosen AFTER the signatures are
 known. The MSM is computed with Pippenger bucket accumulation
 (ops/msm.py) — bucket fill cost amortizes the doublings over all lanes.
+
+Soundness (why the torsion condition is load-bearing): the RLC equation
+alone is only sound against defects in the PRIME-ORDER component. An
+adversary can craft lanes whose per-lane defect D_i = s_i*B - h_i*A_i
+- R_i lies entirely in the 8-torsion subgroup (e.g. R_i = r_i*B + T
+with T the order-2 point): each such lane fails the per-lane
+byte-compare, but the combined torsion defect sum z_i t_i mod 8 cancels
+with probability up to 1/2 per batch — catastrophic for a consensus
+path. The fix is a randomized subgroup certification
+(msm.subgroup_check) over all live lanes' R_i and A_i: K independent
+random aggregates, each multiplied by the group order and compared to
+the identity. Torsion-free R and A (plus torsion-free B) make every
+D_i torsion-free, restoring the RLC bound. Combined soundness per
+batch accept: <= 2^-126 for prime-order defects + <= 2^-K for
+torsion defects (K = FD_RLC_TORSION_K, default 64). Honest traffic
+(real keys and nonces are prime-order) never trips the check; a
+tripped check only routes the batch to the exact per-lane path.
 
 Semantics parity with the reference's byte-compare verify
 (fd_ed25519_user.c:346-433, see ops/verify.py):
@@ -58,12 +75,19 @@ _P_BYTES = np.array([(fe.P >> (8 * i)) & 0xFF for i in range(32)], np.uint8)
 
 
 def fresh_z(batch: int, rng: np.random.Generator | None = None) -> np.ndarray:
-    """(B, 32) uint8: random 126-bit scalars (top 16 bytes zero), z_i >= 1.
+    """(B, 32) uint8: uniform random 126-bit scalars (top 16 bytes zero).
 
     Host-side entropy: z must be unpredictable to whoever crafted the
     signatures, so it is drawn per batch, never fixed in the graph.
     126 bits = 18 exact 7-bit MSM windows (msm.WINDOWS_Z), keeping every
-    window's digit distribution uniform; soundness 2^-126 per batch.
+    window's digit distribution uniform.
+
+    z is FULLY uniform — no bit is forced. (An earlier revision forced
+    z_i odd to avoid zero weights; all-odd z makes order-2 torsion
+    defects cancel pairwise DETERMINISTICALLY, z_i + z_j always even —
+    part of the torsion unsoundness fixed by msm.subgroup_check.) A
+    zero z_i drops lane i's check with probability 2^-126 per lane,
+    which is absorbed by the stated soundness bound.
 
     Default entropy is os.urandom (CSPRNG) — the soundness claim rests
     on z being unpredictable, which a statistical PRNG does not provide.
@@ -79,8 +103,24 @@ def fresh_z(batch: int, rng: np.random.Generator | None = None) -> np.ndarray:
     else:
         z[:, :16] = rng.integers(0, 256, (batch, 16), dtype=np.uint8)
     z[:, 15] &= 0x3F
-    z[:, 0] |= 1  # never zero: a zero weight would drop the lane's check
     return z
+
+
+def fresh_u(k: int, batch: int,
+            rng: np.random.Generator | None = None) -> np.ndarray:
+    """(K, batch) int32 digits uniform in [0, 128): trial weights for the
+    torsion subgroup certification (msm.subgroup_check). 7-bit digits
+    load the fill buckets exactly like one MSM window, so the overflow
+    analysis (msm._default_rounds) carries over unchanged. Same
+    entropy requirements as fresh_z: os.urandom in production, the rng
+    parameter for deterministic tests only."""
+    import os
+
+    if rng is None:
+        raw = np.frombuffer(os.urandom(k * batch), np.uint8)
+    else:
+        raw = rng.integers(0, 256, k * batch, dtype=np.uint8)
+    return (raw.astype(np.int32) & 0x7F).reshape(k, batch)
 
 
 def _bytes_lt_p(b: jnp.ndarray) -> jnp.ndarray:
@@ -97,18 +137,21 @@ def _bytes_lt_p(b: jnp.ndarray) -> jnp.ndarray:
     return lt
 
 
-def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes):
+def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     """One RLC pass over a batch.
 
     Args are as ops.verify.verify_batch, plus z_bytes (B, 32) uint8
-    128-bit random weights (from fresh_z).
+    126-bit random weights (from fresh_z) and u_digits (K, 2B) int32
+    trial weights for the torsion certification (from fresh_u; columns
+    0..B-1 weight the pubkey points, B..2B-1 the R points).
 
     Returns (status, definite, batch_ok):
       status:   (B,) int32 — correct for lanes where definite is True;
                 provisionally SUCCESS elsewhere.
       definite: (B,) bool — lanes whose status is final regardless of
                 the batch equation (s-range / pubkey / R-encoding fails).
-      batch_ok: () bool — True iff the combined equation holds, i.e.
+      batch_ok: () bool — True iff the combined equation holds AND every
+                live lane's A and R are certified torsion-free, i.e.
                 every non-definite lane is genuinely SUCCESS. On False
                 the caller re-runs the per-lane path.
     """
@@ -182,8 +225,15 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes):
     t2, ok2 = msm_impl(m_all, pts_all, n_windows=msm_mod.WINDOWS_253)
     # T = u*B + sum z(-R) + sum m(-A); identity <=> X == 0 and Y == Z.
     t = ge.point_add(t1, t2, need_t=False)
+    # Torsion certification over the live lanes' A and R (the stacked
+    # decompression output `both` is already in that column order). Dead
+    # lanes get zero trial weights — unweighted, identity contribution.
+    live2 = jnp.concatenate([live, live], axis=0)
+    u_live = jnp.where(live2[None, :], u_digits, 0)
+    sub_ok, sub_fill_ok = msm_mod.subgroup_check(both, u_live)
     batch_ok = (
         fe.fe_is_zero(t[0]) & fe.fe_eq(t[1], t[2]) & ok1 & ok2
+        & sub_ok & sub_fill_ok
     )
     return status, definite, batch_ok
 
@@ -239,23 +289,30 @@ class RlcAsyncResult:
 
 
 def make_async_verifier(fallback_fn, rng: np.random.Generator | None = None,
-                        rlc_fn=None):
+                        rlc_fn=None, torsion_k: int | None = None):
     """A drop-in for jit(verify_batch) with RLC fast-pass semantics.
 
     Returns fn(msgs, lens, sigs, pubs) -> RlcAsyncResult. fallback_fn is
     the compiled per-lane verifier used when the batch equation fails;
     rlc_fn overrides the jitted RLC pass (e.g. a shared compiled
-    instance in tests). Fresh z weights are drawn per call (never baked
-    into the graph).
+    instance in tests). Fresh z and torsion-trial u weights are drawn
+    per call (never baked into the graph), from os.urandom by default —
+    the soundness contract (module docstring) requires CSPRNG entropy
+    in production; pass rng only for deterministic tests. torsion_k is
+    the subgroup-check trial count (default FD_RLC_TORSION_K or 64).
     """
     import jax
+    import os
 
-    rng = rng or np.random.default_rng()
     rlc = rlc_fn if rlc_fn is not None else jax.jit(verify_batch_rlc)
+    if torsion_k is None:
+        torsion_k = int(os.environ.get("FD_RLC_TORSION_K", "64"))
 
     def fn(msgs, lens, sigs, pubs):
-        z = jnp.asarray(fresh_z(msgs.shape[0], rng))
-        out = rlc(msgs, lens, sigs, pubs, z)
+        bsz = msgs.shape[0]
+        z = jnp.asarray(fresh_z(bsz, rng))
+        u = jnp.asarray(fresh_u(torsion_k, 2 * bsz, rng))
+        out = rlc(msgs, lens, sigs, pubs, z, u)
         return RlcAsyncResult(out, fallback_fn, (msgs, lens, sigs, pubs))
 
     return fn

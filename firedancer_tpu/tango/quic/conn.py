@@ -48,6 +48,12 @@ TP_INITIAL_MAX_STREAM_DATA_UNI = 0x07
 TP_INITIAL_MAX_STREAMS_BIDI = 0x08
 TP_INITIAL_MAX_STREAMS_UNI = 0x09
 TP_INITIAL_SCID = 0x0F
+TP_STATELESS_RESET_TOKEN = 0x02
+TP_RETRY_SCID = 0x10
+
+# RFC 9000 §8.1: a server may send at most 3x the bytes received from an
+# address it has not yet validated (anti-amplification limit).
+AMP_LIMIT = 3
 
 _LEVEL_TO_PKT = {
     LEVEL_INITIAL: wire.PKT_INITIAL,
@@ -92,9 +98,10 @@ class RttEstimator:
 
     Replaces the fixed 0.25 s probe timeout: smoothed_rtt/rttvar are EWMAs
     of ack-derived samples (ack_delay-adjusted once min_rtt is known) and
-    the PTO backs off exponentially per probe event. Loss detection uses
-    the packet threshold (kPacketThreshold=3, wired in the ACK handler)
-    plus the PTO; the RFC's time-threshold variant is not implemented.
+    the PTO backs off exponentially per probe event. Loss detection (all
+    wired in the ACK handler) uses the packet threshold
+    (kPacketThreshold=3), the time threshold (kTimeThreshold=9/8 of
+    max(srtt, latest_rtt), RFC 9002 §6.1.2), and the PTO.
     Reference behavior: src/tango/quic/fd_quic_pkt_meta.c + RFC defaults.
     """
 
@@ -143,6 +150,7 @@ class _SentPacket:
     crypto: List[Tuple[int, bytes]] = field(default_factory=list)
     streams: List[Tuple[int, int, bytes, bool]] = field(default_factory=list)
     handshake_done: bool = False
+    pmtu_probe: int = 0   # DPLPMTUD probe datagram size (0 = not a probe)
 
 
 class _PnSpace:
@@ -277,10 +285,14 @@ class QuicConn:
         now: float = 0.0,
         initial_max_streams_uni: int = 2048,
         initial_max_data: int = 1 << 24,
+        scid: Optional[bytes] = None,
+        reset_token: Optional[bytes] = None,
+        retry_odcid: Optional[bytes] = None,
+        addr_validated: Optional[bool] = None,
     ):
         self.is_server = is_server
         self.peer_addr = peer_addr
-        self.scid = os.urandom(CID_LEN)
+        self.scid = scid if scid is not None else os.urandom(CID_LEN)
         self.on_stream = on_stream
         self.established = False
         self.closed = False
@@ -313,8 +325,8 @@ class QuicConn:
         self.stat_key_updates = 0
         # Path migration (RFC 9000 §9): a new source address is adopted
         # only after a PATH_CHALLENGE round trip to it succeeds. One
-        # probe at a time; amplification limits are not modeled (the
-        # probe packet is tiny).
+        # probe at a time, and an in-flight probe is never clobbered by
+        # a new candidate (§9.3; see on_peer_address_change).
         self._probe_addr = None
         self._probe_data: Optional[bytes] = None
         self._probe_expire = 0.0
@@ -323,6 +335,39 @@ class QuicConn:
         self._last_rx_addr = None
         self._highest_rx_pn = -1   # §9.3: migrate on newest packet only
         self.stat_migrations = 0
+        # Anti-amplification (RFC 9000 §8.1; reference fd_quic.h:110 names
+        # this mitigation, enforcement fd_quic.c:1198): a server must not
+        # send more than AMP_LIMIT x the bytes received from an address
+        # until that address is validated — by a token-validated Initial
+        # (retry_odcid path) or by the client proving receipt of the
+        # server's Initial (a packet decrypted with handshake keys).
+        # Clients are born validated (they chose to talk to the server).
+        self.addr_validated = (
+            addr_validated if addr_validated is not None else not is_server
+        )
+        self._amp_rx_bytes = 0
+        self._amp_tx_bytes = 0
+        self.stat_amp_blocked = 0
+        # Retry state (RFC 9000 §8.1.2 / 17.2.5): the client echoes the
+        # server's token in every subsequent Initial; one Retry max.
+        self._retry_token = b""
+        self._retry_used = False
+        self.stat_retries = 0
+        # Stateless reset (RFC 9000 §10.3): the peer's token arrives in
+        # its transport parameters; an undecryptable short packet whose
+        # tail matches it kills the connection.
+        self.peer_reset_token: Optional[bytes] = None
+        self.stat_stateless_reset = 0
+        self._peer_cid_adopted = False  # client: server scid adopted (§7.2)
+        # DPLPMTUD (RFC 8899 / RFC 9000 §14.3): datagram budget starts at
+        # the conservative 1200 and is raised only after a padded probe
+        # of the candidate size is ACKNOWLEDGED; a lost probe ends the
+        # search at the last validated size. One probe in flight at most.
+        self.max_datagram = MAX_DATAGRAM
+        self._pmtu_rungs = [1350, 1452]
+        self._pmtu_inflight = 0     # probe size awaiting ack (0 = none)
+        self._pmtu_done = False
+        self.stat_pmtu_probes = 0
         self.spaces = [_PnSpace(), _PnSpace(), _PnSpace()]
         if is_server:
             assert orig_dcid is not None
@@ -350,7 +395,17 @@ class QuicConn:
             TP_INITIAL_SCID: self.scid,
         }
         if is_server:
-            tp[TP_ORIGINAL_DCID] = orig_dcid
+            if retry_odcid is not None:
+                # Post-retry handshake (RFC 9000 §18.2): original dcid is
+                # the one from the FIRST Initial (recovered from the
+                # token); retry_source_connection_id is the cid the Retry
+                # chose, which the client now addresses us by.
+                tp[TP_ORIGINAL_DCID] = retry_odcid
+                tp[TP_RETRY_SCID] = orig_dcid
+            else:
+                tp[TP_ORIGINAL_DCID] = orig_dcid
+            if reset_token is not None:
+                tp[TP_STATELESS_RESET_TOKEN] = reset_token
         self.tls = TlsEndpoint(
             TlsConfig(
                 is_server=is_server,
@@ -375,6 +430,11 @@ class QuicConn:
         self._last_activity = now
         if from_addr is not None:
             self._last_rx_addr = from_addr
+        if not self.addr_validated and (
+            from_addr is None or from_addr == self.peer_addr
+        ):
+            # Bytes from the handshake address buy 3x send budget (§8.1).
+            self._amp_rx_bytes += len(data)
         off = 0
         while off < len(data) and not self.closed:
             first = data[off]
@@ -383,6 +443,9 @@ class QuicConn:
                     hdr = wire.parse_long_header(data, off)
                 except wire.QuicWireError:
                     return
+                if hdr.pkt_type == wire.PKT_RETRY:
+                    self._on_retry(data[off:], hdr, now)
+                    return  # a Retry is never coalesced (§12.2)
                 pkt_end = hdr.hdr_end + hdr.length
                 if hdr.version != wire.QUIC_VERSION_1 or pkt_end > len(data):
                     return
@@ -391,12 +454,13 @@ class QuicConn:
                 elif hdr.pkt_type == wire.PKT_HANDSHAKE:
                     level = LEVEL_HANDSHAKE
                 else:
-                    off = pkt_end  # 0-RTT/Retry unsupported: skip
+                    off = pkt_end  # 0-RTT unsupported: skip
                     continue
                 if not self.dcid:
-                    self.dcid = hdr.scid  # learn the peer's chosen cid
+                    self.dcid = hdr.scid  # server: learn the client's cid
                 self._decrypt_and_process(
-                    data, off, hdr.hdr_end, pkt_end, level, now
+                    data, off, hdr.hdr_end, pkt_end, level, now,
+                    peer_scid=hdr.scid,
                 )
                 off = pkt_end
             else:
@@ -412,7 +476,7 @@ class QuicConn:
 
     def _decrypt_and_process(
         self, data: bytes, pkt_start: int, pn_off: int, pkt_end: int,
-        level: int, now: float,
+        level: int, now: float, peer_scid: Optional[bytes] = None,
     ) -> None:
         space = self.spaces[level]
         if space.keys_rx is None:
@@ -458,9 +522,34 @@ class QuicConn:
             else:
                 payload = space.keys_rx.open(header, pn, ciphertext)
         except QuicCryptoError:
-            return  # undecryptable: drop silently (RFC 9001 §9.3)
+            # Undecryptable: drop silently (RFC 9001 §9.3) — unless it is
+            # a stateless reset: a short-header datagram whose last 16
+            # bytes equal the peer's advertised reset token (RFC 9000
+            # §10.3.1; checked only AFTER AEAD failure, so a valid packet
+            # can never be misread as a reset).
+            if (level == LEVEL_APP and self.peer_reset_token is not None
+                    and pkt_end - pkt_start >= 21
+                    and data[pkt_end - 16:pkt_end] == self.peer_reset_token):
+                self.closed = True
+                self.close_reason = "stateless reset"
+                self.stat_stateless_reset += 1
+            return
         if not space.record_rx(pn):
             return  # duplicate
+        if self.is_server and level == LEVEL_HANDSHAKE:
+            # The client can only have handshake keys if it received our
+            # Initial at the address it claims: address validated (§8.1).
+            self.addr_validated = True
+        if (not self.is_server and peer_scid is not None
+                and not self._peer_cid_adopted):
+            # RFC 9000 §7.2: the client MUST switch its dcid to the
+            # server's chosen scid once a packet from the server is
+            # processed — adopted here, after AEAD authentication, so an
+            # off-path injector cannot redirect the connection. (The
+            # stateless-reset design depends on this: the server's reset
+            # token is minted for ITS cid.)
+            self.dcid = peer_scid
+            self._peer_cid_adopted = True
         if level == LEVEL_APP and pn > self._highest_rx_pn:
             self._highest_rx_pn = pn
             # Authenticated, newest packet from a non-current address:
@@ -491,6 +580,12 @@ class QuicConn:
             if (level == LEVEL_APP and self._ku_pending
                     and any(pn >= self._ku_min_ack_pn for pn, _ in acked)):
                 self._ku_pending = False  # current phase confirmed (§6.2)
+            for _pn, sp in acked:
+                if sp.pmtu_probe and sp.pmtu_probe == self._pmtu_inflight:
+                    # Probe delivered: the path carries this size (§14.3).
+                    self.max_datagram = max(self.max_datagram,
+                                            sp.pmtu_probe)
+                    self._pmtu_inflight = 0
             # RTT sample ONLY when the frame's largest-acknowledged packet
             # is itself newly acked and ack-eliciting (RFC 9002 §5.1) — a
             # reordered ACK re-listing old ranges must not fold its own
@@ -504,8 +599,19 @@ class QuicConn:
             # Packet-threshold loss (RFC 9002 §6.1.1, kPacketThreshold=3):
             # anything 3+ below the new largest acked is lost NOW - the
             # fast-retransmit path that does not wait out a PTO.
+            # Time-threshold loss (§6.1.2, kTimeThreshold = 9/8): a packet
+            # older than 9/8 * max(srtt, latest_rtt) relative to `now`
+            # that the newest ack skipped is also lost — catches tail and
+            # small-flight losses a 3-packet gap can never form for.
+            srtt = self.rtt.smoothed_rtt
+            base_rtt = (max(srtt, self.rtt.latest_rtt)
+                        if srtt is not None else 2 * self.rtt.initial_rtt)
+            time_thresh = max(9 * base_rtt / 8, RttEstimator.K_GRANULARITY)
             for pn in list(space.sent.keys()):
-                if pn <= space.largest_acked - 3:
+                if pn <= space.largest_acked - 3 or (
+                    pn < space.largest_acked
+                    and space.sent[pn].time <= now - time_thresh
+                ):
                     self._retransmit(space, pn)
         elif t == wire.FRAME_CRYPTO:
             self._on_crypto(level, f.fields["offset"], f.data)
@@ -535,6 +641,34 @@ class QuicConn:
             self.close_reason = f.data.decode("utf-8", "replace")
         # MAX_DATA/MAX_STREAMS/NEW_CONNECTION_ID etc: tracked loosely; the
         # TPU role never hits the limits within a connection's lifetime.
+
+    def _on_retry(self, pkt: bytes, hdr: wire.LongHeader, now: float) -> None:
+        """Client-side Retry handling (RFC 9000 §17.2.5.2): validate the
+        integrity tag against our ORIGINAL dcid, adopt the server's new
+        cid (re-deriving Initial keys from it, RFC 9001 §5.2), stash the
+        token for all subsequent Initials, and re-queue the ClientHello.
+        At most one Retry per connection; ignored after any decrypted
+        server packet (the tag alone does not authenticate the server,
+        possession of our Initial does — which an on-path observer has,
+        exactly the threat model Retry is scoped to)."""
+        if self.is_server or self._retry_used or self.established:
+            return
+        if any(s.largest_rx >= 0 for s in self.spaces):
+            return  # §17.2.5.2: discard after any processed packet
+        token = wire.check_retry(pkt, self.orig_dcid)
+        if token is None:
+            return
+        self._retry_used = True
+        self._retry_token = token
+        self.stat_retries += 1
+        self.dcid = hdr.scid
+        ckeys, skeys = initial_secrets(self.dcid)
+        ini = self.spaces[LEVEL_INITIAL]
+        ini.keys_tx, ini.keys_rx = ckeys, skeys
+        # Re-queue everything in flight (the ClientHello): packet numbers
+        # continue, they are not reset after Retry (RFC 9000 §17.2.5.3).
+        for pn in list(ini.sent.keys()):
+            self._retransmit(ini, pn)
 
     def _on_crypto(self, level: int, offset: int, data: bytes) -> None:
         space = self.spaces[level]
@@ -611,6 +745,9 @@ class QuicConn:
             self.peer_tp = parse_transport_params(
                 self.tls.peer_transport_params
             )
+            tok = self.peer_tp.get(TP_STATELESS_RESET_TOKEN)
+            if tok is not None and len(tok) == 16:
+                self.peer_reset_token = tok
         if self.tls.handshake_complete and self.is_server and not self.established:
             self.established = True
             self._hs_done_pending = True
@@ -629,6 +766,18 @@ class QuicConn:
     def pending_datagrams(self, now: float) -> List[bytes]:
         """Assemble everything sendable into coalesced datagrams."""
         out: List[bytes] = []
+        if not self.addr_validated and (
+            self._amp_tx_bytes + MAX_DATAGRAM
+            > AMP_LIMIT * self._amp_rx_bytes
+        ):
+            # Anti-amplification (§8.1): sending one more full datagram
+            # could exceed 3x the bytes this unvalidated address has sent
+            # us. Everything stays queued (crypto_tx untouched) until the
+            # peer's next datagram buys more budget or validates the
+            # address — a spoofed-source Initial flood can at most make
+            # us echo 3x its own traffic at the victim.
+            self.stat_amp_blocked += 1
+            return out
         segments: List[bytes] = []
         pad_initial = False
         for level in (LEVEL_INITIAL, LEVEL_HANDSHAKE, LEVEL_APP):
@@ -642,7 +791,7 @@ class QuicConn:
                 if ack:
                     frames.append(ack)
                 space.ack_needed = False
-            budget = MAX_DATAGRAM - 96  # header + AEAD margin
+            budget = self.max_datagram - 96  # header + AEAD margin
             while space.crypto_tx and budget > 24:
                 off, data = space.crypto_tx.pop(0)
                 room = budget - 12
@@ -701,7 +850,9 @@ class QuicConn:
                     pn,
                     pn_len,
                     len(payload) + AEAD_OVERHEAD,
-                    token=b"",
+                    # Initials echo the server's retry token (§8.1.2).
+                    token=(self._retry_token
+                           if level == LEVEL_INITIAL else b""),
                 )
                 if level == LEVEL_INITIAL and not self.is_server:
                     pad_initial = True
@@ -712,6 +863,7 @@ class QuicConn:
             )
         if not segments:
             return out
+        self._amp_tx_bytes += sum(len(s) for s in segments)
         datagram = b"".join(segments)
         if pad_initial and len(datagram) < 1200:
             # client Initial datagrams must be >=1200B (RFC 9000 §14.1):
@@ -746,6 +898,7 @@ class QuicConn:
             pn,
             pn_len,
             len(payload) + AEAD_OVERHEAD,
+            token=self._retry_token,
         )
         segments.append(
             protect_packet(space.keys_tx, header, pn, pn_len, payload)
@@ -757,6 +910,14 @@ class QuicConn:
     def _retransmit(self, space: "_PnSpace", pn: int) -> None:
         """Re-queue a sent packet's retransmittable content."""
         sp = space.sent.pop(pn)
+        if sp.pmtu_probe:
+            # A lost probe is the DPLPMTUD answer, not data to re-send:
+            # the path cannot carry pmtu_probe bytes — stop the search
+            # at the last validated size (RFC 8899 SEARCH_COMPLETE).
+            if self._pmtu_inflight == sp.pmtu_probe:
+                self._pmtu_inflight = 0
+                self._pmtu_done = True
+            return
         for off, data in sp.crypto:
             space.crypto_tx.insert(0, (off, data))
         for st in sp.streams:
@@ -781,18 +942,61 @@ class QuicConn:
                 continue
             for pn in list(space.sent.keys()):
                 if now - space.sent[pn].time > pto:
+                    probe = space.sent[pn].pmtu_probe != 0
                     self._retransmit(space, pn)
-                    fired = True
+                    if not probe:   # a lost PMTU probe is an answer,
+                        fired = True  # not a congestion signal
         if fired:
             self.rtt.pto_count += 1
-        return self.pending_datagrams(now)
+        out = self.pending_datagrams(now)
+        probe = self._pmtu_probe_datagram(now)
+        if probe is not None:
+            out.append(probe)
+        return out
+
+    def _pmtu_probe_datagram(self, now: float) -> Optional[bytes]:
+        """DPLPMTUD search step (RFC 8899, RFC 9000 §14.3): one padded
+        PING datagram at the next candidate size; adopted on ack, search
+        ended on loss. Never carries data, so a blackholed probe costs
+        nothing but itself."""
+        if (not self.established or self._pmtu_done or self._pmtu_inflight
+                or not self.addr_validated
+                or self.spaces[LEVEL_APP].keys_tx is None):
+            return None
+        target = next(
+            (r for r in self._pmtu_rungs if r > self.max_datagram), None
+        )
+        if target is None:
+            self._pmtu_done = True
+            return None
+        space = self.spaces[LEVEL_APP]
+        pn = space.next_pn
+        space.next_pn += 1
+        pn_len = 2
+        header = wire.encode_short_header(
+            self.dcid, pn, pn_len, key_phase=self.tx_key_phase
+        )
+        payload = bytes([wire.FRAME_PING])
+        payload += bytes(target - len(header) - AEAD_OVERHEAD - len(payload))
+        space.sent[pn] = _SentPacket(
+            time=now, ack_eliciting=True, pmtu_probe=target
+        )
+        self._pmtu_inflight = target
+        self.stat_pmtu_probes += 1
+        return protect_packet(space.keys_tx, header, pn, pn_len, payload)
 
     def on_peer_address_change(self, addr, now: float) -> None:
         """A post-handshake datagram arrived from an unvalidated address:
         start (or continue) a PATH_CHALLENGE probe of it. The connection
         keeps sending to the validated address until the probe round
         trip completes (RFC 9000 §9.1)."""
-        if addr == self._probe_addr and now < self._probe_expire:
+        if self._probe_data is not None and now < self._probe_expire:
+            # A validation is already in flight: a different candidate
+            # address must NOT clobber it (round-2 ADVICE: an off-path
+            # attacker racing copies of genuine datagrams from spoofed
+            # sources could otherwise overwrite the probe indefinitely
+            # and starve a real NAT-rebind migration). The loser will
+            # re-trigger once this probe validates or expires.
             return
         self._probe_addr = addr
         self._probe_data = os.urandom(8)

@@ -62,17 +62,34 @@ class PacketKeys:
         pad = bytes(len(self.iv) - 8) + struct.pack(">Q", pn)
         return bytes(a ^ b for a, b in zip(self.iv, pad))
 
+    # The AEAD/HP cipher objects are cached PER KEY, not built per
+    # packet: constructing an AesGcm costs a key schedule + GHASH table
+    # (milliseconds in the Python fallback), and keys live for millions
+    # of packets — per-packet construction capped the whole QUIC tile
+    # at ~10^2 datagrams/s.
+    def _gcm(self) -> AesGcm:
+        g = self.__dict__.get("_gcm_obj")
+        if g is None:
+            g = self.__dict__["_gcm_obj"] = AesGcm(self.key)
+        return g
+
+    def _hp_aes(self) -> Aes:
+        a = self.__dict__.get("_hp_obj")
+        if a is None:
+            a = self.__dict__["_hp_obj"] = Aes(self.hp)
+        return a
+
     def seal(self, header: bytes, pn: int, payload: bytes) -> bytes:
-        return AesGcm(self.key).seal(self._nonce(pn), payload, header)
+        return self._gcm().seal(self._nonce(pn), payload, header)
 
     def open(self, header: bytes, pn: int, sealed: bytes) -> bytes:
         try:
-            return AesGcm(self.key).open(self._nonce(pn), sealed, header)
+            return self._gcm().open(self._nonce(pn), sealed, header)
         except ValueError as e:
             raise QuicCryptoError(str(e)) from e
 
     def hp_mask(self, sample: bytes) -> bytes:
-        return Aes(self.hp).encrypt_block(sample)[:5]
+        return self._hp_aes().encrypt_block(sample)[:5]
 
 
 def initial_secrets(dcid: bytes) -> tuple:

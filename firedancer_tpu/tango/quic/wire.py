@@ -345,6 +345,78 @@ def parse_frames(buf: bytes) -> List[Frame]:
     return frames
 
 
+# ------------------------------------------------------ retry / reset ------
+
+# RFC 9001 §5.8: fixed key/nonce protecting Retry packet integrity (v1).
+RETRY_INTEGRITY_KEY = bytes.fromhex("be0c690b9f66575a1d766b54e368c84e")
+RETRY_INTEGRITY_NONCE = bytes.fromhex("461599d35d632bf2239825bb")
+
+
+_RETRY_AEAD = None
+
+
+def _retry_tag(odcid: bytes, retry_sans_tag: bytes) -> bytes:
+    """16-byte Retry Integrity Tag: AES-128-GCM over the empty string
+    with the retry pseudo-packet (ODCID-prefixed packet) as AAD. The
+    key is a fixed RFC 9001 §5.8 constant, so ONE cached cipher serves
+    every packet — constructing it per Retry would re-pay key schedule
+    + GHASH setup on the flood path this feature exists to cheapen."""
+    global _RETRY_AEAD
+    if _RETRY_AEAD is None:
+        from firedancer_tpu.ballet.aes import AesGcm
+
+        _RETRY_AEAD = AesGcm(RETRY_INTEGRITY_KEY)
+    pseudo = bytes([len(odcid)]) + odcid + retry_sans_tag
+    return _RETRY_AEAD.seal(RETRY_INTEGRITY_NONCE, b"", pseudo)
+
+
+def encode_retry(dcid: bytes, scid: bytes, token: bytes,
+                 odcid: bytes) -> bytes:
+    """Server Retry packet (RFC 9000 §17.2.5): no packet number, no
+    payload — just the token and the integrity tag binding it to the
+    client's original DCID (so an off-path attacker cannot forge one
+    without having seen the Initial)."""
+    first = 0xC0 | (PKT_RETRY << 4)
+    body = bytearray([first])
+    body += QUIC_VERSION_1.to_bytes(4, "big")
+    body += bytes([len(dcid)]) + dcid
+    body += bytes([len(scid)]) + scid
+    body += token
+    return bytes(body) + _retry_tag(odcid, bytes(body))
+
+
+def check_retry(datagram: bytes, odcid: bytes) -> Optional[bytes]:
+    """Validate a Retry packet's integrity tag against the original DCID
+    this client sent. -> the retry token, or None if invalid."""
+    if len(datagram) < 23:  # header floor + 16-byte tag
+        return None
+    try:
+        hdr = parse_long_header(datagram)
+    except QuicWireError:
+        return None
+    if hdr.pkt_type != PKT_RETRY or hdr.version != QUIC_VERSION_1:
+        return None
+    token = datagram[hdr.hdr_end:-16]
+    if not token:
+        return None  # §17.2.5.1: a Retry MUST carry a non-empty token
+    if _retry_tag(odcid, datagram[:-16]) != datagram[-16:]:
+        return None
+    return bytes(token)
+
+
+def encode_stateless_reset(token16: bytes, size: int = 41) -> bytes:
+    """Stateless Reset (RFC 9000 §10.3): indistinguishable from a short-
+    header packet — fixed bit + unpredictable bytes, with the 16-byte
+    reset token in the last 16 bytes. Minimum 21 bytes total."""
+    import os as _os
+
+    assert len(token16) == 16
+    size = max(21, size)
+    rand = bytearray(_os.urandom(size - 16))
+    rand[0] = 0x40 | (rand[0] & 0x3F)
+    return bytes(rand) + token16
+
+
 def encode_path_frame(ftype: int, data8: bytes) -> bytes:
     """PATH_CHALLENGE / PATH_RESPONSE: type + 8 opaque bytes (RFC 9000
     §19.17-18)."""

@@ -66,6 +66,9 @@ def load_lib() -> ctypes.CDLL:
     lib = ctypes.CDLL(_LIB_PATH)
     lib.fd_wksp_create.restype = ctypes.c_void_p
     lib.fd_wksp_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    if hasattr(lib, "fd_wksp_page_probe"):  # absent in a stale build
+        lib.fd_wksp_page_probe.restype = ctypes.c_uint64
+        lib.fd_wksp_page_probe.argtypes = []
     lib.fd_wksp_join.restype = ctypes.c_void_p
     lib.fd_wksp_join.argtypes = [ctypes.c_char_p]
     lib.fd_wksp_leave.argtypes = [ctypes.c_void_p]

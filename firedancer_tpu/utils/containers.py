@@ -8,7 +8,7 @@ capacity, O(1)/O(log n) worst cases, explicit eviction, iteration
 stability — are load-bearing for tiles (tcache, pack) and worth a
 purpose-built layer with tests instead of ad-hoc dict/list use.
 
-This module provides the four shapes the tile code actually needs,
+This module provides the shapes the tile/funk code actually needs,
 each matching its fd_tmpl counterpart's contract:
 
 - Pool       — fixed-capacity free-list object pool (fd_pool).
@@ -19,6 +19,11 @@ each matching its fd_tmpl counterpart's contract:
                with O(log n) expected insert/delete/min (fd_treap).
 - PrioQueue  — binary min-heap with O(log n) push/pop and O(1) peek
                (fd_prq / fd_heap).
+- Deque      — bounded ring deque, O(1) both ends (fd_deque_dynamic).
+- MapGiant   — chained hash over index slabs, remove-safe iteration
+               (fd_map_giant).
+- RedBlack   — left-leaning red-black tree, O(log n) WORST case +
+               sorted iteration (fd_redblack).
 
 All are allocation-free after construction (fixed slabs, index links —
 the shared-memory-compatible style the reference requires), so they
@@ -327,3 +332,378 @@ class PrioQueue:
                 h[i], h[m] = h[m], h[i]
                 i = m
         return out
+
+
+class Deque:
+    """Bounded ring deque (fd_deque_dynamic): O(1) push/pop at both
+    ends, fixed slab, no allocation after construction. push_* on a
+    full deque returns False (caller policy, like the reference)."""
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._slab: List[Any] = [None] * capacity
+        self._head = 0        # index of the front element
+        self._cnt = 0
+
+    def __len__(self) -> int:
+        return self._cnt
+
+    def push_tail(self, v) -> bool:
+        if self._cnt >= self.capacity:
+            return False
+        self._slab[(self._head + self._cnt) % self.capacity] = v
+        self._cnt += 1
+        return True
+
+    def push_head(self, v) -> bool:
+        if self._cnt >= self.capacity:
+            return False
+        self._head = (self._head - 1) % self.capacity
+        self._slab[self._head] = v
+        self._cnt += 1
+        return True
+
+    def pop_head(self):
+        if not self._cnt:
+            return None
+        v = self._slab[self._head]
+        self._slab[self._head] = None
+        self._head = (self._head + 1) % self.capacity
+        self._cnt -= 1
+        return v
+
+    def pop_tail(self):
+        if not self._cnt:
+            return None
+        i = (self._head + self._cnt - 1) % self.capacity
+        v = self._slab[i]
+        self._slab[i] = None
+        self._cnt -= 1
+        return v
+
+    def peek_head(self):
+        return self._slab[self._head] if self._cnt else None
+
+    def peek_tail(self):
+        if not self._cnt:
+            return None
+        return self._slab[(self._head + self._cnt - 1) % self.capacity]
+
+    def __iter__(self) -> Iterator[Any]:
+        for k in range(self._cnt):
+            yield self._slab[(self._head + k) % self.capacity]
+
+
+class MapGiant:
+    """Bounded chained hash map (fd_map_giant): u64-ish hashable keys,
+    index-linked chains over fixed slabs — O(1) expected insert/query/
+    remove, iteration stable under removal of the CURRENT element (the
+    reference's fd_map_giant iterator contract, which funk-scale scans
+    rely on). Unlike MapSlot (open addressing, shift-delete), chains
+    keep remove cost independent of load clustering at high fill.
+    """
+
+    _EMPTY = -1
+
+    def __init__(self, capacity: int, n_chains: Optional[int] = None):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        n_chains = n_chains or max(8, 1 << (capacity.bit_length()))
+        self._mask = n_chains - 1
+        if n_chains & self._mask:
+            raise ValueError("n_chains must be a power of two")
+        self._heads = [self._EMPTY] * n_chains
+        self._next = [self._EMPTY] * capacity
+        self._keys: List[Any] = [None] * capacity
+        self._vals: List[Any] = [None] * capacity
+        self._free = list(range(capacity - 1, -1, -1))
+        self._cnt = 0
+
+    def __len__(self) -> int:
+        return self._cnt
+
+    def _chain(self, key) -> int:
+        return hash(key) & self._mask
+
+    def insert(self, key, val) -> bool:
+        """Insert or overwrite. False iff the map is full (new key)."""
+        c = self._chain(key)
+        i = self._heads[c]
+        while i != self._EMPTY:
+            if self._keys[i] == key:
+                self._vals[i] = val
+                return True
+            i = self._next[i]
+        if not self._free:
+            return False
+        i = self._free.pop()
+        self._keys[i] = key
+        self._vals[i] = val
+        self._next[i] = self._heads[c]
+        self._heads[c] = i
+        self._cnt += 1
+        return True
+
+    def query(self, key, default=None):
+        i = self._heads[self._chain(key)]
+        while i != self._EMPTY:
+            if self._keys[i] == key:
+                return self._vals[i]
+            i = self._next[i]
+        return default
+
+    def __contains__(self, key) -> bool:
+        sentinel = object()
+        return self.query(key, sentinel) is not sentinel
+
+    def remove(self, key) -> bool:
+        c = self._chain(key)
+        prev = self._EMPTY
+        i = self._heads[c]
+        while i != self._EMPTY:
+            if self._keys[i] == key:
+                if prev == self._EMPTY:
+                    self._heads[c] = self._next[i]
+                else:
+                    self._next[prev] = self._next[i]
+                self._keys[i] = self._vals[i] = None
+                self._next[i] = self._EMPTY
+                self._free.append(i)
+                self._cnt -= 1
+                return True
+            prev, i = i, self._next[i]
+        return False
+
+    def items(self) -> Iterator[Tuple[Any, Any]]:
+        """Snapshot-order iteration; removing the yielded key is safe."""
+        for c in range(self._mask + 1):
+            i = self._heads[c]
+            while i != self._EMPTY:
+                nxt = self._next[i]   # read before the caller may remove
+                yield self._keys[i], self._vals[i]
+                i = nxt
+
+
+class RedBlack:
+    """Bounded red-black tree (fd_redblack): ordered map over fixed
+    index slabs — O(log n) WORST-case insert/remove/query (the treap is
+    expected-case only), in-order iteration, min/max access. The
+    reference instantiates this shape for ordered indices that must not
+    degrade adversarially (funk record ranges); same contract here.
+
+    Implementation: classic left-leaning red-black (Sedgewick LLRB,
+    public-domain algorithm) over parallel arrays with integer links —
+    allocation-free after construction, workspace-backable like the C
+    template's node pools.
+    """
+
+    _NIL = -1
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        n = capacity
+        self._key: List[Any] = [None] * n
+        self._val: List[Any] = [None] * n
+        self._left = [self._NIL] * n
+        self._right = [self._NIL] * n
+        self._red = [False] * n
+        self._free = list(range(n - 1, -1, -1))
+        self._root = self._NIL
+        self._cnt = 0
+
+    def __len__(self) -> int:
+        return self._cnt
+
+    # -- internal LLRB machinery ----------------------------------------
+
+    def _is_red(self, i: int) -> bool:
+        return i != self._NIL and self._red[i]
+
+    def _rot_left(self, h: int) -> int:
+        x = self._right[h]
+        self._right[h] = self._left[x]
+        self._left[x] = h
+        self._red[x] = self._red[h]
+        self._red[h] = True
+        return x
+
+    def _rot_right(self, h: int) -> int:
+        x = self._left[h]
+        self._left[h] = self._right[x]
+        self._right[x] = h
+        self._red[x] = self._red[h]
+        self._red[h] = True
+        return x
+
+    def _flip(self, h: int) -> None:
+        self._red[h] = not self._red[h]
+        for c in (self._left[h], self._right[h]):
+            if c != self._NIL:
+                self._red[c] = not self._red[c]
+
+    def _fixup(self, h: int) -> int:
+        if self._is_red(self._right[h]) and not self._is_red(self._left[h]):
+            h = self._rot_left(h)
+        if self._is_red(self._left[h]) and self._is_red(
+            self._left[self._left[h]]
+        ):
+            h = self._rot_right(h)
+        if self._is_red(self._left[h]) and self._is_red(self._right[h]):
+            self._flip(h)
+        return h
+
+    # -- public API ------------------------------------------------------
+
+    def insert(self, key, val=None) -> bool:
+        """Insert or overwrite. False iff full (new key on a full tree)."""
+        if not self._free:
+            # Full: allow overwrite of an existing key only.
+            i = self._find(key)
+            if i == self._NIL:
+                return False
+            self._val[i] = val
+            return True
+        self._root = self._insert_at(self._root, key, val)
+        self._red[self._root] = False
+        return True
+
+    def _insert_at(self, h: int, key, val) -> int:
+        if h == self._NIL:
+            i = self._free.pop()
+            self._key[i] = key
+            self._val[i] = val
+            self._left[i] = self._right[i] = self._NIL
+            self._red[i] = True
+            self._cnt += 1
+            return i
+        if key == self._key[h]:
+            self._val[h] = val
+        elif key < self._key[h]:
+            self._left[h] = self._insert_at(self._left[h], key, val)
+        else:
+            self._right[h] = self._insert_at(self._right[h], key, val)
+        return self._fixup(h)
+
+    def _find(self, key) -> int:
+        i = self._root
+        while i != self._NIL:
+            if key == self._key[i]:
+                return i
+            i = self._left[i] if key < self._key[i] else self._right[i]
+        return self._NIL
+
+    def query(self, key, default=None):
+        i = self._find(key)
+        return self._val[i] if i != self._NIL else default
+
+    def __contains__(self, key) -> bool:
+        return self._find(key) != self._NIL
+
+    def minimum(self) -> Optional[Tuple[Any, Any]]:
+        i = self._root
+        if i == self._NIL:
+            return None
+        while self._left[i] != self._NIL:
+            i = self._left[i]
+        return self._key[i], self._val[i]
+
+    def maximum(self) -> Optional[Tuple[Any, Any]]:
+        i = self._root
+        if i == self._NIL:
+            return None
+        while self._right[i] != self._NIL:
+            i = self._right[i]
+        return self._key[i], self._val[i]
+
+    def _move_red_left(self, h: int) -> int:
+        self._flip(h)
+        if self._is_red(self._left[self._right[h]]):
+            self._right[h] = self._rot_right(self._right[h])
+            h = self._rot_left(h)
+            self._flip(h)
+        return h
+
+    def _move_red_right(self, h: int) -> int:
+        self._flip(h)
+        if self._is_red(self._left[self._left[h]]):
+            h = self._rot_right(h)
+            self._flip(h)
+        return h
+
+    def _delete_min(self, h: int) -> int:
+        if self._left[h] == self._NIL:
+            self._release(h)
+            return self._NIL
+        if not self._is_red(self._left[h]) and not self._is_red(
+            self._left[self._left[h]]
+        ):
+            h = self._move_red_left(h)
+        self._left[h] = self._delete_min(self._left[h])
+        return self._fixup(h)
+
+    def _release(self, i: int) -> None:
+        self._key[i] = self._val[i] = None
+        self._left[i] = self._right[i] = self._NIL
+        self._red[i] = False
+        self._free.append(i)
+        self._cnt -= 1
+
+    def remove(self, key) -> bool:
+        if self._find(key) == self._NIL:
+            return False
+        if not self._is_red(self._left[self._root]) and not self._is_red(
+            self._right[self._root]
+        ):
+            self._red[self._root] = True
+        self._root = self._remove_at(self._root, key)
+        if self._root != self._NIL:
+            self._red[self._root] = False
+        return True
+
+    def _remove_at(self, h: int, key) -> int:
+        if key < self._key[h]:
+            if not self._is_red(self._left[h]) and not self._is_red(
+                self._left[self._left[h]]
+            ):
+                h = self._move_red_left(h)
+            self._left[h] = self._remove_at(self._left[h], key)
+        else:
+            if self._is_red(self._left[h]):
+                h = self._rot_right(h)
+            if key == self._key[h] and self._right[h] == self._NIL:
+                self._release(h)
+                return self._NIL
+            if not self._is_red(self._right[h]) and not self._is_red(
+                self._left[self._right[h]]
+            ):
+                h = self._move_red_right(h)
+            if key == self._key[h]:
+                # replace with successor (min of right subtree)
+                m = self._right[h]
+                while self._left[m] != self._NIL:
+                    m = self._left[m]
+                self._key[h] = self._key[m]
+                self._val[h] = self._val[m]
+                # detach the successor node: it is structurally the
+                # leftmost of the right subtree, which _delete_min frees
+                self._right[h] = self._delete_min(self._right[h])
+            else:
+                self._right[h] = self._remove_at(self._right[h], key)
+        return self._fixup(h)
+
+    def items(self) -> Iterator[Tuple[Any, Any]]:
+        """In-order (sorted) iteration, iterative (no recursion limit)."""
+        stack: List[int] = []
+        i = self._root
+        while stack or i != self._NIL:
+            while i != self._NIL:
+                stack.append(i)
+                i = self._left[i]
+            i = stack.pop()
+            yield self._key[i], self._val[i]
+            i = self._right[i]

@@ -161,6 +161,41 @@ def target_sbpf_loader():
     return fn, corpus, (SbpfLoaderError,)
 
 
+
+def target_quic_retry_token():
+    """Attacker-facing Retry + token validators (round-3 DoS ladder):
+    wire.check_retry must never crash or validate a forged tag, and the
+    endpoint token check must never crash or accept a mutated token."""
+    import os as _os
+
+    from firedancer_tpu.tango.quic import wire
+    from firedancer_tpu.tango.quic.quic import Quic, QuicConfig
+
+    srv = Quic(QuicConfig(is_server=True, identity_seed=b"\x07" * 32,
+                          retry=True),
+               tx=lambda a, d: None)
+    odcid = b"\x11" * 8
+    addr = ("fuzz", 1)
+    corpus = [
+        wire.encode_retry(b"D" * 8, b"S" * 8, b"tok-tok-tok", odcid),
+        srv._make_token(addr, odcid, 1000.0),
+        wire.encode_stateless_reset(_os.urandom(16)),
+        b"\xf0" + b"\x00" * 40,
+    ]
+
+    def fn(data: bytes) -> None:
+        # Forged/garbage retry: parse must not crash; a mutated packet
+        # must not carry a valid integrity tag (unless it IS the seed).
+        tok = wire.check_retry(data, odcid)
+        if tok is not None and data != corpus[0]:
+            raise AssertionError("mutated Retry passed the integrity tag")
+        got = srv._check_token(data, addr, 1000.0)
+        if got is not None and data != corpus[1]:
+            raise AssertionError("mutated token validated")
+
+    return fn, corpus, (wire.QuicWireError,)
+
+
 ALL_TARGETS = {
     "txn_parse": target_txn_parse,
     "quic_frames": target_quic_frames,
@@ -170,4 +205,5 @@ ALL_TARGETS = {
     "pcap": target_pcap,
     "eth_ip_udp": target_eth_ip_udp,
     "sbpf_loader": target_sbpf_loader,
+    "quic_retry_token": target_quic_retry_token,
 }

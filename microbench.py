@@ -284,6 +284,71 @@ def bench_native_verify_drain():
         wksp.leave()
 
 
+
+def bench_udp_quic_ingest():
+    """Firehose rate INTO the QUIC stack (round-2 VERDICT missing #7:
+    recvmmsg ingest had no measured rate into the QUIC tile): a real
+    localhost handshake over the batched UDP backend, then N txn-sized
+    streams; the metric is server-side COMPLETED streams/s — transport
+    batching + header unprotection + AEAD + reassembly all included."""
+    import os as _os
+    import time as _time
+
+    from firedancer_tpu.tango.quic import Quic, QuicConfig
+    from firedancer_tpu.tango.udpsock import UdpBatchSock
+
+    received = []
+    srv_sock = UdpBatchSock(rcvbuf=1 << 24)
+    cli_sock = UdpBatchSock(rcvbuf=1 << 24)
+    server = Quic(
+        QuicConfig(is_server=True, identity_seed=_os.urandom(32)),
+        tx=lambda addr, d: srv_sock.aio_tx().send_one(addr, d),
+        on_stream=lambda conn, sid, data: received.append(sid),
+    )
+    client = Quic(
+        QuicConfig(is_server=False, identity_seed=_os.urandom(32)),
+        tx=lambda addr, d: cli_sock.aio_tx().send_one(addr, d),
+    )
+    conn = client.connect(srv_sock.local_addr, 0.0)
+    n, payload = 2_000, _os.urandom(200)  # one Solana-sized txn per stream
+
+    def pump(now):
+        srv_sock.service_rx(lambda addr, d: server.rx(addr, d, now))
+        cli_sock.service_rx(lambda addr, d: client.rx(addr, d, now))
+        client.service(now)
+        server.service(now)
+
+    t0 = _time.monotonic()
+    while not conn.established and _time.monotonic() - t0 < 10.0:
+        pump(_time.monotonic() - t0)
+    assert conn.established
+    sent = 0
+    received.clear()
+    t0 = _time.monotonic()
+    while len(received) < n and _time.monotonic() - t0 < 60.0:
+        now = _time.monotonic() - t0
+        if sent < n:
+            for _ in range(min(64, n - sent)):
+                conn.send_stream(payload)
+                sent += 1
+        pump(now)
+    dt = _time.monotonic() - t0
+    done = len(received)
+    print(json.dumps({
+        "bench": "udp_quic_ingest",
+        "value": round(done / dt, 1),
+        "unit": "txn-streams/s",
+        "streams": done,
+        "payload_sz": len(payload),
+        "rx_batches": srv_sock.metrics["rx_batches"],
+        "pkts_per_recvmmsg": round(
+            srv_sock.metrics.get("rx_pkts", done)
+            / max(srv_sock.metrics["rx_batches"], 1), 1),
+    }))
+    srv_sock.close()
+    cli_sock.close()
+
+
 ALL = {
     "mcache_publish_poll": bench_mcache_publish_poll,
     "tcache_insert": bench_tcache_insert,
@@ -294,6 +359,7 @@ ALL = {
     "ha_tag_hash": bench_ha_tag_hash,
     "ring_pipeline_hop": bench_ring_pipeline_hop,
     "native_verify_drain": bench_native_verify_drain,
+    "udp_quic_ingest": bench_udp_quic_ingest,
 }
 
 

@@ -31,6 +31,7 @@
 
 #include <atomic>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <cstdio>
 #include <new>
@@ -105,6 +106,48 @@ struct wksp_join {
 
 static uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
 
+// Hugepage rung (fd_shmem.h:38-46 capability ladder, graceful form):
+// explicit hugetlbfs/MAP_HUGETLB needs a mount + reservations this
+// environment rarely has, so the workspace asks the kernel for
+// TRANSPARENT hugepages on its mapping instead — madvise(MADV_HUGEPAGE)
+// is use-if-available (TLB relief when THP is enabled, a no-op
+// otherwise) and never fails the mapping. fd_wksp_page_probe() reports
+// what the kernel granted so the security/capability report can show
+// the actual page backing instead of "N/A".
+#ifndef MADV_HUGEPAGE
+#define MADV_HUGEPAGE 14
+#endif
+static void wksp_advise_huge(void* base, uint64_t sz) {
+  (void)::madvise(base, sz, MADV_HUGEPAGE);  // best-effort by design
+}
+
+// Returns the kernel page size backing granted for an anonymous probe
+// region: 0 = THP unavailable/unknown, else the huge page size in
+// bytes (parsed from /sys THP settings; cheap, no allocation held).
+uint64_t fd_wksp_page_probe(void) {
+  int fd = ::open("/sys/kernel/mm/transparent_hugepage/enabled", O_RDONLY);
+  if (fd < 0) return 0;
+  char buf[128];
+  ssize_t n = ::read(fd, buf, sizeof buf - 1);
+  ::close(fd);
+  if (n <= 0) return 0;
+  buf[n] = 0;
+  // "always [madvise] never" — anything but [never] means MADV_HUGEPAGE
+  // can be honored.
+  const char* sel = ::strstr(buf, "[");
+  if (!sel || ::strncmp(sel, "[never]", 7) == 0) return 0;
+  uint64_t hps = 2u * 1024 * 1024;
+  int fd2 = ::open("/sys/kernel/mm/transparent_hugepage/hpage_pmd_size",
+                   O_RDONLY);
+  if (fd2 >= 0) {
+    char b2[32];
+    ssize_t n2 = ::read(fd2, b2, sizeof b2 - 1);
+    ::close(fd2);
+    if (n2 > 0) { b2[n2] = 0; hps = ::strtoull(b2, nullptr, 10); }
+  }
+  return hps;
+}
+
 // Create (or truncate) a workspace file of total_sz bytes and map it.
 wksp_join* fd_wksp_create(const char* path, uint64_t total_sz) {
   int fd = ::open(path, O_RDWR | O_CREAT | O_TRUNC, 0644);
@@ -112,6 +155,7 @@ wksp_join* fd_wksp_create(const char* path, uint64_t total_sz) {
   if (::ftruncate(fd, (off_t)total_sz) != 0) { ::close(fd); return nullptr; }
   void* base = ::mmap(nullptr, total_sz, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   if (base == MAP_FAILED) { ::close(fd); return nullptr; }
+  wksp_advise_huge(base, total_sz);
   auto* h = new (base) wksp_hdr();
   h->magic = WKSP_MAGIC;
   h->total_sz = total_sz;
@@ -129,6 +173,7 @@ wksp_join* fd_wksp_join(const char* path) {
   void* base = ::mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
                       MAP_SHARED, fd, 0);
   if (base == MAP_FAILED) { ::close(fd); return nullptr; }
+  wksp_advise_huge(base, (uint64_t)st.st_size);
   auto* h = (wksp_hdr*)base;
   if (h->magic != WKSP_MAGIC) { ::munmap(base, (size_t)st.st_size); ::close(fd); return nullptr; }
   return new wksp_join{base, (uint64_t)st.st_size, fd};

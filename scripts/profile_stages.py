@@ -108,6 +108,35 @@ def main():
                  (jnp.concatenate([sbytes, sbytes], axis=1),))
     print(f"sc_reduce64:         {t*1e3:8.3f} ms")
 
+    # --- RLC-mode stages (round-3: where the >=500k/s budget goes) ------
+    from firedancer_tpu.ops import msm as msm_mod
+    from firedancer_tpu.ops.verify_rlc import fresh_u, fresh_z
+
+    host_rng = np.random.default_rng(7)
+    z = jnp.asarray(fresh_z(batch, host_rng))
+    u = jnp.asarray(fresh_u(64, 2 * batch, host_rng))
+    both = tuple(jnp.concatenate([c, c], axis=1) for c in pt)  # 2B points
+
+    t = bench_fn(
+        jax.jit(lambda s, p: msm_mod.msm(
+            s, p, n_windows=msm_mod.WINDOWS_Z)[0]),
+        (z, pt),
+    )
+    print(f"msm z*(-R) [18w]:    {t*1e3:8.3f} ms")
+
+    scal253 = jnp.asarray(
+        np.concatenate([np.asarray(sbytes), np.zeros((batch, 0), np.uint8)],
+                       axis=1))
+    t = bench_fn(
+        jax.jit(lambda s, p: msm_mod.msm(
+            s, p, n_windows=msm_mod.WINDOWS_253)[0]),
+        (scal253, pt),
+    )
+    print(f"msm h*(-A) [37w]:    {t*1e3:8.3f} ms")
+
+    t = bench_fn(jax.jit(msm_mod.subgroup_check), (both, u))
+    print(f"torsion cert (K=64): {t*1e3:8.3f} ms")
+
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Round-2 VERDICT #4 done-criterion: 20 consecutive green runs of the
+# crash-midflight supervisor test (deterministic CNC_DIAG_UNACKED
+# trigger). Run: scripts/soak_crash_test.sh [N]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+N="${1:-20}"
+for i in $(seq 1 "$N"); do
+  echo "== soak run $i/$N"
+  python -m pytest \
+    tests/test_supervisor.py::test_crash_midflight_staged_batches_not_lost \
+    -q -p no:cacheprovider
+done
+echo "soak OK: $N/$N green"

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# One-command on-chip round: run the moment the axon tunnel is healthy.
+# Order: cheap probe -> kernel/RLC validation -> bench ladder (appends
+# BENCH_LOG.jsonl) -> 100k replay gate (REPLAY_r03.json).
+# Discipline: ONE TPU process at a time (the tunnel serializes across
+# processes; a collision wedges backend init) — this script is strictly
+# sequential and each stage has a hard timeout.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== probe (120s)"
+if ! timeout 120 python -u -c "
+import jax, jax.numpy as jnp
+d = jax.devices(); print('devices:', d, flush=True)
+print('matmul:', float((jnp.ones((128,128)) @ jnp.ones((128,128)))[0,0]))
+"; then
+  echo "probe FAILED — tunnel wedged or unreachable; aborting"
+  exit 1
+fi
+
+echo "== tpu_validate (kernels + RLC timing; 2400s)"
+timeout 2400 python -u scripts/tpu_validate.py 8192 || \
+  echo "tpu_validate failed (continuing: bench has its own ladder)"
+
+echo "== bench ladder (records BENCH_LOG.jsonl)"
+python bench.py || echo "bench ladder failed"
+tail -3 BENCH_LOG.jsonl 2>/dev/null
+
+echo "== 100k replay gate"
+FD_BENCH_MODE=replay timeout 3200 python bench.py --replay \
+  | tee REPLAY_r03.json || echo "replay gate failed"
+
+echo "== done; BENCH_LOG tail:"
+tail -5 BENCH_LOG.jsonl 2>/dev/null

@@ -8,6 +8,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import pytest
+
 import __graft_entry__ as graft
 
 
@@ -21,6 +23,7 @@ def test_entry_jits_and_runs():
     assert (statuses != 0).sum() > 0  # corrupted lanes rejected
 
 
+@pytest.mark.slow  # one 8192-lane shard_map compile: minutes on a CPU host
 def test_dryrun_multichip_8():
     assert jax.device_count() >= 8, "conftest should provide 8 CPU devices"
     graft.dryrun_multichip(8)

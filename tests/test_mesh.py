@@ -1,0 +1,57 @@
+"""Mesh-sharded verify: equality vs the single-device graph, and the
+ring pipeline feeding a data-parallel device mesh (round-2 VERDICT #7 —
+the multichip path must be exercised by the pipeline, not only by one
+standalone jitted step).
+
+Runs on the 8-device virtual CPU mesh conftest forces
+(xla_force_host_platform_device_count), the same way the driver's
+dryrun_multichip does.
+"""
+
+import numpy as np
+import pytest
+
+from __graft_entry__ import _example_batch
+from firedancer_tpu.disco.corpus import mainnet_corpus, sink_mismatch_count
+from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+pytestmark = pytest.mark.slow  # multi-process / compile-heavy (see pytest.ini)
+
+
+def test_verify_step_sharded_matches_single_device():
+    import jax
+
+    from firedancer_tpu.ops.verify import verify_batch
+    from firedancer_tpu.parallel.mesh import make_mesh, verify_step_sharded
+
+    mesh = make_mesh(8)
+    step = verify_step_sharded(mesh)
+    args = _example_batch(batch=64, max_len=512)
+    statuses, diag = step(*args)
+    ref = np.asarray(jax.jit(verify_batch)(*args))
+    assert (np.asarray(statuses) == ref).all()
+    assert int(diag["pub_cnt"]) == int((ref == 0).sum())
+    assert int(diag["filt_cnt"]) == int((ref != 0).sum())
+
+
+def test_pipeline_feeds_device_mesh(tmp_path):
+    """replay -> rings -> VerifyTile(mesh_devices=8) -> dedup -> pack ->
+    sink: the host rings feed a sharded device step; delivery must stay
+    content-exact (count equality alone would let compensating errors
+    cancel). Uses the same 8-device mesh + (64, 64) shape as the
+    equality test above, so the (minutes-long on CPU) shard_map compile
+    is shared through the persistent cache."""
+    corpus = mainnet_corpus(160, seed=33, max_data_sz=48)
+    topo = build_topology(str(tmp_path / "mesh.wksp"), depth=256)
+    res = run_pipeline(
+        topo,
+        corpus.payloads,
+        verify_backend="tpu",
+        verify_batch=64,
+        verify_max_msg_len=512,
+        timeout_s=600.0,
+        verify_opts={"mesh_devices": 8},
+        record_digests=True,
+    )
+    assert res.recv_cnt == corpus.n_unique_ok, res.diag
+    assert sink_mismatch_count(corpus, res.sink_digests) == 0

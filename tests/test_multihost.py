@@ -36,6 +36,8 @@ assert mesh.devices.shape == (2, 4)
 # three diag scalars crosses the process boundary.
 from firedancer_tpu.ballet import ed25519 as oracle
 
+pytestmark = pytest.mark.slow  # multi-process / compile-heavy (see pytest.ini)
+
 PER_HOST = 8
 
 def make_local(host_idx, lanes):

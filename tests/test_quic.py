@@ -498,3 +498,313 @@ def test_connection_migration():
         server.rx("evil", d, now)  # replayed from a spoofed source
     now = pump(now, steps=10)
     assert sconn.peer_addr == "cli-B"  # probe to "evil" never validated
+
+
+# ------------------------------------------------- DoS hardening (§8) ------
+
+def test_retry_handshake_completes():
+    """retry=True: first Initial gets a stateless Retry; the client echoes
+    the token and the handshake completes with the address pre-validated."""
+    received = []
+    c2s, s2c = [], []
+    client = Quic(
+        QuicConfig(is_server=False, identity_seed=os.urandom(32)),
+        tx=lambda a, d: c2s.append(d),
+    )
+    server = Quic(
+        QuicConfig(is_server=True, identity_seed=os.urandom(32), retry=True),
+        tx=lambda a, d: s2c.append(d),
+        on_stream=lambda conn, sid, data: received.append((sid, data)),
+    )
+    conn = client.connect(("srv", 1), 0.0)
+    now = _pump(client, server, conn, c2s, s2c, 0.0, steps=10)
+    assert conn.established
+    assert conn.stat_retries == 1
+    assert server.metrics["retries_sent"] == 1
+    assert server.metrics["tokens_accepted"] == 1
+    assert len(server.conns) == 1
+    assert server.conns[0].addr_validated
+    conn.send_stream(b"post-retry txn")
+    client.service(now)
+    _pump(client, server, conn, c2s, s2c, now, steps=6)
+    assert received and received[0][1] == b"post-retry txn"
+
+
+def test_retry_flood_allocates_no_state():
+    """A spoofed-source Initial flood against a retry server allocates
+    ZERO connection state and costs one small Retry datagram each."""
+    sent = []
+    server = Quic(
+        QuicConfig(is_server=True, identity_seed=os.urandom(32), retry=True),
+        tx=lambda a, d: sent.append((a, d)),
+    )
+    # One real client Initial datagram, replayed from many spoofed addrs.
+    probe = []
+    client = Quic(
+        QuicConfig(is_server=False, identity_seed=os.urandom(32)),
+        tx=lambda a, d: probe.append(d),
+    )
+    client.connect(("srv", 1), 0.0)
+    initial = probe[0]
+    for i in range(100):
+        server.rx(("spoofed", i), initial, now=0.001 * i)
+    assert len(server.conns) == 0
+    assert server.metrics["retries_sent"] == 100
+    # Bounded reflection: each response is far below the 1200B trigger.
+    assert all(len(d) < 200 for _, d in sent)
+
+
+def test_retry_token_is_address_bound():
+    """A token minted for one address must not validate from another
+    (anti-spoofing: the token proves the Retry round trip)."""
+    c2s, s2c = [], []
+    client = Quic(
+        QuicConfig(is_server=False, identity_seed=os.urandom(32)),
+        tx=lambda a, d: c2s.append(d),
+    )
+    server = Quic(
+        QuicConfig(is_server=True, identity_seed=os.urandom(32), retry=True),
+        tx=lambda a, d: s2c.append(d),
+    )
+    conn = client.connect(("srv", 1), 0.0)
+    # Initial -> Retry
+    server.rx(("cli", 1), c2s.pop(0), 0.0)
+    client.rx(("srv", 1), s2c.pop(0), 0.01)
+    client.service(0.01)
+    assert conn.stat_retries == 1
+    tokened_initial = c2s.pop(0)
+    # Replay the tokened Initial from a different (spoofed) source.
+    server.rx(("evil", 666), tokened_initial, 0.02)
+    assert server.metrics["tokens_rejected"] == 1
+    assert len(server.conns) == 0
+    # From the real address it is accepted.
+    server.rx(("cli", 1), tokened_initial, 0.02)
+    assert server.metrics["tokens_accepted"] == 1
+    assert len(server.conns) == 1
+
+
+def test_retry_token_expires():
+    c2s, s2c = [], []
+    client = Quic(
+        QuicConfig(is_server=False, identity_seed=os.urandom(32)),
+        tx=lambda a, d: c2s.append(d),
+    )
+    server = Quic(
+        QuicConfig(is_server=True, identity_seed=os.urandom(32), retry=True,
+                   token_lifetime=5.0),
+        tx=lambda a, d: s2c.append(d),
+    )
+    conn = client.connect(("srv", 1), 0.0)
+    server.rx(("cli", 1), c2s.pop(0), 0.0)
+    client.rx(("srv", 1), s2c.pop(0), 0.01)
+    client.service(0.01)
+    assert conn.stat_retries == 1
+    stale = c2s.pop(0)
+    server.rx(("cli", 1), stale, 100.0)  # long past token_lifetime
+    assert server.metrics["tokens_rejected"] == 1
+    assert len(server.conns) == 0
+
+
+def test_forged_retry_rejected():
+    """A Retry whose integrity tag is not keyed to the client's original
+    dcid (off-path forgery) must be ignored."""
+    c2s, s2c = [], []
+    client = Quic(
+        QuicConfig(is_server=False, identity_seed=os.urandom(32)),
+        tx=lambda a, d: c2s.append(d),
+    )
+    conn = client.connect(("srv", 1), 0.0)
+    forged = wire.encode_retry(
+        dcid=conn.scid, scid=b"EVILCID1", token=b"evil-token",
+        odcid=b"WRONGDCID",  # forger does not know the real odcid binding
+    )
+    client.rx(("srv", 1), forged, 0.01)
+    assert conn.stat_retries == 0
+    assert conn.dcid != b"EVILCID1"
+
+
+def test_amplification_limit_pre_validation():
+    """Until the client's address is validated, the server sends at most
+    3x the bytes it received — even across PTO retransmissions."""
+    c2s, s2c = [], []
+    srv_bytes = []
+    client = Quic(
+        QuicConfig(is_server=False, identity_seed=os.urandom(32)),
+        tx=lambda a, d: c2s.append(d),
+    )
+    server = Quic(
+        QuicConfig(is_server=True, identity_seed=os.urandom(32)),
+        tx=lambda a, d: (s2c.append(d), srv_bytes.append(len(d))),
+    )
+    conn = client.connect(("srv", 1), 0.0)
+    rx_bytes = sum(len(d) for d in c2s)
+    while c2s:
+        server.rx(("cli", 1), c2s.pop(0), 0.0)
+    # Starve the server of further client traffic; let its timers fire
+    # (staying inside the idle timeout so the conn survives to finish).
+    now = 0.0
+    for _ in range(16):
+        now += 0.5
+        server.service(now)
+    assert sum(srv_bytes) <= 3 * rx_bytes
+    assert server.conns and server.conns[0].stat_amp_blocked > 0
+    assert not server.conns[0].addr_validated
+    # The handshake still completes once the client talks again.
+    now = _pump(client, server, conn, c2s, s2c, now, steps=10)
+    assert conn.established
+    assert server.conns[0].addr_validated
+
+
+def test_stateless_reset_tears_down_connection():
+    """A 'rebooted' endpoint (same static reset key, no conn state)
+    answers the client's traffic with a Stateless Reset; the client must
+    recognize the token from the old server's transport params and close
+    instead of retransmitting forever."""
+    received = []
+    client, server, c2s, s2c = _mk_pair(received)
+    conn = client.connect(("srv", 1), 0.0)
+    now = _pump(client, server, conn, c2s, s2c, 0.0, steps=8)
+    assert conn.established
+    assert conn.peer_reset_token is not None
+    # Reboot: fresh endpoint, SAME static reset key, zero conn state.
+    reborn = Quic(
+        QuicConfig(is_server=True, identity_seed=os.urandom(32)),
+        tx=lambda a, d: s2c.append(d),
+    )
+    reborn._reset_key = server._reset_key
+    conn.send_stream(b"into the void")
+    client.service(now)
+    while c2s:
+        reborn.rx(("cli", 1), c2s.pop(0), now)
+    assert reborn.metrics["resets_sent"] >= 1
+    while s2c:
+        client.rx(("srv", 1), s2c.pop(0), now)
+    assert conn.closed
+    assert conn.close_reason == "stateless reset"
+    assert conn.stat_stateless_reset == 1
+
+
+def test_fake_stateless_reset_ignored():
+    """An off-path attacker without the reset key cannot kill the conn:
+    a garbage 'reset' with the wrong token is just an undecryptable
+    datagram."""
+    received = []
+    client, server, c2s, s2c = _mk_pair(received)
+    conn = client.connect(("srv", 1), 0.0)
+    now = _pump(client, server, conn, c2s, s2c, 0.0, steps=8)
+    assert conn.established
+    fake = wire.encode_stateless_reset(os.urandom(16), 48)
+    client.rx(("srv", 1), fake, now)
+    assert not conn.closed
+    assert conn.stat_stateless_reset == 0
+
+
+def test_time_threshold_loss_detection():
+    """One lost packet with too small a flight for the 3-packet
+    threshold: the time threshold (9/8 rtt) must retransmit it without
+    waiting out a full PTO backoff."""
+    received = []
+    state = {"arm": False, "dropped": 0}
+
+    def drop(d):
+        if state["arm"] and state["dropped"] == 0:
+            state["dropped"] += 1
+            return True
+        return False
+
+    client, server, c2s, s2c = _mk_pair(received, drop=drop)
+    conn = client.connect(("srv", 1), 0.0)
+    now = _pump(client, server, conn, c2s, s2c, 0.0, steps=8)
+    assert conn.established
+    state["arm"] = True
+    conn.send_stream(b"lost-on-first-tx")
+    client.service(now)          # dropped datagram
+    state["arm"] = False
+    conn.send_stream(b"second")  # separate later packet, acked normally
+    client.service(now + 0.002)
+    # Pump with steps far below the PTO; only the time threshold can
+    # declare the first packet lost (pn gap is 1, not 3).
+    pto0 = conn.rtt.pto()
+    for _ in range(40):
+        now += 0.02
+        while c2s:
+            server.rx(("cli", 1), c2s.pop(0), now)
+        while s2c:
+            client.rx(("srv", 1), s2c.pop(0), now)
+        client.service(now)
+        server.service(now)
+        if {d for _, d in received} >= {b"lost-on-first-tx", b"second"}:
+            break
+    assert {d for _, d in received} >= {b"lost-on-first-tx", b"second"}
+    assert conn.rtt.pto_count == 0 or conn.rtt.pto() <= pto0  # no PTO storm
+
+
+def test_inflight_path_probe_not_clobbered():
+    """RFC 9000 §9.3 + round-2 ADVICE: while a PATH_CHALLENGE is in
+    flight, packets racing in from other (possibly spoofed) addresses
+    must not replace the probe."""
+    received = []
+    client, server, c2s, s2c = _mk_pair(received)
+    conn = client.connect(("srv", 1), 0.0)
+    now = _pump(client, server, conn, c2s, s2c, 0.0, steps=8)
+    srv_conn = server.conns[0]
+    assert srv_conn.established
+    # Client migrates: same datagrams, new source address.
+    conn.send_stream(b"after-rebind")
+    client.service(now)
+    dg = c2s.pop(0)
+    server.rx(("cli-rebind", 2), dg, now)
+    assert srv_conn._probe_addr == ("cli-rebind", 2)
+    probe_data = srv_conn._probe_data
+    # Attacker races a copy of a later genuine datagram from a spoofed
+    # source before the probe completes.
+    conn.send_stream(b"second")
+    client.service(now + 0.001)
+    dg2 = c2s.pop(0)
+    server.rx(("spoof", 99), dg2, now + 0.001)
+    assert srv_conn._probe_addr == ("cli-rebind", 2)   # unchanged
+    assert srv_conn._probe_data == probe_data          # same challenge
+
+
+def test_pmtud_raises_datagram_budget():
+    """DPLPMTUD over lossless in-memory wires: both sides should walk
+    the probe ladder to 1452 and raise their datagram budget."""
+    received = []
+    client, server, c2s, s2c = _mk_pair(received)
+    conn = client.connect(("srv", 1), 0.0)
+    now = _pump(client, server, conn, c2s, s2c, 0.0, steps=8)
+    assert conn.established
+    now = _pump(client, server, conn, c2s, s2c, now, steps=12)
+    assert conn.max_datagram == 1452
+    assert server.conns[0].max_datagram == 1452
+    assert conn.stat_pmtu_probes >= 2  # 1350 then 1452
+
+
+def test_pmtud_blackhole_keeps_conservative_budget():
+    """Probes above 1200 are blackholed: the search must END at the
+    conservative default (lost probes are answers, not retransmits) and
+    normal traffic must keep flowing."""
+    received = []
+
+    def drop(d):
+        return len(d) > 1200
+
+    client, server, c2s, s2c = _mk_pair(received, drop=drop)
+    conn = client.connect(("srv", 1), 0.0)
+    now = _pump(client, server, conn, c2s, s2c, 0.0, steps=8)
+    assert conn.established
+    # Pump past several PTOs so the lost probe is declared.
+    for _ in range(10):
+        now += 0.4
+        while c2s:
+            server.rx(("cli", 1), c2s.pop(0), now)
+        while s2c:
+            client.rx(("srv", 1), s2c.pop(0), now)
+        client.service(now)
+        server.service(now)
+    assert conn.max_datagram == 1200
+    assert conn._pmtu_done
+    conn.send_stream(b"still-works")
+    client.service(now)
+    _pump(client, server, conn, c2s, s2c, now, steps=4)
+    assert received and received[-1][1] == b"still-works"

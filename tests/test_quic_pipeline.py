@@ -82,3 +82,20 @@ def test_quic_pipeline_end_to_end(tmp_path):
     assert res.recv_cnt == n, res.diag
     assert sum(res.bank_hist.values()) == n
     assert res.recv_sz == sum(len(t) for t in txns)
+
+
+def test_quic_pipeline_with_retry(tmp_path):
+    """Same ingest path with the stateless-Retry DoS posture armed: the
+    client transparently completes the token round trip and delivery is
+    unchanged (round-3 QUIC hardening, RFC 9000 §8.1.2)."""
+    txns = _mk_txns(12, seed=3)
+    topo = build_topology(str(tmp_path / "quicr.wksp"), depth=64)
+    res = run_quic_pipeline(
+        topo,
+        lambda addr: _quic_client(addr, txns),
+        n_txns=len(txns),
+        verify_backend="oracle",
+        timeout_s=60.0,
+        quic_retry=True,
+    )
+    assert res.recv_cnt == len(txns), res.diag

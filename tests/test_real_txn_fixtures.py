@@ -1,0 +1,116 @@
+"""Real mainnet transaction fixtures through parser + verify + pipeline.
+
+Round-2 VERDICT #10: every other corpus in this repo is self-generated
+(disco/corpus.py signs with the repo's own signer), so correctness was
+anchored only to the repo's own construction. These fixtures are REAL
+Solana mainnet transaction bytes — the same vectors the reference ships
+(/root/reference/src/ballet/txn/fixtures/transaction{1,2,3}.bin, checked
+in verbatim as test data, like an RFC vector): a 4-signature legacy txn,
+a 1-signature txn, and an MTU-sized (1232 B) txn.
+
+What they pin: wire-format parsing of real (not generator-shaped)
+payloads, Ed25519 verification of real wallet signatures on both the
+CPU oracle and the batched TPU graph, and content-exact delivery
+through the full tile pipeline.
+"""
+
+import os
+
+import numpy as np
+
+import pytest
+
+from firedancer_tpu.ballet import ed25519 as oracle
+from firedancer_tpu.ballet.txn import parse_txn
+
+_DIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _fixtures():
+    return [
+        open(os.path.join(_DIR, f"transaction{i}.bin"), "rb").read()
+        for i in (1, 2, 3)
+    ]
+
+
+def test_real_txns_parse_and_oracle_verify():
+    raws = _fixtures()
+    assert [len(r) for r in raws] == [1197, 507, 1232]
+    sig_cnts = []
+    for raw in raws:
+        txn = parse_txn(raw)
+        items = list(txn.verify_items(raw))
+        sig_cnts.append(len(items))
+        for sig, pub, msg in items:
+            assert oracle.verify(msg, sig, pub) == 0
+    assert sig_cnts == [4, 1, 1]  # fixture 1 is a real multisig txn
+
+
+@pytest.mark.slow  # MTU-length messages: a fresh (and large) sha512 graph
+def test_real_txns_batched_device_verify():
+    """The same real signatures through the batched verify graph, plus
+    corrupted copies that must fail."""
+    import jax
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops.verify import verify_batch
+
+    items = []
+    for raw in _fixtures():
+        items.extend(parse_txn(raw).verify_items(raw))
+    n = len(items)
+    max_len = max(len(m) for _, _, m in items)
+    lanes = 2 * n
+    msgs = np.zeros((lanes, max_len), np.uint8)
+    lens = np.zeros(lanes, np.int32)
+    sigs = np.zeros((lanes, 64), np.uint8)
+    pubs = np.zeros((lanes, 32), np.uint8)
+    for i, (sig, pub, msg) in enumerate(items + items):
+        m = np.frombuffer(msg, np.uint8)
+        msgs[i, : len(m)] = m
+        lens[i] = len(m)
+        sigs[i] = np.frombuffer(sig, np.uint8)
+        pubs[i] = np.frombuffer(pub, np.uint8)
+        if i >= n:
+            msgs[i, 0] ^= 1  # corrupt the message: must fail verify
+    st = np.asarray(jax.jit(verify_batch)(
+        jnp.asarray(msgs), jnp.asarray(lens),
+        jnp.asarray(sigs), jnp.asarray(pubs),
+    ))
+    assert (st[:n] == 0).all(), st[:n]
+    assert (st[n:] != 0).all(), st[n:]
+
+
+def test_real_txns_through_pipeline(tmp_path):
+    """All three fixtures (plus a corrupt copy) through replay -> verify
+    (oracle backend) -> dedup -> pack -> sink.
+
+    What actually happens to these particular mainnet txns — found BY
+    this fixture, and matching the reference exactly:
+    - txn1 carries the ancient 5-byte ComputeBudget RequestUnits form;
+      the reference's parser demands 9 bytes for tag 0
+      (fd_compute_budget_program.h:87-90) and fails the whole txn at
+      pack insert (fd_pack.c:298-299). Dropped at pack, counted.
+    - txn3 has 355 empty instructions => default CU estimate 355 * 200k
+      = 71M, above any bank budget: never schedulable, dropped at pack.
+    - txn2 (and not its corrupted copy) flows to the sink.
+    All three PASS sigverify; the corrupt copy fails it."""
+    import hashlib
+
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    raws = _fixtures()
+    bad = bytearray(raws[1])
+    bad[-1] ^= 0x01  # corrupt a signature byte of txn2's copy
+    payloads = raws + [bytes(bad)]
+    topo = build_topology(str(tmp_path / "fix.wksp"), depth=64)
+    res = run_pipeline(
+        topo, payloads, verify_backend="oracle", timeout_s=60.0,
+        record_digests=True,
+    )
+    # sigverify: 3 of 4 pass (the corrupt copy is filtered at verify)
+    assert res.diag["tile.verify"]["sv_filt_cnt"] == 1, res.diag
+    # pack: txn1 (malformed budget instr) + txn3 (71M CU) dropped there
+    assert res.diag["link.dedup_pack"]["filt_cnt"] == 2, res.diag
+    assert res.recv_cnt == 1, res.diag
+    assert res.sink_digests == [hashlib.sha256(raws[1]).digest()]

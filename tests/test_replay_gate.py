@@ -17,6 +17,8 @@ from firedancer_tpu.ballet import ed25519 as oracle
 from firedancer_tpu.ballet.txn import TxnParseError, parse_txn
 from firedancer_tpu.disco.corpus import BAD_PARSE, BAD_SIG, DUP, OK, mainnet_corpus
 
+pytestmark = pytest.mark.slow  # multi-process / compile-heavy (see pytest.ini)
+
 
 N = 160  # CPU-sized; the 100k hardware run is bench.py --replay
 

@@ -16,6 +16,8 @@ from firedancer_tpu.disco.corpus import mainnet_corpus
 from firedancer_tpu.disco.pipeline import build_topology
 from firedancer_tpu.disco.supervisor import run_pipeline_supervised
 
+pytestmark = pytest.mark.slow  # multi-process / compile-heavy (see pytest.ini)
+
 
 @pytest.fixture(scope="module")
 def corpus():
@@ -36,36 +38,60 @@ def test_supervised_pipeline_end_to_end(tmp_path, corpus):
 
 
 def test_crash_midflight_staged_batches_not_lost(tmp_path):
-    """Kill the verify tile EARLY, while device batches are staged or in
-    flight (tpu backend, small batches): the held-back ack cursor must
-    leave every consumed-but-unverified txn re-readable, so delivery is
-    still content-exact. This is the window a consumed-seq fseq would
-    lose txns in."""
-    corpus = mainnet_corpus(3000, seed=21, dup_rate=0.0, corrupt_rate=0.0,
-                            parse_err_rate=0.0, max_data_sz=64)
-    topo = build_topology(str(tmp_path / "mid.wksp"), depth=64)
+    """Kill the verify tile at the EXACT moment it is holding staged or
+    in-flight device batches: the held-back ack cursor must leave every
+    consumed-but-unverified txn re-readable, so delivery is still
+    content-exact. This is the window a consumed-seq fseq would lose
+    txns in.
+
+    Determinism (round-2 VERDICT #4): the trigger is the verify tile's
+    own CNC_DIAG_UNACKED gauge — the count of consumed-but-unverified
+    frags it published from housekeep — crossing a full batch, not a
+    wall-clock race on delivery counts. The gauge cannot pass 0 ->
+    >=batch -> 0 between supervisor polls, because draining it requires
+    the whole first device batch to verify AND a housekeep to publish
+    the acks, which takes orders of magnitude longer than the 50 ms
+    poll; and it is guaranteed to rise because the ring (depth 128)
+    holds the whole corpus while the first verify dispatch is still
+    compiling/running."""
+    corpus = mainnet_corpus(96, seed=21, dup_rate=0.0, corrupt_rate=0.0,
+                            parse_err_rate=0.0, max_data_sz=48)
+    batch = 32
+    # Warm the persistent compile cache for the verify worker's exact
+    # (batch, msg_len) shape: a cold compile takes minutes on a small
+    # host and would silently eat the supervised run's budget inside
+    # the worker's boot (the flakiness that plagued this test in r2).
+    import jax
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops.verify import verify_batch
+
+    jax.jit(verify_batch).lower(
+        jnp.zeros((batch, 512), jnp.uint8), jnp.zeros((batch,), jnp.int32),
+        jnp.zeros((batch, 64), jnp.uint8), jnp.zeros((batch, 32), jnp.uint8),
+    ).compile()
+    topo = build_topology(str(tmp_path / "mid.wksp"), depth=128)
     state = {"kills": 0}
-    from firedancer_tpu.tango.rings import DIAG_PUB_CNT, FSeq, Workspace
+    from firedancer_tpu.disco.tiles import CNC_DIAG_UNACKED
+    from firedancer_tpu.tango.rings import Cnc, Workspace
 
     wksp = Workspace.join(topo.wksp_path)
-    sink_fseq = FSeq(wksp, topo.pod.query_cstr("firedancer.pack_sink.fseq"))
+    verify_cnc = Cnc(wksp, topo.pod.query_cstr("firedancer.verify.cnc"))
 
     def fault(tiles, elapsed):
-        # Kill verify once flow has started but well before the corpus
-        # drains — device batches are guaranteed staged or in flight.
         tp = tiles["verify"]
-        delivered = sink_fseq.diag(DIAG_PUB_CNT)
+        staged = verify_cnc.diag(CNC_DIAG_UNACKED)
         if (state["kills"] == 0 and tp.proc.poll() is None
-                and 10 <= delivered < 2500):
+                and staged >= batch):
             os.kill(tp.proc.pid, signal.SIGKILL)
             state["kills"] += 1
 
     res = run_pipeline_supervised(
-        topo, corpus.payloads, verify_backend="tpu", verify_batch=128,
-        verify_max_msg_len=192, timeout_s=240.0, fault_hook=fault,
+        topo, corpus.payloads, verify_backend="tpu", verify_batch=batch,
+        verify_max_msg_len=512, timeout_s=300.0, fault_hook=fault,
         record_digests=True, jax_platform="cpu",
     )
-    assert state["kills"] >= 1
+    assert state["kills"] == 1
     assert res.supervisor_restarts >= state["kills"]
     assert res.recv_cnt == corpus.n_unique_ok, res.diag
     from firedancer_tpu.disco.corpus import sink_mismatch_count

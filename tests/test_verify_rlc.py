@@ -15,10 +15,11 @@ from firedancer_tpu.ballet import ed25519 as oracle
 from firedancer_tpu.ops import fe25519 as fe
 from firedancer_tpu.ops import msm as msm_mod
 from firedancer_tpu.ops.verify import verify_batch
-from firedancer_tpu.ops.verify_rlc import fresh_z, verify_batch_rlc
+from firedancer_tpu.ops.verify_rlc import fresh_u, fresh_z, verify_batch_rlc
 
 N = 16
 MAX_LEN = 64
+K = 8  # torsion-check trials in tests (production default is 64)
 
 _jitted = {}
 
@@ -29,6 +30,12 @@ def _rlc():
 
         _jitted["rlc"] = jax.jit(verify_batch_rlc)
     return _jitted["rlc"]
+
+
+def _zu(seed):
+    rng = np.random.default_rng(seed)
+    return (jnp.asarray(fresh_z(N, rng)),
+            jnp.asarray(fresh_u(K, 2 * N, rng)))
 
 
 def _direct():
@@ -128,8 +135,8 @@ def _batch(bad=()):
 
 def test_rlc_all_valid():
     args = _batch()
-    z = jnp.asarray(fresh_z(N, np.random.default_rng(1)))
-    status, definite, ok = _rlc()(*args, z)
+    z, u = _zu(1)
+    status, definite, ok = _rlc()(*args, z, u)
     assert bool(ok)
     assert not bool(jnp.any(definite))
     assert bool(jnp.all(status == 0))
@@ -137,8 +144,8 @@ def test_rlc_all_valid():
 
 def test_rlc_detects_bad_lane():
     args = _batch(bad=(7,))
-    z = jnp.asarray(fresh_z(N, np.random.default_rng(2)))
-    status, definite, ok = _rlc()(*args, z)
+    z, u = _zu(2)
+    status, definite, ok = _rlc()(*args, z, u)
     # The corrupted-R lane may or may not decompress; either it is caught
     # as definite ERR_MSG, or the batch equation must fail.
     if bool(definite[7]):
@@ -170,8 +177,8 @@ def test_rlc_definite_lanes_match_per_lane_path():
     sigs[3, 31] = 0x7F
 
     args = (msgs, lens, jnp.asarray(sigs), jnp.asarray(pubs))
-    z = jnp.asarray(fresh_z(N, np.random.default_rng(3)))
-    status, definite, ok = _rlc()(*args, z)
+    z, u = _zu(3)
+    status, definite, ok = _rlc()(*args, z, u)
     ref = _direct()(*args)
     for lane in (1, 2):
         assert bool(definite[lane])
@@ -189,7 +196,7 @@ def test_async_verifier_clean_and_dirty():
 
     direct = _direct()
     fn = make_async_verifier(direct, rng=np.random.default_rng(9),
-                             rlc_fn=_rlc())
+                             rlc_fn=_rlc(), torsion_k=K)
 
     clean = _batch()
     out = fn(*clean)
@@ -205,3 +212,119 @@ def test_async_verifier_clean_and_dirty():
     ref = np.asarray(direct(*dirty))
     assert (st == ref).all()
     assert int(st[3]) != 0
+
+
+def _torsion_batch(T, lanes=(4, 5)):
+    """ADVICE round-2 high-severity construction: R_i = r_i*B + T,
+    s_i = r_i + h_i*a_i. Each lane fails per-lane verify (the defect
+    s*B - h*A - R is exactly -T != identity), but the defect lies
+    entirely in the torsion subgroup, invisible to the bare RLC
+    equation whenever the z-weighted torsion combination cancels."""
+    msgs, lens, sigs, pubs = (np.asarray(a).copy() for a in _batch())
+    for i in lanes:
+        seed = bytes([i + 1]) * 32
+        a, _, pub = oracle.keypair_from_seed(seed)
+        m = msgs[i, : lens[i]].tobytes()
+        r = 987_654_321 + i
+        big_r = oracle.point_add(oracle.scalarmult(r, oracle.B), T)
+        r_bytes = oracle.point_compress(big_r)
+        from firedancer_tpu.ballet.ed25519.oracle import _sha512_mod_l
+
+        h = _sha512_mod_l(r_bytes, pub, m)
+        s = (r + h * a) % oracle.L
+        sig = r_bytes + s.to_bytes(32, "little")
+        assert oracle.verify(m, sig, pub) != 0  # per-lane truth: reject
+        sigs[i] = np.frombuffer(sig, np.uint8)
+    return (jnp.asarray(msgs), jnp.asarray(lens), jnp.asarray(sigs),
+            jnp.asarray(pubs))
+
+
+def test_rlc_rejects_order2_torsion_forgery_pair():
+    """Two order-2-offset lanes: their torsion defects cancel in the RLC
+    sum for any z pair of equal parity (always, under the old forced-odd
+    z), so only the subgroup certification can force the fallback."""
+    t2 = (0, oracle.P - 1)
+    assert oracle.scalarmult(2, t2) == (0, 1)  # order 2
+    args = _torsion_batch(t2)
+    for seed in (21, 22):
+        z, u = _zu(seed)
+        status, definite, ok = _rlc()(*args, z, u)
+        assert not bool(definite[4]) and not bool(definite[5])
+        assert not bool(ok)  # batch MUST fall back to the per-lane path
+    ref = _direct()(*args)
+    assert int(ref[4]) != 0 and int(ref[5]) != 0
+
+
+def test_rlc_rejects_order8_torsion_forgery():
+    """Order-8 defects cancel with probability 1/4 per pair under the
+    bare equation; the certification must still force the fallback."""
+    # The canonical order-8 torsion point encoding (its y coordinate is
+    # a full-size field element, so it cannot be found by scanning small
+    # encodings; this is the well-known small-order list entry).
+    t8_enc = bytes.fromhex(
+        "26e8958fc2b227b045c3f489f2ef98f0d5dfac05d3c63339b13802886d53fc05"
+    )
+    t8 = oracle.point_decompress(t8_enc)
+    assert t8 is not None
+    assert oracle.scalarmult(8, t8) == (0, 1)
+    assert oracle.scalarmult(4, t8) != (0, 1)
+    args = _torsion_batch(t8, lanes=(4, 5, 6, 7))
+    z, u = _zu(23)
+    status, definite, ok = _rlc()(*args, z, u)
+    assert not bool(ok)
+
+
+def test_subgroup_check_mixed_and_small_order():
+    """msm.subgroup_check directly: clean prime-order sets certify; a
+    mixed-order point (prime + torsion component, invisible to any
+    small-order blacklist) and a pure small-order point are caught."""
+    import jax
+
+    t2 = (0, oracle.P - 1)
+    t4 = oracle.point_decompress(bytes(32))  # y=0 => x^2 = -1, order 4
+    assert t4 is not None
+    assert oracle.scalarmult(4, t4) == (0, 1)
+    assert oracle.scalarmult(2, t4) != (0, 1)
+
+    clean = [oracle.scalarmult(3 + i, oracle.B) for i in range(6)]
+    f = jax.jit(msm_mod.subgroup_check)
+    u = jnp.asarray(fresh_u(K, 6, np.random.default_rng(31)))
+    ok, fill_ok = f(_mkpts(clean), u)
+    assert bool(fill_ok) and bool(ok)
+
+    mixed = list(clean)
+    mixed[2] = oracle.point_add(clean[2], t4)
+    for seed in (32, 33):
+        u = jnp.asarray(fresh_u(K, 6, np.random.default_rng(seed)))
+        ok, fill_ok = f(_mkpts(mixed), u)
+        assert bool(fill_ok)
+        assert not bool(ok)
+
+    small = list(clean)
+    small[0] = t2
+    u = jnp.asarray(fresh_u(K, 6, np.random.default_rng(34)))
+    ok, _ = f(_mkpts(small), u)
+    assert not bool(ok)
+
+
+def test_async_verifier_default_entropy_is_urandom(monkeypatch):
+    """VERDICT r2 #5: the production entry must draw z (and u) from
+    os.urandom, not a numpy statistical PRNG."""
+    import os as _os
+
+    from firedancer_tpu.ops.verify_rlc import make_async_verifier
+
+    calls = []
+    real = _os.urandom
+
+    def spy(n):
+        calls.append(n)
+        return real(n)
+
+    monkeypatch.setattr("os.urandom", spy)
+    fn = make_async_verifier(_direct(), rlc_fn=_rlc(), torsion_k=K)
+    out = fn(*_batch())
+    st = np.asarray(out)
+    assert not out.used_fallback
+    assert (st == 0).all()
+    assert calls, "z/u weights were not drawn from the CSPRNG"
