@@ -45,6 +45,29 @@ def _pow_auto():
     return fe.fe_invert, fe.fe_pow22523
 
 
+def decompress_auto(y_bytes: jnp.ndarray):
+    """Backend-dispatched decompress: fused Pallas kernel on TPU
+    (ops/curve_pallas.py), the XLA graph elsewhere."""
+    from .backend import use_pallas
+
+    if use_pallas("FD_DECOMPRESS_IMPL"):
+        from .curve_pallas import decompress_pallas
+
+        return decompress_pallas(y_bytes)
+    return decompress(y_bytes)
+
+
+def compress_auto(p) -> jnp.ndarray:
+    """Backend-dispatched compress: fused Pallas kernel on TPU."""
+    from .backend import use_pallas
+
+    if use_pallas("FD_COMPRESS_IMPL"):
+        from .curve_pallas import compress_pallas
+
+        return compress_pallas(p)
+    return compress(p)
+
+
 def identity(batch_shape):
     return (
         fe.fe_zero(batch_shape),

@@ -1,0 +1,189 @@
+"""Fused Pallas TPU kernels for point decompression and compression.
+
+The XLA decompress/compress graphs interleave a handful of field muls
+and canonical-form compares around the Pallas power chains; at
+production batch sizes each stray XLA fe_mul streams its operands
+through HBM (~0.8 ms amortized at B=8192 on v5e) and each canonicalize
+costs a multi-kernel elementwise chain (~7.6 ms measured) — together
+they dwarf the in-VMEM power chain (8.3 ms). These kernels run the
+ENTIRE decompress (square-root candidate via z^((p-5)/8), root checks,
+sign fix-up, identity poison for failed lanes) and compress (per-lane
+inversion chain, canonical bytes, sign bit) on one VMEM-resident lane
+tile, leaving only byte<->limb transposes outside.
+
+Reference semantics: donna-style decompression and canonical encoding,
+identical to curve25519.decompress/compress (the XLA path, which stays
+as the CPU/dryrun implementation and the correctness oracle) — see
+/root/reference/src/ballet/ed25519/ref/fd_ed25519_ge.c:242 (frombytes)
+and fe_tobytes usage therein.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import fe25519 as fe
+
+NLIMBS = fe.NLIMBS
+LANES = 512
+
+
+# One kernel-safe power-chain implementation for all Pallas modules
+# (backend.use_specialized_square's dispatch lives behind these).
+from .pow_pallas import _ladder, _mul, _sq, _sqn
+
+
+def _pow22523(z):
+    z_250_0, _ = _ladder(z)
+    return _mul(_sqn(z_250_0, 2), z)
+
+
+def _invert(z):
+    z_250_0, z11 = _ladder(z)
+    return _mul(_sqn(z_250_0, 5), z11)
+
+
+def _sel(m, a, b):
+    """Arithmetic lane select: m (1, L) int32 in {0,1}."""
+    return m * a + (1 - m) * b
+
+
+@functools.lru_cache(maxsize=1)
+def _const_cols() -> np.ndarray:
+    """(32, 2) int32: column 0 = d, column 1 = sqrt(-1) (kernel input —
+    Pallas kernels cannot capture constant arrays)."""
+    out = np.zeros((NLIMBS, 2), np.int32)
+    for c, val in enumerate((fe.D_INT, fe.SQRT_M1_INT)):
+        for i in range(NLIMBS):
+            out[i, c] = (val >> (8 * i)) & 0xFF
+    return out
+
+
+def _decompress_kernel(yin, sign, consts, ox, oy, oz, ot, ook):
+    y = yin[...]
+    lanes = y.shape[1]
+    d_c = jnp.broadcast_to(consts[:, 0:1], (NLIMBS, lanes))
+    sqrtm1 = jnp.broadcast_to(consts[:, 1:2], (NLIMBS, lanes))
+    one = (jax.lax.broadcasted_iota(jnp.int32, (NLIMBS, lanes), 0) == 0)
+    one = one.astype(jnp.int32)
+
+    yy = _sq(y)
+    u = fe.fe_sub(yy, one)                      # y^2 - 1
+    v = fe.fe_add(_mul(yy, d_c), one)           # d y^2 + 1
+    v3 = _mul(_sq(v), v)
+    uv7 = _mul(_mul(_sq(v3), v), u)             # u v^7
+    x = _mul(_mul(_pow22523(uv7), v3), u)       # u v^3 (uv^7)^((p-5)/8)
+
+    vxx = _mul(_sq(x), v)
+    root_ok = fe.fe_is_zero_k(fe.fe_sub(vxx, u))           # (1, L)
+    neg_ok = fe.fe_is_zero_k(fe.fe_add(vxx, u))
+    x = _sel(root_ok, x, _mul(x, sqrtm1))
+    ok = root_ok | neg_ok
+
+    flip = fe.fe_parity_k(x) ^ sign[...]
+    x = _sel(flip, fe.fe_neg(x), x)
+
+    t = _mul(x, y)
+    zero = jnp.zeros((NLIMBS, lanes), jnp.int32)
+    # Failed lanes carry the identity (0, 1, 1, 0) — harmless poison.
+    ox[...] = _sel(ok, x, zero)
+    oy[...] = _sel(ok, y, one)
+    oz[...] = one
+    ot[...] = _sel(ok, t, zero)
+    ook[...] = ok
+
+
+def decompress_pallas(y_bytes: jnp.ndarray, interpret: bool = False,
+                      lanes: int | None = None):
+    """Drop-in for curve25519.decompress on TPU: (B, 32) uint8 ->
+    ((X, Y, Z, T) of (32, B) limbs, (B,) bool ok). lanes overrides the
+    kernel tile width (tests use a small tile to exercise padding)."""
+    from jax.experimental import pallas as pl
+
+    bsz = y_bytes.shape[0]
+    if bsz < 128:
+        # Sub-tile batches: the XLA path beats a padded kernel launch.
+        from . import curve25519 as ge
+
+        return ge.decompress(y_bytes)
+    sign = (y_bytes[:, 31] >> 7).astype(jnp.int32)[None, :]    # (1, B)
+    y = fe.fe_from_bytes(y_bytes, mask_high_bit=True)          # (32, B)
+    lanes = lanes or min(LANES, bsz)
+    pad = (-bsz) % lanes
+    if pad:
+        y = jnp.pad(y, ((0, 0), (0, pad)))
+        sign = jnp.pad(sign, ((0, 0), (0, pad)))
+    n = (bsz + pad) // lanes
+
+    spec_fe = pl.BlockSpec((NLIMBS, lanes), lambda i: (0, i))
+    spec_row = pl.BlockSpec((1, lanes), lambda i: (0, i))
+    spec_c = pl.BlockSpec((NLIMBS, 2), lambda i: (0, 0))
+    out_fe = jax.ShapeDtypeStruct((NLIMBS, bsz + pad), jnp.int32)
+    out_row = jax.ShapeDtypeStruct((1, bsz + pad), jnp.int32)
+    x, yy, z, t, ok = pl.pallas_call(
+        _decompress_kernel,
+        grid=(n,),
+        in_specs=[spec_fe, spec_row, spec_c],
+        out_specs=[spec_fe] * 4 + [spec_row],
+        out_shape=[out_fe] * 4 + [out_row],
+        interpret=interpret,
+    )(y, sign, jnp.asarray(_const_cols()))
+    if pad:
+        x, yy, z, t = (c[:, :bsz] for c in (x, yy, z, t))
+        ok = ok[:, :bsz]
+    return (x, yy, z, t), ok[0] != 0
+
+
+def _compress_kernel(xin, yin, zin, ocy, osign):
+    x = xin[...]
+    y = yin[...]
+    z = zin[...]
+    zinv = _invert(z)
+    ax = _mul(x, zinv)
+    ay = _mul(y, zinv)
+    ocy[...] = fe._canonicalize_k(ay)
+    osign[...] = fe.fe_parity_k(ax)
+
+
+def compress_pallas(p, interpret: bool = False,
+                    lanes: int | None = None) -> jnp.ndarray:
+    """Drop-in for curve25519.compress on TPU: (X:Y:Z:T) limbs ->
+    (B, 32) uint8 canonical encodings. Runs the per-lane inversion
+    chain in VMEM (the grouped Montgomery tree needs cross-lane muls,
+    which cost more in XLA launches than the extra in-kernel chain)."""
+    from jax.experimental import pallas as pl
+
+    x, y, z, _ = p
+    bsz = x.shape[1]
+    if bsz < 128:
+        from . import curve25519 as ge
+
+        return ge.compress(p)
+    lanes = lanes or min(LANES, bsz)
+    pad = (-bsz) % lanes
+    if pad:
+        x, y, z = (jnp.pad(c, ((0, 0), (0, pad))) for c in (x, y, z))
+    n = (bsz + pad) // lanes
+
+    spec_fe = pl.BlockSpec((NLIMBS, lanes), lambda i: (0, i))
+    spec_row = pl.BlockSpec((1, lanes), lambda i: (0, i))
+    cy, sgn = pl.pallas_call(
+        _compress_kernel,
+        grid=(n,),
+        in_specs=[spec_fe] * 3,
+        out_specs=[spec_fe, spec_row],
+        out_shape=[
+            jax.ShapeDtypeStruct((NLIMBS, bsz + pad), jnp.int32),
+            jax.ShapeDtypeStruct((1, bsz + pad), jnp.int32),
+        ],
+        interpret=interpret,
+    )(x, y, z)
+    if pad:
+        cy, sgn = cy[:, :bsz], sgn[:, :bsz]
+    out = jnp.moveaxis(cy, 0, -1).astype(jnp.uint8)
+    signbit = (sgn[0] << 7).astype(jnp.uint8)
+    return out.at[..., 31].set(out[..., 31] | signbit)

@@ -239,6 +239,57 @@ def _canonicalize(x: jnp.ndarray) -> jnp.ndarray:
     return lo
 
 
+def _seq_carry_k(x: jnp.ndarray):
+    """Kernel-safe _seq_carry: static (1, *batch) slices + concatenate
+    only (no jnp.stack / 1-D intermediates, which Mosaic rejects).
+    Same contract: (canonical limbs in [0, 255], top carry)."""
+    n = x.shape[0]
+    carry = jnp.zeros((1,) + x.shape[1:], jnp.int32)
+    outs = []
+    for i in range(n):
+        t = x[i:i + 1] + carry
+        outs.append(t & _MASK)
+        carry = t >> LIMB_BITS
+    return jnp.concatenate(outs, axis=0), carry
+
+
+def _canonicalize_k(x: jnp.ndarray) -> jnp.ndarray:
+    """Kernel-safe _canonicalize (same algorithm, Mosaic-friendly ops).
+
+    Kept structurally parallel to _canonicalize so the property tests
+    can pin them together; used inside Pallas kernels where the XLA
+    version's stack/scatter constructions are unavailable."""
+    lo, c = _seq_carry_k(x)
+    for _ in range(2):
+        wrap = jnp.concatenate(
+            [lo[0:1] + 38 * c, lo[1:]], axis=0
+        )
+        lo, c = _seq_carry_k(wrap)
+    # Limbs of p built from an iota (Pallas kernels cannot capture
+    # constant arrays): limb0 = 0xED, limb31 = 0x7F, rest = 0xFF.
+    i = jax.lax.broadcasted_iota(
+        jnp.int32, (NLIMBS,) + (1,) * (x.ndim - 1), 0
+    )
+    p_col = jnp.where(i == 0, 0xED, jnp.where(i == NLIMBS - 1, 0x7F, 0xFF))
+    for _ in range(2):
+        d, borrow = _seq_carry_k(lo - p_col)
+        keep = (borrow < 0).astype(jnp.int32)              # (1, *batch)
+        lo = keep * lo + (1 - keep) * d
+    return lo
+
+
+def fe_is_zero_k(x: jnp.ndarray) -> jnp.ndarray:
+    """(1, *batch) int32 mask: 1 where x == 0 mod p (kernel-safe)."""
+    c = _canonicalize_k(x)
+    return (jnp.sum(c, axis=0, keepdims=True) == 0).astype(jnp.int32)
+
+
+def fe_parity_k(x: jnp.ndarray) -> jnp.ndarray:
+    """(1, *batch) int32: parity bit of the canonical representative
+    (kernel-safe fe_is_negative)."""
+    return _canonicalize_k(x)[0:1] & 1
+
+
 def fe_to_bytes(x: jnp.ndarray) -> jnp.ndarray:
     """(32, *batch) limbs -> (*batch, 32) uint8, canonical mod p."""
     return jnp.moveaxis(_canonicalize(x), 0, -1).astype(jnp.uint8)

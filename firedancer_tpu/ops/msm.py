@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import curve25519 as ge
 from . import fe25519 as fe
@@ -79,12 +80,12 @@ def _reduce_pairs(pt, n):
     return pt
 
 
-def _default_rounds(bsz: int) -> int:
+def _default_rounds(bsz: int, n_buckets: int = N_BUCKETS) -> int:
     # Poisson tail bound: with uniform digits each nonzero bucket holds
-    # ~lam = B/(N_BUCKETS-1) points; lam + 7*sqrt(lam) + 8 puts the
+    # ~lam = B/(n_buckets-1) points; lam + 7*sqrt(lam) + 8 puts the
     # per-batch overflow probability below ~1e-7 even across thousands
     # of buckets. Adversarially-biased digits only cost the fallback.
-    lam = bsz / (N_BUCKETS - 1)
+    lam = bsz / (n_buckets - 1)
     return min(int(lam + 7.0 * lam ** 0.5 + 8.0) + 1, bsz)
 
 
@@ -97,9 +98,10 @@ def _staging_indices(scalars_bytes, n_windows: int, bsz: int,
     return _staging_from_digits(d, bsz, max_rounds)
 
 
-def _staging_from_digits(d: jnp.ndarray, bsz: int, max_rounds: int):
+def _staging_from_digits(d: jnp.ndarray, bsz: int, max_rounds: int,
+                         n_buckets: int = N_BUCKETS):
     """As _staging_indices, but from an explicit (nw, B) int32 digit
-    array in [0, N_BUCKETS) — each row an independent weighting of the
+    array in [0, n_buckets) — each row an independent weighting of the
     same points (used by the torsion subgroup check, where rows are
     independent random trials rather than positional windows)."""
     nw = d.shape[0]
@@ -107,28 +109,28 @@ def _staging_from_digits(d: jnp.ndarray, bsz: int, max_rounds: int):
     sorted_d = jnp.take_along_axis(d, order, axis=1)
 
     # starts[t, b] = first sorted position of digit b in window t.
-    buckets = jnp.arange(N_BUCKETS, dtype=jnp.int32)
+    buckets = jnp.arange(n_buckets, dtype=jnp.int32)
     starts = jax.vmap(
         lambda row: jnp.searchsorted(row, buckets, side="left")
-    )(sorted_d)                                           # (nw, 256)
+    )(sorted_d)                                           # (nw, n_buckets)
     ends = jnp.concatenate(
         [starts[:, 1:], jnp.full((nw, 1), bsz, starts.dtype)], axis=1
     )
-    counts = ends - starts                                # (nw, 256)
+    counts = ends - starts                                # (nw, n_buckets)
     ok = jnp.max(jnp.where(buckets[None, :] > 0, counts, 0)) <= max_rounds
 
     # Slot table: idx[t, b, r] = lane index of the r-th point in bucket
     # (t, b), or -1. Bucket 0 contributes nothing (digit 0 == identity).
     r_iota = jnp.arange(max_rounds, dtype=jnp.int32)
-    pos = starts[:, :, None] + r_iota[None, None, :]      # (nw, 256, R)
+    pos = starts[:, :, None] + r_iota[None, None, :]      # (nw, nb, R)
     valid = (r_iota[None, None, :] < counts[:, :, None]) & (
         buckets[None, :, None] > 0
     )
     pos_flat = jnp.clip(pos.reshape(nw, -1), 0, bsz - 1)
     idx = jnp.take_along_axis(order, pos_flat, axis=1).reshape(
-        nw, N_BUCKETS, max_rounds
+        nw, n_buckets, max_rounds
     )
-    idx = jnp.where(valid, idx, -1)                       # (nw, 256, R)
+    idx = jnp.where(valid, idx, -1)                       # (nw, nb, R)
     return idx, ok
 
 
@@ -275,6 +277,39 @@ def subgroup_check(points, u_digits: jnp.ndarray,
     return jnp.all(ok), ok_fill
 
 
+# Staged niels rounds are cast to int16 for the HBM round buffers: every
+# staged limb obeys the |limb| <= 1024 lazy-carry invariant (fe_add /
+# fe_sub / fe_mul outputs), far inside int16 range, and the fill kernel
+# widens back to int32 on load — halving the fill's HBM traffic, which
+# is the dominant byte stream of the whole MSM.
+_STAGE_DTYPE = jnp.int16
+
+
+def _stage_niels(points, idx, max_rounds: int, lanes: int, bsz: int):
+    """Gather per-round niels operands: (R, 32, L) x3, identity-staged
+    ((1, 1, 0) niels form) where a slot is empty. points must have
+    Z == 1 (decompress output / affine constants)."""
+    x, y, z, t = points
+    yp = fe.fe_add(y, x)
+    ym = fe.fe_sub(y, x)
+    t2d = fe.fe_mul(t, fe.FE_D2)
+
+    sel = jnp.transpose(idx, (2, 0, 1)).reshape(max_rounds * lanes)
+    m = (sel >= 0)[None, :]
+    safe = jnp.clip(sel, 0, bsz - 1)
+    one0 = (jnp.arange(fe.NLIMBS, dtype=jnp.int32) == 0)[:, None]
+
+    def stage(src, ident_col):
+        g = jnp.where(m, src[:, safe], ident_col)          # (32, R*L)
+        return jnp.transpose(
+            g.reshape(fe.NLIMBS, max_rounds, lanes), (1, 0, 2)
+        ).astype(_STAGE_DTYPE)                             # (R, 32, L)
+
+    return (stage(yp, one0.astype(jnp.int32)),
+            stage(ym, one0.astype(jnp.int32)),
+            stage(t2d, 0))
+
+
 def msm_fast(scalars_bytes: jnp.ndarray, points, n_windows: int,
              max_rounds: int | None = None, interpret: bool = False):
     """Kernel-backed msm (same contract as msm()).
@@ -293,26 +328,8 @@ def msm_fast(scalars_bytes: jnp.ndarray, points, n_windows: int,
     nw = n_windows
     idx, ok = _staging_indices(scalars_bytes, nw, bsz, max_rounds)
 
-    x, y, z, t = points
-    yp = fe.fe_add(y, x)
-    ym = fe.fe_sub(y, x)
-    t2d = fe.fe_mul(t, fe.FE_D2)
-
     lanes = nw * N_BUCKETS
-    sel = jnp.transpose(idx, (2, 0, 1)).reshape(max_rounds * lanes)
-    m = (sel >= 0)[None, :]
-    safe = jnp.clip(sel, 0, bsz - 1)
-    one0 = (jnp.arange(fe.NLIMBS, dtype=jnp.int32) == 0)[:, None]
-
-    def stage(src, ident_col):
-        g = jnp.where(m, src[:, safe], ident_col)          # (32, R*L)
-        return jnp.transpose(
-            g.reshape(fe.NLIMBS, max_rounds, lanes), (1, 0, 2)
-        )                                                  # (R, 32, L)
-
-    s_yp = stage(yp, one0.astype(jnp.int32))
-    s_ym = stage(ym, one0.astype(jnp.int32))
-    s_t2d = stage(t2d, 0)
+    s_yp, s_ym, s_t2d = _stage_niels(points, idx, max_rounds, lanes, bsz)
 
     bx, by, bz, bt = mp.fill_buckets_pallas(
         s_yp, s_ym, s_t2d, interpret=interpret
@@ -335,3 +352,71 @@ def msm_fast(scalars_bytes: jnp.ndarray, points, n_windows: int,
     )
     w_res = tuple(c[:, :nw] for c in w_res)
     return _window_horner(w_res, nw), ok
+
+
+def _l_bits_col() -> jnp.ndarray:
+    """(256, 1) int32: bits of the group order L, MSB-first from row 0,
+    zero-padded (kernel input for mul_by_group_order_pallas)."""
+    from . import sc25519 as sc
+
+    bits = [int(b) for b in bin(sc.L)[2:]]
+    out = np.zeros((256, 1), np.int32)
+    out[: len(bits), 0] = bits
+    return jnp.asarray(out)
+
+
+def subgroup_check_fast(points, u_digits: jnp.ndarray,
+                        bucket_bits: int = 5,
+                        max_rounds: int | None = None,
+                        interpret: bool = False):
+    """Kernel-backed subgroup_check (same contract and soundness).
+
+    REQUIRES points with Z == 1 (decompress output), like msm_fast.
+
+    Two changes versus the XLA path, neither affecting soundness:
+    - Trial digits are masked to `bucket_bits` (< 7) bits. Uniform
+      digits stay uniform under the mask, and the per-trial catch
+      probability is governed by the digit distribution mod 8, which
+      5-bit digits preserve — but the bucket grid shrinks from
+      (K, 128) to (K, 32), cutting the staged round buffers' HBM
+      footprint ~4x (the fill is HBM-bound; tail efficiency
+      lam/(lam + 7*sqrt(lam)) improves with larger lam per bucket).
+    - The fill, aggregation, and the [L]-ladder all run in VMEM Pallas
+      kernels (the XLA ladder alone cost more than the entire direct
+      verify at production batch sizes).
+    """
+    from . import msm_pallas as mp
+
+    bsz = points[0].shape[1]
+    n_buckets = 1 << bucket_bits
+    if max_rounds is None:
+        max_rounds = _default_rounds(bsz, n_buckets)
+    d = u_digits.astype(jnp.int32) & (n_buckets - 1)
+    k = d.shape[0]
+    idx, ok_fill = _staging_from_digits(d, bsz, max_rounds, n_buckets)
+
+    lanes = k * n_buckets
+    s_yp, s_ym, s_t2d = _stage_niels(points, idx, max_rounds, lanes, bsz)
+    bx, by, bz, bt = mp.fill_buckets_pallas(
+        s_yp, s_ym, s_t2d, interpret=interpret
+    )
+
+    k_pad = k + (-k) % 128                 # Mosaic lane-width alignment
+
+    def to_bucket_major(c):
+        c = jnp.transpose(c.reshape(fe.NLIMBS, k, n_buckets), (2, 0, 1))
+        if k_pad != k:
+            c = jnp.pad(c, ((0, 0), (0, 0), (0, k_pad - k)))
+        return c
+
+    agg = mp.aggregate_buckets_pallas(
+        tuple(to_bucket_major(c) for c in (bx, by, bz, bt)),
+        fe.FE_D2.astype(jnp.int32),
+        interpret=interpret,
+    )
+    la = mp.mul_by_group_order_pallas(
+        agg, fe.FE_D2.astype(jnp.int32), _l_bits_col(), interpret=interpret
+    )
+    la = tuple(c[:, :k] for c in la)
+    ok = fe.fe_is_zero(la[0]) & fe.fe_eq(la[1], la[2])     # (K,) identity
+    return jnp.all(ok), ok_fill

@@ -104,7 +104,9 @@ def fill_buckets_pallas(yp, ym, t2d, lane_tile: int = 2048,
             ts[...] = t0
 
         p = (xs[...], ys[...], zs[...], ts[...])
-        x, y, z, t = _madd_niels(p, (ypr[0], ymr[0], t2dr[0]))
+        q = (ypr[0].astype(jnp.int32), ymr[0].astype(jnp.int32),
+             t2dr[0].astype(jnp.int32))   # staged rounds ride HBM as int16
+        x, y, z, t = _madd_niels(p, q)
         xs[...] = x
         ys[...] = y
         zs[...] = z
@@ -134,6 +136,89 @@ def fill_buckets_pallas(yp, ym, t2d, lane_tile: int = 2048,
         ),
         interpret=interpret,
     )(yp, ym, t2d)
+
+
+def mul_by_group_order_pallas(pt, d2_col, bits_col, interpret: bool = False):
+    """[L]P over a (32, K)-lane point batch, fully in VMEM.
+
+    The XLA version (msm._mul_by_group_order) is a 252-step lax.scan
+    whose per-step while-loop overhead dwarfs its (32, K) arithmetic;
+    here the double/conditional-add ladder runs inside one kernel with
+    the point state resident in VMEM. L is public (vartime is fine) but
+    the ladder is still branch-free: the conditional add is an
+    arithmetic select so every lane runs the identical op stream.
+
+    pt: (X, Y, Z, T) of (32, K) limbs. d2_col: (32, 1) limbs of 2d.
+    bits_col: (256, 1) int32 — bits of L, MSB-first starting at index 0
+    (bits_col[0] is the leading 1 bit), zero-padded after index
+    n_bits-1 (the padding is never read; the loop bound is static).
+    Returns (X, Y, Z, T) of (32, K) limbs of [L]P.
+    """
+    from jax.experimental import pallas as pl
+    from firedancer_tpu.ops import sc25519 as sc
+
+    n_bits = sc.L.bit_length()
+    k = pt[0].shape[1]
+    kpad = (-k) % 128
+    if kpad:
+        pt = tuple(jnp.pad(c, ((0, 0), (0, kpad))) for c in pt)
+    lanes = k + kpad
+
+    def kern(px, py, pz, pt_, d2r, bits, ox, oy, oz, ot):
+        d2 = d2r[...]
+        base = (px[...], py[...], pz[...], pt_[...])
+
+        def body(i, r):
+            r = _point_double_ext(r)
+            added = _point_add_ext(r, base, d2)
+            bit = bits[pl.ds(i, 1), :]              # (1, 1) int32
+            # Single-axis broadcast only (Mosaic cannot broadcast in
+            # sublanes and lanes at once); (1, lanes) then implicit
+            # sublane broadcast inside the arithmetic select.
+            sel = jnp.broadcast_to(bit, (1, lanes))
+            return tuple(sel * a + (1 - sel) * c
+                         for a, c in zip(added, r))
+
+        # bits[0] is the leading 1: init = P, then n_bits-1 = 252
+        # double/(conditional-)add steps.
+        r = jax.lax.fori_loop(1, n_bits, body, base)
+        ox[...] = r[0]
+        oy[...] = r[1]
+        oz[...] = r[2]
+        ot[...] = r[3]
+
+    spec_fe = pl.BlockSpec((NLIMBS, lanes), lambda: (0, 0))
+    spec_d2 = pl.BlockSpec((NLIMBS, 1), lambda: (0, 0))
+    spec_bits = pl.BlockSpec((256, 1), lambda: (0, 0))
+    out_shape = jax.ShapeDtypeStruct((NLIMBS, lanes), jnp.int32)
+    x, y, z, t = pl.pallas_call(
+        kern,
+        in_specs=[spec_fe] * 4 + [spec_d2, spec_bits],
+        out_specs=[spec_fe] * 4,
+        out_shape=[out_shape] * 4,
+        interpret=interpret,
+    )(*pt, d2_col, bits_col)
+    if kpad:
+        x, y, z, t = (c[:, :k] for c in (x, y, z, t))
+    return (x, y, z, t)
+
+
+def _point_double_ext(p):
+    """dbl-2008-hwcd a=-1 with fe_mul_unrolled (kernel-safe)."""
+    from .pow_pallas import _sq
+
+    x1, y1, z1, _ = p
+    a = _sq(x1)
+    b = _sq(y1)
+    zz = _sq(z1)
+    c = fe.fe_add(zz, zz)
+    d_ = fe.fe_neg(a)
+    e = fe.fe_sub(fe.fe_sub(_sq(fe.fe_add(x1, y1)), a), b)
+    g = fe.fe_add(d_, b)
+    f = fe.fe_sub(g, c)
+    h = fe.fe_sub(d_, b)
+    return (fe.fe_mul_unrolled(e, f), fe.fe_mul_unrolled(g, h),
+            fe.fe_mul_unrolled(f, g), fe.fe_mul_unrolled(e, h))
 
 
 def aggregate_buckets_pallas(buckets, d2_col, interpret: bool = False):

@@ -67,7 +67,7 @@ def verify_batch(
 
     s_ok = sc.sc_check_range(s_bytes)
 
-    a_point, pub_ok = ge.decompress(pubkeys)
+    a_point, pub_ok = ge.decompress_auto(pubkeys)
     neg_a = ge.point_neg(a_point)
 
     # h = SHA-512(r || pub || msg) mod L. One batched hash over the
@@ -77,7 +77,7 @@ def verify_batch(
     h_bytes = sc.sc_reduce64(h64)
 
     r_prime = _dsm_auto()(h_bytes, neg_a, s_bytes)
-    r_enc = ge.compress(r_prime)
+    r_enc = ge.compress_auto(r_prime)
     r_match = jnp.all(r_enc == r_bytes, axis=-1)
 
     status = jnp.where(

@@ -162,7 +162,9 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
 
     # One decompression pass over A and R stacked: same lane-work, half
     # the traced graph (the power chain appears once).
-    both, both_ok = ge.decompress(jnp.concatenate([pubkeys, r_bytes], axis=0))
+    both, both_ok = ge.decompress_auto(
+        jnp.concatenate([pubkeys, r_bytes], axis=0)
+    )
     bsz = pubkeys.shape[0]
     a_point = tuple(c[:, :bsz] for c in both)
     r_point = tuple(c[:, bsz:] for c in both)
@@ -220,7 +222,8 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     from .backend import use_pallas
 
     # Decompressed points have Z == 1, so the niels fast path applies.
-    msm_impl = msm_mod.msm_fast if use_pallas("FD_MSM_IMPL") else msm_mod.msm
+    on_tpu = use_pallas("FD_MSM_IMPL")
+    msm_impl = msm_mod.msm_fast if on_tpu else msm_mod.msm
     t1, ok1 = msm_impl(z_live, neg_r, n_windows=msm_mod.WINDOWS_Z)
     t2, ok2 = msm_impl(m_all, pts_all, n_windows=msm_mod.WINDOWS_253)
     # T = u*B + sum z(-R) + sum m(-A); identity <=> X == 0 and Y == Z.
@@ -230,7 +233,9 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     # lanes get zero trial weights — unweighted, identity contribution.
     live2 = jnp.concatenate([live, live], axis=0)
     u_live = jnp.where(live2[None, :], u_digits, 0)
-    sub_ok, sub_fill_ok = msm_mod.subgroup_check(both, u_live)
+    sub_impl = (msm_mod.subgroup_check_fast if on_tpu
+                else msm_mod.subgroup_check)
+    sub_ok, sub_fill_ok = sub_impl(both, u_live)
     batch_ok = (
         fe.fe_is_zero(t[0]) & fe.fe_eq(t[1], t[2]) & ok1 & ok2
         & sub_ok & sub_fill_ok

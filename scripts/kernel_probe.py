@@ -1,0 +1,116 @@
+"""In-kernel VPU cost probes: int32 mul vs add vs carry vs fe_mul.
+
+Times Pallas kernels that run N dependent ops on a VMEM-resident
+(32, LANES) int32 tile, serialized across reps (output feeds input) so
+queue overlap cannot flatter the numbers. Decides where the field-op
+mul budget actually goes on this chip:
+    python scripts/kernel_probe.py [lanes] [reps]
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from firedancer_tpu.ops import fe25519 as fe
+
+LANES = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+REPS = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+N_OPS = 256
+
+
+def _mk(kern_body, n_in=2):
+    from jax.experimental import pallas as pl
+
+    def kern(*refs):
+        ins = [r[...] for r in refs[:-1]]
+        refs[-1][...] = kern_body(*ins)
+
+    spec = pl.BlockSpec((32, LANES), lambda: (0, 0))
+    return pl.pallas_call(
+        kern,
+        in_specs=[spec] * n_in,
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((32, LANES), jnp.int32),
+    )
+
+
+def body_mul(x, y):
+    for _ in range(N_OPS):
+        x = x * y + y
+    return x
+
+
+def body_add(x, y):
+    for _ in range(N_OPS):
+        x = (x + y) ^ y
+    return x
+
+
+def body_carry(x, y):
+    for _ in range(N_OPS // 8):
+        x = fe._carry_pass(x + y, 1)
+    return x
+
+
+def body_femul(x, y):
+    for _ in range(16):
+        x = fe.fe_mul_unrolled(x, y)
+    return x
+
+
+def body_fesq(x, y):
+    x = x + y
+    for _ in range(16):
+        x = fe.fe_sq(x)
+    return x
+
+
+def body_conv_nocarry(x, y):
+    # fe_mul's convolution without the 4 carry passes (bounds ignored —
+    # this is a cost probe, values wrap int32 harmlessly).
+    for _ in range(16):
+        bext = jnp.concatenate([38 * y, y], axis=0)
+        acc = x[0:1] * bext[32:64]
+        for i in range(1, 32):
+            acc = acc + x[i:i + 1] * bext[32 - i:64 - i]
+        x = acc
+    return x
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device={dev} lanes={LANES}")
+    rng = np.random.RandomState(0)
+    x0 = jnp.asarray(rng.randint(0, 256, (32, LANES), dtype=np.int32))
+    y = jnp.asarray(rng.randint(1, 256, (32, LANES), dtype=np.int32))
+
+    for name, body, per_call in [
+        ("mul+add x256", body_mul, N_OPS),
+        ("add+xor x256", body_add, N_OPS),
+        ("carry_pass x32", body_carry, N_OPS // 8),
+        ("fe_mul x16", body_femul, 16),
+        ("fe_sq x16", body_fesq, 16),
+        ("conv-only x16", body_conv_nocarry, 16),
+    ]:
+        fn = jax.jit(_mk(body))
+        x = fn(x0, y)
+        x.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            x = fn(x, y)
+        x.block_until_ready()
+        dt = (time.perf_counter() - t0) / REPS
+        unit = dt / per_call * 1e6
+        print(f"{name:18s} {dt*1e3:8.3f} ms/call  {unit:8.2f} us/op "
+              f"({32 * LANES * per_call / dt / 1e9:.1f} Gop-lanes/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""Fused Pallas decompress/compress kernels vs the XLA path.
+
+Interpret mode on CPU: bit-exact parity with curve25519.decompress /
+compress (which are themselves pinned to the ballet oracle by
+tests/test_curve_and_verify.py), across the tricky encodings the donna
+semantics must honor (non-canonical y, x == 0 with either sign,
+undecompressable y, small-order points).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from firedancer_tpu.ballet import ed25519 as oracle
+from firedancer_tpu.ops import curve25519 as ge
+from firedancer_tpu.ops import fe25519 as fe
+from firedancer_tpu.ops.curve_pallas import compress_pallas, decompress_pallas
+
+# >= 128 so the kernel path engages. With the 128-lane test tile the
+# batch pads 160 -> 256 over two grid steps, covering the jnp.pad
+# staging and the trailing [:, :bsz] slices.
+B = 160
+TILE = 128
+
+
+def _encodings():
+    rng = np.random.RandomState(3)
+    enc = np.zeros((B, 32), np.uint8)
+    for i in range(B - 8):
+        p = oracle.scalarmult(1 + rng.randint(1, 1 << 30), oracle.B)
+        if i % 3 == 0:  # exercise the sign bit
+            p = (oracle.P - p[0], p[1])
+        enc[i] = np.frombuffer(oracle.point_compress(p), np.uint8)
+    # edge rows: identity, x=0 sign=1, non-canonical y = p - 1 + p?,
+    # y >= p (non-canonical but decompressable), junk (undecompressable),
+    # small-order torsion, all-FF, p itself (== 0 mod p, x^2 = -1 case)
+    enc[B - 8] = np.frombuffer(b"\x01" + bytes(31), np.uint8)  # identity
+    e = bytearray(32)
+    e[0] = 1
+    e[31] = 0x80                                  # y=1 with sign bit (x=0)
+    enc[B - 7] = np.frombuffer(bytes(e), np.uint8)
+    pbytes = np.frombuffer(
+        int(oracle.P).to_bytes(32, "little"), np.uint8
+    ).copy()
+    enc[B - 6] = pbytes                           # y == p: non-canonical 0
+    enc[B - 5] = np.frombuffer(bytes([2]) + bytes(31), np.uint8)
+    enc[B - 4] = np.frombuffer(
+        bytes.fromhex("26e8958fc2b227b045c3f489f2ef98f0"
+                      "d5dfac05d3c63339b13802886d53fc05"), np.uint8
+    )                                             # order-8 torsion
+    enc[B - 3] = 0xFF                             # all-FF
+    enc[B - 2] = np.frombuffer(bytes(32), np.uint8)       # y=0: x^2=-1
+    enc[B - 1] = pbytes.copy()
+    enc[B - 1][31] |= 0x80                        # y == p, sign set
+    return jnp.asarray(enc)
+
+
+def test_decompress_pallas_matches_xla():
+    enc = _encodings()
+    pt_ref, ok_ref = ge.decompress(enc)
+    pt_k, ok_k = decompress_pallas(enc, interpret=True, lanes=TILE)
+    assert np.array_equal(np.asarray(ok_ref), np.asarray(ok_k))
+    for c_ref, c_k in zip(pt_ref, pt_k):
+        # Limb representations may differ; compare canonical forms.
+        a = np.asarray(fe.fe_canonical_limbs(c_ref))
+        b = np.asarray(fe.fe_canonical_limbs(c_k))
+        assert np.array_equal(a, b)
+
+
+def test_compress_pallas_matches_xla():
+    enc = _encodings()
+    pt, ok = ge.decompress(enc)
+    # Run every lane (failed ones carry the identity — still encodable),
+    # plus non-trivial Z: double each point so Z != 1.
+    dbl = ge.point_double(pt, need_t=True)
+    for p in (pt, dbl):
+        ref = np.asarray(ge.compress(p))
+        got = np.asarray(compress_pallas(p, interpret=True, lanes=TILE))
+        assert np.array_equal(ref, got)
+
+
+def test_canonicalize_k_pins_xla_canonicalize():
+    """The kernel-safe canonicalize must stay bit-identical to the XLA
+    one over the full lazy-carry input range (docstring contract)."""
+    rng = np.random.RandomState(9)
+    x = rng.randint(-1024, 1025, (32, 257)).astype(np.int32)
+    # Edge lanes: 0, p, 2p-ish, -p, all-max, all-min.
+    x[:, 0] = 0
+    x[:, 1] = np.asarray([0xED] + [0xFF] * 30 + [0x7F], np.int32)   # p
+    x[:, 2] = x[:, 1] * 2
+    x[:, 3] = -x[:, 1]
+    x[:, 4] = 1024
+    x[:, 5] = -1024
+    xj = jnp.asarray(x)
+    ref = np.asarray(fe.fe_canonical_limbs(xj))
+    got = np.asarray(fe._canonicalize_k(xj))
+    assert np.array_equal(ref, got)
+
+
+def test_decompress_pallas_small_batch_falls_back():
+    enc = _encodings()[:5]
+    pt_ref, ok_ref = ge.decompress(enc)
+    pt_k, ok_k = decompress_pallas(enc)  # < 128 lanes: XLA fallback
+    assert np.array_equal(np.asarray(ok_ref), np.asarray(ok_k))
+    for c_ref, c_k in zip(pt_ref, pt_k):
+        assert np.array_equal(np.asarray(c_ref), np.asarray(c_k))
