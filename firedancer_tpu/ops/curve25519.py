@@ -45,16 +45,31 @@ def _pow_auto():
     return fe.fe_invert, fe.fe_pow22523
 
 
-def decompress_auto(y_bytes: jnp.ndarray):
+def decompress_xla(y_bytes: jnp.ndarray, want_x_zero: bool = False):
+    """XLA decompress with the optional x==0-mod-p mask — the shared
+    fallback for decompress_auto and decompress_pallas's sub-tile path
+    (one place for the caveat that the mask is only meaningful for
+    ok lanes: here failed lanes report the identity's x == 0, the
+    kernel reports the pre-poison x)."""
+    pt, ok = decompress(y_bytes)
+    if want_x_zero:
+        return pt, ok, fe.fe_is_zero(pt[0])
+    return pt, ok
+
+
+def decompress_auto(y_bytes: jnp.ndarray, want_x_zero: bool = False):
     """Backend-dispatched decompress: fused Pallas kernel on TPU
-    (ops/curve_pallas.py), the XLA graph elsewhere."""
+    (ops/curve_pallas.py), the XLA graph elsewhere. want_x_zero=True
+    appends an x==0-mod-p lane mask (in-VMEM on the kernel path; a
+    canonicalize chain on the XLA path), meaningful only for ok lanes
+    (see decompress_xla)."""
     from .backend import use_pallas
 
     if use_pallas("FD_DECOMPRESS_IMPL"):
         from .curve_pallas import decompress_pallas
 
-        return decompress_pallas(y_bytes)
-    return decompress(y_bytes)
+        return decompress_pallas(y_bytes, want_x_zero=want_x_zero)
+    return decompress_xla(y_bytes, want_x_zero)
 
 
 def compress_auto(p) -> jnp.ndarray:

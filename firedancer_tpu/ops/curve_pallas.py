@@ -34,17 +34,9 @@ LANES = 512
 
 # One kernel-safe power-chain implementation for all Pallas modules
 # (backend.use_specialized_square's dispatch lives behind these).
-from .pow_pallas import _ladder, _mul, _sq, _sqn
-
-
-def _pow22523(z):
-    z_250_0, _ = _ladder(z)
-    return _mul(_sqn(z_250_0, 2), z)
-
-
-def _invert(z):
-    z_250_0, z11 = _ladder(z)
-    return _mul(_sqn(z_250_0, 5), z11)
+from .pow_pallas import _mul, _sq
+from .pow_pallas import invert_chain as _invert
+from .pow_pallas import pow22523_chain as _pow22523
 
 
 def _sel(m, a, b):
@@ -63,7 +55,7 @@ def _const_cols() -> np.ndarray:
     return out
 
 
-def _decompress_kernel(yin, sign, consts, ox, oy, oz, ot, ook):
+def _decompress_kernel(yin, sign, consts, ox, oy, oz, ot, ook, oxz):
     y = yin[...]
     lanes = y.shape[1]
     d_c = jnp.broadcast_to(consts[:, 0:1], (NLIMBS, lanes))
@@ -95,13 +87,21 @@ def _decompress_kernel(yin, sign, consts, ox, oy, oz, ot, ook):
     oz[...] = one
     ot[...] = _sel(ok, t, zero)
     ook[...] = ok
+    # x == 0 mod p of the DECOMPRESSED point (before identity poison;
+    # negation preserves zero). Costs one in-VMEM canonicalize here vs
+    # a ~7.6 ms XLA chain for the caller (verify_rlc's r-canonicality).
+    oxz[...] = fe.fe_is_zero_k(x)
 
 
 def decompress_pallas(y_bytes: jnp.ndarray, interpret: bool = False,
-                      lanes: int | None = None):
+                      lanes: int | None = None,
+                      want_x_zero: bool = False):
     """Drop-in for curve25519.decompress on TPU: (B, 32) uint8 ->
     ((X, Y, Z, T) of (32, B) limbs, (B,) bool ok). lanes overrides the
-    kernel tile width (tests use a small tile to exercise padding)."""
+    kernel tile width (tests use a small tile to exercise padding).
+    want_x_zero=True appends an (B,) bool x==0-mod-p mask (of the
+    decompressed x, before identity poison — only meaningful for
+    ok lanes) to the return tuple."""
     from jax.experimental import pallas as pl
 
     bsz = y_bytes.shape[0]
@@ -109,7 +109,7 @@ def decompress_pallas(y_bytes: jnp.ndarray, interpret: bool = False,
         # Sub-tile batches: the XLA path beats a padded kernel launch.
         from . import curve25519 as ge
 
-        return ge.decompress(y_bytes)
+        return ge.decompress_xla(y_bytes, want_x_zero)
     sign = (y_bytes[:, 31] >> 7).astype(jnp.int32)[None, :]    # (1, B)
     y = fe.fe_from_bytes(y_bytes, mask_high_bit=True)          # (32, B)
     lanes = lanes or min(LANES, bsz)
@@ -124,17 +124,20 @@ def decompress_pallas(y_bytes: jnp.ndarray, interpret: bool = False,
     spec_c = pl.BlockSpec((NLIMBS, 2), lambda i: (0, 0))
     out_fe = jax.ShapeDtypeStruct((NLIMBS, bsz + pad), jnp.int32)
     out_row = jax.ShapeDtypeStruct((1, bsz + pad), jnp.int32)
-    x, yy, z, t, ok = pl.pallas_call(
+    x, yy, z, t, ok, xz = pl.pallas_call(
         _decompress_kernel,
         grid=(n,),
         in_specs=[spec_fe, spec_row, spec_c],
-        out_specs=[spec_fe] * 4 + [spec_row],
-        out_shape=[out_fe] * 4 + [out_row],
+        out_specs=[spec_fe] * 4 + [spec_row] * 2,
+        out_shape=[out_fe] * 4 + [out_row] * 2,
         interpret=interpret,
     )(y, sign, jnp.asarray(_const_cols()))
     if pad:
         x, yy, z, t = (c[:, :bsz] for c in (x, yy, z, t))
         ok = ok[:, :bsz]
+        xz = xz[:, :bsz]
+    if want_x_zero:
+        return (x, yy, z, t), ok[0] != 0, xz[0] != 0
     return (x, yy, z, t), ok[0] != 0
 
 

@@ -351,7 +351,10 @@ def msm_fast(scalars_bytes: jnp.ndarray, points, n_windows: int,
         interpret=interpret,
     )
     w_res = tuple(c[:, :nw] for c in w_res)
-    return _window_horner(w_res, nw), ok
+    res = mp.window_horner_pallas(
+        w_res, fe.FE_D2.astype(jnp.int32), nw, interpret=interpret
+    )
+    return res, ok
 
 
 def _l_bits_col() -> jnp.ndarray:

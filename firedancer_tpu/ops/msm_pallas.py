@@ -221,6 +221,66 @@ def _point_double_ext(p):
             fe.fe_mul_unrolled(f, g), fe.fe_mul_unrolled(e, h))
 
 
+W_BITS = 7  # msm.W_BITS (kept local to avoid a circular import)
+
+
+def window_horner_pallas(w_res, d2_col, n_windows: int,
+                         interpret: bool = False):
+    """Cross-window Horner combine, fully in VMEM: the 2^(7t)-weighted
+    sum of the per-window points, MSB-first (msm._window_horner is the
+    XLA reference — an (n_windows-1)-step lax.scan whose per-step
+    overhead on TPU dwarfs its (32, 1)-lane arithmetic).
+
+    w_res: (X, Y, Z, T) of (32, nw) limbs, window t in column t.
+    Returns (32, 1)-column points. Window columns are pre-broadcast in
+    XLA to (nw*32, 128) row blocks so the in-kernel loop reads window t
+    with one dynamic sublane-block slice (the dsm window-read pattern;
+    dynamic LANE slicing is what Mosaic cannot do).
+    """
+    from jax.experimental import pallas as pl
+
+    nw = n_windows
+
+    def prep(c):
+        # (32, nw) -> (nw*32, 128): window-major rows, lane-broadcast.
+        return jnp.broadcast_to(
+            jnp.transpose(c[:, :nw], (1, 0)).reshape(nw * NLIMBS, 1),
+            (nw * NLIMBS, 128),
+        )
+
+    def kern(wx, wy, wz, wt, d2r, ox, oy, oz, ot):
+        d2 = d2r[...]
+
+        def col(j):
+            return tuple(
+                w[pl.ds(j * NLIMBS, NLIMBS), :] for w in (wx, wy, wz, wt)
+            )
+
+        def body(i, r):
+            for _ in range(W_BITS):
+                r = _point_double_ext(r)
+            return _point_add_ext(r, col(nw - 2 - i), d2)
+
+        r = jax.lax.fori_loop(0, nw - 1, body, col(nw - 1))
+        ox[...] = r[0]
+        oy[...] = r[1]
+        oz[...] = r[2]
+        ot[...] = r[3]
+
+    spec_w = pl.BlockSpec((nw * NLIMBS, 128), lambda: (0, 0))
+    spec_d2 = pl.BlockSpec((NLIMBS, 1), lambda: (0, 0))
+    spec_out = pl.BlockSpec((NLIMBS, 128), lambda: (0, 0))
+    out_shape = jax.ShapeDtypeStruct((NLIMBS, 128), jnp.int32)
+    x, y, z, t = pl.pallas_call(
+        kern,
+        in_specs=[spec_w] * 4 + [spec_d2],
+        out_specs=[spec_out] * 4,
+        out_shape=[out_shape] * 4,
+        interpret=interpret,
+    )(*(prep(c) for c in w_res), d2_col)
+    return (x[:, :1], y[:, :1], z[:, :1], t[:, :1])
+
+
 def aggregate_buckets_pallas(buckets, d2_col, interpret: bool = False):
     """sum_b b * S_b per window, running-sums walk (b = 255 .. 1).
 

@@ -66,13 +66,24 @@ def _ladder(z):
     return z_250_0, z11
 
 
+def invert_chain(z):
+    """z^(p-2) = z^(2^255 - 21), kernel-safe (shared chain tail)."""
+    z_250_0, z11 = _ladder(z)
+    return _mul(_sqn(z_250_0, 5), z11)
+
+
+def pow22523_chain(z):
+    """z^((p-5)/8) = z^(2^252 - 3), kernel-safe (shared chain tail)."""
+    z_250_0, _ = _ladder(z)
+    return _mul(_sqn(z_250_0, 2), z)
+
+
 def _pow_kernel(zin, out, *, kind: str):
     z = zin[...]
-    z_250_0, z11 = _ladder(z)
     if kind == "invert":
-        out[...] = _mul(_sqn(z_250_0, 5), z11)      # z^(2^255 - 21)
+        out[...] = invert_chain(z)
     elif kind == "pow22523":
-        out[...] = _mul(_sqn(z_250_0, 2), z)        # z^(2^252 - 3)
+        out[...] = pow22523_chain(z)
     else:  # pragma: no cover
         raise ValueError(kind)
 

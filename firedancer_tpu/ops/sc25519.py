@@ -69,6 +69,18 @@ def sc_reduce64(hash_bytes: jnp.ndarray) -> jnp.ndarray:
     return jnp.moveaxis(r[:32], 0, -1).astype(jnp.uint8)
 
 
+def sc_reduce64_auto(hash_bytes: jnp.ndarray) -> jnp.ndarray:
+    """Backend-dispatched sc_reduce64: the VMEM Barrett kernel on TPU
+    (ops/sc_pallas.py), this module's XLA graph elsewhere."""
+    from .backend import use_pallas
+
+    if use_pallas("FD_SC_IMPL"):
+        from .sc_pallas import sc_reduce64_pallas
+
+        return sc_reduce64_pallas(hash_bytes)
+    return sc_reduce64(hash_bytes)
+
+
 def sc_sum(s_bytes: jnp.ndarray) -> jnp.ndarray:
     """Sum of a batch of scalars mod L: (B, 32) uint8 -> (1, 32) uint8.
 

@@ -1,0 +1,107 @@
+"""Pallas TPU kernel for the mod-L Barrett reduction (sc25519).
+
+sc_reduce64's XLA graph is five sequential base-256 carry chains plus
+two small convolutions — ~8.7 ms at B=8192 on v5e, almost all of it
+multi-kernel elementwise launch cost. In VMEM the same reduction is a
+few hundred fused vector ops.
+
+Identical algorithm to sc25519.sc_reduce64 (the CPU/test reference):
+Barrett with b = 2^8, k = 32; mu and L enter as Python int literals
+folded into the instruction stream (the round structure is static), so
+the kernel needs no constant-array inputs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import fe25519 as fe
+from . import sc25519 as sc
+
+LANES = 2048
+
+
+def _seq_carry_k(x):
+    return fe._seq_carry_k(x)
+
+
+def _conv_const(x, weights, n_out: int):
+    """conv(x, weights) truncated to n_out rows; weights are Python
+    ints (static), x is (n_in, L). Static-slice shifts + scalar muls."""
+    n_in = x.shape[0]
+    lanes = x.shape[1]
+    acc = jnp.zeros((n_out, lanes), jnp.int32)
+    for j, w in enumerate(weights):
+        if w == 0:
+            continue
+        rows = min(n_in, n_out - j)
+        if rows <= 0:
+            break
+        term = x[:rows] * np.int32(w)
+        parts = []
+        if j:
+            parts.append(jnp.zeros((j, lanes), jnp.int32))
+        parts.append(term)
+        tail = n_out - j - rows
+        if tail:
+            parts.append(jnp.zeros((tail, lanes), jnp.int32))
+        acc = acc + (parts[0] if len(parts) == 1
+                     else jnp.concatenate(parts, axis=0))
+    return acc
+
+
+def _sc_reduce_kernel(xin, out):
+    """xin: (64, L) int32 canonical byte limbs of x < 2^512.
+    out: (32, L) int32 canonical limbs of x mod L."""
+    x = xin[...]
+    mu = [(sc._MU >> (8 * i)) & 0xFF for i in range(33)]
+    l_limbs = [(sc.L >> (8 * i)) & 0xFF for i in range(33)]
+
+    q1 = x[31:]                                   # (33, L)
+    q2 = _conv_const(q1, mu, 66)
+    q2, _ = _seq_carry_k(q2)
+    q3 = q2[33:]                                  # (33, L)
+    q3l = _conv_const(q3, l_limbs, 33)
+    q3l, _ = _seq_carry_k(q3l)
+    r, _ = _seq_carry_k(x[:33] - q3l)
+    i = jax.lax.broadcasted_iota(jnp.int32, (33, 1), 0)
+    l_col = jnp.zeros((33, 1), jnp.int32)
+    for j, w in enumerate(l_limbs):
+        l_col = l_col + jnp.where(i == j, w, 0)
+    for _ in range(2):
+        d, borrow = _seq_carry_k(r - l_col)
+        keep = (borrow < 0).astype(jnp.int32)
+        r = keep * r + (1 - keep) * d
+    out[...] = r[:32]
+
+
+def sc_reduce64_pallas(hash_bytes: jnp.ndarray,
+                       interpret: bool = False) -> jnp.ndarray:
+    """Drop-in for sc25519.sc_reduce64 on TPU: (B, 64) uint8 -> (B, 32)
+    uint8 canonical mod L. Batches below one lane tile (or with extra
+    leading dims) take the XLA path."""
+    from jax.experimental import pallas as pl
+
+    if hash_bytes.ndim != 2 or hash_bytes.shape[0] < 128:
+        return sc.sc_reduce64(hash_bytes)
+    bsz = hash_bytes.shape[0]
+    x = jnp.moveaxis(hash_bytes.astype(jnp.int32), -1, 0)   # (64, B)
+    lanes = min(LANES, bsz)
+    pad = (-bsz) % lanes
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad)))
+    n = (bsz + pad) // lanes
+
+    out = pl.pallas_call(
+        _sc_reduce_kernel,
+        grid=(n,),
+        in_specs=[pl.BlockSpec((64, lanes), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((32, lanes), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((32, bsz + pad), jnp.int32),
+        interpret=interpret,
+    )(x)
+    if pad:
+        out = out[:, :bsz]
+    return jnp.moveaxis(out, 0, -1).astype(jnp.uint8)

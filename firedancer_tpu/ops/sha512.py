@@ -175,6 +175,18 @@ def _bytes_to_words(block_bytes):
     return hi, lo
 
 
+def sha512_batch_auto(msgs: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """Backend-dispatched batch SHA-512: the VMEM compression kernel on
+    TPU (ops/sha512_pallas.py), this module's XLA graph elsewhere."""
+    from .backend import use_pallas
+
+    if use_pallas("FD_SHA_IMPL"):
+        from .sha512_pallas import sha512_batch_pallas
+
+        return sha512_batch_pallas(msgs, lengths)
+    return sha512_batch(msgs, lengths)
+
+
 def sha512_batch(msgs: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
     """Batched SHA-512 of variable-length messages.
 

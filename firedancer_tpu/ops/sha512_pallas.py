@@ -1,0 +1,192 @@
+"""Pallas TPU kernel for the batched SHA-512 compression loop.
+
+The XLA sha512_batch costs ~15.6 ms at B=8192 on v5e — the 80-round
+compression and 64-step schedule extension become hundreds of small
+HBM-streamed elementwise kernels. Here the whole multi-block absorb
+runs in one kernel with the working state in VMEM.
+
+Layout: every 64-bit word is an (hi, lo) uint32 pair (TPU has no
+64-bit integers — same decision as ops/sha512.py), and the batch axis
+is folded to (8, B/8) so each word occupies a FULL (8, 128)-tile VPU
+vreg instead of a single sublane row — 8x the lane utilization of the
+naive (1, B) layout. The byte->word packing, padding arithmetic, and
+digest assembly stay in XLA (cheap elementwise + transposes); the
+kernel consumes pre-packed schedule words.
+
+Round structure and constants follow FIPS 180-4 via ops/sha512.py's
+helpers (one implementation of rotr/add64/sigma shared by both paths —
+the XLA path remains the CPU/test reference).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import sha512 as s
+
+SUB = 8  # sublane fold of the batch axis
+
+
+def _sha512_kernel(win_hi, win_lo, nblk, out, *, max_blocks: int):
+    """win_hi/lo: (max_blocks*16*SUB, Lb) uint32 message words, word w of
+    block b at rows [(b*16+w)*SUB : +SUB]. nblk: (SUB, Lb) int32 per-lane
+    block counts. out: (16*SUB, Lb) uint32 digest words, word w's hi at
+    rows [2w*SUB : +SUB], its lo at the following SUB rows.
+
+    The 80-round loop is statically unrolled, so the round constants
+    are Python int literals folded into the instruction stream — no
+    constant-array input needed (Pallas forbids captured arrays, and a
+    (1, 1) VMEM scalar read would need a both-axes broadcast Mosaic
+    does not implement)."""
+    lanes = win_hi.shape[1]
+    nblocks = nblk[...]
+
+    def rotr(h, l, n):
+        return s._rotr64(h, l, n)
+
+    def shr(h, l, n):
+        return s._shr64(h, l, n)
+
+    def add64(ah, al, bh, bl):
+        lo = al + bl
+        carry = (lo < al).astype(jnp.uint32)
+        return ah + bh + carry, lo
+
+    def xor3p(p0, p1, p2):
+        return (p0[0] ^ p1[0] ^ p2[0], p0[1] ^ p1[1] ^ p2[1])
+
+    # state: 8 (hi, lo) pairs, (SUB, lanes) each.
+    state = []
+    for i in range(8):
+        hi = jnp.full((SUB, lanes), np.uint32(s._IV[i] >> 32), jnp.uint32)
+        lo = jnp.full((SUB, lanes), np.uint32(s._IV[i] & 0xFFFFFFFF),
+                      jnp.uint32)
+        state.append((hi, lo))
+
+    for b in range(max_blocks):
+        # load the 16 message words of block b
+        wh = [win_hi[(b * 16 + w) * SUB:(b * 16 + w + 1) * SUB]
+              for w in range(16)]
+        wl = [win_lo[(b * 16 + w) * SUB:(b * 16 + w + 1) * SUB]
+              for w in range(16)]
+        # schedule extension 16 -> 80 (rolling window, fully unrolled)
+        for t in range(16, 80):
+            s0 = xor3p(rotr(wh[t - 15], wl[t - 15], 1),
+                       rotr(wh[t - 15], wl[t - 15], 8),
+                       shr(wh[t - 15], wl[t - 15], 7))
+            s1 = xor3p(rotr(wh[t - 2], wl[t - 2], 19),
+                       rotr(wh[t - 2], wl[t - 2], 61),
+                       shr(wh[t - 2], wl[t - 2], 6))
+            nh, nl = add64(wh[t - 16], wl[t - 16], s0[0], s0[1])
+            nh, nl = add64(nh, nl, wh[t - 7], wl[t - 7])
+            nh, nl = add64(nh, nl, s1[0], s1[1])
+            wh.append(nh)
+            wl.append(nl)
+
+        a, bb, c, d, e, f, g, h = state
+        for t in range(80):
+            s1 = xor3p(rotr(e[0], e[1], 14), rotr(e[0], e[1], 18),
+                       rotr(e[0], e[1], 41))
+            ch = (e[0] & f[0]) ^ (~e[0] & g[0]), (e[1] & f[1]) ^ (~e[1] & g[1])
+            kh = np.uint32(s._K[t] >> 32)
+            kl = np.uint32(s._K[t] & 0xFFFFFFFF)
+            t1h, t1l = add64(h[0], h[1], s1[0], s1[1])
+            t1h, t1l = add64(t1h, t1l, ch[0], ch[1])
+            t1h, t1l = add64(t1h, t1l, kh, kl)
+            t1h, t1l = add64(t1h, t1l, wh[t], wl[t])
+            s0 = xor3p(rotr(a[0], a[1], 28), rotr(a[0], a[1], 34),
+                       rotr(a[0], a[1], 39))
+            maj = ((a[0] & bb[0]) ^ (a[0] & c[0]) ^ (bb[0] & c[0]),
+                   (a[1] & bb[1]) ^ (a[1] & c[1]) ^ (bb[1] & c[1]))
+            t2h, t2l = add64(s0[0], s0[1], maj[0], maj[1])
+            ne = add64(d[0], d[1], t1h, t1l)
+            na = add64(t1h, t1l, t2h, t2l)
+            a, bb, c, d, e, f, g, h = (na, a, bb, c, ne, e, f, g)
+
+        # feed-forward + per-lane active masking (lane done once
+        # b >= its block count)
+        active = (nblocks > b).astype(jnp.uint32)
+        new_state = []
+        for i, (sh_, sl_) in enumerate(state):
+            vh, vl = add64(sh_, sl_, *( (a, bb, c, d, e, f, g, h)[i] ))
+            new_state.append((active * vh + (1 - active) * sh_,
+                              active * vl + (1 - active) * sl_))
+        state = new_state
+
+    rows = []
+    for i in range(8):
+        rows.append(state[i][0])
+        rows.append(state[i][1])
+    out[...] = jnp.concatenate(rows, axis=0)
+
+
+def sha512_batch_pallas(msgs: jnp.ndarray, lengths: jnp.ndarray,
+                        interpret: bool = False) -> jnp.ndarray:
+    """Drop-in for sha512_batch on TPU: (B, max_len) uint8 + (B,) int32
+    -> (B, 64) uint8 digests. B must be a multiple of 8*128 for the
+    folded layout; smaller/odd batches take the XLA path."""
+    from jax.experimental import pallas as pl
+
+    bsz, max_len = msgs.shape
+    if bsz % (SUB * 128) != 0:
+        return s.sha512_batch(msgs, lengths)
+    lb = bsz // SUB
+    max_blocks = (max_len + 17 + 127) // 128
+    lengths = lengths.astype(jnp.int32)
+
+    # Padded buffer (total, B) — identical construction to the XLA path.
+    total = max_blocks * 128
+    data = jnp.moveaxis(msgs.astype(jnp.uint32), -1, 0)
+    if total > max_len:
+        data = jnp.concatenate(
+            [data, jnp.zeros((total - max_len, bsz), jnp.uint32)], axis=0
+        )
+    pos = jnp.arange(total, dtype=jnp.int32)[:, None]
+    ln = lengths[None, :]
+    data = jnp.where(pos < ln, data, 0)
+    data = jnp.where(pos == ln, 0x80, data)
+    nblocks = (lengths + 17 + 127) // 128
+    len_start = nblocks * 128 - 8
+    bitlen_lo = lengths.astype(jnp.uint32) << 3
+    bitlen_hi = lengths.astype(jnp.uint32) >> 29
+    k = pos - len_start[None, :]
+    word = jnp.where(k < 4, bitlen_hi[None, :], bitlen_lo[None, :])
+    shift = (3 - (k & 3)) * 8
+    lenbyte = jnp.where(
+        (k >= 0) & (k < 8),
+        (word >> jnp.clip(shift, 0, 31)) & 0xFF,
+        0,
+    ).astype(jnp.uint32)
+    data = data | lenbyte                                   # (total, B)
+
+    # bytes -> big-endian 64-bit (hi, lo) words: (16*max_blocks, B) each.
+    by = data.reshape(16 * max_blocks, 8, bsz)
+    hi = (by[:, 0] << 24) | (by[:, 1] << 16) | (by[:, 2] << 8) | by[:, 3]
+    lo = (by[:, 4] << 24) | (by[:, 5] << 16) | (by[:, 6] << 8) | by[:, 7]
+    # fold batch into sublanes: (W, B) -> (W*SUB, B/SUB)
+    hi = hi.reshape(16 * max_blocks, SUB, lb).reshape(-1, lb)
+    lo = lo.reshape(16 * max_blocks, SUB, lb).reshape(-1, lb)
+    nblk = nblocks.reshape(SUB, lb)
+
+    spec_w = pl.BlockSpec((16 * max_blocks * SUB, lb), lambda: (0, 0))
+    spec_n = pl.BlockSpec((SUB, lb), lambda: (0, 0))
+    out = pl.pallas_call(
+        functools.partial(_sha512_kernel, max_blocks=max_blocks),
+        in_specs=[spec_w, spec_w, spec_n],
+        out_specs=pl.BlockSpec((16 * SUB, lb), lambda: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((16 * SUB, lb), jnp.uint32),
+        interpret=interpret,
+    )(hi, lo, nblk)
+
+    # (16*SUB, lb): rows [2w*SUB:+SUB] = hi of word w, next SUB = lo.
+    words = out.reshape(8, 2, SUB, lb).reshape(8, 2, bsz)   # (8, 2, B)
+    words = jnp.transpose(words, (2, 0, 1))                 # (B, 8, 2)
+    shifts = jnp.asarray([24, 16, 8, 0], jnp.uint32)
+    hi_b = (words[:, :, 0:1] >> shifts[None, None, :]) & 0xFF
+    lo_b = (words[:, :, 1:2] >> shifts[None, None, :]) & 0xFF
+    return jnp.concatenate([hi_b, lo_b], axis=-1).reshape(
+        bsz, 64).astype(jnp.uint8)

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from . import curve25519 as ge
 from . import sc25519 as sc
-from .sha512 import sha512_batch
+from .sha512 import sha512_batch_auto as sha512_batch
 
 FD_ED25519_SUCCESS = 0
 FD_ED25519_ERR_SIG = -1
@@ -74,7 +74,7 @@ def verify_batch(
     # concatenated buffer; lengths shift by the 64-byte prefix.
     hash_in = jnp.concatenate([r_bytes, pubkeys, msgs], axis=1)
     h64 = sha512_batch(hash_in, msg_lengths.astype(jnp.int32) + 64)
-    h_bytes = sc.sc_reduce64(h64)
+    h_bytes = sc.sc_reduce64_auto(h64)
 
     r_prime = _dsm_auto()(h_bytes, neg_a, s_bytes)
     r_enc = ge.compress_auto(r_prime)

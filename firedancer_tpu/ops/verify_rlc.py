@@ -61,7 +61,7 @@ from . import curve25519 as ge
 from . import fe25519 as fe
 from . import msm as msm_mod
 from . import sc25519 as sc
-from .sha512 import sha512_batch
+from .sha512 import sha512_batch_auto as sha512_batch
 from .sign import _sc_muladd
 from .verify import (
     FD_ED25519_ERR_MSG,
@@ -161,9 +161,11 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     s_ok = sc.sc_check_range(s_bytes)
 
     # One decompression pass over A and R stacked: same lane-work, half
-    # the traced graph (the power chain appears once).
-    both, both_ok = ge.decompress_auto(
-        jnp.concatenate([pubkeys, r_bytes], axis=0)
+    # the traced graph (the power chain appears once). The x==0 mask
+    # rides along from the kernel (a free in-VMEM canonicalize vs a
+    # multi-ms XLA chain).
+    both, both_ok, both_xz = ge.decompress_auto(
+        jnp.concatenate([pubkeys, r_bytes], axis=0), want_x_zero=True
     )
     bsz = pubkeys.shape[0]
     a_point = tuple(c[:, :bsz] for c in both)
@@ -177,14 +179,14 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     r_y_lt_p = _bytes_lt_p(
         r_bytes.astype(jnp.int32).at[:, 31].set(r_bytes[:, 31] & 0x7F)
     )
-    r_x_zero = fe.fe_is_zero(r_point[0])
+    r_x_zero = both_xz[bsz:]
     r_ok = r_dec_ok & r_y_lt_p & ~(r_x_zero & r_sign)
 
     h64 = sha512_batch(
         jnp.concatenate([r_bytes, pubkeys, msgs], axis=1),
         msg_lengths.astype(jnp.int32) + 64,
     )
-    h_bytes = sc.sc_reduce64(h64)
+    h_bytes = sc.sc_reduce64_auto(h64)
 
     status = jnp.where(
         ~s_ok,

@@ -91,6 +91,51 @@ def main():
     assert bytes(ef) == bytes(er)
     print(f"3. msm kernels vs XLA: OK ({time.time()-t0:.1f}s)", flush=True)
 
+    # 3b. round-3 kernels: sha512, sc_reduce, decompress/compress,
+    # subgroup_check_fast — parity vs host ground truth / XLA paths.
+    import hashlib
+
+    from firedancer_tpu.ops.sha512_pallas import sha512_batch_pallas
+    from firedancer_tpu.ops.sc_pallas import sc_reduce64_pallas
+    from firedancer_tpu.ops import sc25519 as sc_mod
+    from firedancer_tpu.ops.curve_pallas import (
+        compress_pallas,
+        decompress_pallas,
+    )
+
+    t0 = time.time()
+    sb2 = 1024
+    smsgs = rng.randint(0, 256, (sb2, 200), dtype=np.uint8)
+    slens = rng.randint(0, 201, sb2).astype(np.int32)
+    dig = np.asarray(sha512_batch_pallas(jnp.asarray(smsgs),
+                                         jnp.asarray(slens)))
+    bad = sum(
+        dig[i].tobytes()
+        != hashlib.sha512(smsgs[i, : slens[i]].tobytes()).digest()
+        for i in range(sb2)
+    )
+    assert bad == 0, f"sha512 kernel: {bad} mismatches"
+    h64 = rng.randint(0, 256, (sb2, 64), dtype=np.uint8)
+    red = np.asarray(sc_reduce64_pallas(jnp.asarray(h64)))
+    refred = np.asarray(sc_mod.sc_reduce64(jnp.asarray(h64)))
+    assert np.array_equal(red, refred), "sc_reduce kernel mismatch"
+    print(f"3b. sha512 + sc_reduce kernels: OK ({time.time()-t0:.1f}s)",
+          flush=True)
+
+    t0 = time.time()
+    encs = np.stack([pubs[i % B] for i in range(256)])
+    encs[7] = 0xFF  # an undecompressable lane
+    pt_k, ok_k = decompress_pallas(jnp.asarray(encs))
+    pt_r, ok_r = ge.decompress(jnp.asarray(encs))
+    assert np.array_equal(np.asarray(ok_k), np.asarray(ok_r))
+    assert np.array_equal(np.asarray(compress_pallas(pt_k)),
+                          np.asarray(ge.compress(pt_r)))
+    u = jnp.asarray(rng.randint(0, 128, (64, 512)).astype(np.int32))
+    ok_f, fill_f = msm_mod.subgroup_check_fast(pts, u)
+    assert bool(fill_f) and bool(ok_f), "subgroup_check_fast on honest pts"
+    print(f"3c. decompress/compress + subgroup kernels: OK "
+          f"({time.time()-t0:.1f}s)", flush=True)
+
     # 4. timed RLC verify at bench size vs direct path.
     from firedancer_tpu.ops.verify import verify_batch
     from firedancer_tpu.ops.verify_rlc import make_async_verifier

@@ -56,3 +56,57 @@ def test_uniform_batch_mixed_lengths():
     got = _run(msgs)
     for m, g in zip(msgs, got):
         assert g == hashlib.sha512(m).digest()
+
+
+import os
+
+import pytest
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not os.environ.get("FD_RUN_XSLOW"),
+                    reason="XLA:CPU compile of the unrolled SHA kernel "
+                           "exceeds 1h on a 1-core host; on-chip parity "
+                           "runs in scripts/tpu_validate.py step 4")
+def test_sha512_pallas_interpret_matches_hashlib():
+    """VMEM compression kernel (interpret mode, jitted) vs hashlib over
+    the folded-layout minimum batch (8*128) with variable lengths
+    including the empty message. One-block shape: the unrolled kernel's
+    XLA:CPU compile is minutes on a 1-core host and doubles per block
+    (the 2-block shape is exercised on-chip by the bench correctness
+    gate and tpu_validate)."""
+    import functools
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from firedancer_tpu.ops.sha512_pallas import sha512_batch_pallas
+
+    bsz, max_len = 1024, 40
+    rng = np.random.RandomState(11)
+    msgs = rng.randint(0, 256, (bsz, max_len), dtype=np.uint8)
+    lens = rng.randint(0, max_len + 1, bsz).astype(np.int32)
+    fn = jax.jit(functools.partial(sha512_batch_pallas, interpret=True))
+    got = np.asarray(fn(jnp.asarray(msgs), jnp.asarray(lens)))
+    bad = sum(
+        got[i].tobytes()
+        != hashlib.sha512(msgs[i, : lens[i]].tobytes()).digest()
+        for i in range(bsz)
+    )
+    assert bad == 0
+
+
+def test_sha512_pallas_odd_batch_falls_back():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from firedancer_tpu.ops.sha512 import sha512_batch
+    from firedancer_tpu.ops.sha512_pallas import sha512_batch_pallas
+
+    msgs = np.zeros((12, 32), np.uint8)
+    lens = np.full(12, 32, np.int32)
+    got = np.asarray(sha512_batch_pallas(jnp.asarray(msgs), jnp.asarray(lens)))
+    ref = np.asarray(sha512_batch(jnp.asarray(msgs), jnp.asarray(lens)))
+    assert np.array_equal(got, ref)
