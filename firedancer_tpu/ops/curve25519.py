@@ -57,18 +57,23 @@ def decompress_xla(y_bytes: jnp.ndarray, want_x_zero: bool = False):
     return pt, ok
 
 
-def decompress_auto(y_bytes: jnp.ndarray, want_x_zero: bool = False):
+def decompress_auto(y_bytes: jnp.ndarray, want_x_zero: bool = False,
+                    want_niels: bool = False):
     """Backend-dispatched decompress: fused Pallas kernel on TPU
     (ops/curve_pallas.py), the XLA graph elsewhere. want_x_zero=True
     appends an x==0-mod-p lane mask (in-VMEM on the kernel path; a
     canonicalize chain on the XLA path), meaningful only for ok lanes
-    (see decompress_xla)."""
+    (see decompress_xla). want_niels (kernel path only) appends the
+    (yp, ym, t2d, t2dn) niels-form arrays for the MSM fills."""
     from .backend import use_pallas
 
     if use_pallas("FD_DECOMPRESS_IMPL"):
         from .curve_pallas import decompress_pallas
 
-        return decompress_pallas(y_bytes, want_x_zero=want_x_zero)
+        return decompress_pallas(y_bytes, want_x_zero=want_x_zero,
+                                 want_niels=want_niels)
+    if want_niels:
+        raise ValueError("want_niels requires the kernel backend")
     return decompress_xla(y_bytes, want_x_zero)
 
 

@@ -46,16 +46,43 @@ def _sel(m, a, b):
 
 @functools.lru_cache(maxsize=1)
 def _const_cols() -> np.ndarray:
-    """(32, 2) int32: column 0 = d, column 1 = sqrt(-1) (kernel input —
-    Pallas kernels cannot capture constant arrays)."""
-    out = np.zeros((NLIMBS, 2), np.int32)
-    for c, val in enumerate((fe.D_INT, fe.SQRT_M1_INT)):
+    """(32, 3) int32: columns = d, sqrt(-1), 2d (kernel input — Pallas
+    kernels cannot capture constant arrays)."""
+    out = np.zeros((NLIMBS, 3), np.int32)
+    consts = (fe.D_INT, fe.SQRT_M1_INT, 2 * fe.D_INT % fe.P)
+    for c, val in enumerate(consts):
         for i in range(NLIMBS):
             out[i, c] = (val >> (8 * i)) & 0xFF
     return out
 
 
+def _decompress_niels_kernel(yin, sign, consts, ox, oy, oz, ot, ook, oxz,
+                             oyp, oym, ot2d, ot2dn):
+    """_decompress_kernel plus niels-form outputs for the MSM fills:
+    yp = y+x, ym = y-x, t2d = 2d*t, t2dn = -2d*t (the niels form of the
+    NEGATED point is (ym, yp, t2dn), so both signs come for free).
+    Failed lanes carry the niels identity (1, 1, 0)."""
+    _decompress_body(yin, sign, consts, ox, oy, oz, ot, ook, oxz)
+    lanes = yin[...].shape[1]
+    # Poisoned lanes already hold the identity (0, 1, 1, 0), whose
+    # niels form (1, 1, 0) falls out of the same arithmetic — no
+    # extra select needed.
+    x = ox[...]
+    y = oy[...]
+    t = ot[...]
+    d2 = jnp.broadcast_to(consts[:, 2:3], (NLIMBS, lanes))
+    t2d = _mul(t, d2)
+    oyp[...] = fe.fe_add(y, x)
+    oym[...] = fe.fe_sub(y, x)
+    ot2d[...] = t2d
+    ot2dn[...] = fe.fe_neg(t2d)
+
+
 def _decompress_kernel(yin, sign, consts, ox, oy, oz, ot, ook, oxz):
+    _decompress_body(yin, sign, consts, ox, oy, oz, ot, ook, oxz)
+
+
+def _decompress_body(yin, sign, consts, ox, oy, oz, ot, ook, oxz):
     y = yin[...]
     lanes = y.shape[1]
     d_c = jnp.broadcast_to(consts[:, 0:1], (NLIMBS, lanes))
@@ -91,17 +118,22 @@ def _decompress_kernel(yin, sign, consts, ox, oy, oz, ot, ook, oxz):
     # negation preserves zero). Costs one in-VMEM canonicalize here vs
     # a ~7.6 ms XLA chain for the caller (verify_rlc's r-canonicality).
     oxz[...] = fe.fe_is_zero_k(x)
+    return ok
 
 
 def decompress_pallas(y_bytes: jnp.ndarray, interpret: bool = False,
                       lanes: int | None = None,
-                      want_x_zero: bool = False):
+                      want_x_zero: bool = False,
+                      want_niels: bool = False):
     """Drop-in for curve25519.decompress on TPU: (B, 32) uint8 ->
     ((X, Y, Z, T) of (32, B) limbs, (B,) bool ok). lanes overrides the
     kernel tile width (tests use a small tile to exercise padding).
     want_x_zero=True appends an (B,) bool x==0-mod-p mask (of the
     decompressed x, before identity poison — only meaningful for
-    ok lanes) to the return tuple."""
+    ok lanes). want_niels=True appends (yp, ym, t2d, t2dn) niels-form
+    limbs for the MSM fills (identity-form on failed lanes); the
+    NEGATED point's niels form is (ym, yp, t2dn). Requires the kernel
+    path (bsz >= 128) when want_niels is set."""
     from jax.experimental import pallas as pl
 
     bsz = y_bytes.shape[0]
@@ -109,6 +141,8 @@ def decompress_pallas(y_bytes: jnp.ndarray, interpret: bool = False,
         # Sub-tile batches: the XLA path beats a padded kernel launch.
         from . import curve25519 as ge
 
+        if want_niels:
+            raise ValueError("want_niels requires a kernel-tile batch")
         return ge.decompress_xla(y_bytes, want_x_zero)
     sign = (y_bytes[:, 31] >> 7).astype(jnp.int32)[None, :]    # (1, B)
     y = fe.fe_from_bytes(y_bytes, mask_high_bit=True)          # (32, B)
@@ -121,24 +155,34 @@ def decompress_pallas(y_bytes: jnp.ndarray, interpret: bool = False,
 
     spec_fe = pl.BlockSpec((NLIMBS, lanes), lambda i: (0, i))
     spec_row = pl.BlockSpec((1, lanes), lambda i: (0, i))
-    spec_c = pl.BlockSpec((NLIMBS, 2), lambda i: (0, 0))
+    spec_c = pl.BlockSpec((NLIMBS, 3), lambda i: (0, 0))
     out_fe = jax.ShapeDtypeStruct((NLIMBS, bsz + pad), jnp.int32)
     out_row = jax.ShapeDtypeStruct((1, bsz + pad), jnp.int32)
-    x, yy, z, t, ok, xz = pl.pallas_call(
-        _decompress_kernel,
+    n_fe_out = 8 if want_niels else 4
+    outs = pl.pallas_call(
+        _decompress_niels_kernel if want_niels else _decompress_kernel,
         grid=(n,),
         in_specs=[spec_fe, spec_row, spec_c],
-        out_specs=[spec_fe] * 4 + [spec_row] * 2,
-        out_shape=[out_fe] * 4 + [out_row] * 2,
+        out_specs=[spec_fe] * 4 + [spec_row] * 2
+        + [spec_fe] * (n_fe_out - 4),
+        out_shape=[out_fe] * 4 + [out_row] * 2
+        + [out_fe] * (n_fe_out - 4),
         interpret=interpret,
     )(y, sign, jnp.asarray(_const_cols()))
+    x, yy, z, t = outs[:4]
+    ok, xz = outs[4:6]
+    niels = outs[6:]
     if pad:
         x, yy, z, t = (c[:, :bsz] for c in (x, yy, z, t))
+        niels = tuple(c[:, :bsz] for c in niels)
         ok = ok[:, :bsz]
         xz = xz[:, :bsz]
+    ret = [(x, yy, z, t), ok[0] != 0]
     if want_x_zero:
-        return (x, yy, z, t), ok[0] != 0, xz[0] != 0
-    return (x, yy, z, t), ok[0] != 0
+        ret.append(xz[0] != 0)
+    if want_niels:
+        ret.append(tuple(niels))
+    return tuple(ret)
 
 
 def _compress_kernel(xin, yin, zin, ocy, osign):

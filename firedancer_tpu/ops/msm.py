@@ -285,14 +285,20 @@ def subgroup_check(points, u_digits: jnp.ndarray,
 _STAGE_DTYPE = jnp.int16
 
 
-def _stage_niels(points, idx, max_rounds: int, lanes: int, bsz: int):
+def _stage_niels(points, idx, max_rounds: int, lanes: int, bsz: int,
+                 niels=None):
     """Gather per-round niels operands: (R, 32, L) x3, identity-staged
     ((1, 1, 0) niels form) where a slot is empty. points must have
-    Z == 1 (decompress output / affine constants)."""
-    x, y, z, t = points
-    yp = fe.fe_add(y, x)
-    ym = fe.fe_sub(y, x)
-    t2d = fe.fe_mul(t, fe.FE_D2)
+    Z == 1 (decompress output / affine constants). niels, if given, is
+    the precomputed (yp, ym, t2d) from the decompress kernel — skips
+    three XLA field ops over the whole point set."""
+    if niels is not None:
+        yp, ym, t2d = niels
+    else:
+        x, y, z, t = points
+        yp = fe.fe_add(y, x)
+        ym = fe.fe_sub(y, x)
+        t2d = fe.fe_mul(t, fe.FE_D2)
 
     sel = jnp.transpose(idx, (2, 0, 1)).reshape(max_rounds * lanes)
     m = (sel >= 0)[None, :]
@@ -311,7 +317,8 @@ def _stage_niels(points, idx, max_rounds: int, lanes: int, bsz: int):
 
 
 def msm_fast(scalars_bytes: jnp.ndarray, points, n_windows: int,
-             max_rounds: int | None = None, interpret: bool = False):
+             max_rounds: int | None = None, interpret: bool = False,
+             niels=None):
     """Kernel-backed msm (same contract as msm()).
 
     REQUIRES points with Z == 1 (decompress output / affine constants) —
@@ -329,7 +336,8 @@ def msm_fast(scalars_bytes: jnp.ndarray, points, n_windows: int,
     idx, ok = _staging_indices(scalars_bytes, nw, bsz, max_rounds)
 
     lanes = nw * N_BUCKETS
-    s_yp, s_ym, s_t2d = _stage_niels(points, idx, max_rounds, lanes, bsz)
+    s_yp, s_ym, s_t2d = _stage_niels(points, idx, max_rounds, lanes, bsz,
+                                     niels=niels)
 
     bx, by, bz, bt = mp.fill_buckets_pallas(
         s_yp, s_ym, s_t2d, interpret=interpret
@@ -352,7 +360,8 @@ def msm_fast(scalars_bytes: jnp.ndarray, points, n_windows: int,
     )
     w_res = tuple(c[:, :nw] for c in w_res)
     res = mp.window_horner_pallas(
-        w_res, fe.FE_D2.astype(jnp.int32), nw, interpret=interpret
+        w_res, fe.FE_D2.astype(jnp.int32), nw, interpret=interpret,
+        w_bits=W_BITS,
     )
     return res, ok
 
@@ -371,7 +380,8 @@ def _l_bits_col() -> jnp.ndarray:
 def subgroup_check_fast(points, u_digits: jnp.ndarray,
                         bucket_bits: int = 5,
                         max_rounds: int | None = None,
-                        interpret: bool = False):
+                        interpret: bool = False,
+                        niels=None):
     """Kernel-backed subgroup_check (same contract and soundness).
 
     REQUIRES points with Z == 1 (decompress output), like msm_fast.
@@ -399,7 +409,8 @@ def subgroup_check_fast(points, u_digits: jnp.ndarray,
     idx, ok_fill = _staging_from_digits(d, bsz, max_rounds, n_buckets)
 
     lanes = k * n_buckets
-    s_yp, s_ym, s_t2d = _stage_niels(points, idx, max_rounds, lanes, bsz)
+    s_yp, s_ym, s_t2d = _stage_niels(points, idx, max_rounds, lanes, bsz,
+                                     niels=niels)
     bx, by, bz, bt = mp.fill_buckets_pallas(
         s_yp, s_ym, s_t2d, interpret=interpret
     )

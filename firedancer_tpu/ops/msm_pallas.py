@@ -221,11 +221,8 @@ def _point_double_ext(p):
             fe.fe_mul_unrolled(f, g), fe.fe_mul_unrolled(e, h))
 
 
-W_BITS = 7  # msm.W_BITS (kept local to avoid a circular import)
-
-
 def window_horner_pallas(w_res, d2_col, n_windows: int,
-                         interpret: bool = False):
+                         interpret: bool = False, w_bits: int = 7):
     """Cross-window Horner combine, fully in VMEM: the 2^(7t)-weighted
     sum of the per-window points, MSB-first (msm._window_horner is the
     XLA reference — an (n_windows-1)-step lax.scan whose per-step
@@ -257,7 +254,7 @@ def window_horner_pallas(w_res, d2_col, n_windows: int,
             )
 
         def body(i, r):
-            for _ in range(W_BITS):
+            for _ in range(w_bits):
                 r = _point_double_ext(r)
             return _point_add_ext(r, col(nw - 2 - i), d2)
 

@@ -52,10 +52,10 @@ def _conv_const(x, weights, n_out: int):
     return acc
 
 
-def _sc_reduce_kernel(xin, out):
-    """xin: (64, L) int32 canonical byte limbs of x < 2^512.
-    out: (32, L) int32 canonical limbs of x mod L."""
-    x = xin[...]
+def _barrett_body(x):
+    """(64, L) int32 canonical byte limbs of x < 2^512 -> (32, L)
+    canonical limbs of x mod L (kernel-safe; shared by the reduce and
+    mul kernels)."""
     mu = [(sc._MU >> (8 * i)) & 0xFF for i in range(33)]
     l_limbs = [(sc.L >> (8 * i)) & 0xFF for i in range(33)]
 
@@ -74,7 +74,66 @@ def _sc_reduce_kernel(xin, out):
         d, borrow = _seq_carry_k(r - l_col)
         keep = (borrow < 0).astype(jnp.int32)
         r = keep * r + (1 - keep) * d
-    out[...] = r[:32]
+    return r[:32]
+
+
+def _sc_reduce_kernel(xin, out):
+    out[...] = _barrett_body(xin[...])
+
+
+def _sc_mul_kernel(ain, bin_, out):
+    """a, b: (32, L) int32 canonical byte limbs -> (32, L) canonical
+    limbs of a*b mod L. Schoolbook conv (products <= 32*255^2 < 2^21,
+    inside int32) -> exact carry -> Barrett."""
+    a = ain[...]
+    b = bin_[...]
+    lanes = a.shape[1]
+    acc = jnp.zeros((64, lanes), jnp.int32)
+    for i in range(32):
+        term = a[i:i + 1] * b                     # (32, L)
+        parts = []
+        if i:
+            parts.append(jnp.zeros((i, lanes), jnp.int32))
+        parts.append(term)
+        if 64 - i - 32:
+            parts.append(jnp.zeros((64 - i - 32, lanes), jnp.int32))
+        acc = acc + jnp.concatenate(parts, axis=0)
+    x, _ = _seq_carry_k(acc)                      # < 2^512 exactly
+    out[...] = _barrett_body(x)
+
+
+def sc_mul_pallas(a_bytes: jnp.ndarray, b_bytes: jnp.ndarray,
+                  interpret: bool = False) -> jnp.ndarray:
+    """(B, 32) x (B, 32) uint8 -> (B, 32) uint8, a*b mod L per lane
+    (the c=0 case of sign._sc_muladd, in VMEM). Sub-tile batches fall
+    back to the XLA path."""
+    from jax.experimental import pallas as pl
+
+    from .sign import _sc_muladd
+
+    if a_bytes.ndim != 2 or a_bytes.shape[0] < 128:
+        return _sc_muladd(a_bytes, b_bytes, jnp.zeros_like(a_bytes))
+    bsz = a_bytes.shape[0]
+    a = jnp.moveaxis(a_bytes.astype(jnp.int32), -1, 0)      # (32, B)
+    b = jnp.moveaxis(b_bytes.astype(jnp.int32), -1, 0)
+    lanes = min(LANES, bsz)
+    pad = (-bsz) % lanes
+    if pad:
+        a = jnp.pad(a, ((0, 0), (0, pad)))
+        b = jnp.pad(b, ((0, 0), (0, pad)))
+    n = (bsz + pad) // lanes
+
+    out = pl.pallas_call(
+        _sc_mul_kernel,
+        grid=(n,),
+        in_specs=[pl.BlockSpec((32, lanes), lambda i: (0, i))] * 2,
+        out_specs=pl.BlockSpec((32, lanes), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((32, bsz + pad), jnp.int32),
+        interpret=interpret,
+    )(a, b)
+    if pad:
+        out = out[:, :bsz]
+    return jnp.moveaxis(out, 0, -1).astype(jnp.uint8)
 
 
 def sc_reduce64_pallas(hash_bytes: jnp.ndarray,

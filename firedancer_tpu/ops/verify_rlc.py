@@ -162,12 +162,22 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
 
     # One decompression pass over A and R stacked: same lane-work, half
     # the traced graph (the power chain appears once). The x==0 mask
-    # rides along from the kernel (a free in-VMEM canonicalize vs a
-    # multi-ms XLA chain).
-    both, both_ok, both_xz = ge.decompress_auto(
-        jnp.concatenate([pubkeys, r_bytes], axis=0), want_x_zero=True
-    )
+    # and the niels forms for the MSM fills ride along from the kernel
+    # (free in-VMEM vs multi-ms XLA chains).
+    from .backend import use_pallas
+
     bsz = pubkeys.shape[0]
+    on_tpu = use_pallas("FD_MSM_IMPL")
+    # niels outputs are only consumed by the kernel MSM path, so both
+    # backends must be on (a split config would compute and drop them).
+    want_niels = (on_tpu and use_pallas("FD_DECOMPRESS_IMPL")
+                  and 2 * bsz >= 128)
+    dec = ge.decompress_auto(
+        jnp.concatenate([pubkeys, r_bytes], axis=0),
+        want_x_zero=True, want_niels=want_niels,
+    )
+    both, both_ok, both_xz = dec[:3]
+    both_niels = dec[3] if want_niels else None
     a_point = tuple(c[:, :bsz] for c in both)
     r_point = tuple(c[:, bsz:] for c in both)
     pub_ok = both_ok[:bsz]
@@ -203,9 +213,20 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     live = ~definite
     z_live = jnp.where(live[:, None], z_bytes, 0).astype(jnp.uint8)
 
-    # m = z*h mod L; u = sum z*s mod L.
-    m_bytes = _sc_muladd(z_live, h_bytes, jnp.zeros_like(h_bytes))
-    zs = _sc_muladd(z_live, s_bytes, jnp.zeros_like(s_bytes))
+    # m = z*h mod L; u = sum z*s mod L. On the kernel path both
+    # products ride one stacked VMEM Barrett-multiply launch.
+    if on_tpu:
+        from .sc_pallas import sc_mul_pallas
+
+        both_m = sc_mul_pallas(
+            jnp.concatenate([z_live, z_live], axis=0),
+            jnp.concatenate([h_bytes, s_bytes], axis=0),
+        )
+        bsz_ = z_live.shape[0]
+        m_bytes, zs = both_m[:bsz_], both_m[bsz_:]
+    else:
+        m_bytes = _sc_muladd(z_live, h_bytes, jnp.zeros_like(h_bytes))
+        zs = _sc_muladd(z_live, s_bytes, jnp.zeros_like(s_bytes))
     u_bytes = sc.sc_sum(zs)
 
     neg_r = ge.point_neg(r_point)
@@ -221,13 +242,29 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
         jnp.concatenate([c_a, c_b], axis=1)
         for c_a, c_b in zip(neg_a, b_pt)
     )
-    from .backend import use_pallas
-
+    # niels forms from the decompress kernel: the negated point's form
+    # is the coordinate swap (ym, yp, t2dn); the single B lane's form
+    # is three tiny XLA ops.
+    kw_r = kw_m = kw_sub = {}
+    if both_niels is not None and on_tpu:
+        yp, ym, t2d, t2dn = both_niels
+        kw_r = {"niels": (ym[:, bsz:], yp[:, bsz:], t2dn[:, bsz:])}
+        b_niels = (fe.fe_add(b_pt[1], b_pt[0]),
+                   fe.fe_sub(b_pt[1], b_pt[0]),
+                   fe.fe_mul(b_pt[3], fe.FE_D2))
+        kw_m = {"niels": tuple(
+            jnp.concatenate([na, nb], axis=1)
+            for na, nb in zip(
+                (ym[:, :bsz], yp[:, :bsz], t2dn[:, :bsz]), b_niels
+            )
+        )}
+        kw_sub = {"niels": (yp, ym, t2d)}
     # Decompressed points have Z == 1, so the niels fast path applies.
-    on_tpu = use_pallas("FD_MSM_IMPL")
     msm_impl = msm_mod.msm_fast if on_tpu else msm_mod.msm
-    t1, ok1 = msm_impl(z_live, neg_r, n_windows=msm_mod.WINDOWS_Z)
-    t2, ok2 = msm_impl(m_all, pts_all, n_windows=msm_mod.WINDOWS_253)
+    t1, ok1 = msm_impl(z_live, neg_r, n_windows=msm_mod.WINDOWS_Z,
+                       **kw_r)
+    t2, ok2 = msm_impl(m_all, pts_all, n_windows=msm_mod.WINDOWS_253,
+                       **kw_m)
     # T = u*B + sum z(-R) + sum m(-A); identity <=> X == 0 and Y == Z.
     t = ge.point_add(t1, t2, need_t=False)
     # Torsion certification over the live lanes' A and R (the stacked
@@ -237,7 +274,7 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     u_live = jnp.where(live2[None, :], u_digits, 0)
     sub_impl = (msm_mod.subgroup_check_fast if on_tpu
                 else msm_mod.subgroup_check)
-    sub_ok, sub_fill_ok = sub_impl(both, u_live)
+    sub_ok, sub_fill_ok = sub_impl(both, u_live, **kw_sub)
     batch_ok = (
         fe.fe_is_zero(t[0]) & fe.fe_eq(t[1], t[2]) & ok1 & ok2
         & sub_ok & sub_fill_ok

@@ -103,3 +103,24 @@ def test_decompress_pallas_small_batch_falls_back():
     assert np.array_equal(np.asarray(ok_ref), np.asarray(ok_k))
     for c_ref, c_k in zip(pt_ref, pt_k):
         assert np.array_equal(np.asarray(c_ref), np.asarray(c_k))
+
+
+def test_decompress_pallas_niels_outputs():
+    """want_niels: kernel-emitted (yp, ym, t2d, t2dn) must equal the
+    XLA niels prep on the decompressed points, canonically."""
+    enc = _encodings()
+    pt, ok, xz, niels = decompress_pallas(
+        enc, interpret=True, lanes=TILE, want_x_zero=True,
+        want_niels=True,
+    )
+    x, y, z, t = pt
+    want = (
+        fe.fe_add(y, x),
+        fe.fe_sub(y, x),
+        fe.fe_mul(t, fe.FE_D2),
+        fe.fe_neg(fe.fe_mul(t, fe.FE_D2)),
+    )
+    for got_c, want_c in zip(niels, want):
+        a = np.asarray(fe.fe_canonical_limbs(got_c))
+        b = np.asarray(fe.fe_canonical_limbs(want_c))
+        assert np.array_equal(a, b)

@@ -31,3 +31,37 @@ def test_sc_reduce64_pallas_small_batch_falls_back():
     got = np.asarray(sc_reduce64_pallas(jnp.asarray(x)))
     ref = np.asarray(sc.sc_reduce64(jnp.asarray(x)))
     assert np.array_equal(got, ref)
+
+
+def test_sc_mul_pallas_matches_muladd_and_bigint():
+    from firedancer_tpu.ops.sc_pallas import sc_mul_pallas
+    from firedancer_tpu.ops.sign import _sc_muladd
+
+    bsz = 256
+    rng = np.random.RandomState(6)
+    a = rng.randint(0, 256, (bsz, 32), dtype=np.uint8)
+    b = rng.randint(0, 256, (bsz, 32), dtype=np.uint8)
+    a[0] = 0                                        # zero weight lane
+    b[1] = 0xFF                                     # b >= L (dead-lane shape)
+    got = np.asarray(sc_mul_pallas(jnp.asarray(a), jnp.asarray(b),
+                                   interpret=True))
+    ref = np.asarray(_sc_muladd(jnp.asarray(a), jnp.asarray(b),
+                                jnp.zeros((bsz, 32), jnp.uint8)))
+    assert np.array_equal(got, ref)
+    for i in range(8):
+        ai = int.from_bytes(a[i].tobytes(), "little")
+        bi = int.from_bytes(b[i].tobytes(), "little")
+        assert (int.from_bytes(got[i].tobytes(), "little")
+                == ai * bi % sc.L)
+
+
+def test_sc_mul_pallas_small_batch_falls_back():
+    from firedancer_tpu.ops.sc_pallas import sc_mul_pallas
+    from firedancer_tpu.ops.sign import _sc_muladd
+
+    a = np.full((4, 32), 3, np.uint8)
+    b = np.full((4, 32), 9, np.uint8)
+    got = np.asarray(sc_mul_pallas(jnp.asarray(a), jnp.asarray(b)))
+    ref = np.asarray(_sc_muladd(jnp.asarray(a), jnp.asarray(b),
+                                jnp.zeros((4, 32), jnp.uint8)))
+    assert np.array_equal(got, ref)
