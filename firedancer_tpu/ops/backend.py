@@ -36,3 +36,11 @@ def use_pallas(env_var: str) -> bool:
     except Exception:
         platform = "cpu"
     return platform in TPU_PLATFORMS
+
+
+def use_karatsuba() -> bool:
+    """FD_MUL_IMPL=karatsuba swaps the in-kernel schoolbook multiply
+    for the two-level Karatsuba schedule (fe25519.fe_mul_karatsuba) —
+    fewer VPU multiplies, more adds; enabled when the on-chip probe
+    (scripts/kernel_probe.py) shows int32 mul >> add cost."""
+    return os.environ.get("FD_MUL_IMPL", "schoolbook") == "karatsuba"

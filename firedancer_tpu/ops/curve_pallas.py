@@ -30,6 +30,9 @@ from . import fe25519 as fe
 
 NLIMBS = fe.NLIMBS
 LANES = 512
+# Below this batch the padded kernel launch loses to the XLA graph;
+# callers that pre-gate (e.g. verify_rlc's want_niels) reference this.
+MIN_KERNEL_BATCH = 128
 
 
 # One kernel-safe power-chain implementation for all Pallas modules
@@ -137,7 +140,7 @@ def decompress_pallas(y_bytes: jnp.ndarray, interpret: bool = False,
     from jax.experimental import pallas as pl
 
     bsz = y_bytes.shape[0]
-    if bsz < 128:
+    if bsz < MIN_KERNEL_BATCH:
         # Sub-tile batches: the XLA path beats a padded kernel launch.
         from . import curve25519 as ge
 
@@ -206,7 +209,7 @@ def compress_pallas(p, interpret: bool = False,
 
     x, y, z, _ = p
     bsz = x.shape[1]
-    if bsz < 128:
+    if bsz < MIN_KERNEL_BATCH:
         from . import curve25519 as ge
 
         return ge.compress(p)

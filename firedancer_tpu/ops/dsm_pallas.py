@@ -31,7 +31,7 @@ LANES = 1024  # batch tile per program (measured best on v5e; 512 ~9% slower)
 
 
 def _fe_mul(a, b):
-    return fe.fe_mul_unrolled(a, b)
+    return fe.fe_mul_kernel(a, b)
 
 
 def _fe_sq(a):
@@ -41,7 +41,7 @@ def _fe_sq(a):
 
     if use_specialized_square():
         return fe.fe_sq(a)
-    return fe.fe_mul_unrolled(a, a)
+    return fe.fe_mul_kernel(a, a)
 
 
 def _point_add(p, q, d2, need_t=True):

@@ -138,6 +138,99 @@ def fe_mul_unrolled(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return _carry_pass(acc, 4)
 
 
+def _pad_rows_k(x, lo: int, hi: int, lanes_shape):
+    """Place x's rows at offset lo inside lo + rows + hi total rows via
+    zeros + concatenate — the kernel-safe row-shift every conv/combine
+    in this file (and sc_pallas) builds on. Static shapes only."""
+    parts = []
+    if lo:
+        parts.append(jnp.zeros((lo,) + lanes_shape, jnp.int32))
+    parts.append(x)
+    if hi:
+        parts.append(jnp.zeros((hi,) + lanes_shape, jnp.int32))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, 0)
+
+
+def _conv8(a, b, lanes_shape):
+    """Schoolbook conv of two 8-row limb slices -> 15 rows (kernel-safe:
+    static slices + concat only)."""
+    acc = None
+    for i in range(8):
+        row = _pad_rows_k(a[i:i + 1] * b, i, 7 - i, lanes_shape)
+        acc = row if acc is None else acc + row
+    return acc                                   # (15, *batch)
+
+
+def _kara_combine(z0, z1s, z2, half: int, lanes_shape):
+    """Karatsuba recombine: z0 + x^half*(z1s - z0 - z2) + x^(2*half)*z2
+    where z1s = conv(a0+a1, b0+b1). Returns 4*half - 1 rows."""
+    n = 2 * half - 1
+    z1 = z1s - z0 - z2
+    total = 4 * half - 1
+    return (_pad_rows_k(z0, 0, total - n, lanes_shape)
+            + _pad_rows_k(z1, half, total - half - n, lanes_shape)
+            + _pad_rows_k(z2, 2 * half, total - 2 * half - n, lanes_shape))
+
+
+def _kara_conv16(a, b, lanes_shape):
+    """15+1-row-split Karatsuba conv of 16-row slices -> 31 rows."""
+    a0, a1 = a[:8], a[8:]
+    b0, b1 = b[:8], b[8:]
+    z0 = _conv8(a0, b0, lanes_shape)
+    z2 = _conv8(a1, b1, lanes_shape)
+    zs = _conv8(a0 + a1, b0 + b1, lanes_shape)
+    return _kara_combine(z0, zs, z2, 8, lanes_shape)
+
+
+def _kara_conv32(a, b, lanes_shape):
+    """Two-level Karatsuba conv of 32-row limb arrays -> 63 rows."""
+    a0, a1 = a[:16], a[16:]
+    b0, b1 = b[:16], b[16:]
+    z0 = _kara_conv16(a0, b0, lanes_shape)
+    z2 = _kara_conv16(a1, b1, lanes_shape)
+    zs = _kara_conv16(a0 + a1, b0 + b1, lanes_shape)
+    return _kara_combine(z0, zs, z2, 16, lanes_shape)
+
+
+def fe_mul_karatsuba(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply via two-level Karatsuba: 576 limb products vs the
+    schoolbook's 1024, at the cost of ~650 extra adds — a win exactly
+    when the VPU's int32 multiply costs >~3x an add (decided on-chip by
+    scripts/kernel_probe.py; dispatched by backend.use_karatsuba).
+
+    Bound analysis (inputs |limb| <= 1024, the public-op invariant):
+    level sums <= 2048 (L1) / 4096 (L2); conv8 terms <= 8*4096^2 =
+    2^27; L2 recombine |z1| <= 2^27 + 2*2^25.3 < 2^27.7; L1 recombine
+    rows <= 2^26 + 2^28.2 + 2^26 < 2^28.6 — inside int32. One
+    vectorized plain carry pass bounds rows by 255 + 2^20.6 before the
+    38-fold (<= 39 * 2^20.6 + ... < 2^26), then three wrap passes
+    restore |limb| <= 512 (pass3 tops out ~450, same argument as
+    fe_mul's 4-pass analysis).
+    """
+    lanes_shape = a.shape[1:]
+    c = _kara_conv32(a, b, lanes_shape)          # (63, *batch)
+    # Plain local carry (no wrap): 63 -> 64 rows, values <= 255 + 2^20.6.
+    lo = c & _MASK
+    hi = c >> LIMB_BITS
+    z1 = jnp.zeros((1,) + lanes_shape, jnp.int32)
+    c64 = (jnp.concatenate([lo, z1], axis=0)
+           + jnp.concatenate([z1, hi], axis=0))  # (64, *batch)
+    # Fold rows 32..63 back with weight 38 (2^256 = 38 mod p).
+    r = c64[:NLIMBS] + 38 * c64[NLIMBS:]
+    return _carry_pass(r, 3)
+
+
+def fe_mul_kernel(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """The multiply used INSIDE Pallas kernels: schoolbook
+    (fe_mul_unrolled) by default, Karatsuba under FD_MUL_IMPL=karatsuba
+    (decided at trace time; see backend.use_karatsuba)."""
+    from .backend import use_karatsuba
+
+    if use_karatsuba():
+        return fe_mul_karatsuba(a, b)
+    return fe_mul_unrolled(a, b)
+
+
 def fe_sq(a: jnp.ndarray) -> jnp.ndarray:
     """Specialized squaring: 528 limb products vs fe_mul's 1024.
 

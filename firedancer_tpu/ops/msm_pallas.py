@@ -40,33 +40,33 @@ def _madd_niels(p, q_niels):
     (yp = y+x, ym = y-x, t2d = 2d*t), q.Z == 1. 7 field muls."""
     x1, y1, z1, t1 = p
     yp2, ym2, t2d2 = q_niels
-    a = fe.fe_mul_unrolled(fe.fe_sub(y1, x1), ym2)
-    b = fe.fe_mul_unrolled(fe.fe_add(y1, x1), yp2)
-    c = fe.fe_mul_unrolled(t1, t2d2)
+    a = fe.fe_mul_kernel(fe.fe_sub(y1, x1), ym2)
+    b = fe.fe_mul_kernel(fe.fe_add(y1, x1), yp2)
+    c = fe.fe_mul_kernel(t1, t2d2)
     d = fe.fe_add(z1, z1)
     e = fe.fe_sub(b, a)
     f = fe.fe_sub(d, c)
     g = fe.fe_add(d, c)
     h = fe.fe_add(b, a)
-    return (fe.fe_mul_unrolled(e, f), fe.fe_mul_unrolled(g, h),
-            fe.fe_mul_unrolled(f, g), fe.fe_mul_unrolled(e, h))
+    return (fe.fe_mul_kernel(e, f), fe.fe_mul_kernel(g, h),
+            fe.fe_mul_kernel(f, g), fe.fe_mul_kernel(e, h))
 
 
 def _point_add_ext(p, q, d2):
     """Unified extended add (9 muls); d2 = limbs of 2d, (NLIMBS, 1)."""
     x1, y1, z1, t1 = p
     x2, y2, z2, t2 = q
-    a = fe.fe_mul_unrolled(fe.fe_sub(y1, x1), fe.fe_sub(y2, x2))
-    b = fe.fe_mul_unrolled(fe.fe_add(y1, x1), fe.fe_add(y2, x2))
-    c = fe.fe_mul_unrolled(fe.fe_mul_unrolled(t1, t2), d2)
-    zz = fe.fe_mul_unrolled(z1, z2)
+    a = fe.fe_mul_kernel(fe.fe_sub(y1, x1), fe.fe_sub(y2, x2))
+    b = fe.fe_mul_kernel(fe.fe_add(y1, x1), fe.fe_add(y2, x2))
+    c = fe.fe_mul_kernel(fe.fe_mul_kernel(t1, t2), d2)
+    zz = fe.fe_mul_kernel(z1, z2)
     d = fe.fe_add(zz, zz)
     e = fe.fe_sub(b, a)
     f = fe.fe_sub(d, c)
     g = fe.fe_add(d, c)
     h = fe.fe_add(b, a)
-    return (fe.fe_mul_unrolled(e, f), fe.fe_mul_unrolled(g, h),
-            fe.fe_mul_unrolled(f, g), fe.fe_mul_unrolled(e, h))
+    return (fe.fe_mul_kernel(e, f), fe.fe_mul_kernel(g, h),
+            fe.fe_mul_kernel(f, g), fe.fe_mul_kernel(e, h))
 
 
 def _identity4(lanes):
@@ -217,8 +217,8 @@ def _point_double_ext(p):
     g = fe.fe_add(d_, b)
     f = fe.fe_sub(g, c)
     h = fe.fe_sub(d_, b)
-    return (fe.fe_mul_unrolled(e, f), fe.fe_mul_unrolled(g, h),
-            fe.fe_mul_unrolled(f, g), fe.fe_mul_unrolled(e, h))
+    return (fe.fe_mul_kernel(e, f), fe.fe_mul_kernel(g, h),
+            fe.fe_mul_kernel(f, g), fe.fe_mul_kernel(e, h))
 
 
 def window_horner_pallas(w_res, d2_col, n_windows: int,

@@ -31,7 +31,7 @@ LANES = 512
 
 
 def _mul(a, b):
-    return fe.fe_mul_unrolled(a, b)
+    return fe.fe_mul_kernel(a, b)
 
 
 def _sq(x):

@@ -40,15 +40,7 @@ def _conv_const(x, weights, n_out: int):
         if rows <= 0:
             break
         term = x[:rows] * np.int32(w)
-        parts = []
-        if j:
-            parts.append(jnp.zeros((j, lanes), jnp.int32))
-        parts.append(term)
-        tail = n_out - j - rows
-        if tail:
-            parts.append(jnp.zeros((tail, lanes), jnp.int32))
-        acc = acc + (parts[0] if len(parts) == 1
-                     else jnp.concatenate(parts, axis=0))
+        acc = acc + fe._pad_rows_k(term, j, n_out - j - rows, (lanes,))
     return acc
 
 
@@ -90,14 +82,7 @@ def _sc_mul_kernel(ain, bin_, out):
     lanes = a.shape[1]
     acc = jnp.zeros((64, lanes), jnp.int32)
     for i in range(32):
-        term = a[i:i + 1] * b                     # (32, L)
-        parts = []
-        if i:
-            parts.append(jnp.zeros((i, lanes), jnp.int32))
-        parts.append(term)
-        if 64 - i - 32:
-            parts.append(jnp.zeros((64 - i - 32, lanes), jnp.int32))
-        acc = acc + jnp.concatenate(parts, axis=0)
+        acc = acc + fe._pad_rows_k(a[i:i + 1] * b, i, 32 - i, (lanes,))
     x, _ = _seq_carry_k(acc)                      # < 2^512 exactly
     out[...] = _barrett_body(x)
 

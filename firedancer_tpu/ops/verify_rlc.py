@@ -170,8 +170,10 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     on_tpu = use_pallas("FD_MSM_IMPL")
     # niels outputs are only consumed by the kernel MSM path, so both
     # backends must be on (a split config would compute and drop them).
+    from .curve_pallas import MIN_KERNEL_BATCH
+
     want_niels = (on_tpu and use_pallas("FD_DECOMPRESS_IMPL")
-                  and 2 * bsz >= 128)
+                  and 2 * bsz >= MIN_KERNEL_BATCH)
     dec = ge.decompress_auto(
         jnp.concatenate([pubkeys, r_bytes], axis=0),
         want_x_zero=True, want_niels=want_niels,
@@ -214,8 +216,11 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     z_live = jnp.where(live[:, None], z_bytes, 0).astype(jnp.uint8)
 
     # m = z*h mod L; u = sum z*s mod L. On the kernel path both
-    # products ride one stacked VMEM Barrett-multiply launch.
-    if on_tpu:
+    # products ride one stacked VMEM Barrett-multiply launch (FD_SC_IMPL
+    # is the escape hatch for ALL scalar-arithmetic kernels, so it
+    # gates this launch too — _sc_mul_kernel shares _barrett_body with
+    # the reduce kernel it would disable).
+    if on_tpu and use_pallas("FD_SC_IMPL"):
         from .sc_pallas import sc_mul_pallas
 
         both_m = sc_mul_pallas(

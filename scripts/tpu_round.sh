@@ -18,6 +18,10 @@ print('matmul:', float((jnp.ones((128,128)) @ jnp.ones((128,128)))[0,0]))
   exit 1
 fi
 
+echo "== kernel probe (mul/add/carry costs; 900s)"
+timeout 900 python -u scripts/kernel_probe.py || \
+  echo "kernel probe failed (continuing)"
+
 echo "== tpu_validate (kernels + RLC timing; 2400s)"
 timeout 2400 python -u scripts/tpu_validate.py 8192 || \
   echo "tpu_validate failed (continuing: bench has its own ladder)"

@@ -124,3 +124,41 @@ def test_constants():
     assert _unpack(fe.FE_D) == [fe.D_INT]
     assert _unpack(fe.FE_SQRT_M1) == [fe.SQRT_M1_INT]
     assert (fe.SQRT_M1_INT**2) % P == P - 1
+
+
+def test_fe_mul_karatsuba_matches_fe_mul():
+    """Two-level Karatsuba vs the schoolbook multiply over the full
+    lazy-carry input range, plus the output-invariant bound."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops import fe25519 as fe
+
+    rng = np.random.RandomState(13)
+    a = rng.randint(-1024, 1025, (32, 300)).astype(np.int32)
+    b = rng.randint(-1024, 1025, (32, 300)).astype(np.int32)
+    a[:, 0] = 1024
+    b[:, 0] = 1024          # worst-case magnitudes
+    a[:, 1] = -1024
+    b[:, 1] = 1024
+    a[:, 2] = 0
+    got = fe.fe_mul_karatsuba(jnp.asarray(a), jnp.asarray(b))
+    want = fe.fe_mul(jnp.asarray(a), jnp.asarray(b))
+    assert fe.limbs_to_int(got) == fe.limbs_to_int(want)
+    assert int(np.abs(np.asarray(got)).max()) <= 512
+
+
+def test_fe_mul_kernel_dispatch(monkeypatch):
+    import numpy as np
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops import fe25519 as fe
+
+    rng = np.random.RandomState(14)
+    a = jnp.asarray(rng.randint(-512, 513, (32, 130)).astype(np.int32))
+    b = jnp.asarray(rng.randint(-512, 513, (32, 130)).astype(np.int32))
+    want = fe.limbs_to_int(fe.fe_mul(a, b))
+    monkeypatch.setenv("FD_MUL_IMPL", "karatsuba")
+    assert fe.limbs_to_int(fe.fe_mul_kernel(a, b)) == want
+    monkeypatch.setenv("FD_MUL_IMPL", "schoolbook")
+    assert fe.limbs_to_int(fe.fe_mul_kernel(a, b)) == want
