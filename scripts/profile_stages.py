@@ -137,6 +137,43 @@ def main():
     t = bench_fn(jax.jit(msm_mod.subgroup_check), (both, u))
     print(f"torsion cert (K=64): {t*1e3:8.3f} ms")
 
+    # --- round-3 kernel suite -------------------------------------------
+    from firedancer_tpu.ops.curve_pallas import (
+        compress_pallas,
+        decompress_pallas,
+    )
+    from firedancer_tpu.ops.sc_pallas import sc_mul_pallas, sc_reduce64_pallas
+    from firedancer_tpu.ops.sha512_pallas import sha512_batch_pallas
+
+    t = bench_fn(jax.jit(sha512_batch_pallas), (msgs, lens))
+    print(f"sha512 kernel:       {t*1e3:8.3f} ms")
+    t = bench_fn(jax.jit(sc_reduce64_pallas),
+                 (jnp.concatenate([sbytes, sbytes], axis=1),))
+    print(f"sc_reduce kernel:    {t*1e3:8.3f} ms")
+    t = bench_fn(jax.jit(sc_mul_pallas), (sbytes, sbytes))
+    print(f"sc_mul kernel:       {t*1e3:8.3f} ms")
+    t = bench_fn(jax.jit(decompress_pallas), (ybytes,))
+    print(f"decompress kernel:   {t*1e3:8.3f} ms")
+    t = bench_fn(jax.jit(compress_pallas), (pt,))
+    print(f"compress kernel:     {t*1e3:8.3f} ms")
+    t = bench_fn(
+        jax.jit(lambda p, u_: msm_mod.subgroup_check_fast(p, u_)), (both, u)
+    )
+    print(f"torsion cert kernel: {t*1e3:8.3f} ms")
+    t = bench_fn(
+        jax.jit(lambda s, p: msm_mod.msm_fast(
+            s, p, n_windows=msm_mod.WINDOWS_253)[0]),
+        (scal253, pt),
+    )
+    print(f"msm_fast [37w]:      {t*1e3:8.3f} ms")
+    # staging alone (sort + gather share): how much of msm_fast is XLA.
+    t = bench_fn(
+        jax.jit(lambda s: msm_mod._staging_indices(
+            s, msm_mod.WINDOWS_253, batch, 140)[0]),
+        (scal253,),
+    )
+    print(f"msm staging (sort):  {t*1e3:8.3f} ms")
+
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Perf sweep for a healthy-tunnel window: A/B the knobs that cannot be
+# decided off-chip. Run AFTER tpu_round.sh has banked a baseline.
+# Strictly sequential (one TPU process at a time); every successful
+# measurement lands in BENCH_LOG.jsonl via the bench ladder.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+run() {
+  local label="$1"; shift
+  echo "== $label"
+  env "$@" FD_BENCH_PROBE_TIMEOUT=60 timeout 1500 python bench.py \
+    || echo "$label failed"
+}
+
+# 1. Karatsuba multiply vs schoolbook (direct mode).
+run "direct schoolbook (baseline re-run)" FD_BENCH_VERIFY=direct
+run "direct karatsuba" FD_BENCH_VERIFY=direct FD_MUL_IMPL=karatsuba
+
+# 2. Batch scaling (Pippenger efficiency + dispatch amortization).
+run "rlc 8k" FD_BENCH_VERIFY=rlc
+run "rlc 16k" FD_BENCH_VERIFY=rlc FD_BENCH_BATCH=16384
+run "rlc 32k" FD_BENCH_VERIFY=rlc FD_BENCH_BATCH=32768 FD_BENCH_REPS=5
+
+# 3. Karatsuba on the rlc path (fills + chains are mul-heavy too).
+run "rlc karatsuba 16k" FD_BENCH_VERIFY=rlc FD_BENCH_BATCH=16384 \
+    FD_MUL_IMPL=karatsuba
+
+echo "== sweep done; log tail:"
+tail -8 BENCH_LOG.jsonl
