@@ -91,6 +91,13 @@ def run_pipeline_supervised(
     """
     import shutil
 
+    # FD_SUP_KEEP_LOGS=<dir>: run out of <dir> and keep the per-tile
+    # logs + pod + result files after the run (post-mortem debugging of
+    # crash/restart scenarios; normally everything is ephemeral).
+    keep = os.environ.get("FD_SUP_KEEP_LOGS")
+    if keep:
+        os.makedirs(keep, exist_ok=True)
+        return _supervised(topo, payloads, keep, **kwargs)
     tmp = tempfile.mkdtemp(prefix="fd_sup_")
     try:
         return _supervised(topo, payloads, tmp, **kwargs)

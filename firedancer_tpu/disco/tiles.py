@@ -517,6 +517,18 @@ class VerifyTile(Tile):
         # value forever (phantom staged work — the exact crash this
         # gauge exists to instrument).
         self._last_unacked = int(self.cnc.diag(CNC_DIAG_UNACKED))
+        # Fault-injection knob (the reference's synth-load style): hold
+        # the tile once, right after its first dispatch, with the
+        # UNACKED gauge freshly published — a deterministic window for
+        # crash tests to SIGKILL a tile that provably holds staged
+        # batches (tests/test_supervisor.py). 0 = disabled (production).
+        self._hold_s = float(
+            os.environ.get("FD_VERIFY_HOLD_AFTER_DISPATCH_S", "0") or 0
+        )
+        # A respawned incarnation (nonzero crash-surviving gauge) must
+        # not hold again: the knob freezes only the first incarnation,
+        # so the post-crash re-read path runs at full speed.
+        self._held = self._last_unacked > 0
         self._verify_batch_fn = None
         # dispatch/completion stats (read by monitor/bench)
         self.stat_batches = 0
@@ -581,16 +593,44 @@ class VerifyTile(Tile):
                     self._verify_batch_fn
                 )
             # Pre-warm: compile the fixed (batch, max_msg_len) shape now so
-            # the run loop never stalls on first-flush compilation (the
-            # persistent jax compilation cache makes this fast after the
-            # first ever build of this shape).
-            out = self._verify_batch_fn(
-                jnp.zeros((batch, max_msg_len), jnp.uint8),
-                jnp.zeros((batch,), jnp.int32),
-                jnp.zeros((batch, 64), jnp.uint8),
-                jnp.zeros((batch, 32), jnp.uint8),
-            )
-            np.asarray(out)  # force both graphs (rlc + fallback) compiled
+            # the run loop never stalls on first-flush compilation. A
+            # compile (or even a compile-cache LOAD) takes minutes on
+            # small hosts and on real TPUs, and a silent heartbeat for
+            # that long reads as "wedged" to the supervisor — which
+            # SIGKILLs the tile and loops the respawn through the same
+            # compile forever. A compiling tile is NOT wedged: keep the
+            # cnc heartbeat alive from a side thread for the duration.
+            def _prewarm():
+                out = self._verify_batch_fn(
+                    jnp.zeros((batch, max_msg_len), jnp.uint8),
+                    jnp.zeros((batch,), jnp.int32),
+                    jnp.zeros((batch, 64), jnp.uint8),
+                    jnp.zeros((batch, 32), jnp.uint8),
+                )
+                np.asarray(out)  # force all graphs (rlc + fallback)
+
+            self._with_live_heartbeat(_prewarm)
+
+    def _with_live_heartbeat(self, fn):
+        """Run a blocking host-side operation (jit compile / cache
+        load) while a daemon thread keeps the cnc heartbeat fresh, so
+        supervision can tell 'compiling' from 'wedged'."""
+        import threading
+
+        stop = threading.Event()
+
+        def beat():
+            while not stop.is_set():
+                self.cnc.heartbeat(tempo.tickcount())
+                stop.wait(1.0)
+
+        t = threading.Thread(target=beat, daemon=True)
+        t.start()
+        try:
+            return fn()
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
 
     def _nd_setup(self) -> None:
         import ctypes
@@ -822,17 +862,21 @@ class VerifyTile(Tile):
         # must still run — the base housekeep minus the in-link fseq
         # publication, which is replaced by the verified cursor above.
         self.cnc.heartbeat(now)
-        unacked = 0
         for il in self.in_links:
             il.fseq.update(min(self._acked_seq, il.seq))
+        self._publish_unacked()
+        self._housekeep_out()
+        self.on_housekeep()
+
+    def _publish_unacked(self) -> None:
+        unacked = 0
+        for il in self.in_links:
             unacked += max(0, il.seq - self._acked_seq)
         if unacked != self._last_unacked:
             self.cnc.diag_add(
                 CNC_DIAG_UNACKED, (unacked - self._last_unacked) & _U64
             )
             self._last_unacked = unacked
-        self._housekeep_out()
-        self.on_housekeep()
 
     def on_housekeep(self) -> None:
         # The housekeeping interval is the latency backstop when the tile
@@ -853,8 +897,17 @@ class VerifyTile(Tile):
     def _dispatch(self, force: bool = False) -> None:
         if self._nd:
             self._dispatch_native(force)
-            return
-        self._dispatch_py(force)
+        else:
+            self._dispatch_py(force)
+        if self._hold_s and not self._held and self._inflight:
+            # Fault-injection hold (see __init__): gauge first, so the
+            # supervisor-side observer is guaranteed to see the staged
+            # work before the window closes. Heartbeats stay live so
+            # the wedge detector doesn't race the test's fault hook for
+            # the kill.
+            self._held = True
+            self._publish_unacked()
+            self._with_live_heartbeat(lambda: time.sleep(self._hold_s))
 
     def _dispatch_py(self, force: bool = False) -> None:
         """Ship pending txns to the device as fixed-shape batches without

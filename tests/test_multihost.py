@@ -13,6 +13,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-process / compile-heavy (pytest.ini)
+
 _WORKER = r"""
 import sys
 sys.path.insert(0, __REPO__)
@@ -35,8 +37,6 @@ assert mesh.devices.shape == (2, 4)
 # Host-sharded batch: every host signs ITS OWN lanes; nothing but the
 # three diag scalars crosses the process boundary.
 from firedancer_tpu.ballet import ed25519 as oracle
-
-pytestmark = pytest.mark.slow  # multi-process / compile-heavy (see pytest.ini)
 
 PER_HOST = 8
 

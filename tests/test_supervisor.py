@@ -37,23 +37,21 @@ def test_supervised_pipeline_end_to_end(tmp_path, corpus):
     assert res.supervisor_restarts == 0
 
 
-def test_crash_midflight_staged_batches_not_lost(tmp_path):
+def test_crash_midflight_staged_batches_not_lost(tmp_path, monkeypatch):
     """Kill the verify tile at the EXACT moment it is holding staged or
     in-flight device batches: the held-back ack cursor must leave every
     consumed-but-unverified txn re-readable, so delivery is still
     content-exact. This is the window a consumed-seq fseq would lose
     txns in.
 
-    Determinism (round-2 VERDICT #4): the trigger is the verify tile's
-    own CNC_DIAG_UNACKED gauge — the count of consumed-but-unverified
-    frags it published from housekeep — crossing a full batch, not a
-    wall-clock race on delivery counts. The gauge cannot pass 0 ->
-    >=batch -> 0 between supervisor polls, because draining it requires
-    the whole first device batch to verify AND a housekeep to publish
-    the acks, which takes orders of magnitude longer than the 50 ms
-    poll; and it is guaranteed to rise because the ring (depth 128)
-    holds the whole corpus while the first verify dispatch is still
-    compiling/running."""
+    Determinism (round-2 VERDICT #4, hardened in r3): the tile's
+    fault-injection hold (FD_VERIFY_HOLD_AFTER_DISPATCH_S) freezes the
+    first incarnation right after its first dispatch WITH the UNACKED
+    gauge freshly published, so the kill window is seconds wide by
+    construction — no dependence on compile times or machine speed
+    (the gauge-crossing trigger alone proved racy when a warm compile
+    cache let the whole corpus drain between supervisor polls)."""
+    monkeypatch.setenv("FD_VERIFY_HOLD_AFTER_DISPATCH_S", "30")
     corpus = mainnet_corpus(96, seed=21, dup_rate=0.0, corrupt_rate=0.0,
                             parse_err_rate=0.0, max_data_sz=48)
     batch = 32
@@ -88,7 +86,7 @@ def test_crash_midflight_staged_batches_not_lost(tmp_path):
 
     res = run_pipeline_supervised(
         topo, corpus.payloads, verify_backend="tpu", verify_batch=batch,
-        verify_max_msg_len=512, timeout_s=300.0, fault_hook=fault,
+        verify_max_msg_len=512, timeout_s=900.0, fault_hook=fault,
         record_digests=True, jax_platform="cpu",
     )
     assert state["kills"] == 1
