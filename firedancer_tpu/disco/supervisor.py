@@ -97,6 +97,11 @@ def run_pipeline_supervised(
     keep = os.environ.get("FD_SUP_KEEP_LOGS")
     if keep:
         os.makedirs(keep, exist_ok=True)
+        # A reused keep dir must not leak a previous run's sink result
+        # into this run's PipelineResult (the loader is existence-gated).
+        stale = os.path.join(keep, "sink.json")
+        if os.path.exists(stale):
+            os.unlink(stale)
         return _supervised(topo, payloads, keep, **kwargs)
     tmp = tempfile.mkdtemp(prefix="fd_sup_")
     try:

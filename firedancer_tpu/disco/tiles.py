@@ -66,6 +66,11 @@ CNC_DIAG_SV_FILT_SZ = 5
 # deterministically, when staged device work exists (the crash window
 # the held-back fseq protects).
 CNC_DIAG_UNACKED = 6
+# Fault-injection hold entries (FD_VERIFY_HOLD_AFTER_DISPATCH_S): the
+# deterministic kill trigger for crash tests — UNACKED counts txns
+# while batches fill by signature LANES, so a "staged >= batch" gauge
+# test can miss the hold window on multisig-bearing corpora.
+CNC_DIAG_HOLDS = 7
 
 CTL_SOM_EOM = 3
 
@@ -592,29 +597,25 @@ class VerifyTile(Tile):
                 self._verify_batch_fn = make_async_verifier(
                     self._verify_batch_fn
                 )
-            # Pre-warm: compile the fixed (batch, max_msg_len) shape now so
-            # the run loop never stalls on first-flush compilation. A
-            # compile (or even a compile-cache LOAD) takes minutes on
-            # small hosts and on real TPUs, and a silent heartbeat for
-            # that long reads as "wedged" to the supervisor — which
-            # SIGKILLs the tile and loops the respawn through the same
-            # compile forever. A compiling tile is NOT wedged: keep the
-            # cnc heartbeat alive from a side thread for the duration.
-            def _prewarm():
-                out = self._verify_batch_fn(
-                    jnp.zeros((batch, max_msg_len), jnp.uint8),
-                    jnp.zeros((batch,), jnp.int32),
-                    jnp.zeros((batch, 64), jnp.uint8),
-                    jnp.zeros((batch, 32), jnp.uint8),
-                )
-                np.asarray(out)  # force all graphs (rlc + fallback)
-
-            self._with_live_heartbeat(_prewarm)
+            # Pre-warm: compile the fixed (batch, max_msg_len) shape now
+            # so the run loop never stalls on first-flush compilation.
+            # This can take minutes (cold jit, or even a compile-cache
+            # LOAD on a small host); in the supervised path worker.py's
+            # boot-heartbeat thread keeps the cnc alive throughout, so
+            # the wedge detector does not fire on a compiling tile.
+            out = self._verify_batch_fn(
+                jnp.zeros((batch, max_msg_len), jnp.uint8),
+                jnp.zeros((batch,), jnp.int32),
+                jnp.zeros((batch, 64), jnp.uint8),
+                jnp.zeros((batch, 32), jnp.uint8),
+            )
+            np.asarray(out)  # force all graphs (rlc + fallback)
 
     def _with_live_heartbeat(self, fn):
-        """Run a blocking host-side operation (jit compile / cache
-        load) while a daemon thread keeps the cnc heartbeat fresh, so
-        supervision can tell 'compiling' from 'wedged'."""
+        """Run a blocking host-side operation inside the RUN loop (where
+        worker.py's boot beat no longer covers us) while a daemon thread
+        keeps the cnc heartbeat fresh, so supervision can tell 'held /
+        busy' from 'wedged'. Used by the fault-injection hold."""
         import threading
 
         stop = threading.Event()
@@ -907,6 +908,7 @@ class VerifyTile(Tile):
             # the kill.
             self._held = True
             self._publish_unacked()
+            self.cnc.diag_add(CNC_DIAG_HOLDS, 1)
             self._with_live_heartbeat(lambda: time.sleep(self._hold_s))
 
     def _dispatch_py(self, force: bool = False) -> None:

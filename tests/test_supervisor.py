@@ -70,17 +70,23 @@ def test_crash_midflight_staged_batches_not_lost(tmp_path, monkeypatch):
     ).compile()
     topo = build_topology(str(tmp_path / "mid.wksp"), depth=128)
     state = {"kills": 0}
-    from firedancer_tpu.disco.tiles import CNC_DIAG_UNACKED
+    from firedancer_tpu.disco.tiles import CNC_DIAG_HOLDS, CNC_DIAG_UNACKED
     from firedancer_tpu.tango.rings import Cnc, Workspace
 
     wksp = Workspace.join(topo.wksp_path)
     verify_cnc = Cnc(wksp, topo.pod.query_cstr("firedancer.verify.cnc"))
 
     def fault(tiles, elapsed):
+        # Kill on the HOLD gauge, not "UNACKED >= batch": UNACKED
+        # counts txns while the 32-slot batch fills by signature
+        # lanes, so a multisig-bearing corpus can dispatch with fewer
+        # than `batch` txns consumed and the lane-blind threshold
+        # would miss the hold window entirely.
         tp = tiles["verify"]
-        staged = verify_cnc.diag(CNC_DIAG_UNACKED)
+        holding = verify_cnc.diag(CNC_DIAG_HOLDS)
         if (state["kills"] == 0 and tp.proc.poll() is None
-                and staged >= batch):
+                and holding >= 1):
+            state["staged_at_kill"] = verify_cnc.diag(CNC_DIAG_UNACKED)
             os.kill(tp.proc.pid, signal.SIGKILL)
             state["kills"] += 1
 
@@ -90,6 +96,8 @@ def test_crash_midflight_staged_batches_not_lost(tmp_path, monkeypatch):
         record_digests=True, jax_platform="cpu",
     )
     assert state["kills"] == 1
+    # The kill provably happened while txns were consumed-but-unverified.
+    assert state["staged_at_kill"] >= 1
     assert res.supervisor_restarts >= state["kills"]
     assert res.recv_cnt == corpus.n_unique_ok, res.diag
     from firedancer_tpu.disco.corpus import sink_mismatch_count
