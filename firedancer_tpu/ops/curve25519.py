@@ -47,13 +47,14 @@ def _pow_auto():
 
 def decompress_xla(y_bytes: jnp.ndarray, want_x_zero: bool = False):
     """XLA decompress with the optional x==0-mod-p mask — the shared
-    fallback for decompress_auto and decompress_pallas's sub-tile path
-    (one place for the caveat that the mask is only meaningful for
-    ok lanes: here failed lanes report the identity's x == 0, the
-    kernel reports the pre-poison x)."""
-    pt, ok = decompress(y_bytes)
+    fallback for decompress_auto and decompress_pallas's sub-tile path.
+    The mask is computed on the PRE-poison decompressed x (failed lanes
+    report that candidate x, not the identity's 0), bit-identical to the
+    kernel path, so callers see one semantics across FD_DECOMPRESS_IMPL."""
     if want_x_zero:
-        return pt, ok, fe.fe_is_zero(pt[0])
+        pt, ok, x_pre = decompress(y_bytes, want_x_pre=True)
+        return pt, ok, fe.fe_is_zero(x_pre)
+    pt, ok = decompress(y_bytes)
     return pt, ok
 
 
@@ -148,13 +149,14 @@ def point_select(mask, p, q):
     return tuple(fe.fe_select(mask, a, b) for a, b in zip(p, q))
 
 
-def decompress(y_bytes: jnp.ndarray):
+def decompress(y_bytes: jnp.ndarray, want_x_pre: bool = False):
     """Batch point decompression, donna semantics (ref fd_ed25519_ge.c:242).
 
     y_bytes: (*batch, 32) uint8 encodings.
     Returns ((X, Y, Z, T), ok_mask). Failed lanes carry the identity point
     (harmless poison) with ok=False. Accepts non-canonical y and x==0 with
-    either sign, exactly like the reference.
+    either sign, exactly like the reference. want_x_pre=True appends the
+    pre-poison x limbs (what the Pallas kernel's x==0 mask is computed on).
     """
     sign = (y_bytes[..., 31] >> 7).astype(jnp.int32)          # (*batch,)
     y = fe.fe_from_bytes(y_bytes, mask_high_bit=True)
@@ -180,7 +182,10 @@ def decompress(y_bytes: jnp.ndarray):
 
     t = fe.fe_mul(x, y)
     pt = (x, y, z, t)
-    return point_select(ok, pt, identity(y.shape[1:])), ok
+    sel = point_select(ok, pt, identity(y.shape[1:]))
+    if want_x_pre:
+        return sel, ok, x
+    return sel, ok
 
 
 def compress(p) -> jnp.ndarray:

@@ -171,11 +171,10 @@ def mul_by_group_order_pallas(pt, d2_col, bits_col, interpret: bool = False):
         def body(i, r):
             r = _point_double_ext(r)
             added = _point_add_ext(r, base, d2)
-            bit = bits[pl.ds(i, 1), :]              # (1, 1) int32
-            # Single-axis broadcast only (Mosaic cannot broadcast in
-            # sublanes and lanes at once); (1, lanes) then implicit
-            # sublane broadcast inside the arithmetic select.
-            sel = jnp.broadcast_to(bit, (1, lanes))
+            # Scalar select from SMEM: a vector (1, 1) bit would need a
+            # sublane+lane broadcast, which Mosaic rejects; a scalar
+            # broadcasts freely into the arithmetic select.
+            sel = bits[i]
             return tuple(sel * a + (1 - sel) * c
                          for a, c in zip(added, r))
 
@@ -187,9 +186,11 @@ def mul_by_group_order_pallas(pt, d2_col, bits_col, interpret: bool = False):
         oz[...] = r[2]
         ot[...] = r[3]
 
+    from jax.experimental.pallas import tpu as pltpu
+
     spec_fe = pl.BlockSpec((NLIMBS, lanes), lambda: (0, 0))
     spec_d2 = pl.BlockSpec((NLIMBS, 1), lambda: (0, 0))
-    spec_bits = pl.BlockSpec((256, 1), lambda: (0, 0))
+    spec_bits = pl.BlockSpec(memory_space=pltpu.SMEM)
     out_shape = jax.ShapeDtypeStruct((NLIMBS, lanes), jnp.int32)
     x, y, z, t = pl.pallas_call(
         kern,
@@ -197,7 +198,7 @@ def mul_by_group_order_pallas(pt, d2_col, bits_col, interpret: bool = False):
         out_specs=[spec_fe] * 4,
         out_shape=[out_shape] * 4,
         interpret=interpret,
-    )(*pt, d2_col, bits_col)
+    )(*pt, d2_col, bits_col.reshape(-1))
     if kpad:
         x, y, z, t = (c[:, :k] for c in (x, y, z, t))
     return (x, y, z, t)

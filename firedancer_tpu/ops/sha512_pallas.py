@@ -136,6 +136,16 @@ def sha512_batch_pallas(msgs: jnp.ndarray, lengths: jnp.ndarray,
         return s.sha512_batch(msgs, lengths)
     lb = bsz // SUB
     max_blocks = (max_len + 17 + 127) // 128
+    # VMEM guard: the single-block kernel pins all max_blocks*16 (hi, lo)
+    # message word pairs plus the fully unrolled 80-entry schedule per
+    # block in VMEM. Estimate that footprint (4 B words; x2 for Mosaic
+    # temporaries) and fall back to the XLA path rather than die with an
+    # opaque Mosaic OOM on large (batch, max_msg_len) combinations.
+    vmem_est = (2 * 16 * max_blocks * bsz * 4      # hi + lo inputs
+                + 80 * 2 * bsz * 4                 # unrolled schedule
+                + 16 * 2 * bsz * 4) * 2            # state + slack
+    if vmem_est > 64 * 1024 * 1024:
+        return s.sha512_batch(msgs, lengths)
     lengths = lengths.astype(jnp.int32)
 
     # Padded buffer (total, B) — identical construction to the XLA path.

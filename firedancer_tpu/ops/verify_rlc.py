@@ -54,6 +54,8 @@ RLC pass (~0.4x a direct pass); the clean-traffic common case runs
 
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -215,12 +217,12 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     live = ~definite
     z_live = jnp.where(live[:, None], z_bytes, 0).astype(jnp.uint8)
 
-    # m = z*h mod L; u = sum z*s mod L. On the kernel path both
-    # products ride one stacked VMEM Barrett-multiply launch (FD_SC_IMPL
-    # is the escape hatch for ALL scalar-arithmetic kernels, so it
-    # gates this launch too — _sc_mul_kernel shares _barrett_body with
-    # the reduce kernel it would disable).
-    if on_tpu and use_pallas("FD_SC_IMPL"):
+    # m = z*h mod L; u = sum z*s mod L. FD_SC_IMPL=pallas opts ALL
+    # scalar-arithmetic into the stacked VMEM Barrett kernels; the
+    # default is the XLA graph (round-4 v5e measurement: the Barrett
+    # kernel loses ~3x to XLA on these short scalar chains), matching
+    # sc25519.sc_reduce64_auto so the two launches never mix backends.
+    if on_tpu and os.environ.get("FD_SC_IMPL") == "pallas":
         from .sc_pallas import sc_mul_pallas
 
         both_m = sc_mul_pallas(
@@ -250,7 +252,11 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     # niels forms from the decompress kernel: the negated point's form
     # is the coordinate swap (ym, yp, t2dn); the single B lane's form
     # is three tiny XLA ops.
-    kw_r = kw_m = kw_sub = {}
+    # Separate dict literals: chained assignment would alias one object
+    # and let a future in-place mutation leak across the three kwargs.
+    kw_r = {}
+    kw_m = {}
+    kw_sub = {}
     if both_niels is not None and on_tpu:
         yp, ym, t2d, t2dn = both_niels
         kw_r = {"niels": (ym[:, bsz:], yp[:, bsz:], t2dn[:, bsz:])}

@@ -1,0 +1,98 @@
+"""Dissect the decompress kernel's cost on-chip.
+
+Round-4 finding: decompress_pallas measured 68.6 ms at B=8192 while the
+bare pow22523 chain measures ~0.06 ms (suspiciously fast) — the gap must
+live in the body: the _canonicalize_k-based masks (fe_is_zero_k /
+fe_parity_k) run ~160 SEQUENTIAL (1, L) row ops each, a shape Mosaic
+pads/relayouts per step. Times each suspect with a host pull
+(np.asarray) so tunnel-side laziness can't fake a number.
+Run: python scripts/decompress_probe.py [batch]
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def bench(fn, args, reps=5, warmup=2):
+    """Wall time per rep with a full host pull of one output element."""
+    for _ in range(warmup):
+        out = fn(*args)
+    first = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(first)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0])
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    print(f"device={jax.devices()[0]} batch={batch}", flush=True)
+
+    from jax.experimental import pallas as pl
+
+    from firedancer_tpu.ops import fe25519 as fe
+    from firedancer_tpu.ops.pow_pallas import pow22523_chain
+    from firedancer_tpu.ops.curve_pallas import decompress_pallas
+
+    NL = fe.NLIMBS
+    rng = np.random.RandomState(0)
+    limbs = jnp.asarray(rng.randint(0, 256, (NL, batch), dtype=np.int32))
+    ybytes = jnp.asarray(rng.randint(0, 256, (batch, 32), dtype=np.uint8))
+
+    def chain_kernel(lanes):
+        def kern(zin, out):
+            out[...] = pow22523_chain(zin[...])
+        n = batch // lanes
+        spec = pl.BlockSpec((NL, lanes), lambda i: (0, i))
+        return jax.jit(lambda z: pl.pallas_call(
+            kern, grid=(n,), in_specs=[spec], out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((NL, batch), jnp.int32))(z))
+
+    f = chain_kernel(512)
+    t = bench(f, (limbs,))
+    print(f"pow22523 chain LANES=512:   {t*1e3:8.3f} ms", flush=True)
+    # correctness spot-check vs the XLA chain (4 lanes)
+    small = np.asarray(limbs[:, :512])
+    got = np.asarray(f(jnp.asarray(small)))[:, :4]
+    want = np.asarray(fe.fe_pow22523(jnp.asarray(small[:, :4])))
+    import firedancer_tpu.ops.fe25519 as _fe
+    ok = _fe.limbs_to_int(got) == _fe.limbs_to_int(want)
+    print(f"pow22523 chain correct:     {ok}", flush=True)
+
+    # canonicalize-style masks: the suspects inside the decompress body
+    def mask_kernel(n_masks):
+        def kern(zin, out):
+            z = zin[...]
+            acc = fe.fe_is_zero_k(z)
+            for _ in range(n_masks - 1):
+                acc = acc + fe.fe_is_zero_k(z + acc)
+            out[...] = acc
+        lanes = 512
+        n = batch // lanes
+        spec = pl.BlockSpec((NL, lanes), lambda i: (0, i))
+        ospec = pl.BlockSpec((1, lanes), lambda i: (0, i))
+        return jax.jit(lambda z: pl.pallas_call(
+            kern, grid=(n,), in_specs=[spec], out_specs=ospec,
+            out_shape=jax.ShapeDtypeStruct((1, batch), jnp.int32))(z))
+
+    for n_masks in (1, 3):
+        t = bench(mask_kernel(n_masks), (limbs,))
+        print(f"fe_is_zero_k x{n_masks} kernel:     {t*1e3:8.3f} ms", flush=True)
+
+    t = bench(jax.jit(functools.partial(decompress_pallas)), (ybytes,))
+    print(f"decompress kernel (512):    {t*1e3:8.3f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()
