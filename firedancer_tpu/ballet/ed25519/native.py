@@ -1,0 +1,93 @@
+"""ctypes bindings for the native (C++) Ed25519 CPU verify.
+
+The production CPU fallback (BASELINE: "fd_ed25519_verify kept as the
+CPU fallback"): `native/ed25519_cpu.cc` — from-scratch radix-2^51
+field arithmetic + vartime wNAF double-scalar-mult, >=10k verifies/s
+per core with no asm. Status codes match ops/verify.py
+(0 / -1 ERR_SIG / -2 ERR_PUBKEY / -3 ERR_MSG), and the Python oracle
+(ballet.ed25519.oracle) remains the semantic reference the
+differential tests pin this against.
+
+`available()` gates on the shared library having been built
+(native/Makefile -> build/libfdtango.so); callers fall back to the
+oracle when it is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Iterable, Sequence
+
+_LIB = None
+_TRIED = False
+
+
+def _find_lib():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    path = os.path.join(root, "build", "libfdtango.so")
+    try:
+        lib = ctypes.CDLL(path)
+        lib.fd_ed25519_cpu_verify1.restype = ctypes.c_int
+        lib.fd_ed25519_cpu_verify1.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint32, ctypes.c_char_p,
+            ctypes.c_char_p,
+        ]
+        lib.fd_ed25519_cpu_verify_batch.restype = None
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return _find_lib() is not None
+
+
+def verify(msg: bytes, sig: bytes, pub: bytes) -> int:
+    """Single verify via the native path; raises if unavailable."""
+    lib = _find_lib()
+    if lib is None:
+        raise RuntimeError("native ed25519 library not built")
+    return lib.fd_ed25519_cpu_verify1(msg, len(msg), sig, pub)
+
+
+def verify_items(items: Sequence[tuple[bytes, bytes, bytes]]) -> list[int]:
+    """Batch verify [(sig, pub, msg), ...] -> status list. Uses the
+    native batch entry point with one C call when available; falls
+    back to the Python oracle otherwise."""
+    lib = _find_lib()
+    if lib is None:
+        from . import oracle
+
+        return [oracle.verify(msg, sig, pub) for (sig, pub, msg) in items]
+    import numpy as np
+
+    n = len(items)
+    if n == 0:
+        return []
+    stride = max((len(m) for (_, _, m) in items), default=0)
+    stride = max(stride, 1)
+    msgs = np.zeros((n, stride), np.uint8)
+    lens = np.zeros(n, np.uint32)
+    sigs = np.zeros((n, 64), np.uint8)
+    pubs = np.zeros((n, 32), np.uint8)
+    for i, (sig, pub, msg) in enumerate(items):
+        if msg:
+            msgs[i, : len(msg)] = np.frombuffer(msg, np.uint8)
+        lens[i] = len(msg)
+        sigs[i] = np.frombuffer(sig, np.uint8)
+        pubs[i] = np.frombuffer(pub, np.uint8)
+    status = np.zeros(n, np.int32)
+    lib.fd_ed25519_cpu_verify_batch(
+        msgs.ctypes.data_as(ctypes.c_void_p), ctypes.c_uint32(stride),
+        lens.ctypes.data_as(ctypes.c_void_p),
+        sigs.ctypes.data_as(ctypes.c_void_p),
+        pubs.ctypes.data_as(ctypes.c_void_p),
+        status.ctypes.data_as(ctypes.c_void_p), ctypes.c_uint32(n))
+    return status.tolist()

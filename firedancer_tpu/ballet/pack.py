@@ -21,7 +21,45 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import math
+import random
 from dataclasses import dataclass, field
+
+
+def compare_worse(rewards_a: int, cus_a: int, rewards_b: int, cus_b: int) -> bool:
+    """True iff a's rewards/compute is strictly worse than b's, by integer
+    cross-multiplication (the reference's COMPARE_WORSE, fd_pack.c:85 —
+    exact, no float rounding at the priority boundary)."""
+    return rewards_a * cus_b < rewards_b * cus_a
+
+
+def _heap_remove_at(heap: list, i: int) -> None:
+    """Remove heap[i] in O(log n): swap in the last element and restore
+    the invariant locally (CPython's heapq removal idiom) instead of a
+    full O(n) heapify."""
+    heap[i] = heap[-1]
+    heap.pop()
+    if i < len(heap):
+        heapq._siftup(heap, i)
+        heapq._siftdown(heap, 0, i)
+
+
+def _evict_bottom_half(heap: list, rng: random.Random, txn: PackTxn) -> bool:
+    """The reference's overload rule (fd_pack.c:383-399): pick a random
+    victim from the bottom half of the heap array (leaf-heavy —
+    expected-worst candidates without a full scan) and evict it iff the
+    incoming txn is strictly better by integer cross-multiplication.
+    Returns True when a slot was freed, False when the incoming txn
+    should be dropped. Shared by Pack and PackTimed so the rule cannot
+    diverge between the streaming and timed schedulers."""
+    sz = len(heap)
+    victim_idx = sz // 2 + rng.randrange(max(sz - sz // 2, 1))
+    _, _, victim = heap[victim_idx]
+    if not compare_worse(victim.rewards, victim.est_cus,
+                         txn.rewards, txn.est_cus):
+        return False
+    _heap_remove_at(heap, victim_idx)
+    return True
 
 
 @dataclass(frozen=True)
@@ -39,34 +77,104 @@ class PackTxn:
         return self.rewards / max(self.est_cus, 1)
 
 
+class EstTbl:
+    """Sliding-window mean/variance histogram over tagged data — the
+    fd_est_tbl analog (reference src/ballet/pack/fd_est_tbl.h).
+
+    Tags hash onto a power-of-two bin array (aliasing is intentional: a
+    never-seen tag lands on a bin whose estimate approximates the global
+    mean). Each bin keeps EMA numerators for x and x^2 plus paired
+    denominators d and d2, so
+        mean = x / d,   var = (d*x2 - x^2) / (d^2 - d2)
+    with a default mean (variance 0) for empty bins. ema_coeff is
+    1 - 1/history, matching the reference's window tuning.
+    """
+
+    def __init__(self, bin_cnt: int = 1024, history: int = 512,
+                 default_val: float = 200_000.0):
+        if bin_cnt <= 0 or bin_cnt & (bin_cnt - 1):
+            raise ValueError("bin_cnt must be a power of two")
+        if history <= 0:
+            raise ValueError("history must be positive")
+        self._mask = bin_cnt - 1
+        self._coeff = 1.0 - 1.0 / history
+        self.default_val = float(default_val)
+        # bins: [x, x2, d, d2] per bin
+        self._bins = [[0.0, 0.0, 0.0, 0.0] for _ in range(bin_cnt)]
+
+    @staticmethod
+    def tag(program_key: bytes, first_instr_byte: int = 0) -> int:
+        """Tag = hash of the program id's first 15 bytes + the first
+        instruction-data byte (the reference's word1/word2 mix,
+        fd_pack.c:305-310, re-expressed over Python ints)."""
+        w1 = int.from_bytes(program_key[:8].ljust(8, b"\0"), "little")
+        w2 = int.from_bytes(program_key[8:16].ljust(8, b"\0"), "little")
+        w2 = (w2 & 0xFFFFFFFFFFFFFF00) ^ (first_instr_byte & 0xFF)
+        h = (w1 * 0x9E3779B97F4A7C15) ^ (w2 * 0xC2B2AE3D27D4EB4F)
+        h &= (1 << 64) - 1
+        return h ^ (h >> 32)
+
+    def estimate(self, tag: int) -> tuple[float, float]:
+        """(mean, variance) for this tag's bin; (default_val, 0) when
+        the bin has no data."""
+        x, x2, d, d2 = self._bins[tag & self._mask]
+        if not d > 0.0:
+            return self.default_val, 0.0
+        mean = x / d
+        denom = d * d - d2
+        var = (d * x2 - x * x) / denom if denom > 0.0 else 0.0
+        return mean, max(var, 0.0)
+
+    def update(self, tag: int, value: float) -> None:
+        b = self._bins[tag & self._mask]
+        c = self._coeff
+        b[0] = value + c * b[0]
+        b[1] = value * value + c * b[1]
+        b[2] = 1.0 + c * b[2]
+        b[3] = 1.0 + c * c * b[3]
+
+
 class CuEstimator:
-    """Per-program EMA of observed compute units (fd_est_tbl analog)."""
+    """Per-program CU estimator over an EstTbl histogram (fd_est_tbl
+    analog; was a flat dict-EMA through round 3 — the histogram gives
+    bounded memory, sliding-window variance, and the reference's
+    alias-to-global-mean behavior for unseen programs)."""
 
     DEFAULT = 200_000
-    ALPHA = 0.25
 
-    def __init__(self):
-        self._ema: dict[bytes, float] = {}
+    def __init__(self, bin_cnt: int = 1024, history: int = 512):
+        self._tbl = EstTbl(bin_cnt=bin_cnt, history=history,
+                           default_val=float(self.DEFAULT))
 
     def estimate(self, program_keys) -> int:
-        total = 0
+        mean, _ = self.estimate_with_variance(program_keys)
+        return max(int(0.5 + mean), 1)
+
+    def estimate_with_variance(self, program_keys) -> tuple[float, float]:
+        """Summed (mean, variance) across instructions' programs —
+        variances add under the reference's independence assumption."""
+        total = 0.0
+        var = 0.0
         for k in program_keys:
-            total += int(self._ema.get(k, self.DEFAULT))
-        return max(total, 1)
+            m, v = self._tbl.estimate(EstTbl.tag(k))
+            total += m
+            var += v
+        return total, var
 
     def observe(self, program_key: bytes, actual_cus: int) -> None:
-        prev = self._ema.get(program_key, float(self.DEFAULT))
-        self._ema[program_key] = (1 - self.ALPHA) * prev + self.ALPHA * actual_cus
+        self._tbl.update(EstTbl.tag(program_key), float(actual_cus))
 
 
 class Pack:
     """Bounded pending heap + per-bank in-flight lock tracking."""
 
     def __init__(self, bank_cnt: int, depth: int = 4096,
-                 max_cu_per_bank: int = 12_000_000):
+                 max_cu_per_bank: int = 12_000_000,
+                 rng: random.Random | None = None):
         self.bank_cnt = bank_cnt
         self.depth = depth
         self.max_cu_per_bank = max_cu_per_bank
+        self._rng = rng or random.Random(0x5ACC)
         self._heap: list[tuple[float, int, PackTxn]] = []  # (-score, seq, txn)
         self._seq = itertools.count()
         self._inflight: list[dict[int, PackTxn]] = [dict() for _ in range(bank_cnt)]
@@ -86,16 +194,18 @@ class Pack:
         return sum(len(b) for b in self._inflight)
 
     def insert(self, txn: PackTxn) -> bool:
-        """Queue a transaction; evicts the worst if at depth. False = dropped."""
+        """Queue a transaction; when the heap is full, pick a random
+        victim from the bottom half of the heap array (leaf-heavy —
+        expected-worst candidates without a full scan) and replace it
+        iff the new txn is strictly better, else drop the new txn.
+        This is the reference's overload rule (fd_pack.c:383-399:
+        victim_idx in [sz/2, sz), COMPARE_WORSE by integer
+        cross-multiplication). Returns False when dropped."""
         self.insert_cnt += 1
         if len(self._heap) >= self.depth:
-            worst_idx = max(range(len(self._heap)), key=lambda i: self._heap[i][0])
-            if -self._heap[worst_idx][0] >= txn.score:
+            if not _evict_bottom_half(self._heap, self._rng, txn):
                 self.drop_cnt += 1
                 return False
-            self._heap[worst_idx] = self._heap[-1]
-            self._heap.pop()
-            heapq.heapify(self._heap)
             self.drop_cnt += 1
         heapq.heappush(self._heap, (-txn.score, next(self._seq), txn))
         return True
@@ -159,6 +269,230 @@ class Pack:
     def end_block(self):
         """Reset per-block CU budgets (locks persist only via in-flight)."""
         self._bank_cu = [0] * self.bank_cnt
+
+
+@dataclass(frozen=True)
+class ScheduledTxn:
+    """A scheduling decision: txn starts on bank at time start (CU
+    ticks) — the fd_pack_scheduled_txn_t analog."""
+
+    txn: PackTxn
+    bank: int
+    start: int
+
+
+class PackTimed:
+    """Time-based block scheduler — the close analog of the reference's
+    fd_pack_schedule_next (fd_pack.c:404-545): banks and accounts carry
+    in_use_until times in CU ticks, the best candidate is chosen by
+    rewards/(compute + stall) via integer cross-multiplication over a
+    bounded search depth, read-after-write hazards stall the bank
+    instead of scheduling, and future-start decisions park in a
+    min-heap outq keyed by start time until a bank's clock reaches
+    them.
+
+    Differences from the streaming `Pack` (kept for the pack tile):
+    this models the reference's CU-clock semantics — write locks expire
+    at a TIME rather than at an explicit complete() call — which is
+    what makes its overload behavior (stalls, cu_limit refusal)
+    testable against the reference's rules.
+
+    Insert-side capacity semantics (fd_pack_insert_txn_fini,
+    fd_pack.c:350-399): drop txns whose estimate exceeds cu_limit,
+    perturb compute_est by a clamped Gaussian on the estimator
+    variance, and evict a random bottom-half victim when full.
+    """
+
+    MAX_SEARCH_DEPTH = 64
+
+    def __init__(self, bank_cnt: int, depth: int = 4096,
+                 cu_limit: int = 12_000_000,
+                 rng: random.Random | None = None):
+        self.bank_cnt = bank_cnt
+        self.depth = depth
+        self.cu_limit = cu_limit
+        self._rng = rng or random.Random(0x7AC7)
+        self._seq = itertools.count()
+        # Pending max-heap as an explicit array (heapq is a min-heap on
+        # (-score, seq)); the array layout is what gives the
+        # bottom-half victim rule its meaning.
+        self._heap: list[tuple[float, int, PackTxn]] = []
+        self._bank_until = [0] * bank_cnt      # in_use_until per bank
+        self._bank_done = [False] * bank_cnt
+        self._w_until: dict[bytes, int] = {}   # acct -> write in_use_until
+        self._r_until: dict[bytes, int] = {}   # acct -> read in_use_until
+        self._outq: list[tuple[int, int, ScheduledTxn]] = []  # (start, seq, s)
+        self.insert_cnt = 0
+        self.drop_cnt = 0
+        self.schedule_cnt = 0
+        self.stall_cnt = 0
+
+    def pending_cnt(self) -> int:
+        return len(self._heap)
+
+    def insert(self, txn: PackTxn, compute_var: float = 0.0,
+               compute_max: int | None = None) -> bool:
+        """Queue with the reference's insert-time capacity rules.
+        Returns False when dropped (oversized or lost the eviction
+        coin-flip)."""
+        self.insert_cnt += 1
+        if compute_var > 0.0:
+            # delta ~ N(0, (0.25*sqrt(var))^2), clamped so est stays in
+            # [1, compute_max] (fd_pack.c:374-379).
+            delta = int(0.5 + self._rng.gauss(0.0, 1.0)
+                        * 0.25 * math.sqrt(compute_var))
+            cmax = compute_max if compute_max is not None else txn.est_cus
+            delta = max(1 - txn.est_cus, min(cmax - txn.est_cus, delta))
+            txn = PackTxn(txn.txn_id, txn.rewards, txn.est_cus + delta,
+                          txn.writable, txn.readonly)
+        # Size gate AFTER the perturbation: a perturbed estimate at or
+        # above cu_limit could never schedule and would squat in the
+        # search window forever.
+        if txn.est_cus >= self.cu_limit:
+            self.drop_cnt += 1
+            return False
+        if len(self._heap) >= self.depth:
+            if not _evict_bottom_half(self._heap, self._rng, txn):
+                self.drop_cnt += 1
+                return False
+            self.drop_cnt += 1
+        heapq.heappush(self._heap, (-txn.score, next(self._seq), txn))
+        return True
+
+    def _pick_bank(self) -> int | None:
+        """First non-done bank with the smallest in_use_until clock.
+        Banks whose clock has reached cu_limit can never schedule again
+        and are marked done here — otherwise a clock landing exactly on
+        cu_limit would be neither pickable nor done and drain would
+        spin without ever flushing parked outq decisions."""
+        best, best_until = None, self.cu_limit
+        for i in range(self.bank_cnt):
+            if self._bank_done[i]:
+                continue
+            if self._bank_until[i] >= self.cu_limit:
+                self._bank_done[i] = True
+                continue
+            if self._bank_until[i] < best_until:
+                best, best_until = i, self._bank_until[i]
+        return best
+
+    def schedule_next(self) -> ScheduledTxn | None:
+        """One reference-shaped scheduling step. Returns a decision
+        whose start time has arrived, or None (bank stalled / nothing
+        schedulable / everything done)."""
+        t = self._pick_bank()
+        if t is None:
+            return None
+        now = self._bank_until[t]
+
+        # Emit any parked decision whose start time has arrived.
+        if self._outq and self._outq[0][0] <= now:
+            _, _, sched = heapq.heappop(self._outq)
+            return sched
+
+        best = None
+        best_q = None
+        best_stall = 0
+        best_raw = None
+        best_would_raw = False
+        limit = min(self.MAX_SEARCH_DEPTH, len(self._heap))
+        for q in range(limit):
+            _, _, cand = self._heap[q]
+            start_at = now
+            for k in cand.writable:
+                start_at = max(start_at, self._w_until.get(k, 0),
+                               self._r_until.get(k, 0))
+            would_raw = False
+            for k in cand.readonly:
+                wu = self._w_until.get(k, 0)
+                if wu > start_at:
+                    # Read of an account with a future write scheduled:
+                    # allowed only inside the existing read shadow
+                    # (fd_pack.c:471-483); otherwise stall the bank to
+                    # the write's end.
+                    ru = self._r_until.get(k, 0)
+                    if start_at + cand.est_cus > ru:
+                        would_raw = True
+                        start_at = max(start_at, wu)
+            if start_at + cand.est_cus > self.cu_limit:
+                continue
+            eff_cus = cand.est_cus + (start_at - now)  # charge the stall
+            if best is None or compare_worse(
+                best.rewards, best_raw, cand.rewards, eff_cus
+            ):
+                best = cand
+                best_raw = eff_cus
+                best_q = q
+                best_stall = start_at - now
+                best_would_raw = would_raw
+
+        if best is None:
+            self._bank_done[t] = True
+            return None
+        if best_would_raw:
+            # Stall the bank clock to the hazard horizon; revisit later.
+            self._bank_until[t] += best_stall
+            self.stall_cnt += 1
+            return None
+
+        # Remove best from the heap by index (O(log depth)).
+        _heap_remove_at(self._heap, best_q)
+
+        start = now + best_stall
+        end = start + best.est_cus
+        self._bank_until[t] = end
+        for k in best.writable:
+            self._w_until[k] = end
+        for k in best.readonly:
+            self._r_until[k] = max(self._r_until.get(k, 0), end)
+        self.schedule_cnt += 1
+        sched = ScheduledTxn(best, t, start)
+        if best_stall:
+            heapq.heappush(self._outq, (start, next(self._seq), sched))
+            return None
+        return sched
+
+    def drain(self, max_steps: int = 1_000_000) -> list[ScheduledTxn]:
+        """Run schedule_next until every bank is done; returns emitted
+        decisions in emission order (parked ones included as their
+        start times arrive)."""
+        out = []
+        for _ in range(max_steps):
+            s = self.schedule_next()
+            if s is not None:
+                out.append(s)
+            elif all(self._bank_done) or (
+                not self._heap and not self._outq
+            ):
+                break
+        # Flush parked decisions unconditionally (also covers a
+        # max_steps exhaustion — a scheduled txn must never be silently
+        # dropped from the returned schedule).
+        while self._outq:
+            out.append(heapq.heappop(self._outq)[2])
+        return out
+
+
+def validate_timed_schedule(decisions: list[ScheduledTxn]) -> bool:
+    """Admissibility of a timed schedule: over every account, write
+    intervals never overlap any other use interval (the reference
+    conflict rule lifted to [start, start+est_cus) intervals)."""
+    intervals: dict[bytes, list[tuple[int, int, bool]]] = {}
+    for d in decisions:
+        end = d.start + d.txn.est_cus
+        for k in d.txn.writable:
+            intervals.setdefault(k, []).append((d.start, end, True))
+        for k in d.txn.readonly:
+            intervals.setdefault(k, []).append((d.start, end, False))
+    for uses in intervals.values():
+        uses.sort()
+        for i, (s1, e1, w1) in enumerate(uses):
+            for s2, e2, w2 in uses[i + 1:]:
+                if s2 >= e1:
+                    break
+                if w1 or w2:
+                    return False
+    return True
 
 
 def validate_schedule(batches: list[list[PackTxn]]) -> bool:

@@ -127,6 +127,8 @@ def _supervised(
     fault_hook=None,
     tile_cpus: Optional[List[int]] = None,
     jax_platform: Optional[str] = None,
+    stall_timeout_s: float = 300.0,
+    boot_grace_s: float = 300.0,
 ) -> PipelineResult:
     pod = topo.pod
     pod_path = os.path.join(tmp, "topo.pod")
@@ -194,9 +196,18 @@ def _supervised(
     last_cursors = None
     last_beat: Dict[str, tuple] = {}
     total_restarts = 0
+    # Progress-scaled deadline (round-3 verdict: fixed wall deadlines
+    # made the crash tests cry wolf on loaded hosts). The run is
+    # aborted only after stall_timeout_s with NO progress, where
+    # progress = any ring cursor OR any tile heartbeat advancing; the
+    # wall deadline remains as the hard safety cap.
+    last_progress_sig = None
+    last_progress_at = t0
 
     while time.perf_counter() < deadline:
         now = time.perf_counter()
+        if now - last_progress_at > stall_timeout_s:
+            break  # no cursor/heartbeat movement for stall_timeout_s
         if fault_hook is not None:
             fault_hook(tiles, now - t0)
         # Liveness + heartbeat supervision (crash-only recovery).
@@ -213,11 +224,13 @@ def _supervised(
                 hb = cncs[name].heartbeat_query()
                 seen_at, seen_hb = last_beat.get(name, (now, hb))
                 # hb == seen_hb == 0 means the worker is still BOOTING
-                # (interpreter + imports, easily seconds under load):
-                # give boot a longer grace than a wedged run loop —
-                # killing a booting worker just restarts the boot storm.
-                limit = heartbeat_timeout_s * (4.0 if seen_hb == 0
-                                               else 1.0)
+                # (interpreter + imports + jit compiles, easily MINUTES
+                # on a loaded host even from a warm cache): boot gets
+                # its own generous grace — killing a booting worker
+                # just restarts the boot storm, which was the round-3
+                # under-load flake. A genuinely hung boot is caught by
+                # the global no-progress stall timeout instead.
+                limit = boot_grace_s if seen_hb == 0 else heartbeat_timeout_s
                 if hb != seen_hb:
                     last_beat[name] = (now, hb)
                 elif now - seen_at > limit:
@@ -250,6 +263,11 @@ def _supervised(
         cursors = tuple(
             (mc.seq_next(), fs.query()) for mc, fs in links
         )
+        progress_sig = (cursors,
+                        tuple(c.heartbeat_query() for c in cncs.values()))
+        if progress_sig != last_progress_sig:
+            last_progress_sig = progress_sig
+            last_progress_at = now
         drained = all(fs >= mc for mc, fs in cursors)
         if src_done and drained and cursors == last_cursors:
             settle += 1

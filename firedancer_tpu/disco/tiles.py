@@ -794,9 +794,12 @@ class VerifyTile(Tile):
             return
         items = list(txn.verify_items(payload))
         if self.backend == "oracle":
-            ok = all(
-                oracle.verify(msg, sig, pub) == 0 for (sig, pub, msg) in items
-            )
+            # Bulk path: the native C++ verifier (>=10k/s/core) when
+            # built, else the Python oracle — same status contract,
+            # differentially pinned in tests/test_ed25519_cpu.py.
+            from firedancer_tpu.ballet.ed25519 import native as ed_native
+
+            ok = all(st == 0 for st in ed_native.verify_items(items))
             self._finish(payload, ok, tsorig=frag.tsorig)
             self._ack_inline(frag)
             return
@@ -807,10 +810,10 @@ class VerifyTile(Tile):
             # than the staging width (can't happen when max_msg_len is
             # the MTU, but don't trust the wire — and never silently
             # truncate a message into a false reject): verify on the
-            # oracle, like the native drain's oversize path.
-            ok = all(
-                oracle.verify(msg, sig, pub) == 0 for (sig, pub, msg) in items
-            )
+            # CPU fallback, like the native drain's oversize path.
+            from firedancer_tpu.ballet.ed25519 import native as ed_native
+
+            ok = all(st == 0 for st in ed_native.verify_items(items))
             self._finish(payload, ok, tsorig=frag.tsorig)
             self._ack_inline(frag)
             return
