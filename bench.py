@@ -540,11 +540,15 @@ def main() -> int:
         attempt(forced, None, min(attempt_timeout, max(left(), 60.0)))
     else:
         direct_rec = attempt("direct", None, min(attempt_timeout, left()))
-        if direct_rec is not None and left() > rlc_min_s:
-            # rlc is the largest compile in the ladder: it only spends
-            # budget once direct has BANKED a number — if direct failed,
-            # the remaining budget belongs to the compat rung, which
-            # exists precisely for kernels direct chokes on.
+        if (direct_rec is not None and left() > rlc_min_s
+                and os.environ.get("FD_BENCH_RLC") == "1"):
+            # RLC is PARKED from the default ladder (round-4): measured
+            # 24.8k/s vs direct's 98.6k/s on v5e — the K=64 torsion
+            # certification that makes it sound also makes it lose to
+            # the path it exists to beat, and its compile is the
+            # ladder's largest. The code path stays tested
+            # (tests/test_verify_rlc.py); FD_BENCH_RLC=1 re-adds the
+            # rung for experiments.
             attempt("rlc", None, min(attempt_timeout, left() - 30.0))
         if direct_rec is None and best is None and left() > 90.0:
             attempt("direct", {"FD_SQ_IMPL": "mul"},

@@ -40,7 +40,11 @@ def _find_lib():
         ]
         lib.fd_ed25519_cpu_verify_batch.restype = None
         _LIB = lib
-    except OSError:
+    except (OSError, AttributeError):
+        # OSError: library not built. AttributeError: a stale
+        # libfdtango.so from before ed25519_cpu.cc joined the build —
+        # both mean "fall back to the Python oracle", never crash the
+        # verify tile.
         _LIB = None
     return _LIB
 

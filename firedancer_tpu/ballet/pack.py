@@ -320,6 +320,10 @@ class PackTimed:
         self._bank_until = [0] * bank_cnt      # in_use_until per bank
         self._bank_done = [False] * bank_cnt
         self._w_until: dict[bytes, int] = {}   # acct -> write in_use_until
+        # acct -> (previous write's end, latest write's start, end):
+        # the read-admission gap [prev_end, start] must be exact — see
+        # the readonly hazard check in schedule_next.
+        self._w_info: dict[bytes, tuple[int, int, int]] = {}
         self._r_until: dict[bytes, int] = {}   # acct -> read in_use_until
         self._outq: list[tuple[int, int, ScheduledTxn]] = []  # (start, seq, s)
         self.insert_cnt = 0
@@ -404,14 +408,20 @@ class PackTimed:
                                self._r_until.get(k, 0))
             would_raw = False
             for k in cand.readonly:
-                wu = self._w_until.get(k, 0)
+                prev_end, w_start, wu = self._w_info.get(k, (0, 0, 0))
                 if wu > start_at:
-                    # Read of an account with a future write scheduled:
-                    # allowed only inside the existing read shadow
-                    # (fd_pack.c:471-483); otherwise stall the bank to
-                    # the write's end.
-                    ru = self._r_until.get(k, 0)
-                    if start_at + cand.est_cus > ru:
+                    # Read of an account with a pending write whose
+                    # interval ends after this read would start
+                    # (fd_pack.c:471-483's "read shadow", made
+                    # interval-exact): admissible only when the read
+                    # fits wholly in the gap between the PREVIOUS
+                    # write's end and the pending write's START — the
+                    # reference's r_until approximation of that gap
+                    # admits reads overlapping the write's tail once a
+                    # later read has extended the read horizon past the
+                    # write (found by the round-4 review's fuzz repro).
+                    if not (start_at >= prev_end
+                            and start_at + cand.est_cus <= w_start):
                         would_raw = True
                         start_at = max(start_at, wu)
             if start_at + cand.est_cus > self.cu_limit:
@@ -442,6 +452,8 @@ class PackTimed:
         end = start + best.est_cus
         self._bank_until[t] = end
         for k in best.writable:
+            prev = self._w_info.get(k, (0, 0, 0))[2]
+            self._w_info[k] = (prev, start, end)
             self._w_until[k] = end
         for k in best.readonly:
             self._r_until[k] = max(self._r_until.get(k, 0), end)

@@ -27,7 +27,9 @@ from . import curve25519 as ge
 from . import fe25519 as fe
 
 NLIMBS = fe.NLIMBS
-LANES = 1024  # batch tile per program (measured best on v5e; 512 ~9% slower)
+# Batch tile per program (v5e r3 measurement: 512 ~9% slower than 1024;
+# VMEM headroom allows 2048 — FD_DSM_LANES overrides for on-chip sweeps).
+LANES = int(__import__("os").environ.get("FD_DSM_LANES", "1024"))
 
 
 def _fe_mul(a, b):
@@ -84,16 +86,27 @@ def _identity(lanes):
     return (zero, one, one, zero)
 
 
-def _lookup(table, w_row):
-    """table: list of 16 points; w_row: (1, L) window values 0..15."""
-    coords = []
-    for c in range(4):
-        acc = jnp.zeros_like(table[0][c])
-        for t in range(16):
-            sel = (w_row == t).astype(jnp.int32)      # (1, L)
-            acc = acc + table[t][c] * sel
-        coords.append(acc)
-    return tuple(coords)
+def _stack_table(table):
+    """[(x, y, z, t) coords of (32, L)] -> [(128, L)] stacked entries,
+    hoisted OUT of the window loop so the concats trace once."""
+    return [jnp.concatenate(pt, axis=0) for pt in table]
+
+
+def _lookup(stacked, w_row):
+    """stacked: list of 16 (128, L) entries; w_row: (1, L) values 0..15.
+
+    The select mask is computed ONCE per entry and shared by all four
+    coordinates (accumulated on the stacked (128, L) tile) — a quarter
+    of the compares and a quarter of the op count of the round-3
+    per-coordinate form (Mosaic does not reliably CSE the
+    (w_row == t) masks across coords)."""
+    acc = None
+    for t, entry in enumerate(stacked):
+        sel = (w_row == t).astype(jnp.int32)                  # (1, L)
+        term = entry * sel
+        acc = term if acc is None else acc + term
+    n = acc.shape[0] // 4
+    return tuple(acc[i * n:(i + 1) * n] for i in range(4))
 
 
 def _dsm_kernel(ax, ay, az, at, hw, sw, btab, ox, oy, oz, *, n_windows=64):
@@ -109,6 +122,7 @@ def _dsm_kernel(ax, ay, az, at, hw, sw, btab, ox, oy, oz, *, n_windows=64):
             a_table.append(_point_double(a_table[j // 2]))
         else:
             a_table.append(_point_add(a_table[j - 1], a_pt, d2))
+    a_table = _stack_table(a_table)
 
     # shared B table: btab is (32, 64) — column 4*t+c = coord c of t*B
     b_table = []
@@ -118,6 +132,7 @@ def _dsm_kernel(ax, ay, az, at, hw, sw, btab, ox, oy, oz, *, n_windows=64):
             for c in range(4)
         )
         b_table.append(coords)
+    b_table = _stack_table(b_table)
 
     def body(wi, r3):
         import jax.experimental.pallas as pl

@@ -45,9 +45,16 @@ def _sq(x):
 
 
 def _sqn(x, n):
-    for _ in range(n):
-        x = _sq(x)
-    return x
+    """n successive squarings. Long runs ride lax.fori_loop so the
+    traced kernel stays compact — the chain has ~250 squarings and a
+    fully unrolled trace (~100 ops each) dominated kernel compile time;
+    per-step loop overhead in-VMEM is noise next to the 528-product
+    square itself. Short runs stay unrolled (loop setup isn't free)."""
+    if n <= 8:
+        for _ in range(n):
+            x = _sq(x)
+        return x
+    return jax.lax.fori_loop(0, n, lambda i, v: _sq(v), x)
 
 
 def _ladder(z):

@@ -93,6 +93,46 @@ def main():
     t = bench(jax.jit(functools.partial(decompress_pallas)), (ybytes,))
     print(f"decompress kernel (512):    {t*1e3:8.3f} ms", flush=True)
 
+    # --- DSM sweep: mul impl x LANES (round-4 lookup hoist in place) --
+    import importlib
+
+    from firedancer_tpu.ops import curve25519 as ge
+
+    pt, _ = jax.jit(ge.decompress)(ybytes)
+    pt = tuple(jnp.asarray(c) for c in pt)
+    sbytes = jnp.asarray(rng.randint(0, 128, (batch, 32), dtype=np.uint8))
+    for mul_impl in ("schoolbook", "karatsuba"):
+        for lanes in (1024, 2048):
+            os.environ["FD_MUL_IMPL"] = mul_impl
+            os.environ["FD_DSM_LANES"] = str(lanes)
+            import firedancer_tpu.ops.dsm_pallas as dp
+            importlib.reload(dp)
+            try:
+                t = bench(jax.jit(dp.double_scalarmult_pallas),
+                          (sbytes, pt, sbytes), reps=3, warmup=1)
+                print(f"dsm {mul_impl:10s} L={lanes}: {t*1e3:8.3f} ms",
+                      flush=True)
+            except Exception as e:
+                print(f"dsm {mul_impl:10s} L={lanes}: FAILED "
+                      f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+    os.environ.pop("FD_MUL_IMPL", None)
+    os.environ.pop("FD_DSM_LANES", None)
+
+    # --- fused full verify (what bench.py measures) -------------------
+    import importlib as _il
+
+    import firedancer_tpu.ops.dsm_pallas as dp
+    _il.reload(dp)
+    from firedancer_tpu.ops.verify import verify_batch
+
+    msgs = jnp.asarray(rng.randint(0, 256, (batch, 192), dtype=np.uint8))
+    lens = jnp.full((batch,), 192, jnp.int32)
+    sigs = jnp.asarray(rng.randint(0, 256, (batch, 64), dtype=np.uint8))
+    t = bench(jax.jit(verify_batch), (msgs, lens, sigs, ybytes),
+              reps=3, warmup=1)
+    print(f"verify_batch fused:         {t*1e3:8.3f} ms "
+          f"({batch/t:.0f} lanes/s)", flush=True)
+
 
 if __name__ == "__main__":
     main()

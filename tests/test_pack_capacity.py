@@ -198,19 +198,26 @@ def test_timed_gaussian_perturbation_clamped():
         assert 1 <= txn.est_cus <= 20_000
 
 
-@pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.parametrize("seed", list(range(1, 13)))
 def test_timed_random_load_always_admissible(seed):
     """Property: any drain over random load yields an interval-
-    admissible schedule and never exceeds depth while overloaded."""
+    admissible schedule and never exceeds depth while overloaded.
+    Few accounts + many txns maximizes read/write interleaving — the
+    round-4 review's fuzz found the r_until read-shadow approximation
+    admitted reads overlapping a write's tail under exactly this shape
+    (16 accounts, 400 txns; 22 of 200 seeds), fixed by the exact
+    [prev_end, w_start] gap test."""
     rng = random.Random(seed)
-    p = PackTimed(bank_cnt=4, depth=128, cu_limit=2_000_000,
-                  rng=random.Random(seed + 100))
-    for i in range(1000):
-        w = [rng.randrange(64) for _ in range(rng.randint(1, 3))]
-        r = [x for x in (rng.randrange(64) for _ in range(2)) if x not in w]
+    n_accts = 16 if seed % 2 else 64
+    p = PackTimed(bank_cnt=4, depth=128 if seed % 3 else 256,
+                  cu_limit=2_000_000, rng=random.Random(seed + 100))
+    for i in range(1000 if n_accts == 64 else 400):
+        w = [rng.randrange(n_accts) for _ in range(rng.randint(1, 3))]
+        r = [x for x in (rng.randrange(n_accts) for _ in range(2))
+             if x not in w]
         p.insert(_t(i, rng.randint(1, 10**6), rng.randint(1_000, 200_000),
                     w=w, r=r))
-        assert p.pending_cnt() <= 128
+        assert p.pending_cnt() <= p.depth
     out = p.drain()
     assert out, "some txns must schedule"
     assert validate_timed_schedule(out)
