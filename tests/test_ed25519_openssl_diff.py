@@ -1,0 +1,70 @@
+"""Second, independent crypto oracle: differential sweep vs OpenSSL.
+
+The reference cross-checks random Ed25519 inputs against OpenSSL under
+OPENSSL_COMPARE (reference src/ballet/ed25519/test_ed25519.c:580-592).
+Here the same loop runs three ways — the Python oracle
+(ballet.ed25519.oracle), the native C++ verifier (native/ed25519_cpu.cc)
+and OpenSSL via the `cryptography` package — over random valid
+signatures and random single-bit corruptions.
+
+Scope note: the sweep uses RANDOM inputs, where firedancer/donna
+semantics and strict RFC 8032 agree; the deliberate divergence classes
+(non-canonical encodings, small-order points — fd_ed25519_user.c:379)
+are pinned by dedicated tests in test_oracle.py and excluded here, as
+in the reference's comparison.
+"""
+
+import numpy as np
+import pytest
+
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PublicKey,
+    )
+    HAVE_OPENSSL = True
+except ImportError:  # pragma: no cover
+    HAVE_OPENSSL = False
+
+from firedancer_tpu.ballet import ed25519 as oracle
+from firedancer_tpu.ballet.ed25519 import native
+
+pytestmark = pytest.mark.skipif(not HAVE_OPENSSL,
+                                reason="cryptography package unavailable")
+
+
+def _openssl_ok(msg: bytes, sig: bytes, pub: bytes) -> bool:
+    try:
+        Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
+        return True
+    except (InvalidSignature, ValueError):
+        return False
+
+
+def test_openssl_differential_sweep():
+    rng = np.random.RandomState(424242)
+    n_agree = 0
+    for i in range(128):
+        sk = rng.randint(0, 256, 32, dtype=np.uint8).tobytes()
+        _, _, pub = oracle.keypair_from_seed(sk)
+        m = rng.randint(0, 256, int(rng.randint(0, 256)),
+                        dtype=np.uint8).tobytes()
+        sig = oracle.sign(m, sk)
+        cases = [(m, sig, pub)]
+        # One random corruption of each component per signature.
+        s = bytearray(sig); s[rng.randint(64)] ^= 1 << rng.randint(8)
+        cases.append((m, bytes(s), pub))
+        p = bytearray(pub); p[rng.randint(32)] ^= 1 << rng.randint(8)
+        cases.append((m, sig, bytes(p)))
+        if m:
+            mm = bytearray(m); mm[rng.randint(len(m))] ^= 0xFF
+            cases.append((bytes(mm), sig, pub))
+        for (cm, cs, cp) in cases:
+            want = _openssl_ok(cm, cs, cp)
+            got_py = oracle.verify(cm, cs, cp) == 0
+            assert got_py == want, (i, "python-oracle vs openssl")
+            if native.available():
+                got_c = native.verify(cm, cs, cp) == 0
+                assert got_c == want, (i, "native vs openssl")
+            n_agree += 1
+    assert n_agree >= 128 * 3
