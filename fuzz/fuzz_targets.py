@@ -196,6 +196,42 @@ def target_quic_retry_token():
     return fn, corpus, (wire.QuicWireError,)
 
 
+def target_ed25519_native_diff():
+    """Differential fuzz: the native C++ verifier must agree with the
+    Python oracle on arbitrary (sig, pub) bytes — the decompress
+    failure space, s-range edges, and mutated valid signatures all
+    land here (reference analog: test_ed25519.c OPENSSL_COMPARE)."""
+    from firedancer_tpu.ballet import ed25519 as oracle
+    from firedancer_tpu.ballet.ed25519 import native
+
+    seed = bytes([5]) * 32
+    _, _, pub = oracle.keypair_from_seed(seed)
+    msg = b"fuzz-me-fuzz-me-32-bytes-of-msg!"
+    sig = oracle.sign(msg, seed)
+    corpus = [sig + pub, bytes(96), b"\xff" * 96]
+
+    if not native.available():  # pragma: no cover - built in CI
+        def fn(data: bytes) -> None:
+            return None
+        return fn, corpus, ()
+
+    def fn(data: bytes) -> None:
+        data = (data + bytes(96))[:96]
+        s, p = data[:64], data[64:96]
+        got = native.verify(msg, s, p)
+        assert got in (0, -1, -2, -3), got
+        # The pure-Python oracle costs ~1s per full verify, so the
+        # differential runs on a deterministic 1-in-64 sample (the
+        # bounded CI smoke does 2000 iters/target); the exhaustive
+        # differential suites live in tests/test_ed25519_cpu.py and
+        # tests/test_ed25519_openssl_diff.py.
+        if data[0] & 0x3F == 0x15:
+            want = oracle.verify(msg, s, p)
+            assert got == want, (got, want, data.hex())
+
+    return fn, corpus, ()
+
+
 ALL_TARGETS = {
     "txn_parse": target_txn_parse,
     "quic_frames": target_quic_frames,
@@ -206,4 +242,5 @@ ALL_TARGETS = {
     "eth_ip_udp": target_eth_ip_udp,
     "sbpf_loader": target_sbpf_loader,
     "quic_retry_token": target_quic_retry_token,
+    "ed25519_native_diff": target_ed25519_native_diff,
 }

@@ -1,0 +1,28 @@
+"""Shared on-chip timing helper for the probe scripts.
+
+One dispatch-then-block methodology for every probe
+(profile_stages / decompress_probe / mxu_probe), so a fix to the
+timing discipline lands everywhere at once. The host pull
+(np.asarray of one leaf) defeats any tunnel-side dispatch laziness —
+block_until_ready alone mis-measured ~0.02 ms for a 250-square chain
+on the axon tunnel (round-4 finding).
+"""
+
+import time
+
+import numpy as np
+
+import jax
+
+
+def bench(fn, args, reps=5, warmup=2):
+    """Seconds per rep, after warmup, with one device->host pull per
+    timing boundary."""
+    for _ in range(warmup):
+        out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0])
+    return (time.perf_counter() - t0) / reps

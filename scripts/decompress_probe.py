@@ -22,17 +22,7 @@ import jax
 import jax.numpy as jnp
 
 
-def bench(fn, args, reps=5, warmup=2):
-    """Wall time per rep with a full host pull of one output element."""
-    for _ in range(warmup):
-        out = fn(*args)
-    first = jax.tree_util.tree_leaves(out)[0]
-    np.asarray(first)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-        np.asarray(jax.tree_util.tree_leaves(out)[0])
-    return (time.perf_counter() - t0) / reps
+from _bench_util import bench  # noqa: E402
 
 
 def main():

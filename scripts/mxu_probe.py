@@ -1,0 +1,193 @@
+"""MXU feasibility probe for the verify kernels (round-4).
+
+Answers, on the real chip:
+  1. does Mosaic accept jnp.dot on bf16 (f32 accum) inside a Pallas
+     kernel on this toolchain, and at what rate;
+  2. same for int8 -> int32;
+  3. is the shared-operand field multiply (B-table adds: per-lane a
+     times a CONSTANT b) faster as 4 small bf16 matmuls
+     (M1/M2 38-fold split x a_lo/a_hi byte split, exact in f32 accum)
+     than the VPU fe_mul — the decision gate for wiring the MXU into
+     dsm_pallas's B-side adds and lookups.
+
+Exactness argument for (3): M1/M2 entries <= 255 and a_lo in [0,255],
+a_hi in [-2,2] are all bf16-exact; every f32 partial sum is
+<= 32*255*255 < 2^21 < 2^24, so the f32 accumulation is exact and the
+int32 round-trip is lossless.
+
+Run: python scripts/mxu_probe.py [lanes]
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from _bench_util import bench
+
+
+def main():
+    lanes = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    # The bf16 probe feeds a (128, 128) slice of its accumulator back
+    # into the next dot, so lanes below 128 would be a shape error that
+    # misreads as an MXU infeasibility verdict.
+    lanes = max(lanes, 128)
+    print(f"device={jax.devices()[0]} lanes={lanes}", flush=True)
+
+    from jax.experimental import pallas as pl
+
+    from firedancer_tpu.ops import fe25519 as fe
+
+    NL = fe.NLIMBS
+    rng = np.random.RandomState(0)
+
+    # ---- 1) bf16 matmul rate in-kernel ------------------------------
+    REP_IN_KERNEL = 32
+
+    def mm_bf16_kernel(a_ref, b_ref, o_ref):
+        a = a_ref[...]
+        acc = None
+        for _ in range(REP_IN_KERNEL):
+            c = jnp.dot(a, b_ref[...],
+                        preferred_element_type=jnp.float32)
+            acc = c if acc is None else acc + c
+            a = acc.astype(jnp.bfloat16)[:, :128]
+        o_ref[...] = acc
+
+    A = jnp.asarray(rng.randint(0, 2, (128, 128)), jnp.bfloat16)
+    B = jnp.asarray(rng.randint(0, 2, (128, lanes)), jnp.bfloat16)
+    try:
+        f = jax.jit(lambda a, b: pl.pallas_call(
+            mm_bf16_kernel,
+            in_specs=[pl.BlockSpec((128, 128), lambda: (0, 0)),
+                      pl.BlockSpec((128, lanes), lambda: (0, 0))],
+            out_specs=pl.BlockSpec((128, lanes), lambda: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((128, lanes), jnp.float32),
+        )(a, b))
+        t = bench(f, (A, B))
+        macs = REP_IN_KERNEL * 128 * 128 * lanes
+        print(f"bf16 dot in-kernel:  {t*1e6:9.1f} us  "
+              f"{macs/t/1e12:8.2f} Tmac/s", flush=True)
+    except Exception as e:
+        print(f"bf16 dot in-kernel:  FAILED {type(e).__name__}: "
+              f"{str(e)[:200]}", flush=True)
+
+    # ---- 2) int8 matmul rate in-kernel ------------------------------
+    def mm_i8_kernel(a_ref, b_ref, o_ref):
+        acc = None
+        for _ in range(REP_IN_KERNEL):
+            c = jax.lax.dot_general(
+                a_ref[...], b_ref[...], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            acc = c if acc is None else acc + c
+        o_ref[...] = acc
+
+    Ai = jnp.asarray(rng.randint(-2, 3, (128, 128)), jnp.int8)
+    Bi = jnp.asarray(rng.randint(-2, 3, (128, lanes)), jnp.int8)
+    try:
+        f = jax.jit(lambda a, b: pl.pallas_call(
+            mm_i8_kernel,
+            in_specs=[pl.BlockSpec((128, 128), lambda: (0, 0)),
+                      pl.BlockSpec((128, lanes), lambda: (0, 0))],
+            out_specs=pl.BlockSpec((128, lanes), lambda: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((128, lanes), jnp.int32),
+        )(a, b))
+        t = bench(f, (Ai, Bi))
+        macs = REP_IN_KERNEL * 128 * 128 * lanes
+        print(f"int8 dot in-kernel:  {t*1e6:9.1f} us  "
+              f"{macs/t/1e12:8.2f} Tmac/s", flush=True)
+    except Exception as e:
+        print(f"int8 dot in-kernel:  FAILED {type(e).__name__}: "
+              f"{str(e)[:200]}", flush=True)
+
+    # ---- 3) shared-operand fe_mul: VPU vs MXU -----------------------
+    # Constant b (e.g. a B-table niels coord), per-lane a. VPU version:
+    # fe.fe_mul_kernel. MXU version: c = (M1 + 38*M2) @ (a_lo + 256*a_hi)
+    # with the 38-fold and byte recombines on the VPU.
+    b_int = int(fe.D_INT)  # any fixed field element
+    b_limbs = [(b_int >> (8 * i)) & 0xFF for i in range(NL)]
+    # M[k, i] = bext[32 + k - i], bext = [38*b ; b]; split by the 38
+    # weight so every entry is <= 255 (bf16-exact).
+    M1 = np.zeros((NL, NL), np.float32)
+    M2 = np.zeros((NL, NL), np.float32)
+    for k in range(NL):
+        for i in range(NL):
+            j = k - i
+            if j >= 0:
+                M1[k, i] = b_limbs[j]
+            else:
+                M2[k, i] = b_limbs[j + NL]
+    N_MULS = 16
+
+    def vpu_kernel(a_ref, b_ref, o_ref):
+        a = a_ref[...]
+        b = b_ref[...]
+        for _ in range(N_MULS):
+            a = fe.fe_mul_kernel(a, b)
+        o_ref[...] = a
+
+    def mxu_kernel(a_ref, m1_ref, m2_ref, o_ref):
+        a = a_ref[...]
+        m1 = m1_ref[...].astype(jnp.bfloat16)
+        m2 = m2_ref[...].astype(jnp.bfloat16)
+        for _ in range(N_MULS):
+            a_lo = (a & 255).astype(jnp.bfloat16)   # [0, 255] exact
+            a_hi = (a >> 8).astype(jnp.bfloat16)    # [-2, 1] exact
+            # Four exact bf16 matmuls (every f32 partial < 2^21); the
+            # x256 weight of the a_hi terms is applied as a LIMB SHIFT
+            # (row up, 38-wrap on the top row) so every combined value
+            # stays < 2^27 in int32 — a scalar 256 weight would blow
+            # past both exact-f32 and int32 range.
+            t1 = jnp.dot(m1, a_lo, preferred_element_type=jnp.float32)
+            t2 = jnp.dot(m2, a_lo, preferred_element_type=jnp.float32)
+            t3 = jnp.dot(m1, a_hi, preferred_element_type=jnp.float32)
+            t4 = jnp.dot(m2, a_hi, preferred_element_type=jnp.float32)
+            lo = t1.astype(jnp.int32) + 38 * t2.astype(jnp.int32)
+            hi = t3.astype(jnp.int32) + 38 * t4.astype(jnp.int32)
+            c = lo + jnp.concatenate(
+                [38 * hi[NL - 1:], hi[: NL - 1]], axis=0)
+            a = fe._carry_pass(c, 4)
+        o_ref[...] = a
+
+    a0 = jnp.asarray(rng.randint(0, 256, (NL, lanes)), jnp.int32)
+    bcol = jnp.asarray(np.tile(np.asarray(b_limbs, np.int32)[:, None],
+                               (1, lanes)))
+    spec = pl.BlockSpec((NL, lanes), lambda: (0, 0))
+    spec_m = pl.BlockSpec((NL, NL), lambda: (0, 0))
+    try:
+        f_vpu = jax.jit(lambda a, b: pl.pallas_call(
+            vpu_kernel, in_specs=[spec, spec], out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((NL, lanes), jnp.int32))(a, b))
+        t_vpu = bench(f_vpu, (a0, bcol))
+        print(f"shared-mul VPU x{N_MULS}:  {t_vpu*1e6:9.1f} us", flush=True)
+    except Exception as e:
+        t_vpu = None
+        print(f"shared-mul VPU: FAILED {str(e)[:160]}", flush=True)
+    try:
+        f_mxu = jax.jit(lambda a, m1, m2: pl.pallas_call(
+            mxu_kernel, in_specs=[spec, spec_m, spec_m], out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((NL, lanes), jnp.int32))(
+                a, m1, m2))
+        t_mxu = bench(f_mxu, (a0, jnp.asarray(M1), jnp.asarray(M2)))
+        print(f"shared-mul MXU x{N_MULS}:  {t_mxu*1e6:9.1f} us", flush=True)
+        # correctness: same product chain both ways
+        got = np.asarray(f_mxu(a0, jnp.asarray(M1), jnp.asarray(M2)))
+        want = np.asarray(f_vpu(a0, bcol)) if t_vpu else None
+        if want is not None:
+            gi = fe.limbs_to_int(got[:, :8])
+            wi = fe.limbs_to_int(want[:, :8])
+            print(f"shared-mul MXU == VPU: {gi == wi}", flush=True)
+    except Exception as e:
+        print(f"shared-mul MXU: FAILED {type(e).__name__}: "
+              f"{str(e)[:200]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

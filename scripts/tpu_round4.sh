@@ -37,6 +37,10 @@ echo "== bench ladder (records BENCH_LOG.jsonl)"
 python bench.py || echo "bench ladder failed"
 tail -3 BENCH_LOG.jsonl 2>/dev/null
 
+echo "== mxu feasibility probe (900s)"
+timeout 900 python -u scripts/mxu_probe.py || \
+  echo "mxu probe failed (continuing)"
+
 echo "== pack 64k schedule artifact -> PACK_r04.json"
 timeout 900 python bench.py --pack | tee PACK_r04.json || \
   echo "pack bench failed"
