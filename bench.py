@@ -551,7 +551,12 @@ def main() -> int:
             # rung for experiments.
             attempt("rlc", None, min(attempt_timeout, left() - 30.0))
         if direct_rec is None and best is None and left() > 90.0:
-            attempt("direct", {"FD_SQ_IMPL": "mul"},
+            # Compat rung: roll back the round-4 KS canonicalize and
+            # the specialized square together — the two constructions a
+            # Mosaic update is most likely to reject (the KS form has
+            # only interpret-mode coverage until first on-chip run).
+            attempt("direct", {"FD_SQ_IMPL": "mul",
+                               "FD_CANON_IMPL": "seq"},
                     min(attempt_timeout, left()))
     if best is not None:
         print(json.dumps(best))

@@ -423,7 +423,14 @@ def _canonicalize_k(x: jnp.ndarray) -> jnp.ndarray:
     sequential per-row ops — the round-3 ripple version cost ~60 ms per
     8192-lane decompress on v5e; this form is full-width throughout.
     Differentially tested against _canonicalize_k_seq / _canonicalize.
+    FD_CANON_IMPL=seq is the bench ladder's escape hatch should a
+    Mosaic version reject the KS construction (decided at trace time,
+    like backend.use_karatsuba).
     """
+    import os as _os
+
+    if _os.environ.get("FD_CANON_IMPL") == "seq":
+        return _canonicalize_k_seq(x)
     # Lazy wrap passes: |limb| <= 2^24 -> |limb| <= 512 (same analysis
     # as fe_mul's 4-pass bound).
     x = _carry_pass(x, 4)

@@ -26,7 +26,7 @@ sys.exit(0 if any(d.platform != 'cpu' for d in ds) else 3)
     echo "tunnel never recovered within ${MAX_WAIT_MIN}m; aborting"
     exit 1
   fi
-  sleep 150
+  sleep 600
 done
 
 echo "== decompress probe (round-4 KS canonicalize validation; 1500s)"
