@@ -39,6 +39,24 @@ def _find_lib():
             ctypes.c_char_p,
         ]
         lib.fd_ed25519_cpu_verify_batch.restype = None
+        # Sign/keypair arrived after verify: guard them so a stale
+        # library (verify-only) keeps its working verify path instead
+        # of silently disabling ALL native crypto.
+        if hasattr(lib, "fd_ed25519_cpu_sign"):
+            lib.fd_ed25519_cpu_sign.restype = None
+            lib.fd_ed25519_cpu_sign.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint32, ctypes.c_char_p,
+                ctypes.c_char_p,
+            ]
+            lib.fd_ed25519_cpu_keypair.restype = None
+            lib.fd_ed25519_cpu_keypair.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p,
+            ]
+            lib.fd_ed25519_cpu_sign_batch.restype = None
+            lib.fd_ed25519_cpu_sign_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32,
+            ]
         _LIB = lib
     except (OSError, AttributeError):
         # OSError: library not built. AttributeError: a stale
@@ -61,6 +79,81 @@ def verify(msg: bytes, sig: bytes, pub: bytes) -> int:
     return lib.fd_ed25519_cpu_verify1(msg, len(msg), sig, pub)
 
 
+def _sign_lib():
+    lib = _find_lib()
+    if lib is not None and hasattr(lib, "fd_ed25519_cpu_sign"):
+        return lib
+    return None
+
+
+def sign(msg: bytes, seed: bytes) -> bytes:
+    """RFC 8032 sign via the native path (VARTIME scalar mult — the
+    corpus/test signer; production signing should be constant-time).
+    Bit-identical to oracle.sign, differentially pinned in tests."""
+    lib = _sign_lib()
+    if lib is None:
+        from . import oracle
+
+        return oracle.sign(msg, seed)
+    out = ctypes.create_string_buffer(64)
+    lib.fd_ed25519_cpu_sign(msg, len(msg), seed, out)
+    return out.raw
+
+
+def public_key(seed: bytes) -> bytes:
+    """Seed -> 32-byte public key (oracle.keypair_from_seed()[2])."""
+    lib = _sign_lib()
+    if lib is None:
+        from . import oracle
+
+        return oracle.keypair_from_seed(seed)[2]
+    out = ctypes.create_string_buffer(32)
+    lib.fd_ed25519_cpu_keypair(seed, out)
+    return out.raw
+
+
+def _pack_msgs(msgs_list):
+    """Zero-padded (msgs, lens) row-major arrays for the batch ABIs —
+    shared by sign_jobs and verify_items so stride/padding edge cases
+    cannot drift between them."""
+    import numpy as np
+
+    n = len(msgs_list)
+    stride = max(max((len(m) for m in msgs_list), default=0), 1)
+    msgs = np.zeros((n, stride), np.uint8)
+    lens = np.zeros(n, np.uint32)
+    for i, m in enumerate(msgs_list):
+        if m:
+            msgs[i, : len(m)] = np.frombuffer(m, np.uint8)
+        lens[i] = len(m)
+    return msgs, lens, stride
+
+
+def sign_jobs(jobs: Sequence[tuple[bytes, bytes]]) -> "list[bytes] | None":
+    """Batch-sign [(msg, seed), ...] -> 64-byte sigs, one C call.
+    Returns None if the native signer is unavailable (callers fall
+    back to their existing signer)."""
+    lib = _sign_lib()
+    if lib is None:
+        return None
+    import numpy as np
+
+    n = len(jobs)
+    if n == 0:
+        return []
+    msgs, lens, stride = _pack_msgs([m for m, _ in jobs])
+    seeds = np.zeros((n, 32), np.uint8)
+    for i, (_, s) in enumerate(jobs):
+        seeds[i] = np.frombuffer(s, np.uint8)
+    sigs = np.zeros((n, 64), np.uint8)
+    lib.fd_ed25519_cpu_sign_batch(
+        msgs.ctypes.data_as(ctypes.c_void_p), ctypes.c_uint32(stride),
+        lens.ctypes.data_as(ctypes.c_void_p),
+        seeds.ctypes.data_as(ctypes.c_void_p),
+        sigs.ctypes.data_as(ctypes.c_void_p), ctypes.c_uint32(n))
+    return [sigs[i].tobytes() for i in range(n)]
+
+
 def verify_items(items: Sequence[tuple[bytes, bytes, bytes]]) -> list[int]:
     """Batch verify [(sig, pub, msg), ...] -> status list. Uses the
     native batch entry point with one C call when available; falls
@@ -75,16 +168,10 @@ def verify_items(items: Sequence[tuple[bytes, bytes, bytes]]) -> list[int]:
     n = len(items)
     if n == 0:
         return []
-    stride = max((len(m) for (_, _, m) in items), default=0)
-    stride = max(stride, 1)
-    msgs = np.zeros((n, stride), np.uint8)
-    lens = np.zeros(n, np.uint32)
+    msgs, lens, stride = _pack_msgs([m for (_, _, m) in items])
     sigs = np.zeros((n, 64), np.uint8)
     pubs = np.zeros((n, 32), np.uint8)
-    for i, (sig, pub, msg) in enumerate(items):
-        if msg:
-            msgs[i, : len(msg)] = np.frombuffer(msg, np.uint8)
-        lens[i] = len(msg)
+    for i, (sig, pub, _) in enumerate(items):
         sigs[i] = np.frombuffer(sig, np.uint8)
         pubs[i] = np.frombuffer(pub, np.uint8)
     status = np.zeros(n, np.int32)

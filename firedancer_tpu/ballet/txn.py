@@ -292,11 +292,14 @@ def build_txn(
     instrs: (program_id_index, account_indices, data).
     sign_fn(msg, seed) -> 64-byte signature; defaults to the oracle signer.
     """
-    from .ed25519 import keypair_from_seed, sign as oracle_sign
+    from .ed25519 import native as _native
 
+    # native.sign / native.public_key fall back to the oracle
+    # internally when the library isn't built (~100x slower), so one
+    # code path serves both configurations.
     if sign_fn is None:
-        sign_fn = oracle_sign
-    pubs = [keypair_from_seed(s)[2] for s in signer_seeds]
+        sign_fn = _native.sign
+    pubs = [_native.public_key(s) for s in signer_seeds]
     accounts = list(pubs) + list(extra_accounts)
 
     msg = bytearray()

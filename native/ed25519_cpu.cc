@@ -689,9 +689,110 @@ static int verify_one(const uint8_t *msg, uint32_t msg_len,
   return memcmp(r_check, r_bytes, 32) == 0 ? 0 : -3;  // ERR_MSG
 }
 
+// ---------------------------------------------------------------- sign
+
+// s = (a*b + c) mod L over 32-byte little-endian scalars.
+static void sc_muladd(uint8_t out[32], const uint8_t a[32],
+                      const uint8_t b[32], const uint8_t c[32]) {
+  u64 aw[4], bw[4], cw[4];
+  memcpy(aw, a, 32);
+  memcpy(bw, b, 32);
+  memcpy(cw, c, 32);
+  u64 t[8] = {0};
+  for (int i = 0; i < 4; i++) {
+    u128 carry = 0;
+    for (int j = 0; j < 4; j++) {
+      u128 cur = (u128)aw[i] * bw[j] + t[i + j] + carry;
+      t[i + j] = (u64)cur;
+      carry = cur >> 64;
+    }
+    t[i + 4] += (u64)carry;
+  }
+  u128 carry = 0;
+  for (int i = 0; i < 8; i++) {
+    u128 cur = (u128)t[i] + (i < 4 ? cw[i] : 0) + carry;
+    t[i] = (u64)cur;
+    carry = cur >> 64;
+  }
+  uint8_t wide[64];
+  memcpy(wide, t, 64);
+  sc_reduce64(out, wide);
+}
+
+// [s]B via the existing vartime machinery (zero h-side). Vartime is
+// fine for the corpus/test signer; production signing should be
+// constant-time (the oracle remains the semantic reference).
+static ge ge_scalarmult_base(const uint8_t s[32]) {
+  ge id = ge_identity();
+  uint8_t zero[32] = {0};
+  return ge_double_scalarmult_vartime(zero, id, s);
+}
+
+static void derive_key(const uint8_t seed[32], uint8_t a_clamped[32],
+                       uint8_t prefix[32], uint8_t pub[32]) {
+  sha512_ctx c;
+  uint8_t h[64];
+  sha512_init(c);
+  sha512_update(c, seed, 32);
+  sha512_final(c, h);
+  memcpy(a_clamped, h, 32);
+  a_clamped[0] &= 248;
+  a_clamped[31] &= 63;
+  a_clamped[31] |= 64;
+  memcpy(prefix, h + 32, 32);
+  ge A = ge_scalarmult_base(a_clamped);
+  ge_tobytes(pub, A);
+}
+
+static void sign_one(uint8_t sig[64], const uint8_t *msg, uint32_t msg_len,
+                     const uint8_t seed[32]) {
+  uint8_t a[32], prefix[32], pub[32];
+  derive_key(seed, a, prefix, pub);
+  sha512_ctx c;
+  uint8_t h64[64], r[32], h[32];
+  sha512_init(c);
+  sha512_update(c, prefix, 32);
+  sha512_update(c, msg, msg_len);
+  sha512_final(c, h64);
+  sc_reduce64(r, h64);
+  ge R = ge_scalarmult_base(r);
+  uint8_t r_enc[32];
+  ge_tobytes(r_enc, R);
+  sha512_init(c);
+  sha512_update(c, r_enc, 32);
+  sha512_update(c, pub, 32);
+  sha512_update(c, msg, msg_len);
+  sha512_final(c, h64);
+  sc_reduce64(h, h64);
+  uint8_t s[32];
+  sc_muladd(s, h, a, r);
+  memcpy(sig, r_enc, 32);
+  memcpy(sig + 32, s, 32);
+}
+
 }  // namespace
 
 extern "C" {
+
+void fd_ed25519_cpu_keypair(const uint8_t *seed, uint8_t *pub_out) {
+  uint8_t a[32], prefix[32];
+  derive_key(seed, a, prefix, pub_out);
+}
+
+void fd_ed25519_cpu_sign(const uint8_t *msg, uint32_t msg_len,
+                         const uint8_t *seed, uint8_t *sig_out) {
+  sign_one(sig_out, msg, msg_len, seed);
+}
+
+// Batched signer for corpus generation: msgs (n, msg_stride) row-major.
+void fd_ed25519_cpu_sign_batch(const uint8_t *msgs, uint32_t msg_stride,
+                               const uint32_t *lens, const uint8_t *seeds,
+                               uint8_t *sigs_out, uint32_t n) {
+  for (uint32_t i = 0; i < n; i++) {
+    sign_one(sigs_out + (size_t)i * 64, msgs + (size_t)i * msg_stride,
+             lens[i], seeds + (size_t)i * 32);
+  }
+}
 
 int fd_ed25519_cpu_verify1(const uint8_t *msg, uint32_t msg_len,
                            const uint8_t *sig, const uint8_t *pub) {

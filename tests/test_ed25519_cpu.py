@@ -107,3 +107,20 @@ def test_throughput_floor():
     rate = len(cases) / dt
     floor = 2_000 if os.environ.get("CI_LOADED") else 8_000
     assert rate > floor, f"native verify {rate:.0f}/s under floor"
+
+
+def test_native_sign_and_keypair_match_oracle():
+    """Native signer/keypair must be BIT-identical to the oracle — the
+    corpus generator and txn builder ride this path when built."""
+    rng = np.random.RandomState(77)
+    jobs = []
+    for i in range(12):
+        seed = rng.randint(0, 256, 32, dtype=np.uint8).tobytes()
+        m = rng.randint(0, 256, int(rng.randint(0, 300)),
+                        dtype=np.uint8).tobytes()
+        assert native.sign(m, seed) == oracle.sign(m, seed)
+        assert native.public_key(seed) == oracle.keypair_from_seed(seed)[2]
+        jobs.append((m, seed))
+    batch = native.sign_jobs(jobs)
+    for (m, seed), sig in zip(jobs, batch):
+        assert sig == oracle.sign(m, seed)
