@@ -118,15 +118,31 @@ def mainnet_corpus(
                     [int(rng.randint(0, 64))],
                 )],
             )
-        p = build_txn(
-            signer_seeds=seeds,
-            extra_accounts=extra,
-            n_readonly_unsigned=len(extra),
-            instrs=instrs,
-            recent_blockhash=rng.randint(0, 256, 32, dtype=np.uint8).tobytes(),
-            sign_fn=sign_fn,
-            **kw,
-        )
+        blockhash = rng.randint(0, 256, 32, dtype=np.uint8).tobytes()
+
+        def _build():
+            return build_txn(
+                signer_seeds=seeds,
+                extra_accounts=extra,
+                n_readonly_unsigned=len(extra),
+                instrs=instrs,
+                recent_blockhash=blockhash,
+                sign_fn=sign_fn,
+                **kw,
+            )
+
+        p = _build()
+        if len(p) > 1232:
+            # Mainnet txns never exceed the TPU MTU (1232 B,
+            # src/disco/quic/fd_quic.h:46-47): a fat multi-sig + 700 B
+            # data draw can overshoot, so rebuild with the payload
+            # trimmed to fit (the deferred-sign jobs for the oversized
+            # attempt are discarded with it).
+            del jobs[len(jobs) - n_sign:]
+            instrs[-1] = (instrs[-1][0], instrs[-1][1],
+                          instrs[-1][2][: max(8, 1232 - (len(p) - data_sz))])
+            p = _build()
+            assert len(p) <= 1232, len(p)
         raw.append(p)
         sig_spans.append(n_sign)
 
