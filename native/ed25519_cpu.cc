@@ -290,12 +290,15 @@ static int ge_frombytes(ge &r, const uint8_t s[32]) {
   return 1;
 }
 
-static void ge_tobytes(uint8_t out[32], const ge &p) {
-  fe zi = fe_invert(p.Z);
-  fe x = fe_mul(p.X, zi);
-  fe y = fe_mul(p.Y, zi);
+static void ge_tobytes_zi(uint8_t out[32], const ge &p, const fe &zinv) {
+  fe x = fe_mul(p.X, zinv);
+  fe y = fe_mul(p.Y, zinv);
   fe_tobytes(out, y);
   out[31] ^= (uint8_t)(fe_isnegative(x) << 7);
+}
+
+static void ge_tobytes(uint8_t out[32], const ge &p) {
+  ge_tobytes_zi(out, p, fe_invert(p.Z));
 }
 
 // ------------------------------------------------- scalars mod L (u256)
@@ -665,9 +668,12 @@ static ge ge_neg(const ge &p) {
 
 // -------------------------------------------------------------- verify
 
-static int verify_one(const uint8_t *msg, uint32_t msg_len,
-                      const uint8_t sig[64], const uint8_t pub[32]) {
-  const uint8_t *r_bytes = sig;
+// Phase A of a verify: everything up to (but excluding) the final
+// R' encoding. Returns 0 with *out_r set when the compare is still
+// pending, else the definitive negative status.
+static int verify_pre(const uint8_t *msg, uint32_t msg_len,
+                      const uint8_t sig[64], const uint8_t pub[32],
+                      ge *out_r) {
   const uint8_t *s_bytes = sig + 32;
   if (sc_ge_L(s_bytes)) return -1;  // ERR_SIG: s out of range
   ge A;
@@ -675,7 +681,7 @@ static int verify_one(const uint8_t *msg, uint32_t msg_len,
 
   sha512_ctx c;
   sha512_init(c);
-  sha512_update(c, r_bytes, 32);
+  sha512_update(c, sig, 32);
   sha512_update(c, pub, 32);
   sha512_update(c, msg, msg_len);
   uint8_t h64[64], h[32];
@@ -683,10 +689,18 @@ static int verify_one(const uint8_t *msg, uint32_t msg_len,
   sc_reduce64(h, h64);
 
   ge negA = ge_neg(A);
-  ge R = ge_double_scalarmult_vartime(h, negA, s_bytes);
+  *out_r = ge_double_scalarmult_vartime(h, negA, s_bytes);
+  return 0;
+}
+
+static int verify_one(const uint8_t *msg, uint32_t msg_len,
+                      const uint8_t sig[64], const uint8_t pub[32]) {
+  ge R;
+  int st = verify_pre(msg, msg_len, sig, pub, &R);
+  if (st) return st;
   uint8_t r_check[32];
   ge_tobytes(r_check, R);
-  return memcmp(r_check, r_bytes, 32) == 0 ? 0 : -3;  // ERR_MSG
+  return memcmp(r_check, sig, 32) == 0 ? 0 : -3;  // ERR_MSG
 }
 
 // ---------------------------------------------------------------- sign
@@ -801,13 +815,47 @@ int fd_ed25519_cpu_verify1(const uint8_t *msg, uint32_t msg_len,
 
 // Batched drive: msgs is (n, msg_stride) row-major; lens per-row valid
 // byte counts; sigs (n, 64); pubs (n, 32); status (n,) int32 out.
+// The final R'-encoding inversions are amortized with the Montgomery
+// batch-inversion trick across pending lanes (one ~254-op power chain
+// + 3 muls/lane instead of a chain per lane — ~18% of a verify), in
+// fixed-size groups to bound scratch.
 void fd_ed25519_cpu_verify_batch(const uint8_t *msgs, uint32_t msg_stride,
                                  const uint32_t *lens, const uint8_t *sigs,
                                  const uint8_t *pubs, int32_t *status,
                                  uint32_t n) {
-  for (uint32_t i = 0; i < n; i++) {
-    status[i] = verify_one(msgs + (size_t)i * msg_stride, lens[i],
-                           sigs + (size_t)i * 64, pubs + (size_t)i * 32);
+  constexpr uint32_t G = 64;
+  ge rs[G];
+  uint32_t idx[G];
+  fe prod[G], zinv[G];
+  for (uint32_t base = 0; base < n; base += G) {
+    uint32_t lim = n - base < G ? n - base : G;
+    uint32_t pending = 0;
+    for (uint32_t k = 0; k < lim; k++) {
+      uint32_t i = base + k;
+      int st = verify_pre(msgs + (size_t)i * msg_stride, lens[i],
+                          sigs + (size_t)i * 64, pubs + (size_t)i * 32,
+                          &rs[pending]);
+      status[i] = st;
+      if (st == 0) idx[pending++] = i;
+    }
+    if (!pending) continue;
+    // prefix products: prod[j] = z_0 * ... * z_j (Z != 0 mod p always
+    // holds for group elements).
+    prod[0] = rs[0].Z;
+    for (uint32_t j = 1; j < pending; j++)
+      prod[j] = fe_mul(prod[j - 1], rs[j].Z);
+    fe inv = fe_invert(prod[pending - 1]);
+    for (uint32_t j = pending; j-- > 1;) {
+      zinv[j] = fe_mul(inv, prod[j - 1]);
+      inv = fe_mul(inv, rs[j].Z);
+    }
+    zinv[0] = inv;
+    for (uint32_t j = 0; j < pending; j++) {
+      uint8_t r_check[32];
+      ge_tobytes_zi(r_check, rs[j], zinv[j]);
+      status[idx[j]] =
+          memcmp(r_check, sigs + (size_t)idx[j] * 64, 32) == 0 ? 0 : -3;
+    }
   }
 }
 
