@@ -75,9 +75,9 @@ def cmd_run(cfg: Dict[str, Any], args) -> int:
         if not args.pcap:
             print("run --source pcap requires --pcap FILE", file=sys.stderr)
             return 1
-        from firedancer_tpu.utils.pcap import PcapReader
+        from firedancer_tpu.utils.pcap import read_capture
 
-        payloads = [pkt for _, _, pkt in PcapReader(args.pcap)]
+        payloads = read_capture(args.pcap)  # classic pcap or pcapng
     else:
         print(f"unknown source {args.source!r}", file=sys.stderr)
         return 1

@@ -72,10 +72,18 @@ def available() -> bool:
 
 
 def verify(msg: bytes, sig: bytes, pub: bytes) -> int:
-    """Single verify via the native path; raises if unavailable."""
+    """Single verify via the native path; raises if unavailable.
+
+    Length checks happen HERE, at the FFI boundary — the C side reads
+    exactly 64/32 bytes and a short buffer would read out of bounds.
+    Error codes mirror oracle.verify's short-input contract."""
     lib = _find_lib()
     if lib is None:
         raise RuntimeError("native ed25519 library not built")
+    if len(sig) != 64:
+        return -1  # FD_ED25519_ERR_SIG, matching oracle.verify
+    if len(pub) != 32:
+        return -2  # FD_ED25519_ERR_PUBKEY
     return lib.fd_ed25519_cpu_verify1(msg, len(msg), sig, pub)
 
 
@@ -90,6 +98,8 @@ def sign(msg: bytes, seed: bytes) -> bytes:
     """RFC 8032 sign via the native path (VARTIME scalar mult — the
     corpus/test signer; production signing should be constant-time).
     Bit-identical to oracle.sign, differentially pinned in tests."""
+    if len(seed) != 32:
+        raise ValueError("seed must be 32 bytes")  # oracle.sign contract
     lib = _sign_lib()
     if lib is None:
         from . import oracle
@@ -102,6 +112,8 @@ def sign(msg: bytes, seed: bytes) -> bytes:
 
 def public_key(seed: bytes) -> bytes:
     """Seed -> 32-byte public key (oracle.keypair_from_seed()[2])."""
+    if len(seed) != 32:
+        raise ValueError("seed must be 32 bytes")  # oracle contract
     lib = _sign_lib()
     if lib is None:
         from . import oracle
@@ -154,6 +166,38 @@ def sign_jobs(jobs: Sequence[tuple[bytes, bytes]]) -> "list[bytes] | None":
     return [sigs[i].tobytes() for i in range(n)]
 
 
+def verify_arrays(msgs, lens, sigs, pubs, n: int):
+    """Zero-copy batch verify over pre-staged row-major numpy arrays —
+    the layout fd_verify_drain stages (msgs (B, stride) u8, lens u32,
+    sigs (B, 64) u8, pubs (B, 32) u8). Verifies rows [0, n); returns an
+    (n,) int32 status array, or None when the native lib is absent.
+
+    This is the host half of the CPU-backend batch pipeline: one C call
+    per BATCH instead of one per txn (verify_items' per-item packing
+    costs more Python than the 1-sig verify itself at pipeline rates).
+    """
+    lib = _find_lib()
+    if lib is None:
+        return None
+    import numpy as np
+
+    if n == 0:
+        return np.zeros(0, np.int32)
+    assert msgs.dtype == np.uint8 and msgs.flags.c_contiguous
+    assert sigs.dtype == np.uint8 and sigs.flags.c_contiguous
+    assert pubs.dtype == np.uint8 and pubs.flags.c_contiguous
+    lens32 = np.ascontiguousarray(lens[:n], np.uint32)
+    status = np.zeros(n, np.int32)
+    lib.fd_ed25519_cpu_verify_batch(
+        msgs.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_uint32(msgs.shape[1]),
+        lens32.ctypes.data_as(ctypes.c_void_p),
+        sigs.ctypes.data_as(ctypes.c_void_p),
+        pubs.ctypes.data_as(ctypes.c_void_p),
+        status.ctypes.data_as(ctypes.c_void_p), ctypes.c_uint32(n))
+    return status
+
+
 def verify_items(items: Sequence[tuple[bytes, bytes, bytes]]) -> list[int]:
     """Batch verify [(sig, pub, msg), ...] -> status list. Uses the
     native batch entry point with one C call when available; falls
@@ -171,7 +215,17 @@ def verify_items(items: Sequence[tuple[bytes, bytes, bytes]]) -> list[int]:
     msgs, lens, stride = _pack_msgs([m for (_, _, m) in items])
     sigs = np.zeros((n, 64), np.uint8)
     pubs = np.zeros((n, 32), np.uint8)
+    # Length checks at the FFI boundary (oracle.verify's short-input
+    # contract); bad lanes keep zero buffers — which the C side reads
+    # safely at full stride — and their status is overwritten below.
+    bad = {}
     for i, (sig, pub, _) in enumerate(items):
+        if len(sig) != 64:
+            bad[i] = -1  # FD_ED25519_ERR_SIG
+            continue
+        if len(pub) != 32:
+            bad[i] = -2  # FD_ED25519_ERR_PUBKEY
+            continue
         sigs[i] = np.frombuffer(sig, np.uint8)
         pubs[i] = np.frombuffer(pub, np.uint8)
     status = np.zeros(n, np.int32)
@@ -181,4 +235,7 @@ def verify_items(items: Sequence[tuple[bytes, bytes, bytes]]) -> list[int]:
         sigs.ctypes.data_as(ctypes.c_void_p),
         pubs.ctypes.data_as(ctypes.c_void_p),
         status.ctypes.data_as(ctypes.c_void_p), ctypes.c_uint32(n))
-    return status.tolist()
+    out = status.tolist()
+    for i, code in bad.items():
+        out[i] = code
+    return out

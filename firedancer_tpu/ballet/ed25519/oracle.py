@@ -140,12 +140,26 @@ def _sha512_mod_l(*chunks: bytes) -> int:
     return int.from_bytes(h.digest(), "little") % L
 
 
+def is_small_order(pt) -> bool:
+    """8*P == identity (order divides the cofactor), the reference's
+    fd_ed25519_ge_p3_is_small_order: 3 doublings + identity check."""
+    t = pt
+    for _ in range(3):
+        t = point_add(t, t)
+    return t == (0, 1)
+
+
 def verify(msg: bytes, sig: bytes, public_key: bytes) -> int:
     """Verify an Ed25519 signature. Returns an FD_ED25519_* status code.
 
-    Matches the reference's fd_ed25519_verify 1-point path
-    (fd_ed25519_user.c:346-433) with the upstream s-range semantics (see
-    module docstring, decision 1).
+    Matches the reference's fd_ed25519_verify DEFAULT (2-point) path
+    (fd_ed25519_user.c:346-433, FD_ED25519_VERIFY_USE_2POINT=1): s-range
+    check, decompress BOTH A and R, reject small-order A (ERR_PUBKEY)
+    and small-order R (ERR_SIG), then compare h*(-A)+s*B against the
+    DECODED R as group elements. Pinned by the 396 Zcash malleability
+    vectors (tests/test_ed25519_malleability.py) — the round-4 1-point
+    form (compress + byte-compare, no small-order checks) accepted 12
+    of the reference's should-fail vectors.
     """
     if len(sig) != 64:
         return FD_ED25519_ERR_SIG
@@ -158,10 +172,19 @@ def verify(msg: bytes, sig: bytes, public_key: bytes) -> int:
     A = point_decompress(public_key)
     if A is None:
         return FD_ED25519_ERR_PUBKEY
+    R = point_decompress(r_bytes)
+    if R is None:
+        # frombytes_vartime_2 surfaces a bad R as ERR_PUBKEY (the
+        # shared decompress error code), and so do we.
+        return FD_ED25519_ERR_PUBKEY
+    if is_small_order(A):
+        return FD_ED25519_ERR_PUBKEY
+    if is_small_order(R):
+        return FD_ED25519_ERR_SIG
     h = _sha512_mod_l(r_bytes, public_key, msg)
     neg_A = ((P - A[0]) % P, A[1])
     Rp = point_add(scalarmult(h, neg_A), scalarmult(s, B))
-    if point_compress(Rp) != r_bytes:
+    if Rp != R:
         return FD_ED25519_ERR_MSG
     return FD_ED25519_SUCCESS
 

@@ -33,15 +33,50 @@ def compare_worse(rewards_a: int, cus_a: int, rewards_b: int, cus_b: int) -> boo
     return rewards_a * cus_b < rewards_b * cus_a
 
 
+def _sift_down_to_root(heap: list, i: int) -> int:
+    """Bubble heap[i] toward the root while it beats its parent; returns
+    the final index. (Inlined rather than heapq._siftdown: the
+    underscore helpers are CPython-private and absent on alternative
+    interpreters.)"""
+    item = heap[i]
+    while i > 0:
+        parent = (i - 1) >> 1
+        if item < heap[parent]:
+            heap[i] = heap[parent]
+            i = parent
+        else:
+            break
+    heap[i] = item
+    return i
+
+
+def _sift_up_to_leaves(heap: list, i: int) -> None:
+    """Push heap[i] down toward the leaves until both children are >=."""
+    n = len(heap)
+    item = heap[i]
+    while True:
+        child = 2 * i + 1
+        if child >= n:
+            break
+        right = child + 1
+        if right < n and heap[right] < heap[child]:
+            child = right
+        if heap[child] < item:
+            heap[i] = heap[child]
+            i = child
+        else:
+            break
+    heap[i] = item
+
+
 def _heap_remove_at(heap: list, i: int) -> None:
     """Remove heap[i] in O(log n): swap in the last element and restore
-    the invariant locally (CPython's heapq removal idiom) instead of a
-    full O(n) heapify."""
+    the invariant locally instead of a full O(n) heapify."""
     heap[i] = heap[-1]
     heap.pop()
     if i < len(heap):
-        heapq._siftup(heap, i)
-        heapq._siftdown(heap, 0, i)
+        if _sift_down_to_root(heap, i) == i:
+            _sift_up_to_leaves(heap, i)
 
 
 def _evict_bottom_half(heap: list, rng: random.Random, txn: PackTxn) -> bool:
@@ -397,7 +432,10 @@ class PackTimed:
         best = None
         best_q = None
         best_stall = 0
-        best_raw = None
+        # Sentinel (rewards=0, compute=2), the reference's fd_pack.c
+        # schedule init: COMPARE_WORSE never selects a zero-reward txn,
+        # so spam with rewards==0 is never scheduled.
+        best_raw = 2
         best_would_raw = False
         limit = min(self.MAX_SEARCH_DEPTH, len(self._heap))
         for q in range(limit):
@@ -427,8 +465,9 @@ class PackTimed:
             if start_at + cand.est_cus > self.cu_limit:
                 continue
             eff_cus = cand.est_cus + (start_at - now)  # charge the stall
-            if best is None or compare_worse(
-                best.rewards, best_raw, cand.rewards, eff_cus
+            if compare_worse(
+                best.rewards if best is not None else 0, best_raw,
+                cand.rewards, eff_cus
             ):
                 best = cand
                 best_raw = eff_cus

@@ -298,7 +298,7 @@ def _run_tiles(
 def run_pipeline(
     topo: Topology,
     payloads: List[bytes],
-    verify_backend: str = "oracle",
+    verify_backend: str = "cpu",
     verify_batch: int = 128,
     verify_max_msg_len: Optional[int] = None,
     bank_cnt: int = 4,
@@ -336,7 +336,7 @@ def run_quic_pipeline(
     client_fn,
     n_txns: int,
     identity_seed: bytes = b"\x11" * 32,
-    verify_backend: str = "oracle",
+    verify_backend: str = "cpu",
     verify_batch: int = 128,
     verify_max_msg_len: Optional[int] = None,
     bank_cnt: int = 4,

@@ -114,7 +114,7 @@ def _supervised(
     topo: Topology,
     payloads: List[bytes],
     tmp: str,
-    verify_backend: str = "oracle",
+    verify_backend: str = "cpu",
     verify_batch: int = 128,
     verify_max_msg_len: Optional[int] = None,
     bank_cnt: int = 4,

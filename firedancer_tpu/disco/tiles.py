@@ -457,11 +457,30 @@ class _InflightBatch:
     t_dispatch: int                # tickcount at dispatch (diag)
 
 
+class _ReadyBatch:
+    """Completed-synchronously result with the async-batch surface
+    (_complete polls .is_ready() and np.asarray's the result)."""
+
+    def __init__(self, statuses):
+        self._s = statuses
+
+    def is_ready(self) -> bool:
+        return True
+
+    def __array__(self, dtype=None):
+        import numpy as _np
+
+        return _np.asarray(self._s, dtype=dtype)
+
+
 class VerifyTile(Tile):
     """Sigverify: parse txn in-tile, ha-dedup, verify signatures, forward.
 
-    backend='oracle' verifies per-txn on CPU (the bit-exact reference
-    path); backend='tpu' accumulates a batch and dispatches the fused
+    backend='cpu' verifies per-txn on the host — the native C++
+    verifier when built, else the Python oracle. backend='oracle' PINS
+    the pure-Python reference implementation (differential tests rely
+    on it being the bit-exact oracle, never an out-of-band .so).
+    backend='tpu' accumulates a batch and dispatches the fused
     verify_batch XLA program ASYNCHRONOUSLY (the wiredancer offload shim,
     wd_f1.c:327-408): up to `inflight` batches are in flight on the device
     while the tile keeps draining its in-ring; completions are polled
@@ -480,7 +499,7 @@ class VerifyTile(Tile):
         cnc_name,
         in_link,
         out_link,
-        backend: str = "oracle",
+        backend: str = "cpu",
         batch: int = 128,
         max_msg_len: int = FD_TPU_MTU,
         tcache_depth: int = 4096,
@@ -492,7 +511,7 @@ class VerifyTile(Tile):
         **kw,
     ):
         super().__init__(wksp, cnc_name, in_link=in_link, out_link=out_link, **kw)
-        assert backend in ("oracle", "tpu")
+        assert backend in ("oracle", "cpu", "tpu")
         assert verify_mode in ("direct", "rlc")
         if verify_mode == "rlc" and backend != "tpu":
             # Silently running the oracle path while the operator believes
@@ -548,14 +567,22 @@ class VerifyTile(Tile):
         # counters) are preserved — parse is differentially fuzz-tested
         # against ballet/txn.py.
         self._nd = False
+        self._jnp = None
         from firedancer_tpu.ballet.txn import MAX_SIG_CNT
 
-        if (backend == "tpu" and native_drain and in_link is not None
-                and batch >= MAX_SIG_CNT):
+        if (backend in ("tpu", "cpu") and native_drain
+                and in_link is not None and batch >= MAX_SIG_CNT):
             # batch >= MAX_SIG_CNT guarantees every parseable txn fits a
             # fresh batch; smaller batches fall back to the Python path,
             # which oracles outsized multisig txns instead of dropping.
-            self._nd_setup()
+            # backend='cpu' additionally needs the native verifier: the
+            # drained staging layout feeds fd_ed25519_cpu_verify_batch
+            # directly (one C call per batch — the per-frag Python loop
+            # was the replay gate's 30x cap).
+            from firedancer_tpu.ballet.ed25519 import native as _ed_native
+
+            if backend == "tpu" or _ed_native.available():
+                self._nd_setup()
         if backend == "tpu":
             import jax
             import jax.numpy as jnp
@@ -743,12 +770,22 @@ class VerifyTile(Tile):
         while len(self._inflight) >= self.inflight_max:
             self.stat_inflight_stall += 1
             self._complete(block=True)
-        out = self._verify_batch_fn(
-            jnp.asarray(self._nd_msgs.copy()),
-            jnp.asarray(self._nd_lens.astype(np.int32)),
-            jnp.asarray(self._nd_sigs.copy()),
-            jnp.asarray(self._nd_pubs.copy()),
-        )
+        if self.backend == "cpu":
+            # Host path: one synchronous C call over the staged rows —
+            # no copies (the buffers are free to reuse once it returns).
+            from firedancer_tpu.ballet.ed25519 import native as ed_native
+
+            out = _ReadyBatch(ed_native.verify_arrays(
+                self._nd_msgs, self._nd_lens, self._nd_sigs,
+                self._nd_pubs, self._pending_lanes,
+            ))
+        else:
+            out = self._verify_batch_fn(
+                jnp.asarray(self._nd_msgs.copy()),
+                jnp.asarray(self._nd_lens.astype(np.int32)),
+                jnp.asarray(self._nd_sigs.copy()),
+                jnp.asarray(self._nd_pubs.copy()),
+            )
         todo = self._pending
         self._pending = []
         self._pending_lanes = 0
@@ -793,13 +830,23 @@ class VerifyTile(Tile):
             self._flush_if_due()  # see TxnParseError path
             return
         items = list(txn.verify_items(payload))
-        if self.backend == "oracle":
-            # Bulk path: the native C++ verifier (>=10k/s/core) when
-            # built, else the Python oracle — same status contract,
-            # differentially pinned in tests/test_ed25519_cpu.py.
-            from firedancer_tpu.ballet.ed25519 import native as ed_native
+        if self.backend in ("cpu", "oracle"):
+            if self.backend == "cpu":
+                # Bulk path: the native C++ verifier (>=10k/s/core) when
+                # built, else the Python oracle — same status contract,
+                # differentially pinned in tests/test_ed25519_cpu.py.
+                from firedancer_tpu.ballet.ed25519 import native as ed_native
 
-            ok = all(st == 0 for st in ed_native.verify_items(items))
+                statuses = ed_native.verify_items(items)
+            else:
+                # 'oracle' pins the pure-Python reference — a
+                # cross-check lane must never silently dispatch to an
+                # out-of-band .so (round-4 advisor finding).
+                from firedancer_tpu.ballet.ed25519 import oracle as ed_oracle
+
+                statuses = [ed_oracle.verify(msg, sig, pub)
+                            for (sig, pub, msg) in items]
+            ok = all(st == 0 for st in statuses)
             self._finish(payload, ok, tsorig=frag.tsorig)
             self._ack_inline(frag)
             return
@@ -892,7 +939,7 @@ class VerifyTile(Tile):
     def on_halt(self) -> None:
         # Drain device work so no async computation outlives the tile;
         # results are published best-effort (publish_backp drops on HALT).
-        if self._pending and self.backend == "tpu":
+        if self._pending and (self.backend == "tpu" or self._nd):
             self._dispatch(force=True)
         self._complete(block=True, drain_all=True)
 

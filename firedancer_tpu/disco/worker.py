@@ -61,7 +61,7 @@ def build_tile(wksp, pod, name: str, opts: dict):
                 wksp, pod, lane_link("verify_dedup", lane),
                 lane_link("verify_dedup", lane), mtu,
             ),
-            backend=opts.get("verify_backend", "oracle"),
+            backend=opts.get("verify_backend", "cpu"),
             batch=opts.get("verify_batch", 128),
             max_msg_len=opts.get("verify_max_msg_len") or mtu,
             tcache_depth=opts.get("tcache_depth", 4096),

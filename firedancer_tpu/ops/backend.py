@@ -38,9 +38,17 @@ def use_pallas(env_var: str) -> bool:
     return platform in TPU_PLATFORMS
 
 
+def kernel_mul_impl() -> str:
+    """In-kernel field-multiply schedule, decided at trace time:
+    'schoolbook' (int32, the r3 baseline), 'karatsuba' (576 vs 1024
+    VPU products, more adds), or 'f32' (exact-f32-product convolution —
+    wins when the VPU's int32 multiply is emulated multi-pass while f32
+    multiply is single-pass; products bounded < 2^24 stay exact)."""
+    impl = os.environ.get("FD_MUL_IMPL", "schoolbook")
+    if impl not in ("schoolbook", "karatsuba", "f32", "rolled", "factored"):
+        impl = "schoolbook"
+    return impl
+
+
 def use_karatsuba() -> bool:
-    """FD_MUL_IMPL=karatsuba swaps the in-kernel schoolbook multiply
-    for the two-level Karatsuba schedule (fe25519.fe_mul_karatsuba) —
-    fewer VPU multiplies, more adds; enabled when the on-chip probe
-    (scripts/kernel_probe.py) shows int32 mul >> add cost."""
-    return os.environ.get("FD_MUL_IMPL", "schoolbook") == "karatsuba"
+    return kernel_mul_impl() == "karatsuba"

@@ -78,6 +78,54 @@ def decompress_auto(y_bytes: jnp.ndarray, want_x_zero: bool = False,
     return decompress_xla(y_bytes, want_x_zero)
 
 
+def small_order_mask(p):
+    """Lane mask: 8*P == identity (order divides the cofactor), the
+    reference's fd_ed25519_ge_p3_is_small_order (fd_ed25519_ge.c:62-66)
+    as 3 batched doublings + projective identity test."""
+    t = p
+    for _ in range(3):
+        t = point_double(t, need_t=False)
+    x8, y8, z8, _ = t
+    return fe.fe_is_zero(x8) & fe.fe_is_zero(fe.fe_sub(y8, z8))
+
+
+def point_eq_affine_xla(aff, proj):
+    """Lane mask: affine point (ax, ay) equals projective (X:Y:Z).
+    The reference verify's final compare (fd_ed25519_user.c:424-430):
+    ax*Z == X and ay*Z == Y — no inversion."""
+    ax, ay = aff
+    x, y, z, _ = proj
+    return (fe.fe_is_zero(fe.fe_sub(fe.fe_mul(ax, z), x))
+            & fe.fe_is_zero(fe.fe_sub(fe.fe_mul(ay, z), y)))
+
+
+def decompress_so_auto(y_bytes: jnp.ndarray):
+    """Decompress + small-order lane mask, backend-dispatched. On the
+    kernel path the mask is computed in-VMEM on the just-decompressed
+    point (3 doublings, no extra HBM traffic); failed lanes carry the
+    identity poison and so read small_order=True — callers must gate on
+    ok first (the verify status ladder does)."""
+    from .backend import use_pallas
+
+    if use_pallas("FD_DECOMPRESS_IMPL"):
+        from .curve_pallas import decompress_pallas
+
+        return decompress_pallas(y_bytes, want_small_order=True)
+    pt, ok = decompress_xla(y_bytes)
+    return pt, ok, small_order_mask(pt)
+
+
+def point_eq_affine_auto(aff, proj):
+    """Backend-dispatched affine-vs-projective point equality."""
+    from .backend import use_pallas
+
+    if use_pallas("FD_COMPRESS_IMPL"):
+        from .curve_pallas import point_eq_affine_pallas
+
+        return point_eq_affine_pallas(aff, proj)
+    return point_eq_affine_xla(aff, proj)
+
+
 def compress_auto(p) -> jnp.ndarray:
     """Backend-dispatched compress: fused Pallas kernel on TPU."""
     from .backend import use_pallas

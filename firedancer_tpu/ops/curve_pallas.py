@@ -59,6 +59,38 @@ def _const_cols() -> np.ndarray:
     return out
 
 
+def _point_double_k(x1, y1, z1):
+    """dbl-2008-hwcd a=-1 (T-free), in-kernel field ops."""
+    a = _sq(x1)
+    b = _sq(y1)
+    zz = _sq(z1)
+    c = fe.fe_add(zz, zz)
+    d_ = fe.fe_neg(a)
+    e = fe.fe_sub(fe.fe_sub(_sq(fe.fe_add(x1, y1)), a), b)
+    g = fe.fe_add(d_, b)
+    f = fe.fe_sub(g, c)
+    h = fe.fe_sub(d_, b)
+    return _mul(e, f), _mul(g, h), _mul(f, g)
+
+
+def _small_order_k(x, y, z):
+    """(1, L) mask: 8*P == identity — the reference's
+    fd_ed25519_ge_p3_is_small_order (fd_ed25519_ge.c:62-66), in-VMEM."""
+    for _ in range(3):
+        x, y, z = _point_double_k(x, y, z)
+    return fe.fe_is_zero_k(x) * fe.fe_is_zero_k(fe.fe_sub(y, z))
+
+
+def _decompress_so_kernel(yin, sign, consts, ox, oy, oz, ot, ook, oxz,
+                          oso):
+    """_decompress_kernel plus the small-order mask, computed on the
+    just-decompressed point while it sits in VMEM (the verify path's
+    2-point semantics; failed lanes carry the identity poison, which
+    reads small_order=1 — callers gate on ok first)."""
+    _decompress_body(yin, sign, consts, ox, oy, oz, ot, ook, oxz)
+    oso[...] = _small_order_k(ox[...], oy[...], oz[...])
+
+
 def _decompress_niels_kernel(yin, sign, consts, ox, oy, oz, ot, ook, oxz,
                              oyp, oym, ot2d, ot2dn):
     """_decompress_kernel plus niels-form outputs for the MSM fills:
@@ -127,7 +159,8 @@ def _decompress_body(yin, sign, consts, ox, oy, oz, ot, ook, oxz):
 def decompress_pallas(y_bytes: jnp.ndarray, interpret: bool = False,
                       lanes: int | None = None,
                       want_x_zero: bool = False,
-                      want_niels: bool = False):
+                      want_niels: bool = False,
+                      want_small_order: bool = False):
     """Drop-in for curve25519.decompress on TPU: (B, 32) uint8 ->
     ((X, Y, Z, T) of (32, B) limbs, (B,) bool ok). lanes overrides the
     kernel tile width (tests use a small tile to exercise padding).
@@ -139,6 +172,8 @@ def decompress_pallas(y_bytes: jnp.ndarray, interpret: bool = False,
     path (bsz >= 128) when want_niels is set."""
     from jax.experimental import pallas as pl
 
+    if want_niels and want_small_order:
+        raise ValueError("want_niels and want_small_order are exclusive")
     bsz = y_bytes.shape[0]
     if bsz < MIN_KERNEL_BATCH:
         # Sub-tile batches: the XLA path beats a padded kernel launch.
@@ -146,6 +181,9 @@ def decompress_pallas(y_bytes: jnp.ndarray, interpret: bool = False,
 
         if want_niels:
             raise ValueError("want_niels requires a kernel-tile batch")
+        if want_small_order:
+            pt, ok = ge.decompress_xla(y_bytes)
+            return pt, ok, ge.small_order_mask(pt)
         return ge.decompress_xla(y_bytes, want_x_zero)
     sign = (y_bytes[:, 31] >> 7).astype(jnp.int32)[None, :]    # (1, B)
     y = fe.fe_from_bytes(y_bytes, mask_high_bit=True)          # (32, B)
@@ -162,30 +200,84 @@ def decompress_pallas(y_bytes: jnp.ndarray, interpret: bool = False,
     out_fe = jax.ShapeDtypeStruct((NLIMBS, bsz + pad), jnp.int32)
     out_row = jax.ShapeDtypeStruct((1, bsz + pad), jnp.int32)
     n_fe_out = 8 if want_niels else 4
+    n_row_out = 3 if want_small_order else 2
+    if want_niels:
+        kernel = _decompress_niels_kernel
+    elif want_small_order:
+        kernel = _decompress_so_kernel
+    else:
+        kernel = _decompress_kernel
     outs = pl.pallas_call(
-        _decompress_niels_kernel if want_niels else _decompress_kernel,
+        kernel,
         grid=(n,),
         in_specs=[spec_fe, spec_row, spec_c],
-        out_specs=[spec_fe] * 4 + [spec_row] * 2
+        out_specs=[spec_fe] * 4 + [spec_row] * n_row_out
         + [spec_fe] * (n_fe_out - 4),
-        out_shape=[out_fe] * 4 + [out_row] * 2
+        out_shape=[out_fe] * 4 + [out_row] * n_row_out
         + [out_fe] * (n_fe_out - 4),
         interpret=interpret,
     )(y, sign, jnp.asarray(_const_cols()))
     x, yy, z, t = outs[:4]
     ok, xz = outs[4:6]
-    niels = outs[6:]
+    so = outs[6] if want_small_order else None
+    niels = outs[6:] if want_niels else ()
     if pad:
         x, yy, z, t = (c[:, :bsz] for c in (x, yy, z, t))
         niels = tuple(c[:, :bsz] for c in niels)
         ok = ok[:, :bsz]
         xz = xz[:, :bsz]
+        if so is not None:
+            so = so[:, :bsz]
     ret = [(x, yy, z, t), ok[0] != 0]
     if want_x_zero:
         ret.append(xz[0] != 0)
     if want_niels:
         ret.append(tuple(niels))
+    if want_small_order:
+        ret.append(so[0] != 0)
     return tuple(ret)
+
+
+def _point_eq_kernel(axin, ayin, xin, yin, zin, om):
+    """(1, L) mask: affine (ax, ay) == projective (X:Y:Z) — the verify
+    2-point final compare (fd_ed25519_user.c:424-430): ax*Z == X and
+    ay*Z == Y, two in-VMEM muls + zero tests, no inversion."""
+    z = zin[...]
+    d1 = fe.fe_sub(_mul(axin[...], z), xin[...])
+    d2 = fe.fe_sub(_mul(ayin[...], z), yin[...])
+    om[...] = fe.fe_is_zero_k(d1) * fe.fe_is_zero_k(d2)
+
+
+def point_eq_affine_pallas(aff, proj, interpret: bool = False,
+                           lanes: int | None = None):
+    """Drop-in for curve25519.point_eq_affine_xla on TPU: (B,) bool."""
+    from jax.experimental import pallas as pl
+
+    ax, ay = aff
+    x, y, z, _ = proj
+    bsz = ax.shape[1]
+    if bsz < MIN_KERNEL_BATCH:
+        from . import curve25519 as ge
+
+        return ge.point_eq_affine_xla(aff, proj)
+    lanes = lanes or min(LANES, bsz)
+    pad = (-bsz) % lanes
+    if pad:
+        # Pad lanes are sliced off before return; their values are moot.
+        ax, ay, x, y, z = (jnp.pad(c, ((0, 0), (0, pad)))
+                           for c in (ax, ay, x, y, z))
+    n = (bsz + pad) // lanes
+    spec_fe = pl.BlockSpec((NLIMBS, lanes), lambda i: (0, i))
+    spec_row = pl.BlockSpec((1, lanes), lambda i: (0, i))
+    m = pl.pallas_call(
+        _point_eq_kernel,
+        grid=(n,),
+        in_specs=[spec_fe] * 5,
+        out_specs=spec_row,
+        out_shape=jax.ShapeDtypeStruct((1, bsz + pad), jnp.int32),
+        interpret=interpret,
+    )(ax, ay, x, y, z)
+    return m[0, :bsz] != 0
 
 
 def _compress_kernel(xin, yin, zin, ocy, osign):

@@ -32,16 +32,42 @@ NLIMBS = fe.NLIMBS
 LANES = int(__import__("os").environ.get("FD_DSM_LANES", "1024"))
 
 
+def _lanes_for_impl() -> int:
+    """The rolled multiply keeps 7 extra (64, L) roll temporaries live,
+    which blows the 16 MiB scoped-VMEM stack at L=1024 (measured:
+    19.21M needed). Cap its default tile at 512 unless FD_DSM_LANES
+    explicitly overrides."""
+    import os as _os
+
+    from .backend import kernel_mul_impl
+
+    if "FD_DSM_LANES" in _os.environ:
+        return LANES
+    if kernel_mul_impl() == "rolled":
+        return min(LANES, 512)
+    return LANES
+
+
 def _fe_mul(a, b):
     return fe.fe_mul_kernel(a, b)
 
 
 def _fe_sq(a):
-    """Kernel squaring: specialized fe_sq, or plain multiply under the
-    FD_SQ_IMPL=mul escape hatch (see backend.use_specialized_square)."""
-    from .backend import use_specialized_square
+    """Kernel squaring: specialized fe_sq (f32-product variant when
+    FD_MUL_IMPL=f32), or plain multiply under the FD_SQ_IMPL=mul
+    escape hatch (see backend.use_specialized_square)."""
+    from .backend import kernel_mul_impl, use_specialized_square
 
+    impl = kernel_mul_impl()
+    if impl == "rolled" and not use_specialized_square():
+        # Probe finding (kernel_probe3): fe_sq's 528-product half-
+        # triangle is MOVEMENT-bound (~fe_mul cost despite half the
+        # products) — rolled(a, a) and fe_sq measure within noise of
+        # each other, so FD_SQ_IMPL picks (A/B'd at the DSM level).
+        return fe.fe_mul_rolled(a, a)
     if use_specialized_square():
+        if impl == "f32":
+            return fe.fe_sq_f32(a)
         return fe.fe_sq(a)
     return fe.fe_mul_kernel(a, a)
 
@@ -134,17 +160,30 @@ def _dsm_kernel(ax, ay, az, at, hw, sw, btab, ox, oy, oz, *, n_windows=64):
         b_table.append(coords)
     b_table = _stack_table(b_table)
 
+    # FD_DSM_DEBUG (trace-time, TIMING ATTRIBUTION ONLY — results are
+    # WRONG): 'doubles_only' drops both table adds+lookups;
+    # 'no_badd' drops the B-side lookup+add. Used by
+    # scripts/dsm_attrib.py to split the window cost into
+    # doubles / A-add / B-add shares; never set in production.
+    dbg = __import__("os").environ.get("FD_DSM_DEBUG", "")
+
     def body(wi, r3):
         import jax.experimental.pallas as pl
 
         r = (*r3, None)
         for _ in range(3):
             r = _point_double(r, need_t=False)
-        r = _point_double(r, need_t=True)
+        need_t_last = dbg != "doubles_only"
+        r = _point_double(r, need_t=need_t_last)
+        if dbg == "doubles_only":
+            return (r[0], r[1], r[2])
         idx = 63 - wi
         wh = hw[pl.ds(idx, 1), :]                     # (1, L)
+        r = _point_add(r, _lookup(a_table, wh), d2,
+                       need_t=dbg != "no_badd")
+        if dbg == "no_badd":
+            return (r[0], r[1], r[2])
         ws = sw[pl.ds(idx, 1), :]
-        r = _point_add(r, _lookup(a_table, wh), d2, need_t=True)
         x, y, z, _ = _point_add(r, _lookup(b_table, ws), d2, need_t=False)
         return (x, y, z)
 
@@ -195,7 +234,7 @@ def double_scalarmult_pallas(h_bytes, a_point, s_bytes, interpret=False,
         # Match the XLA path: an empty batch yields empty limb arrays.
         empty = jnp.zeros((NLIMBS, 0), jnp.int32)
         return (empty, empty, empty, None)
-    lanes = min(LANES, bsz)
+    lanes = min(_lanes_for_impl(), bsz)
     pad = (-bsz) % lanes
     if pad:
         hw = jnp.pad(hw, ((0, 0), (0, pad)))

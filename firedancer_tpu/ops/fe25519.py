@@ -220,14 +220,158 @@ def fe_mul_karatsuba(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return _carry_pass(r, 3)
 
 
-def fe_mul_kernel(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """The multiply used INSIDE Pallas kernels: schoolbook
-    (fe_mul_unrolled) by default, Karatsuba under FD_MUL_IMPL=karatsuba
-    (decided at trace time; see backend.use_karatsuba)."""
-    from .backend import use_karatsuba
+def fe_mul_rolled(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """fe_mul_unrolled with the sublane-rotation count cut 32 -> 7.
 
-    if use_karatsuba():
+    Round-5 probe finding (scripts/kernel_probe3.py, v5e): a plain
+    mul+add on a (32, 1024) tile costs ~2.2 ns, but the same op reading
+    a sublane-MISALIGNED slice costs ~23 ns, and fe_mul_unrolled's 32
+    bext[32-i : 64-i] slices are misaligned for every i not = 0 mod 8 —
+    the multiply's cost is ~all data movement. This schedule precomputes
+    the 7 nontrivial sublane rotations of bext ONCE (rolls[r][j] =
+    bext[(j - r) mod 64]) and reads every term from an ALIGNED 32-row
+    window of the right roll: bext[32-i : 64-i] = rolls[i % 8]
+    [32 - 8*(i//8) : 64 - 8*(i//8)], whose start is a multiple of 8
+    (the vreg sublane height). The modular wrap rows of rolls[r]
+    (indices < r) are never read: every window starts at >= 8 > r.
+
+    Same contract as fe_mul_unrolled: |limb| <= 1024 in, <= 512 out.
+    """
+    bext = jnp.concatenate([38 * b, b], axis=0)          # (64, *batch)
+    rolls = [bext]
+    for r in range(1, 8):
+        rolls.append(jnp.concatenate([bext[NLIMBS * 2 - r:],
+                                      bext[:NLIMBS * 2 - r]], axis=0))
+    acc = None
+    for i in range(NLIMBS):
+        q, r = divmod(i, 8)
+        s = NLIMBS - 8 * q
+        term = a[i:i + 1] * rolls[r][s:s + NLIMBS]
+        acc = term if acc is None else acc + term
+    return _carry_pass(acc, 4)
+
+
+def fe_mul_factored(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """fe_mul with the sublane rotations factored OUT of the term sum.
+
+    Same alignment insight as fe_mul_rolled (misaligned sublane slices
+    cost ~10x a plain op on v5e), but instead of materializing 7
+    rotated copies of bext (whose (64, L) temporaries blow the 16 MiB
+    scoped-VMEM stack at L=1024), the rotation is applied to the SUMS:
+
+        c = sum_r shift_r( sum_q a_{8q+r} * bext[24-8q : 64-8q] )
+
+    Every inner window is a 40-row ALIGNED slice (starts 24-8q, all
+    multiples of 8); each r needs ONE misaligned 32-row slice of its
+    40-row partial (rows 8-r .. 40-r). 8 misaligned slices per multiply
+    instead of fe_mul_unrolled's 32, with ~130 rows of live scratch.
+
+    Index check: out[j] needs a_i * bext[32-i+j] (i = 8q+r); the
+    partial's window row k holds bext[24-8q+k], and the slice takes
+    k = 8-r+j -> bext[32-8q-r+j]. Same contract as fe_mul_unrolled:
+    |limb| <= 1024 in, <= 512 out.
+    """
+    bext = jnp.concatenate([38 * b, b], axis=0)          # (64, *batch)
+    acc = None
+    for r in range(8):
+        part = None
+        for q in range(4):
+            i = 8 * q + r
+            w = bext[24 - 8 * q:64 - 8 * q]              # 40 rows, aligned
+            t = a[i:i + 1] * w
+            part = t if part is None else part + t
+        sl = part[8 - r:40 - r]                          # 32 rows
+        acc = sl if acc is None else acc + sl
+    return _carry_pass(acc, 4)
+
+
+def fe_mul_f32(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply with EXACT f32 products (round-5 candidate for
+    the VPU hot loop: TPU f32 multiply is single-pass where int32
+    multiply may be emulated).
+
+    Contract: |limb| <= 512 on both inputs (every public-op output
+    satisfies it: fe_mul/fe_sq <= 293, fe_add/fe_sub/fe_neg <= 407).
+    The full 63-row convolution runs in f32 — worst row sums 32 terms
+    of <= 512*512 so every partial sum is < 2^23 < 2^24 and each f32
+    add is exact. The 38-fold (2^256 = 38 mod p) and carries run in
+    int32 (fold values < 2^27). Kernel-safe: static slices + concat.
+    """
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    L = a.shape[1:]
+
+    lo = af[0:1] * bf                     # conv rows 0..31
+    hi = None                             # conv rows 32..62
+    for i in range(1, NLIMBS):
+        p = af[i:i + 1] * bf              # (32, *batch) at offset i
+        head = p[:NLIMBS - i]             # rows i..31 of lo
+        tail = p[NLIMBS - i:]             # rows 32..32+i-1 of hi
+        lo = lo + jnp.concatenate(
+            [jnp.zeros((i,) + L, jnp.float32), head], axis=0)
+        t = jnp.concatenate(
+            [tail, jnp.zeros((NLIMBS - i,) + L, jnp.float32)], axis=0)
+        hi = t if hi is None else hi + t
+    c = lo.astype(jnp.int32) + 38 * hi.astype(jnp.int32)
+    return _carry_pass(c, 4)
+
+
+def fe_sq_f32(a: jnp.ndarray) -> jnp.ndarray:
+    """fe_sq with exact f32 products (same half-triangle schedule).
+
+    Contract: |limb| <= 512. Terms a_i * (2a)_j are <= 512*1024 = 2^19
+    with <= 16 terms per row -> partial sums < 2^23: exact in f32. The
+    38-wrap and the even/odd interleave run in int32.
+    """
+    batch = a.shape[1:]
+    af = a.astype(jnp.float32)
+    ad = af + af
+
+    def pad_rows(x, lo_, hi_):
+        parts = []
+        if lo_:
+            parts.append(jnp.zeros((lo_,) + batch, jnp.float32))
+        parts.append(x)
+        if hi_:
+            parts.append(jnp.zeros((hi_,) + batch, jnp.float32))
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts, 0)
+
+    ev = af * af                                # d=0: a_q^2 at k=2q
+    for e in range(1, NLIMBS // 2):             # d = 2e
+        ev = ev + pad_rows(af[: NLIMBS - 2 * e] * ad[2 * e:], e, e)
+    od = None
+    for e in range(NLIMBS // 2):                # d = 2e + 1
+        p = pad_rows(af[: NLIMBS - 1 - 2 * e] * ad[2 * e + 1:], e, e)
+        od = p if od is None else od + p
+    half = NLIMBS // 2
+    evi = ev.astype(jnp.int32)
+    odi = od.astype(jnp.int32)
+    z1 = jnp.zeros((1,) + batch, jnp.int32)
+    ce = evi[:half] + 38 * evi[half:]
+    co = odi[:half] + 38 * jnp.concatenate([odi[half:], z1], axis=0)
+    rows = []
+    for q in range(half):
+        rows.append(ce[q:q + 1])
+        rows.append(co[q:q + 1])
+    c = jnp.concatenate(rows, axis=0)
+    return _carry_pass(c, 4)
+
+
+def fe_mul_kernel(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """The multiply used INSIDE Pallas kernels, dispatched at trace
+    time by FD_MUL_IMPL: schoolbook int32 (default), karatsuba, or f32
+    (exact-f32-product conv; see backend.kernel_mul_impl)."""
+    from .backend import kernel_mul_impl
+
+    impl = kernel_mul_impl()
+    if impl == "karatsuba":
         return fe_mul_karatsuba(a, b)
+    if impl == "f32":
+        return fe_mul_f32(a, b)
+    if impl == "rolled":
+        return fe_mul_rolled(a, b)
+    if impl == "factored":
+        return fe_mul_factored(a, b)
     return fe_mul_unrolled(a, b)
 
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -35,26 +36,51 @@ def _mul(a, b):
 
 
 def _sq(x):
-    """Kernel squaring with the FD_SQ_IMPL=mul escape hatch (see
-    backend.use_specialized_square)."""
-    from .backend import use_specialized_square
+    """Kernel squaring (f32-product variant under FD_MUL_IMPL=f32) with
+    the FD_SQ_IMPL=mul escape hatch (backend.use_specialized_square)."""
+    from .backend import kernel_mul_impl, use_specialized_square
 
+    impl = kernel_mul_impl()
+    if impl == "rolled" and not use_specialized_square():
+        # Movement-bound squaring: rolled(x, x) vs fe_sq is decided by
+        # FD_SQ_IMPL (see dsm_pallas._fe_sq).
+        return fe.fe_mul_rolled(x, x)
     if use_specialized_square():
+        if impl == "f32":
+            return fe.fe_sq_f32(x)
         return fe.fe_sq(x)
     return _mul(x, x)
 
 
 def _sqn(x, n):
-    """n successive squarings. Long runs ride lax.fori_loop so the
-    traced kernel stays compact — the chain has ~250 squarings and a
-    fully unrolled trace (~100 ops each) dominated kernel compile time;
-    per-step loop overhead in-VMEM is noise next to the 528-product
-    square itself. Short runs stay unrolled (loop setup isn't free)."""
+    """n successive squarings, BLOCK-unrolled inside lax.fori_loop.
+
+    Round-4 put the long runs in a per-squaring fori_loop to shrink
+    compile time, asserting the per-step loop overhead was noise — an
+    assumption that was never re-measured on chip (the tunnel was down
+    the whole round). Round-5 hedges both ways: FD_POW_BLOCK squarings
+    (default 10) are unrolled per loop iteration, cutting the loop-step
+    count ~10x while the traced body stays ~1k ops. FD_POW_BLOCK=1
+    reproduces the round-4 shape for A/B timing; a block >= n fully
+    unrolls."""
     if n <= 8:
         for _ in range(n):
             x = _sq(x)
         return x
-    return jax.lax.fori_loop(0, n, lambda i, v: _sq(v), x)
+    block = int(os.environ.get("FD_POW_BLOCK", "10"))
+    block = max(1, block)
+    nb, rem = divmod(n, block)
+
+    def body(i, v):
+        for _ in range(block):
+            v = _sq(v)
+        return v
+
+    if nb:
+        x = jax.lax.fori_loop(0, nb, body, x)
+    for _ in range(rem):
+        x = _sq(x)
+    return x
 
 
 def _ladder(z):

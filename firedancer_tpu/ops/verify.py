@@ -67,7 +67,20 @@ def verify_batch(
 
     s_ok = sc.sc_check_range(s_bytes)
 
-    a_point, pub_ok = ge.decompress_auto(pubkeys)
+    # 2-point scheme (the reference DEFAULT, fd_ed25519_user.c:399-430,
+    # FD_ED25519_VERIFY_USE_2POINT=1; pinned by the 396 Zcash
+    # malleability vectors): decompress A AND R in ONE batched pass,
+    # reject small-order A (ERR_PUBKEY) / R (ERR_SIG), and compare
+    # h*(-A)+s*B against the DECODED R as group elements — which also
+    # deletes the compress inversion chain from the graph.
+    ar = jnp.concatenate([pubkeys, r_bytes], axis=0)       # (2B, 32)
+    ar_pt, ar_ok, ar_so = ge.decompress_so_auto(ar)
+    a_point = tuple(c[:, :bsz] for c in ar_pt)
+    rd_point = tuple(c[:, bsz:] for c in ar_pt)
+    pub_ok = ar_ok[:bsz]
+    r_dec_ok = ar_ok[bsz:]
+    a_small = ar_so[:bsz]
+    r_small = ar_so[bsz:]
     neg_a = ge.point_neg(a_point)
 
     # h = SHA-512(r || pub || msg) mod L. One batched hash over the
@@ -77,16 +90,26 @@ def verify_batch(
     h_bytes = sc.sc_reduce64_auto(h64)
 
     r_prime = _dsm_auto()(h_bytes, neg_a, s_bytes)
-    r_enc = ge.compress_auto(r_prime)
-    r_match = jnp.all(r_enc == r_bytes, axis=-1)
+    # Rd is affine (decompress emits Z=1): projective cross-compare.
+    r_match = ge.point_eq_affine_auto(
+        (rd_point[0], rd_point[1]), r_prime)
 
+    # Priority ladder, matching the reference exactly: s-range (SIG),
+    # A/R decompress failure (PUBKEY — frombytes_vartime_2 reports both
+    # as ERR_PUBKEY), small-order A (PUBKEY), small-order R (SIG), then
+    # the group-element compare (MSG).
     status = jnp.where(
         ~s_ok,
         FD_ED25519_ERR_SIG,
         jnp.where(
-            ~pub_ok,
+            ~pub_ok | ~r_dec_ok | a_small,
             FD_ED25519_ERR_PUBKEY,
-            jnp.where(r_match, FD_ED25519_SUCCESS, FD_ED25519_ERR_MSG),
+            jnp.where(
+                r_small,
+                FD_ED25519_ERR_SIG,
+                jnp.where(r_match, FD_ED25519_SUCCESS,
+                          FD_ED25519_ERR_MSG),
+            ),
         ),
     ).astype(jnp.int32)
     return status

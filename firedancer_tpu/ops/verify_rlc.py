@@ -32,18 +32,17 @@ torsion defects (K = FD_RLC_TORSION_K, default 64). Honest traffic
 (real keys and nonces are prime-order) never trips the check; a
 tripped check only routes the batch to the exact per-lane path.
 
-Semantics parity with the reference's byte-compare verify
-(fd_ed25519_user.c:346-433, see ops/verify.py):
-- s range check (ERR_SIG) and A decompress (ERR_PUBKEY) exactly as the
-  per-lane path.
-- The reference compares compress(h*(-A) + s*B) against the r bytes.
-  For that byte-compare to succeed, r MUST be the canonical encoding of
-  a curve point (compress only emits canonical encodings). So lanes
-  whose r bytes fail decompression or are non-canonical are definite
-  ERR_MSG — they are excluded from the combination (z_i = 0) with their
-  status already decided. For the remaining lanes, canonical-encoding
-  injectivity gives: bytes equal <=> R' == R as group elements, which
-  is exactly what the RLC equation tests.
+Semantics parity with the reference's DEFAULT (2-point) verify
+(fd_ed25519_user.c:346-433, FD_ED25519_VERIFY_USE_2POINT=1; round-5,
+pinned by the 396 Zcash malleability vectors — see ops/verify.py):
+- s range check (ERR_SIG) exactly as the per-lane path.
+- A or R failing decompression is definite ERR_PUBKEY (the reference's
+  frombytes_vartime_2 reports both with the shared code); small-order A
+  is definite ERR_PUBKEY, small-order R definite ERR_SIG. These lanes
+  are excluded from the combination (z_i = 0).
+- The per-lane compare is on GROUP ELEMENTS (projective cross-multiply
+  against the decoded R), so a non-canonical-but-decodable r encoding
+  stays LIVE — the RLC equation on points is exactly the right test.
 
 Failure handling is the caller's job (disco/tiles.py): if the batch
 equation fails, at least one lane is bad — re-dispatch the batch on the
@@ -66,15 +65,10 @@ from . import sc25519 as sc
 from .sha512 import sha512_batch_auto as sha512_batch
 from .sign import _sc_muladd
 from .verify import (
-    FD_ED25519_ERR_MSG,
     FD_ED25519_ERR_PUBKEY,
     FD_ED25519_ERR_SIG,
     FD_ED25519_SUCCESS,
 )
-
-# Canonical little-endian bytes of p, for the r-canonicality compare.
-_P_BYTES = np.array([(fe.P >> (8 * i)) & 0xFF for i in range(32)], np.uint8)
-
 
 def fresh_z(batch: int, rng: np.random.Generator | None = None) -> np.ndarray:
     """(B, 32) uint8: uniform random 126-bit scalars (top 16 bytes zero).
@@ -125,18 +119,6 @@ def fresh_u(k: int, batch: int,
     return (raw.astype(np.int32) & 0x7F).reshape(k, batch)
 
 
-def _bytes_lt_p(b: jnp.ndarray) -> jnp.ndarray:
-    """(B, 32) uint8 (with bit 255 already masked) < p, lexicographic."""
-    pb = jnp.asarray(_P_BYTES, jnp.int32)
-    x = b.astype(jnp.int32)
-    # Most-significant differing byte decides; scan from byte 31 down.
-    lt = jnp.zeros(b.shape[:-1], jnp.bool_)
-    decided = jnp.zeros(b.shape[:-1], jnp.bool_)
-    for i in range(31, -1, -1):
-        xi, pi = x[..., i], pb[i]
-        lt = jnp.where(~decided & (xi < pi), True, lt)
-        decided = decided | (xi != pi)
-    return lt
 
 
 def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
@@ -163,9 +145,9 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     s_ok = sc.sc_check_range(s_bytes)
 
     # One decompression pass over A and R stacked: same lane-work, half
-    # the traced graph (the power chain appears once). The x==0 mask
-    # and the niels forms for the MSM fills ride along from the kernel
-    # (free in-VMEM vs multi-ms XLA chains).
+    # the traced graph (the power chain appears once). The niels forms
+    # for the MSM fills ride along from the kernel (free in-VMEM vs
+    # multi-ms XLA chains).
     from .backend import use_pallas
 
     bsz = pubkeys.shape[0]
@@ -178,23 +160,24 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
                   and 2 * bsz >= MIN_KERNEL_BATCH)
     dec = ge.decompress_auto(
         jnp.concatenate([pubkeys, r_bytes], axis=0),
-        want_x_zero=True, want_niels=want_niels,
+        want_niels=want_niels,
     )
-    both, both_ok, both_xz = dec[:3]
-    both_niels = dec[3] if want_niels else None
+    both, both_ok = dec[:2]
+    both_niels = dec[2] if want_niels else None
     a_point = tuple(c[:, :bsz] for c in both)
     r_point = tuple(c[:, bsz:] for c in both)
     pub_ok = both_ok[:bsz]
     r_dec_ok = both_ok[bsz:]
 
-    # R must also be canonical, else definite ERR_MSG (see module
-    # docstring). Canonical <=> y < p and not (x == 0 with sign bit set).
-    r_sign = (r_bytes[:, 31] >> 7) == 1
-    r_y_lt_p = _bytes_lt_p(
-        r_bytes.astype(jnp.int32).at[:, 31].set(r_bytes[:, 31] & 0x7F)
-    )
-    r_x_zero = both_xz[bsz:]
-    r_ok = r_dec_ok & r_y_lt_p & ~(r_x_zero & r_sign)
+    # 2-point semantics (round-5, pinned by the Zcash malleability
+    # vectors — see ops/verify.py): the per-lane path compares group
+    # ELEMENTS, so a non-canonical-but-decodable r encoding is LIVE
+    # (the RLC equation on points is exactly the right test), an
+    # undecodable r is ERR_PUBKEY (frombytes_vartime_2's shared code),
+    # and small-order A (ERR_PUBKEY) / R (ERR_SIG) are definite fails.
+    so_both = ge.small_order_mask(both)
+    a_small = so_both[:bsz]
+    r_small = so_both[bsz:]
 
     h64 = sha512_batch(
         jnp.concatenate([r_bytes, pubkeys, msgs], axis=1),
@@ -206,12 +189,12 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
         ~s_ok,
         FD_ED25519_ERR_SIG,
         jnp.where(
-            ~pub_ok,
+            ~pub_ok | ~r_dec_ok | a_small,
             FD_ED25519_ERR_PUBKEY,
-            jnp.where(~r_ok, FD_ED25519_ERR_MSG, FD_ED25519_SUCCESS),
+            jnp.where(r_small, FD_ED25519_ERR_SIG, FD_ED25519_SUCCESS),
         ),
     ).astype(jnp.int32)
-    definite = ~(s_ok & pub_ok & r_ok)
+    definite = ~(s_ok & pub_ok & r_dec_ok & ~a_small & ~r_small)
 
     # Zero out excluded lanes' weights; z=0 contributes the identity.
     live = ~definite

@@ -88,3 +88,16 @@ class PcapReader:
 def read_all(path: str) -> List[bytes]:
     with PcapReader(path) as r:
         return [p for _, _, p in r]
+
+
+def read_capture(path: str) -> List[bytes]:
+    """Auto-detecting reader: classic pcap or pcapng, by leading magic
+    (the reference exposes both fd_pcap and fd_pcapng; capture tooling
+    emits either). Returns packet payloads in file order."""
+    with open(path, "rb") as f:
+        magic = f.read(4)
+    if len(magic) == 4 and struct.unpack("<I", magic)[0] == 0x0A0D0D0A:
+        from . import pcapng
+
+        return pcapng.read_all(path)
+    return read_all(path)

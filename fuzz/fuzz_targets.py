@@ -137,6 +137,30 @@ def target_pcap():
     return fn, corpus, (ValueError, EOFError)
 
 
+def target_pcapng():
+    from firedancer_tpu.utils import pcapng
+
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "seed.pcapng")
+    with pcapng.PcapngWriter(path, hardware="fuzz", if_name="lo") as w:
+        w.write(b"\x01" * 64, ts_ns=123456789)
+        w.write_simple(b"\x02" * 32)
+        w.write_tls_keys(b"CLIENT_HANDSHAKE_TRAFFIC_SECRET 00 11\n")
+    with open(path, "rb") as f:
+        corpus = [f.read()]
+
+    def fn(data: bytes) -> None:
+        p = os.path.join(d, "fuzz.pcapng")
+        with open(p, "wb") as f:
+            f.write(data)
+        try:
+            pcapng.read_all(p)
+        except (ValueError, EOFError, struct.error):
+            pass
+
+    return fn, corpus, (ValueError, EOFError)
+
+
 def target_eth_ip_udp():
     from firedancer_tpu.utils import net
 
@@ -239,6 +263,7 @@ ALL_TARGETS = {
     "quic_headers": target_quic_headers,
     "bincode_types": target_bincode_types,
     "pcap": target_pcap,
+    "pcapng": target_pcapng,
     "eth_ip_udp": target_eth_ip_udp,
     "sbpf_loader": target_sbpf_loader,
     "quic_retry_token": target_quic_retry_token,

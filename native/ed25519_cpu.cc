@@ -668,16 +668,54 @@ static ge ge_neg(const ge &p) {
 
 // -------------------------------------------------------------- verify
 
-// Phase A of a verify: everything up to (but excluding) the final
-// R' encoding. Returns 0 with *out_r set when the compare is still
-// pending, else the definitive negative status.
+// Every 32-byte string that decodes (donna semantics) to a SMALL-ORDER
+// point: the 8-torsion subgroup's canonical encodings plus the
+// non-canonical y+p variants (y in {0, 1}) and both sign bits.
+// Generated programmatically from the Python oracle (enumerate the
+// subgroup from the order-8 generator; keep every decodable encoding
+// whose decoded point satisfies 8P == O) — the same public table
+// libsodium/dalek use for their small-order rejection. A byte-compare
+// against this list is EXACTLY "decoded point is small-order", which
+// lets the hot path skip both the 3-doubling checks and the R
+// decompression (see verify_one).
+static const uint8_t TORSION_ENC[14][32] = {
+  {0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00},
+  {0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x80},
+  {0x01, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00},
+  {0x01, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x80},
+  {0x26, 0xe8, 0x95, 0x8f, 0xc2, 0xb2, 0x27, 0xb0, 0x45, 0xc3, 0xf4, 0x89, 0xf2, 0xef, 0x98, 0xf0, 0xd5, 0xdf, 0xac, 0x05, 0xd3, 0xc6, 0x33, 0x39, 0xb1, 0x38, 0x02, 0x88, 0x6d, 0x53, 0xfc, 0x05},
+  {0x26, 0xe8, 0x95, 0x8f, 0xc2, 0xb2, 0x27, 0xb0, 0x45, 0xc3, 0xf4, 0x89, 0xf2, 0xef, 0x98, 0xf0, 0xd5, 0xdf, 0xac, 0x05, 0xd3, 0xc6, 0x33, 0x39, 0xb1, 0x38, 0x02, 0x88, 0x6d, 0x53, 0xfc, 0x85},
+  {0xc7, 0x17, 0x6a, 0x70, 0x3d, 0x4d, 0xd8, 0x4f, 0xba, 0x3c, 0x0b, 0x76, 0x0d, 0x10, 0x67, 0x0f, 0x2a, 0x20, 0x53, 0xfa, 0x2c, 0x39, 0xcc, 0xc6, 0x4e, 0xc7, 0xfd, 0x77, 0x92, 0xac, 0x03, 0x7a},
+  {0xc7, 0x17, 0x6a, 0x70, 0x3d, 0x4d, 0xd8, 0x4f, 0xba, 0x3c, 0x0b, 0x76, 0x0d, 0x10, 0x67, 0x0f, 0x2a, 0x20, 0x53, 0xfa, 0x2c, 0x39, 0xcc, 0xc6, 0x4e, 0xc7, 0xfd, 0x77, 0x92, 0xac, 0x03, 0xfa},
+  {0xec, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f},
+  {0xec, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff},
+  {0xed, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f},
+  {0xed, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff},
+  {0xee, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f},
+  {0xee, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff},
+};
+
+static int is_torsion_encoding(const uint8_t e[32]) {
+  for (int i = 0; i < 14; i++)
+    if (memcmp(e, TORSION_ENC[i], 32) == 0) return 1;
+  return 0;
+}
+
+// Phase A of a verify under the reference's DEFAULT (2-point)
+// semantics (fd_ed25519_user.c:346-433, FD_ED25519_VERIFY_USE_2POINT=1,
+// pinned by the 396 Zcash malleability vectors): s-range, decompress A,
+// small-order A (ERR_PUBKEY) / small-order R (ERR_SIG) via the
+// torsion-encoding table. Returns 0 with *out_r = h*(-A) + s*B when the
+// compare is still pending, else the definitive negative status.
 static int verify_pre(const uint8_t *msg, uint32_t msg_len,
                       const uint8_t sig[64], const uint8_t pub[32],
                       ge *out_r) {
   const uint8_t *s_bytes = sig + 32;
   if (sc_ge_L(s_bytes)) return -1;  // ERR_SIG: s out of range
   ge A;
-  if (!ge_frombytes(A, pub)) return -2;  // ERR_PUBKEY
+  if (!ge_frombytes(A, pub)) return -2;    // ERR_PUBKEY
+  if (is_torsion_encoding(pub)) return -2; // small-order A
+  if (is_torsion_encoding(sig)) return -1; // small-order R
 
   sha512_ctx c;
   sha512_init(c);
@@ -693,6 +731,25 @@ static int verify_pre(const uint8_t *msg, uint32_t msg_len,
   return 0;
 }
 
+// Phase B: the byte-compare fast path is EXACT for canonical r
+// (compress emits canonical encodings; canonical encoding equality <=>
+// group-element equality). On mismatch, the slow path decodes r and
+// compares as group elements — reached only by lanes that are failing
+// anyway or carry a non-canonical r (both rare), so the common case
+// never pays the second decompression the 2-point scheme implies.
+static int verify_post(const ge &R, const uint8_t r_check[32],
+                       const uint8_t sig[64]) {
+  if (memcmp(r_check, sig, 32) == 0) return 0;
+  ge Rd;
+  if (!ge_frombytes(Rd, sig)) return -2;  // ERR_PUBKEY (frombytes_2)
+  uint8_t a0[32], b0[32], a1[32], b1[32];
+  fe_tobytes(a0, fe_mul(Rd.X, R.Z));
+  fe_tobytes(b0, R.X);
+  fe_tobytes(a1, fe_mul(Rd.Y, R.Z));
+  fe_tobytes(b1, R.Y);
+  return (memcmp(a0, b0, 32) == 0 && memcmp(a1, b1, 32) == 0) ? 0 : -3;
+}
+
 static int verify_one(const uint8_t *msg, uint32_t msg_len,
                       const uint8_t sig[64], const uint8_t pub[32]) {
   ge R;
@@ -700,7 +757,7 @@ static int verify_one(const uint8_t *msg, uint32_t msg_len,
   if (st) return st;
   uint8_t r_check[32];
   ge_tobytes(r_check, R);
-  return memcmp(r_check, sig, 32) == 0 ? 0 : -3;  // ERR_MSG
+  return verify_post(R, r_check, sig);
 }
 
 // ---------------------------------------------------------------- sign
@@ -854,7 +911,7 @@ void fd_ed25519_cpu_verify_batch(const uint8_t *msgs, uint32_t msg_stride,
       uint8_t r_check[32];
       ge_tobytes_zi(r_check, rs[j], zinv[j]);
       status[idx[j]] =
-          memcmp(r_check, sigs + (size_t)idx[j] * 64, 32) == 0 ? 0 : -3;
+          verify_post(rs[j], r_check, sigs + (size_t)idx[j] * 64);
     }
   }
 }

@@ -1,0 +1,114 @@
+"""DSM + verify-stage cost attribution on the real chip (round-5).
+
+Round-5 finding: verify throughput is INSENSITIVE to the in-kernel
+multiply schedule (schoolbook/f32/rolled/factored all land 111-114.5k
+verifies/s), so the chain-probe per-mul costs do not transfer — the
+kernel's time must live elsewhere. This script splits the budget:
+
+  1. dsm full           64 vs 16 windows -> per-window slope + fixed
+  2. dsm doubles_only   (FD_DSM_DEBUG) -> doubling share
+  3. dsm no_badd        -> + A-lookup+add share; full adds B share
+  4. decompress_pallas  at B and 2B lanes (the verify runs 2B)
+  5. sha512 + point_eq  the remaining stages
+
+Run on an OTHERWISE IDLE host (contended timings are garbage):
+    python scripts/dsm_attrib.py [batch]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def t_(fn, args, reps=6):
+    x = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(x)[0])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        x = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(x)[0])
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    print(f"device={jax.devices()[0]} batch={batch}", flush=True)
+
+    from firedancer_tpu.ballet.ed25519 import oracle
+    from firedancer_tpu.ops import curve25519 as ge
+
+    rng = np.random.RandomState(0)
+    pubs = []
+    for i in range(64):
+        _, _, pub = oracle.keypair_from_seed(bytes([i + 1]) + bytes(31))
+        pubs.append(np.frombuffer(pub, np.uint8))
+    pubs = np.tile(np.stack(pubs), (batch // 64, 1))
+    h = rng.randint(0, 256, (batch, 32), dtype=np.uint8)
+    s = rng.randint(0, 256, (batch, 32), dtype=np.uint8)
+    h[:, 31] &= 0x0F
+    s[:, 31] &= 0x0F
+    enc = jnp.asarray(pubs)
+    apt, ok = jax.jit(ge.decompress_auto)(enc)[:2]
+    apt = tuple(jnp.asarray(c) for c in apt)
+    hj, sj = jnp.asarray(h), jnp.asarray(s)
+
+    import functools
+
+    from firedancer_tpu.ops.dsm_pallas import double_scalarmult_pallas
+
+    def run_dsm(nw):
+        f = jax.jit(functools.partial(double_scalarmult_pallas,
+                                      n_windows=nw))
+        return t_(f, (hj, apt, sj))
+
+    t64 = run_dsm(64)
+    t16 = run_dsm(16)
+    per_w = (t64 - t16) / 48
+    print(f"dsm full   : {t64*1e3:8.2f} ms  ({per_w*1e6:.1f} us/window, "
+          f"fixed {1e3*(t16 - 16*per_w):.2f} ms)", flush=True)
+
+    # debug variants re-trace (env read at trace time; fresh partials
+    # defeat jit caching because the debug flag changes the traced fn)
+    for dbg in ("doubles_only", "no_badd"):
+        os.environ["FD_DSM_DEBUG"] = dbg
+        try:
+            td = run_dsm(64)
+            print(f"dsm {dbg:12s}: {td*1e3:8.2f} ms", flush=True)
+        finally:
+            del os.environ["FD_DSM_DEBUG"]
+
+    from firedancer_tpu.ops.curve_pallas import decompress_pallas
+
+    t_dec = t_(jax.jit(decompress_pallas), (enc,))
+    enc2 = jnp.concatenate([enc, enc], axis=0)
+    t_dec2 = t_(jax.jit(decompress_pallas), (enc2,))
+    t_dec2so = t_(jax.jit(functools.partial(
+        decompress_pallas, want_small_order=True)), (enc2,))
+    print(f"decompress B: {t_dec*1e3:8.2f} ms   2B: {t_dec2*1e3:8.2f} ms"
+          f"   2B+so: {t_dec2so*1e3:8.2f} ms", flush=True)
+
+    from firedancer_tpu.ops.sha512 import sha512_batch_auto
+
+    msgs = jnp.asarray(rng.randint(0, 256, (batch, 256), dtype=np.uint8))
+    lens = jnp.full((batch,), 256, jnp.int32)
+    print(f"sha512 256B : {t_(jax.jit(sha512_batch_auto), (msgs, lens))*1e3:8.2f} ms",
+          flush=True)
+
+    from firedancer_tpu.ops.curve_pallas import point_eq_affine_pallas
+
+    r3 = jax.jit(functools.partial(double_scalarmult_pallas,
+                                   n_windows=64))(hj, apt, sj)
+    r3 = tuple(jnp.asarray(c) if c is not None else None for c in r3[:3]) + (None,)
+    t_eq = t_(jax.jit(lambda a, b, x, y, z: point_eq_affine_pallas(
+        (a, b), (x, y, z, None))), (apt[0], apt[1], r3[0], r3[1], r3[2]))
+    print(f"point_eq    : {t_eq*1e3:8.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,190 @@
+"""Round-5 VPU op-cost probe: int32 vs f32 multiply, and an exact
+f32-product fe_mul candidate.
+
+Why: round-4's probes were dispatch-dominated (16 field muls "took"
+24 ms when the full 2800-mul verify does 83 ms/8192 — impossible
+unless per-call overhead swamps the kernel). This probe measures the
+SLOPE between two in-kernel op counts, which cancels dispatch/launch
+overhead exactly, and answers:
+
+  1. is the VPU int32 multiply multi-pass emulated (cost >> add)?
+  2. is f32 multiply full-rate?
+  3. does fe_mul_f32 (63-row conv in f32 — every partial sum
+     <= 32*255*407 < 2^23, exact in f32 — then int32 fold+carry)
+     beat fe_mul_unrolled int32, and by how much?
+
+Run: python scripts/kernel_probe2.py [lanes]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from firedancer_tpu.ops import fe25519 as fe
+
+LANES = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+NL = fe.NLIMBS
+
+
+def _mk(body, n_in=2, dtype=jnp.int32):
+    from jax.experimental import pallas as pl
+
+    def kern(*refs):
+        ins = [r[...] for r in refs[:-1]]
+        refs[-1][...] = body(*ins)
+
+    spec = pl.BlockSpec((NL, LANES), lambda: (0, 0))
+    return jax.jit(pl.pallas_call(
+        kern,
+        in_specs=[spec] * n_in,
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((NL, LANES), dtype),
+    ))
+
+
+def _time(fn, args, reps=20):
+    x = fn(*args)
+    jax.block_until_ready(x)
+    np.asarray(x)  # defeat tunnel-side laziness (round-4 finding)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        x = fn(*args)
+    np.asarray(x)
+    return (time.perf_counter() - t0) / reps
+
+
+def slope(make_body, n_lo, n_hi, n_in=2, dtype=jnp.int32, args=None):
+    """us per unit-op from the (n_hi - n_lo) slope; also returns t_hi."""
+    f_lo = _mk(make_body(n_lo), n_in, dtype)
+    f_hi = _mk(make_body(n_hi), n_in, dtype)
+    t_lo = _time(f_lo, args)
+    t_hi = _time(f_hi, args)
+    return (t_hi - t_lo) / (n_hi - n_lo) * 1e6, t_hi
+
+
+def fe_mul_f32(a, b):
+    """Exact f32-product field multiply (probe candidate).
+
+    a, b: (32, L) int32, |limb| <= 407 (one carry-pass output bound).
+    Products <= 407*407 < 2^18; worst conv row has 32 terms -> sums
+    < 2^23 < 2^24: every f32 add is exact. The 38-fold and carries run
+    in int32 (fold values < 2^27).
+    """
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    L = a.shape[1:]
+
+    lo = af[0:1] * bf                     # rows 0..31
+    hi = None                             # rows 32..62
+    for i in range(1, NL):
+        p = af[i:i + 1] * bf              # (32, L) at offset i
+        head = p[:NL - i]                 # rows i..31 of lo
+        tail = p[NL - i:]                 # rows 32..32+i-1 of hi
+        lo = lo + jnp.concatenate(
+            [jnp.zeros((i,) + L, jnp.float32), head], axis=0)
+        t = jnp.concatenate(
+            [tail, jnp.zeros((NL - i,) + L, jnp.float32)], axis=0)
+        hi = t if hi is None else hi + t
+    c = lo.astype(jnp.int32) + 38 * hi.astype(jnp.int32)
+    return fe._carry_pass(c, 4)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device={dev} lanes={LANES}", flush=True)
+    rng = np.random.RandomState(0)
+    xi = jnp.asarray(rng.randint(1, 256, (NL, LANES), dtype=np.int32))
+    yi = jnp.asarray(rng.randint(1, 256, (NL, LANES), dtype=np.int32))
+    xf = xi.astype(jnp.float32)
+    yf = yi.astype(jnp.float32)
+
+    # dispatch overhead: 1-op kernel round trip
+    f0 = _mk(lambda x, y: x + y)
+    print(f"dispatch+1op:        {_time(f0, (xi, yi))*1e6:9.1f} us", flush=True)
+
+    def mk_muli(n):
+        def body(x, y):
+            for _ in range(n):
+                x = x * y + y
+            return x
+        return body
+
+    def mk_mulf(n):
+        def body(x, y):
+            for _ in range(n):
+                x = x * y + y
+            return x
+        return body
+
+    def mk_addi(n):
+        def body(x, y):
+            for _ in range(n):
+                x = (x + y) ^ y
+            return x
+        return body
+
+    us, t = slope(mk_muli, 1024, 4096, args=(xi, yi))
+    print(f"int32 mul+add:       {us*1000:9.3f} ns/op  (t_hi {t*1e3:.2f} ms)", flush=True)
+    us, t = slope(mk_addi, 1024, 4096, args=(xi, yi))
+    print(f"int32 add+xor:       {us*1000:9.3f} ns/op  (t_hi {t*1e3:.2f} ms)", flush=True)
+    us, t = slope(mk_mulf, 1024, 4096, dtype=jnp.float32, args=(xf, yf))
+    print(f"f32   mul+add:       {us*1000:9.3f} ns/op  (t_hi {t*1e3:.2f} ms)", flush=True)
+
+    # f32 <-> int32 conversion cost
+    def mk_conv(n):
+        def body(x, y):
+            for _ in range(n // 2):
+                x = (x.astype(jnp.float32) + 1.0).astype(jnp.int32)
+            return x
+        return body
+    us, t = slope(mk_conv, 1024, 4096, args=(xi, yi))
+    print(f"cvt i2f+f2i pair:    {us*1000:9.3f} ns/op  (t_hi {t*1e3:.2f} ms)", flush=True)
+
+    # full field multiplies (chained: output feeds input; bounds hold
+    # because each returns carried |limb|<=512... <=407 after pass 4)
+    def mk_femul_i(n):
+        def body(x, y):
+            for _ in range(n):
+                x = fe.fe_mul_unrolled(x, y)
+            return x
+        return body
+
+    def mk_femul_f(n):
+        def body(x, y):
+            for _ in range(n):
+                x = fe_mul_f32(x, y)
+            return x
+        return body
+
+    def mk_fesq_i(n):
+        def body(x, y):
+            for _ in range(n):
+                x = fe.fe_sq(x)
+            return x
+        return body
+
+    us_i, t = slope(mk_femul_i, 8, 40, args=(xi, yi))
+    print(f"fe_mul int32:        {us_i:9.2f} us/mul  (t_hi {t*1e3:.2f} ms)", flush=True)
+    us_f, t = slope(mk_femul_f, 8, 40, args=(xi, yi))
+    print(f"fe_mul f32conv:      {us_f:9.2f} us/mul  (t_hi {t*1e3:.2f} ms)", flush=True)
+    us_s, t = slope(mk_fesq_i, 8, 40, args=(xi, yi))
+    print(f"fe_sq  int32:        {us_s:9.2f} us/sq   (t_hi {t*1e3:.2f} ms)", flush=True)
+    if us_f > 0:
+        print(f"f32/int32 fe_mul speedup: {us_i/us_f:.2f}x", flush=True)
+
+    # correctness: chained product both ways
+    fi = _mk(mk_femul_i(8))
+    ff = _mk(mk_femul_f(8))
+    gi = fe.limbs_to_int(np.asarray(fi(xi, yi))[:, :8])
+    gf = fe.limbs_to_int(np.asarray(ff(xi, yi))[:, :8])
+    print(f"fe_mul f32 == int32: {gi == gf}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,138 @@
+"""Round-5 probe #3: where do the DSM kernel's cycles actually go?
+
+Round-5 facts so far: f32 vs int32 multiply is a wash through the real
+DSM (112.9k vs 112.6k verifies/s), so the multiply unit is not the
+bottleneck. The kernel runs ~0.9 T elem-ops/s against a ~7 T/s VPU
+peak. Hypotheses: (a) sublane-misaligned slices (every fe_mul term
+reads bext at a row offset -> cross-vreg rotations), (b) sublane
+broadcasts ((1, L) * (32, L)), (c) VMEM spill traffic at big tiles,
+(d) plain op-issue ceiling.
+
+Method: ONE pallas dispatch per measurement, grid=(G,) tiles each
+running an N-deep dependent op chain; cost = slope between two N
+values — dispatch and grid overheads cancel exactly. Chains:
+
+  mul       x * y + y                 (aligned, no movement)
+  bcast     x[0:1] * y + y           (sublane broadcast per term)
+  shift     x * rot5(y) + y          (misaligned row read per term)
+  bshift    x[7:8] * rot5(y) + y     (both)
+  fe_mul    fe_mul_unrolled          (the real 32-term schedule)
+  fe_sq     fe.fe_sq
+  carry     fe._carry_pass(x+y, 1)
+
+Each at LANES in {128, 1024}: if per-lane cost FALLS at 128, big tiles
+are spilling (hypothesis c).
+
+Run: python scripts/kernel_probe3.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from firedancer_tpu.ops import fe25519 as fe
+
+NL = fe.NLIMBS
+GRID = 64
+
+
+def _mk(body, lanes):
+    from jax.experimental import pallas as pl
+
+    def kern(x_ref, y_ref, o_ref):
+        o_ref[...] = body(x_ref[...], y_ref[...])
+
+    spec = pl.BlockSpec((NL, lanes), lambda i: (0, 0))
+    return jax.jit(pl.pallas_call(
+        kern,
+        grid=(GRID,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((NL, lanes), jnp.int32),
+    ))
+
+
+def _time(fn, args, reps=10):
+    x = fn(*args)
+    np.asarray(x)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        x = fn(*args)
+    np.asarray(x)
+    return (time.perf_counter() - t0) / reps
+
+
+def _rot5(y):
+    return jnp.concatenate([y[5:], y[:5]], axis=0)
+
+
+def _chain(kind, n):
+    def body(x, y):
+        if kind == "shift" or kind == "bshift":
+            pass
+        for _ in range(n):
+            if kind == "mul":
+                x = x * y + y
+            elif kind == "bcast":
+                x = x[0:1] * y + y
+            elif kind == "shift":
+                x = x * _rot5(y) + y
+            elif kind == "bshift":
+                x = x[7:8] * _rot5(y) + y
+            elif kind == "carry":
+                x = fe._carry_pass(x + y, 1)
+            elif kind == "fe_mul":
+                x = fe.fe_mul_unrolled(x, y)
+            elif kind == "fe_sq":
+                x = fe.fe_sq(x)
+            else:
+                raise ValueError(kind)
+        return x
+    return body
+
+
+def probe(kind, lanes, n_lo, n_hi, unit_ops):
+    """us per chain step and effective T elem-ops/s (counting unit_ops
+    (NL, lanes) row-ops per step)."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(1, 256, (NL, lanes), dtype=np.int32))
+    y = jnp.asarray(rng.randint(1, 256, (NL, lanes), dtype=np.int32))
+    f_lo = _mk(_chain(kind, n_lo), lanes)
+    f_hi = _mk(_chain(kind, n_hi), lanes)
+    t_lo = _time(f_lo, (x, y))
+    t_hi = _time(f_hi, (x, y))
+    per_step = (t_hi - t_lo) / (n_hi - n_lo) / GRID
+    eff = unit_ops * NL * lanes / per_step / 1e12 if per_step > 0 else 0
+    return per_step, eff, t_hi
+
+
+def main():
+    print(f"device={jax.devices()[0]} grid={GRID}", flush=True)
+    for kind, n_lo, n_hi, unit in [
+        ("mul", 512, 2048, 2),
+        ("bcast", 512, 2048, 2),
+        ("shift", 512, 2048, 2),
+        ("bshift", 512, 2048, 2),
+        ("carry", 256, 1024, 5),
+        ("fe_mul", 16, 64, 80),
+        ("fe_sq", 16, 64, 60),
+    ]:
+        for lanes in (128, 1024):
+            try:
+                us, eff, t_hi = probe(kind, lanes, n_lo, n_hi, unit)
+                print(f"{kind:7s} L={lanes:5d}: {us*1e9:9.1f} ns/step "
+                      f"eff {eff:6.2f} T elem-op/s  (t_hi {t_hi*1e3:.1f} ms)",
+                      flush=True)
+            except Exception as e:
+                print(f"{kind:7s} L={lanes:5d}: FAILED "
+                      f"{type(e).__name__}: {str(e)[:140]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

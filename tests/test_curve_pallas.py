@@ -124,3 +124,44 @@ def test_decompress_pallas_niels_outputs():
         a = np.asarray(fe.fe_canonical_limbs(got_c))
         b = np.asarray(fe.fe_canonical_limbs(want_c))
         assert np.array_equal(a, b)
+
+
+def test_decompress_pallas_small_order_output():
+    """want_small_order: the kernel's in-VMEM 8P==O mask must match the
+    XLA small_order_mask AND the oracle's is_small_order on every
+    edge encoding (identity, order-4 y=0, order-8 torsion, ...)."""
+    from firedancer_tpu.ballet.ed25519 import oracle
+
+    enc = _encodings()
+    pt, ok, so = decompress_pallas(enc, interpret=True, lanes=TILE,
+                                   want_small_order=True)
+    so = np.asarray(so)
+    so_xla = np.asarray(ge.small_order_mask(pt))
+    assert np.array_equal(so, so_xla)
+    ok_np = np.asarray(ok)
+    for i, row in enumerate(np.asarray(enc)):
+        p = oracle.point_decompress(row.tobytes())
+        if p is None:
+            assert not ok_np[i]
+            continue  # poisoned identity lanes read small-order=True
+        assert bool(so[i]) == oracle.is_small_order(p), i
+
+
+def test_point_eq_affine_pallas_matches_xla():
+    from firedancer_tpu.ops.curve_pallas import point_eq_affine_pallas
+
+    enc = _encodings()
+    pt, ok = ge.decompress(enc)
+    x, y, z, t = pt
+    # Projective forms of the same points: scale X, Y, Z by k
+    k = fe.int_to_limbs(12345, (1,))
+    proj = (fe.fe_mul(x, k), fe.fe_mul(y, k), fe.fe_mul(z, k), None)
+    m = np.asarray(point_eq_affine_pallas((x, y), proj,
+                                          interpret=True, lanes=TILE))
+    assert m.all()  # same point in scaled coordinates
+    # flip one coordinate: lanes must mismatch
+    bad = (fe.fe_add(proj[0], fe.int_to_limbs(1, (1,))), proj[1],
+           proj[2], None)
+    m2 = np.asarray(point_eq_affine_pallas((x, y), bad,
+                                           interpret=True, lanes=TILE))
+    assert not m2.any()

@@ -67,6 +67,34 @@ def test_pallas_matches_xla():
     assert (ref_b == got_b).all()
 
 
+@pytest.mark.skipif(not (on_accel or force), reason="needs TPU (or forced)")
+@pytest.mark.parametrize("impl", ["f32"])
+def test_pallas_matches_xla_mul_impls(impl, monkeypatch):
+    """One alternate in-kernel multiply schedule through the real DSM
+    kernel (truncated windows in interpret mode off-accelerator) —
+    insurance that the FD_MUL_IMPL dispatch plumbing reaches the kernel.
+    Exhaustive per-impl semantics (incl. rolled/factored/karatsuba) are
+    pinned by the cheap numpy-level tests in test_fe25519.py; interpret
+    mode is ~30 min per impl on this host, so only one rides here."""
+    import jax.numpy as jnp  # noqa: F401
+
+    from firedancer_tpu.ops import curve25519 as ge
+    from firedancer_tpu.ops.dsm_pallas import double_scalarmult_pallas
+
+    monkeypatch.setenv("FD_MUL_IMPL", impl)
+    h, apt, s = _inputs()
+    kw = {}
+    if not on_accel:
+        kw = {"n_windows": 2, "interpret": True}
+        ref = ge.double_scalarmult(h, apt, s, n_windows=2)
+    else:
+        ref = ge.double_scalarmult(h, apt, s)
+    got = double_scalarmult_pallas(h, apt, s, **kw)
+    ref_b = np.asarray(ge.compress(ref))
+    got_b = np.asarray(ge.compress(got))
+    assert (ref_b == got_b).all()
+
+
 @pytest.mark.skipif(not on_accel, reason="needs TPU")
 def test_verify_batch_pallas_backend_end_to_end():
     """Full verify with the pallas dsm vs oracle statuses."""

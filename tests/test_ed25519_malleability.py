@@ -1,0 +1,90 @@
+"""Ed25519 signature-malleability vectors through every verify path.
+
+The reference ships 396 REAL external edge-case vectors (Zcash-derived;
+checked in verbatim as test data like an RFC vector set):
+/root/reference/src/ballet/ed25519/test_ed25519_signature_malleability
+_{should_pass,should_fail}.bin, consumed by
+test_ed25519_signature_malleability.c — (sig, pub) pairs against the
+5-byte message "Zcash". They cover the hostile corners of the verify
+space: non-canonical encodings, low-order/torsion points, s >= L,
+mixed-order aggregates.
+
+Every verify implementation in this repo must agree with the vectors:
+the Python oracle, the native C++ verifier, and the batched XLA graph
+(the TPU program, run on the CPU lane here). A divergence on any vector
+is a consensus bug.
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+_DIR = os.path.join(os.path.dirname(__file__), "fixtures")
+_MSG = b"Zcash"
+
+
+def _load(name):
+    raw = open(os.path.join(_DIR, name), "rb").read()
+    assert len(raw) % 96 == 0
+    out = []
+    for off in range(0, len(raw), 96):
+        out.append((raw[off:off + 64], raw[off + 64:off + 96]))
+    return out
+
+
+SHOULD_PASS = _load("ed25519_malleability_should_pass.bin")
+SHOULD_FAIL = _load("ed25519_malleability_should_fail.bin")
+
+
+def test_vector_counts():
+    assert len(SHOULD_PASS) == 200
+    assert len(SHOULD_FAIL) == 196
+
+
+def test_oracle_agrees_with_vectors():
+    from firedancer_tpu.ballet.ed25519 import oracle
+
+    for i, (sig, pub) in enumerate(SHOULD_PASS):
+        assert oracle.verify(_MSG, sig, pub) == 0, ("pass", i)
+    for i, (sig, pub) in enumerate(SHOULD_FAIL):
+        assert oracle.verify(_MSG, sig, pub) != 0, ("fail", i)
+
+
+def test_native_agrees_with_vectors():
+    from firedancer_tpu.ballet.ed25519 import native
+
+    if not native.available():
+        pytest.skip("native lib not built")
+    items = [(sig, pub, _MSG) for sig, pub in SHOULD_PASS + SHOULD_FAIL]
+    statuses = native.verify_items(items)
+    for i, st in enumerate(statuses[:len(SHOULD_PASS)]):
+        assert st == 0, ("pass", i)
+    for i, st in enumerate(statuses[len(SHOULD_PASS):]):
+        assert st != 0, ("fail", i)
+
+
+@pytest.mark.slow
+def test_batched_graph_agrees_with_vectors():
+    """All 396 vectors through the fused verify_batch XLA program in one
+    batch — the batched device path must match the reference verdicts
+    lane-for-lane."""
+    import jax
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops.verify import verify_batch
+
+    vecs = SHOULD_PASS + SHOULD_FAIL
+    n = len(vecs)
+    msgs = np.tile(np.frombuffer(_MSG, np.uint8), (n, 1))
+    lens = np.full(n, len(_MSG), np.int32)
+    sigs = np.stack([np.frombuffer(s, np.uint8) for s, _ in vecs])
+    pubs = np.stack([np.frombuffer(p, np.uint8) for _, p in vecs])
+    st = np.asarray(jax.jit(verify_batch)(
+        jnp.asarray(msgs), jnp.asarray(lens), jnp.asarray(sigs),
+        jnp.asarray(pubs)))
+    for i in range(len(SHOULD_PASS)):
+        assert st[i] == 0, ("pass", i)
+    for i in range(len(SHOULD_PASS), n):
+        assert st[i] != 0, ("fail", i)

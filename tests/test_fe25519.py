@@ -148,6 +148,46 @@ def test_fe_mul_karatsuba_matches_fe_mul():
     assert int(np.abs(np.asarray(got)).max()) <= 512
 
 
+def test_fe_mul_f32_matches_fe_mul():
+    """Exact-f32-product multiply vs schoolbook over the full |limb|
+    <= 512 contract range (incl. the worst-case all-+/-512 columns that
+    maximize the conv partial sums), plus the output-invariant bound."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops import fe25519 as fe
+
+    rng = np.random.RandomState(15)
+    a = rng.randint(-512, 513, (32, 300)).astype(np.int32)
+    b = rng.randint(-512, 513, (32, 300)).astype(np.int32)
+    a[:, 0] = 512
+    b[:, 0] = 512           # max positive partial sums (2^23, exact)
+    a[:, 1] = -512
+    b[:, 1] = 512           # max negative
+    a[:, 2] = 0
+    got = fe.fe_mul_f32(jnp.asarray(a), jnp.asarray(b))
+    want = fe.fe_mul(jnp.asarray(a), jnp.asarray(b))
+    assert fe.limbs_to_int(got) == fe.limbs_to_int(want)
+    assert int(np.abs(np.asarray(got)).max()) <= 512
+
+
+def test_fe_sq_f32_matches_fe_sq():
+    import numpy as np
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops import fe25519 as fe
+
+    rng = np.random.RandomState(16)
+    a = rng.randint(-512, 513, (32, 300)).astype(np.int32)
+    a[:, 0] = 512
+    a[:, 1] = -512
+    a[:, 2] = 0
+    got = fe.fe_sq_f32(jnp.asarray(a))
+    want = fe.fe_sq(jnp.asarray(a))
+    assert fe.limbs_to_int(got) == fe.limbs_to_int(want)
+    assert int(np.abs(np.asarray(got)).max()) <= 512
+
+
 def test_fe_mul_kernel_dispatch(monkeypatch):
     import numpy as np
     import jax.numpy as jnp
@@ -160,8 +200,37 @@ def test_fe_mul_kernel_dispatch(monkeypatch):
     want = fe.limbs_to_int(fe.fe_mul(a, b))
     monkeypatch.setenv("FD_MUL_IMPL", "karatsuba")
     assert fe.limbs_to_int(fe.fe_mul_kernel(a, b)) == want
+    monkeypatch.setenv("FD_MUL_IMPL", "f32")
+    assert fe.limbs_to_int(fe.fe_mul_kernel(a, b)) == want
+    monkeypatch.setenv("FD_MUL_IMPL", "rolled")
+    assert fe.limbs_to_int(fe.fe_mul_kernel(a, b)) == want
     monkeypatch.setenv("FD_MUL_IMPL", "schoolbook")
     assert fe.limbs_to_int(fe.fe_mul_kernel(a, b)) == want
+
+
+def test_fe_mul_rolled_matches_fe_mul():
+    """The 7-rotation schedule over the full |limb| <= 1024 input range
+    (same contract as fe_mul_unrolled), plus the output bound."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops import fe25519 as fe
+
+    rng = np.random.RandomState(17)
+    a = rng.randint(-1024, 1025, (32, 300)).astype(np.int32)
+    b = rng.randint(-1024, 1025, (32, 300)).astype(np.int32)
+    a[:, 0] = 1024
+    b[:, 0] = 1024
+    a[:, 1] = -1024
+    b[:, 1] = 1024
+    a[:, 2] = 0
+    got = fe.fe_mul_rolled(jnp.asarray(a), jnp.asarray(b))
+    want = fe.fe_mul(jnp.asarray(a), jnp.asarray(b))
+    assert fe.limbs_to_int(got) == fe.limbs_to_int(want)
+    assert int(np.abs(np.asarray(got)).max()) <= 512
+    got2 = fe.fe_mul_factored(jnp.asarray(a), jnp.asarray(b))
+    assert fe.limbs_to_int(got2) == fe.limbs_to_int(want)
+    assert int(np.abs(np.asarray(got2)).max()) <= 512
 
 
 def test_canonicalize_k_parallel_matches_seq():

@@ -123,11 +123,31 @@ def test_range_check_quirk():
 
 
 def test_s_just_below_l_not_rejected_by_range():
-    """s = L - 1 passes the range check (fails later with ERR_MSG)."""
+    """s = L - 1 passes the range check (fails later with ERR_MSG).
+
+    The r bytes come from a REAL signature (a prime-order nonce point):
+    under the 2-point semantics an all-zeros r decodes to the order-4
+    point (sqrt(-1), 0) and would correctly fail earlier with ERR_SIG
+    (small-order R), shadowing what this test pins."""
     seed = bytes(range(32))
     _, _, pub = keypair_from_seed(seed)
-    sig = bytes(32) + (L - 1).to_bytes(32, "little")
+    real = sign(b"x", seed)
+    sig = real[:32] + (L - 1).to_bytes(32, "little")
     assert verify(b"x", sig, pub) == FD_ED25519_ERR_MSG
+
+
+def test_small_order_r_and_a_rejected():
+    """2-point semantics (reference default): small-order R -> ERR_SIG,
+    small-order A -> ERR_PUBKEY (fd_ed25519_user.c:402-403)."""
+    seed = bytes(range(32))
+    _, _, pub = keypair_from_seed(seed)
+    # all-zeros r: y=0 decodes to the order-4 point (sqrt(-1), 0)
+    sig = bytes(32) + (1).to_bytes(32, "little")
+    assert verify(b"x", sig, pub) == FD_ED25519_ERR_SIG
+    # identity pubkey (y=1): small-order A
+    ident = (1).to_bytes(32, "little")
+    real = sign(b"x", seed)
+    assert verify(b"x", real, ident) == FD_ED25519_ERR_PUBKEY
 
 
 def test_bad_pubkey_rejected():

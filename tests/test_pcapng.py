@@ -1,0 +1,192 @@
+"""pcapng reader/writer: round-trip, tsresol, interop, robustness.
+
+Parity model: the reference's test_pcapng.c + fuzz_pcapng.c
+(/root/reference/src/util/net/) — SHB/IDB/EPB/SPB/DSB handling,
+hardened parse on malformed inputs.
+"""
+
+import struct
+
+import pytest
+
+from firedancer_tpu.utils import pcapng
+from firedancer_tpu.utils.pcap import PcapWriter, read_capture
+
+
+def test_roundtrip_epb(tmp_path):
+    p = str(tmp_path / "a.pcapng")
+    pkts = [bytes([i]) * (i + 1) for i in range(8)]
+    with pcapng.PcapngWriter(p, hardware="x86_64", os_name="linux",
+                             if_name="lo0") as w:
+        for i, pkt in enumerate(pkts):
+            w.write(pkt, ts_ns=1_000_000_000 + i)
+    frames = list(pcapng.PcapngReader(p))
+    assert [f.data for f in frames] == pkts
+    assert [f.ts_ns for f in frames] == [1_000_000_000 + i
+                                         for i in range(8)]
+    assert all(f.type == pcapng.FRAME_ENHANCED for f in frames)
+    assert all(f.orig_sz == len(f.data) for f in frames)
+
+
+def test_roundtrip_spb_and_dsb(tmp_path):
+    p = str(tmp_path / "b.pcapng")
+    keylog = b"CLIENT_TRAFFIC_SECRET_0 aa bb\n"
+    with pcapng.PcapngWriter(p) as w:
+        w.write_simple(b"hello world!")
+        w.write_tls_keys(keylog)
+        w.write(b"enhanced", ts_ns=7)
+    frames = list(pcapng.PcapngReader(p))
+    assert [f.type for f in frames] == [
+        pcapng.FRAME_SIMPLE, pcapng.FRAME_TLSKEYS, pcapng.FRAME_ENHANCED]
+    assert frames[0].data == b"hello world!"
+    assert frames[1].data == keylog
+    # read_all returns packets only (no TLS keys frame)
+    assert pcapng.read_all(p) == [b"hello world!", b"enhanced"]
+
+
+def test_usec_tsresol_default(tmp_path):
+    """An IDB without if_tsresol means 10^-6 ticks (spec default)."""
+    p = str(tmp_path / "c.pcapng")
+    with open(p, "wb") as f:
+        shb = struct.pack("<IHHq", pcapng.BYTE_ORDER_MAGIC, 1, 0, -1)
+        f.write(struct.pack("<II", pcapng.BLOCK_SHB, 12 + len(shb))
+                + shb + struct.pack("<I", 12 + len(shb)))
+        idb = struct.pack("<HHI", 147, 0, 0)       # no options at all
+        f.write(struct.pack("<II", pcapng.BLOCK_IDB, 12 + len(idb))
+                + idb + struct.pack("<I", 12 + len(idb)))
+        pkt = b"abcd"
+        ts_us = 5_000_001
+        epb = struct.pack("<IIIII", 0, ts_us >> 32, ts_us & 0xFFFFFFFF,
+                          len(pkt), len(pkt)) + pkt
+        f.write(struct.pack("<II", pcapng.BLOCK_EPB, 12 + len(epb))
+                + epb + struct.pack("<I", 12 + len(epb)))
+    frames = list(pcapng.PcapngReader(p))
+    assert frames[0].ts_ns == ts_us * 1000
+
+
+def test_unknown_blocks_skipped(tmp_path):
+    p = str(tmp_path / "d.pcapng")
+    with pcapng.PcapngWriter(p) as w:
+        w.write(b"first", ts_ns=1)
+        # custom block type 0x0BAD: must be skipped, not an error
+        body = b"\xde\xad\xbe\xef"
+        w._block(0x0BAD, body)
+        w.write(b"second", ts_ns=2)
+    assert pcapng.read_all(p) == [b"first", b"second"]
+
+
+def test_multi_section(tmp_path):
+    """A second SHB starts a new section with a fresh interface table."""
+    p = str(tmp_path / "e.pcapng")
+    with pcapng.PcapngWriter(p) as w:
+        w.write(b"sec1", ts_ns=1)
+    with open(p, "ab") as f:
+        shb = struct.pack("<IHHq", pcapng.BYTE_ORDER_MAGIC, 1, 0, -1)
+        f.write(struct.pack("<II", pcapng.BLOCK_SHB, 12 + len(shb))
+                + shb + struct.pack("<I", 12 + len(shb)))
+        idb = struct.pack("<HHI", 1, 0, 0)
+        f.write(struct.pack("<II", pcapng.BLOCK_IDB, 12 + len(idb))
+                + idb + struct.pack("<I", 12 + len(idb)))
+        pkt = b"sec2"
+        epb = struct.pack("<IIIII", 0, 0, 9, len(pkt), len(pkt)) + pkt
+        f.write(struct.pack("<II", pcapng.BLOCK_EPB, 12 + len(epb))
+                + epb + struct.pack("<I", 12 + len(epb)))
+    assert pcapng.read_all(p) == [b"sec1", b"sec2"]
+
+
+def test_big_endian_section(tmp_path):
+    p = str(tmp_path / "f.pcapng")
+    with open(p, "wb") as f:
+        shb = struct.pack(">IHHq", pcapng.BYTE_ORDER_MAGIC, 1, 0, -1)
+        f.write(struct.pack("<I", pcapng.BLOCK_SHB)
+                + struct.pack(">I", 12 + len(shb))
+                + shb + struct.pack(">I", 12 + len(shb)))
+        idb = struct.pack(">HHI", 147, 0, 0)
+        f.write(struct.pack(">II", pcapng.BLOCK_IDB, 12 + len(idb))
+                + idb + struct.pack(">I", 12 + len(idb)))
+        pkt = b"bige"
+        epb = struct.pack(">IIIII", 0, 0, 77, len(pkt), len(pkt)) + pkt
+        f.write(struct.pack(">II", pcapng.BLOCK_EPB, 12 + len(epb))
+                + epb + struct.pack(">I", 12 + len(epb)))
+    frames = list(pcapng.PcapngReader(p))
+    assert frames[0].data == b"bige"
+    assert frames[0].ts_ns == 77 * 1000
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda b: b[:7],                       # truncated header
+    lambda b: b"\x00" * 8 + b[8:],         # wrong leading block
+    lambda b: b[:8] + b"\xff\xff\xff\xff" + b[12:],  # bad BOM
+    lambda b: b[:4] + struct.pack("<I", 13) + b[8:],  # unaligned length
+    lambda b: b[:4] + struct.pack("<I", 2 << 20) + b[8:],  # huge length
+])
+def test_malformed_raises_valueerror(tmp_path, mutate):
+    p0 = str(tmp_path / "ok.pcapng")
+    with pcapng.PcapngWriter(p0) as w:
+        w.write(b"x" * 16, ts_ns=1)
+    with open(p0, "rb") as f:
+        good = f.read()
+    p1 = str(tmp_path / "bad.pcapng")
+    with open(p1, "wb") as f:
+        f.write(mutate(good))
+    with pytest.raises(ValueError):
+        list(pcapng.PcapngReader(p1))
+
+
+def test_truncated_tail_is_eof(tmp_path):
+    """EOF mid-block ends iteration cleanly (like PcapReader)."""
+    p0 = str(tmp_path / "ok.pcapng")
+    with pcapng.PcapngWriter(p0) as w:
+        w.write(b"a" * 100, ts_ns=1)
+        w.write(b"b" * 100, ts_ns=2)
+    with open(p0, "rb") as f:
+        good = f.read()
+    p1 = str(tmp_path / "cut.pcapng")
+    with open(p1, "wb") as f:
+        f.write(good[:-30])
+    frames = list(pcapng.PcapngReader(p1))
+    assert [f.data for f in frames] == [b"a" * 100]
+
+
+def test_read_capture_autodetect(tmp_path):
+    png = str(tmp_path / "x.pcapng")
+    with pcapng.PcapngWriter(png) as w:
+        w.write(b"ng-payload", ts_ns=0)
+    assert read_capture(png) == [b"ng-payload"]
+    pc = str(tmp_path / "x.pcap")
+    with PcapWriter(pc) as w:
+        w.write(b"classic-payload")
+    assert read_capture(pc) == [b"classic-payload"]
+
+
+def test_option_overrun_rejected(tmp_path):
+    """An IDB option whose length runs off the block must raise."""
+    p = str(tmp_path / "g.pcapng")
+    with open(p, "wb") as f:
+        shb = struct.pack("<IHHq", pcapng.BYTE_ORDER_MAGIC, 1, 0, -1)
+        f.write(struct.pack("<II", pcapng.BLOCK_SHB, 12 + len(shb))
+                + shb + struct.pack("<I", 12 + len(shb)))
+        # option header claims 200 bytes but only 4 present
+        opts = struct.pack("<HH", pcapng.OPT_IDB_NAME, 200) + b"abcd"
+        idb = struct.pack("<HHI", 147, 0, 0) + opts
+        pad = (-len(idb)) % 4
+        idb += b"\x00" * pad
+        f.write(struct.pack("<II", pcapng.BLOCK_IDB, 12 + len(idb))
+                + idb + struct.pack("<I", 12 + len(idb)))
+    with pytest.raises(ValueError):
+        list(pcapng.PcapngReader(p))
+
+
+def test_fuzz_smoke_pcapng():
+    """The structured mutator over the pcapng reader: parse-or-
+    ValueError only (CI smoke; the long soak runs via fuzz/run_fuzz)."""
+    import random
+
+    from fuzz.fuzz_targets import target_pcapng
+
+    fn, corpus, _ = target_pcapng()
+    from fuzz.fuzz_common import mutate
+
+    rng = random.Random(1234)
+    for _ in range(400):
+        fn(mutate(rng, corpus))

@@ -43,7 +43,7 @@ def _mk_txns(n, n_dups=0, n_bad=0, seed=0):
     return txns, out
 
 
-@pytest.mark.parametrize("backend", ["oracle", "tpu"])
+@pytest.mark.parametrize("backend", ["oracle", "cpu", "tpu"])
 def test_pipeline_end_to_end(tmp_path, backend):
     n_uniq, n_dups, n_bad = 24, 6, 4
     _, payloads = _mk_txns(n_uniq, n_dups, n_bad, seed=1)

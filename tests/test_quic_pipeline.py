@@ -75,7 +75,7 @@ def test_quic_pipeline_end_to_end(tmp_path):
         topo,
         client_fn=lambda addr: _quic_client(addr, txns),
         n_txns=n,
-        verify_backend="oracle",
+        verify_backend="cpu",
         bank_cnt=4,
         timeout_s=60.0,
     )
@@ -94,7 +94,7 @@ def test_quic_pipeline_with_retry(tmp_path):
         topo,
         lambda addr: _quic_client(addr, txns),
         n_txns=len(txns),
-        verify_backend="oracle",
+        verify_backend="cpu",
         timeout_s=60.0,
         quic_retry=True,
     )

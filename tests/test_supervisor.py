@@ -31,7 +31,7 @@ def corpus():
 def test_supervised_pipeline_end_to_end(tmp_path, corpus):
     topo = build_topology(str(tmp_path / "sup.wksp"), depth=64)
     res = run_pipeline_supervised(
-        topo, corpus.payloads, verify_backend="oracle", timeout_s=600.0,
+        topo, corpus.payloads, verify_backend="cpu", timeout_s=600.0,
     )
     assert res.recv_cnt == corpus.n_unique_ok, res.diag
     assert res.supervisor_restarts == 0
@@ -117,7 +117,7 @@ def test_crash_only_restart_heals_pipeline(tmp_path, corpus):
             state["killed"] = True
 
     res = run_pipeline_supervised(
-        topo, corpus.payloads, verify_backend="oracle", timeout_s=900.0,
+        topo, corpus.payloads, verify_backend="cpu", timeout_s=900.0,
         fault_hook=fault, record_digests=True,
     )
     assert state["killed"]
