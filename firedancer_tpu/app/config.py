@@ -32,9 +32,20 @@ DEFAULTS: Dict[str, Any] = {
     },
     "tiles": {
         "verify": {
-            "backend": "oracle",   # oracle | tpu
-            "mode": "direct",      # direct | rlc (RLC batch verification
-                                   # with per-lane fallback, tpu backend)
+            "backend": "cpu",      # cpu (native/oracle host) | oracle
+                                   # (pure-Python reference) | tpu
+            "mode": "direct",      # direct only. RLC batch verification
+                                   # is PARKED from the operator surface
+                                   # (round-5 decision, VERDICT #6): on
+                                   # v5e it measured 24.8k/s vs direct's
+                                   # 98.6k/s, and the round-5 MXU probe
+                                   # found no matmul path that would
+                                   # make the MSM cheap. The code +
+                                   # soundness tests remain
+                                   # (ops/verify_rlc.py,
+                                   # tests/test_verify_rlc.py); the
+                                   # bench ladder re-adds it only under
+                                   # FD_BENCH_RLC=1.
             "batch": 128,
             "max_msg_len": 0,      # 0 = mtu
             "tcache_depth": 4096,

@@ -143,6 +143,17 @@ def load_lib() -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_void_p,                   # lanes, tsorig
         ctypes.c_void_p,                                    # counters
     ]
+    if hasattr(lib, "fd_frag_drain"):  # absent in a stale build
+        lib.fd_frag_drain.restype = ctypes.c_int
+        lib.fd_frag_drain.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,               # mcache, dcache
+            ctypes.POINTER(ctypes.c_uint64),                # seq_io
+            ctypes.c_uint32, ctypes.c_uint32,               # max_n, mtu
+            ctypes.c_void_p, ctypes.c_uint32,               # payloads, cap
+            ctypes.c_void_p, ctypes.c_void_p,               # offs, lens
+            ctypes.c_void_p, ctypes.c_void_p,               # sigs, tsorigs
+            ctypes.c_void_p, ctypes.c_void_p,               # seqs, counters
+        ]
     return lib
 
 
@@ -154,6 +165,21 @@ def lib() -> ctypes.CDLL:
     if _lib is None:
         _lib = load_lib()
     return _lib
+
+
+_native_ok: bool | None = None
+
+
+def native_available() -> bool:
+    """True when the native ring library loads AND carries the bulk
+    drain entry (a stale .so keeps the pure-Python poll path)."""
+    global _native_ok
+    if _native_ok is None:
+        try:
+            _native_ok = hasattr(lib(), "fd_frag_drain")
+        except Exception:
+            _native_ok = False
+    return _native_ok
 
 
 class Alloc:

@@ -1,0 +1,987 @@
+// 8-way Ed25519 batch verify with AVX-512 IFMA (vpmadd52) — the host
+// fallback's wide lane, from scratch.
+//
+// Role: BASELINE "CPU fallback at reference-software parity". The
+// reference's software number is 30k verifies/s/core on Skylake AVX2
+// (src/wiredancer/README.md:65, built on 4-way AVX SHA-512 +
+// fd_ed25519 AVX field ops). This host has AVX-512 IFMA (52-bit
+// integer FMA), which maps radix-2^51 field arithmetic directly onto
+// vpmadd52lo/hi — 8 verifies ride one register lane-set through the
+// whole pipeline:
+//
+//   sha512 x8 (vprorq rounds, gathered message words)
+//   -> sc_reduce (scalar, cheap)
+//   -> decompress A x8 (shared exponent chains)
+//   -> fixed-window double-scalarmult x8 (w=4, 64 windows, per-lane
+//      A-table gathers + broadcast B-table, like the TPU kernel's
+//      schedule in ops/dsm_pallas.py — lane-uniform control flow, no
+//      per-lane vartime wNAF)
+//   -> compress via ONE vectorized invert chain for all 8 Zs
+//   -> byte-compare fast path; mismatch lanes fall back to the scalar
+//      verify_one (2-point slow path), so semantics stay EXACTLY the
+//      scalar path's (fd_ed25519_user.c:346-433 2-point scheme).
+//
+// Field element fe8: 5 limbs, radix 2^51, 8 lanes per __m512i.
+// madd52lo/hi multiply the LOW 52 bits of each operand, so every
+// multiply input must hold limbs < 2^52 — public ops restore that
+// invariant (carry chains) before any multiply.
+//
+// Bounds (mul): inputs < 2^52 -> each 104-bit product splits into
+// lo < 2^52, hi < 2^52; per output limb the accumulated sums are
+// L < 5*2^52, 19*Lw < 19*5*2^52 < 2^59, 2*H < 2^55.4, 38*Hw < 2^58.3;
+// total < 2^60.5 < 2^63: no accumulator overflow. Two carry passes
+// (x19 wrap) restore limbs < 2^52.
+//
+// Runtime dispatch: fd_ed25519_cpu_verify_batch (ed25519_cpu.cc) calls
+// fd_ed25519_avx512_verify_batch when __builtin_cpu_supports says the
+// host has avx512ifma; otherwise the scalar loop runs. This file is
+// compiled with the AVX-512 flags but only executed behind the check.
+
+#include <immintrin.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+
+// Scalar helpers shared with ed25519_cpu.cc (same translation unit
+// boundary: declared here, defined there).
+extern "C" int fd_ed25519_cpu_verify1(const uint8_t *msg, uint32_t msg_len,
+                                      const uint8_t *sig, const uint8_t *pub);
+
+namespace {
+
+using u64 = uint64_t;
+
+constexpr u64 MASK51 = (1ULL << 51) - 1;
+
+// ----------------------------------------------------------- fe8 core
+
+struct fe8 {
+  __m512i v[5];
+};
+
+static inline __m512i bc(u64 x) { return _mm512_set1_epi64((long long)x); }
+
+static inline fe8 fe8_zero() {
+  fe8 r;
+  for (int i = 0; i < 5; i++) r.v[i] = _mm512_setzero_si512();
+  return r;
+}
+
+// carry chain: limbs (< 2^63) -> limbs < 2^52. ONE sequential pass
+// suffices: after limb i is masked its outgoing carry (< 2^12 even for
+// mul accumulators < 2^61) lands on limb i+1 BEFORE that limb is
+// masked, so every masked limb ends < 2^51 + 2^12, and the 19-folded
+// top carry adds < 2^17 to limb 0 — all < 2^52, the madd52 input
+// invariant.
+static inline fe8 fe8_carry(fe8 a) {
+  __m512i c;
+  for (int i = 0; i < 4; i++) {
+    c = _mm512_srli_epi64(a.v[i], 51);
+    a.v[i] = _mm512_and_si512(a.v[i], bc(MASK51));
+    a.v[i + 1] = _mm512_add_epi64(a.v[i + 1], c);
+  }
+  c = _mm512_srli_epi64(a.v[4], 51);
+  a.v[4] = _mm512_and_si512(a.v[4], bc(MASK51));
+  // c * 19 = c*16 + c*2 + c
+  __m512i c19 = _mm512_add_epi64(
+      _mm512_add_epi64(_mm512_slli_epi64(c, 4), _mm512_slli_epi64(c, 1)), c);
+  a.v[0] = _mm512_add_epi64(a.v[0], c19);
+  return a;
+}
+
+static inline fe8 fe8_add(const fe8 &a, const fe8 &b) {
+  fe8 r;
+  for (int i = 0; i < 5; i++) r.v[i] = _mm512_add_epi64(a.v[i], b.v[i]);
+  return fe8_carry(r);
+}
+
+// 2p limb constants (radix 51): limb0 = 2*(2^51-19), rest = 2*(2^51-1).
+static inline fe8 fe8_sub(const fe8 &a, const fe8 &b) {
+  fe8 r;
+  r.v[0] = _mm512_sub_epi64(_mm512_add_epi64(a.v[0], bc(2 * (MASK51 - 18))),
+                            b.v[0]);
+  for (int i = 1; i < 5; i++)
+    r.v[i] = _mm512_sub_epi64(_mm512_add_epi64(a.v[i], bc(2 * MASK51)),
+                              b.v[i]);
+  return fe8_carry(r);
+}
+
+static inline fe8 fe8_neg(const fe8 &a) { return fe8_sub(fe8_zero(), a); }
+
+// c = a * b. Inputs: limbs < 2^52 (the public-op invariant).
+static fe8 fe8_mul(const fe8 &a, const fe8 &b) {
+  // Unwrapped (t = i+j < 5) and wrapped (t >= 5 -> t-5, x19) lo/hi
+  // accumulators; hi lands at t+1 with weight 2 (2^52 = 2*2^51).
+  __m512i L[5], Lw[5], H[6], Hw[5], Hww;
+  for (int i = 0; i < 5; i++) {
+    L[i] = _mm512_setzero_si512();
+    Lw[i] = _mm512_setzero_si512();
+    Hw[i] = _mm512_setzero_si512();
+  }
+  for (int i = 0; i < 6; i++) H[i] = _mm512_setzero_si512();
+  Hww = _mm512_setzero_si512();
+  for (int i = 0; i < 5; i++) {
+    for (int j = 0; j < 5; j++) {
+      int t = i + j;
+      if (t < 5) {
+        L[t] = _mm512_madd52lo_epu64(L[t], a.v[i], b.v[j]);
+        H[t + 1] = _mm512_madd52hi_epu64(H[t + 1], a.v[i], b.v[j]);
+      } else {
+        Lw[t - 5] = _mm512_madd52lo_epu64(Lw[t - 5], a.v[i], b.v[j]);
+        if (t + 1 - 5 < 5) {
+          Hw[t + 1 - 5] = _mm512_madd52hi_epu64(Hw[t + 1 - 5], a.v[i],
+                                                b.v[j]);
+        } else {
+          // t == 9 (i=j=4): hi lands at position 10, wrapping TWICE
+          // (2^510 = 19^2 mod p) back to limb 0 with weight 2*361.
+          Hww = _mm512_madd52hi_epu64(Hww, a.v[i], b.v[j]);
+        }
+      }
+    }
+  }
+  // H[5] wraps to position 0 (x19 on top of its weight-2).
+  fe8 c;
+  for (int t = 0; t < 5; t++) {
+    __m512i x = L[t];
+    // + 19 * Lw[t]
+    __m512i w = Lw[t];
+    x = _mm512_add_epi64(
+        x, _mm512_add_epi64(
+               _mm512_add_epi64(_mm512_slli_epi64(w, 4),
+                                _mm512_slli_epi64(w, 1)),
+               w));
+    // + 2 * H[t]   (H[0] is always zero)
+    x = _mm512_add_epi64(x, _mm512_slli_epi64(H[t], 1));
+    // + 38 * Hw[t] (2 * 19)
+    __m512i hw = Hw[t];
+    __m512i hw19 = _mm512_add_epi64(
+        _mm512_add_epi64(_mm512_slli_epi64(hw, 4), _mm512_slli_epi64(hw, 1)),
+        hw);
+    x = _mm512_add_epi64(x, _mm512_slli_epi64(hw19, 1));
+    c.v[t] = x;
+  }
+  // + 38 * H[5] at position 0
+  __m512i h5 = H[5];
+  __m512i h519 = _mm512_add_epi64(
+      _mm512_add_epi64(_mm512_slli_epi64(h5, 4), _mm512_slli_epi64(h5, 1)),
+      h5);
+  c.v[0] = _mm512_add_epi64(c.v[0], _mm512_slli_epi64(h519, 1));
+  // + 2 * 361 * Hww at position 0 (361 = 256 + 64 + 32 + 8 + 1)
+  __m512i w361 = _mm512_add_epi64(
+      _mm512_add_epi64(
+          _mm512_add_epi64(_mm512_slli_epi64(Hww, 8),
+                           _mm512_slli_epi64(Hww, 6)),
+          _mm512_add_epi64(_mm512_slli_epi64(Hww, 5),
+                           _mm512_slli_epi64(Hww, 3))),
+      Hww);
+  c.v[0] = _mm512_add_epi64(c.v[0], _mm512_slli_epi64(w361, 1));
+  return fe8_carry(c);
+}
+
+// c = a^2: the 15 cross products accumulate once and double at the
+// combine (doubling an OPERAND would overflow madd52's 52-bit input
+// read), the 5 squares accumulate straight — 40 madds vs mul's 50.
+static fe8 fe8_sq(const fe8 &a) {
+  // diag: i==j terms; cross: i<j terms (weight 2 applied at combine)
+  __m512i Ld[5], Lc[5], Lwd[5], Lwc[5], Hd[6], Hc[6], Hwd[5], Hwc[5];
+  __m512i Hwwd = _mm512_setzero_si512();  // (4,4) hi: wraps twice
+  for (int i = 0; i < 5; i++) {
+    Ld[i] = Lc[i] = Lwd[i] = Lwc[i] = Hwd[i] = Hwc[i] =
+        _mm512_setzero_si512();
+  }
+  for (int i = 0; i < 6; i++) Hd[i] = Hc[i] = _mm512_setzero_si512();
+  for (int i = 0; i < 5; i++) {
+    for (int j = i; j < 5; j++) {
+      int t = i + j;
+      __m512i *L = (i == j) ? Ld : Lc;
+      __m512i *H = (i == j) ? Hd : Hc;
+      __m512i *Lw = (i == j) ? Lwd : Lwc;
+      __m512i *Hw = (i == j) ? Hwd : Hwc;
+      if (t < 5) {
+        L[t] = _mm512_madd52lo_epu64(L[t], a.v[i], a.v[j]);
+        H[t + 1] = _mm512_madd52hi_epu64(H[t + 1], a.v[i], a.v[j]);
+      } else {
+        Lw[t - 5] = _mm512_madd52lo_epu64(Lw[t - 5], a.v[i], a.v[j]);
+        if (t + 1 - 5 < 5)
+          Hw[t + 1 - 5] = _mm512_madd52hi_epu64(Hw[t + 1 - 5], a.v[i],
+                                                a.v[j]);
+        else  // t == 9: only (4,4), a diag term
+          Hwwd = _mm512_madd52hi_epu64(Hwwd, a.v[i], a.v[j]);
+      }
+    }
+  }
+  auto x19 = [](__m512i w) {
+    return _mm512_add_epi64(
+        _mm512_add_epi64(_mm512_slli_epi64(w, 4), _mm512_slli_epi64(w, 1)),
+        w);
+  };
+  fe8 c;
+  for (int t = 0; t < 5; t++) {
+    // diag + 2*cross at every accumulator class
+    __m512i lo = _mm512_add_epi64(Ld[t], _mm512_slli_epi64(Lc[t], 1));
+    __m512i lw = _mm512_add_epi64(Lwd[t], _mm512_slli_epi64(Lwc[t], 1));
+    __m512i hi = _mm512_add_epi64(Hd[t], _mm512_slli_epi64(Hc[t], 1));
+    __m512i hw = _mm512_add_epi64(Hwd[t], _mm512_slli_epi64(Hwc[t], 1));
+    __m512i x = _mm512_add_epi64(lo, x19(lw));
+    x = _mm512_add_epi64(x, _mm512_slli_epi64(hi, 1));
+    x = _mm512_add_epi64(x, _mm512_slli_epi64(x19(hw), 1));
+    c.v[t] = x;
+  }
+  __m512i h5 = _mm512_add_epi64(Hd[5], _mm512_slli_epi64(Hc[5], 1));
+  c.v[0] = _mm512_add_epi64(c.v[0], _mm512_slli_epi64(x19(h5), 1));
+  // + 2 * 361 * Hwwd at limb 0 (the (4,4) hi, wrapped twice)
+  __m512i w361 = _mm512_add_epi64(
+      _mm512_add_epi64(
+          _mm512_add_epi64(_mm512_slli_epi64(Hwwd, 8),
+                           _mm512_slli_epi64(Hwwd, 6)),
+          _mm512_add_epi64(_mm512_slli_epi64(Hwwd, 5),
+                           _mm512_slli_epi64(Hwwd, 3))),
+      Hwwd);
+  c.v[0] = _mm512_add_epi64(c.v[0], _mm512_slli_epi64(w361, 1));
+  return fe8_carry(c);
+}
+
+// k small (< 2^11): c = a * k
+static inline fe8 fe8_mul_small(const fe8 &a, u64 k) {
+  fe8 r;
+  for (int i = 0; i < 5; i++)
+    r.v[i] = _mm512_mullo_epi64(a.v[i], bc(k));  // avx512dq
+  return fe8_carry(r);
+}
+
+// lane select: m lanes take a, else b.
+static inline fe8 fe8_sel(__mmask8 m, const fe8 &a, const fe8 &b) {
+  fe8 r;
+  for (int i = 0; i < 5; i++)
+    r.v[i] = _mm512_mask_blend_epi64(m, b.v[i], a.v[i]);
+  return r;
+}
+
+static fe8 fe8_from_bytes_lanes(const uint8_t *p32[8], bool mask_high) {
+  // per-lane scalar unpack (boundary op, not hot)
+  alignas(64) u64 limb[5][8];
+  for (int l = 0; l < 8; l++) {
+    u64 w[4];
+    memcpy(w, p32[l], 32);
+    if (mask_high) w[3] &= 0x7FFFFFFFFFFFFFFFULL;
+    limb[0][l] = w[0] & MASK51;
+    limb[1][l] = ((w[0] >> 51) | (w[1] << 13)) & MASK51;
+    limb[2][l] = ((w[1] >> 38) | (w[2] << 26)) & MASK51;
+    limb[3][l] = ((w[2] >> 25) | (w[3] << 39)) & MASK51;
+    limb[4][l] = (w[3] >> 12) & MASK51;
+  }
+  fe8 r;
+  for (int i = 0; i < 5; i++)
+    r.v[i] = _mm512_load_si512(limb[i]);
+  return r;
+}
+
+// canonical bytes of one lane
+static void fe8_tobytes_lane(uint8_t out[32], const fe8 &a, int lane) {
+  alignas(64) u64 limb[5][8];
+  for (int i = 0; i < 5; i++) _mm512_store_si512(limb[i], a.v[i]);
+  u64 t[5];
+  for (int i = 0; i < 5; i++) t[i] = limb[i][lane];
+  // full canonical reduce
+  for (int pass = 0; pass < 3; pass++) {
+    for (int i = 0; i < 4; i++) {
+      t[i + 1] += t[i] >> 51;
+      t[i] &= MASK51;
+    }
+    t[0] += 19 * (t[4] >> 51);
+    t[4] &= MASK51;
+  }
+  // subtract p if >= p (twice for safety)
+  for (int k = 0; k < 2; k++) {
+    u64 b;
+    u64 s0 = t[0] - (MASK51 - 18);
+    b = s0 >> 63;
+    u64 s1 = t[1] - MASK51 - b;
+    b = s1 >> 63;
+    u64 s2 = t[2] - MASK51 - b;
+    b = s2 >> 63;
+    u64 s3 = t[3] - MASK51 - b;
+    b = s3 >> 63;
+    u64 s4 = t[4] - MASK51 - b;
+    b = s4 >> 63;
+    if (!b) {
+      t[0] = s0 & MASK51;
+      t[1] = s1 & MASK51;
+      t[2] = s2 & MASK51;
+      t[3] = s3 & MASK51;
+      t[4] = s4 & MASK51;
+    }
+  }
+  u64 w0 = t[0] | (t[1] << 51);
+  u64 w1 = (t[1] >> 13) | (t[2] << 38);
+  u64 w2 = (t[2] >> 26) | (t[3] << 25);
+  u64 w3 = (t[3] >> 39) | (t[4] << 12);
+  memcpy(out, &w0, 8);
+  memcpy(out + 8, &w1, 8);
+  memcpy(out + 16, &w2, 8);
+  memcpy(out + 24, &w3, 8);
+}
+
+// lane mask: a == 0 mod p (canonicalized compare)
+static __mmask8 fe8_iszero_mask(const fe8 &a) {
+  uint8_t b[32];
+  __mmask8 m = 0;
+  for (int l = 0; l < 8; l++) {
+    fe8_tobytes_lane(b, a, l);
+    uint8_t acc = 0;
+    for (int i = 0; i < 32; i++) acc |= b[i];
+    if (acc == 0) m = (__mmask8)(m | (1u << l));
+  }
+  return m;
+}
+
+static __mmask8 fe8_isneg_mask(const fe8 &a) {
+  uint8_t b[32];
+  __mmask8 m = 0;
+  for (int l = 0; l < 8; l++) {
+    fe8_tobytes_lane(b, a, l);
+    if (b[0] & 1) m = (__mmask8)(m | (1u << l));
+  }
+  return m;
+}
+
+// ------------------------------------------------- exponent chains
+
+static fe8 fe8_sqn(fe8 x, int n) {
+  for (int i = 0; i < n; i++) x = fe8_sq(x);
+  return x;
+}
+
+// returns (z^(2^250-1), z^11)
+static void fe8_ladder(const fe8 &z, fe8 *z250, fe8 *z11) {
+  fe8 z2 = fe8_sq(z);
+  fe8 z9 = fe8_mul(fe8_sqn(z2, 2), z);
+  *z11 = fe8_mul(z9, z2);
+  fe8 z5 = fe8_mul(fe8_sq(*z11), z9);        // 2^5 - 2^0
+  fe8 z10 = fe8_mul(fe8_sqn(z5, 5), z5);     // 2^10 - 1
+  fe8 z20 = fe8_mul(fe8_sqn(z10, 10), z10);
+  fe8 z40 = fe8_mul(fe8_sqn(z20, 20), z20);
+  fe8 z50 = fe8_mul(fe8_sqn(z40, 10), z10);
+  fe8 z100 = fe8_mul(fe8_sqn(z50, 50), z50);
+  fe8 z200 = fe8_mul(fe8_sqn(z100, 100), z100);
+  *z250 = fe8_mul(fe8_sqn(z200, 50), z50);
+}
+
+static fe8 fe8_invert(const fe8 &z) {
+  fe8 z250, z11;
+  fe8_ladder(z, &z250, &z11);
+  return fe8_mul(fe8_sqn(z250, 5), z11);     // 2^255 - 21
+}
+
+static fe8 fe8_pow22523(const fe8 &z) {
+  fe8 z250, z11;
+  fe8_ladder(z, &z250, &z11);
+  return fe8_mul(fe8_sqn(z250, 2), z);       // 2^252 - 3
+}
+
+// ---------------------------------------------------- point ops (x8)
+
+struct ge8 {
+  fe8 X, Y, Z, T;
+};
+
+struct fe51 {
+  u64 v[5];
+};
+
+static fe51 fe51_from_int(const u64 w[4]) {
+  fe51 r;
+  r.v[0] = w[0] & MASK51;
+  r.v[1] = ((w[0] >> 51) | (w[1] << 13)) & MASK51;
+  r.v[2] = ((w[1] >> 38) | (w[2] << 26)) & MASK51;
+  r.v[3] = ((w[2] >> 25) | (w[3] << 39)) & MASK51;
+  r.v[4] = (w[3] >> 12) & MASK51;
+  return r;
+}
+
+static inline fe8 fe8_bc51(const fe51 &x) {
+  fe8 r;
+  for (int i = 0; i < 5; i++) r.v[i] = bc(x.v[i]);
+  return r;
+}
+
+// curve constant d, 2d (radix-51 limbs of the public values)
+static const u64 D_W[4] = {0x75eb4dca135978a3ULL, 0x00700a4d4141d8abULL,
+                           0x8cc740797779e898ULL, 0x52036cee2b6ffe73ULL};
+static const u64 D2_W[4] = {0xebd69b9426b2f159ULL, 0x00e0149a8283b156ULL,
+                            0x198e80f2eef3d130ULL, 0x2406d9dc56dffce7ULL};
+static const u64 SQRTM1_W[4] = {0xc4ee1b274a0ea0b0ULL, 0x2f431806ad2fe478ULL,
+                                0x2b4d00993dfbd7a7ULL, 0x2b8324804fc1df0bULL};
+
+static ge8 ge8_identity() {
+  ge8 r;
+  r.X = fe8_zero();
+  r.Z = fe8_zero();
+  r.T = fe8_zero();
+  r.Y = fe8_zero();
+  r.Y.v[0] = bc(1);
+  r.Z.v[0] = bc(1);
+  return r;
+}
+
+static ge8 ge8_dbl(const ge8 &p, bool need_t) {
+  fe8 a = fe8_sq(p.X);
+  fe8 b = fe8_sq(p.Y);
+  fe8 zz = fe8_sq(p.Z);
+  fe8 c = fe8_add(zz, zz);
+  fe8 d = fe8_neg(a);
+  fe8 e = fe8_sub(fe8_sub(fe8_sq(fe8_add(p.X, p.Y)), a), b);
+  fe8 g = fe8_add(d, b);
+  fe8 f = fe8_sub(g, c);
+  fe8 h = fe8_sub(d, b);
+  ge8 r;
+  r.X = fe8_mul(e, f);
+  r.Y = fe8_mul(g, h);
+  r.Z = fe8_mul(f, g);
+  if (need_t) r.T = fe8_mul(e, h);
+  return r;
+}
+
+static ge8 ge8_add_pt(const ge8 &p, const ge8 &q, const fe8 &d2,
+                      bool need_t) {
+  fe8 a = fe8_mul(fe8_sub(p.Y, p.X), fe8_sub(q.Y, q.X));
+  fe8 b = fe8_mul(fe8_add(p.Y, p.X), fe8_add(q.Y, q.X));
+  fe8 c = fe8_mul(fe8_mul(p.T, q.T), d2);
+  fe8 zz = fe8_mul(p.Z, q.Z);
+  fe8 dd = fe8_add(zz, zz);
+  fe8 e = fe8_sub(b, a);
+  fe8 f = fe8_sub(dd, c);
+  fe8 g = fe8_add(dd, c);
+  fe8 h = fe8_add(b, a);
+  ge8 r;
+  r.X = fe8_mul(e, f);
+  r.Y = fe8_mul(g, h);
+  r.Z = fe8_mul(f, g);
+  if (need_t) r.T = fe8_mul(e, h);
+  return r;
+}
+
+// q in niels form (yp = Y+X, ym = Y-X, t2 = 2d*T, plus Z). z_one skips
+// the zz multiply (affine table entries). Saves the d2 and (for
+// affine) the Z multiplies vs ge8_add_pt.
+struct ge8n {
+  fe8 yp, ym, z, t2;
+};
+
+static ge8 ge8_add_niels(const ge8 &p, const ge8n &q, bool z_one,
+                         bool need_t) {
+  fe8 a = fe8_mul(fe8_sub(p.Y, p.X), q.ym);
+  fe8 b = fe8_mul(fe8_add(p.Y, p.X), q.yp);
+  fe8 c = fe8_mul(p.T, q.t2);
+  fe8 zz = z_one ? p.Z : fe8_mul(p.Z, q.z);
+  fe8 dd = fe8_add(zz, zz);
+  fe8 e = fe8_sub(b, a);
+  fe8 f = fe8_sub(dd, c);
+  fe8 g = fe8_add(dd, c);
+  fe8 h = fe8_add(b, a);
+  ge8 r;
+  r.X = fe8_mul(e, f);
+  r.Y = fe8_mul(g, h);
+  r.Z = fe8_mul(f, g);
+  if (need_t) r.T = fe8_mul(e, h);
+  return r;
+}
+
+// ------------------------------------------------- decompress (x8)
+
+// donna semantics; returns ok mask. Failed lanes get identity poison.
+static __mmask8 ge8_frombytes(ge8 *out, const uint8_t *enc[8]) {
+  fe8 y = fe8_from_bytes_lanes(enc, true);
+  fe8 one = fe8_zero();
+  one.v[0] = bc(1);
+  fe8 d = fe8_bc51(fe51_from_int(D_W));
+  fe8 yy = fe8_sq(y);
+  fe8 u = fe8_sub(yy, one);
+  fe8 v = fe8_add(fe8_mul(yy, d), one);
+  fe8 v3 = fe8_mul(fe8_sq(v), v);
+  fe8 uv7 = fe8_mul(fe8_mul(fe8_sq(v3), v), u);
+  fe8 x = fe8_mul(fe8_mul(fe8_pow22523(uv7), v3), u);
+
+  fe8 vxx = fe8_mul(fe8_sq(x), v);
+  __mmask8 root_ok = fe8_iszero_mask(fe8_sub(vxx, u));
+  __mmask8 neg_ok = fe8_iszero_mask(fe8_add(vxx, u));
+  fe8 sqrtm1 = fe8_bc51(fe51_from_int(SQRTM1_W));
+  x = fe8_sel(root_ok, x, fe8_mul(x, sqrtm1));
+  __mmask8 ok = (__mmask8)(root_ok | neg_ok);
+
+  __mmask8 signbit = 0;
+  for (int l = 0; l < 8; l++)
+    if (enc[l][31] >> 7) signbit = (__mmask8)(signbit | (1u << l));
+  __mmask8 isneg = fe8_isneg_mask(x);
+  __mmask8 flip = (__mmask8)(isneg ^ signbit);
+  x = fe8_sel(flip, fe8_neg(x), x);
+
+  out->X = x;
+  out->Y = y;
+  out->Z = fe8_zero();
+  out->Z.v[0] = bc(1);
+  out->T = fe8_mul(x, y);
+  // poison failed lanes with identity
+  ge8 id = ge8_identity();
+  out->X = fe8_sel(ok, out->X, id.X);
+  out->Y = fe8_sel(ok, out->Y, id.Y);
+  out->T = fe8_sel(ok, out->T, id.T);
+  return ok;
+}
+
+// ---------------------------------------------------- sha512 (x8)
+
+static const u64 K512[80] = {
+    0x428a2f98d728ae22ULL, 0x7137449123ef65cdULL, 0xb5c0fbcfec4d3b2fULL,
+    0xe9b5dba58189dbbcULL, 0x3956c25bf348b538ULL, 0x59f111f1b605d019ULL,
+    0x923f82a4af194f9bULL, 0xab1c5ed5da6d8118ULL, 0xd807aa98a3030242ULL,
+    0x12835b0145706fbeULL, 0x243185be4ee4b28cULL, 0x550c7dc3d5ffb4e2ULL,
+    0x72be5d74f27b896fULL, 0x80deb1fe3b1696b1ULL, 0x9bdc06a725c71235ULL,
+    0xc19bf174cf692694ULL, 0xe49b69c19ef14ad2ULL, 0xefbe4786384f25e3ULL,
+    0x0fc19dc68b8cd5b5ULL, 0x240ca1cc77ac9c65ULL, 0x2de92c6f592b0275ULL,
+    0x4a7484aa6ea6e483ULL, 0x5cb0a9dcbd41fbd4ULL, 0x76f988da831153b5ULL,
+    0x983e5152ee66dfabULL, 0xa831c66d2db43210ULL, 0xb00327c898fb213fULL,
+    0xbf597fc7beef0ee4ULL, 0xc6e00bf33da88fc2ULL, 0xd5a79147930aa725ULL,
+    0x06ca6351e003826fULL, 0x142929670a0e6e70ULL, 0x27b70a8546d22ffcULL,
+    0x2e1b21385c26c926ULL, 0x4d2c6dfc5ac42aedULL, 0x53380d139d95b3dfULL,
+    0x650a73548baf63deULL, 0x766a0abb3c77b2a8ULL, 0x81c2c92e47edaee6ULL,
+    0x92722c851482353bULL, 0xa2bfe8a14cf10364ULL, 0xa81a664bbc423001ULL,
+    0xc24b8b70d0f89791ULL, 0xc76c51a30654be30ULL, 0xd192e819d6ef5218ULL,
+    0xd69906245565a910ULL, 0xf40e35855771202aULL, 0x106aa07032bbd1b8ULL,
+    0x19a4c116b8d2d0c8ULL, 0x1e376c085141ab53ULL, 0x2748774cdf8eeb99ULL,
+    0x34b0bcb5e19b48a8ULL, 0x391c0cb3c5c95a63ULL, 0x4ed8aa4ae3418acbULL,
+    0x5b9cca4f7763e373ULL, 0x682e6ff3d6b2b8a3ULL, 0x748f82ee5defb2fcULL,
+    0x78a5636f43172f60ULL, 0x84c87814a1f0ab72ULL, 0x8cc702081a6439ecULL,
+    0x90befffa23631e28ULL, 0xa4506cebde82bde9ULL, 0xbef9a3f7b2c67915ULL,
+    0xc67178f2e372532bULL, 0xca273eceea26619cULL, 0xd186b8c721c0c207ULL,
+    0xeada7dd6cde0eb1eULL, 0xf57d4f7fee6ed178ULL, 0x06f067aa72176fbaULL,
+    0x0a637dc5a2c898a6ULL, 0x113f9804bef90daeULL, 0x1b710b35131c471bULL,
+    0x28db77f523047d84ULL, 0x32caab7b40c72493ULL, 0x3c9ebe0a15c9bebcULL,
+    0x431d67c49c100d4cULL, 0x4cc5d4becb3e42b6ULL, 0x597f299cfc657e2aULL,
+    0x5fcb6fab3ad6faecULL, 0x6c44198c4a475817ULL};
+
+static inline __m512i S(__m512i x, int a, int b, int c) {
+  return _mm512_xor_si512(
+      _mm512_xor_si512(_mm512_ror_epi64(x, a), _mm512_ror_epi64(x, b)),
+      _mm512_ror_epi64(x, c));
+}
+
+static inline __m512i s0f(__m512i x) {
+  return _mm512_xor_si512(
+      _mm512_xor_si512(_mm512_ror_epi64(x, 1), _mm512_ror_epi64(x, 8)),
+      _mm512_srli_epi64(x, 7));
+}
+
+static inline __m512i s1f(__m512i x) {
+  return _mm512_xor_si512(
+      _mm512_xor_si512(_mm512_ror_epi64(x, 19), _mm512_ror_epi64(x, 61)),
+      _mm512_srli_epi64(x, 6));
+}
+
+// 8 independent messages, per-lane lengths. Produces 64-byte digests.
+// Lanes beyond n are ignored. Each lane's padded block stream is
+// materialized lane-side (boundary cost), then the rounds run 8-wide.
+static void sha512_x8(const uint8_t *msgs[8], const uint32_t lens[8],
+                      uint8_t out64[8][64], int n) {
+  // per-lane padded buffers
+  uint32_t nblocks[8] = {0};
+  uint32_t maxb = 0;
+  // worst case: msg + 17 bytes pad -> len/128 + 2 blocks
+  static thread_local uint8_t *pad_buf[8] = {nullptr};
+  static thread_local size_t pad_cap[8] = {0};
+  for (int l = 0; l < n; l++) {
+    uint64_t total = (uint64_t)lens[l] + 17;
+    uint32_t nb = (uint32_t)((total + 127) / 128);
+    nblocks[l] = nb;
+    if (nb > maxb) maxb = nb;
+    size_t need = (size_t)nb * 128;
+    if (pad_cap[l] < need) {
+      delete[] pad_buf[l];
+      pad_buf[l] = new uint8_t[need];
+      pad_cap[l] = need;
+    }
+    memcpy(pad_buf[l], msgs[l], lens[l]);
+    memset(pad_buf[l] + lens[l], 0, need - lens[l]);
+    pad_buf[l][lens[l]] = 0x80;
+    uint64_t bits = (uint64_t)lens[l] * 8;
+    for (int i = 0; i < 8; i++)
+      pad_buf[l][need - 1 - i] = (uint8_t)(bits >> (8 * i));
+  }
+  static const u64 IV[8] = {0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL,
+                            0x3c6ef372fe94f82bULL, 0xa54ff53a5f1d36f1ULL,
+                            0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+                            0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+  __m512i st[8];
+  for (int i = 0; i < 8; i++) st[i] = bc(IV[i]);
+
+  alignas(64) u64 wl[8];
+  for (uint32_t blk = 0; blk < maxb; blk++) {
+    __mmask8 active = 0;
+    for (int l = 0; l < n; l++)
+      if (blk < nblocks[l]) active = (__mmask8)(active | (1u << l));
+    __m512i W[16];
+    for (int t = 0; t < 16; t++) {
+      for (int l = 0; l < 8; l++) {
+        if (l < n && blk < nblocks[l]) {
+          u64 w;
+          memcpy(&w, pad_buf[l] + (size_t)blk * 128 + t * 8, 8);
+          wl[l] = __builtin_bswap64(w);
+        } else {
+          wl[l] = 0;
+        }
+      }
+      W[t] = _mm512_load_si512(wl);
+    }
+    __m512i a = st[0], b_ = st[1], c = st[2], d = st[3], e = st[4],
+            f = st[5], g = st[6], h = st[7];
+    for (int t = 0; t < 80; t++) {
+      __m512i wt;
+      if (t < 16) {
+        wt = W[t];
+      } else {
+        wt = _mm512_add_epi64(
+            _mm512_add_epi64(s1f(W[(t - 2) & 15]), W[(t - 7) & 15]),
+            _mm512_add_epi64(s0f(W[(t - 15) & 15]), W[t & 15]));
+        W[t & 15] = wt;
+      }
+      __m512i ch = _mm512_xor_si512(
+          _mm512_and_si512(e, f), _mm512_andnot_si512(e, g));
+      __m512i t1 = _mm512_add_epi64(
+          _mm512_add_epi64(_mm512_add_epi64(h, S(e, 14, 18, 41)),
+                           _mm512_add_epi64(ch, bc(K512[t]))),
+          wt);
+      __m512i maj = _mm512_xor_si512(
+          _mm512_xor_si512(_mm512_and_si512(a, b_), _mm512_and_si512(a, c)),
+          _mm512_and_si512(b_, c));
+      __m512i t2 = _mm512_add_epi64(S(a, 28, 34, 39), maj);
+      h = g;
+      g = f;
+      f = e;
+      e = _mm512_add_epi64(d, t1);
+      d = c;
+      c = b_;
+      b_ = a;
+      a = _mm512_add_epi64(t1, t2);
+    }
+    // masked state update: inactive lanes keep their state
+    st[0] = _mm512_mask_add_epi64(st[0], active, st[0], a);
+    st[1] = _mm512_mask_add_epi64(st[1], active, st[1], b_);
+    st[2] = _mm512_mask_add_epi64(st[2], active, st[2], c);
+    st[3] = _mm512_mask_add_epi64(st[3], active, st[3], d);
+    st[4] = _mm512_mask_add_epi64(st[4], active, st[4], e);
+    st[5] = _mm512_mask_add_epi64(st[5], active, st[5], f);
+    st[6] = _mm512_mask_add_epi64(st[6], active, st[6], g);
+    st[7] = _mm512_mask_add_epi64(st[7], active, st[7], h);
+  }
+  alignas(64) u64 sl[8][8];
+  for (int i = 0; i < 8; i++) _mm512_store_si512(sl[i], st[i]);
+  for (int l = 0; l < n; l++)
+    for (int i = 0; i < 8; i++) {
+      u64 w = __builtin_bswap64(sl[i][l]);
+      memcpy(out64[l] + 8 * i, &w, 8);
+    }
+}
+
+}  // namespace
+
+// The scalar side exposes these (ed25519_cpu.cc).
+extern "C" {
+int fd_ed25519_sc_ge_L(const uint8_t s[32]);
+void fd_ed25519_sc_reduce64(uint8_t out[32], const uint8_t wide[64]);
+int fd_ed25519_is_torsion_encoding(const uint8_t e[32]);
+}
+
+namespace {
+
+// ---------------------------------------------- fixed-window DSM x8
+
+// window digits: 64 nibbles of a 32-byte scalar, MSB window first
+static void nibbles_of(const uint8_t s[32], uint8_t w[64]) {
+  for (int i = 0; i < 32; i++) {
+    w[2 * i] = (uint8_t)(s[i] & 15);
+    w[2 * i + 1] = (uint8_t)(s[i] >> 4);
+  }
+}
+
+// per-lane A tables live as [entry][coord][limb][lane] u64 for gathers
+struct ATable {
+  alignas(64) u64 t[16][4][5][8];
+};
+
+// entries stored in NIELS form (yp, ym, z, t2) for the cheaper add
+static void store_entry(ATable &tab, int e, const ge8 &p, const fe8 &d2) {
+  fe8 yp = fe8_add(p.Y, p.X);
+  fe8 ym = fe8_sub(p.Y, p.X);
+  fe8 t2 = fe8_mul(p.T, d2);
+  alignas(64) u64 tmp[5][8];
+  const fe8 *coords[4] = {&yp, &ym, &p.Z, &t2};
+  for (int c = 0; c < 4; c++) {
+    for (int i = 0; i < 5; i++) _mm512_store_si512(tmp[i], coords[c]->v[i]);
+    for (int i = 0; i < 5; i++)
+      for (int l = 0; l < 8; l++) tab.t[e][c][i][l] = tmp[i][l];
+  }
+}
+
+static ge8n gather_entry(const ATable &tab, const uint8_t d[8]) {
+  // index (in u64 units) for lane l, coord c, limb i:
+  //   ((d[l]*4 + c)*5 + i)*8 + l
+  __m512i lane_iota = _mm512_set_epi64(7, 6, 5, 4, 3, 2, 1, 0);
+  alignas(64) u64 dl[8];
+  for (int l = 0; l < 8; l++) dl[l] = d[l];
+  __m512i dv = _mm512_load_si512(dl);
+  __m512i base = _mm512_add_epi64(
+      _mm512_mullo_epi64(dv, bc(4 * 5 * 8)), lane_iota);
+  ge8n r;
+  fe8 *coords[4] = {&r.yp, &r.ym, &r.z, &r.t2};
+  const u64 *flat = &tab.t[0][0][0][0];
+  for (int c = 0; c < 4; c++)
+    for (int i = 0; i < 5; i++) {
+      __m512i idx = _mm512_add_epi64(base, bc(((u64)c * 5 + i) * 8));
+      coords[c]->v[i] =
+          _mm512_i64gather_epi64(idx, (const long long *)flat, 8);
+    }
+  return r;
+}
+
+// shared B table (entry t = t*B affine niels-free extended, Z=1),
+// broadcast to lanes — built once, from the scalar table the scalar
+// path already computes via its own machinery. We rebuild here from
+// bytes to stay self-contained.
+struct BTable {
+  fe51 yp[16], ym[16], t2[16];  // affine niels: y+x, y-x, 2d*x*y
+  bool init = false;
+};
+
+static BTable g_btab;
+static std::atomic<int> g_btab_state{0};  // 0 empty, 1 building, 2 ready
+
+// scalar p+q on affine-extended coords via u128 (setup only, cold)
+struct P2 {
+  unsigned __int128 dummy;
+};
+
+}  // namespace
+
+// Scalar affine point add over GF(2^255-19) using __int128 bigints —
+// setup-only (builds the 16-entry B table once per process).
+extern "C" void fd_ed25519_scalar_btable(uint64_t out_xyt[16][3][4]);
+
+namespace {
+
+static void btab_init() {
+  int expect = 0;
+  if (g_btab_state.compare_exchange_strong(expect, 1)) {
+    uint64_t raw[16][3][4];
+    fd_ed25519_scalar_btable(raw);
+    for (int e = 0; e < 16; e++) {
+      g_btab.yp[e] = fe51_from_int(raw[e][0]);
+      g_btab.ym[e] = fe51_from_int(raw[e][1]);
+      g_btab.t2[e] = fe51_from_int(raw[e][2]);
+    }
+    g_btab_state.store(2);
+  } else {
+    while (g_btab_state.load() != 2) {
+    }
+  }
+}
+
+static ge8n btab_select(const uint8_t d[8]) {
+  // lanes select among 16 broadcast entries: masked blends (B table is
+  // shared, so this is 16 compares — no gather needed). Identity niels
+  // is (1, 1, 0); Z is 1 for every entry.
+  ge8n r;
+  r.yp = fe8_zero();
+  r.yp.v[0] = bc(1);
+  r.ym = r.yp;
+  r.t2 = fe8_zero();
+  r.z = r.yp;
+  for (int e = 1; e < 16; e++) {
+    __mmask8 m = 0;
+    for (int l = 0; l < 8; l++)
+      if (d[l] == e) m = (__mmask8)(m | (1u << l));
+    if (!m) continue;
+    r.yp = fe8_sel(m, fe8_bc51(g_btab.yp[e]), r.yp);
+    r.ym = fe8_sel(m, fe8_bc51(g_btab.ym[e]), r.ym);
+    r.t2 = fe8_sel(m, fe8_bc51(g_btab.t2[e]), r.t2);
+  }
+  return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+// 8 lanes of the 2-point verify; statuses written per lane. Lanes with
+// index >= n are ignored. Semantics identical to verify_one in
+// ed25519_cpu.cc (the scalar 2-point path): the fast path byte-compares
+// compress(h*(-A)+s*B) against r and defers ONLY mismatching lanes to
+// the scalar slow path (decode R, projective compare), which also
+// handles non-canonical r encodings.
+void fd_ed25519_avx512_verify8(const uint8_t *msgs[8],
+                               const uint32_t lens[8],
+                               const uint8_t *sigs[8],
+                               const uint8_t *pubs[8], int32_t status[8],
+                               int n) {
+  btab_init();
+  __mmask8 live = 0;
+  for (int l = 0; l < n; l++) {
+    const uint8_t *s_bytes = sigs[l] + 32;
+    if (fd_ed25519_sc_ge_L(s_bytes)) {
+      status[l] = -1;
+      continue;
+    }
+    status[l] = 0;
+    live = (__mmask8)(live | (1u << l));
+  }
+  if (!live) return;
+
+  // decompress A (all 8 lanes; dead lanes use lane 0's bytes)
+  const uint8_t *enc[8];
+  for (int l = 0; l < 8; l++)
+    enc[l] = (l < n && (live >> l) & 1) ? pubs[l] : pubs[0];
+  ge8 A;
+  __mmask8 dec_ok = ge8_frombytes(&A, enc);
+  // Status-code ORDER matches the scalar verify_pre exactly: A
+  // decompression failure (-2), then small-order A (-2), then
+  // small-order R (-1) — a torsion R with an undecodable A must read
+  // ERR_PUBKEY on every backend.
+  for (int l = 0; l < n; l++) {
+    if (!((live >> l) & 1)) continue;
+    if (!((dec_ok >> l) & 1) ||
+        fd_ed25519_is_torsion_encoding(pubs[l])) {
+      status[l] = -2;
+      live = (__mmask8)(live & ~(1u << l));
+    } else if (fd_ed25519_is_torsion_encoding(sigs[l])) {
+      status[l] = -1;
+      live = (__mmask8)(live & ~(1u << l));
+    }
+  }
+  if (!live) return;
+
+  // h = SHA-512(r || pub || msg) mod L, 8-wide
+  static thread_local uint8_t *cat_buf[8] = {nullptr};
+  static thread_local size_t cat_cap[8] = {0};
+  const uint8_t *hmsgs[8];
+  uint32_t hlens[8];
+  for (int l = 0; l < 8; l++) {
+    int src = (l < n && ((live >> l) & 1)) ? l : -1;
+    if (src < 0) {
+      hmsgs[l] = (const uint8_t *)"";
+      hlens[l] = 0;
+      continue;
+    }
+    size_t need = 64 + lens[l];
+    if (cat_cap[l] < need) {
+      delete[] cat_buf[l];
+      cat_buf[l] = new uint8_t[need < 256 ? 256 : need];
+      cat_cap[l] = need < 256 ? 256 : need;
+    }
+    memcpy(cat_buf[l], sigs[l], 32);
+    memcpy(cat_buf[l] + 32, pubs[l], 32);
+    memcpy(cat_buf[l] + 64, msgs[l], lens[l]);
+    hmsgs[l] = cat_buf[l];
+    hlens[l] = 64 + lens[l];
+  }
+  uint8_t h64[8][64];
+  sha512_x8(hmsgs, hlens, h64, 8);
+  uint8_t h32[8][32];
+  for (int l = 0; l < 8; l++) fd_ed25519_sc_reduce64(h32[l], h64[l]);
+
+  // negate A (the equation computes h*(-A) + s*B)
+  A.X = fe8_neg(A.X);
+  A.T = fe8_neg(A.T);
+
+  // per-lane A table: [0]=identity, [1]=A, dbl/add chain (niels form)
+  fe8 d2 = fe8_bc51(fe51_from_int(D2_W));
+  static thread_local ATable atab;
+  {
+    ge8 cur = ge8_identity();
+    store_entry(atab, 0, cur, d2);
+    store_entry(atab, 1, A, d2);
+    ge8 entries[16];
+    entries[0] = cur;
+    entries[1] = A;
+    for (int e = 2; e < 16; e++) {
+      if (e % 2 == 0)
+        entries[e] = ge8_dbl(entries[e / 2], true);
+      else
+        entries[e] = ge8_add_pt(entries[e - 1], A, d2, true);
+      store_entry(atab, e, entries[e], d2);
+    }
+  }
+
+  uint8_t hw[8][64], sw[8][64];
+  for (int l = 0; l < 8; l++) {
+    int src = (l < n && ((live >> l) & 1)) ? l : -1;
+    if (src < 0) {
+      memset(hw[l], 0, 64);
+      memset(sw[l], 0, 64);
+    } else {
+      nibbles_of(h32[l], hw[l]);
+      nibbles_of(sigs[l] + 32, sw[l]);
+    }
+  }
+
+  ge8 r = ge8_identity();
+  for (int wi = 63; wi >= 0; wi--) {
+    r = ge8_dbl(r, false);
+    r = ge8_dbl(r, false);
+    r = ge8_dbl(r, false);
+    r = ge8_dbl(r, true);
+    uint8_t dh[8], ds[8];
+    for (int l = 0; l < 8; l++) {
+      dh[l] = hw[l][wi];
+      ds[l] = sw[l][wi];
+    }
+    ge8n ta = gather_entry(atab, dh);
+    r = ge8_add_niels(r, ta, false, true);
+    ge8n tb = btab_select(ds);
+    r = ge8_add_niels(r, tb, true, false);
+    r.T = fe8_zero();  // T unused until the next window's last dbl
+  }
+
+  // compress: ONE vector invert for all 8 Zs
+  fe8 zinv = fe8_invert(r.Z);
+  fe8 ax = fe8_mul(r.X, zinv);
+  fe8 ay = fe8_mul(r.Y, zinv);
+  __mmask8 xneg = fe8_isneg_mask(ax);
+  for (int l = 0; l < n; l++) {
+    if (!((live >> l) & 1)) continue;
+    uint8_t yb[32];
+    fe8_tobytes_lane(yb, ay, l);
+    yb[31] = (uint8_t)(yb[31] | (((xneg >> l) & 1) << 7));
+    if (memcmp(yb, sigs[l], 32) == 0) {
+      status[l] = 0;
+    } else {
+      // slow path: the scalar 2-point verify decides (decodes R,
+      // projective compare; also the non-canonical-r accepts)
+      status[l] = fd_ed25519_cpu_verify1(msgs[l], lens[l], sigs[l],
+                                         pubs[l]);
+    }
+  }
+}
+
+// Unit-test hook: c = a*b (sq=0) or a^2 (sq=1) on 8 lanes of radix-51
+// limbs (u64[5][8] each), canonical byte outputs (8 x 32). Exercised by
+// tests/test_ed25519_avx512.py against python bigints.
+void fd_ed25519_avx512_fe8_mul_test(const uint64_t *a_limbs,
+                                    const uint64_t *b_limbs, int sq,
+                                    uint8_t out[8][32]) {
+  fe8 a, b;
+  for (int i = 0; i < 5; i++) {
+    a.v[i] = _mm512_loadu_si512(a_limbs + 8 * i);
+    b.v[i] = _mm512_loadu_si512(b_limbs + 8 * i);
+  }
+  fe8 c = sq ? fe8_sq(a) : fe8_mul(a, b);
+  for (int l = 0; l < 8; l++) fe8_tobytes_lane(out[l], c, l);
+}
+
+int fd_ed25519_avx512_available(void) {
+  return __builtin_cpu_supports("avx512ifma") &&
+         __builtin_cpu_supports("avx512vl") &&
+         __builtin_cpu_supports("avx512dq") &&
+         __builtin_cpu_supports("avx512bw");
+}
+
+}  // extern "C"

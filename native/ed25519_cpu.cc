@@ -21,6 +21,7 @@
 //     (matching FD_ED25519_* in ops/verify.py).
 
 #include <stdint.h>
+#include <stdlib.h>
 #include <string.h>
 
 namespace {
@@ -876,10 +877,61 @@ int fd_ed25519_cpu_verify1(const uint8_t *msg, uint32_t msg_len,
 // batch-inversion trick across pending lanes (one ~254-op power chain
 // + 3 muls/lane instead of a chain per lane — ~18% of a verify), in
 // fixed-size groups to bound scratch.
+// wide lane (ed25519_avx512.cc, linked on x86_64 only). The WEAK
+// definitions below are the non-x86 fallback: the strong definitions
+// in ed25519_avx512.o win when that object is linked.
+int fd_ed25519_avx512_available(void);
+void fd_ed25519_avx512_verify8(const uint8_t *msgs[8],
+                               const uint32_t lens[8],
+                               const uint8_t *sigs[8],
+                               const uint8_t *pubs[8], int32_t status[8],
+                               int n);
+
+__attribute__((weak)) int fd_ed25519_avx512_available(void) { return 0; }
+
+__attribute__((weak)) void fd_ed25519_avx512_verify8(
+    const uint8_t *msgs[8], const uint32_t lens[8], const uint8_t *sigs[8],
+    const uint8_t *pubs[8], int32_t status[8], int n) {
+  (void)msgs;
+  (void)lens;
+  (void)sigs;
+  (void)pubs;
+  (void)status;
+  (void)n;  // unreachable: available() gates every call
+}
+
 void fd_ed25519_cpu_verify_batch(const uint8_t *msgs, uint32_t msg_stride,
                                  const uint32_t *lens, const uint8_t *sigs,
                                  const uint8_t *pubs, int32_t *status,
                                  uint32_t n) {
+  // Wide lane: 8 verifies per AVX-512 IFMA register set when the host
+  // supports it (ed25519_avx512.cc; FD_NO_AVX512=1 forces scalar —
+  // the differential tests exercise both).
+  static int use_avx = -1;
+  if (use_avx < 0)
+    use_avx = fd_ed25519_avx512_available() && !getenv("FD_NO_AVX512");
+  if (use_avx) {
+    for (uint32_t base = 0; base < n; base += 8) {
+      int lim = (int)(n - base < 8 ? n - base : 8);
+      const uint8_t *m8[8], *s8[8], *p8[8];
+      uint32_t l8[8];
+      for (int k = 0; k < lim; k++) {
+        uint32_t i = base + (uint32_t)k;
+        m8[k] = msgs + (size_t)i * msg_stride;
+        l8[k] = lens[i];
+        s8[k] = sigs + (size_t)i * 64;
+        p8[k] = pubs + (size_t)i * 32;
+      }
+      for (int k = lim; k < 8; k++) {
+        m8[k] = m8[0];
+        l8[k] = 0;
+        s8[k] = s8[0];
+        p8[k] = p8[0];
+      }
+      fd_ed25519_avx512_verify8(m8, l8, s8, p8, status + base, lim);
+    }
+    return;
+  }
   constexpr uint32_t G = 64;
   ge rs[G];
   uint32_t idx[G];
@@ -913,6 +965,55 @@ void fd_ed25519_cpu_verify_batch(const uint8_t *msgs, uint32_t msg_stride,
       status[idx[j]] =
           verify_post(rs[j], r_check, sigs + (size_t)idx[j] * 64);
     }
+  }
+}
+
+// ---- exports for the AVX-512 wide lane (ed25519_avx512.cc) ---------
+
+int fd_ed25519_sc_ge_L(const uint8_t s[32]) { return sc_ge_L(s); }
+
+void fd_ed25519_sc_reduce64(uint8_t out[32], const uint8_t wide[64]) {
+  sc_reduce64(out, wide);
+}
+
+int fd_ed25519_is_torsion_encoding(const uint8_t e[32]) {
+  return is_torsion_encoding(e);
+}
+
+// B table for the wide fixed-window DSM: entry e = e*B in affine
+// NIELS form (y+x, y-x, 2d*x*y) as 4x64-bit little-endian words each
+// (the add then needs no d2 multiply and no zz multiply — Z = 1).
+// Cold setup path.
+void fd_ed25519_scalar_btable(uint64_t out_niels[16][3][4]) {
+  memset(out_niels, 0, sizeof(uint64_t) * 16 * 3 * 4);
+  out_niels[0][0][0] = 1;  // identity niels: (1, 1, 0)
+  out_niels[0][1][0] = 1;
+  // 2d mod p, little-endian words
+  static const uint64_t D2W[4] = {0xebd69b9426b2f159ULL,
+                                  0x00e0149a8283b156ULL,
+                                  0x198e80f2eef3d130ULL,
+                                  0x2406d9dc56dffce7ULL};
+  uint8_t d2b[32];
+  memcpy(d2b, D2W, 32);
+  fe d2;
+  fe_frombytes(d2, d2b);
+  for (int e = 1; e < 16; e++) {
+    uint8_t s[32] = {0};
+    s[0] = (uint8_t)e;
+    ge P = ge_scalarmult_base(s);
+    fe zi = fe_invert(P.Z);
+    fe ax = fe_mul(P.X, zi);
+    fe ay = fe_mul(P.Y, zi);
+    fe yp = fe_add(ay, ax);
+    fe ym = fe_sub(ay, ax);
+    fe t2 = fe_mul(fe_mul(ax, ay), d2);
+    uint8_t b0[32], b1[32], b2[32];
+    fe_tobytes(b0, yp);
+    fe_tobytes(b1, ym);
+    fe_tobytes(b2, t2);
+    memcpy(out_niels[e][0], b0, 32);
+    memcpy(out_niels[e][1], b1, 32);
+    memcpy(out_niels[e][2], b2, 32);
   }
 }
 

@@ -1,0 +1,127 @@
+"""The AVX-512 IFMA wide verify lane vs the scalar path and bigints.
+
+The wide lane (native/ed25519_avx512.cc) must be BIT-exact with the
+scalar 2-point verify for every input: same statuses on honest,
+corrupted, and the 396 Zcash malleability vectors. Skipped wholesale on
+hosts without avx512ifma (the runtime dispatch takes the scalar path
+there anyway).
+"""
+
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.ballet.ed25519 import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native lib not built")
+
+
+def _avx_available():
+    lib = native._find_lib()
+    try:
+        return bool(lib.fd_ed25519_avx512_available())
+    except AttributeError:
+        return False
+
+
+def test_fe8_mul_sq_exact_vs_bigint():
+    if not _avx_available():
+        pytest.skip("no avx512ifma")
+    lib = native._find_lib()
+    P = 2**255 - 19
+    M51 = (1 << 51) - 1
+    rng = np.random.RandomState(11)
+
+    def to_limbs(x):
+        return [(x >> (51 * i)) & M51 for i in range(5)]
+
+    for trial in range(20):
+        A = [int.from_bytes(rng.randint(0, 256, 32, dtype=np.uint8)
+                            .tobytes(), "little") % P for _ in range(8)]
+        B = [int.from_bytes(rng.randint(0, 256, 32, dtype=np.uint8)
+                            .tobytes(), "little") % P for _ in range(8)]
+        if trial == 0:
+            A = [P - 1] * 8
+            B = [P - 1] * 8
+        al = np.zeros((5, 8), np.uint64)
+        bl = np.zeros((5, 8), np.uint64)
+        for l in range(8):
+            la, lb = to_limbs(A[l]), to_limbs(B[l])
+            for i in range(5):
+                al[i, l] = la[i]
+                bl[i, l] = lb[i]
+        out = np.zeros((8, 32), np.uint8)
+        lib.fd_ed25519_avx512_fe8_mul_test(
+            al.ctypes.data_as(ctypes.c_void_p),
+            bl.ctypes.data_as(ctypes.c_void_p), 0,
+            out.ctypes.data_as(ctypes.c_void_p))
+        for l in range(8):
+            got = int.from_bytes(out[l].tobytes(), "little")
+            assert got == A[l] * B[l] % P, (trial, l)
+        lib.fd_ed25519_avx512_fe8_mul_test(
+            al.ctypes.data_as(ctypes.c_void_p),
+            bl.ctypes.data_as(ctypes.c_void_p), 1,
+            out.ctypes.data_as(ctypes.c_void_p))
+        for l in range(8):
+            got = int.from_bytes(out[l].tobytes(), "little")
+            assert got == A[l] * A[l] % P, ("sq", trial, l)
+
+
+def _cases():
+    rng = np.random.RandomState(7)
+    cases = []
+    for i in range(24):
+        seed = rng.randint(0, 256, 32, dtype=np.uint8).tobytes()
+        pub = native.public_key(seed)
+        m = rng.randint(0, 256, 50 + i, dtype=np.uint8).tobytes()
+        sig = native.sign(m, seed)
+        cases.append((sig, pub, m))
+        bs = bytearray(sig)
+        bs[i % 64] ^= 1
+        cases.append((bytes(bs), pub, m))
+        bm = bytearray(m)
+        bm[0] ^= 1
+        cases.append((sig, pub, bytes(bm)))
+        bp = bytearray(pub)
+        bp[i % 32] ^= 1
+        cases.append((sig, bytes(bp), m))
+    d = os.path.join(os.path.dirname(__file__), "fixtures")
+    for name in ("ed25519_malleability_should_pass.bin",
+                 "ed25519_malleability_should_fail.bin"):
+        raw = open(os.path.join(d, name), "rb").read()
+        for o in range(0, len(raw), 96):
+            cases.append((raw[o:o + 64], raw[o + 64:o + 96], b"Zcash"))
+    return cases
+
+
+def test_avx_matches_scalar_statuses():
+    if not _avx_available():
+        pytest.skip("no avx512ifma")
+    cases = _cases()
+    avx = native.verify_items(cases)
+    # scalar reference in a fresh process (the dispatch latches once)
+    import pickle
+
+    path = "/tmp/_avx_diff_cases.pkl"
+    with open(path, "wb") as f:
+        pickle.dump(cases, f)
+    code = (
+        "import pickle\n"
+        "from firedancer_tpu.ballet.ed25519 import native\n"
+        f"cases = pickle.load(open({path!r}, 'rb'))\n"
+        "print(pickle.dumps(native.verify_items(cases)).hex())\n"
+    )
+    env = dict(os.environ)
+    env["FD_NO_AVX512"] = "1"
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, env=env, timeout=600,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+    scalar = pickle.loads(bytes.fromhex(
+        out.stdout.strip().splitlines()[-1]))
+    assert avx == scalar
