@@ -130,3 +130,49 @@ def test_crash_only_restart_heals_pipeline(tmp_path, corpus):
     from firedancer_tpu.disco.corpus import sink_mismatch_count
 
     assert sink_mismatch_count(corpus, res.sink_digests) == 0
+
+
+def test_crash_restart_bulk_drain_content_exact(tmp_path):
+    """SIGKILL the verify tile while it runs the GENERIC native bulk
+    drain (round-5's fd_frag_drain path: verify_batch < MAX_SIG_CNT
+    disables the verify-specific drain, so the base Tile bulk poll
+    carries it): the batch crash-replay window (up to BULK_FRAGS
+    consumed-but-unpublished frags) must be absorbed exactly like the
+    per-frag window — the downstream dedup filters the respawned
+    tile's replays and delivery stays content-exact. The kill is gated
+    on OBSERVED partial delivery (sink fseq pub count strictly inside
+    (0, expected)) so the window cannot be vacuously empty. Compile-
+    free (cpu backend): covers bulk+restart without the tpu-worker's
+    cache-load cost."""
+    from firedancer_tpu.tango.rings import DIAG_PUB_CNT, FSeq, Workspace
+
+    corpus = mainnet_corpus(600, seed=5, dup_rate=0.0, corrupt_rate=0.0,
+                            parse_err_rate=0.0, max_data_sz=48)
+    topo = build_topology(str(tmp_path / "cr.wksp"), depth=64)
+    wksp = Workspace.join(topo.wksp_path)
+    sink_fseq = FSeq(wksp, topo.pod.query_cstr("firedancer.pack_sink.fseq"))
+    state = {"kills": 0, "recv_at_kill": -1}
+
+    def fault(tiles, elapsed):
+        if state["kills"]:
+            return
+        recv = sink_fseq.diag(DIAG_PUB_CNT)
+        if 0 < recv < corpus.n_unique_ok:
+            tp = tiles.get("verify")
+            if tp and tp.proc.poll() is None:
+                state["recv_at_kill"] = recv
+                os.kill(tp.proc.pid, signal.SIGKILL)
+                state["kills"] += 1
+
+    res = run_pipeline_supervised(
+        topo, corpus.payloads, verify_backend="cpu",
+        verify_batch=8,  # < MAX_SIG_CNT: forces the generic bulk drain
+        timeout_s=300.0, fault_hook=fault, record_digests=True,
+    )
+    from firedancer_tpu.disco.corpus import sink_delta
+
+    missing, unexpected = sink_delta(corpus, res.sink_digests)
+    assert state["kills"] == 1
+    assert 0 < state["recv_at_kill"] < corpus.n_unique_ok
+    assert res.supervisor_restarts >= 1
+    assert missing == 0 and unexpected == 0, (missing, unexpected)
