@@ -26,6 +26,14 @@ fi
 echo "== pytest (full lane; quick lane is: pytest -m 'not slow') =="
 python -m pytest tests/ -x -q
 
+echo "== RLC verify smoke (CPU backend, FD_BENCH_VERIFY=rlc) =="
+# The production verify mode's dispatch contract (round-6 promotion):
+# tiny batch through the tile-facing RLC wrapper — no fallback on clean
+# traffic, correct per-lane fallback on a salted lane, both bit-exact
+# against the Python oracle. Keeps the RLC path from silently rotting
+# back into parked status.
+JAX_PLATFORMS=cpu FD_BENCH_VERIFY=rlc python scripts/rlc_smoke.py
+
 echo "== fuzz smoke (10k iters/target) =="
 python fuzz/run_fuzz.py --iters 10000
 

@@ -12,7 +12,11 @@ from __future__ import annotations
 
 import copy
 import os
-import tomllib
+
+try:
+    import tomllib  # Python 3.11+
+except ModuleNotFoundError:  # 3.10 host: the API-compatible backport
+    import tomli as tomllib
 from typing import Any, Dict, Optional
 
 ENV_CONFIG = "FIREDANCER_CONFIG_TOML"
@@ -34,18 +38,23 @@ DEFAULTS: Dict[str, Any] = {
         "verify": {
             "backend": "cpu",      # cpu (native/oracle host) | oracle
                                    # (pure-Python reference) | tpu
-            "mode": "direct",      # direct only. RLC batch verification
-                                   # is PARKED from the operator surface
-                                   # (round-5 decision, VERDICT #6): on
-                                   # v5e it measured 24.8k/s vs direct's
-                                   # 98.6k/s, and the round-5 MXU probe
-                                   # found no matmul path that would
-                                   # make the MSM cheap. The code +
-                                   # soundness tests remain
-                                   # (ops/verify_rlc.py,
-                                   # tests/test_verify_rlc.py); the
-                                   # bench ladder re-adds it only under
-                                   # FD_BENCH_RLC=1.
+            "mode": "auto",        # auto | direct | rlc. Round-6
+                                   # UN-PARK: RLC batch verification is
+                                   # the primary device verify mode —
+                                   # the round-4 parking number (24.8k/s
+                                   # vs direct's 98.6k/s) was measured
+                                   # on the XLA-graph MSM only, never on
+                                   # the VMEM Pallas Pippenger engine
+                                   # (VERDICT r5 weak #4; op-count case
+                                   # in docs/ROOFLINE.md). 'auto'
+                                   # resolves per attached platform
+                                   # (ops/backend.default_verify_mode):
+                                   # rlc on TPU, direct per-lane on host
+                                   # backends. Batch-equation failure or
+                                   # fill overflow falls back to the
+                                   # exact per-lane path (~0.4x extra
+                                   # worst case; 2-point semantics
+                                   # pinned by the Zcash vectors).
             "batch": 128,
             "max_msg_len": 0,      # 0 = mtu
             "tcache_depth": 4096,

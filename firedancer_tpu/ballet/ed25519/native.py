@@ -183,9 +183,30 @@ def verify_arrays(msgs, lens, sigs, pubs, n: int):
 
     if n == 0:
         return np.zeros(0, np.int32)
-    assert msgs.dtype == np.uint8 and msgs.flags.c_contiguous
-    assert sigs.dtype == np.uint8 and sigs.flags.c_contiguous
-    assert pubs.dtype == np.uint8 and pubs.flags.c_contiguous
+    # Explicit raises, not asserts: python -O strips asserts, and a
+    # malformed staging buffer slipping through here hands garbage (or
+    # out-of-bounds) memory straight to fd_ed25519_cpu_verify_batch
+    # (ADVICE r5 low #2 — match the hardened length checks in
+    # verify_items above).
+    for name, arr in (("msgs", msgs), ("sigs", sigs), ("pubs", pubs)):
+        if arr.dtype != np.uint8 or not arr.flags.c_contiguous:
+            raise ValueError(
+                f"verify_arrays: {name} must be C-contiguous uint8 "
+                f"(got dtype={arr.dtype}, "
+                f"c_contiguous={arr.flags.c_contiguous})"
+            )
+    if msgs.ndim != 2 or sigs.shape[1:] != (64,) or pubs.shape[1:] != (32,):
+        raise ValueError(
+            "verify_arrays: expected msgs (B, stride), sigs (B, 64), "
+            f"pubs (B, 32); got {msgs.shape}, {sigs.shape}, {pubs.shape}"
+        )
+    if not (msgs.shape[0] >= n and sigs.shape[0] >= n
+            and pubs.shape[0] >= n and len(lens) >= n):
+        raise ValueError(
+            f"verify_arrays: n={n} exceeds staged rows "
+            f"({msgs.shape[0]}, {sigs.shape[0]}, {pubs.shape[0]}, "
+            f"{len(lens)})"
+        )
     lens32 = np.ascontiguousarray(lens[:n], np.uint32)
     status = np.zeros(n, np.int32)
     lib.fd_ed25519_cpu_verify_batch(

@@ -286,6 +286,12 @@ def _run_tiles(
                 "batches": v.stat_batches,
                 "flush_timeout": v.stat_flush_timeout,
                 "inflight_stall": v.stat_inflight_stall,
+                # RLC dispatch accounting (round-6 promotion): which
+                # mode ran and how many batches took the exact per-lane
+                # fallback — replay gates assert fallbacks stay 0 on
+                # clean traffic.
+                "mode": v.verify_mode,
+                "rlc_fallback": v.stat_rlc_fallback,
             }
             for v in verifies
         ],

@@ -258,6 +258,9 @@ class Tile:
         if st is None:
             import ctypes as _ct
 
+            from firedancer_tpu.tango.rings import (
+                frag_drain_has_ctl as _has_ctl,
+            )
             from firedancer_tpu.tango.rings import lib as _rings_lib
 
             n = self.BULK_FRAGS
@@ -275,6 +278,8 @@ class Tile:
                 "sigs": np.zeros(n, np.uint64),
                 "ts": np.zeros(n, np.uint32),
                 "seqs": np.zeros(n, np.uint64),
+                "ctls": np.zeros(n, np.uint16),
+                "has_ctl": _has_ctl(),
                 "ctr": np.zeros(2, np.uint64),
                 "cap": 0xFFFF,
             }
@@ -299,14 +304,18 @@ class Tile:
             ct = st["ct"]
             seq = ct.c_uint64(il.seq)
             ovr0 = int(st["ctr"][1])
-            n = st["lib"].fd_frag_drain(
+            args = [
                 il.mcache._mem, ct.addressof(il.dcache._buf),
                 ct.byref(seq), self.BULK_FRAGS, st["cap"],
                 st["pay"].ctypes.data, st["pay"].nbytes,
                 st["offs"].ctypes.data, st["lens"].ctypes.data,
                 st["sigs"].ctypes.data, st["ts"].ctypes.data,
-                st["seqs"].ctypes.data, st["ctr"].ctypes.data,
-            )
+                st["seqs"].ctypes.data,
+            ]
+            if st["has_ctl"]:  # stale .so builds lack the ctl output
+                args.append(st["ctls"].ctypes.data)
+            args.append(st["ctr"].ctypes.data)
+            n = st["lib"].fd_frag_drain(*args)
             d_ovr = int(st["ctr"][1]) - ovr0
             if d_ovr:
                 il.fseq.diag_add(DIAG_OVRNR_CNT, d_ovr)
@@ -316,11 +325,19 @@ class Tile:
                 pay = st["pay"]
                 offs, lens = st["offs"], st["lens"]
                 sigs, tss, seqs = st["sigs"], st["ts"], st["seqs"]
+                ctls = st["ctls"]
                 for i in range(n):
                     off = int(offs[i])
                     ln = int(lens[i])
+                    # Propagate the producer's ctl word (ADVICE r5 low
+                    # #3): a CTL_ERR frag must reach on_frag as an
+                    # error frag on the bulk path exactly as it does on
+                    # the per-frag Python poll. Stale .so builds have
+                    # no ctl output; they keep the old synthesized
+                    # SOM|EOM.
+                    ctl = int(ctls[i]) if st["has_ctl"] else CTL_SOM_EOM
                     frag = Frag(seq=int(seqs[i]), sig=int(sigs[i]),
-                                chunk=0, sz=ln, ctl=CTL_SOM_EOM,
+                                chunk=0, sz=ln, ctl=ctl,
                                 tsorig=int(tss[i]), tspub=0)
                     self.on_frag(frag, pay[off:off + ln].tobytes())
                 progressed = True
@@ -588,17 +605,66 @@ class VerifyTile(Tile):
         inflight: int = 2,
         max_wait_us: int = 500,
         native_drain: bool = True,
-        verify_mode: str = "direct",
+        verify_mode: str = "auto",
         mesh_devices: int = 0,
         **kw,
     ):
         super().__init__(wksp, cnc_name, in_link=in_link, out_link=out_link, **kw)
         assert backend in ("oracle", "cpu", "tpu")
-        assert verify_mode in ("direct", "rlc")
+        assert verify_mode in ("auto", "direct", "rlc")
+        if verify_mode == "auto":
+            # Production default (round-6 un-park): RLC batch verify is
+            # the PRIMARY device mode — one Pippenger MSM pass per
+            # clean batch, exact per-lane fallback on batch-equation
+            # failure or fill overflow (ops/verify_rlc.py). 'auto'
+            # resolves by the ATTACHED PLATFORM (backend.py policy):
+            # rlc on TPU families (where the VMEM MSM engine runs),
+            # direct on host-jax backends (CPU CI keeps its proven
+            # compile shapes; explicit verify_mode='rlc' still forces
+            # the RLC graph there, e.g. the ci.sh smoke lane).
+            # The env force is validated HERE as well as in backend.py
+            # (default_verify_mode): host-backend tiles must stay
+            # jax-import-free, so they cannot call into ops.backend,
+            # but an explicit force — or a typo'd one — must still fail
+            # loudly instead of being silently dropped.
+            forced = os.environ.get("FD_VERIFY_MODE")
+            if forced and forced not in ("rlc", "direct"):
+                raise ValueError(
+                    f"unknown FD_VERIFY_MODE {forced!r} (want rlc|direct)"
+                )
+            verify_mode = "direct"
+            if backend != "tpu":
+                if forced == "rlc":
+                    raise ValueError(
+                        "FD_VERIFY_MODE=rlc requires backend='tpu'"
+                    )
+            else:
+                from firedancer_tpu.ops.backend import default_verify_mode
+
+                verify_mode = default_verify_mode()
+                if verify_mode == "rlc" and mesh_devices:
+                    # Mesh: the sharded step is the direct graph; RLC
+                    # needs a sharded MSM (future work). A platform
+                    # auto-pick quietly stays direct, but an EXPLICIT
+                    # FD_VERIFY_MODE=rlc force must fail loudly, not be
+                    # silently dropped (same contract as the explicit
+                    # verify_mode='rlc' + mesh rejection below).
+                    if forced == "rlc":
+                        raise ValueError(
+                            "FD_VERIFY_MODE=rlc is not supported with "
+                            "mesh_devices (the RLC MSM graph is not "
+                            "sharded yet)"
+                        )
+                    verify_mode = "direct"
         if verify_mode == "rlc" and backend != "tpu":
             # Silently running the oracle path while the operator believes
             # RLC is on would be indistinguishable from "no fallbacks".
             raise ValueError("verify_mode='rlc' requires backend='tpu'")
+        if verify_mode == "rlc" and mesh_devices:
+            raise ValueError(
+                "verify_mode='rlc' is not supported with mesh_devices "
+                "(the RLC MSM graph is not sharded yet)"
+            )
         self.backend = backend
         self.verify_mode = verify_mode
         self.batch = batch
@@ -698,27 +764,32 @@ class VerifyTile(Tile):
                 self._verify_batch_fn = _mesh_fn
             else:
                 self._verify_batch_fn = jax.jit(verify_batch)
+            direct_fn = self._verify_batch_fn
             if verify_mode == "rlc":
                 # RLC batch-verify fast pass with lazy per-lane fallback
                 # (ops/verify_rlc.py); clean batches cost one MSM pass.
                 from firedancer_tpu.ops.verify_rlc import make_async_verifier
 
-                self._verify_batch_fn = make_async_verifier(
-                    self._verify_batch_fn
-                )
+                self._verify_batch_fn = make_async_verifier(direct_fn)
             # Pre-warm: compile the fixed (batch, max_msg_len) shape now
             # so the run loop never stalls on first-flush compilation.
             # This can take minutes (cold jit, or even a compile-cache
             # LOAD on a small host); in the supervised path worker.py's
             # boot-heartbeat thread keeps the cnc alive throughout, so
             # the wedge detector does not fire on a compiling tile.
-            out = self._verify_batch_fn(
+            warm_args = (
                 jnp.zeros((batch, max_msg_len), jnp.uint8),
                 jnp.zeros((batch,), jnp.int32),
                 jnp.zeros((batch, 64), jnp.uint8),
                 jnp.zeros((batch, 32), jnp.uint8),
             )
-            np.asarray(out)  # force all graphs (rlc + fallback)
+            np.asarray(self._verify_batch_fn(*warm_args))
+            if verify_mode == "rlc":
+                # The zero-lane warm batch resolves on the RLC pass
+                # alone, so the per-lane FALLBACK graph would otherwise
+                # compile mid-run on the first salted batch — warm it
+                # explicitly (one extra device pass at boot).
+                np.asarray(direct_fn(*warm_args))
 
     def _with_live_heartbeat(self, fn):
         """Run a blocking host-side operation inside the RUN loop (where

@@ -24,6 +24,17 @@ def use_specialized_square() -> bool:
     return os.environ.get("FD_SQ_IMPL", "sq") != "mul"
 
 
+def _platform_is_tpu() -> bool:
+    """Whether the attached jax backend is a TPU family (shared probe:
+    the pallas-kernel dispatch and the verify-mode default must never
+    disagree about what the device is)."""
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "cpu"
+    return platform in TPU_PLATFORMS
+
+
 def use_pallas(env_var: str) -> bool:
     """Decide at trace time whether to use the Pallas implementation."""
     impl = os.environ.get(env_var, "auto")
@@ -31,11 +42,28 @@ def use_pallas(env_var: str) -> bool:
         return False
     if impl == "pallas":
         return True
-    try:
-        platform = jax.devices()[0].platform
-    except Exception:
-        platform = "cpu"
-    return platform in TPU_PLATFORMS
+    return _platform_is_tpu()
+
+
+def default_verify_mode() -> str:
+    """Verify-tile mode when the config says 'auto' (round-6 RLC
+    promotion): 'rlc' — batch RLC verification over the VMEM Pallas
+    Pippenger MSM (ops/verify_rlc.py), one shared doubling chain per
+    batch with exact per-lane fallback — on TPU platforms; 'direct'
+    per-lane on host-jax backends (no VMEM engine to amortize, and the
+    CPU-jax RLC graph is a CI/parity path, not a production one).
+    FD_VERIFY_MODE forces either explicitly; an unrecognized value is
+    an error, not a silent fall-through to the platform default (a
+    typo'd force must never masquerade as a measurement of the mode
+    the operator asked for)."""
+    forced = os.environ.get("FD_VERIFY_MODE")
+    if forced:
+        if forced not in ("rlc", "direct"):
+            raise ValueError(
+                f"unknown FD_VERIFY_MODE {forced!r} (want rlc|direct)"
+            )
+        return forced
+    return "rlc" if _platform_is_tpu() else "direct"
 
 
 def kernel_mul_impl() -> str:

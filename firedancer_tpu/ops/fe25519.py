@@ -29,6 +29,8 @@ Bound analysis (why 4 vectorized carry passes after mul):
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -321,8 +323,12 @@ def fe_sq_f32(a: jnp.ndarray) -> jnp.ndarray:
 
     Contract: |limb| <= 512. Terms a_i * (2a)_j are <= 512*1024 = 2^19
     with <= 16 terms per row -> partial sums < 2^23: exact in f32. The
-    38-wrap and the even/odd interleave run in int32.
+    38-wrap and the even/odd interleave run in int32. (Tighter than the
+    generic |limb| <= 1024 kernel-multiply contract — see
+    fe_mul_kernel's f32 dispatch note; FD_FE_DEBUG_BOUNDS=1 checks
+    concrete operands.)
     """
+    _debug_check_f32_bound(a)
     batch = a.shape[1:]
     af = a.astype(jnp.float32)
     ad = af + af
@@ -357,6 +363,32 @@ def fe_sq_f32(a: jnp.ndarray) -> jnp.ndarray:
     return _carry_pass(c, 4)
 
 
+def _debug_check_f32_bound(*operands) -> None:
+    """Debug-mode guard for the NARROWER f32 contract (ADVICE r5 low
+    #1): fe_mul_f32/fe_sq_f32 are exact only for |limb| <= 512, while
+    the generic kernel-multiply contract (fe_mul_unrolled et al.)
+    accepts |limb| <= 1024. Active only under FD_FE_DEBUG_BOUNDS=1 —
+    concrete operands (eager / interpret-style evaluation) are checked
+    directly; traced operands inside a compiled kernel cannot be
+    inspected at trace time and pass through unchecked, so debug runs
+    that want the guard must evaluate eagerly or in interpret mode."""
+    if os.environ.get("FD_FE_DEBUG_BOUNDS", "0") != "1":
+        return
+    for x in operands:
+        try:
+            cx = np.asarray(x)
+        except Exception:
+            continue  # traced operand: not inspectable at trace time;
+            #            still check any concrete co-operand
+        m = int(np.abs(cx).max()) if cx.size else 0
+        if m > 512:
+            raise ValueError(
+                f"FD_MUL_IMPL=f32 requires |limb| <= 512 (got {m}): "
+                "f32 partial sums are only exact under the tighter "
+                "bound — see fe_mul_f32's contract"
+            )
+
+
 def fe_mul_kernel(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """The multiply used INSIDE Pallas kernels, dispatched at trace
     time by FD_MUL_IMPL: schoolbook int32 (default), karatsuba, or f32
@@ -367,6 +399,15 @@ def fe_mul_kernel(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     if impl == "karatsuba":
         return fe_mul_karatsuba(a, b)
     if impl == "f32":
+        # TIGHTER input invariant than the other impls: f32 exactness
+        # needs |limb| <= 512 on BOTH operands (fe_mul_f32's bound
+        # analysis), not the |limb| <= 1024 the kernel-multiply
+        # contract otherwise advertises. Every current kernel call
+        # site stays <= ~407 (fe_add/fe_sub of public-op outputs); a
+        # future op emitting limbs in (512, 1024] would silently
+        # compute wrong products here. FD_FE_DEBUG_BOUNDS=1 checks
+        # concrete operands in debug/eager runs.
+        _debug_check_f32_bound(a, b)
         return fe_mul_f32(a, b)
     if impl == "rolled":
         return fe_mul_rolled(a, b)

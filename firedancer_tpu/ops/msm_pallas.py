@@ -35,6 +35,20 @@ from . import fe25519 as fe
 NLIMBS = fe.NLIMBS
 
 
+def _tpu_compiler_params(**kw):
+    """pltpu compiler-params across jax versions: 0.4.x exposes
+    TPUCompilerParams, newer releases renamed it CompilerParams. The
+    parked round-4 code used only the new name, so the kernels failed
+    to TRACE on this image's jax — exactly the kind of rot the round-6
+    un-park (and its CI smoke lane) exists to catch."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
 def _madd_niels(p, q_niels):
     """Unified mixed add: p extended (x, y, z, t) + q in niels form
     (yp = y+x, ym = y-x, t2d = 2d*t), q.Z == 1. 7 field muls."""
@@ -131,7 +145,7 @@ def fill_buckets_pallas(yp, ym, t2d, lane_tile: int = 2048,
         scratch_shapes=[
             pltpu.VMEM((NLIMBS, lane_tile), jnp.int32) for _ in range(4)
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,
@@ -336,7 +350,7 @@ def aggregate_buckets_pallas(buckets, d2_col, interpret: bool = False):
         scratch_shapes=[
             pltpu.VMEM((NLIMBS, nw), jnp.int32) for _ in range(8)
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

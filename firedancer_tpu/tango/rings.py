@@ -145,15 +145,23 @@ def load_lib() -> ctypes.CDLL:
     ]
     if hasattr(lib, "fd_frag_drain"):  # absent in a stale build
         lib.fd_frag_drain.restype = ctypes.c_int
-        lib.fd_frag_drain.argtypes = [
+        argt = [
             ctypes.c_void_p, ctypes.c_void_p,               # mcache, dcache
             ctypes.POINTER(ctypes.c_uint64),                # seq_io
             ctypes.c_uint32, ctypes.c_uint32,               # max_n, mtu
             ctypes.c_void_p, ctypes.c_uint32,               # payloads, cap
             ctypes.c_void_p, ctypes.c_void_p,               # offs, lens
             ctypes.c_void_p, ctypes.c_void_p,               # sigs, tsorigs
-            ctypes.c_void_p, ctypes.c_void_p,               # seqs, counters
+            ctypes.c_void_p,                                # seqs
+            ctypes.c_void_p,                                # counters
         ]
+        if hasattr(lib, "fd_frag_drain_has_ctl"):
+            # Current ABI: the drain exports the meta ctl word (one
+            # more output array, before counters) so a producer's
+            # CTL_ERR is not laundered into a normal frag on the bulk
+            # path. A stale .so keeps the pre-ctl call shape.
+            argt.insert(len(argt) - 1, ctypes.c_void_p)     # ctls
+        lib.fd_frag_drain.argtypes = argt
     return lib
 
 
@@ -180,6 +188,16 @@ def native_available() -> bool:
         except Exception:
             _native_ok = False
     return _native_ok
+
+
+def frag_drain_has_ctl() -> bool:
+    """True when fd_frag_drain exports the meta ctl word (current ABI).
+    A stale .so without the marker keeps the old call shape; callers
+    synthesize CTL_SOM_EOM for it, exactly the pre-ctl behavior."""
+    try:
+        return hasattr(lib(), "fd_frag_drain_has_ctl")
+    except Exception:
+        return False
 
 
 class Alloc:

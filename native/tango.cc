@@ -482,14 +482,24 @@ uint64_t fd_cnc_diag_get(void* mem, uint32_t idx) {
 // the payload, fence, re-validate the meta seq.
 //
 //   payloads: packed bytes; frag i at offs[i], length lens[i]
+//   ctls:     the meta ctl word per frag — the drain must not launder a
+//             producer's CTL_ERR into a normal frag (the per-frag
+//             Python poll preserves ctl; so must the bulk path)
 //   counters: u64[2] {drained, overrun}
 // Returns the number of staged frags; *seq_io advances past every
 // consumed frag (overruns skip forward like the Python poll).
+//
+// ABI marker: fd_frag_drain grew the ctls output (one more array) —
+// Python callers probe fd_frag_drain_has_ctl before passing it, so a
+// stale .so without the marker takes the old call shape (and the
+// synthesized CTL_SOM_EOM) instead of corrupting the stack.
+int fd_frag_drain_has_ctl(void) { return 1; }
+
 int fd_frag_drain(void *mcache, void *dcache_base, uint64_t *seq_io,
                   uint32_t max_n, uint32_t mtu,
                   uint8_t *payloads, uint32_t payload_cap,
                   uint32_t *offs, uint32_t *lens, uint64_t *sigs,
-                  uint32_t *tsorigs, uint64_t *seqs,
+                  uint32_t *tsorigs, uint64_t *seqs, uint16_t *ctls,
                   uint64_t *counters) {
   auto *h = (mcache_hdr *)mcache;
   auto *line = (frag_meta *)((char *)mcache + sizeof(mcache_hdr));
@@ -509,6 +519,7 @@ int fd_frag_drain(void *mcache, void *dcache_base, uint64_t *seq_io,
     uint64_t sig = m->sig.load(std::memory_order_relaxed);
     uint32_t chunk = m->chunk.load(std::memory_order_relaxed);
     uint16_t sz = m->sz.load(std::memory_order_relaxed);
+    uint16_t ctl = m->ctl.load(std::memory_order_relaxed);
     uint32_t tsorig = m->tsorig.load(std::memory_order_relaxed);
     uint32_t cp = sz <= mtu ? sz : mtu;
     if (pay_off + cp > payload_cap) break;  // out of staging room
@@ -525,6 +536,7 @@ int fd_frag_drain(void *mcache, void *dcache_base, uint64_t *seq_io,
     sigs[n] = sig;
     tsorigs[n] = tsorig;
     seqs[n] = seq;
+    ctls[n] = ctl;
     pay_off += cp;
     n += 1;
     counters[0] += 1;

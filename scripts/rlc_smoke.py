@@ -1,0 +1,137 @@
+"""FD_BENCH_VERIFY=rlc CPU-backend smoke lane (ci.sh).
+
+The round-6 promotion made RLC batch verification the primary device
+verify mode (ops/verify_rlc.py, docs/ROOFLINE.md). This lane exists so
+the RLC dispatch path can never silently rot back into parked status:
+it runs the EXACT tile-facing wrapper (make_async_verifier — the same
+object VerifyTile and the bench's rlc rung dispatch) on the CPU backend
+with a tiny batch and asserts
+
+  1. clean traffic: no per-lane fallback, statuses bit-exact against
+     the pure-Python per-lane oracle;
+  2. a salted lane: the wrapper falls back to the exact per-lane path
+     and the post-fallback statuses are bit-exact against the oracle
+     (the forced-fallback batch is part of the parity contract, not an
+     error path).
+
+Shapes are pinned to the test suite's (16, 64) / K=8 RLC graph so the
+persistent jax compilation cache makes this lane cheap after the first
+CI run. Exits nonzero (with a JSON error line) on any divergence.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+)
+
+N = 16
+MAX_LEN = 64
+TORSION_K = 8
+
+
+def _batch(oracle, np, salt_lane=None):
+    rng = np.random.RandomState(7)
+    msgs = np.zeros((N, MAX_LEN), np.uint8)
+    lens = np.zeros(N, np.int32)
+    sigs = np.zeros((N, 64), np.uint8)
+    pubs = np.zeros((N, 32), np.uint8)
+    for i in range(N):
+        seed = bytes([i + 1]) * 32
+        _, _, pub = oracle.keypair_from_seed(seed)
+        m = rng.randint(0, 256, rng.randint(1, MAX_LEN), dtype=np.uint8)
+        sig = oracle.sign(m.tobytes(), seed)
+        msgs[i, : len(m)] = m
+        lens[i] = len(m)
+        sigs[i] = np.frombuffer(sig, np.uint8)
+        pubs[i] = np.frombuffer(pub, np.uint8)
+    if salt_lane is not None:
+        sigs[salt_lane, 2] ^= 0x40  # corrupt R: RLC equation must fail
+    return msgs, lens, sigs, pubs
+
+
+def main() -> int:
+    mode = os.environ.get("FD_BENCH_VERIFY", "rlc")
+    if mode != "rlc":
+        print(json.dumps({"lane": "rlc_smoke", "ok": False,
+                          "error": f"lane requires FD_BENCH_VERIFY=rlc, "
+                                   f"got {mode!r}"}))
+        return 1
+
+    import jax
+    import numpy as np
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     os.pardir, ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ballet.ed25519 import oracle
+    from firedancer_tpu.ops.verify import verify_batch
+    from firedancer_tpu.ops.verify_rlc import make_async_verifier
+
+    t0 = time.perf_counter()
+    direct = jax.jit(verify_batch)
+    fn = make_async_verifier(direct, torsion_k=TORSION_K)
+
+    def run(salt_lane=None):
+        msgs, lens, sigs, pubs = _batch(oracle, np, salt_lane)
+        out = fn(jnp.asarray(msgs), jnp.asarray(lens),
+                 jnp.asarray(sigs), jnp.asarray(pubs))
+        st = np.asarray(out)
+        want = np.asarray(
+            [oracle.verify(msgs[i, : lens[i]].tobytes(),
+                           sigs[i].tobytes(), pubs[i].tobytes())
+             for i in range(N)], np.int32)
+        return out, st, want
+
+    # 1. Clean traffic: the RLC pass must accept without fallback and
+    #    match the per-lane oracle bit-exactly.
+    out, st, want = run()
+    if out.used_fallback:
+        print(json.dumps({"lane": "rlc_smoke", "ok": False,
+                          "error": "clean batch took the per-lane "
+                                   "fallback (RLC pass rejected honest "
+                                   "traffic)"}))
+        return 1
+    if not (st == want).all() or not (want == 0).all():
+        print(json.dumps({"lane": "rlc_smoke", "ok": False,
+                          "error": "clean-batch status mismatch vs "
+                                   "per-lane oracle",
+                          "got": st.tolist(), "want": want.tolist()}))
+        return 1
+
+    # 2. Salted lane: the batch equation must fail, route to the exact
+    #    per-lane path, and the final statuses must be bit-exact.
+    out, st, want = run(salt_lane=5)
+    if not out.used_fallback:
+        print(json.dumps({"lane": "rlc_smoke", "ok": False,
+                          "error": "salted batch did NOT fall back — "
+                                   "the RLC equation accepted a bad "
+                                   "lane"}))
+        return 1
+    if not (st == want).all() or want[5] == 0:
+        print(json.dumps({"lane": "rlc_smoke", "ok": False,
+                          "error": "post-fallback status mismatch vs "
+                                   "per-lane oracle",
+                          "got": st.tolist(), "want": want.tolist()}))
+        return 1
+
+    print(json.dumps({
+        "lane": "rlc_smoke", "ok": True, "mode": mode,
+        "batch": N, "torsion_k": TORSION_K,
+        "clean_fallback": False, "salted_fallback": True,
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

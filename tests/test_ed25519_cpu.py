@@ -124,3 +124,44 @@ def test_native_sign_and_keypair_match_oracle():
     batch = native.sign_jobs(jobs)
     for (m, seed), sig in zip(jobs, batch):
         assert sig == oracle.sign(m, seed)
+
+
+def _staged_arrays(n=4, stride=160, seed=51):
+    rng = np.random.RandomState(seed)
+    msgs = np.zeros((n, stride), np.uint8)
+    lens = np.zeros(n, np.uint32)
+    sigs = np.zeros((n, 64), np.uint8)
+    pubs = np.zeros((n, 32), np.uint8)
+    for i in range(n):
+        sk = rng.randint(0, 256, 32, dtype=np.uint8).tobytes()
+        _, _, pub = oracle.keypair_from_seed(sk)
+        m = rng.randint(0, 256, 40 + i, dtype=np.uint8).tobytes()
+        msgs[i, : len(m)] = np.frombuffer(m, np.uint8)
+        lens[i] = len(m)
+        sigs[i] = np.frombuffer(oracle.sign(m, sk), np.uint8)
+        pubs[i] = np.frombuffer(pub, np.uint8)
+    return msgs, lens, sigs, pubs
+
+
+def test_verify_arrays_rejects_malformed_staging():
+    """ADVICE r5 low #2: the FFI boundary must raise (not assert) on a
+    malformed staging buffer — python -O strips asserts, and a wrong
+    dtype / non-contiguous array handed to fd_ed25519_cpu_verify_batch
+    reads garbage or out-of-bounds memory."""
+    msgs, lens, sigs, pubs = _staged_arrays()
+    # The well-formed layout verifies clean (guard must not over-reject).
+    st = native.verify_arrays(msgs, lens, sigs, pubs, len(lens))
+    assert st is not None and (st == 0).all()
+    with pytest.raises(ValueError, match="uint8"):
+        native.verify_arrays(msgs.astype(np.int32), lens, sigs, pubs, 4)
+    with pytest.raises(ValueError, match="C-contiguous"):
+        native.verify_arrays(np.asfortranarray(msgs), lens, sigs, pubs, 4)
+    with pytest.raises(ValueError, match="uint8"):
+        native.verify_arrays(msgs, lens, sigs.astype(np.uint16), pubs, 4)
+    with pytest.raises(ValueError, match="64"):
+        native.verify_arrays(
+            msgs, lens, np.ascontiguousarray(sigs[:, :32]), pubs, 4)
+    with pytest.raises(ValueError, match="exceeds"):
+        native.verify_arrays(msgs, lens, sigs, pubs, 5)
+    # n=0 short-circuits before the layout checks (empty drain round).
+    assert len(native.verify_arrays(msgs, lens, sigs, pubs, 0)) == 0

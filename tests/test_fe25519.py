@@ -208,6 +208,37 @@ def test_fe_mul_kernel_dispatch(monkeypatch):
     assert fe.limbs_to_int(fe.fe_mul_kernel(a, b)) == want
 
 
+def test_fe_mul_kernel_f32_debug_bound(monkeypatch):
+    """ADVICE r5 low #1: the f32 multiply's contract is |limb| <= 512,
+    NARROWER than the generic |limb| <= 1024 kernel-multiply contract.
+    Under FD_FE_DEBUG_BOUNDS=1 the dispatch point rejects concrete
+    out-of-contract operands instead of silently computing wrong
+    products; in-contract operands and disabled-guard runs pass."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops import fe25519 as fe
+
+    rng = np.random.RandomState(23)
+    ok_ops = jnp.asarray(rng.randint(-512, 513, (32, 8)).astype(np.int32))
+    hot = np.asarray(rng.randint(-512, 513, (32, 8)), np.int32)
+    hot[3, 2] = 600  # inside the generic contract, outside f32's
+    hot_ops = jnp.asarray(hot)
+
+    monkeypatch.setenv("FD_MUL_IMPL", "f32")
+    monkeypatch.setenv("FD_FE_DEBUG_BOUNDS", "1")
+    # In-contract: guard passes and the product is exact.
+    want = fe.limbs_to_int(fe.fe_mul(ok_ops, ok_ops))
+    assert fe.limbs_to_int(fe.fe_mul_kernel(ok_ops, ok_ops)) == want
+    with pytest.raises(ValueError, match="512"):
+        fe.fe_mul_kernel(ok_ops, hot_ops)
+    with pytest.raises(ValueError, match="512"):
+        fe.fe_sq_f32(hot_ops)
+    # Guard off (production kernels): dispatch never pays the check.
+    monkeypatch.delenv("FD_FE_DEBUG_BOUNDS")
+    fe.fe_mul_kernel(ok_ops, hot_ops)  # no raise (caller's contract)
+
+
 def test_fe_mul_rolled_matches_fe_mul():
     """The 7-rotation schedule over the full |limb| <= 1024 input range
     (same contract as fe_mul_unrolled), plus the output bound."""

@@ -76,3 +76,65 @@ def test_native_drain_pipeline(tmp_path):
     assert Counter(res.sink_digests) == expected_sink_digests(corpus)
     # The native drain actually ran (batches dispatched via staging).
     assert res.verify_stats[0]["batches"] >= 1
+
+
+def test_frag_drain_preserves_ctl(tmp_path):
+    """ADVICE r5 low #3: the bulk drain must export the meta ctl word —
+    a producer publishing CTL_ERR must not be laundered into a normal
+    (SOM|EOM) frag on the native path while the per-frag Python poll
+    preserves it."""
+    from firedancer_tpu.disco.tiles import InLink, LinkNames, Tile
+    from firedancer_tpu.tango.rings import (
+        CTL_ERR,
+        Cnc,
+        DCache,
+        FSeq,
+        MCache,
+        Workspace,
+        frag_drain_has_ctl,
+        native_available,
+    )
+
+    if not native_available():
+        pytest.skip("native library not built")
+    assert frag_drain_has_ctl(), (
+        "libfdtango.so is stale: rebuild (make -C native) — "
+        "fd_frag_drain must export the ctl word"
+    )
+
+    w = Workspace.create(str(tmp_path / "ctl.wksp"), 1 << 20)
+    try:
+        MCache(w, "mc", depth=16, create=True)
+        dc = DCache(w, "dc", data_sz=64 * 256, create=True)
+        FSeq(w, "fs", create=True)
+        Cnc(w, "cnc", create=True)
+
+        il = InLink(w, LinkNames("mc", "dc", "fs"))
+        CTL_SOM_EOM = 3
+        payloads = [b"frag-a", b"frag-b", b"frag-c"]
+        ctls = [CTL_SOM_EOM, CTL_SOM_EOM | CTL_ERR, CTL_SOM_EOM]
+        chunk = 0
+        for seq, (p, ctl) in enumerate(zip(payloads, ctls)):
+            dc.write(chunk, p)
+            il.mcache.publish(seq, sig=seq, chunk=chunk, sz=len(p),
+                              ctl=ctl, tsorig=7 + seq)
+            chunk = dc.next_chunk(chunk, len(p), 64)
+
+        got = []
+
+        class Capture(Tile):
+            def on_frag(self, frag, payload):
+                got.append((frag.seq, frag.ctl, payload))
+
+        t = Capture(w, "cnc", in_link=il)
+        assert t._bulk_ok is None or t._bulk_ok  # force the native path
+        progressed, overrun = t.poll_inputs()
+        assert progressed and not overrun
+        assert [(s, p) for s, _, p in got] == [
+            (i, p) for i, p in enumerate(payloads)
+        ]
+        assert [c for _, c, _ in got] == ctls, (
+            "bulk drain laundered the ctl word"
+        )
+    finally:
+        w.leave()
