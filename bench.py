@@ -29,6 +29,8 @@ import time
 
 import numpy as np
 
+from firedancer_tpu import flags
+
 
 def _gen_inputs(batch: int, msg_len: int, cache_path: str):
     """Generate (or load cached) valid signature batches."""
@@ -129,12 +131,13 @@ def replay_cpu_worker() -> int:
 
     lock = _replay_lock()  # noqa: F841 - held for the process lifetime
 
-    n = int(os.environ.get("FD_BENCH_REPLAY_N", "100000"))
+    n = flags.get_int("FD_BENCH_REPLAY_N")
     corpus, gen_s = _cached_corpus(n, seed=1234)
 
     from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
 
-    timeout_s = float(os.environ.get("FD_BENCH_REPLAY_TIMEOUT", "1200"))
+    # The CPU gate keeps its wider 1200s default (1-core host).
+    timeout_s = flags.get_float("FD_BENCH_REPLAY_TIMEOUT", 1200.0)
     with tempfile.TemporaryDirectory() as d:
         topo = build_topology(
             os.path.join(d, "replay.wksp"), depth=4096, wksp_sz=1 << 27
@@ -202,13 +205,13 @@ def replay_worker() -> int:
 
     lock = _replay_lock()  # noqa: F841 - held for the process lifetime
 
-    n = int(os.environ.get("FD_BENCH_REPLAY_N", "100000"))
-    vbatch = int(os.environ.get("FD_BENCH_REPLAY_BATCH", "8192"))
+    n = flags.get_int("FD_BENCH_REPLAY_N")
+    vbatch = flags.get_int("FD_BENCH_REPLAY_BATCH")
     corpus, gen_s = _cached_corpus(n, seed=1234)
 
     from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
 
-    timeout_s = float(os.environ.get("FD_BENCH_REPLAY_TIMEOUT", "900"))
+    timeout_s = flags.get_float("FD_BENCH_REPLAY_TIMEOUT")
     with tempfile.TemporaryDirectory() as d:
         topo = build_topology(
             os.path.join(d, "replay.wksp"), depth=4096, wksp_sz=1 << 27
@@ -279,8 +282,8 @@ def pack_worker() -> int:
     from firedancer_tpu.ballet.pack import Pack, PackTxn, validate_schedule
     from firedancer_tpu.ops.pack_gc import schedule_block
 
-    n = int(os.environ.get("FD_BENCH_PACK_N", "65536"))
-    n_accounts = int(os.environ.get("FD_BENCH_PACK_ACCTS", "16384"))
+    n = flags.get_int("FD_BENCH_PACK_N")
+    n_accounts = flags.get_int("FD_BENCH_PACK_ACCTS")
     rng = random.Random(7)
     keys = [i.to_bytes(8, "little") + bytes(24) for i in range(n_accounts)]
     txns = []
@@ -345,12 +348,12 @@ def worker(cpu: bool) -> int:
         # unreachable, not to be fast: on a 1-core host the verify graph
         # takes ~200 s just to load from the compile cache and ~45 s per
         # 256-lane run, so the shape is tiny and timed once.
-        batch = int(os.environ.get("FD_BENCH_BATCH_CPU", "256"))
-        reps = int(os.environ.get("FD_BENCH_REPS_CPU", "1"))
+        batch = flags.get_int("FD_BENCH_BATCH_CPU")
+        reps = flags.get_int("FD_BENCH_REPS_CPU")
     else:
-        batch = int(os.environ.get("FD_BENCH_BATCH", "8192"))
-        reps = int(os.environ.get("FD_BENCH_REPS", "10"))
-    msg_len = int(os.environ.get("FD_BENCH_MSG_LEN", "192"))
+        batch = flags.get_int("FD_BENCH_BATCH")
+        reps = flags.get_int("FD_BENCH_REPS")
+    msg_len = flags.get_int("FD_BENCH_MSG_LEN")
 
     import jax
     import jax.numpy as jnp
@@ -361,7 +364,7 @@ def worker(cpu: bool) -> int:
 
     from firedancer_tpu.ops.verify import verify_batch
 
-    mode = os.environ.get("FD_BENCH_VERIFY", "direct")
+    mode = flags.get_str("FD_BENCH_VERIFY")
     if mode not in ("rlc", "direct"):
         print(json.dumps({"metric": "ed25519_verify_throughput", "value": 0,
                           "unit": "verifies/s", "vs_baseline": 0.0,
@@ -491,7 +494,7 @@ def replay_main() -> int:
     can wedge backend init indefinitely and an in-process hang is
     uninterruptible (same rationale as main()), so the worker gets a hard
     timeout and failures land as a JSON error line, never a traceback."""
-    timeout_s = float(os.environ.get("FD_BENCH_REPLAY_TOTAL_TIMEOUT", "3000"))
+    timeout_s = flags.get_float("FD_BENCH_REPLAY_TOTAL_TIMEOUT")
     cmd = [sys.executable, os.path.abspath(__file__), "--replay-worker"]
     try:
         proc = subprocess.run(
@@ -584,11 +587,11 @@ def main() -> int:
     fallback-tainted rlc timing (the worker refuses those).
     """
     errors = []
-    tpu_budget = float(os.environ.get("FD_BENCH_TPU_BUDGET", "740"))
-    attempt_timeout = float(os.environ.get("FD_BENCH_ATTEMPT_TIMEOUT", "420"))
-    rlc_min_s = float(os.environ.get("FD_BENCH_RLC_MIN_BUDGET", "240"))
-    cpu_timeout = float(os.environ.get("FD_BENCH_CPU_TIMEOUT", "500"))
-    forced = os.environ.get("FD_BENCH_VERIFY")
+    tpu_budget = flags.get_float("FD_BENCH_TPU_BUDGET")
+    attempt_timeout = flags.get_float("FD_BENCH_ATTEMPT_TIMEOUT")
+    rlc_min_s = flags.get_float("FD_BENCH_RLC_MIN_BUDGET")
+    cpu_timeout = flags.get_float("FD_BENCH_CPU_TIMEOUT")
+    forced = flags.get_raw("FD_BENCH_VERIFY")
     if forced and forced not in ("rlc", "direct"):
         print(json.dumps({
             "metric": "ed25519_verify_throughput", "value": 0,
@@ -605,7 +608,7 @@ def main() -> int:
     # indefinitely, so a worker attempt burns its whole timeout learning
     # nothing. 120s spent probing saves ~300s of doomed attempts and
     # leaves the CPU rung (the only rung that can land) its full budget.
-    probe_timeout = float(os.environ.get("FD_BENCH_PROBE_TIMEOUT", "120"))
+    probe_timeout = flags.get_float("FD_BENCH_PROBE_TIMEOUT")
     tpu_reachable = True
     if probe_timeout > 0:
         try:
@@ -654,10 +657,8 @@ def main() -> int:
         # rung below keeps a full attempt even if the rlc compile eats
         # its whole timeout — a numberless round is worse than a
         # direct-only round.
-        direct_min_s = float(
-            os.environ.get("FD_BENCH_DIRECT_MIN_BUDGET", "300")
-        )
-        if os.environ.get("FD_BENCH_RLC", "1") != "0":
+        direct_min_s = flags.get_float("FD_BENCH_DIRECT_MIN_BUDGET")
+        if flags.get_str("FD_BENCH_RLC") != "0":
             rlc_budget = min(attempt_timeout, left() - direct_min_s)
             if rlc_budget >= 120.0:
                 attempt("rlc", None, rlc_budget)
@@ -745,7 +746,7 @@ if __name__ == "__main__":
         sys.exit(replay_cpu_worker())
     if "--replay-worker" in sys.argv:
         sys.exit(replay_worker())
-    if "--replay" in sys.argv or os.environ.get("FD_BENCH_MODE") == "replay":
+    if "--replay" in sys.argv or flags.get_raw("FD_BENCH_MODE") == "replay":
         sys.exit(replay_main())
     if "--worker" in sys.argv:
         sys.exit(worker(cpu="--cpu" in sys.argv))

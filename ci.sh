@@ -1,27 +1,54 @@
 #!/usr/bin/env bash
-# CI gate: unit/integration tests + native ring stress + fuzz smoke.
+# CI gate: static analysis + unit/integration tests + native ring stress
+# + fuzz smoke.
 #
 # Mirrors the reference's CI shape (.github/workflows/make_test.yml:
 # build + run-unit-test across machine profiles; fuzz_artifacts.yml for
 # the fuzz targets). This environment has one profile (CPU-hosted JAX,
-# virtual 8-device mesh via tests/conftest.py) — sanitizer profiles are
-# N/A for the Python layer; the native layer builds with -fsanitize when
-# SAN=1.
+# virtual 8-device mesh via tests/conftest.py). The sanitizer profile IS
+# a default blocking lane here: the native stress binaries build and run
+# under ASan+UBSan unless SAN=0 (TSAN=1 swaps in ThreadSanitizer); the
+# Python layer's equivalent is the fdlint static-analysis lane.
 set -euo pipefail
 cd "$(dirname "$0")"
 
+echo "== fdlint (blocking static-analysis lane) =="
+# Fails fast, before anything builds: trace-safety in jitted/pallas
+# paths, FD_* flag-registry discipline, boundary-assert contracts, and
+# the native ring-word atomics check — new violations (vs
+# lint_baseline.json) or stale baseline entries exit nonzero.
+python scripts/fdlint.py --check
+
 echo "== native build + stress =="
-if [ "${SAN:-0}" = "1" ]; then
-  make -C native CXXFLAGS="-O1 -g -Wall -Wextra -std=c++17 -fPIC -fsanitize=address,undefined" all
-elif [ "${TSAN:-0}" = "1" ]; then
+if [ "${TSAN:-0}" = "1" ]; then
   # Memory-model gate for the lock-free structures (ring publishes,
   # allocator freelists): the stress binaries under ThreadSanitizer.
+  make -C native clean
   make -C native CXXFLAGS="-O1 -g -Wall -Wextra -std=c++17 -fPIC -fsanitize=thread" all
-else
+  ./build/tango_stress
+  ./build/alloc_stress
+  make -C native clean   # never leave an instrumented .so for the tests
   make -C native all
+elif [ "${SAN:-1}" = "1" ]; then
+  # DEFAULT blocking lane (round-7 promotion; SAN=0 opts out): the
+  # stress binaries under ASan+UBSan. The instrumented tree is then
+  # rebuilt clean — python ctypes.CDLL cannot load an ASan .so without
+  # LD_PRELOAD, and a silent fallback to the pure-Python ring path
+  # would invalidate the pytest lane's native coverage.
+  make -C native clean
+  make -C native CXXFLAGS="-O1 -g -Wall -Wextra -std=c++17 -fPIC -fsanitize=address,undefined" all
+  ./build/tango_stress
+  ./build/alloc_stress
+  make -C native clean
+  make -C native all
+else
+  # Plain build: the only path where the stress binaries haven't
+  # already run (the sanitizer branches run them instrumented, which
+  # is a coverage superset).
+  make -C native all
+  ./build/tango_stress
+  ./build/alloc_stress
 fi
-./build/tango_stress
-./build/alloc_stress
 
 echo "== pytest (full lane; quick lane is: pytest -m 'not slow') =="
 python -m pytest tests/ -x -q

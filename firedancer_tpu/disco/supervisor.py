@@ -28,6 +28,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from firedancer_tpu import flags
 from firedancer_tpu.disco.pipeline import (
     LINKS,
     PipelineResult,
@@ -94,7 +95,7 @@ def run_pipeline_supervised(
     # FD_SUP_KEEP_LOGS=<dir>: run out of <dir> and keep the per-tile
     # logs + pod + result files after the run (post-mortem debugging of
     # crash/restart scenarios; normally everything is ephemeral).
-    keep = os.environ.get("FD_SUP_KEEP_LOGS")
+    keep = flags.get_raw("FD_SUP_KEEP_LOGS")
     if keep:
         os.makedirs(keep, exist_ok=True)
         # A reused keep dir must not leak a previous run's sink result

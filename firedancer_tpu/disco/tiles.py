@@ -27,6 +27,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from firedancer_tpu import flags
 from firedancer_tpu.ballet import ed25519 as oracle
 from firedancer_tpu.ballet.txn import TxnParseError, parse_txn
 from firedancer_tpu.tango import tempo
@@ -173,7 +174,15 @@ class OutLink:
 
     def publish(self, payload: bytes, sig: int, tsorig: int = 0) -> None:
         """Copy payload into the dcache and publish its frag meta."""
-        assert len(payload) <= self.mtu
+        if len(payload) > self.mtu:
+            # Not an assert: python -O would strip it, and an oversized
+            # payload published past the MTU tramples the next frag's
+            # dcache chunk (shared-memory corruption, not a local bug).
+            raise ValueError(
+                f"payload of {len(payload)} bytes exceeds the link MTU "
+                f"({self.mtu}): refusing to publish past the dcache "
+                "chunk walk"
+            )
         self.dcache.write(self.chunk, payload)
         tspub = tempo.tickcount() & 0xFFFFFFFF
         self.mcache.publish(
@@ -610,8 +619,16 @@ class VerifyTile(Tile):
         **kw,
     ):
         super().__init__(wksp, cnc_name, in_link=in_link, out_link=out_link, **kw)
-        assert backend in ("oracle", "cpu", "tpu")
-        assert verify_mode in ("auto", "direct", "rlc")
+        # Typed raises, not asserts (python -O strips asserts, and a
+        # typo'd config here silently verifies on the wrong engine):
+        if backend not in ("oracle", "cpu", "tpu"):
+            raise ValueError(
+                f"unknown verify backend {backend!r} (want oracle|cpu|tpu)"
+            )
+        if verify_mode not in ("auto", "direct", "rlc"):
+            raise ValueError(
+                f"unknown verify_mode {verify_mode!r} (want auto|direct|rlc)"
+            )
         if verify_mode == "auto":
             # Production default (round-6 un-park): RLC batch verify is
             # the PRIMARY device mode — one Pippenger MSM pass per
@@ -627,7 +644,7 @@ class VerifyTile(Tile):
             # jax-import-free, so they cannot call into ops.backend,
             # but an explicit force — or a typo'd one — must still fail
             # loudly instead of being silently dropped.
-            forced = os.environ.get("FD_VERIFY_MODE")
+            forced = flags.get_raw("FD_VERIFY_MODE")
             if forced and forced not in ("rlc", "direct"):
                 raise ValueError(
                     f"unknown FD_VERIFY_MODE {forced!r} (want rlc|direct)"
@@ -694,9 +711,7 @@ class VerifyTile(Tile):
         # UNACKED gauge freshly published — a deterministic window for
         # crash tests to SIGKILL a tile that provably holds staged
         # batches (tests/test_supervisor.py). 0 = disabled (production).
-        self._hold_s = float(
-            os.environ.get("FD_VERIFY_HOLD_AFTER_DISPATCH_S", "0") or 0
-        )
+        self._hold_s = flags.get_float("FD_VERIFY_HOLD_AFTER_DISPATCH_S")
         # A respawned incarnation (nonzero crash-surviving gauge) must
         # not hold again: the knob freezes only the first incarnation,
         # so the post-crash re-read path runs at full speed.

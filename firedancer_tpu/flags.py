@@ -1,0 +1,446 @@
+"""flags — the typed central registry for FD_* environment flags.
+
+Role parity with the reference's compile-time configuration discipline
+(fd_util_base.h FD_HAS_* capability macros + the make profiles): every
+tunable the reference bakes in at compile time, this port reads from
+the environment — which is strictly more dangerous, because a typo'd
+name, a stale default duplicated across call sites, or a read at the
+wrong time (trace time vs run time) all fail silently at runtime.
+
+This module is the single source of truth for every FD_* flag:
+
+  - name, type, typed default, and a doc string (docs/FLAGS.md is
+    generated from here via `scripts/fdlint.py --dump-flags`);
+  - the `trace_time` marker: a flag whose value is captured while a
+    jax/pallas computation TRACES (baked into the compiled graph, NOT
+    re-read per step). fdlint's trace-safety pass allows registry reads
+    inside traced code only for flags carrying this marker — a raw
+    os.environ read there is flagged (the value silently pins without
+    the registry's paper trail, and jit caching does not key on it);
+  - optional `choices` for enum-shaped flags.
+
+fdlint's flag-registry pass flags any os.environ/getenv read of an
+FD_* name outside this module, so defaults and semantics cannot drift
+back into call sites. Deliberately stdlib-only: host-side tiles must
+stay jax-import-free (disco/tiles.py's dispatch contract), and the
+bench orchestrator reads budgets before any backend import.
+
+Read accessors preserve the call-site semantics the registry replaced:
+an UNSET or EMPTY environment value yields the default (`get_raw`
+returns None so `if flags.get_raw("FD_VERIFY_MODE"):` behaves exactly
+like the `os.environ.get(...)` truthiness checks it replaced).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+_UNSET = object()
+
+
+@dataclass(frozen=True)
+class Flag:
+    name: str
+    type: type                              # str | int | float | bool
+    default: Any                            # typed default (None = unset)
+    doc: str
+    trace_time: bool = False                # baked into traced graphs
+    choices: Optional[Tuple[str, ...]] = None
+
+
+REGISTRY: Dict[str, Flag] = {}
+
+
+def _register(
+    name: str,
+    type_: type,
+    default: Any,
+    doc: str,
+    *,
+    trace_time: bool = False,
+    choices: Optional[Tuple[str, ...]] = None,
+) -> None:
+    if name in REGISTRY:
+        raise ValueError(f"duplicate flag registration: {name}")
+    if not doc:
+        raise ValueError(f"flag {name} registered without a doc string")
+    REGISTRY[name] = Flag(
+        name=name, type=type_, default=default, doc=doc,
+        trace_time=trace_time, choices=choices,
+    )
+
+
+# --------------------------------------------------------------------------
+# Kernel / backend implementation selectors (ALL trace-time: the chosen
+# implementation is baked into the traced graph; changing the env after
+# a graph compiled does nothing until a fresh trace).
+# --------------------------------------------------------------------------
+
+_register(
+    "FD_MUL_IMPL", str, "schoolbook",
+    "In-kernel field-multiply schedule: schoolbook int32 (r3 baseline), "
+    "karatsuba, f32 (exact-f32-product convolution; |limb| <= 512), "
+    "rolled (7-rotation), or factored. A/B'd by the bench ladder.",
+    trace_time=True,
+    choices=("schoolbook", "karatsuba", "f32", "rolled", "factored"),
+)
+_register(
+    "FD_SQ_IMPL", str, "sq",
+    "In-kernel squaring: 'sq' = the specialized half-triangle fe_sq; "
+    "'mul' swaps in a plain multiply — the escape hatch if a Mosaic "
+    "version rejects fe_sq's slice/concat construction.",
+    trace_time=True, choices=("sq", "mul"),
+)
+_register(
+    "FD_SC_IMPL", str, None,
+    "Scalar (mod-L) arithmetic backend: 'pallas' opts into the VMEM "
+    "Barrett kernels; default is the XLA graph (round-4 v5e measurement: "
+    "XLA wins ~3x on these short scalar chains).",
+    trace_time=True, choices=("pallas",),
+)
+_register(
+    "FD_CANON_IMPL", str, None,
+    "Kernel canonicalize form: 'seq' rolls back to the sequential-ripple "
+    "version should a Mosaic update reject the Kogge-Stone construction.",
+    trace_time=True, choices=("seq",),
+)
+_register(
+    "FD_MSM_IMPL", str, "auto",
+    "MSM engine for the RLC batch-verify pass: 'pallas' (VMEM Pippenger "
+    "kernels, the production TPU engine), 'xla' (graph MSM), 'interpret' "
+    "(the Pallas kernels under the interpreter — CPU CI parity-tests the "
+    "exact shipping engine), 'auto' = pallas iff the backend is a TPU "
+    "family. An unrecognized value raises (a typo'd force must never "
+    "quietly test the wrong engine).",
+    trace_time=True, choices=("auto", "xla", "pallas", "interpret"),
+)
+_register(
+    "FD_DSM_IMPL", str, "auto",
+    "Double-scalar-mult backend: 'pallas' forces the VMEM kernel, 'xla' "
+    "the graph; 'auto' = pallas iff the backend is a TPU family.",
+    trace_time=True, choices=("auto", "xla", "pallas"),
+)
+_register(
+    "FD_POW_IMPL", str, "auto",
+    "Field power-chain (invert / pow22523) backend: pallas | xla | auto "
+    "(pallas iff TPU; the VMEM chains measure ~5x the XLA graph's "
+    "per-mul rate on v5e).",
+    trace_time=True, choices=("auto", "xla", "pallas"),
+)
+_register(
+    "FD_SHA_IMPL", str, "auto",
+    "Batch SHA-512 backend: pallas (VMEM compression kernel) | xla | "
+    "auto (pallas iff TPU).",
+    trace_time=True, choices=("auto", "xla", "pallas"),
+)
+_register(
+    "FD_DECOMPRESS_IMPL", str, "auto",
+    "Point-decompress backend: pallas (fused sqrt-chain kernel with "
+    "niels emission) | xla | auto (pallas iff TPU).",
+    trace_time=True, choices=("auto", "xla", "pallas"),
+)
+_register(
+    "FD_COMPRESS_IMPL", str, "auto",
+    "Point-compress / point-equality backend: pallas | xla | auto "
+    "(pallas iff TPU).",
+    trace_time=True, choices=("auto", "xla", "pallas"),
+)
+_register(
+    "FD_DSM_LANES", int, 1024,
+    "DSM kernel batch tile per program (v5e r3: 512 ~9% slower than "
+    "1024; VMEM headroom allows 2048). The rolled multiply caps its "
+    "default at 512 unless this is set explicitly.",
+    trace_time=True,
+)
+_register(
+    "FD_DSM_DEBUG", str, "",
+    "DSM timing attribution ONLY (results are WRONG): 'doubles_only' "
+    "drops both table adds+lookups, 'no_badd' drops the B-side "
+    "lookup+add. Used by scripts/dsm_attrib.py; never set in production.",
+    trace_time=True, choices=("doubles_only", "no_badd"),
+)
+_register(
+    "FD_POW_BLOCK", int, 10,
+    "Squarings unrolled per fori_loop iteration in the pow chains "
+    "(round-5 hedge: 1 reproduces the round-4 per-squaring loop shape; "
+    ">= chain length fully unrolls).",
+    trace_time=True,
+)
+_register(
+    "FD_FE_DEBUG_BOUNDS", bool, False,
+    "Debug guard for the NARROWER f32 kernel-multiply contract "
+    "(|limb| <= 512 vs the generic 1024): checks concrete operands at "
+    "fe_mul_f32/fe_sq_f32 dispatch in eager/interpret runs.",
+    trace_time=True,
+)
+_register(
+    "FD_RLC_TORSION_K", int, 64,
+    "Trial count for the RLC torsion subgroup certification "
+    "(soundness <= 2^-K for torsion defects per accepted batch).",
+    trace_time=True,
+)
+_register(
+    "FD_VERIFY_MODE", str, None,
+    "Force the verify tile's device mode: 'rlc' (batch RLC over the "
+    "Pippenger MSM) or 'direct' (per-lane). Unset = platform auto "
+    "(rlc on TPU families, direct on host-jax backends). An "
+    "unrecognized value raises rather than falling through.",
+    trace_time=True, choices=("rlc", "direct"),
+)
+
+# --------------------------------------------------------------------------
+# Host-side runtime knobs (read per run, not baked into graphs).
+# --------------------------------------------------------------------------
+
+_register(
+    "FD_VERIFY_HOLD_AFTER_DISPATCH_S", float, 0.0,
+    "Fault injection: hold the verify tile once, right after its first "
+    "dispatch, with the UNACKED gauge freshly published — the "
+    "deterministic SIGKILL window for crash tests. 0 disables "
+    "(production).",
+)
+_register(
+    "FD_SUP_KEEP_LOGS", str, None,
+    "Supervisor post-mortem dir: run out of this directory and keep "
+    "per-tile logs + pod + result files after the run (normally "
+    "everything is ephemeral).",
+)
+
+# --------------------------------------------------------------------------
+# bench.py ladder knobs (orchestrator + workers).
+# --------------------------------------------------------------------------
+
+_register(
+    "FD_BENCH_VERIFY", str, "direct",
+    "Verify mode for a bench worker / the rlc smoke lane: rlc | direct. "
+    "In the orchestrator, setting it forces a single-mode ladder.",
+    choices=("rlc", "direct"),
+)
+_register(
+    "FD_BENCH_RLC", str, "1",
+    "'0' re-parks the rlc rung from the bench ladder (escape hatch; "
+    "direct remains measured).",
+)
+_register(
+    "FD_BENCH_BATCH", int, 8192,
+    "Device bench batch (lanes per timed verify call).",
+)
+_register(
+    "FD_BENCH_BATCH_CPU", int, 256,
+    "CPU-fallback bench batch (the CPU rung exists to make the artifact "
+    "numeric, not to be fast).",
+)
+_register("FD_BENCH_REPS", int, 10, "Timed repetitions on device.")
+_register("FD_BENCH_REPS_CPU", int, 1, "Timed repetitions on CPU.")
+_register(
+    "FD_BENCH_MSG_LEN", int, 192,
+    "Signed-message bytes per lane (~typical Solana txn payload).",
+)
+_register(
+    "FD_BENCH_MODE", str, None,
+    "'replay' runs the 100k replay gate instead of the verify ladder "
+    "(equivalent to --replay).",
+    choices=("replay",),
+)
+_register(
+    "FD_BENCH_REPLAY_N", int, 100000,
+    "Replay-gate corpus size (txns).",
+)
+_register(
+    "FD_BENCH_REPLAY_BATCH", int, 8192,
+    "Verify-tile batch for the device replay gate.",
+)
+_register(
+    "FD_BENCH_REPLAY_TIMEOUT", float, 900.0,
+    "Per-run pipeline budget for the replay gates (the CPU gate's "
+    "call site defaults to 1200).",
+)
+_register(
+    "FD_BENCH_REPLAY_TOTAL_TIMEOUT", float, 3000.0,
+    "Hard subprocess timeout for the whole replay-gate worker.",
+)
+_register("FD_BENCH_PACK_N", int, 65536, "Pack-gate block size (txns).")
+_register(
+    "FD_BENCH_PACK_ACCTS", int, 16384,
+    "Distinct account keys in the pack-gate corpus.",
+)
+_register(
+    "FD_BENCH_TPU_BUDGET", float, 740.0,
+    "Total wall budget for the device rungs of the verify ladder.",
+)
+_register(
+    "FD_BENCH_ATTEMPT_TIMEOUT", float, 420.0,
+    "Hard timeout for one bench worker attempt.",
+)
+_register(
+    "FD_BENCH_RLC_MIN_BUDGET", float, 240.0,
+    "Leftover budget required before spending an A/B rung.",
+)
+_register(
+    "FD_BENCH_CPU_TIMEOUT", float, 500.0,
+    "Hard timeout for the CPU-pinned fallback rung.",
+)
+_register(
+    "FD_BENCH_PROBE_TIMEOUT", float, 120.0,
+    "Budget for the wedged-tunnel pre-probe; 0 skips the probe.",
+)
+_register(
+    "FD_BENCH_DIRECT_MIN_BUDGET", float, 300.0,
+    "Budget reserved for the direct rung before the rlc rung may spend "
+    "(a numberless round is worse than a direct-only round).",
+)
+
+# --------------------------------------------------------------------------
+# Driver / test harness knobs. These are read OUTSIDE the package scan
+# (tests/conftest.py, __graft_entry__.py, native getenv) but registered
+# here so docs/FLAGS.md documents every FD_* name with one semantics.
+# --------------------------------------------------------------------------
+
+_register(
+    "FD_DRYRUN_BATCH", int, 2048,
+    "dryrun_multichip total lanes (read in __graft_entry__.py, which "
+    "stays registry-free by design — see lint_baseline.json).",
+)
+_register(
+    "FD_DRYRUN_SWEEP", bool, False,
+    "'1' sweeps per-device batch in dryrun_multichip (each point is its "
+    "own shard_map compile; opt-in). Read in __graft_entry__.py.",
+)
+_register(
+    "FD_DRYRUN_CHILD", str, None,
+    "Internal recursion guard for dryrun_multichip's clean-subprocess "
+    "re-exec. Never set by hand. Read in __graft_entry__.py.",
+)
+_register(
+    "FD_TPU_TESTS", bool, False,
+    "'1' lets the test session attach the real TPU plugin instead of "
+    "pinning JAX_PLATFORMS=cpu (read in tests/conftest.py before any "
+    "jax import).",
+)
+_register(
+    "FD_RUN_PALLAS_TESTS", bool, False,
+    "'1' forces the pallas kernel test files to run even off-TPU "
+    "(interpret mode; slow). Read in tests.",
+)
+_register(
+    "FD_RUN_XSLOW", bool, False,
+    "Enables the extra-slow test tier (e.g. full SHA-512 NIST vectors). "
+    "Read in tests.",
+)
+_register(
+    "FD_NO_AVX512", bool, False,
+    "Pins the native ed25519 host verifier to the scalar path even "
+    "when CPUID reports AVX-512 IFMA (read by native/ed25519_avx512.cc "
+    "via getenv).",
+)
+
+# --------------------------------------------------------------------------
+# Accessors.
+# --------------------------------------------------------------------------
+
+
+def _lookup(name: str) -> Flag:
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unregistered FD_* flag {name!r} — add it to "
+            "firedancer_tpu/flags.py (fdlint enforces this)"
+        ) from None
+
+
+def is_set(name: str) -> bool:
+    """True when the flag is present AND non-empty in the environment."""
+    _lookup(name)
+    return bool(os.environ.get(name))
+
+
+def get_raw(name: str) -> Optional[str]:
+    """The raw environment string, or None when unset/empty.
+
+    Truthiness-compatible with the `os.environ.get(name)` reads this
+    registry replaced (`if flags.get_raw("FD_VERIFY_MODE"):`)."""
+    _lookup(name)
+    return os.environ.get(name) or None
+
+
+def get_str(name: str, default: Any = _UNSET) -> Optional[str]:
+    flag = _lookup(name)
+    raw = os.environ.get(name)
+    if not raw:
+        return flag.default if default is _UNSET else default
+    return raw
+
+
+def get_int(name: str, default: Any = _UNSET) -> int:
+    flag = _lookup(name)
+    raw = os.environ.get(name)
+    if not raw:
+        return flag.default if default is _UNSET else default
+    try:
+        # Base 10, matching the int(os.environ.get(...)) call sites this
+        # registry replaced (leading zeros stay decimal, no hex).
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not an integer (see docs/FLAGS.md)"
+        ) from None
+
+
+def get_float(name: str, default: Any = _UNSET) -> float:
+    flag = _lookup(name)
+    raw = os.environ.get(name)
+    if not raw:
+        return flag.default if default is _UNSET else default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not a number (see docs/FLAGS.md)"
+        ) from None
+
+
+_TRUE = ("1", "true", "yes", "on")
+
+
+def get_bool(name: str, default: Any = _UNSET) -> bool:
+    flag = _lookup(name)
+    raw = os.environ.get(name)
+    if not raw:
+        return flag.default if default is _UNSET else default
+    return raw.lower() in _TRUE
+
+
+def dump_markdown() -> str:
+    """docs/FLAGS.md body — the registry is the only source of truth."""
+    lines = [
+        "# FD_* environment flags",
+        "",
+        "Generated from the typed registry (`firedancer_tpu/flags.py`) by",
+        "`python scripts/fdlint.py --dump-flags > docs/FLAGS.md`.",
+        "Do not edit by hand; edit the registry and regenerate.",
+        "",
+        "`trace-time` flags are captured while a jax/pallas computation",
+        "traces: the value is baked into the compiled graph and NOT",
+        "re-read per step — set them before the first compile. fdlint's",
+        "trace-safety pass only permits registry reads of trace-time",
+        "flags inside traced code.",
+        "",
+        "| Flag | Type | Default | Trace-time | Description |",
+        "|---|---|---|---|---|",
+    ]
+    for name in sorted(REGISTRY):
+        f = REGISTRY[name]
+        default = "(unset)" if f.default is None else repr(f.default)
+        doc = f.doc
+        if f.choices:
+            doc += " Choices: " + ", ".join(f"`{c}`" for c in f.choices) + "."
+        doc = doc.replace("|", "\\|")
+        lines.append(
+            f"| `{name}` | {f.type.__name__} | `{default}` | "
+            f"{'yes' if f.trace_time else 'no'} | {doc} |"
+        )
+    lines.append("")
+    return "\n".join(lines)

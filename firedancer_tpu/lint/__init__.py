@@ -1,0 +1,75 @@
+"""fdlint — the repo-native static-analysis suite.
+
+Four passes, each a machine-checked contract for a bug class the
+Python/JAX port only surfaces at runtime (see each module's docstring):
+
+  1. trace_safety   — host-sync/retrace hazards inside jitted/pallas code
+  2. flag_registry  — FD_* env reads must go through firedancer_tpu.flags
+  3. boundary       — no bare `assert` in FFI/tile/ring boundary modules
+  4. native_atomics — ring seq/ctl words accessed atomically in native/
+
+Driven by scripts/fdlint.py (the CLI and the blocking ci.sh lane);
+pre-existing debt resolves against lint_baseline.json (common.Baseline).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from . import boundary, flag_registry, native_atomics, trace_safety
+from .common import Baseline, Violation, iter_files, rel, repo_root
+
+# Default scan scope, repo-relative. tests/ is deliberately excluded:
+# monkeypatch-driven env reads are the point there, and the lint
+# fixtures under tests/fixtures/lint/ contain violations by design.
+PY_ROOTS = (
+    "firedancer_tpu",
+    "scripts",
+    "fuzz",
+    "bench.py",
+    "microbench.py",
+    "__graft_entry__.py",
+)
+NATIVE_ROOTS = ("native",)
+
+# The registry module is the one place allowed to touch FD_* env vars
+# directly (it doesn't today — accessors read by name — but the scan
+# exempts it on principle).
+_FLAG_PASS_EXEMPT = ("firedancer_tpu/flags.py",)
+
+
+def run_all(
+    root: Optional[str] = None,
+    py_roots: Sequence[str] = PY_ROOTS,
+    native_roots: Sequence[str] = NATIVE_ROOTS,
+) -> List[Violation]:
+    root = root or repo_root()
+    out: List[Violation] = []
+    py_paths = [os.path.join(root, r) for r in py_roots]
+    for path in iter_files(py_paths, (".py",)):
+        rpath = rel(path, root)
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        out.extend(trace_safety.check_source(src, path, root=root))
+        if rpath not in _FLAG_PASS_EXEMPT:
+            out.extend(flag_registry.check_source(src, path, root=root))
+        out.extend(boundary.check_source(src, path, root=root))
+    out.extend(flag_registry.check_registry_docs())
+    native_paths = [os.path.join(root, r) for r in native_roots]
+    for path in iter_files(native_paths, (".cc", ".h", ".cpp", ".hpp")):
+        out.extend(native_atomics.check_file(path, root=root))
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))
+
+
+__all__ = [
+    "Baseline",
+    "Violation",
+    "run_all",
+    "PY_ROOTS",
+    "NATIVE_ROOTS",
+    "boundary",
+    "flag_registry",
+    "native_atomics",
+    "trace_safety",
+]

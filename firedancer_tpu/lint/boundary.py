@@ -1,0 +1,91 @@
+"""Pass 3 — boundary contracts: no bare `assert` at FFI/tile/ring
+boundaries.
+
+`python -O` strips asserts. At an interior call site that's a lost
+sanity check; at a BOUNDARY it's memory-unsafe or silently corrupting:
+
+  - FFI staging (ballet/ed25519/native.py): a malformed buffer shape
+    slipping past a stripped assert hands out-of-bounds memory straight
+    to the C side (PR 1 fixed verify_arrays by hand — this pass
+    generalizes that one-off);
+  - ring bindings (tango/rings.py): a non-power-of-two depth or
+    unaligned dcache size corrupts the shared-memory layout every
+    OTHER process maps;
+  - tile protocol (disco/tiles.py): an oversized payload published past
+    the MTU tramples the next frag's dcache chunk.
+
+Boundary modules must `raise ValueError`/`TypeError` with a message
+instead. The default module list lives here (BOUNDARY_MODULES);
+fixture tests pass force_boundary=True to check arbitrary files.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .common import Violation, rel, suppressed
+
+RULE_ASSERT = "boundary-assert"
+
+# Repo-relative paths of the FFI/tile/ring boundary modules.
+BOUNDARY_MODULES = (
+    "firedancer_tpu/ballet/ed25519/native.py",
+    "firedancer_tpu/tango/rings.py",
+    "firedancer_tpu/disco/tiles.py",
+    "firedancer_tpu/disco/worker.py",
+    "firedancer_tpu/disco/supervisor.py",
+)
+
+
+def is_boundary(rpath: str) -> bool:
+    return rpath in BOUNDARY_MODULES
+
+
+def _assert_key(node: ast.Assert, src_lines) -> str:
+    """Stable key: the asserted expression's source text (linenos drift,
+    expressions don't)."""
+    try:
+        seg = ast.get_source_segment("\n".join(src_lines), node.test)
+    except Exception:
+        seg = None
+    return " ".join((seg or "assert").split())[:80]
+
+
+def check_source(
+    src: str, path: str, *, root: Optional[str] = None,
+    force_boundary: bool = False,
+) -> List[Violation]:
+    rpath = rel(path, root)
+    if not force_boundary and not is_boundary(rpath):
+        return []
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Violation(
+            rule="parse-error", path=rpath, line=e.lineno or 0,
+            key="syntax", message=f"cannot parse: {e.msg}",
+        )]
+    src_lines = src.splitlines()
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assert):
+            continue
+        if suppressed(src_lines, node.lineno, RULE_ASSERT):
+            continue
+        out.append(Violation(
+            rule=RULE_ASSERT, path=rpath, line=node.lineno,
+            key=_assert_key(node, src_lines),
+            message="bare `assert` in a boundary module (stripped under "
+                    "python -O) — raise ValueError/TypeError with a "
+                    "message instead",
+        ))
+    return out
+
+
+def check_file(
+    path: str, *, root: Optional[str] = None, force_boundary: bool = False
+) -> List[Violation]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return check_source(src, path, root=root, force_boundary=force_boundary)

@@ -1,0 +1,198 @@
+"""fdlint common machinery: violations, file walking, suppression,
+baseline resolution.
+
+The repo-native analog of the reference's compile-time discipline
+(-Wall -Wextra -Werror + the sanitizer CI profiles): the Python/JAX
+port has bug classes the interpreter only surfaces at runtime —
+trace-unsafe code in jitted paths, scattered FD_* env reads, `python
+-O`-strippable asserts at FFI/tile boundaries, non-atomic ring-word
+access in the native TUs. Each pass turns one class into a
+machine-checked contract.
+
+Baselines: pre-existing debt lives in a checked-in JSON file
+(lint_baseline.json) where every entry carries a one-line
+justification. Baselined violations don't fail the build; NEW
+violations do; a baseline entry that no longer matches anything is
+reported stale so the debt list only ever burns down. Violation keys
+are structural (rule + file + a rule-specific stable key), never line
+numbers — mere motion must not churn the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+# Directory names never scanned, in any pass.
+SKIP_DIRS = {
+    "__pycache__", ".git", "build", ".jax_cache", "tests", ".claude",
+}
+
+SUPPRESS_MARK = "fdlint: ignore"
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str          # e.g. "trace-env-read"
+    path: str          # repo-relative, forward slashes
+    line: int          # 1-based (display only; not part of the key)
+    key: str           # stable structural key for baseline matching
+    message: str
+
+    @property
+    def baseline_key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.key)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None. Shared by every
+    AST pass so call-root resolution cannot drift between them."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_environ_expr(node: ast.AST) -> bool:
+    """True for any expression denoting os.environ — `os.environ`,
+    `_os.environ`, bare `environ`, `__import__("os").environ`. Shared
+    by the trace-safety and flag-registry passes: what counts as an
+    environment read must be ONE definition."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return True
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def is_env_get_call(func: ast.AST) -> bool:
+    """True when a Call's func denotes an environment read: any
+    `<environ>.get` (per is_environ_expr) or any `getenv` — bare,
+    `os.getenv`, aliased `_os.getenv`, or `__import__("os").getenv`.
+    ONE definition shared by both passes (an aliased import must not
+    be visible to one pass and invisible to the other)."""
+    if isinstance(func, ast.Attribute):
+        if func.attr == "getenv":
+            return True
+        return func.attr == "get" and is_environ_expr(func.value)
+    return isinstance(func, ast.Name) and func.id == "getenv"
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def rel(path: str, root: Optional[str] = None) -> str:
+    root = root or repo_root()
+    return os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+
+
+def iter_files(
+    roots: Sequence[str], suffixes: Tuple[str, ...]
+) -> Iterator[str]:
+    """Walk roots (files or directories), yielding matching file paths
+    in sorted order, skipping SKIP_DIRS subtrees."""
+    for r in roots:
+        if os.path.isfile(r):
+            if r.endswith(suffixes):
+                yield r
+            continue
+        for dirpath, dirnames, filenames in os.walk(r):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in SKIP_DIRS
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(suffixes):
+                    yield os.path.join(dirpath, fn)
+
+
+def suppressed(src_lines: List[str], lineno: int, rule: str) -> bool:
+    """True when the flagged line carries an inline waiver:
+    `# fdlint: ignore` (any rule) or `# fdlint: ignore[<rule>]`.
+    C++ passes use the same grammar with `//` comments."""
+    if not 1 <= lineno <= len(src_lines):
+        return False
+    line = src_lines[lineno - 1]
+    i = line.find(SUPPRESS_MARK)
+    if i < 0:
+        return False
+    tail = line[i + len(SUPPRESS_MARK):]
+    if not tail.startswith("[") or "]" not in tail:
+        return True  # bare `fdlint: ignore` waives every rule
+    rules = tail[1:tail.index("]")].split(",")
+    return rule in [r.strip() for r in rules]
+
+
+@dataclass
+class Baseline:
+    path: str
+    entries: List[dict] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls(path=path, entries=[])
+        with open(path) as f:
+            data = json.load(f)
+        entries = data.get("entries", [])
+        for e in entries:
+            for k in ("rule", "file", "key", "justification"):
+                if k not in e:
+                    raise ValueError(
+                        f"{path}: baseline entry missing {k!r}: {e}"
+                    )
+        return cls(path=path, entries=entries)
+
+    def _keys(self) -> Dict[Tuple[str, str, str], dict]:
+        return {(e["rule"], e["file"], e["key"]): e for e in self.entries}
+
+    def resolve(
+        self, violations: Sequence[Violation]
+    ) -> Tuple[List[Violation], List[dict]]:
+        """-> (new_violations, stale_entries). A baseline entry absorbs
+        every violation sharing its (rule, file, key); entries matching
+        nothing are stale (burned-down debt that should be deleted)."""
+        keys = self._keys()
+        matched = set()
+        new: List[Violation] = []
+        for v in violations:
+            if v.baseline_key in keys:
+                matched.add(v.baseline_key)
+            else:
+                new.append(v)
+        stale = [e for k, e in keys.items() if k not in matched]
+        return new, stale
+
+    @staticmethod
+    def write(path: str, violations: Sequence[Violation]) -> None:
+        """Snapshot violations as baseline entries. Justifications of
+        entries that survive from the existing baseline are preserved —
+        a re-snapshot must never reset hand-written rationale to TODO."""
+        old = {}
+        if os.path.exists(path):
+            old = Baseline.load(path)._keys()
+        entries = []
+        for bkey in sorted({v.baseline_key for v in violations}):
+            rule, file, key = bkey
+            prev = old.get(bkey)
+            entries.append({
+                "rule": rule,
+                "file": file,
+                "key": key,
+                "justification": (
+                    prev["justification"] if prev
+                    else "TODO: one-line justification"
+                ),
+            })
+        with open(path, "w") as f:
+            json.dump({"version": 1, "entries": entries}, f, indent=2)
+            f.write("\n")

@@ -1,0 +1,151 @@
+"""Pass 2 — flag registry: every FD_* env read goes through
+firedancer_tpu/flags.py.
+
+The ~30 FD_* knobs used to be read inline (`os.environ.get("FD_X",
+"default")`) at every call site, which means: defaults duplicated (and
+drifting) across files, no typed parsing, no doc, and no way to tell a
+trace-time-pinned knob from a per-run one. The registry centralizes
+all of that; this pass keeps it centralized.
+
+Flags:
+  - any `os.environ.get("FD_*")` / `os.getenv("FD_*")` /
+    `os.environ["FD_*"]`-load / `"FD_*" in os.environ` outside the
+    registry module itself (rule `flag-env-read`);
+  - any registry accessor call with an FD_* string literal that is NOT
+    a registered flag (rule `flag-unregistered`) — a typo'd name would
+    otherwise raise only when that code path first runs;
+  - (registration-time, not here) a registered flag with no doc string
+    is impossible: flags._register raises on an empty doc. The pass
+    re-asserts it over the imported registry anyway (`flag-no-doc`)
+    so a future bypass of _register still fails CI.
+
+Environment WRITES (`os.environ["FD_X"] = ...`, `.pop`, `del`) stay
+legal: sweep/probe scripts legitimately set flags for child configs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .common import Violation, dotted as _dotted, is_env_get_call, \
+    is_environ_expr as _is_environ, rel, suppressed
+
+RULE_ENV_READ = "flag-env-read"
+RULE_UNREGISTERED = "flag-unregistered"
+RULE_NO_DOC = "flag-no-doc"
+
+_ACCESSORS = ("get_raw", "get_str", "get_int", "get_float", "get_bool",
+              "is_set")
+
+
+def _fd_literal(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+            and node.value.startswith("FD_")):
+        return node.value
+    return None
+
+
+def check_source(
+    src: str, path: str, *, root: Optional[str] = None,
+    registry=None,
+) -> List[Violation]:
+    if registry is None:
+        from firedancer_tpu import flags as flags_mod
+
+        registry = flags_mod.REGISTRY
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Violation(
+            rule="parse-error", path=rel(path, root), line=e.lineno or 0,
+            key="syntax", message=f"cannot parse: {e.msg}",
+        )]
+    src_lines = src.splitlines()
+    out: List[Violation] = []
+    rpath = rel(path, root)
+
+    def flag(rule: str, node: ast.AST, key: str, msg: str) -> None:
+        if suppressed(src_lines, node.lineno, rule):
+            return
+        out.append(Violation(
+            rule=rule, path=rpath, line=node.lineno, key=key, message=msg,
+        ))
+
+    for node in ast.walk(tree):
+        # os.environ.get("FD_X") / os.getenv("FD_X")
+        if isinstance(node, ast.Call):
+            root_name = _dotted(node.func) or ""
+            leaf = (node.func.attr if isinstance(node.func, ast.Attribute)
+                    else root_name)
+            if is_env_get_call(node.func) and node.args:
+                name = _fd_literal(node.args[0])
+                if name:
+                    flag(
+                        RULE_ENV_READ, node, name,
+                        f"raw environment read of {name} — go through "
+                        "firedancer_tpu.flags (typed default + doc + "
+                        "trace-time marker live there)",
+                    )
+            # registry accessor with an unregistered FD_* literal
+            if leaf in _ACCESSORS and node.args:
+                name = _fd_literal(node.args[0])
+                if name and name not in registry:
+                    flag(
+                        RULE_UNREGISTERED, node, name,
+                        f"flags accessor reads unregistered flag {name} — "
+                        "register it in firedancer_tpu/flags.py",
+                    )
+        # os.environ["FD_X"] load
+        elif isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, ast.Load
+        ):
+            if _is_environ(node.value):
+                name = _fd_literal(node.slice)
+                if name:
+                    flag(
+                        RULE_ENV_READ, node, name,
+                        f"raw os.environ[{name!r}] read — go through "
+                        "firedancer_tpu.flags",
+                    )
+        # "FD_X" in os.environ
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 and (
+            isinstance(node.ops[0], (ast.In, ast.NotIn))
+        ):
+            name = _fd_literal(node.left)
+            if name and node.comparators and _is_environ(
+                node.comparators[0]
+            ):
+                flag(
+                    RULE_ENV_READ, node, name,
+                    f"`{name} in os.environ` membership read — use "
+                    "flags.is_set",
+                )
+    return out
+
+
+def check_file(
+    path: str, *, root: Optional[str] = None, registry=None
+) -> List[Violation]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return check_source(src, path, root=root, registry=registry)
+
+
+def check_registry_docs(*, registry=None) -> List[Violation]:
+    """flag-no-doc over the live registry (a belt for _register's
+    suspenders: bypassing _register must still fail CI)."""
+    if registry is None:
+        from firedancer_tpu import flags as flags_mod
+
+        registry = flags_mod.REGISTRY
+    out: List[Violation] = []
+    for name in sorted(registry):
+        f = registry[name]
+        if not getattr(f, "doc", ""):
+            out.append(Violation(
+                rule=RULE_NO_DOC, path="firedancer_tpu/flags.py", line=0,
+                key=name,
+                message=f"registered flag {name} has no doc string",
+            ))
+    return out

@@ -1,0 +1,134 @@
+"""Pass 4 — native ring-word atomics: every load/store of a ring
+`seq`/`seq_next`/`ctl` member goes through the atomic accessors.
+
+The frag_meta seqlock (native/tango_abi.h) is only TSan-clean because
+the body words are std::atomic and every access names its memory
+order: `m->seq.store(..., release)` / `m->seq.load(acquire)` on the
+synchronization word, relaxed on the body. A plain `m->seq = x` or
+`uint64_t s = m->seq;` still COMPILES (std::atomic's operator= /
+conversion default to seq_cst) — it is not UB, but it silently changes
+the publish protocol's cost and, worse, hides which word is the
+synchronization point. The reference enforces this by construction
+(FD_VOLATILE + explicit fences, fd_tango_base.h:149-203); here a
+structural check enforces it.
+
+This is a token-level structural checker, not a C++ parser: it strips
+comments/strings, then requires every member access of seq/seq_next/
+ctl (`->seq`, `.ctl`, ...) to be immediately followed by an explicit
+atomic accessor call (.load( / .store( / .exchange( / .fetch_*( /
+.compare_exchange*). Local variables named `seq`/`ctl` (no `->`/`.`
+prefix) and field declarations are not member accesses and pass.
+Waiver grammar: trailing `// fdlint: ignore[native-atomics]`.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from .common import Violation, rel, suppressed
+
+RULE_ATOMICS = "native-atomics"
+
+_MEMBER_RE = re.compile(r"(?:->|\.)\s*(seq_next|seq|ctl)\b")
+_ACCESSOR_RE = re.compile(
+    r"\s*\.\s*(load|store|exchange|fetch_add|fetch_sub|fetch_or|fetch_and|"
+    r"fetch_xor|compare_exchange_weak|compare_exchange_strong)\s*\("
+)
+
+
+def _strip_comments_and_strings(src: str) -> str:
+    """Replace comment/string contents with spaces, preserving offsets
+    and newlines so line numbers survive."""
+    out = []
+    i, n = 0, len(src)
+    mode = None  # None | '//' | '/*' | '"' | "'"
+    while i < n:
+        c = src[i]
+        nxt = src[i + 1] if i + 1 < n else ""
+        if mode is None:
+            if c == "/" and nxt == "/":
+                mode = "//"
+                out.append("  ")
+                i += 2
+                continue
+            if c == "/" and nxt == "*":
+                mode = "/*"
+                out.append("  ")
+                i += 2
+                continue
+            if c == "'" and out and (out[-1].isalnum() or out[-1] == "_"):
+                # C++14 digit separator (2'000'000'000ULL) or a suffix
+                # position inside an identifier-ish token — NOT a char
+                # literal. Treating it as a quote would blank the rest
+                # of the file and blind the pass (review finding).
+                out.append(" ")
+                i += 1
+                continue
+            if c in ('"', "'"):
+                mode = c
+                out.append(" ")
+                i += 1
+                continue
+            out.append(c)
+            i += 1
+        elif mode == "//":
+            if c == "\n":
+                mode = None
+                out.append("\n")
+            else:
+                out.append(" ")
+            i += 1
+        elif mode == "/*":
+            if c == "*" and nxt == "/":
+                mode = None
+                out.append("  ")
+                i += 2
+                continue
+            out.append("\n" if c == "\n" else " ")
+            i += 1
+        else:  # string literal
+            if c == "\\":
+                out.append("  ")
+                i += 2
+                continue
+            if c == mode:
+                mode = None
+            out.append("\n" if c == "\n" else " ")
+            i += 1
+    return "".join(out)
+
+
+def check_source(
+    src: str, path: str, *, root: Optional[str] = None
+) -> List[Violation]:
+    rpath = rel(path, root)
+    stripped = _strip_comments_and_strings(src)
+    src_lines = src.splitlines()
+    out: List[Violation] = []
+    for m in _MEMBER_RE.finditer(stripped):
+        member = m.group(1)
+        tail = stripped[m.end():]
+        if _ACCESSOR_RE.match(tail):
+            continue
+        lineno = stripped.count("\n", 0, m.start()) + 1
+        if suppressed(src_lines, lineno, RULE_ATOMICS):
+            continue
+        snippet = src_lines[lineno - 1].strip() if lineno <= len(
+            src_lines
+        ) else ""
+        out.append(Violation(
+            rule=RULE_ATOMICS, path=rpath, line=lineno,
+            key=f"{member}:{' '.join(snippet.split())[:60]}",
+            message=f"ring word `{member}` accessed without an explicit "
+                    "atomic accessor (.load/.store with a named memory "
+                    "order) — plain access compiles but breaks the "
+                    "seqlock discipline's paper trail",
+        ))
+    return out
+
+
+def check_file(path: str, *, root: Optional[str] = None) -> List[Violation]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return check_source(src, path, root=root)

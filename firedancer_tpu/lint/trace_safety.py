@@ -1,0 +1,388 @@
+"""Pass 1 — trace-safety: host-sync and retrace hazards inside traced code.
+
+A function body that jax traces (`@jax.jit`, `jax.jit(fn)`, or a
+pallas_call kernel) runs ONCE per compile, not once per step. Host
+work inside it is therefore one of two bugs:
+
+  - host-sync hazards (`.item()`, `float()/int()/bool()` on a tracer,
+    `np.asarray` on device values): force a device round-trip or raise
+    `ConcretizationTypeError` at trace time — the exact failure class
+    PR 1 hit when `ballet/ed25519`'s staging asserts met `python -O`
+    and the pallas API rename made msm_pallas untraceable;
+  - retrace hazards (`os.environ` reads, `time.*`/`random.*` calls,
+    Python `if` on a tracer): the value is silently baked into the
+    compiled graph, and the jit cache does NOT key on it — the graph
+    pins whatever the environment said at first trace.
+
+Registry reads (`flags.get_*("FD_X")`) are the sanctioned form of a
+trace-time configuration read: they are allowed inside traced code
+exactly when the registered flag carries the `trace_time=True` marker
+(firedancer_tpu/flags.py), so every graph-pinned knob is declared.
+
+Tracer taint is a deliberate approximation: parameters of a traced
+function are tracers; taint flows through assignment and expressions;
+it is KILLED by static-structure accessors (`.shape`, `.ndim`,
+`.dtype`, `.size`, `len()`, `isinstance()`) and by `is`/`is not`
+comparisons (an `x is None` arm is host-side structure, not a value
+branch). The fixture suite pins both directions, including the
+`if x.shape[0] > 2:` false-positive guard.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .common import Violation, dotted as _dotted, is_env_get_call, \
+    is_environ_expr as _environ_expr, rel, suppressed
+
+RULE_HOST_SYNC = "trace-host-sync"
+RULE_ENV_READ = "trace-env-read"
+RULE_NONDET = "trace-nondet"
+RULE_BRANCH = "trace-tracer-branch"
+
+# attribute reads that yield static (trace-time-constant) structure
+_UNTAINT_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type", "sharding"}
+# calls that yield host values regardless of argument taint
+_UNTAINT_CALLS = {"len", "isinstance", "issubclass", "type", "range",
+                  "getattr", "hasattr", "zip", "enumerate"}
+_JIT_NAMES = {"jit"}           # bare `jit(...)` / `@jit`
+_PALLAS_CALL_NAMES = {"pallas_call"}
+_HOST_SYNC_NP_FUNCS = {"asarray", "array", "copy"}
+
+
+def _call_root(call: ast.Call) -> Optional[str]:
+    return _dotted(call.func)
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    root = _call_root(call)
+    if root is None:
+        return False
+    return root in _JIT_NAMES or root.endswith(".jit")
+
+
+def _is_pallas_call(call: ast.Call) -> bool:
+    root = _call_root(call)
+    if root is None:
+        return False
+    return root.split(".")[-1] in _PALLAS_CALL_NAMES
+
+
+def _fn_arg_names(call: ast.Call) -> List[str]:
+    """Names of functions referenced by a jit/pallas_call's first
+    positional argument — unwrapping functools.partial(fn, ...)."""
+    if not call.args:
+        return []
+    arg = call.args[0]
+    if isinstance(arg, ast.Name):
+        return [arg.id]
+    if isinstance(arg, ast.Call):
+        root = _call_root(arg) or ""
+        if root.split(".")[-1] == "partial" and arg.args:
+            inner = arg.args[0]
+            if isinstance(inner, ast.Name):
+                return [inner.id]
+    return []
+
+
+def _decorated_traced(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            root = _call_root(dec) or ""
+            if root in _JIT_NAMES or root.endswith(".jit"):
+                return True
+            # @functools.partial(jax.jit, static_argnames=...)
+            if root.split(".")[-1] == "partial" and dec.args:
+                inner = _dotted(dec.args[0]) or ""
+                if inner in _JIT_NAMES or inner.endswith(".jit"):
+                    return True
+        else:
+            root = _dotted(dec) or ""
+            if root in _JIT_NAMES or root.endswith(".jit"):
+                return True
+    return False
+
+
+def _collect_traced_functions(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
+    """Functions this module traces: decorated with jit, passed to
+    jit(...), or passed (possibly partial-wrapped) to pallas_call."""
+    by_name: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            by_name.setdefault(node.name, []).append(node)
+    traced: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and _decorated_traced(node):
+            traced[node.name] = node
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_jit_call(node) or _is_pallas_call(node):
+            for name in _fn_arg_names(node):
+                for fn in by_name.get(name, []):
+                    traced[name] = fn
+    return traced
+
+
+class _TaintChecker:
+    """Per-traced-function hazard walk with simple forward taint."""
+
+    def __init__(self, fn: ast.FunctionDef, trace_time_flags: Set[str],
+                 registry_names: Set[str]):
+        self.fn = fn
+        self.trace_time_flags = trace_time_flags
+        self.registry_names = registry_names
+        self.tainted: Set[str] = set()
+        args = fn.args
+        # Positional params are tracers (jit/pallas pass arrays/refs
+        # positionally). Keyword-ONLY params are static configuration by
+        # repo convention — pallas kernels bind them via
+        # functools.partial (e.g. _pow_kernel's kind=) before the
+        # pallas_call, so they are python values at trace time.
+        for a in list(args.posonlyargs) + list(args.args):
+            self.tainted.add(a.arg)
+        if args.vararg:
+            self.tainted.add(args.vararg.arg)
+        self.violations: List[tuple] = []  # (rule, lineno, key, msg)
+
+    # -- taint query -----------------------------------------------------
+
+    def is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _UNTAINT_ATTRS:
+                return False
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            root = _call_root(node) or ""
+            if root in _UNTAINT_CALLS:
+                return False
+            # x.shape[0], x.dtype, jnp.* of tainted args stay tainted
+            return any(self.is_tainted(a) for a in node.args) or any(
+                self.is_tainted(kw.value) for kw in node.keywords
+            ) or (isinstance(node.func, ast.Attribute)
+                  and self.is_tainted(node.func.value))
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False  # `x is None` — structural, not a value read
+            return self.is_tainted(node.left) or any(
+                self.is_tainted(c) for c in node.comparators
+            )
+        if isinstance(node, (ast.Constant, ast.Lambda)):
+            return False
+        return any(
+            self.is_tainted(child) for child in ast.iter_child_nodes(node)
+        )
+
+    def _assign_taint(self, targets, value) -> None:
+        t = self.is_tainted(value)
+        for target in targets:
+            for leaf in ast.walk(target):
+                if isinstance(leaf, ast.Name):
+                    if t:
+                        self.tainted.add(leaf.id)
+                    else:
+                        self.tainted.discard(leaf.id)
+
+    # -- hazard checks ---------------------------------------------------
+
+    def _flag(self, rule: str, node: ast.AST, key: str, msg: str) -> None:
+        self.violations.append((rule, node.lineno, key, msg))
+
+    def _check_call(self, node: ast.Call) -> None:
+        root = _call_root(node) or ""
+        leaf = root.split(".")[-1]
+        # .item() on anything — the canonical device->host sync
+        if (isinstance(node.func, ast.Attribute) and node.func.attr == "item"
+                and not node.args):
+            self._flag(
+                RULE_HOST_SYNC, node, f"{self.fn.name}:item",
+                f"`.item()` inside traced `{self.fn.name}` forces a "
+                "device->host sync (ConcretizationTypeError under jit)",
+            )
+            return
+        # np.asarray / np.array on device values
+        head = root.split(".")[0]
+        if head in ("np", "numpy") and leaf in _HOST_SYNC_NP_FUNCS:
+            self._flag(
+                RULE_HOST_SYNC, node, f"{self.fn.name}:np.{leaf}",
+                f"`{root}` inside traced `{self.fn.name}` materializes on "
+                "host (blocks, or fails on tracers); stay in jnp",
+            )
+            return
+        # float()/int()/bool() on tracer-typed expressions
+        if root in ("float", "int", "bool") and node.args and self.is_tainted(
+            node.args[0]
+        ):
+            self._flag(
+                RULE_HOST_SYNC, node, f"{self.fn.name}:{root}()",
+                f"`{root}()` on a tracer inside traced `{self.fn.name}` "
+                "(ConcretizationTypeError at trace time)",
+            )
+            return
+        # environ.get / getenv, incl. aliased imports (`_os.getenv`)
+        # and `__import__("os").environ` — shared matcher in common.py
+        if is_env_get_call(node.func):
+            self._flag(
+                RULE_ENV_READ, node, f"{self.fn.name}:environ",
+                f"environment read inside traced `{self.fn.name}`: the "
+                "value is baked into the graph and never re-read — go "
+                "through firedancer_tpu.flags with trace_time=True",
+            )
+            return
+        # time.* / random.* — nondeterministic trace-time values
+        if head in ("time", "random") and root != "random.Random":
+            self._flag(
+                RULE_NONDET, node, f"{self.fn.name}:{root}",
+                f"`{root}()` inside traced `{self.fn.name}` pins a "
+                "trace-time value into the compiled graph",
+            )
+            return
+        # flags registry reads: allowed iff the flag is trace_time-marked
+        if leaf in ("get_raw", "get_str", "get_int", "get_float",
+                    "get_bool", "is_set") and node.args:
+            arg = node.args[0]
+            if (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and arg.value.startswith("FD_")):
+                name = arg.value
+                if (name in self.registry_names
+                        and name not in self.trace_time_flags):
+                    self._flag(
+                        RULE_ENV_READ, node,
+                        f"{self.fn.name}:flags:{name}",
+                        f"flags read of {name} inside traced "
+                        f"`{self.fn.name}`, but the registry entry is "
+                        "not marked trace_time=True",
+                    )
+
+    def _check_subscript(self, node: ast.Subscript) -> None:
+        # os.environ["X"] load
+        if _environ_expr(node.value) and isinstance(node.ctx, ast.Load):
+            self._flag(
+                RULE_ENV_READ, node, f"{self.fn.name}:environ",
+                f"os.environ[...] read inside traced `{self.fn.name}` — "
+                "go through firedancer_tpu.flags with trace_time=True",
+            )
+
+    def run(self) -> None:
+        self._walk_body(self.fn.body)
+
+    def _walk_body(self, body) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt)
+
+    def _walk_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            self._scan_expr(stmt.value)
+            self._assign_taint(stmt.targets, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._scan_expr(stmt.value)
+            self._assign_taint([stmt.target], stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            self._scan_expr(stmt.value)
+            if self.is_tainted(stmt.value):
+                self._assign_taint([stmt.target], stmt.value)
+        elif isinstance(stmt, ast.If):
+            self._scan_expr(stmt.test)
+            if self.is_tainted(stmt.test):
+                self._flag(
+                    RULE_BRANCH, stmt,
+                    f"{self.fn.name}:if",
+                    f"Python `if` on a tracer-derived value inside traced "
+                    f"`{self.fn.name}` — branches on traced values need "
+                    "jnp.where / lax.cond (a plain `if` either raises or "
+                    "silently specializes the graph)",
+                )
+            self._walk_body(stmt.body)
+            self._walk_body(stmt.orelse)
+        elif isinstance(stmt, (ast.For,)):
+            self._scan_expr(stmt.iter)
+            self._assign_taint([stmt.target], stmt.iter)
+            self._walk_body(stmt.body)
+            self._walk_body(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self._scan_expr(stmt.test)
+            if self.is_tainted(stmt.test):
+                self._flag(
+                    RULE_BRANCH, stmt, f"{self.fn.name}:while",
+                    f"Python `while` on a tracer-derived value inside "
+                    f"traced `{self.fn.name}` — use lax.while_loop",
+                )
+            self._walk_body(stmt.body)
+            self._walk_body(stmt.orelse)
+        elif isinstance(stmt, ast.FunctionDef):
+            # Nested defs trace as part of the enclosing computation
+            # (fori_loop/while_loop/cond bodies, closures). Their
+            # POSITIONAL params are tracers too — lax control flow
+            # feeds loop-carried traced values into them — so taint
+            # them like the outer function's params (kwonly stays
+            # static config, same convention as the top level).
+            inner_prev = set(self.tainted)
+            for a in (list(stmt.args.posonlyargs) + list(stmt.args.args)):
+                self.tainted.add(a.arg)
+            if stmt.args.vararg:
+                self.tainted.add(stmt.args.vararg.arg)
+            self._walk_body(stmt.body)
+            self.tainted = inner_prev
+        elif isinstance(stmt, (ast.With,)):
+            for item in stmt.items:
+                self._scan_expr(item.context_expr)
+            self._walk_body(stmt.body)
+        elif isinstance(stmt, (ast.Try,)):
+            self._walk_body(stmt.body)
+            for h in stmt.handlers:
+                self._walk_body(h.body)
+            self._walk_body(stmt.orelse)
+            self._walk_body(stmt.finalbody)
+        elif isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._scan_expr(child)
+
+    def _scan_expr(self, expr: ast.expr) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._check_call(node)
+            elif isinstance(node, ast.Subscript):
+                self._check_subscript(node)
+
+
+def check_source(
+    src: str, path: str, *, root: Optional[str] = None
+) -> List[Violation]:
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Violation(
+            rule="parse-error", path=rel(path, root), line=e.lineno or 0,
+            key="syntax", message=f"cannot parse: {e.msg}",
+        )]
+    from firedancer_tpu import flags as flags_mod
+
+    trace_time = {n for n, f in flags_mod.REGISTRY.items() if f.trace_time}
+    registry = set(flags_mod.REGISTRY)
+    src_lines = src.splitlines()
+    out: List[Violation] = []
+    for name, fn in sorted(_collect_traced_functions(tree).items()):
+        checker = _TaintChecker(fn, trace_time, registry)
+        checker.run()
+        for rule, lineno, key, msg in checker.violations:
+            if suppressed(src_lines, lineno, rule):
+                continue
+            out.append(Violation(
+                rule=rule, path=rel(path, root), line=lineno, key=key,
+                message=msg,
+            ))
+    return out
+
+
+def check_file(path: str, *, root: Optional[str] = None) -> List[Violation]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return check_source(src, path, root=root)

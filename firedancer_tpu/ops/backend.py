@@ -9,9 +9,9 @@ pallas.tpu BlockSpecs/VMEM, so every other platform takes the XLA graph.
 
 from __future__ import annotations
 
-import os
-
 import jax
+
+from firedancer_tpu import flags
 
 TPU_PLATFORMS = ("tpu", "axon")
 
@@ -21,7 +21,7 @@ def use_specialized_square() -> bool:
     for a plain multiply — the escape hatch the bench ladder retries
     with if a Mosaic version rejects fe_sq's slice/concat construction.
     Centralized here so dsm_pallas and pow_pallas cannot drift."""
-    return os.environ.get("FD_SQ_IMPL", "sq") != "mul"
+    return flags.get_str("FD_SQ_IMPL") != "mul"
 
 
 def _platform_is_tpu() -> bool:
@@ -36,8 +36,9 @@ def _platform_is_tpu() -> bool:
 
 
 def use_pallas(env_var: str) -> bool:
-    """Decide at trace time whether to use the Pallas implementation."""
-    impl = os.environ.get(env_var, "auto")
+    """Decide at trace time whether to use the Pallas implementation.
+    env_var names a registered *_IMPL flag (firedancer_tpu/flags.py)."""
+    impl = flags.get_str(env_var, "auto")
     if impl == "xla":
         return False
     if impl == "pallas":
@@ -56,7 +57,7 @@ def default_verify_mode() -> str:
     an error, not a silent fall-through to the platform default (a
     typo'd force must never masquerade as a measurement of the mode
     the operator asked for)."""
-    forced = os.environ.get("FD_VERIFY_MODE")
+    forced = flags.get_raw("FD_VERIFY_MODE")
     if forced:
         if forced not in ("rlc", "direct"):
             raise ValueError(
@@ -72,7 +73,7 @@ def kernel_mul_impl() -> str:
     VPU products, more adds), or 'f32' (exact-f32-product convolution —
     wins when the VPU's int32 multiply is emulated multi-pass while f32
     multiply is single-pass; products bounded < 2^24 stay exact)."""
-    impl = os.environ.get("FD_MUL_IMPL", "schoolbook")
+    impl = flags.get_str("FD_MUL_IMPL")
     if impl not in ("schoolbook", "karatsuba", "f32", "rolled", "factored"):
         impl = "schoolbook"
     return impl

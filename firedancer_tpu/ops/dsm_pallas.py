@@ -29,7 +29,9 @@ from . import fe25519 as fe
 NLIMBS = fe.NLIMBS
 # Batch tile per program (v5e r3 measurement: 512 ~9% slower than 1024;
 # VMEM headroom allows 2048 — FD_DSM_LANES overrides for on-chip sweeps).
-LANES = int(__import__("os").environ.get("FD_DSM_LANES", "1024"))
+from firedancer_tpu import flags  # noqa: E402
+
+LANES = flags.get_int("FD_DSM_LANES")
 
 
 def _lanes_for_impl() -> int:
@@ -37,11 +39,9 @@ def _lanes_for_impl() -> int:
     which blows the 16 MiB scoped-VMEM stack at L=1024 (measured:
     19.21M needed). Cap its default tile at 512 unless FD_DSM_LANES
     explicitly overrides."""
-    import os as _os
-
     from .backend import kernel_mul_impl
 
-    if "FD_DSM_LANES" in _os.environ:
+    if flags.is_set("FD_DSM_LANES"):
         return LANES
     if kernel_mul_impl() == "rolled":
         return min(LANES, 512)
@@ -164,8 +164,10 @@ def _dsm_kernel(ax, ay, az, at, hw, sw, btab, ox, oy, oz, *, n_windows=64):
     # WRONG): 'doubles_only' drops both table adds+lookups;
     # 'no_badd' drops the B-side lookup+add. Used by
     # scripts/dsm_attrib.py to split the window cost into
-    # doubles / A-add / B-add shares; never set in production.
-    dbg = __import__("os").environ.get("FD_DSM_DEBUG", "")
+    # doubles / A-add / B-add shares; never set in production. The
+    # registry read is trace_time-marked: this executes while the DSM
+    # kernel builds, and the choice pins into the compiled graph.
+    dbg = flags.get_str("FD_DSM_DEBUG")
 
     def body(wi, r3):
         import jax.experimental.pallas as pl

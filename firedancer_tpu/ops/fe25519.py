@@ -29,8 +29,6 @@ Bound analysis (why 4 vectorized carry passes after mul):
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -372,7 +370,9 @@ def _debug_check_f32_bound(*operands) -> None:
     directly; traced operands inside a compiled kernel cannot be
     inspected at trace time and pass through unchecked, so debug runs
     that want the guard must evaluate eagerly or in interpret mode."""
-    if os.environ.get("FD_FE_DEBUG_BOUNDS", "0") != "1":
+    from firedancer_tpu import flags
+
+    if not flags.get_bool("FD_FE_DEBUG_BOUNDS"):
         return
     for x in operands:
         try:
@@ -612,9 +612,9 @@ def _canonicalize_k(x: jnp.ndarray) -> jnp.ndarray:
     Mosaic version reject the KS construction (decided at trace time,
     like backend.use_karatsuba).
     """
-    import os as _os
+    from firedancer_tpu import flags
 
-    if _os.environ.get("FD_CANON_IMPL") == "seq":
+    if flags.get_raw("FD_CANON_IMPL") == "seq":
         return _canonicalize_k_seq(x)
     # Lazy wrap passes: |limb| <= 2^24 -> |limb| <= 512 (same analysis
     # as fe_mul's 4-pass bound).

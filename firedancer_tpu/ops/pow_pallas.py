@@ -16,8 +16,6 @@ from __future__ import annotations
 
 import functools
 import math
-import os
-
 import jax
 import jax.numpy as jnp
 
@@ -67,8 +65,9 @@ def _sqn(x, n):
         for _ in range(n):
             x = _sq(x)
         return x
-    block = int(os.environ.get("FD_POW_BLOCK", "10"))
-    block = max(1, block)
+    from firedancer_tpu import flags
+
+    block = max(1, flags.get_int("FD_POW_BLOCK"))
     nb, rem = divmod(n, block)
 
     def body(i, v):

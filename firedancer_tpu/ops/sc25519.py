@@ -74,9 +74,9 @@ def sc_reduce64_auto(hash_bytes: jnp.ndarray) -> jnp.ndarray:
     the XLA graph (5.3 ms @8192) beats the VMEM Barrett kernel
     (14.7 ms — the scalar path is short and fuses well in XLA), so XLA
     is the default everywhere; FD_SC_IMPL=pallas opts back in."""
-    import os
+    from firedancer_tpu import flags
 
-    if os.environ.get("FD_SC_IMPL") == "pallas":
+    if flags.get_raw("FD_SC_IMPL") == "pallas":
         from .sc_pallas import sc_reduce64_pallas
 
         return sc_reduce64_pallas(hash_bytes)

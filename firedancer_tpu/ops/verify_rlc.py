@@ -67,10 +67,11 @@ analysis that motivates the promotion.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax.numpy as jnp
 import numpy as np
+
+from firedancer_tpu import flags
 
 from . import curve25519 as ge
 from . import fe25519 as fe
@@ -93,7 +94,7 @@ def msm_engine() -> str:
     default) resolves to pallas exactly when the attached backend is a
     TPU family (ops.backend.use_pallas). An unrecognized value is an
     error — a typo'd force must never quietly test the wrong engine."""
-    impl = os.environ.get("FD_MSM_IMPL", "auto")
+    impl = flags.get_str("FD_MSM_IMPL")
     if impl == "interpret":
         return "interpret"
     if impl not in ("", "auto", "xla", "pallas"):
@@ -242,7 +243,11 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     # default is the XLA graph (round-4 v5e measurement: the Barrett
     # kernel loses ~3x to XLA on these short scalar chains), matching
     # sc25519.sc_reduce64_auto so the two launches never mix backends.
-    if on_tpu and os.environ.get("FD_SC_IMPL") == "pallas":
+    # Registry read, not a raw environ read: this line executes while
+    # verify_batch_rlc TRACES, so the value pins into the compiled
+    # graph — FD_SC_IMPL carries the trace_time marker that sanctions
+    # exactly that (fdlint pass 1 flags the raw form).
+    if on_tpu and flags.get_raw("FD_SC_IMPL") == "pallas":
         from .sc_pallas import sc_mul_pallas
 
         both_m = sc_mul_pallas(
@@ -383,11 +388,10 @@ def make_async_verifier(fallback_fn, rng: np.random.Generator | None = None,
     the subgroup-check trial count (default FD_RLC_TORSION_K or 64).
     """
     import jax
-    import os
 
     rlc = rlc_fn if rlc_fn is not None else jax.jit(verify_batch_rlc)
     if torsion_k is None:
-        torsion_k = int(os.environ.get("FD_RLC_TORSION_K", "64"))
+        torsion_k = flags.get_int("FD_RLC_TORSION_K")
 
     def fn(msgs, lens, sigs, pubs):
         bsz = msgs.shape[0]

@@ -220,7 +220,12 @@ class Alloc:
         L.fd_alloc_in_use.argtypes = [ctypes.c_void_p]
         L.fd_alloc_max_alloc.restype = ctypes.c_uint64
         if create:
-            assert heap_sz is not None
+            # Typed raises, not asserts, throughout this module: python
+            # -O strips asserts, and these values define the shared-
+            # memory layout every OTHER process maps — a bad one is IPC
+            # corruption, not a local bug.
+            if heap_sz is None:
+                raise ValueError("Alloc(create=True) requires heap_sz")
             fp = L.fd_alloc_footprint(heap_sz)
             off = wksp.alloc(name, fp)
             self._mem = wksp.laddr(off)
@@ -343,7 +348,13 @@ class MCache:
     def __init__(self, wksp: Workspace, name: str, depth: int | None = None,
                  create: bool = False):
         if create:
-            assert depth is not None and depth & (depth - 1) == 0
+            if depth is None or depth <= 0 or depth & (depth - 1) != 0:
+                # The line index is seq & (depth-1): a non-power-of-two
+                # depth silently aliases mcache lines for every joiner.
+                raise ValueError(
+                    f"mcache depth must be a positive power of two, "
+                    f"got {depth!r}"
+                )
             fp = lib().fd_mcache_footprint(depth)
             off = wksp.alloc(name, fp)
             self._mem = wksp.laddr(off)
@@ -378,7 +389,13 @@ class DCache:
     def __init__(self, wksp: Workspace, name: str, data_sz: int | None = None,
                  create: bool = False):
         if create:
-            assert data_sz is not None and data_sz % 64 == 0
+            if data_sz is None or data_sz <= 0 or data_sz % 64 != 0:
+                # Chunk indices address 64-byte units; an unaligned size
+                # breaks the chunk walk for every process on the link.
+                raise ValueError(
+                    f"dcache data_sz must be a positive multiple of 64, "
+                    f"got {data_sz!r}"
+                )
             off = wksp.alloc(name, data_sz)
         else:
             off, data_sz = wksp.query(name)

@@ -1,0 +1,129 @@
+#!/usr/bin/env python3
+"""fdlint CLI — the repo-native static-analysis gate (ci.sh blocking lane).
+
+Usage:
+  python scripts/fdlint.py --check [paths...]
+      Run all four passes (trace-safety, flag-registry, boundary
+      contracts, native atomics) over the default scan scope (or the
+      given paths), resolve against lint_baseline.json, print new
+      violations, exit nonzero if any. Stale baseline entries (debt
+      that got fixed) are reported and also fail the gate — the
+      baseline only ever burns down, never silently over-approves.
+
+  python scripts/fdlint.py --dump-flags
+      Print docs/FLAGS.md generated from the typed FD_* registry
+      (firedancer_tpu/flags.py).
+
+  python scripts/fdlint.py --write-baseline
+      Rewrite lint_baseline.json from the current violations (each
+      entry then needs a hand-written one-line justification).
+
+Inline waiver: `# fdlint: ignore[<rule>]` (py) or
+`// fdlint: ignore[<rule>]` (native) on the flagged line.
+
+Pure stdlib + the repo's own firedancer_tpu.lint/flags modules — no
+jax import, so the lane runs in milliseconds before anything builds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+)
+
+from firedancer_tpu.lint import (  # noqa: E402
+    Baseline,
+    run_all,
+)
+from firedancer_tpu.lint.common import repo_root  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fdlint", description=__doc__)
+    ap.add_argument("--check", action="store_true",
+                    help="run all passes and gate on the baseline")
+    ap.add_argument("--dump-flags", action="store_true",
+                    help="print docs/FLAGS.md from the flag registry")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current violations")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline path (default: <repo>/lint_baseline.json)")
+    ap.add_argument("--root", default=None,
+                    help="repo root override (fixture/self tests)")
+    ap.add_argument("paths", nargs="*",
+                    help="optional scan roots (default: the repo scope)")
+    args = ap.parse_args(argv)
+
+    if args.dump_flags:
+        from firedancer_tpu import flags
+
+        sys.stdout.write(flags.dump_markdown())
+        return 0
+
+    root = args.root or repo_root()
+    baseline_path = args.baseline or os.path.join(root, "lint_baseline.json")
+
+    kwargs = {}
+    if args.paths:
+        # Files route to one scanner by suffix; DIRECTORIES go to both
+        # (each scanner walks for its own suffixes), so e.g.
+        # `fdlint --check native` still reaches the atomics pass.
+        py, native = [], []
+        for p in args.paths:
+            if os.path.isdir(os.path.join(root, p) if not os.path.isabs(p)
+                             else p):
+                py.append(p)
+                native.append(p)
+            elif p.endswith((".cc", ".h", ".cpp", ".hpp")):
+                native.append(p)
+            else:
+                py.append(p)
+        kwargs = {"py_roots": py, "native_roots": native}
+    violations = run_all(root=root, **kwargs)
+
+    if args.write_baseline:
+        if args.paths:
+            # A partial scan must never overwrite the whole-tree
+            # baseline: unscanned files' entries (and their hand-written
+            # justifications) would be silently dropped.
+            print("fdlint: --write-baseline requires a full scan — "
+                  "drop the explicit paths")
+            return 2
+        Baseline.write(baseline_path, violations)
+        print(f"fdlint: wrote {len(violations)} entr"
+              f"{'y' if len(violations) == 1 else 'ies'} to "
+              f"{baseline_path} — fill in the justifications")
+        return 0
+
+    if not args.check:
+        ap.print_usage()
+        return 2
+
+    baseline = Baseline.load(baseline_path)
+    new, stale = baseline.resolve(violations)
+
+    for v in new:
+        print(v.format())
+    for e in stale:
+        print(f"{e['file']}: [stale-baseline] entry ({e['rule']}, "
+              f"{e['key']!r}) no longer matches anything — debt fixed; "
+              "delete the entry")
+    n_base = len(violations) - len(new)
+    if new or stale:
+        print(f"fdlint: FAIL — {len(new)} new violation(s), "
+              f"{len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} "
+              f"({n_base} baselined)")
+        return 1
+    print(f"fdlint: OK — 0 new violations "
+          f"({n_base} baselined, {len(baseline.entries)} baseline "
+          f"entr{'y' if len(baseline.entries) == 1 else 'ies'})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

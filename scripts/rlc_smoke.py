@@ -28,6 +28,8 @@ sys.path.insert(
     0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
 )
 
+from firedancer_tpu import flags  # noqa: E402
+
 N = 16
 MAX_LEN = 64
 TORSION_K = 8
@@ -54,7 +56,7 @@ def _batch(oracle, np, salt_lane=None):
 
 
 def main() -> int:
-    mode = os.environ.get("FD_BENCH_VERIFY", "rlc")
+    mode = flags.get_str("FD_BENCH_VERIFY", "rlc")
     if mode != "rlc":
         print(json.dumps({"lane": "rlc_smoke", "ok": False,
                           "error": f"lane requires FD_BENCH_VERIFY=rlc, "

@@ -1,0 +1,14 @@
+"""fdlint fixture: pass 3 (boundary contracts) MUST flag these when the
+file is treated as a boundary module. Never imported, only parsed."""
+
+
+def publish(payload, mtu):
+    assert len(payload) <= mtu                       # boundary-assert
+    return payload
+
+
+class Ring:
+    def __init__(self, depth=None, create=False):
+        if create:
+            assert depth and depth & (depth - 1) == 0  # boundary-assert
+        self.depth = depth

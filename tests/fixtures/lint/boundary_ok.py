@@ -1,0 +1,20 @@
+"""fdlint fixture: pass 3 (boundary contracts) must NOT flag these even
+when the file is treated as a boundary module. Never imported."""
+
+
+def publish(payload, mtu):
+    if len(payload) > mtu:
+        raise ValueError(f"payload {len(payload)} exceeds MTU {mtu}")
+    return payload
+
+
+class Ring:
+    def __init__(self, depth=None, create=False):
+        if create and (not depth or depth & (depth - 1) != 0):
+            raise ValueError(f"depth must be a power of two, got {depth!r}")
+        self.depth = depth
+
+
+def waived(x):
+    assert x is not None  # fdlint: ignore[boundary-assert]
+    return x

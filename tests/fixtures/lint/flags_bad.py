@@ -1,0 +1,22 @@
+"""fdlint fixture: every construct pass 2 (flag-registry) MUST flag.
+Never imported, only parsed."""
+
+import os
+from os import environ, getenv
+
+from firedancer_tpu import flags
+
+a = os.environ.get("FD_MUL_IMPL", "schoolbook")     # flag-env-read
+b = os.getenv("FD_SQ_IMPL")                         # flag-env-read
+c = os.environ["FD_DSM_LANES"]                      # flag-env-read
+d = "FD_POW_BLOCK" in os.environ                    # flag-env-read
+e = environ.get("FD_VERIFY_MODE")                   # flag-env-read (alias)
+f = getenv("FD_SHA_IMPL")                           # flag-env-read (alias)
+g = __import__("os").environ.get("FD_DSM_DEBUG")    # flag-env-read (dunder)
+
+# registry accessor with a typo'd / unregistered name
+h = flags.get_str("FD_NOT_A_REAL_FLAG")             # flag-unregistered
+
+import os as _os  # noqa: E402
+
+i = _os.getenv("FD_BENCH_REPLAY_TIMEOUT", "900")    # flag-env-read (alias)

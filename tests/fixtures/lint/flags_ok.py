@@ -1,0 +1,26 @@
+"""fdlint fixture: constructs pass 2 (flag-registry) must NOT flag.
+Never imported, only parsed."""
+
+import os
+
+from firedancer_tpu import flags
+
+# registry reads are the sanctioned form
+a = flags.get_str("FD_MUL_IMPL")
+b = flags.get_int("FD_DSM_LANES")
+c = flags.is_set("FD_DSM_LANES")
+
+# non-FD_* environment traffic is out of scope
+d = os.environ.get("JAX_PLATFORMS", "cpu")
+e = os.environ["HOME"] if "HOME" in os.environ else ""
+
+# WRITES stay legal (sweep/probe scripts set flags for child configs)
+os.environ["FD_MUL_IMPL"] = "f32"
+os.environ.pop("FD_MUL_IMPL", None)
+
+# dynamic keys are not literal FD_* reads (utils/env.py's generic strip)
+key = "FD_" + "MUL_IMPL"
+f = os.environ.get(key)
+
+# inline waiver grammar
+g = os.environ.get("FD_SQ_IMPL")  # fdlint: ignore[flag-env-read]

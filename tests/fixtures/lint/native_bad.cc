@@ -1,0 +1,22 @@
+// fdlint fixture: pass 4 (native-atomics) MUST flag these.
+// Never compiled, only scanned.
+#include <atomic>
+#include <cstdint>
+
+struct frag_meta {
+  std::atomic<uint64_t> seq;
+  std::atomic<uint16_t> ctl;
+};
+
+struct mcache_hdr {
+  std::atomic<uint64_t> seq_next;
+};
+
+void bad_publish(frag_meta* m, mcache_hdr* h, uint64_t s) {
+  m->seq = s;                        // native-atomics: plain operator=
+  uint64_t got = m->seq;             // native-atomics: plain conversion
+  m->ctl = 3;                        // native-atomics
+  h->seq_next = got + 1;             // native-atomics
+  uint64_t lim = 1'000'000ULL;       // digit separators must not hide...
+  m->seq = lim;                      // native-atomics (...this one)
+}

@@ -1,0 +1,32 @@
+// fdlint fixture: pass 4 (native-atomics) must NOT flag these.
+// Never compiled, only scanned. Comment bait: the seq word, a .seq
+// mention, and "->ctl" in prose must all be ignored.
+#include <atomic>
+#include <cstdint>
+
+struct frag_meta {
+  std::atomic<uint64_t> seq;   // declaration, not a member access
+  std::atomic<uint16_t> ctl;
+};
+
+struct mcache_hdr {
+  std::atomic<uint64_t> seq_next;
+};
+
+void good_publish(frag_meta* m, mcache_hdr* h, uint64_t seq) {
+  // local variable `seq` (no ->/. prefix) is not a ring-word access
+  m->seq.store(~0ULL, std::memory_order_release);
+  m->ctl.store(3, std::memory_order_relaxed);
+  m->seq.store(seq, std::memory_order_release);
+  h->seq_next.store(seq + 1, std::memory_order_release);
+  uint64_t s0 = m->seq.load(std::memory_order_acquire);
+  (void)s0;
+  const char* bait = "m->seq = raw in a string literal";
+  (void)bait;
+  uint64_t waived = m->seq;  // fdlint: ignore[native-atomics]
+  (void)waived;
+  // C++14 digit separators must not be read as char-literal quotes
+  // (they would blank the rest of the file and blind the pass):
+  uint64_t budget = 2'000'000'000ULL;
+  m->seq.store(budget, std::memory_order_release);
+}

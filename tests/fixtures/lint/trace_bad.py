@@ -1,0 +1,104 @@
+"""fdlint fixture: every construct pass 1 (trace-safety) MUST flag.
+
+Each hazard sits inside a function jax traces (decorator, jit(fn), or
+pallas_call kernel). tests/test_fdlint.py asserts one violation per
+marked line; this file is never imported, only parsed.
+"""
+
+import os
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from firedancer_tpu import flags
+
+
+@jax.jit
+def item_sync(x):
+    return x.sum().item()                       # trace-host-sync (.item)
+
+
+@jax.jit
+def float_on_tracer(x):
+    return float(x[0])                          # trace-host-sync (float())
+
+
+@jax.jit
+def np_asarray_sync(x):
+    return np.asarray(x) + 1                    # trace-host-sync (asarray)
+
+
+@jax.jit
+def env_read(x):
+    if os.environ.get("FD_MUL_IMPL") == "f32":  # trace-env-read
+        return x + 1
+    return x
+
+
+@jax.jit
+def nondet_time(x):
+    return x + time.time()                      # trace-nondet (time.*)
+
+
+@jax.jit
+def nondet_random(x):
+    return x * random.random()                  # trace-nondet (random.*)
+
+
+@jax.jit
+def tracer_branch(x):
+    if x[0] > 0:                                # trace-tracer-branch
+        return x + 1
+    return x - 1
+
+
+@jax.jit
+def non_trace_time_flag(x):
+    # FD_BENCH_BATCH is registered WITHOUT trace_time=True: reading it
+    # here pins the bench knob into a compiled graph -> trace-env-read.
+    return x + flags.get_int("FD_BENCH_BATCH")
+
+
+def _kernel_env(ref, out):
+    # hazard inside a pallas kernel body (traced via pallas_call below)
+    out[...] = ref[...] * int(os.getenv("FD_POW_BLOCK", "1"))
+
+
+def launch(x):
+    return pl.pallas_call(
+        _kernel_env,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def _plain(x):
+    # traced via the jit() call below, not a decorator
+    while x.sum() > 0:                          # trace-tracer-branch
+        x = x - 1
+    return x
+
+
+plain_jit = jax.jit(_plain)
+
+import os as _aliased_os  # noqa: E402
+
+
+@jax.jit
+def aliased_getenv(x):
+    # aliased import must not hide the env read (review escape)
+    return x + int(_aliased_os.getenv("FD_POW_BLOCK", "1"))
+
+
+@jax.jit
+def loop_body_branch(x):
+    # nested lax-control-flow body params are tracers too
+    def body(i, v):
+        if v > 0:                               # trace-tracer-branch
+            return v - 1
+        return v
+
+    return jax.lax.fori_loop(0, 3, body, x)

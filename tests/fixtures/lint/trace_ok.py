@@ -1,0 +1,87 @@
+"""fdlint fixture: constructs pass 1 (trace-safety) must NOT flag.
+
+The false-positive guards the test suite pins: static-shape branches
+(`x.shape[0]`), `is None` structure checks, host work in UNtraced
+helpers, trace_time-marked registry reads, and partial-bound static
+keyword-only kernel params. Never imported, only parsed.
+"""
+
+import functools
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from firedancer_tpu import flags
+
+
+@jax.jit
+def shape_branch(x):
+    # tracer-if FALSE-POSITIVE GUARD: .shape is static at trace time
+    if x.shape[0] > 2:
+        return x + 1
+    bsz, width = x.shape
+    if width > bsz:
+        return x - 1
+    return x
+
+
+@jax.jit
+def none_check(x, opt=None):
+    # `is None` is host-side structure, not a tracer value read
+    if opt is not None:
+        return x + opt
+    return x
+
+
+# module-level host config: read ONCE at import, outside any trace
+_CFG = os.environ.get("PLAIN_KNOB", "0") == "1"
+
+
+@jax.jit
+def static_config_branch(x):
+    # branch on a module-level python value — static at trace time
+    if _CFG:
+        return x * 2
+    return x
+
+
+@jax.jit
+def trace_time_flag_read(x):
+    # FD_MUL_IMPL is registered trace_time=True: the sanctioned form
+    # of a trace-time configuration read.
+    if flags.get_str("FD_MUL_IMPL") == "f32":
+        return x.astype(jnp.float32).astype(jnp.int32)
+    return x
+
+
+def host_helper(x):
+    # NOT traced: host code may sync, read env, and time freely.
+    time.sleep(0)
+    _ = os.environ.get("FD_MUL_IMPL")
+    return np.asarray(x).sum().item()
+
+
+def _kernel_static_kind(ref, out, *, kind: str):
+    # keyword-only `kind` is partial-bound static config, not a tracer
+    if kind == "double":
+        out[...] = ref[...] * 2
+    else:
+        out[...] = ref[...]
+
+
+def launch(x):
+    return pl.pallas_call(
+        functools.partial(_kernel_static_kind, kind="double"),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+@jax.jit
+def waived_hazard(x):
+    # inline waiver grammar: the read is flagged by rule, then ignored
+    _ = os.environ.get("FD_SQ_IMPL")  # fdlint: ignore[trace-env-read]
+    return x

@@ -1,0 +1,314 @@
+"""fdlint self-tests: each pass flags its bad fixture, stays silent on
+its ok fixture (incl. the tracer-`if` false-positive guard), the live
+tree is clean modulo the checked-in baseline, and the CLI gates.
+
+Fixtures live in tests/fixtures/lint/ and are parsed, never imported —
+tests/ is outside fdlint's default scan scope precisely so these
+violations-by-design can exist.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from firedancer_tpu.lint import (
+    NATIVE_ROOTS,
+    PY_ROOTS,
+    Baseline,
+    boundary,
+    flag_registry,
+    native_atomics,
+    run_all,
+    trace_safety,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+
+@pytest.fixture(scope="module")
+def live_violations():
+    """One full-tree scan shared by every live-tree assertion (the scan
+    is pure parsing, ~3s — no reason to repeat it per test)."""
+    return run_all(root=REPO)
+
+
+def _fx(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+# ---------------------------------------------------------------- pass 1
+
+
+def test_trace_safety_flags_every_hazard():
+    vs = trace_safety.check_file(_fx("trace_bad.py"), root=REPO)
+    rules = sorted(v.rule for v in vs)
+    by_key = {v.key for v in vs}
+    # one violation per hazard construct in the fixture
+    assert "item_sync:item" in by_key
+    assert "float_on_tracer:float()" in by_key
+    assert "np_asarray_sync:np.asarray" in by_key
+    assert "env_read:environ" in by_key
+    assert "nondet_time:time.time" in by_key
+    assert "nondet_random:random.random" in by_key
+    assert "tracer_branch:if" in by_key
+    assert "non_trace_time_flag:flags:FD_BENCH_BATCH" in by_key
+    assert "_kernel_env:environ" in by_key          # pallas kernel body
+    assert "_plain:while" in by_key                  # jit(fn) reference
+    assert "aliased_getenv:environ" in by_key        # `import os as _x`
+    assert "loop_body_branch:if" in by_key           # fori_loop body param
+    assert rules.count("trace-tracer-branch") == 3
+    assert len(vs) == 12
+
+
+def test_trace_safety_no_false_positives():
+    vs = trace_safety.check_file(_fx("trace_ok.py"), root=REPO)
+    assert vs == [], [v.format() for v in vs]
+
+
+def test_trace_safety_tracer_if_guard():
+    # The load-bearing false-positive guard in isolation: a branch on
+    # x.shape is static and must NOT flag; a branch on x must.
+    ok = trace_safety.check_source(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x.shape[0] > 2:\n"
+        "        return x + 1\n"
+        "    return x\n",
+        "mem.py", root=REPO,
+    )
+    assert ok == []
+    bad = trace_safety.check_source(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 2:\n"
+        "        return x + 1\n"
+        "    return x\n",
+        "mem.py", root=REPO,
+    )
+    assert [v.rule for v in bad] == ["trace-tracer-branch"]
+
+
+def test_trace_safety_taint_propagates_through_assignment():
+    bad = trace_safety.check_source(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    y = x + 1\n"
+        "    z = y * 2\n"
+        "    if z:\n"
+        "        return x\n"
+        "    return y\n",
+        "mem.py", root=REPO,
+    )
+    assert [v.rule for v in bad] == ["trace-tracer-branch"]
+
+
+# ---------------------------------------------------------------- pass 2
+
+
+def test_flag_registry_flags_every_read_form():
+    vs = flag_registry.check_file(_fx("flags_bad.py"), root=REPO)
+    keys = sorted(v.key for v in vs)
+    assert keys == sorted([
+        "FD_MUL_IMPL", "FD_SQ_IMPL", "FD_DSM_LANES", "FD_POW_BLOCK",
+        "FD_VERIFY_MODE", "FD_SHA_IMPL", "FD_DSM_DEBUG",
+        "FD_NOT_A_REAL_FLAG", "FD_BENCH_REPLAY_TIMEOUT",
+    ])
+    unreg = [v for v in vs if v.rule == "flag-unregistered"]
+    assert [v.key for v in unreg] == ["FD_NOT_A_REAL_FLAG"]
+
+
+def test_flag_registry_no_false_positives():
+    vs = flag_registry.check_file(_fx("flags_ok.py"), root=REPO)
+    assert vs == [], [v.format() for v in vs]
+
+
+def test_flag_registry_docs_complete():
+    assert flag_registry.check_registry_docs() == []
+
+
+def test_registry_rejects_undocumented_flag():
+    from firedancer_tpu import flags
+
+    with pytest.raises(ValueError, match="doc"):
+        flags._register("FD_TEST_NO_DOC", str, None, "")
+    assert "FD_TEST_NO_DOC" not in flags.REGISTRY
+
+
+def test_registry_rejects_unregistered_accessor_read():
+    from firedancer_tpu import flags
+
+    with pytest.raises(KeyError, match="unregistered"):
+        flags.get_str("FD_NOT_A_REAL_FLAG")
+
+
+def test_registry_typed_defaults_and_env(monkeypatch):
+    from firedancer_tpu import flags
+
+    assert flags.get_int("FD_DSM_LANES") == 1024
+    monkeypatch.setenv("FD_DSM_LANES", "512")
+    assert flags.get_int("FD_DSM_LANES") == 512
+    assert flags.is_set("FD_DSM_LANES")
+    # empty string means unset (matches the `or None` call sites)
+    monkeypatch.setenv("FD_VERIFY_MODE", "")
+    assert flags.get_raw("FD_VERIFY_MODE") is None
+    assert not flags.is_set("FD_VERIFY_MODE")
+    monkeypatch.setenv("FD_RLC_TORSION_K", "not-a-number")
+    with pytest.raises(ValueError, match="FD_RLC_TORSION_K"):
+        flags.get_int("FD_RLC_TORSION_K")
+
+
+# ---------------------------------------------------------------- pass 3
+
+
+def test_boundary_flags_bare_asserts():
+    vs = boundary.check_file(
+        _fx("boundary_bad.py"), root=REPO, force_boundary=True
+    )
+    assert [v.rule for v in vs] == ["boundary-assert", "boundary-assert"]
+    # stable structural keys (expression text, not line numbers)
+    assert any("len(payload)" in v.key for v in vs)
+
+
+def test_boundary_ok_and_waiver():
+    vs = boundary.check_file(
+        _fx("boundary_ok.py"), root=REPO, force_boundary=True
+    )
+    assert vs == [], [v.format() for v in vs]
+
+
+def test_boundary_scope_is_boundary_modules_only():
+    # the same bad file outside the boundary list is not checked
+    assert boundary.check_file(_fx("boundary_bad.py"), root=REPO) == []
+    # and the live boundary modules really are in scope
+    assert boundary.is_boundary("firedancer_tpu/tango/rings.py")
+    assert boundary.is_boundary("firedancer_tpu/disco/tiles.py")
+    assert boundary.is_boundary("firedancer_tpu/ballet/ed25519/native.py")
+
+
+# ---------------------------------------------------------------- pass 4
+
+
+def test_native_atomics_flags_plain_access():
+    vs = native_atomics.check_file(_fx("native_bad.cc"), root=REPO)
+    assert len(vs) == 5
+    members = sorted(v.key.split(":")[0] for v in vs)
+    assert members == ["ctl", "seq", "seq", "seq", "seq_next"]
+    # the violation AFTER the digit-separator literal is still seen
+    assert any("lim" in v.key for v in vs)
+
+
+def test_native_atomics_ok_comments_strings_waiver():
+    vs = native_atomics.check_file(_fx("native_ok.cc"), root=REPO)
+    assert vs == [], [v.format() for v in vs]
+
+
+def test_native_atomics_live_tree_clean():
+    for fname in sorted(os.listdir(os.path.join(REPO, "native"))):
+        if not fname.endswith((".cc", ".h")):
+            continue
+        path = os.path.join(REPO, "native", fname)
+        vs = native_atomics.check_file(path, root=REPO)
+        assert vs == [], [v.format() for v in vs]
+
+
+# ------------------------------------------------------------- live tree
+
+
+def test_live_tree_clean_modulo_baseline(live_violations):
+    violations = live_violations
+    baseline = Baseline.load(os.path.join(REPO, "lint_baseline.json"))
+    new, stale = baseline.resolve(violations)
+    assert new == [], [v.format() for v in new]
+    assert stale == [], stale
+    # the acceptance contract: baseline stays small and justified
+    assert len(baseline.entries) <= 5
+    for e in baseline.entries:
+        assert e["justification"].strip()
+
+
+def test_default_scope_excludes_tests(live_violations):
+    # fixtures full of violations must never enter the default scan
+    violations = live_violations
+    assert not any(v.path.startswith("tests/") for v in violations)
+    assert "tests" not in PY_ROOTS and "tests" not in NATIVE_ROOTS
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def _run_cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fdlint.py"), *args],
+        capture_output=True, text=True, cwd=cwd, timeout=120,
+    )
+
+
+@pytest.mark.slow  # subprocess + full-tree scan; ci.sh's fdlint
+# lane runs the identical command as its own blocking gate
+def test_cli_check_passes_on_live_tree():
+    p = _run_cli("--check")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "OK" in p.stdout
+
+
+def test_cli_check_fails_on_introduced_violation(tmp_path):
+    # drop one bad fixture into a scratch tree -> nonzero exit
+    scratch = tmp_path / "scratch"
+    scratch.mkdir()
+    (scratch / "bad.py").write_text(
+        'import os\nx = os.environ.get("FD_MUL_IMPL")\n'
+    )
+    p = _run_cli(
+        "--check", "--root", str(scratch), "--baseline",
+        str(scratch / "none.json"), str(scratch),
+    )
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "flag-env-read" in p.stdout
+
+
+def test_cli_stale_baseline_entry_fails(tmp_path):
+    scratch = tmp_path / "scratch"
+    scratch.mkdir()
+    (scratch / "clean.py").write_text("x = 1\n")
+    base = scratch / "base.json"
+    base.write_text(json.dumps({
+        "version": 1,
+        "entries": [{
+            "rule": "flag-env-read", "file": "clean.py",
+            "key": "FD_GONE", "justification": "was fixed",
+        }],
+    }))
+    p = _run_cli(
+        "--check", "--root", str(scratch), "--baseline", str(base),
+        str(scratch),
+    )
+    assert p.returncode == 1
+    assert "stale-baseline" in p.stdout
+
+
+def test_cli_write_baseline_refuses_partial_scan(tmp_path):
+    # a subtree snapshot must never clobber the whole-tree baseline
+    p = _run_cli("--write-baseline", "firedancer_tpu")
+    assert p.returncode == 2
+    assert "full scan" in p.stdout
+
+
+def test_cli_dump_flags_matches_committed_doc():
+    p = _run_cli("--dump-flags")
+    assert p.returncode == 0
+    assert "| `FD_MUL_IMPL` |" in p.stdout
+    with open(os.path.join(REPO, "docs", "FLAGS.md")) as f:
+        committed = f.read()
+    assert p.stdout == committed, (
+        "docs/FLAGS.md is stale — regenerate with "
+        "`python scripts/fdlint.py --dump-flags > docs/FLAGS.md`"
+    )
