@@ -121,6 +121,25 @@ def _cached_corpus(n: int, seed: int):
     return corpus, gen_s
 
 
+def _stage_latency_ms(res) -> dict:
+    """PipelineResult.stage_latency (ns percentiles per stage) -> the
+    artifact's ms schema (docs/LATENCY.md budget table)."""
+    out = {}
+    for stage, d in (getattr(res, "stage_latency", None) or {}).items():
+        out[stage] = {
+            "p50_ms": round(d.get("p50_ns", 0) / 1e6, 2),
+            "p99_ms": round(d.get("p99_ns", 0) / 1e6, 2),
+            "n": d.get("n", 0),
+        }
+    return out
+
+
+def _rlc_fallbacks(res) -> int:
+    """Total per-lane-fallback batches across verify lanes (the ROADMAP
+    round-6 'record fallback counts in the artifact' gate)."""
+    return sum(v.get("rlc_fallback", 0) or 0 for v in res.verify_stats)
+
+
 def replay_cpu_worker() -> int:
     """The host-side 100k correctness gate: the full tile pipeline
     (replay -> verify[cpu native] -> dedup -> pack -> sink) with the
@@ -184,6 +203,13 @@ def replay_cpu_worker() -> int:
         "latency_p99_ms": round(res.latency_p99_ns / 1e6, 2),
         "gen_s": round(gen_s, 1),
         "run_s": round(run_s, 1),
+        # fd_feed artifact schema (round 8): which runner produced this,
+        # its feeder gauges, RLC fallback total, and the per-stage
+        # latency budget table.
+        "feed": bool(getattr(res, "feed", False)),
+        "verify_stats": res.verify_stats,
+        "rlc_fallbacks": _rlc_fallbacks(res),
+        "stage_latency_ms": _stage_latency_ms(res),
     }
     print(json.dumps(rec))
     return 0 if ok else 1
@@ -263,6 +289,9 @@ def replay_worker() -> int:
         "gen_s": round(gen_s, 1),
         "run_s": round(run_s, 1),
         "verify_stats": res.verify_stats,
+        "feed": bool(getattr(res, "feed", False)),
+        "rlc_fallbacks": _rlc_fallbacks(res),
+        "stage_latency_ms": _stage_latency_ms(res),
     }
     print(json.dumps(rec))
     return 0 if ok else 1
@@ -417,15 +446,21 @@ def worker(cpu: bool) -> int:
     finals = [np.asarray(o) for o in outs]
     dt = time.perf_counter() - t0
     bad = any(not bool((f == 0).all()) for f in finals)
-    fell_back = mode == "rlc" and any(
-        getattr(o, "used_fallback", False) for o in outs
-    )
+    # COUNT fallbacks, don't just flag them: the artifact must record
+    # how many timed reps took the per-lane path (ROADMAP round-6 gate
+    # "record fallback counts in the bench artifact") — 0 on the clean
+    # bench corpus, and any nonzero count also voids the rlc timing.
+    fallback_cnt = sum(
+        1 for o in outs if getattr(o, "used_fallback", False)
+    ) if mode == "rlc" else 0
+    fell_back = fallback_cnt > 0
     if bad or fell_back:
         # Not an assert: a fallback-tainted timing must never publish as
         # an "rlc" rate (and must fail over to the direct mode), even
         # under python -O.
         print(json.dumps({"metric": "ed25519_verify_throughput", "value": 0,
                           "unit": "verifies/s", "vs_baseline": 0.0,
+                          "rlc_fallbacks": fallback_cnt,
                           "error": "timed reps failed correctness"
                                    + (" (rlc fell back)" if fell_back else "")}))
         return 1
@@ -443,6 +478,7 @@ def worker(cpu: bool) -> int:
         "device": str(dev),
         "compile_s": round(compile_s, 1),
         "ms_per_batch": round(1e3 * dt / reps, 2),
+        "rlc_fallbacks": fallback_cnt,
     }
     if cpu:
         rec["cpu_fallback"] = True

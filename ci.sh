@@ -53,6 +53,13 @@ fi
 echo "== pytest (full lane; quick lane is: pytest -m 'not slow') =="
 python -m pytest tests/ -x -q
 
+echo "== fd_feed replay smoke (CPU backend, feeder vs step loop) =="
+# The round-8 ingest runtime's gate: a mainnet-shaped corpus through the
+# fd_feed path must be content-exact (mismatches == 0, missing == 0),
+# carry feeder stats + per-stage latency in its artifact, run >= 5x the
+# seed step loop, and never lose to the FD_FEED=0 bisection baseline.
+JAX_PLATFORMS=cpu python scripts/feed_smoke.py
+
 echo "== RLC verify smoke (CPU backend, FD_BENCH_VERIFY=rlc) =="
 # The production verify mode's dispatch contract (round-6 promotion):
 # tiny batch through the tile-facing RLC wrapper — no fallback on clean

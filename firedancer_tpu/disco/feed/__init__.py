@@ -1,0 +1,35 @@
+"""fd_feed — host-side ingest runtime for the verify pipeline.
+
+The round-5 replay artifact pushed 674 txn/s through a verify engine
+that sustains ~117k verifies/s standalone: the device idled ~99% because
+txn parse, dedup, pack, and device dispatch all stepped inside one
+GIL-serialized process. fd_feed is the input pipeline every
+training/inference stack bolts onto an accelerator (and the role
+wiredancer's async DMA-slot model plays for the FPGA): keep the
+accelerator's staging queues full, off the dispatch thread.
+
+Three pieces:
+
+  slots.py    SlotPool — preallocated staging arenas (one numpy arena
+              per in-flight slot, the exact fd_verify_drain layout) with
+              a FREE -> FILLING -> READY -> dispatched lifecycle, so
+              batch assembly happens while the previous batch is on the
+              device. No per-frag allocation.
+  policy.py   AdaptiveFlush — the deadline-based partial-batch flush
+              policy that replaces VerifyTile's fixed max-wait timer
+              (flush_timeout ~= 0 at steady state; a partial batch is
+              never starved past the deadline).
+  runtime.py  run_feed_pipeline — the pipeline runner that keeps source
+              + verify (stager thread + dispatcher) in-process and moves
+              dedup/pack/sink into a worker process (disco/worker.py
+              tiles over the same tango shm rings, credit-backpressured
+              by the existing fctl), then folds feeder stats and
+              per-stage latency into the PipelineResult.
+
+The legacy step loop stays selectable with FD_FEED=0 for bisection.
+"""
+
+from .policy import AdaptiveFlush
+from .slots import Slot, SlotPool
+
+__all__ = ["AdaptiveFlush", "Slot", "SlotPool"]

@@ -1,0 +1,98 @@
+"""Adaptive partial-batch flush policy for the verify feeder.
+
+Replaces VerifyTile's fixed max-wait timer (round-2's 500 us partial-
+batch timeout). The fixed timer has the wrong shape at both ends: at
+steady state it chops full batches into partials whenever staging takes
+longer than the timer (the round-5 replay artifact flushed 77 of 88
+batches partial), and under trickle traffic it makes every stray txn
+wait the full timer even when the device is sitting idle.
+
+The policy is deadline-based with one adaptive early-out:
+
+  full      lanes filled the batch — dispatch, always.
+  deadline  the oldest staged txn is older than the latency deadline,
+            anchored at STAGING time: dispatch NOW. This is the hard
+            bound the property test pins — a partial batch is never
+            starved past the deadline. Ring dwell (publish -> drain) is
+            deliberately NOT folded into this anchor: with a backlog
+            the next drain round fills the batch in O(ms) anyway, so
+            counting dwell would only trade fill ratio for nothing —
+            dwell is instead reported as the `verify_drain` stage
+            latency so a growing backlog stays visible as input-side
+            pressure.
+  starved   the input ran dry AND the device is idle AND downstream has
+            credits: waiting longer cannot improve fill and only adds
+            latency, so dispatch after a short debounce (deadline/16,
+            clamped) that absorbs momentary producer stalls (GIL hiccups
+            must not collapse batch sizes).
+
+At steady state arrivals fill batches before the deadline and the
+device is never idle, so deadline/starved flushes both go to ~0 — the
+ROADMAP round-6 `flush_timeout ~= 0` gate becomes the natural operating
+point instead of a tuning exercise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# due() verdicts (also the stat-bucket names in verify_stats)
+FLUSH_FULL = "full"
+FLUSH_DEADLINE = "deadline"
+FLUSH_STARVED = "starved"
+
+_STARVE_MIN_NS = 100_000       # debounce floor: 100 us
+_STARVE_MAX_NS = 5_000_000     # debounce ceiling: 5 ms
+
+
+class AdaptiveFlush:
+    """Pure decision logic (no clocks, no rings) so the property test
+    can drive it through arbitrary arrival schedules."""
+
+    def __init__(self, deadline_ns: int):
+        if deadline_ns <= 0:
+            raise ValueError(f"deadline_ns must be positive, got {deadline_ns}")
+        self.deadline_ns = deadline_ns
+        self.starve_ns = min(
+            max(deadline_ns // 16, _STARVE_MIN_NS), _STARVE_MAX_NS
+        )
+        # A debounce longer than the deadline could never fire first;
+        # keep the invariant starve <= deadline explicit.
+        self.starve_ns = min(self.starve_ns, deadline_ns)
+
+    def due(
+        self,
+        now_ns: int,
+        lanes: int,
+        batch: int,
+        first_ns: int,
+        starved: bool = False,
+        device_idle: bool = False,
+        backpressured: bool = False,
+    ) -> Optional[str]:
+        """Flush verdict for the currently staged partial batch.
+
+        now_ns/first_ns are the caller's tickcount and the batch's
+        oldest-txn anchor; `starved` means the last drain round returned
+        nothing; `device_idle` means no batch is in flight and no READY
+        slot is queued; `backpressured` means the out link has no
+        credits (flushing could not publish anyway, so the starved
+        early-out defers — the DEADLINE still fires, because the staged
+        txns' latency budget keeps burning while downstream recovers).
+        Returns None (keep filling) or one of FLUSH_*.
+        """
+        if lanes <= 0:
+            return None
+        if lanes >= batch:
+            return FLUSH_FULL
+        age = now_ns - first_ns
+        if age >= self.deadline_ns:
+            return FLUSH_DEADLINE
+        if (
+            starved
+            and device_idle
+            and not backpressured
+            and age >= self.starve_ns
+        ):
+            return FLUSH_STARVED
+        return None

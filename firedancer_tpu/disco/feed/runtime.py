@@ -1,0 +1,445 @@
+"""run_feed_pipeline — the fd_feed pipeline runner.
+
+Topology is the same ring graph build_topology creates; what moves is
+WHERE the stages run:
+
+    main process   replay source (thread) + VerifyTile in feed mode
+                   (stager thread + dispatcher thread)
+    worker process dedup + pack + sink (disco/worker.py --tile
+                   dedup,pack,sink — three tiles on threads over the
+                   same shm rings, credit-backpressured by fctl)
+
+The legacy runner interleaves every per-frag Python stage on one GIL
+with ~5 ms thread-switch quanta; here the main process spends its GIL on
+source publish + completion publish while the stager's ring drain and
+the CPU verifier's batch call run GIL-released, and ALL downstream
+per-frag Python runs on the other core. FD_FEED_PROC=0 keeps the
+downstream tiles on in-process threads (parity/debug).
+
+Quiescence is supervisor-style (the downstream tiles are another
+process, so only shared memory is visible): source exhausted + feeder
+fully drained (stager cursor caught up, no staged slots, nothing in
+flight) + every downstream consumer cursor caught up to its producer
+and stable across a settle window (covers PackTile's internal pending
+set, which rings cannot see).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from firedancer_tpu import flags
+from firedancer_tpu.tango.rings import (
+    CNC_HALT,
+    Cnc,
+    FSeq,
+    MCache,
+    Workspace,
+)
+
+
+def latency_percentiles(samples) -> Dict[str, int]:
+    """{n, p50_ns, p99_ns} of a latency sample list (0s when empty)."""
+    if not samples:
+        return {"n": 0, "p50_ns": 0, "p99_ns": 0}
+    s = sorted(samples)
+    return {
+        "n": len(s),
+        "p50_ns": int(s[len(s) // 2]),
+        "p99_ns": int(s[(len(s) * 99) // 100]),
+    }
+
+
+def verify_tile_stats(v) -> Dict[str, object]:
+    """The verify_stats record for one VerifyTile, feeder fields
+    included (legacy tiles report the same schema with zeroed feeder
+    gauges, so artifact consumers see ONE shape)."""
+    lanes = getattr(v, "stat_lanes", 0)
+    fill = lanes / float(v.stat_batches * v.batch) if v.stat_batches else 0.0
+    st = {
+        "batches": v.stat_batches,
+        "lanes": lanes,
+        "fill_ratio": round(fill, 4),
+        "flush_timeout": v.stat_flush_timeout,
+        "flush_starved": getattr(v, "stat_flush_starved", 0),
+        "inflight_stall": v.stat_inflight_stall,
+        "mode": v.verify_mode,
+        "rlc_fallback": v.stat_rlc_fallback,
+        "feed": bool(getattr(v, "_feed", False)),
+        "slot_stall": 0,
+        "slot_stall_ms": 0.0,
+        "device_idle_est_ms": round(
+            getattr(v, "stat_feed_idle_ns", 0) / 1e6, 2),
+    }
+    if getattr(v, "_feed", False):
+        st["slot_stall"] = v.feed_pool.slot_stall
+        st["slot_stall_ms"] = round(v.feed_pool.stall_ns / 1e6, 2)
+    return st
+
+
+def _spawn_worker(tile: str, wksp_path: str, pod_path: str, opts: dict,
+                  max_ns: int, result_path: str, log_dir: str):
+    cmd = [
+        sys.executable, "-m", "firedancer_tpu.disco.worker",
+        "--wksp", wksp_path, "--pod", pod_path, "--tile", tile,
+        "--opts", json.dumps(opts), "--max-ns", str(max_ns),
+    ]
+    if result_path:
+        cmd += ["--result", result_path]
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    log = os.path.join(log_dir, f"{tile.split(',')[0]}.log")
+    with open(log, "ab") as stderr:
+        return subprocess.Popen(cmd, cwd=repo, stderr=stderr)
+
+
+def run_feed_pipeline(
+    topo,
+    payloads: List[bytes],
+    verify_backend: str = "cpu",
+    verify_batch: int = 128,
+    verify_max_msg_len: Optional[int] = None,
+    bank_cnt: int = 4,
+    timeout_s: float = 60.0,
+    tcache_depth: int = 4096,
+    verify_opts: Optional[dict] = None,
+    record_digests: bool = False,
+    pack_scheduler: str = "greedy",
+    tile_cpus: Optional[List[int]] = None,
+):
+    """Same contract as pipeline.run_pipeline (which routes here when
+    FD_FEED is on and the topology qualifies); returns a PipelineResult
+    with feed=True, feeder verify_stats, and per-stage latency."""
+    # Tiles import feed.policy at module load; import them lazily here
+    # to keep the package import graph acyclic.
+    from firedancer_tpu.disco.pipeline import (
+        PipelineResult,
+        _link_names,
+        _make_out_link,
+        _make_source_out_links,
+    )
+    from firedancer_tpu.disco.tiles import (
+        FD_TPU_MTU,
+        DedupTile,
+        InLink,
+        PackTile,
+        ReplayTile,
+        SinkTile,
+        VerifyTile,
+    )
+
+    pod = topo.pod
+    wksp = Workspace.join(topo.wksp_path)
+    mtu = pod.query_ulong("firedancer.mtu", FD_TPU_MTU)
+
+    # Process layout (FD_FEED_PROC): with worker processes the MAIN
+    # process is only the feeder — stager thread (C drain) + dispatcher
+    # thread (device / native verify + completion publish) — while the
+    # SOURCE and the downstream per-frag tiles each get their own
+    # interpreter. That wins only when cores exist to put under them:
+    # on a 2-core host the extra boots + oversubscription cost more
+    # than the GIL they dodge (especially since the PyDLL ring-op
+    # routing removed most cross-thread GIL handoffs), so 'auto' uses
+    # processes only on >= 4 cores.
+    proc_mode = flags.get_str("FD_FEED_PROC")
+    if proc_mode == "auto":
+        use_proc = (os.cpu_count() or 1) >= 4
+    else:
+        use_proc = proc_mode not in ("0", "false", "no")
+    if pack_scheduler == "gc":
+        # The GC scheduler batches txns in pack-internal state and its
+        # first drain pays an XLA compile, during which every ring
+        # cursor sits STABLE — cursor-settle quiescence would HALT the
+        # run mid-compile and drop the block. In-process tiles let the
+        # quiescence check read the pack's pending set directly (the
+        # same contract the legacy runner uses).
+        use_proc = False
+    replay = None
+    if not use_proc:
+        replay = ReplayTile(
+            wksp, pod.query_cstr("firedancer.replay.cnc"),
+            out_links=_make_source_out_links(wksp, pod),
+            payloads=payloads,
+        )
+    vopts = dict(verify_opts or {})
+    vopts["feed"] = True
+    verify = VerifyTile(
+        wksp, pod.query_cstr("firedancer.verify.cnc"),
+        in_link=InLink(wksp, _link_names(pod, "replay_verify")),
+        out_link=_make_out_link(wksp, pod, "verify_dedup", "verify_dedup",
+                                mtu),
+        backend=verify_backend, batch=verify_batch,
+        max_msg_len=verify_max_msg_len or mtu,
+        tcache_depth=tcache_depth,
+        **vopts,
+    )
+
+    downstream_opts = {
+        "tcache_depth": tcache_depth,
+        "bank_cnt": bank_cnt,
+        "pack_scheduler": pack_scheduler,
+        "record_digests": record_digests,
+        # Pin children to the host platform the parent runs under: this
+        # image's sitecustomize force-registers the TPU plugin, and a
+        # pack-gc worker importing jax must not claim the tunnel.
+        "jax_platform": os.environ.get("JAX_PLATFORMS") or None,
+    }
+    in_tiles: List = []
+    if not use_proc:
+        dedup = DedupTile(
+            wksp, pod.query_cstr("firedancer.dedup.cnc"),
+            in_links=[InLink(wksp, _link_names(pod, "verify_dedup"))],
+            out_link=_make_out_link(wksp, pod, "dedup_pack", "dedup_pack",
+                                    mtu),
+            tcache_depth=tcache_depth,
+        )
+        pack = PackTile(
+            wksp, pod.query_cstr("firedancer.pack.cnc"),
+            in_link=InLink(wksp, _link_names(pod, "dedup_pack")),
+            out_link=_make_out_link(wksp, pod, "pack_sink", "pack_sink",
+                                    mtu),
+            bank_cnt=bank_cnt, scheduler=pack_scheduler,
+        )
+        sink = SinkTile(
+            wksp, pod.query_cstr("firedancer.sink.cnc"),
+            in_link=InLink(wksp, _link_names(pod, "pack_sink")),
+            record_digests=record_digests,
+        )
+        in_tiles = [dedup, pack, sink]
+
+    threads_tiles = [verify] if replay is None else [replay, verify]
+    threads_tiles += in_tiles
+    if tile_cpus:
+        for i, t in enumerate(threads_tiles):
+            t.cpu_idx = tile_cpus[i % len(tile_cpus)]
+        if use_proc:
+            downstream_opts["cpu_map"] = {
+                name: tile_cpus[(2 + i) % len(tile_cpus)]
+                for i, name in enumerate(("dedup", "pack", "sink"))
+            }
+
+    tile_max_ns = int((timeout_s + 30.0) * 1e9)
+    threads = [
+        threading.Thread(target=t.run, args=(tile_max_ns,), name=t.name,
+                         daemon=True)
+        for t in threads_tiles
+    ]
+
+    tmp = tempfile.mkdtemp(prefix="fd_feed_")
+    result_path = os.path.join(tmp, "downstream.json")
+    procs: Dict[str, object] = {}
+    t0 = time.perf_counter()
+    try:
+        if use_proc:
+            import pickle
+
+            pod_path = os.path.join(tmp, "topo.pod")
+            with open(pod_path, "wb") as f:
+                f.write(pod.serialize())
+            payloads_path = os.path.join(tmp, "payloads.pkl")
+            with open(payloads_path, "wb") as f:
+                pickle.dump(list(payloads), f)
+            procs["downstream"] = _spawn_worker(
+                "dedup,pack,sink", topo.wksp_path, pod_path,
+                downstream_opts, tile_max_ns, result_path, tmp)
+            procs["replay"] = _spawn_worker(
+                "replay", topo.wksp_path, pod_path,
+                dict(downstream_opts, payloads_path=payloads_path),
+                tile_max_ns, "", tmp)
+        for th in threads:
+            th.start()
+
+        links = [
+            (MCache(wksp, pod.query_cstr(f"firedancer.{n}.mcache")),
+             FSeq(wksp, pod.query_cstr(f"firedancer.{n}.fseq")))
+            for n in ("verify_dedup", "dedup_pack", "pack_sink")
+        ]
+        worker_cncs = [
+            Cnc(wksp, pod.query_cstr(f"firedancer.{n}.cnc"))
+            for n in (("dedup", "pack", "sink", "replay") if use_proc
+                      else ("dedup", "pack", "sink"))
+        ]
+        src_mcache = MCache(
+            wksp, pod.query_cstr("firedancer.replay_verify.mcache"))
+        n_payloads = len(payloads)
+
+        def src_done() -> bool:
+            if replay is not None:
+                return replay.done()
+            # Source in a worker: only its out-ring cursor is visible.
+            return src_mcache.seq_next() >= n_payloads
+
+        def feeder_drained() -> bool:
+            return (
+                verify.in_link.seq >= src_mcache.seq_next()
+                and verify.feed_pool.idle()
+                and not verify._inflight
+            )
+
+        def downstream_idle() -> bool:
+            # In-process downstream tiles expose their internal pending
+            # work (PackTile holds scheduled-but-unpublished txns that
+            # no ring cursor reflects); worker processes are covered by
+            # the cursor-settle window alone (greedy pack only — see
+            # the gc guard above).
+            if not in_tiles:
+                return True
+            return (pack.pack.pending_cnt() == 0
+                    and not pack._gc_pending)
+
+        # Settle-window quiescence (supervisor-style): PackTile's
+        # CU-deferred pending set is invisible through the rings, so
+        # "drained" must also be STABLE across several passes.
+        deadline = t0 + timeout_s
+        settle, settle_needed = 0, 5
+        last_cursors = None
+        worker_died = None
+        while time.perf_counter() < deadline:
+            for name, proc in procs.items():
+                rc = proc.poll()
+                if rc is not None:
+                    # Workers must outlive the run (they exit only
+                    # after HALT): an early exit is fatal, not
+                    # something to time out on.
+                    worker_died = (name, rc)
+                    break
+            if worker_died:
+                break
+            if any(not th.is_alive() for th in threads):
+                # A tile thread can only exit before HALT by raising
+                # (stager death, verify dispatch error): stop waiting
+                # for a quiescence that cannot come.
+                worker_died = ("tile-thread", -1)
+                break
+            cursors = tuple(
+                (mc.seq_next(), fs.query()) for mc, fs in links
+            )
+            drained = all(fs >= mc for mc, fs in cursors)
+            if (src_done() and feeder_drained() and drained
+                    and downstream_idle() and cursors == last_cursors):
+                settle += 1
+                if settle >= settle_needed:
+                    break
+            else:
+                settle = 0
+            last_cursors = cursors
+            time.sleep(0.005)
+
+        # HALT — but a worker tile that has not reached its run loop yet
+        # would overwrite HALT with RUN at startup and spin to max_ns.
+        # Wait (bounded) until every worker cnc has left BOOT or its
+        # process is gone.
+        if procs and worker_died is None:
+            boot_deadline = time.perf_counter() + 60.0
+            while time.perf_counter() < boot_deadline:
+                if any(p.poll() is not None for p in procs.values()):
+                    break
+                if all(c.signal_query() != 0 for c in worker_cncs):
+                    break
+                time.sleep(0.01)
+        for t in threads_tiles:
+            t.cnc.signal(CNC_HALT)
+        for c in worker_cncs:
+            c.signal(CNC_HALT)
+        join_deadline = time.perf_counter() + timeout_s + 35.0
+        for th in threads:
+            th.join(timeout=max(0.1, join_deadline - time.perf_counter()))
+        if worker_died is None:
+            for proc in procs.values():
+                try:
+                    proc.wait(timeout=60.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+        elapsed = time.perf_counter() - t0
+
+        if worker_died is not None:
+            name, rc = worker_died
+            log_path = os.path.join(
+                tmp, ("dedup" if name == "downstream" else name) + ".log")
+            tail = ""
+            if os.path.exists(log_path):
+                with open(log_path, "rb") as f:
+                    tail = f.read()[-2000:].decode("utf-8", "replace")
+            raise RuntimeError(
+                f"fd_feed {name} worker exited rc={rc} mid-run; "
+                f"stderr tail:\n{tail}"
+            )
+
+        from firedancer_tpu.disco.monitor import snapshot
+
+        diag = snapshot(wksp, pod)
+
+        stage_latency = {
+            "replay_pub": latency_percentiles(
+                replay.out_links[0].lat_ns if replay is not None else []),
+            # Ring dwell (source publish -> stager drain): the feeder's
+            # input-backlog distribution, from the drain's tspub export.
+            "verify_drain": latency_percentiles(verify.stat_ring_dwell_ns),
+            "verify_pub": latency_percentiles(verify.out_link.lat_ns),
+        }
+        down = {}
+        if use_proc:
+            if os.path.exists(result_path):
+                with open(result_path) as f:
+                    down = json.load(f)
+            sink_res = down.get("sink", {})
+            stage_latency["dedup_pub"] = down.get("dedup", {}).get(
+                "pub_lat", latency_percentiles([]))
+            stage_latency["pack_pub"] = down.get("pack", {}).get(
+                "pub_lat", latency_percentiles([]))
+            recv_cnt = sink_res.get("recv_cnt", 0)
+            recv_sz = sink_res.get("recv_sz", 0)
+            bank_hist = {int(k): v for k, v in
+                         (sink_res.get("bank_hist") or {}).items()}
+            lat_p50 = sink_res.get("latency_p50_ns", 0)
+            lat_p99 = sink_res.get("latency_p99_ns", 0)
+            digests = ([bytes.fromhex(d) for d in sink_res["digests"]]
+                       if sink_res.get("digests") is not None else None)
+            stage_latency["sink"] = sink_res.get(
+                "e2e_lat", latency_percentiles([]))
+        else:
+            stage_latency["dedup_pub"] = latency_percentiles(
+                dedup.out_link.lat_ns)
+            stage_latency["pack_pub"] = latency_percentiles(
+                pack.out_link.lat_ns)
+            recv_cnt = sink.recv_cnt
+            recv_sz = sink.recv_sz
+            bank_hist = dict(sink.bank_hist)
+            lat = sorted(sink.latencies_ns)
+            lat_p50 = lat[len(lat) // 2] if lat else 0
+            lat_p99 = lat[(len(lat) * 99) // 100] if lat else 0
+            digests = list(sink.digests) if record_digests else None
+            stage_latency["sink"] = latency_percentiles(sink.latencies_ns)
+
+        res = PipelineResult(
+            recv_cnt=recv_cnt,
+            recv_sz=recv_sz,
+            bank_hist=bank_hist,
+            diag=diag,
+            elapsed_s=elapsed,
+            latency_p50_ns=lat_p50,
+            latency_p99_ns=lat_p99,
+            sink_digests=digests,
+            verify_stats=[verify_tile_stats(verify)],
+            stage_latency=stage_latency,
+            feed=True,
+        )
+        if all(not th.is_alive() for th in threads):
+            wksp.leave()  # else leak the mapping rather than segfault
+        return res
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)

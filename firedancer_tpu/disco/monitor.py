@@ -48,11 +48,20 @@ def snapshot(wksp: Workspace, pod: Pod) -> Dict[str, Dict[str, int]]:
     """One diag snapshot of every tile cnc + link fseq named in the pod."""
     out: Dict[str, Dict[str, int]] = {}
     fd = pod.subpod("firedancer")
+    from firedancer_tpu.tango.rings import cnc_diag_cap
+
+    feed_cap = cnc_diag_cap() >= 16
     for name, sub in _walk_objects(fd.to_dict()):
         if "cnc" in sub:
             cnc = Cnc(wksp, sub["cnc"])
             from firedancer_tpu.disco.tiles import (
                 CNC_DIAG_BACKP_CNT,
+                CNC_DIAG_FEED_BATCHES,
+                CNC_DIAG_FEED_DEADLINE,
+                CNC_DIAG_FEED_IDLE_NS,
+                CNC_DIAG_FEED_LANES,
+                CNC_DIAG_FEED_SLOT_STALL,
+                CNC_DIAG_FEED_STARVED,
                 CNC_DIAG_HA_FILT_CNT,
                 CNC_DIAG_HA_FILT_SZ,
                 CNC_DIAG_IN_BACKP,
@@ -60,7 +69,7 @@ def snapshot(wksp: Workspace, pod: Pod) -> Dict[str, Dict[str, int]]:
                 CNC_DIAG_SV_FILT_SZ,
             )
 
-            out[f"tile.{name}"] = {
+            d = {
                 "signal": cnc.signal_query(),
                 "heartbeat": cnc.heartbeat_query(),
                 "in_backp": cnc.diag(CNC_DIAG_IN_BACKP),
@@ -70,6 +79,19 @@ def snapshot(wksp: Workspace, pod: Pod) -> Dict[str, Dict[str, int]]:
                 "sv_filt_cnt": cnc.diag(CNC_DIAG_SV_FILT_CNT),
                 "sv_filt_sz": cnc.diag(CNC_DIAG_SV_FILT_SZ),
             }
+            if feed_cap:
+                # fd_feed feeder gauges (verify tiles publish them;
+                # zeros elsewhere). Slots 8.. only exist on the 16-slot
+                # cnc ABI — never read them against a stale .so.
+                d.update({
+                    "feed_batches": cnc.diag(CNC_DIAG_FEED_BATCHES),
+                    "feed_lanes": cnc.diag(CNC_DIAG_FEED_LANES),
+                    "feed_deadline_flush": cnc.diag(CNC_DIAG_FEED_DEADLINE),
+                    "feed_starved_flush": cnc.diag(CNC_DIAG_FEED_STARVED),
+                    "feed_slot_stall": cnc.diag(CNC_DIAG_FEED_SLOT_STALL),
+                    "feed_idle_ns": cnc.diag(CNC_DIAG_FEED_IDLE_NS),
+                })
+            out[f"tile.{name}"] = d
         if "fseq" in sub:
             fs = FSeq(wksp, sub["fseq"])
             mc = MCache(wksp, sub["mcache"]) if "mcache" in sub else None
@@ -115,6 +137,28 @@ def render(
             f"{hb_age:>11.1f}{d['backp_cnt']:>8}"
             f"{d['ha_filt_cnt']:>9}{d['sv_filt_cnt']:>9}"
         )
+    # fd_feed feeder panel: only tiles that actually dispatched feeder
+    # batches (verify tiles under fd_feed) — fill%, flush buckets,
+    # stalls, and the device-idle estimate per snapshot interval.
+    feeders = [
+        (name, d) for name, d in sorted(snap.items())
+        if name.startswith("tile.") and d.get("feed_batches")
+    ]
+    if feeders:
+        lines.append("")
+        lines.append(
+            f"{bold}{'FEEDER':<14}{'batches':>9}{'lanes':>9}{'dl-fl':>7}"
+            f"{'st-fl':>7}{'stall':>7}{'idle-ms':>9}{rst}"
+        )
+        for name, d in feeders:
+            p = (prev or {}).get(name, {})
+            idle_ms = (d["feed_idle_ns"]
+                       - p.get("feed_idle_ns", 0)) / 1e6
+            lines.append(
+                f"{name[5:]:<14}{d['feed_batches']:>9}{d['feed_lanes']:>9}"
+                f"{d['feed_deadline_flush']:>7}{d['feed_starved_flush']:>7}"
+                f"{d['feed_slot_stall']:>7}{idle_ms:>9.1f}"
+            )
     lines.append("")
     lines.append(
         f"{bold}{'LINK':<16}{'tx_seq':>9}{'rx_seq':>9}{'pub/s':>10}"

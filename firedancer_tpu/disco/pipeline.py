@@ -20,6 +20,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from firedancer_tpu import flags
 from firedancer_tpu.tango.rings import (
     CNC_HALT,
     Cnc,
@@ -135,11 +136,21 @@ class PipelineResult:
     latency_p50_ns: int = 0
     latency_p99_ns: int = 0
     # Per-verify-lane async offload shim counters (batches dispatched,
-    # max-wait flushes, in-flight-cap stalls).
+    # adaptive-flush buckets, in-flight-cap stalls) plus the fd_feed
+    # feeder gauges (fill_ratio, slot_stall, device_idle_est_ms) —
+    # one schema for both runners (feed/runtime.verify_tile_stats).
     verify_stats: List[Dict[str, int]] = field(default_factory=list)
     # sha256 digests of sink-received payloads (SinkTile record_digests);
     # replay gates compare this multiset against the expected corpus.
     sink_digests: Optional[List[bytes]] = None
+    # Per-stage tsorig->tspub latency percentiles (docs/LATENCY.md):
+    # {"verify_pub": {n, p50_ns, p99_ns}, ...} — source stamp to each
+    # stage's publish, sampled at the stage's own OutLink; "sink" is the
+    # end-to-end reservoir.
+    stage_latency: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    # True when the fd_feed ingest runtime produced this result (the
+    # legacy step loop remains selectable with FD_FEED=0).
+    feed: bool = False
 
 
 def _run_tiles(
@@ -268,6 +279,10 @@ def _run_tiles(
         post_wait()
     elapsed = time.perf_counter() - t0
 
+    from firedancer_tpu.disco.feed.runtime import (
+        latency_percentiles,
+        verify_tile_stats,
+    )
     from firedancer_tpu.disco.monitor import snapshot
 
     diag = snapshot(wksp, pod)
@@ -281,24 +296,54 @@ def _run_tiles(
         latency_p50_ns=lat[len(lat) // 2] if lat else 0,
         latency_p99_ns=lat[(len(lat) * 99) // 100] if lat else 0,
         sink_digests=list(sink.digests) if record_digests else None,
-        verify_stats=[
-            {
-                "batches": v.stat_batches,
-                "flush_timeout": v.stat_flush_timeout,
-                "inflight_stall": v.stat_inflight_stall,
-                # RLC dispatch accounting (round-6 promotion): which
-                # mode ran and how many batches took the exact per-lane
-                # fallback — replay gates assert fallbacks stay 0 on
-                # clean traffic.
-                "mode": v.verify_mode,
-                "rlc_fallback": v.stat_rlc_fallback,
-            }
-            for v in verifies
-        ],
+        # RLC dispatch accounting (round-6) + feeder gauges (round-8):
+        # one schema with the feed runtime — replay gates assert
+        # fallbacks stay 0 on clean traffic, the feeder gates read
+        # fill_ratio/flush buckets.
+        verify_stats=[verify_tile_stats(v) for v in verifies],
+        stage_latency={
+            "replay_pub": latency_percentiles(src_outs[0].lat_ns),
+            "verify_pub": latency_percentiles(verifies[0].out_link.lat_ns),
+            "dedup_pub": latency_percentiles(dedup.out_link.lat_ns),
+            "pack_pub": latency_percentiles(pack.out_link.lat_ns),
+            "sink": latency_percentiles(sink.latencies_ns),
+        },
     )
     if all(not th.is_alive() for th in threads):
         wksp.leave()  # else: leak the mapping rather than segfault a thread
     return res
+
+
+def _feed_supported(pod: Pod, verify_backend: str, verify_batch: int,
+                    verify_opts: Optional[dict]) -> bool:
+    """Can the fd_feed runtime serve this topology? Mirrors VerifyTile's
+    native-drain preconditions (single verify lane, cpu|tpu backend,
+    batch wide enough that any parseable txn fits a fresh slot, native
+    lib built) — anything else silently keeps the legacy step loop, the
+    same graceful degradation the native drain itself uses."""
+    from firedancer_tpu.ballet.txn import MAX_SIG_CNT
+    from firedancer_tpu.tango.rings import feed_abi_ok, native_available
+
+    if verify_backend not in ("cpu", "tpu"):
+        return False
+    if pod.query_ulong("firedancer.layout.verify_lane_cnt", 1) != 1:
+        return False
+    if verify_batch < MAX_SIG_CNT or not native_available():
+        return False
+    if not feed_abi_ok():
+        return False  # stale .so: drain ABI v2 / bulk publisher absent
+    if verify_opts and verify_opts.get("native_drain") is False:
+        return False
+    if verify_opts and verify_opts.get("mesh_devices"):
+        # The sharded verify step stays on the legacy runner until the
+        # feeder learns to keep several device shards full.
+        return False
+    if verify_backend == "cpu":
+        from firedancer_tpu.ballet.ed25519 import native as ed_native
+
+        if not ed_native.available():
+            return False
+    return True
 
 
 def run_pipeline(
@@ -314,13 +359,39 @@ def run_pipeline(
     record_digests: bool = False,
     pack_scheduler: str = "greedy",
     tile_cpus: Optional[List[int]] = None,
+    feed: Optional[bool] = None,
 ) -> PipelineResult:
     """Replay-sourced pipeline: payload list -> verify -> dedup -> pack -> sink.
+
+    Routes through the fd_feed ingest runtime (disco/feed/runtime.py —
+    staging-slot feeder + downstream worker process) when `feed` is True
+    or unset-with-FD_FEED-on AND the topology qualifies
+    (_feed_supported); otherwise the legacy in-process step loop runs.
+    FD_FEED=0 pins the legacy loop for bisection.
 
     Shutdown is quiescence-based (source exhausted + every link drained);
     filtered frags never reach the sink, so the caller asserts on
     PipelineResult.recv_cnt rather than passing an expected count in.
     """
+    if feed is None:
+        feed = flags.get_bool("FD_FEED")
+    if feed and _feed_supported(topo.pod, verify_backend, verify_batch,
+                                verify_opts):
+        from firedancer_tpu.disco.feed.runtime import run_feed_pipeline
+
+        return run_feed_pipeline(
+            topo, payloads,
+            verify_backend=verify_backend,
+            verify_batch=verify_batch,
+            verify_max_msg_len=verify_max_msg_len,
+            bank_cnt=bank_cnt,
+            timeout_s=timeout_s,
+            tcache_depth=tcache_depth,
+            verify_opts=verify_opts,
+            record_digests=record_digests,
+            pack_scheduler=pack_scheduler,
+            tile_cpus=tile_cpus,
+        )
     pod = topo.pod
     wksp = Workspace.join(topo.wksp_path)
     replay = ReplayTile(

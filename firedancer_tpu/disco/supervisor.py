@@ -224,14 +224,20 @@ def _supervised(
             if not dead:
                 hb = cncs[name].heartbeat_query()
                 seen_at, seen_hb = last_beat.get(name, (now, hb))
-                # hb == seen_hb == 0 means the worker is still BOOTING
-                # (interpreter + imports + jit compiles, easily MINUTES
-                # on a loaded host even from a warm cache): boot gets
-                # its own generous grace — killing a booting worker
-                # just restarts the boot storm, which was the round-3
-                # under-load flake. A genuinely hung boot is caught by
-                # the global no-progress stall timeout instead.
-                limit = boot_grace_s if seen_hb == 0 else heartbeat_timeout_s
+                # A worker whose cnc signal is still BOOT gets the
+                # generous boot grace even when its heartbeat has been
+                # seen nonzero: the worker's boot-beat thread CAN stall
+                # for >heartbeat_timeout_s behind a long GIL-holding
+                # compile phase, and killing it there re-pays the whole
+                # compile before the persistent cache entry is ever
+                # written — a respawn storm that never converges (the
+                # round-8 cold-cache hang; the round-3 flake was the
+                # hb==0 variant of the same storm). A genuinely hung
+                # boot is caught by boot_grace_s and the global
+                # no-progress stall timeout.
+                booting = cncs[name].signal_query() == 0  # CNC_BOOT
+                limit = (boot_grace_s if (seen_hb == 0 or booting)
+                         else heartbeat_timeout_s)
                 if hb != seen_hb:
                     last_beat[name] = (now, hb)
                 elif now - seen_at > limit:
@@ -305,6 +311,41 @@ def _supervised(
     # only reflects the final sink incarnation and is best-effort.
     from firedancer_tpu.tango.rings import DIAG_PUB_CNT, DIAG_PUB_SZ
 
+    # Verify-tile stats survive worker crashes in the cnc diag region
+    # (the in-process runners read tile objects instead): the fd_feed
+    # gauges give the supervisor fill/flush/stall visibility it never
+    # had. 16-slot cnc ABI only.
+    from firedancer_tpu.tango.rings import cnc_diag_cap
+
+    verify_stats = []
+    if cnc_diag_cap() >= 16:
+        from firedancer_tpu.disco.tiles import (
+            CNC_DIAG_FEED_BATCHES,
+            CNC_DIAG_FEED_DEADLINE,
+            CNC_DIAG_FEED_IDLE_NS,
+            CNC_DIAG_FEED_LANES,
+            CNC_DIAG_FEED_SLOT_STALL,
+            CNC_DIAG_FEED_STARVED,
+        )
+
+        for name in tile_names:
+            if not name.startswith("verify"):
+                continue
+            c = cncs[name]
+            batches = c.diag(CNC_DIAG_FEED_BATCHES)
+            lanes = c.diag(CNC_DIAG_FEED_LANES)
+            verify_stats.append({
+                "batches": batches,
+                "lanes": lanes,
+                "fill_ratio": round(
+                    lanes / (batches * verify_batch), 4) if batches else 0.0,
+                "flush_timeout": c.diag(CNC_DIAG_FEED_DEADLINE),
+                "flush_starved": c.diag(CNC_DIAG_FEED_STARVED),
+                "slot_stall": c.diag(CNC_DIAG_FEED_SLOT_STALL),
+                "device_idle_est_ms": round(
+                    c.diag(CNC_DIAG_FEED_IDLE_NS) / 1e6, 2),
+            })
+
     sink_fseq = FSeq(wksp, pod.query_cstr("firedancer.pack_sink.fseq"))
     res = PipelineResult(
         recv_cnt=sink_fseq.diag(DIAG_PUB_CNT),
@@ -317,6 +358,7 @@ def _supervised(
         latency_p99_ns=sink_res.get("latency_p99_ns", 0),
         sink_digests=[bytes.fromhex(d) for d in sink_res["digests"]]
         if sink_res.get("digests") else None,
+        verify_stats=verify_stats,
     )
     res.supervisor_restarts = total_restarts  # type: ignore[attr-defined]
     return res

@@ -29,7 +29,13 @@ import numpy as np
 
 from firedancer_tpu import flags
 from firedancer_tpu.ballet import ed25519 as oracle
-from firedancer_tpu.ballet.txn import TxnParseError, parse_txn
+from firedancer_tpu.ballet.txn import MAX_SIG_CNT, TxnParseError, parse_txn
+from firedancer_tpu.disco.feed.policy import (
+    FLUSH_DEADLINE,
+    FLUSH_FULL,
+    FLUSH_STARVED,
+    AdaptiveFlush,
+)
 from firedancer_tpu.tango import tempo
 from firedancer_tpu.tango.fctl import make_fctl_for_fseqs
 from firedancer_tpu.tango.rings import (
@@ -72,6 +78,20 @@ CNC_DIAG_UNACKED = 6
 # while batches fill by signature LANES, so a "staged >= batch" gauge
 # test can miss the hold window on multisig-bearing corpora.
 CNC_DIAG_HOLDS = 7
+# fd_feed feeder gauges (verify tiles; slots 8.. exist only on the
+# 16-slot cnc ABI — writers MUST gate on rings.cnc_diag_cap() >= 16, an
+# 8-slot .so would take these as out-of-bounds wksp writes). Counters
+# mirror the in-process verify_stats so monitors/supervisors see the
+# feeder across process boundaries: batches dispatched, lanes in them
+# (fill_ratio = lanes / (batches * batch)), deadline vs starved partial
+# flushes, stager slot-acquire stalls, and the dispatcher's
+# device-idle-estimate ns.
+CNC_DIAG_FEED_BATCHES = 8
+CNC_DIAG_FEED_LANES = 9
+CNC_DIAG_FEED_DEADLINE = 10
+CNC_DIAG_FEED_STARVED = 11
+CNC_DIAG_FEED_SLOT_STALL = 12
+CNC_DIAG_FEED_IDLE_NS = 13
 
 CTL_SOM_EOM = 3
 
@@ -162,6 +182,29 @@ class OutLink:
             self.mcache.depth, reliable_fseqs or [], cr_burst=1
         )
         self.cr_avail = 0
+        # Per-stage latency reservoir (docs/LATENCY.md): tsorig -> tspub
+        # of every frag published on THIS link, i.e. source-stamp to
+        # this-stage-complete. publish() already computes both stamps,
+        # so the sample is one subtraction on a path that costs ~40 us —
+        # bounded reservoir (algorithm R) keeps long soaks at constant
+        # memory. The replay artifacts report p50/p99 per stage.
+        self.lat_ns: list = []
+        self.lat_cap = 16384
+        self._lat_seen = 0
+        self._lat_rng = Rng(seq=0x1a7)
+
+    def lat_sample(self, lat: int) -> None:
+        """Algorithm-R reservoir insert: every publish-latency sample in
+        the link's lifetime has equal selection probability, so a long
+        soak's percentiles reflect the whole run, not the warmup window.
+        Shared by the per-frag publish and the fd_feed bulk completion."""
+        self._lat_seen += 1
+        if len(self.lat_ns) < self.lat_cap:
+            self.lat_ns.append(lat)
+        else:
+            j = self._lat_rng.roll(self._lat_seen)
+            if j < self.lat_cap:
+                self.lat_ns[j] = lat
 
     def housekeep(self):
         self.cr_avail = self.fctl.tx_cr_update(self.cr_avail, self.seq)
@@ -185,6 +228,8 @@ class OutLink:
             )
         self.dcache.write(self.chunk, payload)
         tspub = tempo.tickcount() & 0xFFFFFFFF
+        if tsorig:
+            self.lat_sample((tspub - tsorig) & 0xFFFFFFFF)
         self.mcache.publish(
             self.seq, sig, self.chunk, len(payload), CTL_SOM_EOM, tsorig, tspub
         )
@@ -563,6 +608,9 @@ class _InflightBatch:
     todo: list                     # [(payload, n_items, tsorig)] whole txns
     oversize: list                 # per-lane True if msg exceeded staging
     t_dispatch: int                # tickcount at dispatch (diag)
+    # fd_feed cpu path: the staging slot the verify executor is still
+    # reading from; released back to the pool when the batch retires.
+    slot: object = None
 
 
 class _ReadyBatch:
@@ -581,6 +629,24 @@ class _ReadyBatch:
         return _np.asarray(self._s, dtype=dtype)
 
 
+class _FutureBatch:
+    """concurrent.futures result with the async-batch surface — the
+    fd_feed cpu dispatch path, where a verify executor thread runs the
+    GIL-releasing fd_ed25519_cpu_verify_batch call concurrently with
+    staging (the host-verifier analog of an async device dispatch)."""
+
+    def __init__(self, fut):
+        self._f = fut
+
+    def is_ready(self) -> bool:
+        return self._f.done()
+
+    def __array__(self, dtype=None):
+        import numpy as _np
+
+        return _np.asarray(self._f.result(), dtype=dtype)
+
+
 class VerifyTile(Tile):
     """Sigverify: parse txn in-tile, ha-dedup, verify signatures, forward.
 
@@ -593,10 +659,23 @@ class VerifyTile(Tile):
     wd_f1.c:327-408): up to `inflight` batches are in flight on the device
     while the tile keeps draining its in-ring; completions are polled
     non-blockingly (jax async dispatch + Array.is_ready) and published
-    into the out mcache in dispatch order. A partial batch older than
-    `max_wait_us` is flushed so trickle traffic has bounded latency.
+    into the out mcache in dispatch order. Partial batches are governed
+    by the ADAPTIVE flush policy (disco/feed/policy.py): hard latency
+    deadline (max_wait_us if passed, else FD_FEED_DEADLINE_US), plus a
+    fast starved-input flush when the device is idle — at steady state
+    batches fill and flush_timeout stays ~0 (the ROADMAP round-6 gate).
     Failed/parse-error/duplicate txns are dropped and counted in the cnc
     diag (SV/HA filter slots).
+
+    feed=True (the fd_feed ingest runtime) moves the whole ring-drain /
+    parse / HA-dedup / staging path onto a dedicated STAGER thread that
+    fills preallocated SlotPool arenas (disco/feed/slots.py) while this
+    tile's run loop becomes a pure dispatcher: pop READY slots, ship
+    them to the device (or the native CPU verifier — a GIL-releasing C
+    call, so staging genuinely overlaps it), publish completions. Feeder
+    stats (fill_ratio, slot_stall, device_idle_est) land in verify_stats
+    and — on the 16-slot cnc ABI — in the CNC_DIAG_FEED_* gauges that
+    monitors and supervisors read across process boundaries.
     """
 
     name = "verify"
@@ -612,10 +691,12 @@ class VerifyTile(Tile):
         max_msg_len: int = FD_TPU_MTU,
         tcache_depth: int = 4096,
         inflight: int = 2,
-        max_wait_us: int = 500,
+        max_wait_us: Optional[int] = None,
         native_drain: bool = True,
         verify_mode: str = "auto",
         mesh_devices: int = 0,
+        feed: bool = False,
+        feed_slots: Optional[int] = None,
         **kw,
     ):
         super().__init__(wksp, cnc_name, in_link=in_link, out_link=out_link, **kw)
@@ -688,7 +769,16 @@ class VerifyTile(Tile):
         self.max_msg_len = max_msg_len
         self.ha_tcache = TCache(tcache_depth)
         self.inflight_max = max(1, inflight)
-        self.max_wait_ns = max_wait_us * 1_000
+        # Partial-batch flush: deadline-based adaptive policy (replaces
+        # the round-2 fixed max-wait timer). An explicit max_wait_us
+        # still pins the deadline (the device replay gate passes 200 ms
+        # for the slow remote tunnel); otherwise FD_FEED_DEADLINE_US.
+        deadline_us = (
+            max_wait_us if max_wait_us is not None
+            else flags.get_int("FD_FEED_DEADLINE_US")
+        )
+        self.max_wait_ns = deadline_us * 1_000  # kept: tests/monitors read it
+        self.flush_policy = AdaptiveFlush(self.max_wait_ns)
         self._pending: list = []       # [(payload, items, tsorig, seq_end)]
         self._pending_lanes = 0
         self._pending_since = 0        # tickcount of oldest pending txn
@@ -719,9 +809,21 @@ class VerifyTile(Tile):
         self._verify_batch_fn = None
         # dispatch/completion stats (read by monitor/bench)
         self.stat_batches = 0
-        self.stat_flush_timeout = 0
+        self.stat_lanes = 0            # lanes in dispatched batches (fill)
+        self.stat_flush_timeout = 0    # deadline flushes (gate: ~0 steady)
+        self.stat_flush_starved = 0    # starved-input early flushes
         self.stat_inflight_stall = 0
         self.stat_rlc_fallback = 0
+        self.stat_feed_idle_ns = 0     # dispatcher starved-of-slots estimate
+        self.stat_ring_dwell_ns: list = []  # publish->drain backlog samples
+        # Feeder gauge mirror (CNC_DIAG_FEED_*): published by EVERY
+        # verify tile — legacy tiles report batches/lanes/flush buckets
+        # too, so the supervisor's cross-process verify_stats are never
+        # blind — but only on the 16-slot cnc ABI.
+        self._feed_diag_mirror = [0] * 6
+        from firedancer_tpu.tango.rings import cnc_diag_cap
+
+        self._feed_diag_ok = cnc_diag_cap() >= 16
         # Native bulk drain (native/verify_drain.cc): one C call per batch
         # round replaces the per-frag Python poll/parse/copy loop (~30 us
         # per txn measured; the loop is the host-side throughput cap,
@@ -731,10 +833,12 @@ class VerifyTile(Tile):
         # against ballet/txn.py.
         self._nd = False
         self._jnp = None
+        self._feed = False
         from firedancer_tpu.ballet.txn import MAX_SIG_CNT
 
-        if (backend in ("tpu", "cpu") and native_drain
-                and in_link is not None and batch >= MAX_SIG_CNT):
+        nd_ok = (backend in ("tpu", "cpu") and native_drain
+                 and in_link is not None and batch >= MAX_SIG_CNT)
+        if nd_ok:
             # batch >= MAX_SIG_CNT guarantees every parseable txn fits a
             # fresh batch; smaller batches fall back to the Python path,
             # which oracles outsized multisig txns instead of dropping.
@@ -744,8 +848,22 @@ class VerifyTile(Tile):
             # was the replay gate's 30x cap).
             from firedancer_tpu.ballet.ed25519 import native as _ed_native
 
-            if backend == "tpu" or _ed_native.available():
-                self._nd_setup()
+            nd_ok = backend == "tpu" or _ed_native.available()
+        if feed:
+            if not nd_ok:
+                # A feeder that silently fell back to the per-frag loop
+                # would report legacy throughput as fd_feed numbers;
+                # run_pipeline's routing checks the same preconditions
+                # and picks the legacy runner instead of ever hitting
+                # this.
+                raise ValueError(
+                    "feed=True requires the native drain path (cpu|tpu "
+                    "backend, a single in_link, batch >= MAX_SIG_CNT, "
+                    "and the native verifier for backend='cpu')"
+                )
+            self._feed_setup(feed_slots)
+        elif nd_ok:
+            self._nd_setup()
         if backend == "tpu":
             import jax
             import jax.numpy as jnp
@@ -828,13 +946,22 @@ class VerifyTile(Tile):
             stop.set()
             t.join(timeout=5.0)
 
-    def _nd_setup(self) -> None:
+    def _nd_bindings(self) -> None:
+        """ctypes bindings + drain scratch shared by the legacy native
+        staging path and the fd_feed stager."""
         import ctypes
 
         from firedancer_tpu.tango.rings import lib as rings_lib
+        from firedancer_tpu.tango.rings import verify_drain_abi2
 
         self._nd_lib = rings_lib()
         self._nd_ct = ctypes
+        self._nd_abi2 = verify_drain_abi2()
+        self._nd_counters = np.zeros(6, np.uint64)
+        self._nd_prev = np.zeros(6, np.uint64)
+
+    def _nd_setup(self) -> None:
+        self._nd_bindings()
         b, mtu = self.batch, self.max_msg_len
         self._nd_msgs = np.zeros((b, mtu), np.uint8)
         self._nd_lens = np.zeros(b, np.uint32)
@@ -846,12 +973,59 @@ class VerifyTile(Tile):
         self._nd_psigs = np.zeros(b, np.uint64)
         self._nd_tlanes = np.zeros(b, np.uint32)
         self._nd_tsorig = np.zeros(b, np.uint32)
-        self._nd_counters = np.zeros(6, np.uint64)
-        self._nd_prev = np.zeros(6, np.uint64)
+        self._nd_tspub = np.zeros(b, np.uint32)
+        self._nd_hash = np.zeros(b, np.uint64)
         self._nd_pay_fill = 0
         self._nd = True
 
+    def _feed_setup(self, feed_slots: Optional[int]) -> None:
+        """fd_feed mode: staging slots + stager-thread state. The slot
+        arenas replace the single _nd_* staging buffer; the stager is
+        started lazily by the first dispatcher poll (construction must
+        stay side-effect-free for tiles that are built but never run)."""
+        import threading as _threading
+
+        from firedancer_tpu.disco.feed.slots import SlotPool
+        from firedancer_tpu.tango.rings import feed_abi_ok
+
+        if not feed_abi_ok():
+            # The feeder's staging + completion are built on drain ABI
+            # v2 + the bulk publisher; a stale .so must route to the
+            # legacy runner (run_pipeline checks this), never half-run.
+            raise ValueError(
+                "feed=True requires the current native ABI "
+                "(fd_verify_drain_abi2 + fd_frag_publish_bulk); "
+                "rebuild native/ or run with FD_FEED=0"
+            )
+        self._nd_bindings()
+        n_slots = feed_slots or flags.get_int("FD_FEED_SLOTS")
+        self.feed_pool = SlotPool(n_slots, self.batch, self.max_msg_len)
+        self._feed_exec = None
+        if self.backend == "cpu":
+            # Concurrent GIL-releasing native verify calls: the cpu
+            # "device" is every core the host can spare, not one
+            # serialized C call (the wiredancer shim's multiple DMA
+            # slots, in host form).
+            from concurrent.futures import ThreadPoolExecutor
+
+            n_thr = flags.get_int("FD_FEED_VERIFY_THREADS")
+            if n_thr <= 0:
+                n_thr = min(2, os.cpu_count() or 1)
+            self._feed_exec = ThreadPoolExecutor(
+                max_workers=n_thr,
+                thread_name_prefix=f"{self.name}.verify",
+            )
+            self.inflight_max = max(self.inflight_max, n_thr)
+        self._feed = True
+        self._feed_started = False
+        self._feed_stop = _threading.Event()
+        self._feed_thread: Optional[_threading.Thread] = None
+        self._feed_slot = None          # current FILLING slot (stager-owned)
+        self._feed_idle_mark = 0        # dispatcher idle-window anchor
+
     def poll_inputs(self):
+        if self._feed:
+            return self._feed_poll()
         if not self._nd:
             return super().poll_inputs()
         il = self.in_link
@@ -877,6 +1051,8 @@ class VerifyTile(Tile):
             self._nd_offs.ctypes.data, self._nd_plens.ctypes.data,
             self._nd_psigs.ctypes.data,
             self._nd_tlanes.ctypes.data, self._nd_tsorig.ctypes.data,
+            *([self._nd_tspub.ctypes.data, self._nd_hash.ctypes.data]
+              if self._nd_abi2 else []),
             self._nd_counters.ctypes.data,
         )
         overrun = False
@@ -929,6 +1105,371 @@ class VerifyTile(Tile):
         self._complete(block=False)
         return True, overrun
 
+    # -- fd_feed: stager thread + slot dispatcher ------------------------
+
+    def _feed_start(self) -> None:
+        import threading as _threading
+
+        self._feed_started = True
+        self._feed_stager_err: Optional[BaseException] = None
+
+        def _guarded():
+            try:
+                self._stager_loop()
+            except BaseException as e:  # propagate to the dispatcher
+                self._feed_stager_err = e
+
+        t = _threading.Thread(
+            target=_guarded, name=f"{self.name}.stager", daemon=True
+        )
+        self._feed_thread = t
+        t.start()
+
+    def _stager_drain(self, slot) -> int:
+        """One fd_verify_drain round into `slot` at its current fill
+        cursors. Per-txn bookkeeping stays in the slot's numpy sidecar
+        arrays (offs converted to absolute arena offsets) — the only
+        per-txn Python here is the HA-tcache insert of the drain's FNV
+        tag. Returns staged txn count; updates diag counters."""
+        il = self.in_link
+        ct = self._nd_ct
+        k0 = slot.n_txn
+        seq = ct.c_uint64(il.seq)
+        n = self._nd_lib.fd_verify_drain(
+            il.mcache._mem, ct.addressof(il.dcache._buf),
+            ct.byref(seq),
+            self.batch - k0, self.batch - slot.n_lane,
+            self.batch, self.max_msg_len,
+            slot.msgs.ctypes.data + slot.n_lane * self.max_msg_len,
+            slot.lens.ctypes.data + slot.n_lane * 4,
+            slot.sigs.ctypes.data + slot.n_lane * 64,
+            slot.pubs.ctypes.data + slot.n_lane * 32,
+            slot.pay.ctypes.data + slot.pay_fill,
+            slot.pay.nbytes - slot.pay_fill,
+            slot.offs.ctypes.data + k0 * 4,
+            slot.plens.ctypes.data + k0 * 4,
+            slot.psigs.ctypes.data + k0 * 8,
+            slot.tlanes.ctypes.data + k0 * 4,
+            slot.tsorigs.ctypes.data + k0 * 4,
+            slot.tspubs.ctypes.data + k0 * 4,
+            slot.hashes.ctypes.data + k0 * 8,
+            self._nd_counters.ctypes.data,
+        )
+        d = self._nd_counters - self._nd_prev
+        self._nd_prev = self._nd_counters.copy()
+        if d[1] or d[3]:  # parse errors + oversize -> sv filter diag
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, int(d[1] + d[3]))
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, int(d[4] + d[5]))
+        if d[2]:
+            il.fseq.diag_add(DIAG_OVRNR_CNT, int(d[2]))
+        if n <= 0:
+            il.seq = seq.value  # consumed-but-unstageable (errors) frags
+            if (
+                slot.n_txn == 0 and not self._inflight
+                and self.feed_pool.idle()
+            ):
+                # Everything consumed is fully handled: nothing staged in
+                # this slot, no READY backlog, nothing on the device. New
+                # staged work can only come from THIS thread, so the ack
+                # fast-path cannot race a dispatch.
+                self._acked_seq = il.seq
+            return 0
+        now = tempo.tickcount()
+        if k0 == 0:
+            slot.t_first = now  # deadline anchor: first STAGED txn
+        # Ring dwell (producer publish -> this drain) of the round's
+        # oldest frag: the feeder's input-backlog gauge (reported as
+        # stage latency). tspub is a 32-bit tick; reject absurd dwells
+        # (> ~4 s) as wrap artifacts. Dwell is NOT folded into the
+        # flush deadline: with a backlog the next round fills the batch
+        # in O(ms) anyway, and turning old-but-plentiful input into
+        # partial flushes would trade fill ratio for nothing.
+        dwell = (now - int(slot.tspubs[k0])) & 0xFFFFFFFF
+        if dwell < 4_000_000_000 and len(self.stat_ring_dwell_ns) < 65536:
+            self.stat_ring_dwell_ns.append(dwell)
+        # Offsets came back relative to the round's arena base; make
+        # them absolute so the completion's bulk publish can read every
+        # round of this slot with one base pointer.
+        slot.offs[k0 : k0 + n] += slot.pay_fill
+        # HA dedup on the drain's whole-payload FNV tags — the only
+        # per-txn Python in the feeder (~1 us/txn); duplicates keep
+        # their staged lanes but are masked out of the publish.
+        ha_filt_cnt = 0
+        ha_filt_sz = 0
+        hashes = slot.hashes[k0 : k0 + n].tolist()
+        insert = self.ha_tcache.insert
+        for i, h in enumerate(hashes):
+            if insert(h):
+                k = k0 + i
+                slot.ha_mask[k] = True
+                ha_filt_cnt += 1
+                ha_filt_sz += int(slot.plens[k])
+        if ha_filt_cnt:
+            self.cnc.diag_add(CNC_DIAG_HA_FILT_CNT, ha_filt_cnt)
+            self.cnc.diag_add(CNC_DIAG_HA_FILT_SZ, ha_filt_sz)
+        last = k0 + n - 1
+        slot.pay_fill = int(slot.offs[last]) + int(slot.plens[last])
+        slot.n_lane += int(slot.tlanes[k0 : k0 + n].sum())
+        slot.n_txn += n
+        slot.drain_end = seq.value
+        # Consumed-seq marker only AFTER the txns are visible in the
+        # slot (n_txn above): the quiescence check and the ack fast
+        # path read both from other threads, and seq-first would open a
+        # consumed-but-invisible window where the pipeline looks
+        # drained while staged txns exist.
+        il.seq = seq.value
+        return n
+
+    def _stager_loop(self) -> None:
+        """fd_feed stager: drain the in-ring into slot arenas and hand
+        full (or flush-due partial) slots to the dispatcher. Everything
+        per-frag — seqlock'd ring drain, parse, payload copy, HA dedup —
+        lives here, OFF the dispatch thread; the drain itself is one
+        GIL-releasing C call per round."""
+        pool = self.feed_pool
+        il = self.in_link
+        idle_spins = 0
+        while not self._feed_stop.is_set():
+            slot = self._feed_slot
+            if slot is None:
+                slot = pool.acquire(0.05)  # stalls counted by the pool
+                if slot is None:
+                    continue
+                self._feed_slot = slot
+            seq_before = il.seq
+            n = self._stager_drain(slot)
+            if slot.n_lane >= self.batch:
+                self._feed_commit(slot)
+                idle_spins = 0
+                continue
+            if n > 0:
+                idle_spins = 0
+                continue
+            if (slot.n_txn and il.seq == seq_before
+                    and self.batch - slot.n_lane < MAX_SIG_CNT
+                    and il.mcache.seq_next() > il.seq):
+                # Capacity-blocked, not starved: the ring head is a
+                # multisig txn that cannot fit the remaining lane room.
+                # Ship the slot as effectively-full instead of letting
+                # the deadline timer misbook a 25 ms stall per batch.
+                self._feed_commit(slot)
+                idle_spins = 0
+                continue
+            if slot.n_txn:
+                if self._ring_starved():
+                    # Held-back acks are about to exhaust the producer's
+                    # credits: a partial batch beats a stalled pipeline
+                    # (uncounted force, matching the legacy path).
+                    self._feed_commit(slot)
+                    continue
+                verdict = self.flush_policy.due(
+                    tempo.tickcount(), slot.n_lane, self.batch,
+                    slot.t_first, starved=True,
+                    device_idle=(not self._inflight
+                                 and pool.ready_cnt() == 0),
+                    backpressured=self.out_link.fctl.probe(
+                        self.out_link.seq) <= 0,
+                )
+                if verdict is not None:
+                    if verdict == FLUSH_DEADLINE:
+                        self.stat_flush_timeout += 1
+                    elif verdict == FLUSH_STARVED:
+                        self.stat_flush_starved += 1
+                    self._feed_commit(slot)
+                    continue
+            # Empty drain round: sleep IMMEDIATELY rather than hot-spin.
+            # The feeder works at batch granularity (a cpu batch is
+            # ~20 ms of verify), so a 100 us reaction lag is free — while
+            # a spinning stager holds the GIL in ~5 ms scheduler quanta
+            # and starves the in-process source publisher, which was
+            # measured to cost more end-to-end than the device idle it
+            # was trying to avoid.
+            idle_spins += 1
+            time.sleep(20e-6 if idle_spins <= 8 else 100e-6)
+
+    def _feed_commit(self, slot) -> None:
+        self._feed_slot = None
+        self.feed_pool.commit(slot)
+
+    def _feed_dispatch(self, slot) -> None:
+        """Ship one READY slot to the verify engine and record the
+        in-flight batch. The slot stays attached to the batch until it
+        retires — the completion publishes straight out of its sidecar
+        arrays (fd_frag_publish_bulk) — so the stager refills OTHER
+        slots while this one verifies."""
+        if slot.n_lane < self.batch:
+            # Zero the stale tail rows exactly like _dispatch_py's pad
+            # lanes (zero sig/pub/len): a previous batch's leftovers in
+            # the arena must never verify — and under rlc they would
+            # poison the batch equation into a permanent fallback.
+            slot.lens[slot.n_lane:] = 0
+            slot.sigs[slot.n_lane:] = 0
+            slot.pubs[slot.n_lane:] = 0
+        if self.backend == "cpu":
+            from firedancer_tpu.ballet.ed25519 import native as ed_native
+
+            out = _FutureBatch(self._feed_exec.submit(
+                ed_native.verify_arrays,
+                slot.msgs, slot.lens, slot.sigs, slot.pubs, slot.n_lane,
+            ))
+        else:
+            jnp = self._jnp
+            out = self._verify_batch_fn(
+                jnp.asarray(slot.msgs),
+                jnp.asarray(slot.lens.astype(np.int32)),
+                jnp.asarray(slot.sigs),
+                jnp.asarray(slot.pubs),
+            )
+        self._inflight.append(_InflightBatch(
+            out=out, todo=[], oversize=[False] * self.batch,
+            t_dispatch=tempo.tickcount(), slot=slot,
+        ))
+        self.stat_batches += 1
+        self.stat_lanes += slot.n_lane
+
+    def _publish_feed_batch(self, slot, statuses) -> int:
+        """Completion half of the feeder: fold per-lane statuses to
+        per-txn verdicts (numpy reduceat over the slot's lane counts)
+        and publish every passing, non-HA-duplicate txn downstream with
+        ONE bulk native call per credit window. Returns the batch's ack
+        target (the in-ring seq after the slot's last drain round)."""
+        n = slot.n_txn
+        if n == 0:
+            return slot.drain_end
+        lanes = slot.tlanes[:n].astype(np.int64)
+        starts = np.zeros(n, np.int64)
+        np.cumsum(lanes[:-1], out=starts[1:])
+        bad = (np.asarray(statuses)[: slot.n_lane] != 0).astype(np.int32)
+        anybad = np.add.reduceat(bad, starts) > 0
+        ha = slot.ha_mask[:n]
+        ok = ~anybad & ~ha
+        sv = anybad & ~ha
+        sv_cnt = int(sv.sum())
+        if sv_cnt:
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, sv_cnt)
+            self.cnc.diag_add(
+                CNC_DIAG_SV_FILT_SZ, int(slot.plens[:n][sv].sum()))
+        n_ok = int(ok.sum())
+        if not n_ok:
+            return slot.drain_end
+        mask8 = ok.astype(np.uint8)
+        ol = self.out_link
+        ct = self._nd_ct
+        seqv = ct.c_uint64(ol.seq)
+        chunkv = ct.c_uint32(ol.chunk)
+        cursor = ct.c_uint32(0)
+        bytes_out = np.zeros(1, np.uint64)
+        now32 = tempo.tickcount() & 0xFFFFFFFF
+        published = 0
+        halted = False
+        while published < n_ok and not halted:
+            # Credit-windowed bulk publish: same fctl discipline as
+            # publish_backp (spin through backpressure, drop on HALT),
+            # amortized over the window instead of paid per frag.
+            while not ol.can_publish():
+                if self.cnc.signal_query() == CNC_HALT:
+                    halted = True  # drop the rest, like publish_backp
+                    break
+                self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
+                time.sleep(20e-6)
+            if halted:
+                break
+            pub = self._nd_lib.fd_frag_publish_bulk(
+                ol.mcache._mem, ct.addressof(ol.dcache._buf),
+                ol.dcache.chunk_cnt, ol.mtu,
+                ct.byref(seqv), ct.byref(chunkv),
+                slot.pay.ctypes.data,
+                slot.offs.ctypes.data, slot.plens.ctypes.data,
+                slot.psigs.ctypes.data, slot.tsorigs.ctypes.data,
+                mask8.ctypes.data, ct.byref(cursor), n,
+                min(ol.cr_avail, n_ok - published), now32,
+                bytes_out.ctypes.data,
+            )
+            ol.seq = seqv.value
+            ol.chunk = chunkv.value
+            ol.cr_avail = max(0, ol.cr_avail - pub)
+            published += pub
+            if pub <= 0:
+                break  # defensive: cursor exhausted without publishes
+        il = self.in_link
+        il.fseq.diag_add(DIAG_PUB_CNT, published)
+        il.fseq.diag_add(DIAG_PUB_SZ, int(bytes_out[0]))
+        # Stage-latency reservoir (OutLink.publish is bypassed on the
+        # bulk path): same Algorithm-R insert per sample, so long-soak
+        # percentiles stay run-representative, not warmup-biased.
+        ts = slot.tsorigs[:n][ok]
+        ts = ts[ts != 0]
+        if ts.size:
+            lats = (now32 - ts.astype(np.int64)) & 0xFFFFFFFF
+            for lat in lats.tolist():
+                ol.lat_sample(lat)
+        return slot.drain_end
+
+    def _feed_poll(self):
+        """Dispatcher round (the feed-mode poll_inputs): retire one
+        completion, ship every READY slot up to the in-flight cap, and
+        account device idleness (nothing in flight AND nothing READY =
+        the engine is starving — the gauge this subsystem exists to
+        drive to zero)."""
+        if not self._feed_started:
+            self._feed_start()
+        if self._feed_stager_err is not None:
+            # A dead stager is a dead feeder: re-raise on the dispatch
+            # thread so the tile fails loudly instead of the pipeline
+            # quiescing empty at timeout.
+            raise RuntimeError(
+                "fd_feed stager thread died"
+            ) from self._feed_stager_err
+        self._complete(block=False)
+        progressed = False
+        while len(self._inflight) < self.inflight_max:
+            slot = self.feed_pool.pop_ready()
+            if slot is None:
+                break
+            self._feed_dispatch(slot)
+            progressed = True
+        now = tempo.tickcount()
+        if self.stat_batches and not self._inflight \
+                and self.feed_pool.ready_cnt() == 0:
+            if self._feed_idle_mark:
+                self.stat_feed_idle_ns += now - self._feed_idle_mark
+            self._feed_idle_mark = now
+        else:
+            self._feed_idle_mark = 0
+        if not progressed:
+            # Same GIL-citizenship as the stager: the dispatcher has
+            # nothing until a slot commits (>= one drain round away) or
+            # a device batch completes — don't hot-spin the run loop at
+            # the source publisher's expense. Completions of an ALREADY
+            # in-flight batch are polled on a shorter nap.
+            time.sleep(50e-6 if self._inflight else 100e-6)
+        return progressed, False
+
+    def _publish_feed_diag(self) -> None:
+        """Mirror the feeder/dispatch stats into the CNC_DIAG_FEED_*
+        gauges (delta-published like the UNACKED gauge) so monitors and
+        the supervisor see them through shared memory. Legacy tiles
+        publish too (zeroed slot stalls); 16-slot ABI only."""
+        if not self._feed_diag_ok:
+            return
+        vals = (
+            self.stat_batches, self.stat_lanes, self.stat_flush_timeout,
+            self.stat_flush_starved,
+            self.feed_pool.slot_stall if self._feed else 0,
+            self.stat_feed_idle_ns,
+        )
+        for i, (slot_idx, v) in enumerate(zip(
+            (CNC_DIAG_FEED_BATCHES, CNC_DIAG_FEED_LANES,
+             CNC_DIAG_FEED_DEADLINE, CNC_DIAG_FEED_STARVED,
+             CNC_DIAG_FEED_SLOT_STALL, CNC_DIAG_FEED_IDLE_NS),
+            vals,
+        )):
+            if v != self._feed_diag_mirror[i]:
+                self.cnc.diag_add(
+                    slot_idx, (v - self._feed_diag_mirror[i]) & _U64
+                )
+                self._feed_diag_mirror[i] = v
+
     def _dispatch_native(self, force: bool = False) -> None:
         jnp = self._jnp
         if not self._pending:
@@ -948,6 +1489,14 @@ class VerifyTile(Tile):
                 self._nd_pubs, self._pending_lanes,
             ))
         else:
+            if self._pending_lanes < self.batch:
+                # Stale rows from the previous batch must verify as pad
+                # lanes (zero sig/pub/len — _dispatch_py's padding), not
+                # as leftover signatures: under rlc a stale lane poisons
+                # the whole-batch equation into a permanent fallback.
+                self._nd_lens[self._pending_lanes:] = 0
+                self._nd_sigs[self._pending_lanes:] = 0
+                self._nd_pubs[self._pending_lanes:] = 0
             out = self._verify_batch_fn(
                 jnp.asarray(self._nd_msgs.copy()),
                 jnp.asarray(self._nd_lens.astype(np.int32)),
@@ -955,6 +1504,7 @@ class VerifyTile(Tile):
                 jnp.asarray(self._nd_pubs.copy()),
             )
         todo = self._pending
+        self.stat_lanes += self._pending_lanes
         self._pending = []
         self._pending_lanes = 0
         self._nd_pay_fill = 0
@@ -1048,27 +1598,45 @@ class VerifyTile(Tile):
             il.seq - self._acked_seq >= max(1, il.mcache.depth - 64)
         )
 
-    def _flush_if_due(self) -> None:
+    def _flush_if_due(self, starved: bool = False) -> None:
         """Dispatch a staged batch when it is full, when the held-back
-        ack cursor is about to starve the producer's credits, or when the
-        oldest staged txn has waited past max_wait_us. Called from every
+        ack cursor is about to starve the producer's credits, or when
+        the adaptive policy says so (deadline expiry, or starved input
+        with an idle device — disco/feed/policy.py). Called from every
         path that can make progress without going idle (frag drain,
         filtered frags, housekeeping), so a continuous input stream can
-        never strand a partial batch (round-2 ADVICE finding)."""
-        if not self._pending:
+        never strand a partial batch (round-2 ADVICE finding). In feed
+        mode the stager owns flushing; this is a no-op."""
+        if self._feed or not self._pending:
             return
         if self._pending_lanes >= self.batch:
             self._dispatch()
-        elif self._ring_starved():
+            return
+        if self._ring_starved():
             self._dispatch(force=True)
-        elif tempo.tickcount() - self._pending_since >= self.max_wait_ns:
+            return
+        verdict = self.flush_policy.due(
+            tempo.tickcount(), self._pending_lanes, self.batch,
+            self._pending_since, starved=starved,
+            device_idle=not self._inflight,
+            # The housekeep-refreshed gauge, not a fresh fseq probe:
+            # this runs per frag on the Python path.
+            backpressured=bool(self.out_link.fctl.in_backpressure)
+            if self.out_link else False,
+        )
+        if verdict == FLUSH_DEADLINE:
             self.stat_flush_timeout += 1
             self._dispatch(force=True)
+        elif verdict == FLUSH_STARVED:
+            self.stat_flush_starved += 1
+            self._dispatch(force=True)
+        # FLUSH_FULL is unreachable here: the lanes >= batch case
+        # dispatched above, and this method is single-threaded.
 
     def on_idle(self) -> None:
         if self._inflight:
             self._complete(block=False)
-        self._flush_if_due()
+        self._flush_if_due(starved=True)
 
     def housekeep(self, now: int) -> None:
         # Publish the VERIFIED cursor, not the consumed one: a crash
@@ -1084,6 +1652,7 @@ class VerifyTile(Tile):
         for il in self.in_links:
             il.fseq.update(min(self._acked_seq, il.seq))
         self._publish_unacked()
+        self._publish_feed_diag()
         self._housekeep_out()
         self.on_housekeep()
 
@@ -1107,6 +1676,26 @@ class VerifyTile(Tile):
     def on_halt(self) -> None:
         # Drain device work so no async computation outlives the tile;
         # results are published best-effort (publish_backp drops on HALT).
+        if self._feed:
+            # Stop the stager first (it owns the in-ring cursor), then
+            # flush everything it staged: the leftover FILLING slot,
+            # every READY slot, and all in-flight batches.
+            self._feed_stop.set()
+            if self._feed_thread is not None:
+                self._feed_thread.join(timeout=10.0)
+            slot = self._feed_slot
+            if slot is not None and slot.n_txn:
+                self._feed_commit(slot)
+            while True:
+                s = self.feed_pool.pop_ready()
+                if s is None:
+                    break
+                self._feed_dispatch(s)
+            self._complete(block=True, drain_all=True)
+            if self._feed_exec is not None:
+                self._feed_exec.shutdown(wait=True)
+            self._publish_feed_diag()
+            return
         if self._pending and (self.backend == "tpu" or self._nd):
             self._dispatch(force=True)
         self._complete(block=True, drain_all=True)
@@ -1173,6 +1762,7 @@ class VerifyTile(Tile):
                 t_dispatch=tempo.tickcount(),
             ))
             self.stat_batches += 1
+            self.stat_lanes += len(flat)
             del self._pending[:take]
             self._pending_lanes -= len(flat)
             if self._pending:
@@ -1188,29 +1778,42 @@ class VerifyTile(Tile):
             statuses = np.asarray(ib.out)  # blocks only if not ready
             if getattr(ib.out, "used_fallback", False):
                 self.stat_rlc_fallback += 1
-            off = 0
-            batch_ack = 0
-            for payload, cnt, tsorig, seq_end in ib.todo:
-                batch_ack = max(batch_ack, seq_end)
-                if payload is None:  # HA-filtered post-staging (native)
+            if ib.slot is not None:
+                # fd_feed batch: verdicts + publishes straight off the
+                # slot's sidecar arrays (one bulk native call).
+                batch_ack = self._publish_feed_batch(ib.slot, statuses)
+            else:
+                off = 0
+                batch_ack = 0
+                for payload, cnt, tsorig, seq_end in ib.todo:
+                    batch_ack = max(batch_ack, seq_end)
+                    if payload is None:  # HA-filtered post-staging
+                        off += cnt
+                        continue
+                    lane = statuses[off : off + cnt]
+                    over = any(ib.oversize[off : off + cnt])
+                    ok = cnt > 0 and not over and bool((lane == 0).all())
+                    self._finish(payload, ok, tsorig=tsorig)
                     off += cnt
-                    continue
-                lane = statuses[off : off + cnt]
-                over = any(ib.oversize[off : off + cnt])
-                ok = cnt > 0 and not over and bool((lane == 0).all())
-                self._finish(payload, ok, tsorig=tsorig)
-                off += cnt
             # Pop only AFTER the batch's results are published: the
             # supervisor's quiescence check reads _inflight from another
             # thread, and popping first opens a window where the
             # pipeline looks drained, HALT lands, and publish_backp
             # drops this batch's output.
             self._inflight.pop(0)
+            if ib.slot is not None:
+                self.feed_pool.release(ib.slot)
             # Batches retire in dispatch order, so the newest seq carried
             # by this batch is now fully verified and ackable; with the
-            # device idle, everything consumed is.
+            # device idle, everything consumed is. In feed mode "device
+            # idle" must also mean the STAGER holds nothing: frags
+            # consumed into a slot but not yet dispatched are exactly
+            # the crash window the held-back ack protects (the stager
+            # makes staged txns visible — slot.n_txn — BEFORE advancing
+            # il.seq, so this check cannot race past them).
             self._acked_seq = max(self._acked_seq, batch_ack)
-            if not self._pending and not self._inflight and self.in_link:
+            if (not self._pending and not self._inflight and self.in_link
+                    and (not self._feed or self.feed_pool.idle())):
                 self._acked_seq = self.in_link.seq
             if not drain_all:
                 return  # retire at most one per call; keep the loop hot

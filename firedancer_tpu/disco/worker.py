@@ -98,11 +98,18 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--wksp", required=True)
     ap.add_argument("--pod", required=True)
+    # One tile name, or a comma list ("dedup,pack,sink") to run several
+    # tiles on threads in ONE interpreter — the fd_feed downstream pool,
+    # where per-process boot cost (imports) dwarfs the GIL sharing of
+    # three per-frag Python stages.
     ap.add_argument("--tile", required=True)
     ap.add_argument("--opts", default="{}")
     ap.add_argument("--max-ns", type=int, default=600_000_000_000)
     ap.add_argument("--result", default="")
     args = ap.parse_args(argv)
+
+    tile_names = [t for t in args.tile.split(",") if t]
+    multi = len(tile_names) > 1
 
     opts_early = json.loads(args.opts)
     plat = opts_early.get("jax_platform")
@@ -112,10 +119,12 @@ def main(argv=None) -> int:
     # supervisor's run budget (and the judge's patience) drains before
     # the first frag moves. replay/dedup/pack/sink never touch jax
     # (pack only under scheduler="gc").
-    _needs_jax = (
-        args.tile.startswith("verify")
-        and opts_early.get("verify_backend") == "tpu"
-    ) or (args.tile == "pack" and opts_early.get("pack_scheduler") == "gc")
+    _needs_jax = any(
+        (t.startswith("verify")
+         and opts_early.get("verify_backend") == "tpu")
+        or (t == "pack" and opts_early.get("pack_scheduler") == "gc")
+        for t in tile_names
+    )
     if plat and _needs_jax:
         # Workers don't run the test conftest, and this image's
         # sitecustomize force-registers the TPU plugin via jax.config
@@ -185,37 +194,104 @@ def main(argv=None) -> int:
 
     from firedancer_tpu.tango import tempo
 
-    cnc = Cnc(wksp, pod.query_cstr(f"firedancer.{args.tile}.cnc"))
+    cncs = [Cnc(wksp, pod.query_cstr(f"firedancer.{t}.cnc"))
+            for t in tile_names]
     boot_done = threading.Event()
 
     def _boot_beat():
         while not boot_done.is_set():
-            cnc.heartbeat(tempo.tickcount())
+            for cnc in cncs:
+                cnc.heartbeat(tempo.tickcount())
             boot_done.wait(0.5)
 
     beat = threading.Thread(target=_boot_beat, daemon=True)
     beat.start()
     try:
-        tile = build_tile(wksp, pod, args.tile, opts)
+        tiles = [build_tile(wksp, pod, t, opts) for t in tile_names]
     finally:
         boot_done.set()
         beat.join(timeout=2.0)
-    if opts.get("cpu_idx") is not None:
-        tile.cpu_idx = int(opts["cpu_idx"])
-    tile.run(args.max_ns)
+    cpu_map = opts.get("cpu_map") or {}
+    for name, tile in zip(tile_names, tiles):
+        if name in cpu_map:
+            tile.cpu_idx = int(cpu_map[name])
+        elif opts.get("cpu_idx") is not None:
+            tile.cpu_idx = int(opts["cpu_idx"])
+    if multi:
+        # Several per-frag tiles share this interpreter: the default
+        # 5 ms GIL switch interval turns every ring hop into a
+        # scheduler-quantum stall (a tile hot-spinning its drain holds
+        # the GIL while its downstream neighbor starves). 100 us keeps
+        # the intra-process pipeline latency at ring-hop scale.
+        sys.setswitchinterval(1e-4)
+        # A tile thread dying must take the WORKER down with a nonzero
+        # rc: the feed runtime's liveness check watches the process,
+        # and a dedup crash that left this process idling at rc=0
+        # would burn the whole pipeline timeout looking healthy.
+        errors = []
 
-    if args.result and args.tile == "sink":
+        def _guarded(tile):
+            try:
+                tile.run(args.max_ns)
+            except BaseException:
+                import traceback
+
+                traceback.print_exc()
+                errors.append(tile.name)
+                from firedancer_tpu.tango.rings import CNC_HALT
+
+                for c in cncs:  # take the sibling tiles down too
+                    c.signal(CNC_HALT)
+
+        tile_threads = [
+            threading.Thread(target=_guarded, args=(t,),
+                             name=t.name, daemon=True)
+            for t in tiles
+        ]
+        for th in tile_threads:
+            th.start()
+        for th in tile_threads:
+            th.join()
+        if errors:
+            print(f"worker: tile(s) died: {errors}", file=sys.stderr)
+            return 1
+    else:
+        tiles[0].run(args.max_ns)
+
+    def _sink_result(tile) -> dict:
         lat = sorted(tile.latencies_ns)
+        return {
+            "recv_cnt": tile.recv_cnt,
+            "recv_sz": tile.recv_sz,
+            "bank_hist": {str(k): v for k, v in tile.bank_hist.items()},
+            "latency_p50_ns": lat[len(lat) // 2] if lat else 0,
+            "latency_p99_ns": lat[(len(lat) * 99) // 100] if lat else 0,
+            "digests": [d.hex() for d in tile.digests]
+            if getattr(tile, "digests", None) is not None else None,
+        }
+
+    if args.result and not multi and tile_names[0] == "sink":
+        # Single-tile sink: the supervisor's result schema, unchanged.
         with open(args.result, "w") as f:
-            json.dump({
-                "recv_cnt": tile.recv_cnt,
-                "recv_sz": tile.recv_sz,
-                "bank_hist": {str(k): v for k, v in tile.bank_hist.items()},
-                "latency_p50_ns": lat[len(lat) // 2] if lat else 0,
-                "latency_p99_ns": lat[(len(lat) * 99) // 100] if lat else 0,
-                "digests": [d.hex() for d in tile.digests]
-                if getattr(tile, "digests", None) is not None else None,
-            }, f)
+            json.dump(_sink_result(tiles[0]), f)
+    elif args.result and multi:
+        # Multi-tile (fd_feed downstream pool): one json keyed by tile,
+        # each with its out-link tsorig->tspub percentiles (the
+        # per-stage latency budget of docs/LATENCY.md); the sink section
+        # keeps the supervisor schema plus the e2e reservoir.
+        from firedancer_tpu.disco.feed.runtime import latency_percentiles
+
+        out = {}
+        for name, tile in zip(tile_names, tiles):
+            d = {}
+            if tile.out_link is not None:
+                d["pub_lat"] = latency_percentiles(tile.out_link.lat_ns)
+            if name == "sink":
+                d.update(_sink_result(tile))
+                d["e2e_lat"] = latency_percentiles(tile.latencies_ns)
+            out[name] = d
+        with open(args.result, "w") as f:
+            json.dump(out, f)
     return 0
 
 

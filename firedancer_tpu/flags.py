@@ -209,6 +209,70 @@ _register(
 )
 
 # --------------------------------------------------------------------------
+# fd_feed ingest-runtime knobs (disco/feed/ — the host-side feeder that
+# overlaps parse/dedup/staging with device verify; all read per run).
+# --------------------------------------------------------------------------
+
+_register(
+    "FD_FEED", bool, True,
+    "Route run_pipeline through the fd_feed ingest runtime (staging-slot "
+    "feeder + downstream worker process) when the topology supports it "
+    "(single verify lane, cpu|tpu backend, batch >= MAX_SIG_CNT, native "
+    "drain built). '0' pins the legacy in-process step loop for "
+    "bisection; unsupported topologies fall back to it automatically.",
+)
+_register(
+    "FD_FEED_SLOTS", int, 4,
+    "Staging slots per verify lane: preallocated host arenas filled by "
+    "the stager thread while earlier batches are on the device. 2 is "
+    "the minimum for fill/dispatch overlap; cpu-backend batches hold "
+    "their slot until the verify call retires, so the default leaves "
+    "FD_FEED_VERIFY_THREADS in flight plus one filling plus one ready. "
+    "Cost: (batch x MTU) host bytes per slot.",
+)
+_register(
+    "FD_FEED_DEADLINE_US", int, 25_000,
+    "Partial-batch latency deadline for the adaptive flush policy "
+    "(VerifyTile default when the caller does not pass max_wait_us): a "
+    "staged partial batch is ALWAYS dispatched within this bound, "
+    "anchored at the oldest txn's STAGING time (ring dwell is reported "
+    "separately as the verify_drain stage latency, not charged to the "
+    "flush deadline). Steady-state traffic fills batches long before "
+    "the deadline, so deadline flushes ~= 0 (the ROADMAP round-6 "
+    "flush_timeout gate); an input-starved partial with an idle device "
+    "flushes after deadline/16 instead of waiting the full budget.",
+)
+_register(
+    "FD_RINGS_PYDLL", bool, True,
+    "Route the nanosecond-scale ring ops (mcache publish/poll, fseq, "
+    "cnc, next_chunk) through a GIL-HOLDING ctypes handle (PyDLL). The "
+    "seed's CDLL handle released the GIL around every ring op, costing "
+    "a scheduler handoff (~100-700 us under thread contention) per "
+    "~100 ns op — the dominant host-pipeline cost before round 8. '0' "
+    "restores the seed behavior for A/B and bisection; bulk drains and "
+    "batch verifies always release the GIL regardless.",
+)
+_register(
+    "FD_FEED_VERIFY_THREADS", int, 0,
+    "CPU-backend verify executor width for the fd_feed dispatcher: N "
+    "concurrent GIL-releasing fd_ed25519_cpu_verify_batch calls over "
+    "READY slots (the host-verifier analog of keeping several device "
+    "batches in flight). 0 = auto (min(2, cpu_count)); 1 pins the "
+    "serial dispatch.",
+)
+_register(
+    "FD_FEED_PROC", str, "auto",
+    "fd_feed worker-pool placement: '1' runs source + dedup/pack/sink "
+    "in worker processes (tango shm rings across process boundaries), "
+    "'0' keeps them on in-process threads, 'auto' picks processes only "
+    "when the host has >= 4 cores (on a 2-core host the extra "
+    "interpreters cost more in boot + oversubscription than the GIL "
+    "they dodge — measured 3401 vs 753 txn/s at n=2180). The feeder "
+    "slots and adaptive flush are active either way.",
+    choices=("auto", "1", "0"),
+)
+
+# --------------------------------------------------------------------------
 # bench.py ladder knobs (orchestrator + workers).
 # --------------------------------------------------------------------------
 

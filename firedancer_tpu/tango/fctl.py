@@ -69,6 +69,19 @@ class Fctl:
         self._rx.append(_Rx(seq_query, slow_attr))
         return self
 
+    def probe(self, tx_seq: int) -> int:
+        """Side-effect-free credit query: how many credits a refresh at
+        `tx_seq` would yield right now. Unlike tx_cr_update this neither
+        mutates hysteresis state nor attributes slow consumers — it is
+        the read-only signal fd_feed's flush policy uses ("is the out
+        link backpressured?") without perturbing the producer's own
+        credit accounting from another thread."""
+        cr_query = self.cr_max
+        for rx in self._rx:
+            cr = self.cr_max - _seq_diff(tx_seq, rx.seq_query())
+            cr_query = min(cr_query, max(0, min(self.cr_max, cr)))
+        return cr_query
+
     def tx_cr_update(self, cr_avail: int, tx_seq: int) -> int:
         """Housekeeping refresh (fd_fctl_tx_cr_update): recompute credits
         from the slowest reliable consumer. Returns new cr_avail."""

@@ -131,7 +131,7 @@ def load_lib() -> ctypes.CDLL:
     lib.fd_txn_parse_check.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
                                        ctypes.c_void_p]
     lib.fd_verify_drain.restype = ctypes.c_int
-    lib.fd_verify_drain.argtypes = [
+    _vd_argt = [
         ctypes.c_void_p, ctypes.c_void_p,                   # mcache, dcache
         ctypes.POINTER(ctypes.c_uint64),                    # seq_io
         ctypes.c_uint32, ctypes.c_uint32,                   # txns, room
@@ -143,6 +143,29 @@ def load_lib() -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_void_p,                   # lanes, tsorig
         ctypes.c_void_p,                                    # counters
     ]
+    if hasattr(lib, "fd_verify_drain_abi2"):
+        # Current ABI: the drain exports the producer's publish stamp
+        # (fd_feed's ring-dwell gauge) and the FNV-1a payload hash (the
+        # HA-dedup tag) per staged txn. A stale .so keeps the v1 call
+        # shape.
+        _vd_argt.insert(len(_vd_argt) - 1, ctypes.c_void_p)  # tspubs
+        _vd_argt.insert(len(_vd_argt) - 1, ctypes.c_void_p)  # hashes
+    lib.fd_verify_drain.argtypes = _vd_argt
+    if hasattr(lib, "fd_frag_publish_bulk"):
+        lib.fd_frag_publish_bulk.restype = ctypes.c_int
+        lib.fd_frag_publish_bulk.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,               # mcache, dcache
+            ctypes.c_uint32, ctypes.c_uint32,               # chunks, mtu
+            ctypes.POINTER(ctypes.c_uint64),                # seq_io
+            ctypes.POINTER(ctypes.c_uint32),                # chunk_io
+            ctypes.c_void_p, ctypes.c_void_p,               # payloads, offs
+            ctypes.c_void_p, ctypes.c_void_p,               # lens, sigs
+            ctypes.c_void_p, ctypes.c_void_p,               # tsorigs, mask
+            ctypes.POINTER(ctypes.c_uint32),                # txn_io
+            ctypes.c_uint32, ctypes.c_uint32,               # n_txn, max_pub
+            ctypes.c_uint32,                                # now32
+            ctypes.c_void_p,                                # bytes_out
+        ]
     if hasattr(lib, "fd_frag_drain"):  # absent in a stale build
         lib.fd_frag_drain.restype = ctypes.c_int
         argt = [
@@ -175,6 +198,53 @@ def lib() -> ctypes.CDLL:
     return _lib
 
 
+# Names whose C bodies are nanosecond-scale (one atomic or a handful of
+# word ops). These are called per frag on every hot path, and a CDLL
+# call RELEASES the GIL around the C body: with several pipeline
+# threads in one interpreter, every release is an invitation for the
+# scheduler to hand the GIL elsewhere and make the caller wait a full
+# switch quantum to continue — measured ~100-700 us per ring op under
+# contention, ~1000x the op itself, and the dominant cost of the whole
+# host pipeline. Routing them through PyDLL (C body runs WITH the GIL
+# held) makes a ring op cost a ring op again. Long-running calls (the
+# bulk drains, wksp create) stay on the CDLL handle so they genuinely
+# overlap with other threads.
+_HOT_FUNCS = (
+    "fd_mcache_depth", "fd_mcache_seq_next", "fd_mcache_publish",
+    "fd_mcache_poll", "fd_fseq_update", "fd_fseq_query",
+    "fd_fseq_diag_add", "fd_fseq_diag_get", "fd_cnc_signal",
+    "fd_cnc_signal_query", "fd_cnc_heartbeat", "fd_cnc_heartbeat_query",
+    "fd_cnc_diag_add", "fd_cnc_diag_get", "fd_dcache_next_chunk",
+)
+
+_pylib = None
+
+
+def pylib() -> ctypes.CDLL:
+    """GIL-holding handle for the fine-grained ring ops (see
+    _HOT_FUNCS). Prototypes are copied from the CDLL handle so the two
+    cannot drift. FD_RINGS_PYDLL=0 hands back the GIL-releasing CDLL
+    handle — the seed behavior — for A/B and bisection."""
+    global _pylib
+    if _pylib is None:
+        L = lib()  # ensures the .so is built + prototypes configured
+        from firedancer_tpu import flags
+
+        if not flags.get_bool("FD_RINGS_PYDLL"):
+            _pylib = L
+            return _pylib
+        pl = ctypes.PyDLL(_LIB_PATH)
+        for name in _HOT_FUNCS:
+            if not hasattr(L, name):
+                continue
+            src = getattr(L, name)
+            dst = getattr(pl, name)
+            dst.restype = src.restype
+            dst.argtypes = src.argtypes
+        _pylib = pl
+    return _pylib
+
+
 _native_ok: bool | None = None
 
 
@@ -198,6 +268,43 @@ def frag_drain_has_ctl() -> bool:
         return hasattr(lib(), "fd_frag_drain_has_ctl")
     except Exception:
         return False
+
+
+def verify_drain_abi2() -> bool:
+    """True when fd_verify_drain exports the per-txn publish stamp and
+    FNV payload hash (current ABI). A stale .so keeps the v1 call
+    shape; the legacy native staging path degrades gracefully and the
+    fd_feed runtime routing falls back to the legacy runner."""
+    try:
+        return hasattr(lib(), "fd_verify_drain_abi2")
+    except Exception:
+        return False
+
+
+def feed_abi_ok() -> bool:
+    """The fd_feed runtime's native surface: drain ABI v2 (tspub + HA
+    hash outputs) plus the bulk completion publisher. Absent on a stale
+    .so — run_pipeline then keeps the legacy step loop."""
+    try:
+        return verify_drain_abi2() and hasattr(lib(), "fd_frag_publish_bulk")
+    except Exception:
+        return False
+
+
+def cnc_diag_cap() -> int:
+    """Diag slots carried by the native cnc object: 16 on the current
+    ABI (fd_cnc_diag_cap marker), 8 on a stale .so. Writers of the
+    fd_feed feeder gauges (slots 8..) MUST check this — on an 8-slot
+    build those indices land out of bounds in the workspace, which is
+    shared-memory corruption, not a miscounted gauge."""
+    try:
+        L = lib()
+        if hasattr(L, "fd_cnc_diag_cap"):
+            L.fd_cnc_diag_cap.restype = ctypes.c_uint64
+            return int(L.fd_cnc_diag_cap())
+    except Exception:
+        pass
+    return 8
 
 
 class Alloc:
@@ -362,19 +469,19 @@ class MCache:
         else:
             off, _ = wksp.query(name)
             self._mem = wksp.laddr(off)
-        self.depth = lib().fd_mcache_depth(self._mem)
+        self.depth = pylib().fd_mcache_depth(self._mem)
 
     def seq_next(self) -> int:
-        return lib().fd_mcache_seq_next(self._mem)
+        return pylib().fd_mcache_seq_next(self._mem)
 
     def publish(self, seq: int, sig: int, chunk: int, sz: int, ctl: int,
                 tsorig: int = 0, tspub: int = 0):
-        lib().fd_mcache_publish(self._mem, seq, sig, chunk, sz, ctl,
+        pylib().fd_mcache_publish(self._mem, seq, sig, chunk, sz, ctl,
                                 tsorig, tspub)
 
     def poll(self, seq: int) -> tuple[int, Frag | None]:
         out = (ctypes.c_uint64 * 4)()
-        r = lib().fd_mcache_poll(self._mem, seq, ctypes.byref(out))
+        r = pylib().fd_mcache_poll(self._mem, seq, ctypes.byref(out))
         if r != POLL_FRAG:
             return r, None
         sig, b, ts, s = out
@@ -412,7 +519,7 @@ class DCache:
         return bytes(self._buf[o : o + sz])
 
     def next_chunk(self, chunk: int, sz: int, mtu: int) -> int:
-        return lib().fd_dcache_next_chunk(chunk, sz, (mtu + 63) // 64,
+        return pylib().fd_dcache_next_chunk(chunk, sz, (mtu + 63) // 64,
                                           self.chunk_cnt)
 
 
@@ -427,16 +534,16 @@ class FSeq:
             self._mem = wksp.laddr(off)
 
     def update(self, seq: int):
-        lib().fd_fseq_update(self._mem, seq)
+        pylib().fd_fseq_update(self._mem, seq)
 
     def query(self) -> int:
-        return lib().fd_fseq_query(self._mem)
+        return pylib().fd_fseq_query(self._mem)
 
     def diag_add(self, idx: int, delta: int):
-        lib().fd_fseq_diag_add(self._mem, idx, delta)
+        pylib().fd_fseq_diag_add(self._mem, idx, delta)
 
     def diag(self, idx: int) -> int:
-        return lib().fd_fseq_diag_get(self._mem, idx)
+        return pylib().fd_fseq_diag_get(self._mem, idx)
 
 
 class Cnc:
@@ -450,19 +557,19 @@ class Cnc:
             self._mem = wksp.laddr(off)
 
     def signal(self, sig: int):
-        lib().fd_cnc_signal(self._mem, sig)
+        pylib().fd_cnc_signal(self._mem, sig)
 
     def signal_query(self) -> int:
-        return lib().fd_cnc_signal_query(self._mem)
+        return pylib().fd_cnc_signal_query(self._mem)
 
     def heartbeat(self, now: int):
-        lib().fd_cnc_heartbeat(self._mem, now)
+        pylib().fd_cnc_heartbeat(self._mem, now)
 
     def heartbeat_query(self) -> int:
-        return lib().fd_cnc_heartbeat_query(self._mem)
+        return pylib().fd_cnc_heartbeat_query(self._mem)
 
     def diag_add(self, idx: int, delta: int):
-        lib().fd_cnc_diag_add(self._mem, idx, delta)
+        pylib().fd_cnc_diag_add(self._mem, idx, delta)
 
     def diag(self, idx: int) -> int:
-        return lib().fd_cnc_diag_get(self._mem, idx)
+        return pylib().fd_cnc_diag_get(self._mem, idx)

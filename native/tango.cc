@@ -451,8 +451,17 @@ enum { CNC_BOOT = 0, CNC_RUN = 1, CNC_HALT = 2, CNC_FAIL = 3 };
 struct cnc_obj {
   std::atomic<uint64_t> signal;
   std::atomic<uint64_t> heartbeat;
-  uint64_t diag[8];
+  // 16 diag slots (grown from 8 for the fd_feed feeder gauges). The
+  // capacity is queryable via fd_cnc_diag_cap so a Python layer running
+  // against a stale 8-slot .so can refuse to write the upper slots
+  // (writing them there would be out-of-bounds into the next wksp
+  // allocation, not a wrong counter).
+  uint64_t diag[16];
 };
+
+// ABI marker + capacity query: present iff this build carries the
+// 16-slot cnc diag region (fd_feed feeder gauges live in slots 8..).
+uint64_t fd_cnc_diag_cap() { return 16; }
 
 uint64_t fd_cnc_footprint() { return sizeof(cnc_obj); }
 void fd_cnc_init(void* mem) { new (mem) cnc_obj(); }
@@ -557,6 +566,52 @@ uint32_t fd_dcache_next_chunk(uint32_t chunk, uint32_t sz, uint32_t mtu_chunks,
   uint32_t next = chunk + ((sz + 63u) >> 6);
   if (next + mtu_chunks > data_sz_chunks) next = 0;
   return next;
+}
+
+// Bulk producer half of the fd_feed completion path: publish up to
+// max_pub mask-selected frags from a packed payload arena (the staging
+// slot's layout: txn i at offs[i], lens[i] bytes) in ONE call — dcache
+// copy + seqlock'd mcache publish + chunk walk all in C, so a verify
+// batch's completion costs the Python layer one call instead of one
+// publish round-trip per txn. The caller owns flow control: max_pub
+// must not exceed its credit budget. *txn_io advances over every
+// consumed entry (mask-skipped txns are consumed without publishing);
+// *chunk_io/*seq_io track the dcache walk and mcache seq exactly like
+// the per-frag publish. Returns the number of frags published and adds
+// their payload bytes into *bytes_out (fseq PUB_SZ accounting).
+int fd_frag_publish_bulk(void* mcache, void* dcache_base,
+                         uint32_t data_sz_chunks, uint32_t mtu,
+                         uint64_t* seq_io, uint32_t* chunk_io,
+                         const uint8_t* payloads, const uint32_t* offs,
+                         const uint32_t* lens, const uint64_t* sigs,
+                         const uint32_t* tsorigs, const uint8_t* mask,
+                         uint32_t* txn_io, uint32_t n_txn,
+                         uint32_t max_pub, uint32_t now32,
+                         uint64_t* bytes_out) {
+  uint32_t mtu_chunks = (mtu + 63u) >> 6;
+  uint64_t seq = *seq_io;
+  uint32_t chunk = *chunk_io;
+  uint32_t i = *txn_io;
+  uint32_t published = 0;
+  uint64_t bytes = 0;
+  while (i < n_txn && published < max_pub) {
+    if (!mask[i]) { i++; continue; }
+    uint32_t sz = lens[i];
+    std::memcpy((uint8_t*)dcache_base + (uint64_t)chunk * 64,
+                payloads + offs[i], sz);
+    fd_mcache_publish(mcache, seq, sigs[i], chunk, (uint16_t)sz,
+                      /*ctl=*/3 /* SOM|EOM */, tsorigs[i], now32);
+    chunk = fd_dcache_next_chunk(chunk, sz, mtu_chunks, data_sz_chunks);
+    seq++;
+    published++;
+    bytes += sz;
+    i++;
+  }
+  *seq_io = seq;
+  *chunk_io = chunk;
+  *txn_io = i;
+  if (bytes_out) *bytes_out += bytes;
+  return (int)published;
 }
 
 }  // extern "C"

@@ -170,6 +170,15 @@ int fd_txn_parse_check(const uint8_t *buf, uint32_t len, uint32_t *out5) {
 //                    is only the REMAINING room in the current batch
 //   txn_lanes      : (max_txns,) u32 — lanes (signatures) of txn i
 //   txn_tsorig     : (max_txns,) u32
+//   txn_tspub      : (max_txns,) u32 — the producer's publish stamp of
+//                    frag i (fd_feed's ring-dwell gauge: how long input
+//                    sat in the ring before staging)
+//   txn_hash       : (max_txns,) u64 — FNV-1a 64 over the whole payload
+//                    of txn i: the HA-dedup tag, computed here so the
+//                    feeder's Python side never has to materialize
+//                    payload bytes just to hash them
+//   (both v2 outputs are absent from stale builds — probe
+//    fd_verify_drain_abi2 before passing them)
 //   counters       : u64[6] {drained_ok, parse_err, overrun, oversize,
 //                    parse_err_bytes, oversize_bytes}
 //
@@ -179,6 +188,12 @@ int fd_txn_parse_check(const uint8_t *buf, uint32_t len, uint32_t *out5) {
 // consumed. Returns the number of staged txns; *seq_io advances past
 // every consumed frag. Stops early when lanes, txn, or payload capacity
 // would overflow, or the ring is empty.
+// ABI marker: fd_verify_drain grew the txn_tspub + txn_hash outputs
+// (two more arrays, before counters) — Python callers probe this
+// before passing them, so a stale .so keeps the old call shape (same
+// convention as fd_frag_drain_has_ctl).
+int fd_verify_drain_abi2(void) { return 2; }
+
 int fd_verify_drain(void *mcache, void *dcache_base, uint64_t *seq_io,
                     uint32_t max_txns, uint32_t max_lanes,
                     uint32_t hard_max_lanes, uint32_t max_msg_len,
@@ -188,6 +203,7 @@ int fd_verify_drain(void *mcache, void *dcache_base, uint64_t *seq_io,
                     uint32_t *payload_offs, uint32_t *payload_lens,
                     uint64_t *payload_sigs,
                     uint32_t *txn_lanes, uint32_t *txn_tsorig,
+                    uint32_t *txn_tspub, uint64_t *txn_hash,
                     uint64_t *counters) {
   auto *h = (mcache_hdr *)mcache;
   auto *line = (frag_meta *)((char *)mcache + sizeof(mcache_hdr));
@@ -211,6 +227,7 @@ int fd_verify_drain(void *mcache, void *dcache_base, uint64_t *seq_io,
     uint32_t chunk = m->chunk.load(std::memory_order_relaxed);
     uint16_t sz = m->sz.load(std::memory_order_relaxed);
     uint32_t tsorig = m->tsorig.load(std::memory_order_relaxed);
+    uint32_t tspub = m->tspub.load(std::memory_order_relaxed);
     // Copy the payload out BEFORE revalidating the seqlock.
     uint8_t tmp[MTU];
     uint32_t cp = sz <= MTU ? sz : MTU;
@@ -256,11 +273,22 @@ int fd_verify_drain(void *mcache, void *dcache_base, uint64_t *seq_io,
       lens[l] = msg_len;
     }
     std::memcpy(payloads + pay_off, tmp, cp);
+    // FNV-1a 64 over the WHOLE payload: the HA-dedup tag (same
+    // whole-payload coverage contract as the Python hash() it
+    // replaces — a corrupted copy of a pending txn must not shadow
+    // the valid original out of the tcache).
+    uint64_t hv = 0xcbf29ce484222325ULL;
+    for (uint32_t b = 0; b < cp; b++) {
+      hv ^= tmp[b];
+      hv *= 0x100000001b3ULL;
+    }
     payload_offs[n_txn] = pay_off;
     payload_lens[n_txn] = cp;
     payload_sigs[n_txn] = sig;
     txn_lanes[n_txn] = tv.sig_cnt;
     txn_tsorig[n_txn] = tsorig;
+    txn_tspub[n_txn] = tspub;
+    txn_hash[n_txn] = hv;
     pay_off += cp;
     n_lane += tv.sig_cnt;
     n_txn += 1;

@@ -1,0 +1,328 @@
+"""fd_feed — staging slots, adaptive flush policy, and runtime parity.
+
+Three layers, matching the subsystem's pieces: SlotPool unit tests
+(lifecycle / reuse / FIFO / backpressure accounting), AdaptiveFlush
+property tests (the deadline bound the ROADMAP gate leans on), and
+pipeline-level tests that the feed runtime and the legacy step loop
+produce IDENTICAL sink contents on the same corpus (content-exact
+parity, the only acceptable definition of "same pipeline").
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.disco.feed.policy import (
+    FLUSH_DEADLINE,
+    FLUSH_FULL,
+    FLUSH_STARVED,
+    AdaptiveFlush,
+)
+from firedancer_tpu.disco.feed.slots import FILLING, FREE, READY, Slot, SlotPool
+
+# ------------------------------------------------------------- slots -----
+
+
+def test_slot_pool_lifecycle_and_reuse():
+    pool = SlotPool(2, batch=8, max_msg_len=64)
+    s = pool.acquire(0.1)
+    other = pool.acquire(0.1)  # drain the free list so reuse is forced
+    assert s is not None and other is not None and s.state == FILLING
+    s.n_txn = 3
+    s.n_lane = 4
+    s.pay_fill = 100
+    s.ha_mask[1] = True
+    s.drain_end = 17
+    pool.commit(s)
+    assert s.state == READY and pool.ready_cnt() == 1
+    got = pool.pop_ready()
+    assert got is s
+    pool.release(got)
+    assert s.state == FREE
+    # reuse resets every cursor (the arenas themselves are reused)
+    s2 = pool.acquire(0.1)
+    assert s2 is s
+    assert s2.n_txn == 0 and s2.n_lane == 0 and s2.pay_fill == 0
+    assert not s2.ha_mask.any() and s2.drain_end == 0
+
+
+def test_slot_pool_commit_requires_filling():
+    pool = SlotPool(2, batch=8, max_msg_len=64)
+    s = pool.slots[0]
+    with pytest.raises(ValueError):
+        pool.commit(s)  # FREE, never acquired
+
+
+def test_slot_pool_needs_two_slots():
+    with pytest.raises(ValueError):
+        SlotPool(1, batch=8, max_msg_len=64)
+
+
+def test_slot_pool_exhaustion_counts_stall():
+    pool = SlotPool(2, batch=8, max_msg_len=64)
+    a = pool.acquire(0.05)
+    b = pool.acquire(0.05)
+    assert a is not None and b is not None
+    t0 = time.perf_counter()
+    c = pool.acquire(0.05)
+    waited = time.perf_counter() - t0
+    assert c is None and waited >= 0.04
+    assert pool.slot_stall == 1 and pool.stall_ns > 0
+    # idle() sees staged work only when a slot actually holds txns
+    assert pool.idle()
+    a.n_txn = 1
+    assert not pool.idle()
+
+
+def test_slot_pool_fifo_order_under_threads():
+    """Stager/dispatcher handoff: READY slots come out in commit order
+    even when the consumer lags (FIFO is what lets batch retirement
+    carry the ack cursor)."""
+    pool = SlotPool(3, batch=8, max_msg_len=64)
+    committed, popped = [], []
+    stop = threading.Event()
+
+    def stager():
+        for i in range(50):
+            s = None
+            while s is None:
+                s = pool.acquire(0.1)
+            s.n_txn = 1
+            s.drain_end = i + 1
+            committed.append(i + 1)
+            pool.commit(s)
+        stop.set()
+
+    t = threading.Thread(target=stager, daemon=True)
+    t.start()
+    deadline = time.time() + 20
+    while (len(popped) < 50) and time.time() < deadline:
+        s = pool.pop_ready()
+        if s is None:
+            time.sleep(0.002)  # slow consumer: forces stager waits
+            continue
+        popped.append(s.drain_end)
+        pool.release(s)
+    t.join(timeout=5)
+    assert popped == committed == list(range(1, 51))
+    assert pool.slot_stall > 0  # the slow consumer made the stager wait
+
+
+# ------------------------------------------------------------ policy -----
+
+
+def test_adaptive_flush_basic_verdicts():
+    p = AdaptiveFlush(deadline_ns=25_000_000)
+    assert p.due(0, 0, 128, 0) is None                      # empty: never
+    assert p.due(0, 128, 128, 0) == FLUSH_FULL              # full: always
+    assert p.due(25_000_000, 10, 128, 0) == FLUSH_DEADLINE  # at deadline
+    # starved + idle device + credits -> early flush after the debounce
+    assert p.due(p.starve_ns, 10, 128, 0, starved=True,
+                 device_idle=True) == FLUSH_STARVED
+    # ... but not while the device is busy, not while backpressured,
+    # and not before the debounce
+    assert p.due(p.starve_ns, 10, 128, 0, starved=True,
+                 device_idle=False) is None
+    assert p.due(p.starve_ns, 10, 128, 0, starved=True, device_idle=True,
+                 backpressured=True) is None
+    assert p.due(p.starve_ns - 1, 10, 128, 0, starved=True,
+                 device_idle=True) is None
+
+
+def test_adaptive_flush_rejects_nonpositive_deadline():
+    with pytest.raises(ValueError):
+        AdaptiveFlush(0)
+
+
+def test_adaptive_flush_never_starves_past_deadline():
+    """Property (the ROADMAP latency bound): for ANY state flags and
+    ANY deadline, a non-empty partial batch polled at/after its
+    deadline flushes — deadline expiry dominates every suppressor
+    (device busy, backpressure, rich input)."""
+    rng = np.random.RandomState(7)
+    for _ in range(500):
+        deadline = int(rng.randint(1_000, 1_000_000_000))
+        p = AdaptiveFlush(deadline)
+        assert p.starve_ns <= p.deadline_ns
+        first = int(rng.randint(0, 1 << 40))
+        lanes = int(rng.randint(1, 128))
+        late = first + deadline + int(rng.randint(0, 1 << 30))
+        verdict = p.due(
+            late, lanes, 128, first,
+            starved=bool(rng.randint(2)),
+            device_idle=bool(rng.randint(2)),
+            backpressured=bool(rng.randint(2)),
+        )
+        assert verdict in (FLUSH_DEADLINE, FLUSH_FULL)
+        # and BEFORE the starve debounce nothing flushes a partial
+        early = first + p.starve_ns - 1
+        assert p.due(early, min(lanes, 127), 128, first,
+                     starved=True, device_idle=True) is None
+
+
+# ----------------------------------------------------------- runtime -----
+
+
+def _corpus(n=96, seed=5):
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+
+    return mainnet_corpus(
+        n=n, seed=seed, dup_rate=0.1, corrupt_rate=0.06,
+        parse_err_rate=0.04, sign_batch_size=128, max_data_sz=140,
+    )
+
+
+def test_feed_legacy_sink_parity(tmp_path):
+    """The gate of gates: fd_feed and the legacy step loop produce
+    IDENTICAL sink content multisets on the same mainnet-shaped corpus
+    (dups, corrupt sigs, parse errors included), and both match the
+    by-construction oracle expectation."""
+    from collections import Counter
+
+    from firedancer_tpu.disco.corpus import expected_sink_digests
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    corpus = _corpus()
+    results = {}
+    for mode, feed in (("feed", True), ("legacy", False)):
+        topo = build_topology(str(tmp_path / f"{mode}.wksp"), depth=256)
+        results[mode] = run_pipeline(
+            topo, corpus.payloads, verify_backend="cpu", timeout_s=240.0,
+            record_digests=True, feed=feed,
+        )
+    want = expected_sink_digests(corpus)
+    assert Counter(results["feed"].sink_digests) == want
+    assert Counter(results["legacy"].sink_digests) == want
+    assert results["feed"].feed and not results["legacy"].feed
+    # Filter accounting parity: both runners classify the corpus the
+    # same way (dups at the HA filter, bad sigs at sigverify).
+    from firedancer_tpu.disco.corpus import BAD_SIG, DUP
+
+    for mode in ("feed", "legacy"):
+        d = results[mode].diag["tile.verify"]
+        assert d["ha_filt_cnt"] == int((corpus.expected == DUP).sum()), mode
+        assert d["sv_filt_cnt"] >= int(
+            (corpus.expected == BAD_SIG).sum()), mode
+
+
+def test_feed_stats_and_stage_latency_schema(tmp_path):
+    """Feeder stats + per-stage latency land in the PipelineResult with
+    the artifact schema the replay gates publish."""
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    corpus = _corpus(n=64, seed=9)
+    topo = build_topology(str(tmp_path / "stats.wksp"), depth=256)
+    res = run_pipeline(
+        topo, corpus.payloads, verify_backend="cpu", timeout_s=240.0,
+        record_digests=True, feed=True,
+    )
+    assert res.feed
+    vs = res.verify_stats[0]
+    assert vs["feed"] is True
+    assert vs["batches"] >= 1
+    assert vs["lanes"] >= corpus.n_unique_ok
+    assert 0.0 < vs["fill_ratio"] <= 1.0
+    for key in ("slot_stall", "slot_stall_ms", "device_idle_est_ms",
+                "flush_timeout", "flush_starved", "mode", "rlc_fallback"):
+        assert key in vs, key
+    for stage in ("replay_pub", "verify_pub", "dedup_pub", "pack_pub",
+                  "sink"):
+        d = res.stage_latency[stage]
+        assert d["n"] > 0, stage
+        assert d["p99_ns"] >= d["p50_ns"] > 0, stage
+    # stage ordering: latency-to-stage grows monotonically downstream
+    assert (res.stage_latency["sink"]["p50_ns"]
+            >= res.stage_latency["verify_pub"]["p50_ns"])
+
+
+def test_feed_small_ring_backpressure(tmp_path):
+    """A ring much smaller than the corpus forces the full credit /
+    held-back-ack machinery through the feeder (slot commits driven by
+    credit starvation rather than full batches); content must survive
+    intact."""
+    from collections import Counter
+
+    from firedancer_tpu.disco.corpus import expected_sink_digests
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    corpus = _corpus(n=120, seed=11)
+    topo = build_topology(str(tmp_path / "bp.wksp"), depth=32)
+    res = run_pipeline(
+        topo, corpus.payloads, verify_backend="cpu", timeout_s=240.0,
+        record_digests=True, feed=True, verify_batch=64,
+    )
+    assert res.feed
+    assert Counter(res.sink_digests) == expected_sink_digests(corpus)
+    for name, d in res.diag.items():
+        if name.startswith("link."):
+            assert d["ovrnr_cnt"] == 0 and d["ovrnp_cnt"] == 0, (name, d)
+
+
+def test_feed_routing_falls_back_when_unsupported(tmp_path):
+    """Topologies the feeder cannot serve (oracle backend, tiny batch,
+    multi-lane) silently keep the legacy loop — FD_FEED=1 must never
+    change their semantics."""
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    corpus = _corpus(n=24, seed=13)
+    # batch below MAX_SIG_CNT -> legacy
+    topo = build_topology(str(tmp_path / "small.wksp"), depth=64)
+    res = run_pipeline(topo, corpus.payloads, verify_backend="cpu",
+                       verify_batch=16, timeout_s=240.0, feed=True)
+    assert not res.feed
+    assert res.recv_cnt == corpus.n_unique_ok
+
+
+def test_feed_worker_pool_mode(tmp_path, monkeypatch):
+    """FD_FEED_PROC=1: source + dedup/pack/sink in worker processes
+    over the same shm rings (the >= 4-core production layout); results
+    — content, bank spread, stage latency — must come back through the
+    worker result file intact."""
+    from collections import Counter
+
+    from firedancer_tpu.disco.corpus import expected_sink_digests
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    monkeypatch.setenv("FD_FEED_PROC", "1")
+    corpus = _corpus(n=64, seed=23)
+    topo = build_topology(str(tmp_path / "proc.wksp"), depth=256)
+    res = run_pipeline(
+        topo, corpus.payloads, verify_backend="cpu", timeout_s=240.0,
+        record_digests=True, feed=True,
+    )
+    assert res.feed
+    assert Counter(res.sink_digests) == expected_sink_digests(corpus)
+    assert res.recv_cnt == corpus.n_unique_ok
+    assert sum(res.bank_hist.values()) == corpus.n_unique_ok
+    # Worker-side stage latencies made it back through the result file.
+    for stage in ("dedup_pub", "pack_pub", "sink"):
+        assert res.stage_latency[stage]["n"] > 0, stage
+    assert res.latency_p99_ns > 0
+
+
+def test_feed_cnc_diag_gauges(tmp_path):
+    """The CNC_DIAG_FEED_* gauges mirror the feeder stats into shared
+    memory (what monitor.render's FEEDER panel and the supervisor
+    read)."""
+    from firedancer_tpu.disco.monitor import render, snapshot
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+    from firedancer_tpu.tango.rings import Workspace, cnc_diag_cap
+
+    if cnc_diag_cap() < 16:
+        pytest.skip("stale native .so: 8-slot cnc diag")
+    corpus = _corpus(n=48, seed=17)
+    topo = build_topology(str(tmp_path / "gauge.wksp"), depth=256)
+    res = run_pipeline(topo, corpus.payloads, verify_backend="cpu",
+                       timeout_s=240.0, feed=True)
+    assert res.feed
+    wksp = Workspace.join(topo.wksp_path)
+    snap = snapshot(wksp, topo.pod)
+    vt = snap["tile.verify"]
+    assert vt["feed_batches"] == res.verify_stats[0]["batches"]
+    assert vt["feed_lanes"] == res.verify_stats[0]["lanes"]
+    text = render(snap, ansi=False)
+    assert "FEEDER" in text and "idle-ms" in text
+    wksp.leave()
