@@ -205,8 +205,13 @@ def replay_cpu_worker() -> int:
         "run_s": round(run_s, 1),
         # fd_feed artifact schema (round 8): which runner produced this,
         # its feeder gauges, RLC fallback total, and the per-stage
-        # latency budget table.
+        # latency budget table. Round 9: verify_stats additionally
+        # carries the fd_chaos healing counters (stager_restarts,
+        # cpu_failover, quarantined, breaker state, slots_leaked — all
+        # zero on a fault-free run), and a feed-requested run that fell
+        # back to the legacy loop records WHY.
         "feed": bool(getattr(res, "feed", False)),
+        "feed_fallback_reason": getattr(res, "feed_fallback_reason", None),
         "verify_stats": res.verify_stats,
         "rlc_fallbacks": _rlc_fallbacks(res),
         "stage_latency_ms": _stage_latency_ms(res),
@@ -290,6 +295,7 @@ def replay_worker() -> int:
         "run_s": round(run_s, 1),
         "verify_stats": res.verify_stats,
         "feed": bool(getattr(res, "feed", False)),
+        "feed_fallback_reason": getattr(res, "feed_fallback_reason", None),
         "rlc_fallbacks": _rlc_fallbacks(res),
         "stage_latency_ms": _stage_latency_ms(res),
     }

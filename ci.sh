@@ -60,6 +60,16 @@ echo "== fd_feed replay smoke (CPU backend, feeder vs step loop) =="
 # seed step loop, and never lose to the FD_FEED=0 bisection baseline.
 JAX_PLATFORMS=cpu python scripts/feed_smoke.py
 
+echo "== fd_chaos smoke (CPU backend, seeded 7-class fault schedule) =="
+# The round-9 robustness gate: the SAME corpus replayed under a fixed
+# seeded schedule of 7 fault classes (ring CTL_ERR / overrun / credit
+# starvation, stager kill, slot corruption, backend raise, device loss)
+# must complete, stay bit-exact vs the oracle minus exactly the
+# corrupted txns, keep the slot pool whole, report per-class
+# injected == detected == healed, and demonstrate the device->CPU
+# breaker failover (trip -> CPU lane -> half-open re-probe -> closed).
+JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
+
 echo "== RLC verify smoke (CPU backend, FD_BENCH_VERIFY=rlc) =="
 # The production verify mode's dispatch contract (round-6 promotion):
 # tiny batch through the tile-facing RLC wrapper — no fallback on clean

@@ -1,0 +1,475 @@
+"""fd_chaos — deterministic, schedule-driven fault injection.
+
+The reference validator's whole design is crash-only: a misbehaving
+tile is killed and respawned and the lossy-by-design tango rings heal
+around it. This module makes that property TESTABLE for every boundary
+the pipeline crosses, the way wiredancer treats the FPGA as a component
+that can disappear and must degrade to the host path: faults are
+injected at fixed, replayable points (ordinal counters per hook site,
+byte/position choices from a seeded counter-based Rng), so a failing
+chaos run re-runs bit-identically from (seed, schedule).
+
+Fault classes and their hook sites:
+
+  ring_ctl_err   source publish path: emit a CTL_ERR frag (garbage
+                 payload) ahead of the scheduled publish. Consumers
+                 must drop it at the ctl word, not launder it.
+  ring_overrun   consumer side (stager drain round): rewind the in-ring
+                 cursor past the ring depth so the seqlock poll reports
+                 a producer overrun and the drain repositions. Re-read
+                 frags are healed by the HA tcache (dup filter) — a
+                 reliable-link producer-side seq gap would deadlock the
+                 credit loop, so the overrun is injected where real
+                 ones appear: at the consumer.
+  credit_starve  source publish path: report zero credits for a window
+                 of publish attempts (forced backpressure; liveness
+                 fault, heals when the window closes).
+  stager_kill    raise out of the stager thread at a scheduled drain
+                 round; healed by the feeder's thread supervision
+                 (restart with exponential backoff, staged slots kept).
+  slot_corrupt   flip one byte in a staged slot's msg sidecar (the
+                 verify staging, NOT the payload): the lane must fail
+                 sigverify and the txn must be dropped without wedging
+                 the slot pool. Keyed to the Nth non-duplicate STAGED
+                 TXN (not the drain round): a single in-order producer
+                 makes that ordinal — and therefore WHICH txn is hit —
+                 replay-exact, where round boundaries depend on ring
+                 timing.
+  backend_raise  raise at a scheduled batch completion (the shape of a
+                 backend/XLA error surfacing from an async dispatch);
+                 healed by poisoned-batch quarantine (CPU oracle lane
+                 re-verify, offenders published CTL_ERR, slot freed).
+  device_lost    raise at scheduled dispatch ordinals (device
+                 unavailable); healed by the verify circuit breaker
+                 (trip -> CPU failover lane -> half-open re-probe).
+  hb_stall       suppress a tile's cnc heartbeat for a window of that
+                 tile's OWN housekeeping passes (ordinals are per-tile:
+                 in-process runs housekeep from every tile thread, and
+                 a shared counter would tie WHICH tile stalls to thread
+                 interleaving). Supervised runs: the wedge detector
+                 must kill + respawn.
+  worker_kill    supervisor monitor pass: SIGKILL the verify worker at
+                 a scheduled pass ordinal (supervised runs).
+
+Schedule grammar (FD_CHAOS_SCHEDULE):
+
+    entry[,entry...]    entry := class@N | class@N:M
+
+N/M are 1-based ordinals of the class's hook site (publish attempt,
+drain round, staged txn, dispatch, completion, housekeep pass,
+monitor pass).
+Point classes may repeat (`ring_ctl_err@5,ring_ctl_err@40`); window
+classes (credit_starve, device_lost, hb_stall) take N:M inclusive.
+
+Accounting: every class carries injected/detected/healed counters; the
+chaos smoke lane (scripts/chaos_smoke.py) gates on per-class parity
+(injected == detected == healed), so recovery is audited, not assumed.
+For drop-type faults (ring_ctl_err, ring_overrun, slot_corrupt) the
+detection IS the heal (the frag/lane is filtered and the machinery
+carries on); pool integrity is gated separately (slots_leaked == 0).
+Counters are process-local: in supervised (multi-process) runs the
+supervisor-level classes are asserted behaviorally (restart counts,
+content exactness) rather than through the tri-counter.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from firedancer_tpu import flags
+from firedancer_tpu.utils.rng import Rng
+
+FAULT_CLASSES = (
+    "ring_ctl_err",
+    "ring_overrun",
+    "credit_starve",
+    "stager_kill",
+    "slot_corrupt",
+    "backend_raise",
+    "device_lost",
+    "hb_stall",
+    "worker_kill",
+)
+
+_WINDOW_CLASSES = ("credit_starve", "device_lost", "hb_stall")
+
+
+class ChaosFault(RuntimeError):
+    """Base of every injected exception; `cls` names the fault class so
+    healing paths can attribute detected/healed counters exactly."""
+
+    cls = "chaos"
+
+
+class ChaosStagerKill(ChaosFault):
+    cls = "stager_kill"
+
+
+class ChaosBackendError(ChaosFault):
+    cls = "backend_raise"
+
+
+class ChaosDeviceLost(ChaosFault):
+    cls = "device_lost"
+
+
+def parse_schedule(spec: str) -> Dict[str, List[Tuple[int, int]]]:
+    """`class@N[:M],...` -> {class: [(lo, hi), ...]} (1-based, inclusive).
+
+    Point entries become (N, N). Unknown classes, malformed ordinals,
+    or windows on point-only classes raise ValueError — a typo'd
+    schedule must never silently inject nothing.
+    """
+    out: Dict[str, List[Tuple[int, int]]] = {}
+    for raw in spec.split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        if "@" not in entry:
+            raise ValueError(f"chaos schedule entry {entry!r}: missing '@N'")
+        cls, _, ord_s = entry.partition("@")
+        cls = cls.strip()
+        if cls not in FAULT_CLASSES:
+            raise ValueError(
+                f"unknown chaos fault class {cls!r} "
+                f"(want one of {', '.join(FAULT_CLASSES)})"
+            )
+        if ":" in ord_s:
+            if cls not in _WINDOW_CLASSES:
+                raise ValueError(
+                    f"chaos class {cls!r} takes a point ordinal, "
+                    f"not a window ({entry!r})"
+                )
+            lo_s, _, hi_s = ord_s.partition(":")
+        else:
+            lo_s = hi_s = ord_s
+        try:
+            lo, hi = int(lo_s), int(hi_s)
+        except ValueError:
+            raise ValueError(
+                f"chaos schedule entry {entry!r}: ordinals must be ints"
+            ) from None
+        if lo < 1 or hi < lo:
+            raise ValueError(
+                f"chaos schedule entry {entry!r}: want 1 <= N <= M"
+            )
+        out.setdefault(cls, []).append((lo, hi))
+    return out
+
+
+class ChaosInjector:
+    """One run's injection plan + fault accounting.
+
+    Hook ordinals are per-site counters (source publish attempts,
+    stager drain rounds, dispatches, completions, per-tile housekeeping
+    passes) — each site is driven by exactly one thread (housekeeping
+    is keyed per tile precisely to keep that true), so the ordinals are
+    deterministic given the run's configuration.
+    """
+
+    def __init__(self, seed: int = 0, schedule: str = ""):
+        self.seed = seed
+        self.schedule = parse_schedule(schedule or "")
+        if "ring_ctl_err" in self.schedule:
+            # The audit for this class counts typed CTL_ERR drops at the
+            # native drain (counters[6]); a stale .so stages err frags
+            # like any other and the parity gate would fail with a
+            # misleading detected=0. Refuse up front instead. (Pure
+            # Python consumers check frag.ctl directly and need no
+            # native support.)
+            from firedancer_tpu.tango.rings import (
+                native_available,
+                verify_drain_ctl_err,
+            )
+
+            if native_available() and not verify_drain_ctl_err():
+                raise RuntimeError(
+                    "FD_CHAOS_SCHEDULE includes ring_ctl_err but the "
+                    "native .so predates the CTL_ERR drop counter "
+                    "(fd_verify_drain_ctl_err absent) — rebuild native/"
+                )
+        # Per-site Rng streams (counter-based, splittable): byte/position
+        # choices must not depend on how draws from DIFFERENT threads
+        # interleave, or the replay contract dies to scheduler noise.
+        self._junk_rng = Rng(seq=seed ^ 0xC4A05)      # ring_ctl_err payloads
+        self._corrupt_rng = Rng(seq=seed ^ 0x51077)   # slot_corrupt flips
+        self._lock = threading.Lock()
+        self.counters: Dict[str, Dict[str, int]] = {
+            cls: {"injected": 0, "detected": 0, "healed": 0}
+            for cls in self.schedule
+        }
+        # per-site ordinal counters
+        self._ord: Dict[str, int] = {}
+        # match-based detection state (consume-one-pending per event so
+        # an unrelated lookalike cannot inflate parity)
+        self._overrun_pending = 0
+        self._corrupt_psigs: List[int] = []
+        self._starve_active = False
+        self.corrupted_sha256: List[str] = []
+
+    # -- plumbing --------------------------------------------------------
+
+    def note(self, cls: str, kind: str, n: int = 1) -> None:
+        """Record a detected/healed (or extra injected) event for a
+        scheduled class; events for unscheduled classes are ignored so
+        organic faults don't skew the parity audit."""
+        with self._lock:
+            c = self.counters.get(cls)
+            if c is not None:
+                c[kind] += n
+
+    def _tick(self, site: str) -> int:
+        """Next 1-based ordinal of a hook site. Locked: most sites are
+        single-threaded by construction, but the housekeep site family
+        is ticked from every tile thread of an in-process run, and a
+        lost read-modify-write there would skew ordinals off the
+        schedule (chaos-armed runs are test traffic — the lock is not
+        on any production path)."""
+        with self._lock:
+            n = self._ord.get(site, 0) + 1
+            self._ord[site] = n
+            return n
+
+    def _hit(self, cls: str, ordinal: int, consume: bool = False) -> bool:
+        """True when `ordinal` falls in one of cls's scheduled windows.
+        consume=True removes a matched POINT entry — for hook sites
+        whose ordinal can be retried (a deferred injection must fire
+        exactly once, not once per retry)."""
+        wins = self.schedule.get(cls, [])
+        for i, (lo, hi) in enumerate(wins):
+            if lo <= ordinal <= hi:
+                if consume and lo == hi:
+                    wins.pop(i)
+                return True
+        return False
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "seed": self.seed,
+                "counters": {
+                    cls: dict(v) for cls, v in self.counters.items()
+                },
+                "corrupted_sha256": list(self.corrupted_sha256),
+            }
+
+    # -- ring level (source publish path) --------------------------------
+
+    def source_starved(self) -> bool:
+        """True while the credit_starve window covers this publish
+        attempt: the source must treat the link as backpressured."""
+        n = self._tick("source_attempt")
+        if self._hit("credit_starve", n):
+            if not self._starve_active:
+                self._starve_active = True
+                self.note("credit_starve", "injected")
+                # forced backpressure is observed the moment the source
+                # takes the backoff path — detection is the injection
+                # point's own visibility in the BACKP diag.
+                self.note("credit_starve", "detected")
+            return True
+        if self._starve_active:
+            self._starve_active = False
+            self.note("credit_starve", "healed")  # window closed, flow back
+        return False
+
+    def source_inject(self, out_link, publish_ord: int) -> None:
+        """Called by the source right before publishing payload number
+        `publish_ord` (1-based): may emit a CTL_ERR frag ahead of it.
+        The err frag spends a credit like any frag — with none to spare
+        the injection defers to the next attempt at the SAME ordinal
+        (the entry is consumed only when it actually fires). The err
+        payload is seeded garbage, so even a consumer without the ctl
+        check (stale .so) drops it at parse."""
+        from firedancer_tpu.tango.rings import CTL_ERR
+
+        if not self._hit("ring_ctl_err", publish_ord):
+            return
+        if not out_link.can_publish():
+            return
+        self._hit("ring_ctl_err", publish_ord, consume=True)
+        junk = bytes(self._junk_rng.roll(256) for _ in range(24))
+        out_link.publish(junk, sig=0, ctl=CTL_ERR)
+        self.note("ring_ctl_err", "injected")
+
+    def on_ctl_err_drop(self, n: int = 1) -> None:
+        """A consumer dropped n CTL_ERR frags at the ctl word: the drop
+        is both the detection and the heal for this class."""
+        self.note("ring_ctl_err", "detected", n)
+        self.note("ring_ctl_err", "healed", n)
+
+    # -- ring level (consumer drain) -------------------------------------
+
+    def overrun_rewind(self, in_link) -> None:
+        """Maybe rewind the consumer cursor past the ring depth so the
+        next seqlock poll reports an overrun and the drain repositions
+        (counted in DIAG_OVRNR_CNT). Deferred until enough frags have
+        flowed that the rewound lines are guaranteed stale."""
+        n = self._tick("drain_round")
+        depth = in_link.mcache.depth
+        if self._hit("ring_overrun", n):
+            self._ord["_overrun_due"] = self._ord.get("_overrun_due", 0) + 1
+        if self._ord.get("_overrun_due", 0) and in_link.seq > depth + 1:
+            self._ord["_overrun_due"] -= 1
+            in_link.seq -= depth + 1
+            with self._lock:
+                self._overrun_pending += 1
+            self.note("ring_overrun", "injected")
+
+    def on_overrun_observed(self) -> None:
+        """The drain repositioned past an overrun; consume one pending
+        injection (organic overruns beyond the pending count are not
+        booked against the chaos audit)."""
+        with self._lock:
+            if self._overrun_pending <= 0:
+                return
+            self._overrun_pending -= 1
+        self.note("ring_overrun", "detected")
+        self.note("ring_overrun", "healed")
+
+    # -- feed level (stager) ---------------------------------------------
+
+    def stager_round_hook(self) -> None:
+        """Top of every stager drain round; raises ChaosStagerKill at
+        scheduled rounds (before the round's C call, so the kill point
+        is state-clean: nothing half-booked in the slot)."""
+        n = self._tick("stager_round")
+        if self._hit("stager_kill", n):
+            self.note("stager_kill", "injected")
+            raise ChaosStagerKill(f"injected stager kill at round {n}")
+
+    def post_stage_hook(self, slot, k0: int, n: int, lane0: int) -> None:
+        """After a drain round staged txns [k0, k0+n) with lanes starting
+        at lane0: maybe flip one byte in a scheduled txn's staged
+        MESSAGE row (the payload sidecar stays pristine — the fault
+        models staging-arena corruption, and the expected outcome is a
+        sigverify drop of exactly that txn). The ordinal counts
+        non-HA-masked STAGED txns: ring order is the single producer's
+        publish order and duplicates are masked, so the same schedule
+        hits the same txn on every run regardless of how the stream
+        happened to split into drain rounds."""
+        import hashlib
+
+        lane = lane0
+        for t in range(k0, k0 + n):
+            if not bool(slot.ha_mask[t]):
+                ordn = self._tick("staged_txn")
+                msg_len = int(slot.lens[lane])
+                if msg_len > 0 and self._hit(
+                        "slot_corrupt", ordn, consume=True):
+                    slot.msgs[lane, self._corrupt_rng.roll(msg_len)] ^= (
+                        1 + self._corrupt_rng.roll(255)
+                    )
+                    off = int(slot.offs[t])
+                    ln = int(slot.plens[t])
+                    pay = slot.pay[off:off + ln].tobytes()
+                    with self._lock:
+                        self._corrupt_psigs.append(int(slot.psigs[t]))
+                        self.corrupted_sha256.append(
+                            hashlib.sha256(pay).hexdigest())
+                    self.note("slot_corrupt", "injected")
+            lane += int(slot.tlanes[t])
+
+    def on_sv_drop(self, psigs) -> None:
+        """Sigverify dropped txns with these meta sigs; consume matching
+        corruption records (detected + healed: the poisoned lane was
+        filtered and the slot carries on)."""
+        hits = 0
+        with self._lock:
+            for p in psigs:
+                try:
+                    self._corrupt_psigs.remove(int(p))
+                    hits += 1
+                except ValueError:
+                    continue
+        if hits:
+            self.note("slot_corrupt", "detected", hits)
+            self.note("slot_corrupt", "healed", hits)
+
+    # -- verify level ----------------------------------------------------
+
+    def verify_dispatch_hook(self) -> None:
+        """Before each device/executor dispatch; raises ChaosDeviceLost
+        during scheduled dispatch windows (the breaker's trip fuel).
+        Only ATTEMPTED device dispatches tick the ordinal — while the
+        breaker is open the CPU lane serves and no injection fires, so
+        injected == detected == healed holds per raise."""
+        n = self._tick("dispatch")
+        if self._hit("device_lost", n):
+            self.note("device_lost", "injected")
+            raise ChaosDeviceLost(f"injected device loss at dispatch {n}")
+
+    def verify_complete_hook(self) -> None:
+        """Before each batch completion is consumed; raises
+        ChaosBackendError at scheduled completion ordinals (the shape
+        of an async backend error surfacing at result time)."""
+        n = self._tick("complete")
+        if self._hit("backend_raise", n):
+            self.note("backend_raise", "injected")
+            raise ChaosBackendError(f"injected backend error at batch {n}")
+
+    # -- supervisor level ------------------------------------------------
+
+    def hb_stalled(self, tile_id: str) -> bool:
+        """True while the hb_stall window covers this housekeeping pass
+        OF THIS TILE: the tile must skip its heartbeat (the supervised
+        wedge detector is the intended observer). Ordinals are keyed
+        per tile — in-process runs drive housekeeping from every tile's
+        own thread, and a shared counter would make WHICH tile stalls
+        depend on thread interleaving, breaking replay. (Supervised
+        runs are unchanged: one tile per process, one injector each.)"""
+        n = self._tick(f"housekeep:{tile_id}")
+        if self._hit("hb_stall", n):
+            self.note("hb_stall", "injected")
+            return True
+        return False
+
+    def supervisor_hook(self, tiles) -> None:
+        """One supervisor monitor pass: SIGKILL the verify worker at
+        scheduled pass ordinals (detected/healed are booked by the
+        supervisor's own respawn accounting)."""
+        import os
+        import signal
+
+        n = self._tick("monitor_pass")
+        if not self._hit("worker_kill", n):
+            return
+        tp = tiles.get("verify")
+        if tp is not None and tp.proc.poll() is None:
+            self.note("worker_kill", "injected")
+            os.kill(tp.proc.pid, signal.SIGKILL)
+
+
+# -- process-global active injector ---------------------------------------
+
+_active: Optional[ChaosInjector] = None
+
+
+def active() -> Optional[ChaosInjector]:
+    return _active
+
+
+def install(injector: Optional[ChaosInjector]) -> None:
+    global _active
+    _active = injector
+
+
+def uninstall() -> None:
+    install(None)
+
+
+def init_for_run() -> Optional[ChaosInjector]:
+    """Pipeline-run entry point: FD_CHAOS on installs a FRESH injector
+    (per-run ordinal counters — the determinism contract: the same
+    seed + schedule + corpus replays the same faults), FD_CHAOS off
+    clears any previous one. Called by every pipeline runner and by
+    worker processes at boot."""
+    if flags.get_bool("FD_CHAOS"):
+        install(ChaosInjector(
+            seed=flags.get_int("FD_CHAOS_SEED"),
+            schedule=flags.get_str("FD_CHAOS_SCHEDULE") or "",
+        ))
+    else:
+        install(None)
+    return _active

@@ -46,8 +46,16 @@ _STARVE_MAX_NS = 5_000_000     # debounce ceiling: 5 ms
 
 
 class AdaptiveFlush:
-    """Pure decision logic (no clocks, no rings) so the property test
-    can drive it through arbitrary arrival schedules."""
+    """Clock-free decision logic (no clock READS — the caller passes
+    now_ns) so the property test can drive it through arbitrary arrival
+    schedules, including pathological ones: the policy keeps a
+    high-water mark of the now_ns it has been shown FOR THE CURRENT
+    BATCH (keyed by the first_ns anchor), so a clock that stutters or
+    jumps BACKWARD can never un-expire a deadline — once a partial
+    batch has been observed past its deadline, every later poll
+    flushes it regardless of what the clock claims. The hwm resets
+    with each new anchor: batches are independent latency contracts,
+    and a prior batch's late clock must not pre-expire the next."""
 
     def __init__(self, deadline_ns: int):
         if deadline_ns <= 0:
@@ -59,6 +67,8 @@ class AdaptiveFlush:
         # A debounce longer than the deadline could never fire first;
         # keep the invariant starve <= deadline explicit.
         self.starve_ns = min(self.starve_ns, deadline_ns)
+        self._now_hwm = 0      # monotonic view of the caller's clock...
+        self._hwm_anchor = None  # ...scoped to this batch anchor
 
     def due(
         self,
@@ -85,7 +95,19 @@ class AdaptiveFlush:
             return None
         if lanes >= batch:
             return FLUSH_FULL
-        age = now_ns - first_ns
+        # Clock-jitter hardening: within one batch (anchor), a backward
+        # jump must not rewind the deadline (the staged txns' budget
+        # keeps burning in real time), and an anchor stamped "in the
+        # future" by a glitch must not produce a negative age that
+        # defers the starved early-out.
+        if first_ns != self._hwm_anchor:
+            self._hwm_anchor = first_ns
+            self._now_hwm = now_ns
+        elif now_ns < self._now_hwm:
+            now_ns = self._now_hwm
+        else:
+            self._now_hwm = now_ns
+        age = max(0, now_ns - first_ns)
         if age >= self.deadline_ns:
             return FLUSH_DEADLINE
         if (
@@ -96,3 +118,108 @@ class AdaptiveFlush:
         ):
             return FLUSH_STARVED
         return None
+
+
+# due-state names of the device->CPU verify failover breaker
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Device->CPU verify failover circuit (the fd_chaos healing lane).
+
+    The device (or verify executor) is a component that can disappear —
+    wiredancer's FPGA model, SZKP's host fallback behind the accelerator
+    scheduler — and its loss must degrade THROUGHPUT, not liveness:
+
+      closed     dispatches go to the device; `threshold` CONSECUTIVE
+                 device errors trip the breaker (one transient error
+                 followed by a success resets the count — that is the
+                 quarantine path's job, not an outage).
+      open       dispatches are served by the CPU oracle lane for
+                 `cooldown_ns`; then one half-open probe is allowed.
+      half_open  exactly one dispatch probes the device. Success closes
+                 the breaker (and resets the cooldown multiplier);
+                 failure re-opens with the cooldown doubled, up to 8x —
+                 a dead device is re-probed at a decaying rate instead
+                 of once per cooldown forever.
+
+    Pure decision logic like AdaptiveFlush: the caller passes now_ns,
+    and only the dispatcher thread drives it (no locking needed).
+    """
+
+    def __init__(self, threshold: int, cooldown_ns: int):
+        if threshold < 1:
+            raise ValueError(f"breaker threshold must be >= 1, got {threshold}")
+        if cooldown_ns <= 0:
+            raise ValueError(
+                f"breaker cooldown_ns must be positive, got {cooldown_ns}")
+        self.threshold = threshold
+        self.cooldown_ns = cooldown_ns
+        self.state = BREAKER_CLOSED
+        self.errors = 0          # consecutive device errors while closed
+        self.trips = 0           # times the circuit opened from closed
+        self.reprobes = 0        # half-open probes attempted
+        self._open_until = 0
+        self._mult = 1
+
+    def allow_device(self, now_ns: int) -> bool:
+        """May this dispatch go to the device? Transitions open ->
+        half_open when the cooldown has elapsed (granting exactly one
+        probe; everything else stays on the CPU lane until the probe's
+        own completion decides)."""
+        if self.state == BREAKER_CLOSED:
+            return True
+        if self.state == BREAKER_OPEN and now_ns >= self._open_until:
+            self.state = BREAKER_HALF_OPEN
+            self.reprobes += 1
+            return True
+        return False
+
+    def record_error(self, now_ns: int) -> bool:
+        """A device dispatch/completion failed. Returns True when this
+        error tripped (or re-opened) the circuit."""
+        if self.state == BREAKER_HALF_OPEN:
+            self._mult = min(self._mult * 2, 8)
+            self.state = BREAKER_OPEN
+            self._open_until = now_ns + self.cooldown_ns * self._mult
+            return True
+        if self.state == BREAKER_OPEN:
+            # Straggler completion from the outage window: extend nothing.
+            return False
+        self.errors += 1
+        if self.errors >= self.threshold:
+            self.state = BREAKER_OPEN
+            self.trips += 1
+            self.errors = 0
+            self._mult = 1
+            self._open_until = now_ns + self.cooldown_ns
+            return True
+        return False
+
+    def record_success(self) -> None:
+        """A device batch completed cleanly. Closes a half-open circuit
+        (probe passed); a success from a pre-outage straggler while
+        open changes nothing."""
+        if self.state == BREAKER_HALF_OPEN:
+            self.state = BREAKER_CLOSED
+            self._mult = 1
+        if self.state == BREAKER_CLOSED:
+            self.errors = 0
+
+
+def respawn_backoff_s(restarts: int, base_s: float, max_s: float,
+                      rng) -> float:
+    """Crash-only respawn delay AFTER `restarts` crashes (restarts >= 1):
+    base * 2^(restarts-1) + 0-25% jitter, capped at max_s. Pure so the
+    policy is unit-testable; base_s == 0 keeps immediate respawn. The
+    jitter de-lockstops components that all died to one shared cause
+    (e.g. a wedged workspace) from respawning as one thundering herd.
+    Shared by the process supervisor's tile respawn and the feeder's
+    stager-thread restart — ONE backoff policy, two supervision layers.
+    """
+    if base_s <= 0.0:
+        return 0.0
+    d = min(base_s * (1 << min(restarts - 1, 30)), max_s)
+    return min(d * (1.0 + 0.25 * rng.float01()), max_s)

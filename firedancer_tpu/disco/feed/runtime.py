@@ -58,11 +58,14 @@ def latency_percentiles(samples) -> Dict[str, int]:
 
 
 def verify_tile_stats(v) -> Dict[str, object]:
-    """The verify_stats record for one VerifyTile, feeder fields
-    included (legacy tiles report the same schema with zeroed feeder
-    gauges, so artifact consumers see ONE shape)."""
+    """The verify_stats record for one VerifyTile, feeder + fd_chaos
+    healing fields included (legacy tiles report the same schema with
+    zeroed feeder gauges, so artifact consumers see ONE shape)."""
+    from firedancer_tpu.disco import chaos
+
     lanes = getattr(v, "stat_lanes", 0)
     fill = lanes / float(v.stat_batches * v.batch) if v.stat_batches else 0.0
+    breaker = getattr(v, "_breaker", None)
     st = {
         "batches": v.stat_batches,
         "lanes": lanes,
@@ -77,10 +80,25 @@ def verify_tile_stats(v) -> Dict[str, object]:
         "slot_stall_ms": 0.0,
         "device_idle_est_ms": round(
             getattr(v, "stat_feed_idle_ns", 0) / 1e6, 2),
+        # fd_chaos healing accounting (all zero on a fault-free run):
+        "stager_restarts": getattr(v, "stat_stager_restarts", 0),
+        "cpu_failover": getattr(v, "stat_cpu_failover", 0),
+        "quarantined": getattr(v, "stat_quarantined", 0),
+        "quarantine_err_txn": getattr(v, "stat_quarantine_err_txn", 0),
+        "ctl_err_drop": getattr(v, "stat_ctl_err", 0),
+        "breaker_state": (breaker.state if breaker is not None
+                          else "disabled"),
+        "breaker_trips": breaker.trips if breaker is not None else 0,
+        "breaker_reprobes": breaker.reprobes if breaker is not None else 0,
+        "slots_leaked": 0,
     }
     if getattr(v, "_feed", False):
         st["slot_stall"] = v.feed_pool.slot_stall
         st["slot_stall_ms"] = round(v.feed_pool.stall_ns / 1e6, 2)
+        st["slots_leaked"] = v.feed_pool.outstanding()
+    c = chaos.active()
+    if c is not None:
+        st["chaos"] = c.snapshot()
     return st
 
 
@@ -117,6 +135,11 @@ def run_feed_pipeline(
     """Same contract as pipeline.run_pipeline (which routes here when
     FD_FEED is on and the topology qualifies); returns a PipelineResult
     with feed=True, feeder verify_stats, and per-stage latency."""
+    from firedancer_tpu.disco import chaos
+
+    # Fresh injector per run (no-op with FD_CHAOS off): direct callers
+    # (smoke lanes) get the same determinism contract as run_pipeline.
+    chaos.init_for_run()
     # Tiles import feed.policy at module load; import them lazily here
     # to keep the package import graph acyclic.
     from firedancer_tpu.disco.pipeline import (
@@ -160,6 +183,15 @@ def run_feed_pipeline(
         # run mid-compile and drop the block. In-process tiles let the
         # quiescence check read the pack's pending set directly (the
         # same contract the legacy runner uses).
+        use_proc = False
+    if chaos.active() is not None:
+        # Armed chaos forces in-process placement: the injector and its
+        # tri-counters are process-local, and the parity audit
+        # (injected == detected == healed) only adds up when the
+        # source-side injection sites (ring_ctl_err, credit_starve) and
+        # the verify-side detection sites book into ONE injector.
+        # Supervisor-level classes keep their own multi-process path
+        # (run_supervised), asserted behaviorally per the RUNBOOK.
         use_proc = False
     replay = None
     if not use_proc:

@@ -163,6 +163,14 @@ class SlotPool:
         with self._lock:
             return len(self._ready)
 
+    def outstanding(self) -> int:
+        """Slots not currently FREE (FILLING + READY + dispatched). At
+        post-halt quiescence this must be 0 — the chaos smoke's "no
+        slot is permanently lost from the pool" gate: every fault path
+        (quarantine, failover, stager restart) must return its slot."""
+        with self._lock:
+            return len(self.slots) - len(self._free)
+
     def idle(self) -> bool:
         """True when no slot holds staged-but-undispatched txns (no
         READY backlog, and the stager's FILLING slot — if any — is

@@ -55,6 +55,7 @@ def snapshot(wksp: Workspace, pod: Pod) -> Dict[str, Dict[str, int]]:
         if "cnc" in sub:
             cnc = Cnc(wksp, sub["cnc"])
             from firedancer_tpu.disco.tiles import (
+                CNC_DIAG_BACKOFF_MS,
                 CNC_DIAG_BACKP_CNT,
                 CNC_DIAG_FEED_BATCHES,
                 CNC_DIAG_FEED_DEADLINE,
@@ -65,6 +66,7 @@ def snapshot(wksp: Workspace, pod: Pod) -> Dict[str, Dict[str, int]]:
                 CNC_DIAG_HA_FILT_CNT,
                 CNC_DIAG_HA_FILT_SZ,
                 CNC_DIAG_IN_BACKP,
+                CNC_DIAG_RESTARTS,
                 CNC_DIAG_SV_FILT_CNT,
                 CNC_DIAG_SV_FILT_SZ,
             )
@@ -90,6 +92,10 @@ def snapshot(wksp: Workspace, pod: Pod) -> Dict[str, Dict[str, int]]:
                     "feed_starved_flush": cnc.diag(CNC_DIAG_FEED_STARVED),
                     "feed_slot_stall": cnc.diag(CNC_DIAG_FEED_SLOT_STALL),
                     "feed_idle_ns": cnc.diag(CNC_DIAG_FEED_IDLE_NS),
+                    # Crash-only recovery state (supervisor-written):
+                    # restart count + currently-pending respawn backoff.
+                    "restarts": cnc.diag(CNC_DIAG_RESTARTS),
+                    "backoff_ms": cnc.diag(CNC_DIAG_BACKOFF_MS),
                 })
             out[f"tile.{name}"] = d
         if "fseq" in sub:
@@ -126,7 +132,7 @@ def render(
     lines = []
     lines.append(
         f"{bold}{'TILE':<14}{'state':>6}{'hb-age-ms':>11}{'backp':>8}"
-        f"{'ha-filt':>9}{'sv-filt':>9}{rst}"
+        f"{'ha-filt':>9}{'sv-filt':>9}{'rst':>5}{'boff-ms':>9}{rst}"
     )
     for name, d in sorted(snap.items()):
         if not name.startswith("tile."):
@@ -136,6 +142,7 @@ def render(
             f"{name[5:]:<14}{_SIGNAL_NAMES.get(d['signal'], '?'):>6}"
             f"{hb_age:>11.1f}{d['backp_cnt']:>8}"
             f"{d['ha_filt_cnt']:>9}{d['sv_filt_cnt']:>9}"
+            f"{d.get('restarts', 0):>5}{d.get('backoff_ms', 0):>9}"
         )
     # fd_feed feeder panel: only tiles that actually dispatched feeder
     # batches (verify tiles under fd_feed) — fill%, flush buckets,

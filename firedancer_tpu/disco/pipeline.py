@@ -151,6 +151,11 @@ class PipelineResult:
     # True when the fd_feed ingest runtime produced this result (the
     # legacy step loop remains selectable with FD_FEED=0).
     feed: bool = False
+    # Why a feed-requested run fell back to the legacy step loop (None
+    # when feed ran, or was never requested). A silent fallback once
+    # hid a 5x throughput regression behind a topology change — the
+    # reason is recorded AND warned.
+    feed_fallback_reason: Optional[str] = None
 
 
 def _run_tiles(
@@ -314,36 +319,49 @@ def _run_tiles(
     return res
 
 
-def _feed_supported(pod: Pod, verify_backend: str, verify_batch: int,
-                    verify_opts: Optional[dict]) -> bool:
-    """Can the fd_feed runtime serve this topology? Mirrors VerifyTile's
-    native-drain preconditions (single verify lane, cpu|tpu backend,
-    batch wide enough that any parseable txn fits a fresh slot, native
-    lib built) — anything else silently keeps the legacy step loop, the
-    same graceful degradation the native drain itself uses."""
+def _feed_fallback_reason(pod: Pod, verify_backend: str, verify_batch: int,
+                          verify_opts: Optional[dict]) -> Optional[str]:
+    """None when the fd_feed runtime can serve this topology, else WHY
+    not. Mirrors VerifyTile's native-drain preconditions (single verify
+    lane, cpu|tpu backend, batch wide enough that any parseable txn
+    fits a fresh slot, native lib built) — anything else keeps the
+    legacy step loop, the same graceful degradation the native drain
+    itself uses, but the fallback is warned + recorded in the result
+    (feed_fallback_reason), never silent."""
     from firedancer_tpu.ballet.txn import MAX_SIG_CNT
     from firedancer_tpu.tango.rings import feed_abi_ok, native_available
 
     if verify_backend not in ("cpu", "tpu"):
-        return False
-    if pod.query_ulong("firedancer.layout.verify_lane_cnt", 1) != 1:
-        return False
-    if verify_batch < MAX_SIG_CNT or not native_available():
-        return False
+        return f"verify backend {verify_backend!r} (feed needs cpu|tpu)"
+    lanes = pod.query_ulong("firedancer.layout.verify_lane_cnt", 1)
+    if lanes != 1:
+        return f"verify_lane_cnt={lanes} (feed serves exactly 1 lane)"
+    if verify_batch < MAX_SIG_CNT:
+        return (f"verify_batch={verify_batch} < MAX_SIG_CNT="
+                f"{MAX_SIG_CNT} (a parseable txn must fit a fresh slot)")
+    if not native_available():
+        return "native ring library not built"
     if not feed_abi_ok():
-        return False  # stale .so: drain ABI v2 / bulk publisher absent
+        return ("stale native .so: drain ABI v2 / bulk publisher absent "
+                "(rebuild native/)")
     if verify_opts and verify_opts.get("native_drain") is False:
-        return False
+        return "verify_opts disabled the native drain"
     if verify_opts and verify_opts.get("mesh_devices"):
         # The sharded verify step stays on the legacy runner until the
         # feeder learns to keep several device shards full.
-        return False
+        return "mesh_devices sharded verify (legacy runner only)"
     if verify_backend == "cpu":
         from firedancer_tpu.ballet.ed25519 import native as ed_native
 
         if not ed_native.available():
-            return False
-    return True
+            return "native ed25519 host verifier not built"
+    return None
+
+
+def _feed_supported(pod: Pod, verify_backend: str, verify_batch: int,
+                    verify_opts: Optional[dict]) -> bool:
+    return _feed_fallback_reason(
+        pod, verify_backend, verify_batch, verify_opts) is None
 
 
 def run_pipeline(
@@ -373,24 +391,36 @@ def run_pipeline(
     filtered frags never reach the sink, so the caller asserts on
     PipelineResult.recv_cnt rather than passing an expected count in.
     """
+    from firedancer_tpu.disco import chaos
+
+    chaos.init_for_run()
+    fallback_reason = None
     if feed is None:
         feed = flags.get_bool("FD_FEED")
-    if feed and _feed_supported(topo.pod, verify_backend, verify_batch,
-                                verify_opts):
-        from firedancer_tpu.disco.feed.runtime import run_feed_pipeline
+    if feed:
+        fallback_reason = _feed_fallback_reason(
+            topo.pod, verify_backend, verify_batch, verify_opts)
+        if fallback_reason is None:
+            from firedancer_tpu.disco.feed.runtime import run_feed_pipeline
 
-        return run_feed_pipeline(
-            topo, payloads,
-            verify_backend=verify_backend,
-            verify_batch=verify_batch,
-            verify_max_msg_len=verify_max_msg_len,
-            bank_cnt=bank_cnt,
-            timeout_s=timeout_s,
-            tcache_depth=tcache_depth,
-            verify_opts=verify_opts,
-            record_digests=record_digests,
-            pack_scheduler=pack_scheduler,
-            tile_cpus=tile_cpus,
+            return run_feed_pipeline(
+                topo, payloads,
+                verify_backend=verify_backend,
+                verify_batch=verify_batch,
+                verify_max_msg_len=verify_max_msg_len,
+                bank_cnt=bank_cnt,
+                timeout_s=timeout_s,
+                tcache_depth=tcache_depth,
+                verify_opts=verify_opts,
+                record_digests=record_digests,
+                pack_scheduler=pack_scheduler,
+                tile_cpus=tile_cpus,
+            )
+        import logging
+
+        logging.getLogger("firedancer_tpu.disco.feed").warning(
+            "fd_feed requested but unsupported here — falling back to "
+            "the legacy step loop: %s", fallback_reason,
         )
     pod = topo.pod
     wksp = Workspace.join(topo.wksp_path)
@@ -399,13 +429,15 @@ def run_pipeline(
         out_links=_make_source_out_links(wksp, pod),
         payloads=payloads,
     )
-    return _run_tiles(
+    res = _run_tiles(
         wksp, pod, replay, replay.done,
         verify_backend, verify_batch, verify_max_msg_len, bank_cnt, timeout_s,
         tcache_depth=tcache_depth, verify_opts=verify_opts,
         record_digests=record_digests, pack_scheduler=pack_scheduler,
         tile_cpus=tile_cpus,
     )
+    res.feed_fallback_reason = fallback_reason
+    return res
 
 
 def run_quic_pipeline(

@@ -29,6 +29,10 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from firedancer_tpu import flags
+from firedancer_tpu.disco import chaos
+# Shared with the feeder's stager-thread supervision (one backoff law,
+# two supervision layers); re-exported here as its test-facing home.
+from firedancer_tpu.disco.feed.policy import respawn_backoff_s  # noqa: F401
 from firedancer_tpu.disco.pipeline import (
     LINKS,
     PipelineResult,
@@ -36,6 +40,9 @@ from firedancer_tpu.disco.pipeline import (
     lane_link,
 )
 from firedancer_tpu.tango.rings import Cnc, FSeq, MCache, Workspace
+from firedancer_tpu.utils.rng import Rng
+
+_U64 = (1 << 64) - 1
 
 
 @dataclass
@@ -190,6 +197,7 @@ def _supervised(
     cncs = {n: Cnc(wksp, pod.query_cstr(f"firedancer.{n}.cnc"))
             for n in tile_names}
 
+    chaos.init_for_run()  # worker_kill / hb_stall injection (FD_CHAOS)
     t0 = time.perf_counter()
     deadline = t0 + timeout_s
     settle_needed = 5
@@ -197,6 +205,33 @@ def _supervised(
     last_cursors = None
     last_beat: Dict[str, tuple] = {}
     total_restarts = 0
+    # Respawn backoff policy (crash-only recovery, bounded rate): a
+    # crashed tile waits base * 2^(restarts-1) + jitter before its
+    # respawn — immediate respawn turned a crash-looping tile into a
+    # respawn storm that starved the healthy tiles (and, round 8, never
+    # let a cold compile cache fill). The per-tile restart count and
+    # the currently-pending backoff are mirrored into the tile's cnc
+    # diag so monitors see recovery state through shared memory.
+    backoff_base_s = flags.get_int("FD_SUP_BACKOFF_MS") / 1e3
+    backoff_max_s = flags.get_int("FD_SUP_BACKOFF_MAX_MS") / 1e3
+    backoff_rng = Rng(seq=os.getpid())
+    respawn_due: Dict[str, float] = {}   # name -> perf_counter deadline
+    backoff_gauge: Dict[str, int] = {}   # name -> ms currently published
+    from firedancer_tpu.disco.tiles import (
+        CNC_DIAG_BACKOFF_MS,
+        CNC_DIAG_RESTARTS,
+    )
+    from firedancer_tpu.tango.rings import cnc_diag_cap
+
+    diag16 = cnc_diag_cap() >= 16
+
+    def _publish_backoff(name: str, ms: int) -> None:
+        if not diag16:
+            return
+        prev = backoff_gauge.get(name, 0)
+        if ms != prev:
+            cncs[name].diag_add(CNC_DIAG_BACKOFF_MS, (ms - prev) & _U64)
+            backoff_gauge[name] = ms
     # Progress-scaled deadline (round-3 verdict: fixed wall deadlines
     # made the crash tests cry wolf on loaded hosts). The run is
     # aborted only after stall_timeout_s with NO progress, where
@@ -211,8 +246,30 @@ def _supervised(
             break  # no cursor/heartbeat movement for stall_timeout_s
         if fault_hook is not None:
             fault_hook(tiles, now - t0)
+        c = chaos.active()
+        if c is not None:
+            # Scheduled worker_kill injection (FD_CHAOS): SIGKILL the
+            # verify worker at this monitor-pass ordinal; the crash-only
+            # machinery below is the heal under test.
+            c.supervisor_hook(tiles)
         # Liveness + heartbeat supervision (crash-only recovery).
         for name, tp in tiles.items():
+            due = respawn_due.get(name)
+            if due is not None:
+                # Dead, waiting out its respawn backoff.
+                if now < due:
+                    continue
+                respawn_due.pop(name)
+                _publish_backoff(name, 0)
+                cncs[name].heartbeat(0)
+                fresh = _spawn(name, topo.wksp_path, pod_path,
+                               tile_opts[name], max_ns, result_path,
+                               log_dir=tmp)
+                fresh.restarts = tp.restarts + 1
+                tiles[name] = fresh
+                total_restarts += 1
+                last_beat.pop(name, None)
+                continue
             rc = tp.proc.poll()
             if rc == 0:
                 # Clean exit: the source when exhausted (and any tile
@@ -251,6 +308,20 @@ def _supervised(
                 if tp.proc.poll() is None:
                     tp.proc.kill()
                     tp.proc.wait()
+                if diag16:
+                    cncs[name].diag_add(CNC_DIAG_RESTARTS, 1)
+                delay = respawn_backoff_s(
+                    tp.restarts + 1, backoff_base_s, backoff_max_s,
+                    backoff_rng)
+                if delay > 0.0:
+                    # Exponential backoff + jitter per tile: schedule
+                    # the respawn instead of spawning in-pass, so a
+                    # crash-looping tile is rate-limited and the
+                    # backoff is visible in the monitor panel.
+                    respawn_due[name] = now + delay
+                    _publish_backoff(name, int(delay * 1e3))
+                    last_beat.pop(name, None)
+                    continue
                 # Zero the stale heartbeat BEFORE respawning: the cnc
                 # still holds the dead incarnation's stamp, and a fresh
                 # worker must get the 4x BOOT grace, not the run-loop
@@ -344,6 +415,9 @@ def _supervised(
                 "slot_stall": c.diag(CNC_DIAG_FEED_SLOT_STALL),
                 "device_idle_est_ms": round(
                     c.diag(CNC_DIAG_FEED_IDLE_NS) / 1e6, 2),
+                # Crash-only recovery accounting (supervisor-written):
+                "restarts": c.diag(CNC_DIAG_RESTARTS),
+                "backoff_ms": c.diag(CNC_DIAG_BACKOFF_MS),
             })
 
     sink_fseq = FSeq(wksp, pod.query_cstr("firedancer.pack_sink.fseq"))
@@ -361,4 +435,7 @@ def _supervised(
         verify_stats=verify_stats,
     )
     res.supervisor_restarts = total_restarts  # type: ignore[attr-defined]
+    res.tile_restarts = {  # type: ignore[attr-defined]
+        name: tp.restarts for name, tp in tiles.items() if tp.restarts
+    }
     return res

@@ -30,11 +30,14 @@ import numpy as np
 from firedancer_tpu import flags
 from firedancer_tpu.ballet import ed25519 as oracle
 from firedancer_tpu.ballet.txn import MAX_SIG_CNT, TxnParseError, parse_txn
+from firedancer_tpu.disco import chaos
 from firedancer_tpu.disco.feed.policy import (
     FLUSH_DEADLINE,
     FLUSH_FULL,
     FLUSH_STARVED,
     AdaptiveFlush,
+    CircuitBreaker,
+    respawn_backoff_s,
 )
 from firedancer_tpu.tango import tempo
 from firedancer_tpu.tango.fctl import make_fctl_for_fseqs
@@ -42,6 +45,7 @@ from firedancer_tpu.tango.rings import (
     CNC_BOOT,
     CNC_HALT,
     CNC_RUN,
+    CTL_ERR,
     DIAG_FILT_CNT,
     DIAG_FILT_SZ,
     DIAG_OVRNR_CNT,
@@ -92,8 +96,19 @@ CNC_DIAG_FEED_DEADLINE = 10
 CNC_DIAG_FEED_STARVED = 11
 CNC_DIAG_FEED_SLOT_STALL = 12
 CNC_DIAG_FEED_IDLE_NS = 13
+# Supervisor respawn accounting (written by the SUPERVISOR, read by
+# monitor.py): crash-only restarts of this tile, and the current
+# respawn backoff in ms (a gauge, delta-published). 16-slot ABI only.
+CNC_DIAG_RESTARTS = 14
+CNC_DIAG_BACKOFF_MS = 15
 
 CTL_SOM_EOM = 3
+
+# Cap on the stager-thread restart backoff (thread-scale supervision: a
+# stager outage past ~2 s blows the flush deadline regardless, so the
+# exponential decay stops here; the process supervisor's analogous cap
+# is flag-tunable via FD_SUP_BACKOFF_MAX_MS).
+_STAGER_BACKOFF_CAP_S = 2.0
 
 FD_TPU_MTU = 1232  # disco/quic/fd_quic.h:46-47
 
@@ -215,8 +230,12 @@ class OutLink:
         self.housekeep()
         return self.cr_avail > 0
 
-    def publish(self, payload: bytes, sig: int, tsorig: int = 0) -> None:
-        """Copy payload into the dcache and publish its frag meta."""
+    def publish(self, payload: bytes, sig: int, tsorig: int = 0,
+                ctl: int = CTL_SOM_EOM) -> None:
+        """Copy payload into the dcache and publish its frag meta.
+        `ctl` defaults to SOM|EOM; the quarantine/audit paths publish
+        offending txns with CTL_ERR set so the fault is visible on the
+        ring instead of silently vanishing."""
         if len(payload) > self.mtu:
             # Not an assert: python -O would strip it, and an oversized
             # payload published past the MTU tramples the next frag's
@@ -231,7 +250,7 @@ class OutLink:
         if tsorig:
             self.lat_sample((tspub - tsorig) & 0xFFFFFFFF)
         self.mcache.publish(
-            self.seq, sig, self.chunk, len(payload), CTL_SOM_EOM, tsorig, tspub
+            self.seq, sig, self.chunk, len(payload), ctl, tsorig, tspub
         )
         self.chunk = self.dcache.next_chunk(self.chunk, len(payload), self.mtu)
         self.seq += 1
@@ -259,6 +278,7 @@ class Tile:
         if in_link is not None and in_links is not None:
             raise ValueError("pass in_link or in_links, not both")
         self.wksp = wksp
+        self.cnc_name = cnc_name  # stable tile identity (chaos hb ordinals)
         self.cnc = Cnc(wksp, cnc_name)
         # Multi-input tiles (the mux pattern, mux/fd_mux.h:56-175) poll
         # every in-link round-robin; in_link stays as the first for the
@@ -434,8 +454,17 @@ class Tile:
                 )
                 self._last_in_backp = backp
 
-    def housekeep(self, now: int) -> None:
+    def _beat(self, now: int) -> None:
+        """Publish the cnc heartbeat — unless a chaos hb_stall window is
+        open (the supervised wedge detector is the intended observer of
+        a stalled heartbeat; healing is the kill + respawn)."""
+        c = chaos.active()
+        if c is not None and c.hb_stalled(self.cnc_name):
+            return
         self.cnc.heartbeat(now)
+
+    def housekeep(self, now: int) -> None:
+        self._beat(now)
         for il in self.in_links:
             il.housekeep()
         self._housekeep_out()
@@ -570,10 +599,24 @@ class ReplayTile(Tile):
 
     def step(self) -> None:
         lane = self.out_links[self.pos % len(self.out_links)]
+        c = chaos.active()
+        if c is not None and c.source_starved():
+            # Injected credit starvation: behave exactly like real
+            # backpressure (count + back off) until the window closes.
+            self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
+            time.sleep(20e-6)
+            return
         if not lane.can_publish():
             self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
             time.sleep(20e-6)
             return
+        if c is not None:
+            # Ring-level injection keyed to the upcoming payload ordinal
+            # (1-based): may publish a CTL_ERR frag ahead of it. Re-check
+            # credits afterward — the err frag spent one.
+            c.source_inject(lane, self.pos + 1)
+            if not lane.can_publish():
+                return
         payload = self.payloads[self.pos]
         lane.publish(payload, meta_sig(payload),
                      tsorig=tempo.tickcount() & 0xFFFFFFFF)
@@ -611,6 +654,10 @@ class _InflightBatch:
     # fd_feed cpu path: the staging slot the verify executor is still
     # reading from; released back to the pool when the batch retires.
     slot: object = None
+    # True when the batch went to the PRIMARY verify lane (device, or
+    # the feed cpu executor): its outcome feeds the failover circuit
+    # breaker. CPU-failover and quarantine re-verifies set False.
+    device: bool = False
 
 
 class _ReadyBatch:
@@ -816,6 +863,16 @@ class VerifyTile(Tile):
         self.stat_rlc_fallback = 0
         self.stat_feed_idle_ns = 0     # dispatcher starved-of-slots estimate
         self.stat_ring_dwell_ns: list = []  # publish->drain backlog samples
+        # fd_chaos healing stats (zeros when nothing ever faulted):
+        self.stat_stager_restarts = 0  # feeder thread supervision respawns
+        self.stat_cpu_failover = 0     # batches served by the CPU oracle
+                                       # lane (breaker open / dispatch err)
+        self.stat_quarantined = 0      # poisoned batches re-verified on
+                                       # the CPU oracle lane at completion
+        self.stat_quarantine_err_txn = 0  # offenders published CTL_ERR
+        self.stat_ctl_err = 0          # producer-flagged err frags dropped
+        # Device->CPU failover circuit (fd_feed mode; None elsewhere).
+        self._breaker: Optional[CircuitBreaker] = None
         # Feeder gauge mirror (CNC_DIAG_FEED_*): published by EVERY
         # verify tile — legacy tiles report batches/lanes/flush buckets
         # too, so the supervisor's cross-process verify_stats are never
@@ -957,8 +1014,10 @@ class VerifyTile(Tile):
         self._nd_lib = rings_lib()
         self._nd_ct = ctypes
         self._nd_abi2 = verify_drain_abi2()
-        self._nd_counters = np.zeros(6, np.uint64)
-        self._nd_prev = np.zeros(6, np.uint64)
+        # 8 slots: the current drain ABI appends {ctl_err, ctl_err_bytes}
+        # at [6]/[7]; a stale .so writes only [0..5] and the pair stays 0.
+        self._nd_counters = np.zeros(8, np.uint64)
+        self._nd_prev = np.zeros(8, np.uint64)
 
     def _nd_setup(self) -> None:
         self._nd_bindings()
@@ -1022,6 +1081,56 @@ class VerifyTile(Tile):
         self._feed_thread: Optional[_threading.Thread] = None
         self._feed_slot = None          # current FILLING slot (stager-owned)
         self._feed_idle_mark = 0        # dispatcher idle-window anchor
+        # Device->CPU verify failover circuit: consecutive primary-lane
+        # errors trip it, the CPU oracle lane serves while it is open,
+        # and a half-open probe restores the primary path once the
+        # device recovers — device loss costs throughput, not liveness.
+        if flags.get_bool("FD_VERIFY_BREAKER"):
+            self._breaker = CircuitBreaker(
+                threshold=flags.get_int("FD_VERIFY_BREAKER_THRESHOLD"),
+                cooldown_ns=flags.get_int(
+                    "FD_VERIFY_BREAKER_COOLDOWN_MS") * 1_000_000,
+            )
+        # Feeder-internal thread supervision (crash-only, like the
+        # process supervisor one level up): a dead stager is restarted
+        # with exponential backoff instead of taking the whole feeder
+        # down; staged slots (READY backlog + the FILLING arena) are
+        # preserved across the restart. Beyond the restart budget the
+        # feeder fails loudly — a permanently crashing stager is a bug,
+        # not an operational fault.
+        self._stager_restart_max = flags.get_int("FD_FEED_STAGER_RESTART_MAX")
+        self._stager_backoff_ns = flags.get_int(
+            "FD_FEED_STAGER_BACKOFF_MS") * 1_000_000
+        self._stager_restart_at = 0     # 0 = no restart pending
+        self._stager_err_cls: Optional[str] = None
+
+    def _nd_account(self, il) -> bool:
+        """Fold one native drain round's counter deltas into the diag
+        counters (parse errors, oversize, CTL_ERR drops, overruns) and
+        the chaos audit; returns True when the round crossed an
+        overrun. Shared by the legacy staging path and the stager."""
+        d = self._nd_counters - self._nd_prev
+        self._nd_prev = self._nd_counters.copy()
+        if d[1] or d[3]:  # parse errors + oversize -> sv filter diag
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, int(d[1] + d[3]))
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, int(d[4] + d[5]))
+        c = chaos.active()
+        if d[6]:
+            # Producer-flagged CTL_ERR frags dropped at the ctl word
+            # (never staged): filtered traffic, and the detection+heal
+            # of the chaos ring_ctl_err class.
+            self.stat_ctl_err += int(d[6])
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, int(d[6]))
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, int(d[7]))
+            if c is not None:
+                c.on_ctl_err_drop(int(d[6]))
+        overrun = False
+        if d[2]:
+            il.fseq.diag_add(DIAG_OVRNR_CNT, int(d[2]))
+            overrun = True
+            if c is not None:
+                c.on_overrun_observed()
+        return overrun
 
     def poll_inputs(self):
         if self._feed:
@@ -1055,15 +1164,7 @@ class VerifyTile(Tile):
               if self._nd_abi2 else []),
             self._nd_counters.ctypes.data,
         )
-        overrun = False
-        d = self._nd_counters - self._nd_prev
-        self._nd_prev = self._nd_counters.copy()
-        if d[1] or d[3]:  # parse errors + oversize -> sv filter diag
-            self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, int(d[1] + d[3]))
-            self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, int(d[4] + d[5]))
-        if d[2]:
-            il.fseq.diag_add(DIAG_OVRNR_CNT, int(d[2]))
-            overrun = True
+        overrun = self._nd_account(il)
         if n <= 0:
             il.seq = seq.value
             if not self._pending and not self._inflight:
@@ -1125,6 +1226,67 @@ class VerifyTile(Tile):
         self._feed_thread = t
         t.start()
 
+    def _stager_supervise(self) -> None:
+        """Feeder-internal crash-only supervision of the stager thread:
+        a raise out of the stager loop is DETECTED here (the dispatcher
+        keeps running — it still retires in-flight batches and ships the
+        READY backlog), and the stager is restarted after an
+        exponential backoff with jitter. Nothing staged is lost across
+        the restart: the READY queue lives in the SlotPool, the FILLING
+        arena stays parked in self._feed_slot, and the in-ring cursor
+        plus held-back ack cover anything the dead incarnation had
+        consumed (the property tests/test_chaos.py pins). Past the
+        restart budget the original error is re-raised — the old
+        fail-loudly behavior for genuinely broken code."""
+        err = self._feed_stager_err
+        if err is not None:
+            self._feed_stager_err = None
+            self.stat_stager_restarts += 1
+            c = chaos.active()
+            if c is not None and isinstance(err, chaos.ChaosFault):
+                c.note(err.cls, "detected")
+                self._stager_err_cls = err.cls
+            if self.stat_stager_restarts > self._stager_restart_max:
+                raise RuntimeError(
+                    f"fd_feed stager died {self.stat_stager_restarts} times "
+                    f"(> FD_FEED_STAGER_RESTART_MAX="
+                    f"{self._stager_restart_max}); giving up"
+                ) from err
+            # Same backoff law as the process supervisor's tile respawn
+            # (feed/policy.respawn_backoff_s), with a thread-scale cap:
+            # a stager outage beyond 2 s would blow the flush deadline
+            # anyway, so decaying further buys nothing.
+            backoff_s = respawn_backoff_s(
+                self.stat_stager_restarts,
+                self._stager_backoff_ns / 1e9,
+                _STAGER_BACKOFF_CAP_S,
+                self.rng,
+            )
+            self._stager_restart_at = (
+                tempo.tickcount() + int(backoff_s * 1e9))
+            import logging
+
+            logging.getLogger("firedancer_tpu.disco.feed").warning(
+                "fd_feed stager died (%r); restart %d/%d in %.1f ms",
+                err, self.stat_stager_restarts, self._stager_restart_max,
+                backoff_s * 1e3,
+            )
+            return
+        if (
+            self._stager_restart_at
+            and not self._feed_stop.is_set()
+            and (self._feed_thread is None
+                 or not self._feed_thread.is_alive())
+            and tempo.tickcount() >= self._stager_restart_at
+        ):
+            self._stager_restart_at = 0
+            self._feed_start()
+            if self._stager_err_cls is not None:
+                c = chaos.active()
+                if c is not None:
+                    c.note(self._stager_err_cls, "healed")
+                self._stager_err_cls = None
+
     def _stager_drain(self, slot) -> int:
         """One fd_verify_drain round into `slot` at its current fill
         cursors. Per-txn bookkeeping stays in the slot's numpy sidecar
@@ -1132,6 +1294,14 @@ class VerifyTile(Tile):
         per-txn Python here is the HA-tcache insert of the drain's FNV
         tag. Returns staged txn count; updates diag counters."""
         il = self.in_link
+        c = chaos.active()
+        if c is not None:
+            # Injection points, both state-clean (before the C call, so
+            # a raise leaves no half-booked slot): scheduled stager
+            # death, and the consumer-side cursor rewind that produces
+            # a deterministic overrun on the next poll.
+            c.stager_round_hook()
+            c.overrun_rewind(il)
         ct = self._nd_ct
         k0 = slot.n_txn
         seq = ct.c_uint64(il.seq)
@@ -1155,13 +1325,7 @@ class VerifyTile(Tile):
             slot.hashes.ctypes.data + k0 * 8,
             self._nd_counters.ctypes.data,
         )
-        d = self._nd_counters - self._nd_prev
-        self._nd_prev = self._nd_counters.copy()
-        if d[1] or d[3]:  # parse errors + oversize -> sv filter diag
-            self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, int(d[1] + d[3]))
-            self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, int(d[4] + d[5]))
-        if d[2]:
-            il.fseq.diag_add(DIAG_OVRNR_CNT, int(d[2]))
+        self._nd_account(il)
         if n <= 0:
             il.seq = seq.value  # consumed-but-unstageable (errors) frags
             if (
@@ -1207,6 +1371,12 @@ class VerifyTile(Tile):
         if ha_filt_cnt:
             self.cnc.diag_add(CNC_DIAG_HA_FILT_CNT, ha_filt_cnt)
             self.cnc.diag_add(CNC_DIAG_HA_FILT_SZ, ha_filt_sz)
+        if c is not None:
+            # slot_corrupt injection: flip one staged MESSAGE byte of a
+            # txn from this round (lanes started at the pre-round
+            # n_lane). The sidecar payload stays pristine — sigverify
+            # must fail exactly that txn and the pool must carry on.
+            c.post_stage_hook(slot, k0, n, lane0=slot.n_lane)
         last = k0 + n - 1
         slot.pay_fill = int(slot.offs[last]) + int(slot.plens[last])
         slot.n_lane += int(slot.tlanes[k0 : k0 + n].sum())
@@ -1305,34 +1475,154 @@ class VerifyTile(Tile):
             slot.lens[slot.n_lane:] = 0
             slot.sigs[slot.n_lane:] = 0
             slot.pubs[slot.n_lane:] = 0
-        if self.backend == "cpu":
-            from firedancer_tpu.ballet.ed25519 import native as ed_native
+        out = None
+        via_device = False
+        c = chaos.active()
+        now = tempo.tickcount()
+        allow = self._breaker is None or self._breaker.allow_device(now)
+        fault_cls = None
+        if allow:
+            try:
+                if c is not None:
+                    c.verify_dispatch_hook()  # may raise ChaosDeviceLost
+                if self.backend == "cpu":
+                    from firedancer_tpu.ballet.ed25519 import (
+                        native as ed_native,
+                    )
 
-            out = _FutureBatch(self._feed_exec.submit(
-                ed_native.verify_arrays,
-                slot.msgs, slot.lens, slot.sigs, slot.pubs, slot.n_lane,
-            ))
-        else:
-            jnp = self._jnp
-            out = self._verify_batch_fn(
-                jnp.asarray(slot.msgs),
-                jnp.asarray(slot.lens.astype(np.int32)),
-                jnp.asarray(slot.sigs),
-                jnp.asarray(slot.pubs),
-            )
+                    out = _FutureBatch(self._feed_exec.submit(
+                        ed_native.verify_arrays,
+                        slot.msgs, slot.lens, slot.sigs, slot.pubs,
+                        slot.n_lane,
+                    ))
+                else:
+                    jnp = self._jnp
+                    out = self._verify_batch_fn(
+                        jnp.asarray(slot.msgs),
+                        jnp.asarray(slot.lens.astype(np.int32)),
+                        jnp.asarray(slot.sigs),
+                        jnp.asarray(slot.pubs),
+                    )
+                via_device = True
+            except Exception as e:
+                # Device unavailable at dispatch (or the executor
+                # refused the batch): feed the breaker and fall through
+                # to the CPU oracle lane — the slot is NEVER lost to a
+                # dispatch failure, and the loop keeps running.
+                if self._breaker is not None:
+                    self._breaker.record_error(now)
+                if c is not None and isinstance(e, chaos.ChaosFault):
+                    c.note(e.cls, "detected")
+                    fault_cls = e.cls
+        if out is None:
+            out = _ReadyBatch(self._verify_slot_cpu(slot))
+            self.stat_cpu_failover += 1
+            if fault_cls is not None and c is not None:
+                c.note(fault_cls, "healed")
         self._inflight.append(_InflightBatch(
             out=out, todo=[], oversize=[False] * self.batch,
-            t_dispatch=tempo.tickcount(), slot=slot,
+            t_dispatch=tempo.tickcount(), slot=slot, device=via_device,
         ))
         self.stat_batches += 1
         self.stat_lanes += slot.n_lane
 
-    def _publish_feed_batch(self, slot, statuses) -> int:
+    def _verify_slot_cpu(self, slot):
+        """The CPU oracle lane over a staged slot: the failover target
+        when the device (or verify executor) is gone, and the re-verify
+        engine of the poisoned-batch quarantine. Bisection ladder: the
+        native batch verifier first; if IT raises, per-lane through the
+        pure-Python RFC 8032 oracle — the lane of last resort cannot
+        itself be an offload."""
+        from firedancer_tpu.ballet.ed25519 import native as ed_native
+
+        if ed_native.available():
+            try:
+                return np.asarray(ed_native.verify_arrays(
+                    slot.msgs, slot.lens, slot.sigs, slot.pubs,
+                    slot.n_lane,
+                ))
+            except Exception:
+                pass  # bisect further: per-lane oracle below
+        from firedancer_tpu.ballet.ed25519 import oracle as ed_oracle
+
+        out = np.ones(self.batch, np.int32)
+        for lane in range(slot.n_lane):
+            ln = int(slot.lens[lane])
+            out[lane] = ed_oracle.verify(
+                slot.msgs[lane, :ln].tobytes(),
+                slot.sigs[lane].tobytes(),
+                slot.pubs[lane].tobytes(),
+            )
+        return out
+
+    def _oracle_verify_payload(self, payload: bytes) -> bool:
+        """Whole-txn CPU oracle verdict (quarantine lane for batches
+        staged outside slot arenas)."""
+        try:
+            txn = parse_txn(payload)
+            items = list(txn.verify_items(payload))
+        except TxnParseError:
+            return False
+        from firedancer_tpu.ballet.ed25519 import native as ed_native
+
+        if ed_native.available():
+            try:
+                return all(st == 0 for st in ed_native.verify_items(items))
+            except Exception:
+                pass
+        from firedancer_tpu.ballet.ed25519 import oracle as ed_oracle
+
+        return all(
+            ed_oracle.verify(msg, sig, pub) == 0 for (sig, pub, msg) in items
+        )
+
+    def _quarantine_statuses(self, ib):
+        """Poisoned-batch quarantine: the batch's verify raised, so its
+        result is untrusted — bisect to the CPU oracle lane and produce
+        per-lane statuses in the batch's own layout. Clean txns go on
+        to publish normally (an injected/transient backend error loses
+        nothing); genuinely bad txns fail here and are published with
+        CTL_ERR by the completion path."""
+        if ib.slot is not None:
+            return self._verify_slot_cpu(ib.slot)
+        return self._oracle_statuses_todo(ib.todo)
+
+    def _oracle_statuses_todo(self, todo):
+        """Per-lane statuses for a todo-list batch (legacy staging
+        layout) from whole-txn CPU oracle verdicts — the quarantine
+        re-verify for batches staged outside slot arenas."""
+        statuses = np.ones(self.batch, np.int32)
+        off = 0
+        for payload, cnt, _tsorig, _seq_end in todo:
+            ok = payload is None or self._oracle_verify_payload(payload)
+            statuses[off:off + cnt] = 0 if ok else 1
+            off += cnt
+        return statuses
+
+    def _publish_err(self, payload: bytes, sig: int) -> None:
+        """Quarantine audit trail: an offending txn goes downstream as a
+        CTL_ERR frag — visible on the ring (dedup counts + drops it
+        without letting it shadow a valid same-sig txn) instead of
+        silently vanishing. Same HALT/backpressure discipline as
+        publish_backp."""
+        while not self.out_link.can_publish():
+            if self.cnc.signal_query() == CNC_HALT:
+                return
+            self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
+            time.sleep(20e-6)
+        self.out_link.publish(payload, sig, ctl=CTL_SOM_EOM | CTL_ERR)
+        self.stat_quarantine_err_txn += 1
+
+    def _publish_feed_batch(self, slot, statuses,
+                            quarantined: bool = False) -> int:
         """Completion half of the feeder: fold per-lane statuses to
         per-txn verdicts (numpy reduceat over the slot's lane counts)
         and publish every passing, non-HA-duplicate txn downstream with
         ONE bulk native call per credit window. Returns the batch's ack
-        target (the in-ring seq after the slot's last drain round)."""
+        target (the in-ring seq after the slot's last drain round).
+        quarantined=True (the batch's verify raised and these statuses
+        came from the CPU oracle lane) additionally publishes each
+        offending txn with CTL_ERR — the audit trail of the quarantine."""
         n = slot.n_txn
         if n == 0:
             return slot.drain_end
@@ -1349,6 +1639,19 @@ class VerifyTile(Tile):
             self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, sv_cnt)
             self.cnc.diag_add(
                 CNC_DIAG_SV_FILT_SZ, int(slot.plens[:n][sv].sum()))
+            c = chaos.active()
+            if c is not None:
+                # slot_corrupt audit: consume corruption records whose
+                # txn just failed sigverify (the drop IS the heal).
+                c.on_sv_drop(slot.psigs[:n][sv])
+            if quarantined:
+                for t in np.nonzero(sv)[0]:
+                    off_b = int(slot.offs[t])
+                    ln = int(slot.plens[t])
+                    self._publish_err(
+                        slot.pay[off_b:off_b + ln].tobytes(),
+                        int(slot.psigs[t]),
+                    )
         n_ok = int(ok.sum())
         if not n_ok:
             return slot.drain_end
@@ -1413,13 +1716,7 @@ class VerifyTile(Tile):
         drive to zero)."""
         if not self._feed_started:
             self._feed_start()
-        if self._feed_stager_err is not None:
-            # A dead stager is a dead feeder: re-raise on the dispatch
-            # thread so the tile fails loudly instead of the pipeline
-            # quiescing empty at timeout.
-            raise RuntimeError(
-                "fd_feed stager thread died"
-            ) from self._feed_stager_err
+        self._stager_supervise()
         self._complete(block=False)
         progressed = False
         while len(self._inflight) < self.inflight_max:
@@ -1479,15 +1776,22 @@ class VerifyTile(Tile):
         while len(self._inflight) >= self.inflight_max:
             self.stat_inflight_stall += 1
             self._complete(block=True)
+        via_device = False
         if self.backend == "cpu":
             # Host path: one synchronous C call over the staged rows —
             # no copies (the buffers are free to reuse once it returns).
             from firedancer_tpu.ballet.ed25519 import native as ed_native
 
-            out = _ReadyBatch(ed_native.verify_arrays(
-                self._nd_msgs, self._nd_lens, self._nd_sigs,
-                self._nd_pubs, self._pending_lanes,
-            ))
+            try:
+                out = _ReadyBatch(ed_native.verify_arrays(
+                    self._nd_msgs, self._nd_lens, self._nd_sigs,
+                    self._nd_pubs, self._pending_lanes,
+                ))
+            except Exception:
+                # Verifier raised mid-batch: quarantine inline (per-txn
+                # CPU oracle verdicts) instead of killing the tile.
+                self.stat_quarantined += 1
+                out = _ReadyBatch(self._oracle_statuses_todo(self._pending))
         else:
             if self._pending_lanes < self.batch:
                 # Stale rows from the previous batch must verify as pad
@@ -1503,6 +1807,7 @@ class VerifyTile(Tile):
                 jnp.asarray(self._nd_sigs.copy()),
                 jnp.asarray(self._nd_pubs.copy()),
             )
+            via_device = True
         todo = self._pending
         self.stat_lanes += self._pending_lanes
         self._pending = []
@@ -1510,7 +1815,7 @@ class VerifyTile(Tile):
         self._nd_pay_fill = 0
         self._inflight.append(_InflightBatch(
             out=out, todo=todo, oversize=[False] * self.batch,
-            t_dispatch=tempo.tickcount(),
+            t_dispatch=tempo.tickcount(), device=via_device,
         ))
         self.stat_batches += 1
 
@@ -1522,6 +1827,18 @@ class VerifyTile(Tile):
             self._acked_seq = frag.seq + 1
 
     def on_frag(self, frag: Frag, payload: bytes) -> None:
+        if frag.ctl & CTL_ERR:
+            # Producer-flagged error frag (the Python-path analog of the
+            # native drain's ctl word drop): filter, never verify.
+            self.stat_ctl_err += 1
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, 1)
+            self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, len(payload))
+            c = chaos.active()
+            if c is not None:
+                c.on_ctl_err_drop(1)
+            self._ack_inline(frag)
+            self._flush_if_due()
+            return
         try:
             txn = parse_txn(payload)
         except TxnParseError:
@@ -1648,7 +1965,7 @@ class VerifyTile(Tile):
         # backpressure diag mirror, on_housekeep's max-wait backstop)
         # must still run — the base housekeep minus the in-link fseq
         # publication, which is replaced by the verified cursor above.
-        self.cnc.heartbeat(now)
+        self._beat(now)
         for il in self.in_links:
             il.fseq.update(min(self._acked_seq, il.seq))
         self._publish_unacked()
@@ -1684,8 +2001,15 @@ class VerifyTile(Tile):
             if self._feed_thread is not None:
                 self._feed_thread.join(timeout=10.0)
             slot = self._feed_slot
-            if slot is not None and slot.n_txn:
-                self._feed_commit(slot)
+            if slot is not None:
+                if slot.n_txn:
+                    self._feed_commit(slot)
+                else:
+                    # An empty FILLING slot must return to FREE, or the
+                    # pool-integrity audit (slots_leaked) reads a
+                    # phantom leak at every shutdown.
+                    self._feed_slot = None
+                    self.feed_pool.release(slot)
             while True:
                 s = self.feed_pool.pop_ready()
                 if s is None:
@@ -1759,7 +2083,7 @@ class VerifyTile(Tile):
             oversize = [len(msg) > self.max_msg_len for (_, _, msg) in flat]
             self._inflight.append(_InflightBatch(
                 out=out, todo=todo, oversize=oversize,
-                t_dispatch=tempo.tickcount(),
+                t_dispatch=tempo.tickcount(), device=True,
             ))
             self.stat_batches += 1
             self.stat_lanes += len(flat)
@@ -1775,13 +2099,41 @@ class VerifyTile(Tile):
             ib = self._inflight[0]
             if not block and not ib.out.is_ready():
                 return
-            statuses = np.asarray(ib.out)  # blocks only if not ready
-            if getattr(ib.out, "used_fallback", False):
-                self.stat_rlc_fallback += 1
+            c = chaos.active()
+            quarantined = False
+            try:
+                if c is not None:
+                    c.verify_complete_hook()  # may raise ChaosBackendError
+                statuses = np.asarray(ib.out)  # blocks only if not ready
+            except Exception as e:
+                # Poisoned batch: the verify raised instead of returning
+                # statuses. Quarantine — re-verify the whole batch on
+                # the CPU oracle lane (offenders will publish CTL_ERR,
+                # clean txns publish normally) — so a backend error
+                # fails at most the txns that deserve it and the slot
+                # always returns to the pool. Device-lane failures also
+                # feed the failover breaker.
+                quarantined = True
+                self.stat_quarantined += 1
+                if ib.device and self._breaker is not None:
+                    self._breaker.record_error(tempo.tickcount())
+                fault_cls = (e.cls if isinstance(e, chaos.ChaosFault)
+                             else None)
+                if c is not None and fault_cls is not None:
+                    c.note(fault_cls, "detected")
+                statuses = self._quarantine_statuses(ib)
+                if c is not None and fault_cls is not None:
+                    c.note(fault_cls, "healed")
+            if not quarantined:
+                if ib.device and self._breaker is not None:
+                    self._breaker.record_success()
+                if getattr(ib.out, "used_fallback", False):
+                    self.stat_rlc_fallback += 1
             if ib.slot is not None:
                 # fd_feed batch: verdicts + publishes straight off the
                 # slot's sidecar arrays (one bulk native call).
-                batch_ack = self._publish_feed_batch(ib.slot, statuses)
+                batch_ack = self._publish_feed_batch(
+                    ib.slot, statuses, quarantined=quarantined)
             else:
                 off = 0
                 batch_ack = 0
@@ -1794,6 +2146,8 @@ class VerifyTile(Tile):
                     over = any(ib.oversize[off : off + cnt])
                     ok = cnt > 0 and not over and bool((lane == 0).all())
                     self._finish(payload, ok, tsorig=tsorig)
+                    if quarantined and not ok:
+                        self._publish_err(payload, meta_sig(payload))
                     off += cnt
             # Pop only AFTER the batch's results are published: the
             # supervisor's quiescence check reads _inflight from another
@@ -1840,6 +2194,14 @@ class DedupTile(Tile):
         self.tcache = TCache(tcache_depth)
 
     def on_frag(self, frag: Frag, payload: bytes) -> None:
+        if frag.ctl & CTL_ERR:
+            # Quarantine audit frags (verify's CTL_ERR offenders) end
+            # here: counted + dropped BEFORE the tcache insert — a
+            # poisoned copy must never shadow the valid same-sig txn
+            # out of the dedup window.
+            self.in_cur.fseq.diag_add(DIAG_FILT_CNT, 1)
+            self.in_cur.fseq.diag_add(DIAG_FILT_SZ, frag.sz)
+            return
         if self.tcache.insert(frag.sig):
             self.in_cur.fseq.diag_add(DIAG_FILT_CNT, 1)
             self.in_cur.fseq.diag_add(DIAG_FILT_SZ, frag.sz)

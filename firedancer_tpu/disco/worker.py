@@ -177,9 +177,14 @@ def main(argv=None) -> int:
                           _os.path.join(repo, ".jax_cache"))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+    from firedancer_tpu.disco import chaos
     from firedancer_tpu.tango.rings import Cnc, Workspace
     from firedancer_tpu.utils.pod import Pod
 
+    # Workers inherit the run's FD_CHAOS env: each process installs its
+    # own injector (counters are process-local; supervised-run fault
+    # classes are asserted behaviorally, not through the tri-counter).
+    chaos.init_for_run()
     wksp = Workspace.join(args.wksp)
     with open(args.pod, "rb") as f:
         pod = Pod.deserialize(f.read())

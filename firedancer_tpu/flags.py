@@ -273,6 +273,82 @@ _register(
 )
 
 # --------------------------------------------------------------------------
+# fd_chaos fault injection + the self-healing machinery it proves out
+# (disco/chaos.py; all read per run).
+# --------------------------------------------------------------------------
+
+_register(
+    "FD_CHAOS", bool, False,
+    "Arm the fd_chaos deterministic fault-injection layer for the run: "
+    "every pipeline runner (and worker process) installs a fresh "
+    "ChaosInjector from FD_CHAOS_SEED + FD_CHAOS_SCHEDULE at boot. "
+    "Off (default) in production — the healing machinery it exercises "
+    "(stager supervision, verify breaker, quarantine) is always on.",
+)
+_register(
+    "FD_CHAOS_SEED", int, 0,
+    "Seed for the chaos injector's counter-based Rng (byte/position "
+    "choices of corrupting faults). Same seed + schedule + corpus "
+    "replays the same faults bit-identically.",
+)
+_register(
+    "FD_CHAOS_SCHEDULE", str, None,
+    "Chaos schedule: 'class@N[,class@N:M,...]' with 1-based ordinals "
+    "of each class's hook site (publish attempt, stager drain round, "
+    "dispatch, completion, housekeep pass, monitor pass). Classes: "
+    "ring_ctl_err, ring_overrun, credit_starve, stager_kill, "
+    "slot_corrupt, backend_raise, device_lost, hb_stall, worker_kill; "
+    "windowed classes (credit_starve, device_lost, hb_stall) take N:M. "
+    "Unknown classes or malformed ordinals raise — a typo'd schedule "
+    "must never silently inject nothing.",
+)
+_register(
+    "FD_VERIFY_BREAKER", bool, True,
+    "Device->CPU verify failover circuit breaker in the fd_feed "
+    "dispatcher: consecutive primary-lane verify errors trip it, the "
+    "CPU oracle lane serves while open, and a half-open probe restores "
+    "the device path once it recovers (device loss degrades throughput, "
+    "not liveness). '0' disables — a dispatch error then falls back "
+    "per-batch without tripping.",
+)
+_register(
+    "FD_VERIFY_BREAKER_THRESHOLD", int, 3,
+    "Consecutive device verify errors (while the breaker is closed) "
+    "that trip it open. One transient error followed by a success "
+    "resets the count — that is the quarantine path's job.",
+)
+_register(
+    "FD_VERIFY_BREAKER_COOLDOWN_MS", int, 100,
+    "Open-circuit cooldown before a half-open re-probe of the device "
+    "path. A failed probe re-opens with the cooldown doubled (up to "
+    "8x), so a dead device is re-probed at a decaying rate.",
+)
+_register(
+    "FD_FEED_STAGER_RESTART_MAX", int, 5,
+    "fd_feed stager-thread supervision budget: restarts allowed before "
+    "the feeder gives up and re-raises the stager's error (a "
+    "permanently crashing stager is a bug, not an operational fault). "
+    "Staged slots survive each restart.",
+)
+_register(
+    "FD_FEED_STAGER_BACKOFF_MS", int, 10,
+    "Base delay before a crashed stager thread is restarted; doubles "
+    "per consecutive restart (capped at 2 s) with +0-25% jitter.",
+)
+_register(
+    "FD_SUP_BACKOFF_MS", int, 200,
+    "Supervisor respawn backoff base per tile: a crashed tile is "
+    "respawned after base * 2^(restarts-1) ms (+0-25% jitter, capped "
+    "by FD_SUP_BACKOFF_MAX_MS), so a crash-looping tile cannot drive "
+    "a respawn storm (the round-8 boot-grace fix papered over exactly "
+    "that). 0 restores the seed's immediate-respawn behavior.",
+)
+_register(
+    "FD_SUP_BACKOFF_MAX_MS", int, 5000,
+    "Cap on the supervisor's per-tile exponential respawn backoff.",
+)
+
+# --------------------------------------------------------------------------
 # bench.py ladder knobs (orchestrator + workers).
 # --------------------------------------------------------------------------
 

@@ -281,6 +281,18 @@ def verify_drain_abi2() -> bool:
         return False
 
 
+def verify_drain_ctl_err() -> bool:
+    """True when fd_verify_drain drops CTL_ERR frags at the ctl word
+    (counters[6]/[7], current ABI). A stale .so stages err frags like
+    any other — their payloads then fail at parse, so nothing poisoned
+    verifies, but the chaos ring_ctl_err audit needs the typed drop
+    counter and refuses to run without it."""
+    try:
+        return hasattr(lib(), "fd_verify_drain_ctl_err")
+    except Exception:
+        return False
+
+
 def feed_abi_ok() -> bool:
     """The fd_feed runtime's native surface: drain ABI v2 (tspub + HA
     hash outputs) plus the bulk completion publisher. Absent on a stale

@@ -179,8 +179,11 @@ int fd_txn_parse_check(const uint8_t *buf, uint32_t len, uint32_t *out5) {
 //                    payload bytes just to hash them
 //   (both v2 outputs are absent from stale builds — probe
 //    fd_verify_drain_abi2 before passing them)
-//   counters       : u64[6] {drained_ok, parse_err, overrun, oversize,
-//                    parse_err_bytes, oversize_bytes}
+//   counters       : u64[8] {drained_ok, parse_err, overrun, oversize,
+//                    parse_err_bytes, oversize_bytes, ctl_err,
+//                    ctl_err_bytes} (the ctl_err pair is written only
+//                    by builds carrying fd_verify_drain_ctl_err —
+//                    Python sizes the array at 8 either way)
 //
 // A txn with message bytes > max_msg_len is counted oversize and NOT
 // staged (the tile oracles/fails it; cannot happen under the MTU with
@@ -193,6 +196,13 @@ int fd_txn_parse_check(const uint8_t *buf, uint32_t len, uint32_t *out5) {
 // before passing them, so a stale .so keeps the old call shape (same
 // convention as fd_frag_drain_has_ctl).
 int fd_verify_drain_abi2(void) { return 2; }
+
+// ABI marker: this build drops CTL_ERR frags at the ctl word (counted
+// in counters[6]/[7]) instead of staging them — a producer-flagged
+// error frag must never reach sigverify looking like a clean txn.
+// Probed by firedancer_tpu.tango.rings.verify_drain_ctl_err(); a stale
+// .so stages err frags as before (their payloads then fail parse).
+int fd_verify_drain_ctl_err(void) { return 1; }
 
 int fd_verify_drain(void *mcache, void *dcache_base, uint64_t *seq_io,
                     uint32_t max_txns, uint32_t max_lanes,
@@ -226,6 +236,7 @@ int fd_verify_drain(void *mcache, void *dcache_base, uint64_t *seq_io,
     uint64_t sig = m->sig.load(std::memory_order_relaxed);
     uint32_t chunk = m->chunk.load(std::memory_order_relaxed);
     uint16_t sz = m->sz.load(std::memory_order_relaxed);
+    uint16_t ctl = m->ctl.load(std::memory_order_relaxed);
     uint32_t tsorig = m->tsorig.load(std::memory_order_relaxed);
     uint32_t tspub = m->tspub.load(std::memory_order_relaxed);
     // Copy the payload out BEFORE revalidating the seqlock.
@@ -235,6 +246,13 @@ int fd_verify_drain(void *mcache, void *dcache_base, uint64_t *seq_io,
     std::atomic_thread_fence(std::memory_order_seq_cst);
     if (m->seq.load(std::memory_order_acquire) != seq) {
       counters[2] += 1;  // overwritten mid-copy
+      seq += 1;
+      continue;
+    }
+
+    if (ctl & 4u) {  // CTL_ERR: producer flagged the frag poisoned
+      counters[6] += 1;
+      counters[7] += cp;
       seq += 1;
       continue;
     }

@@ -1,0 +1,176 @@
+"""fd_chaos smoke — the ci.sh fault-injection lane (JAX_PLATFORMS=cpu).
+
+Drives one mainnet-shaped corpus through the CPU-backend fd_feed replay
+pipeline twice and prints ONE JSON line:
+
+  oracle    FD_CHAOS off: the reference run, recording the expected
+            sink digest multiset (which disco/corpus.py already pins
+            by construction — the run double-checks it end to end).
+  chaos     the SAME corpus under a fixed seeded schedule covering 7
+            distinct fault classes, every boundary the pipeline
+            crosses: ring (CTL_ERR frag, consumer overrun, credit
+            starvation), feed (stager thread killed mid-stream, staged
+            slot byte-flip), verify (backend raise at completion,
+            device loss at dispatch — trips the failover breaker).
+
+Gates (exit nonzero on any):
+  * liveness: the chaos replay COMPLETES and the sink receives every
+    unique valid txn except those whose staged arena was corrupted,
+  * bit-exactness: the chaos sink content equals the oracle content
+    minus exactly the corrupted txns (nothing else lost, nothing
+    poisoned leaked through),
+  * audit parity: every scheduled fault class reports
+    injected == detected == healed, with injected >= 1,
+  * pool integrity: slots_leaked == 0 (no staging slot is permanently
+    lost to any fault path),
+  * failover: the device-loss window tripped the circuit breaker, the
+    CPU lane served while it was open, and the half-open re-probe
+    restored the device path (breaker_state back to closed).
+
+Determinism contract: the schedule is ordinal-based and the byte/
+position choices come from a counter-based Rng seeded by
+FD_CHAOS_SEED, so a failing run replays bit-identically.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+from collections import Counter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable as `python scripts/chaos_smoke.py`
+    sys.path.insert(0, REPO)
+
+N = 3000
+SEED = 4242
+CHAOS_SEED = 42
+# 7 distinct fault classes (>= the 6 the acceptance gate asks for).
+# device_lost@1:3 with threshold 2 guarantees two consecutive dispatch
+# errors (the trip) plus a failed half-open probe (the decaying
+# re-probe) before the window closes and the probe restores the path.
+SCHEDULE = (
+    "ring_ctl_err@7,ring_ctl_err@60,ring_overrun@9,credit_starve@100:160,"
+    "stager_kill@5,slot_corrupt@4,backend_raise@3,device_lost@1:3"
+)
+CLASSES = ("ring_ctl_err", "ring_overrun", "credit_starve", "stager_kill",
+           "slot_corrupt", "backend_raise", "device_lost")
+
+
+def _run(payloads, record_digests=True):
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    with tempfile.TemporaryDirectory() as d:
+        topo = build_topology(os.path.join(d, "chaos.wksp"), depth=2048,
+                              wksp_sz=1 << 27)
+        t0 = time.perf_counter()
+        res = run_pipeline(
+            topo, payloads, verify_backend="cpu", timeout_s=300.0,
+            tcache_depth=1 << 17, record_digests=record_digests, feed=True,
+        )
+        return res, time.perf_counter() - t0
+
+
+def main() -> int:
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    corpus = mainnet_corpus(
+        n=N, seed=SEED, dup_rate=0.05, corrupt_rate=0.03,
+        parse_err_rate=0.02, sign_batch_size=256, max_data_sz=140,
+    )
+    fails = []
+
+    os.environ["FD_CHAOS"] = "0"
+    oracle_res, oracle_s = _run(corpus.payloads)
+    if not oracle_res.feed:
+        fails.append("oracle run did not take the fd_feed runtime")
+    oracle_digests = Counter(oracle_res.sink_digests)
+
+    os.environ["FD_CHAOS"] = "1"
+    os.environ["FD_CHAOS_SEED"] = str(CHAOS_SEED)
+    os.environ["FD_CHAOS_SCHEDULE"] = SCHEDULE
+    os.environ["FD_VERIFY_BREAKER_THRESHOLD"] = "2"
+    os.environ["FD_VERIFY_BREAKER_COOLDOWN_MS"] = "20"
+    try:
+        chaos_res, chaos_s = _run(corpus.payloads)
+    finally:
+        os.environ["FD_CHAOS"] = "0"
+    vs = chaos_res.verify_stats[0]
+    snap = vs.get("chaos") or {}
+    counters = snap.get("counters") or {}
+
+    # -- liveness + bit-exactness (non-faulted txns vs the oracle) -----
+    if not chaos_res.feed:
+        fails.append("chaos run did not take the fd_feed runtime")
+    corrupted = Counter(
+        bytes.fromhex(h) for h in snap.get("corrupted_sha256", ()))
+    want = oracle_digests - corrupted
+    got = Counter(chaos_res.sink_digests)
+    missing = sum((want - got).values())
+    unexpected = sum((got - want).values())
+    if missing or unexpected:
+        fails.append(
+            f"content not bit-exact minus corrupted: missing={missing} "
+            f"unexpected={unexpected} (corrupted={sum(corrupted.values())})"
+        )
+
+    # -- audit parity ---------------------------------------------------
+    if set(counters) != set(CLASSES):
+        fails.append(
+            f"fault-class coverage: scheduled {sorted(CLASSES)}, "
+            f"audited {sorted(counters)}"
+        )
+    for cls, c in counters.items():
+        if c["injected"] < 1:
+            fails.append(f"{cls}: scheduled but never injected")
+        if not (c["injected"] == c["detected"] == c["healed"]):
+            fails.append(f"{cls}: parity broken {c}")
+
+    # -- pool integrity -------------------------------------------------
+    if vs.get("slots_leaked", -1) != 0:
+        fails.append(f"slots_leaked={vs.get('slots_leaked')} (want 0)")
+    if vs.get("stager_restarts") != 1:
+        fails.append(
+            f"stager_restarts={vs.get('stager_restarts')} (want 1)")
+
+    # -- device-loss failover demonstration ----------------------------
+    if vs.get("breaker_trips", 0) < 1:
+        fails.append("breaker never tripped under the device_lost window")
+    if vs.get("breaker_reprobes", 0) < 1:
+        fails.append("breaker never half-open re-probed")
+    if vs.get("breaker_state") != "closed":
+        fails.append(
+            f"breaker_state={vs.get('breaker_state')!r} at end of run "
+            "(the re-probe must restore the device path)"
+        )
+    if vs.get("cpu_failover", 0) < 1:
+        fails.append("CPU failover lane never served a batch")
+
+    print(json.dumps({
+        "metric": "chaos_smoke",
+        "corpus": len(corpus.payloads),
+        "schedule": SCHEDULE,
+        "chaos_seed": CHAOS_SEED,
+        "oracle_s": round(oracle_s, 2),
+        "chaos_s": round(chaos_s, 2),
+        "chaos_recv": chaos_res.recv_cnt,
+        "corrupted": sum(corrupted.values()),
+        "missing": missing,
+        "unexpected": unexpected,
+        "chaos_counters": counters,
+        "healing": {k: vs.get(k) for k in (
+            "stager_restarts", "cpu_failover", "quarantined",
+            "quarantine_err_txn", "ctl_err_drop", "breaker_state",
+            "breaker_trips", "breaker_reprobes", "slots_leaked")},
+        "ok": not fails,
+        "failures": fails,
+    }))
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

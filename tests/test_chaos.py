@@ -1,0 +1,473 @@
+"""fd_chaos — deterministic fault injection + the self-healing it proves.
+
+Four layers, matching the subsystem's pieces: schedule-grammar and
+injector unit tests (a typo'd schedule must raise, ordinals must
+replay), CircuitBreaker state-machine tests (trip / half-open probe /
+decaying re-probe), AdaptiveFlush clock-jitter property tests (a clock
+that stutters or jumps backward can never un-expire a deadline), and
+pipeline-level chaos runs asserting the acceptance contract: under a
+seeded multi-class fault schedule the replay completes, every
+non-faulted txn is bit-exact vs the oracle, no slot is lost from the
+pool, and every fault class reports injected == detected == healed.
+"""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.disco import chaos
+from firedancer_tpu.disco.chaos import ChaosInjector, parse_schedule
+from firedancer_tpu.disco.feed.policy import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    FLUSH_DEADLINE,
+    FLUSH_FULL,
+    AdaptiveFlush,
+    CircuitBreaker,
+)
+
+# ---------------------------------------------------------- schedule -----
+
+
+def test_parse_schedule_points_and_windows():
+    sched = parse_schedule(
+        "ring_ctl_err@5,ring_ctl_err@40,device_lost@3:9, stager_kill@2 ,"
+    )
+    assert sched == {
+        "ring_ctl_err": [(5, 5), (40, 40)],
+        "device_lost": [(3, 9)],
+        "stager_kill": [(2, 2)],
+    }
+
+
+@pytest.mark.parametrize("spec", [
+    "nonsense@3",            # unknown class
+    "stager_kill",           # missing @N
+    "stager_kill@2:5",       # window on a point-only class
+    "device_lost@x:y",       # non-integer ordinals
+    "device_lost@0:4",       # ordinals are 1-based
+    "device_lost@9:3",       # inverted window
+])
+def test_parse_schedule_rejects(spec):
+    with pytest.raises(ValueError):
+        parse_schedule(spec)
+
+
+def test_injector_counters_only_for_scheduled_classes():
+    """Organic events of UNSCHEDULED classes never skew the audit."""
+    inj = ChaosInjector(seed=1, schedule="stager_kill@1")
+    inj.note("ring_ctl_err", "detected")       # unscheduled: ignored
+    inj.note("stager_kill", "detected")
+    snap = inj.snapshot()
+    assert set(snap["counters"]) == {"stager_kill"}
+    assert snap["counters"]["stager_kill"]["detected"] == 1
+
+
+def test_injector_hooks_fire_at_exact_ordinals():
+    inj = ChaosInjector(seed=3, schedule="stager_kill@3,backend_raise@2")
+    inj.stager_round_hook()
+    inj.stager_round_hook()
+    with pytest.raises(chaos.ChaosStagerKill):
+        inj.stager_round_hook()
+    inj.verify_complete_hook()
+    with pytest.raises(chaos.ChaosBackendError):
+        inj.verify_complete_hook()
+    c = inj.snapshot()["counters"]
+    assert c["stager_kill"]["injected"] == 1
+    assert c["backend_raise"]["injected"] == 1
+
+
+def test_injector_window_classes_heal_on_close():
+    inj = ChaosInjector(seed=0, schedule="credit_starve@2:3")
+    assert inj.source_starved() is False          # attempt 1
+    assert inj.source_starved() is True           # 2: window opens
+    assert inj.source_starved() is True           # 3
+    assert inj.source_starved() is False          # 4: window closed
+    c = inj.snapshot()["counters"]["credit_starve"]
+    assert c == {"injected": 1, "detected": 1, "healed": 1}
+
+
+# ----------------------------------------------------------- breaker -----
+
+
+def test_breaker_trips_on_consecutive_errors_only():
+    b = CircuitBreaker(threshold=3, cooldown_ns=1_000)
+    t = 0
+    assert b.allow_device(t)
+    b.record_error(t)
+    b.record_error(t)
+    b.record_success()        # success resets the consecutive count
+    b.record_error(t)
+    b.record_error(t)
+    assert b.state == BREAKER_CLOSED and b.trips == 0
+    assert b.record_error(t)  # third consecutive: trips
+    assert b.state == BREAKER_OPEN and b.trips == 1
+    assert not b.allow_device(t)          # open: CPU lane serves
+    assert not b.allow_device(t + 999)
+
+
+def test_breaker_half_open_probe_closes_on_success():
+    b = CircuitBreaker(threshold=1, cooldown_ns=1_000)
+    b.record_error(0)
+    assert b.state == BREAKER_OPEN
+    assert b.allow_device(1_000)          # cooldown elapsed: one probe
+    assert b.state == BREAKER_HALF_OPEN and b.reprobes == 1
+    b.record_success()
+    assert b.state == BREAKER_CLOSED
+
+
+def test_breaker_failed_probe_reopens_with_decaying_rate():
+    b = CircuitBreaker(threshold=1, cooldown_ns=1_000)
+    b.record_error(0)
+    assert b.allow_device(1_000)
+    assert b.record_error(1_000)          # probe failed: re-open, 2x
+    assert b.state == BREAKER_OPEN
+    assert not b.allow_device(1_000 + 1_999)   # 2x cooldown not elapsed
+    assert b.allow_device(1_000 + 2_000)
+    assert b.record_error(3_000)          # 4x
+    assert not b.allow_device(3_000 + 3_999)
+    assert b.allow_device(3_000 + 4_000)
+    b.record_success()                    # probe passed: closed, reset
+    assert b.state == BREAKER_CLOSED
+    b.record_error(10_000)
+    assert b.state == BREAKER_OPEN
+    assert b.allow_device(11_000)         # multiplier reset to 1x
+
+
+def test_breaker_straggler_results_while_open_change_nothing():
+    b = CircuitBreaker(threshold=1, cooldown_ns=1_000_000)
+    b.record_error(0)
+    assert b.state == BREAKER_OPEN
+    b.record_success()                    # pre-outage straggler
+    assert b.state == BREAKER_OPEN
+    assert not b.record_error(1)          # outage-window straggler
+    assert b.state == BREAKER_OPEN
+
+
+def test_breaker_rejects_bad_config():
+    with pytest.raises(ValueError):
+        CircuitBreaker(threshold=0, cooldown_ns=1)
+    with pytest.raises(ValueError):
+        CircuitBreaker(threshold=1, cooldown_ns=0)
+
+
+# ----------------------------------------- flush under clock jitter -----
+
+
+def test_adaptive_flush_backward_jump_cannot_unexpire_deadline():
+    """Property: once a partial batch has been OBSERVED at/past its
+    deadline, every later poll flushes it even when the injected clock
+    jumps backward (the staged txns' budget keeps burning in real
+    time; a glitching clock must not turn the latency bound off)."""
+    rng = np.random.RandomState(11)
+    for _ in range(300):
+        deadline = int(rng.randint(1_000, 1_000_000_000))
+        p = AdaptiveFlush(deadline)
+        first = int(rng.randint(0, 1 << 40))
+        lanes = int(rng.randint(1, 128))
+        late = first + deadline + int(rng.randint(0, 1 << 30))
+        assert p.due(late, lanes, 128, first) in (FLUSH_DEADLINE, FLUSH_FULL)
+        # backward jump, possibly to BEFORE the batch was even staged
+        back = int(rng.randint(0, late))
+        assert p.due(back, lanes, 128, first) in (
+            FLUSH_DEADLINE, FLUSH_FULL)
+
+
+def test_adaptive_flush_stuttering_clock_meets_hard_deadline():
+    """Drive due() through a stuttering/backward clock schedule. The
+    policy can only act on the clock it is SHOWN, so the hard bound is
+    in high-water-mark time: at the FIRST poll whose hwm-clock crosses
+    first + deadline the partial flushes — a stutter (repeat) or a
+    backward glitch in between must never defer it to a later poll."""
+    rng = np.random.RandomState(23)
+    for _ in range(200):
+        deadline = int(rng.randint(10_000, 100_000_000))
+        p = AdaptiveFlush(deadline)
+        first = int(rng.randint(0, 1 << 38))
+        true_now = first
+        hwm = 0
+        fired = False
+        for _step in range(64):
+            # stutter (repeat), advance, or glitch backward
+            r = rng.randint(3)
+            if r == 1:
+                true_now += int(rng.randint(1, deadline // 2 + 1))
+            observed = (true_now if r != 2
+                        else true_now - int(rng.randint(0, deadline)))
+            hwm = max(hwm, observed)
+            v = p.due(observed, 7, 128, first)
+            if hwm >= first + deadline:
+                assert v in (FLUSH_DEADLINE, FLUSH_FULL)
+                fired = True
+                break
+        assert fired  # 64 steps at >= deadline/2 mean advance must cross
+
+
+def test_adaptive_flush_future_anchor_never_negative_age():
+    """An anchor stamped 'in the future' by a glitch must not produce
+    a negative age that defers expiry past deadline-from-now."""
+    p = AdaptiveFlush(1_000_000)
+    first = 10_000_000                     # anchor ahead of the clock
+    assert p.due(5_000_000, 3, 128, first) is None
+    assert p.due(first + 1_000_000, 3, 128, first) == FLUSH_DEADLINE
+
+
+# --------------------------------------------------- pipeline chaos -----
+
+
+def _corpus(n=400, seed=5):
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+
+    return mainnet_corpus(
+        n=n, seed=seed, dup_rate=0.08, corrupt_rate=0.04,
+        parse_err_rate=0.03, sign_batch_size=128, max_data_sz=140,
+    )
+
+
+def _chaos_run(tmp_path, monkeypatch, corpus, schedule, seed=42, name="c",
+               **kw):
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    monkeypatch.setenv("FD_CHAOS", "1")
+    monkeypatch.setenv("FD_CHAOS_SEED", str(seed))
+    monkeypatch.setenv("FD_CHAOS_SCHEDULE", schedule)
+    topo = build_topology(str(tmp_path / f"{name}.wksp"), depth=512,
+                          wksp_sz=1 << 26)
+    res = run_pipeline(
+        topo, corpus.payloads, verify_backend="cpu", timeout_s=240.0,
+        record_digests=True, feed=True, **kw,
+    )
+    assert res.feed
+    return res
+
+
+def _assert_content_exact_minus_corrupted(corpus, res):
+    """Every NON-FAULTED txn's sink content is bit-exact vs the
+    oracle expectation; txns whose staged arena was corrupted by
+    slot_corrupt are the only permitted drops."""
+    from firedancer_tpu.disco.corpus import expected_sink_digests
+
+    want = expected_sink_digests(corpus)
+    corrupted = Counter(
+        bytes.fromhex(h)
+        for h in res.verify_stats[0]["chaos"]["corrupted_sha256"]
+    )
+    got = Counter(res.sink_digests)
+    assert got == want - corrupted
+
+
+def _assert_parity(res, classes):
+    counters = res.verify_stats[0]["chaos"]["counters"]
+    assert set(counters) == set(classes)
+    for cls, c in counters.items():
+        assert c["injected"] >= 1, (cls, c)
+        assert c["injected"] == c["detected"] == c["healed"], (cls, c)
+
+
+SCHEDULE_6 = (
+    "ring_ctl_err@5,ring_ctl_err@40,ring_overrun@6,credit_starve@50:80,"
+    "stager_kill@4,slot_corrupt@3,backend_raise@2,device_lost@4:6"
+)
+CLASSES_6 = ("ring_ctl_err", "ring_overrun", "credit_starve",
+             "stager_kill", "slot_corrupt", "backend_raise", "device_lost")
+
+
+def test_chaos_multi_fault_replay_heals(tmp_path, monkeypatch):
+    """The acceptance schedule: 7 distinct fault classes in one seeded
+    replay — completes, content exact minus the corrupted txn, pool
+    intact, per-class injected == detected == healed."""
+    corpus = _corpus(n=500, seed=7)
+    res = _chaos_run(tmp_path, monkeypatch, corpus, SCHEDULE_6)
+    vs = res.verify_stats[0]
+    _assert_parity(res, CLASSES_6)
+    _assert_content_exact_minus_corrupted(corpus, res)
+    assert vs["slots_leaked"] == 0
+    assert vs["stager_restarts"] == 1
+    assert vs["quarantined"] >= 1           # backend_raise healing path
+    assert vs["cpu_failover"] >= 1          # device_lost healing path
+    assert vs["ctl_err_drop"] >= 2          # injected err frags dropped
+    # the injected consumer-side overrun is visible on the source link
+    assert res.diag["link.replay_verify"]["ovrnr_cnt"] >= 1
+
+
+def test_chaos_replay_is_deterministic(tmp_path, monkeypatch):
+    """Same seed + schedule + corpus replays the same faults: the
+    audit counters AND the corrupted-payload hashes are identical
+    across runs (the replayability contract FD_CHAOS exists for)."""
+    corpus = _corpus(n=300, seed=19)
+    snaps = []
+    for i in range(2):
+        res = _chaos_run(tmp_path, monkeypatch, corpus, SCHEDULE_6,
+                         name=f"det{i}")
+        snaps.append(res.verify_stats[0]["chaos"])
+    assert snaps[0]["counters"] == snaps[1]["counters"]
+    assert snaps[0]["corrupted_sha256"] == snaps[1]["corrupted_sha256"]
+    assert len(snaps[0]["corrupted_sha256"]) == 1
+
+
+def test_chaos_stager_restart_loses_no_staged_slot(tmp_path, monkeypatch):
+    """Kill the stager twice mid-stream: the feeder's thread
+    supervision restarts it (with backoff) and NOTHING staged is lost
+    — content stays exact, the pool returns whole."""
+    monkeypatch.setenv("FD_FEED_STAGER_BACKOFF_MS", "2")
+    corpus = _corpus(n=400, seed=29)
+    res = _chaos_run(tmp_path, monkeypatch, corpus,
+                     "stager_kill@2,stager_kill@5", name="stg")
+    vs = res.verify_stats[0]
+    assert vs["stager_restarts"] == 2
+    assert vs["slots_leaked"] == 0
+    _assert_parity(res, ("stager_kill",))
+    from firedancer_tpu.disco.corpus import expected_sink_digests
+
+    assert Counter(res.sink_digests) == expected_sink_digests(corpus)
+
+
+def test_chaos_device_loss_breaker_failover(tmp_path, monkeypatch):
+    """The ISSUE's failover demonstration: a device-unavailable window
+    trips the circuit breaker mid-replay; the pipeline keeps
+    publishing through the CPU oracle lane (liveness), and the
+    half-open re-probe restores the device path once the faults stop
+    — trips, re-probes, and the final closed state all visible in
+    verify_stats."""
+    monkeypatch.setenv("FD_VERIFY_BREAKER_THRESHOLD", "2")
+    monkeypatch.setenv("FD_VERIFY_BREAKER_COOLDOWN_MS", "20")
+    corpus = _corpus(n=700, seed=31)
+    res = _chaos_run(tmp_path, monkeypatch, corpus, "device_lost@1:3",
+                     name="dev", verify_batch=64)
+    vs = res.verify_stats[0]
+    assert vs["breaker_trips"] >= 1         # tripped mid-replay
+    assert vs["breaker_reprobes"] >= 1      # half-open probe attempted
+    assert vs["breaker_state"] == BREAKER_CLOSED  # device path restored
+    assert vs["cpu_failover"] >= 1          # CPU lane served while open
+    assert vs["slots_leaked"] == 0
+    _assert_parity(res, ("device_lost",))
+    from firedancer_tpu.disco.corpus import expected_sink_digests
+
+    assert Counter(res.sink_digests) == expected_sink_digests(corpus)
+
+
+def test_chaos_backend_raise_quarantine_publishes_offenders(
+        tmp_path, monkeypatch):
+    """A poisoned batch (verify raised at completion) is quarantined:
+    clean txns still publish (bit-exact), genuinely-bad txns are
+    re-failed on the CPU oracle lane and leave a CTL_ERR audit trail
+    that dedup counts + drops (never reaching the sink)."""
+    corpus = _corpus(n=300, seed=37)
+    res = _chaos_run(tmp_path, monkeypatch, corpus,
+                     "backend_raise@1,backend_raise@2", name="qr")
+    vs = res.verify_stats[0]
+    assert vs["quarantined"] == 2
+    _assert_parity(res, ("backend_raise",))
+    from firedancer_tpu.disco.corpus import BAD_SIG, expected_sink_digests
+
+    assert Counter(res.sink_digests) == expected_sink_digests(corpus)
+    # The quarantined batches' bad-sig txns went downstream as CTL_ERR
+    # audit frags; dedup filtered every one of them.
+    n_bad = int((corpus.expected == BAD_SIG).sum())
+    assert 0 < vs["quarantine_err_txn"] <= n_bad
+    assert res.diag["link.verify_dedup"]["filt_cnt"] >= \
+        vs["quarantine_err_txn"]
+
+
+def test_chaos_clean_run_reports_zero_healing(tmp_path, monkeypatch):
+    """FD_CHAOS off: no injector is installed, every healing counter
+    reads zero, and the breaker sits closed — the accounting can be
+    trusted BECAUSE a fault-free run is provably silent."""
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    monkeypatch.delenv("FD_CHAOS", raising=False)
+    corpus = _corpus(n=200, seed=41)
+    topo = build_topology(str(tmp_path / "clean.wksp"), depth=512,
+                          wksp_sz=1 << 26)
+    res = run_pipeline(topo, corpus.payloads, verify_backend="cpu",
+                       timeout_s=240.0, record_digests=True, feed=True)
+    vs = res.verify_stats[0]
+    assert "chaos" not in vs
+    for key in ("stager_restarts", "cpu_failover", "quarantined",
+                "quarantine_err_txn", "ctl_err_drop", "breaker_trips",
+                "breaker_reprobes", "slots_leaked"):
+        assert vs[key] == 0, key
+    assert vs["breaker_state"] == BREAKER_CLOSED
+
+
+# ------------------------------------------------- supervisor level -----
+
+
+def test_respawn_backoff_policy():
+    """Pure-policy contract: exponential per-restart growth, +0-25%
+    jitter, hard cap, and base 0 == the seed's immediate respawn."""
+    from firedancer_tpu.disco.supervisor import respawn_backoff_s
+    from firedancer_tpu.utils.rng import Rng
+
+    rng = Rng(seq=99)
+    assert respawn_backoff_s(1, 0.0, 5.0, rng) == 0.0
+    prev_hi = 0.0
+    for restarts in range(1, 6):
+        d = respawn_backoff_s(restarts, 0.2, 5.0, rng)
+        lo = 0.2 * (1 << (restarts - 1))
+        assert lo <= d <= min(lo * 1.25, 5.0)
+        assert d >= prev_hi * 0.8          # monotone modulo jitter
+        prev_hi = d
+    # deep restart counts saturate at the cap, never overflow
+    assert respawn_backoff_s(40, 0.2, 5.0, rng) == 5.0
+
+
+def test_monitor_surfaces_restart_and_backoff(tmp_path):
+    """The monitor panel reads the supervisor-written respawn
+    accounting (CNC_DIAG_RESTARTS / CNC_DIAG_BACKOFF_MS) through
+    shared memory and renders it per tile."""
+    from firedancer_tpu.disco.monitor import render, snapshot
+    from firedancer_tpu.disco.pipeline import build_topology
+    from firedancer_tpu.disco.tiles import (
+        CNC_DIAG_BACKOFF_MS,
+        CNC_DIAG_RESTARTS,
+    )
+    from firedancer_tpu.tango.rings import Cnc, Workspace, cnc_diag_cap
+
+    if cnc_diag_cap() < 16:
+        pytest.skip("stale native .so: 8-slot cnc diag")
+    topo = build_topology(str(tmp_path / "mon.wksp"), depth=64)
+    wksp = Workspace.join(topo.wksp_path)
+    cnc = Cnc(wksp, topo.pod.query_cstr("firedancer.verify.cnc"))
+    cnc.diag_add(CNC_DIAG_RESTARTS, 3)
+    cnc.diag_add(CNC_DIAG_BACKOFF_MS, 250)
+    snap = snapshot(wksp, topo.pod)
+    assert snap["tile.verify"]["restarts"] == 3
+    assert snap["tile.verify"]["backoff_ms"] == 250
+    out = render(snap, ansi=False)
+    assert "rst" in out and "boff-ms" in out
+    row = next(ln for ln in out.splitlines() if ln.startswith("verify "))
+    assert " 3" in row and "250" in row
+
+
+@pytest.mark.slow
+def test_chaos_worker_kill_supervised(tmp_path, monkeypatch):
+    """Supervisor-level chaos: worker_kill SIGKILLs the verify worker
+    at a scheduled monitor pass; crash-only respawn (now with backoff)
+    heals the run and the restart surfaces in the artifact."""
+    from firedancer_tpu.disco.pipeline import build_topology
+    from firedancer_tpu.disco.supervisor import run_pipeline_supervised
+
+    monkeypatch.setenv("FD_CHAOS", "1")
+    monkeypatch.setenv("FD_CHAOS_SEED", "1")
+    monkeypatch.setenv("FD_CHAOS_SCHEDULE", "worker_kill@20")
+    monkeypatch.setenv("FD_SUP_BACKOFF_MS", "50")
+    corpus = _corpus(n=200, seed=43)
+    topo = build_topology(str(tmp_path / "sup.wksp"), depth=512,
+                          wksp_sz=1 << 26)
+    res = run_pipeline_supervised(
+        topo, corpus.payloads, verify_backend="cpu", timeout_s=240.0,
+        record_digests=True,
+    )
+    assert res.supervisor_restarts >= 1
+    assert res.tile_restarts.get("verify", 0) >= 1
+    # Respawn accounting reached shared memory (monitor's view).
+    from firedancer_tpu.tango.rings import cnc_diag_cap
+
+    if cnc_diag_cap() >= 16:
+        assert res.verify_stats[0]["restarts"] >= 1
+    # Crash-window delivery is at-least-once (rings are lossy by
+    # design; dedup heals re-reads): every unique-OK txn arrives.
+    assert res.recv_cnt >= corpus.n_unique_ok

@@ -115,19 +115,27 @@ def test_slot_pool_fifo_order_under_threads():
 
 
 def test_adaptive_flush_basic_verdicts():
-    p = AdaptiveFlush(deadline_ns=25_000_000)
+    # Each scenario gets a FRESH policy: an observed deadline expiry is
+    # sticky for its batch (the clock-jitter hardening — a backward
+    # clock cannot un-expire it), so independent what-if probes against
+    # one instance would see each other.
+    mk = lambda: AdaptiveFlush(deadline_ns=25_000_000)  # noqa: E731
+    p = mk()
     assert p.due(0, 0, 128, 0) is None                      # empty: never
     assert p.due(0, 128, 128, 0) == FLUSH_FULL              # full: always
-    assert p.due(25_000_000, 10, 128, 0) == FLUSH_DEADLINE  # at deadline
+    assert mk().due(25_000_000, 10, 128, 0) == FLUSH_DEADLINE  # at deadline
     # starved + idle device + credits -> early flush after the debounce
+    p = mk()
     assert p.due(p.starve_ns, 10, 128, 0, starved=True,
                  device_idle=True) == FLUSH_STARVED
     # ... but not while the device is busy, not while backpressured,
     # and not before the debounce
+    p = mk()
     assert p.due(p.starve_ns, 10, 128, 0, starved=True,
                  device_idle=False) is None
     assert p.due(p.starve_ns, 10, 128, 0, starved=True, device_idle=True,
                  backpressured=True) is None
+    p = mk()
     assert p.due(p.starve_ns - 1, 10, 128, 0, starved=True,
                  device_idle=True) is None
 
@@ -158,9 +166,12 @@ def test_adaptive_flush_never_starves_past_deadline():
         )
         assert verdict in (FLUSH_DEADLINE, FLUSH_FULL)
         # and BEFORE the starve debounce nothing flushes a partial
+        # (fresh policy: on `p` the expiry above is sticky for this
+        # anchor by design — tests/test_chaos.py pins that property)
         early = first + p.starve_ns - 1
-        assert p.due(early, min(lanes, 127), 128, first,
-                     starved=True, device_idle=True) is None
+        assert AdaptiveFlush(deadline).due(
+            early, min(lanes, 127), 128, first,
+            starved=True, device_idle=True) is None
 
 
 # ----------------------------------------------------------- runtime -----
@@ -261,19 +272,36 @@ def test_feed_small_ring_backpressure(tmp_path):
             assert d["ovrnr_cnt"] == 0 and d["ovrnp_cnt"] == 0, (name, d)
 
 
-def test_feed_routing_falls_back_when_unsupported(tmp_path):
+def test_feed_routing_falls_back_when_unsupported(tmp_path, caplog):
     """Topologies the feeder cannot serve (oracle backend, tiny batch,
-    multi-lane) silently keep the legacy loop — FD_FEED=1 must never
-    change their semantics."""
+    multi-lane) keep the legacy loop — FD_FEED=1 must never change
+    their semantics — and the fallback is NOT silent: the reason is
+    warned and recorded in the result (feed_fallback_reason)."""
+    import logging
+
     from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
 
     corpus = _corpus(n=24, seed=13)
     # batch below MAX_SIG_CNT -> legacy
     topo = build_topology(str(tmp_path / "small.wksp"), depth=64)
-    res = run_pipeline(topo, corpus.payloads, verify_backend="cpu",
-                       verify_batch=16, timeout_s=240.0, feed=True)
+    with caplog.at_level(logging.WARNING, "firedancer_tpu.disco.feed"):
+        res = run_pipeline(topo, corpus.payloads, verify_backend="cpu",
+                           verify_batch=16, timeout_s=240.0, feed=True)
     assert not res.feed
     assert res.recv_cnt == corpus.n_unique_ok
+    assert res.feed_fallback_reason is not None
+    assert "MAX_SIG_CNT" in res.feed_fallback_reason
+    assert any("falling back" in r.message for r in caplog.records)
+
+
+def test_feed_run_records_no_fallback_reason(tmp_path):
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    corpus = _corpus(n=24, seed=13)
+    topo = build_topology(str(tmp_path / "ok.wksp"), depth=64)
+    res = run_pipeline(topo, corpus.payloads, verify_backend="cpu",
+                       timeout_s=240.0, feed=True)
+    assert res.feed and res.feed_fallback_reason is None
 
 
 def test_feed_worker_pool_mode(tmp_path, monkeypatch):
