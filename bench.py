@@ -486,9 +486,44 @@ def worker(cpu: bool) -> int:
         "ms_per_batch": round(1e3 * dt / reps, 2),
         "rlc_fallbacks": fallback_cnt,
     }
+    # Round-10 artifact fields. The analytic fill-efficiency of the
+    # Pippenger bucket grids at this batch plus the predicted B-sweep
+    # winner (firedancer_tpu/msm_plan.py — stdlib math, free; the
+    # measured sweep is main()'s FD_BENCH_SWEEP_B rungs) go in BEFORE
+    # the headline prints.
+    rec["stage_ms"] = None
+    if mode == "rlc":
+        from firedancer_tpu import msm_plan
+
+        torsion_k = flags.get_int("FD_RLC_TORSION_K")
+        eff = msm_plan.fill_efficiency(batch, torsion_k=torsion_k)
+        rec["fill_efficiency"] = round(eff["total"], 4)
+        rec["b_sweep_predicted"] = msm_plan.sweep_prediction(
+            (8192, 16384, 32768), torsion_k=torsion_k)
     if cpu:
         rec["cpu_fallback"] = True
-    print(json.dumps(rec))
+    # Publish the headline NOW: stage attribution below jits fresh
+    # per-stage graphs, and if the rung's external timeout kills this
+    # worker mid-attribution the orchestrator salvages this line
+    # (_run_worker's TimeoutExpired path) — the attribution must never
+    # void the measurement it annotates. When attribution completes,
+    # the enriched record prints after and last-JSON-line-wins.
+    print(json.dumps(rec), flush=True)
+    if flags.get_bool("FD_BENCH_STAGE_ATTRIB"):
+        try:
+            from scripts.profile_stages import stage_attribution
+
+            rec["stage_ms"] = stage_attribution(
+                msgs, lens, sigs, pubs, mode=mode,
+                reps=1 if cpu else 3,
+                total_ms=rec["ms_per_batch"],
+            )
+        except Exception as e:  # noqa: BLE001 - attribution must never
+            # void the headline measurement it annotates.
+            print(f"bench: stage attribution failed: {e!r}",
+                  file=sys.stderr)
+            rec["stage_ms_error"] = repr(e)
+        print(json.dumps(rec))
     return 0
 
 
@@ -508,7 +543,29 @@ def _run_worker(cpu: bool, timeout_s: float, mode: str | None = None,
             cmd, capture_output=True, text=True, timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # Salvage a headline the worker already published: the worker
+        # prints its measurement record BEFORE the stage-attribution
+        # compiles, so a timeout during attribution must not void the
+        # number. Error records (value 0) are never salvaged.
+        out = e.stdout or ""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        for line in reversed(out.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("metric") and rec.get("value"):
+                    print(f"bench: worker timed out after {timeout_s:.0f}s "
+                          f"(cpu={cpu}) AFTER publishing its headline — "
+                          "salvaged (stage attribution lost)",
+                          file=sys.stderr)
+                    rec["timed_out_post_headline"] = True
+                    return rec
+                break
         print(f"bench: worker timed out after {timeout_s:.0f}s "
               f"(cpu={cpu})", file=sys.stderr)
         return None
@@ -700,10 +757,11 @@ def main() -> int:
         # its whole timeout — a numberless round is worse than a
         # direct-only round.
         direct_min_s = flags.get_float("FD_BENCH_DIRECT_MIN_BUDGET")
+        rlc_rec = None
         if flags.get_str("FD_BENCH_RLC") != "0":
             rlc_budget = min(attempt_timeout, left() - direct_min_s)
             if rlc_budget >= 120.0:
-                attempt("rlc", None, rlc_budget)
+                rlc_rec = attempt("rlc", None, rlc_budget)
         # Measured fallback rung: direct always runs so the artifact
         # records both modes side by side.
         direct_rec = attempt("direct", None, min(attempt_timeout, left()))
@@ -737,11 +795,63 @@ def main() -> int:
             attempt("direct", {"FD_SQ_IMPL": "mul",
                                "FD_CANON_IMPL": "seq"},
                     min(attempt_timeout, left()))
+        # Round-10 fill-efficiency B-sweep (FD_BENCH_SWEEP_B, e.g.
+        # "8192,16384,32768"): each size is its own budgeted rlc rung —
+        # msm_plan predicts efficiency monotone in B, these rungs
+        # measure the compile/VMEM/dispatch effects the model cannot
+        # see. Stage attribution is skipped on sweep rungs (the default
+        # shape's rung already carries it; sweep budget buys sizes, not
+        # repeats). The winner becomes the headline via best-of-log.
+        sweep_raw = flags.get_raw("FD_BENCH_SWEEP_B")
+        if sweep_raw:
+            b_results = {}
+            for b_str in sweep_raw.split(","):
+                try:
+                    b = int(b_str)
+                except ValueError:
+                    errors.append(f"bad FD_BENCH_SWEEP_B entry {b_str!r}")
+                    continue
+                if b == flags.get_int("FD_BENCH_BATCH") and (
+                        rlc_rec is not None):
+                    # The primary rung measured this size — reuse its
+                    # value so b_sweep_measured is complete (ROOFLINE
+                    # prediction 9 reads the ordering from this one
+                    # dict), but only skip the re-run when the primary
+                    # actually SUCCEEDED; a parked/failed primary would
+                    # otherwise leave the size silently unmeasured.
+                    b_results[b] = rlc_rec.get("value", 0)
+                    continue
+                if left() <= rlc_min_s:
+                    errors.append(f"B-sweep: no budget left for B={b}")
+                    break
+                rec = attempt("rlc", {"FD_BENCH_BATCH": str(b),
+                                      "FD_BENCH_STAGE_ATTRIB": "0"},
+                              min(attempt_timeout, left() - 30.0))
+                if rec is not None:
+                    b_results[b] = rec.get("value", 0)
+            if b_results and best is not None:
+                best = dict(best)
+                best["b_sweep_measured"] = b_results
     if best is not None:
         out = dict(best)
         # Which mode produced the headline number (the artifact must
         # say, not leave it to whoever diffs BENCH_LOG later).
         out["headline_mode"] = out.get("mode")
+        # Annotate the log with the headline SHAPE when a sweep ran or
+        # a non-default batch won, so a BENCH_r06 diff can see which
+        # sweep point produced the number without re-deriving it from
+        # value ordering.
+        if out.get("mode") == "rlc" and out.get("batch") and (
+                out.get("b_sweep_measured")
+                or out["batch"] != flags.get_int("FD_BENCH_BATCH")):
+            _log_measurement({
+                "metric": "note",
+                "note": f"headline shape: mode={out['mode']} "
+                        f"B={out['batch']} ({out.get('value', 0)} "
+                        "verifies/s; round-10 fused front-end + "
+                        "B-sweep pick)",
+                "b_sweep_measured": out.get("b_sweep_measured"),
+            })
         print(json.dumps(out))
         return 0
     # TPU unreachable (wedged tunnel): run the CPU-pinned rung so the round

@@ -78,6 +78,18 @@ echo "== RLC verify smoke (CPU backend, FD_BENCH_VERIFY=rlc) =="
 # back into parked status.
 JAX_PLATFORMS=cpu FD_BENCH_VERIFY=rlc python scripts/rlc_smoke.py
 
+echo "== fused front-end smoke (CPU, interpret-kernel arithmetic) =="
+# The round-10 fused verify front-end's gate: the kernel-body
+# arithmetic (SHA-512 compression -> folded Barrett mod-L -> RLC
+# coefficient muls — exactly what pallas interpret mode executes) must
+# stay bit-exact vs the staged CPU oracle, the FD_FRONTEND_IMPL
+# dispatch/eligibility contract must hold, and a real bench worker
+# artifact must carry the stage_ms attribution schema + fill-efficiency
+# fields the ROOFLINE budget is stated in. FD_RUN_PALLAS_TESTS=1
+# additionally runs the full pallas_call interpret parity (one big
+# cached compile — same opt-in as the kernel test tier).
+JAX_PLATFORMS=cpu python scripts/fused_smoke.py
+
 echo "== fuzz smoke (10k iters/target) =="
 python fuzz/run_fuzz.py --iters 10000
 

@@ -676,6 +676,77 @@ class _ReadyBatch:
         return _np.asarray(self._s, dtype=dtype)
 
 
+def resolve_verify_mode(backend: str, verify_mode: str,
+                        mesh_devices: int) -> str:
+    """Resolve a VerifyTile's verify mode (module-level so the
+    contract is unit-testable without a workspace).
+
+    'auto' resolves by the ATTACHED PLATFORM (ops.backend policy): rlc
+    on TPU families — including mesh_devices, now that the Pippenger
+    MSM shards across the mesh (round-10) — direct on host-jax
+    backends. FD_VERIFY_MODE forces either explicitly; an unknown
+    value raises. The GENUINELY unsupported combination is rlc on a
+    non-jax backend ('cpu'/'oracle' host verifiers have no batch
+    engine for the RLC graph to run on) — that is the only remaining
+    blanket rejection. FD_MSM_SHARD=0 is the bisection hatch that
+    restores the pre-round-10 rlc+mesh rejection (a silent downgrade
+    to direct would masquerade as a measurement of the sharded path).
+
+    The env force is validated HERE as well as in ops.backend
+    (default_verify_mode): host-backend tiles must stay
+    jax-import-free, so they cannot call into ops.backend, but an
+    explicit force — or a typo'd one — must still fail loudly instead
+    of being silently dropped."""
+    if verify_mode not in ("auto", "direct", "rlc"):
+        raise ValueError(
+            f"unknown verify_mode {verify_mode!r} (want auto|direct|rlc)"
+        )
+    shard_ok = flags.get_bool("FD_MSM_SHARD")
+    if verify_mode == "auto":
+        forced = flags.get_raw("FD_VERIFY_MODE")
+        if forced and forced not in ("rlc", "direct"):
+            raise ValueError(
+                f"unknown FD_VERIFY_MODE {forced!r} (want rlc|direct)"
+            )
+        if backend != "tpu":
+            if forced == "rlc":
+                raise ValueError(
+                    "FD_VERIFY_MODE=rlc requires backend='tpu' (the "
+                    "host cpu|oracle verifiers have no batch engine "
+                    "for the RLC graph — the one genuinely "
+                    "unsupported combination)"
+                )
+            return "direct"
+        from firedancer_tpu.ops.backend import default_verify_mode
+
+        verify_mode = default_verify_mode()
+        if verify_mode == "rlc" and mesh_devices and not shard_ok:
+            # The FD_MSM_SHARD=0 hatch: a platform auto-pick quietly
+            # stays direct, but an EXPLICIT FD_VERIFY_MODE=rlc force
+            # must fail loudly, not be silently dropped.
+            if forced == "rlc":
+                raise ValueError(
+                    "FD_VERIFY_MODE=rlc with mesh_devices needs the "
+                    "sharded MSM, which FD_MSM_SHARD=0 disabled"
+                )
+            verify_mode = "direct"
+        return verify_mode
+    if verify_mode == "rlc" and backend != "tpu":
+        # Silently running the oracle path while the operator believes
+        # RLC is on would be indistinguishable from "no fallbacks".
+        raise ValueError(
+            "verify_mode='rlc' requires backend='tpu' (the host "
+            "cpu|oracle verifiers have no batch engine for the RLC "
+            "graph — the one genuinely unsupported combination)"
+        )
+    if verify_mode == "rlc" and mesh_devices and not shard_ok:
+        raise ValueError(
+            "verify_mode='rlc' with mesh_devices needs the sharded "
+            "MSM, which FD_MSM_SHARD=0 disabled"
+        )
+    return verify_mode
+
+
 class _FutureBatch:
     """concurrent.futures result with the async-batch surface — the
     fd_feed cpu dispatch path, where a verify executor thread runs the
@@ -753,63 +824,14 @@ class VerifyTile(Tile):
             raise ValueError(
                 f"unknown verify backend {backend!r} (want oracle|cpu|tpu)"
             )
-        if verify_mode not in ("auto", "direct", "rlc"):
-            raise ValueError(
-                f"unknown verify_mode {verify_mode!r} (want auto|direct|rlc)"
-            )
-        if verify_mode == "auto":
-            # Production default (round-6 un-park): RLC batch verify is
-            # the PRIMARY device mode — one Pippenger MSM pass per
-            # clean batch, exact per-lane fallback on batch-equation
-            # failure or fill overflow (ops/verify_rlc.py). 'auto'
-            # resolves by the ATTACHED PLATFORM (backend.py policy):
-            # rlc on TPU families (where the VMEM MSM engine runs),
-            # direct on host-jax backends (CPU CI keeps its proven
-            # compile shapes; explicit verify_mode='rlc' still forces
-            # the RLC graph there, e.g. the ci.sh smoke lane).
-            # The env force is validated HERE as well as in backend.py
-            # (default_verify_mode): host-backend tiles must stay
-            # jax-import-free, so they cannot call into ops.backend,
-            # but an explicit force — or a typo'd one — must still fail
-            # loudly instead of being silently dropped.
-            forced = flags.get_raw("FD_VERIFY_MODE")
-            if forced and forced not in ("rlc", "direct"):
-                raise ValueError(
-                    f"unknown FD_VERIFY_MODE {forced!r} (want rlc|direct)"
-                )
-            verify_mode = "direct"
-            if backend != "tpu":
-                if forced == "rlc":
-                    raise ValueError(
-                        "FD_VERIFY_MODE=rlc requires backend='tpu'"
-                    )
-            else:
-                from firedancer_tpu.ops.backend import default_verify_mode
-
-                verify_mode = default_verify_mode()
-                if verify_mode == "rlc" and mesh_devices:
-                    # Mesh: the sharded step is the direct graph; RLC
-                    # needs a sharded MSM (future work). A platform
-                    # auto-pick quietly stays direct, but an EXPLICIT
-                    # FD_VERIFY_MODE=rlc force must fail loudly, not be
-                    # silently dropped (same contract as the explicit
-                    # verify_mode='rlc' + mesh rejection below).
-                    if forced == "rlc":
-                        raise ValueError(
-                            "FD_VERIFY_MODE=rlc is not supported with "
-                            "mesh_devices (the RLC MSM graph is not "
-                            "sharded yet)"
-                        )
-                    verify_mode = "direct"
-        if verify_mode == "rlc" and backend != "tpu":
-            # Silently running the oracle path while the operator believes
-            # RLC is on would be indistinguishable from "no fallbacks".
-            raise ValueError("verify_mode='rlc' requires backend='tpu'")
-        if verify_mode == "rlc" and mesh_devices:
-            raise ValueError(
-                "verify_mode='rlc' is not supported with mesh_devices "
-                "(the RLC MSM graph is not sharded yet)"
-            )
+        # Production default (round-6 un-park, round-10 mesh
+        # composition): RLC batch verify is the PRIMARY device mode —
+        # one Pippenger MSM pass per clean batch (sharded across
+        # mesh_devices when configured), exact per-lane fallback on
+        # batch-equation failure or fill overflow (ops/verify_rlc.py).
+        # Resolution + validation live in resolve_verify_mode above.
+        verify_mode = resolve_verify_mode(backend, verify_mode,
+                                          mesh_devices)
         self.backend = backend
         self.verify_mode = verify_mode
         self.batch = batch
@@ -958,9 +980,20 @@ class VerifyTile(Tile):
             if verify_mode == "rlc":
                 # RLC batch-verify fast pass with lazy per-lane fallback
                 # (ops/verify_rlc.py); clean batches cost one MSM pass.
+                # On a mesh the RLC pass itself shards: local bucket
+                # fills, one cross-mesh window-partial combine, the
+                # per-lane fallback staying the sharded direct graph.
                 from firedancer_tpu.ops.verify_rlc import make_async_verifier
 
-                self._verify_batch_fn = make_async_verifier(direct_fn)
+                rlc_fn = None
+                if mesh_devices:
+                    from firedancer_tpu.parallel.mesh import (
+                        verify_rlc_step_sharded,
+                    )
+
+                    rlc_fn = verify_rlc_step_sharded(self._mesh)
+                self._verify_batch_fn = make_async_verifier(
+                    direct_fn, rlc_fn=rlc_fn)
             # Pre-warm: compile the fixed (batch, max_msg_len) shape now
             # so the run loop never stalls on first-flush compilation.
             # This can take minutes (cold jit, or even a compile-cache

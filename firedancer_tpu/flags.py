@@ -142,6 +142,19 @@ _register(
     trace_time=True, choices=("auto", "xla", "pallas"),
 )
 _register(
+    "FD_FRONTEND_IMPL", str, "auto",
+    "Fused verify front-end engine (ops/frontend_pallas.py: SHA-512 -> "
+    "Barrett mod-L -> RLC coefficient muls as ONE VMEM kernel): "
+    "'pallas' forces the fused kernels, 'xla' pins the staged "
+    "composition (per-stage FD_SHA_IMPL / FD_SC_IMPL dispatch), "
+    "'interpret' runs the fused kernels under the Pallas interpreter "
+    "(CPU CI parity-tests the exact shipping engine), 'auto' = pallas "
+    "iff the backend is a TPU family. Ineligible shapes (batch not a "
+    "multiple of 1024, VMEM overflow) always take the staged "
+    "composition regardless. An unrecognized value raises.",
+    trace_time=True, choices=("auto", "xla", "pallas", "interpret"),
+)
+_register(
     "FD_COMPRESS_IMPL", str, "auto",
     "Point-compress / point-equality backend: pallas | xla | auto "
     "(pallas iff TPU).",
@@ -180,6 +193,17 @@ _register(
     "Trial count for the RLC torsion subgroup certification "
     "(soundness <= 2^-K for torsion defects per accepted batch).",
     trace_time=True,
+)
+_register(
+    "FD_MSM_SHARD", bool, True,
+    "Allow the RLC verify mode to compose with mesh_devices via the "
+    "mesh-sharded Pippenger MSM (per-device bucket fills, one "
+    "cross-mesh window-partial combine; parallel/mesh."
+    "verify_rlc_step_sharded). '0' is the bisection hatch that "
+    "restores the pre-round-10 behavior: auto mode quietly resolves "
+    "rlc+mesh to direct, while an EXPLICIT rlc force with mesh_devices "
+    "raises (a silent downgrade would masquerade as a sharded-path "
+    "measurement). Read at tile construction, not inside traced code.",
 )
 _register(
     "FD_VERIFY_MODE", str, None,
@@ -430,6 +454,21 @@ _register(
     "FD_BENCH_DIRECT_MIN_BUDGET", float, 300.0,
     "Budget reserved for the direct rung before the rlc rung may spend "
     "(a numberless round is worse than a direct-only round).",
+)
+_register(
+    "FD_BENCH_STAGE_ATTRIB", bool, True,
+    "Record per-stage ms attribution (sha, decompress, sc, rlc_combine, "
+    "msm, glue — scripts/profile_stages.stage_attribution) in every "
+    "verify-ladder artifact. '0' skips the extra per-stage compiles "
+    "when the rung budget is tight; the artifact then carries "
+    "stage_ms: null.",
+)
+_register(
+    "FD_BENCH_SWEEP_B", str, None,
+    "Comma-separated batch sizes for the rlc fill-efficiency B-sweep "
+    "rungs (e.g. '8192,16384,32768' — the BENCH_r06 shape pick). Each "
+    "size is its own budgeted worker attempt; unset skips the measured "
+    "sweep (the analytic msm_plan prediction is always recorded).",
 )
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,93 @@
+"""msm_plan — pure-Python planning math for the Pippenger MSM engine.
+
+Deliberately stdlib-only (like firedancer_tpu/flags.py): the bench
+orchestrator computes fill-efficiency predictions and picks the B-sweep
+shape BEFORE any jax import (its workers are subprocesses precisely so
+the orchestrator process stays light), and ops/msm.py delegates its
+static round-count here so the two can never disagree.
+
+The quantities:
+
+- ``default_rounds(bsz, n_buckets)`` — the static fill round count
+  R(lam) = lam + 7*sqrt(lam) + 8 with lam = points/(buckets-1): the
+  Poisson tail bound that puts per-batch overflow below ~1e-7
+  (ops/msm.py's fill; overflow only costs the exact-path fallback).
+- ``fill_efficiency(batch, ...)`` — useful madds / executed madds of
+  the static-round fill across the verify pass's three bucket grids
+  (the z MSM, the 253-bit MSM, the torsion certification). Executed =
+  R * windows * buckets lanes (every lane runs every round); useful =
+  the expected nonzero-digit placements. This is the structural cost
+  the B in {8k, 16k, 32k} sweep trades against latency: lam grows with
+  B, so R(lam)/lam — the fill's overhead factor — shrinks.
+"""
+
+from __future__ import annotations
+
+import math
+
+W_BITS = 7
+N_BUCKETS = 1 << W_BITS          # 7-bit MSM windows
+WINDOWS_Z = 18                   # RLC z weights: uniform < 2^126
+WINDOWS_253 = 37                 # scalars mod L
+TORSION_BUCKET_BITS = 5          # subgroup_check_fast's masked digits
+
+
+def default_rounds(bsz: int, n_buckets: int = N_BUCKETS) -> int:
+    """Static fill rounds for bsz points over n_buckets buckets (must
+    stay bit-identical to ops/msm._default_rounds — a test pins it)."""
+    lam = bsz / (n_buckets - 1)
+    return min(int(lam + 7.0 * lam ** 0.5 + 8.0) + 1, bsz)
+
+
+def _fill(npts: int, nw: int, n_buckets: int) -> tuple:
+    """(useful, executed) madd counts of one static-round fill."""
+    r = default_rounds(npts, n_buckets)
+    executed = r * nw * n_buckets
+    useful = npts * nw * (n_buckets - 1) / n_buckets
+    return useful, executed
+
+
+def fill_efficiency(batch: int, torsion_k: int = 64) -> dict:
+    """Per-grid and combined useful/executed madd ratios of the RLC
+    verify pass's bucket fills at this batch size. Keys: 'z' (the
+    18-window z*(-R) MSM), 'msm253' (the 37-window (zh)*(-A) + u*B MSM,
+    batch+1 points), 'torsion' (K trials on 5-bit buckets over 2B
+    points), 'total' (madd-weighted), 'rounds' (the three R values)."""
+    tb = 1 << TORSION_BUCKET_BITS
+    u_z, e_z = _fill(batch, WINDOWS_Z, N_BUCKETS)
+    u_m, e_m = _fill(batch + 1, WINDOWS_253, N_BUCKETS)
+    u_t, e_t = _fill(2 * batch, torsion_k, tb)
+    return {
+        "z": u_z / e_z,
+        "msm253": u_m / e_m,
+        "torsion": u_t / e_t,
+        "total": (u_z + u_m + u_t) / (e_z + e_m + e_t),
+        "rounds": {
+            "z": default_rounds(batch),
+            "msm253": default_rounds(batch + 1),
+            "torsion": default_rounds(2 * batch, tb),
+        },
+    }
+
+
+def sweep_prediction(batches, torsion_k: int = 64) -> dict:
+    """Analytic fill-efficiency sweep over candidate batch sizes:
+    {'batches': {B: total_efficiency}, 'winner': argmax-B}. Efficiency
+    is monotone in B for these grids, so the analytic winner is the
+    largest B that fits — the on-device sweep exists to catch the
+    compile/VMEM/dispatch effects this model cannot see."""
+    effs = {int(b): fill_efficiency(int(b), torsion_k)["total"]
+            for b in batches}
+    winner = max(effs, key=lambda b: (effs[b], b))
+    return {"batches": effs, "winner": winner}
+
+
+def executed_madds_per_lane(batch: int, torsion_k: int = 64) -> float:
+    """Executed fill madds per verify lane — the engine-cost proxy the
+    sweep normalizes by (each madd is 7 field muls regardless of grid,
+    so per-lane madds track per-lane engine time)."""
+    tb = 1 << TORSION_BUCKET_BITS
+    _, e_z = _fill(batch, WINDOWS_Z, N_BUCKETS)
+    _, e_m = _fill(batch + 1, WINDOWS_253, N_BUCKETS)
+    _, e_t = _fill(2 * batch, torsion_k, tb)
+    return (e_z + e_m + e_t) / batch

@@ -1,0 +1,366 @@
+"""Fused verify front-end: SHA-512 -> mod-L reduce -> RLC coefficient
+muls in ONE Pallas kernel, intermediates resident in VMEM.
+
+Why this exists (round-10; docs/ROOFLINE.md): with the MSM on the VMEM
+Pippenger engine, the verify batch is floored by the NON-DSM stages
+(~33 ms/8192 measured by subtraction on v5e). A large share of that is
+not stage arithmetic but the XLA glue BETWEEN per-stage kernels: the
+digest unpacks to (B, 64) bytes in HBM, transposes to (64, B) limbs for
+sc_reduce64 (five sequential carry scans as multi-kernel elementwise
+chains), re-packs to bytes, transposes again for each _sc_muladd, and
+every hop streams the full batch through HBM. This module chains the
+whole scalar front half onto the SHA compression while the digest still
+sits in VMEM:
+
+    schedule words -> 80-round compression -> digest byte limbs
+      -> Barrett reduce mod L (h)
+      -> m = z*h mod L, zs = z*s mod L     (the RLC coefficient muls)
+
+The scalar stages run in the SAME folded (SUB, B/SUB) lane layout as
+the SHA kernel (ops/sha512_pallas.py): each byte limb occupies a full
+(8, 128)-tile block instead of a single sublane row, so the Barrett
+carry chains and the 32x32 schoolbook convolutions are full-width VPU
+ops — and no byte<->limb transpose ever materializes between stages.
+
+Algorithm parity: the Barrett body mirrors sc25519.sc_reduce64 (b=2^8,
+k=32; mu and L folded in as Python int literals) and the muls mirror
+sign._sc_muladd — both remain the CPU/test reference the interpret-mode
+parity tests compare against, bit-exact.
+
+Engine selection: FD_FRONTEND_IMPL = auto | pallas | xla | interpret.
+'auto' resolves to the fused kernels exactly when the attached backend
+is a TPU family; 'xla' pins the staged composition (where FD_SHA_IMPL /
+FD_SC_IMPL still dispatch each stage individually — the escape hatch if
+a Mosaic version rejects the fused construction); 'interpret' runs the
+production kernels under the Pallas interpreter so CPU CI parity-tests
+the exact shipping engine (same contract as FD_MSM_IMPL=interpret).
+Ineligible shapes (batch not a multiple of 8*128, or VMEM overflow)
+always fall back to the staged composition — never a wrong result.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from firedancer_tpu import flags
+
+from . import sc25519 as sc
+# Module-level, NOT lazy: sha512.py and sign.py create jnp constants at
+# module scope (_K_HI, the basepoint tables). A first import that
+# happens INSIDE a jit trace would turn those into leaked tracers that
+# poison every later trace (UnexpectedTracerError) — importing here
+# guarantees they materialize as concrete arrays at import time.
+from .sha512 import sha512_batch_auto
+from .sign import _sc_muladd
+from .sha512_pallas import (
+    SUB,
+    VMEM_BUDGET,
+    _pack_schedule,
+    _sha512_rounds,
+    _vmem_estimate,
+)
+
+_MU_W = [(sc._MU >> (8 * i)) & 0xFF for i in range(33)]
+_L_W = [(sc.L >> (8 * i)) & 0xFF for i in range(33)]
+
+
+def frontend_impl() -> str:
+    """Trace-time front-end engine: 'pallas' (the fused VMEM kernels),
+    'xla' (staged composition, per-stage flags apply), or 'interpret'
+    (fused kernels under the interpreter — CPU CI runs the exact
+    shipping engine). An unrecognized value is an error: a typo'd force
+    must never quietly measure the wrong engine."""
+    impl = flags.get_str("FD_FRONTEND_IMPL")
+    if impl == "interpret":
+        return "interpret"
+    if impl not in ("", "auto", "xla", "pallas"):
+        raise ValueError(
+            f"unknown FD_FRONTEND_IMPL {impl!r} "
+            "(want auto|xla|pallas|interpret)"
+        )
+    from .backend import use_pallas
+
+    return "pallas" if use_pallas("FD_FRONTEND_IMPL") else "xla"
+
+
+def frontend_eligible(bsz: int, max_len: int, with_rlc: bool) -> bool:
+    """Whether the fused kernel launch handles this shape: the folded
+    layout needs bsz % (8*128) == 0, and the combined SHA + scalar
+    footprint must fit the VMEM guard (the scalar stage adds the z/s
+    inputs, h/m/zs outputs, and the 64-limb convolution accumulators on
+    top of the compression's schedule words)."""
+    if bsz % (SUB * 128) != 0:
+        return False
+    max_blocks = (max_len + 17 + 127) // 128
+    if with_rlc:
+        # z + s inputs, h + m + zs outputs (32 limb blocks each), plus
+        # ~8 64-limb-block temporaries (conv accumulators, Barrett q2).
+        extra = (5 * 32 + 8 * 64) * bsz * 4
+    else:
+        extra = (1 * 32 + 4 * 64) * bsz * 4
+    return _vmem_estimate(bsz, max_blocks) + 2 * extra <= VMEM_BUDGET
+
+
+# --------------------------------------------------------------------------
+# Fold-layout scalar arithmetic (kernel-safe): limb i of every lane
+# occupies rows [i*SUB : (i+1)*SUB], lanes ride (SUB, B/SUB) tiles.
+# Mirrors sc25519 / sc_pallas semantics exactly; carries propagate
+# across limb BLOCKS (each (row, col) within a block is an independent
+# lane).
+# --------------------------------------------------------------------------
+
+
+def _carry_f(x):
+    """Exact sequential base-256 carry over limb blocks: (n*SUB, L)
+    int32 (signed ok — arithmetic shift floors) -> (digits in [0, 255],
+    top carry (SUB, L), possibly negative)."""
+    n = x.shape[0] // SUB
+    carry = jnp.zeros((SUB,) + x.shape[1:], jnp.int32)
+    outs = []
+    for i in range(n):
+        t = x[i * SUB:(i + 1) * SUB] + carry
+        outs.append(t & 0xFF)
+        carry = t >> 8
+    return jnp.concatenate(outs, axis=0), carry
+
+
+def _pad_blocks(x, lo: int, hi: int):
+    """Place x's limb blocks at block offset lo inside lo+blocks+hi
+    total blocks (zeros + concatenate; static shapes only)."""
+    parts = []
+    if lo:
+        parts.append(jnp.zeros((lo * SUB,) + x.shape[1:], jnp.int32))
+    parts.append(x)
+    if hi:
+        parts.append(jnp.zeros((hi * SUB,) + x.shape[1:], jnp.int32))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+
+
+def _conv_w(x, weights, n_out: int):
+    """conv(x, weights) truncated to n_out limb blocks; weights are
+    Python ints (static). Partial sums <= 33*255^2 < 2^21: int32-safe
+    (same bound as sc_pallas._conv_const)."""
+    n_in = x.shape[0] // SUB
+    acc = jnp.zeros((n_out * SUB,) + x.shape[1:], jnp.int32)
+    for j, w in enumerate(weights):
+        if w == 0:
+            continue
+        blocks = min(n_in, n_out - j)
+        if blocks <= 0:
+            break
+        term = x[:blocks * SUB] * np.int32(w)
+        acc = acc + _pad_blocks(term, j, n_out - j - blocks)
+    return acc
+
+
+def _barrett_f(x):
+    """(64*SUB, L) canonical byte-limb blocks of x < 2^512 ->
+    (32*SUB, L) canonical limb blocks of x mod L (sc_reduce64's Barrett
+    with b = 2^8, k = 32, in the folded layout)."""
+    lanes = x.shape[1]
+    q1 = x[31 * SUB:]                              # 33 blocks
+    q2 = _conv_w(q1, _MU_W, 66)
+    q2, _ = _carry_f(q2)
+    q3 = q2[33 * SUB:]                             # 33 blocks
+    q3l = _conv_w(q3, _L_W, 33)
+    q3l, _ = _carry_f(q3l)
+    # r = (x - q3*L) mod b^33: final borrow discarded (= the wrap).
+    r, _ = _carry_f(x[:33 * SUB] - q3l)
+    i = jax.lax.broadcasted_iota(jnp.int32, (33 * SUB, lanes), 0) // SUB
+    l_col = jnp.zeros((33 * SUB, lanes), jnp.int32)
+    for j, w in enumerate(_L_W):
+        if w:
+            l_col = l_col + jnp.where(i == j, w, 0)
+    # r in [0, 3L): subtract L at most twice (arithmetic lane select —
+    # the borrow is per lane, broadcast over the 33 limb blocks).
+    for _ in range(2):
+        d, borrow = _carry_f(r - l_col)
+        keep = (borrow < 0).astype(jnp.int32)      # (SUB, L)
+        keep_b = jnp.concatenate([keep] * 33, axis=0)
+        r = keep_b * r + (1 - keep_b) * d
+    return r[:32 * SUB]
+
+
+def _mul_mod_l_f(a, b):
+    """(32*SUB, L) x (32*SUB, L) canonical byte-limb blocks ->
+    (32*SUB, L) canonical a*b mod L (sign._sc_muladd's c=0 case:
+    schoolbook conv, partial sums <= 32*255^2 < 2^21, exact carry to a
+    64-limb integer, shared Barrett)."""
+    acc = jnp.zeros((64 * SUB,) + a.shape[1:], jnp.int32)
+    for i in range(32):
+        ai = a[i * SUB:(i + 1) * SUB]
+        ai_b = jnp.concatenate([ai] * 32, axis=0)  # broadcast over b's blocks
+        acc = acc + _pad_blocks(ai_b * b, i, 32 - i)
+    x, _ = _carry_f(acc)                           # < 2^512: exact
+    return _barrett_f(x)
+
+
+def _digest_limbs(state):
+    """SHA final state (8 (hi, lo) pairs of (SUB, L) uint32) ->
+    (64*SUB, L) int32 byte-limb blocks of the digest read as a
+    little-endian integer (limb j = digest byte j — the sc_reduce64
+    input convention), extracted with shifts only: the byte<->word
+    transpose this kernel exists to delete."""
+    limbs = []
+    for w in range(8):
+        hi, lo = state[w]
+        for c in range(4):
+            limbs.append(((hi >> (24 - 8 * c)) & 0xFF).astype(jnp.int32))
+        for c in range(4):
+            limbs.append(((lo >> (24 - 8 * c)) & 0xFF).astype(jnp.int32))
+    return jnp.concatenate(limbs, axis=0)
+
+
+# --------------------------------------------------------------------------
+# Kernels + launch wrappers.
+# --------------------------------------------------------------------------
+
+
+def _sha_mod_l_kernel(win_hi, win_lo, nblk, oh, *, max_blocks: int):
+    state = _sha512_rounds(win_hi[...], win_lo[...], nblk[...],
+                           max_blocks=max_blocks)
+    oh[...] = _barrett_f(_digest_limbs(state))
+
+
+def _frontend_rlc_kernel(win_hi, win_lo, nblk, zin, sin, oh, om, ozs, *,
+                         max_blocks: int):
+    state = _sha512_rounds(win_hi[...], win_lo[...], nblk[...],
+                           max_blocks=max_blocks)
+    h = _barrett_f(_digest_limbs(state))
+    z = zin[...]
+    oh[...] = h
+    om[...] = _mul_mod_l_f(z, h)
+    ozs[...] = _mul_mod_l_f(z, sin[...])
+
+
+def _fold_scalar(b_bytes, lb: int):
+    """(B, 32) uint8 -> (32*SUB, lb) int32 folded limb blocks, the same
+    b = row*lb + col lane order as _pack_schedule's fold."""
+    x = jnp.moveaxis(b_bytes.astype(jnp.int32), -1, 0)       # (32, B)
+    return x.reshape(32, SUB, lb).reshape(32 * SUB, lb)
+
+
+def _unfold_scalar(x, bsz: int):
+    """(32*SUB, lb) int32 -> (B, 32) uint8 (inverse of _fold_scalar)."""
+    lb = bsz // SUB
+    return jnp.moveaxis(
+        x.reshape(32, SUB, lb).reshape(32, bsz), 0, -1
+    ).astype(jnp.uint8)
+
+
+def sha512_mod_l_pallas(msgs: jnp.ndarray, lengths: jnp.ndarray,
+                        interpret: bool = False) -> jnp.ndarray:
+    """Fused h = SHA-512(msgs) mod L: (B, max_len) uint8 + (B,) int32
+    -> (B, 32) uint8 canonical scalars. Callers gate on
+    frontend_eligible first."""
+    from jax.experimental import pallas as pl
+
+    bsz = msgs.shape[0]
+    hi, lo, nblk, lb, max_blocks = _pack_schedule(
+        msgs, lengths.astype(jnp.int32))
+    spec_w = pl.BlockSpec((16 * max_blocks * SUB, lb), lambda: (0, 0))
+    spec_n = pl.BlockSpec((SUB, lb), lambda: (0, 0))
+    spec_s = pl.BlockSpec((32 * SUB, lb), lambda: (0, 0))
+    out = pl.pallas_call(
+        functools.partial(_sha_mod_l_kernel, max_blocks=max_blocks),
+        in_specs=[spec_w, spec_w, spec_n],
+        out_specs=spec_s,
+        out_shape=jax.ShapeDtypeStruct((32 * SUB, lb), jnp.int32),
+        interpret=interpret,
+    )(hi, lo, nblk)
+    return _unfold_scalar(out, bsz)
+
+
+def frontend_rlc_pallas(msgs: jnp.ndarray, lengths: jnp.ndarray,
+                        z_bytes: jnp.ndarray, s_bytes: jnp.ndarray,
+                        interpret: bool = False):
+    """Fused RLC scalar front half: returns (h, m, zs) as (B, 32) uint8
+    with h = SHA-512(msgs) mod L, m = z*h mod L, zs = z*s mod L — one
+    kernel, digest never leaves VMEM between the stages. z carries the
+    caller's live-lane masking (dead lanes: z = 0 -> m = zs = 0,
+    identical to the staged path). Callers gate on frontend_eligible."""
+    from jax.experimental import pallas as pl
+
+    bsz = msgs.shape[0]
+    hi, lo, nblk, lb, max_blocks = _pack_schedule(
+        msgs, lengths.astype(jnp.int32))
+    z = _fold_scalar(z_bytes, lb)
+    ss = _fold_scalar(s_bytes, lb)
+    spec_w = pl.BlockSpec((16 * max_blocks * SUB, lb), lambda: (0, 0))
+    spec_n = pl.BlockSpec((SUB, lb), lambda: (0, 0))
+    spec_s = pl.BlockSpec((32 * SUB, lb), lambda: (0, 0))
+    out_s = jax.ShapeDtypeStruct((32 * SUB, lb), jnp.int32)
+    h, m, zs = pl.pallas_call(
+        functools.partial(_frontend_rlc_kernel, max_blocks=max_blocks),
+        in_specs=[spec_w, spec_w, spec_n, spec_s, spec_s],
+        out_specs=[spec_s] * 3,
+        out_shape=[out_s] * 3,
+        interpret=interpret,
+    )(hi, lo, nblk, z, ss)
+    return (_unfold_scalar(h, bsz), _unfold_scalar(m, bsz),
+            _unfold_scalar(zs, bsz))
+
+
+# --------------------------------------------------------------------------
+# Auto dispatchers (the verify paths call these).
+# --------------------------------------------------------------------------
+
+
+def sha512_mod_l_auto(msgs: jnp.ndarray,
+                      lengths: jnp.ndarray) -> jnp.ndarray:
+    """h = SHA-512(msgs) mod L: the fused kernel when the front-end is
+    active and the shape is eligible, else the staged composition
+    (sha512_batch_auto + sc_reduce64_auto, each stage's own flag
+    dispatching as before)."""
+    impl = frontend_impl()
+    bsz, max_len = msgs.shape
+    if impl != "xla" and frontend_eligible(bsz, max_len, with_rlc=False):
+        return sha512_mod_l_pallas(msgs, lengths,
+                                   interpret=impl == "interpret")
+    return sc.sc_reduce64_auto(sha512_batch_auto(msgs, lengths))
+
+
+def staged_coeff_muls(z_bytes: jnp.ndarray, h_bytes: jnp.ndarray,
+                      s_bytes: jnp.ndarray):
+    """The staged path's RLC coefficient muls: (m, zs) = (z*h, z*s)
+    mod L, with verify_batch_rlc's HISTORICAL dispatch — the stacked
+    VMEM Barrett kernels iff FD_SC_IMPL=pallas is EXPLICIT and the
+    platform is a TPU (round-4 v5e: the Barrett kernel loses ~3x to
+    XLA on short scalar chains, so auto stays XLA), independent of
+    FD_FRONTEND_IMPL: forcing the front-end to 'xla' must reproduce
+    the pre-fusion staged composition, not a third configuration.
+    Registry reads at trace time (fdlint pass 1 sanctions both).
+    profile_stages times this exact function for `rlc_combine`."""
+    from .backend import _platform_is_tpu
+
+    if flags.get_raw("FD_SC_IMPL") == "pallas" and _platform_is_tpu():
+        from .sc_pallas import sc_mul_pallas
+
+        bsz = z_bytes.shape[0]
+        both_m = sc_mul_pallas(
+            jnp.concatenate([z_bytes, z_bytes], axis=0),
+            jnp.concatenate([h_bytes, s_bytes], axis=0),
+        )
+        return both_m[:bsz], both_m[bsz:]
+    return (_sc_muladd(z_bytes, h_bytes, jnp.zeros_like(h_bytes)),
+            _sc_muladd(z_bytes, s_bytes, jnp.zeros_like(s_bytes)))
+
+
+def frontend_rlc_auto(msgs: jnp.ndarray, lengths: jnp.ndarray,
+                      z_bytes: jnp.ndarray, s_bytes: jnp.ndarray):
+    """(h, m, zs) for the RLC pass: the fused kernel when active and
+    eligible, else the staged composition (sha512_batch_auto +
+    sc_reduce64_auto + staged_coeff_muls, each stage's own flag
+    dispatching exactly as verify_batch_rlc historically did)."""
+    impl = frontend_impl()
+    bsz, max_len = msgs.shape
+    if impl != "xla" and frontend_eligible(bsz, max_len, with_rlc=True):
+        return frontend_rlc_pallas(msgs, lengths, z_bytes, s_bytes,
+                                   interpret=impl == "interpret")
+    h_bytes = sc.sc_reduce64_auto(sha512_batch_auto(msgs, lengths))
+    m_bytes, zs = staged_coeff_muls(z_bytes, h_bytes, s_bytes)
+    return h_bytes, m_bytes, zs

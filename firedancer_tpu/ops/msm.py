@@ -85,8 +85,35 @@ def _default_rounds(bsz: int, n_buckets: int = N_BUCKETS) -> int:
     # ~lam = B/(n_buckets-1) points; lam + 7*sqrt(lam) + 8 puts the
     # per-batch overflow probability below ~1e-7 even across thousands
     # of buckets. Adversarially-biased digits only cost the fallback.
-    lam = bsz / (n_buckets - 1)
-    return min(int(lam + 7.0 * lam ** 0.5 + 8.0) + 1, bsz)
+    # The formula lives in firedancer_tpu/msm_plan.py (stdlib-only) so
+    # the bench orchestrator's fill-efficiency predictions can never
+    # drift from the engine's actual round count.
+    from firedancer_tpu.msm_plan import default_rounds
+
+    return default_rounds(bsz, n_buckets)
+
+
+def _gather_point_sum(pt, axis_name: str):
+    """Combine per-device point partials into the global sum, on every
+    device: all_gather the (X, Y, Z, T) limb arrays over the mesh axis
+    and point_add the device slices. Point addition is the GROUP
+    operation, so a raw psum cannot combine partials — but the partials
+    are tiny ((32, nw) limbs per coordinate), so gather + a handful of
+    unified adds costs microseconds against the milliseconds of bucket
+    work they summarize. This is the only cross-device traffic in the
+    sharded MSM."""
+    g = tuple(jax.lax.all_gather(c, axis_name) for c in pt)  # (N, ...)
+    n = g[0].shape[0]
+    acc = tuple(c[0] for c in g)
+    for d in range(1, n):
+        acc = ge.point_add(acc, tuple(c[d] for c in g))
+    return acc
+
+
+def _all_shards_ok(ok, axis_name: str):
+    """Global AND of a per-shard () bool (fill-overflow flags: ONE
+    overflowing shard invalidates the whole batch result)."""
+    return jnp.all(jax.lax.all_gather(ok, axis_name))
 
 
 def _staging_indices(scalars_bytes, n_windows: int, bsz: int,
@@ -135,11 +162,15 @@ def _staging_from_digits(d: jnp.ndarray, bsz: int, max_rounds: int,
 
 
 def msm(scalars_bytes: jnp.ndarray, points, n_windows: int,
-        max_rounds: int | None = None):
+        max_rounds: int | None = None, axis_name: str | None = None):
     """sum_i scalars_i * P_i (XLA reference path).
 
     scalars_bytes: (B, 32) uint8 little-endian (windows beyond
       n_windows must be zero). points: (X, Y, Z, T) of (32, B) limbs.
+    axis_name (under shard_map): B is the LOCAL lane count; the
+      per-window bucket sums are combined across the mesh before the
+      Horner tail, so the returned point is the global MSM over all
+      shards' lanes (replicated), and ok is the global fill verdict.
     Returns (point, ok): point is (X, Y, Z, T) of (32, 1) limbs; ok is a
       () bool — False iff a bucket overflowed max_rounds (result then
       invalid; caller must use the exact path).
@@ -150,6 +181,9 @@ def msm(scalars_bytes: jnp.ndarray, points, n_windows: int,
     nw = n_windows
     idx, ok = _staging_indices(scalars_bytes, nw, bsz, max_rounds)
     w_res = _fill_and_aggregate(idx, points, max_rounds, nw)
+    if axis_name is not None:
+        w_res = _gather_point_sum(w_res, axis_name)
+        ok = _all_shards_ok(ok, axis_name)
     return _window_horner(w_res, nw), ok
 
 
@@ -240,7 +274,8 @@ def _mul_by_group_order(pt):
 
 
 def subgroup_check(points, u_digits: jnp.ndarray,
-                   max_rounds: int | None = None):
+                   max_rounds: int | None = None,
+                   axis_name: str | None = None):
     """Randomized prime-subgroup (torsion-freeness) certification.
 
     points: (X, Y, Z, T) of (32, B) limbs. u_digits: (K, B) int32 in
@@ -259,6 +294,12 @@ def subgroup_check(points, u_digits: jnp.ndarray,
     order-4, 1/8 order-8), so K trials miss with probability <= 2^-K.
     Honest (torsion-free) points always pass.
 
+    axis_name (under shard_map): the K trial rows weight the GLOBAL
+    point set; each shard fills its local lanes' contributions and the
+    per-trial aggregates combine across the mesh before the [L] ladder
+    (Agg_j = sum over all shards' lanes), so the certification is over
+    every live point, not per-shard.
+
     Returns (ok_subgroup, ok_fill): ok_subgroup () bool — every trial
     aggregated to the identity; ok_fill () bool — False iff a bucket
     overflowed max_rounds (trials then unusable; the caller must treat
@@ -272,6 +313,9 @@ def subgroup_check(points, u_digits: jnp.ndarray,
         u_digits.astype(jnp.int32), bsz, max_rounds
     )
     agg = _fill_and_aggregate(idx, points, max_rounds, k)  # (32, K) coords
+    if axis_name is not None:
+        agg = _gather_point_sum(agg, axis_name)
+        ok_fill = _all_shards_ok(ok_fill, axis_name)
     la = _mul_by_group_order(agg)
     ok = fe.fe_is_zero(la[0]) & fe.fe_eq(la[1], la[2])     # (K,) identity
     return jnp.all(ok), ok_fill
@@ -318,8 +362,9 @@ def _stage_niels(points, idx, max_rounds: int, lanes: int, bsz: int,
 
 def msm_fast(scalars_bytes: jnp.ndarray, points, n_windows: int,
              max_rounds: int | None = None, interpret: bool = False,
-             niels=None):
-    """Kernel-backed msm (same contract as msm()).
+             niels=None, axis_name: str | None = None):
+    """Kernel-backed msm (same contract as msm(), including axis_name's
+    cross-mesh window-partial combine before the Horner tail).
 
     REQUIRES points with Z == 1 (decompress output / affine constants) —
     the bucket fill uses precomputed niels form (y+x, y-x, 2d*t) with
@@ -359,6 +404,9 @@ def msm_fast(scalars_bytes: jnp.ndarray, points, n_windows: int,
         interpret=interpret,
     )
     w_res = tuple(c[:, :nw] for c in w_res)
+    if axis_name is not None:
+        w_res = _gather_point_sum(w_res, axis_name)
+        ok = _all_shards_ok(ok, axis_name)
     res = mp.window_horner_pallas(
         w_res, fe.FE_D2.astype(jnp.int32), nw, interpret=interpret,
         w_bits=W_BITS,
@@ -381,8 +429,9 @@ def subgroup_check_fast(points, u_digits: jnp.ndarray,
                         bucket_bits: int = 5,
                         max_rounds: int | None = None,
                         interpret: bool = False,
-                        niels=None):
-    """Kernel-backed subgroup_check (same contract and soundness).
+                        niels=None, axis_name: str | None = None):
+    """Kernel-backed subgroup_check (same contract and soundness,
+    including axis_name's cross-mesh per-trial aggregate combine).
 
     REQUIRES points with Z == 1 (decompress output), like msm_fast.
 
@@ -428,6 +477,9 @@ def subgroup_check_fast(points, u_digits: jnp.ndarray,
         fe.FE_D2.astype(jnp.int32),
         interpret=interpret,
     )
+    if axis_name is not None:
+        agg = _gather_point_sum(agg, axis_name)
+        ok_fill = _all_shards_ok(ok_fill, axis_name)
     la = mp.mul_by_group_order_pallas(
         agg, fe.FE_D2.astype(jnp.int32), _l_bits_col(), interpret=interpret
     )

@@ -16,6 +16,11 @@ kernel consumes pre-packed schedule words.
 Round structure and constants follow FIPS 180-4 via ops/sha512.py's
 helpers (one implementation of rotr/add64/sigma shared by both paths —
 the XLA path remains the CPU/test reference).
+
+The compression body (`_sha512_rounds`) and the XLA-side schedule
+packing (`_pack_schedule`) are module-level so the fused verify
+front-end (ops/frontend_pallas.py) can chain the mod-L reduction and
+the RLC coefficient muls onto the digest while it still sits in VMEM.
 """
 
 from __future__ import annotations
@@ -31,11 +36,13 @@ from . import sha512 as s
 SUB = 8  # sublane fold of the batch axis
 
 
-def _sha512_kernel(win_hi, win_lo, nblk, out, *, max_blocks: int):
-    """win_hi/lo: (max_blocks*16*SUB, Lb) uint32 message words, word w of
-    block b at rows [(b*16+w)*SUB : +SUB]. nblk: (SUB, Lb) int32 per-lane
-    block counts. out: (16*SUB, Lb) uint32 digest words, word w's hi at
-    rows [2w*SUB : +SUB], its lo at the following SUB rows.
+def _sha512_rounds(win_hi, win_lo, nblocks, *, max_blocks: int):
+    """The multi-block SHA-512 absorb on folded VMEM tiles.
+
+    win_hi/lo: (max_blocks*16*SUB, Lb) uint32 message words, word w of
+    block b at rows [(b*16+w)*SUB : +SUB]. nblocks: (SUB, Lb) int32
+    per-lane block counts. Returns the final state as a list of 8
+    (hi, lo) pairs, each (SUB, Lb) uint32.
 
     The 80-round loop is statically unrolled, so the round constants
     are Python int literals folded into the instruction stream — no
@@ -43,7 +50,6 @@ def _sha512_kernel(win_hi, win_lo, nblk, out, *, max_blocks: int):
     (1, 1) VMEM scalar read would need a both-axes broadcast Mosaic
     does not implement)."""
     lanes = win_hi.shape[1]
-    nblocks = nblk[...]
 
     def rotr(h, l, n):
         return s._rotr64(h, l, n)
@@ -116,7 +122,15 @@ def _sha512_kernel(win_hi, win_lo, nblk, out, *, max_blocks: int):
             new_state.append((active * vh + (1 - active) * sh_,
                               active * vl + (1 - active) * sl_))
         state = new_state
+    return state
 
+
+def _sha512_kernel(win_hi, win_lo, nblk, out, *, max_blocks: int):
+    """win_hi/lo, nblk as _sha512_rounds. out: (16*SUB, Lb) uint32
+    digest words, word w's hi at rows [2w*SUB : +SUB], its lo at the
+    following SUB rows."""
+    state = _sha512_rounds(win_hi[...], win_lo[...], nblk[...],
+                           max_blocks=max_blocks)
     rows = []
     for i in range(8):
         rows.append(state[i][0])
@@ -124,28 +138,16 @@ def _sha512_kernel(win_hi, win_lo, nblk, out, *, max_blocks: int):
     out[...] = jnp.concatenate(rows, axis=0)
 
 
-def sha512_batch_pallas(msgs: jnp.ndarray, lengths: jnp.ndarray,
-                        interpret: bool = False) -> jnp.ndarray:
-    """Drop-in for sha512_batch on TPU: (B, max_len) uint8 + (B,) int32
-    -> (B, 64) uint8 digests. B must be a multiple of 8*128 for the
-    folded layout; smaller/odd batches take the XLA path."""
-    from jax.experimental import pallas as pl
-
+def _pack_schedule(msgs: jnp.ndarray, lengths: jnp.ndarray):
+    """XLA-side staging shared by the plain kernel and the fused
+    front-end: padded buffer construction + byte->word packing + the
+    sublane fold. msgs (B, max_len) uint8, lengths (B,) int32 ->
+    (hi, lo, nblk, lb, max_blocks) with hi/lo (max_blocks*16*SUB, lb)
+    uint32 and nblk (SUB, lb) int32. Requires B % (SUB*128) == 0
+    (callers gate on that before packing)."""
     bsz, max_len = msgs.shape
-    if bsz % (SUB * 128) != 0:
-        return s.sha512_batch(msgs, lengths)
     lb = bsz // SUB
     max_blocks = (max_len + 17 + 127) // 128
-    # VMEM guard: the single-block kernel pins all max_blocks*16 (hi, lo)
-    # message word pairs plus the fully unrolled 80-entry schedule per
-    # block in VMEM. Estimate that footprint (4 B words; x2 for Mosaic
-    # temporaries) and fall back to the XLA path rather than die with an
-    # opaque Mosaic OOM on large (batch, max_msg_len) combinations.
-    vmem_est = (2 * 16 * max_blocks * bsz * 4      # hi + lo inputs
-                + 80 * 2 * bsz * 4                 # unrolled schedule
-                + 16 * 2 * bsz * 4) * 2            # state + slack
-    if vmem_est > 64 * 1024 * 1024:
-        return s.sha512_batch(msgs, lengths)
     lengths = lengths.astype(jnp.int32)
 
     # Padded buffer (total, B) — identical construction to the XLA path.
@@ -181,6 +183,37 @@ def sha512_batch_pallas(msgs: jnp.ndarray, lengths: jnp.ndarray,
     hi = hi.reshape(16 * max_blocks, SUB, lb).reshape(-1, lb)
     lo = lo.reshape(16 * max_blocks, SUB, lb).reshape(-1, lb)
     nblk = nblocks.reshape(SUB, lb)
+    return hi, lo, nblk, lb, max_blocks
+
+
+def _vmem_estimate(bsz: int, max_blocks: int) -> int:
+    """VMEM footprint estimate of the single-launch compression: all
+    max_blocks*16 (hi, lo) message word pairs plus the fully unrolled
+    80-entry schedule per block, state, x2 for Mosaic temporaries."""
+    return (2 * 16 * max_blocks * bsz * 4      # hi + lo inputs
+            + 80 * 2 * bsz * 4                 # unrolled schedule
+            + 16 * 2 * bsz * 4) * 2            # state + slack
+
+
+VMEM_BUDGET = 64 * 1024 * 1024
+
+
+def sha512_batch_pallas(msgs: jnp.ndarray, lengths: jnp.ndarray,
+                        interpret: bool = False) -> jnp.ndarray:
+    """Drop-in for sha512_batch on TPU: (B, max_len) uint8 + (B,) int32
+    -> (B, 64) uint8 digests. B must be a multiple of 8*128 for the
+    folded layout; smaller/odd batches take the XLA path."""
+    from jax.experimental import pallas as pl
+
+    bsz, max_len = msgs.shape
+    if bsz % (SUB * 128) != 0:
+        return s.sha512_batch(msgs, lengths)
+    max_blocks = (max_len + 17 + 127) // 128
+    # VMEM guard: fall back to the XLA path rather than die with an
+    # opaque Mosaic OOM on large (batch, max_msg_len) combinations.
+    if _vmem_estimate(bsz, max_blocks) > VMEM_BUDGET:
+        return s.sha512_batch(msgs, lengths)
+    hi, lo, nblk, lb, max_blocks = _pack_schedule(msgs, lengths)
 
     spec_w = pl.BlockSpec((16 * max_blocks * SUB, lb), lambda: (0, 0))
     spec_n = pl.BlockSpec((SUB, lb), lambda: (0, 0))

@@ -20,7 +20,10 @@ import jax.numpy as jnp
 
 from . import curve25519 as ge
 from . import sc25519 as sc
-from .sha512 import sha512_batch_auto as sha512_batch
+# Top-level, not trace-time: frontend_pallas transitively materializes
+# sha512/sign's module-scope jnp constants; importing inside the traced
+# body would leak tracers into those globals on the first call.
+from .frontend_pallas import sha512_mod_l_auto
 
 FD_ED25519_SUCCESS = 0
 FD_ED25519_ERR_SIG = -1
@@ -84,10 +87,13 @@ def verify_batch(
     neg_a = ge.point_neg(a_point)
 
     # h = SHA-512(r || pub || msg) mod L. One batched hash over the
-    # concatenated buffer; lengths shift by the 64-byte prefix.
+    # concatenated buffer; lengths shift by the 64-byte prefix. The
+    # fused front-end (ops/frontend_pallas.py) chains the Barrett
+    # reduction onto the compression in VMEM when active and the shape
+    # is eligible; otherwise the staged sha512_batch_auto +
+    # sc_reduce64_auto composition runs as before.
     hash_in = jnp.concatenate([r_bytes, pubkeys, msgs], axis=1)
-    h64 = sha512_batch(hash_in, msg_lengths.astype(jnp.int32) + 64)
-    h_bytes = sc.sc_reduce64_auto(h64)
+    h_bytes = sha512_mod_l_auto(hash_in, msg_lengths.astype(jnp.int32) + 64)
 
     r_prime = _dsm_auto()(h_bytes, neg_a, s_bytes)
     # Rd is affine (decompress emits Z=1): projective cross-compare.

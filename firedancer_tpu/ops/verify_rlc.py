@@ -77,8 +77,10 @@ from . import curve25519 as ge
 from . import fe25519 as fe
 from . import msm as msm_mod
 from . import sc25519 as sc
-from .sha512 import sha512_batch_auto as sha512_batch
-from .sign import _sc_muladd
+# Top-level, not trace-time: frontend_pallas transitively materializes
+# sha512/sign's module-scope jnp constants; importing inside the traced
+# body would leak tracers into those globals on the first call.
+from .frontend_pallas import frontend_rlc_auto
 from .verify import (
     FD_ED25519_ERR_PUBKEY,
     FD_ED25519_ERR_SIG,
@@ -158,13 +160,23 @@ def fresh_u(k: int, batch: int,
 
 
 
-def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
+def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits,
+                     axis_name: str | None = None):
     """One RLC pass over a batch.
 
     Args are as ops.verify.verify_batch, plus z_bytes (B, 32) uint8
     126-bit random weights (from fresh_z) and u_digits (K, 2B) int32
     trial weights for the torsion certification (from fresh_u; columns
     0..B-1 weight the pubkey points, B..2B-1 the R points).
+
+    axis_name shards the batch over a device mesh (round-10): called
+    under shard_map with per-device lane slices, the per-lane stages
+    run locally and the MSMs combine per-window PARTIALS across the
+    mesh (ops/msm.py axis_name plumbing) before the doubling-chain
+    tails — the u*B term folds per shard (sum_d u_d*B == (sum_d u_d)*B
+    in the group, so no scalar collective is needed), and batch_ok is
+    the replicated global verdict. parallel/mesh.verify_rlc_step_sharded
+    is the tile-facing builder.
 
     Returns (status, definite, batch_ok):
       status:   (B,) int32 — correct for lanes where definite is True;
@@ -217,12 +229,6 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     a_small = so_both[:bsz]
     r_small = so_both[bsz:]
 
-    h64 = sha512_batch(
-        jnp.concatenate([r_bytes, pubkeys, msgs], axis=1),
-        msg_lengths.astype(jnp.int32) + 64,
-    )
-    h_bytes = sc.sc_reduce64_auto(h64)
-
     status = jnp.where(
         ~s_ok,
         FD_ED25519_ERR_SIG,
@@ -238,27 +244,19 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     live = ~definite
     z_live = jnp.where(live[:, None], z_bytes, 0).astype(jnp.uint8)
 
-    # m = z*h mod L; u = sum z*s mod L. FD_SC_IMPL=pallas opts ALL
-    # scalar-arithmetic into the stacked VMEM Barrett kernels; the
-    # default is the XLA graph (round-4 v5e measurement: the Barrett
-    # kernel loses ~3x to XLA on these short scalar chains), matching
-    # sc25519.sc_reduce64_auto so the two launches never mix backends.
-    # Registry read, not a raw environ read: this line executes while
-    # verify_batch_rlc TRACES, so the value pins into the compiled
-    # graph — FD_SC_IMPL carries the trace_time marker that sanctions
-    # exactly that (fdlint pass 1 flags the raw form).
-    if on_tpu and flags.get_raw("FD_SC_IMPL") == "pallas":
-        from .sc_pallas import sc_mul_pallas
-
-        both_m = sc_mul_pallas(
-            jnp.concatenate([z_live, z_live], axis=0),
-            jnp.concatenate([h_bytes, s_bytes], axis=0),
-        )
-        bsz_ = z_live.shape[0]
-        m_bytes, zs = both_m[:bsz_], both_m[bsz_:]
-    else:
-        m_bytes = _sc_muladd(z_live, h_bytes, jnp.zeros_like(h_bytes))
-        zs = _sc_muladd(z_live, s_bytes, jnp.zeros_like(s_bytes))
+    # h = SHA-512(r||pub||msg) mod L, m = z*h mod L, zs = z*s mod L —
+    # the fused front-end (ops/frontend_pallas.py) runs all three as
+    # one VMEM kernel chained onto the compression when active and the
+    # shape is eligible; the staged fallback keeps the historical
+    # per-stage dispatch (FD_SHA_IMPL / FD_SC_IMPL, registry reads at
+    # trace time — fdlint pass 1 sanctions exactly that). The z-live
+    # masking rides INTO the fused muls (dead lanes: z = 0 -> m = zs =
+    # 0, bit-identical to the staged path). u = sum zs mod L.
+    _h_bytes, m_bytes, zs = frontend_rlc_auto(
+        jnp.concatenate([r_bytes, pubkeys, msgs], axis=1),
+        msg_lengths.astype(jnp.int32) + 64,
+        z_live, s_bytes,
+    )
     u_bytes = sc.sc_sum(zs)
 
     neg_r = ge.point_neg(r_point)
@@ -296,14 +294,20 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
         )}
         kw_sub = {"niels": (yp, ym, t2d)}
     # Decompressed points have Z == 1, so the niels fast path applies.
+    # axis_name threads through to the engines: local bucket work, one
+    # cross-mesh window-partial combine before each doubling-chain tail.
     if engine == "xla":
-        msm_impl = msm_mod.msm
-        sub_impl = msm_mod.subgroup_check
+        msm_impl = functools.partial(msm_mod.msm, axis_name=axis_name)
+        sub_impl = functools.partial(
+            msm_mod.subgroup_check, axis_name=axis_name
+        )
     else:
         interp = engine == "interpret"
-        msm_impl = functools.partial(msm_mod.msm_fast, interpret=interp)
+        msm_impl = functools.partial(msm_mod.msm_fast, interpret=interp,
+                                     axis_name=axis_name)
         sub_impl = functools.partial(
-            msm_mod.subgroup_check_fast, interpret=interp
+            msm_mod.subgroup_check_fast, interpret=interp,
+            axis_name=axis_name,
         )
     t1, ok1 = msm_impl(z_live, neg_r, n_windows=msm_mod.WINDOWS_Z,
                        **kw_r)

@@ -30,6 +30,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.verify import verify_batch
 
 
+def shard_map_nocheck(f, mesh, in_specs, out_specs):
+    """shard_map with replication checking off, across the jax rename
+    (check_rep in <= 0.4.x, check_vma in >= 0.7) — the same
+    version-compat treatment msm_pallas gives TPUCompilerParams. The
+    check must be off: our steps combine per-shard point partials with
+    explicit collectives and declare the results replicated, which the
+    static inference cannot verify."""
+    import inspect
+
+    params = inspect.signature(shard_map).parameters
+    kw = "check_vma" if "check_vma" in params else "check_rep"
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, **{kw: False})
+
+
 def make_mesh(n_devices: int | None = None, axis: str = "dp") -> Mesh:
     devs = jax.devices()
     if n_devices is not None:
@@ -67,11 +82,63 @@ def verify_step_sharded(mesh: Mesh):
         return statuses, diag
 
     spec = P(axis)
-    sharded = shard_map(
+    sharded = shard_map_nocheck(
         step,
         mesh=mesh,
         in_specs=(spec, spec, spec, spec),
         out_specs=(spec, P()),
-        check_vma=False,
     )
     return jax.jit(sharded)
+
+
+def verify_rlc_step_sharded(mesh: Mesh):
+    """Build the jitted, mesh-sharded RLC batch-verify pass (round-10:
+    the primary verify mode finally composes with multi-chip).
+
+    Per-lane stages (s-range, decompress, SHA/sc front-end, status
+    ladder) shard trivially over 'dp'; the Pippenger MSMs and the
+    torsion certification fill buckets LOCALLY per device and combine
+    per-window/per-trial point partials across the mesh with one
+    all_gather + unified adds before the doubling-chain tails
+    (ops/msm.py axis_name plumbing). The u*B term folds per shard —
+    sum_d u_d*B == (sum_d u_d)*B in the group — so no scalar collective
+    is needed.
+
+    Returns fn(msgs, lens, sigs, pubs, z, u) -> (status, definite,
+    batch_ok) with the exact verify_batch_rlc contract: status/definite
+    per-lane (global batch order), batch_ok the replicated global
+    verdict. z is (B, 32) per-lane weights; u is (K, 2B) with columns
+    0..B-1 weighting the pubkey points and B..2B-1 the R points —
+    i.e. a drop-in rlc_fn for verify_rlc.make_async_verifier.
+    """
+    from ..ops.verify_rlc import verify_batch_rlc
+
+    axis = mesh.axis_names[0]
+
+    def step(msgs, lens, sigs, pubs, z, u3):
+        # u3: (K, 2, B_local) — axis 1 separates A-weights from
+        # R-weights so the lane shard of each half lands on the right
+        # device; restack to the local (K, 2*B_local) column order
+        # verify_batch_rlc's stacked [A-lanes, R-lanes] decompression
+        # expects.
+        u = u3.reshape(u3.shape[0], -1)
+        return verify_batch_rlc(msgs, lens, sigs, pubs, z, u,
+                                axis_name=axis)
+
+    spec = P(axis)
+    sharded = shard_map_nocheck(
+        step,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec, P(None, None, axis)),
+        out_specs=(spec, spec, P()),
+    )
+    jitted = jax.jit(sharded)
+
+    def fn(msgs, lens, sigs, pubs, z, u):
+        k = u.shape[0]
+        bsz = msgs.shape[0]
+        # (K, 2B) -> (K, 2, B): columns 0..B-1 are the A weights,
+        # B..2B-1 the R weights (verify_rlc.fresh_u's convention).
+        return jitted(msgs, lens, sigs, pubs, z, u.reshape(k, 2, bsz))
+
+    return fn

@@ -3,6 +3,14 @@
 Times each stage of verify_batch independently at the bench batch size so
 optimization effort lands where the milliseconds are. Run on the real TPU:
     python scripts/profile_stages.py [batch]
+
+`stage_attribution()` is the importable round-10 harness: it times the
+verify pass's logical stages at an exact input shape with the SAME
+flag-selected engines the production graph uses, and attributes the
+leftover (total - sum of stages) to `glue` — the dsm_attrib.py-style
+subtraction, generalized to the whole verify column. bench.py records
+its dict (`stage_ms`) in every verify-ladder artifact, and the ROOFLINE
+budget table is stated in its keys.
 """
 
 import os
@@ -32,6 +40,172 @@ def bench_fn(fn, args, reps=5, warmup=2):
         out,
     )
     return (time.perf_counter() - t0) / reps
+
+
+# Artifact schema: every key is always present (the fused_smoke lane
+# pins this), `glue` is the subtraction residual and may be negative
+# when stages overlap (that is signal — fusion working — not an error).
+STAGE_KEYS = ("sha", "decompress", "sc", "rlc_combine", "msm", "glue")
+
+
+def stage_attribution(msgs, lens, sigs, pubs, mode="rlc", reps=3,
+                      warmup=1, total_ms=None, seed=7):
+    """Per-stage ms attribution of the verify pass at this input shape.
+
+    Times each logical stage as its own jitted launch with the engines
+    the CURRENT flag environment selects (fused front-end, kernel vs
+    XLA MSM, ...), then attributes `glue = total - sum(stages)` — the
+    inter-stage cost (byte<->limb transposes, canonicalize chains,
+    dispatch) that no per-stage timer can see, measured by subtraction
+    exactly like scripts/dsm_attrib.py isolates the DSM's terms.
+
+    Keys (STAGE_KEYS, all always present):
+      sha         — SHA-512 over r||pub||msg. When the fused front-end
+                    is active and the shape eligible this is the FUSED
+                    kernel (compression + Barrett mod-L + the RLC
+                    coefficient muls in one VMEM launch), and `sc` /
+                    `rlc_combine` report 0.0 — their work is inside
+                    this number (`fused: true` marks that).
+      decompress  — the stacked (A, R) point decompression.
+      sc          — sc_reduce64 of the digest (staged path only).
+      rlc_combine — m = z*h, zs = z*s, u = sum zs (rlc mode; the
+                    sc_sum stays outside the fused kernel and is
+                    always charged here).
+      msm         — rlc: the two Pippenger MSMs + torsion cert at the
+                    flag-selected engine; direct: the double-scalarmult.
+      glue        — total - sum(above); negative = overlap/fusion
+                    across the stage boundaries the timers cut at.
+
+    total_ms: the measured end-to-end ms/batch (bench.py passes its
+    timed number so the residual is attributed against the production
+    graph, not a re-measurement); None re-measures here.
+
+    Returns {**{k: ms}, 'total': ms, 'fused': bool, 'engine': str,
+    'mode': mode}. Works on any backend (CPU CI runs it at the smoke
+    shape); on-chip it is the ROOFLINE per-stage table's source.
+    """
+    from firedancer_tpu.ops import curve25519 as ge
+    from firedancer_tpu.ops import msm as msm_mod
+    from firedancer_tpu.ops import sc25519 as sc
+    from firedancer_tpu.ops.frontend_pallas import (
+        frontend_eligible,
+        frontend_impl,
+        frontend_rlc_auto,
+        sha512_mod_l_auto,
+        staged_coeff_muls,
+    )
+    from firedancer_tpu.ops.sha512 import sha512_batch_auto
+    from firedancer_tpu.ops.verify import _dsm_auto, verify_batch
+    from firedancer_tpu.ops.verify_rlc import (
+        fresh_u, fresh_z, msm_engine, verify_batch_rlc,
+    )
+
+    msgs = jnp.asarray(msgs)
+    lens = jnp.asarray(lens).astype(jnp.int32)
+    sigs = jnp.asarray(sigs)
+    pubs = jnp.asarray(pubs)
+    bsz = msgs.shape[0]
+    r_bytes, s_bytes = sigs[:, :32], sigs[:, 32:]
+    hash_in = jnp.concatenate([r_bytes, pubs, msgs], axis=1)
+    hlens = lens + 64
+    from firedancer_tpu import flags
+
+    rng = np.random.default_rng(seed)
+    z = jnp.asarray(fresh_z(bsz, rng))
+    u = jnp.asarray(fresh_u(flags.get_int("FD_RLC_TORSION_K"),
+                            2 * bsz, rng))
+
+    impl = frontend_impl()
+    fused = impl != "xla" and frontend_eligible(
+        bsz, hash_in.shape[1], with_rlc=(mode == "rlc"))
+    engine = msm_engine() if mode == "rlc" else (
+        "pallas" if impl == "pallas" else "xla")
+    out = {k: 0.0 for k in STAGE_KEYS}
+
+    def t(fn, args):
+        return 1e3 * bench_fn(jax.jit(fn), args, reps=reps, warmup=warmup)
+
+    # -- sha / sc / rlc_combine (the scalar front half) -----------------
+    h_bytes = None
+    if mode == "rlc":
+        if fused:
+            out["sha"] = t(
+                lambda m, l, zz, ss: frontend_rlc_auto(m, l, zz, ss),
+                (hash_in, hlens, z, s_bytes))
+            _h, m_bytes, zs = frontend_rlc_auto(hash_in, hlens, z, s_bytes)
+        else:
+            h64 = sha512_batch_auto(hash_in, hlens)
+            out["sha"] = t(sha512_batch_auto, (hash_in, hlens))
+            out["sc"] = t(sc.sc_reduce64_auto, (h64,))
+            h_bytes = sc.sc_reduce64_auto(h64)
+            # The EXACT production dispatch (frontend_pallas.
+            # staged_coeff_muls honors FD_SC_IMPL=pallas on TPU), so
+            # the artifact times the engine the verify graph ran, not
+            # a hardcoded XLA stand-in.
+            out["rlc_combine"] = t(staged_coeff_muls,
+                                   (z, h_bytes, s_bytes))
+            m_bytes, zs = staged_coeff_muls(z, h_bytes, s_bytes)
+        out["rlc_combine"] += t(sc.sc_sum, (zs,))
+    else:
+        if fused:
+            out["sha"] = t(sha512_mod_l_auto, (hash_in, hlens))
+        else:
+            h64 = sha512_batch_auto(hash_in, hlens)
+            out["sha"] = t(sha512_batch_auto, (hash_in, hlens))
+            out["sc"] = t(sc.sc_reduce64_auto, (h64,))
+        h_bytes = sha512_mod_l_auto(hash_in, hlens)
+
+    # -- decompress (stacked A, R — both modes) --------------------------
+    ar = jnp.concatenate([pubs, r_bytes], axis=0)
+    out["decompress"] = t(lambda x: ge.decompress_auto(x), (ar,))
+    both, _ = ge.decompress_auto(ar)[:2]
+    a_point = tuple(c[:, :bsz] for c in both)
+    r_point = tuple(c[:, bsz:] for c in both)
+
+    # -- msm (rlc: 2 MSMs + torsion cert; direct: the DSM) ---------------
+    if mode == "rlc":
+        import functools
+
+        if engine == "xla":
+            msm_impl, sub_impl = msm_mod.msm, msm_mod.subgroup_check
+        else:
+            interp = engine == "interpret"
+            msm_impl = functools.partial(msm_mod.msm_fast, interpret=interp)
+            sub_impl = functools.partial(
+                msm_mod.subgroup_check_fast, interpret=interp)
+        neg_r = ge.point_neg(r_point)
+        neg_a = ge.point_neg(a_point)
+        out["msm"] = (
+            t(lambda s_, p: msm_impl(s_, p, n_windows=msm_mod.WINDOWS_Z)[0],
+              (z, neg_r))
+            + t(lambda s_, p: msm_impl(
+                s_, p, n_windows=msm_mod.WINDOWS_253)[0],
+                (m_bytes, neg_a))
+            + t(lambda p, u_: sub_impl(p, u_)[0], (both, u))
+        )
+    else:
+        neg_a = ge.point_neg(a_point)
+        out["msm"] = t(lambda h, a, s_: _dsm_auto()(h, a, s_),
+                       (h_bytes, neg_a, s_bytes))
+
+    # -- total + the subtraction residual --------------------------------
+    if total_ms is None:
+        if mode == "rlc":
+            total_ms = 1e3 * bench_fn(
+                jax.jit(verify_batch_rlc),
+                (msgs, lens, sigs, pubs, z, u), reps=reps, warmup=warmup)
+        else:
+            total_ms = 1e3 * bench_fn(
+                jax.jit(verify_batch), (msgs, lens, sigs, pubs),
+                reps=reps, warmup=warmup)
+    staged = sum(out[k] for k in STAGE_KEYS if k != "glue")
+    out["glue"] = total_ms - staged
+    out = {k: round(v, 3) for k, v in out.items()}
+    out["total"] = round(total_ms, 3)
+    out["fused"] = bool(fused)
+    out["engine"] = engine
+    out["mode"] = mode
+    return out
 
 
 def main():
@@ -174,6 +348,48 @@ def main():
     )
     print(f"msm staging (sort):  {t*1e3:8.3f} ms")
 
+    # --- round-10 fused front-end ---------------------------------------
+    from firedancer_tpu.ops.frontend_pallas import (
+        frontend_eligible,
+        frontend_rlc_pallas,
+        sha512_mod_l_pallas,
+    )
+
+    hash_in = jnp.concatenate([sbytes, ybytes, msgs], axis=1)
+    hlens = lens + 64
+    if frontend_eligible(batch, hash_in.shape[1], with_rlc=True):
+        t = bench_fn(
+            jax.jit(sha512_mod_l_pallas), (hash_in, hlens))
+        print(f"fused sha+mod-L:     {t*1e3:8.3f} ms")
+        t = bench_fn(
+            jax.jit(frontend_rlc_pallas), (hash_in, hlens, z, sbytes))
+        print(f"fused rlc frontend:  {t*1e3:8.3f} ms")
+    else:
+        print(f"fused frontend:      ineligible at B={batch}")
+
+
+def attrib_main():
+    """JSON per-stage attribution at the bench shape (both modes):
+    python scripts/profile_stages.py --attrib [batch [msg_len]]."""
+    import json
+
+    argv = [a for a in sys.argv[1:] if not a.startswith("-")]
+    batch = int(argv[0]) if argv else 8192
+    msg_len = int(argv[1]) if len(argv) > 1 else 192
+    rng = np.random.RandomState(0)
+    msgs = rng.randint(0, 256, (batch, msg_len), dtype=np.uint8)
+    lens = np.full((batch,), msg_len, np.int32)
+    sigs = rng.randint(0, 256, (batch, 64), dtype=np.uint8)
+    sigs[:, 63] &= 0x0F                    # keep s in range
+    pubs = rng.randint(0, 256, (batch, 32), dtype=np.uint8)
+    for mode in ("rlc", "direct"):
+        rec = stage_attribution(msgs, lens, sigs, pubs, mode=mode)
+        rec["batch"], rec["msg_len"] = batch, msg_len
+        print(json.dumps(rec))
+
 
 if __name__ == "__main__":
-    main()
+    if "--attrib" in sys.argv:
+        attrib_main()
+    else:
+        main()

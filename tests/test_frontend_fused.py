@@ -1,0 +1,476 @@
+"""Round-10 fused verify front-end + mesh-sharded Pippenger MSM.
+
+Two contracts this file pins:
+
+1. The fused front-end (ops/frontend_pallas.py: SHA-512 -> Barrett
+   mod-L -> RLC coefficient muls as ONE VMEM kernel) is bit-exact vs
+   the staged CPU oracle (sha512_batch + sc_reduce64 + _sc_muladd) on a
+   mixed good/bad/non-canonical/torsion batch — the kernel-body
+   arithmetic always (eager jax ops are exactly what pallas interpret
+   mode executes), the full pallas_call interpret plumbing behind the
+   same FD_RUN_PALLAS_TESTS opt-in the kernel test tier uses, and the
+   ineligible-shape fallback silently staged, never a wrong launch.
+
+2. The sharded MSM: under a 2-device shard_map, per-device bucket fills
+   combined across the mesh (ops/msm.py axis_name) equal the
+   single-device MSM and the affine oracle, the torsion certification
+   certifies the GLOBAL point set (a small-order point on shard 1 fails
+   the whole batch), and VerifyTile's resolve_verify_mode no longer
+   blanket-rejects rlc + mesh_devices.
+
+Cost discipline matches test_verify_rlc.py: small fixed shapes, jitted
+once, persistent compilation cache.
+"""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from firedancer_tpu.ballet import ed25519 as oracle
+from firedancer_tpu.ops import sc25519 as sc
+from firedancer_tpu.ops import frontend_pallas as fp
+from firedancer_tpu.ops.sha512 import sha512_batch
+from firedancer_tpu.ops.sha512_pallas import _pack_schedule, _sha512_rounds
+from firedancer_tpu.ops.sign import _sc_muladd
+
+B = 1024          # the smallest fold-eligible batch (8 sublanes x 128)
+MAX_LEN = 64
+SEED = 23
+
+force_pallas = os.environ.get("FD_RUN_PALLAS_TESTS") == "1"
+
+
+def _mixed_batch():
+    """(msgs, lens, sigs, pubs) at B=1024: 16 mixed lanes tiled 64x.
+
+    Lane classes (the verify column's whole input space, so the fused
+    scalar front half sees every byte pattern the staged path does):
+    good signatures, a salted R (live lane, batch-equation defect), a
+    non-canonical R (y = 2^255 - 1: decodable, >= p), an out-of-range
+    s (0xFF..: definite ERR_SIG upstream, but the front half still
+    hashes/multiplies its bytes), and a torsion-forged lane
+    (R = r*B + T with T order-2 — valid-format bytes whose defect only
+    the certification sees).
+    """
+    base = 16
+    rng = np.random.RandomState(SEED)
+    msgs = np.zeros((base, MAX_LEN), np.uint8)
+    lens = np.zeros(base, np.int32)
+    sigs = np.zeros((base, 64), np.uint8)
+    pubs = np.zeros((base, 32), np.uint8)
+    for i in range(base):
+        seed = bytes([i + 1, SEED]) + bytes(30)
+        _, _, pub = oracle.keypair_from_seed(seed)
+        m = rng.randint(0, 256, rng.randint(1, MAX_LEN), dtype=np.uint8)
+        sig = oracle.sign(m.tobytes(), seed)
+        msgs[i, : len(m)] = m
+        lens[i] = len(m)
+        sigs[i] = np.frombuffer(sig, np.uint8)
+        pubs[i] = np.frombuffer(pub, np.uint8)
+    sigs[3, 2] ^= 0x40                   # salted R
+    sigs[4, :32] = 0xFF
+    sigs[4, 31] = 0x7F                   # non-canonical R: y = 2^255 - 1
+    sigs[5, 32:] = 0xFF                  # s out of range
+    # Torsion forgery on lane 6 (test_verify_rlc._torsion_batch's
+    # construction, T = (0, p-1) the order-2 point).
+    t2 = (0, oracle.P - 1)
+    seed6 = bytes([7, SEED]) + bytes(30)
+    a6, _, pub6 = oracle.keypair_from_seed(seed6)
+    m6 = msgs[6, : lens[6]].tobytes()
+    r6 = 987_654_321
+    big_r = oracle.point_add(oracle.scalarmult(r6, oracle.B), t2)
+    r_bytes = oracle.point_compress(big_r)
+    from firedancer_tpu.ballet.ed25519.oracle import _sha512_mod_l
+
+    h6 = _sha512_mod_l(r_bytes, pub6, m6)
+    s6 = (r6 + h6 * a6) % oracle.L
+    sigs[6] = np.frombuffer(r_bytes + s6.to_bytes(32, "little"), np.uint8)
+    pubs[6] = np.frombuffer(pub6, np.uint8)
+
+    reps = B // base
+    return (np.tile(msgs, (reps, 1)), np.tile(lens, reps),
+            np.tile(sigs, (reps, 1)), np.tile(pubs, (reps, 1)))
+
+
+def _front_inputs():
+    msgs, lens, sigs, pubs = _mixed_batch()
+    rng = np.random.RandomState(SEED + 1)
+    z = rng.randint(0, 256, (B, 32), dtype=np.uint8)
+    z[0] = 0                             # dead lane: m = zs = 0
+    hash_in = np.concatenate([sigs[:, :32], pubs, msgs], axis=1)
+    hlens = lens + 64
+    return (jnp.asarray(hash_in), jnp.asarray(hlens.astype(np.int32)),
+            jnp.asarray(z), jnp.asarray(sigs[:, 32:]))
+
+
+def _staged_ref(hash_in, hlens, z, s_bytes):
+    h = sc.sc_reduce64(sha512_batch(hash_in, hlens))
+    zero = jnp.zeros_like(z)
+    return (np.asarray(h), np.asarray(_sc_muladd(z, h, zero)),
+            np.asarray(_sc_muladd(z, s_bytes, zero)))
+
+
+def test_fused_kernel_body_parity_mixed_batch():
+    """The exact arithmetic the fused kernel executes — compression,
+    digest-limb extraction, folded Barrett, folded mod-L muls — run
+    eagerly (which is precisely what pallas interpret mode lowers to)
+    over the mixed batch, bit-exact vs the staged oracle and spot-
+    checked vs Python bigint."""
+    hash_in, hlens, z, s_bytes = _front_inputs()
+    h_ref, m_ref, zs_ref = _staged_ref(hash_in, hlens, z, s_bytes)
+
+    hi, lo, nblk, lb, mb = _pack_schedule(hash_in, hlens)
+    state = _sha512_rounds(hi, lo, nblk, max_blocks=mb)
+    h_fold = fp._barrett_f(fp._digest_limbs(state))
+    h_got = np.asarray(fp._unfold_scalar(h_fold, B))
+    assert (h_got == h_ref).all()
+
+    z_fold = fp._fold_scalar(z, lb)
+    m_got = np.asarray(fp._unfold_scalar(
+        fp._mul_mod_l_f(z_fold, h_fold), B))
+    zs_got = np.asarray(fp._unfold_scalar(
+        fp._mul_mod_l_f(z_fold, fp._fold_scalar(s_bytes, lb)), B))
+    assert (m_got == m_ref).all()
+    assert (zs_got == zs_ref).all()
+
+    z_np, s_np = np.asarray(z), np.asarray(s_bytes)
+    for i in (0, 3, 4, 5, 6):            # one lane per mixed class
+        want = (int.from_bytes(z_np[i].tobytes(), "little")
+                * int.from_bytes(s_np[i].tobytes(), "little")) % sc.L
+        assert int.from_bytes(zs_got[i].tobytes(), "little") == want
+
+
+@pytest.mark.skipif(not force_pallas,
+                    reason="pallas interpret is compile-heavy on CPU "
+                           "(FD_RUN_PALLAS_TESTS=1 forces; the ci.sh "
+                           "fused_smoke lane gates the kernel body "
+                           "every run)")
+def test_fused_pallas_interpret_parity_mixed_batch(monkeypatch):
+    """The production launch path under the Pallas interpreter: the
+    dispatcher must pick the fused kernel at this eligible shape and
+    agree bit-exactly with the staged oracle on the mixed batch."""
+    import jax
+
+    hash_in, hlens, z, s_bytes = _front_inputs()
+    h_ref, m_ref, zs_ref = _staged_ref(hash_in, hlens, z, s_bytes)
+
+    monkeypatch.setenv("FD_FRONTEND_IMPL", "interpret")
+    h, m, zs = jax.jit(fp.frontend_rlc_auto)(hash_in, hlens, z, s_bytes)
+    assert (np.asarray(h) == h_ref).all()
+    assert (np.asarray(m) == m_ref).all()
+    assert (np.asarray(zs) == zs_ref).all()
+
+    h2 = jax.jit(fp.sha512_mod_l_auto)(hash_in, hlens)
+    assert (np.asarray(h2) == h_ref).all()
+
+
+def test_fused_ineligible_shape_falls_back_staged(monkeypatch):
+    """A non-fold-multiple batch must take the staged composition even
+    with the fused engine forced — bit-exact, never a wrong launch."""
+    import jax
+
+    hash_in, hlens, z, s_bytes = _front_inputs()
+    n = 16                               # not a multiple of 8*128
+    args = (hash_in[:n], hlens[:n], z[:n], s_bytes[:n])
+    h_ref, m_ref, zs_ref = _staged_ref(*args)
+
+    monkeypatch.setenv("FD_FRONTEND_IMPL", "interpret")
+    assert not fp.frontend_eligible(n, hash_in.shape[1], with_rlc=True)
+    h, m, zs = jax.jit(fp.frontend_rlc_auto)(*args)
+    assert (np.asarray(h) == h_ref).all()
+    assert (np.asarray(m) == m_ref).all()
+    assert (np.asarray(zs) == zs_ref).all()
+
+
+def test_frontend_dispatch_contract(monkeypatch):
+    """FD_FRONTEND_IMPL resolution: auto -> staged off-TPU, interpret
+    honored, a typo raises (never quietly measures the wrong engine);
+    frontend_eligible gates the fold multiple and the VMEM guard."""
+    monkeypatch.delenv("FD_FRONTEND_IMPL", raising=False)
+    assert fp.frontend_impl() == "xla"   # cpu-jax host
+    monkeypatch.setenv("FD_FRONTEND_IMPL", "interpret")
+    assert fp.frontend_impl() == "interpret"
+    monkeypatch.setenv("FD_FRONTEND_IMPL", "bogus")
+    with pytest.raises(ValueError):
+        fp.frontend_impl()
+    assert fp.frontend_eligible(B, MAX_LEN, with_rlc=True)
+    assert not fp.frontend_eligible(B - 1, MAX_LEN, with_rlc=True)
+    assert not fp.frontend_eligible(1 << 20, 4096, with_rlc=True)
+
+
+# --------------------------------------------------------------------------
+# Sharded Pippenger MSM (2-shard CPU shard_map parity).
+# --------------------------------------------------------------------------
+
+
+def _oracle_points(n, seed=11):
+    import random as pyrandom
+
+    rng = pyrandom.Random(seed)
+    pts_aff = [oracle.scalarmult(rng.randint(1, 2**60), oracle.B)
+               for _ in range(n)]
+    coords = [np.zeros((32, n), np.int32) for _ in range(4)]
+    from firedancer_tpu.ops import fe25519 as fe
+
+    for i, p in enumerate(pts_aff):
+        for j, v in enumerate((p[0], p[1], 1, p[0] * p[1] % fe.P)):
+            for k in range(32):
+                coords[j][k, i] = (v >> (8 * k)) & 0xFF
+    return pts_aff, tuple(jnp.asarray(c) for c in coords)
+
+
+def _affine(pt):
+    from firedancer_tpu.ops import fe25519 as fe
+
+    x, y, z = (fe.limbs_to_int(c)[0] for c in pt[:3])
+    zi = pow(z, fe.P - 2, fe.P)
+    return (x * zi % fe.P, y * zi % fe.P)
+
+
+def test_msm_sharded_two_devices_matches_single_and_oracle():
+    """The satellite's named parity: per-device window partials combined
+    across a 2-device mesh == the single-device MSM == the affine
+    oracle. Lanes split 12/12; each shard's bucket grid only ever sees
+    its local points, so agreement requires the cross-mesh
+    _gather_point_sum combine to be the group sum."""
+    import random as pyrandom
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from firedancer_tpu.ops import msm as msm_mod
+    from firedancer_tpu.parallel.mesh import make_mesh, shard_map_nocheck
+
+    bsz = 24
+    pts_aff, pts = _oracle_points(bsz)
+    rng = pyrandom.Random(13)
+    scal = np.zeros((bsz, 32), np.uint8)
+    for i in range(bsz):
+        c = rng.randint(0, 2**252 - 1)
+        scal[i] = np.frombuffer(c.to_bytes(32, "little"), np.uint8)
+    scal_j = jnp.asarray(scal)
+
+    nw = msm_mod.WINDOWS_253
+    single, ok_single = jax.jit(
+        lambda s, p: msm_mod.msm(s, p, n_windows=nw))(scal_j, pts)
+    assert bool(ok_single)
+
+    mesh = make_mesh(2)
+    axis = mesh.axis_names[0]
+    sharded = shard_map_nocheck(
+        lambda s, p: msm_mod.msm(s, p, n_windows=nw, axis_name=axis),
+        mesh=mesh,
+        in_specs=(P(axis), (P(None, axis),) * 4),
+        out_specs=((P(None, None),) * 4, P()),
+    )
+    got, ok = jax.jit(sharded)(scal_j, pts)
+    assert bool(ok)
+    assert _affine(got) == _affine(single)
+
+    want = (0, 1)
+    for i in range(bsz):
+        c = int.from_bytes(scal[i].tobytes(), "little")
+        want = oracle.point_add(want, oracle.scalarmult(c, pts_aff[i]))
+    assert _affine(got) == want
+
+
+def test_subgroup_check_sharded_certifies_global_point_set():
+    """The sharded torsion certification is over EVERY shard's points:
+    clean points pass, and a small-order point placed on the SECOND
+    shard fails the global verdict (a per-shard-only certification
+    would let shard 0's identity-aggregate mask it)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from firedancer_tpu.ops import msm as msm_mod
+    from firedancer_tpu.parallel.mesh import make_mesh, shard_map_nocheck
+
+    bsz, k = 16, 4
+    _, pts = _oracle_points(bsz, seed=19)
+    rng = np.random.RandomState(29)
+    u = rng.randint(0, 8, (k, bsz)).astype(np.int32)
+    u_j = jnp.asarray(u)
+
+    mesh = make_mesh(2)
+    axis = mesh.axis_names[0]
+    sharded = shard_map_nocheck(
+        lambda p, uu: msm_mod.subgroup_check(p, uu, axis_name=axis),
+        mesh=mesh,
+        in_specs=((P(None, axis),) * 4, P(None, axis)),
+        out_specs=(P(), P()),
+    )
+    f = jax.jit(sharded)
+    ok, ok_fill = f(pts, u_j)
+    assert bool(ok_fill)
+    assert bool(ok)
+
+    # Order-2 point T = (0, p-1) in a lane of the second shard, with a
+    # trial weight that does not cancel mod 2: the global verdict must
+    # flip even though shard 0's local points are all clean.
+    from firedancer_tpu.ops import fe25519 as fe
+
+    t2 = (0, fe.P - 1, 1, 0)
+    bad = [np.asarray(c).copy() for c in pts]
+    lane = bsz - 2                       # lives on shard 1
+    for j, v in enumerate(t2):
+        for kk in range(32):
+            bad[j][kk, lane] = (v >> (8 * kk)) & 0xFF
+    u_bad = u.copy()
+    u_bad[:, lane] = 1
+    ok2, ok_fill2 = f(tuple(jnp.asarray(c) for c in bad),
+                      jnp.asarray(u_bad))
+    assert bool(ok_fill2)
+    assert not bool(ok2)
+
+
+@pytest.mark.slow
+def test_verify_rlc_step_sharded_matches_single_device():
+    """End-to-end: the mesh-sharded RLC verify pass (2 devices) agrees
+    with the single-device graph on clean and dirty batches — status,
+    definite, and the replicated global batch_ok."""
+    import jax
+
+    from firedancer_tpu.ops.verify_rlc import (
+        fresh_u, fresh_z, verify_batch_rlc,
+    )
+    from firedancer_tpu.parallel.mesh import make_mesh, verify_rlc_step_sharded
+
+    n, k = 16, 8
+    msgs, lens, sigs, pubs = (a[:n] for a in _mixed_batch())
+    args = (jnp.asarray(msgs), jnp.asarray(lens.astype(np.int32)),
+            jnp.asarray(sigs), jnp.asarray(pubs))
+    rng = np.random.default_rng(41)
+    z = jnp.asarray(fresh_z(n, rng))
+    u = jnp.asarray(fresh_u(k, 2 * n, rng))
+
+    ref = [np.asarray(x) for x in
+           jax.jit(verify_batch_rlc)(*args, z, u)]
+    step = verify_rlc_step_sharded(make_mesh(2))
+    got = [np.asarray(x) for x in step(*args, z, u)]
+    assert (got[0] == ref[0]).all()          # status
+    assert (got[1] == ref[1]).all()          # definite
+    assert bool(got[2]) == bool(ref[2])      # batch_ok (global)
+    assert not bool(got[2])                  # the mixed batch is dirty
+
+    clean = tuple(jnp.asarray(a) for a in _clean16())
+    z2 = jnp.asarray(fresh_z(n, rng))
+    u2 = jnp.asarray(fresh_u(k, 2 * n, rng))
+    ref2 = [np.asarray(x) for x in
+            jax.jit(verify_batch_rlc)(*clean, z2, u2)]
+    got2 = [np.asarray(x) for x in step(*clean, z2, u2)]
+    assert bool(got2[2]) and bool(ref2[2])
+    assert (got2[0] == ref2[0]).all()
+    assert (got2[1] == ref2[1]).all()
+
+
+def _clean16():
+    rng = np.random.RandomState(77)
+    msgs = np.zeros((16, MAX_LEN), np.uint8)
+    lens = np.zeros(16, np.int32)
+    sigs = np.zeros((16, 64), np.uint8)
+    pubs = np.zeros((16, 32), np.uint8)
+    for i in range(16):
+        seed = bytes([i + 1, 77]) + bytes(30)
+        _, _, pub = oracle.keypair_from_seed(seed)
+        m = rng.randint(0, 256, rng.randint(1, MAX_LEN), dtype=np.uint8)
+        sig = oracle.sign(m.tobytes(), seed)
+        msgs[i, : len(m)] = m
+        lens[i] = len(m)
+        sigs[i] = np.frombuffer(sig, np.uint8)
+        pubs[i] = np.frombuffer(pub, np.uint8)
+    return msgs, lens.astype(np.int32), sigs, pubs
+
+
+# --------------------------------------------------------------------------
+# Tile-facing mode resolution: rlc + mesh composes now.
+# --------------------------------------------------------------------------
+
+
+def test_resolve_verify_mode_rlc_mesh_composes(monkeypatch):
+    """Round-10 contract: explicit rlc + mesh_devices constructs (the
+    pre-round-10 blanket rejection is gone); the only remaining blanket
+    rejection is rlc on a non-jax host backend; FD_MSM_SHARD=0 restores
+    the old behavior — auto quietly resolves direct, an explicit force
+    raises."""
+    from firedancer_tpu.disco.tiles import resolve_verify_mode
+
+    monkeypatch.delenv("FD_VERIFY_MODE", raising=False)
+    monkeypatch.delenv("FD_MSM_SHARD", raising=False)
+
+    assert resolve_verify_mode("tpu", "rlc", 4) == "rlc"
+    assert resolve_verify_mode("tpu", "rlc", 0) == "rlc"
+    assert resolve_verify_mode("tpu", "direct", 4) == "direct"
+
+    # The genuinely unsupported combination still fails loudly.
+    with pytest.raises(ValueError, match="genuinely unsupported"):
+        resolve_verify_mode("cpu", "rlc", 0)
+    with pytest.raises(ValueError, match="genuinely unsupported"):
+        resolve_verify_mode("oracle", "rlc", 2)
+    monkeypatch.setenv("FD_VERIFY_MODE", "rlc")
+    with pytest.raises(ValueError):
+        resolve_verify_mode("cpu", "auto", 0)
+    monkeypatch.delenv("FD_VERIFY_MODE")
+
+    # Bisection hatch: FD_MSM_SHARD=0 + explicit rlc force + mesh.
+    monkeypatch.setenv("FD_MSM_SHARD", "0")
+    with pytest.raises(ValueError, match="FD_MSM_SHARD"):
+        resolve_verify_mode("tpu", "rlc", 4)
+    assert resolve_verify_mode("tpu", "rlc", 0) == "rlc"
+
+    with pytest.raises(ValueError, match="unknown verify_mode"):
+        resolve_verify_mode("tpu", "bogus", 0)
+
+
+@pytest.mark.slow
+def test_verify_tile_constructs_rlc_with_mesh(tmp_path, monkeypatch):
+    """Acceptance: VerifyTile(verify_mode='rlc', mesh_devices=N)
+    constructs — the blanket rejection is lifted, and construction
+    prewarms the SHARDED RLC pass plus the sharded per-lane fallback
+    (slow: two shard_map compiles at the (16, 64) shape)."""
+    from firedancer_tpu.disco.pipeline import build_topology
+    from firedancer_tpu.disco.tiles import VerifyTile
+    from firedancer_tpu.tango.rings import Workspace
+
+    monkeypatch.setenv("FD_RLC_TORSION_K", "8")
+    topo = build_topology(str(tmp_path / "t.wksp"), depth=64)
+    wksp = Workspace.join(topo.wksp_path)
+    try:
+        tile = VerifyTile(
+            wksp, "verify.cnc", in_link=None, out_link=None,
+            backend="tpu", verify_mode="rlc", mesh_devices=2,
+            batch=16, max_msg_len=MAX_LEN,
+        )
+        assert tile.verify_mode == "rlc"
+    finally:
+        wksp.leave()
+
+
+# --------------------------------------------------------------------------
+# msm_plan: the stdlib planning math must never drift from the engine.
+# --------------------------------------------------------------------------
+
+
+def test_msm_plan_rounds_pin_engine():
+    from firedancer_tpu import msm_plan
+    from firedancer_tpu.ops import msm as msm_mod
+
+    for bsz in (16, 128, 1024, 8192, 16384, 32768):
+        for nb in (32, msm_plan.N_BUCKETS):
+            assert (msm_plan.default_rounds(bsz, nb)
+                    == msm_mod._default_rounds(bsz, nb))
+    assert msm_plan.N_BUCKETS == msm_mod.N_BUCKETS
+    assert msm_plan.WINDOWS_Z == msm_mod.WINDOWS_Z
+    assert msm_plan.WINDOWS_253 == msm_mod.WINDOWS_253
+
+
+def test_msm_plan_efficiency_monotone_and_winner():
+    from firedancer_tpu import msm_plan
+
+    effs = [msm_plan.fill_efficiency(b)["total"]
+            for b in (8192, 16384, 32768)]
+    assert effs[0] < effs[1] < effs[2]
+    assert all(0.0 < e < 1.0 for e in effs)
+    pred = msm_plan.sweep_prediction((8192, 16384, 32768))
+    assert pred["winner"] == 32768
