@@ -140,6 +140,66 @@ def _rlc_fallbacks(res) -> int:
     return sum(v.get("rlc_fallback", 0) or 0 for v in res.verify_stats)
 
 
+def _schema_version() -> int:
+    from firedancer_tpu.disco.flight import ARTIFACT_SCHEMA_VERSION
+
+    return ARTIFACT_SCHEMA_VERSION
+
+
+def _replay_artifact(metric: str, corpus, res, run_s: float, gen_s: float,
+                     timeout_s: float) -> tuple[dict, bool]:
+    """The shared replay-gate artifact (round-11: ONE assembly for the
+    CPU and device gates — the per-worker hand-built dicts drifted a
+    field at a time before fd_flight centralized the view). Returns
+    (record, ok)."""
+    from firedancer_tpu.disco.corpus import sink_delta
+
+    missing, unexpected = sink_delta(corpus, res.sink_digests)
+    ok = missing == 0 and unexpected == 0
+    # Classification: "mismatch" ONLY when received content was wrong
+    # (unexpected > 0). A shortfall with clean content is a run cut
+    # short — "timeout" at the budget boundary, else "incomplete"
+    # (crash/kill) — never booked as corruption.
+    if ok:
+        status = "ok"
+    elif unexpected > 0:
+        status = "mismatch"
+    elif run_s >= timeout_s - 1.0:
+        status = "timeout"
+    else:
+        status = "incomplete"
+    rec = {
+        "metric": metric,
+        "value": round(len(corpus.payloads) / run_s, 1),
+        "unit": "txns/s",
+        "vs_baseline": 1.0 if ok else 0.0,  # gate: content-exact
+        "schema_version": _schema_version(),
+        "status": status,
+        "corpus": len(corpus.payloads),
+        "unique_ok": corpus.n_unique_ok,
+        "sink_recv": res.recv_cnt,
+        "missing": missing,
+        "unexpected": unexpected,
+        "mismatches": missing + unexpected,
+        "latency_p50_ms": round(res.latency_p50_ns / 1e6, 2),
+        "latency_p99_ms": round(res.latency_p99_ns / 1e6, 2),
+        "gen_s": round(gen_s, 1),
+        "run_s": round(run_s, 1),
+        # fd_feed/fd_chaos/fd_flight artifact fields: which runner
+        # produced this, its feeder gauges + healing counters (views
+        # over the flight registry), RLC fallback total, the sampled
+        # per-stage latency table, and the always-on trace-span
+        # histograms (docs/LATENCY.md states the p99 budget in these).
+        "feed": bool(getattr(res, "feed", False)),
+        "feed_fallback_reason": getattr(res, "feed_fallback_reason", None),
+        "verify_stats": res.verify_stats,
+        "rlc_fallbacks": _rlc_fallbacks(res),
+        "stage_latency_ms": _stage_latency_ms(res),
+        "stage_hist": getattr(res, "stage_hist", None),
+    }
+    return rec, ok
+
+
 def replay_cpu_worker() -> int:
     """The host-side 100k correctness gate: the full tile pipeline
     (replay -> verify[cpu native] -> dedup -> pack -> sink) with the
@@ -171,51 +231,9 @@ def replay_cpu_worker() -> int:
             record_digests=True,
         )
         run_s = time.perf_counter() - t0
-    from firedancer_tpu.disco.corpus import sink_delta
-
-    missing, unexpected = sink_delta(corpus, res.sink_digests)
-    ok = missing == 0 and unexpected == 0
-    # Classification: "mismatch" ONLY when received content was wrong
-    # (unexpected > 0). A shortfall with clean content is a run cut
-    # short — "timeout" at the budget boundary, else "incomplete"
-    # (crash/kill) — never booked as corruption.
-    if ok:
-        status = "ok"
-    elif unexpected > 0:
-        status = "mismatch"
-    elif run_s >= timeout_s - 1.0:
-        status = "timeout"
-    else:
-        status = "incomplete"
-    rec = {
-        "metric": "replay_pipeline_throughput_cpu",
-        "value": round(len(corpus.payloads) / run_s, 1),
-        "unit": "txns/s",
-        "vs_baseline": 1.0 if ok else 0.0,
-        "status": status,
-        "corpus": len(corpus.payloads),
-        "unique_ok": corpus.n_unique_ok,
-        "sink_recv": res.recv_cnt,
-        "missing": missing,
-        "unexpected": unexpected,
-        "mismatches": missing + unexpected,
-        "latency_p50_ms": round(res.latency_p50_ns / 1e6, 2),
-        "latency_p99_ms": round(res.latency_p99_ns / 1e6, 2),
-        "gen_s": round(gen_s, 1),
-        "run_s": round(run_s, 1),
-        # fd_feed artifact schema (round 8): which runner produced this,
-        # its feeder gauges, RLC fallback total, and the per-stage
-        # latency budget table. Round 9: verify_stats additionally
-        # carries the fd_chaos healing counters (stager_restarts,
-        # cpu_failover, quarantined, breaker state, slots_leaked — all
-        # zero on a fault-free run), and a feed-requested run that fell
-        # back to the legacy loop records WHY.
-        "feed": bool(getattr(res, "feed", False)),
-        "feed_fallback_reason": getattr(res, "feed_fallback_reason", None),
-        "verify_stats": res.verify_stats,
-        "rlc_fallbacks": _rlc_fallbacks(res),
-        "stage_latency_ms": _stage_latency_ms(res),
-    }
+    rec, ok = _replay_artifact(
+        "replay_pipeline_throughput_cpu", corpus, res, run_s, gen_s,
+        timeout_s)
     print(json.dumps(rec))
     return 0 if ok else 1
 
@@ -262,43 +280,9 @@ def replay_worker() -> int:
             record_digests=True,
         )
         run_s = time.perf_counter() - t0
-    # Content-exact gate with the missing/unexpected split (same
-    # classification as the CPU gate: a run cut short is a timeout or
-    # incomplete, never booked as content corruption).
-    from firedancer_tpu.disco.corpus import sink_delta
-
-    missing, unexpected = sink_delta(corpus, res.sink_digests)
-    ok = missing == 0 and unexpected == 0
-    if ok:
-        status = "ok"
-    elif unexpected > 0:
-        status = "mismatch"
-    elif run_s >= timeout_s - 1.0:
-        status = "timeout"
-    else:
-        status = "incomplete"
-    rec = {
-        "metric": "replay_pipeline_throughput",
-        "value": round(len(corpus.payloads) / run_s, 1),
-        "unit": "txns/s",
-        "vs_baseline": 1.0 if ok else 0.0,  # gate: content-exact
-        "status": status,
-        "corpus": len(corpus.payloads),
-        "unique_ok": corpus.n_unique_ok,
-        "sink_recv": res.recv_cnt,
-        "missing": missing,
-        "unexpected": unexpected,
-        "mismatches": missing + unexpected,
-        "latency_p50_ms": round(res.latency_p50_ns / 1e6, 2),
-        "latency_p99_ms": round(res.latency_p99_ns / 1e6, 2),
-        "gen_s": round(gen_s, 1),
-        "run_s": round(run_s, 1),
-        "verify_stats": res.verify_stats,
-        "feed": bool(getattr(res, "feed", False)),
-        "feed_fallback_reason": getattr(res, "feed_fallback_reason", None),
-        "rlc_fallbacks": _rlc_fallbacks(res),
-        "stage_latency_ms": _stage_latency_ms(res),
-    }
+    rec, ok = _replay_artifact(
+        "replay_pipeline_throughput", corpus, res, run_s, gen_s,
+        timeout_s)
     print(json.dumps(rec))
     return 0 if ok else 1
 
@@ -358,6 +342,7 @@ def pack_worker() -> int:
         "value": round(n / sched_s, 1),
         "unit": "txns/s",
         "vs_baseline": 1.0 if admissible else 0.0,  # gate: admissibility
+        "schema_version": _schema_version(),
         "block": n,
         "scheduled": scheduled,
         "leftover": len(leftover),
@@ -447,9 +432,29 @@ def worker(cpu: bool) -> int:
                                    + (" (rlc fell back)" if fell_back else "")}))
         return 1
 
+    # fd_flight: per-engine compile accounting (mode x B x shards=0 x
+    # frontend) — the registry record the engine-registry refactor
+    # (ROADMAP direction 3) will key on.
+    from firedancer_tpu.disco import flight
+
+    ekey = flight.engine_key(
+        mode, batch, 0, flags.get_str("FD_FRONTEND_IMPL") or "auto")
+    flight.record_compile(ekey, compile_s)
+
+    # Opt-in jax.profiler capture around the timed reps (device-side
+    # attribution for the ROOFLINE budget; the trace perturbs timing,
+    # so the artifact notes it).
+    trace_dir = flags.get_raw("FD_FLIGHT_JAX_TRACE")
     t0 = time.perf_counter()
-    outs = [fn(*args) for _ in range(reps)]
-    finals = [np.asarray(o) for o in outs]
+    if trace_dir and not cpu:
+        import jax.profiler as _prof
+
+        with _prof.trace(trace_dir):
+            outs = [fn(*args) for _ in range(reps)]
+            finals = [np.asarray(o) for o in outs]
+    else:
+        outs = [fn(*args) for _ in range(reps)]
+        finals = [np.asarray(o) for o in outs]
     dt = time.perf_counter() - t0
     bad = any(not bool((f == 0).all()) for f in finals)
     # COUNT fallbacks, don't just flag them: the artifact must record
@@ -477,12 +482,16 @@ def worker(cpu: bool) -> int:
         "value": round(rate, 1),
         "unit": "verifies/s",
         "vs_baseline": round(rate / 1_000_000, 4),
+        "schema_version": _schema_version(),
         "batch": batch,
         "msg_len": msg_len,
         "reps": reps,
         "mode": mode,
         "device": str(dev),
         "compile_s": round(compile_s, 1),
+        "engine_key": ekey,
+        "compile_cache_hit_est": compile_s < 1.0,
+        "jax_trace_dir": trace_dir if (trace_dir and not cpu) else None,
         "ms_per_batch": round(1e3 * dt / reps, 2),
         "rlc_fallbacks": fallback_cnt,
     }
@@ -631,6 +640,7 @@ def _log_measurement(rec: dict) -> None:
     BENCH_LOG.jsonl, so a wedged tunnel at snapshot time cannot erase a
     number that was measured earlier in the round."""
     entry = dict(rec)
+    entry.setdefault("schema_version", _schema_version())
     entry["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     try:
         with open(_BENCH_LOG, "a") as f:
@@ -866,6 +876,7 @@ def main() -> int:
         last = _last_logged_tpu()
         if last is not None:
             out = dict(last)
+            out.setdefault("schema_version", _schema_version())
             out["stale"] = True
             out["stale_ts"] = last.get("ts")
             out["error"] = rec["error"]
@@ -879,6 +890,7 @@ def main() -> int:
         "value": 0,
         "unit": "verifies/s",
         "vs_baseline": 0.0,
+        "schema_version": _schema_version(),
         "error": "; ".join(errors) + "; cpu fallback also failed",
     }
     last = _last_logged_tpu()

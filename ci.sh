@@ -70,6 +70,18 @@ echo "== fd_chaos smoke (CPU backend, seeded 7-class fault schedule) =="
 # breaker failover (trip -> CPU lane -> half-open re-probe -> closed).
 JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 
+echo "== fd_flight observability smoke (registry/export/fd_top/dump) =="
+# The round-11 observability gate: a clean fd_feed run must populate
+# the shared metric registry (verify_stats are bit-equal VIEWS over
+# it), every edge's always-on trace-span histogram must carry the full
+# population (sink span n == sink recv), the Prometheus export must
+# pin every declared metric family, fd_top must render the live
+# panels (FEEDER breaker/quarantine columns included), a seeded
+# 3-class fd_chaos run must dump a flight recorder whose per-class
+# recorded injections equal the injector's audit counters, and the
+# always-on layer must cost <= 5% vs FD_FLIGHT=0.
+JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+
 echo "== RLC verify smoke (CPU backend, FD_BENCH_VERIFY=rlc) =="
 # The production verify mode's dispatch contract (round-6 promotion):
 # tiny batch through the tile-facing RLC wrapper — no fallback on clean

@@ -199,6 +199,13 @@ class ChaosInjector:
             cls: {"injected": 0, "detected": 0, "healed": 0}
             for cls in self.schedule
         }
+        # fd_flight: every injected/detected/healed event also lands in
+        # the "chaos" flight recorder, so a crash dump carries the
+        # fault timeline and the obs smoke can gate injected ==
+        # recorded per class against the tri-counter audit.
+        from firedancer_tpu.disco import flight
+
+        self._flightrec = flight.recorder("chaos")
         # per-site ordinal counters
         self._ord: Dict[str, int] = {}
         # match-based detection state (consume-one-pending per event so
@@ -216,8 +223,10 @@ class ChaosInjector:
         organic faults don't skew the parity audit."""
         with self._lock:
             c = self.counters.get(cls)
-            if c is not None:
-                c[kind] += n
+            if c is None:
+                return
+            c[kind] += n
+        self._flightrec.record("chaos", cls=cls, event=kind, n=n)
 
     def _tick(self, site: str) -> int:
         """Next 1-based ordinal of a hook site. Locked: most sites are

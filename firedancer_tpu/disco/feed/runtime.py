@@ -58,39 +58,50 @@ def latency_percentiles(samples) -> Dict[str, int]:
 
 
 def verify_tile_stats(v) -> Dict[str, object]:
-    """The verify_stats record for one VerifyTile, feeder + fd_chaos
-    healing fields included (legacy tiles report the same schema with
-    zeroed feeder gauges, so artifact consumers see ONE shape)."""
+    """The verify_stats record for one VerifyTile: a VIEW assembled
+    from the tile's fd_flight registry lane (disco/flight.py — the one
+    authority every dispatch/healing counter is written through), plus
+    the tile-object-only extras (mode, pool wall times, chaos audit).
+    Legacy tiles report the same schema with zeroed feeder gauges, so
+    artifact consumers see ONE shape; the supervisor's cross-process
+    variant (flight.verify_stats_view) reads the same lane through
+    shared memory."""
     from firedancer_tpu.disco import chaos
 
-    lanes = getattr(v, "stat_lanes", 0)
-    fill = lanes / float(v.stat_batches * v.batch) if v.stat_batches else 0.0
+    m = v.fl.as_dict()
+    lanes = m["lanes"]
+    batches = m["batches"]
+    fill = lanes / float(batches * v.batch) if batches else 0.0
     breaker = getattr(v, "_breaker", None)
     st = {
-        "batches": v.stat_batches,
+        "batches": batches,
         "lanes": lanes,
         "fill_ratio": round(fill, 4),
-        "flush_timeout": v.stat_flush_timeout,
-        "flush_starved": getattr(v, "stat_flush_starved", 0),
-        "inflight_stall": v.stat_inflight_stall,
+        "flush_timeout": m["flush_timeout"],
+        "flush_starved": m["flush_starved"],
+        "inflight_stall": m["inflight_stall"],
         "mode": v.verify_mode,
-        "rlc_fallback": v.stat_rlc_fallback,
+        "rlc_fallback": m["rlc_fallback"],
         "feed": bool(getattr(v, "_feed", False)),
         "slot_stall": 0,
         "slot_stall_ms": 0.0,
-        "device_idle_est_ms": round(
-            getattr(v, "stat_feed_idle_ns", 0) / 1e6, 2),
+        "device_idle_est_ms": round(m["feed_idle_ns"] / 1e6, 2),
         # fd_chaos healing accounting (all zero on a fault-free run):
-        "stager_restarts": getattr(v, "stat_stager_restarts", 0),
-        "cpu_failover": getattr(v, "stat_cpu_failover", 0),
-        "quarantined": getattr(v, "stat_quarantined", 0),
-        "quarantine_err_txn": getattr(v, "stat_quarantine_err_txn", 0),
-        "ctl_err_drop": getattr(v, "stat_ctl_err", 0),
+        "stager_restarts": m["stager_restarts"],
+        "cpu_failover": m["cpu_failover"],
+        "quarantined": m["quarantined"],
+        "quarantine_err_txn": m["quarantine_err_txn"],
+        "ctl_err_drop": m["ctl_err_drop"],
         "breaker_state": (breaker.state if breaker is not None
                           else "disabled"),
         "breaker_trips": breaker.trips if breaker is not None else 0,
         "breaker_reprobes": breaker.reprobes if breaker is not None else 0,
         "slots_leaked": 0,
+        # Per-engine compile accounting (fd_flight): the prewarm's
+        # wall time + cache-hit estimate for this tile's engine.
+        "compile_cnt": m["compile_cnt"],
+        "compile_ms": round(m["compile_ns"] / 1e6, 1),
+        "compile_cache_hit": m["compile_cache_hit"],
     }
     if getattr(v, "_feed", False):
         st["slot_stall"] = v.feed_pool.slot_stall
@@ -161,6 +172,9 @@ def run_feed_pipeline(
     pod = topo.pod
     wksp = Workspace.join(topo.wksp_path)
     mtu = pod.query_ulong("firedancer.mtu", FD_TPU_MTU)
+    from firedancer_tpu.disco import flight
+
+    flight.install_dump_signal(wksp)  # SIGUSR1 -> live postmortem dump
 
     # Process layout (FD_FEED_PROC): with worker processes the MAIN
     # process is only the feeder — stager thread (C drain) + dispatcher
@@ -451,6 +465,8 @@ def run_feed_pipeline(
             digests = list(sink.digests) if record_digests else None
             stage_latency["sink"] = latency_percentiles(sink.latencies_ns)
 
+        from firedancer_tpu.disco.pipeline import finish_flight_run
+
         res = PipelineResult(
             recv_cnt=recv_cnt,
             recv_sz=recv_sz,
@@ -462,6 +478,7 @@ def run_feed_pipeline(
             sink_digests=digests,
             verify_stats=[verify_tile_stats(verify)],
             stage_latency=stage_latency,
+            stage_hist=finish_flight_run(wksp),
             feed=True,
         )
         if all(not th.is_alive() for th in threads):

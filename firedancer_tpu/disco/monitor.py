@@ -114,6 +114,23 @@ def snapshot(wksp: Workspace, pod: Pod) -> Dict[str, Dict[str, int]]:
             if mc is not None:
                 d["tx_seq"] = mc.seq_next()
             out[f"link.{name}"] = d
+    # fd_flight registry overlay: the typed metric rows (breaker state,
+    # quarantine/failover counters, compile accounting — everything the
+    # 16-slot cnc diag never had room for) merged into each tile's
+    # snapshot dict, plus the per-edge trace-span summaries.
+    from firedancer_tpu.disco import flight
+
+    ftiles = flight.read_tiles(wksp)
+    if ftiles:
+        for label, metrics in ftiles.items():
+            key = f"tile.{label}"
+            if key in out:
+                out[key].update(
+                    {f"fl_{k}": v for k, v in metrics.items()})
+    fedges = flight.read_edges(wksp)
+    if fedges:
+        for label, summ in fedges.items():
+            out[f"span.{label}"] = summ
     return out
 
 
@@ -146,25 +163,43 @@ def render(
         )
     # fd_feed feeder panel: only tiles that actually dispatched feeder
     # batches (verify tiles under fd_feed) — fill%, flush buckets,
-    # stalls, and the device-idle estimate per snapshot interval.
+    # stalls, device-idle estimate per snapshot interval, plus the
+    # fd_flight healing columns the cnc diag never had room for:
+    # circuit-breaker state/trips and the quarantine counters (before
+    # fd_flight the breaker was only visible in verify_stats, never on
+    # the live dashboard).
+    _BRK = {0: "clsd", 1: "OPEN", 2: "half", 3: "-"}
     feeders = [
         (name, d) for name, d in sorted(snap.items())
-        if name.startswith("tile.") and d.get("feed_batches")
+        if name.startswith("tile.")
+        and (d.get("feed_batches") or d.get("fl_batches"))
     ]
     if feeders:
         lines.append("")
         lines.append(
             f"{bold}{'FEEDER':<14}{'batches':>9}{'lanes':>9}{'dl-fl':>7}"
-            f"{'st-fl':>7}{'stall':>7}{'idle-ms':>9}{rst}"
+            f"{'st-fl':>7}{'stall':>7}{'idle-ms':>9}"
+            f"{'brk':>6}{'trip':>6}{'quar':>6}{'q-err':>7}{'cpu-fo':>8}"
+            f"{rst}"
         )
         for name, d in feeders:
             p = (prev or {}).get(name, {})
-            idle_ms = (d["feed_idle_ns"]
-                       - p.get("feed_idle_ns", 0)) / 1e6
+            idle_ns = d.get("feed_idle_ns", d.get("fl_feed_idle_ns", 0))
+            idle_ms = (idle_ns - p.get(
+                "feed_idle_ns", p.get("fl_feed_idle_ns", 0))) / 1e6
+            brk = _BRK.get(d.get("fl_breaker_state", 3), "?")
             lines.append(
-                f"{name[5:]:<14}{d['feed_batches']:>9}{d['feed_lanes']:>9}"
-                f"{d['feed_deadline_flush']:>7}{d['feed_starved_flush']:>7}"
-                f"{d['feed_slot_stall']:>7}{idle_ms:>9.1f}"
+                f"{name[5:]:<14}"
+                f"{d.get('feed_batches', d.get('fl_batches', 0)):>9}"
+                f"{d.get('feed_lanes', d.get('fl_lanes', 0)):>9}"
+                f"{d.get('feed_deadline_flush', d.get('fl_flush_timeout', 0)):>7}"
+                f"{d.get('feed_starved_flush', d.get('fl_flush_starved', 0)):>7}"
+                f"{d.get('feed_slot_stall', d.get('fl_slot_stall', 0)):>7}"
+                f"{idle_ms:>9.1f}"
+                f"{brk:>6}{d.get('fl_breaker_trips', 0):>6}"
+                f"{d.get('fl_quarantined', 0):>6}"
+                f"{d.get('fl_quarantine_err_txn', 0):>7}"
+                f"{d.get('fl_cpu_failover', 0):>8}"
             )
     lines.append("")
     lines.append(

@@ -94,8 +94,39 @@ def build_topology(
         topo.pod.insert_cstr(f"firedancer.{tile}.cnc", f"{tile}.cnc")
     topo.pod.insert_ulong("firedancer.mtu", mtu)
     topo.pod.insert_ulong("firedancer.layout.verify_lane_cnt", verify_lanes)
+    # fd_flight shared-memory registry: one pre-labeled metric row per
+    # tile, one trace-span histogram row per edge (every link's publish
+    # span + the stager ring-dwell + the e2e "sink" span). Tiles and
+    # worker processes attach by label; monitors/fd_top/the supervisor
+    # read the rows — verify_stats become views over this, not
+    # hand-mirrored diag slots.
+    from firedancer_tpu.disco import flight
+
+    edge_labels = [lane_link(l, lane) for l, lane in links]
+    edge_labels += ["verify_drain", "sink"]
+    flight.create_regions(wksp, tiles, edge_labels)
+    topo.pod.insert_ulong("firedancer.flight.schema",
+                          flight.ARTIFACT_SCHEMA_VERSION)
     wksp.leave()
     return topo
+
+
+def finish_flight_run(wksp) -> Dict[str, Dict[str, int]]:
+    """End-of-run fd_flight duties, shared by every pipeline runner:
+    HALT dump (no-op unless FD_FLIGHT_DUMP is set), the FD_METRICS_PROM
+    text snapshot, and the stage_hist view read back from the shared
+    registry."""
+    from firedancer_tpu.disco import flight
+
+    flight.maybe_dump("halt", wksp=wksp)
+    prom = flags.get_raw("FD_METRICS_PROM")
+    if prom:
+        try:
+            with open(prom, "w") as f:
+                f.write(flight.render_prom(wksp))
+        except OSError:
+            pass
+    return flight.read_edges(wksp) or {}
 
 
 def _link_names(pod: Pod, link: str) -> LinkNames:
@@ -108,9 +139,11 @@ def _link_names(pod: Pod, link: str) -> LinkNames:
 
 def _make_out_link(wksp, pod: Pod, link: str, consumer_fseq_link: str,
                    mtu: int) -> OutLink:
-    """Producer-side link: publish ring + the reliable consumer's fseq."""
+    """Producer-side link: publish ring + the reliable consumer's fseq
+    + the link's always-on flight trace-span histogram (edge=link)."""
     fs = FSeq(wksp, pod.query_cstr(f"firedancer.{consumer_fseq_link}.fseq"))
-    return OutLink(wksp, _link_names(pod, link), mtu=mtu, reliable_fseqs=[fs])
+    return OutLink(wksp, _link_names(pod, link), mtu=mtu,
+                   reliable_fseqs=[fs], edge=link)
 
 
 def _make_source_out_link(wksp, pod: Pod, lane: int = 0) -> OutLink:
@@ -148,6 +181,12 @@ class PipelineResult:
     # stage's publish, sampled at the stage's own OutLink; "sink" is the
     # end-to-end reservoir.
     stage_latency: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    # fd_flight always-on trace-span histograms per edge (FULL
+    # population, log2 buckets — the docs/LATENCY.md budget surface),
+    # read back from the shared registry: {edge: {n, p50_ns_le,
+    # p99_ns_le, sum_ns}}. The sampled stage_latency reservoirs above
+    # remain for fine-grained percentiles.
+    stage_hist: Dict[str, Dict[str, int]] = field(default_factory=dict)
     # True when the fd_feed ingest runtime produced this result (the
     # legacy step loop remains selectable with FD_FEED=0).
     feed: bool = False
@@ -233,6 +272,9 @@ def _run_tiles(
 
     # Tiles run until HALT; max_ns is a hung-pipeline safety net and must
     # outlast the supervisor's own timeout or slow runs silently truncate.
+    from firedancer_tpu.disco import flight
+
+    flight.install_dump_signal(wksp)  # SIGUSR1 -> live postmortem dump
     tile_max_ns = int((timeout_s + 30.0) * 1e9)
     threads = [
         threading.Thread(
@@ -313,6 +355,7 @@ def _run_tiles(
             "pack_pub": latency_percentiles(pack.out_link.lat_ns),
             "sink": latency_percentiles(sink.latencies_ns),
         },
+        stage_hist=finish_flight_run(wksp),
     )
     if all(not th.is_alive() for th in threads):
         wksp.leave()  # else: leak the mapping rather than segfault a thread

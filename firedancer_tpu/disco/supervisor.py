@@ -198,6 +198,9 @@ def _supervised(
             for n in tile_names}
 
     chaos.init_for_run()  # worker_kill / hb_stall injection (FD_CHAOS)
+    from firedancer_tpu.disco import flight
+
+    fr = flight.recorder("supervisor")
     t0 = time.perf_counter()
     deadline = t0 + timeout_s
     settle_needed = 5
@@ -268,6 +271,7 @@ def _supervised(
                 fresh.restarts = tp.restarts + 1
                 tiles[name] = fresh
                 total_restarts += 1
+                fr.record("respawn", tile=name, restarts=fresh.restarts)
                 last_beat.pop(name, None)
                 continue
             rc = tp.proc.poll()
@@ -333,6 +337,7 @@ def _supervised(
                 fresh.restarts = tp.restarts + 1
                 tiles[name] = fresh
                 total_restarts += 1
+                fr.record("respawn", tile=name, restarts=fresh.restarts)
                 last_beat.pop(name, None)
         # Quiescence: source finished publishing (visible in its out
         # rings — source tiles spin until HALT, so process exit can't be
@@ -382,43 +387,30 @@ def _supervised(
     # only reflects the final sink incarnation and is best-effort.
     from firedancer_tpu.tango.rings import DIAG_PUB_CNT, DIAG_PUB_SZ
 
-    # Verify-tile stats survive worker crashes in the cnc diag region
-    # (the in-process runners read tile objects instead): the fd_feed
-    # gauges give the supervisor fill/flush/stall visibility it never
-    # had. 16-slot cnc ABI only.
+    # Verify-tile stats survive worker crashes in the fd_flight shared
+    # registry (counters delta-accumulate across tile incarnations);
+    # the supervised verify_stats are assembled as a VIEW over it —
+    # the round-11 replacement for the hand-built cnc-diag dict, which
+    # had room for only six of the feeder gauges. The cnc diag keeps
+    # the supervisor-written restart/backoff accounting (it must
+    # survive even when the worker never booted far enough to attach
+    # its flight lane).
+    from firedancer_tpu.disco import flight
     from firedancer_tpu.tango.rings import cnc_diag_cap
 
     verify_stats = []
-    if cnc_diag_cap() >= 16:
-        from firedancer_tpu.disco.tiles import (
-            CNC_DIAG_FEED_BATCHES,
-            CNC_DIAG_FEED_DEADLINE,
-            CNC_DIAG_FEED_IDLE_NS,
-            CNC_DIAG_FEED_LANES,
-            CNC_DIAG_FEED_SLOT_STALL,
-            CNC_DIAG_FEED_STARVED,
-        )
-
-        for name in tile_names:
-            if not name.startswith("verify"):
-                continue
+    diag16 = cnc_diag_cap() >= 16
+    for name in tile_names:
+        if not name.startswith("verify"):
+            continue
+        st = flight.verify_stats_view(wksp, name, verify_batch)
+        if st is None:
+            continue
+        if diag16:
             c = cncs[name]
-            batches = c.diag(CNC_DIAG_FEED_BATCHES)
-            lanes = c.diag(CNC_DIAG_FEED_LANES)
-            verify_stats.append({
-                "batches": batches,
-                "lanes": lanes,
-                "fill_ratio": round(
-                    lanes / (batches * verify_batch), 4) if batches else 0.0,
-                "flush_timeout": c.diag(CNC_DIAG_FEED_DEADLINE),
-                "flush_starved": c.diag(CNC_DIAG_FEED_STARVED),
-                "slot_stall": c.diag(CNC_DIAG_FEED_SLOT_STALL),
-                "device_idle_est_ms": round(
-                    c.diag(CNC_DIAG_FEED_IDLE_NS) / 1e6, 2),
-                # Crash-only recovery accounting (supervisor-written):
-                "restarts": c.diag(CNC_DIAG_RESTARTS),
-                "backoff_ms": c.diag(CNC_DIAG_BACKOFF_MS),
-            })
+            st["restarts"] = c.diag(CNC_DIAG_RESTARTS)
+            st["backoff_ms"] = c.diag(CNC_DIAG_BACKOFF_MS)
+        verify_stats.append(st)
 
     sink_fseq = FSeq(wksp, pod.query_cstr("firedancer.pack_sink.fseq"))
     res = PipelineResult(
@@ -434,6 +426,9 @@ def _supervised(
         if sink_res.get("digests") else None,
         verify_stats=verify_stats,
     )
+    from firedancer_tpu.disco.pipeline import finish_flight_run
+
+    res.stage_hist = finish_flight_run(wksp)
     res.supervisor_restarts = total_restarts  # type: ignore[attr-defined]
     res.tile_restarts = {  # type: ignore[attr-defined]
         name: tp.restarts for name, tp in tiles.items() if tp.restarts

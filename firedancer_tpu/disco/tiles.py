@@ -30,7 +30,7 @@ import numpy as np
 from firedancer_tpu import flags
 from firedancer_tpu.ballet import ed25519 as oracle
 from firedancer_tpu.ballet.txn import MAX_SIG_CNT, TxnParseError, parse_txn
-from firedancer_tpu.disco import chaos
+from firedancer_tpu.disco import chaos, flight
 from firedancer_tpu.disco.feed.policy import (
     FLUSH_DEADLINE,
     FLUSH_FULL,
@@ -175,6 +175,7 @@ class OutLink:
         names: LinkNames,
         mtu: int = FD_TPU_MTU,
         reliable_fseqs: Optional[Sequence[FSeq]] = None,
+        edge: Optional[str] = None,
     ):
         self.mcache = MCache(wksp, names.mcache)
         self.dcache = DCache(wksp, names.dcache)
@@ -207,12 +208,20 @@ class OutLink:
         self.lat_cap = 16384
         self._lat_seen = 0
         self._lat_rng = Rng(seq=0x1a7)
+        # fd_flight trace span: this link's ALWAYS-ON log2 latency
+        # histogram (full population, unlike the sampled reservoir) in
+        # the shared registry. None when the link has no edge name
+        # (direct test construction) or spans are hatched off.
+        self.span: Optional[flight.EdgeHist] = None
+        if (edge and flight.enabled()
+                and flags.get_bool("FD_TRACE_SPANS")):
+            self.span = flight.edge_hist(wksp, edge)
 
-    def lat_sample(self, lat: int) -> None:
-        """Algorithm-R reservoir insert: every publish-latency sample in
-        the link's lifetime has equal selection probability, so a long
-        soak's percentiles reflect the whole run, not the warmup window.
-        Shared by the per-frag publish and the fd_feed bulk completion."""
+    def _reservoir_insert(self, lat: int) -> None:
+        """Algorithm-R insert: every publish-latency sample in the
+        link's lifetime has equal selection probability, so a long
+        soak's percentiles reflect the whole run, not the warmup
+        window. ONE body, shared by both sampling entry points."""
         self._lat_seen += 1
         if len(self.lat_ns) < self.lat_cap:
             self.lat_ns.append(lat)
@@ -220,6 +229,20 @@ class OutLink:
             j = self._lat_rng.roll(self._lat_seen)
             if j < self.lat_cap:
                 self.lat_ns[j] = lat
+
+    def lat_sample(self, lat: int) -> None:
+        """Per-frag sample: always-on span histogram + reservoir."""
+        if self.span is not None:
+            self.span.observe(lat)
+        self._reservoir_insert(lat)
+
+    def lat_sample_many(self, lats) -> None:
+        """Bulk-completion variant: one vectorized histogram update for
+        the whole batch, reservoir inserts per sample as before."""
+        if self.span is not None:
+            self.span.observe_many(lats)
+        for lat in lats.tolist():
+            self._reservoir_insert(lat)
 
     def housekeep(self):
         self.cr_avail = self.fctl.tx_cr_update(self.cr_avail, self.seq)
@@ -279,6 +302,12 @@ class Tile:
             raise ValueError("pass in_link or in_links, not both")
         self.wksp = wksp
         self.cnc_name = cnc_name  # stable tile identity (chaos hb ordinals)
+        # fd_flight identity: the cnc name minus its ".cnc" suffix is
+        # the registry row label AND the flight-recorder name.
+        self.flight_label = (
+            cnc_name[:-4] if cnc_name.endswith(".cnc") else cnc_name
+        )
+        self.flightrec = flight.recorder(self.flight_label)
         self.cnc = Cnc(wksp, cnc_name)
         # Multi-input tiles (the mux pattern, mux/fd_mux.h:56-175) poll
         # every in-link round-robin; in_link stays as the first for the
@@ -483,6 +512,13 @@ class Tile:
                 pass  # affinity is best-effort (cpuset may forbid it)
         try:
             self._run_loop(max_ns)
+        except BaseException as e:
+            # Postmortem BEFORE re-raising: the flight dump is the
+            # record of what the tile was doing when it died (no-op
+            # unless FD_FLIGHT_DUMP names a directory).
+            self.flightrec.record("crash", err=repr(e)[:200])
+            flight.maybe_dump(f"crash:{self.flight_label}", wksp=self.wksp)
+            raise
         finally:
             # teardown must happen even if step()/on_frag() raised, or
             # sockets leak and the supervisor spins until its timeout;
@@ -492,6 +528,7 @@ class Tile:
                 self.on_halt()
             finally:
                 self.halted = True
+                self.flightrec.record("halt")
                 try:
                     self.housekeep(tempo.tickcount())
                 finally:
@@ -876,23 +913,16 @@ class VerifyTile(Tile):
         # so the post-crash re-read path runs at full speed.
         self._held = self._last_unacked > 0
         self._verify_batch_fn = None
-        # dispatch/completion stats (read by monitor/bench)
-        self.stat_batches = 0
-        self.stat_lanes = 0            # lanes in dispatched batches (fill)
-        self.stat_flush_timeout = 0    # deadline flushes (gate: ~0 steady)
-        self.stat_flush_starved = 0    # starved-input early flushes
-        self.stat_inflight_stall = 0
-        self.stat_rlc_fallback = 0
-        self.stat_feed_idle_ns = 0     # dispatcher starved-of-slots estimate
+        # fd_flight: dispatch/completion/healing stats live in the
+        # tile's registry LANE (one typed metric row, shared-memory
+        # backed when the workspace carries the flight region) — the
+        # stat_* names below are read-only VIEWS over it, so monitors,
+        # verify_stats, and the replay/bench artifacts all read one
+        # authority instead of hand-mirrored attributes.
+        self.fl = flight.tile_lane(wksp, self.flight_label)
         self.stat_ring_dwell_ns: list = []  # publish->drain backlog samples
-        # fd_chaos healing stats (zeros when nothing ever faulted):
-        self.stat_stager_restarts = 0  # feeder thread supervision respawns
-        self.stat_cpu_failover = 0     # batches served by the CPU oracle
-                                       # lane (breaker open / dispatch err)
-        self.stat_quarantined = 0      # poisoned batches re-verified on
-                                       # the CPU oracle lane at completion
-        self.stat_quarantine_err_txn = 0  # offenders published CTL_ERR
-        self.stat_ctl_err = 0          # producer-flagged err frags dropped
+        self._dwell_span: Optional[flight.EdgeHist] = None
+        self._breaker_pub = (None, 0, 0)   # last published breaker view
         # Device->CPU failover circuit (fd_feed mode; None elsewhere).
         self._breaker: Optional[CircuitBreaker] = None
         # Feeder gauge mirror (CNC_DIAG_FEED_*): published by EVERY
@@ -1006,13 +1036,87 @@ class VerifyTile(Tile):
                 jnp.zeros((batch, 64), jnp.uint8),
                 jnp.zeros((batch, 32), jnp.uint8),
             )
+            # Per-engine compile accounting (mode x B x shards x
+            # frontend impl) into the flight registry: the respawn-
+            # storm class of failure is a COMPILE-TIME pathology, and
+            # before fd_flight it was invisible until it had destroyed
+            # throughput.
+            ekey = flight.engine_key(
+                verify_mode, batch, mesh_devices,
+                flags.get_str("FD_FRONTEND_IMPL") or "auto")
+            t_c = time.perf_counter()
             np.asarray(self._verify_batch_fn(*warm_args))
+            self._account_compile(ekey, time.perf_counter() - t_c)
             if verify_mode == "rlc":
                 # The zero-lane warm batch resolves on the RLC pass
                 # alone, so the per-lane FALLBACK graph would otherwise
                 # compile mid-run on the first salted batch — warm it
                 # explicitly (one extra device pass at boot).
+                t_c = time.perf_counter()
                 np.asarray(direct_fn(*warm_args))
+                self._account_compile(ekey + ":fallback",
+                                      time.perf_counter() - t_c)
+
+    # -- fd_flight views: the registry lane is the ONE authority for
+    # dispatch/healing stats; these read-only properties keep the
+    # long-standing stat_* read surface for monitors and tests. --------
+
+    @property
+    def stat_batches(self) -> int:
+        return self.fl.get("batches")
+
+    @property
+    def stat_lanes(self) -> int:
+        return self.fl.get("lanes")
+
+    @property
+    def stat_flush_timeout(self) -> int:
+        return self.fl.get("flush_timeout")
+
+    @property
+    def stat_flush_starved(self) -> int:
+        return self.fl.get("flush_starved")
+
+    @property
+    def stat_inflight_stall(self) -> int:
+        return self.fl.get("inflight_stall")
+
+    @property
+    def stat_rlc_fallback(self) -> int:
+        return self.fl.get("rlc_fallback")
+
+    @property
+    def stat_feed_idle_ns(self) -> int:
+        return self.fl.get("feed_idle_ns")
+
+    @property
+    def stat_stager_restarts(self) -> int:
+        return self.fl.get("stager_restarts")
+
+    @property
+    def stat_cpu_failover(self) -> int:
+        return self.fl.get("cpu_failover")
+
+    @property
+    def stat_quarantined(self) -> int:
+        return self.fl.get("quarantined")
+
+    @property
+    def stat_quarantine_err_txn(self) -> int:
+        return self.fl.get("quarantine_err_txn")
+
+    @property
+    def stat_ctl_err(self) -> int:
+        return self.fl.get("ctl_err_drop")
+
+    def _account_compile(self, engine: str, seconds: float) -> None:
+        rec = flight.record_compile(engine, seconds)
+        self.fl.inc("compile_cnt")
+        self.fl.inc("compile_ns", int(seconds * 1e9))
+        if rec["cache_hit_est"]:
+            self.fl.inc("compile_cache_hit")
+        self.flightrec.record("compile", engine=engine,
+                              s=round(seconds, 3))
 
     def _with_live_heartbeat(self, fn):
         """Run a blocking host-side operation inside the RUN loop (where
@@ -1136,6 +1240,11 @@ class VerifyTile(Tile):
             "FD_FEED_STAGER_BACKOFF_MS") * 1_000_000
         self._stager_restart_at = 0     # 0 = no restart pending
         self._stager_err_cls: Optional[str] = None
+        # Ring-dwell trace span (source publish -> stager drain): the
+        # feeder's input-backlog distribution, always-on in the flight
+        # registry next to the publish edges.
+        if flight.enabled() and flags.get_bool("FD_TRACE_SPANS"):
+            self._dwell_span = flight.edge_hist(self.wksp, "verify_drain")
 
     def _nd_account(self, il) -> bool:
         """Fold one native drain round's counter deltas into the diag
@@ -1152,7 +1261,8 @@ class VerifyTile(Tile):
             # Producer-flagged CTL_ERR frags dropped at the ctl word
             # (never staged): filtered traffic, and the detection+heal
             # of the chaos ring_ctl_err class.
-            self.stat_ctl_err += int(d[6])
+            self.fl.inc("ctl_err_drop", int(d[6]))
+            self.flightrec.record("ctl_err_drop", n=int(d[6]))
             self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, int(d[6]))
             self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, int(d[7]))
             if c is not None:
@@ -1274,7 +1384,10 @@ class VerifyTile(Tile):
         err = self._feed_stager_err
         if err is not None:
             self._feed_stager_err = None
-            self.stat_stager_restarts += 1
+            self.fl.inc("stager_restarts")
+            self.flightrec.record("stager_restart",
+                                  n=self.stat_stager_restarts,
+                                  err=repr(err)[:120])
             c = chaos.active()
             if c is not None and isinstance(err, chaos.ChaosFault):
                 c.note(err.cls, "detected")
@@ -1382,8 +1495,11 @@ class VerifyTile(Tile):
         # in O(ms) anyway, and turning old-but-plentiful input into
         # partial flushes would trade fill ratio for nothing.
         dwell = (now - int(slot.tspubs[k0])) & 0xFFFFFFFF
-        if dwell < 4_000_000_000 and len(self.stat_ring_dwell_ns) < 65536:
-            self.stat_ring_dwell_ns.append(dwell)
+        if dwell < 4_000_000_000:
+            if len(self.stat_ring_dwell_ns) < 65536:
+                self.stat_ring_dwell_ns.append(dwell)
+            if self._dwell_span is not None:
+                self._dwell_span.observe(dwell)
         # Offsets came back relative to the round's arena base; make
         # them absolute so the completion's bulk publish can read every
         # round of this slot with one base pointer.
@@ -1475,9 +1591,11 @@ class VerifyTile(Tile):
                 )
                 if verdict is not None:
                     if verdict == FLUSH_DEADLINE:
-                        self.stat_flush_timeout += 1
+                        self.fl.inc("flush_timeout")
                     elif verdict == FLUSH_STARVED:
-                        self.stat_flush_starved += 1
+                        self.fl.inc("flush_starved")
+                    self.flightrec.record("flush", verdict=verdict,
+                                          lanes=slot.n_lane)
                     self._feed_commit(slot)
                     continue
             # Empty drain round: sleep IMMEDIATELY rather than hot-spin.
@@ -1549,15 +1667,18 @@ class VerifyTile(Tile):
                     fault_cls = e.cls
         if out is None:
             out = _ReadyBatch(self._verify_slot_cpu(slot))
-            self.stat_cpu_failover += 1
+            self.fl.inc("cpu_failover")
+            self.flightrec.record("cpu_failover", lanes=slot.n_lane)
             if fault_cls is not None and c is not None:
                 c.note(fault_cls, "healed")
         self._inflight.append(_InflightBatch(
             out=out, todo=[], oversize=[False] * self.batch,
             t_dispatch=tempo.tickcount(), slot=slot, device=via_device,
         ))
-        self.stat_batches += 1
-        self.stat_lanes += slot.n_lane
+        self.fl.inc("batches")
+        self.fl.inc("lanes", slot.n_lane)
+        self.flightrec.record("dispatch", lanes=slot.n_lane,
+                              device=via_device)
 
     def _verify_slot_cpu(self, slot):
         """The CPU oracle lane over a staged slot: the failover target
@@ -1644,7 +1765,7 @@ class VerifyTile(Tile):
             self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
             time.sleep(20e-6)
         self.out_link.publish(payload, sig, ctl=CTL_SOM_EOM | CTL_ERR)
-        self.stat_quarantine_err_txn += 1
+        self.fl.inc("quarantine_err_txn")
 
     def _publish_feed_batch(self, slot, statuses,
                             quarantined: bool = False) -> int:
@@ -1737,8 +1858,7 @@ class VerifyTile(Tile):
         ts = ts[ts != 0]
         if ts.size:
             lats = (now32 - ts.astype(np.int64)) & 0xFFFFFFFF
-            for lat in lats.tolist():
-                ol.lat_sample(lat)
+            ol.lat_sample_many(lats)
         return slot.drain_end
 
     def _feed_poll(self):
@@ -1762,7 +1882,7 @@ class VerifyTile(Tile):
         if self.stat_batches and not self._inflight \
                 and self.feed_pool.ready_cnt() == 0:
             if self._feed_idle_mark:
-                self.stat_feed_idle_ns += now - self._feed_idle_mark
+                self.fl.inc("feed_idle_ns", now - self._feed_idle_mark)
             self._feed_idle_mark = now
         else:
             self._feed_idle_mark = 0
@@ -1776,10 +1896,31 @@ class VerifyTile(Tile):
         return progressed, False
 
     def _publish_feed_diag(self) -> None:
-        """Mirror the feeder/dispatch stats into the CNC_DIAG_FEED_*
-        gauges (delta-published like the UNACKED gauge) so monitors and
-        the supervisor see them through shared memory. Legacy tiles
-        publish too (zeroed slot stalls); 16-slot ABI only."""
+        """Publish the tile's flight-registry lane (breaker gauges,
+        slot stalls, and every dispatch/healing counter) to shared
+        memory, and keep the legacy CNC_DIAG_FEED_* mirror for the
+        16-slot cnc ABI (crash-surviving, read by old tooling)."""
+        if self._feed:
+            # Pool-owned stat: fold into the lane so the shared row is
+            # the one authority (delta via counter semantics: the lane
+            # value tracks the pool's monotonically).
+            stall = self.feed_pool.slot_stall
+            have = self.fl.get("slot_stall")
+            if stall > have:
+                self.fl.inc("slot_stall", stall - have)
+        b = self._breaker
+        bstate = b.state if b is not None else "disabled"
+        self.fl.set_gauge("breaker_state",
+                          flight.BREAKER_STATE_CODE.get(bstate, 3))
+        if b is not None:
+            self.fl.set_gauge("breaker_trips", b.trips)
+            self.fl.set_gauge("breaker_reprobes", b.reprobes)
+            cur = (b.state, b.trips, b.reprobes)
+            if cur != self._breaker_pub and self._breaker_pub[0] is not None:
+                self.flightrec.record("breaker", state=b.state,
+                                      trips=b.trips, reprobes=b.reprobes)
+            self._breaker_pub = cur
+        self.fl.publish()
         if not self._feed_diag_ok:
             return
         vals = (
@@ -1807,7 +1948,7 @@ class VerifyTile(Tile):
         if not force and self._pending_lanes < self.batch:
             return
         while len(self._inflight) >= self.inflight_max:
-            self.stat_inflight_stall += 1
+            self.fl.inc("inflight_stall")
             self._complete(block=True)
         via_device = False
         if self.backend == "cpu":
@@ -1823,7 +1964,9 @@ class VerifyTile(Tile):
             except Exception:
                 # Verifier raised mid-batch: quarantine inline (per-txn
                 # CPU oracle verdicts) instead of killing the tile.
-                self.stat_quarantined += 1
+                self.fl.inc("quarantined")
+                self.flightrec.record("quarantine",
+                                      lanes=self._pending_lanes)
                 out = _ReadyBatch(self._oracle_statuses_todo(self._pending))
         else:
             if self._pending_lanes < self.batch:
@@ -1842,7 +1985,7 @@ class VerifyTile(Tile):
             )
             via_device = True
         todo = self._pending
-        self.stat_lanes += self._pending_lanes
+        self.fl.inc("lanes", self._pending_lanes)
         self._pending = []
         self._pending_lanes = 0
         self._nd_pay_fill = 0
@@ -1850,7 +1993,7 @@ class VerifyTile(Tile):
             out=out, todo=todo, oversize=[False] * self.batch,
             t_dispatch=tempo.tickcount(), device=via_device,
         ))
-        self.stat_batches += 1
+        self.fl.inc("batches")
 
     def _ack_inline(self, frag: Frag) -> None:
         """A frag handled to completion inside on_frag (filtered or
@@ -1863,7 +2006,8 @@ class VerifyTile(Tile):
         if frag.ctl & CTL_ERR:
             # Producer-flagged error frag (the Python-path analog of the
             # native drain's ctl word drop): filter, never verify.
-            self.stat_ctl_err += 1
+            self.fl.inc("ctl_err_drop")
+            self.flightrec.record("ctl_err_drop", n=1)
             self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, 1)
             self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, len(payload))
             c = chaos.active()
@@ -1975,10 +2119,14 @@ class VerifyTile(Tile):
             if self.out_link else False,
         )
         if verdict == FLUSH_DEADLINE:
-            self.stat_flush_timeout += 1
+            self.fl.inc("flush_timeout")
+            self.flightrec.record("flush", verdict=verdict,
+                                  lanes=self._pending_lanes)
             self._dispatch(force=True)
         elif verdict == FLUSH_STARVED:
-            self.stat_flush_starved += 1
+            self.fl.inc("flush_starved")
+            self.flightrec.record("flush", verdict=verdict,
+                                  lanes=self._pending_lanes)
             self._dispatch(force=True)
         # FLUSH_FULL is unreachable here: the lanes >= batch case
         # dispatched above, and this method is single-threaded.
@@ -2101,7 +2249,7 @@ class VerifyTile(Tile):
             # Back-pressure the shim, not the device: cap in-flight batches
             # (wiredancer polls the DMA fill level, wd_f1.c:352-358).
             while len(self._inflight) >= self.inflight_max:
-                self.stat_inflight_stall += 1
+                self.fl.inc("inflight_stall")
                 self._complete(block=True)
             pad = [(b"\x00" * 64, b"\x00" * 32, b"")] * (self.batch - len(flat))
             msgs, lens, sigs, pubs = _txn_batch_arrays(
@@ -2118,8 +2266,8 @@ class VerifyTile(Tile):
                 out=out, todo=todo, oversize=oversize,
                 t_dispatch=tempo.tickcount(), device=True,
             ))
-            self.stat_batches += 1
-            self.stat_lanes += len(flat)
+            self.fl.inc("batches")
+            self.fl.inc("lanes", len(flat))
             del self._pending[:take]
             self._pending_lanes -= len(flat)
             if self._pending:
@@ -2147,7 +2295,9 @@ class VerifyTile(Tile):
                 # always returns to the pool. Device-lane failures also
                 # feed the failover breaker.
                 quarantined = True
-                self.stat_quarantined += 1
+                self.fl.inc("quarantined")
+                self.flightrec.record("quarantine",
+                                      err=repr(e)[:120])
                 if ib.device and self._breaker is not None:
                     self._breaker.record_error(tempo.tickcount())
                 fault_cls = (e.cls if isinstance(e, chaos.ChaosFault)
@@ -2161,7 +2311,7 @@ class VerifyTile(Tile):
                 if ib.device and self._breaker is not None:
                     self._breaker.record_success()
                 if getattr(ib.out, "used_fallback", False):
-                    self.stat_rlc_fallback += 1
+                    self.fl.inc("rlc_fallback")
             if ib.slot is not None:
                 # fd_feed batch: verdicts + publishes straight off the
                 # slot's sidecar arrays (one bulk native call).
@@ -2433,6 +2583,17 @@ class SinkTile(Tile):
         self.latencies_ns: list = []
         self.latency_sample_cap = 65536
         self._latency_seen = 0
+        # Trace-id audit trail: with record_digests on, the tsorig
+        # stamp (the txn's trace id, minted once at source publish) of
+        # every received frag — the propagation tests assert these
+        # survive the pipeline bit-exactly.
+        self.trace_ids: list = []
+        # End-to-end trace span: the "sink" edge of the flight registry
+        # (always-on log2 histogram; the reservoir below stays for
+        # fine-grained percentiles).
+        self._e2e_span: Optional[flight.EdgeHist] = None
+        if flight.enabled() and flags.get_bool("FD_TRACE_SPANS"):
+            self._e2e_span = flight.edge_hist(wksp, "sink")
 
     def on_frag(self, frag: Frag, payload: bytes) -> None:
         self.recv_cnt += 1
@@ -2441,8 +2602,11 @@ class SinkTile(Tile):
         self.bank_hist[bank] = self.bank_hist.get(bank, 0) + 1
         if self.record_digests:
             self.digests.append(_sha256(payload).digest())
+            self.trace_ids.append(frag.tsorig)
         if frag.tsorig:
             lat = (tempo.tickcount() - frag.tsorig) & 0xFFFFFFFF
+            if self._e2e_span is not None:
+                self._e2e_span.observe(lat)
             self._latency_seen += 1
             if len(self.latencies_ns) < self.latency_sample_cap:
                 self.latencies_ns.append(lat)

@@ -186,6 +186,9 @@ def main(argv=None) -> int:
     # classes are asserted behaviorally, not through the tri-counter).
     chaos.init_for_run()
     wksp = Workspace.join(args.wksp)
+    from firedancer_tpu.disco import flight as _flight
+
+    _flight.install_dump_signal(wksp)  # SIGUSR1 -> live postmortem dump
     with open(args.pod, "rb") as f:
         pod = Pod.deserialize(f.read())
     opts = json.loads(args.opts)
@@ -263,6 +266,13 @@ def main(argv=None) -> int:
     else:
         tiles[0].run(args.max_ns)
 
+    # Worker-level flight postmortem (no-op unless FD_FLIGHT_DUMP is
+    # set): per-tile crash dumps already fired inside Tile.run; this is
+    # the clean-HALT record of the whole worker.
+    from firedancer_tpu.disco import flight
+
+    flight.maybe_dump(f"halt:worker:{args.tile}", wksp=wksp)
+
     def _sink_result(tile) -> dict:
         lat = sorted(tile.latencies_ns)
         return {
@@ -273,6 +283,12 @@ def main(argv=None) -> int:
             "latency_p99_ns": lat[(len(lat) * 99) // 100] if lat else 0,
             "digests": [d.hex() for d in tile.digests]
             if getattr(tile, "digests", None) is not None else None,
+            # fd_flight trace ids (the tsorig stamps) of every received
+            # frag, in arrival order next to `digests` — the
+            # propagation tests assert these crossed the process
+            # boundary bit-exactly.
+            "trace_ids": list(getattr(tile, "trace_ids", []))
+            if opts.get("record_digests") else None,
         }
 
     if args.result and not multi and tile_names[0] == "sink":

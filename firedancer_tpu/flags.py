@@ -373,6 +373,54 @@ _register(
 )
 
 # --------------------------------------------------------------------------
+# fd_flight observability (disco/flight.py — unified metrics registry,
+# per-txn trace spans, crash-dumpable flight recorder; all read per run).
+# --------------------------------------------------------------------------
+
+_register(
+    "FD_FLIGHT", bool, True,
+    "fd_flight event recording + always-on trace-span histograms. '0' "
+    "is the overhead-bisection hatch: flight recorders become no-ops "
+    "and OutLink publishes skip the edge-histogram observe; the metric "
+    "LANES stay on regardless (verify_stats and the replay/bench "
+    "artifacts are views over them).",
+)
+_register(
+    "FD_FLIGHT_EVENTS", int, 256,
+    "Ring capacity of each flight recorder (events kept per tile / "
+    "per subsystem for the crash dump). Memory is O(cap) tuples.",
+)
+_register(
+    "FD_FLIGHT_DUMP", str, None,
+    "Directory for flight-recorder JSON dumps. When set, a dump is "
+    "written on tile crash, pipeline HALT, and SIGUSR1 (see "
+    "docs/RUNBOOK.md 'reading a flight-recorder dump'). Unset (the "
+    "default) writes nothing — recording still runs, so an operator "
+    "can flip this on and signal a live process.",
+)
+_register(
+    "FD_FLIGHT_JAX_TRACE", str, None,
+    "Directory for a jax.profiler trace captured around the bench "
+    "worker's timed reps (device rungs only; the trace is large and "
+    "perturbs timing, so it is opt-in and the artifact notes it).",
+)
+_register(
+    "FD_TRACE_SPANS", bool, True,
+    "Per-frag trace spans: every OutLink publish (and the fd_feed bulk "
+    "completion) observes tspub - tsorig into the edge's always-on "
+    "log2 histogram in the flight registry. '0' disables the observes "
+    "only (A/B hatch); the trace id (the tsorig stamp minted at source "
+    "publish) propagates regardless — it is the latency stamp.",
+)
+_register(
+    "FD_METRICS_PROM", str, None,
+    "File path: the pipeline runners write a Prometheus-style text "
+    "snapshot of the flight registry here after each run (the pull-"
+    "less export for scrapers/CI; scripts/fd_top.py --prom renders "
+    "the same text live).",
+)
+
+# --------------------------------------------------------------------------
 # bench.py ladder knobs (orchestrator + workers).
 # --------------------------------------------------------------------------
 

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""fd_top — live terminal view of a running pipeline's flight registry.
+
+The `fdctl monitor` analog for fd_flight (disco/flight.py): joins a
+pipeline's workspace + pod and renders, per refresh interval,
+
+  - the monitor's TILE / FEEDER / LINK panels (disco/monitor.py —
+    the FEEDER panel now includes the circuit-breaker state and the
+    quarantine / CPU-failover counters from the flight registry),
+  - a SPAN panel: the always-on per-edge log2 latency histograms
+    (tsorig -> tspub trace spans; n / p50 / p99 upper-bucket bounds),
+  - a VERIFY panel: the verify tiles' registry rows (compile
+    accounting included).
+
+Usage:
+    python scripts/fd_top.py --wksp /path/run.wksp --pod /path/topo.pod
+        [--interval 1.0] [--iterations 0] [--prom] [--no-ansi]
+
+--prom prints one Prometheus-style text snapshot instead of the live
+view (the same text FD_METRICS_PROM writes after a run). The pod file
+is the serialized topology pod the supervisor / feed runtime write
+next to their logs (FD_SUP_KEEP_LOGS keeps it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def render_flight(snap: dict, ansi: bool = True) -> str:
+    """SPAN + VERIFY panels from a monitor.snapshot() (which overlays
+    the flight registry); importable so smoke lanes can gate on the
+    rendering without a terminal."""
+    bold = "\x1b[1m" if ansi else ""
+    rst = "\x1b[0m" if ansi else ""
+    lines = []
+    spans = [(k[5:], d) for k, d in sorted(snap.items())
+             if k.startswith("span.")]
+    if spans:
+        lines.append(
+            f"{bold}{'SPAN':<16}{'n':>10}{'p50<=':>12}{'p99<=':>12}{rst}"
+        )
+        for name, d in spans:
+            lines.append(
+                f"{name:<16}{d['n']:>10}"
+                f"{_fmt_ns(d['p50_ns_le']):>12}{_fmt_ns(d['p99_ns_le']):>12}"
+            )
+    verifies = [
+        (k[5:], d) for k, d in sorted(snap.items())
+        if k.startswith("tile.") and "fl_batches" in d
+        and k[5:].startswith("verify")
+    ]
+    if verifies:
+        lines.append("")
+        lines.append(
+            f"{bold}{'VERIFY':<12}{'batches':>9}{'rlc-fb':>8}{'quar':>6}"
+            f"{'cpu-fo':>8}{'stgr-rst':>9}{'compiles':>9}{'comp-ms':>9}"
+            f"{'hit':>5}{rst}"
+        )
+        for name, d in verifies:
+            lines.append(
+                f"{name:<12}{d['fl_batches']:>9}{d['fl_rlc_fallback']:>8}"
+                f"{d['fl_quarantined']:>6}{d['fl_cpu_failover']:>8}"
+                f"{d['fl_stager_restarts']:>9}{d['fl_compile_cnt']:>9}"
+                f"{d['fl_compile_ns'] / 1e6:>9.0f}"
+                f"{d['fl_compile_cache_hit']:>5}"
+            )
+    return "\n".join(lines)
+
+
+def _fmt_ns(ns: int) -> str:
+    if ns >= 1_000_000_000:
+        return f"{ns / 1e9:.1f}s"
+    if ns >= 1_000_000:
+        return f"{ns / 1e6:.1f}ms"
+    if ns >= 1_000:
+        return f"{ns / 1e3:.0f}us"
+    return f"{ns}ns"
+
+
+def render_once(wksp, pod, prev=None, dt_s: float = 1.0, ansi: bool = True):
+    """One full fd_top frame (monitor panels + flight panels).
+    Returns (frame_text, snapshot) — the snapshot feeds the next
+    frame's rate columns."""
+    from firedancer_tpu.disco.monitor import render, snapshot
+
+    snap = snapshot(wksp, pod)
+    parts = [render(snap, prev, dt_s, ansi=ansi)]
+    fl = render_flight(snap, ansi=ansi)
+    if fl:
+        parts.append("")
+        parts.append(fl)
+    return "\n".join(parts), snap
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--wksp", required=True, help="workspace file path")
+    ap.add_argument("--pod", required=True, help="serialized topology pod")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="0 = run until interrupted")
+    ap.add_argument("--prom", action="store_true",
+                    help="print one Prometheus text snapshot and exit")
+    ap.add_argument("--no-ansi", action="store_true")
+    args = ap.parse_args(argv)
+
+    from firedancer_tpu.disco import flight
+    from firedancer_tpu.tango.rings import Workspace
+    from firedancer_tpu.utils.pod import Pod
+
+    wksp = Workspace.join(args.wksp)
+    with open(args.pod, "rb") as f:
+        pod = Pod.deserialize(f.read())
+
+    if args.prom:
+        sys.stdout.write(flight.render_prom(wksp))
+        return 0
+
+    ansi = not args.no_ansi
+    prev = None
+    i = 0
+    try:
+        while not args.iterations or i < args.iterations:
+            frame, prev = render_once(wksp, pod, prev, args.interval,
+                                      ansi=ansi)
+            if ansi:
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(frame)
+            i += 1
+            if args.iterations and i >= args.iterations:
+                break
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

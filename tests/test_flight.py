@@ -1,0 +1,489 @@
+"""fd_flight — registry, trace spans, flight recorder (disco/flight.py).
+
+Four layers, matching the subsystem's pieces: registry unit/property
+tests (typed specs, shared-memory rows, the allocation-free hot-path
+bound), trace-id propagation (the tsorig stamp must survive feed
+staging, quarantine re-verify, and the worker-process boundary
+BIT-EXACTLY), flight-recorder semantics (bounded ring, chaos-parity
+dumps), and the exporter surfaces (prometheus text, monitor panels).
+"""
+
+import gc
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.disco import flight
+
+# ------------------------------------------------------------ registry ---
+
+
+def test_metric_specs_unique_and_typed():
+    names = [m.name for m in flight.TILE_METRICS]
+    assert len(names) == len(set(names))
+    for m in flight.TILE_METRICS:
+        assert m.kind in ("counter", "gauge"), m.name
+        assert m.doc, m.name
+    # verify_stats view fields the artifacts rely on must stay specced
+    for need in ("batches", "lanes", "quarantined", "cpu_failover",
+                 "breaker_state", "compile_cnt"):
+        assert need in flight.TILE_IDX, need
+
+
+def test_tile_lane_local_inc_get():
+    lane = flight.TileLane("t")
+    lane.inc("batches")
+    lane.inc("lanes", 128)
+    lane.set_gauge("breaker_state", 2)
+    assert lane.get("batches") == 1
+    assert lane.get("lanes") == 128
+    assert lane.get("breaker_state") == 2
+    d = lane.as_dict()
+    assert d["lanes"] == 128 and d["flush_timeout"] == 0
+
+
+def test_shm_rows_roundtrip_and_delta_publish(tmp_path):
+    """Counters delta-accumulate across tile incarnations (the crash-
+    respawn contract); gauges are last-write-wins."""
+    from firedancer_tpu.tango.rings import Workspace
+
+    wksp = Workspace.create(str(tmp_path / "f.wksp"), 1 << 22)
+    flight.create_regions(wksp, ["verify", "replay"], ["edge_a", "sink"])
+
+    lane = flight.tile_lane(wksp, "verify")
+    assert lane._shm is not None
+    lane.inc("batches", 3)
+    lane.set_gauge("breaker_trips", 1)
+    lane.publish()
+    # A second incarnation (fresh local array) must ADD its counters to
+    # the shared row, not rewind them.
+    lane2 = flight.tile_lane(wksp, "verify")
+    lane2.inc("batches", 2)
+    lane2.set_gauge("breaker_trips", 0)
+    lane2.publish()
+    tiles = flight.read_tiles(wksp)
+    assert tiles["verify"]["batches"] == 5
+    assert tiles["verify"]["breaker_trips"] == 0  # gauge: last write wins
+    assert tiles["replay"]["batches"] == 0
+    # Unknown labels degrade to process-local lanes, not errors.
+    stray = flight.tile_lane(wksp, "no-such-tile")
+    assert stray._shm is None
+    stray.inc("batches")
+    stray.publish()  # no-op, no crash
+
+
+def test_counter_increment_allocation_free_and_bounded():
+    """The hot-path contract: metric writes go to PREALLOCATED arrays.
+    Property over 50k mixed increments/observes (magnitudes from 0 to
+    2^62): backing stores never grow, and tracemalloc sees no net
+    Python-heap growth beyond noise."""
+    import random
+    import tracemalloc
+
+    lane = flight.TileLane("t")
+    hist = flight.EdgeHist("e")
+    rng = random.Random(7)
+    vals = [rng.randrange(0, 1 << 62) for _ in range(1000)]
+    nbytes_lane = lane.a.nbytes
+    nbytes_hist = hist.row.nbytes
+    gc.collect()
+    tracemalloc.start()
+    base, _ = tracemalloc.get_traced_memory()
+    for i in range(50_000):
+        lane.inc("lanes", vals[i % 1000] & 0xFFFF)
+        hist.observe(vals[i % 1000])
+    cur, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    # bounded: fixed-size backing stores, bucket index always in range
+    assert lane.a.nbytes == nbytes_lane
+    assert hist.row.nbytes == nbytes_hist
+    assert hist.count() == 50_000
+    assert int(hist.row[1:].sum()) == 50_000  # nothing fell outside
+    # allocation-free: no net heap growth (temp numpy scalars are freed
+    # immediately; allow small interpreter noise)
+    assert cur - base < 64 * 1024, f"hot path leaked {cur - base} bytes"
+    assert peak - base < 256 * 1024
+
+
+def test_edge_hist_vectorized_matches_scalar():
+    import random
+
+    rng = random.Random(3)
+    vals = [0, 1, 2, 3, 1023, 1024, 1025, (1 << 45)] + [
+        rng.randrange(0, 1 << 40) for _ in range(500)
+    ]
+    a, b = flight.EdgeHist("a"), flight.EdgeHist("b")
+    for v in vals:
+        a.observe(v)
+    b.observe_many(np.asarray(vals, np.int64))
+    assert np.array_equal(a.row[1:], b.row[1:])
+    assert a.count() == b.count() == len(vals)
+
+
+def test_edge_hist_percentiles_are_upper_bounds():
+    h = flight.EdgeHist("h")
+    for v in [100] * 98 + [10_000_000] * 2:
+        h.observe(v)
+    s = h.summary()
+    assert s["n"] == 100
+    assert 100 <= s["p50_ns_le"] <= 256          # within one log2 bucket
+    assert 10_000_000 <= s["p99_ns_le"] <= (1 << 24)
+    assert s["p99_ns_le"] >= s["p50_ns_le"]
+
+
+# ------------------------------------------------------------ recorder ---
+
+
+def test_recorder_ring_bounded_and_ordered(monkeypatch):
+    monkeypatch.setenv("FD_FLIGHT_EVENTS", "16")
+    rec = flight.recorder("ringtest")
+    for i in range(40):
+        rec.record("tick", i=i)
+    ev = rec.events()
+    assert len(ev) == 16              # bounded at the configured cap
+    assert rec.n == 40                # totals keep counting
+    assert [e["i"] for e in ev] == list(range(24, 40))  # newest window
+
+
+def test_recorder_disabled_is_noop(monkeypatch):
+    monkeypatch.setenv("FD_FLIGHT", "0")
+    rec = flight.recorder("off")
+    rec.record("tick")
+    assert rec.events() == []
+
+
+def test_dump_artifact_schema(tmp_path, monkeypatch):
+    monkeypatch.setenv("FD_FLIGHT_DUMP", str(tmp_path / "dumps"))
+    rec = flight.recorder("dumptest")
+    rec.record("hello", x=1)
+    path = flight.maybe_dump("unit-test")
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        d = json.load(f)
+    assert d["kind"] == "fd_flight_dump"
+    assert d["schema_version"] == flight.ARTIFACT_SCHEMA_VERSION
+    assert d["reason"] == "unit-test"
+    ev = d["recorders"]["dumptest"]["events"]
+    assert ev and ev[-1]["kind"] == "hello" and ev[-1]["x"] == 1
+
+
+def test_maybe_dump_without_dir_is_silent(monkeypatch):
+    monkeypatch.delenv("FD_FLIGHT_DUMP", raising=False)
+    assert flight.maybe_dump("nothing") is None
+
+
+# ---------------------------------------------------- trace-id spans -----
+
+
+def _clean_corpus(n=48, seed=11):
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+
+    return mainnet_corpus(n=n, seed=seed, dup_rate=0.0, corrupt_rate=0.0,
+                          parse_err_rate=0.0, sign_batch_size=64,
+                          max_data_sz=120)
+
+
+def _staging_harness(tmp_path, name):
+    """Topology + source out-link + a feed-mode VerifyTile, driven by
+    hand (no run loop): the deterministic rig for the bit-exact
+    propagation assertions."""
+    from firedancer_tpu.disco.pipeline import (
+        _link_names,
+        _make_out_link,
+        _make_source_out_link,
+        build_topology,
+    )
+    from firedancer_tpu.disco.tiles import InLink, VerifyTile
+    from firedancer_tpu.tango.rings import Workspace
+
+    topo = build_topology(str(tmp_path / f"{name}.wksp"), depth=1024,
+                          wksp_sz=1 << 25)
+    wksp = Workspace.join(topo.wksp_path)
+    src = _make_source_out_link(wksp, topo.pod)
+    verify = VerifyTile(
+        wksp, "verify.cnc",
+        in_link=InLink(wksp, _link_names(topo.pod, "replay_verify")),
+        out_link=_make_out_link(wksp, topo.pod, "verify_dedup",
+                                "verify_dedup", 1232),
+        backend="cpu", batch=128, feed=True,
+    )
+    return topo, wksp, src, verify
+
+
+def _drain_out_ring(wksp, pod, n_expect):
+    """Collect (sig, tsorig) of the frags on the verify_dedup ring."""
+    from firedancer_tpu.disco.pipeline import _link_names
+    from firedancer_tpu.tango.rings import POLL_FRAG, DCache, MCache
+
+    names = _link_names(pod, "verify_dedup")
+    mc = MCache(wksp, names.mcache)
+    got = []
+    seq = 0
+    deadline = time.time() + 10
+    while len(got) < n_expect and time.time() < deadline:
+        r, frag = mc.poll(seq)
+        if r != POLL_FRAG:
+            time.sleep(0.001)
+            continue
+        got.append((frag.sig, frag.tsorig))
+        seq += 1
+    return got
+
+
+@pytest.mark.skipif(
+    not __import__("firedancer_tpu.tango.rings",
+                   fromlist=["x"]).feed_abi_ok(),
+    reason="fd_feed native ABI not built")
+def test_trace_id_survives_feed_staging_bit_exactly(tmp_path):
+    """Source-minted trace ids (tsorig) through the native drain into
+    the slot sidecars, then through the bulk completion publish —
+    bit-exact at both hops."""
+    from firedancer_tpu.ballet.ed25519 import native as ed_native
+
+    if not ed_native.available():
+        pytest.skip("native ed25519 verifier not built")
+    corpus = _clean_corpus()
+    topo, wksp, src, v = _staging_harness(tmp_path, "stage")
+    try:
+        want = {}
+        for i, p in enumerate(corpus.payloads):
+            from firedancer_tpu.disco.tiles import meta_sig
+
+            tid = 10_000 + i  # distinct, nonzero trace ids
+            assert src.can_publish()
+            src.publish(p, meta_sig(p), tsorig=tid)
+            want[meta_sig(p)] = tid
+        slot = v.feed_pool.acquire(0.5)
+        staged = 0
+        while staged < len(corpus.payloads):
+            n = v._stager_drain(slot)
+            if n <= 0:
+                break
+            staged += n
+        assert staged == len(corpus.payloads)
+        # Hop 1: staging sidecar carries the ids bit-exactly.
+        assert sorted(int(t) for t in slot.tsorigs[:staged]) == sorted(
+            want.values())
+        # Hop 2: dispatch + bulk completion publish them downstream.
+        v._feed_dispatch(slot)
+        v._complete(block=True, drain_all=True)
+        got = _drain_out_ring(wksp, topo.pod, len(want))
+        assert {s: t for s, t in got} == want
+        assert v.stat_batches == 1
+    finally:
+        if v._feed_exec is not None:
+            v._feed_exec.shutdown(wait=True)
+
+
+@pytest.mark.skipif(
+    not __import__("firedancer_tpu.tango.rings",
+                   fromlist=["x"]).feed_abi_ok(),
+    reason="fd_feed native ABI not built")
+def test_trace_id_survives_quarantine_reverify(tmp_path, monkeypatch):
+    """A poisoned batch (backend raise at completion) re-verifies on
+    the CPU oracle lane — the quarantine path must republish the SAME
+    trace ids, not re-mint or zero them."""
+    from firedancer_tpu.ballet.ed25519 import native as ed_native
+
+    if not ed_native.available():
+        pytest.skip("native ed25519 verifier not built")
+    from firedancer_tpu.disco import chaos
+
+    monkeypatch.setenv("FD_CHAOS", "1")
+    monkeypatch.setenv("FD_CHAOS_SEED", "1")
+    monkeypatch.setenv("FD_CHAOS_SCHEDULE", "backend_raise@1")
+    chaos.init_for_run()
+    corpus = _clean_corpus(seed=13)
+    topo, wksp, src, v = _staging_harness(tmp_path, "quar")
+    try:
+        from firedancer_tpu.disco.tiles import meta_sig
+
+        want = {}
+        for i, p in enumerate(corpus.payloads):
+            tid = 77_000 + i
+            assert src.can_publish()
+            src.publish(p, meta_sig(p), tsorig=tid)
+            want[meta_sig(p)] = tid
+        slot = v.feed_pool.acquire(0.5)
+        staged = 0
+        while staged < len(corpus.payloads):
+            n = v._stager_drain(slot)
+            if n <= 0:
+                break
+            staged += n
+        v._feed_dispatch(slot)
+        v._complete(block=True, drain_all=True)
+        assert v.stat_quarantined == 1  # the injected raise was taken
+        got = _drain_out_ring(wksp, topo.pod, len(want))
+        assert {s: t for s, t in got} == want
+    finally:
+        chaos.uninstall()
+        if v._feed_exec is not None:
+            v._feed_exec.shutdown(wait=True)
+
+
+def test_trace_id_survives_worker_process_boundary(tmp_path):
+    """Frags published with known trace ids into verify_dedup, drained
+    by a REAL worker process (dedup -> pack -> sink over shm rings):
+    the sink's recorded trace ids must be the published ones,
+    bit-exact across the process boundary."""
+    from firedancer_tpu.disco.pipeline import (
+        _make_out_link,
+        build_topology,
+    )
+    from firedancer_tpu.disco.tiles import meta_sig
+    from firedancer_tpu.tango.rings import CNC_HALT, Cnc, FSeq, Workspace
+
+    corpus = _clean_corpus(n=32, seed=17)
+    topo = build_topology(str(tmp_path / "wb.wksp"), depth=512,
+                          wksp_sz=1 << 25)
+    wksp = Workspace.join(topo.wksp_path)
+    pod_path = str(tmp_path / "topo.pod")
+    with open(pod_path, "wb") as f:
+        f.write(topo.pod.serialize())
+    result_path = str(tmp_path / "down.json")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    opts = {"tcache_depth": 4096, "bank_cnt": 4,
+            "pack_scheduler": "greedy", "record_digests": True,
+            "jax_platform": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "firedancer_tpu.disco.worker",
+         "--wksp", topo.wksp_path, "--pod", pod_path,
+         "--tile", "dedup,pack,sink", "--opts", json.dumps(opts),
+         "--max-ns", str(120_000_000_000), "--result", result_path],
+        cwd=repo, stderr=subprocess.PIPE)
+    try:
+        out = _make_out_link(wksp, topo.pod, "verify_dedup",
+                             "verify_dedup", 1232)
+        want = []
+        for i, p in enumerate(corpus.payloads):
+            tid = 500_000 + i
+            deadline = time.time() + 30
+            while not out.can_publish():
+                assert time.time() < deadline, "no credits from worker"
+                time.sleep(0.002)
+            out.publish(p, meta_sig(p), tsorig=tid)
+            want.append(tid)
+        sink_fseq = FSeq(wksp, topo.pod.query_cstr(
+            "firedancer.pack_sink.fseq"))
+        deadline = time.time() + 60
+        while sink_fseq.query() < len(want):
+            assert proc.poll() is None, (
+                f"worker died rc={proc.returncode}: "
+                f"{proc.stderr.read().decode()[-2000:]}")
+            assert time.time() < deadline, (
+                f"sink only reached {sink_fseq.query()}/{len(want)}")
+            time.sleep(0.01)
+        for t in ("dedup", "pack", "sink"):
+            Cnc(wksp, topo.pod.query_cstr(
+                f"firedancer.{t}.cnc")).signal(CNC_HALT)
+        proc.wait(timeout=60)
+        with open(result_path) as f:
+            res = json.load(f)
+        got = res["sink"]["trace_ids"]
+        assert sorted(got) == sorted(want)  # bit-exact across the boundary
+        assert res["sink"]["recv_cnt"] == len(want)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+
+
+# ------------------------------------------- views, dumps, exporters -----
+
+
+def _pipeline_run(tmp_path, name, corpus, **kw):
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    topo = build_topology(str(tmp_path / f"{name}.wksp"), depth=512,
+                          wksp_sz=1 << 26)
+    res = run_pipeline(topo, corpus.payloads, verify_backend="cpu",
+                       timeout_s=240.0, record_digests=True, **kw)
+    return topo, res
+
+
+def test_verify_stats_is_registry_view_and_spans_full(tmp_path):
+    """The tentpole contract: verify_stats fields equal the shared
+    registry row, and the always-on span histograms carry the FULL
+    population (sink span n == sink recv_cnt)."""
+    from firedancer_tpu.tango.rings import Workspace
+
+    corpus = _clean_corpus(n=96, seed=29)
+    topo, res = _pipeline_run(tmp_path, "view", corpus, feed=True)
+    vs = res.verify_stats[0]
+    wksp = Workspace.join(topo.wksp_path)
+    row = flight.read_tiles(wksp)["verify"]
+    for k in ("batches", "lanes", "quarantined", "cpu_failover",
+              "rlc_fallback", "stager_restarts"):
+        assert row[k] == vs[k], k
+    assert vs["compile_cnt"] == row["compile_cnt"]
+    assert res.stage_hist["sink"]["n"] == res.recv_cnt
+    for edge in ("replay_verify", "verify_dedup", "dedup_pack",
+                 "pack_sink"):
+        assert res.stage_hist[edge]["n"] > 0, edge
+
+
+def test_flight_dump_chaos_parity(tmp_path, monkeypatch):
+    """The postmortem gate: a seeded fd_chaos run's HALT dump records
+    per-class injection events equal to the injector's own audit
+    counters (injected == detected == healed == RECORDED)."""
+    dump_dir = tmp_path / "dumps"
+    monkeypatch.setenv("FD_CHAOS", "1")
+    monkeypatch.setenv("FD_CHAOS_SEED", "42")
+    monkeypatch.setenv("FD_CHAOS_SCHEDULE",
+                       "slot_corrupt@2,backend_raise@1,stager_kill@3")
+    monkeypatch.setenv("FD_FLIGHT_DUMP", str(dump_dir))
+    corpus = _clean_corpus(n=200, seed=31)
+    _topo, res = _pipeline_run(tmp_path, "chaosdump", corpus, feed=True)
+    counters = res.verify_stats[0]["chaos"]["counters"]
+    dumps = sorted(os.listdir(dump_dir))
+    assert dumps, "no HALT dump written"
+    with open(dump_dir / dumps[-1]) as f:
+        d = json.load(f)
+    recorded = {}
+    for e in d["recorders"]["chaos"]["events"]:
+        if e["kind"] == "chaos" and e.get("event") == "injected":
+            recorded[e["cls"]] = recorded.get(e["cls"], 0) + e.get("n", 1)
+    for cls, c in counters.items():
+        assert c["injected"] == c["detected"] == c["healed"], (cls, c)
+        assert recorded.get(cls, 0) == c["injected"], (cls, recorded)
+    # The healing machinery's own events are in the verify recorder.
+    kinds = {e["kind"] for e in d["recorders"]["verify"]["events"]}
+    assert "quarantine" in kinds and "stager_restart" in kinds
+
+
+def test_prom_render_and_monitor_panels(tmp_path):
+    from firedancer_tpu.disco.monitor import render, snapshot
+    from firedancer_tpu.tango.rings import Workspace
+
+    corpus = _clean_corpus(n=64, seed=37)
+    topo, res = _pipeline_run(tmp_path, "prom", corpus, feed=True)
+    wksp = Workspace.join(topo.wksp_path)
+    prom = flight.render_prom(wksp)
+    assert '# TYPE fd_flight_batches counter' in prom
+    assert 'fd_flight_batches{tile="verify"}' in prom
+    assert f'fd_flight_batches{{tile="verify"}} ' \
+           f'{res.verify_stats[0]["batches"]}' in prom
+    assert 'fd_flight_edge_latency_ns_bucket{edge="sink",le="+Inf"}' in prom
+    # Monitor: flight overlay + FEEDER breaker/quarantine columns.
+    snap = snapshot(wksp, topo.pod)
+    assert snap["tile.verify"]["fl_batches"] == res.verify_stats[0]["batches"]
+    assert "span.sink" in snap
+    text = render(snap, ansi=False)
+    assert "brk" in text and "quar" in text and "cpu-fo" in text
+    assert "clsd" in text  # breaker rendered closed on a clean run
+
+
+def test_metrics_prom_file_export(tmp_path, monkeypatch):
+    prom_path = tmp_path / "metrics.prom"
+    monkeypatch.setenv("FD_METRICS_PROM", str(prom_path))
+    corpus = _clean_corpus(n=48, seed=41)
+    _topo, _res = _pipeline_run(tmp_path, "promfile", corpus, feed=True)
+    text = prom_path.read_text()
+    assert "fd_flight_batches" in text and "edge_latency_ns_bucket" in text
