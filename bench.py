@@ -505,6 +505,10 @@ def worker(cpu: bool) -> int:
         from firedancer_tpu import msm_plan
 
         torsion_k = flags.get_int("FD_RLC_TORSION_K")
+        # The ledger's K-sweep prediction (ROOFLINE #3) matches on this
+        # field: without it a K=32 rung is indistinguishable from K=64
+        # in the log and the prediction can never auto-grade.
+        rec["torsion_k"] = torsion_k
         eff = msm_plan.fill_efficiency(batch, torsion_k=torsion_k)
         rec["fill_efficiency"] = round(eff["total"], 4)
         rec["b_sweep_predicted"] = msm_plan.sweep_prediction(
@@ -638,10 +642,28 @@ _BENCH_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 def _log_measurement(rec: dict) -> None:
     """Append a dated copy of every successful measurement to the repo's
     BENCH_LOG.jsonl, so a wedged tunnel at snapshot time cannot erase a
-    number that was measured earlier in the round."""
+    number that was measured earlier in the round.
+
+    The entry is validated against the log's own schema gate
+    (scripts/bench_log_check.py, the ci.sh hygiene lane) BEFORE the
+    append: a line this writer produces that its own CI lane would
+    reject is a bench bug, and refusing loudly here beats poisoning
+    every future fd_report trend/ledger read."""
     entry = dict(rec)
     entry.setdefault("schema_version", _schema_version())
     entry["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    try:
+        from scripts.bench_log_check import validate_entry
+    except ImportError:
+        validate_entry = None  # validator missing is a repo-layout bug,
+        # but must not void a real measurement round.
+    if validate_entry is not None:
+        errs = validate_entry(entry)
+        if errs:
+            raise ValueError(
+                "bench: refusing to append a BENCH_LOG.jsonl line that "
+                f"fails its own validator: {errs} (entry: {entry})"
+            )
     try:
         with open(_BENCH_LOG, "a") as f:
             f.write(json.dumps(entry) + "\n")

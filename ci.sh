@@ -19,6 +19,14 @@ echo "== fdlint (blocking static-analysis lane) =="
 # lint_baseline.json) or stale baseline entries exit nonzero.
 python scripts/fdlint.py --check
 
+echo "== BENCH_LOG hygiene (schema_version-2 shape + legacy allowlist) =="
+# The measurement history feeds fd_report's trend tables and the
+# prediction ledger; a malformed line poisons every future read-back.
+# Pre-PR-6 lines are hash-allowlisted (burn-down only); everything
+# newer must validate against the schema bench.py itself enforces at
+# append time.
+python scripts/bench_log_check.py
+
 echo "== native build + stress =="
 if [ "${TSAN:-0}" = "1" ]; then
   # Memory-model gate for the lock-free structures (ring publishes,
@@ -81,6 +89,16 @@ echo "== fd_flight observability smoke (registry/export/fd_top/dump) =="
 # recorded injections equal the injector's audit counters, and the
 # always-on layer must cost <= 5% vs FD_FLIGHT=0.
 JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+
+echo "== fd_sentinel SLO smoke (burn-rate asymmetry + report/ledger) =="
+# The round-12 judgment-layer gate: a clean CPU replay books ZERO SLO
+# alerts (liveness quiet, whole-run histograms within the docs/SLO.md
+# latency rule), a seeded hb_stall + credit_starve chaos schedule
+# trips EXACTLY the matching SLOs (fault class <-> SLO name pinned in
+# the flight dump), fd_report ingests the repo's real BENCH_LOG.jsonl
+# + artifact family without error with all nine ROOFLINE predictions
+# pending, and flight+sentinel overhead stays <= 5% vs both disabled.
+JAX_PLATFORMS=cpu python scripts/slo_smoke.py
 
 echo "== RLC verify smoke (CPU backend, FD_BENCH_VERIFY=rlc) =="
 # The production verify mode's dispatch contract (round-6 promotion):

@@ -213,6 +213,7 @@ class ChaosInjector:
         self._overrun_pending = 0
         self._corrupt_psigs: List[int] = []
         self._starve_active = False
+        self._hb_stall_active: set = set()   # tile_ids inside a window
         self.corrupted_sha256: List[str] = []
 
     # -- plumbing --------------------------------------------------------
@@ -430,8 +431,24 @@ class ChaosInjector:
         runs are unchanged: one tile per process, one injector each.)"""
         n = self._tick(f"housekeep:{tile_id}")
         if self._hit("hb_stall", n):
-            self.note("hb_stall", "injected")
+            # Window-edge accounting (the credit_starve pattern): ONE
+            # injected per window per tile, not one per suppressed
+            # pass — a 20k-pass window would otherwise flood the
+            # 256-event chaos flight ring and evict every other
+            # class's record from the dump. As with credit_starve,
+            # detection is the injection point's own visibility (the
+            # frozen heartbeat is observable in monitor.snapshot /
+            # the fd_sentinel tile_heartbeat SLO the moment the beat
+            # is skipped), so the tri-counter stays balanced:
+            # injected == detected at window open, healed at close.
+            if tile_id not in self._hb_stall_active:
+                self._hb_stall_active.add(tile_id)
+                self.note("hb_stall", "injected")
+                self.note("hb_stall", "detected")
             return True
+        if tile_id in self._hb_stall_active:
+            self._hb_stall_active.discard(tile_id)
+            self.note("hb_stall", "healed")  # window closed, beat resumes
         return False
 
     def supervisor_hook(self, tiles) -> None:

@@ -282,6 +282,13 @@ def run_feed_pipeline(
     result_path = os.path.join(tmp, "downstream.json")
     procs: Dict[str, object] = {}
     t0 = time.perf_counter()
+    # fd_sentinel: the in-run SLO evaluator (stopped at quiescence,
+    # before HALT — and unconditionally in the finally, so the poller
+    # can never outlive the workspace mapping).
+    from firedancer_tpu.disco import sentinel as sentinel_mod
+
+    snt = None
+    slo_summary = None
     try:
         if use_proc:
             import pickle
@@ -301,6 +308,7 @@ def run_feed_pipeline(
                 tile_max_ns, "", tmp)
         for th in threads:
             th.start()
+        snt = sentinel_mod.start_for_run(wksp, pod)
 
         links = [
             (MCache(wksp, pod.query_cstr(f"firedancer.{n}.mcache")),
@@ -378,6 +386,8 @@ def run_feed_pipeline(
             last_cursors = cursors
             time.sleep(0.005)
 
+        if snt is not None:
+            slo_summary = snt.stop()   # before HALT: drain != stall
         # HALT — but a worker tile that has not reached its run loop yet
         # would overwrite HALT with RUN at startup and spin to max_ns.
         # Wait (bounded) until every worker cnc has left BOOT or its
@@ -480,11 +490,15 @@ def run_feed_pipeline(
             stage_latency=stage_latency,
             stage_hist=finish_flight_run(wksp),
             feed=True,
+            slo=slo_summary,
         )
-        if all(not th.is_alive() for th in threads):
+        if all(not th.is_alive() for th in threads) and (
+                snt is None or not snt.alive()):
             wksp.leave()  # else leak the mapping rather than segfault
         return res
     finally:
+        if snt is not None:
+            snt.stop()   # idempotent; error paths must stop the poller
         for proc in procs.values():
             if proc.poll() is None:
                 proc.kill()

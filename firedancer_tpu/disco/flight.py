@@ -152,9 +152,19 @@ EDGE_SLOTS = 1 + N_BUCKETS
 # each row: 4 u64 of utf-8 label (32 bytes, NUL-padded) + n_slots u64.
 _METRICS_REGION = "flight.metrics"
 _EDGES_REGION = "flight.edges"
+_SLO_REGION = "flight.slo"
 _MAGIC_TILES = 0xF11687_0001
 _MAGIC_EDGES = 0xF11687_0002
+_MAGIC_SLO = 0xF11687_0003
 _LABEL_U64 = 4   # 32-byte label field
+
+# fd_sentinel SLO rows (disco/sentinel.py is the single writer — one
+# sentinel per run, in the runner process). Slot layout per SLO:
+# [evals, alerts, breach_polls, burn_milli, state]; evals/alerts/
+# breach_polls are counters, burn_milli (current burn rate x1000, or
+# stall ms for liveness SLOs) and state (0 ok / 1 alert) are gauges.
+SLO_SLOTS = 5
+SLO_EVALS, SLO_ALERTS, SLO_BREACH_POLLS, SLO_BURN_MILLI, SLO_STATE = range(5)
 
 
 def _region_footprint(n_rows: int, n_slots: int) -> int:
@@ -166,14 +176,19 @@ def _pack_label(label: str) -> bytes:
     return b + b"\x00" * (_LABEL_U64 * 8 - len(b))
 
 
-def create_regions(wksp, tile_labels, edge_labels) -> None:
+def create_regions(wksp, tile_labels, edge_labels, slo_labels=()) -> None:
     """Allocate + initialize the shared-memory registry regions (called
     by build_topology; every row is pre-labeled so attachers never
-    race a claim)."""
-    for region, magic, labels, n_slots in (
+    race a claim). slo_labels pre-labels the fd_sentinel SLO rows
+    (sentinel.SLO_NAMES); empty skips the region — old callers keep
+    working and the sentinel degrades to process-local rows."""
+    regions = [
         (_METRICS_REGION, _MAGIC_TILES, tile_labels, len(TILE_METRICS)),
         (_EDGES_REGION, _MAGIC_EDGES, edge_labels, EDGE_SLOTS),
-    ):
+    ]
+    if slo_labels:
+        regions.append((_SLO_REGION, _MAGIC_SLO, slo_labels, SLO_SLOTS))
+    for region, magic, labels, n_slots in regions:
         labels = list(labels)
         wksp.alloc(region, _region_footprint(len(labels), n_slots))
         a = np.frombuffer(wksp.view(region), np.uint64)
@@ -388,6 +403,122 @@ def read_edges(wksp) -> Optional[Dict[str, Dict[str, int]]]:
     return {label: EdgeHist(label, row).summary() for label, row in rows}
 
 
+def read_edges_raw(wksp) -> Optional[Dict[str, np.ndarray]]:
+    """{edge_label: COPY of the raw [sum_ns, bucket_0..] row} — the
+    form fd_sentinel's windowed burn-rate deltas and the cross-shard
+    histogram merge need (summaries cannot be merged; log2 bucket rows
+    merge by elementwise add)."""
+    rows = _region_rows(wksp, _EDGES_REGION, _MAGIC_EDGES, EDGE_SLOTS)
+    if rows is None:
+        return None
+    return {label: np.array(row, dtype=np.uint64) for label, row in rows}
+
+
+def slo_row(wksp, label):
+    """The shared row for one SLO (sentinel is the single writer), or
+    None when the workspace predates the region / lacks the label —
+    callers degrade to a process-local array."""
+    if wksp is None:
+        return None
+    try:
+        return _attach_row(wksp, _SLO_REGION, _MAGIC_SLO, SLO_SLOTS, label)
+    except Exception:
+        return None
+
+
+def read_slos(wksp) -> Optional[Dict[str, Dict[str, int]]]:
+    """{slo_name: {evals, alerts, breach_polls, burn_milli, state}}
+    from the shared region (None when absent)."""
+    rows = _region_rows(wksp, _SLO_REGION, _MAGIC_SLO, SLO_SLOTS)
+    if rows is None:
+        return None
+    keys = ("evals", "alerts", "breach_polls", "burn_milli", "state")
+    return {label: {k: int(row[i]) for i, k in enumerate(keys)}
+            for label, row in rows}
+
+
+# --------------------------------------------------------------------------
+# Cross-process / cross-shard aggregation (fd_sentinel part 3): roll
+# per-process and per-shard registry rows into ONE snapshot. Counters
+# sum (they delta-accumulate, so the sum over rows IS the pod total);
+# log2 histogram rows merge by elementwise add (bucketing is identical
+# everywhere, so the merged histogram is exactly the histogram of the
+# concatenated samples); gauges need a policy — breaker_state merges
+# most-severe (an open breaker anywhere must not be averaged away),
+# every other gauge sums (trips/reprobes are per-row totals whose pod
+# aggregate is their sum).
+# --------------------------------------------------------------------------
+
+# Severity order for merging breaker_state codes: open > half_open >
+# closed > disabled (codes 1, 2, 0, 3 — see BREAKER_STATE_CODE).
+_BREAKER_SEVERITY = {1: 3, 2: 2, 0: 1, 3: 0}
+
+
+def merge_tile_metrics(rows) -> Dict[str, int]:
+    """Aggregate several tile metric dicts (as read_tiles values /
+    TileLane.as_dict) into one rollup row."""
+    out = {m.name: 0 for m in TILE_METRICS}
+    breaker = 3  # disabled until any row says otherwise
+    for row in rows:
+        for m in TILE_METRICS:
+            v = int(row.get(m.name, 0))
+            if m.name == "breaker_state":
+                if (_BREAKER_SEVERITY.get(v, 0)
+                        > _BREAKER_SEVERITY.get(breaker, 0)):
+                    breaker = v
+            else:
+                out[m.name] += v
+    out["breaker_state"] = breaker
+    return out
+
+
+def merge_edge_rows(rows) -> np.ndarray:
+    """Elementwise-add several raw edge rows into one (sum_ns wraps
+    mod 2^64 like the per-row counter it is)."""
+    out = np.zeros(EDGE_SLOTS, np.uint64)
+    sum_ns = 0
+    for row in rows:
+        a = np.asarray(row, np.uint64)
+        out[1:] += a[1:]
+        sum_ns = (sum_ns + int(a[0])) & _U64
+    out[0] = np.uint64(sum_ns)
+    return out
+
+
+def snapshot_raw(wksp) -> Dict[str, dict]:
+    """One registry snapshot in mergeable form: {"metrics": {tile:
+    {metric: value}}, "edges": {edge: raw row}}."""
+    return {
+        "metrics": read_tiles(wksp) or {},
+        "edges": read_edges_raw(wksp) or {},
+    }
+
+
+def merge_snapshots(snaps) -> Dict[str, dict]:
+    """Merge several snapshot_raw() results (one per process workspace
+    / verify shard) into ONE: per-label counter sums and histogram
+    adds, plus summaries of the merged edges. The contract the pod-
+    scale verify service stands on: counters of the merge equal the
+    sum of the per-source rows (test-pinned in tests/test_sentinel.py)."""
+    snaps = list(snaps)
+    metric_rows: Dict[str, List[dict]] = {}
+    edge_rows: Dict[str, List[np.ndarray]] = {}
+    for s in snaps:
+        for label, row in (s.get("metrics") or {}).items():
+            metric_rows.setdefault(label, []).append(row)
+        for label, row in (s.get("edges") or {}).items():
+            edge_rows.setdefault(label, []).append(row)
+    edges_raw = {label: merge_edge_rows(rows)
+                 for label, rows in edge_rows.items()}
+    return {
+        "metrics": {label: merge_tile_metrics(rows)
+                    for label, rows in metric_rows.items()},
+        "edges_raw": edges_raw,
+        "edges": {label: EdgeHist(label, row).summary()
+                  for label, row in edges_raw.items()},
+    }
+
+
 def verify_stats_view(wksp, label: str, batch: int) -> Optional[dict]:
     """The verify_stats record for one tile, assembled from the shared
     registry — the cross-process view the supervisor publishes (the
@@ -456,6 +587,29 @@ def render_prom(wksp) -> str:
             f'fd_flight_edge_latency_ns_sum{{edge="{label}"}} {int(row[0])}')
         lines.append(
             f'fd_flight_edge_latency_ns_count{{edge="{label}"}} {acc}')
+    # fd_sentinel SLO rows (the fl_slo_* families): evaluation counts,
+    # alert transitions, breach polls, current burn (x1000) and state
+    # per declared SLO — scrapers alert on fd_flight_slo_state.
+    slos = _region_rows(wksp, _SLO_REGION, _MAGIC_SLO, SLO_SLOTS) or []
+    if slos:
+        fams = (
+            ("evals", SLO_EVALS, "counter", "sentinel evaluation passes"),
+            ("alerts", SLO_ALERTS, "counter",
+             "ok->alert transitions (burn-rate breaches)"),
+            ("breach_polls", SLO_BREACH_POLLS, "counter",
+             "evaluation passes spent in breach"),
+            ("burn_milli", SLO_BURN_MILLI, "gauge",
+             "current burn rate x1000 (stall/heartbeat-age ms for "
+             "liveness SLOs)"),
+            ("state", SLO_STATE, "gauge", "0 ok, 1 alerting"),
+        )
+        for name, slot, kind, doc in fams:
+            lines.append(f"# HELP fd_flight_slo_{name} {doc}")
+            lines.append(f"# TYPE fd_flight_slo_{name} {kind}")
+            for label, row in slos:
+                lines.append(
+                    f'fd_flight_slo_{name}{{slo="{label}"}} '
+                    f"{int(row[slot])}")
     with _compile_lock:
         recs = list(_compiles)
     lines.append("# HELP fd_flight_compile_seconds verify-engine compile "
@@ -618,6 +772,7 @@ def dump(reason: str, wksp=None) -> dict:
         try:
             out["metrics"] = read_tiles(wksp)
             out["edges"] = read_edges(wksp)
+            out["slos"] = read_slos(wksp)
         except Exception:
             pass
     return out

@@ -131,6 +131,13 @@ def snapshot(wksp: Workspace, pod: Pod) -> Dict[str, Dict[str, int]]:
     if fedges:
         for label, summ in fedges.items():
             out[f"span.{label}"] = summ
+    # fd_sentinel SLO rows: evaluation/alert counters + current burn
+    # and state per declared SLO (the live view of the judgment layer;
+    # fd_top renders them as the SLO panel).
+    fslos = flight.read_slos(wksp)
+    if fslos:
+        for label, row in fslos.items():
+            out[f"slo.{label}"] = row
     return out
 
 

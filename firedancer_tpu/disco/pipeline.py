@@ -64,6 +64,7 @@ def lane_link(link: str, lane: int) -> str:
 def build_topology(
     wksp_path: str, depth: int = 128, mtu: int = FD_TPU_MTU,
     wksp_sz: int = 1 << 24, verify_lanes: int = 1,
+    verify_shards: int = 0,
 ) -> Topology:
     """Create workspace + all rings; record names/params in the pod.
 
@@ -71,6 +72,13 @@ def build_topology(
     verify cncs (the reference's verify_tile_count data parallelism,
     configure/frank.c:215-224): source fans out round-robin, dedup muxes
     the lanes back in.
+
+    verify_shards: callers that will run a mesh-sharded VerifyTile
+    (verify_opts mesh_devices=N) should pass N here so the per-shard
+    flight rows land in shared memory; with the default 0 the tile's
+    shard lanes degrade to process-local arrays (in-process visibility
+    only). Wiring this through the production mesh drivers is the
+    pod-scale verify service's job (ROADMAP direction 1).
     """
     topo = Topology(wksp_path=wksp_path, depth=depth, mtu=mtu)
     wksp = Workspace.create(wksp_path, wksp_sz)
@@ -100,11 +108,23 @@ def build_topology(
     # worker processes attach by label; monitors/fd_top/the supervisor
     # read the rows — verify_stats become views over this, not
     # hand-mirrored diag slots.
-    from firedancer_tpu.disco import flight
+    from firedancer_tpu.disco import flight, sentinel
 
     edge_labels = [lane_link(l, lane) for l, lane in links]
     edge_labels += ["verify_drain", "sink"]
-    flight.create_regions(wksp, tiles, edge_labels)
+    # verify_shards > 0 pre-labels per-mesh-shard verify rows — for
+    # EVERY verify lane (a tile's shard lanes are named
+    # "<flight_label>.shard<i>", so lane verify.v1 needs
+    # "verify.v1.shard<i>" rows too) — so a sharded VerifyTile's
+    # per-shard lanes land in shared memory and the merged
+    # (sum-of-shards) snapshot is readable cross-process: the
+    # telemetry substrate of the pod-scale verify service. The
+    # fd_sentinel SLO rows are always created (sentinel.SLO_NAMES).
+    tiles += [f"{lane_link('verify', lane)}.shard{i}"
+              for lane in range(verify_lanes)
+              for i in range(verify_shards)]
+    flight.create_regions(wksp, tiles, edge_labels,
+                          slo_labels=sentinel.SLO_NAMES)
     topo.pod.insert_ulong("firedancer.flight.schema",
                           flight.ARTIFACT_SCHEMA_VERSION)
     wksp.leave()
@@ -195,6 +215,11 @@ class PipelineResult:
     # hid a 5x throughput regression behind a topology change — the
     # reason is recorded AND warned.
     feed_fallback_reason: Optional[str] = None
+    # fd_sentinel run summary (disco/sentinel.py; None when FD_SENTINEL
+    # is off): evaluation count, every SLO's state, and the structured
+    # alert list — the same alerts land as "sentinel" flight-recorder
+    # events and fd_flight_slo_* prom metrics.
+    slo: Optional[dict] = None
 
 
 def _run_tiles(
@@ -285,32 +310,45 @@ def _run_tiles(
     t0 = time.perf_counter()
     for th in threads:
         th.start()
-    post_wait = pre_wait() if pre_wait is not None else None
+    # fd_sentinel: the in-run SLO evaluator (burn-rate over the edge
+    # histograms + progress/heartbeat liveness). Stopped at quiescence,
+    # BEFORE the HALT signal, so drain-and-halt never books a stall —
+    # and always before wksp.leave (the poller reads mapped rows).
+    from firedancer_tpu.disco import sentinel as sentinel_mod
 
-    src_outs = getattr(source, "out_links", None) or [source.out_link]
+    snt = sentinel_mod.start_for_run(wksp, pod)
+    try:
+        post_wait = pre_wait() if pre_wait is not None else None
 
-    def quiesced() -> bool:
-        """Source exhausted and every link fully drained end to end."""
-        if not source_done():
-            return False
-        for i, v in enumerate(verifies):
-            src_seq = src_outs[i].seq if i < len(src_outs) else 0
-            if v.in_link.seq < src_seq or v._pending or v._inflight:
+        src_outs = getattr(source, "out_links", None) or [source.out_link]
+
+        def quiesced() -> bool:
+            """Source exhausted and every link fully drained end to end."""
+            if not source_done():
                 return False
-            if dedup.in_links[i].seq < v.out_link.seq:
-                return False
-        return (
-            pack.in_link.seq >= dedup.out_link.seq
-            and pack.pack.pending_cnt() == 0
-            and not pack._gc_pending
-            and sink.in_link.seq >= pack.out_link.seq
-        )
+            for i, v in enumerate(verifies):
+                src_seq = src_outs[i].seq if i < len(src_outs) else 0
+                if v.in_link.seq < src_seq or v._pending or v._inflight:
+                    return False
+                if dedup.in_links[i].seq < v.out_link.seq:
+                    return False
+            return (
+                pack.in_link.seq >= dedup.out_link.seq
+                and pack.pack.pending_cnt() == 0
+                and not pack._gc_pending
+                and sink.in_link.seq >= pack.out_link.seq
+            )
 
-    deadline = t0 + timeout_s
-    while time.perf_counter() < deadline:
-        if quiesced():
-            break
-        time.sleep(0.005)
+        deadline = t0 + timeout_s
+        while time.perf_counter() < deadline:
+            if quiesced():
+                break
+            time.sleep(0.005)
+    finally:
+        # Idempotent, and in the finally on purpose: an exception in
+        # pre_wait()/the wait loop must still stop the poller before
+        # any teardown can unmap the rows it reads.
+        slo_summary = snt.stop() if snt is not None else None
     # Signal HALT through every cnc (supervisor role, run.c:318-340 analog
     # without the kill-the-namespace part).
     for t in tiles:
@@ -356,8 +394,10 @@ def _run_tiles(
             "sink": latency_percentiles(sink.latencies_ns),
         },
         stage_hist=finish_flight_run(wksp),
+        slo=slo_summary,
     )
-    if all(not th.is_alive() for th in threads):
+    if all(not th.is_alive() for th in threads) and (
+            snt is None or not snt.alive()):
         wksp.leave()  # else: leak the mapping rather than segfault a thread
     return res
 

@@ -199,8 +199,14 @@ def _supervised(
 
     chaos.init_for_run()  # worker_kill / hb_stall injection (FD_CHAOS)
     from firedancer_tpu.disco import flight
+    from firedancer_tpu.disco import sentinel as sentinel_mod
 
     fr = flight.recorder("supervisor")
+    # fd_sentinel: supervised runs are first-class SLO citizens — the
+    # worker processes write the same shared registry, so the
+    # supervisor-side evaluator sees every edge histogram and heartbeat
+    # exactly as the in-process runners do.
+    snt = sentinel_mod.start_for_run(wksp, pod)
     t0 = time.perf_counter()
     deadline = t0 + timeout_s
     settle_needed = 5
@@ -243,124 +249,130 @@ def _supervised(
     last_progress_sig = None
     last_progress_at = t0
 
-    while time.perf_counter() < deadline:
-        now = time.perf_counter()
-        if now - last_progress_at > stall_timeout_s:
-            break  # no cursor/heartbeat movement for stall_timeout_s
-        if fault_hook is not None:
-            fault_hook(tiles, now - t0)
-        c = chaos.active()
-        if c is not None:
-            # Scheduled worker_kill injection (FD_CHAOS): SIGKILL the
-            # verify worker at this monitor-pass ordinal; the crash-only
-            # machinery below is the heal under test.
-            c.supervisor_hook(tiles)
-        # Liveness + heartbeat supervision (crash-only recovery).
-        for name, tp in tiles.items():
-            due = respawn_due.get(name)
-            if due is not None:
-                # Dead, waiting out its respawn backoff.
-                if now < due:
-                    continue
-                respawn_due.pop(name)
-                _publish_backoff(name, 0)
-                cncs[name].heartbeat(0)
-                fresh = _spawn(name, topo.wksp_path, pod_path,
-                               tile_opts[name], max_ns, result_path,
-                               log_dir=tmp)
-                fresh.restarts = tp.restarts + 1
-                tiles[name] = fresh
-                total_restarts += 1
-                fr.record("respawn", tile=name, restarts=fresh.restarts)
-                last_beat.pop(name, None)
-                continue
-            rc = tp.proc.poll()
-            if rc == 0:
-                # Clean exit: the source when exhausted (and any tile
-                # that saw HALT). Not a fault — and its heartbeat is
-                # legitimately frozen now, so skip that check too.
-                last_beat.pop(name, None)
-                continue
-            dead = rc is not None
-            if not dead:
-                hb = cncs[name].heartbeat_query()
-                seen_at, seen_hb = last_beat.get(name, (now, hb))
-                # A worker whose cnc signal is still BOOT gets the
-                # generous boot grace even when its heartbeat has been
-                # seen nonzero: the worker's boot-beat thread CAN stall
-                # for >heartbeat_timeout_s behind a long GIL-holding
-                # compile phase, and killing it there re-pays the whole
-                # compile before the persistent cache entry is ever
-                # written — a respawn storm that never converges (the
-                # round-8 cold-cache hang; the round-3 flake was the
-                # hb==0 variant of the same storm). A genuinely hung
-                # boot is caught by boot_grace_s and the global
-                # no-progress stall timeout.
-                booting = cncs[name].signal_query() == 0  # CNC_BOOT
-                limit = (boot_grace_s if (seen_hb == 0 or booting)
-                         else heartbeat_timeout_s)
-                if hb != seen_hb:
-                    last_beat[name] = (now, hb)
-                elif now - seen_at > limit:
-                    dead = True  # wedged: kill, then crash-only restart
-                    tp.proc.kill()
-                    tp.proc.wait()
-                    last_beat.pop(name, None)
-                else:
-                    last_beat.setdefault(name, (now, hb))
-            if dead and restart:
-                if tp.proc.poll() is None:
-                    tp.proc.kill()
-                    tp.proc.wait()
-                if diag16:
-                    cncs[name].diag_add(CNC_DIAG_RESTARTS, 1)
-                delay = respawn_backoff_s(
-                    tp.restarts + 1, backoff_base_s, backoff_max_s,
-                    backoff_rng)
-                if delay > 0.0:
-                    # Exponential backoff + jitter per tile: schedule
-                    # the respawn instead of spawning in-pass, so a
-                    # crash-looping tile is rate-limited and the
-                    # backoff is visible in the monitor panel.
-                    respawn_due[name] = now + delay
-                    _publish_backoff(name, int(delay * 1e3))
+    try:
+        while time.perf_counter() < deadline:
+            now = time.perf_counter()
+            if now - last_progress_at > stall_timeout_s:
+                break  # no cursor/heartbeat movement for stall_timeout_s
+            if fault_hook is not None:
+                fault_hook(tiles, now - t0)
+            c = chaos.active()
+            if c is not None:
+                # Scheduled worker_kill injection (FD_CHAOS): SIGKILL the
+                # verify worker at this monitor-pass ordinal; the crash-only
+                # machinery below is the heal under test.
+                c.supervisor_hook(tiles)
+            # Liveness + heartbeat supervision (crash-only recovery).
+            for name, tp in tiles.items():
+                due = respawn_due.get(name)
+                if due is not None:
+                    # Dead, waiting out its respawn backoff.
+                    if now < due:
+                        continue
+                    respawn_due.pop(name)
+                    _publish_backoff(name, 0)
+                    cncs[name].heartbeat(0)
+                    fresh = _spawn(name, topo.wksp_path, pod_path,
+                                   tile_opts[name], max_ns, result_path,
+                                   log_dir=tmp)
+                    fresh.restarts = tp.restarts + 1
+                    tiles[name] = fresh
+                    total_restarts += 1
+                    fr.record("respawn", tile=name, restarts=fresh.restarts)
                     last_beat.pop(name, None)
                     continue
-                # Zero the stale heartbeat BEFORE respawning: the cnc
-                # still holds the dead incarnation's stamp, and a fresh
-                # worker must get the 4x BOOT grace, not the run-loop
-                # timeout, or slow boots turn into a respawn storm.
-                cncs[name].heartbeat(0)
-                fresh = _spawn(name, topo.wksp_path, pod_path,
-                               tile_opts[name], max_ns, result_path,
-                               log_dir=tmp)
-                fresh.restarts = tp.restarts + 1
-                tiles[name] = fresh
-                total_restarts += 1
-                fr.record("respawn", tile=name, restarts=fresh.restarts)
-                last_beat.pop(name, None)
-        # Quiescence: source finished publishing (visible in its out
-        # rings — source tiles spin until HALT, so process exit can't be
-        # the signal) + cursors caught up + stable.
-        src_done = sum(mc.seq_next() for mc in src_mcaches) >= n_payloads
-        cursors = tuple(
-            (mc.seq_next(), fs.query()) for mc, fs in links
-        )
-        progress_sig = (cursors,
-                        tuple(c.heartbeat_query() for c in cncs.values()))
-        if progress_sig != last_progress_sig:
-            last_progress_sig = progress_sig
-            last_progress_at = now
-        drained = all(fs >= mc for mc, fs in cursors)
-        if src_done and drained and cursors == last_cursors:
-            settle += 1
-            if settle >= settle_needed:
-                break
-        else:
-            settle = 0
-        last_cursors = cursors
-        time.sleep(0.05)
+                rc = tp.proc.poll()
+                if rc == 0:
+                    # Clean exit: the source when exhausted (and any tile
+                    # that saw HALT). Not a fault — and its heartbeat is
+                    # legitimately frozen now, so skip that check too.
+                    last_beat.pop(name, None)
+                    continue
+                dead = rc is not None
+                if not dead:
+                    hb = cncs[name].heartbeat_query()
+                    seen_at, seen_hb = last_beat.get(name, (now, hb))
+                    # A worker whose cnc signal is still BOOT gets the
+                    # generous boot grace even when its heartbeat has been
+                    # seen nonzero: the worker's boot-beat thread CAN stall
+                    # for >heartbeat_timeout_s behind a long GIL-holding
+                    # compile phase, and killing it there re-pays the whole
+                    # compile before the persistent cache entry is ever
+                    # written — a respawn storm that never converges (the
+                    # round-8 cold-cache hang; the round-3 flake was the
+                    # hb==0 variant of the same storm). A genuinely hung
+                    # boot is caught by boot_grace_s and the global
+                    # no-progress stall timeout.
+                    booting = cncs[name].signal_query() == 0  # CNC_BOOT
+                    limit = (boot_grace_s if (seen_hb == 0 or booting)
+                             else heartbeat_timeout_s)
+                    if hb != seen_hb:
+                        last_beat[name] = (now, hb)
+                    elif now - seen_at > limit:
+                        dead = True  # wedged: kill, then crash-only restart
+                        tp.proc.kill()
+                        tp.proc.wait()
+                        last_beat.pop(name, None)
+                    else:
+                        last_beat.setdefault(name, (now, hb))
+                if dead and restart:
+                    if tp.proc.poll() is None:
+                        tp.proc.kill()
+                        tp.proc.wait()
+                    if diag16:
+                        cncs[name].diag_add(CNC_DIAG_RESTARTS, 1)
+                    delay = respawn_backoff_s(
+                        tp.restarts + 1, backoff_base_s, backoff_max_s,
+                        backoff_rng)
+                    if delay > 0.0:
+                        # Exponential backoff + jitter per tile: schedule
+                        # the respawn instead of spawning in-pass, so a
+                        # crash-looping tile is rate-limited and the
+                        # backoff is visible in the monitor panel.
+                        respawn_due[name] = now + delay
+                        _publish_backoff(name, int(delay * 1e3))
+                        last_beat.pop(name, None)
+                        continue
+                    # Zero the stale heartbeat BEFORE respawning: the cnc
+                    # still holds the dead incarnation's stamp, and a fresh
+                    # worker must get the 4x BOOT grace, not the run-loop
+                    # timeout, or slow boots turn into a respawn storm.
+                    cncs[name].heartbeat(0)
+                    fresh = _spawn(name, topo.wksp_path, pod_path,
+                                   tile_opts[name], max_ns, result_path,
+                                   log_dir=tmp)
+                    fresh.restarts = tp.restarts + 1
+                    tiles[name] = fresh
+                    total_restarts += 1
+                    fr.record("respawn", tile=name, restarts=fresh.restarts)
+                    last_beat.pop(name, None)
+            # Quiescence: source finished publishing (visible in its out
+            # rings — source tiles spin until HALT, so process exit can't be
+            # the signal) + cursors caught up + stable.
+            src_done = sum(mc.seq_next() for mc in src_mcaches) >= n_payloads
+            cursors = tuple(
+                (mc.seq_next(), fs.query()) for mc, fs in links
+            )
+            progress_sig = (cursors,
+                            tuple(c.heartbeat_query() for c in cncs.values()))
+            if progress_sig != last_progress_sig:
+                last_progress_sig = progress_sig
+                last_progress_at = now
+            drained = all(fs >= mc for mc, fs in cursors)
+            if src_done and drained and cursors == last_cursors:
+                settle += 1
+                if settle >= settle_needed:
+                    break
+            else:
+                settle = 0
+            last_cursors = cursors
+            time.sleep(0.05)
 
+    finally:
+        # Idempotent, and in the finally on purpose: a raising
+        # fault_hook / spawn failure must still stop the poller
+        # before teardown can unmap the rows it reads.
+        slo_summary = snt.stop() if snt is not None else None
     for name, cnc in cncs.items():
         from firedancer_tpu.disco.tiles import CNC_HALT
 
@@ -425,6 +437,7 @@ def _supervised(
         sink_digests=[bytes.fromhex(d) for d in sink_res["digests"]]
         if sink_res.get("digests") else None,
         verify_stats=verify_stats,
+        slo=slo_summary,
     )
     from firedancer_tpu.disco.pipeline import finish_flight_run
 
@@ -433,4 +446,16 @@ def _supervised(
     res.tile_restarts = {  # type: ignore[attr-defined]
         name: tp.restarts for name, tp in tiles.items() if tp.restarts
     }
+    # The ONE merged flight snapshot of the run: every verify-LANE row
+    # — one per worker PROCESS (verify, verify.v1, ...) — rolled up
+    # with counter sums (counters delta-accumulate, so the sum over
+    # rows IS the pod total; test-pinned in tests/test_sentinel.py).
+    # Mesh-shard rows (verify.shardN) are excluded: they mirror lanes
+    # the owning tile's row already counts, and folding both in would
+    # double-book every dispatched lane.
+    ftiles = flight.read_tiles(wksp) or {}
+    res.flight_merged = flight.merge_tile_metrics(  # type: ignore[attr-defined]
+        [row for label, row in ftiles.items()
+         if (label == "verify" or label.startswith("verify."))
+         and ".shard" not in label])
     return res

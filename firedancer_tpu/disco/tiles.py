@@ -920,6 +920,13 @@ class VerifyTile(Tile):
         # verify_stats, and the replay/bench artifacts all read one
         # authority instead of hand-mirrored attributes.
         self.fl = flight.tile_lane(wksp, self.flight_label)
+        # Per-mesh-shard metric lanes (round-12 distributed aggregation:
+        # populated only when mesh_devices > 1 — one row per shard,
+        # booked at dispatch with the lanes that shard's slice of the
+        # batch actually carries, so flight.merge_tile_metrics over them
+        # reproduces this tile's own row; shared-memory backed when
+        # build_topology(verify_shards=N) pre-labeled the rows).
+        self.fl_shards: list = []
         self.stat_ring_dwell_ns: list = []  # publish->drain backlog samples
         self._dwell_span: Optional[flight.EdgeHist] = None
         self._breaker_pub = (None, 0, 0)   # last published breaker view
@@ -998,6 +1005,11 @@ class VerifyTile(Tile):
                 )
 
                 self._mesh = make_mesh(mesh_devices)
+                self.fl_shards = [
+                    flight.tile_lane(wksp,
+                                     f"{self.flight_label}.shard{i}")
+                    for i in range(mesh_devices)
+                ]
                 _sharded = verify_step_sharded(self._mesh)
 
                 def _mesh_fn(msgs, lens, sigs, pubs):
@@ -1921,6 +1933,8 @@ class VerifyTile(Tile):
                                       trips=b.trips, reprobes=b.reprobes)
             self._breaker_pub = cur
         self.fl.publish()
+        for shard in self.fl_shards:
+            shard.publish()
         if not self._feed_diag_ok:
             return
         vals = (
@@ -1986,6 +2000,7 @@ class VerifyTile(Tile):
             via_device = True
         todo = self._pending
         self.fl.inc("lanes", self._pending_lanes)
+        self._book_shard_lanes(self._pending_lanes)
         self._pending = []
         self._pending_lanes = 0
         self._nd_pay_fill = 0
@@ -2082,6 +2097,20 @@ class VerifyTile(Tile):
         self._pending_lanes += len(items)
         self._flush_if_due()
         self._complete(block=False)
+
+    def _book_shard_lanes(self, n_lane: int) -> None:
+        """Per-mesh-shard dispatch accounting: shard_map partitions the
+        batch axis contiguously over 'dp', so shard i owns lanes
+        [i*per, (i+1)*per) — book each shard's slice of the real (non-
+        pad) lanes into its flight row. The slices sum to n_lane by
+        construction, so the merged (sum-of-shards) snapshot equals
+        this tile's own lanes counter (test-pinned)."""
+        if not self.fl_shards:
+            return
+        per = self.batch // len(self.fl_shards)
+        for i, lane in enumerate(self.fl_shards):
+            lane.inc("batches")
+            lane.inc("lanes", min(max(n_lane - i * per, 0), per))
 
     def _ring_starved(self) -> bool:
         """The held-back ack cursor is about to exhaust the producer's
@@ -2268,6 +2297,7 @@ class VerifyTile(Tile):
             ))
             self.fl.inc("batches")
             self.fl.inc("lanes", len(flat))
+            self._book_shard_lanes(len(flat))
             del self._pending[:take]
             self._pending_lanes -= len(flat)
             if self._pending:

@@ -421,6 +421,86 @@ _register(
 )
 
 # --------------------------------------------------------------------------
+# fd_sentinel — the judgment layer over fd_flight (disco/sentinel.py):
+# in-pipeline SLO evaluation with multi-window burn-rate detection,
+# the perf-regression tracker, and the prediction ledger. All read per
+# run; budgets are stated ONCE here + in sentinel.SLO_TABLE and
+# rendered into docs/SLO.md (test-pinned, like docs/FLAGS.md).
+# --------------------------------------------------------------------------
+
+_register(
+    "FD_SENTINEL", bool, True,
+    "Run the fd_sentinel SLO evaluator inside every pipeline run: a "
+    "low-rate poller over the fd_flight registry (edge histograms, "
+    "heartbeats, progress) that turns docs/SLO.md budget breaches into "
+    "flight-recorder events, fd_flight_slo_* prom metrics, and the "
+    "PipelineResult.slo summary. '0' is the overhead-bisection hatch.",
+)
+_register(
+    "FD_SENTINEL_INTERVAL_MS", int, 250,
+    "fd_sentinel evaluation interval. Each pass is a handful of "
+    "shared-memory reads + integer math; the burn-rate windows "
+    "(FD_SLO_FAST_S/FD_SLO_SLOW_S) are measured in wall time, so a "
+    "coarser interval only coarsens detection latency, not the math.",
+)
+_register(
+    "FD_SLO_E2E_BUDGET_MS", int, 2500,
+    "p99 budget for the queue-inclusive trace-span latency SLOs (sink "
+    "end-to-end and the cumulative verify/dedup/pack/drain stages), ms "
+    "— the docs/LATENCY.md gate-corpus budget. Enforced in log2-bucket "
+    "space with one bucket of slack (a sample counts against the error "
+    "budget only when it is provably > 2x this). Smoke lanes with "
+    "smaller corpora pin it to their corpus budget (slo_smoke's clean "
+    "half: 1500).",
+)
+_register(
+    "FD_SLO_SOURCE_BUDGET_MS", int, 10,
+    "p99 budget for the source-publish span (replay_verify edge), ms. "
+    "The stage is queue-free (tsorig is minted in the same call that "
+    "stamps tspub), so breaching 2x this means pathological scheduling "
+    "— GIL monopolization, a blocked dcache write — not offered load.",
+)
+_register(
+    "FD_SLO_STALL_MS", int, 2000,
+    "pipeline_progress liveness SLO: alert when NO pipeline edge "
+    "advances for this long mid-run (armed after the first observed "
+    "frag; the runners stop the sentinel at quiescence, so drain-and-"
+    "halt never counts). A chaos credit_starve window trips exactly "
+    "this SLO (scripts/slo_smoke.py pins the asymmetry).",
+)
+_register(
+    "FD_SLO_HB_MS", int, 1500,
+    "tile_heartbeat liveness SLO: alert when a RUNning tile's cnc "
+    "heartbeat stops advancing for this long (the wedge signature the "
+    "supervisor kills on — this SLO makes it visible in UNsupervised "
+    "runs too). A chaos hb_stall window trips exactly this SLO.",
+)
+_register(
+    "FD_SLO_BURN", float, 2.0,
+    "Burn-rate multiple that alerts: a latency SLO alerts when "
+    "(observed bad fraction / error budget) >= this in BOTH the fast "
+    "and the slow window (multi-window multi-burn-rate detection; 2.0 "
+    "= consuming error budget at twice the sustainable rate).",
+)
+_register(
+    "FD_SLO_FAST_S", float, 1.0,
+    "Fast burn-rate window, seconds. The fast window makes detection "
+    "prompt; the slow window keeps a transient spike from alerting.",
+)
+_register(
+    "FD_SLO_SLOW_S", float, 4.0,
+    "Slow burn-rate window, seconds. A window is only evaluated once "
+    "the sentinel's history actually spans it, so runs shorter than "
+    "this cannot latency-alert (liveness SLOs are unaffected).",
+)
+_register(
+    "FD_REPORT_REGRESS_PCT", float, 10.0,
+    "scripts/fd_report.py regression threshold: a device measurement "
+    "more than this far below its series' rolling best-of baseline "
+    "(same metric x mode x batch) is flagged as a regression.",
+)
+
+# --------------------------------------------------------------------------
 # bench.py ladder knobs (orchestrator + workers).
 # --------------------------------------------------------------------------
 

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""bench_log_check — BENCH_LOG.jsonl hygiene gate (ci.sh lane).
+
+The log is the repo's only append-only measurement history — the
+perf-regression tracker (scripts/fd_report.py) and the prediction
+ledger (disco/sentinel.py) read it back, so a malformed line silently
+poisons every future trend report and auto-graded prediction. This
+validator pins the shape:
+
+  * every line must parse as one JSON object;
+  * a line carrying ``schema_version`` must validate against the
+    schema_version-2 shape for its metric (the fd_flight artifact era:
+    bench.py refuses to append anything that fails validate_entry —
+    the writer runs its own validator);
+  * a line WITHOUT ``schema_version`` is legacy-shaped and must hash-
+    match the explicit pre-PR-6 allowlist (bench_log_legacy.json,
+    burn-down only — new legacy-shaped lines FAIL, so the pre-schema
+    era can never grow).
+
+Exit nonzero on any violation; importable (validate_entry /
+validate_file) by bench.py and the tests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+from typing import List
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(_HERE)
+_LEGACY_PATH = os.path.join(_HERE, "bench_log_legacy.json")
+
+# Oldest schema this validator understands. Deliberately a MINIMUM,
+# not an equality against flight.ARTIFACT_SCHEMA_VERSION: bench.py
+# stamps whatever the current version is and raises when its own line
+# fails validation, so an equality check would crash the bench ladder
+# mid-TPU-round on the next schema bump (tests/test_sentinel.py pins
+# that the current writer version stays accepted).
+SCHEMA_VERSION_MIN = 2
+
+# Verify-ladder records: the rung measurements bench.py's workers print
+# and _log_measurement appends (CPU-fallback rungs carry cpu_fallback +
+# error on top of the same core shape).
+_VERIFY_REQUIRED = {
+    "value": (int, float),
+    "unit": str,
+    "vs_baseline": (int, float),
+    "mode": str,
+    "batch": int,
+    "reps": int,
+    "msg_len": int,
+    "ms_per_batch": (int, float),
+    "device": str,
+    "rlc_fallbacks": int,
+}
+
+
+def _legacy_hashes() -> set:
+    try:
+        with open(_LEGACY_PATH) as f:
+            return set(json.load(f)["sha256"])
+    except (OSError, json.JSONDecodeError, KeyError):
+        return set()
+
+
+def validate_entry(rec: dict) -> List[str]:
+    """Schema_version-2 shape errors for one record ([] = valid). The
+    same function gates bench.py's appends — the writer can never
+    produce a line its own CI lane rejects."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return ["line is not a JSON object"]
+    metric = rec.get("metric")
+    if not isinstance(metric, str) or not metric:
+        errs.append("missing/empty 'metric'")
+        return errs
+    sv = rec.get("schema_version")
+    if not isinstance(sv, int) or isinstance(sv, bool) \
+            or sv < SCHEMA_VERSION_MIN:
+        errs.append(
+            f"schema_version must be an int >= {SCHEMA_VERSION_MIN}, "
+            f"got {sv!r}")
+    ts = rec.get("ts")
+    if not isinstance(ts, str) or "T" not in ts:
+        errs.append(f"missing/odd ISO 'ts': {ts!r}")
+    if metric == "ed25519_verify_throughput":
+        for key, typ in _VERIFY_REQUIRED.items():
+            v = rec.get(key)
+            if v is None or not isinstance(v, typ) or isinstance(v, bool):
+                errs.append(f"'{key}' missing or not {typ}: {v!r}")
+        mode = rec.get("mode")
+        if isinstance(mode, str) and mode not in ("rlc", "direct"):
+            errs.append(f"mode must be rlc|direct, got {mode!r}")
+        if isinstance(rec.get("rlc_fallbacks"), int) \
+                and rec["rlc_fallbacks"] < 0:
+            errs.append("rlc_fallbacks < 0")
+    elif metric == "note":
+        if not isinstance(rec.get("note"), str) or not rec["note"]:
+            errs.append("note record missing a 'note' string")
+    else:
+        # Any other metric still needs a numeric value + a unit (the
+        # trend reports group on these).
+        if not isinstance(rec.get("value"), (int, float)) \
+                or isinstance(rec.get("value"), bool):
+            errs.append(f"'{metric}' record missing numeric 'value'")
+        if not isinstance(rec.get("unit"), str):
+            errs.append(f"'{metric}' record missing 'unit'")
+    return errs
+
+
+def validate_file(path: str) -> List[str]:
+    """All violations in a BENCH_LOG.jsonl file, prefixed line:N."""
+    legacy = _legacy_hashes()
+    errs: List[str] = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                errs.append(f"line:{i}: not JSON ({e})")
+                continue
+            if isinstance(rec, dict) and "schema_version" not in rec:
+                h = hashlib.sha256(line.encode()).hexdigest()
+                if h not in legacy:
+                    errs.append(
+                        f"line:{i}: legacy-shaped (no schema_version) and "
+                        "NOT in the pre-PR-6 allowlist "
+                        "(scripts/bench_log_legacy.json is burn-down "
+                        "only; new lines must be schema_version-2 valid)"
+                    )
+                continue
+            for e in validate_entry(rec):
+                errs.append(f"line:{i}: {e}")
+    return errs
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    path = argv[0] if argv else os.path.join(REPO, "BENCH_LOG.jsonl")
+    if not os.path.exists(path):
+        print(f"bench_log_check: {path} absent (nothing to validate)")
+        return 0
+    errs = validate_file(path)
+    n = sum(1 for line in open(path) if line.strip())
+    if errs:
+        for e in errs:
+            print(f"bench_log_check: FAIL — {e}", file=sys.stderr)
+        return 1
+    legacy = len(_legacy_hashes())
+    print(f"bench_log_check: OK ({n} lines; {legacy} allowlisted legacy)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

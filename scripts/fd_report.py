@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""fd_report — trend reports, regression flags, and the prediction
+ledger over the repo's measurement history.
+
+The read-back half of fd_sentinel (disco/sentinel.py): BENCH_LOG.jsonl
+plus the BENCH/REPLAY/MULTICHIP/PACK/HOSTFEED artifact family are
+parsed into one schema-normalized timeline (pre-schema_version legacy
+lines included), rendered as per-mode/per-B/per-stage trend tables,
+checked against the rolling best-of baseline (FD_REPORT_REGRESS_PCT),
+and reconciled against the nine ROOFLINE.md falsifiable predictions —
+each listed pending until a matching schema_version-2 artifact lands,
+then auto-graded confirmed/falsified (the BENCH_r06 hardware session
+self-grades).
+
+Usage:
+    python scripts/fd_report.py                  # text report
+    python scripts/fd_report.py --json           # machine-readable
+    python scripts/fd_report.py --dump-spec      # docs/SLO.md body
+    python scripts/fd_report.py --slo DUMP.json  # latency-SLO check of
+                                                 # a flight dump's edges
+    python scripts/fd_report.py --repo DIR       # non-default root
+
+docs/RUNBOOK.md ("responding to an SLO burn alert") walks a worked
+example.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from firedancer_tpu.disco import sentinel  # noqa: E402
+
+
+def _fmt_row(cols, widths) -> str:
+    return "  ".join(str(c)[:w].ljust(w) for c, w in zip(cols, widths))
+
+
+def render_verify_trend(timeline) -> List[str]:
+    lines = ["== VERIFY LADDER (ed25519_verify_throughput) =="]
+    widths = (20, 7, 7, 12, 9, 8, 6, 28)
+    lines.append(_fmt_row(
+        ("ts", "mode", "B", "verifies/s", "ms/batch", "sv", "fb",
+         "stage_ms (sha/msm/glue)"), widths))
+    rows = 0
+    for e in timeline:
+        if e.kind != "verify_bench" or not e.rec.get("value"):
+            continue
+        r = e.rec
+        stage = ""
+        sm = r.get("stage_ms")
+        if isinstance(sm, dict):
+            stage = (f"{sm.get('sha', '-')}/{sm.get('msm', '-')}"
+                     f"/{sm.get('glue', '-')}"
+                     + (" fused" if sm.get("fused") else ""))
+        tag = "cpu-fb" if r.get("cpu_fallback") else (
+            "stale" if r.get("stale") else "")
+        lines.append(_fmt_row(
+            (r.get("ts", "?"), r.get("mode", "?"), r.get("batch", "?"),
+             f"{float(r['value']):,.0f}{' ' + tag if tag else ''}",
+             r.get("ms_per_batch", "-"), e.schema_version or "pre",
+             r.get("rlc_fallbacks", "-"), stage), widths))
+        rows += 1
+    if not rows:
+        lines.append("(no verify measurements)")
+    return lines
+
+
+def render_replay_trend(timeline) -> List[str]:
+    lines = ["== REPLAY / PACK / MULTICHIP =="]
+    widths = (26, 34, 14, 24)
+    lines.append(_fmt_row(("source", "metric", "value", "detail"), widths))
+    rows = 0
+    for e in timeline:
+        r = e.rec
+        if e.kind in ("replay", "replay_cpu", "pack", "feed_smoke"):
+            detail = f"p99 {r.get('latency_p99_ms', '-')} ms" \
+                if "latency_p99_ms" in r else ""
+            if r.get("feed"):
+                detail += " feed"
+            lines.append(_fmt_row(
+                (e.source, r.get("metric"),
+                 f"{float(r.get('value', 0)):,.1f} {r.get('unit', '')}",
+                 detail.strip()), widths))
+            rows += 1
+        elif e.kind == "multichip":
+            lines.append(_fmt_row(
+                (e.source, "multichip_dryrun",
+                 f"{r.get('n_devices', '?')} devices",
+                 "ok" if r.get("ok") else f"rc={r.get('rc')}"), widths))
+            rows += 1
+        elif e.kind == "hostfeed":
+            lines.append(_fmt_row(
+                (e.source, r.get("metric"),
+                 f"{float(r.get('verify_per_s_core', 0)):,.0f} v/s/core",
+                 ""), widths))
+            rows += 1
+    if not rows:
+        lines.append("(no replay-family artifacts)")
+    return lines
+
+
+def render_stage_trend(timeline) -> List[str]:
+    lines = ["== PER-STAGE ATTRIBUTION vs ROOFLINE BUDGET (ms/8192) =="]
+    budgets = sentinel.STAGE_BUDGETS_MS
+    found = False
+    for e in timeline:
+        sm = e.rec.get("stage_ms")
+        if not isinstance(sm, dict):
+            continue
+        found = True
+        cells = []
+        for key in ("sha", "decompress", "sc", "rlc_combine", "msm",
+                    "glue", "total"):
+            v = sm.get(key)
+            if v is None:
+                continue
+            b = budgets.get(key)
+            flag = ""
+            if b is not None and b > 0 and e.schema_version >= 2 \
+                    and not e.rec.get("cpu_fallback") \
+                    and float(v) > b:
+                flag = f" OVER({b})"
+            cells.append(f"{key}={v}{flag}")
+        lines.append(f"{e.rec.get('ts', e.source)} "
+                     f"{e.rec.get('mode', '?')}@B{e.rec.get('batch', '?')}"
+                     f"{' fused' if sm.get('fused') else ''}: "
+                     + " ".join(cells))
+    if not found:
+        lines.append("(no stage_ms attributions recorded yet — budgets: "
+                     + ", ".join(f"{k}<={v}" for k, v in budgets.items())
+                     + ")")
+    return lines
+
+
+def render_regressions(regs) -> List[str]:
+    lines = ["== REGRESSIONS vs ROLLING BEST =="]
+    if not regs:
+        lines.append("(none)")
+    for r in regs:
+        lines.append(
+            f"{r['series']}: {r['value']:,.1f} at {r['ts'] or r['source']} "
+            f"is -{r['drop_pct']}% vs rolling best {r['rolling_best']:,.1f}")
+    return lines
+
+
+def render_ledger(ledger) -> List[str]:
+    lines = ["== PREDICTION LEDGER (ROOFLINE round-10 falsifiables) =="]
+    for p in ledger:
+        status = p["verdict"].upper()
+        measured = f" — {p['measured']} [{p['source']}]" \
+            if p["measured"] else ""
+        lines.append(f"  {p['id']}. [{status}] {p['name']} "
+                     f"(predicted: {p['predicted']}){measured}")
+    pend = sum(1 for p in ledger if p["verdict"] == "pending")
+    lines.append(f"  {len(ledger) - pend}/{len(ledger)} graded, "
+                 f"{pend} pending")
+    return lines
+
+
+def render_gates(timeline) -> List[str]:
+    lines = ["== THROUGHPUT GATES =="]
+    best: dict = {}
+    for e in timeline:
+        r = e.rec
+        if sentinel._device_measurement(e):
+            m = r.get("metric")
+            best[m] = max(best.get(m, 0.0), float(r["value"]))
+    for name, g in sentinel.THROUGHPUT_GATES.items():
+        have = best.get(g["metric"])
+        if have is None:
+            status = "unmeasured"
+        else:
+            status = (f"{have:,.0f} {g['unit']} "
+                      + ("MET" if have >= g["min"] else
+                         f"({have / g['min'] * 100:.0f}% of gate)"))
+        lines.append(f"  {name}: need >= {g['min']:,.0f} {g['unit']} — "
+                     f"{status}")
+    return lines
+
+
+def render_report(timeline, regress_pct=None) -> str:
+    regs = sentinel.regressions(timeline, regress_pct)
+    ledger = sentinel.prediction_ledger(timeline)
+    bad = [e for e in timeline if e.kind == "invalid"]
+    parts = [
+        f"fd_report: {len(timeline)} timeline entries "
+        f"({sum(1 for e in timeline if e.legacy)} legacy, "
+        f"{len(bad)} unparseable)",
+        "",
+    ]
+    for section in (render_verify_trend(timeline),
+                    render_stage_trend(timeline),
+                    render_replay_trend(timeline),
+                    render_gates(timeline),
+                    render_regressions(regs),
+                    render_ledger(ledger)):
+        parts.extend(section)
+        parts.append("")
+    return "\n".join(parts)
+
+
+def slo_check_dump(path: str) -> int:
+    """Standalone SLO evaluation over a flight dump's edge summaries
+    (the docs/LATENCY.md whole-run rule: p99_ns_le <= 2x budget)."""
+    with open(path) as f:
+        dump = json.load(f)
+    edges = dump.get("edges") or {}
+    if not edges:
+        print(f"fd_report: {path} carries no edge histograms")
+        return 0
+    violations = sentinel.evaluate_edges_summary(edges)
+    if not violations:
+        print(f"fd_report: {path}: all latency SLOs within budget "
+              f"({len(edges)} edges)")
+        return 0
+    for v in violations:
+        print(f"fd_report: SLO {v['slo']} VIOLATED on edge {v['edge']}: "
+              f"p99_ns_le {v['p99_ns_le']:,} > limit {v['limit_ns']:,} "
+              f"(n={v['n']})")
+    return 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--repo", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    ap.add_argument("--dump-spec", action="store_true",
+                    help="print the docs/SLO.md body and exit")
+    ap.add_argument("--slo", metavar="DUMP",
+                    help="evaluate a flight dump's edges vs the latency "
+                         "SLOs; exit 1 on violation")
+    ap.add_argument("--regress-pct", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    if args.dump_spec:
+        sys.stdout.write(sentinel.dump_slo_markdown())
+        return 0
+    if args.slo:
+        return slo_check_dump(args.slo)
+    timeline = sentinel.load_timeline(args.repo)
+    if args.json:
+        out = {
+            "entries": len(timeline),
+            "regressions": sentinel.regressions(timeline, args.regress_pct),
+            "prediction_ledger": sentinel.prediction_ledger(timeline),
+            "timeline": [
+                {"source": e.source, "kind": e.kind, "ts": e.ts,
+                 "schema_version": e.schema_version, "legacy": e.legacy,
+                 "rec": e.rec}
+                for e in timeline
+            ],
+        }
+        json.dump(out, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        return 0
+    sys.stdout.write(render_report(timeline, args.regress_pct))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,7 +10,10 @@ pipeline's workspace + pod and renders, per refresh interval,
   - a SPAN panel: the always-on per-edge log2 latency histograms
     (tsorig -> tspub trace spans; n / p50 / p99 upper-bucket bounds),
   - a VERIFY panel: the verify tiles' registry rows (compile
-    accounting included).
+    accounting included),
+  - an SLO panel: every declared fd_sentinel SLO's state / alert
+    counters / current burn rate (disco/sentinel.py; docs/SLO.md is
+    the spec).
 
 Usage:
     python scripts/fd_top.py --wksp /path/run.wksp --pod /path/topo.pod
@@ -49,6 +52,21 @@ def render_flight(snap: dict, ansi: bool = True) -> str:
             lines.append(
                 f"{name:<16}{d['n']:>10}"
                 f"{_fmt_ns(d['p50_ns_le']):>12}{_fmt_ns(d['p99_ns_le']):>12}"
+            )
+    slos = [(k[4:], d) for k, d in sorted(snap.items())
+            if k.startswith("slo.")]
+    if slos:
+        lines.append("")
+        lines.append(
+            f"{bold}{'SLO':<20}{'state':>7}{'evals':>8}{'alerts':>8}"
+            f"{'breach':>8}{'burn':>8}{rst}"
+        )
+        for name, d in slos:
+            state = "ALERT" if d.get("state") else "ok"
+            lines.append(
+                f"{name:<20}{state:>7}{d.get('evals', 0):>8}"
+                f"{d.get('alerts', 0):>8}{d.get('breach_polls', 0):>8}"
+                f"{d.get('burn_milli', 0) / 1e3:>8.2f}"
             )
     verifies = [
         (k[5:], d) for k, d in sorted(snap.items())

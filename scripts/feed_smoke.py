@@ -23,9 +23,14 @@ Gates (exit nonzero on any):
   * feeder stats present in the feed artifact (batches, fill_ratio,
     slot_stall, device_idle_est_ms, flush buckets) + per-stage latency
     percentiles,
-  * feed >= 5x the seed step loop (the round-8 acceptance bar;
-    measured 5.1-6.1x across a 10-sample calibration on the 2-core CI
-    host: feed 3186-3906 txn/s vs seedloop 626-641 txn/s at n=5000),
+  * feed >= 5x the seed step loop on hosts with >= 2 cores (the
+    round-8 acceptance bar; measured 5.1-6.1x across a 10-sample
+    calibration on the 2-core CI host: feed 3186-3906 txn/s vs
+    seedloop 626-641 txn/s at n=5000) — scaled to 1.2x on a 1-core
+    host, where the overlap the feeder exists for is structurally
+    impossible (PR 6: 1.54x there, identical at HEAD and at the PR-3
+    promotion commit); the artifact records `gate_basis` so small-host
+    CI reds read as environment, not regression,
   * feed >= 0.9x current legacy (the feeder must not cost throughput
     vs its own bisection baseline; > 1x expected, 0.9 absorbs noise).
 
@@ -48,8 +53,38 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:  # runnable as `python scripts/feed_smoke.py`
     sys.path.insert(0, REPO)
 N = 5000
-RATIO_SEED_MIN = 5.0
 RATIO_LEGACY_MIN = 0.9
+
+
+def _gate_basis() -> dict:
+    """The seedloop-ratio gate, scaled to the host (round-12 fix for a
+    known-environmental failure): the feeder's >= 5x win comes from
+    OVERLAP — stager drain + GIL-releasing verify on one core while
+    source/downstream Python runs on another — so on a 1-core host the
+    structural win collapses to the ring-op/flush improvements alone
+    (PR 6 measured 1.54x there vs 6.8x on 2+ cores, identical at HEAD
+    and at the PR-3 promotion commit). Gate at 5x with >= 2 cores,
+    1.2x below that, and record the basis in the artifact so a CI red
+    on a small host reads as environment, not regression."""
+    # Usable cores, not physical: a container pinned to 1 CPU of a
+    # 16-core host is exactly the overlap-free environment this gate
+    # scaling exists for, and os.cpu_count() would claim 16 there.
+    try:
+        cpus = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cpus = os.cpu_count() or 1
+    ratio = 5.0 if cpus >= 2 else 1.2
+    return {
+        "cpu_count": cpus,
+        "ratio_seed_min": ratio,
+        "scaled_down": cpus < 2,
+        "reason": (
+            "full overlap gate (>= 2 cores)" if cpus >= 2 else
+            "1-core host: no stager/verify overlap possible; gate "
+            "covers the ring-op + adaptive-flush win only (PR 6 "
+            "calibration: 1.54x)"
+        ),
+    }
 
 _MODE_ENV = {
     "feed": {"FD_FEED": "1", "FD_RINGS_PYDLL": "1"},
@@ -149,11 +184,14 @@ def main() -> int:
             fails.append(f"feeder stat {key!r} missing from artifact")
     if not feed_best.get("stage_latency_ms", {}).get("sink", {}).get("n"):
         fails.append("per-stage latency percentiles missing from artifact")
+    gate_basis = _gate_basis()
+    ratio_seed_min = gate_basis["ratio_seed_min"]
     ratio_seed = feed_txn_s / max(seed_txn_s, 1e-9)
     ratio_legacy = feed_txn_s / max(legacy_txn_s, 1e-9)
-    if ratio_seed < RATIO_SEED_MIN:
+    if ratio_seed < ratio_seed_min:
         fails.append(f"feed only {ratio_seed:.2f}x the seed step loop "
-                     f"(need >= {RATIO_SEED_MIN}x)")
+                     f"(need >= {ratio_seed_min}x on "
+                     f"{gate_basis['cpu_count']} core(s))")
     if ratio_legacy < RATIO_LEGACY_MIN:
         fails.append(f"feed only {ratio_legacy:.2f}x current legacy "
                      f"(need >= {RATIO_LEGACY_MIN}x)")
@@ -169,6 +207,7 @@ def main() -> int:
         "seedloop_runs": [r["txn_s"] for r in runs["seedloop"]],
         "ratio_vs_seedloop": round(ratio_seed, 2),
         "ratio_vs_legacy": round(ratio_legacy, 2),
+        "gate_basis": gate_basis,
         "feed_verify_stats": feed_best.get("verify_stats"),
         "feed_stage_latency_ms": feed_best.get("stage_latency_ms"),
         "ok": not fails,

@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""slo_smoke — the fd_sentinel SLO/report gate (ci.sh lane).
+
+Four checks, one small mainnet-shaped corpus on the CPU backend:
+
+  1. DETECTION ASYMMETRY, clean half — a clean fd_feed replay with the
+     sentinel armed must book ZERO SLO alerts (every liveness SLO
+     quiet, every whole-run edge histogram within the docs/SLO.md
+     latency rule p99_ns_le <= 2x budget), and the workspace must
+     carry populated fd_flight_slo_* rows (evals > 0) in the prom
+     export.
+
+  2. DETECTION ASYMMETRY, fault half — the SAME corpus under a seeded
+     fd_chaos hb_stall + credit_starve schedule must alert EXACTLY the
+     matching SLOs (fault class <-> SLO name per sentinel.FAULT_SLO,
+     cross-checked against the chaos recorder's injected classes in
+     the flight dump) and nothing else.
+
+  3. REPORT / LEDGER — scripts/fd_report.py must ingest the repo's
+     REAL BENCH_LOG.jsonl + artifact family without a single parse
+     error, render the trajectory, and the prediction ledger must list
+     all nine ROOFLINE predictions with machine-checkable rules (all
+     currently pending — BENCH_r06 auto-grades them) and round-trip
+     through JSON.
+
+  4. OVERHEAD — flight + sentinel on vs FD_FLIGHT=0/FD_SENTINEL=0 must
+     stay within 5% (+ a 150 ms jitter floor on this small corpus).
+
+Exits nonzero on any violation; prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable as `python scripts/slo_smoke.py`
+    sys.path.insert(0, REPO)
+
+N = 2600
+SEED = 777
+CHAOS_SEED = 7
+# hb_stall: ~10k housekeep passes/s per tile at depth 2048 -> a 20k-pass
+# window freezes heartbeats for ~2 s >> FD_SLO_HB_MS below.
+# credit_starve: each starved publish attempt sleeps >= 20 us (measured
+# ~150 us with Linux sleep granularity) -> a 60k-attempt window stalls
+# the source 2.4 s worst-case (~9 s typical) >> FD_SLO_STALL_MS below.
+CHAOS_SCHEDULE = "hb_stall@50:20050,credit_starve@400:60400"
+EXPECT_SLOS = {"tile_heartbeat", "pipeline_progress"}
+# Clean-half corpus budget (queue-inclusive, docs/LATENCY.md smoke
+# scale): the ~1 s replay must keep every whole-run edge p99 bucket
+# <= 2x this — tighter than the 2500 ms gate-corpus default, with one
+# log2 bucket (2.15 s) of headroom against CI-host jitter.
+E2E_BUDGET_MS = 1500
+
+
+def log(msg: str) -> None:
+    print(f"slo_smoke: {msg}", flush=True)
+
+
+def fail(msg: str) -> None:
+    print(f"slo_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _corpus():
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+
+    return mainnet_corpus(n=N, seed=SEED, dup_rate=0.04, corrupt_rate=0.02,
+                          parse_err_rate=0.02, sign_batch_size=256,
+                          max_data_sz=150)
+
+
+def _run(tmp, corpus, name, **env):
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update({k: str(v) for k, v in env.items()})
+    try:
+        topo = build_topology(os.path.join(tmp, f"{name}.wksp"), depth=2048,
+                              wksp_sz=1 << 26)
+        t0 = time.perf_counter()
+        res = run_pipeline(topo, corpus.payloads, verify_backend="cpu",
+                           timeout_s=240.0, tcache_depth=1 << 16,
+                           record_digests=True, feed=True)
+        return topo, res, time.perf_counter() - t0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def check_clean(tmp, corpus) -> float:
+    from firedancer_tpu.disco import flight, sentinel
+    from firedancer_tpu.tango.rings import Workspace
+
+    topo, res, dt = _run(tmp, corpus, "clean",
+                         FD_SLO_E2E_BUDGET_MS=E2E_BUDGET_MS)
+    if res.slo is None:
+        fail("clean run carried no sentinel summary (FD_SENTINEL on?)")
+    if res.slo["evals"] < 2:
+        fail(f"sentinel barely ran: {res.slo['evals']} evals")
+    if res.slo["alert_cnt"]:
+        fail(f"clean run booked SLO alerts: {res.slo['alerts']}")
+    for name, st in res.slo["slos"].items():
+        if st["state"] != "ok" or st["alerts"]:
+            fail(f"clean run left SLO {name} in {st}")
+    # Whole-run latency rule over the always-on histograms, at the
+    # smoke corpus budget (the in-run Sentinel saw the same value via
+    # the env pin above; this env is restored by now, so pass it).
+    budgets = {s.name: E2E_BUDGET_MS for s in sentinel.SLO_TABLE}
+    budgets["source_p99"] = sentinel._budget_ms(
+        sentinel.SLO_BY_NAME["source_p99"])
+    violations = sentinel.evaluate_edges_summary(res.stage_hist, budgets)
+    if violations:
+        fail(f"clean-run edge histograms violate the latency rule: "
+             f"{violations}")
+    # Shared rows + prom export carry the SLO families.
+    wksp = Workspace.join(topo.wksp_path)
+    slos = flight.read_slos(wksp) or {}
+    for name in sentinel.SLO_NAMES:
+        if name not in slos:
+            fail(f"flight.slo region missing row {name!r}")
+        if slos[name]["evals"] < 1:
+            fail(f"SLO row {name!r} never evaluated")
+        if slos[name]["alerts"]:
+            fail(f"SLO row {name!r} shows alerts on a clean run")
+    prom = flight.render_prom(wksp)
+    for needle in ('fd_flight_slo_state{slo="e2e_p99"}',
+                   "# TYPE fd_flight_slo_alerts counter"):
+        if needle not in prom:
+            fail(f"prom export missing {needle!r}")
+    log(f"clean half OK ({res.slo['evals']} evals, 0 alerts, "
+        f"{len(res.stage_hist)} edges within budget, {dt:.2f}s)")
+    return dt
+
+
+def check_chaos(tmp, corpus) -> None:
+    from firedancer_tpu.disco import sentinel
+
+    dump_dir = os.path.join(tmp, "dumps")
+    _topo, res, _dt = _run(
+        tmp, corpus, "chaos",
+        FD_CHAOS="1", FD_CHAOS_SEED=str(CHAOS_SEED),
+        FD_CHAOS_SCHEDULE=CHAOS_SCHEDULE,
+        FD_FLIGHT_DUMP=dump_dir,
+        FD_SLO_HB_MS="900", FD_SLO_STALL_MS="1200",
+        FD_SENTINEL_INTERVAL_MS="100",
+    )
+    if res.slo is None:
+        fail("chaos run carried no sentinel summary")
+    got = {a["slo"] for a in res.slo["alerts"]}
+    if got != EXPECT_SLOS:
+        fail(f"detection asymmetry broken: alerted {sorted(got)}, "
+             f"expected exactly {sorted(EXPECT_SLOS)} "
+             f"(alerts: {res.slo['alerts']})")
+    # The dump must carry the same alerts AND the injecting fault
+    # classes, matched per sentinel.FAULT_SLO both ways.
+    dumps = sorted(os.listdir(dump_dir)) if os.path.isdir(dump_dir) else []
+    if not dumps:
+        fail("no flight dump written on HALT")
+    with open(os.path.join(dump_dir, dumps[-1])) as f:
+        dump = json.load(f)
+    sent_events = dump["recorders"].get("sentinel", {}).get("events", [])
+    dumped = {e["slo"] for e in sent_events if e["kind"] == "slo_alert"}
+    if not EXPECT_SLOS <= dumped:
+        fail(f"dump's sentinel recorder missing alerts: {sorted(dumped)}")
+    injected = {e["cls"] for e in
+                dump["recorders"].get("chaos", {}).get("events", [])
+                if e["kind"] == "chaos" and e.get("event") == "injected"}
+    if injected != {"hb_stall", "credit_starve"}:
+        fail(f"chaos recorder injected classes off: {sorted(injected)}")
+    for cls in injected:
+        if sentinel.FAULT_SLO.get(cls) not in dumped:
+            fail(f"fault class {cls} did not trip its SLO "
+                 f"{sentinel.FAULT_SLO.get(cls)!r}")
+    for alert in res.slo["alerts"]:
+        classes = set(alert.get("fault_classes") or [])
+        if not classes & injected:
+            fail(f"alert {alert['slo']} matches no injected fault class")
+    if dump.get("slos", {}).get("tile_heartbeat", {}).get("alerts", 0) < 1:
+        fail("dump's slo section missing the heartbeat alert counter")
+    log(f"fault half OK (alerts {sorted(got)} <-> injected "
+        f"{sorted(injected)}, dump {dumps[-1]})")
+
+
+def check_report() -> None:
+    from firedancer_tpu.disco import sentinel
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import fd_report
+
+    timeline = sentinel.load_timeline(REPO)
+    bad = [e for e in timeline if e.parse_error]
+    if bad:
+        fail(f"timeline ingest errors: {[(e.source, e.parse_error) for e in bad]}")
+    if len(timeline) < 20:
+        fail(f"timeline implausibly small: {len(timeline)} entries")
+    text = fd_report.render_report(timeline)
+    for needle in ("VERIFY LADDER", "PREDICTION LEDGER", "REGRESSIONS"):
+        if needle not in text:
+            fail(f"fd_report render missing section {needle!r}")
+    ledger = sentinel.prediction_ledger(timeline)
+    if len(ledger) != 9:
+        fail(f"prediction ledger has {len(ledger)} entries, want 9")
+    for p in ledger:
+        if p["verdict"] != "pending":
+            fail(f"prediction {p['id']} pre-graded {p['verdict']!r} from "
+                 f"pre-round-10 history: {p}")
+        if not p["rule"]:
+            fail(f"prediction {p['id']} has no machine-checkable rule")
+    if json.loads(json.dumps(ledger)) != ledger:
+        fail("ledger does not round-trip through JSON")
+    log(f"report OK ({len(timeline)} entries ingested, 9 predictions "
+        "pending)")
+
+
+def check_overhead(tmp, corpus, dt_on: float) -> None:
+    _topo, res_off, dt_off = _run(tmp, corpus, "off", FD_FLIGHT="0",
+                                  FD_TRACE_SPANS="0", FD_SENTINEL="0")
+    if res_off.slo is not None:
+        fail("FD_SENTINEL=0 run still produced a sentinel summary")
+    # 5% gate with an absolute floor (same rationale as obs_smoke: on a
+    # small corpus the run is ~1 s and scheduler jitter dwarfs any real
+    # always-on cost).
+    slack = max(dt_off * 0.05, 0.15)
+    if dt_on > dt_off + slack:
+        fail(f"flight+sentinel overhead: {dt_on:.2f}s vs {dt_off:.2f}s "
+             "with both off (> 5% + jitter floor)")
+    log(f"overhead OK ({dt_on:.2f}s on vs {dt_off:.2f}s off)")
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.perf_counter()
+    corpus = _corpus()
+    log(f"corpus ready ({len(corpus.payloads)} txns)")
+    with tempfile.TemporaryDirectory(prefix="fd_slo_") as tmp:
+        dt_on = check_clean(tmp, corpus)
+        check_chaos(tmp, corpus)
+        check_report()
+        check_overhead(tmp, corpus, dt_on)
+    print(json.dumps({
+        "metric": "slo_smoke", "ok": True,
+        "corpus": N, "schedule": CHAOS_SCHEDULE,
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,590 @@
+"""fd_sentinel — SLO engine, regression tracker, prediction ledger,
+cross-process/cross-shard aggregation (disco/sentinel.py + the flight
+merge helpers + scripts/fd_report.py + scripts/bench_log_check.py).
+
+Layers: spec typing + the pinned docs render, the burn-rate / liveness
+evaluators over synthetic telemetry (injected clocks — no sleeps), the
+EdgeHist percentile edge cases + the histogram-merge property, the
+timeline/ledger/regression machinery against BOTH the repo's real
+history and synthetic r06-shaped artifacts, the BENCH_LOG hygiene
+gate, and pipeline integration (clean run quiet, chaos starve trips
+exactly the matching SLO, supervised/mesh merged snapshots sum).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.disco import flight, sentinel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ---------------------------------------------------------------- spec ---
+
+
+def test_slo_table_typed_and_unique():
+    names = [s.name for s in sentinel.SLO_TABLE]
+    assert len(names) == len(set(names))
+    for s in sentinel.SLO_TABLE:
+        assert s.kind in ("latency", "liveness"), s.name
+        assert s.objective, s.name
+        assert s.budget_flag in __import__(
+            "firedancer_tpu.flags", fromlist=["REGISTRY"]).REGISTRY, s.name
+        if s.kind == "latency":
+            assert 0.5 < s.target < 1.0, s.name
+    # every chaos class in the fault map maps to a declared SLO
+    for cls, slo in sentinel.FAULT_SLO.items():
+        assert slo in sentinel.SLO_BY_NAME, (cls, slo)
+    # the smoke-pinned pairs must stay declared
+    assert sentinel.FAULT_SLO["credit_starve"] == "pipeline_progress"
+    assert sentinel.FAULT_SLO["hb_stall"] == "tile_heartbeat"
+
+
+def test_slo_spec_markdown_pinned():
+    """docs/SLO.md is generated from the spec — regenerate with
+    `python scripts/fd_report.py --dump-spec > docs/SLO.md`."""
+    with open(os.path.join(REPO, "docs", "SLO.md")) as f:
+        assert f.read() == sentinel.dump_slo_markdown()
+
+
+def test_bad_from_bucket_is_conservative():
+    # 2x budget exactly on a bucket boundary: that bucket still counts
+    # GOOD (lower bound >= 2x budget is required).
+    th = 1 << 20   # 2x = 2^21
+    b = sentinel._bad_from_bucket(th)
+    assert (1 << (b - 1)) >= 2 * th
+    assert (1 << (b - 2)) < 2 * th
+    # huge budgets saturate at the bucket count, never index past it
+    assert sentinel._bad_from_bucket(1 << 62) == flight.N_BUCKETS
+
+
+# ------------------------------------------------- synthetic evaluators ---
+
+
+def _synthetic_sentinel(edges, tiles=lambda: {}):
+    return sentinel.Sentinel(None, None, edges_fn=edges, tiles_fn=tiles,
+                             clock=lambda: 0.0)
+
+
+def test_latency_burn_alert_fires_and_clears():
+    h = flight.EdgeHist("sink")
+    snt = _synthetic_sentinel(lambda: {"sink": h.row})
+    t = 0.0
+    while t <= 4.0:   # bad samples (~10 s each) every half second
+        for _ in range(50):
+            h.observe(10_000_000_000)
+        snt.poll(now=t)
+        t += 0.5
+    assert [a["slo"] for a in snt.alerts] == ["e2e_p99"]
+    assert snt._state["e2e_p99"].alerting
+    a = snt.alerts[0]
+    assert a["slo_kind"] == "latency" and a["burn_milli"] >= 2000
+    # traffic goes quiet -> windows drain -> alert clears
+    for _ in range(4):
+        snt.poll(now=t)
+        t += 0.5
+    assert not snt._state["e2e_p99"].alerting
+    assert snt.summary()["slos"]["e2e_p99"]["state"] == "ok"
+    assert snt.summary()["slos"]["e2e_p99"]["alerts"] == 1
+
+
+def test_latency_good_traffic_never_alerts():
+    h = flight.EdgeHist("sink")
+    snt = _synthetic_sentinel(lambda: {"sink": h.row})
+    t = 0.0
+    while t <= 6.0:
+        for _ in range(50):
+            h.observe(1_000_000)   # 1 ms, far under budget
+        snt.poll(now=t)
+        t += 0.5
+    assert snt.alerts == []
+
+
+def test_latency_alert_requires_spanned_windows():
+    """Early-run transients cannot alert: the slow window must actually
+    be covered by history before a burn is believed."""
+    h = flight.EdgeHist("sink")
+    snt = _synthetic_sentinel(lambda: {"sink": h.row})
+    for i, t in enumerate((0.0, 0.5, 1.0, 1.5, 2.0)):
+        for _ in range(100):
+            h.observe(10_000_000_000)
+        snt.poll(now=t)
+    assert snt.alerts == []   # 2 s of pure badness, slow window (4 s) unspanned
+
+
+def test_progress_stall_alert():
+    h = flight.EdgeHist("sink")
+    snt = _synthetic_sentinel(lambda: {"sink": h.row})
+    h.observe(1000)
+    snt.poll(now=0.0)          # armed (first frag seen)
+    snt.poll(now=1.0)
+    assert snt.alerts == []
+    snt.poll(now=2.5)          # > FD_SLO_STALL_MS (2000) since change
+    assert [a["slo"] for a in snt.alerts] == ["pipeline_progress"]
+    h.observe(1000)            # progress resumes
+    snt.poll(now=2.6)
+    assert not snt._state["pipeline_progress"].alerting
+
+
+def test_progress_not_armed_before_first_frag():
+    snt = _synthetic_sentinel(lambda: {"sink": np.zeros(
+        flight.EDGE_SLOTS, np.uint64)})
+    for t in (0.0, 3.0, 6.0, 9.0):
+        snt.poll(now=t)
+    assert snt.alerts == []
+
+
+def test_heartbeat_stall_alert():
+    hb = {"verify": (1, 12345)}
+    snt = _synthetic_sentinel(lambda: {}, tiles=lambda: dict(hb))
+    snt.poll(now=0.0)          # arms at first sight
+    snt.poll(now=1.0)
+    assert snt.alerts == []
+    snt.poll(now=1.7)          # > FD_SLO_HB_MS (1500) frozen
+    assert [a["slo"] for a in snt.alerts] == ["tile_heartbeat"]
+    assert snt.alerts[0]["tiles"] == ["verify"]
+    hb["verify"] = (1, 99999)  # beat resumes
+    snt.poll(now=1.8)
+    assert not snt._state["tile_heartbeat"].alerting
+
+
+def test_heartbeat_ignores_booting_and_halted_tiles():
+    snt = _synthetic_sentinel(
+        lambda: {},
+        tiles=lambda: {"boot": (0, 777), "halted": (2, 777)})
+    for t in (0.0, 2.0, 4.0):
+        snt.poll(now=t)
+    assert snt.alerts == []
+
+
+# -------------------------------- EdgeHist percentile edge cases (S3) ---
+
+
+def test_percentile_empty_histogram():
+    h = flight.EdgeHist("e")
+    assert h.percentile_ns(0.5) == 0
+    assert h.percentile_ns(0.99) == 0
+    assert h.summary() == {"n": 0, "p50_ns_le": 0, "p99_ns_le": 0,
+                           "sum_ns": 0}
+
+
+def test_percentile_single_bucket():
+    h = flight.EdgeHist("e")
+    for _ in range(7):
+        h.observe(1000)        # bucket 10: [512, 1024)
+    for q in (0.01, 0.5, 0.99, 1.0):
+        assert h.percentile_ns(q) == 1024
+
+
+def test_percentile_all_mass_in_overflow_bucket():
+    h = flight.EdgeHist("e")
+    for _ in range(5):
+        h.observe(1 << 50)     # clamps into the last bucket
+    assert int(h.row[1 + flight.N_BUCKETS - 1]) == 5
+    assert h.percentile_ns(0.5) == 1 << (flight.N_BUCKETS - 1)
+    # the vectorized path clamps identically
+    h2 = flight.EdgeHist("e2")
+    h2.observe_many(np.full(5, 1 << 50, np.int64))
+    assert np.array_equal(h.row[1:], h2.row[1:])
+
+
+def test_merged_histogram_percentile_matches_concatenated():
+    """Property (S3): merging per-shard histograms (elementwise add)
+    yields EXACTLY the histogram of the concatenated samples, and its
+    percentile estimate brackets the true sample percentile within one
+    log2 bucket."""
+    import random
+
+    rng = random.Random(1234)
+    for trial in range(20):
+        shards = [flight.EdgeHist(f"s{i}") for i in range(3)]
+        samples = []
+        for _ in range(rng.randrange(30, 400)):
+            v = rng.randrange(1, 1 << rng.randrange(4, 36))
+            samples.append(v)
+            rng.choice(shards).observe(v)
+        merged = flight.EdgeHist(
+            "m", flight.merge_edge_rows([s.row for s in shards]))
+        whole = flight.EdgeHist("w")
+        for v in samples:
+            whole.observe(v)
+        assert np.array_equal(merged.row, whole.row)
+        import math
+
+        for q in (0.5, 0.9, 0.99):
+            est = merged.percentile_ns(q)
+            k = max(1, math.ceil(q * len(samples)))  # rank of the quantile
+            true = sorted(samples)[min(k, len(samples)) - 1]
+            assert true <= est < 2 * max(true, 1), (trial, q, true, est)
+
+
+# --------------------------------------------- merge / aggregation ------
+
+
+def test_merge_tile_metrics_counters_and_gauges():
+    a = {m.name: 0 for m in flight.TILE_METRICS}
+    b = dict(a)
+    a.update(batches=3, lanes=100, breaker_trips=1, breaker_state=0)
+    b.update(batches=2, lanes=50, breaker_trips=2, breaker_state=1)
+    m = flight.merge_tile_metrics([a, b])
+    assert m["batches"] == 5 and m["lanes"] == 150
+    assert m["breaker_trips"] == 3           # gauges sum...
+    assert m["breaker_state"] == 1           # ...except state: most severe
+    assert flight.merge_tile_metrics([])["breaker_state"] == 3  # disabled
+
+
+def test_merge_snapshots_counters_equal_sum(tmp_path):
+    """Two registry-bearing workspaces (two shards of a pod) merge into
+    ONE snapshot whose counters equal the sum of the per-shard rows."""
+    from firedancer_tpu.tango.rings import Workspace
+
+    snaps, lanes_in = [], [37, 91]
+    for i, n in enumerate(lanes_in):
+        w = Workspace.create(str(tmp_path / f"s{i}.wksp"), 1 << 22)
+        flight.create_regions(w, ["verify"], ["sink"])
+        lane = flight.tile_lane(w, "verify")
+        lane.inc("batches", i + 1)
+        lane.inc("lanes", n)
+        lane.publish()
+        h = flight.edge_hist(w, "sink")
+        for v in range(1, n + 1):
+            h.observe(v * 1000)
+        snaps.append(flight.snapshot_raw(w))
+    merged = flight.merge_snapshots(snaps)
+    assert merged["metrics"]["verify"]["lanes"] == sum(lanes_in)
+    assert merged["metrics"]["verify"]["batches"] == 3
+    assert merged["edges"]["sink"]["n"] == sum(lanes_in)
+    per_shard_n = [flight.EdgeHist("x", s["edges"]["sink"]).count()
+                   for s in snaps]
+    assert merged["edges"]["sink"]["n"] == sum(per_shard_n)
+
+
+def test_book_shard_lanes_merged_equals_main_row():
+    """The VerifyTile per-mesh-shard booking: shard slices sum to the
+    tile's own lanes counter, so the merged (sum-of-shards) snapshot
+    reproduces the main row."""
+    from firedancer_tpu.disco.tiles import VerifyTile
+
+    class T:
+        pass
+
+    t = T()
+    t.batch = 512
+    t.fl_shards = [flight.TileLane(f"verify.shard{i}") for i in range(4)]
+    VerifyTile._book_shard_lanes(t, 300)
+    VerifyTile._book_shard_lanes(t, 512)
+    per = [lane.as_dict() for lane in t.fl_shards]
+    assert [p["lanes"] for p in per] == [128 + 128, 128 + 128, 44 + 128,
+                                         0 + 128]
+    merged = flight.merge_tile_metrics(per)
+    assert merged["lanes"] == 300 + 512
+    assert merged["batches"] == 8    # every shard participates per batch
+
+
+# ------------------------------------- timeline / ledger / regressions ---
+
+
+def test_timeline_ingests_repo_history_without_error():
+    timeline = sentinel.load_timeline(REPO)
+    assert not [e for e in timeline if e.parse_error], \
+        [(e.source, e.parse_error) for e in timeline if e.parse_error]
+    kinds = {e.kind for e in timeline}
+    assert {"verify_bench", "replay", "replay_cpu", "multichip",
+            "pack"} <= kinds
+    assert len(timeline) >= 25
+    # pre-schema lines classify as legacy, schema_version intact where set
+    assert any(e.legacy for e in timeline)
+
+
+def test_prediction_ledger_all_nine_pending_on_repo_history():
+    ledger = sentinel.prediction_ledger(sentinel.load_timeline(REPO))
+    assert len(ledger) == 9
+    assert [p["id"] for p in ledger] == list(range(1, 10))
+    for p in ledger:
+        assert p["verdict"] == "pending", p
+        assert p["rule"] and p["predicted"], p
+    assert json.loads(json.dumps(ledger)) == ledger
+
+
+def _sv2(rec):
+    base = {
+        "metric": "ed25519_verify_throughput", "unit": "verifies/s",
+        "vs_baseline": 0.4, "schema_version": 2, "msg_len": 192,
+        "reps": 10, "device": "TPU v5 lite0", "ms_per_batch": 20.0,
+        "rlc_fallbacks": 0, "ts": "2026-08-09T00:00:00Z",
+    }
+    base.update(rec)
+    return sentinel._classify(base, "synthetic")
+
+
+def test_prediction_ledger_autogrades_synthetic_r06():
+    timeline = [
+        _sv2({"mode": "direct", "batch": 8192, "value": 120_000.0}),
+        _sv2({"mode": "rlc", "batch": 8192, "value": 410_000.0,
+              "torsion_k": 64,
+              "stage_ms": {"sha": 3.2, "glue": 1.9, "decompress": 4.0,
+                           "msm": 9.0, "fused": True},
+              "b_sweep_measured": {"8192": 410_000, "16384": 455_000,
+                                   "32768": 470_000}}),
+        _sv2({"mode": "rlc", "batch": 8192, "value": 452_000.0,
+              "torsion_k": 32}),
+        _sv2({"mode": "rlc", "batch": 16384, "value": 455_000.0}),
+        sentinel._classify({"metric": "rlc_mesh_scaling", "speedup": 1.9,
+                            "devices": 2}, "synthetic"),
+    ]
+    ledger = sentinel.prediction_ledger(timeline)
+    assert all(p["verdict"] == "confirmed" for p in ledger), ledger
+    assert all(p["measured"] for p in ledger)
+    # falsification path: a fallback-carrying rlc record flips #4
+    bad = [_sv2({"mode": "rlc", "batch": 8192, "value": 400_000.0,
+                 "rlc_fallbacks": 3})]
+    p4 = sentinel.prediction_ledger(bad)[3]
+    assert p4["id"] == 4 and p4["verdict"] == "falsified"
+    # old (pre-schema) measurements can never grade a prediction
+    legacy = sentinel._classify(
+        {"metric": "ed25519_verify_throughput", "value": 24_830.5,
+         "mode": "rlc", "batch": 8192}, "legacy")
+    assert sentinel.prediction_ledger([legacy])[0]["verdict"] == "pending"
+    # a mesh-speedup record WITHOUT a devices count must stay pending
+    nodev = sentinel._classify({"rlc_mesh_speedup": 1.9}, "synthetic")
+    assert sentinel.prediction_ledger([nodev])[7]["verdict"] == "pending"
+    # a non-numeric schema_version classifies legacy, never crashes
+    weird = sentinel._classify(
+        {"metric": "note", "note": "x", "schema_version": "v2"}, "s")
+    assert weird.legacy and weird.schema_version == 0
+
+
+def test_regressions_flag_drops_vs_rolling_best():
+    mk = lambda v, **kw: _sv2(
+        {"mode": "direct", "batch": 8192, "value": v, **kw})
+    timeline = [mk(100_000.0), mk(120_000.0), mk(80_000.0),
+                mk(20.0, cpu_fallback=True)]
+    regs = sentinel.regressions(timeline, pct=10.0)
+    assert len(regs) == 1
+    assert regs[0]["value"] == 80_000.0
+    assert regs[0]["rolling_best"] == 120_000.0
+    assert regs[0]["drop_pct"] == pytest.approx(33.3, abs=0.1)
+
+
+def test_evaluate_edges_summary_rule():
+    budgets = {s.name: 2500 for s in sentinel.SLO_TABLE}
+    ok = {"sink": {"n": 100, "p50_ns_le": 1 << 28, "p99_ns_le": 4_000_000_000,
+                   "sum_ns": 0}}
+    assert sentinel.evaluate_edges_summary(ok, budgets) == []
+    bad = {"sink": {"n": 100, "p50_ns_le": 1 << 28, "p99_ns_le": 6_000_000_000,
+                    "sum_ns": 0}}
+    v = sentinel.evaluate_edges_summary(bad, budgets)
+    assert len(v) == 1 and v[0]["slo"] == "e2e_p99"
+    # empty edges / zero-n edges are not violations
+    assert sentinel.evaluate_edges_summary({}, budgets) == []
+
+
+# ----------------------------------------------- BENCH_LOG hygiene (S2) ---
+
+
+def test_bench_log_check_green_on_repo():
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import bench_log_check
+
+    assert bench_log_check.validate_file(
+        os.path.join(REPO, "BENCH_LOG.jsonl")) == []
+    # The validator must keep accepting whatever version bench.py
+    # stamps (bench raises on its own rejects — an equality check here
+    # would crash the ladder on the next schema bump).
+    assert flight.ARTIFACT_SCHEMA_VERSION >= bench_log_check.SCHEMA_VERSION_MIN
+
+
+def test_bench_log_check_rejects_bad_lines(tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import bench_log_check
+
+    p = tmp_path / "log.jsonl"
+    p.write_text(
+        # legacy-shaped line NOT in the allowlist
+        '{"metric": "ed25519_verify_throughput", "value": 1}\n'
+        # sv2 line with a broken shape (no mode/batch/...)
+        '{"metric": "ed25519_verify_throughput", "value": 1, '
+        '"schema_version": 2, "ts": "2026-08-09T00:00:00Z"}\n'
+        # sv2 note without a note
+        '{"metric": "note", "schema_version": 2, '
+        '"ts": "2026-08-09T00:00:00Z"}\n'
+        "not json\n"
+    )
+    errs = bench_log_check.validate_file(str(p))
+    assert len(errs) >= 4
+    assert any("allowlist" in e for e in errs)
+    assert any("not JSON" in e for e in errs)
+
+
+def test_bench_refuses_to_append_invalid_log_line(tmp_path, monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "_BENCH_LOG", str(tmp_path / "log.jsonl"))
+    with pytest.raises(ValueError, match="refusing to append"):
+        bench._log_measurement({"metric": "ed25519_verify_throughput",
+                                "value": 1})
+    assert not os.path.exists(str(tmp_path / "log.jsonl"))
+    good = {
+        "metric": "ed25519_verify_throughput", "value": 1000.0,
+        "unit": "verifies/s", "vs_baseline": 0.001, "mode": "direct",
+        "batch": 256, "reps": 1, "msg_len": 192, "ms_per_batch": 1.0,
+        "device": "TFRT_CPU_0", "rlc_fallbacks": 0,
+    }
+    bench._log_measurement(good)
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import bench_log_check
+
+    assert bench_log_check.validate_file(str(tmp_path / "log.jsonl")) == []
+
+
+# --------------------------------------------- pipeline integration -----
+
+
+def _corpus(n=220, seed=91):
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+
+    return mainnet_corpus(n=n, seed=seed, dup_rate=0.03, corrupt_rate=0.02,
+                          parse_err_rate=0.02, sign_batch_size=64,
+                          max_data_sz=120)
+
+
+def test_clean_pipeline_run_quiet_sentinel(tmp_path):
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+    from firedancer_tpu.tango.rings import Workspace
+
+    topo = build_topology(str(tmp_path / "clean.wksp"), depth=512,
+                          wksp_sz=1 << 26)
+    res = run_pipeline(topo, _corpus().payloads, verify_backend="cpu",
+                       timeout_s=240.0, record_digests=True, feed=True)
+    assert res.slo is not None
+    assert res.slo["evals"] >= 1
+    assert res.slo["alert_cnt"] == 0, res.slo
+    assert set(res.slo["slos"]) == set(sentinel.SLO_NAMES)
+    assert sentinel.evaluate_edges_summary(res.stage_hist) == []
+    wksp = Workspace.join(topo.wksp_path)
+    slos = flight.read_slos(wksp)
+    assert slos and slos["e2e_p99"]["evals"] >= 1
+    prom = flight.render_prom(wksp)
+    assert 'fd_flight_slo_state{slo="e2e_p99"} 0' in prom
+    # monitor overlay + fd_top SLO panel render from the same rows
+    from firedancer_tpu.disco.monitor import snapshot
+
+    snap = snapshot(wksp, topo.pod)
+    assert snap["slo.pipeline_progress"]["evals"] >= 1
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import fd_top
+
+    frame, _ = fd_top.render_once(wksp, topo.pod, ansi=False)
+    assert "SLO" in frame and "e2e_p99" in frame
+
+
+def test_chaos_starve_trips_progress_slo(tmp_path, monkeypatch):
+    """Detection asymmetry, in-tree: a credit_starve window must trip
+    pipeline_progress (and nothing else), with the alert recorded in
+    the sentinel flight recorder and matched to the fault class."""
+    from firedancer_tpu.disco import chaos
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    monkeypatch.setenv("FD_CHAOS", "1")
+    monkeypatch.setenv("FD_CHAOS_SEED", "5")
+    monkeypatch.setenv("FD_CHAOS_SCHEDULE", "credit_starve@40:25040")
+    monkeypatch.setenv("FD_SLO_STALL_MS", "300")
+    monkeypatch.setenv("FD_SENTINEL_INTERVAL_MS", "50")
+    dump_dir = tmp_path / "dumps"
+    monkeypatch.setenv("FD_FLIGHT_DUMP", str(dump_dir))
+    try:
+        topo = build_topology(str(tmp_path / "starve.wksp"), depth=512,
+                              wksp_sz=1 << 26)
+        res = run_pipeline(topo, _corpus(n=400, seed=97).payloads,
+                           verify_backend="cpu", timeout_s=240.0,
+                           record_digests=True, feed=True)
+    finally:
+        chaos.uninstall()
+    assert res.slo is not None
+    got = {a["slo"] for a in res.slo["alerts"]}
+    assert got == {"pipeline_progress"}, res.slo["alerts"]
+    alert = res.slo["alerts"][0]
+    assert "credit_starve" in alert["fault_classes"]
+    dumps = sorted(os.listdir(dump_dir))
+    assert dumps
+    with open(dump_dir / dumps[-1]) as f:
+        dump = json.load(f)
+    events = dump["recorders"]["sentinel"]["events"]
+    assert any(e["kind"] == "slo_alert"
+               and e["slo"] == "pipeline_progress" for e in events)
+    assert dump["slos"]["pipeline_progress"]["alerts"] >= 1
+    assert dump["slos"]["tile_heartbeat"]["alerts"] == 0
+
+
+@pytest.mark.slow
+def test_supervised_two_process_merged_snapshot(tmp_path):
+    """Acceptance: a supervised multi-process run with two verify lanes
+    (two worker PROCESSES, two registry rows) produces one merged
+    flight snapshot whose counters equal the sum of the per-process
+    rows."""
+    from firedancer_tpu.disco.pipeline import build_topology
+    from firedancer_tpu.disco.supervisor import run_pipeline_supervised
+    from firedancer_tpu.tango.rings import Workspace
+
+    corpus = _corpus(n=600, seed=13)
+    topo = build_topology(str(tmp_path / "sup.wksp"), depth=1024,
+                          wksp_sz=1 << 26, verify_lanes=2)
+    res = run_pipeline_supervised(
+        topo, corpus.payloads, verify_backend="cpu", verify_batch=64,
+        timeout_s=180.0, record_digests=True,
+    )
+    assert res.recv_cnt == corpus.n_unique_ok
+    assert res.slo is not None     # supervised runs are SLO citizens
+    wksp = Workspace.join(topo.wksp_path)
+    rows = {label: row for label, row in (flight.read_tiles(wksp) or {}
+                                          ).items()
+            if label in ("verify", "verify.v1")}
+    assert set(rows) == {"verify", "verify.v1"}
+    for label, row in rows.items():
+        assert row["lanes"] > 0, (label, row)   # both processes verified
+    merged = res.flight_merged
+    assert merged["lanes"] == sum(r["lanes"] for r in rows.values())
+    assert merged["batches"] == sum(r["batches"] for r in rows.values())
+    assert merged == flight.merge_tile_metrics(rows.values())
+    assert len(res.verify_stats) == 2   # per-lane views stay per-lane
+
+
+@pytest.mark.slow
+def test_mesh_two_shard_merged_snapshot(tmp_path):
+    """Acceptance: a 2-shard mesh verify run produces per-shard flight
+    rows in shared memory whose merged counters equal the sum of the
+    per-shard rows AND reproduce the verify tile's own row."""
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+    from firedancer_tpu.tango.rings import Workspace
+
+    corpus = mainnet_corpus(120, seed=33, max_data_sz=48)
+    topo = build_topology(str(tmp_path / "mesh2.wksp"), depth=256,
+                          verify_shards=2)
+    res = run_pipeline(
+        topo, corpus.payloads, verify_backend="tpu", verify_batch=64,
+        verify_max_msg_len=512, timeout_s=600.0,
+        verify_opts={"mesh_devices": 2}, record_digests=True,
+    )
+    assert res.recv_cnt == corpus.n_unique_ok, res.diag
+    wksp = Workspace.join(topo.wksp_path)
+    tiles = flight.read_tiles(wksp) or {}
+    shards = [tiles[f"verify.shard{i}"] for i in range(2)]
+    main = tiles["verify"]
+    assert main["batches"] > 0 and main["lanes"] > 0
+    merged = flight.merge_tile_metrics(shards)
+    assert merged["lanes"] == sum(s["lanes"] for s in shards)
+    assert merged["lanes"] == main["lanes"]
+    for s in shards:
+        assert s["batches"] == main["batches"]   # every shard, every batch
+    assert merged["batches"] == 2 * main["batches"]
