@@ -12,12 +12,30 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== fdlint (blocking static-analysis lane) =="
-# Fails fast, before anything builds: trace-safety in jitted/pallas
-# paths, FD_* flag-registry discipline, boundary-assert contracts, and
-# the native ring-word atomics check — new violations (vs
-# lint_baseline.json) or stale baseline entries exit nonzero.
+echo "== fdlint (blocking static-analysis lane, passes 1-6) =="
+# Fails fast, before anything builds: trace-safety in jitted/pallas/
+# shard_map paths, FD_* flag-registry discipline, boundary-assert
+# contracts, the native ring-word atomics check, the fdcert
+# limb-bounds certifier (pass 5: int32/f32-window proofs over the
+# crypto kernel bodies), and the fdcert ownership pass (pass 6:
+# registered threads, single-writer resources, blessed channels) —
+# new violations (vs lint_baseline.json) or stale baseline entries
+# exit nonzero.
 python scripts/fdlint.py --check
+
+echo "== fdcert bounds certificate (artifact + drift gate) =="
+# The machine-readable proof of every certified kernel's bounds. The
+# committed lint_bounds_cert.json must match what the certifier proves
+# against the CURRENT source — a kernel edit that widens any bound
+# regenerates different numbers and fails here (and the committed file
+# is what reviewers diff). The fresh copy is kept as a build artifact.
+mkdir -p build
+python scripts/fdlint.py --dump-cert > build/lint_bounds_cert.json
+diff -u lint_bounds_cert.json build/lint_bounds_cert.json || {
+  echo "fdcert: lint_bounds_cert.json is stale — regenerate with"
+  echo "  python scripts/fdlint.py --dump-cert > lint_bounds_cert.json"
+  exit 1
+}
 
 echo "== BENCH_LOG hygiene (schema_version-2 shape + legacy allowlist) =="
 # The measurement history feeds fd_report's trend tables and the

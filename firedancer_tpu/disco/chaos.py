@@ -451,6 +451,20 @@ class ChaosInjector:
             self.note("hb_stall", "healed")  # window closed, beat resumes
         return False
 
+    def supervisor_faults_pending(self) -> bool:
+        """True while a scheduled supervisor-level fault (worker_kill)
+        has not yet reached its monitor-pass ordinal. The supervisor
+        folds this into its quiescence condition: a drained pipeline
+        keeps taking monitor passes (each one ticks the ordinal) until
+        every scheduled kill has fired, so WHETHER the fault lands no
+        longer races corpus size against host speed — the round-12
+        flake was exactly that race (quiescence at pass <20 on a fast
+        1-core host silently skipped worker_kill@20)."""
+        with self._lock:
+            n = self._ord.get("monitor_pass", 0)
+            return any(hi > n
+                       for lo, hi in self.schedule.get("worker_kill", []))
+
     def supervisor_hook(self, tiles) -> None:
         """One supervisor monitor pass: SIGKILL the verify worker at
         scheduled pass ordinals (detected/healed are booked by the

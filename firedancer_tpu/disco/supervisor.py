@@ -359,7 +359,16 @@ def _supervised(
                 last_progress_sig = progress_sig
                 last_progress_at = now
             drained = all(fs >= mc for mc, fs in cursors)
-            if src_done and drained and cursors == last_cursors:
+            # A drained pipeline may NOT quiesce while a scheduled
+            # supervisor-level chaos fault is still pending: monitor
+            # passes keep ticking (supervisor_hook above), so the
+            # scheduled ordinal is always reached and the kill fires
+            # deterministically on any host speed (the fixed-ordinal
+            # wait this replaces made worker_kill@N a race against
+            # corpus drain on fast hosts).
+            chaos_pending = c is not None and c.supervisor_faults_pending()
+            if (src_done and drained and cursors == last_cursors
+                    and not chaos_pending):
                 settle += 1
                 if settle >= settle_needed:
                     break

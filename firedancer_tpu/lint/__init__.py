@@ -1,15 +1,25 @@
 """fdlint — the repo-native static-analysis suite.
 
-Four passes, each a machine-checked contract for a bug class the
+Six passes, each a machine-checked contract for a bug class the
 Python/JAX port only surfaces at runtime (see each module's docstring):
 
-  1. trace_safety   — host-sync/retrace hazards inside jitted/pallas code
+  1. trace_safety   — host-sync/retrace hazards inside jitted/pallas/
+                      shard_map code
   2. flag_registry  — FD_* env reads must go through firedancer_tpu.flags
   3. boundary       — no bare `assert` in FFI/tile/ring boundary modules
   4. native_atomics — ring seq/ctl words accessed atomically in native/
+  5. bounds         — fdcert: abstract-interpretation limb-bounds
+                      certifier for the crypto kernels (proves int32 /
+                      f32-window safety and the |limb| <= 512 dispatch
+                      contracts; emits lint_bounds_cert.json)
+  6. ownership      — fdcert: single-writer / registered-thread /
+                      blessed-channel discipline for the concurrency
+                      surface (tables rendered into docs/OWNERSHIP.md)
 
 Driven by scripts/fdlint.py (the CLI and the blocking ci.sh lane);
 pre-existing debt resolves against lint_baseline.json (common.Baseline).
+docs/LINT.md catalogs all six passes, the waiver grammar, and how to
+add a pass.
 """
 
 from __future__ import annotations
@@ -17,7 +27,8 @@ from __future__ import annotations
 import os
 from typing import List, Optional, Sequence
 
-from . import boundary, flag_registry, native_atomics, trace_safety
+from . import boundary, bounds, flag_registry, native_atomics, ownership, \
+    trace_safety
 from .common import Baseline, Violation, iter_files, rel, repo_root
 
 # Default scan scope, repo-relative. tests/ is deliberately excluded:
@@ -45,9 +56,12 @@ def run_all(
     native_roots: Sequence[str] = NATIVE_ROOTS,
 ) -> List[Violation]:
     root = root or repo_root()
+    full_scan = tuple(py_roots) == PY_ROOTS
     out: List[Violation] = []
-    py_paths = [os.path.join(root, r) for r in py_roots]
-    for path in iter_files(py_paths, (".py",)):
+    own_scan = ownership.Scan()
+    py_paths = list(iter_files([os.path.join(root, r) for r in py_roots],
+                               (".py",)))
+    for path in py_paths:
         rpath = rel(path, root)
         with open(path, encoding="utf-8") as f:
             src = f.read()
@@ -55,7 +69,16 @@ def run_all(
         if rpath not in _FLAG_PASS_EXEMPT:
             out.extend(flag_registry.check_source(src, path, root=root))
         out.extend(boundary.check_source(src, path, root=root))
+        out.extend(own_scan.check_source(src, path, root=root))
     out.extend(flag_registry.check_registry_docs())
+    # Pass 5: certify every FDCERT_CONTRACTS module the scan covers (a
+    # full scan proves everything; --changed re-proves only touched
+    # certified modules). Pass 6 stale-entry detection needs the full
+    # scope — a partial scan must not cry stale about unscanned files.
+    out.extend(bounds.check_repo(root, py_paths=None if full_scan
+                                 else py_paths))
+    if full_scan:
+        out.extend(own_scan.stale_entries())
     native_paths = [os.path.join(root, r) for r in native_roots]
     for path in iter_files(native_paths, (".cc", ".h", ".cpp", ".hpp")):
         out.extend(native_atomics.check_file(path, root=root))
@@ -69,7 +92,9 @@ __all__ = [
     "PY_ROOTS",
     "NATIVE_ROOTS",
     "boundary",
+    "bounds",
     "flag_registry",
     "native_atomics",
+    "ownership",
     "trace_safety",
 ]

@@ -1,0 +1,919 @@
+"""Pass 5 — fdcert bounds: abstract-interpretation limb-bounds certifier.
+
+The crypto kernels are hand-scheduled fixed-point arithmetic whose
+correctness hangs on magnitude invariants the dtype cannot express:
+int32 convolution rows must stay under 2^31, the f32 kernel-multiply
+contract needs every partial sum inside the 2^24 mantissa-exact window,
+and the public field-op invariant (|limb| <= 512) is what makes the
+FD_MUL_IMPL=f32 dispatch sound at all. Today those bounds live in
+docstrings and one opt-in runtime guard (FD_FE_DEBUG_BOUNDS); a new
+kernel that widens a constant ships silently-wrong products on the
+first out-of-range operand ("Efficient Verification of Optimized Code",
+2012.09919, finds exactly this class by static range reasoning).
+
+This pass PROVES the bounds instead: each certified module's AST is
+executed with jnp/jax replaced by an interval-domain shim (the
+transfer-function table below), so the repo's real kernel dataflow —
+add/sub/mul/carry/reduce chains, static slices, concats, gathers,
+Kogge-Stone prefix rounds — is followed row-exactly with Python-int
+intervals. No jax import, original line numbers survive into
+violations, and the proof re-runs on the shipping source (not a
+hand-maintained model that can drift).
+
+Entry contracts are declared next to the code as a module-level
+``FDCERT_CONTRACTS`` literal (ast.literal_eval'd, never imported):
+
+    FDCERT_CONTRACTS = {
+        "fe_mul": {"inputs": ["limbs:32:1024", "limbs:32:1024"],
+                   "out_abs": 512, "doc": "..."},
+        ...
+    }
+
+Input spec grammar (see _make_input):
+    limbs:<rows>:<bound>   (rows, 1) int32, |limb| <= bound
+    bytes:<cols>           (1, cols) uint8 in [0, 255]
+    bytes2:<rows>:<cols>   (rows, cols) uint8 (batched byte matrix)
+    blocks:<n>:<bound>     (n*SUB, 1) int32 in [0, bound] (fold layout)
+    digest_state           8 (hi, lo) pairs of (SUB, 1) uint32
+    int:<k>                the Python int k (static arg)
+
+Violations:
+    bounds-overflow     an intermediate escapes its lane (int32 wrap,
+                        f32 window, uint8/uint32 range, bad cast)
+    bounds-contract     the function's proven output bound exceeds its
+                        declared |limb| contract
+    bounds-unprovable   the body used an idiom the transfer table does
+                        not model (this must fail loudly: an unmodeled
+                        op is an unproven kernel, not a clean one)
+
+The machine-readable certificate (lint_bounds_cert.json, emitted by
+``scripts/fdlint.py --dump-cert``) records, per function, the declared
+contract, the proven output bound, and the worst intermediate
+magnitudes per lane — so FD_FE_DEBUG_BOUNDS becomes a belt over
+statically-proven suspenders, and certificate drift fails CI.
+"""
+
+from __future__ import annotations
+
+import __future__ as _future
+import ast
+import os
+from types import SimpleNamespace
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .common import Violation, rel, repo_root
+
+RULE_OVERFLOW = "bounds-overflow"
+RULE_CONTRACT = "bounds-contract"
+RULE_UNPROVABLE = "bounds-unprovable"
+
+# Modules certified by the default repo scan, in dependency order (each
+# later module may reference the earlier ones' extracted namespaces).
+CERT_MODULES = (
+    "firedancer_tpu/ops/fe25519.py",
+    "firedancer_tpu/ops/sc25519.py",
+    "firedancer_tpu/ops/frontend_pallas.py",
+)
+
+# Lane limits. F32_WINDOW is the mantissa-exact integer window: every
+# f32 intermediate must stay inside it or a product/sum silently
+# rounds (the fe_mul_f32 contract's whole point).
+INT32_MIN, INT32_MAX = -(1 << 31), (1 << 31) - 1
+F32_WINDOW = 1 << 24
+SUB = 8  # fold-layout sublane height default for isolated check_file
+#          runs; repo scans extract the live value from
+#          sha512_pallas.py's source via _extract_sub().
+
+
+class CertError(Exception):
+    """Raised by the transfer functions on a lane escape; carries the
+    rule so the driver can attribute overflow vs unprovable."""
+
+    def __init__(self, rule: str, message: str):
+        super().__init__(message)
+        self.rule = rule
+
+
+# --------------------------------------------------------------------------
+# The abstract value: per-element integer intervals over concrete
+# (batch-free) shapes, dtype-tagged. lo/hi are numpy object arrays of
+# Python ints, so the checker itself can never overflow.
+# --------------------------------------------------------------------------
+
+_CTX: Optional[dict] = None  # per-certification stats (worst magnitudes)
+
+
+def _note(kind: str, val: int) -> None:
+    if _CTX is not None:
+        _CTX["ops"] += 1
+        if val > _CTX[kind]:
+            _CTX[kind] = val
+
+
+_DTYPE_RANGE = {
+    "int32": (INT32_MIN, INT32_MAX),
+    "uint8": (0, 255),
+    "uint32": (0, (1 << 32) - 1),
+    "bool": (0, 1),
+    # float32 is range-checked against the exactness window instead.
+}
+
+
+def _checked(lo, hi, dtype: str) -> "Abs":
+    """Build an Abs after the lane check — every arithmetic transfer
+    funnels through here, so no intermediate escapes unchecked."""
+    lo = np.asarray(lo, dtype=object)
+    hi = np.asarray(hi, dtype=object)
+    mn = int(min(lo.min(), 0)) if lo.size else 0
+    mx = int(max(hi.max(), 0)) if hi.size else 0
+    mag = max(-mn, mx)
+    if dtype == "float32":
+        _note("max_abs_f32", mag)
+        if mag > F32_WINDOW:
+            raise CertError(
+                RULE_OVERFLOW,
+                f"f32 intermediate magnitude {mag} exceeds the 2^24 "
+                f"mantissa-exact window ({F32_WINDOW}) — the product/sum "
+                "is no longer exact",
+            )
+    else:
+        _note("max_abs_int32", mag)
+        rng = _DTYPE_RANGE.get(dtype)
+        if rng is None:
+            raise CertError(RULE_UNPROVABLE, f"unmodeled dtype {dtype!r}")
+        if mn < rng[0] or mx > rng[1]:
+            raise CertError(
+                RULE_OVERFLOW,
+                f"{dtype} intermediate range [{mn}, {mx}] escapes "
+                f"[{rng[0]}, {rng[1]}] — wraparound on real hardware",
+            )
+    return Abs(lo, hi, dtype)
+
+
+def _as_interval(x) -> Tuple[np.ndarray, np.ndarray, bool]:
+    """(lo, hi, was_abstract) for an operand: Abs passes through,
+    concrete ints/arrays/bools become degenerate intervals."""
+    if isinstance(x, Abs):
+        return x.lo, x.hi, True
+    if isinstance(x, (bool, np.bool_)):
+        x = int(x)
+    a = np.asarray(x)
+    if a.dtype == np.bool_:
+        a = a.astype(object) * 1
+    o = a.astype(object)
+    return o, o, False
+
+
+def _np_dtype_name(dt) -> str:
+    if dt is None:
+        return "int32"
+    name = np.dtype(dt).name
+    if name == "float64":  # jnp.float32 token maps via shim; be strict
+        return "float32"
+    return name
+
+
+class Abs:
+    """Interval-valued array in the abstract domain. Implements exactly
+    the operator/method surface the certified kernel bodies use; any
+    other access raises AttributeError -> bounds-unprovable."""
+
+    __slots__ = ("lo", "hi", "dtype")
+
+    def __init__(self, lo, hi, dtype: str = "int32"):
+        self.lo = np.asarray(lo, dtype=object)
+        self.hi = np.asarray(hi, dtype=object)
+        self.dtype = dtype
+
+    # -- structure -------------------------------------------------------
+
+    @property
+    def shape(self):
+        return self.lo.shape
+
+    @property
+    def ndim(self):
+        return self.lo.ndim
+
+    @property
+    def size(self):
+        return self.lo.size
+
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], tuple):
+            shape = shape[0]
+        return Abs(self.lo.reshape(shape), self.hi.reshape(shape),
+                   self.dtype)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, Abs):
+            raise CertError(
+                RULE_UNPROVABLE, "data-dependent indexing (Abs index)")
+        lo, hi = self.lo[idx], self.hi[idx]
+        if not isinstance(lo, np.ndarray):  # scalar pick keeps 0-d form
+            lo, hi = np.asarray(lo, object), np.asarray(hi, object)
+        return Abs(lo, hi, self.dtype)
+
+    @property
+    def at(self):
+        return _At(self)
+
+    def astype(self, dt):
+        # Casting is where lanes change: int -> f32 is exact only
+        # inside the mantissa window (the cast itself starts rounding a
+        # wide value); f32 -> int is exact because the window check
+        # held on every op; narrowing int casts (uint8) must be in
+        # range. All enforced by _checked against the target lane.
+        return _checked(self.lo, self.hi, _np_dtype_name(dt))
+
+    # -- arithmetic ------------------------------------------------------
+
+    def _bin_dtype(self, other) -> str:
+        # Symmetric lane promotion, matching jnp: mixing an int lane
+        # with float32 promotes to float32 — and therefore gets the
+        # mantissa-window check. (An asymmetric tag here once let
+        # `int32 + f32` skip the window check when the int operand was
+        # on the left; pinned by test_mixed_lane_promotion_is_checked.)
+        if self.dtype == "float32" or (isinstance(other, Abs)
+                                       and other.dtype == "float32"):
+            return "float32"
+        return self.dtype
+
+    def __add__(self, other):
+        lo2, hi2, _ = _as_interval(other)
+        return _checked(self.lo + lo2, self.hi + hi2,
+                        self._bin_dtype(other))
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        lo2, hi2, _ = _as_interval(other)
+        return _checked(self.lo - hi2, self.hi - lo2,
+                        self._bin_dtype(other))
+
+    def __rsub__(self, other):
+        lo2, hi2, _ = _as_interval(other)
+        return _checked(lo2 - self.hi, hi2 - self.lo,
+                        self._bin_dtype(other))
+
+    def __neg__(self):
+        return _checked(-self.hi, -self.lo, self.dtype)
+
+    def __mul__(self, other):
+        lo2, hi2, _ = _as_interval(other)
+        a, b = self.lo * lo2, self.lo * hi2
+        c, d = self.hi * lo2, self.hi * hi2
+        lo = np.minimum(np.minimum(a, b), np.minimum(c, d))
+        hi = np.maximum(np.maximum(a, b), np.maximum(c, d))
+        return _checked(lo, hi, self._bin_dtype(other))
+
+    __rmul__ = __mul__
+
+    def __abs__(self):
+        lo = np.where(self.lo >= 0, self.lo,
+                      np.where(self.hi <= 0, -self.hi, 0))
+        hi = np.maximum(-self.lo, self.hi)
+        return _checked(lo, hi, self.dtype)
+
+    # -- bit ops ---------------------------------------------------------
+
+    def __and__(self, other):
+        if isinstance(other, Abs):
+            if (self.lo.min() >= 0 and self.hi.max() <= 1
+                    and other.lo.min() >= 0 and other.hi.max() <= 1):
+                # {0,1} lattice: & is monotone
+                return _checked(self.lo & other.lo, self.hi & other.hi,
+                                self.dtype)
+            raise CertError(RULE_UNPROVABLE, "general Abs & Abs")
+        m = int(other)
+        if m < 0 or (m & (m + 1)) != 0:
+            raise CertError(RULE_UNPROVABLE,
+                            f"& with non-(2^k - 1) mask {m}")
+        inside = (self.lo >= 0) & (self.hi <= m)
+        lo = np.where(inside, self.lo, 0)
+        hi = np.where(inside, self.hi, m)
+        return _checked(lo.astype(object), hi.astype(object), self.dtype)
+
+    __rand__ = __and__
+
+    def __or__(self, other):
+        lo2, hi2, _ = _as_interval(other)
+        if (self.lo.min() >= 0 and self.hi.max() <= 1
+                and lo2.min() >= 0 and hi2.max() <= 1):
+            return _checked(self.lo | lo2, self.hi | hi2, self.dtype)
+        raise CertError(RULE_UNPROVABLE, "| outside the {0,1} lattice")
+
+    __ror__ = __or__
+
+    def __invert__(self):
+        if self.lo.min() >= 0 and self.hi.max() <= 1:
+            return _checked(1 - self.hi, 1 - self.lo, self.dtype)
+        raise CertError(RULE_UNPROVABLE, "~ outside the {0,1} lattice")
+
+    def __rshift__(self, k):
+        k = int(k)
+        # Arithmetic shift on both bounds: Python's >> floors toward
+        # -inf, exactly numpy's signed semantics.
+        return _checked(self.lo >> k, self.hi >> k, self.dtype)
+
+    def __lshift__(self, k):
+        k = int(k)
+        return _checked(self.lo * (1 << k), self.hi * (1 << k), self.dtype)
+
+    # -- comparisons (-> {0,1} bool intervals) ---------------------------
+    # Each resolves per element to 1 (provably true), 0 (provably
+    # false), or the undecided interval [0, 1].
+
+    @staticmethod
+    def _bool(t, f) -> "Abs":
+        return Abs(np.where(t, 1, 0).astype(object),
+                   np.where(f, 0, 1).astype(object), "bool")
+
+    def __lt__(self, other):
+        lo2, hi2, _ = _as_interval(other)
+        return Abs._bool(self.hi < lo2, self.lo >= hi2)
+
+    def __le__(self, other):
+        lo2, hi2, _ = _as_interval(other)
+        return Abs._bool(self.hi <= lo2, self.lo > hi2)
+
+    def __gt__(self, other):
+        lo2, hi2, _ = _as_interval(other)
+        return Abs._bool(self.lo > hi2, self.hi <= lo2)
+
+    def __ge__(self, other):
+        lo2, hi2, _ = _as_interval(other)
+        return Abs._bool(self.lo >= hi2, self.hi < lo2)
+
+    def __eq__(self, other):  # type: ignore[override]
+        lo2, hi2, _ = _as_interval(other)
+        t = (self.lo == self.hi) & (lo2 == hi2) & (self.lo == lo2)
+        f = (self.hi < lo2) | (self.lo > hi2)
+        return Abs._bool(t, f)
+
+    def __ne__(self, other):  # type: ignore[override]
+        e = self.__eq__(other)
+        return Abs(1 - e.hi, 1 - e.lo, "bool")
+
+    def __hash__(self):  # keep Abs usable as a plain object
+        return id(self)
+
+    def __repr__(self):
+        mn = int(self.lo.min()) if self.lo.size else 0
+        mx = int(self.hi.max()) if self.hi.size else 0
+        return f"Abs({self.dtype}, shape={self.shape}, [{mn}, {mx}])"
+
+    def max_abs(self) -> int:
+        if not self.lo.size:
+            return 0
+        return max(-int(self.lo.min()), int(self.hi.max()), 0)
+
+
+class _At:
+    """jnp .at[...] indexed-update shim: set/add on row slices."""
+
+    def __init__(self, base: Abs):
+        self._base = base
+
+    def __getitem__(self, idx):
+        base = self._base
+
+        class _Upd:
+            @staticmethod
+            def set(val):
+                lo, hi = base.lo.copy(), base.hi.copy()
+                vlo, vhi, _ = _as_interval(val)
+                lo[idx], hi[idx] = vlo, vhi
+                return _checked(lo, hi, base.dtype)
+
+            @staticmethod
+            def add(val):
+                lo, hi = base.lo.copy(), base.hi.copy()
+                vlo, vhi, _ = _as_interval(val)
+                lo[idx] = lo[idx] + vlo
+                hi[idx] = hi[idx] + vhi
+                return _checked(lo, hi, base.dtype)
+
+        return _Upd
+
+
+# --------------------------------------------------------------------------
+# The jnp/jax transfer-function table. Each shim function dispatches:
+# any Abs argument -> interval transfer; all-concrete -> real numpy (so
+# module-level constant tables build exactly as they do under jax).
+# --------------------------------------------------------------------------
+
+
+def _any_abs(*xs) -> bool:
+    for x in xs:
+        if isinstance(x, Abs):
+            return True
+        if isinstance(x, (list, tuple)) and _any_abs(*x):
+            return True
+    return False
+
+
+def _shim_asarray(x, dtype=None):
+    if isinstance(x, Abs):
+        return x if dtype is None else x.astype(dtype)
+    return np.asarray(x, dtype=dtype)
+
+
+def _shim_zeros(shape, dtype=None):
+    # The requested lane tags the accumulator: a uint8/uint32/bool
+    # zeros array must be range-checked against ITS lane, not int32
+    # (collapsing to int32 once let a uint8 accumulator certify past
+    # 255; pinned by test_zeros_accumulator_keeps_its_lane).
+    name = _np_dtype_name(dtype)
+    z = np.zeros(shape, object)
+    return Abs(z, z.copy(), name)
+
+
+def _shim_zeros_like(x):
+    if isinstance(x, Abs):
+        return Abs(np.zeros(x.shape, object), np.zeros(x.shape, object),
+                   x.dtype)
+    return np.zeros_like(x)
+
+
+def _shim_concatenate(parts, axis=0):
+    parts = list(parts)
+    if not _any_abs(*parts):
+        return np.concatenate(parts, axis=axis)
+    dtype = next(p.dtype for p in parts if isinstance(p, Abs))
+    los, his = [], []
+    for p in parts:
+        lo, hi, _ = _as_interval(p)
+        los.append(lo)
+        his.append(hi)
+    return Abs(np.concatenate(los, axis=axis),
+               np.concatenate(his, axis=axis), dtype)
+
+
+def _shim_stack(parts, axis=0):
+    parts = list(parts)
+    if not _any_abs(*parts):
+        return np.stack(parts, axis=axis)
+    dtype = next(p.dtype for p in parts if isinstance(p, Abs))
+    los, his = [], []
+    for p in parts:
+        lo, hi, _ = _as_interval(p)
+        los.append(lo)
+        his.append(hi)
+    return Abs(np.stack(los, axis=axis), np.stack(his, axis=axis), dtype)
+
+
+def _shim_sum(x, axis=None, keepdims=False):
+    if not isinstance(x, Abs):
+        return np.sum(x, axis=axis, keepdims=keepdims)
+    lo = np.sum(x.lo, axis=axis, keepdims=keepdims)
+    hi = np.sum(x.hi, axis=axis, keepdims=keepdims)
+    return _checked(lo, hi, x.dtype)
+
+
+def _shim_where(cond, a, b):
+    if not _any_abs(cond, a, b):
+        return np.where(cond, a, b)
+    alo, ahi, a_abs = _as_interval(a)
+    blo, bhi, b_abs = _as_interval(b)
+    dtype = (a.dtype if isinstance(a, Abs)
+             else b.dtype if isinstance(b, Abs) else "int32")
+    if isinstance(cond, Abs):
+        # Decided lanes select exactly; undecided lanes take the union.
+        t = cond.lo == 1   # provably true
+        f = cond.hi == 0   # provably false
+        lo = np.where(t, alo, np.where(f, blo, np.minimum(alo, blo)))
+        hi = np.where(t, ahi, np.where(f, bhi, np.maximum(ahi, bhi)))
+        # broadcast against both branch shapes
+        lo = lo + np.zeros(np.broadcast_shapes(alo.shape, blo.shape),
+                           object)
+        hi = hi + np.zeros(np.broadcast_shapes(ahi.shape, bhi.shape),
+                           object)
+        return _checked(lo, hi, dtype)
+    lo = np.where(cond, alo, blo)
+    hi = np.where(cond, ahi, bhi)
+    return _checked(lo, hi, dtype)
+
+
+def _shim_moveaxis(x, src, dst):
+    if not isinstance(x, Abs):
+        return np.moveaxis(x, src, dst)
+    return Abs(np.moveaxis(x.lo, src, dst), np.moveaxis(x.hi, src, dst),
+               x.dtype)
+
+
+def _shim_tensordot(t, x, axes=1):
+    if not isinstance(x, Abs):
+        return np.tensordot(t, x, axes=axes)
+    if isinstance(t, Abs) or axes != 1:
+        raise CertError(RULE_UNPROVABLE, "tensordot beyond T @ Abs")
+    t = np.asarray(t).astype(object)
+    tp = np.where(t > 0, t, 0)
+    tn = np.where(t < 0, t, 0)
+    lo = np.tensordot(tp, x.lo, axes=1) + np.tensordot(tn, x.hi, axes=1)
+    hi = np.tensordot(tp, x.hi, axes=1) + np.tensordot(tn, x.lo, axes=1)
+    return _checked(lo, hi, x.dtype)
+
+
+def _shim_broadcast_to(x, shape):
+    if not isinstance(x, Abs):
+        return np.broadcast_to(x, shape)
+    return Abs(np.broadcast_to(x.lo, shape).copy(),
+               np.broadcast_to(x.hi, shape).copy(), x.dtype)
+
+
+def _shim_all(x, axis=None):
+    if not isinstance(x, Abs):
+        return np.all(x, axis=axis)
+    lo = np.min(x.lo, axis=axis)
+    hi = np.min(x.hi, axis=axis)
+    return Abs(np.asarray(lo, object), np.asarray(hi, object), "bool")
+
+
+def _shim_full(shape, val, dtype=None):
+    name = _np_dtype_name(dtype)
+    if name.startswith("float"):
+        return np.full(shape, val, np.dtype(dtype))
+    return np.full(shape, val, np.dtype(dtype) if dtype else np.int64)
+
+
+def _unprovable_fn(name):
+    def fn(*a, **k):
+        raise CertError(
+            RULE_UNPROVABLE,
+            f"`{name}` has no transfer function — extend the table in "
+            "lint/bounds.py or keep the idiom out of certified bodies",
+        )
+
+    return fn
+
+
+def _broadcasted_iota(dtype, shape, dim):
+    n = shape[dim]
+    view = [1] * len(shape)
+    view[dim] = n
+    return np.broadcast_to(
+        np.arange(n, dtype=np.int64).reshape(view), shape
+    ).copy()
+
+
+def make_shims() -> Tuple[SimpleNamespace, SimpleNamespace]:
+    """(jnp, jax) shim namespaces — the transfer-function table."""
+    jnp = SimpleNamespace(
+        ndarray=Abs,
+        int32=np.int32, int64=np.int64, float32=np.float32,
+        uint8=np.uint8, uint32=np.uint32, bool_=np.bool_,
+        asarray=_shim_asarray,
+        array=_shim_asarray,
+        zeros=_shim_zeros,
+        zeros_like=_shim_zeros_like,
+        concatenate=_shim_concatenate,
+        stack=_shim_stack,
+        sum=_shim_sum,
+        where=_shim_where,
+        moveaxis=_shim_moveaxis,
+        tensordot=_shim_tensordot,
+        broadcast_to=_shim_broadcast_to,
+        all=_shim_all,
+        full=_shim_full,
+        minimum=_unprovable_fn("jnp.minimum"),
+        maximum=_unprovable_fn("jnp.maximum"),
+        dot=_unprovable_fn("jnp.dot"),
+    )
+    lax = SimpleNamespace(
+        broadcasted_iota=_broadcasted_iota,
+        fori_loop=_unprovable_fn("lax.fori_loop"),
+        scan=_unprovable_fn("lax.scan"),
+        cond=_unprovable_fn("lax.cond"),
+        while_loop=_unprovable_fn("lax.while_loop"),
+        psum=_unprovable_fn("lax.psum"),
+        all_gather=_unprovable_fn("lax.all_gather"),
+    )
+    jax = SimpleNamespace(
+        numpy=jnp,
+        lax=lax,
+        jit=lambda fn, **kw: fn,
+    )
+    return jnp, jax
+
+
+# --------------------------------------------------------------------------
+# Module extraction: exec the certified module's AST (imports stripped,
+# shims injected) so function bodies AND module-level constant tables
+# (_IDX_MUL, _T_MU, FE_D ...) build under the transfer-function table
+# with original line numbers intact.
+# --------------------------------------------------------------------------
+
+
+def load_abstract_module(path: str, externs: Dict[str, Any]) -> dict:
+    """-> the module's globals dict after abstract execution."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=path)
+    jnp, jax = make_shims()
+    g: Dict[str, Any] = {
+        "__name__": "fdcert." + os.path.basename(path)[:-3],
+        "__file__": path,
+        "jnp": jnp,
+        "jax": jax,
+        "np": np,
+        "functools": __import__("functools"),
+    }
+    g.update(externs)
+    body = [s for s in tree.body
+            if not isinstance(s, (ast.Import, ast.ImportFrom))]
+    mod = ast.Module(body=body, type_ignores=[])
+    # Compile with lazy annotations (the stripped `from __future__
+    # import annotations`) so signature hints never evaluate.
+    code = compile(mod, path, "exec", _future.annotations.compiler_flag)
+    exec(code, g)  # noqa: S102 — repo-source only, under the shim domain
+    return g
+
+
+def read_contracts(path: str) -> Dict[str, dict]:
+    """The module's FDCERT_CONTRACTS literal, parsed without import."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "FDCERT_CONTRACTS":
+                    return ast.literal_eval(node.value)
+    return {}
+
+
+def _extract_sub(root: str) -> int:
+    """sha512_pallas.SUB parsed from source (the fold-layout height the
+    frontend kernels inherit); never imported."""
+    path = os.path.join(root, "firedancer_tpu/ops/sha512_pallas.py")
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "SUB":
+                    return int(ast.literal_eval(node.value))
+    raise CertError(RULE_UNPROVABLE, "sha512_pallas.SUB not found")
+
+
+def _make_input(spec: str, sub: int):
+    kind, _, rest = spec.partition(":")
+    if kind == "limbs":
+        rows_s, _, bound_s = rest.partition(":")
+        rows, bound = int(rows_s), int(bound_s)
+        lo = np.full((rows, 1), -bound, object)
+        hi = np.full((rows, 1), bound, object)
+        return Abs(lo, hi, "int32")
+    if kind == "bytes":
+        cols = int(rest)
+        return Abs(np.zeros((1, cols), object),
+                   np.full((1, cols), 255, object), "uint8")
+    if kind == "bytes2":
+        rows_s, _, cols_s = rest.partition(":")
+        rows, cols = int(rows_s), int(cols_s)
+        return Abs(np.zeros((rows, cols), object),
+                   np.full((rows, cols), 255, object), "uint8")
+    if kind == "blocks":
+        n_s, _, bound_s = rest.partition(":")
+        n, bound = int(n_s), int(bound_s)
+        return Abs(np.zeros((n * sub, 1), object),
+                   np.full((n * sub, 1), bound, object), "int32")
+    if kind == "digest_state":
+        word = lambda: Abs(np.zeros((sub, 1), object),  # noqa: E731
+                           np.full((sub, 1), (1 << 32) - 1, object),
+                           "uint32")
+        return [(word(), word()) for _ in range(8)]
+    if kind == "int":
+        return int(rest)
+    raise CertError(RULE_UNPROVABLE, f"unknown input spec {spec!r}")
+
+
+def _result_max_abs(res) -> int:
+    if isinstance(res, Abs):
+        return res.max_abs()
+    if isinstance(res, (tuple, list)):
+        return max((_result_max_abs(r) for r in res), default=0)
+    return 0
+
+
+def _fault_line(path: str) -> int:
+    """Deepest traceback line inside the certified module — the real
+    source location of the op that escaped its lane."""
+    import sys
+
+    tb = sys.exc_info()[2]
+    line = 0
+    while tb is not None:
+        if tb.tb_frame.f_code.co_filename == path:
+            line = tb.tb_lineno
+        tb = tb.tb_next
+    return line
+
+
+# --------------------------------------------------------------------------
+# Driver.
+# --------------------------------------------------------------------------
+
+
+def certify_module(
+    path: str, externs: Dict[str, Any], *, root: Optional[str] = None,
+    sub: Optional[int] = None,
+) -> Tuple[List[Violation], Dict[str, dict], Dict[str, Any]]:
+    """Certify one module. -> (violations, per-function cert entries,
+    the extracted namespace for downstream externs)."""
+    global _CTX
+    root = root or repo_root()
+    sub = sub if sub is not None else _extract_sub(root)
+    rpath = rel(path, root)
+    contracts = read_contracts(path)
+    out: List[Violation] = []
+    cert: Dict[str, dict] = {}
+
+    # Certification must be environment-independent: the runtime belt
+    # (concrete-operand checks) stays off while Abs operands drive the
+    # bodies, and trace-time impl selectors take their defaults.
+    _pinned = ("FD_FE_DEBUG_BOUNDS", "FD_CANON_IMPL")
+    saved = {k: os.environ.pop(k) for k in _pinned if k in os.environ}
+    try:
+        try:
+            g = load_abstract_module(path, externs)
+        except CertError as e:
+            out.append(Violation(
+                rule=e.rule, path=rpath, line=_fault_line(path),
+                key="module-body", message=str(e)))
+            return out, cert, {}
+        except Exception as e:
+            out.append(Violation(
+                rule=RULE_UNPROVABLE, path=rpath, line=_fault_line(path),
+                key="module-body",
+                message=f"abstract module execution failed: {e!r}"))
+            return out, cert, {}
+
+        for fname in sorted(contracts):
+            spec = contracts[fname]
+            fn = g.get(fname)
+            if fn is None:
+                out.append(Violation(
+                    rule=RULE_UNPROVABLE, path=rpath, line=1, key=fname,
+                    message=f"FDCERT_CONTRACTS names `{fname}` but the "
+                            "module does not define it"))
+                continue
+            _CTX = {"max_abs_int32": 0, "max_abs_f32": 0, "ops": 0}
+            try:
+                inputs = [_make_input(s, sub) for s in spec["inputs"]]
+                res = fn(*inputs)
+            except CertError as e:
+                out.append(Violation(
+                    rule=e.rule, path=rpath, line=_fault_line(path),
+                    key=fname,
+                    message=f"`{fname}` ({spec['inputs']}): {e}"))
+                _CTX = None
+                continue
+            except Exception as e:
+                out.append(Violation(
+                    rule=RULE_UNPROVABLE, path=rpath,
+                    line=_fault_line(path), key=fname,
+                    message=f"`{fname}`: abstract execution failed: "
+                            f"{e!r}"))
+                _CTX = None
+                continue
+            stats, _CTX = _CTX, None
+            proved = _result_max_abs(res)
+            entry = {
+                "inputs": list(spec["inputs"]),
+                "out_abs": spec.get("out_abs"),
+                "proved_out_abs": proved,
+                "max_abs_int32": stats["max_abs_int32"],
+                "max_abs_f32": stats["max_abs_f32"],
+                "ops_checked": stats["ops"],
+            }
+            if spec.get("doc"):
+                entry["doc"] = spec["doc"]
+            cert[fname] = entry
+            declared = spec.get("out_abs")
+            if declared is not None and proved > declared:
+                out.append(Violation(
+                    rule=RULE_CONTRACT, path=rpath, line=1, key=fname,
+                    message=f"`{fname}` proves output |limb| <= {proved} "
+                            f"but declares <= {declared} — the contract "
+                            "no longer holds; widen it deliberately or "
+                            "fix the kernel"))
+        return out, cert, g
+    finally:
+        os.environ.update(saved)
+        _CTX = None
+
+
+def _stub(name):
+    return _unprovable_fn(name)
+
+
+def _default_externs(root: str, done: Dict[str, dict]) -> Dict[str, dict]:
+    """Cross-module names each certified module needs, built from the
+    already-extracted namespaces (dependency order of CERT_MODULES)."""
+    from firedancer_tpu import flags as real_flags  # stdlib-only
+
+    fe_ns = done.get("firedancer_tpu/ops/fe25519.py")
+    sc_ns = done.get("firedancer_tpu/ops/sc25519.py")
+    ext: Dict[str, Dict[str, Any]] = {
+        "firedancer_tpu/ops/fe25519.py": {},
+        "firedancer_tpu/ops/sc25519.py": {
+            "fe25519": SimpleNamespace(**fe_ns) if fe_ns else
+            _stub("fe25519"),
+        },
+        "firedancer_tpu/ops/frontend_pallas.py": {
+            "sc": SimpleNamespace(**sc_ns) if sc_ns else _stub("sc"),
+            "flags": real_flags,
+            "SUB": _extract_sub(root),
+            "VMEM_BUDGET": 64 * 1024 * 1024,
+            "sha512_batch_auto": _stub("sha512_batch_auto"),
+            "_sc_muladd": _stub("_sc_muladd"),
+            "_pack_schedule": _stub("_pack_schedule"),
+            "_sha512_rounds": _stub("_sha512_rounds"),
+            "_vmem_estimate": _stub("_vmem_estimate"),
+        },
+    }
+    return ext
+
+
+def certify_all(
+    root: Optional[str] = None, modules: Sequence[str] = CERT_MODULES
+) -> Tuple[List[Violation], Dict[str, Any]]:
+    """Certify every declared module. -> (violations, certificate)."""
+    root = root or repo_root()
+    out: List[Violation] = []
+    cert_modules: Dict[str, dict] = {}
+    done: Dict[str, dict] = {}
+    present = [m for m in CERT_MODULES
+               if m in modules and os.path.exists(os.path.join(root, m))]
+    if not present:
+        return out, {"version": 1, "modules": {}}
+    sub = _extract_sub(root)
+    for rmod in present:  # dependency order is fixed
+        path = os.path.join(root, rmod)
+        externs = _default_externs(root, done).get(rmod, {})
+        vs, cert, ns = certify_module(path, externs, root=root, sub=sub)
+        out.extend(vs)
+        cert_modules[rmod] = cert
+        done[rmod] = ns
+    certificate = {
+        "version": 1,
+        "generated_by": "scripts/fdlint.py --dump-cert",
+        "lane_limits": {
+            "int32": [INT32_MIN, INT32_MAX],
+            "f32_exact_window": F32_WINDOW,
+        },
+        "modules": cert_modules,
+    }
+    return out, certificate
+
+
+def check_repo(
+    root: Optional[str] = None,
+    py_paths: Optional[Sequence[str]] = None,
+) -> List[Violation]:
+    """The run_all entry point. When py_paths is given (a partial scan,
+    e.g. --changed), only certified modules among them re-prove; a full
+    scan proves everything."""
+    root = root or repo_root()
+    if py_paths is None:
+        mods: Sequence[str] = CERT_MODULES
+    else:
+        scanned = {rel(p, root) for p in py_paths}
+        touched = [i for i, m in enumerate(CERT_MODULES) if m in scanned]
+        if not touched:
+            return []
+        # Dependency closure: CERT_MODULES is a chain — later modules
+        # exec against the extracted namespaces of earlier ones
+        # (fe25519 -> sc25519 -> frontend_pallas), so a touched later
+        # module re-proves the whole prefix (a --changed scan of only
+        # frontend_pallas.py otherwise execs against stubs and
+        # false-fails as bounds-unprovable).
+        mods = CERT_MODULES[: max(touched) + 1]
+    vs, _cert = certify_all(root, modules=mods)
+    return vs
+
+
+def check_file(path: str, *, root: Optional[str] = None,
+               externs: Optional[Dict[str, Any]] = None,
+               sub: int = SUB) -> List[Violation]:
+    """Certify one file in isolation (fixtures/mutation tests)."""
+    vs, _cert, _ns = certify_module(
+        path, externs or {}, root=root, sub=sub)
+    return vs
+
+
+def dump_certificate(root: Optional[str] = None) -> str:
+    """lint_bounds_cert.json body (deterministic; test-pinned)."""
+    import json
+
+    vs, cert = certify_all(root)
+    if vs:
+        lines = "\n".join(v.format() for v in vs)
+        raise SystemExit(
+            f"fdcert: refusing to emit a certificate with open "
+            f"violations:\n{lines}"
+        )
+    return json.dumps(cert, indent=2, sort_keys=True) + "\n"

@@ -1,0 +1,510 @@
+"""Pass 6 — fdcert ownership: single-writer / thread-discipline checks
+for the concurrency surface.
+
+The runtime already RELIES on a concurrency discipline nothing checks:
+every fd_flight registry row has exactly one writer (the shared-memory
+counters are delta-accumulated without atomics on that assumption),
+each cnc/fseq diag slot has one owning module (supervised verify_stats
+read CNC_DIAG_RESTARTS assuming only the supervisor ever writes it),
+every thread reading mapped workspace rows must be accounted for in the
+runner's wksp.leave() guard (a straggler poll into an unmapped row is a
+segfault, not an exception), and cross-thread mutable state in the
+feed/sentinel/supervisor runtime is supposed to flow through a blessed
+channel (registry row, ring, Queue, Event, or a declared single-writer
+mailbox). fdlint's PR-2 passes never look at any of it.
+
+This pass makes the discipline a machine-checked contract, flags.py
+style: the tables below declare it ONCE (and render into
+docs/OWNERSHIP.md via ``scripts/fdlint.py --dump-ownership``), and the
+AST scan flags drift:
+
+  own-thread-unregistered   a threading.Thread / ThreadPoolExecutor
+                            creation site not in THREAD_TABLE — every
+                            thread must state its stop condition and
+                            how the leave-guard accounts for it
+  own-thread-stale          a THREAD_TABLE entry matching no site
+                            (burn-down semantics; full scans only)
+  own-double-writer         a diag-slot / registry write from a module
+                            the ownership table does not name as the
+                            resource's writer
+  own-unblessed-share       a thread-entry closure stores to object
+                            state not declared in SHARED_STATE (the
+                            blessed-channel table)
+
+Site keys are structural (enclosing scope + target name), never line
+numbers. Inline waivers use the shared fdlint grammar.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .common import Violation, dotted as _dotted, rel, suppressed
+
+RULE_THREAD = "own-thread-unregistered"
+RULE_THREAD_STALE = "own-thread-stale"
+RULE_WRITER = "own-double-writer"
+RULE_SHARE = "own-unblessed-share"
+
+
+# --------------------------------------------------------------------------
+# The typed ownership tables — the single statement of the concurrency
+# discipline (rendered into docs/OWNERSHIP.md; test-pinned).
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ThreadSite:
+    """One registered thread/executor creation site."""
+
+    module: str        # repo-relative path
+    key: str           # "<Enclosing scope>:<target name>" structural key
+    purpose: str
+    lifecycle: str     # how the thread stops
+    leave_guard: str   # how wksp.leave() is kept safe from it
+
+
+THREAD_TABLE: Tuple[ThreadSite, ...] = (
+    ThreadSite(
+        "firedancer_tpu/disco/pipeline.py", "_run_tiles:t.run",
+        "one thread per tile in the in-process runner",
+        "runs until CNC_HALT; joined with a deadline after the signal",
+        "wksp.leave() only when every tile thread is provably dead "
+        "(all(not th.is_alive()) gate)",
+    ),
+    ThreadSite(
+        "firedancer_tpu/disco/pipeline.py", "pre_wait:client_fn",
+        "QUIC test-client driver for run_pipeline_quic",
+        "joined via the post_wait hook after quiescence",
+        "touches sockets only, never workspace rows",
+    ),
+    ThreadSite(
+        "firedancer_tpu/disco/sentinel.py", "Sentinel.start:loop",
+        "fd_sentinel SLO poller over the flight registry rows",
+        "Event-stopped + joined in stop(); one final pass after join",
+        "alive() is part of every runner's leave-guard condition (a "
+        "descheduled poll still holds views over mapped rows)",
+    ),
+    ThreadSite(
+        "firedancer_tpu/disco/feed/runtime.py", "run_feed_pipeline:t.run",
+        "one thread per tile in the fd_feed runner",
+        "runs until CNC_HALT; joined with a deadline after the signal",
+        "wksp.leave() only when every tile thread is dead and the "
+        "sentinel poller reports not alive()",
+    ),
+    ThreadSite(
+        "firedancer_tpu/disco/worker.py", "main:_boot_beat",
+        "boot-phase heartbeat through long tile constructions",
+        "Event-stopped + joined in the finally around build_tile",
+        "process-lifetime workspace mapping (worker never leaves)",
+    ),
+    ThreadSite(
+        "firedancer_tpu/disco/worker.py", "main:_guarded",
+        "per-tile threads of a multi-tile worker process",
+        "run until CNC_HALT; joined before the worker exits",
+        "process-lifetime workspace mapping (worker never leaves)",
+    ),
+    ThreadSite(
+        "firedancer_tpu/disco/tiles.py",
+        "VerifyTile._with_live_heartbeat:beat",
+        "heartbeat keeper across a blocking host-side hold",
+        "Event-stopped + joined in the finally",
+        "writes only through the tile's own cnc handle; joined before "
+        "the hold returns to the run loop",
+    ),
+    ThreadSite(
+        "firedancer_tpu/disco/tiles.py", "VerifyTile._feed_start:_guarded",
+        "fd_feed stager: drains the in ring into staging slots",
+        "Event-stopped at tile halt; crash-restarted with backoff by "
+        "_stager_supervise (FD_FEED_STAGER_RESTART_MAX budget)",
+        "owned by the verify tile thread, which the runner joins "
+        "before leaving; errors hand off via the _feed_stager_err "
+        "mailbox (SHARED_STATE)",
+    ),
+    ThreadSite(
+        "firedancer_tpu/disco/tiles.py",
+        "VerifyTile._feed_setup:ThreadPoolExecutor",
+        "GIL-releasing CPU verify executor (FD_FEED_VERIFY_THREADS)",
+        "shutdown with the tile at halt; futures drained by _complete",
+        "workers touch preallocated numpy sidecars, never workspace "
+        "rows directly",
+    ),
+    ThreadSite(
+        "firedancer_tpu/utils/tpool.py", "TPool.__init__:self._worker",
+        "spin-style fork-join pool for host-parallel byte work",
+        "halt flag + go Events; process-lifetime daemon workers",
+        "operates on caller-passed arrays only, never workspace rows",
+    ),
+    ThreadSite(
+        "microbench.py", "bench_ring_pipeline_hop:replay.run",
+        "replay tile driving the ring-hop microbench",
+        "runs until CNC_HALT; the bench signals and joins it",
+        "bench-local workspace, left only after the join",
+    ),
+)
+
+# Resource -> allowed writer modules. Keys are the diag-slot constant
+# names (cnc + fseq ABI slots) as they appear at .diag_add() call
+# sites, plus the flight writer-acquisition APIs and the sentinel's
+# SLO-row slot constants. "<dynamic>" covers computed slot indices
+# (the fd_feed gauge mirror loop) — allowed only where declared.
+WRITER_TABLE: Dict[str, Tuple[str, ...]] = {
+    # Supervisor-owned respawn accounting: supervised verify_stats and
+    # the monitor read these assuming the supervisor is the ONE writer.
+    "CNC_DIAG_RESTARTS": ("firedancer_tpu/disco/supervisor.py",),
+    "CNC_DIAG_BACKOFF_MS": ("firedancer_tpu/disco/supervisor.py",),
+    # Tile-owned cnc gauges (each tile writes its OWN cnc; quic shares
+    # the sigverify-filter semantics with the verify tile).
+    "CNC_DIAG_IN_BACKP": ("firedancer_tpu/disco/tiles.py",),
+    "CNC_DIAG_BACKP_CNT": ("firedancer_tpu/disco/tiles.py",
+                           "firedancer_tpu/disco/quic_tile.py"),
+    "CNC_DIAG_HA_FILT_CNT": ("firedancer_tpu/disco/tiles.py",),
+    "CNC_DIAG_HA_FILT_SZ": ("firedancer_tpu/disco/tiles.py",),
+    "CNC_DIAG_SV_FILT_CNT": ("firedancer_tpu/disco/tiles.py",
+                             "firedancer_tpu/disco/quic_tile.py"),
+    "CNC_DIAG_SV_FILT_SZ": ("firedancer_tpu/disco/tiles.py",
+                            "firedancer_tpu/disco/quic_tile.py"),
+    "CNC_DIAG_UNACKED": ("firedancer_tpu/disco/tiles.py",),
+    "CNC_DIAG_HOLDS": ("firedancer_tpu/disco/tiles.py",),
+    "<dynamic>": ("firedancer_tpu/disco/tiles.py",),
+    # fseq diag slots (consumer-side flow accounting, fd_fseq.h ABI).
+    "DIAG_PUB_CNT": ("firedancer_tpu/disco/tiles.py",),
+    "DIAG_PUB_SZ": ("firedancer_tpu/disco/tiles.py",),
+    "DIAG_FILT_CNT": ("firedancer_tpu/disco/tiles.py",),
+    "DIAG_FILT_SZ": ("firedancer_tpu/disco/tiles.py",),
+    "DIAG_OVRNR_CNT": ("firedancer_tpu/disco/tiles.py",),
+    "DIAG_SLOW_CNT": ("firedancer_tpu/tango/fctl.py",),
+    # fd_flight registry acquisition: tile metric rows belong to the
+    # owning tile; regions are created once by build_topology.
+    "flight.tile_lane": ("firedancer_tpu/disco/tiles.py",),
+    "flight.create_regions": ("firedancer_tpu/disco/pipeline.py",),
+    # fd_sentinel SLO rows: one sentinel per run, in the runner
+    # process, is the single writer.
+    "SLO_EVALS": ("firedancer_tpu/disco/sentinel.py",),
+    "SLO_ALERTS": ("firedancer_tpu/disco/sentinel.py",),
+    "SLO_BREACH_POLLS": ("firedancer_tpu/disco/sentinel.py",),
+    "SLO_BURN_MILLI": ("firedancer_tpu/disco/sentinel.py",),
+    "SLO_STATE": ("firedancer_tpu/disco/sentinel.py",),
+}
+
+
+@dataclass(frozen=True)
+class SharedState:
+    """One blessed cross-thread mutable attribute: state a thread-entry
+    closure stores to, with the channel discipline that makes it safe."""
+
+    module: str
+    attr: str
+    channel: str   # mailbox | barrier-slot | lock | queue | event | ...
+    doc: str
+
+
+SHARED_STATE: Tuple[SharedState, ...] = (
+    SharedState(
+        "firedancer_tpu/disco/tiles.py", "_feed_stager_err", "mailbox",
+        "stager-death handoff: the stager closure writes the exception "
+        "exactly once per incarnation, the dispatcher consumes-and-"
+        "clears it in _stager_supervise before any restart (write-once "
+        "then cleared; both sides tolerate one-poll staleness)",
+    ),
+    SharedState(
+        "firedancer_tpu/utils/tpool.py", "_errors", "barrier-slot",
+        "per-worker error slot: worker i writes only index i between "
+        "its go/done Events, the caller reads only after the join "
+        "barrier — single writer per slot by construction",
+    ),
+)
+
+
+# --------------------------------------------------------------------------
+# AST scan.
+# --------------------------------------------------------------------------
+
+_THREAD_LEAVES = {"Thread", "ThreadPoolExecutor"}
+_DIAG_CALL_LEAVES = {"diag_add"}
+
+
+def _scope_key(stack: List[str]) -> str:
+    return ".".join(stack) if stack else "<module>"
+
+
+def _target_name(call: ast.Call) -> str:
+    for kw in call.keywords:
+        if kw.arg == "target":
+            return _dotted(kw.value) or "<expr>"
+    return ""
+
+
+class _Scanner(ast.NodeVisitor):
+    def __init__(self, rpath: str, src_lines: List[str],
+                 thread_keys: Set[Tuple[str, str]],
+                 writer_table: Dict[str, Tuple[str, ...]],
+                 shared: Dict[Tuple[str, str], SharedState]):
+        self.rpath = rpath
+        self.src_lines = src_lines
+        self.thread_keys = thread_keys
+        self.writer_table = writer_table
+        self.shared = shared
+        self.scope: List[str] = []
+        self.violations: List[Violation] = []
+        self.found_sites: Set[Tuple[str, str]] = set()
+        # name -> FunctionDef for thread-target resolution: methods are
+        # qualified per class, nested defs by bare name (the creation
+        # site and the def share the enclosing function).
+        self.defs: Dict[str, ast.FunctionDef] = {}
+        self.class_stack: List[str] = []
+
+    # -- plumbing --------------------------------------------------------
+
+    def _flag(self, rule: str, node: ast.AST, key: str, msg: str) -> None:
+        if suppressed(self.src_lines, node.lineno, rule):
+            return
+        self.violations.append(Violation(
+            rule=rule, path=self.rpath, line=node.lineno, key=key,
+            message=msg))
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.scope.append(node.name)
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+        self.scope.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        qual = (f"{self.class_stack[-1]}.{node.name}"
+                if self.class_stack else node.name)
+        self.defs.setdefault(qual, node)
+        self.defs.setdefault(node.name, node)
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    # -- rule 1: thread registration -------------------------------------
+
+    def _scope_for_key(self) -> str:
+        # "Class.method" when directly inside a class, else the two
+        # innermost function scopes collapse to the innermost def name
+        # prefixed by its class if any — matches THREAD_TABLE keys.
+        parts = [s for s in self.scope]
+        if not parts:
+            return "<module>"
+        if len(parts) >= 2 and parts[-2][0].isupper():
+            return f"{parts[-2]}.{parts[-1]}"
+        return parts[-1]
+
+    def visit_Call(self, node: ast.Call) -> None:
+        root = _dotted(node.func) or ""
+        leaf = root.split(".")[-1]
+        if leaf in _THREAD_LEAVES:
+            target = _target_name(node)
+            key = f"{self._scope_for_key()}:{target or leaf}"
+            site = (self.rpath, key)
+            self.found_sites.add(site)
+            if site not in self.thread_keys:
+                self._flag(
+                    RULE_THREAD, node, key,
+                    f"thread creation site `{key}` is not in the "
+                    "ownership THREAD_TABLE (lint/ownership.py) — "
+                    "declare its stop condition and how the workspace "
+                    "leave-guard accounts for it",
+                )
+            if target:
+                self._check_thread_target(target)
+        elif leaf in _DIAG_CALL_LEAVES and node.args:
+            self._check_diag_writer(node)
+        elif leaf in ("tile_lane", "create_regions") and root.startswith(
+                "flight."):
+            self._check_resource(node, f"flight.{leaf}")
+        self.generic_visit(node)
+
+    def _check_resource(self, node: ast.AST, resource: str) -> None:
+        owners = self.writer_table.get(resource)
+        if owners is None:
+            self._flag(
+                RULE_WRITER, node, resource,
+                f"write/acquisition of undeclared resource `{resource}` "
+                "— add it to the ownership WRITER_TABLE with its owner",
+            )
+        elif self.rpath not in owners:
+            self._flag(
+                RULE_WRITER, node, resource,
+                f"`{resource}` is owned by {', '.join(owners)} — a "
+                f"second writer module breaks the single-writer "
+                "discipline the readers rely on",
+            )
+
+    def _check_diag_writer(self, node: ast.Call) -> None:
+        arg = node.args[0]
+        name = _dotted(arg)
+        if name is not None:
+            leaf = name.split(".")[-1]
+            if leaf.startswith(("CNC_DIAG_", "DIAG_")):
+                self._check_resource(node, leaf)
+                return
+            self._check_resource(node, "<dynamic>")
+        elif not isinstance(arg, ast.Constant):
+            self._check_resource(node, "<dynamic>")
+        # Literal ints: fixtures/tests poking raw slots — covered by
+        # the constant-name discipline at real call sites.
+
+    # -- rule 3: blessed channels in thread-entry closures ---------------
+
+    def _check_thread_target(self, target: str) -> None:
+        fn = None
+        if target.startswith("self."):
+            cls = self.class_stack[-1] if self.class_stack else None
+            if cls:
+                fn = self.defs.get(f"{cls}.{target[5:]}")
+        elif "." not in target:
+            fn = self.defs.get(target)
+        if fn is None:
+            return  # cross-object target (t.run): owned elsewhere
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (stmt.targets
+                           if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    self._check_store_target(t, fn.name)
+            elif isinstance(stmt, (ast.Global, ast.Nonlocal)):
+                for nm in stmt.names:
+                    if (self.rpath, nm) not in self.shared:
+                        self._flag(
+                            RULE_SHARE, stmt, f"{fn.name}:{nm}",
+                            f"thread-entry `{fn.name}` rebinds "
+                            f"`{nm}` across the thread boundary — "
+                            "route it through a blessed channel or "
+                            "declare it in SHARED_STATE",
+                        )
+
+    def _check_store_target(self, t: ast.AST, fn_name: str) -> None:
+        # x.attr = ... and x.attr[i] = ... are cross-thread stores when
+        # they escape the closure; locals are fine.
+        attr: Optional[str] = None
+        node = t
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Attribute):
+            attr = node.attr
+        if attr is None:
+            return
+        if (self.rpath, attr) in self.shared:
+            return
+        self._flag(
+            RULE_SHARE, t, f"{fn_name}:{attr}",
+            f"thread-entry `{fn_name}` stores to `.{attr}`, which is "
+            "not a blessed cross-thread channel — use a registry row / "
+            "ring / Queue / Event, or declare the single-writer "
+            "discipline in SHARED_STATE (lint/ownership.py)",
+        )
+
+
+class Scan:
+    """One ownership scan across a file set; collects thread sites so a
+    full scan can report stale THREAD_TABLE entries (burn-down)."""
+
+    def __init__(self, thread_table: Sequence[ThreadSite] = THREAD_TABLE,
+                 writer_table: Optional[Dict[str, Tuple[str, ...]]] = None,
+                 shared_state: Sequence[SharedState] = SHARED_STATE):
+        self.thread_table = tuple(thread_table)
+        self.thread_keys = {(s.module, s.key) for s in self.thread_table}
+        self.writer_table = (WRITER_TABLE if writer_table is None
+                             else writer_table)
+        self.shared = {(s.module, s.attr): s for s in shared_state}
+        self.found_sites: Set[Tuple[str, str]] = set()
+        self.scanned: Set[str] = set()
+
+    def check_source(self, src: str, path: str, *,
+                     root: Optional[str] = None) -> List[Violation]:
+        rpath = rel(path, root)
+        self.scanned.add(rpath)
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            return []  # trace_safety already reports parse errors
+        sc = _Scanner(rpath, src.splitlines(), self.thread_keys,
+                      self.writer_table, self.shared)
+        sc.visit(tree)
+        self.found_sites |= sc.found_sites
+        return sc.violations
+
+    def stale_entries(self) -> List[Violation]:
+        """Table entries whose site no longer exists — only meaningful
+        after a scan that covered the entry's module."""
+        out = []
+        for site in self.thread_table:
+            if site.module not in self.scanned:
+                continue
+            if (site.module, site.key) not in self.found_sites:
+                out.append(Violation(
+                    rule=RULE_THREAD_STALE, path=site.module, line=1,
+                    key=site.key,
+                    message=f"THREAD_TABLE entry `{site.key}` matches no "
+                            "creation site — the thread is gone; delete "
+                            "the entry (the table only burns down)"))
+        return out
+
+
+def check_file(path: str, *, root: Optional[str] = None,
+               scan: Optional[Scan] = None) -> List[Violation]:
+    """Single-file convenience (fixtures/tests)."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return (scan or Scan()).check_source(src, path, root=root)
+
+
+# --------------------------------------------------------------------------
+# Docs rendering (docs/OWNERSHIP.md; test-pinned like FLAGS.md/SLO.md).
+# --------------------------------------------------------------------------
+
+
+def dump_markdown() -> str:
+    lines = [
+        "# Concurrency ownership tables",
+        "",
+        "Generated from the typed tables in `firedancer_tpu/lint/"
+        "ownership.py` by",
+        "`python scripts/fdlint.py --dump-ownership > docs/OWNERSHIP.md`.",
+        "Do not edit by hand; edit the tables and regenerate.",
+        "",
+        "fdlint pass 6 enforces these: an undeclared thread creation "
+        "site, a",
+        "second writer module for a declared resource, or a thread-entry",
+        "closure storing to undeclared shared state fails the CI lane.",
+        "",
+        "## Registered threads (the workspace leave-guard ledger)",
+        "",
+        "| Module | Site | Purpose | Stops | Leave-guard accounting |",
+        "|---|---|---|---|---|",
+    ]
+    for s in THREAD_TABLE:
+        lines.append(
+            f"| `{s.module}` | `{s.key}` | {s.purpose} | {s.lifecycle} "
+            f"| {s.leave_guard} |")
+    lines += [
+        "",
+        "## Single-writer resources",
+        "",
+        "| Resource | Owning module(s) |",
+        "|---|---|",
+    ]
+    for res in sorted(WRITER_TABLE):
+        owners = ", ".join(f"`{m}`" for m in WRITER_TABLE[res])
+        lines.append(f"| `{res}` | {owners} |")
+    lines += [
+        "",
+        "## Blessed cross-thread state (beyond registry rows / rings / "
+        "Queue / Event)",
+        "",
+        "| Module | Attribute | Channel | Discipline |",
+        "|---|---|---|---|",
+    ]
+    for s in SHARED_STATE:
+        lines.append(
+            f"| `{s.module}` | `{s.attr}` | {s.channel} | {s.doc} |")
+    lines.append("")
+    return "\n".join(lines)

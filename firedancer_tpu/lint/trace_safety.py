@@ -1,7 +1,8 @@
 """Pass 1 — trace-safety: host-sync and retrace hazards inside traced code.
 
-A function body that jax traces (`@jax.jit`, `jax.jit(fn)`, or a
-pallas_call kernel) runs ONCE per compile, not once per step. Host
+A function body that jax traces (`@jax.jit`, `jax.jit(fn)`, a
+pallas_call kernel, or a `shard_map`/`pjit`-wrapped step) runs ONCE
+per compile, not once per step. Host
 work inside it is therefore one of two bugs:
 
   - host-sync hazards (`.item()`, `float()/int()/bool()` on a tracer,
@@ -48,6 +49,10 @@ _UNTAINT_CALLS = {"len", "isinstance", "issubclass", "type", "range",
                   "getattr", "hasattr", "zip", "enumerate"}
 _JIT_NAMES = {"jit"}           # bare `jit(...)` / `@jit`
 _PALLAS_CALL_NAMES = {"pallas_call"}
+# shard_map/pjit wrap a callable exactly like jit does (the body traces
+# once per compile) — the round-13 coverage-gap fix: mesh.py's sharded
+# step closures and any pjit-wrapped body now get the same hazard walk.
+_SHARD_NAMES = {"shard_map", "shard_map_nocheck", "pjit"}
 _HOST_SYNC_NP_FUNCS = {"asarray", "array", "copy"}
 
 
@@ -67,6 +72,13 @@ def _is_pallas_call(call: ast.Call) -> bool:
     if root is None:
         return False
     return root.split(".")[-1] in _PALLAS_CALL_NAMES
+
+
+def _is_shard_call(call: ast.Call) -> bool:
+    root = _call_root(call)
+    if root is None:
+        return False
+    return root.split(".")[-1] in _SHARD_NAMES
 
 
 def _fn_arg_names(call: ast.Call) -> List[str]:
@@ -106,7 +118,8 @@ def _decorated_traced(fn: ast.FunctionDef) -> bool:
 
 def _collect_traced_functions(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
     """Functions this module traces: decorated with jit, passed to
-    jit(...), or passed (possibly partial-wrapped) to pallas_call."""
+    jit(...), passed (possibly partial-wrapped) to pallas_call, or
+    wrapped by shard_map / shard_map_nocheck / pjit."""
     by_name: Dict[str, List[ast.FunctionDef]] = {}
     for node in ast.walk(tree):
         if isinstance(node, ast.FunctionDef):
@@ -118,7 +131,7 @@ def _collect_traced_functions(tree: ast.Module) -> Dict[str, ast.FunctionDef]:
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
-        if _is_jit_call(node) or _is_pallas_call(node):
+        if _is_jit_call(node) or _is_pallas_call(node) or _is_shard_call(node):
             for name in _fn_arg_names(node):
                 for fn in by_name.get(name, []):
                     traced[name] = fn

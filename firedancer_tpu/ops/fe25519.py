@@ -38,6 +38,74 @@ LIMB_BITS = 8
 NLIMBS = 32
 _MASK = (1 << LIMB_BITS) - 1
 
+# fdcert entry contracts (fdlint pass 5, firedancer_tpu/lint/bounds.py):
+# ast.literal_eval'd, never imported. Each entry drives the abstract
+# interpreter over the function at the declared input bounds and proves
+# every intermediate fits its lane (int32 no-wrap, f32 mantissa-exact
+# window) and the output fits `out_abs` — the |limb| <= 512 public-op
+# invariant that makes the f32 kernel-multiply dispatch sound. The
+# machine-readable proof lands in lint_bounds_cert.json; widening any
+# constant below (or in a body) fails the fdlint CI lane, not a TPU run.
+FDCERT_CONTRACTS = {
+    # Public-op invariant closure: invariant-bounded inputs stay
+    # invariant-bounded, so chains of public ops never need re-proof.
+    "fe_add": {"inputs": ["limbs:32:512", "limbs:32:512"], "out_abs": 512,
+               "doc": "invariant closure under one lazy carry pass"},
+    "fe_sub": {"inputs": ["limbs:32:512", "limbs:32:512"], "out_abs": 512,
+               "doc": "invariant closure (signed limbs go negative)"},
+    "fe_neg": {"inputs": ["limbs:32:512"], "out_abs": 512,
+               "doc": "invariant closure"},
+    # Kernel multiplies: the generic |limb| <= 1024 contract (any two
+    # public-op results, or their one-step sums, multiply directly).
+    "fe_mul": {"inputs": ["limbs:32:1024", "limbs:32:1024"],
+               "out_abs": 512,
+               "doc": "gather/fold schedule; conv rows < 2^31"},
+    "fe_mul_unrolled": {"inputs": ["limbs:32:1024", "limbs:32:1024"],
+                        "out_abs": 512,
+                        "doc": "Pallas-safe static-slice schedule"},
+    "fe_mul_karatsuba": {"inputs": ["limbs:32:1024", "limbs:32:1024"],
+                         "out_abs": 512,
+                         "doc": "two-level Karatsuba recombine bounds"},
+    "fe_mul_rolled": {"inputs": ["limbs:32:1024", "limbs:32:1024"],
+                      "out_abs": 512,
+                      "doc": "7-rotation aligned-window schedule"},
+    "fe_mul_factored": {"inputs": ["limbs:32:1024", "limbs:32:1024"],
+                        "out_abs": 512,
+                        "doc": "rotation-factored aligned windows"},
+    "fe_sq": {"inputs": ["limbs:32:1024"], "out_abs": 512,
+              "doc": "half-triangle regrouping of the fe_mul conv"},
+    "fe_mul_small": {"inputs": ["limbs:32:1024", "int:131071"],
+                     "out_abs": 512,
+                     "doc": "k < 2^17 scalar multiply"},
+    # The TIGHTER f32 contract (FD_MUL_IMPL=f32 dispatch at
+    # fe_mul_kernel / fe_sq_f32): |limb| <= 512 inputs, every f32
+    # partial product and sum inside the 2^24 mantissa-exact window.
+    # FD_FE_DEBUG_BOUNDS=1 is the runtime belt over this static proof.
+    "fe_mul_f32": {"inputs": ["limbs:32:512", "limbs:32:512"],
+                   "out_abs": 512,
+                   "doc": "exact-f32-product conv; window <= 2^23"},
+    "fe_sq_f32": {"inputs": ["limbs:32:512"], "out_abs": 512,
+                  "doc": "exact-f32 half-triangle; window <= 2^23"},
+    # Canonicalizers: bytes-boundary reductions. The Kogge-Stone forms
+    # end in an arithmetic lane select (keep*a + (1-keep)*b) the
+    # interval domain over-approximates to [0, 510]; digits are
+    # canonical [0, 255] at runtime (the seq twin proves the tight
+    # bound for the identical math).
+    "_canonicalize": {"inputs": ["limbs:32:1024"], "out_abs": 255,
+                      "doc": "sequential ripple + cond-subtract p"},
+    "_canonicalize_k_seq": {"inputs": ["limbs:32:16777216"],
+                            "out_abs": 765,
+                            "doc": "kernel-safe ripple form (2^24 in)"},
+    "_canonicalize_k": {"inputs": ["limbs:32:16777216"], "out_abs": 803,
+                        "doc": "Kogge-Stone form (2^24 in)"},
+    "fe_is_zero_k": {"inputs": ["limbs:32:16777216"], "out_abs": 1,
+                     "doc": "canonical-zero mask"},
+    "fe_parity_k": {"inputs": ["limbs:32:16777216"], "out_abs": 1,
+                    "doc": "canonical parity bit"},
+    "fe_from_bytes": {"inputs": ["bytes2:1:32"], "out_abs": 255,
+                      "doc": "byte unpack (+ high-bit mask)"},
+}
+
 # d = -121665/121666 mod p (twisted Edwards constant), sqrt(-1) mod p.
 D_INT = (-121665 * pow(121666, P - 2, P)) % P
 SQRT_M1_INT = pow(2, (P - 1) // 4, P)

@@ -67,6 +67,28 @@ from .sha512_pallas import (
 _MU_W = [(sc._MU >> (8 * i)) & 0xFF for i in range(33)]
 _L_W = [(sc.L >> (8 * i)) & 0xFF for i in range(33)]
 
+# fdcert entry contracts (fdlint pass 5 — grammar in ops/fe25519.py).
+# These are the folded-layout Barrett/schoolbook mirrors of
+# sc25519.sc_reduce64 / sign._sc_muladd; the certifier re-proves them
+# independently so a divergence that widens an intermediate fails CI
+# even if the bit-exact parity tests are skipped. The final
+# conditional-subtract lane select is arithmetic (keep*r + (1-keep)*d),
+# which the interval domain over-approximates to [0, 765]; runtime
+# digits are canonical [0, 255]. _mul_mod_l_f is certified at the
+# wider [0, 765] input so the kernel composition h = _barrett_f(...)
+# -> _mul_mod_l_f(z, h) is covered by the proof chain.
+FDCERT_CONTRACTS = {
+    "_carry_f": {"inputs": ["blocks:64:255"], "out_abs": 255,
+                 "doc": "exact folded base-256 carry"},
+    "_barrett_f": {"inputs": ["blocks:64:255"], "out_abs": 765,
+                   "doc": "folded Barrett mod L; conv rows < 2^21"},
+    "_mul_mod_l_f": {"inputs": ["blocks:32:765", "blocks:32:765"],
+                     "out_abs": 765,
+                     "doc": "folded schoolbook mul mod L"},
+    "_digest_limbs": {"inputs": ["digest_state"], "out_abs": 255,
+                      "doc": "uint32 state -> byte limbs, shifts only"},
+}
+
 
 def frontend_impl() -> str:
     """Trace-time front-end engine: 'pallas' (the fused VMEM kernels),

@@ -25,6 +25,20 @@ from . import fe25519
 L = 2**252 + 27742317777372353535851937790883648493
 _MU = (2**512) // L  # 259-bit
 
+# fdcert entry contracts (fdlint pass 5 — see ops/fe25519.py's table
+# for the grammar). The Barrett body is the CPU/test reference the
+# fused front-end mirrors bit-exactly, so its proof is the anchor for
+# frontend_pallas's folded twin.
+FDCERT_CONTRACTS = {
+    "sc_reduce64": {"inputs": ["bytes:64"], "out_abs": 255,
+                    "doc": "Barrett b=2^8 k=32; q2 rows < 2^21"},
+    "sc_sum": {"inputs": ["bytes2:32768:32"], "out_abs": 255,
+               "doc": "batch scalar sum at the max shipping batch "
+                      "(32768 lanes; limb sums < 2^23)"},
+    "sc_check_range": {"inputs": ["bytes2:1:32"], "out_abs": 1,
+                       "doc": "lexicographic s < L compare"},
+}
+
 _L_LIMBS33 = jnp.asarray(
     [(L >> (8 * i)) & 0xFF for i in range(33)], jnp.int32
 ).reshape(33, 1)

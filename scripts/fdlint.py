@@ -3,16 +3,35 @@
 
 Usage:
   python scripts/fdlint.py --check [paths...]
-      Run all four passes (trace-safety, flag-registry, boundary
-      contracts, native atomics) over the default scan scope (or the
-      given paths), resolve against lint_baseline.json, print new
-      violations, exit nonzero if any. Stale baseline entries (debt
-      that got fixed) are reported and also fail the gate — the
-      baseline only ever burns down, never silently over-approves.
+      Run all six passes (trace-safety, flag-registry, boundary
+      contracts, native atomics, fdcert bounds, fdcert ownership) over
+      the default scan scope (or the given paths), resolve against
+      lint_baseline.json, print new violations, exit nonzero if any.
+      Stale baseline entries (debt that got fixed) are reported and
+      also fail the gate — the baseline only ever burns down, never
+      silently over-approves.
+
+  python scripts/fdlint.py --check --changed
+      Lint only the files `git diff --name-only HEAD` reports as
+      touched (plus untracked files) — the fast inner-loop/pre-commit
+      mode. Certified crypto modules re-prove only when touched;
+      whole-tree-only checks (stale entries, registry docs) are
+      skipped, so the full gate still runs in CI. See docs/LINT.md for
+      the pre-commit recipe.
 
   python scripts/fdlint.py --dump-flags
       Print docs/FLAGS.md generated from the typed FD_* registry
       (firedancer_tpu/flags.py).
+
+  python scripts/fdlint.py --dump-cert
+      Print lint_bounds_cert.json — the fdcert machine-readable bounds
+      certificate (per-function proven output bound + worst
+      intermediate magnitudes). Refuses if any proof is open. CI pins
+      the committed file against this output.
+
+  python scripts/fdlint.py --dump-ownership
+      Print docs/OWNERSHIP.md generated from the typed concurrency
+      ownership tables (firedancer_tpu/lint/ownership.py).
 
   python scripts/fdlint.py --write-baseline
       Rewrite lint_baseline.json from the current violations (each
@@ -21,14 +40,15 @@ Usage:
 Inline waiver: `# fdlint: ignore[<rule>]` (py) or
 `// fdlint: ignore[<rule>]` (native) on the flagged line.
 
-Pure stdlib + the repo's own firedancer_tpu.lint/flags modules — no
-jax import, so the lane runs in milliseconds before anything builds.
+Pure stdlib + numpy + the repo's own firedancer_tpu.lint/flags modules
+— no jax import, so the lane runs in seconds before anything builds.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 
 sys.path.insert(
@@ -42,12 +62,57 @@ from firedancer_tpu.lint import (  # noqa: E402
 from firedancer_tpu.lint.common import repo_root  # noqa: E402
 
 
+def _in_scan_scope(rpath: str) -> bool:
+    """Whether a repo-relative path is inside fdlint's default scope —
+    --changed must never widen the scope the full gate uses (tests/
+    and the violation-by-design fixtures live OUTSIDE it)."""
+    from firedancer_tpu.lint import NATIVE_ROOTS, PY_ROOTS
+    from firedancer_tpu.lint.common import SKIP_DIRS
+
+    parts = rpath.split("/")
+    if any(seg in SKIP_DIRS for seg in parts[:-1]):
+        return False
+    for scope_root in (*PY_ROOTS, *NATIVE_ROOTS):
+        if rpath == scope_root or rpath.startswith(scope_root + "/"):
+            return True
+    return False
+
+
+def _changed_paths(root: str) -> list:
+    """Repo-relative files touched vs HEAD (staged + unstaged +
+    untracked), filtered to the default scan scope — the pre-commit
+    scan set. Deleted files drop out."""
+    out = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        p = subprocess.run(cmd, capture_output=True, text=True, cwd=root,
+                           timeout=60)
+        if p.returncode != 0:
+            raise SystemExit(
+                f"fdlint --changed: {' '.join(cmd)} failed: {p.stderr}")
+        out.update(ln.strip() for ln in p.stdout.splitlines() if ln.strip())
+    return sorted(
+        p for p in out
+        if os.path.exists(os.path.join(root, p))
+        and p.endswith((".py", ".cc", ".h", ".cpp", ".hpp"))
+        and _in_scan_scope(p)
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="fdlint", description=__doc__)
     ap.add_argument("--check", action="store_true",
                     help="run all passes and gate on the baseline")
+    ap.add_argument("--changed", action="store_true",
+                    help="with --check: lint only git-touched files")
     ap.add_argument("--dump-flags", action="store_true",
                     help="print docs/FLAGS.md from the flag registry")
+    ap.add_argument("--dump-cert", action="store_true",
+                    help="print the fdcert bounds certificate JSON")
+    ap.add_argument("--dump-ownership", action="store_true",
+                    help="print docs/OWNERSHIP.md from the ownership tables")
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline from current violations")
     ap.add_argument("--baseline", default=None,
@@ -64,8 +129,31 @@ def main(argv=None) -> int:
         sys.stdout.write(flags.dump_markdown())
         return 0
 
+    if args.dump_cert:
+        from firedancer_tpu.lint import bounds
+
+        sys.stdout.write(bounds.dump_certificate(args.root))
+        return 0
+
+    if args.dump_ownership:
+        from firedancer_tpu.lint import ownership
+
+        sys.stdout.write(ownership.dump_markdown())
+        return 0
+
     root = args.root or repo_root()
     baseline_path = args.baseline or os.path.join(root, "lint_baseline.json")
+
+    if args.changed:
+        if args.paths:
+            print("fdlint: --changed derives the path set from git — "
+                  "drop the explicit paths")
+            return 2
+        changed = _changed_paths(root)
+        if not changed:
+            print("fdlint: OK — no changed lintable files")
+            return 0
+        args.paths = changed
 
     kwargs = {}
     if args.paths:
@@ -105,6 +193,11 @@ def main(argv=None) -> int:
 
     baseline = Baseline.load(baseline_path)
     new, stale = baseline.resolve(violations)
+    if args.changed:
+        # --changed scans only touched files: entries for untouched
+        # files legitimately match nothing — only the full gate (or an
+        # explicit whole-scope scan) may call an entry stale.
+        stale = []
 
     for v in new:
         print(v.format())

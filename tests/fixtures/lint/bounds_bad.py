@@ -1,0 +1,72 @@
+"""fdlint fixture: constructs pass 5 (fdcert bounds) MUST flag.
+
+Parsed + abstractly executed by tests/test_fdcert.py, never imported.
+Each certified function here violates one lane/contract class.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NLIMBS = 32
+_MASK = 255
+
+FDCERT_CONTRACTS = {
+    "overflow_conv": {"inputs": ["limbs:32:1024", "limbs:32:1024"],
+                      "out_abs": 512,
+                      "doc": "conv rows blow int32 (weight 38 -> 38000)"},
+    "f32_window_escape": {"inputs": ["limbs:32:1024", "limbs:32:1024"],
+                          "out_abs": 512,
+                          "doc": "f32 products of 1024-bound limbs round"},
+    "contract_break": {"inputs": ["limbs:32:1024", "limbs:32:1024"],
+                       "out_abs": 512,
+                       "doc": "too few carry passes leave limbs wide"},
+    "unmodeled_idiom": {"inputs": ["limbs:32:512"], "out_abs": 512,
+                        "doc": "fori_loop has no transfer function"},
+}
+
+
+def _carry_pass(x, passes):
+    for _ in range(passes):
+        lo = x & _MASK
+        hi = x >> 8
+        x = lo + jnp.concatenate([38 * hi[NLIMBS - 1:], hi[:NLIMBS - 1]],
+                                 axis=0)
+    return x
+
+
+def overflow_conv(a, b):
+    # the widened-constant bug class: 38 -> 38000 pushes the 32-term
+    # convolution rows past 2^31
+    bext = jnp.concatenate([38000 * b, b], axis=0)
+    acc = a[0:1] * bext[NLIMBS:2 * NLIMBS]
+    for i in range(1, NLIMBS):
+        acc = acc + a[i:i + 1] * bext[NLIMBS - i:2 * NLIMBS - i]
+    return _carry_pass(acc, 4)
+
+
+def f32_window_escape(a, b):
+    # f32 products of |limb| <= 1024 operands exceed the 2^24 window
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    acc = af[0:1] * bf
+    for i in range(1, NLIMBS):
+        acc = acc + af[i:i + 1] * jnp.concatenate(
+            [bf[i:], bf[:i]], axis=0)
+    return acc.astype(jnp.int32)
+
+
+def contract_break(a, b):
+    # correct arithmetic, but only 2 carry passes: output limbs stay
+    # far above the declared |limb| <= 512 contract
+    bext = jnp.concatenate([38 * b, b], axis=0)
+    acc = a[0:1] * bext[NLIMBS:2 * NLIMBS]
+    for i in range(1, NLIMBS):
+        acc = acc + a[i:i + 1] * bext[NLIMBS - i:2 * NLIMBS - i]
+    return _carry_pass(acc, 2)
+
+
+def unmodeled_idiom(a):
+    # lax.fori_loop has no transfer function: must fail LOUDLY as
+    # bounds-unprovable, never pass silently
+    return jax.lax.fori_loop(0, 4, lambda i, v: v + 1, a)

@@ -1,0 +1,54 @@
+"""fdlint fixture: pass 5 (fdcert bounds) must certify this cleanly.
+
+A miniature of the fe25519 idiom set: lazy carries, static-slice
+schoolbook conv, f32-exact products inside the window.
+"""
+
+import jax.numpy as jnp
+
+NLIMBS = 32
+_MASK = 255
+
+FDCERT_CONTRACTS = {
+    "tiny_mul": {"inputs": ["limbs:32:1024", "limbs:32:1024"],
+                 "out_abs": 512,
+                 "doc": "schoolbook conv + 4 carry passes"},
+    "tiny_f32": {"inputs": ["limbs:32:512", "limbs:32:512"],
+                 "out_abs": 512,
+                 "doc": "exact f32 products under the window"},
+    "tiny_add": {"inputs": ["limbs:32:512", "limbs:32:512"],
+                 "out_abs": 512, "doc": "invariant closure"},
+}
+
+
+def _carry_pass(x, passes):
+    for _ in range(passes):
+        lo = x & _MASK
+        hi = x >> 8
+        x = lo + jnp.concatenate([38 * hi[NLIMBS - 1:], hi[:NLIMBS - 1]],
+                                 axis=0)
+    return x
+
+
+def tiny_mul(a, b):
+    bext = jnp.concatenate([38 * b, b], axis=0)
+    acc = a[0:1] * bext[NLIMBS:2 * NLIMBS]
+    for i in range(1, NLIMBS):
+        acc = acc + a[i:i + 1] * bext[NLIMBS - i:2 * NLIMBS - i]
+    return _carry_pass(acc, 4)
+
+
+def tiny_f32(a, b):
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    lo = af[0:1] * bf
+    for i in range(1, NLIMBS):
+        p = af[i:i + 1] * bf
+        lo = lo + jnp.concatenate(
+            [jnp.zeros((i,) + a.shape[1:], jnp.float32),
+             p[:NLIMBS - i]], axis=0)
+    return _carry_pass(lo.astype(jnp.int32), 4)
+
+
+def tiny_add(a, b):
+    return _carry_pass(a + b, 1)

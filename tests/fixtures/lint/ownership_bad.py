@@ -1,0 +1,33 @@
+"""fdlint fixture: constructs pass 6 (fdcert ownership) MUST flag.
+
+Never imported, only scanned. One violation per marked construct.
+"""
+
+import threading
+
+from firedancer_tpu.disco.tiles import CNC_DIAG_RESTARTS
+
+
+class RogueRunner:
+    def start(self):
+        def loop():
+            while True:
+                self.counter = self.counter + 1   # own-unblessed-share
+                self.slots[0] = 1                 # own-unblessed-share
+
+        # own-thread-unregistered: not in THREAD_TABLE
+        self._t = threading.Thread(target=loop, daemon=True)
+        self._t.start()
+
+    def poke(self, cnc):
+        # own-double-writer: CNC_DIAG_RESTARTS belongs to the
+        # supervisor — the injected double-writer
+        cnc.diag_add(CNC_DIAG_RESTARTS, 1)
+
+    def poke_new_slot(self, cnc):
+        # own-double-writer (undeclared resource): a NEW diag slot
+        # constant must be declared in the WRITER_TABLE first
+        cnc.diag_add(CNC_DIAG_SHINY_NEW, 1)
+
+
+CNC_DIAG_SHINY_NEW = 12

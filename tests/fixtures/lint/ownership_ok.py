@@ -1,0 +1,38 @@
+"""fdlint fixture: pass 6 (fdcert ownership) must stay silent here.
+
+Covers the non-flagging shapes: plain (non-thread) attribute stores,
+cross-object thread targets (owned elsewhere), literal diag slots, and
+the inline waiver grammar.
+"""
+
+import threading
+
+
+class QuietRunner:
+    def configure(self):
+        # attribute stores OUTSIDE a thread-entry closure are plain
+        # object construction, not cross-thread shares
+        self.counter = 0
+        self.slots = [0] * 4
+
+    def start(self, tile):
+        # cross-object target: tile.run's discipline is declared at
+        # tile.run's home module, not at every caller
+        self._t = threading.Thread(  # fdlint: ignore[own-thread-unregistered]
+            target=tile.run, daemon=True
+        )
+        self._t.start()
+
+    def start_waived(self):
+        def loop():
+            self.beats = self.beats + 1  # fdlint: ignore[own-unblessed-share]
+
+        t = threading.Thread(  # fdlint: ignore[own-thread-unregistered]
+            target=loop, daemon=True
+        )
+        t.start()
+
+    def poke(self, cnc):
+        # literal slot indices are test/fixture pokes, not governed
+        # call sites (real call sites use the declared constants)
+        cnc.diag_add(3, 1)

@@ -102,3 +102,16 @@ def loop_body_branch(x):
         return v
 
     return jax.lax.fori_loop(0, 3, body, x)
+
+
+def _sharded_step(msgs):
+    # hazard inside a shard_map-wrapped body (the round-13 coverage
+    # fix: sharded steps trace exactly like jitted bodies)
+    return msgs * int(os.getenv("FD_DSM_LANES", "1"))
+
+
+def build_sharded(mesh, spec):
+    from jax import shard_map
+
+    return shard_map(_sharded_step, mesh=mesh, in_specs=(spec,),
+                     out_specs=spec)

@@ -85,3 +85,18 @@ def waived_hazard(x):
     # inline waiver grammar: the read is flagged by rule, then ignored
     _ = os.environ.get("FD_SQ_IMPL")  # fdlint: ignore[trace-env-read]
     return x
+
+
+def _sharded_clean(msgs):
+    # shard_map bodies are scanned; clean jnp dataflow must not flag
+    # (x.shape reads stay static-structure, like the jit case)
+    if msgs.shape[0] > 2:
+        return msgs + 1
+    return msgs
+
+
+def build_sharded_clean(mesh, spec):
+    from firedancer_tpu.parallel.mesh import shard_map_nocheck
+
+    return shard_map_nocheck(_sharded_clean, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec)

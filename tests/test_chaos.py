@@ -442,11 +442,33 @@ def test_monitor_surfaces_restart_and_backoff(tmp_path):
     assert " 3" in row and "250" in row
 
 
+def test_supervisor_faults_pending_quiescence_condition():
+    """The deterministic quiescence condition that fixed the round-12
+    flake: a drained pipeline may not quiesce while a scheduled
+    worker_kill ordinal is still ahead of the monitor-pass counter."""
+    from firedancer_tpu.disco.chaos import ChaosInjector
+
+    inj = ChaosInjector(seed=1, schedule="worker_kill@3")
+    assert inj.supervisor_faults_pending()
+    for _ in range(2):
+        inj._tick("monitor_pass")
+        assert inj.supervisor_faults_pending()
+    inj._tick("monitor_pass")  # ordinal 3 reached: the kill fires here
+    assert not inj.supervisor_faults_pending()
+    # unscheduled runs never hold quiescence
+    assert not ChaosInjector(seed=1).supervisor_faults_pending()
+
+
 @pytest.mark.slow
 def test_chaos_worker_kill_supervised(tmp_path, monkeypatch):
     """Supervisor-level chaos: worker_kill SIGKILLs the verify worker
     at a scheduled monitor pass; crash-only respawn (now with backoff)
-    heals the run and the restart surfaces in the artifact."""
+    heals the run and the restart surfaces in the artifact.
+
+    Deterministic since round 13: the supervisor's quiescence condition
+    includes supervisor_faults_pending(), so a fast host draining the
+    corpus before pass 20 keeps taking monitor passes until the
+    scheduled kill has fired (previously this raced and flaked)."""
     from firedancer_tpu.disco.pipeline import build_topology
     from firedancer_tpu.disco.supervisor import run_pipeline_supervised
 

@@ -1,0 +1,355 @@
+"""fdcert self-tests (fdlint passes 5-6): the bounds certifier proves
+the live kernels and flags every fixture bug class, the certificate is
+pinned against the committed artifact, seeded mutations are caught by
+BOTH the certifier and the runtime FD_FE_DEBUG_BOUNDS belt, a property
+test shows the runtime belt never fires inside the proven ranges, and
+the ownership pass enforces the declared concurrency tables.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.lint import bounds, ownership
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+
+def _fx(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+# ---------------------------------------------------------------- pass 5
+
+
+def test_bounds_flags_every_fixture_class():
+    vs = bounds.check_file(_fx("bounds_bad.py"), root=REPO)
+    by_key = {v.key: v.rule for v in vs}
+    assert by_key["overflow_conv"] == "bounds-overflow"      # int32 wrap
+    assert by_key["f32_window_escape"] == "bounds-overflow"  # 2^24 window
+    assert by_key["contract_break"] == "bounds-contract"     # out > 512
+    assert by_key["unmodeled_idiom"] == "bounds-unprovable"  # fori_loop
+    assert len(vs) == 4
+    # violations carry real source lines (the traceback walk), not 0
+    lines = {v.key: v.line for v in vs}
+    assert lines["overflow_conv"] > 1
+    assert lines["f32_window_escape"] > 1
+
+
+def test_bounds_ok_fixture_certifies_clean():
+    vs = bounds.check_file(_fx("bounds_ok.py"), root=REPO)
+    assert vs == [], [v.format() for v in vs]
+
+
+def test_live_tree_proves_with_zero_waivers():
+    """The acceptance contract: every fe25519/sc25519/frontend_pallas
+    limb body proves overflow-free at its declared contract, no
+    waivers, no baseline entries."""
+    vs, cert = bounds.certify_all(REPO)
+    assert vs == [], [v.format() for v in vs]
+    mods = cert["modules"]
+    fe = mods["firedancer_tpu/ops/fe25519.py"]
+    # Every declared contract produced a proof entry.
+    for rmod in bounds.CERT_MODULES:
+        declared = bounds.read_contracts(os.path.join(REPO, rmod))
+        assert set(mods[rmod]) == set(declared), rmod
+    # The numbers the docstring analyses claim, now machine-checked:
+    # fe_mul's proven output bound is the classic 293 < 512, its conv
+    # rows stay under 2^31, and the f32 schedules never leave the
+    # 2^23 partial-sum envelope (half the 2^24 window).
+    assert fe["fe_mul"]["proved_out_abs"] == 293
+    assert fe["fe_mul"]["max_abs_int32"] < 2**31
+    assert fe["fe_mul"]["max_abs_int32"] > 2**30  # the analysis is tight
+    for f32fn in ("fe_mul_f32", "fe_sq_f32"):
+        assert fe[f32fn]["max_abs_f32"] <= 2**23
+        assert fe[f32fn]["proved_out_abs"] <= 512
+    # Invariant closure: public add/sub/neg of invariant-bounded inputs
+    # stay inside the invariant — the induction step for every chain.
+    for pub in ("fe_add", "fe_sub", "fe_neg"):
+        assert fe[pub]["proved_out_abs"] <= 512
+
+
+def test_certificate_pinned_against_committed_artifact():
+    """FLAGS.md/SLO.md pattern: the committed lint_bounds_cert.json
+    must equal what the certifier proves against the current source —
+    certificate drift fails the gate (ci.sh diffs the same pair)."""
+    fresh = bounds.dump_certificate(REPO)
+    with open(os.path.join(REPO, "lint_bounds_cert.json")) as f:
+        committed = f.read()
+    assert fresh == committed, (
+        "lint_bounds_cert.json is stale — regenerate with "
+        "`python scripts/fdlint.py --dump-cert > lint_bounds_cert.json`"
+    )
+    # and it is valid, versioned JSON with all three modules
+    doc = json.loads(committed)
+    assert doc["version"] == 1
+    assert set(doc["modules"]) == set(bounds.CERT_MODULES)
+
+
+def test_dump_certificate_is_deterministic():
+    assert bounds.dump_certificate(REPO) == bounds.dump_certificate(REPO)
+
+
+def test_changed_scan_of_dependent_module_reproves_prefix():
+    """A --changed scan touching only frontend_pallas.py must certify
+    cleanly: the module execs against sc25519's extracted namespace,
+    so the dependency-chain prefix re-proves with it (previously the
+    stubs made a comment-only edit false-fail as bounds-unprovable)."""
+    for rmod in ("firedancer_tpu/ops/frontend_pallas.py",
+                 "firedancer_tpu/ops/sc25519.py"):
+        vs = bounds.check_repo(REPO, py_paths=[os.path.join(REPO, rmod)])
+        assert vs == [], (rmod, [v.format() for v in vs])
+    # and an unrelated path set skips certification entirely
+    assert bounds.check_repo(
+        REPO, py_paths=[os.path.join(REPO, "bench.py")]) == []
+
+
+def test_mixed_lane_promotion_is_checked():
+    """int32 op float32 promotes to the f32 lane SYMMETRICALLY, so the
+    mantissa-window check cannot be dodged by operand order."""
+    big = bounds.Abs([[2**29]], [[2**29]], "int32")
+    f = bounds.Abs([[100]], [[100]], "float32")
+    with pytest.raises(bounds.CertError):
+        _ = f + big
+    with pytest.raises(bounds.CertError):
+        _ = big + f   # the once-unchecked order
+    with pytest.raises(bounds.CertError):
+        _ = big * f
+
+
+def test_zeros_accumulator_keeps_its_lane():
+    """jnp.zeros(shape, <narrow dtype>) accumulators are range-checked
+    against THEIR lane, not a collapsed int32."""
+    z = bounds._shim_zeros((2, 1), np.uint8)
+    assert z.dtype == "uint8"
+    with pytest.raises(bounds.CertError):
+        _ = z + 300   # wraps a real uint8; must not certify
+    zb = bounds._shim_zeros((2, 1), np.bool_)
+    assert zb.dtype == "bool"
+    zf = bounds._shim_zeros((2, 1), np.float32)
+    assert zf.dtype == "float32"
+
+
+# ----------------------------------------------------- seeded mutations
+
+_FE_PATH = os.path.join(REPO, "firedancer_tpu", "ops", "fe25519.py")
+
+# The seeded mutation: widen fe_mul's residual-bound constant (carry
+# passes 4 -> 2), leaving limbs far above the 512 contract. Exact
+# source text so the test fails loudly if the body is refactored.
+_MUT_OLD = ("    folded = jnp.sum(a[:, None] * gathered, axis=0)     "
+            "# (32, *batch)\n    return _carry_pass(folded, 4)")
+_MUT_NEW = ("    folded = jnp.sum(a[:, None] * gathered, axis=0)     "
+            "# (32, *batch)\n    return _carry_pass(folded, 2)")
+
+# The sharper companion: widening the 38 wrap weight overflows int32,
+# which WRAPS at runtime and lands back inside [0, 512] — silently
+# wrong results the runtime belt provably cannot see. Only the static
+# certifier catches this class.
+_WRAP_OLD = ("    bext = jnp.concatenate([38 * b, b], axis=0)         "
+             "# (64, *batch)")
+_WRAP_NEW = ("    bext = jnp.concatenate([38000 * b, b], axis=0)         "
+             "# (64, *batch)")
+
+
+def _mutated_src(old: str, new: str) -> str:
+    with open(_FE_PATH, encoding="utf-8") as f:
+        src = f.read()
+    assert old in src, "fe_mul body changed — update the mutation spec"
+    return src.replace(old, new, 1)
+
+
+def _write_and_certify(tmp_path, src: str):
+    mut = tmp_path / "fe25519.py"
+    mut.write_text(src)
+    return bounds.check_file(str(mut), root=str(tmp_path))
+
+
+def test_mutation_widened_carry_fails_certifier(tmp_path):
+    vs = _write_and_certify(tmp_path, _mutated_src(_MUT_OLD, _MUT_NEW))
+    assert any(v.rule == "bounds-contract" and v.key == "fe_mul"
+               for v in vs), [v.format() for v in vs]
+
+
+def test_mutation_widened_wrap_weight_fails_certifier(tmp_path):
+    vs = _write_and_certify(tmp_path, _mutated_src(_WRAP_OLD, _WRAP_NEW))
+    assert any(v.rule == "bounds-overflow" and v.key == "fe_mul"
+               for v in vs), [v.format() for v in vs]
+
+
+def _load_runtime_module(name: str, src: str):
+    spec = importlib.util.spec_from_loader(name, loader=None)
+    mod = importlib.util.module_from_spec(spec)
+    mod.__file__ = name
+    sys.modules[name] = mod
+    try:
+        exec(compile(src, name, "exec"), mod.__dict__)
+    except Exception:
+        sys.modules.pop(name, None)
+        raise
+    return mod
+
+
+def test_mutation_also_caught_by_runtime_belt(monkeypatch):
+    """Belt AND suspenders: the same seeded mutation that fails the
+    certifier also fires FD_FE_DEBUG_BOUNDS at the f32 dispatch when
+    the widened fe_mul output reaches fe_sq_f32."""
+    import jax.numpy as jnp
+
+    mut = _load_runtime_module(
+        "_fdcert_fe_mut", _mutated_src(_MUT_OLD, _MUT_NEW))
+    try:
+        x = jnp.full((32, 4), 1024, jnp.int32)
+        out = np.asarray(mut.fe_mul(x, x))
+        assert np.abs(out).max() > 512  # the mutation's observable harm
+        monkeypatch.setenv("FD_FE_DEBUG_BOUNDS", "1")
+        with pytest.raises(ValueError, match="512"):
+            mut.fe_sq_f32(jnp.asarray(out))
+    finally:
+        sys.modules.pop("_fdcert_fe_mut", None)
+
+
+def test_wrap_mutation_is_runtime_invisible(monkeypatch):
+    """The widened wrap weight wraps int32 back INSIDE the runtime
+    bound — wrong answers the belt cannot see. This pins why the
+    static pass is the load-bearing check, not the runtime guard."""
+    import jax.numpy as jnp
+
+    mut = _load_runtime_module(
+        "_fdcert_fe_wrap", _mutated_src(_WRAP_OLD, _WRAP_NEW))
+    try:
+        x = jnp.full((32, 4), 1024, jnp.int32)
+        out = np.asarray(mut.fe_mul(x, x))
+        assert np.abs(out).max() <= 512  # looks healthy...
+        monkeypatch.setenv("FD_FE_DEBUG_BOUNDS", "1")
+        mut.fe_sq_f32(jnp.asarray(out))  # ...and the belt stays silent
+    finally:
+        sys.modules.pop("_fdcert_fe_wrap", None)
+
+
+# --------------------------------------------------- runtime-belt property
+
+
+def test_runtime_belt_never_fires_inside_proven_ranges(monkeypatch):
+    """Randomized soundness link between the two layers: inputs inside
+    the certificate's proven ranges never trip FD_FE_DEBUG_BOUNDS, and
+    real outputs respect the proven output bounds."""
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops import fe25519
+
+    _vs, cert = bounds.certify_all(REPO)
+    fe = cert["modules"]["firedancer_tpu/ops/fe25519.py"]
+    monkeypatch.setenv("FD_FE_DEBUG_BOUNDS", "1")
+    rng = np.random.default_rng(0xFDCE47)
+    for _ in range(16):
+        a = jnp.asarray(rng.integers(-512, 513, (32, 8)), jnp.int32)
+        b = jnp.asarray(rng.integers(-512, 513, (32, 8)), jnp.int32)
+        # the f32 schedules, under the belt, at the contract boundary
+        out_m = np.asarray(fe25519.fe_mul_f32(a, b))
+        out_s = np.asarray(fe25519.fe_sq_f32(a))
+        assert np.abs(out_m).max() <= fe["fe_mul_f32"]["proved_out_abs"]
+        assert np.abs(out_s).max() <= fe["fe_sq_f32"]["proved_out_abs"]
+        # chain closure: public-op outputs re-enter the f32 contract
+        s = np.asarray(fe25519.fe_add(jnp.asarray(out_m), jnp.asarray(out_s)))
+        assert np.abs(s).max() <= fe["fe_add"]["proved_out_abs"]
+        fe25519.fe_sq_f32(jnp.asarray(s))  # must not raise
+    # and the full-width generic multiply stays within ITS proof
+    wide_a = jnp.asarray(rng.integers(-1024, 1025, (32, 8)), jnp.int32)
+    wide_b = jnp.asarray(rng.integers(-1024, 1025, (32, 8)), jnp.int32)
+    out = np.asarray(fe25519.fe_mul(wide_a, wide_b))
+    assert np.abs(out).max() <= fe["fe_mul"]["proved_out_abs"]
+
+
+# ---------------------------------------------------------------- pass 6
+
+
+def test_ownership_flags_every_fixture_class():
+    vs = ownership.check_file(_fx("ownership_bad.py"), root=REPO)
+    rules = sorted(v.rule for v in vs)
+    assert rules.count("own-thread-unregistered") == 1
+    assert rules.count("own-unblessed-share") == 2
+    assert rules.count("own-double-writer") == 2
+    assert len(vs) == 5
+    keys = {v.key for v in vs}
+    assert "RogueRunner.start:loop" in keys
+    assert "CNC_DIAG_RESTARTS" in keys        # the injected double-writer
+    assert "CNC_DIAG_SHINY_NEW" in keys       # undeclared new slot
+
+
+def test_ownership_ok_fixture_and_waivers():
+    vs = ownership.check_file(_fx("ownership_ok.py"), root=REPO)
+    assert vs == [], [v.format() for v in vs]
+
+
+def test_ownership_live_tree_clean():
+    """The live concurrency surface matches the declared tables with
+    zero violations AND zero stale entries (the acceptance contract:
+    no new baseline entries for pass 6)."""
+    from firedancer_tpu.lint import PY_ROOTS
+    from firedancer_tpu.lint.common import iter_files
+
+    scan = ownership.Scan()
+    vs = []
+    for path in iter_files(
+            [os.path.join(REPO, r) for r in PY_ROOTS], (".py",)):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        vs.extend(scan.check_source(src, path, root=REPO))
+    vs.extend(scan.stale_entries())
+    assert vs == [], [v.format() for v in vs]
+
+
+def test_ownership_stale_entry_detection(tmp_path):
+    """A table entry whose thread site is gone must flag (burn-down
+    semantics) — but only when the entry's module was scanned."""
+    table = (ownership.ThreadSite(
+        "gone.py", "Runner.start:loop", "x", "x", "x"),)
+    scan = ownership.Scan(thread_table=table)
+    src = "x = 1\n"
+    scan.check_source(src, str(tmp_path / "gone.py"), root=str(tmp_path))
+    stale = scan.stale_entries()
+    assert [v.rule for v in stale] == ["own-thread-stale"]
+    # unscanned module: silent (partial scans must not cry stale)
+    scan2 = ownership.Scan(thread_table=table)
+    scan2.check_source(src, str(tmp_path / "other.py"),
+                       root=str(tmp_path))
+    assert scan2.stale_entries() == []
+
+
+def test_ownership_doc_pinned():
+    fresh = ownership.dump_markdown()
+    with open(os.path.join(REPO, "docs", "OWNERSHIP.md")) as f:
+        committed = f.read()
+    assert fresh == committed, (
+        "docs/OWNERSHIP.md is stale — regenerate with "
+        "`python scripts/fdlint.py --dump-ownership > docs/OWNERSHIP.md`"
+    )
+    # every declared thread site and shared attr is in the rendering
+    for site in ownership.THREAD_TABLE:
+        assert site.key in fresh
+    for ss in ownership.SHARED_STATE:
+        assert ss.attr in fresh
+
+
+# ------------------------------------------------------------------ CLI
+
+
+@pytest.mark.slow  # subprocess; ci.sh runs the identical diff as a gate
+def test_cli_dump_cert_matches_committed():
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fdlint.py"),
+         "--dump-cert"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert p.returncode == 0, p.stderr
+    with open(os.path.join(REPO, "lint_bounds_cert.json")) as f:
+        assert p.stdout == f.read()

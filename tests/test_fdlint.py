@@ -62,8 +62,9 @@ def test_trace_safety_flags_every_hazard():
     assert "_plain:while" in by_key                  # jit(fn) reference
     assert "aliased_getenv:environ" in by_key        # `import os as _x`
     assert "loop_body_branch:if" in by_key           # fori_loop body param
+    assert "_sharded_step:environ" in by_key         # shard_map body
     assert rules.count("trace-tracer-branch") == 3
-    assert len(vs) == 12
+    assert len(vs) == 13
 
 
 def test_trace_safety_no_false_positives():
@@ -312,3 +313,49 @@ def test_cli_dump_flags_matches_committed_doc():
         "docs/FLAGS.md is stale — regenerate with "
         "`python scripts/fdlint.py --dump-flags > docs/FLAGS.md`"
     )
+
+
+def test_cli_changed_rejects_explicit_paths():
+    p = _run_cli("--check", "--changed", "firedancer_tpu")
+    assert p.returncode == 2
+    assert "drop the explicit paths" in p.stdout
+
+
+@pytest.mark.slow  # spawns git + a scan; the semantics under test are
+# the pre-commit recipe documented in docs/LINT.md
+def test_cli_changed_scans_only_touched_files(tmp_path):
+    # a scratch git repo with one clean file and one violating file;
+    # only the violating file is MODIFIED, so --changed must flag it —
+    # and must NOT flag the untouched violating sibling.
+    import shutil
+
+    scratch = tmp_path / "repo"
+    (scratch / "scripts").mkdir(parents=True)
+    (scratch / "tests").mkdir()
+    (scratch / "scripts" / "clean.py").write_text("x = 1\n")
+    (scratch / "scripts" / "old_bad.py").write_text(
+        'import os\na = os.environ.get("FD_SQ_IMPL")\n')
+    git = shutil.which("git")
+    if git is None:
+        pytest.skip("git unavailable")
+    env = dict(os.environ, GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+    for cmd in (["init", "-q"], ["add", "-A"],
+                ["commit", "-qm", "seed"]):
+        subprocess.run([git, *cmd], cwd=scratch, check=True, env=env)
+    (scratch / "scripts" / "new_bad.py").write_text(
+        'import os\nb = os.environ.get("FD_MUL_IMPL")\n')
+    # out-of-scope noise: touched tests/fixtures must NOT widen the
+    # scan (they hold violations by design in the real repo)
+    (scratch / "tests" / "fixture_bad.py").write_text(
+        'import os\nc = os.environ.get("FD_DSM_LANES")\n')
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fdlint.py"),
+         "--check", "--changed", "--root", str(scratch),
+         "--baseline", str(scratch / "none.json")],
+        capture_output=True, text=True, cwd=scratch, timeout=120,
+    )
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "new_bad.py" in p.stdout
+    assert "old_bad.py" not in p.stdout  # untouched debt: full scan's job
+    assert "fixture_bad.py" not in p.stdout  # out of scope, stays out
