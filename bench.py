@@ -146,6 +146,21 @@ def _schema_version() -> int:
     return ARTIFACT_SCHEMA_VERSION
 
 
+def _xray_block(res) -> "dict | None":
+    """The bounded fd_xray artifact block out of PipelineResult.xray
+    (the full waterfall/suspects stay in dumps and autopsies — a
+    BENCH_LOG line must stay one readable line)."""
+    x = getattr(res, "xray", None)
+    if not x:
+        return None
+    return {
+        "sample_rate": x.get("sample_rate", 0),
+        "exemplars": x.get("exemplars") or {},
+        "traces": x.get("traces", 0),
+        "top_slowest": (x.get("top_slowest") or [])[:3],
+    }
+
+
 def _replay_artifact(metric: str, corpus, res, run_s: float, gen_s: float,
                      timeout_s: float) -> tuple[dict, bool]:
     """The shared replay-gate artifact (round-11: ONE assembly for the
@@ -196,6 +211,11 @@ def _replay_artifact(metric: str, corpus, res, run_s: float, gen_s: float,
         "rlc_fallbacks": _rlc_fallbacks(res),
         "stage_latency_ms": _stage_latency_ms(res),
         "stage_hist": getattr(res, "stage_hist", None),
+        # fd_xray summary (behind the schema_version gate like every
+        # round-11+ field; None with FD_XRAY=0): exemplar counts by
+        # trigger class + the top-3 slowest exemplars with per-stage
+        # breakdown — scripts/bench_log_check.py validates the shape.
+        "xray": _xray_block(res),
     }
     return rec, ok
 

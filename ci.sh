@@ -118,6 +118,17 @@ echo "== fd_sentinel SLO smoke (burn-rate asymmetry + report/ledger) =="
 # pending, and flight+sentinel overhead stays <= 5% vs both disabled.
 JAX_PLATFORMS=cpu python scripts/slo_smoke.py
 
+echo "== fd_xray smoke (exemplars / waterfall / autopsy / overhead) =="
+# The round-14 diagnosability gate: a clean replay head-samples
+# exemplar traces at the configured rate with monotone span chains and
+# a valid Chrome trace export, the queue-wait vs service waterfall
+# reconciles with the always-on EdgeHist totals within one log2
+# bucket, a seeded hb_stall + credit_starve chaos schedule produces an
+# xray_autopsy_*.json whose suspected stage matches the injected fault
+# class both ways, and xray overhead stays <= 2% vs FD_XRAY=0 with the
+# sink content bit-identical.
+JAX_PLATFORMS=cpu python scripts/xray_smoke.py
+
 echo "== RLC verify smoke (CPU backend, FD_BENCH_VERIFY=rlc) =="
 # The production verify mode's dispatch contract (round-6 promotion):
 # tiny batch through the tile-facing RLC wrapper — no fallback on clean

@@ -218,7 +218,7 @@ def run_feed_pipeline(
     vopts["feed"] = True
     verify = VerifyTile(
         wksp, pod.query_cstr("firedancer.verify.cnc"),
-        in_link=InLink(wksp, _link_names(pod, "replay_verify")),
+        in_link=InLink(wksp, _link_names(pod, "replay_verify"), edge="replay_verify"),
         out_link=_make_out_link(wksp, pod, "verify_dedup", "verify_dedup",
                                 mtu),
         backend=verify_backend, batch=verify_batch,
@@ -241,21 +241,21 @@ def run_feed_pipeline(
     if not use_proc:
         dedup = DedupTile(
             wksp, pod.query_cstr("firedancer.dedup.cnc"),
-            in_links=[InLink(wksp, _link_names(pod, "verify_dedup"))],
+            in_links=[InLink(wksp, _link_names(pod, "verify_dedup"), edge="verify_dedup")],
             out_link=_make_out_link(wksp, pod, "dedup_pack", "dedup_pack",
                                     mtu),
             tcache_depth=tcache_depth,
         )
         pack = PackTile(
             wksp, pod.query_cstr("firedancer.pack.cnc"),
-            in_link=InLink(wksp, _link_names(pod, "dedup_pack")),
+            in_link=InLink(wksp, _link_names(pod, "dedup_pack"), edge="dedup_pack"),
             out_link=_make_out_link(wksp, pod, "pack_sink", "pack_sink",
                                     mtu),
             bank_cnt=bank_cnt, scheduler=pack_scheduler,
         )
         sink = SinkTile(
             wksp, pod.query_cstr("firedancer.sink.cnc"),
-            in_link=InLink(wksp, _link_names(pod, "pack_sink")),
+            in_link=InLink(wksp, _link_names(pod, "pack_sink"), edge="pack_sink"),
             record_digests=record_digests,
         )
         in_tiles = [dedup, pack, sink]
@@ -475,6 +475,7 @@ def run_feed_pipeline(
             digests = list(sink.digests) if record_digests else None
             stage_latency["sink"] = latency_percentiles(sink.latencies_ns)
 
+        from firedancer_tpu.disco import xray
         from firedancer_tpu.disco.pipeline import finish_flight_run
 
         res = PipelineResult(
@@ -488,10 +489,17 @@ def run_feed_pipeline(
             sink_digests=digests,
             verify_stats=[verify_tile_stats(verify)],
             stage_latency=stage_latency,
-            stage_hist=finish_flight_run(wksp),
+            stage_hist=finish_flight_run(wksp, slo_summary),
             feed=True,
             slo=slo_summary,
         )
+        # fd_xray: this process's exemplar rings + the worker pool's
+        # (its result file carries a spans dump, so cross-process span
+        # chains correlate at one place — by trace id, the same
+        # deterministic hash everywhere).
+        res.xray = xray.run_summary(
+            wksp, extra_spans=(down.get("xray") or {}).get("spans"),
+            alerts=(slo_summary or {}).get("alerts"))
         if all(not th.is_alive() for th in threads) and (
                 snt is None or not snt.alive()):
             wksp.leave()  # else leak the mapping rather than segfault

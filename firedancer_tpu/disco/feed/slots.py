@@ -44,6 +44,7 @@ class Slot:
         "idx", "state", "msgs", "lens", "sigs", "pubs", "pay", "offs",
         "plens", "psigs", "tlanes", "tsorigs", "tspubs", "hashes",
         "ha_mask", "n_txn", "n_lane", "pay_fill", "t_first", "drain_end",
+        "flush_verdict",
     )
 
     def __init__(self, idx: int, batch: int, max_msg_len: int):
@@ -74,6 +75,11 @@ class Slot:
         self.t_first = 0       # deadline anchor (tickcount ns)
         self.drain_end = 0     # in-ring seq after the last drain round
                                # (the batch's ack target once verified)
+        # Why this slot shipped ("full" / "capacity" / "deadline" /
+        # "starved" / "ring_starved" / "halt") — stamped at commit so
+        # fd_xray's exemplar batch context can attribute the flush
+        # decision per dispatched batch.
+        self.flush_verdict = "full"
 
     def reset(self) -> None:
         self.ha_mask[: max(self.n_txn, 1)] = False
@@ -82,6 +88,7 @@ class Slot:
         self.pay_fill = 0
         self.t_first = 0
         self.drain_end = 0
+        self.flush_verdict = "full"
 
 
 class SlotPool:

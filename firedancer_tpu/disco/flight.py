@@ -764,6 +764,17 @@ def dump(reason: str, wksp=None) -> dict:
         },
         "compiles": compile_records(),
     }
+    # fd_xray exemplar rings + queue telemetry ride in the SAME dump
+    # envelope (one postmortem artifact per trigger; lazy import —
+    # xray imports this module). Readers that predate the section
+    # ignore the key; sentinel.evaluate_edges_summary explicitly
+    # accepts-and-ignores non-edge sections.
+    try:
+        from firedancer_tpu.disco import xray as _xray
+
+        out["xray"] = {"spans": _xray.dump_spans()}
+    except Exception:
+        pass
     # A left workspace (leave() nulls the handle) must be skipped, not
     # dereferenced: fd_wksp_* with a NULL handle is a crash, not an
     # exception — and the signal handler can outlive the run that
@@ -773,6 +784,10 @@ def dump(reason: str, wksp=None) -> dict:
             out["metrics"] = read_tiles(wksp)
             out["edges"] = read_edges(wksp)
             out["slos"] = read_slos(wksp)
+            if "xray" in out:
+                from firedancer_tpu.disco import xray as _xray
+
+                out["xray"]["queue"] = _xray.read_queue(wksp)
         except Exception:
             pass
     return out

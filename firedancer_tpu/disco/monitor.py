@@ -138,6 +138,18 @@ def snapshot(wksp: Workspace, pod: Pod) -> Dict[str, Dict[str, int]]:
     if fslos:
         for label, row in fslos.items():
             out[f"slo.{label}"] = row
+    # fd_xray queue/backpressure rows: per-edge dwell histogram summary
+    # + producer stall / consumer idle / depth / credits — fd_top's
+    # XRAY panel and the waterfall read these.
+    from firedancer_tpu.disco import xray
+
+    xq = xray.read_queue(wksp)
+    if xq:
+        for label, row in xq.items():
+            d = dict(row)
+            dwell = d.pop("dwell", {}) or {}
+            d.update({f"dwell_{k}": v for k, v in dwell.items()})
+            out[f"xq.{label}"] = d
     return out
 
 

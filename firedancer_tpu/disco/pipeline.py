@@ -108,7 +108,7 @@ def build_topology(
     # worker processes attach by label; monitors/fd_top/the supervisor
     # read the rows — verify_stats become views over this, not
     # hand-mirrored diag slots.
-    from firedancer_tpu.disco import flight, sentinel
+    from firedancer_tpu.disco import flight, sentinel, xray
 
     edge_labels = [lane_link(l, lane) for l, lane in links]
     edge_labels += ["verify_drain", "sink"]
@@ -125,20 +125,29 @@ def build_topology(
               for i in range(verify_shards)]
     flight.create_regions(wksp, tiles, edge_labels,
                           slo_labels=sentinel.SLO_NAMES)
+    # fd_xray queue-telemetry region: one consumer (rx) + one producer
+    # (tx) row per edge for the queue-wait vs service waterfall —
+    # created unconditionally (rows are tiny) so attachers never race.
+    xray.create_region(wksp, edge_labels)
     topo.pod.insert_ulong("firedancer.flight.schema",
                           flight.ARTIFACT_SCHEMA_VERSION)
     wksp.leave()
     return topo
 
 
-def finish_flight_run(wksp) -> Dict[str, Dict[str, int]]:
+def finish_flight_run(wksp, slo_summary: Optional[dict] = None,
+                      ) -> Dict[str, Dict[str, int]]:
     """End-of-run fd_flight duties, shared by every pipeline runner:
-    HALT dump (no-op unless FD_FLIGHT_DUMP is set), the FD_METRICS_PROM
-    text snapshot, and the stage_hist view read back from the shared
-    registry."""
-    from firedancer_tpu.disco import flight
+    HALT dump (no-op unless FD_FLIGHT_DUMP is set), the HALT xray
+    autopsy (no-op unless FD_XRAY_DIR is set; carries the run's
+    sentinel alerts when the caller passes its slo summary), the
+    FD_METRICS_PROM text snapshot, and the stage_hist view read back
+    from the shared registry."""
+    from firedancer_tpu.disco import flight, xray
 
     flight.maybe_dump("halt", wksp=wksp)
+    xray.maybe_autopsy("halt", wksp=wksp,
+                       alerts=(slo_summary or {}).get("alerts"))
     prom = flags.get_raw("FD_METRICS_PROM")
     if prom:
         try:
@@ -220,6 +229,11 @@ class PipelineResult:
     # alert list — the same alerts land as "sentinel" flight-recorder
     # events and fd_flight_slo_* prom metrics.
     slo: Optional[dict] = None
+    # fd_xray run summary (disco/xray.py; None when FD_XRAY is off):
+    # exemplar counts by trigger class, distinct sampled traces, top-3
+    # slowest exemplars with per-stage breakdown, and the queue-wait vs
+    # service waterfall — the same block the bench artifacts carry.
+    xray: Optional[dict] = None
 
 
 def _run_tiles(
@@ -251,7 +265,7 @@ def _run_tiles(
     lanes = pod.query_ulong("firedancer.layout.verify_lane_cnt", 1)
 
     def in_link(link):
-        return InLink(wksp, _link_names(pod, link))
+        return InLink(wksp, _link_names(pod, link), edge=link)
 
     def out_link(link, consumer_fseq_link):
         return _make_out_link(wksp, pod, link, consumer_fseq_link, mtu)
@@ -393,9 +407,13 @@ def _run_tiles(
             "pack_pub": latency_percentiles(pack.out_link.lat_ns),
             "sink": latency_percentiles(sink.latencies_ns),
         },
-        stage_hist=finish_flight_run(wksp),
+        stage_hist=finish_flight_run(wksp, slo_summary),
         slo=slo_summary,
     )
+    from firedancer_tpu.disco import xray as xray_mod
+
+    res.xray = xray_mod.run_summary(
+        wksp, alerts=(slo_summary or {}).get("alerts"))
     if all(not th.is_alive() for th in threads) and (
             snt is None or not snt.alive()):
         wksp.leave()  # else: leak the mapping rather than segfault a thread

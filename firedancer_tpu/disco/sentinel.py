@@ -265,6 +265,13 @@ class Sentinel:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._stopped = False
+        # fd_xray alert-time autopsies: a dedicated flusher thread
+        # (None unless FD_XRAY_DIR is set) so poll() only ever
+        # enqueues — the judge never blocks on file IO. Imported
+        # lazily: xray imports this module for the SLO budget table.
+        from firedancer_tpu.disco import xray as _xray
+
+        self._xray_flusher = _xray.flusher_for_run(wksp)
 
     @staticmethod
     def _make_pod_tiles_fn(wksp, pod):
@@ -421,6 +428,11 @@ class Sentinel:
                     }
                     self.alerts.append(alert)
                     self.rec.record("slo_alert", **alert)
+                    if self._xray_flusher is not None:
+                        # Automated postmortem: bundle the window's
+                        # exemplars + waterfall + suspects off-thread.
+                        self._xray_flusher.request(
+                            f"slo:{slo.name}", [alert])
             elif st.alerting:
                 st.alerting = False
                 self.rec.record("slo_clear", slo=slo.name,
@@ -458,7 +470,8 @@ class Sentinel:
         the runners' wksp.leave() guard must include this: a poll
         descheduled past stop()'s join budget still holds numpy views
         over the mapped registry rows."""
-        return self._thread is not None and self._thread.is_alive()
+        return (self._thread is not None and self._thread.is_alive()) or (
+            self._xray_flusher is not None and self._xray_flusher.alive())
 
     def stop(self) -> dict:
         """Stop the poller (idempotent), run one final pass, return the
@@ -480,6 +493,10 @@ class Sentinel:
                     self.poll()
                 except Exception:
                     pass
+            if self._xray_flusher is not None:
+                # Drain + stop the autopsy writer BEFORE the runner can
+                # leave the workspace (it reads mapped registry rows).
+                self._xray_flusher.stop()
             self._stopped = True
         return self.summary()
 
@@ -525,7 +542,12 @@ def evaluate_edges_summary(edges: Dict[str, dict],
                   or label.startswith(slo.edge_or_stage + ".v")]
         for label in labels:
             s = edges[label]
-            if not s.get("n"):
+            # Accept-and-ignore anything that is not an edge summary:
+            # newer dumps nest extra sections (fd_xray queue rows,
+            # future schema growth) and this evaluator must keep
+            # parsing BOTH old and new envelopes.
+            if not isinstance(s, dict) or not s.get("n") \
+                    or "p99_ns_le" not in s:
                 continue
             limit = 2 * budgets[slo.name] * 1_000_000
             if s["p99_ns_le"] > limit:

@@ -198,7 +198,7 @@ def _supervised(
             for n in tile_names}
 
     chaos.init_for_run()  # worker_kill / hb_stall injection (FD_CHAOS)
-    from firedancer_tpu.disco import flight
+    from firedancer_tpu.disco import flight, xray
     from firedancer_tpu.disco import sentinel as sentinel_mod
 
     fr = flight.recorder("supervisor")
@@ -279,6 +279,7 @@ def _supervised(
                     tiles[name] = fresh
                     total_restarts += 1
                     fr.record("respawn", tile=name, restarts=fresh.restarts)
+                    xray.maybe_autopsy(f"crash:{name}", wksp=wksp)
                     last_beat.pop(name, None)
                     continue
                 rc = tp.proc.poll()
@@ -345,6 +346,7 @@ def _supervised(
                     tiles[name] = fresh
                     total_restarts += 1
                     fr.record("respawn", tile=name, restarts=fresh.restarts)
+                    xray.maybe_autopsy(f"crash:{name}", wksp=wksp)
                     last_beat.pop(name, None)
             # Quiescence: source finished publishing (visible in its out
             # rings — source tiles spin until HALT, so process exit can't be
@@ -450,7 +452,13 @@ def _supervised(
     )
     from firedancer_tpu.disco.pipeline import finish_flight_run
 
-    res.stage_hist = finish_flight_run(wksp)
+    res.stage_hist = finish_flight_run(wksp, slo_summary)
+    # fd_xray: supervised runs read the shared queue region + this
+    # process's rings (worker exemplars live in the worker processes;
+    # their crash/HALT dumps carry them — the autopsy correlates what
+    # the supervisor can see: waterfall, suspects, alerts).
+    res.xray = xray.run_summary(
+        wksp, alerts=(slo_summary or {}).get("alerts"))
     res.supervisor_restarts = total_restarts  # type: ignore[attr-defined]
     res.tile_restarts = {  # type: ignore[attr-defined]
         name: tp.restarts for name, tp in tiles.items() if tp.restarts

@@ -30,7 +30,7 @@ import numpy as np
 from firedancer_tpu import flags
 from firedancer_tpu.ballet import ed25519 as oracle
 from firedancer_tpu.ballet.txn import MAX_SIG_CNT, TxnParseError, parse_txn
-from firedancer_tpu.disco import chaos, flight
+from firedancer_tpu.disco import chaos, flight, xray
 from firedancer_tpu.disco.feed.policy import (
     FLUSH_DEADLINE,
     FLUSH_FULL,
@@ -134,7 +134,8 @@ class LinkNames:
 class InLink:
     """Consumer side of a link: poll frags in seq order, detect overruns."""
 
-    def __init__(self, wksp: Workspace, names: LinkNames):
+    def __init__(self, wksp: Workspace, names: LinkNames,
+                 edge: Optional[str] = None):
         self.mcache = MCache(wksp, names.mcache)
         self.dcache = DCache(wksp, names.dcache)
         self.fseq = FSeq(wksp, names.fseq)
@@ -142,6 +143,31 @@ class InLink:
         # fseq, the last-acknowledged seq after a crash-restart (the
         # supervisor's crash-only recovery relies on this).
         self.seq = self.fseq.query()
+        # fd_xray consumer-side queue telemetry for this edge (sampled
+        # dwell = producer tspub -> drain, depth, consumer idle): None
+        # when the link has no edge name (direct test construction) or
+        # FD_XRAY=0 — hot paths gate on the handle's None-ness.
+        self.edge = edge
+        self.xq: Optional[xray.EdgeRx] = (
+            xray.edge_rx(wksp, edge) if edge else None)
+        self.xq_cnt = 0
+        # Clamped to >= 1: the stride is a modulus on the hot drain
+        # path, and a 0 from the environment must tighten sampling to
+        # every frag, never divide-by-zero a consuming tile.
+        self.xq_every = (max(1, flags.get_int("FD_XRAY_QUEUE_SAMPLE"))
+                         if self.xq is not None else 0)
+
+    def dwell_sample(self, tspub: int, now: int = 0) -> None:
+        """Sampled queue-dwell observe (every FD_XRAY_QUEUE_SAMPLE'th
+        drained frag): the queue-wait half of the xray waterfall. The
+        stride check runs FIRST so non-sampled frags cost one counter
+        increment — callers without a hoisted clock pass now=0 and the
+        tick is read only on the sampled Nth frag."""
+        self.xq_cnt += 1
+        if tspub and self.xq_cnt % self.xq_every == 0:
+            if not now:
+                now = tempo.tickcount() & 0xFFFFFFFF
+            self.xq.observe_dwell((now - tspub) & 0xFFFFFFFF)
 
     def poll(self):
         """Returns (status, frag, payload_bytes_or_None)."""
@@ -216,6 +242,13 @@ class OutLink:
         if (edge and flight.enabled()
                 and flags.get_bool("FD_TRACE_SPANS")):
             self.span = flight.edge_hist(wksp, edge)
+        # fd_xray producer-side handles: exemplar sampler (head/tail
+        # capture riding the same publish-latency computation) and the
+        # credit-stall/credits tx row. Both None when xray is off.
+        self.xspan: Optional[xray.SpanCtx] = (
+            xray.span_ctx(edge) if edge else None)
+        self.xq_tx: Optional[xray.EdgeTx] = (
+            xray.edge_tx(wksp, edge) if edge else None)
 
     def _reservoir_insert(self, lat: int) -> None:
         """Algorithm-R insert: every publish-latency sample in the
@@ -230,17 +263,24 @@ class OutLink:
             if j < self.lat_cap:
                 self.lat_ns[j] = lat
 
-    def lat_sample(self, lat: int) -> None:
-        """Per-frag sample: always-on span histogram + reservoir."""
+    def lat_sample(self, lat: int, tsorig: int = 0, tspub: int = 0) -> None:
+        """Per-frag sample: always-on span histogram + reservoir +
+        (when xray is armed and the caller passed the stamps) the
+        deterministic exemplar head/tail capture."""
         if self.span is not None:
             self.span.observe(lat)
+        if self.xspan is not None and tsorig:
+            self.xspan.observe(tsorig, tspub, lat)
         self._reservoir_insert(lat)
 
-    def lat_sample_many(self, lats) -> None:
+    def lat_sample_many(self, lats, tsorigs=None) -> None:
         """Bulk-completion variant: one vectorized histogram update for
-        the whole batch, reservoir inserts per sample as before."""
+        the whole batch, reservoir inserts per sample as before; the
+        exemplar capture is one vectorized mask over the trace ids."""
         if self.span is not None:
             self.span.observe_many(lats)
+        if self.xspan is not None and tsorigs is not None:
+            self.xspan.observe_many(tsorigs, lats)
         for lat in lats.tolist():
             self._reservoir_insert(lat)
 
@@ -271,7 +311,8 @@ class OutLink:
         self.dcache.write(self.chunk, payload)
         tspub = tempo.tickcount() & 0xFFFFFFFF
         if tsorig:
-            self.lat_sample((tspub - tsorig) & 0xFFFFFFFF)
+            self.lat_sample((tspub - tsorig) & 0xFFFFFFFF,
+                            tsorig=tsorig, tspub=tspub)
         self.mcache.publish(
             self.seq, sig, self.chunk, len(payload), ctl, tsorig, tspub
         )
@@ -333,6 +374,11 @@ class Tile:
         # jitter from the hot poll loops and matches the reference's
         # affinity contract for the native drain path.
         self.cpu_idx: Optional[int] = None
+        # fd_xray consumer-idle accounting: ns this tile spent in its
+        # idle naps, accumulated locally and flushed to the in-edge rx
+        # row at housekeep (single-writer: this tile's thread).
+        self._xq_idle_ns = 0
+        self._xq_on = any(il.xq is not None for il in self.in_links)
 
     # -- overridables ----------------------------------------------------
 
@@ -364,6 +410,9 @@ class Tile:
             from firedancer_tpu.tango.rings import (
                 frag_drain_has_ctl as _has_ctl,
             )
+            from firedancer_tpu.tango.rings import (
+                frag_drain_has_tspub as _has_tspub,
+            )
             from firedancer_tpu.tango.rings import lib as _rings_lib
 
             n = self.BULK_FRAGS
@@ -383,6 +432,8 @@ class Tile:
                 "seqs": np.zeros(n, np.uint64),
                 "ctls": np.zeros(n, np.uint16),
                 "has_ctl": _has_ctl(),
+                "tspubs": np.zeros(n, np.uint32),
+                "has_tspub": _has_tspub(),
                 "ctr": np.zeros(2, np.uint64),
                 "cap": 0xFFFF,
             }
@@ -417,6 +468,8 @@ class Tile:
             ]
             if st["has_ctl"]:  # stale .so builds lack the ctl output
                 args.append(st["ctls"].ctypes.data)
+            if st["has_tspub"]:  # stale .so builds lack the tspub output
+                args.append(st["tspubs"].ctypes.data)
             args.append(st["ctr"].ctypes.data)
             n = st["lib"].fd_frag_drain(*args)
             d_ovr = int(st["ctr"][1]) - ovr0
@@ -428,7 +481,10 @@ class Tile:
                 pay = st["pay"]
                 offs, lens = st["offs"], st["lens"]
                 sigs, tss, seqs = st["sigs"], st["ts"], st["seqs"]
-                ctls = st["ctls"]
+                ctls, tspubs = st["ctls"], st["tspubs"]
+                has_tspub = st["has_tspub"]
+                xq_now = (tempo.tickcount() & 0xFFFFFFFF
+                          if il.xq is not None and has_tspub else 0)
                 for i in range(n):
                     off = int(offs[i])
                     ln = int(lens[i])
@@ -439,9 +495,14 @@ class Tile:
                     # no ctl output; they keep the old synthesized
                     # SOM|EOM.
                     ctl = int(ctls[i]) if st["has_ctl"] else CTL_SOM_EOM
+                    tspub = int(tspubs[i]) if has_tspub else 0
+                    if xq_now:
+                        # fd_xray queue-dwell (producer publish -> this
+                        # drain), sampled every Nth frag per edge.
+                        il.dwell_sample(tspub, xq_now)
                     frag = Frag(seq=int(seqs[i]), sig=int(sigs[i]),
                                 chunk=0, sz=ln, ctl=ctl,
-                                tsorig=int(tss[i]), tspub=0)
+                                tsorig=int(tss[i]), tspub=tspub)
                     self.on_frag(frag, pay[off:off + ln].tobytes())
                 progressed = True
             # Publish-cursor semantics match the per-frag path: il.seq
@@ -457,6 +518,8 @@ class Tile:
             r, frag, payload = il.poll()
             if r == POLL_FRAG:
                 self.in_cur = il
+                if il.xq is not None:
+                    il.dwell_sample(frag.tspub)  # tick read only when due
                 self.on_frag(frag, payload)
                 il.advance()
                 progressed = True
@@ -474,6 +537,8 @@ class Tile:
         in-link fseq publication (VerifyTile's verified cursor)."""
         if self.out_link:
             self.out_link.housekeep()
+            if self.out_link.xq_tx is not None:
+                self.out_link.xq_tx.sample_credits(self.out_link.cr_avail)
             # Mirror the fctl backpressure gauge into the cnc diag
             # (IN_BACKP slot, frank/fd_frank.h:20-36 semantics).
             backp = 1 if self.out_link.fctl.in_backpressure else 0
@@ -492,10 +557,32 @@ class Tile:
             return
         self.cnc.heartbeat(now)
 
+    def _xq_housekeep(self) -> None:
+        """fd_xray queue telemetry at housekeeping rate: sampled ring
+        depth per in-edge + the idle-ns flush (both cheap; the depth
+        probe is one ns-scale PyDLL call per link). Runs on the tile
+        thread — the same thread that drains the in-links — so every
+        rx-row write stays single-threaded. VerifyTile's overridden
+        housekeep does NOT route here: in feed mode the STAGER thread
+        drains (and owns the row — see _stager_drain), and the legacy
+        native path books its telemetry at the drain site too."""
+        if not self._xq_on:
+            return
+        first = True
+        for il in self.in_links:
+            if il.xq is None:
+                continue
+            il.xq.sample_depth(il.mcache.seq_next() - il.seq)
+            if first and self._xq_idle_ns:
+                il.xq.add_idle(self._xq_idle_ns)
+                self._xq_idle_ns = 0
+                first = False
+
     def housekeep(self, now: int) -> None:
         self._beat(now)
         for il in self.in_links:
             il.housekeep()
+        self._xq_housekeep()
         self._housekeep_out()
         self.on_housekeep()
 
@@ -515,9 +602,13 @@ class Tile:
         except BaseException as e:
             # Postmortem BEFORE re-raising: the flight dump is the
             # record of what the tile was doing when it died (no-op
-            # unless FD_FLIGHT_DUMP names a directory).
+            # unless FD_FLIGHT_DUMP names a directory), and the xray
+            # autopsy bundles the window's exemplars + waterfall +
+            # suspects (no-op unless FD_XRAY_DIR names a directory).
             self.flightrec.record("crash", err=repr(e)[:200])
             flight.maybe_dump(f"crash:{self.flight_label}", wksp=self.wksp)
+            xray.maybe_autopsy(f"crash:{self.flight_label}",
+                               wksp=self.wksp)
             raise
         finally:
             # teardown must happen even if step()/on_frag() raised, or
@@ -564,6 +655,8 @@ class Tile:
                 idle_spins += 1
                 if idle_spins > 64:
                     time.sleep(20e-6)  # FD_SPIN_PAUSE analog
+                    if self._xq_on:
+                        self._xq_idle_ns += 20_000
 
     def on_halt(self) -> None:
         """Tile-specific teardown (close sockets etc)."""
@@ -573,11 +666,19 @@ class Tile:
         """Publish downstream, spinning through backpressure (counted in
         the cnc BACKP diag) until credits arrive or HALT. Returns False if
         the frag was dropped because HALT arrived first."""
+        t_stall = 0
         while not self.out_link.can_publish():
             if self.cnc.signal_query() == CNC_HALT:
                 return False
+            if not t_stall:
+                t_stall = tempo.tickcount()
             self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
             time.sleep(20e-6)
+        if t_stall and self.out_link.xq_tx is not None:
+            # fd_xray producer credit-stall: the wall time this publish
+            # spent blocked on downstream credits (the backpressure
+            # half of the waterfall attribution).
+            self.out_link.xq_tx.add_stall(tempo.tickcount() - t_stall)
         self.out_link.publish(payload, sig, tsorig=tsorig)
         if count_diag and self.in_cur is not None:
             self.in_cur.fseq.diag_add(DIAG_PUB_CNT, 1)
@@ -641,10 +742,17 @@ class ReplayTile(Tile):
             # Injected credit starvation: behave exactly like real
             # backpressure (count + back off) until the window closes.
             self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
+            if lane.xq_tx is not None:
+                lane.xq_tx.add_stall(20_000)
             time.sleep(20e-6)
             return
         if not lane.can_publish():
             self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
+            if lane.xq_tx is not None:
+                # fd_xray: source-side credit stall (one 20 us backoff
+                # per refused attempt) — a credit_starve chaos window
+                # shows up as stall_ns on the replay_verify edge.
+                lane.xq_tx.add_stall(20_000)
             time.sleep(20e-6)
             return
         if c is not None:
@@ -920,6 +1028,17 @@ class VerifyTile(Tile):
         # verify_stats, and the replay/bench artifacts all read one
         # authority instead of hand-mirrored attributes.
         self.fl = flight.tile_lane(wksp, self.flight_label)
+        # fd_xray: the tile's trigger/batch-context exemplar ring (one
+        # span per sampled txn of every dispatched batch — batch id,
+        # engine key, flush verdict, shard lane — plus quarantine /
+        # breaker / CTL_ERR trigger events), and the cached sampling
+        # threshold so the per-batch mask costs one vectorized hash.
+        self._xr_on = xray.enabled()
+        self.xr = xray.ring(f"tile:{self.flight_label}")
+        self._xr_thr = xray.sample_threshold() if self._xr_on else 0
+        self._engine_key = flight.engine_key(
+            verify_mode if backend == "tpu" else backend, batch,
+            mesh_devices, flags.get_str("FD_FRONTEND_IMPL") or "auto")
         # Per-mesh-shard metric lanes (round-12 distributed aggregation:
         # populated only when mesh_devices > 1 — one row per shard,
         # booked at dispatch with the lanes that shard's slice of the
@@ -1053,9 +1172,7 @@ class VerifyTile(Tile):
             # storm class of failure is a COMPILE-TIME pathology, and
             # before fd_flight it was invisible until it had destroyed
             # throughput.
-            ekey = flight.engine_key(
-                verify_mode, batch, mesh_devices,
-                flags.get_str("FD_FRONTEND_IMPL") or "auto")
+            ekey = self._engine_key
             t_c = time.perf_counter()
             np.asarray(self._verify_batch_fn(*warm_args))
             self._account_compile(ekey, time.perf_counter() - t_c)
@@ -1120,6 +1237,57 @@ class VerifyTile(Tile):
     @property
     def stat_ctl_err(self) -> int:
         return self.fl.get("ctl_err_drop")
+
+    def _xr_batch(self, tsorigs, n: int, verdict: str, device: bool,
+                  slot_idx=None, tlanes=None) -> None:
+        """fd_xray batch-context exemplars: one span per HEAD-SAMPLED
+        txn of a dispatched batch, carrying the batch ordinal, engine
+        key (mode x B x shards x frontend), flush verdict, and — on a
+        sharded mesh — the shard lane the txn's signatures land on.
+        One vectorized hash per batch; Python only for the hits."""
+        if not self._xr_on or n <= 0:
+            return
+        ids = np.asarray(tsorigs[:n], np.uint64)
+        idxs = np.nonzero(xray.sampled_mask(ids, self._xr_thr))[0]
+        if idxs.size == 0:
+            return
+        now = tempo.tickcount() & 0xFFFFFFFF
+        batch_no = self.stat_batches
+        shards = len(self.fl_shards)
+        lane_start = None
+        if shards and tlanes is not None:
+            lane_start = np.zeros(n, np.int64)
+            np.cumsum(np.asarray(tlanes[:n], np.int64)[:-1],
+                      out=lane_start[1:])
+        per = self.batch // shards if shards else 0
+        for i in idxs[:16]:
+            extra = {
+                "batch": batch_no,
+                "engine": self._engine_key,
+                "verdict": verdict,
+                "device": device,
+            }
+            if slot_idx is not None:
+                extra["slot"] = slot_idx
+            if lane_start is not None:
+                extra["shard"] = int(lane_start[i]) // per
+            t = int(ids[i])
+            self.xr.record(t, t, now, "head", extra)
+
+    def _xr_trigger(self, trigger: str, tsorigs=None, **extra) -> None:
+        """fd_xray tail-trigger event (quarantine / breaker / ctl_err):
+        recorded with up to 8 of the affected trace ids so the
+        autopsy's exemplar section names transactions, not just
+        counters."""
+        if not self._xr_on:
+            return
+        ids = []
+        if tsorigs is not None:
+            ids = [int(t) for t in np.asarray(tsorigs).ravel()[:8]]
+        now = tempo.tickcount() & 0xFFFFFFFF
+        self.xr.record(ids[0] if ids else 0, ids[0] if ids else 0, now,
+                       trigger,
+                       dict(extra, traces=ids, engine=self._engine_key))
 
     def _account_compile(self, engine: str, seconds: float) -> None:
         rec = flight.record_compile(engine, seconds)
@@ -1275,6 +1443,7 @@ class VerifyTile(Tile):
             # of the chaos ring_ctl_err class.
             self.fl.inc("ctl_err_drop", int(d[6]))
             self.flightrec.record("ctl_err_drop", n=int(d[6]))
+            self._xr_trigger("ctl_err", n=int(d[6]))
             self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, int(d[6]))
             self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, int(d[7]))
             if c is not None:
@@ -1460,6 +1629,13 @@ class VerifyTile(Tile):
             # a deterministic overrun on the next poll.
             c.stager_round_hook()
             c.overrun_rewind(il)
+        if il.xq is not None:
+            # The STAGER thread is the one writer of the feeder's
+            # in-edge rx row (VerifyTile.housekeep deliberately skips
+            # the base _xq_housekeep — a tile-thread write here would
+            # break the row's single-writer contract), so depth is
+            # sampled per drain round alongside the dwell below.
+            il.xq.sample_depth(il.mcache.seq_next() - il.seq)
         ct = self._nd_ct
         k0 = slot.n_txn
         seq = ct.c_uint64(il.seq)
@@ -1512,6 +1688,10 @@ class VerifyTile(Tile):
                 self.stat_ring_dwell_ns.append(dwell)
             if self._dwell_span is not None:
                 self._dwell_span.observe(dwell)
+            if il.xq is not None:
+                # fd_xray queue row for the feeder's in-edge: the same
+                # round-oldest dwell the verify_drain stage reports.
+                il.xq.observe_dwell(dwell)
         # Offsets came back relative to the round's arena base; make
         # them absolute so the completion's bulk publish can read every
         # round of this slot with one base pointer.
@@ -1570,7 +1750,7 @@ class VerifyTile(Tile):
             seq_before = il.seq
             n = self._stager_drain(slot)
             if slot.n_lane >= self.batch:
-                self._feed_commit(slot)
+                self._feed_commit(slot, FLUSH_FULL)
                 idle_spins = 0
                 continue
             if n > 0:
@@ -1583,7 +1763,7 @@ class VerifyTile(Tile):
                 # multisig txn that cannot fit the remaining lane room.
                 # Ship the slot as effectively-full instead of letting
                 # the deadline timer misbook a 25 ms stall per batch.
-                self._feed_commit(slot)
+                self._feed_commit(slot, "capacity")
                 idle_spins = 0
                 continue
             if slot.n_txn:
@@ -1591,7 +1771,7 @@ class VerifyTile(Tile):
                     # Held-back acks are about to exhaust the producer's
                     # credits: a partial batch beats a stalled pipeline
                     # (uncounted force, matching the legacy path).
-                    self._feed_commit(slot)
+                    self._feed_commit(slot, "ring_starved")
                     continue
                 verdict = self.flush_policy.due(
                     tempo.tickcount(), slot.n_lane, self.batch,
@@ -1608,7 +1788,7 @@ class VerifyTile(Tile):
                         self.fl.inc("flush_starved")
                     self.flightrec.record("flush", verdict=verdict,
                                           lanes=slot.n_lane)
-                    self._feed_commit(slot)
+                    self._feed_commit(slot, verdict)
                     continue
             # Empty drain round: sleep IMMEDIATELY rather than hot-spin.
             # The feeder works at batch granularity (a cpu batch is
@@ -1620,7 +1800,8 @@ class VerifyTile(Tile):
             idle_spins += 1
             time.sleep(20e-6 if idle_spins <= 8 else 100e-6)
 
-    def _feed_commit(self, slot) -> None:
+    def _feed_commit(self, slot, verdict: str = FLUSH_FULL) -> None:
+        slot.flush_verdict = verdict  # fd_xray batch-context exemplars
         self._feed_slot = None
         self.feed_pool.commit(slot)
 
@@ -1691,6 +1872,8 @@ class VerifyTile(Tile):
         self.fl.inc("lanes", slot.n_lane)
         self.flightrec.record("dispatch", lanes=slot.n_lane,
                               device=via_device)
+        self._xr_batch(slot.tsorigs, slot.n_txn, slot.flush_verdict,
+                       via_device, slot_idx=slot.idx, tlanes=slot.tlanes)
 
     def _verify_slot_cpu(self, slot):
         """The CPU oracle lane over a staged slot: the failover target
@@ -1771,11 +1954,16 @@ class VerifyTile(Tile):
         without letting it shadow a valid same-sig txn) instead of
         silently vanishing. Same HALT/backpressure discipline as
         publish_backp."""
+        t_stall = 0
         while not self.out_link.can_publish():
             if self.cnc.signal_query() == CNC_HALT:
                 return
+            if not t_stall:
+                t_stall = tempo.tickcount()
             self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
             time.sleep(20e-6)
+        if t_stall and self.out_link.xq_tx is not None:
+            self.out_link.xq_tx.add_stall(tempo.tickcount() - t_stall)
         self.out_link.publish(payload, sig, ctl=CTL_SOM_EOM | CTL_ERR)
         self.fl.inc("quarantine_err_txn")
 
@@ -1835,12 +2023,17 @@ class VerifyTile(Tile):
             # Credit-windowed bulk publish: same fctl discipline as
             # publish_backp (spin through backpressure, drop on HALT),
             # amortized over the window instead of paid per frag.
+            t_stall = 0
             while not ol.can_publish():
                 if self.cnc.signal_query() == CNC_HALT:
                     halted = True  # drop the rest, like publish_backp
                     break
+                if not t_stall:
+                    t_stall = tempo.tickcount()
                 self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
                 time.sleep(20e-6)
+            if t_stall and ol.xq_tx is not None:
+                ol.xq_tx.add_stall(tempo.tickcount() - t_stall)
             if halted:
                 break
             pub = self._nd_lib.fd_frag_publish_bulk(
@@ -1870,7 +2063,7 @@ class VerifyTile(Tile):
         ts = ts[ts != 0]
         if ts.size:
             lats = (now32 - ts.astype(np.int64)) & 0xFFFFFFFF
-            ol.lat_sample_many(lats)
+            ol.lat_sample_many(lats, ts)
         return slot.drain_end
 
     def _feed_poll(self):
@@ -1931,6 +2124,8 @@ class VerifyTile(Tile):
             if cur != self._breaker_pub and self._breaker_pub[0] is not None:
                 self.flightrec.record("breaker", state=b.state,
                                       trips=b.trips, reprobes=b.reprobes)
+                self._xr_trigger("breaker", state=b.state, trips=b.trips,
+                                 reprobes=b.reprobes)
             self._breaker_pub = cur
         self.fl.publish()
         for shard in self.fl_shards:
@@ -1999,6 +2194,7 @@ class VerifyTile(Tile):
             )
             via_device = True
         todo = self._pending
+        lanes0 = self._pending_lanes
         self.fl.inc("lanes", self._pending_lanes)
         self._book_shard_lanes(self._pending_lanes)
         self._pending = []
@@ -2009,6 +2205,11 @@ class VerifyTile(Tile):
             t_dispatch=tempo.tickcount(), device=via_device,
         ))
         self.fl.inc("batches")
+        if self._xr_on:
+            self._xr_batch(
+                np.array([t[2] for t in todo], np.uint64), len(todo),
+                FLUSH_FULL if lanes0 >= self.batch else "partial",
+                via_device)
 
     def _ack_inline(self, frag: Frag) -> None:
         """A frag handled to completion inside on_frag (filtered or
@@ -2023,6 +2224,7 @@ class VerifyTile(Tile):
             # native drain's ctl word drop): filter, never verify.
             self.fl.inc("ctl_err_drop")
             self.flightrec.record("ctl_err_drop", n=1)
+            self._xr_trigger("ctl_err", tsorigs=[frag.tsorig], n=1)
             self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, 1)
             self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, len(payload))
             c = chaos.active()
@@ -2213,7 +2415,7 @@ class VerifyTile(Tile):
             slot = self._feed_slot
             if slot is not None:
                 if slot.n_txn:
-                    self._feed_commit(slot)
+                    self._feed_commit(slot, "halt")
                 else:
                     # An empty FILLING slot must return to FREE, or the
                     # pool-integrity audit (slots_leaked) reads a
@@ -2298,6 +2500,12 @@ class VerifyTile(Tile):
             self.fl.inc("batches")
             self.fl.inc("lanes", len(flat))
             self._book_shard_lanes(len(flat))
+            if self._xr_on:
+                self._xr_batch(
+                    np.array([t[2] for t in todo], np.uint64), len(todo),
+                    FLUSH_FULL if len(flat) >= self.batch else "partial",
+                    True,
+                    tlanes=np.array([t[1] for t in todo], np.int64))
             del self._pending[:take]
             self._pending_lanes -= len(flat)
             if self._pending:
@@ -2328,6 +2536,13 @@ class VerifyTile(Tile):
                 self.fl.inc("quarantined")
                 self.flightrec.record("quarantine",
                                       err=repr(e)[:120])
+                if self._xr_on:
+                    ids = (ib.slot.tsorigs[:ib.slot.n_txn]
+                           if ib.slot is not None
+                           else np.array([t[2] for t in ib.todo],
+                                         np.uint64))
+                    self._xr_trigger("quarantine", ids,
+                                     err=repr(e)[:80])
                 if ib.device and self._breaker is not None:
                     self._breaker.record_error(tempo.tickcount())
                 fault_cls = (e.cls if isinstance(e, chaos.ChaosFault)
@@ -2624,6 +2839,10 @@ class SinkTile(Tile):
         self._e2e_span: Optional[flight.EdgeHist] = None
         if flight.enabled() and flags.get_bool("FD_TRACE_SPANS"):
             self._e2e_span = flight.edge_hist(wksp, "sink")
+        # fd_xray e2e exemplar sampler: the sink's head/tail capture
+        # closes every sampled txn's span chain (correlated by the
+        # deterministic trace-id hash — no coordination with upstream).
+        self._xr_ctx: Optional[xray.SpanCtx] = xray.span_ctx("sink")
 
     def on_frag(self, frag: Frag, payload: bytes) -> None:
         self.recv_cnt += 1
@@ -2637,6 +2856,9 @@ class SinkTile(Tile):
             lat = (tempo.tickcount() - frag.tsorig) & 0xFFFFFFFF
             if self._e2e_span is not None:
                 self._e2e_span.observe(lat)
+            if self._xr_ctx is not None:
+                self._xr_ctx.observe(frag.tsorig,
+                                     (frag.tsorig + lat) & 0xFFFFFFFF, lat)
             self._latency_seen += 1
             if len(self.latencies_ns) < self.latency_sample_cap:
                 self.latencies_ns.append(lat)

@@ -42,7 +42,7 @@ def build_tile(wksp, pod, name: str, opts: dict):
     lanes = pod.query_ulong("firedancer.layout.verify_lane_cnt", 1)
 
     def in_link(link):
-        return InLink(wksp, _link_names(pod, link))
+        return InLink(wksp, _link_names(pod, link), edge=link)
 
     if name == "replay":
         with open(opts["payloads_path"], "rb") as f:
@@ -291,10 +291,18 @@ def main(argv=None) -> int:
             if opts.get("record_digests") else None,
         }
 
+    # fd_xray exemplar rings are process-local: ship this worker's
+    # spans home in the result file so the runner can correlate
+    # cross-process span chains by trace id (the deterministic hash
+    # guarantees both processes sampled the SAME txns).
+    from firedancer_tpu.disco import xray
+
     if args.result and not multi and tile_names[0] == "sink":
-        # Single-tile sink: the supervisor's result schema, unchanged.
+        # Single-tile sink: the supervisor's result schema, plus the
+        # xray spans section (consumers accept-and-ignore it).
         with open(args.result, "w") as f:
-            json.dump(_sink_result(tiles[0]), f)
+            json.dump(dict(_sink_result(tiles[0]),
+                           xray={"spans": xray.dump_spans()}), f)
     elif args.result and multi:
         # Multi-tile (fd_feed downstream pool): one json keyed by tile,
         # each with its out-link tsorig->tspub percentiles (the
@@ -311,6 +319,7 @@ def main(argv=None) -> int:
                 d.update(_sink_result(tile))
                 d["e2e_lat"] = latency_percentiles(tile.latencies_ns)
             out[name] = d
+        out["xray"] = {"spans": xray.dump_spans()}
         with open(args.result, "w") as f:
             json.dump(out, f)
     return 0

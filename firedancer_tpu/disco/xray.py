@@ -1,0 +1,865 @@
+"""fd_xray — tail-sampled exemplar traces, per-edge queue/backpressure
+attribution, and automated postmortem bundles.
+
+The third observability layer. fd_flight (PR 6) answers "how slow is
+each edge" with always-on log2 histograms; fd_sentinel (PR 7) answers
+"is that a violation" with burn-rate SLO alerts. Neither answers the
+first question of an actual page: **which transactions, which ring,
+queue-wait or service time, and under which engine/flush decision** —
+the runbook recipe for that was manual log archaeology. fd_xray makes
+it mechanical, in three parts:
+
+  EXEMPLARS   full span chains for a sampled subset of transactions.
+              Head sampling is keyed DETERMINISTICALLY off the trace id
+              (the 32-bit ``tsorig`` stamp minted once at source
+              publish): every tile hashes the id with the same
+              multiplicative mix and compares against the same
+              ``FD_XRAY_SAMPLE`` threshold, so all stages — across
+              threads and worker processes, with zero coordination —
+              sample the SAME transactions and the sink can correlate
+              complete chains by id. On top of the head sample, TAIL
+              triggers capture any txn landing in a log2 bucket past
+              2x its docs/LATENCY.md budget (the sentinel's
+              one-bucket-of-slack rule, budgets resolved from the SAME
+              FD_SLO_* flags — docs/SLO.md is the single source of
+              truth), plus quarantine / breaker-transition / CTL_ERR
+              events. Spans land in bounded per-edge rings
+              (single-writer: each publish edge has one producing
+              tile; the flight-recorder pattern, docs/OWNERSHIP.md),
+              are dumped inside every flight-dump envelope, and export
+              as Chrome trace-event JSON (scripts/fd_xray.py
+              --chrome-trace, Perfetto-loadable).
+
+  QUEUE       per-ring-edge telemetry that splits each stage's latency
+              into queue-wait vs service: a sampled dwell histogram
+              (producer ``tspub`` -> consumer drain, the generalization
+              of the feeder's ``verify_drain`` ring-dwell stage to
+              every edge), producer credit-stall ns (wall time spent
+              spinning in the fctl backpressure loops), consumer idle
+              ns, and sampled depth / available credits. Rows live in
+              a ``xray.queue`` shared-memory region next to the flight
+              registry (one rx row per edge written by the consumer,
+              one tx row written by the producer — single-writer each).
+              ``waterfall()`` rolls them into the per-stage queue-wait
+              vs service decomposition ``fd_report.py --waterfall``
+              renders and fd_top's XRAY panel shows live.
+
+  AUTOPSY     on any sentinel alert (via a dedicated flusher thread so
+              the poller never blocks on file IO), tile crash, or HALT,
+              bundle the window's exemplar traces, merged metrics,
+              waterfall, chaos schedule, and FD_* flags snapshot into
+              ONE ``xray_autopsy_*.json`` artifact with a
+              suspected-stage ranking (alert-backed stages first,
+              then largest budget-share wins); ``fd_report.py
+              --autopsy`` renders it.
+
+Deliberately stdlib+numpy only (the disco/tiles.py jax-import-free
+dispatch contract): every hook below runs on host tile threads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue as _queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from firedancer_tpu import flags
+from firedancer_tpu.disco import flight, sentinel
+
+_U64 = (1 << 64) - 1
+_U32 = 0xFFFFFFFF
+
+# Knuth multiplicative mix over the 32-bit trace id. The SAME constant
+# everywhere is the whole design: stage-local sampling decisions agree
+# bit-exactly without any coordination.
+_HASH_MULT = 0x9E3779B1
+
+# Dwell samples larger than this are 32-bit tick-wrap artifacts, not
+# queue waits (the stager's existing rule for the verify_drain stage).
+_DWELL_WRAP_NS = 4_000_000_000
+
+# Trigger classes an exemplar span/event can carry.
+TRIGGERS = ("head", "tail", "quarantine", "breaker", "ctl_err", "crash")
+
+# ``xray.queue`` shared region: per edge one rx row (consumer-written)
+# and one tx row (producer-written). rx row layout = one EdgeHist row
+# (dwell: [sum_ns, bucket_0..]) + [idle_ns, depth_sum, depth_n]; tx row
+# reuses the same width with [stall_ns, stall_cnt, cr_sum, cr_n] in the
+# leading slots. Single writer per ROW keeps the no-atomics contract.
+_QUEUE_REGION = "xray.queue"
+_MAGIC_QUEUE = 0xF11687_0004
+Q_SLOTS = flight.EDGE_SLOTS + 3
+RX_IDLE_NS = flight.EDGE_SLOTS
+RX_DEPTH_SUM = flight.EDGE_SLOTS + 1
+RX_DEPTH_N = flight.EDGE_SLOTS + 2
+TX_STALL_NS, TX_STALL_CNT, TX_CR_SUM, TX_CR_N = 0, 1, 2, 3
+
+# The cumulative-edge chain the waterfall decomposes (consumer stage,
+# in-edge = the ring it drains, out-edge = the cumulative span marking
+# the stage complete). The verify stage's queue term is the feeder's
+# long-standing verify_drain ring-dwell; every other stage's comes
+# from the same dwell measure generalized in the rx rows.
+STAGE_CHAIN = (
+    ("verify", "replay_verify", "verify_dedup"),
+    ("dedup", "verify_dedup", "dedup_pack"),
+    ("pack", "dedup_pack", "pack_sink"),
+    ("sink", "pack_sink", "sink"),
+)
+
+
+def enabled() -> bool:
+    """FD_XRAY=0 is the overhead-bisection hatch (exemplars, queue
+    telemetry, and autopsies all off; pipeline OUTPUT is bit-identical
+    either way — xray only ever observes). Rides on fd_flight: with
+    FD_FLIGHT=0 there are no trace spans to sample from."""
+    return flags.get_bool("FD_XRAY") and flight.enabled()
+
+
+def sample_threshold() -> int:
+    """Hash threshold for 1-in-FD_XRAY_SAMPLE head sampling (0 disables
+    head sampling; tail triggers stay armed)."""
+    n = flags.get_int("FD_XRAY_SAMPLE")
+    if n <= 0:
+        return 0
+    return (1 << 32) // n
+
+
+def sampled(trace_id: int, threshold: Optional[int] = None) -> bool:
+    """The ONE head-sampling decision, stage-independent: every tile
+    evaluates this same pure function of the trace id, so the sampled
+    set is identical everywhere with zero coordination. id 0 means
+    'no source stamp' and never samples."""
+    if not trace_id:
+        return False
+    if threshold is None:
+        threshold = sample_threshold()
+    return ((trace_id * _HASH_MULT) & _U32) < threshold
+
+
+def sampled_mask(ids, threshold: Optional[int] = None) -> np.ndarray:
+    """Vectorized `sampled` for the fd_feed bulk completion path."""
+    if threshold is None:
+        threshold = sample_threshold()
+    a = np.asarray(ids, np.uint64)
+    h = (a * np.uint64(_HASH_MULT)) & np.uint64(_U32)
+    return (h < np.uint64(threshold)) & (a != 0)
+
+
+def tail_threshold_ns(edge: str) -> int:
+    """Tail-capture threshold for one edge: the lower bound of the
+    first log2 bucket provably past 2x the edge's budget — the exact
+    docs/LATENCY.md one-bucket-of-slack rule fd_sentinel burns error
+    budget by, with the budget resolved from the SAME FD_SLO_* flag
+    (docs/SLO.md stays the single source of truth). 0 = no latency SLO
+    covers this edge (tail capture disarmed there)."""
+    base = edge.split(".v")[0]  # lane variants share the base budget
+    for slo in sentinel.SLO_TABLE:
+        if slo.kind == "latency" and slo.edge_or_stage == base:
+            budget_ns = flags.get_int(slo.budget_flag) * 1_000_000
+            return 1 << (sentinel._bad_from_bucket(budget_ns) - 1)
+    return 0
+
+
+# --------------------------------------------------------------------------
+# Exemplar span rings — the flight-recorder pattern: bounded, per-edge
+# (one producing tile per publish edge), locked only because triggers
+# can land from the dispatcher thread while publishes run on the tile
+# thread. Process-local; dumped inside the flight envelope + worker
+# results, correlated at sink by trace id.
+# --------------------------------------------------------------------------
+
+
+class SpanRing:
+    """Bounded ring of exemplar spans (trace, tsorig, tspub, trigger,
+    extra) plus per-trigger totals (the exemplar accounting the bench
+    artifact and the autopsy report by class)."""
+
+    __slots__ = ("name", "buf", "pos", "n", "counts", "_lock")
+
+    def __init__(self, name: str, cap: int):
+        self.name = name
+        self.buf: List[Optional[tuple]] = [None] * max(cap, 8)
+        self.pos = 0
+        self.n = 0
+        self.counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def record(self, trace_id: int, tsorig: int, tspub: int, trigger: str,
+               extra: Optional[dict] = None) -> None:
+        with self._lock:
+            self.buf[self.pos] = (trace_id, tsorig, tspub, trigger, extra)
+            self.pos = (self.pos + 1) % len(self.buf)
+            self.n += 1
+            self.counts[trigger] = self.counts.get(trigger, 0) + 1
+
+    def spans(self) -> List[dict]:
+        """Chronological span dicts currently held (oldest first)."""
+        with self._lock:
+            buf = list(self.buf)
+            pos, n = self.pos, self.n
+        cap = len(buf)
+        start = pos if n >= cap else 0
+        out = []
+        for i in range(min(n, cap)):
+            e = buf[(start + i) % cap]
+            if e is None:
+                continue
+            trace_id, tsorig, tspub, trigger, extra = e
+            d = {"trace": trace_id, "tsorig": tsorig, "tspub": tspub,
+                 "lat_ns": (tspub - tsorig) & _U32, "trigger": trigger}
+            if extra:
+                d.update(extra)
+            out.append(d)
+        return out
+
+
+class _NullRing:
+    __slots__ = ()
+    name = "null"
+    n = 0
+    counts: Dict[str, int] = {}
+
+    def record(self, trace_id, tsorig, tspub, trigger, extra=None) -> None:
+        pass
+
+    def spans(self) -> List[dict]:
+        return []
+
+
+_NULL_RING = _NullRing()
+_rings: Dict[str, SpanRing] = {}
+_rings_lock = threading.Lock()
+
+
+def ring(name: str):
+    """A FRESH exemplar ring registered under `name` (latest wins, the
+    flight.recorder contract: each tile incarnation gets its own ring;
+    dumps show the current run's). No-op ring when FD_XRAY=0."""
+    if not enabled():
+        return _NULL_RING
+    r = SpanRing(name, flags.get_int("FD_XRAY_RING"))
+    with _rings_lock:
+        _rings[name] = r
+    return r
+
+
+def dump_spans() -> Dict[str, dict]:
+    """{ring: {n_total, counts, spans}} across every live ring."""
+    with _rings_lock:
+        rings = dict(_rings)
+    return {
+        name: {"n_total": r.n, "counts": dict(r.counts), "spans": r.spans()}
+        for name, r in sorted(rings.items())
+    }
+
+
+class SpanCtx:
+    """One publish edge's exemplar sampler, bound into the hot path
+    next to the EdgeHist observe: ONE hash + compare per frag decides
+    head capture; one compare decides tail capture. Constructed per
+    OutLink/sink, so the thresholds are resolved once, not per frag."""
+
+    __slots__ = ("edge", "ring", "thr", "tail_ns")
+
+    def __init__(self, edge: str):
+        self.edge = edge
+        self.ring = ring(f"edge:{edge}")
+        self.thr = sample_threshold()
+        self.tail_ns = tail_threshold_ns(edge)
+
+    def observe(self, tsorig: int, tspub: int, lat: int) -> None:
+        if sampled(tsorig, self.thr):
+            self.ring.record(tsorig, tsorig, tspub, "head")
+        elif self.tail_ns and lat >= self.tail_ns and lat < _DWELL_WRAP_NS:
+            self.ring.record(tsorig, tsorig, tspub, "tail")
+
+    def observe_many(self, ts_arr, lats) -> None:
+        """Vectorized variant (the fd_feed bulk completion): numpy mask
+        first, Python only for the handful of hits."""
+        ts = np.asarray(ts_arr, np.uint64)
+        la = np.asarray(lats, np.int64)
+        head = sampled_mask(ts, self.thr)
+        for i in np.nonzero(head)[0]:
+            t = int(ts[i])
+            self.ring.record(t, t, (t + int(la[i])) & _U32, "head")
+        if self.tail_ns:
+            tail = (~head) & (la >= self.tail_ns) \
+                & (la < _DWELL_WRAP_NS) & (ts != 0)
+            for i in np.nonzero(tail)[0]:
+                t = int(ts[i])
+                self.ring.record(t, t, (t + int(la[i])) & _U32, "tail")
+
+
+def span_ctx(edge: str) -> Optional[SpanCtx]:
+    """The OutLink/sink construction hook: a bound sampler when xray is
+    armed, else None (hot paths gate on the handle's None-ness, the
+    fd_flight pattern — zero per-frag cost when off)."""
+    if not enabled():
+        return None
+    return SpanCtx(edge)
+
+
+# --------------------------------------------------------------------------
+# Queue/backpressure telemetry — the ``xray.queue`` shared region.
+# --------------------------------------------------------------------------
+
+
+def create_region(wksp, edge_labels) -> None:
+    """Allocate + label the queue-telemetry region (build_topology is
+    the one creator, like flight.create_regions): one rx + one tx row
+    per edge, pre-labeled so attachers never race a claim."""
+    labels = [f"{e}|rx" for e in edge_labels] + \
+             [f"{e}|tx" for e in edge_labels]
+    wksp.alloc(_QUEUE_REGION,
+               flight._region_footprint(len(labels), Q_SLOTS))
+    a = np.frombuffer(wksp.view(_QUEUE_REGION), np.uint64)
+    a[:] = 0
+    a[0] = _MAGIC_QUEUE
+    a[1] = len(labels)
+    a[2] = Q_SLOTS
+    for i, label in enumerate(labels):
+        row = 4 + i * (flight._LABEL_U64 + Q_SLOTS)
+        a[row: row + flight._LABEL_U64] = np.frombuffer(
+            flight._pack_label(label), np.uint64)
+
+
+def _attach(wksp, label: str):
+    if wksp is None:
+        return None
+    try:
+        return flight._attach_row(wksp, _QUEUE_REGION, _MAGIC_QUEUE,
+                                  Q_SLOTS, label)
+    except Exception:
+        return None
+
+
+class EdgeRx:
+    """Consumer-side row of one edge: sampled dwell histogram (producer
+    tspub -> consumer drain), idle ns, depth samples. Single writer:
+    the edge's one DRAINING THREAD — the consuming tile's run loop for
+    generic tiles, the fd_feed stager for the verify in-edge (the
+    tile thread never touches that row; see tiles._stager_drain)."""
+
+    __slots__ = ("label", "row", "hist")
+
+    def __init__(self, label: str, row=None):
+        self.label = label
+        self.row = row if row is not None else np.zeros(Q_SLOTS, np.uint64)
+        self.hist = flight.EdgeHist(label, self.row[: flight.EDGE_SLOTS])
+
+    def observe_dwell(self, ns: int) -> None:
+        if 0 <= ns < _DWELL_WRAP_NS:
+            self.hist.observe(ns)
+
+    def add_idle(self, ns: int) -> None:
+        self.row[RX_IDLE_NS] = np.uint64(
+            (int(self.row[RX_IDLE_NS]) + ns) & _U64)
+
+    def sample_depth(self, depth: int) -> None:
+        self.row[RX_DEPTH_SUM] += np.uint64(max(depth, 0))
+        self.row[RX_DEPTH_N] += np.uint64(1)
+
+
+class EdgeTx:
+    """Producer-side row of one edge: credit-stall wall ns (time spent
+    spinning in the fctl backpressure loops) + sampled available
+    credits. Single writer: the edge's one producing tile."""
+
+    __slots__ = ("label", "row")
+
+    def __init__(self, label: str, row=None):
+        self.label = label
+        self.row = row if row is not None else np.zeros(Q_SLOTS, np.uint64)
+
+    def add_stall(self, ns: int) -> None:
+        if ns > 0:
+            self.row[TX_STALL_NS] = np.uint64(
+                (int(self.row[TX_STALL_NS]) + ns) & _U64)
+            self.row[TX_STALL_CNT] += np.uint64(1)
+
+    def sample_credits(self, cr: int) -> None:
+        self.row[TX_CR_SUM] += np.uint64(max(cr, 0))
+        self.row[TX_CR_N] += np.uint64(1)
+
+
+def edge_rx(wksp, label: str) -> Optional[EdgeRx]:
+    """Consumer attach (disco/tiles.py InLink is the one caller — the
+    ownership WRITER_TABLE pins it). None when xray is off; degrades to
+    a process-local row when the workspace predates the region."""
+    if not enabled():
+        return None
+    return EdgeRx(label, _attach(wksp, f"{label}|rx"))
+
+
+def edge_tx(wksp, label: str) -> Optional[EdgeTx]:
+    """Producer attach (disco/tiles.py OutLink is the one caller)."""
+    if not enabled():
+        return None
+    return EdgeTx(label, _attach(wksp, f"{label}|tx"))
+
+
+def read_queue(wksp) -> Optional[Dict[str, dict]]:
+    """{edge: {dwell summary, idle/stall/depth/credit telemetry}} from
+    the shared region (None when the workspace predates fd_xray)."""
+    rows = flight._region_rows(wksp, _QUEUE_REGION, _MAGIC_QUEUE, Q_SLOTS)
+    if rows is None:
+        return None
+    rx: Dict[str, np.ndarray] = {}
+    tx: Dict[str, np.ndarray] = {}
+    for label, row in rows:
+        base, _, side = label.rpartition("|")
+        (rx if side == "rx" else tx)[base] = row
+    out: Dict[str, dict] = {}
+    for edge in rx:
+        r, t = rx[edge], tx.get(edge)
+        dwell = flight.EdgeHist(edge, r[: flight.EDGE_SLOTS]).summary()
+        depth_n = int(r[RX_DEPTH_N])
+        cr_n = int(t[TX_CR_N]) if t is not None else 0
+        out[edge] = {
+            "dwell": dwell,
+            "idle_ns": int(r[RX_IDLE_NS]),
+            "depth_avg": round(int(r[RX_DEPTH_SUM]) / depth_n, 1)
+            if depth_n else 0.0,
+            "depth_samples": depth_n,
+            "stall_ns": int(t[TX_STALL_NS]) if t is not None else 0,
+            "stall_cnt": int(t[TX_STALL_CNT]) if t is not None else 0,
+            "cr_avail_avg": round(int(t[TX_CR_SUM]) / cr_n, 1)
+            if cr_n else 0.0,
+        }
+    return out
+
+
+# --------------------------------------------------------------------------
+# The waterfall: queue-wait vs service per stage, reconciled against
+# the always-on EdgeHist totals.
+# --------------------------------------------------------------------------
+
+
+def _mean_ns(summary: Optional[dict]) -> Optional[float]:
+    if not summary or not summary.get("n"):
+        return None
+    return summary["sum_ns"] / summary["n"]
+
+
+def _lane_labels(d: Dict[str, dict], base: str) -> List[str]:
+    """`base` plus its per-lane variants (replay_verify.v1, ... — the
+    sentinel's aggregation rule): multi-lane topologies must fold every
+    lane into the decomposition, not silently drop lanes > 0."""
+    return [label for label in d
+            if label == base or label.startswith(base + ".v")]
+
+
+def _merged_summary(d: Optional[Dict[str, dict]], base: str,
+                    pick=lambda row: row) -> Optional[dict]:
+    """One EdgeHist-style summary over a base edge and its lane
+    variants: n and sum_ns add exactly (they are counters); the p99
+    bound merges conservatively as the max across lanes."""
+    rows = [pick(d[label]) for label in _lane_labels(d or {}, base)]
+    rows = [r for r in rows if isinstance(r, dict) and r.get("n")]
+    if not rows:
+        return None
+    return {
+        "n": sum(r["n"] for r in rows),
+        "sum_ns": sum(r.get("sum_ns", 0) for r in rows),
+        "p99_ns_le": max(r.get("p99_ns_le", 0) for r in rows),
+    }
+
+
+def waterfall(edges: Optional[Dict[str, dict]],
+              queue: Optional[Dict[str, dict]]) -> List[dict]:
+    """Per-stage decomposition over the STAGE_CHAIN: for each consumer
+    stage, queue-wait comes from the INDEPENDENTLY measured dwell
+    histogram of its in-edge (verify's from the long-standing
+    verify_drain ring-dwell edge) and service is the residual of the
+    cumulative EdgeHist means (cum_out - cum_in - queue, floored at 0).
+    Means decompose exactly where p99s cannot; the p99 bounds of both
+    measures ride along for the report. The xray_smoke lane gates that
+    the reconstruction re-sums to the sink EdgeHist within one log2
+    bucket — the decomposition is cross-checked against the always-on
+    totals, not assumed."""
+    edges = edges or {}
+    queue = queue or {}
+    out: List[dict] = []
+    for stage, in_edge, out_edge in STAGE_CHAIN:
+        # Lane-aggregated: '<edge>.v<N>' variants fold into the base
+        # edge (counters add exactly), so a backed-up lane > 0 cannot
+        # hide from the decomposition.
+        cum_in = _mean_ns(_merged_summary(edges, in_edge))
+        cum_out = _mean_ns(_merged_summary(edges, out_edge))
+        if stage == "verify" and "verify_drain" in edges:
+            q_summary = _merged_summary(edges, "verify_drain")
+        else:
+            q_summary = _merged_summary(
+                queue, in_edge, pick=lambda row: row.get("dwell") or {})
+        q_mean = _mean_ns(q_summary) or 0.0
+        q_rows = [queue[label] for label in _lane_labels(queue, in_edge)]
+        service = None
+        if cum_in is not None and cum_out is not None:
+            service = max(0.0, cum_out - cum_in - q_mean)
+        out.append({
+            "stage": stage,
+            "in_edge": in_edge,
+            "out_edge": out_edge,
+            "queue_mean_ns": round(q_mean, 1),
+            "queue_p99_ns_le": (q_summary or {}).get("p99_ns_le", 0),
+            "queue_n": (q_summary or {}).get("n", 0),
+            "service_mean_ns": round(service, 1)
+            if service is not None else None,
+            "cum_mean_ns": round(cum_out, 1) if cum_out is not None else None,
+            "cum_p99_ns_le": (_merged_summary(edges, out_edge)
+                              or {}).get("p99_ns_le", 0),
+            "stall_ns": sum(r.get("stall_ns", 0) for r in q_rows),
+            "idle_ns": sum(r.get("idle_ns", 0) for r in q_rows),
+            "depth_avg": round(sum(r.get("depth_avg", 0.0)
+                                   for r in q_rows), 1),
+        })
+    return out
+
+
+def waterfall_reconciles(edges: Dict[str, dict], wf: List[dict],
+                         slack_factor: float = 2.0) -> bool:
+    """The xray_smoke gate: source mean + sum of per-stage
+    (queue + service) must land within one log2 bucket (factor 2) of
+    the sink EdgeHist mean. Vacuously True when the chain is not fully
+    populated (partial topologies must not fail the check)."""
+    src = _mean_ns(_merged_summary(edges, "replay_verify"))
+    sink = _mean_ns(_merged_summary(edges, "sink"))
+    if src is None or sink is None:
+        return True
+    total = src
+    for st in wf:
+        if st["service_mean_ns"] is None:
+            return True
+        total += st["queue_mean_ns"] + st["service_mean_ns"]
+    lo, hi = sink / slack_factor, sink * slack_factor
+    return lo <= total <= hi
+
+
+# --------------------------------------------------------------------------
+# Postmortem bundles.
+# --------------------------------------------------------------------------
+
+
+def flags_snapshot() -> Dict[str, str]:
+    """Every registered FD_* flag explicitly set in the environment
+    (registry accessors only — the fdlint flag-registry discipline)."""
+    return {name: flags.get_raw(name) or ""
+            for name in sorted(flags.REGISTRY) if flags.is_set(name)}
+
+
+def suspect_ranking(edges: Optional[Dict[str, dict]],
+                    slos: Optional[Dict[str, dict]],
+                    alerts: Optional[List[dict]] = None) -> List[dict]:
+    """Ranked suspected stages. Alert-backed suspects first (an active
+    sentinel alert is a CONFIRMED burn; its score is the reported burn/
+    stall over budget), then passive latency stages by budget share
+    (p99_ns_le / the 2x-budget limit — 'largest budget-share regression
+    wins'). When the caller has no alert list (crash-path autopsies:
+    Tile.run, supervisor respawn) the shared SLO rows stand in — a row
+    in alert state at crash time IS the sentinel's live verdict. Every
+    entry carries why, so the report is an explanation, not a name."""
+    out: List[dict] = []
+    budgets = {s.name: flags.get_int(s.budget_flag)
+               for s in sentinel.SLO_TABLE}
+    if not alerts and slos:
+        alerts = [
+            {
+                "slo": name,
+                "edge_or_stage": sentinel.SLO_BY_NAME[name].edge_or_stage,
+                "burn_milli": int(row.get("burn_milli", 0)),
+                "fault_classes": list(
+                    sentinel.SLO_BY_NAME[name].fault_classes),
+                "from_slo_rows": True,
+            }
+            for name, row in sorted(slos.items())
+            if name in sentinel.SLO_BY_NAME
+            and (row.get("state") or row.get("alerts"))
+        ]
+    for a in alerts or []:
+        budget = max(budgets.get(a.get("slo"), 0), 1)
+        burn = a.get("burn_milli", 0) / 1000.0
+        slo = sentinel.SLO_BY_NAME.get(a.get("slo"))
+        score = (burn / budget if slo is not None and slo.kind == "liveness"
+                 else burn)
+        out.append({
+            "stage": a.get("edge_or_stage", "?"),
+            "slo": a.get("slo"),
+            "score": round(max(score, 1.0), 3),
+            "alerted": True,
+            "fault_classes": a.get("fault_classes", []),
+            "why": f"sentinel alert on {a.get('slo')} "
+                   f"(burn_milli={a.get('burn_milli')})",
+        })
+    alerted = {o["slo"] for o in out}
+    for slo in sentinel.SLO_TABLE:
+        if slo.kind != "latency" or slo.name in alerted:
+            continue
+        labels = [label for label in (edges or {})
+                  if label == slo.edge_or_stage
+                  or label.startswith(slo.edge_or_stage + ".v")]
+        limit = 2 * budgets[slo.name] * 1_000_000
+        for label in labels:
+            s = edges[label]
+            if not s.get("n") or limit <= 0:
+                continue
+            out.append({
+                "stage": label,
+                "slo": slo.name,
+                "score": round(s["p99_ns_le"] / limit, 3),
+                "alerted": False,
+                "fault_classes": list(slo.fault_classes),
+                "why": f"p99_ns_le {s['p99_ns_le']:,} vs limit "
+                       f"{limit:,} (2x {slo.budget_flag})",
+            })
+    out.sort(key=lambda o: (not o["alerted"], -o["score"]))
+    return out
+
+
+def _top_slowest(spans_by_ring: Dict[str, dict], k: int = 3) -> List[dict]:
+    """The k slowest exemplar traces with their per-stage breakdown
+    (spans of one trace across every edge ring, sorted by tspub — the
+    monotone chain the integrity tests pin)."""
+    traces: Dict[int, List[dict]] = {}
+    for name, sect in spans_by_ring.items():
+        if not name.startswith("edge:"):
+            continue
+        edge = name[5:]
+        for s in sect.get("spans", []):
+            if s.get("trigger") not in ("head", "tail"):
+                continue
+            traces.setdefault(s["trace"], []).append(dict(s, edge=edge))
+    scored = []
+    for trace, spans in traces.items():
+        spans.sort(key=lambda s: (s["tspub"] - s["tsorig"]) & _U32)
+        e2e = next((s for s in spans if s["edge"] == "sink"), spans[-1])
+        scored.append({
+            "trace": trace,
+            "lat_ns": e2e["lat_ns"],
+            "trigger": e2e["trigger"],
+            "stages": {s["edge"]: s["lat_ns"] for s in spans},
+        })
+    scored.sort(key=lambda t: -t["lat_ns"])
+    return scored[:k]
+
+
+def exemplar_counts(spans_by_ring: Dict[str, dict]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for sect in spans_by_ring.values():
+        for trig, n in (sect.get("counts") or {}).items():
+            out[trig] = out.get(trig, 0) + n
+    return out
+
+
+def run_summary(wksp=None, extra_spans: Optional[Dict[str, dict]] = None,
+                alerts: Optional[List[dict]] = None) -> Optional[dict]:
+    """The PipelineResult.xray / bench-artifact block: exemplar counts
+    by trigger class, distinct sampled traces, the top-3 slowest
+    exemplars with stage breakdown, and the waterfall — assembled from
+    this process's rings (+ worker-result spans when the feed runtime
+    passes them) and the shared registry."""
+    if not enabled():
+        return None
+    spans = dump_spans()
+    for name, sect in (extra_spans or {}).items():
+        if name in spans:
+            merged = dict(sect)
+            merged["spans"] = spans[name].get("spans", []) + \
+                list(sect.get("spans", []))
+            merged["n_total"] = spans[name].get("n_total", 0) + \
+                sect.get("n_total", 0)
+            counts = dict(spans[name].get("counts", {}))
+            for k, v in (sect.get("counts") or {}).items():
+                counts[k] = counts.get(k, 0) + v
+            merged["counts"] = counts
+            spans[name] = merged
+        else:
+            spans[name] = sect
+    traces = set()
+    for name, sect in spans.items():
+        if name.startswith("edge:"):
+            traces.update(s["trace"] for s in sect.get("spans", [])
+                          if s.get("trigger") in ("head", "tail"))
+    edges = flight.read_edges(wksp) if wksp is not None else None
+    queue = read_queue(wksp) if wksp is not None else None
+    wf = waterfall(edges, queue)
+    return {
+        "sample_rate": flags.get_int("FD_XRAY_SAMPLE"),
+        "exemplars": exemplar_counts(spans),
+        "traces": len(traces),
+        "top_slowest": _top_slowest(spans),
+        "waterfall": wf,
+        "suspects": suspect_ranking(edges, None, alerts)[:5],
+    }
+
+
+def build_autopsy(reason: str, wksp=None,
+                  alerts: Optional[List[dict]] = None,
+                  extra_spans: Optional[Dict[str, dict]] = None) -> dict:
+    """One self-contained postmortem bundle (the artifact
+    ``fd_report.py --autopsy`` renders): suspects ranking, exemplar
+    spans, waterfall + queue telemetry, merged metrics/SLO rows, the
+    chaos schedule that (maybe) caused it, and the FD_* flag
+    snapshot."""
+    from firedancer_tpu.disco import chaos
+
+    spans = dump_spans()
+    for name, sect in (extra_spans or {}).items():
+        spans.setdefault(name, sect)
+    edges = slos = metrics = queue = None
+    if wksp is not None and getattr(wksp, "_h", None):
+        try:
+            edges = flight.read_edges(wksp)
+            slos = flight.read_slos(wksp)
+            metrics = flight.read_tiles(wksp)
+            queue = read_queue(wksp)
+        except Exception:
+            pass
+    c = chaos.active()
+    return {
+        "schema_version": flight.ARTIFACT_SCHEMA_VERSION,
+        "kind": "xray_autopsy",
+        "reason": reason,
+        "pid": os.getpid(),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "alerts": list(alerts or []),
+        "suspects": suspect_ranking(edges, slos, alerts),
+        "exemplars": {
+            "counts": exemplar_counts(spans),
+            "top_slowest": _top_slowest(spans),
+            "spans": spans,
+        },
+        "waterfall": waterfall(edges, queue),
+        "queue": queue,
+        "edges": edges,
+        "metrics": metrics,
+        "slos": slos,
+        "chaos": None if c is None else dict(
+            c.snapshot(),
+            schedule=flags.get_raw("FD_CHAOS_SCHEDULE") or "",
+        ),
+        "flags": flags_snapshot(),
+    }
+
+
+def maybe_autopsy(reason: str, wksp=None,
+                  alerts: Optional[List[dict]] = None,
+                  extra_spans: Optional[Dict[str, dict]] = None,
+                  ) -> Optional[str]:
+    """Write the autopsy when FD_XRAY_DIR names a directory (sentinel
+    alert / tile crash / HALT triggers all route here); returns the
+    path or None. Never raises — a failing postmortem writer must not
+    mask the fault it documents (the flight.maybe_dump contract)."""
+    try:
+        d = flags.get_raw("FD_XRAY_DIR")
+        if not d or not enabled():
+            return None
+        os.makedirs(d, exist_ok=True)
+        slug = "".join(c if c.isalnum() else "_" for c in reason)[:48]
+        path = os.path.join(
+            d,
+            f"xray_autopsy_{os.getpid()}_{int(time.time() * 1e3)}_"
+            f"{slug}.json")
+        with open(path, "w") as f:
+            json.dump(build_autopsy(reason, wksp=wksp, alerts=alerts,
+                                    extra_spans=extra_spans), f, indent=1)
+        return path
+    except Exception:
+        return None
+
+
+class AutopsyFlusher:
+    """Alert-time autopsy writer on its own daemon thread: the
+    sentinel poller enqueues (never blocks on file IO — the judge must
+    stay cheap) and this thread bundles + writes. Reads only mapped
+    registry rows, so the owning sentinel stops it BEFORE the runner's
+    wksp.leave() (registered in the pass-6 ownership THREAD_TABLE)."""
+
+    def __init__(self, wksp=None):
+        self._wksp = wksp
+        self._q: _queue.Queue = _queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.written: List[str] = []
+
+    def start(self) -> "AutopsyFlusher":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fd_xray_autopsy", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while True:
+            try:
+                item = self._q.get(timeout=0.25)
+            except _queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            if item is None:
+                return
+            reason, alerts = item
+            path = maybe_autopsy(reason, wksp=self._wksp, alerts=alerts)
+            if path:
+                self.written.append(path)
+
+    def request(self, reason: str, alerts: Optional[List[dict]] = None
+                ) -> None:
+        self._q.put((reason, list(alerts or [])))
+
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def stop(self) -> None:
+        """Drain pending requests, then stop (idempotent). Bounded:
+        each write is a JSON dump of bounded rings/rows."""
+        self._stop.set()
+        self._q.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+
+def flusher_for_run(wksp=None) -> Optional[AutopsyFlusher]:
+    """A started flusher when alert-time autopsies can ever fire
+    (FD_XRAY_DIR set + xray armed), else None — the sentinel owns the
+    stop, before the runner leaves the workspace."""
+    if not enabled() or not flags.get_raw("FD_XRAY_DIR"):
+        return None
+    return AutopsyFlusher(wksp).start()
+
+
+# --------------------------------------------------------------------------
+# Chrome trace-event export (Perfetto / chrome://tracing loadable).
+# --------------------------------------------------------------------------
+
+
+def to_chrome_trace(spans_by_ring: Dict[str, dict]) -> dict:
+    """Exemplar spans as Chrome trace-event JSON: one complete ("X")
+    event per span — ts = the trace id's mint tick (us), dur = the
+    span latency (us), one pid per edge ring, tid = trace id — so a
+    sampled txn's chain lines up as one row per stage in Perfetto."""
+    events = []
+    pids = {}
+    for name, sect in sorted(spans_by_ring.items()):
+        pid = pids.setdefault(name, len(pids) + 1)
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name},
+        })
+        for s in sect.get("spans", []):
+            events.append({
+                "name": name[5:] if name.startswith("edge:") else name,
+                "cat": s.get("trigger", "span"),
+                "ph": "X",
+                "ts": s["tsorig"] / 1e3,
+                "dur": max(s.get("lat_ns", 0), 1) / 1e3,
+                "pid": pid,
+                "tid": s.get("trace", 0),
+                "args": {k: v for k, v in s.items()
+                         if k not in ("tsorig", "tspub")},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -493,6 +493,55 @@ _register(
     "the sentinel's history actually spans it, so runs shorter than "
     "this cannot latency-alert (liveness SLOs are unaffected).",
 )
+# --------------------------------------------------------------------------
+# fd_xray — tail-sampled exemplar traces, per-edge queue attribution,
+# and automated postmortems (disco/xray.py). All read per run; tail
+# thresholds resolve from the FD_SLO_* budgets above (docs/SLO.md is
+# the single source of truth).
+# --------------------------------------------------------------------------
+
+_register(
+    "FD_XRAY", bool, True,
+    "fd_xray exemplar traces + per-edge queue/backpressure telemetry + "
+    "autopsy bundles. '0' is the overhead-bisection hatch: span "
+    "sampling, dwell/stall/depth observes, and autopsy writes all off "
+    "(pipeline output is bit-identical either way — xray only "
+    "observes). Rides on FD_FLIGHT: with flight off there are no trace "
+    "spans to sample from.",
+)
+_register(
+    "FD_XRAY_SAMPLE", int, 64,
+    "Head-sampling rate for exemplar traces: 1 in N transactions, "
+    "keyed DETERMINISTICALLY off the trace id (the tsorig stamp) with "
+    "one shared multiplicative hash, so every tile — across threads "
+    "and worker processes, zero coordination — samples the SAME txns "
+    "and the sink correlates full span chains by id. 0 disables head "
+    "sampling (tail/quarantine/breaker/CTL_ERR triggers stay armed).",
+)
+_register(
+    "FD_XRAY_RING", int, 512,
+    "Exemplar spans kept per xray ring (one single-writer ring per "
+    "publish edge plus per-tile trigger rings — the flight-recorder "
+    "pattern). Memory is O(cap) tuples per ring.",
+)
+_register(
+    "FD_XRAY_QUEUE_SAMPLE", int, 16,
+    "Per-edge queue-dwell sampling stride: every Nth drained frag "
+    "observes (producer tspub -> consumer drain) into the edge's "
+    "xray.queue dwell histogram — the queue-wait half of the "
+    "fd_report --waterfall decomposition. Values < 1 clamp to 1 "
+    "(every frag); disable queue telemetry with FD_XRAY=0, not here.",
+)
+_register(
+    "FD_XRAY_DIR", str, None,
+    "Directory for xray_autopsy_*.json postmortem bundles. When set, "
+    "an autopsy is written on every sentinel alert (via the xray "
+    "flusher thread), tile crash, and pipeline HALT (see "
+    "docs/RUNBOOK.md 'reading an xray autopsy'). Unset (the default) "
+    "writes nothing — sampling still runs, so the HALT flight dump "
+    "carries the exemplar rings regardless.",
+)
+
 _register(
     "FD_REPORT_REGRESS_PCT", float, 10.0,
     "scripts/fd_report.py regression threshold: a device measurement "

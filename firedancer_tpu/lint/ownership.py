@@ -132,6 +132,18 @@ THREAD_TABLE: Tuple[ThreadSite, ...] = (
         "rows directly",
     ),
     ThreadSite(
+        "firedancer_tpu/disco/xray.py", "AutopsyFlusher.start:self._loop",
+        "fd_xray alert-time autopsy writer (sentinel poll() only "
+        "enqueues; this thread bundles exemplars + waterfall + "
+        "suspects and writes xray_autopsy_*.json)",
+        "Event+sentinel queue stopped and joined in stop(); the owning "
+        "Sentinel stops it in its own stop(), before the runner's "
+        "wksp.leave()",
+        "reads mapped registry/queue rows only until stop(); "
+        "Sentinel.alive() — part of every runner's leave-guard — "
+        "reports True while this thread lives",
+    ),
+    ThreadSite(
         "firedancer_tpu/utils/tpool.py", "TPool.__init__:self._worker",
         "spin-style fork-join pool for host-parallel byte work",
         "halt flag + go Events; process-lifetime daemon workers",
@@ -180,6 +192,17 @@ WRITER_TABLE: Dict[str, Tuple[str, ...]] = {
     # owning tile; regions are created once by build_topology.
     "flight.tile_lane": ("firedancer_tpu/disco/tiles.py",),
     "flight.create_regions": ("firedancer_tpu/disco/pipeline.py",),
+    # fd_xray: queue-region creation (build_topology, once), the
+    # per-edge rx/tx telemetry rows (consumer/producer tile of the
+    # edge — tiles.py holds both call sites: InLink/OutLink
+    # construction), and the single-writer exemplar rings (per-edge
+    # publish rings via span_ctx in OutLink/SinkTile, per-tile trigger
+    # rings via ring in VerifyTile).
+    "xray.create_region": ("firedancer_tpu/disco/pipeline.py",),
+    "xray.edge_rx": ("firedancer_tpu/disco/tiles.py",),
+    "xray.edge_tx": ("firedancer_tpu/disco/tiles.py",),
+    "xray.span_ctx": ("firedancer_tpu/disco/tiles.py",),
+    "xray.ring": ("firedancer_tpu/disco/tiles.py",),
     # fd_sentinel SLO rows: one sentinel per run, in the runner
     # process, is the single writer.
     "SLO_EVALS": ("firedancer_tpu/disco/sentinel.py",),
@@ -319,6 +342,9 @@ class _Scanner(ast.NodeVisitor):
         elif leaf in ("tile_lane", "create_regions") and root.startswith(
                 "flight."):
             self._check_resource(node, f"flight.{leaf}")
+        elif leaf in ("create_region", "edge_rx", "edge_tx", "span_ctx",
+                      "ring") and root.startswith("xray."):
+            self._check_resource(node, f"xray.{leaf}")
         self.generic_visit(node)
 
     def _check_resource(self, node: ast.AST, resource: str) -> None:

@@ -184,6 +184,12 @@ def load_lib() -> ctypes.CDLL:
             # CTL_ERR is not laundered into a normal frag on the bulk
             # path. A stale .so keeps the pre-ctl call shape.
             argt.insert(len(argt) - 1, ctypes.c_void_p)     # ctls
+        if hasattr(lib, "fd_frag_drain_has_tspub"):
+            # Current ABI: the drain also exports the producer publish
+            # stamp per frag — fd_xray's per-edge queue-dwell (ring
+            # wait) attribution on the bulk path. Probe discipline as
+            # above: a stale .so keeps the pre-tspub call shape.
+            argt.insert(len(argt) - 1, ctypes.c_void_p)     # tspubs
         lib.fd_frag_drain.argtypes = argt
     return lib
 
@@ -266,6 +272,18 @@ def frag_drain_has_ctl() -> bool:
     synthesize CTL_SOM_EOM for it, exactly the pre-ctl behavior."""
     try:
         return hasattr(lib(), "fd_frag_drain_has_ctl")
+    except Exception:
+        return False
+
+
+def frag_drain_has_tspub() -> bool:
+    """True when fd_frag_drain exports the producer publish stamp per
+    frag (current ABI) — the fd_xray queue-dwell input on the bulk
+    drain path. A stale .so keeps the pre-tspub call shape; callers
+    then skip dwell attribution for bulk-drained edges (the sampled
+    telemetry degrades, nothing corrupts)."""
+    try:
+        return hasattr(lib(), "fd_frag_drain_has_tspub")
     except Exception:
         return False
 

@@ -504,12 +504,18 @@ uint64_t fd_cnc_diag_get(void* mem, uint32_t idx) {
 // synthesized CTL_SOM_EOM) instead of corrupting the stack.
 int fd_frag_drain_has_ctl(void) { return 1; }
 
+// ABI marker: fd_frag_drain also exports the producer's publish stamp
+// per frag (tspubs, after ctls) — fd_xray's per-edge queue-dwell
+// attribution (now - tspub = ring wait) needs it on the bulk path the
+// downstream tiles actually run. Same probe discipline as has_ctl.
+int fd_frag_drain_has_tspub(void) { return 1; }
+
 int fd_frag_drain(void *mcache, void *dcache_base, uint64_t *seq_io,
                   uint32_t max_n, uint32_t mtu,
                   uint8_t *payloads, uint32_t payload_cap,
                   uint32_t *offs, uint32_t *lens, uint64_t *sigs,
                   uint32_t *tsorigs, uint64_t *seqs, uint16_t *ctls,
-                  uint64_t *counters) {
+                  uint32_t *tspubs, uint64_t *counters) {
   auto *h = (mcache_hdr *)mcache;
   auto *line = (frag_meta *)((char *)mcache + sizeof(mcache_hdr));
   uint64_t seq = *seq_io;
@@ -530,6 +536,7 @@ int fd_frag_drain(void *mcache, void *dcache_base, uint64_t *seq_io,
     uint16_t sz = m->sz.load(std::memory_order_relaxed);
     uint16_t ctl = m->ctl.load(std::memory_order_relaxed);
     uint32_t tsorig = m->tsorig.load(std::memory_order_relaxed);
+    uint32_t tspub = m->tspub.load(std::memory_order_relaxed);
     uint32_t cp = sz <= mtu ? sz : mtu;
     if (pay_off + cp > payload_cap) break;  // out of staging room
     std::memcpy(payloads + pay_off,
@@ -546,6 +553,7 @@ int fd_frag_drain(void *mcache, void *dcache_base, uint64_t *seq_io,
     tsorigs[n] = tsorig;
     seqs[n] = seq;
     ctls[n] = ctl;
+    tspubs[n] = tspub;
     pay_off += cp;
     n += 1;
     counters[0] += 1;

@@ -108,6 +108,38 @@ def validate_entry(rec: dict) -> List[str]:
             errs.append(f"'{metric}' record missing numeric 'value'")
         if not isinstance(rec.get("unit"), str):
             errs.append(f"'{metric}' record missing 'unit'")
+    errs.extend(_validate_xray(rec.get("xray")))
+    return errs
+
+
+def _validate_xray(x) -> List[str]:
+    """Shape of the optional fd_xray artifact block (None is valid —
+    FD_XRAY=0 runs; a present block must carry the exemplar accounting
+    the trend reports and autopsy cross-checks read)."""
+    if x is None:
+        return []
+    if not isinstance(x, dict):
+        return ["'xray' must be an object or null"]
+    errs: List[str] = []
+    if not isinstance(x.get("sample_rate"), int) \
+            or isinstance(x.get("sample_rate"), bool) \
+            or x["sample_rate"] < 0:
+        errs.append("'xray.sample_rate' missing or not a non-negative int")
+    if not isinstance(x.get("exemplars"), dict) or not all(
+            isinstance(v, int) and not isinstance(v, bool)
+            for v in x["exemplars"].values()):
+        errs.append("'xray.exemplars' must map trigger class -> count")
+    top = x.get("top_slowest")
+    if not isinstance(top, list) or len(top) > 3:
+        errs.append("'xray.top_slowest' must be a list of <= 3 exemplars")
+    else:
+        for t in top:
+            if not isinstance(t, dict) or "trace" not in t \
+                    or not isinstance(t.get("lat_ns"), int) \
+                    or not isinstance(t.get("stages"), dict):
+                errs.append(
+                    "'xray.top_slowest' entries need trace/lat_ns/stages")
+                break
     return errs
 
 

@@ -18,10 +18,16 @@ Usage:
     python scripts/fd_report.py --dump-spec      # docs/SLO.md body
     python scripts/fd_report.py --slo DUMP.json  # latency-SLO check of
                                                  # a flight dump's edges
+    python scripts/fd_report.py --waterfall F    # fd_xray queue-wait vs
+                                                 # service per stage (F =
+                                                 # flight dump / replay
+                                                 # artifact / autopsy)
+    python scripts/fd_report.py --autopsy F      # render an
+                                                 # xray_autopsy_*.json
     python scripts/fd_report.py --repo DIR       # non-default root
 
-docs/RUNBOOK.md ("responding to an SLO burn alert") walks a worked
-example.
+docs/RUNBOOK.md ("responding to an SLO burn alert" and "reading an
+xray autopsy") walk worked examples.
 """
 
 from __future__ import annotations
@@ -226,6 +232,106 @@ def slo_check_dump(path: str) -> int:
     return 1
 
 
+def _load_edges_queue(doc: dict):
+    """(edges, queue) out of any artifact shape that carries them: a
+    flight dump ({edges, xray.queue}), a replay artifact (stage_hist +
+    xray.waterfall), or an autopsy ({edges, queue})."""
+    edges = doc.get("edges") or doc.get("stage_hist") or {}
+    queue = doc.get("queue") or (doc.get("xray") or {}).get("queue") or {}
+    return edges, queue
+
+
+def render_waterfall(wf, edges=None) -> str:
+    from firedancer_tpu.disco import xray
+
+    widths = (8, 14, 12, 12, 12, 12, 10, 10, 7)
+    lines = ["== XRAY WATERFALL (queue-wait vs service per stage) =="]
+    lines.append(_fmt_row(
+        ("stage", "in-edge", "queue-mean", "service", "cum-mean",
+         "cum-p99<=", "stall-ms", "idle-ms", "depth"), widths))
+    for st in wf:
+        lines.append(_fmt_row((
+            st["stage"], st["in_edge"],
+            f"{st['queue_mean_ns'] / 1e6:.2f}ms",
+            "-" if st["service_mean_ns"] is None
+            else f"{st['service_mean_ns'] / 1e6:.2f}ms",
+            "-" if st["cum_mean_ns"] is None
+            else f"{st['cum_mean_ns'] / 1e6:.2f}ms",
+            f"{st['cum_p99_ns_le'] / 1e6:.1f}ms",
+            f"{st['stall_ns'] / 1e6:.1f}",
+            f"{st['idle_ns'] / 1e6:.1f}",
+            st["depth_avg"]), widths))
+    if edges is not None:
+        ok = xray.waterfall_reconciles(edges, wf)
+        lines.append(
+            "reconciliation vs EdgeHist totals (one log2 bucket): "
+            + ("OK" if ok else "FAILED"))
+    return "\n".join(lines)
+
+
+def waterfall_cmd(path: str) -> int:
+    from firedancer_tpu.disco import xray
+
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc.get("waterfall"), list):
+        wf = doc["waterfall"]
+        edges = doc.get("edges")
+    else:
+        edges, queue = _load_edges_queue(doc)
+        if not edges:
+            print(f"fd_report: {path} carries no edge histograms")
+            return 1
+        wf = xray.waterfall(edges, queue)
+    print(render_waterfall(wf, edges))
+    return 0
+
+
+def autopsy_cmd(path: str) -> int:
+    """Render an xray_autopsy_*.json: the suspected-stage ranking
+    first (the answer to the page), then the alerts, waterfall, and
+    the top exemplars with per-stage breakdown."""
+    with open(path) as f:
+        a = json.load(f)
+    if a.get("kind") != "xray_autopsy":
+        print(f"fd_report: {path} is not an xray autopsy "
+              f"(kind={a.get('kind')!r})")
+        return 1
+    print(f"== XRAY AUTOPSY [{a.get('reason')}] at {a.get('ts')} "
+          f"(pid {a.get('pid')}) ==")
+    suspects = a.get("suspects") or []
+    if suspects:
+        top = suspects[0]
+        print(f"SUSPECTED STAGE: {top['stage']} "
+              f"(slo={top.get('slo')}, score={top.get('score')}, "
+              f"{'ALERTED' if top.get('alerted') else 'budget share'})")
+        for s in suspects[1:5]:
+            print(f"  also: {s['stage']} score={s.get('score')} "
+                  f"— {s.get('why')}")
+        print(f"  why: {top.get('why')}")
+    for al in a.get("alerts") or []:
+        print(f"alert: {al.get('slo')} burn_milli={al.get('burn_milli')} "
+              f"fault_classes={al.get('fault_classes')}")
+    chaos = a.get("chaos")
+    if chaos:
+        print(f"chaos: seed={chaos.get('seed')} "
+              f"schedule={chaos.get('schedule')!r} "
+              f"counters={chaos.get('counters')}")
+    print()
+    print(render_waterfall(a.get("waterfall") or [], a.get("edges")))
+    ex = a.get("exemplars") or {}
+    print()
+    print(f"exemplars by trigger: {ex.get('counts')}")
+    for t in (ex.get("top_slowest") or [])[:3]:
+        stages = " -> ".join(f"{k}:{v / 1e6:.1f}ms"
+                             for k, v in (t.get("stages") or {}).items())
+        print(f"  trace {t['trace']}: {t['lat_ns'] / 1e6:.1f}ms "
+              f"[{t.get('trigger')}] {stages}")
+    if a.get("flags"):
+        print(f"flags: {a['flags']}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--repo", default=os.path.dirname(
@@ -237,6 +343,11 @@ def main(argv=None) -> int:
     ap.add_argument("--slo", metavar="DUMP",
                     help="evaluate a flight dump's edges vs the latency "
                          "SLOs; exit 1 on violation")
+    ap.add_argument("--waterfall", metavar="FILE",
+                    help="render the fd_xray queue-wait vs service "
+                         "decomposition of a dump/artifact/autopsy")
+    ap.add_argument("--autopsy", metavar="FILE",
+                    help="render an xray_autopsy_*.json postmortem")
     ap.add_argument("--regress-pct", type=float, default=None)
     args = ap.parse_args(argv)
 
@@ -245,6 +356,10 @@ def main(argv=None) -> int:
         return 0
     if args.slo:
         return slo_check_dump(args.slo)
+    if args.waterfall:
+        return waterfall_cmd(args.waterfall)
+    if args.autopsy:
+        return autopsy_cmd(args.autopsy)
     timeline = sentinel.load_timeline(args.repo)
     if args.json:
         out = {

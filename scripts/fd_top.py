@@ -11,6 +11,9 @@ pipeline's workspace + pod and renders, per refresh interval,
     (tsorig -> tspub trace spans; n / p50 / p99 upper-bucket bounds),
   - a VERIFY panel: the verify tiles' registry rows (compile
     accounting included),
+  - an XRAY panel: fd_xray's per-edge queue attribution (sampled
+    dwell p50/p99, ring depth, producer credit-stall, consumer idle,
+    available credits — disco/xray.py's queue region),
   - an SLO panel: every declared fd_sentinel SLO's state / alert
     counters / current burn rate (disco/sentinel.py; docs/SLO.md is
     the spec).
@@ -52,6 +55,26 @@ def render_flight(snap: dict, ansi: bool = True) -> str:
             lines.append(
                 f"{name:<16}{d['n']:>10}"
                 f"{_fmt_ns(d['p50_ns_le']):>12}{_fmt_ns(d['p99_ns_le']):>12}"
+            )
+    xqs = [(k[3:], d) for k, d in sorted(snap.items())
+           if k.startswith("xq.")]
+    if xqs:
+        lines.append("")
+        lines.append(
+            f"{bold}{'XRAY edge':<16}{'q-p50<=':>10}{'q-p99<=':>10}"
+            f"{'q-n':>8}{'depth':>7}{'stall-ms':>10}{'idle-ms':>9}"
+            f"{'cr-avg':>8}{rst}"
+        )
+        for name, d in xqs:
+            lines.append(
+                f"{name:<16}"
+                f"{_fmt_ns(d.get('dwell_p50_ns_le', 0)):>10}"
+                f"{_fmt_ns(d.get('dwell_p99_ns_le', 0)):>10}"
+                f"{d.get('dwell_n', 0):>8}"
+                f"{d.get('depth_avg', 0.0):>7}"
+                f"{d.get('stall_ns', 0) / 1e6:>10.1f}"
+                f"{d.get('idle_ns', 0) / 1e6:>9.1f}"
+                f"{d.get('cr_avail_avg', 0.0):>8}"
             )
     slos = [(k[4:], d) for k, d in sorted(snap.items())
             if k.startswith("slo.")]

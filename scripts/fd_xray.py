@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""fd_xray — exemplar-trace tooling over fd_xray artifacts.
+
+Input is any artifact carrying an xray spans section: a flight dump
+(``FD_FLIGHT_DUMP``; the "xray" envelope section), an
+``xray_autopsy_*.json`` bundle (``FD_XRAY_DIR``), or a worker result
+file. Sampling is deterministic off the trace id, so spans of one
+transaction from DIFFERENT processes' dumps correlate by id — pass
+several files and they merge.
+
+Usage:
+    python scripts/fd_xray.py --chrome-trace DUMP.json [...] [-o OUT]
+        # Chrome trace-event JSON (chrome://tracing / Perfetto): one
+        # row per edge, one complete event per exemplar span.
+    python scripts/fd_xray.py --spans DUMP.json [...]
+        # correlated span chains by trace id, slowest first
+
+The queue-wait vs service waterfall lives in
+``fd_report.py --waterfall``; autopsy rendering in
+``fd_report.py --autopsy``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from firedancer_tpu.disco import xray  # noqa: E402
+
+
+def _spans_sections(doc: dict) -> dict:
+    """The {ring: {spans, counts, n_total}} section of any supported
+    artifact shape (flight dump, autopsy, worker result)."""
+    x = doc.get("xray") or {}
+    if "spans" in x:
+        return x["spans"]
+    ex = doc.get("exemplars") or {}
+    if isinstance(ex.get("spans"), dict):   # autopsy bundle
+        return ex["spans"]
+    return {}
+
+
+def load_spans(paths) -> dict:
+    merged: dict = {}
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        for name, sect in _spans_sections(doc).items():
+            if name not in merged:
+                merged[name] = {"n_total": 0, "counts": {}, "spans": []}
+            m = merged[name]
+            m["n_total"] += sect.get("n_total", 0)
+            for k, v in (sect.get("counts") or {}).items():
+                m["counts"][k] = m["counts"].get(k, 0) + v
+            m["spans"].extend(sect.get("spans") or [])
+    return merged
+
+
+def chains(spans_by_ring: dict) -> list:
+    """Correlated per-trace chains, slowest first: the operator view
+    of 'which transactions' (each span's edge + latency, monotone in
+    cumulative latency by construction of the tsorig stamps)."""
+    traces: dict = {}
+    for name, sect in spans_by_ring.items():
+        for s in sect.get("spans") or []:
+            traces.setdefault(s["trace"], []).append(dict(s, ring=name))
+    out = []
+    for trace, spans in traces.items():
+        spans.sort(key=lambda s: s.get("lat_ns", 0))
+        out.append({
+            "trace": trace,
+            "e2e_lat_ns": spans[-1].get("lat_ns", 0),
+            "triggers": sorted({s.get("trigger") for s in spans}),
+            "spans": spans,
+        })
+    out.sort(key=lambda t: -t["e2e_lat_ns"])
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="+",
+                    help="flight dumps / autopsies / worker results")
+    ap.add_argument("--chrome-trace", action="store_true",
+                    help="emit Chrome trace-event JSON")
+    ap.add_argument("--spans", action="store_true",
+                    help="list correlated span chains, slowest first")
+    ap.add_argument("-o", "--out", default="",
+                    help="output path (default stdout)")
+    ap.add_argument("--limit", type=int, default=20,
+                    help="--spans: chains shown (default 20)")
+    args = ap.parse_args(argv)
+
+    spans = load_spans(args.files)
+    if not spans:
+        print("fd_xray: no xray spans in the given files", file=sys.stderr)
+        return 1
+    out = open(args.out, "w") if args.out else sys.stdout
+    try:
+        if args.chrome_trace:
+            json.dump(xray.to_chrome_trace(spans), out, indent=1)
+            out.write("\n")
+            return 0
+        # default / --spans: the correlated chains
+        for c in chains(spans)[: args.limit]:
+            out.write(
+                f"trace {c['trace']}: {c['e2e_lat_ns'] / 1e6:.2f}ms "
+                f"{c['triggers']}\n")
+            for s in c["spans"]:
+                extra = {k: v for k, v in s.items()
+                         if k not in ("trace", "tsorig", "tspub", "lat_ns",
+                                      "trigger", "ring")}
+                out.write(
+                    f"    {s['ring']:<24} {s['lat_ns'] / 1e6:>9.3f}ms "
+                    f"[{s['trigger']}]"
+                    + (f" {extra}" if extra else "") + "\n")
+        return 0
+    finally:
+        if args.out:
+            out.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,293 @@
+#!/usr/bin/env python
+"""xray_smoke — the fd_xray exemplar/waterfall/autopsy gate (ci.sh lane).
+
+Four checks, one small mainnet-shaped corpus on the CPU backend:
+
+  1. EXEMPLARS, clean half — a clean fd_feed replay with xray armed
+     must head-sample at the configured rate (distinct sampled traces
+     within a binomial-tolerant band of corpus/FD_XRAY_SAMPLE), every
+     exemplar's span chain must be monotone (cumulative latency
+     nondecreasing along the stage order), and the HALT flight dump's
+     xray section must export to a valid Chrome trace-event JSON.
+
+  2. WATERFALL — the queue-wait vs service decomposition must
+     reconcile with the always-on EdgeHist totals within one log2
+     bucket (source mean + sum of per-stage queue+service vs the sink
+     EdgeHist mean), and sentinel.evaluate_edges_summary must still
+     parse both the new dump (with xray sections) and a synthesized
+     old-shape dump.
+
+  3. AUTOPSY — the SAME corpus under a seeded fd_chaos hb_stall +
+     credit_starve schedule must write xray_autopsy_*.json bundles
+     whose suspected stage matches the injected fault class BOTH ways
+     (every injected class's SLO appears among the alert-backed
+     suspects, every alert-backed suspect maps back to an injected
+     class via sentinel.FAULT_SLO), with the chaos schedule and flags
+     snapshot embedded; fd_report --autopsy must render it.
+
+  4. OVERHEAD — xray on (sampling armed) vs FD_XRAY=0 must stay
+     within 2% (+ a jitter floor on this sub-second corpus), and the
+     sink content must be BIT-IDENTICAL between the two runs (xray
+     only observes, never alters the pipeline).
+
+Exits nonzero on any violation; prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable as `python scripts/xray_smoke.py`
+    sys.path.insert(0, REPO)
+
+N = 2600
+SEED = 777
+SAMPLE = 16          # 1-in-16 head sampling -> ~160 exemplars expected
+CHAOS_SEED = 7
+# Same windows as slo_smoke: hb_stall freezes heartbeats ~2 s >> the
+# pinned FD_SLO_HB_MS; credit_starve stalls the source >> FD_SLO_STALL_MS.
+CHAOS_SCHEDULE = "hb_stall@50:20050,credit_starve@400:60400"
+INJECTED = {"hb_stall", "credit_starve"}
+# The stage order exemplar chains must be monotone along (cumulative
+# tsorig->tspub latency can only grow downstream).
+STAGE_ORDER = ("replay_verify", "verify_dedup", "dedup_pack",
+               "pack_sink", "sink")
+
+
+def log(msg: str) -> None:
+    print(f"xray_smoke: {msg}", flush=True)
+
+
+def fail(msg: str) -> None:
+    print(f"xray_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _corpus():
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+
+    return mainnet_corpus(n=N, seed=SEED, dup_rate=0.04, corrupt_rate=0.02,
+                          parse_err_rate=0.02, sign_batch_size=256,
+                          max_data_sz=150)
+
+
+def _run(tmp, corpus, name, **env):
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update({k: str(v) for k, v in env.items()})
+    try:
+        topo = build_topology(os.path.join(tmp, f"{name}.wksp"), depth=2048,
+                              wksp_sz=1 << 26)
+        t0 = time.perf_counter()
+        res = run_pipeline(topo, corpus.payloads, verify_backend="cpu",
+                           timeout_s=240.0, tcache_depth=1 << 16,
+                           record_digests=True, feed=True)
+        return topo, res, time.perf_counter() - t0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def check_clean(tmp, corpus):
+    from firedancer_tpu.disco import xray
+
+    dump_dir = os.path.join(tmp, "dumps")
+    topo, res, dt = _run(tmp, corpus, "clean",
+                         FD_XRAY_SAMPLE=SAMPLE,
+                         FD_XRAY_RING=4096,
+                         FD_FLIGHT_DUMP=dump_dir)
+    if res.xray is None:
+        fail("clean run carried no xray summary (FD_XRAY on?)")
+    # Sampled-rate exemplars: the head-sample predicate is a fixed hash
+    # over source-minted tick stamps, so the hit count is binomial
+    # around unique-delivered/SAMPLE — gate a generous band, not the
+    # mean (CI hosts must not flake on hash luck).
+    expect = res.recv_cnt / SAMPLE
+    traces = res.xray["traces"]
+    if not (0.3 * expect <= traces <= 3.0 * expect + 8):
+        fail(f"sampled exemplar count off: {traces} traces vs "
+             f"~{expect:.0f} expected (recv {res.recv_cnt} / {SAMPLE})")
+    if res.xray["exemplars"].get("head", 0) < traces:
+        fail(f"head span records {res.xray['exemplars']} < traces {traces}")
+    # Monotone span chains out of the HALT dump (full spans live there).
+    dumps = sorted(os.listdir(dump_dir)) if os.path.isdir(dump_dir) else []
+    if not dumps:
+        fail("no flight dump written on HALT")
+    with open(os.path.join(dump_dir, dumps[-1])) as f:
+        dump = json.load(f)
+    xsect = (dump.get("xray") or {}).get("spans") or {}
+    chains: dict = {}
+    for ring_name, sect in xsect.items():
+        if not ring_name.startswith("edge:"):
+            continue
+        edge = ring_name[5:]
+        if edge not in STAGE_ORDER:
+            continue
+        for s in sect.get("spans", []):
+            if s.get("trigger") == "head":
+                chains.setdefault(s["trace"], {})[edge] = s["lat_ns"]
+    full = 0
+    for trace, stages in chains.items():
+        lats = [stages[e] for e in STAGE_ORDER if e in stages]
+        if len(lats) == len(STAGE_ORDER):
+            full += 1
+        if lats != sorted(lats):
+            fail(f"non-monotone span chain for trace {trace}: {stages}")
+    if not full:
+        fail(f"no exemplar completed a full {len(STAGE_ORDER)}-stage "
+             f"chain ({len(chains)} partial chains)")
+    # Chrome trace-event export must be valid and carry the spans.
+    trace_doc = xray.to_chrome_trace(xsect)
+    trace_doc = json.loads(json.dumps(trace_doc))  # JSON round trip
+    events = trace_doc.get("traceEvents")
+    if not events:
+        fail("chrome trace export has no events")
+    for e in events:
+        if e.get("ph") == "X" and not (
+                "name" in e and "ts" in e and "dur" in e and "pid" in e):
+            fail(f"malformed chrome trace event: {e}")
+    n_x = sum(1 for e in events if e.get("ph") == "X")
+    log(f"clean half OK ({traces} traces, {full} full chains, "
+        f"{n_x} chrome events, {dt:.2f}s)")
+    return topo, res, dump, dt
+
+
+def check_waterfall(res, dump):
+    from firedancer_tpu.disco import sentinel, xray
+
+    wf = res.xray["waterfall"]
+    if [st["stage"] for st in wf] != [s for s, _, _ in xray.STAGE_CHAIN]:
+        fail(f"waterfall stage chain off: {[st['stage'] for st in wf]}")
+    for st in wf:
+        if st["queue_n"] == 0:
+            fail(f"waterfall stage {st['stage']} has no queue-dwell "
+                 f"samples (rx hook dead?)")
+        if st["service_mean_ns"] is None:
+            fail(f"waterfall stage {st['stage']} missing cumulative "
+                 "edges")
+    if not xray.waterfall_reconciles(res.stage_hist, wf):
+        fail(f"waterfall does not reconcile with EdgeHist totals "
+             f"within one log2 bucket: {wf}")
+    # evaluate_edges_summary parses the NEW dump (xray sections nested)
+    # and an OLD-shape dump (no xray) identically.
+    new_edges = dump.get("edges") or {}
+    v_new = sentinel.evaluate_edges_summary(
+        dict(new_edges, xray={"not": "an edge"}))
+    v_old = sentinel.evaluate_edges_summary(new_edges)
+    if v_new != v_old:
+        fail("evaluate_edges_summary treats new/old dump shapes "
+             f"differently: {v_new} vs {v_old}")
+    log("waterfall OK (reconciles; old+new dump shapes parse alike)")
+
+
+def check_autopsy(tmp, corpus):
+    import subprocess
+
+    from firedancer_tpu.disco import sentinel
+
+    xdir = os.path.join(tmp, "autopsies")
+    _topo, res, _dt = _run(
+        tmp, corpus, "chaos",
+        FD_XRAY_SAMPLE=SAMPLE,
+        FD_XRAY_DIR=xdir,
+        FD_CHAOS="1", FD_CHAOS_SEED=str(CHAOS_SEED),
+        FD_CHAOS_SCHEDULE=CHAOS_SCHEDULE,
+        FD_SLO_HB_MS="900", FD_SLO_STALL_MS="1200",
+        FD_SENTINEL_INTERVAL_MS="100",
+    )
+    if not res.slo or not res.slo["alerts"]:
+        fail("chaos run booked no sentinel alerts (schedule dead?)")
+    files = sorted(os.listdir(xdir)) if os.path.isdir(xdir) else []
+    if not files:
+        fail("no xray_autopsy_*.json written (alert + HALT triggers)")
+    # The HALT autopsy carries every alert of the window; judge that one.
+    halt = [f for f in files if f.endswith("halt.json")]
+    with open(os.path.join(xdir, (halt or files)[-1])) as f:
+        a = json.load(f)
+    if a.get("kind") != "xray_autopsy":
+        fail(f"autopsy kind off: {a.get('kind')!r}")
+    for key in ("suspects", "waterfall", "exemplars", "flags", "chaos"):
+        if key not in a:
+            fail(f"autopsy missing section {key!r}")
+    if a["chaos"] is None or a["chaos"].get("schedule") != CHAOS_SCHEDULE:
+        fail(f"autopsy chaos schedule off: {a.get('chaos')}")
+    # Suspected stage <-> injected fault class, BOTH ways.
+    alert_suspects = [s for s in a["suspects"] if s.get("alerted")]
+    if not alert_suspects:
+        fail(f"no alert-backed suspects in {a['suspects'][:3]}")
+    top = a["suspects"][0]
+    if not top.get("alerted"):
+        fail(f"top suspect is not alert-backed: {top}")
+    suspect_slos = {s["slo"] for s in alert_suspects}
+    for cls in INJECTED:
+        if sentinel.FAULT_SLO[cls] not in suspect_slos:
+            fail(f"injected class {cls} (SLO {sentinel.FAULT_SLO[cls]}) "
+                 f"missing from suspects {sorted(suspect_slos)}")
+    for s in alert_suspects:
+        classes = set(s.get("fault_classes") or [])
+        if not classes & INJECTED:
+            fail(f"alert-backed suspect {s['slo']} maps to no injected "
+                 f"class ({sorted(classes)} vs {sorted(INJECTED)})")
+    # fd_report must render it.
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fd_report.py"),
+         "--autopsy", os.path.join(xdir, (halt or files)[-1])],
+        capture_output=True, text=True, timeout=120)
+    if p.returncode != 0 or "SUSPECTED STAGE" not in p.stdout:
+        fail(f"fd_report --autopsy failed rc={p.returncode}: "
+             f"{p.stdout[-400:]}{p.stderr[-400:]}")
+    log(f"autopsy OK ({len(files)} bundles; top suspect "
+        f"{top['stage']}/{top['slo']} <-> injected {sorted(INJECTED)})")
+
+
+def check_overhead(tmp, corpus, res_on, dt_on):
+    _topo, res_off, dt_off = _run(tmp, corpus, "off", FD_XRAY="0",
+                                  FD_XRAY_SAMPLE=SAMPLE)
+    if res_off.xray is not None:
+        fail("FD_XRAY=0 run still produced an xray summary")
+    # Bit-identical pipeline output: xray must only observe.
+    d_on = sorted(d.hex() for d in (res_on.sink_digests or []))
+    d_off = sorted(d.hex() for d in (res_off.sink_digests or []))
+    if d_on != d_off:
+        fail(f"sink content differs with xray on/off "
+             f"({len(d_on)} vs {len(d_off)} digests)")
+    # 2% gate with an absolute jitter floor: the corpus runs ~1 s and
+    # host scheduling noise dwarfs any real sampling cost at that
+    # scale (the same rationale as the obs/slo smoke floors).
+    slack = max(dt_off * 0.02, 0.2)
+    if dt_on > dt_off + slack:
+        fail(f"xray overhead: {dt_on:.2f}s on vs {dt_off:.2f}s off "
+             "(> 2% + jitter floor)")
+    log(f"overhead OK ({dt_on:.2f}s on vs {dt_off:.2f}s off, "
+        "sink bit-identical)")
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.perf_counter()
+    corpus = _corpus()
+    log(f"corpus ready ({len(corpus.payloads)} txns)")
+    with tempfile.TemporaryDirectory(prefix="fd_xray_") as tmp:
+        _topo, res, dump, dt_on = check_clean(tmp, corpus)
+        check_waterfall(res, dump)
+        check_autopsy(tmp, corpus)
+        check_overhead(tmp, corpus, res, dt_on)
+    print(json.dumps({
+        "metric": "xray_smoke", "ok": True,
+        "corpus": N, "sample": SAMPLE, "schedule": CHAOS_SCHEDULE,
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

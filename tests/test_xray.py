@@ -1,0 +1,618 @@
+"""fd_xray — exemplar traces, queue attribution, autopsies (disco/xray.py).
+
+Four layers, matching the subsystem's pieces: the deterministic
+sampling contract (one pure hash, scalar == vectorized, stage- and
+process-independent), exemplar-integrity propagation (a sampled trace
+id must survive feed staging, quarantine re-verify, and a REAL worker
+process boundary with a monotone span chain — the PR-6 trace-id tests,
+now asserting full span records instead of histogram membership),
+queue-telemetry/waterfall arithmetic, and the autopsy bundle + dump
+compatibility surfaces.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.disco import flight, sentinel, xray
+
+# ------------------------------------------------------------ sampling ---
+
+
+def test_sampling_deterministic_scalar_matches_vectorized():
+    ids = np.arange(1, 50_001, dtype=np.uint64)
+    mask = xray.sampled_mask(ids)
+    # Spot-check a deterministic slice scalar-vs-vectorized (the bulk
+    # completion and the per-frag path MUST agree on the sampled set).
+    for i in range(0, 50_000, 997):
+        assert xray.sampled(int(ids[i])) == bool(mask[i])
+    # Rate: binomial around 1/FD_XRAY_SAMPLE over a uniform id range.
+    rate = mask.mean()
+    expect = 1.0 / 64
+    assert 0.5 * expect < rate < 2.0 * expect
+
+
+def test_sampling_zero_id_and_disabled(monkeypatch):
+    assert not xray.sampled(0)
+    assert not xray.sampled_mask(np.array([0], np.uint64))[0]
+    monkeypatch.setenv("FD_XRAY_SAMPLE", "0")
+    assert xray.sample_threshold() == 0
+    assert not xray.sampled(12345)
+
+
+def _sampled_ids(n, base=100_000):
+    """n trace ids that ARE head-sampled at the default rate (pure
+    function — the same ids sample everywhere, which is the point)."""
+    out = []
+    i = base
+    while len(out) < n:
+        if xray.sampled(i):
+            out.append(i)
+        i += 1
+    return out
+
+
+def test_tail_threshold_follows_slo_budget(monkeypatch):
+    # The tail trigger is the docs/LATENCY.md rule: first bucket
+    # provably past 2x the budget, budget resolved from the SAME
+    # FD_SLO_* flag the sentinel evaluates (single source of truth).
+    monkeypatch.setenv("FD_SLO_E2E_BUDGET_MS", "100")
+    thr = xray.tail_threshold_ns("sink")
+    budget_ns = 100 * 1_000_000
+    assert thr == 1 << (sentinel._bad_from_bucket(budget_ns) - 1)
+    assert thr >= 2 * budget_ns
+    # lane variants share the base edge's budget
+    assert xray.tail_threshold_ns("replay_verify.v1") == \
+        xray.tail_threshold_ns("replay_verify")
+    # an edge with no latency SLO never tail-triggers
+    assert xray.tail_threshold_ns("no_such_edge") == 0
+
+
+# ------------------------------------------------------------ rings ------
+
+
+def test_span_ring_bounded_and_trigger_counts(monkeypatch):
+    monkeypatch.setenv("FD_XRAY_RING", "16")
+    r = xray.ring("edge:ringtest")
+    for i in range(40):
+        r.record(i, i, i + 5, "head" if i % 2 else "tail")
+    spans = r.spans()
+    assert len(spans) == 16
+    assert r.n == 40
+    assert r.counts["head"] + r.counts["tail"] == 40
+    assert [s["trace"] for s in spans] == list(range(24, 40))
+
+
+def test_ring_disabled_is_noop(monkeypatch):
+    monkeypatch.setenv("FD_XRAY", "0")
+    r = xray.ring("edge:off")
+    r.record(1, 1, 2, "head")
+    assert r.spans() == []
+    assert xray.span_ctx("sink") is None
+    assert xray.edge_rx(None, "x") is None
+    assert xray.run_summary() is None
+
+
+def test_span_ctx_head_and_tail(monkeypatch):
+    monkeypatch.setenv("FD_SLO_E2E_BUDGET_MS", "1")  # tiny tail budget
+    ctx = xray.span_ctx("sink")
+    head_id = _sampled_ids(1)[0]
+    ctx.observe(head_id, head_id + 100, 100)          # head capture
+    cold = next(i for i in range(1, 10_000) if not xray.sampled(i))
+    ctx.observe(cold, cold + 50, 50)                  # below tail: dropped
+    tail_lat = ctx.tail_ns + 1
+    ctx.observe(cold, (cold + tail_lat) & 0xFFFFFFFF, tail_lat)  # tail
+    spans = ctx.ring.spans()
+    assert {s["trigger"] for s in spans} == {"head", "tail"}
+    assert spans[0]["trace"] == head_id
+    assert spans[1]["trace"] == cold
+    # vectorized path agrees
+    ctx2 = xray.span_ctx("sink")
+    ctx2.observe_many(np.array([head_id, cold, cold], np.uint64),
+                      np.array([100, 50, tail_lat], np.int64))
+    assert sorted(s["trigger"] for s in ctx2.ring.spans()) == \
+        ["head", "tail"]
+
+
+# ------------------------------------------- exemplar integrity ----------
+
+
+def _clean_corpus(n=48, seed=11):
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+
+    return mainnet_corpus(n=n, seed=seed, dup_rate=0.0, corrupt_rate=0.0,
+                          parse_err_rate=0.0, sign_batch_size=64,
+                          max_data_sz=120)
+
+
+def _staging_harness(tmp_path, name):
+    from firedancer_tpu.disco.pipeline import (
+        _link_names,
+        _make_out_link,
+        _make_source_out_link,
+        build_topology,
+    )
+    from firedancer_tpu.disco.tiles import InLink, VerifyTile
+    from firedancer_tpu.tango.rings import Workspace
+
+    topo = build_topology(str(tmp_path / f"{name}.wksp"), depth=1024,
+                          wksp_sz=1 << 25)
+    wksp = Workspace.join(topo.wksp_path)
+    src = _make_source_out_link(wksp, topo.pod)
+    verify = VerifyTile(
+        wksp, "verify.cnc",
+        in_link=InLink(wksp, _link_names(topo.pod, "replay_verify"),
+                       edge="replay_verify"),
+        out_link=_make_out_link(wksp, topo.pod, "verify_dedup",
+                                "verify_dedup", 1232),
+        backend="cpu", batch=128, feed=True,
+    )
+    return topo, wksp, src, verify
+
+
+def _edge_ring_traces(edge):
+    sect = xray.dump_spans().get(f"edge:{edge}", {})
+    return {s["trace"]: s for s in sect.get("spans", [])}
+
+
+@pytest.mark.skipif(
+    not __import__("firedancer_tpu.tango.rings",
+                   fromlist=["x"]).feed_abi_ok(),
+    reason="fd_feed native ABI not built")
+def test_exemplar_survives_feed_staging(tmp_path):
+    """Head-sampled trace ids through the native drain, slot sidecars,
+    dispatch, and bulk completion: full span records (not just
+    histogram membership) with the batch context attached, trace ids
+    bit-exact."""
+    from firedancer_tpu.ballet.ed25519 import native as ed_native
+
+    if not ed_native.available():
+        pytest.skip("native ed25519 verifier not built")
+    from firedancer_tpu.disco.tiles import meta_sig
+
+    corpus = _clean_corpus()
+    topo, wksp, src, v = _staging_harness(tmp_path, "stage")
+    try:
+        tids = _sampled_ids(len(corpus.payloads))
+        for p, tid in zip(corpus.payloads, tids):
+            assert src.can_publish()
+            src.publish(p, meta_sig(p), tsorig=tid)
+        slot = v.feed_pool.acquire(0.5)
+        staged = 0
+        while staged < len(corpus.payloads):
+            n = v._stager_drain(slot)
+            if n <= 0:
+                break
+            staged += n
+        assert staged == len(corpus.payloads)
+        v._feed_dispatch(slot)
+        v._complete(block=True, drain_all=True)
+        # Publish-edge spans: every sampled id, bit-exact.
+        got = _edge_ring_traces("verify_dedup")
+        assert set(tids) <= set(got)
+        # Batch-context exemplars on the tile ring: engine key, flush
+        # verdict, slot id, batch ordinal.
+        tile = xray.dump_spans().get("tile:verify", {})
+        ctx = [s for s in tile.get("spans", [])
+               if s["trigger"] == "head" and s["trace"] in set(tids)]
+        assert ctx, "no batch-context exemplars recorded"
+        for s in ctx:
+            assert s["engine"].startswith("cpu:B128")
+            assert s["verdict"] in ("full", "capacity", "deadline",
+                                    "starved", "ring_starved", "halt")
+            assert s["batch"] == 1 and "slot" in s
+    finally:
+        if v._feed_exec is not None:
+            v._feed_exec.shutdown(wait=True)
+
+
+@pytest.mark.skipif(
+    not __import__("firedancer_tpu.tango.rings",
+                   fromlist=["x"]).feed_abi_ok(),
+    reason="fd_feed native ABI not built")
+def test_exemplar_survives_quarantine_reverify(tmp_path, monkeypatch):
+    """A poisoned batch (chaos backend_raise) re-verifies on the CPU
+    oracle lane: the quarantine TRIGGER records the batch's trace ids,
+    and the republished spans carry the SAME sampled ids."""
+    from firedancer_tpu.ballet.ed25519 import native as ed_native
+
+    if not ed_native.available():
+        pytest.skip("native ed25519 verifier not built")
+    from firedancer_tpu.disco import chaos
+    from firedancer_tpu.disco.tiles import meta_sig
+
+    monkeypatch.setenv("FD_CHAOS", "1")
+    monkeypatch.setenv("FD_CHAOS_SEED", "1")
+    monkeypatch.setenv("FD_CHAOS_SCHEDULE", "backend_raise@1")
+    chaos.init_for_run()
+    corpus = _clean_corpus(seed=13)
+    topo, wksp, src, v = _staging_harness(tmp_path, "quar")
+    try:
+        from firedancer_tpu.disco.tiles import meta_sig
+
+        tids = _sampled_ids(len(corpus.payloads), base=7_000_000)
+        for p, tid in zip(corpus.payloads, tids):
+            assert src.can_publish()
+            src.publish(p, meta_sig(p), tsorig=tid)
+        slot = v.feed_pool.acquire(0.5)
+        staged = 0
+        while staged < len(corpus.payloads):
+            n = v._stager_drain(slot)
+            if n <= 0:
+                break
+            staged += n
+        v._feed_dispatch(slot)
+        v._complete(block=True, drain_all=True)
+        assert v.stat_quarantined == 1
+        # The quarantine trigger event names the batch's trace ids.
+        tile = xray.dump_spans().get("tile:verify", {})
+        quar = [s for s in tile.get("spans", [])
+                if s["trigger"] == "quarantine"]
+        assert quar and set(quar[0]["traces"]) <= set(tids)
+        assert tile["counts"].get("quarantine", 0) == 1
+        # Clean txns republished with the SAME sampled ids.
+        got = _edge_ring_traces("verify_dedup")
+        assert set(tids) <= set(got)
+    finally:
+        chaos.uninstall()
+        if v._feed_exec is not None:
+            v._feed_exec.shutdown(wait=True)
+
+
+def test_exemplar_survives_worker_process_boundary(tmp_path):
+    """Sampled ids published into verify_dedup, drained by a REAL
+    worker process (dedup -> pack -> sink): the worker's result file
+    carries its xray span rings, the sampled ids appear bit-exactly on
+    the downstream edges, and each trace's span chain is monotone in
+    cumulative latency."""
+    from firedancer_tpu.disco.pipeline import (
+        _make_out_link,
+        build_topology,
+    )
+    from firedancer_tpu.disco.tiles import meta_sig
+    from firedancer_tpu.tango.rings import CNC_HALT, Cnc, FSeq, Workspace
+
+    corpus = _clean_corpus(n=32, seed=17)
+    topo = build_topology(str(tmp_path / "wb.wksp"), depth=512,
+                          wksp_sz=1 << 25)
+    wksp = Workspace.join(topo.wksp_path)
+    pod_path = str(tmp_path / "topo.pod")
+    with open(pod_path, "wb") as f:
+        f.write(topo.pod.serialize())
+    result_path = str(tmp_path / "down.json")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    opts = {"tcache_depth": 4096, "bank_cnt": 4,
+            "pack_scheduler": "greedy", "record_digests": True,
+            "jax_platform": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "firedancer_tpu.disco.worker",
+         "--wksp", topo.wksp_path, "--pod", pod_path,
+         "--tile", "dedup,pack,sink", "--opts", json.dumps(opts),
+         "--max-ns", str(120_000_000_000), "--result", result_path],
+        cwd=repo, stderr=subprocess.PIPE)
+    try:
+        out = _make_out_link(wksp, topo.pod, "verify_dedup",
+                             "verify_dedup", 1232)
+        # Trace ids are minted as NOW-ish ticks so the worker-side
+        # latency math ((tspub - tsorig) & u32) stays small/monotone.
+        from firedancer_tpu.tango import tempo
+
+        base = tempo.tickcount() & 0xFFFFFFFF
+        tids = _sampled_ids(len(corpus.payloads), base=base)
+        for p, tid in zip(corpus.payloads, tids):
+            deadline = time.time() + 30
+            while not out.can_publish():
+                assert time.time() < deadline, "no credits from worker"
+                time.sleep(0.002)
+            out.publish(p, meta_sig(p), tsorig=tid)
+        sink_fseq = FSeq(wksp, topo.pod.query_cstr(
+            "firedancer.pack_sink.fseq"))
+        deadline = time.time() + 60
+        while sink_fseq.query() < len(tids):
+            assert proc.poll() is None, (
+                f"worker died rc={proc.returncode}: "
+                f"{proc.stderr.read().decode()[-2000:]}")
+            assert time.time() < deadline, (
+                f"sink only reached {sink_fseq.query()}/{len(tids)}")
+            time.sleep(0.01)
+        for t in ("dedup", "pack", "sink"):
+            Cnc(wksp, topo.pod.query_cstr(
+                f"firedancer.{t}.cnc")).signal(CNC_HALT)
+        proc.wait(timeout=60)
+        with open(result_path) as f:
+            res = json.load(f)
+        spans = (res.get("xray") or {}).get("spans") or {}
+        chains = {}
+        for edge in ("dedup_pack", "pack_sink", "sink"):
+            sect = spans.get(f"edge:{edge}", {})
+            for s in sect.get("spans", []):
+                chains.setdefault(s["trace"], {})[edge] = s["lat_ns"]
+        # Bit-exact across the boundary: every sampled id has spans.
+        missing = set(tids) - set(chains)
+        assert not missing, f"sampled ids missing worker spans: {missing}"
+        for tid in tids:
+            lats = [chains[tid][e] for e in
+                    ("dedup_pack", "pack_sink", "sink")
+                    if e in chains[tid]]
+            assert len(lats) >= 2
+            assert lats == sorted(lats), (tid, chains[tid])
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+
+
+# -------------------------------------------- queue region + waterfall ---
+
+
+def test_queue_region_rx_tx_roundtrip(tmp_path):
+    from firedancer_tpu.tango.rings import Workspace
+
+    wksp = Workspace.create(str(tmp_path / "q.wksp"), 1 << 22)
+    xray.create_region(wksp, ["edge_a", "edge_b"])
+    rx = xray.edge_rx(wksp, "edge_a")
+    tx = xray.edge_tx(wksp, "edge_a")
+    assert rx is not None and tx is not None
+    for ns in (1000, 2000, 4000):
+        rx.observe_dwell(ns)
+    rx.observe_dwell(-5)                    # rejected
+    rx.observe_dwell(xray._DWELL_WRAP_NS)   # wrap artifact: rejected
+    rx.add_idle(500)
+    rx.sample_depth(10)
+    rx.sample_depth(20)
+    tx.add_stall(1_000_000)
+    tx.sample_credits(64)
+    q = xray.read_queue(wksp)
+    a = q["edge_a"]
+    assert a["dwell"]["n"] == 3
+    assert a["idle_ns"] == 500
+    assert a["depth_avg"] == 15.0
+    assert a["stall_ns"] == 1_000_000 and a["stall_cnt"] == 1
+    assert a["cr_avail_avg"] == 64.0
+    assert q["edge_b"]["dwell"]["n"] == 0
+    # unknown label degrades to a process-local row, not an error
+    stray = xray.edge_rx(wksp, "nope")
+    stray.observe_dwell(1)
+    assert "nope" not in xray.read_queue(wksp)
+
+
+def _hist_summary(vals):
+    h = flight.EdgeHist("t")
+    for v in vals:
+        h.observe(v)
+    return h.summary()
+
+
+def test_waterfall_decomposition_and_reconciliation():
+    # Synthetic cumulative chain: src 1us; verify +10ms (6ms queue),
+    # dedup +2ms (1ms queue), pack +3ms (2ms queue), sink +1ms (0.5ms).
+    edges = {
+        "replay_verify": _hist_summary([1_000] * 100),
+        "verify_drain": _hist_summary([6_000_000] * 100),
+        "verify_dedup": _hist_summary([10_001_000] * 100),
+        "dedup_pack": _hist_summary([12_001_000] * 100),
+        "pack_sink": _hist_summary([15_001_000] * 100),
+        "sink": _hist_summary([16_001_000] * 100),
+    }
+    queue = {
+        "verify_dedup": {"dwell": _hist_summary([1_000_000] * 50)},
+        "dedup_pack": {"dwell": _hist_summary([2_000_000] * 50)},
+        "pack_sink": {"dwell": _hist_summary([500_000] * 50)},
+    }
+    wf = xray.waterfall(edges, queue)
+    assert [st["stage"] for st in wf] == ["verify", "dedup", "pack", "sink"]
+    v = wf[0]
+    assert v["queue_mean_ns"] == pytest.approx(6_000_000)     # verify_drain
+    assert v["service_mean_ns"] == pytest.approx(4_000_000)   # residual
+    d = wf[1]
+    assert d["queue_mean_ns"] == pytest.approx(1_000_000)
+    assert d["service_mean_ns"] == pytest.approx(1_000_000)
+    assert xray.waterfall_reconciles(edges, wf)
+    # A queue mean wildly past the cumulative gap breaks reconciliation.
+    queue_bad = dict(queue, verify_dedup={
+        "dwell": _hist_summary([400_000_000] * 50)})
+    edges_bad = dict(edges)
+    wf_bad = xray.waterfall(edges_bad, dict(
+        queue_bad, dedup_pack={"dwell": _hist_summary([400_000_000] * 50)},
+        pack_sink={"dwell": _hist_summary([400_000_000] * 50)}))
+    assert not xray.waterfall_reconciles(edges_bad, wf_bad)
+
+
+def test_queue_sample_stride_zero_clamps(tmp_path, monkeypatch):
+    """FD_XRAY_QUEUE_SAMPLE=0 must tighten to every-frag sampling,
+    never divide-by-zero the hot drain path (review finding)."""
+    from firedancer_tpu.disco.pipeline import _link_names, build_topology
+    from firedancer_tpu.disco.tiles import InLink
+    from firedancer_tpu.tango.rings import Workspace
+
+    monkeypatch.setenv("FD_XRAY_QUEUE_SAMPLE", "0")
+    topo = build_topology(str(tmp_path / "z.wksp"), depth=128,
+                          wksp_sz=1 << 24)
+    wksp = Workspace.join(topo.wksp_path)
+    il = InLink(wksp, _link_names(topo.pod, "replay_verify"),
+                edge="replay_verify")
+    assert il.xq_every == 1
+    il.dwell_sample(123)          # no ZeroDivisionError, observes
+    assert il.xq.hist.count() == 1
+
+
+def test_waterfall_merges_lane_variants():
+    """Multi-lane topologies: '<edge>.v<N>' folds into the base edge
+    of the decomposition (counters add; a backed-up lane 1 cannot hide
+    — review finding)."""
+    lane0 = _hist_summary([10_000_000] * 50)
+    lane1 = _hist_summary([30_000_000] * 50)
+    edges = {
+        "replay_verify": _hist_summary([1_000] * 100),
+        "verify_dedup": lane0, "verify_dedup.v1": lane1,
+        "dedup_pack": _hist_summary([21_000_000] * 100),
+        "pack_sink": _hist_summary([22_000_000] * 100),
+        "sink": _hist_summary([23_000_000] * 100),
+    }
+    queue = {
+        "verify_dedup": {"dwell": _hist_summary([1_000_000] * 10),
+                         "stall_ns": 5, "idle_ns": 7, "depth_avg": 1.0},
+        "verify_dedup.v1": {"dwell": _hist_summary([3_000_000] * 10),
+                            "stall_ns": 5, "idle_ns": 7,
+                            "depth_avg": 2.0},
+    }
+    wf = xray.waterfall(edges, queue)
+    verify = wf[0]
+    dedup = wf[1]
+    # verify stage cum-out merges both lanes: mean = 20ms, n = 100
+    assert verify["cum_mean_ns"] == pytest.approx(20_000_000)
+    # dedup stage's queue merges both lanes' dwell: mean = 2ms
+    assert dedup["queue_mean_ns"] == pytest.approx(2_000_000)
+    assert dedup["queue_n"] == 20
+    assert dedup["stall_ns"] == 10 and dedup["idle_ns"] == 14
+    assert dedup["depth_avg"] == pytest.approx(3.0)
+
+
+def test_suspects_derive_from_slo_rows_when_no_alert_list():
+    """Crash-path autopsies pass no alert list; a shared SLO row in
+    alert state stands in as the sentinel's live verdict (review
+    finding: the slos parameter must be consumed, not decorative)."""
+    slos = {"tile_heartbeat": {"evals": 10, "alerts": 1,
+                               "breach_polls": 3, "burn_milli": 1800,
+                               "state": 1},
+            "e2e_p99": {"evals": 10, "alerts": 0, "breach_polls": 0,
+                        "burn_milli": 0, "state": 0}}
+    ranked = xray.suspect_ranking({}, slos, alerts=None)
+    assert ranked[0]["slo"] == "tile_heartbeat"
+    assert ranked[0]["alerted"] is True
+    assert "hb_stall" in ranked[0]["fault_classes"]
+    # an explicit alert list takes precedence over the rows
+    alerts = [{"slo": "pipeline_progress", "edge_or_stage": "progress",
+               "burn_milli": 5000, "fault_classes": ["credit_starve"]}]
+    ranked2 = xray.suspect_ranking({}, slos, alerts)
+    assert ranked2[0]["slo"] == "pipeline_progress"
+
+
+def test_suspect_ranking_alert_backed_first(monkeypatch):
+    edges = {
+        "sink": {"n": 100, "p50_ns_le": 1 << 20, "p99_ns_le": 1 << 34,
+                 "sum_ns": 100 << 20},
+    }
+    alerts = [{"slo": "tile_heartbeat", "edge_or_stage": "heartbeat",
+               "burn_milli": 2_000, "fault_classes": ["hb_stall"]}]
+    ranked = xray.suspect_ranking(edges, None, alerts)
+    assert ranked[0]["stage"] == "heartbeat"
+    assert ranked[0]["alerted"] is True
+    assert "hb_stall" in ranked[0]["fault_classes"]
+    passive = [s for s in ranked if not s["alerted"]]
+    assert any(s["slo"] == "e2e_p99" for s in passive)
+    # passive entries ranked by budget share, descending
+    scores = [s["score"] for s in passive]
+    assert scores == sorted(scores, reverse=True)
+
+
+# ----------------------------------------------- autopsy + dump compat ---
+
+
+def test_autopsy_writer_schema(tmp_path, monkeypatch):
+    monkeypatch.setenv("FD_XRAY_DIR", str(tmp_path / "autopsies"))
+    r = xray.ring("edge:sink")
+    tid = _sampled_ids(1)[0]
+    r.record(tid, tid, tid + 5_000, "head")
+    path = xray.maybe_autopsy(
+        "unit-test", alerts=[{"slo": "e2e_p99", "edge_or_stage": "sink",
+                              "burn_milli": 3000, "fault_classes": []}])
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        a = json.load(f)
+    assert a["kind"] == "xray_autopsy"
+    assert a["schema_version"] == flight.ARTIFACT_SCHEMA_VERSION
+    assert a["reason"] == "unit-test"
+    assert a["suspects"][0]["slo"] == "e2e_p99"
+    assert a["suspects"][0]["alerted"]
+    assert "edge:sink" in a["exemplars"]["spans"]
+    assert isinstance(a["waterfall"], list)
+    assert isinstance(a["flags"], dict)
+    assert "FD_XRAY_DIR" in a["flags"]    # the pinned env is snapshotted
+
+
+def test_autopsy_without_dir_is_silent(monkeypatch):
+    monkeypatch.delenv("FD_XRAY_DIR", raising=False)
+    assert xray.maybe_autopsy("nothing") is None
+
+
+def test_flight_dump_carries_xray_and_old_dumps_parse(monkeypatch):
+    r = xray.ring("edge:sink")
+    r.record(42, 42, 99, "head")
+    d = flight.dump("unit")
+    assert "edge:sink" in d["xray"]["spans"]
+    # evaluate_edges_summary accepts NEW sections (non-summary values
+    # nested among edges) and OLD dumps (no xray key) identically.
+    edges = {"sink": {"n": 10, "p50_ns_le": 1024, "p99_ns_le": 2048,
+                      "sum_ns": 10240}}
+    budgets = {s.name: 1000 for s in sentinel.SLO_TABLE}
+    v_old = sentinel.evaluate_edges_summary(edges, budgets)
+    v_new = sentinel.evaluate_edges_summary(
+        dict(edges, xray={"spans": {}}, queue=[1, 2, 3]), budgets)
+    assert v_old == v_new == []
+
+
+def test_chrome_trace_export_shape():
+    spans = {"edge:sink": {"n_total": 1, "counts": {"head": 1},
+                           "spans": [{"trace": 7, "tsorig": 7,
+                                      "tspub": 5007, "lat_ns": 5000,
+                                      "trigger": "head"}]}}
+    doc = xray.to_chrome_trace(spans)
+    doc = json.loads(json.dumps(doc))
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 1
+    e = xs[0]
+    assert e["name"] == "sink" and e["dur"] == 5.0 and e["tid"] == 7
+    assert any(ev["ph"] == "M" for ev in doc["traceEvents"])
+
+
+def test_run_summary_merges_worker_spans():
+    # Process-global rings persist across tests (latest-wins per name);
+    # start from a clean registry so top_slowest is deterministic.
+    with xray._rings_lock:
+        xray._rings.clear()
+    local = xray.ring("edge:pack_sink")
+    local.record(11, 11, 2011, "head")
+    extra = {"edge:sink": {"n_total": 2, "counts": {"head": 1, "tail": 1},
+                           "spans": [{"trace": 11, "tsorig": 11,
+                                      "tspub": 3011, "lat_ns": 3000,
+                                      "trigger": "head"}]}}
+    s = xray.run_summary(extra_spans=extra)
+    assert s["exemplars"]["head"] >= 2
+    assert s["exemplars"]["tail"] >= 1
+    assert s["traces"] >= 1
+    top = s["top_slowest"][0]
+    assert top["trace"] == 11 and "sink" in top["stages"]
+
+
+def test_bench_log_check_validates_xray_block():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import bench_log_check
+
+    base = {"metric": "feed_replay_smoke", "value": 1.0, "unit": "x",
+            "schema_version": 2, "ts": "2026-08-04T00:00:00Z"}
+    ok = dict(base, xray={"sample_rate": 64, "exemplars": {"head": 3},
+                          "traces": 3,
+                          "top_slowest": [{"trace": 1, "lat_ns": 5,
+                                           "stages": {"sink": 5}}]})
+    assert bench_log_check.validate_entry(ok) == []
+    assert bench_log_check.validate_entry(dict(base, xray=None)) == []
+    bad = dict(base, xray={"sample_rate": "lots", "exemplars": [],
+                           "top_slowest": [{}] * 5})
+    errs = bench_log_check.validate_entry(bad)
+    assert len(errs) == 3
+
+
+def test_xray_flags_registered():
+    from firedancer_tpu import flags
+
+    for name in ("FD_XRAY", "FD_XRAY_SAMPLE", "FD_XRAY_RING",
+                 "FD_XRAY_QUEUE_SAMPLE", "FD_XRAY_DIR"):
+        assert name in flags.REGISTRY, name
