@@ -129,6 +129,17 @@ echo "== fd_xray smoke (exemplars / waterfall / autopsy / overhead) =="
 # sink content bit-identical.
 JAX_PLATFORMS=cpu python scripts/xray_smoke.py
 
+echo "== fd_siege smoke (QUIC front door under attack, CPU) =="
+# The round-15 robustness gate: a seeded adversarial profile (dup storm
+# + concurrent quic_malformed/quic_conn_churn/quic_slowloris chaos)
+# through the full QUIC -> fd_feed -> verify topology must book ZERO
+# fd_sentinel burn-rate alerts, keep shed accounting exact (admitted +
+# shed == offered), deliver bit-exact sink content for admitted
+# traffic, balance the chaos tri-counters, demonstrably shed via the
+# admission bucket, validate the SIEGE_r*.json schema, and cost <= 5%
+# with the defenses on vs off on a clean churn profile.
+JAX_PLATFORMS=cpu python scripts/siege_smoke.py
+
 echo "== RLC verify smoke (CPU backend, FD_BENCH_VERIFY=rlc) =="
 # The production verify mode's dispatch contract (round-6 promotion):
 # tiny batch through the tile-facing RLC wrapper — no fallback on clean

@@ -50,6 +50,18 @@ Fault classes and their hook sites:
                  must kill + respawn.
   worker_kill    supervisor monitor pass: SIGKILL the verify worker at
                  a scheduled pass ordinal (supervised runs).
+  quic_malformed QUIC tile rx round: feed one seeded junk datagram into
+                 the endpoint; it must drop it unprocessed (drop-type:
+                 detection is the heal). Runs concurrently with a live
+                 swarm — the fd_siege contract.
+  quic_conn_churn QUIC tile churn round: feed a well-formed garbage
+                 Initial from a synthetic peer (half-open conn flood
+                 shape); healed when the handshake-deadline reaper (or
+                 the conn-cap refusal) retires it.
+  quic_slowloris window over QUIC rx rounds: completed streams are
+                 deferred (held, not lost) while open — injected at
+                 window open, healed at close when the held txns
+                 requeue (window-edge accounting, hb_stall pattern).
 
 Schedule grammar (FD_CHAOS_SCHEDULE):
 
@@ -90,9 +102,33 @@ FAULT_CLASSES = (
     "device_lost",
     "hb_stall",
     "worker_kill",
+    # fd_siege front-door classes (hook sites inside QuicTile.step —
+    # runnable CONCURRENTLY with a live attack swarm, the siege suite's
+    # whole point):
+    #   quic_malformed   point: feed one seeded junk datagram into the
+    #                    endpoint at the Nth rx-service round; the
+    #                    endpoint must drop it unprocessed (detection ==
+    #                    heal, the drop-type pattern).
+    #   quic_conn_churn  point: feed a well-formed-but-garbage Initial
+    #                    from a synthetic peer at the Nth churn round —
+    #                    the server allocates a half-open conn (or
+    #                    refuses at the conn cap); healed when the
+    #                    handshake-deadline reaper (or the cap refusal)
+    #                    retires it.
+    #   quic_slowloris   window over rx-service rounds: completed
+    #                    streams are DEFERRED (held, not lost) while
+    #                    the window is open — the shape of a client
+    #                    dribbling bytes; injected==detected at window
+    #                    open, healed at close when the held txns
+    #                    requeue (window-edge accounting, the hb_stall
+    #                    pattern).
+    "quic_malformed",
+    "quic_conn_churn",
+    "quic_slowloris",
 )
 
-_WINDOW_CLASSES = ("credit_starve", "device_lost", "hb_stall")
+_WINDOW_CLASSES = ("credit_starve", "device_lost", "hb_stall",
+                   "quic_slowloris")
 
 
 class ChaosFault(RuntimeError):
@@ -214,6 +250,7 @@ class ChaosInjector:
         self._corrupt_psigs: List[int] = []
         self._starve_active = False
         self._hb_stall_active: set = set()   # tile_ids inside a window
+        self._slowloris_active = False       # quic_slowloris window open
         self.corrupted_sha256: List[str] = []
 
     # -- plumbing --------------------------------------------------------
@@ -419,6 +456,87 @@ class ChaosInjector:
             self.note("backend_raise", "injected")
             raise ChaosBackendError(f"injected backend error at batch {n}")
 
+    # -- quic front-door level (fd_siege classes; hooks in QuicTile) -----
+
+    def quic_malformed_junk(self) -> Optional[bytes]:
+        """Ticked once per QuicTile rx-service round: seeded junk bytes
+        to feed straight into the endpoint at scheduled ordinals (the
+        tile bypasses its own quarantine gate for the injection so the
+        endpoint-level drop is what gets audited), else None. The junk
+        wears a short-header first byte so it takes the unknown-cid
+        path — the endpoint must count it rx_dropped, which the tile
+        verifies synchronously (on_quic_malformed_dropped)."""
+        n = self._tick("quic_rx_round")
+        if not self._hit("quic_malformed", n, consume=True):
+            return None
+        junk = bytes([0x40 | self._junk_rng.roll(0x40)]) + bytes(
+            self._junk_rng.roll(256) for _ in range(39))
+        self.note("quic_malformed", "injected")
+        return junk
+
+    def on_quic_malformed_dropped(self) -> None:
+        """The endpoint dropped the injected junk unprocessed: the drop
+        is both the detection and the heal (drop-type class)."""
+        self.note("quic_malformed", "detected")
+        self.note("quic_malformed", "healed")
+
+    def quic_churn_initial(self) -> Optional[bytes]:
+        """Ticked once per QuicTile churn round: a well-formed Initial
+        datagram with seeded garbage payload at scheduled ordinals
+        (else None). The server allocates a connection that can never
+        complete its handshake — the half-open-flood shape — or
+        refuses it at the conn cap; the tile books detected when the
+        conn appears (or the refusal drops), healed when the
+        handshake-deadline reaper retires it."""
+        n = self._tick("quic_churn_round")
+        if not self._hit("quic_conn_churn", n, consume=True):
+            return None
+        from firedancer_tpu.tango.quic import wire
+
+        rng = self._junk_rng
+        dcid = bytes(rng.roll(256) for _ in range(8))
+        scid = bytes(rng.roll(256) for _ in range(8))
+        payload = bytes(rng.roll(256) for _ in range(64))
+        hdr = wire.encode_long_header(
+            wire.PKT_INITIAL, dcid, scid, pn=0, pn_len=2,
+            payload_len=len(payload))
+        self.note("quic_conn_churn", "injected")
+        return hdr + payload
+
+    def quic_slowloris_held(self) -> bool:
+        """Ticked once per QuicTile rx-service round: True while the
+        quic_slowloris window covers this round — the tile defers
+        completed streams instead of admitting them (a client
+        dribbling bytes). Window-edge accounting like hb_stall: ONE
+        injected+detected at open (the deferral is immediately visible
+        in the tile's hold buffer), healed at close when the held txns
+        requeue for admission."""
+        n = self._tick("quic_service_round")
+        if self._hit("quic_slowloris", n):
+            if not self._slowloris_active:
+                self._slowloris_active = True
+                self.note("quic_slowloris", "injected")
+                self.note("quic_slowloris", "detected")
+            return True
+        if self._slowloris_active:
+            self._slowloris_active = False
+            self.note("quic_slowloris", "healed")
+        return False
+
+    def quic_slowloris_active(self) -> bool:
+        """True while a quic_slowloris window is open (no tick): the
+        stream-completion path checks this to route into the hold
+        buffer; only the rx-round hook advances the window."""
+        return self._slowloris_active
+
+    def quic_slowloris_halt(self) -> None:
+        """Tile halt with the deferral window still open: the tile
+        flushes its hold buffer (nothing is lost) and the window closes
+        here so the tri-counter stays balanced on truncated runs."""
+        if self._slowloris_active:
+            self._slowloris_active = False
+            self.note("quic_slowloris", "healed")
+
     # -- supervisor level ------------------------------------------------
 
     def hb_stalled(self, tile_id: str) -> bool:
@@ -450,6 +568,24 @@ class ChaosInjector:
             self._hb_stall_active.discard(tile_id)
             self.note("hb_stall", "healed")  # window closed, beat resumes
         return False
+
+    def quic_faults_pending(self) -> bool:
+        """True while a scheduled quic_* fault has not yet fired (or a
+        slowloris window is still open). The quic tile folds this into
+        its done() predicate the way the supervisor folds
+        supervisor_faults_pending into quiescence: the tile keeps
+        stepping — each step ticks the hook ordinals — until every
+        scheduled injection has landed, so WHETHER a fault fires never
+        races swarm speed against host speed."""
+        with self._lock:
+            if self._slowloris_active:
+                return True
+            for cls in ("quic_malformed", "quic_conn_churn"):
+                if self.schedule.get(cls):
+                    return True  # unconsumed point entries remain
+            n = self._ord.get("quic_service_round", 0)
+            return any(hi > n
+                       for lo, hi in self.schedule.get("quic_slowloris", []))
 
     def supervisor_faults_pending(self) -> bool:
         """True while a scheduled supervisor-level fault (worker_kill)

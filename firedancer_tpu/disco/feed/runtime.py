@@ -142,10 +142,22 @@ def run_feed_pipeline(
     record_digests: bool = False,
     pack_scheduler: str = "greedy",
     tile_cpus: Optional[List[int]] = None,
+    source_tile=None,
+    source_done=None,
+    pre_wait=None,
 ):
     """Same contract as pipeline.run_pipeline (which routes here when
     FD_FEED is on and the topology qualifies); returns a PipelineResult
-    with feed=True, feeder verify_stats, and per-stage latency."""
+    with feed=True, feeder verify_stats, and per-stage latency.
+
+    source_tile (with its source_done exhaustion predicate and an
+    optional pre_wait hook that runs after threads start and returns a
+    cleanup callable) swaps the payload-replay source for an already-
+    constructed tile publishing on replay_verify — run_quic_pipeline
+    passes its QuicTile here, making QUIC -> feed staging -> verify a
+    first-class run_pipeline topology instead of a legacy-loop-only
+    path. A custom source always runs in-process (it owns host state —
+    the QUIC tile's socket — that cannot cross a worker boundary)."""
     from firedancer_tpu.disco import chaos
 
     # Fresh injector per run (no-op with FD_CHAOS off): direct callers
@@ -208,7 +220,13 @@ def run_feed_pipeline(
         # (run_supervised), asserted behaviorally per the RUNBOOK.
         use_proc = False
     replay = None
-    if not use_proc:
+    source_proc = use_proc
+    if source_tile is not None:
+        # Custom source (the QUIC tile): always in-process — it owns a
+        # socket/endpoint no worker process can adopt. Downstream
+        # worker placement is unaffected.
+        source_proc = False
+    elif not use_proc:
         replay = ReplayTile(
             wksp, pod.query_cstr("firedancer.replay.cnc"),
             out_links=_make_source_out_links(wksp, pod),
@@ -260,7 +278,8 @@ def run_feed_pipeline(
         )
         in_tiles = [dedup, pack, sink]
 
-    threads_tiles = [verify] if replay is None else [replay, verify]
+    src_inproc = source_tile if source_tile is not None else replay
+    threads_tiles = [verify] if src_inproc is None else [src_inproc, verify]
     threads_tiles += in_tiles
     if tile_cpus:
         for i, t in enumerate(threads_tiles):
@@ -296,18 +315,20 @@ def run_feed_pipeline(
             pod_path = os.path.join(tmp, "topo.pod")
             with open(pod_path, "wb") as f:
                 f.write(pod.serialize())
-            payloads_path = os.path.join(tmp, "payloads.pkl")
-            with open(payloads_path, "wb") as f:
-                pickle.dump(list(payloads), f)
             procs["downstream"] = _spawn_worker(
                 "dedup,pack,sink", topo.wksp_path, pod_path,
                 downstream_opts, tile_max_ns, result_path, tmp)
-            procs["replay"] = _spawn_worker(
-                "replay", topo.wksp_path, pod_path,
-                dict(downstream_opts, payloads_path=payloads_path),
-                tile_max_ns, "", tmp)
+            if source_proc:
+                payloads_path = os.path.join(tmp, "payloads.pkl")
+                with open(payloads_path, "wb") as f:
+                    pickle.dump(list(payloads), f)
+                procs["replay"] = _spawn_worker(
+                    "replay", topo.wksp_path, pod_path,
+                    dict(downstream_opts, payloads_path=payloads_path),
+                    tile_max_ns, "", tmp)
         for th in threads:
             th.start()
+        post_wait = pre_wait() if pre_wait is not None else None
         snt = sentinel_mod.start_for_run(wksp, pod)
 
         links = [
@@ -317,14 +338,16 @@ def run_feed_pipeline(
         ]
         worker_cncs = [
             Cnc(wksp, pod.query_cstr(f"firedancer.{n}.cnc"))
-            for n in (("dedup", "pack", "sink", "replay") if use_proc
-                      else ("dedup", "pack", "sink"))
-        ]
+            for n in (("dedup", "pack", "sink")
+                      + (("replay",) if source_proc else ()))
+        ] if use_proc else []
         src_mcache = MCache(
             wksp, pod.query_cstr("firedancer.replay_verify.mcache"))
         n_payloads = len(payloads)
 
         def src_done() -> bool:
+            if source_done is not None:
+                return source_done()
             if replay is not None:
                 return replay.done()
             # Source in a worker: only its out-ring cursor is visible.
@@ -407,6 +430,8 @@ def run_feed_pipeline(
         join_deadline = time.perf_counter() + timeout_s + 35.0
         for th in threads:
             th.join(timeout=max(0.1, join_deadline - time.perf_counter()))
+        if post_wait is not None:
+            post_wait()
         if worker_died is None:
             for proc in procs.values():
                 try:
@@ -433,9 +458,10 @@ def run_feed_pipeline(
 
         diag = snapshot(wksp, pod)
 
+        src_out = (src_inproc.out_link if src_inproc is not None else None)
         stage_latency = {
             "replay_pub": latency_percentiles(
-                replay.out_links[0].lat_ns if replay is not None else []),
+                src_out.lat_ns if src_out is not None else []),
             # Ring dwell (source publish -> stager drain): the feeder's
             # input-backlog distribution, from the drain's tspub export.
             "verify_drain": latency_percentiles(verify.stat_ring_dwell_ns),

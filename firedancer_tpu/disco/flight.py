@@ -133,6 +133,21 @@ TILE_METRICS: Tuple[Metric, ...] = (
            "times the failover circuit opened from closed"),
     Metric("breaker_reprobes", "gauge",
            "half-open device re-probes attempted"),
+    # fd_siege QUIC front-door defense counters (written by the quic
+    # tile's lane; zero everywhere else). Shed work is ACCOUNTED, never
+    # silent: admitted + shed == offered is a siege-smoke gate.
+    Metric("admit_shed", "counter",
+           "txns shed by per-connection token-bucket admission at the "
+           "QUIC tile (FD_QUIC_ADMIT_RATE/_BURST)"),
+    Metric("queue_shed", "counter",
+           "txns shed by credit-aware lowest-priority load shedding "
+           "when the front-door ready queue exceeds FD_QUIC_SHED_DEPTH"),
+    Metric("conn_quarantine", "counter",
+           "abusive peers quarantined by the connection-level circuit "
+           "breaker (FD_QUIC_ABUSE_THRESHOLD trips within 1 s)"),
+    Metric("quarantine_drop", "counter",
+           "datagrams dropped at the socket from quarantined peers "
+           "(cooldown window; half-open re-admit after it)"),
 )
 
 TILE_IDX: Dict[str, int] = {m.name: i for i, m in enumerate(TILE_METRICS)}

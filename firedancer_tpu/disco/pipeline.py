@@ -111,7 +111,7 @@ def build_topology(
     from firedancer_tpu.disco import flight, sentinel, xray
 
     edge_labels = [lane_link(l, lane) for l, lane in links]
-    edge_labels += ["verify_drain", "sink"]
+    edge_labels += ["verify_drain", "sink", "quic_ingest"]
     # verify_shards > 0 pre-labels per-mesh-shard verify rows — for
     # EVERY verify lane (a tile's shard lanes are named
     # "<flight_label>.shard<i>", so lane verify.v1 needs
@@ -234,6 +234,12 @@ class PipelineResult:
     # slowest exemplars with per-stage breakdown, and the queue-wait vs
     # service waterfall — the same block the bench artifacts carry.
     xray: Optional[dict] = None
+    # QUIC front-door accounting (quic_tile.quic_tile_stats; None on
+    # replay-sourced runs): offered/admitted/shed parity counters, the
+    # shed ledger (sha256 per shed txn — replay gates subtract exactly
+    # these from the corpus oracle), quarantine counts, and the
+    # endpoint metrics. The fd_siege artifacts carry this block.
+    quic: Optional[dict] = None
 
 
 def _run_tiles(
@@ -553,17 +559,34 @@ def run_quic_pipeline(
     timeout_s: float = 60.0,
     tile_cpus: Optional[List[int]] = None,
     quic_retry: bool = False,
+    record_digests: bool = False,
+    feed: Optional[bool] = None,
+    quic_idle_timeout: float = 10.0,
+    quic_stop_when=None,
 ) -> PipelineResult:
     """Full ingest path: QUIC server tile -> verify -> dedup -> pack -> sink.
 
     The quic tile binds an ephemeral localhost UDP port; `client_fn` is
     called on a helper thread with the listen address and must deliver
     `n_txns` transactions over QUIC (one per unidirectional stream). The
-    run ends when the quic tile has published n_txns frags and every
-    downstream link has drained (or on timeout).
-    """
-    from firedancer_tpu.disco.quic_tile import QuicTile
+    run ends when the quic tile has seen n_txns completed streams, every
+    one is admitted or accounted shed, and every downstream link has
+    drained (or on timeout).
 
+    Like run_pipeline, the run routes through the fd_feed ingest runtime
+    (the QuicTile publishes into the same replay_verify ring the feed's
+    stager drains — the QUIC -> feed -> verify first-class topology)
+    when `feed` is True or unset-with-FD_FEED-on AND the topology
+    qualifies; FD_FEED=0 or an unsupported topology keeps the legacy
+    in-process step loop, warned + recorded, never silent.
+    """
+    from firedancer_tpu.disco import chaos
+    from firedancer_tpu.disco.quic_tile import QuicTile, quic_tile_stats
+
+    chaos.init_for_run()
+    fallback_reason = None
+    if feed is None:
+        feed = flags.get_bool("FD_FEED")
     pod = topo.pod
     wksp = Workspace.join(topo.wksp_path)
     quic = QuicTile(
@@ -572,6 +595,9 @@ def run_quic_pipeline(
         identity_seed=identity_seed,
         stop_after=n_txns,
         retry=quic_retry,
+        idle_timeout=quic_idle_timeout,
+        record_digests=record_digests,
+        stop_when=quic_stop_when,
     )
 
     def pre_wait():
@@ -581,8 +607,40 @@ def run_quic_pipeline(
         client.start()
         return lambda: client.join(timeout=5.0)
 
-    return _run_tiles(
+    if feed:
+        fallback_reason = _feed_fallback_reason(
+            pod, verify_backend, verify_batch, None)
+        if fallback_reason is None:
+            from firedancer_tpu.disco.feed.runtime import run_feed_pipeline
+
+            res = run_feed_pipeline(
+                topo, [],
+                verify_backend=verify_backend,
+                verify_batch=verify_batch,
+                verify_max_msg_len=verify_max_msg_len,
+                bank_cnt=bank_cnt,
+                timeout_s=timeout_s,
+                record_digests=record_digests,
+                tile_cpus=tile_cpus,
+                source_tile=quic,
+                source_done=quic.done,
+                pre_wait=pre_wait,
+            )
+            res.quic = quic_tile_stats(quic)
+            return res
+        import logging
+
+        logging.getLogger("firedancer_tpu.disco.feed").warning(
+            "fd_feed requested for the QUIC topology but unsupported "
+            "here — falling back to the legacy step loop: %s",
+            fallback_reason,
+        )
+    res = _run_tiles(
         wksp, pod, quic, quic.done,
         verify_backend, verify_batch, verify_max_msg_len, bank_cnt, timeout_s,
         pre_wait=pre_wait, tile_cpus=tile_cpus,
+        record_digests=record_digests,
     )
+    res.feed_fallback_reason = fallback_reason
+    res.quic = quic_tile_stats(quic)
+    return res

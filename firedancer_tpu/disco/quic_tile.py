@@ -1,4 +1,4 @@
-"""QUIC ingest tile: UDP/QUIC server -> txn frag stream.
+"""QUIC ingest tile: UDP/QUIC server -> txn frag stream, defended.
 
 Role parity with /root/reference/src/disco/quic/fd_quic_tile.c: the tile's
 run loop services the packet transport and the QUIC endpoint back to back
@@ -11,15 +11,63 @@ for sigverify anyway), and oversized/empty streams are dropped at ingest
 with the same effect as the reference's parse-failure drop. Transport is
 the udpsock aio backend (the reference's XDP path has no host-kernel-bypass
 equivalent in this environment; the aio seam is where one would plug in).
+
+fd_siege overload defenses (on by default, FD_QUIC_DEFENSES=0 is the A/B
+hatch — scripts/siege_smoke.py gates their overhead and docs/RUNBOOK.md
+"the front door under attack" catalogs the expected counters per attack
+profile):
+
+  admission   per-connection token bucket (FD_QUIC_ADMIT_RATE/_BURST):
+              a stream completing past its connection's budget is SHED —
+              counted in the tile's `admit_shed` flight metric, its
+              sha256 appended to the shed ledger (so replay gates stay
+              bit-exact: expected sink content = corpus oracle minus
+              exactly the ledger), and recorded as an fd_xray "shed"
+              event. One hostile connection cannot monopolize ingest.
+
+  shedding    credit-aware lowest-priority load shedding: when the ready
+              queue exceeds FD_QUIC_SHED_DEPTH, the LOWEST-priority
+              queued txn (compute-budget rewards order — the same order
+              fd_pack maximizes downstream) is dropped (`queue_shed`)
+              BEFORE the feed backpressures. Overload degrades by
+              shedding the cheapest work, not by stalling the pipeline
+              into an fd_sentinel burn alert.
+
+  quarantine  a connection-level circuit breaker (the fd_chaos breaker
+              pattern: trip -> open -> half-open re-admit): peers
+              accumulating FD_QUIC_ABUSE_THRESHOLD abuse events within
+              1 s (malformed datagrams, oversized streams, slowloris
+              reassembly pressure — NOT admission sheds, which are
+              normal degradation an address full of honest NAT'd
+              users produces) have their
+              connections closed and their datagrams dropped at the
+              socket (`quarantine_drop`) for a cooldown that doubles
+              per consecutive trip. Handshake-deadline reaping
+              (FD_QUIC_HS_TIMEOUT_S, enforced in Quic.service) bounds
+              half-open-connection floods independently.
+
+Every admitted stream's (completion -> publish) latency lands in the
+always-on "quic_ingest" flight edge histogram — the fd_sentinel
+`quic_ingest_p99` SLO row — so "the defenses keep the front door
+shallow" is a continuously-enforced budget, not a slogan.
+
+fd_chaos hook sites (quic_malformed / quic_conn_churn / quic_slowloris,
+disco/chaos.py) live in step(): injections are fed straight into the
+endpoint, bypassing the quarantine gate, so the audited behavior is the
+endpoint's own defense, and they run concurrently with live swarm
+traffic (the fd_siege scenario contract).
 """
 
 from __future__ import annotations
 
+import hashlib
 import subprocess
 import time
 from collections import deque
-from typing import Deque, Optional, Tuple
+from typing import Deque, Dict, Optional, Tuple
 
+from firedancer_tpu import flags
+from firedancer_tpu.disco import chaos, flight, xray
 from firedancer_tpu.disco.tiles import (
     CNC_DIAG_BACKP_CNT,
     CNC_DIAG_SV_FILT_CNT,
@@ -31,6 +79,62 @@ from firedancer_tpu.disco.tiles import (
 from firedancer_tpu.tango import tempo
 from firedancer_tpu.tango.quic.quic import Quic, QuicConfig
 from firedancer_tpu.tango.udpsock import UdpBatchSock, UdpSock
+
+# Abuse/quarantine tables are bounded: a spoofed-source flood must not
+# grow tile memory without limit. Oldest entries evict first (dict
+# insertion order) — an evicted abuser simply starts a fresh window.
+_ABUSE_TABLE_CAP = 8192
+# Rolling abuse-score window (seconds): events older than this stop
+# counting toward the breaker threshold.
+_ABUSE_WINDOW_S = 1.0
+# Quarantine cooldown doubling cap (the decaying re-admit, breaker
+# pattern: a persistent abuser is re-probed at 8x base at most).
+_QUARANTINE_BACKOFF_CAP = 8
+
+
+def _txn_priority(payload: bytes, estimator) -> int:
+    """Shed priority of a queued txn: the pack tile's own rewards
+    estimate (priority fee + base fee), so the front door sheds exactly
+    the work fd_pack would have scheduled last. Unparseable payloads
+    are priority 0 — junk is always the first thing shed."""
+    from firedancer_tpu.ballet.compute_budget import (
+        estimate_rewards_and_compute,
+    )
+    from firedancer_tpu.ballet.txn import TxnParseError, parse_txn
+
+    try:
+        txn = parse_txn(payload)
+        rce = estimate_rewards_and_compute(
+            txn, payload, lamports_per_signature=5000, estimator=estimator
+        )
+    except TxnParseError:
+        return 0
+    if rce is None:
+        return 0
+    return int(rce[0])
+
+
+def quic_tile_stats(q: "QuicTile") -> Dict[str, object]:
+    """The front-door accounting record (PipelineResult.quic / the
+    SIEGE_r*.json artifacts): offered/admitted/shed parity counters,
+    the shed ledger, quarantine accounting, and the endpoint metrics.
+    Invariant the siege smoke gates: admitted + shed_total == offered."""
+    m = q.fl.as_dict()
+    return {
+        "streams_seen": q.streams_seen,
+        "offered": q.offered,
+        "admitted": q.pub_cnt,
+        "admit_shed": m["admit_shed"],
+        "queue_shed": m["queue_shed"],
+        "shed_total": m["admit_shed"] + m["queue_shed"],
+        "shed_sha256": list(q.shed_sha256),
+        "admitted_sha256": (list(q.admitted_sha256)
+                            if q.record_digests else None),
+        "conn_quarantine": m["conn_quarantine"],
+        "quarantine_drop": m["quarantine_drop"],
+        "defenses": q.defenses,
+        "quic_metrics": dict(q.quic.metrics),
+    }
 
 
 class QuicTile(Tile):
@@ -48,6 +152,8 @@ class QuicTile(Tile):
         idle_timeout: float = 10.0,
         stop_after: Optional[int] = None,
         retry: bool = False,
+        record_digests: bool = False,
+        stop_when=None,
         **kw,
     ):
         super().__init__(wksp, cnc_name, out_link=out_link, **kw)
@@ -74,46 +180,363 @@ class QuicTile(Tile):
                 # public ingest port (zero state for spoofed Initials);
                 # off by default so dev-loop clients stay one-round-trip.
                 retry=retry,
+                # Handshake-deadline reaping: half-open conns (junk or
+                # spoofed Initials that will never complete) are
+                # retired on this budget, not the full idle timeout.
+                hs_timeout=flags.get_float("FD_QUIC_HS_TIMEOUT_S"),
             ),
             tx=lambda addr, dg: self._tx_aio.send_one(addr, dg),
             on_stream=self._on_stream,
+            on_rx_drop=self._on_rx_drop,
         )
-        self._ready: Deque[bytes] = deque()
+        # Ready queue entries: (arrival_tick, priority, payload). FIFO
+        # publish order; the shed scan removes the minimum priority.
+        self._ready: Deque[list] = deque()
         self._t0 = time.monotonic()
         self.pub_cnt = 0
         self.pub_sz = 0
         self.stop_after = stop_after  # for bounded test runs
+        # Custom exhaustion predicate (fd_siege: the swarm knows how
+        # many streams it actually delivered — under active shedding
+        # and quarantine a fixed stop_after cannot).
+        self.stop_when = stop_when
+        # Admitted-content audit (siege gates): sha256 of every payload
+        # PUBLISHED downstream, so "bit-exact sink digests for admitted
+        # traffic" is checkable regardless of which copies were shed.
+        self.record_digests = record_digests
+        self.admitted_sha256: list = []
+        # -- fd_siege defenses (resolved once; FD_QUIC_DEFENSES=0 is
+        # the overhead-A/B hatch the siege smoke uses) ----------------
+        self.defenses = flags.get_bool("FD_QUIC_DEFENSES")
+        self._admit_rate = float(flags.get_int("FD_QUIC_ADMIT_RATE"))
+        self._admit_burst = float(flags.get_int("FD_QUIC_ADMIT_BURST"))
+        self._shed_depth = flags.get_int("FD_QUIC_SHED_DEPTH")
+        self._abuse_threshold = flags.get_int("FD_QUIC_ABUSE_THRESHOLD")
+        self._quarantine_cooldown_s = flags.get_int(
+            "FD_QUIC_QUARANTINE_COOLDOWN_MS") / 1e3
+        self._slow_max_buf = flags.get_int("FD_QUIC_SLOW_MAX_BUF")
+        # addr -> [events_in_window, window_start, trips]
+        self._abuse: Dict[object, list] = {}
+        # addr -> quarantine-until (tile clock); absent = admitted.
+        self._quarantine: Dict[object, float] = {}
+        # Accounting: offered = streams past the size filter; the siege
+        # parity gate is admitted + shed == offered. The shed ledger
+        # (sha256 per shed txn) keeps replay gates bit-exact.
+        self.streams_seen = 0
+        self.offered = 0
+        self.shed_sha256: list = []
+        from firedancer_tpu.ballet.pack import CuEstimator
+
+        self._est = CuEstimator()
+        # fd_flight: the tile's typed metric lane (admit_shed /
+        # queue_shed / conn_quarantine / quarantine_drop counters,
+        # shared-memory backed under build_topology workspaces) + the
+        # always-on admission-span histogram (stream completion ->
+        # frag publish; the fd_sentinel quic_ingest_p99 SLO reads it).
+        self.fl = flight.tile_lane(wksp, self.flight_label)
+        self._ingest_span: Optional[flight.EdgeHist] = None
+        if flight.enabled() and flags.get_bool("FD_TRACE_SPANS"):
+            self._ingest_span = flight.edge_hist(wksp, "quic_ingest")
+        # fd_xray: shed/quarantine trigger events land in the tile's
+        # exemplar ring (autopsies name the defense that acted).
+        self.xr = xray.ring(f"tile:{self.flight_label}")
+        # fd_chaos quic_slowloris hold buffer (deferred, never lost)
+        # and the churn-conn heal watch (scids awaiting reap).
+        self._deferred: list = []
+        self._churn_watch: list = []
 
     # -------------------------------------------------------------- quic ---
 
+    def _now(self) -> float:
+        return time.monotonic() - self._t0
+
+    def _abuse_event(self, addr, reason: str, n: int = 1) -> None:
+        """Score one abuse event against a peer; trip the quarantine
+        breaker past the threshold (fd_chaos breaker pattern: open for
+        a cooldown that doubles per consecutive trip, half-open
+        re-admit when it lapses — see _rx)."""
+        if not self.defenses or addr is None:
+            return
+        now = self._now()
+        st = self._abuse.get(addr)
+        if st is None:
+            if len(self._abuse) >= _ABUSE_TABLE_CAP:
+                self._abuse.pop(next(iter(self._abuse)))
+            st = self._abuse[addr] = [0, now, 0]
+        if now - st[1] > _ABUSE_WINDOW_S:
+            st[0], st[1] = 0, now
+        st[0] += n
+        if st[0] < self._abuse_threshold or addr in self._quarantine:
+            return
+        st[0] = 0
+        st[2] += 1
+        cooldown = self._quarantine_cooldown_s * min(
+            1 << (st[2] - 1), _QUARANTINE_BACKOFF_CAP)
+        if len(self._quarantine) >= _ABUSE_TABLE_CAP:
+            self._quarantine.pop(next(iter(self._quarantine)))
+        self._quarantine[addr] = now + cooldown
+        self.fl.inc("conn_quarantine")
+        self.flightrec.record("quic_quarantine", addr=repr(addr)[:64],
+                              reason=reason, trips=st[2],
+                              cooldown_ms=int(cooldown * 1e3))
+        self.xr.record(0, 0, tempo.tickcount() & 0xFFFFFFFF,
+                       "quic_quarantine",
+                       {"addr": repr(addr)[:64], "reason": reason,
+                        "trips": st[2]})
+        # Close the abuser's live connections; Quic.service reaps them.
+        for conn in list(self.quic.conns):
+            if conn.peer_addr == addr and not conn.closed:
+                conn.abort(0x02, "quarantined: abusive peer")
+
+    def _on_rx_drop(self, addr) -> None:
+        """Endpoint-attributed junk (malformed datagram, unknown cid,
+        bad token, conn-cap overflow): an abuse event for the breaker."""
+        self._abuse_event(addr, "rx_drop")
+
+    def _rx(self, addr, datagram: bytes, now: float) -> None:
+        """Socket rx gate: quarantined peers are dropped HERE, before
+        any QUIC processing buys them CPU or state; a lapsed cooldown
+        re-admits (half-open — re-abuse re-trips with the doubled
+        cooldown already recorded against the peer)."""
+        until = self._quarantine.get(addr)
+        if until is not None:
+            if now < until:
+                self.fl.inc("quarantine_drop")
+                return
+            del self._quarantine[addr]  # half-open re-admit
+        self.quic.rx(addr, datagram, now)
+
+    def _shed(self, payload: bytes, reason: str) -> None:
+        """Book one shed txn: counter (admit_shed for admission sheds,
+        queue_shed for overflow and halt drains), ledger sha256 (the
+        replay-gate oracle subtracts exactly these), flight event, xray
+        shed trigger. The ONE bookkeeping path for every shed — the
+        siege parity gate admitted + shed == offered has no third
+        bucket to hide in, and a halt-time drain must not diverge from
+        the steady-state accounting."""
+        self.fl.inc("admit_shed" if reason == "admit" else "queue_shed")
+        self.shed_sha256.append(hashlib.sha256(payload).hexdigest())
+        self.flightrec.record("shed", reason=reason, sz=len(payload))
+        self.xr.record(0, 0, tempo.tickcount() & 0xFFFFFFFF, "shed",
+                       {"reason": reason, "sz": len(payload)})
+
+    def _admit(self, conn) -> bool:
+        """Per-connection token-bucket admission (FD_QUIC_ADMIT_RATE /
+        _BURST). Bucket state rides on the connection object — state
+        dies with the conn, exactly the lifetime it governs."""
+        now = self._now()
+        tokens = getattr(conn, "_admit_tokens", None)
+        if tokens is None:
+            tokens, at = self._admit_burst, now
+        else:
+            at = conn._admit_at
+            tokens = min(self._admit_burst,
+                         tokens + (now - at) * self._admit_rate)
+        if tokens < 1.0:
+            conn._admit_tokens, conn._admit_at = tokens, now
+            return False
+        conn._admit_tokens, conn._admit_at = tokens - 1.0, now
+        return True
+
     def _on_stream(self, conn, stream_id: int, data: bytes) -> None:
+        self.streams_seen += 1
         if not data or len(data) > min(FD_TPU_MTU, self.out_link.mtu):
             # same effect as the reference's in-tile parse-failure drop
             self.cnc.diag_add(CNC_DIAG_SV_FILT_CNT, 1)
             self.cnc.diag_add(CNC_DIAG_SV_FILT_SZ, len(data))
+            if data:
+                # Oversized-stream abuse scores against the peer (an
+                # empty stream is a client bug, not an attack surface).
+                self._abuse_event(conn.peer_addr, "oversize")
             return
-        self._ready.append(data)
+        self.offered += 1
+        if self.defenses and not self._admit(conn):
+            # Admission excess is NORMAL degradation, not abuse: it is
+            # ledgered shed, never breaker fuel — many honest
+            # connections share one address behind a NAT, and folding
+            # their aggregate bucket excess into the per-peer abuse
+            # score would quarantine the whole address for being
+            # popular (malformed/oversize/slowloris evidence still
+            # scores; see _abuse_event call sites).
+            self._shed(data, "admit")
+            return
+        entry = [tempo.tickcount(), None, data]
+        if self.defenses and len(self._ready) > self._shed_depth // 2:
+            # Pre-overload amortization: once the queue is half-deep,
+            # pay the priority parse at enqueue (one per arrival) so
+            # the shed scan never has to lazily fill thousands of
+            # entries in one pass — shallow queues (steady state) still
+            # never pay it.
+            entry[1] = _txn_priority(data, self._est)
+        c = chaos.active()
+        if c is not None and c.quic_slowloris_active():
+            # Inside an open quic_slowloris window: defer (hold, never
+            # lose) — the release at window close restamps arrival, so
+            # the simulated late delivery is not charged to the
+            # admission span (the bytes "had not arrived" yet).
+            self._deferred.append(entry)
+            return
+        self._ready.append(entry)
+        self._shed_overflow()
+
+    def _shed_overflow(self) -> None:
+        """Credit-aware load shedding: while the ready queue is past
+        FD_QUIC_SHED_DEPTH, drop the LOWEST-priority entry (compute-
+        budget rewards order). Priorities are cached on the entry —
+        filled at enqueue once the queue is half-deep (see _on_stream),
+        lazily here only for the bounded prefix enqueued while shallow
+        — so steady-state traffic never pays the parse and the shed
+        scan is one O(depth) integer pass, not a parse storm."""
+        if not self.defenses:
+            return
+        while len(self._ready) > self._shed_depth:
+            low_i, low_p = 0, None
+            for i, e in enumerate(self._ready):
+                if e[1] is None:
+                    e[1] = _txn_priority(e[2], self._est)
+                if low_p is None or e[1] < low_p:
+                    low_i, low_p = i, e[1]
+            victim = self._ready[low_i]
+            del self._ready[low_i]
+            self._shed(victim[2], "queue")
+
+    def chaos_quiet(self) -> bool:
+        """True when no scheduled quic_* chaos fault is still pending
+        and every injected churn conn has healed (been reaped) — the
+        supervisor_faults_pending pattern: the tile keeps stepping
+        (each step ticks the hook ordinals and drives the reaper)
+        until the audit can balance."""
+        c = chaos.active()
+        if c is None:
+            return True
+        return not c.quic_faults_pending() and not self._churn_watch
 
     def done(self) -> bool:
-        return self.stop_after is not None and self.pub_cnt >= self.stop_after
+        if not self.chaos_quiet():
+            return False
+        if self.stop_when is not None:
+            return bool(self.stop_when(self))
+        if self.stop_after is None:
+            return False
+        # Every expected stream seen AND everything admitted-or-shed:
+        # the ready/hold queues are empty, so admitted + shed == offered
+        # holds at quiescence (the siege accounting-parity gate).
+        return (self.streams_seen >= self.stop_after
+                and not self._ready and not self._deferred)
 
     # -------------------------------------------------------------- loop ---
 
+    def _chaos_hooks(self, c, now: float) -> None:
+        """fd_siege chaos injections, fed straight into the endpoint
+        (bypassing the quarantine gate on purpose: the audited defense
+        is the ENDPOINT's, and a quarantined synthetic peer must not
+        mask a later scheduled injection)."""
+        # Synthetic peer addresses are ROUTABLE-but-inert (127.0.0.2,
+        # low ports no client binds): the endpoint replies to junk
+        # (stateless resets) and to fake Initials, and those replies
+        # must be sendable no-ops, not tx errors.
+        junk = c.quic_malformed_junk()
+        if junk is not None:
+            drops0 = self.quic.metrics["rx_dropped"]
+            self.quic.rx(("127.0.0.2", 9), junk, now)
+            if self.quic.metrics["rx_dropped"] > drops0:
+                c.on_quic_malformed_dropped()
+        fake = c.quic_churn_initial()
+        if fake is not None:
+            conns0 = self.quic.metrics["conns_created"]
+            drops0 = self.quic.metrics["rx_dropped"]
+            addr = ("127.0.0.2", 10000 + len(self._churn_watch) + 1)
+            self.quic.rx(addr, fake, now)
+            if self.quic.metrics["conns_created"] > conns0:
+                # Half-open conn allocated: detected now, healed when
+                # the handshake-deadline reaper retires its cid.
+                c.note("quic_conn_churn", "detected")
+                self._churn_watch.append(self.quic.conns[-1].scid)
+            elif self.quic.metrics["rx_dropped"] > drops0:
+                # Conn cap refused it: the drop is detection AND heal.
+                c.note("quic_conn_churn", "detected")
+                c.note("quic_conn_churn", "healed")
+        if not c.quic_slowloris_held() and self._deferred:
+            # Window closed: release the held txns — restamped, see
+            # _on_stream — back into the admission queue.
+            now_tick = tempo.tickcount()
+            for e in self._deferred:
+                e[0] = now_tick
+                self._ready.append(e)
+            self._deferred.clear()
+            self._shed_overflow()
+        if self._churn_watch:
+            alive = self.quic._conns_by_cid
+            still = []
+            for scid in self._churn_watch:
+                if scid in alive:
+                    still.append(scid)
+                else:
+                    c.note("quic_conn_churn", "healed")
+            self._churn_watch = still
+
     def step(self) -> None:
-        now = time.monotonic() - self._t0
-        self.sock.service_rx(lambda addr, d: self.quic.rx(addr, d, now))
+        now = self._now()
+        c = chaos.active()
+        if c is not None:
+            self._chaos_hooks(c, now)
+        self.sock.service_rx(lambda addr, d: self._rx(addr, d, now))
         self.quic.service(now)
         while self._ready:
             if not self.out_link.can_publish():
                 self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
+                # Backpressured with a deep queue: shed rather than
+                # stall (the queue can only be past the depth here if
+                # defenses are off or entries raced in; _shed_overflow
+                # is idempotent and cheap when not).
+                self._shed_overflow()
                 return  # keep servicing the socket; retry next step
-            payload = self._ready.popleft()
+            t_arr, _prio, payload = self._ready.popleft()
+            now_tick = tempo.tickcount()
+            if self._ingest_span is not None:
+                self._ingest_span.observe((now_tick - t_arr)
+                                          & 0xFFFFFFFF)
             self.out_link.publish(payload, meta_sig(payload),
-                                  tsorig=tempo.tickcount() & 0xFFFFFFFF)
+                                  tsorig=now_tick & 0xFFFFFFFF)
+            if self.record_digests:
+                self.admitted_sha256.append(
+                    hashlib.sha256(payload).hexdigest())
             self.pub_cnt += 1
             self.pub_sz += len(payload)
         if not self.quic.conns and not self._ready:
             time.sleep(0.0005)  # idle: no conns to service
 
+    def on_housekeep(self) -> None:
+        # Publish the tile's flight lane (shed/quarantine counters are
+        # read cross-thread by monitors and the siege gates), then the
+        # slowloris-posture scan: a connection holding more than
+        # FD_QUIC_SLOW_MAX_BUF bytes of incomplete streams is an abuse
+        # event (reassembly pressure is the one thing a dribbling
+        # client grows). Housekeeping rate keeps the O(streams) scan
+        # off the per-datagram path.
+        self.fl.publish()
+        if not self.defenses:
+            return
+        for conn in list(self.quic.conns):
+            if conn.closed:
+                continue
+            _n, buffered = conn.reassembly_pressure()
+            if buffered > self._slow_max_buf:
+                self._abuse_event(conn.peer_addr, "slowloris",
+                                  n=self._abuse_threshold)
+
     def on_halt(self) -> None:
+        c = chaos.active()
+        if c is not None:
+            c.quic_slowloris_halt()
+        # Anything still queued at HALT is booked as shed (reason
+        # "halt", the queue_shed counter — through the ONE _shed
+        # bookkeeping path) so the accounting parity admitted + shed ==
+        # offered survives truncated runs — work is never silently
+        # dropped.
+        for e in list(self._deferred) + list(self._ready):
+            self._shed(e[2], "halt")
+        self._deferred.clear()
+        self._ready.clear()
+        self.fl.publish()
         self.sock.close()

@@ -116,6 +116,13 @@ SLO_TABLE: Tuple[SLO, ...] = (
         "source-publish span p99 stays us-scale (queue-free stage; a "
         "breach is pathological host scheduling, not load)",
         "FD_SLO_SOURCE_BUDGET_MS"),
+    SLO("quic_ingest_p99", "latency", "quic_ingest",
+        "QUIC front-door admission span (stream completion at the quic "
+        "tile -> frag publish into the feed) p99 within budget — the "
+        "queue the fd_siege admission/shedding defenses keep shallow: "
+        "a breach means completed txns are stalling INSIDE the front "
+        "door under attack instead of being admitted or shed",
+        "FD_SLO_QUIC_INGEST_MS"),
     SLO("pipeline_progress", "liveness", "progress",
         "some pipeline edge advances at least every FD_SLO_STALL_MS "
         "while the run is live (armed after the first frag)",
@@ -566,6 +573,7 @@ def evaluate_edges_summary(edges: Dict[str, dict],
 ARTIFACT_GLOBS = (
     "BENCH_r[0-9]*.json", "REPLAY_r[0-9]*.json", "REPLAY_CPU_r[0-9]*.json",
     "MULTICHIP_r[0-9]*.json", "PACK_r[0-9]*.json", "HOSTFEED_r[0-9]*.json",
+    "SIEGE_r[0-9]*.json",
 )
 
 _METRIC_KIND = {
@@ -575,6 +583,7 @@ _METRIC_KIND = {
     "pack_gc_schedule": "pack",
     "hostfeed_native_rates": "hostfeed",
     "feed_replay_smoke": "feed_smoke",
+    "quic_siege_profile": "siege",
     "note": "note",
 }
 
@@ -725,6 +734,33 @@ def regressions(timeline: List[TimelineEntry],
                 "drop_pct": round(100.0 * (1.0 - v / b), 1),
             })
         best[key] = max(b or 0.0, v)
+    return out
+
+
+def siege_status(timeline: List[TimelineEntry]) -> List[dict]:
+    """Every fd_siege profile artifact (SIEGE_r*.json) with its graded
+    gates: zero sentinel burn-rate alerts, shed-accounting parity
+    (admitted + shed == offered), chaos tri-counter parity, bit-exact
+    sink digests for admitted traffic. scripts/fd_siege.py writes the
+    verdicts into the artifact; fd_report renders this table."""
+    out = []
+    for e in timeline:
+        if e.kind != "siege":
+            continue
+        r = e.rec
+        out.append({
+            "source": e.source,
+            "profile": r.get("profile"),
+            "ts": e.ts,
+            "value": r.get("value"),
+            "unit": r.get("unit"),
+            "ok": bool(r.get("ok")),
+            "alert_cnt": (r.get("slo") or {}).get("alert_cnt"),
+            "offered": (r.get("quic") or {}).get("offered"),
+            "admitted": (r.get("quic") or {}).get("admitted"),
+            "shed": (r.get("quic") or {}).get("shed_total"),
+            "failures": list(r.get("failures") or []),
+        })
     return out
 
 

@@ -1,0 +1,572 @@
+"""fd_siege — the adversarial QUIC front-door scenario suite.
+
+ROADMAP direction #2 made "heavy traffic from millions of users" a
+measurable claim: drive the QUIC -> fd_feed -> verify topology with a
+deterministic, seeded attack swarm and gate on **zero fd_sentinel
+burn-rate alerts under every adversarial profile** — the defenses
+(per-connection admission, credit-aware shedding, the per-peer abuse
+breaker; disco/quic_tile.py) are what keep the table green, and the
+suite proves it continuously instead of assuming it.
+
+Profiles (each a named, seeded traffic shape over a disco/corpus.py
+mainnet corpus, so expected sink content stays computable by
+construction):
+
+  conn_churn       the whole corpus spread over many short-lived
+                   connections opened/closed as fast as the handshake
+                   allows (the thousands-of-users arrival shape; scale
+                   with the conns knob).
+  dup_storm        honest carriers plus attacker connections replaying
+                   duplicate copies of valid txns at wire speed —
+                   admission sheds the excess, dedup absorbs the rest.
+  malformed_flood  honest traffic while attacker sockets spray junk
+                   datagrams (and the corpus's truncated/corrupt txns
+                   ride the honest streams): the endpoint must drop
+                   every one unprocessed and the abuse breaker must
+                   quarantine the flooding peers.
+  slowloris        attacker connections dribble partial streams (no
+                   FIN) to grow reassembly state; the per-conn
+                   incomplete-stream budget (FD_QUIC_SLOW_MAX_BUF)
+                   quarantines them while honest traffic flows.
+  oversize_abuse   attacker streams past the TPU MTU (dropped at
+                   ingest, abuse-scored) interleaved with honest load.
+  keyupdate_churn  honest connections churn their 1-RTT keys
+                   (RFC 9001 §6) mid-delivery and the whole swarm
+                   migrates its socket once (NAT-rebind shape) — the
+                   crypto/path state machines under load.
+
+Determinism: which payloads ride which connection, every junk byte,
+and the attacker schedules all derive from the profile seed; thread
+timing varies but the content accounting (the admitted-digest law
+below) is order-independent, so a failing profile replays.
+
+The content gate (scripts/fd_siege.py): the sink must hold EXACTLY
+  { d in corpus-OK digests : d was admitted at least once }
+— the quic tile's admitted/shed ledgers (quic_tile_stats) make that
+set exact no matter which copies admission shed, so load shedding
+never hides corruption and corruption never hides behind shedding.
+
+Accounting-parity gate: admitted + shed == offered at the tile, and
+the swarm's delivered-stream count reconciles with streams_seen.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from firedancer_tpu.utils.rng import Rng
+
+PROFILES = (
+    "conn_churn",
+    "dup_storm",
+    "malformed_flood",
+    "slowloris",
+    "oversize_abuse",
+    "keyupdate_churn",
+)
+
+# Per-worker cap on concurrently-open client connections: handshakes
+# are the expensive part of churn, so the swarm pipelines a few while
+# the rest of the jobs queue.
+MAX_CONCURRENT = 16
+# Give up on an HONEST job after this many fresh-connection attempts;
+# attacker jobs never retry — a quarantined attacker's death is the
+# defense working, and retrying it only adds a traffic-free tail that
+# would read as a pipeline stall. Honest jobs abandoning is a gate
+# failure the digest check catches.
+JOB_RETRIES = 2
+# A connection that has not completed its handshake within this budget
+# is abandoned client-side (quarantined peers' Initials are dropped at
+# the server socket — waiting a full idle timeout for them would stall
+# the whole profile past the liveness SLO). Scaled by usable cores:
+# on a 1-core host the swarm, the tile, and the whole verify pipeline
+# contend for one CPU and honest handshakes legitimately take longer.
+ESTABLISH_TIMEOUT_S = 1.5
+
+
+def usable_cores() -> int:
+    """Cores this process may actually run on (the feed_smoke gate-
+    scaling precedent: a 1-CPU cgroup on a big host must read as 1)."""
+    try:
+        return len(os.sched_getaffinity(0))
+    except AttributeError:
+        return os.cpu_count() or 1
+
+
+def establish_timeout_s() -> float:
+    return ESTABLISH_TIMEOUT_S * (1.0 if usable_cores() >= 2 else 4.0)
+
+
+@dataclass
+class Job:
+    """One logical client connection's work."""
+
+    streams: List[bytes] = field(default_factory=list)  # complete (FIN)
+    hold: List[bytes] = field(default_factory=list)     # partial, no FIN
+    keyupdates: int = 0
+    attacker: bool = False   # rides an attacker socket (quarantine
+    #                          expected; its losses are not gate errors)
+
+
+@dataclass
+class SiegePlan:
+    name: str
+    jobs: List[Job]
+    junk_datagrams: int = 0          # raw junk sprayed at the port
+    env: Dict[str, str] = field(default_factory=dict)  # profile knobs
+    workers: int = 2                 # honest worker threads
+    note: str = ""
+
+
+@dataclass
+class SwarmStats:
+    """Shared swarm accounting (lock-guarded; the tile's stop_when
+    reads delivered/finished to know when the offered traffic is
+    exhausted — under shedding/quarantine a fixed count cannot)."""
+
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    delivered_streams: int = 0   # complete streams fully acked
+    held_streams: int = 0        # partial streams placed (never FIN)
+    abandoned_jobs: int = 0
+    abandoned_honest: int = 0
+    abandoned_streams: int = 0
+    junk_sent: int = 0
+    keyupdates: int = 0
+    migrations: int = 0
+    conns_opened: int = 0
+    finished: bool = False
+
+    def snapshot(self) -> Dict[str, int]:
+        with self.lock:
+            return {
+                "delivered_streams": self.delivered_streams,
+                "held_streams": self.held_streams,
+                "abandoned_jobs": self.abandoned_jobs,
+                "abandoned_honest": self.abandoned_honest,
+                "abandoned_streams": self.abandoned_streams,
+                "junk_sent": self.junk_sent,
+                "keyupdates": self.keyupdates,
+                "migrations": self.migrations,
+                "conns_opened": self.conns_opened,
+            }
+
+
+# --------------------------------------------------------------------------
+# Profile builders.
+# --------------------------------------------------------------------------
+
+
+def _split_jobs(payloads: List[bytes], n_conns: int, **kw) -> List[Job]:
+    """Round-robin the payload list over n_conns connection jobs."""
+    n_conns = max(1, min(n_conns, len(payloads) or 1))
+    jobs = [Job(**kw) for _ in range(n_conns)]
+    for i, p in enumerate(payloads):
+        jobs[i % n_conns].streams.append(p)
+    return [j for j in jobs if j.streams]
+
+
+def build_profile(name: str, corpus, seed: int = 0,
+                  conns: Optional[int] = None) -> SiegePlan:
+    """One named adversarial profile over a disco/corpus.py corpus.
+    `conns` scales the connection count (the churn/thousands-of-users
+    axis); defaults are sized for a CI-budget run — raise it for a
+    soak. Every random choice comes from (seed, name), so the plan is
+    replay-exact."""
+    if name not in PROFILES:
+        raise ValueError(
+            f"unknown siege profile {name!r} (want one of "
+            f"{', '.join(PROFILES)})"
+        )
+    import zlib
+
+    # crc32, NOT hash(): str hashes are salted per interpreter, which
+    # would silently void the bit-identical-replay contract above.
+    rng = Rng(seq=seed ^ (zlib.crc32(name.encode()) & 0xFFFF) ^ 0x51E6E)
+    payloads = list(corpus.payloads)
+    n = len(payloads)
+
+    if name == "conn_churn":
+        # Many tiny connections, churned as fast as handshakes allow:
+        # ~4 txns per conn, workers pipeline MAX_CONCURRENT at a time.
+        jobs = _split_jobs(payloads, conns or max(32, n // 4))
+        return SiegePlan(
+            name=name, jobs=jobs, workers=3,
+            env={"FD_QUIC_HS_TIMEOUT_S": "1.0"},
+            note=f"{len(jobs)} short-lived conns, ~4 txns each",
+        )
+
+    if name == "dup_storm":
+        # Honest conn count scales WITH the corpus so each conn's
+        # one-shot burst (n / conns txns) stays under the tightened
+        # admission bucket below at any FD_SIEGE_N — a fixed count
+        # would push honest bursts past the bucket AND the abuse
+        # threshold at large n (quarantining honest peers, a gate-5
+        # failure on a correct system).
+        jobs = _split_jobs(payloads, conns or max(32, n // 24))
+        # Attacker conns replay duplicate copies of VALID txns at wire
+        # speed: admission sheds the excess (ledgered), dedup drops
+        # the admitted remainder — either way the sink sees each txn
+        # once. Attacker losses (quarantine) cost only duplicates.
+        dup_jobs = []
+        # Sized past the profile's admission burst so the token bucket
+        # provably sheds at any corpus scale.
+        n_dup = max(150, n // 8)
+        for _ in range(4):
+            dups = [payloads[rng.roll(n)] for _ in range(n_dup)]
+            dup_jobs.append(Job(streams=dups, attacker=True))
+        return SiegePlan(
+            name=name, jobs=jobs + dup_jobs, workers=2,
+            # Rate sized BELOW what an attacker conn can deliver even
+            # on a contended 1-core CI host, so the bucket provably
+            # sheds at any host speed; honest conns' ~24-txn bursts
+            # ride the burst allowance + refill and stay under the
+            # abuse threshold even at wire speed.
+            env={"FD_QUIC_ADMIT_RATE": "25",
+                 "FD_QUIC_ADMIT_BURST": "16"},
+            note=f"4 attacker conns x {n_dup} dup txns vs a 16-burst "
+                 "25/s admission bucket",
+        )
+
+    if name == "malformed_flood":
+        jobs = _split_jobs(payloads, conns or 16)
+        return SiegePlan(
+            name=name, jobs=jobs, junk_datagrams=max(400, n // 2),
+            workers=2,
+            env={"FD_QUIC_ABUSE_THRESHOLD": "24"},
+            note="junk-datagram spray from attacker sockets + the "
+                 "corpus's truncated/corrupt txns on honest streams",
+        )
+
+    if name == "slowloris":
+        jobs = _split_jobs(payloads, conns or 16)
+        hold_jobs = []
+        for _ in range(4):
+            # Partial streams (no FIN), big enough that one conn blows
+            # the profile's reassembly budget and gets quarantined.
+            held = [bytes(rng.roll(256) for _ in range(900))
+                    for _ in range(24)]
+            hold_jobs.append(Job(hold=held, attacker=True))
+        return SiegePlan(
+            name=name, jobs=jobs + hold_jobs, workers=2,
+            env={"FD_QUIC_SLOW_MAX_BUF": "16384"},
+            note="4 dribbling conns x 24 held partial streams "
+                 "(~21 KiB each) vs a 16 KiB reassembly budget",
+        )
+
+    if name == "oversize_abuse":
+        jobs = _split_jobs(payloads, conns or 16)
+        big_jobs = []
+        for _ in range(3):
+            big = [bytes(rng.roll(256) for _ in range(1400))
+                   for _ in range(24)]
+            big_jobs.append(Job(streams=big, attacker=True))
+        return SiegePlan(
+            name=name, jobs=jobs + big_jobs, workers=2,
+            env={"FD_QUIC_ABUSE_THRESHOLD": "16"},
+            note="3 attacker conns x 24 oversize (1400 B > MTU) "
+                 "streams",
+        )
+
+    if name == "keyupdate_churn":
+        jobs = _split_jobs(payloads, conns or 12, keyupdates=3)
+        return SiegePlan(
+            name=name, jobs=jobs, workers=2,
+            note="3 key updates per conn mid-delivery + one whole-"
+                 "swarm socket rebind (migration)",
+        )
+
+    raise AssertionError("unreachable")  # noqa: B011 — PROFILES gate above
+
+
+# --------------------------------------------------------------------------
+# The swarm: worker threads multiplexing client connections.
+# --------------------------------------------------------------------------
+
+
+class _ConnState:
+    """Per-connection send state machine: the job's streams split into
+    (keyupdates + 1) chunks, a key update rolled between chunks — each
+    chunk's data is the ack-eliciting traffic that CONFIRMS the
+    previous update (RFC 9001 §6.2: a second roll needs the first
+    acknowledged), so the churn can never deadlock on a quiet wire."""
+
+    __slots__ = ("conn", "job", "chunks", "ci", "want_ku", "hold_sent",
+                 "chunk_sent", "attempts", "t_open")
+
+    def __init__(self, conn, job: Job, attempts: int, t_open: float):
+        self.conn = conn
+        self.job = job
+        self.t_open = t_open
+        n_chunks = max(1, job.keyupdates + 1)
+        per = max(1, -(-len(job.streams) // n_chunks)) if job.streams else 1
+        self.chunks = [job.streams[i:i + per]
+                       for i in range(0, len(job.streams), per)] or [[]]
+        self.ci = 0
+        self.want_ku = False
+        self.hold_sent = False
+        self.chunk_sent = False
+        self.attempts = attempts
+
+    def quiet(self) -> bool:
+        c = self.conn
+        return (not c._send_queue
+                and not any(s.sent for s in c.spaces))
+
+
+def _run_worker(listen_addr, jobs: List[Job], stats: SwarmStats,
+                deadline: float, seed: int, migrate_at: float = 0.0,
+                ) -> None:
+    """One swarm worker: a UdpSock + client QUIC endpoint multiplexing
+    up to MAX_CONCURRENT connection jobs. Jobs whose connection dies
+    retry on a fresh conn (JOB_RETRIES) then abandon — abandonment of
+    an HONEST job surfaces in the digest gate, an attacker job's is
+    the defense working."""
+    from firedancer_tpu.tango.quic.quic import Quic, QuicConfig
+    from firedancer_tpu.tango.udpsock import UdpSock
+
+    est_timeout = establish_timeout_s()
+    box = {"sock": UdpSock()}
+    box["tx"] = box["sock"].aio_tx()
+    client = Quic(
+        QuicConfig(is_server=False,
+                   identity_seed=bytes([seed & 0xFF]) * 32),
+        tx=lambda addr, d: box["tx"].send_one(addr, d),
+    )
+    pending: deque = deque(jobs)
+    active: List[_ConnState] = []
+    t0 = time.monotonic()
+    migrated = False
+    while time.monotonic() < deadline and (pending or active):
+        now = time.monotonic() - t0
+        if migrate_at and not migrated and now >= migrate_at:
+            # NAT-rebind shape: the whole worker rebinds its socket;
+            # the server sees every conn's next packet from a new
+            # port, path-challenges it, and the conns answer — one
+            # migration per conn, zero delivery impact expected.
+            old = box["sock"]
+            box["sock"] = UdpSock()
+            box["tx"] = box["sock"].aio_tx()
+            old.close()
+            migrated = True
+            with stats.lock:
+                stats.migrations += 1
+        while pending and len(active) < MAX_CONCURRENT:
+            job = pending.popleft()
+            attempts = getattr(job, "_attempts", 0) + 1
+            job._attempts = attempts  # type: ignore[attr-defined]
+            conn = client.connect(listen_addr, now)
+            with stats.lock:
+                stats.conns_opened += 1
+            active.append(_ConnState(conn, job, attempts, now))
+        box["sock"].service_rx(
+            lambda addr, d: client.rx(addr, d, time.monotonic() - t0))
+        now = time.monotonic() - t0
+        client.service(now)
+        still: List[_ConnState] = []
+        for st in active:
+            conn, job = st.conn, st.job
+            if (not conn.established and not conn.closed
+                    and now - st.t_open > est_timeout):
+                conn.closed = True  # handshake starved (quarantine?)
+            if conn.closed:
+                # Died before full ack: retry the whole job on a fresh
+                # conn, else abandon (losses surface in the gates).
+                # Attacker jobs never retry — see JOB_RETRIES above.
+                if not job.attacker and st.attempts <= JOB_RETRIES:
+                    pending.append(job)
+                else:
+                    with stats.lock:
+                        stats.abandoned_jobs += 1
+                        stats.abandoned_streams += len(job.streams)
+                        if not job.attacker:
+                            stats.abandoned_honest += 1
+                continue
+            if not conn.established:
+                still.append(st)
+                continue
+            if not st.hold_sent:
+                for p in job.hold:
+                    conn.send_stream(p, fin=False)
+                st.hold_sent = True
+                if job.hold:
+                    with stats.lock:
+                        stats.held_streams += len(job.hold)
+            if st.want_ku:
+                try:
+                    conn.initiate_key_update()
+                    st.want_ku = False
+                    with stats.lock:
+                        stats.keyupdates += 1
+                except RuntimeError:
+                    still.append(st)   # prior roll unconfirmed; retry
+                    continue
+            if not st.chunk_sent:
+                for p in st.chunks[st.ci]:
+                    conn.send_stream(p)
+                st.chunk_sent = True
+            if st.quiet():
+                # Chunk fully acked: the server completed its streams.
+                with stats.lock:
+                    stats.delivered_streams += len(st.chunks[st.ci])
+                st.ci += 1
+                st.chunk_sent = False
+                if st.ci < len(st.chunks):
+                    st.want_ku = st.ci <= job.keyupdates
+                    still.append(st)
+                    continue
+                if not job.hold:
+                    # Churn: abandon the conn client-side (the server
+                    # reaps it on idle timeout — the arrival shape the
+                    # profile exists to exercise). Held-stream conns
+                    # stay open to keep their reassembly pressure.
+                    conn.closed = True
+                continue
+            still.append(st)
+        active = still
+        time.sleep(0.001)
+    # Held conns stay open until the run ends; the socket closes here
+    # and the server reaps them on idle timeout. Jobs still pending or
+    # active at the deadline are abandoned.
+    with stats.lock:
+        for st in active:
+            stats.abandoned_jobs += 1
+            stats.abandoned_streams += len(st.job.streams)
+            if not st.job.attacker:
+                stats.abandoned_honest += 1
+        for job in pending:
+            stats.abandoned_jobs += 1
+            stats.abandoned_streams += len(job.streams)
+            if not job.attacker:
+                stats.abandoned_honest += 1
+    box["sock"].close()
+
+
+def _run_junk(listen_addr, n: int, stats: SwarmStats, seed: int,
+              deadline: float) -> None:
+    """Attacker junk sprayer: raw garbage datagrams from a dedicated
+    socket (the breaker quarantines this peer, which is the point —
+    honest traffic rides other sockets)."""
+    from firedancer_tpu.tango.udpsock import UdpSock
+
+    rng = Rng(seq=seed ^ 0x1A77AC)
+    sock = UdpSock()
+    tx = sock.aio_tx()
+    sent = 0
+    while sent < n and time.monotonic() < deadline:
+        burst = min(32, n - sent)
+        for _ in range(burst):
+            ln = 20 + rng.roll(120)
+            first = rng.roll(256)
+            junk = bytes([first]) + bytes(
+                rng.roll(256) for _ in range(ln - 1))
+            tx.send_one(listen_addr, junk)
+        sent += burst
+        sock.service_rx(lambda a, d: None)  # drain stateless resets
+        time.sleep(0.002)
+    with stats.lock:
+        stats.junk_sent += sent
+    sock.close()
+
+
+class _Runner:
+    """Thread-entry wrapper: the swarm's workers run as bound methods
+    (the tile-thread `t.run` pattern the ownership pass recognizes) so
+    every cross-thread store stays inside _run_worker/_run_junk, whose
+    shared state is the lock-guarded SwarmStats."""
+
+    def __init__(self, fn, *args, **kw):
+        self._fn, self._args, self._kw = fn, args, kw
+
+    def run(self) -> None:
+        self._fn(*self._args, **self._kw)
+
+
+def make_swarm(plan: SiegePlan, stats: SwarmStats, seed: int,
+               deadline_s: float = 120.0):
+    """-> client_fn for run_quic_pipeline: starts honest workers,
+    attacker workers (separate sockets — quarantine must never splash
+    honest peers), and the junk sprayer; returns when all are done and
+    flips stats.finished (the tile's stop_when reads it)."""
+    honest = [j for j in plan.jobs if not j.attacker]
+    attackers = [j for j in plan.jobs if j.attacker]
+    migrate_at = 1.5 if plan.name == "keyupdate_churn" else 0.0
+
+    def client_fn(listen_addr):
+        deadline = time.monotonic() + deadline_s
+        threads: List[threading.Thread] = []
+        # Worker-thread count scales DOWN with usable cores: on a
+        # 1-core host every extra client thread only adds GIL-handoff
+        # thrash against the tile and the verify pipeline (the same
+        # work gets done either way — it is one CPU).
+        cores = usable_cores()
+        n_w = max(1, min(plan.workers, 1 if cores < 2 else plan.workers))
+        shards: List[List[Job]] = [[] for _ in range(n_w)]
+        for i, j in enumerate(honest):
+            shards[i % n_w].append(j)
+        for i, shard in enumerate(shards):
+            if not shard:
+                continue
+            r = _Runner(_run_worker, listen_addr, shard, stats, deadline,
+                        seed + i, migrate_at=migrate_at)
+            threads.append(threading.Thread(
+                target=r.run, name=f"siege-honest-{i}", daemon=True))
+        for i, job in enumerate(attackers):
+            r = _Runner(_run_worker, listen_addr, [job], stats, deadline,
+                        0x4000 + seed + i)
+            threads.append(threading.Thread(
+                target=r.run, name=f"siege-attacker-{i}", daemon=True))
+        if plan.junk_datagrams:
+            r = _Runner(_run_junk, listen_addr, plan.junk_datagrams,
+                        stats, seed, deadline)
+            threads.append(threading.Thread(
+                target=r.run, name="siege-junk", daemon=True))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=max(1.0, deadline - time.monotonic()))
+        with stats.lock:
+            stats.finished = True
+
+    return client_fn
+
+
+def make_stop_when(stats: SwarmStats):
+    """Tile exhaustion predicate: the swarm is done, the tile has seen
+    at least every stream the swarm got acked, and everything seen is
+    admitted-or-shed (queues empty) — the accounting-parity point."""
+
+    def stop_when(tile) -> bool:
+        with stats.lock:
+            if not stats.finished:
+                return False
+            delivered = stats.delivered_streams
+        return (tile.streams_seen >= delivered
+                and not tile._ready and not tile._deferred)
+
+    return stop_when
+
+
+def siege_env(plan: SiegePlan, extra: Optional[Dict[str, str]] = None,
+              ) -> Dict[str, Optional[str]]:
+    """The env overrides a profile runs under (its defense knobs +
+    caller extras); returns the PREVIOUS values for restoration."""
+    env = dict(plan.env)
+    env.update(extra or {})
+    saved: Dict[str, Optional[str]] = {}
+    for k, v in env.items():
+        saved[k] = os.environ.get(k)
+        os.environ[k] = str(v)
+    return saved
+
+
+def restore_env(saved: Dict[str, Optional[str]]) -> None:
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v

@@ -297,6 +297,98 @@ _register(
 )
 
 # --------------------------------------------------------------------------
+# fd_siege QUIC front-door defenses + scenario-suite knobs (disco/
+# quic_tile.py admission/shedding/quarantine, disco/siege.py swarm; all
+# read per run — the quic tile resolves them at construction).
+# --------------------------------------------------------------------------
+
+_register(
+    "FD_QUIC_DEFENSES", bool, True,
+    "Master switch for the QUIC front-door overload defenses: per-"
+    "connection token-bucket admission, credit-aware lowest-priority "
+    "load shedding, and the per-peer abuse circuit breaker (connection "
+    "quarantine). On by default — the fd_siege suite proves the "
+    "pipeline stays inside its SLOs under attack BECAUSE of these; "
+    "'0' is the A/B hatch the siege smoke uses for the overhead gate.",
+)
+_register(
+    "FD_QUIC_ADMIT_RATE", int, 5000,
+    "Per-connection token-bucket admission rate at the QUIC tile, "
+    "transactions/second: streams completing beyond the bucket are "
+    "SHED (counted in the quic tile's admit_shed flight metric, sha256 "
+    "recorded in the shed ledger so replay gates stay bit-exact) "
+    "instead of ever reaching the feed. A single hostile connection "
+    "cannot monopolize the front door.",
+)
+_register(
+    "FD_QUIC_ADMIT_BURST", int, 256,
+    "Per-connection admission bucket depth (burst allowance). A fresh "
+    "connection may land this many transactions at wire speed before "
+    "the FD_QUIC_ADMIT_RATE refill governs it.",
+)
+_register(
+    "FD_QUIC_SHED_DEPTH", int, 4096,
+    "Ready-queue depth at the QUIC tile above which credit-aware load "
+    "shedding engages: the LOWEST-priority queued transaction (compute-"
+    "budget fee order, the same order fd_pack maximizes) is dropped "
+    "and counted in queue_shed — overload degrades by shedding the "
+    "cheapest work instead of backpressuring the feed into an SLO burn.",
+)
+_register(
+    "FD_QUIC_ABUSE_THRESHOLD", int, 32,
+    "Per-peer abuse events (malformed datagrams, oversized streams, "
+    "slowloris reassembly pressure — admission sheds deliberately do "
+    "NOT score: a NAT'd address full of honest users sheds without "
+    "malice) within a 1 s window that "
+    "trip the connection-level circuit breaker: the peer's connections "
+    "are closed and its datagrams dropped at the socket for the "
+    "quarantine cooldown (fd_chaos breaker pattern: trip -> quarantine "
+    "-> half-open re-admit, cooldown doubling per consecutive trip).",
+)
+_register(
+    "FD_QUIC_QUARANTINE_COOLDOWN_MS", int, 250,
+    "Base quarantine cooldown for a tripped abusive peer before the "
+    "half-open re-admit; doubles per consecutive re-trip (capped 8x).",
+)
+_register(
+    "FD_QUIC_SLOW_MAX_BUF", int, 262144,
+    "Per-connection cap on buffered bytes of INCOMPLETE streams "
+    "(slowloris posture): a connection dribbling partial streams past "
+    "this reassembly budget is an abuse event and gets quarantined — "
+    "held-open streams cannot grow server state unboundedly.",
+)
+_register(
+    "FD_QUIC_HS_TIMEOUT_S", float, 3.0,
+    "Server-side handshake deadline: a connection that has not "
+    "completed its handshake within this window is reaped (the half-"
+    "open-connection flood defense; a junk Initial buys an attacker "
+    "at most this much state lifetime). 0 disables.",
+)
+_register(
+    "FD_SIEGE_N", int, 1200,
+    "fd_siege corpus size per adversarial profile (unique valid txns; "
+    "disco/corpus.py mainnet shape, so expected sink digests stay "
+    "computable by construction).",
+)
+_register(
+    "FD_SIEGE_SEED", int, 0,
+    "fd_siege determinism seed: corpus generation, swarm connection "
+    "schedules, and junk payloads all derive from it — a failing "
+    "profile replays bit-identically.",
+)
+_register(
+    "FD_SIEGE_PROFILES", str, None,
+    "Comma-separated adversarial profile names for scripts/fd_siege.py "
+    "(conn_churn, dup_storm, malformed_flood, slowloris, "
+    "oversize_abuse, keyupdate_churn). Unset = the full suite.",
+)
+_register(
+    "FD_SIEGE_OUT", str, None,
+    "Directory for the per-profile SIEGE_r*.json artifacts (default: "
+    "the repo root, next to the BENCH_r* family fd_report ingests).",
+)
+
+# --------------------------------------------------------------------------
 # fd_chaos fault injection + the self-healing machinery it proves out
 # (disco/chaos.py; all read per run).
 # --------------------------------------------------------------------------
@@ -492,6 +584,15 @@ _register(
     "Slow burn-rate window, seconds. A window is only evaluated once "
     "the sentinel's history actually spans it, so runs shorter than "
     "this cannot latency-alert (liveness SLOs are unaffected).",
+)
+_register(
+    "FD_SLO_QUIC_INGEST_MS", int, 500,
+    "p99 budget for the QUIC front-door admission span (stream "
+    "completion at the quic tile -> frag publish into the feed, the "
+    "'quic_ingest' edge), ms. This is the queue the admission/shedding "
+    "defenses exist to keep shallow: a breach means completed "
+    "transactions are stalling INSIDE the front door instead of being "
+    "admitted or shed.",
 )
 # --------------------------------------------------------------------------
 # fd_xray — tail-sampled exemplar traces, per-edge queue attribution,

@@ -28,12 +28,20 @@ from .common import Violation, rel, suppressed
 
 RULE_ASSERT = "boundary-assert"
 
-# Repo-relative paths of the FFI/tile/ring boundary modules.
+# Repo-relative paths of the FFI/tile/ring boundary modules. The
+# tango/quic codecs are boundary modules of the sharpest kind: every
+# byte they touch is attacker-controlled wire input from the public
+# ingest port, so a stripped assert there is not a lost sanity check —
+# it is a parser that silently accepts malformed traffic under -O.
 BOUNDARY_MODULES = (
     "firedancer_tpu/ballet/ed25519/native.py",
     "firedancer_tpu/tango/rings.py",
+    "firedancer_tpu/tango/quic/wire.py",
+    "firedancer_tpu/tango/quic/conn.py",
+    "firedancer_tpu/tango/quic/quic.py",
     "firedancer_tpu/disco/tiles.py",
     "firedancer_tpu/disco/worker.py",
+    "firedancer_tpu/disco/quic_tile.py",
     "firedancer_tpu/disco/supervisor.py",
 )
 

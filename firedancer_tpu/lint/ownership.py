@@ -144,6 +144,17 @@ THREAD_TABLE: Tuple[ThreadSite, ...] = (
         "reports True while this thread lives",
     ),
     ThreadSite(
+        "firedancer_tpu/disco/siege.py", "client_fn:r.run",
+        "fd_siege swarm threads: honest QUIC client workers, attacker "
+        "workers (separate sockets so quarantine cannot splash honest "
+        "peers), and the junk-datagram sprayer",
+        "run to job completion or the per-profile deadline; client_fn "
+        "joins them all before returning (run_quic_pipeline's "
+        "post_wait joins client_fn in turn)",
+        "touch client sockets and the lock-guarded SwarmStats only, "
+        "never workspace rows",
+    ),
+    ThreadSite(
         "firedancer_tpu/utils/tpool.py", "TPool.__init__:self._worker",
         "spin-style fork-join pool for host-parallel byte work",
         "halt flag + go Events; process-lifetime daemon workers",
@@ -189,8 +200,11 @@ WRITER_TABLE: Dict[str, Tuple[str, ...]] = {
     "DIAG_OVRNR_CNT": ("firedancer_tpu/disco/tiles.py",),
     "DIAG_SLOW_CNT": ("firedancer_tpu/tango/fctl.py",),
     # fd_flight registry acquisition: tile metric rows belong to the
-    # owning tile; regions are created once by build_topology.
-    "flight.tile_lane": ("firedancer_tpu/disco/tiles.py",),
+    # owning tile (the quic tile acquires its own lane for the
+    # fd_siege admit_shed/queue_shed/quarantine counters); regions are
+    # created once by build_topology.
+    "flight.tile_lane": ("firedancer_tpu/disco/tiles.py",
+                         "firedancer_tpu/disco/quic_tile.py"),
     "flight.create_regions": ("firedancer_tpu/disco/pipeline.py",),
     # fd_xray: queue-region creation (build_topology, once), the
     # per-edge rx/tx telemetry rows (consumer/producer tile of the
@@ -202,7 +216,8 @@ WRITER_TABLE: Dict[str, Tuple[str, ...]] = {
     "xray.edge_rx": ("firedancer_tpu/disco/tiles.py",),
     "xray.edge_tx": ("firedancer_tpu/disco/tiles.py",),
     "xray.span_ctx": ("firedancer_tpu/disco/tiles.py",),
-    "xray.ring": ("firedancer_tpu/disco/tiles.py",),
+    "xray.ring": ("firedancer_tpu/disco/tiles.py",
+                  "firedancer_tpu/disco/quic_tile.py"),
     # fd_sentinel SLO rows: one sentinel per run, in the runner
     # process, is the single writer.
     "SLO_EVALS": ("firedancer_tpu/disco/sentinel.py",),

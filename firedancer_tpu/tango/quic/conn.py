@@ -369,8 +369,16 @@ class QuicConn:
         self._pmtu_done = False
         self.stat_pmtu_probes = 0
         self.spaces = [_PnSpace(), _PnSpace(), _PnSpace()]
+        # Creation stamp: the server-side handshake-deadline reaper
+        # (Quic.service, hs_timeout) measures half-open lifetime from
+        # here — a junk Initial buys bounded state, not a 10 s idle slot.
+        self.created = now
         if is_server:
-            assert orig_dcid is not None
+            if orig_dcid is None:
+                raise ValueError(
+                    "server QuicConn requires orig_dcid (the client "
+                    "Initial's destination cid derives the Initial keys)"
+                )
             self.dcid = b""  # learned from the client's first Initial (scid)
             self.orig_dcid = orig_dcid
             ckeys, skeys = initial_secrets(orig_dcid)
@@ -1047,6 +1055,24 @@ class QuicConn:
         self._ku_pending = True
         self._ku_min_ack_pn = space.next_pn
         self.stat_key_updates += 1
+
+    def reassembly_pressure(self) -> Tuple[int, int]:
+        """(incomplete_streams, buffered_bytes) held by streams that
+        have NOT completed: the slowloris posture gauge. A peer
+        dribbling partial streams grows exactly this — the quic tile's
+        FD_QUIC_SLOW_MAX_BUF defense reads it at housekeeping rate and
+        quarantines the connection past the budget, so held-open
+        streams cannot grow server state unboundedly."""
+        n = 0
+        nbytes = 0
+        for st in self._recv_streams.values():
+            if st.delivered:
+                continue
+            sz = sum(len(c) for c in st.chunks.values())
+            if sz:
+                n += 1
+                nbytes += sz
+        return n, nbytes
 
     def abort(self, error: int, reason: str) -> None:
         self.closed = True

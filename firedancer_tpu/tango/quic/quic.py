@@ -39,6 +39,12 @@ class QuicConfig:
     # cids with a Stateless Reset (§10.3), letting peers of a rebooted
     # endpoint tear down dead connections instead of timing out.
     stateless_reset: bool = True
+    # Server-side handshake deadline (seconds): a connection that has
+    # not completed its handshake within this window is reaped by
+    # service() — the half-open-connection flood defense (a spoofed or
+    # junk Initial buys an attacker at most hs_timeout of state
+    # lifetime, not a full idle_timeout slot). 0 disables.
+    hs_timeout: float = 0.0
 
 
 class Quic:
@@ -51,12 +57,19 @@ class Quic:
         on_stream: Optional[Callable[[QuicConn, int, bytes], None]] = None,
         on_conn_new: Optional[Callable[[QuicConn], None]] = None,
         on_conn_closed: Optional[Callable[[QuicConn], None]] = None,
+        on_rx_drop: Optional[Callable[[object], None]] = None,
     ):
         self.cfg = cfg
         self._tx = tx
         self._on_stream = on_stream
         self._on_conn_new = on_conn_new
         self._on_conn_closed = on_conn_closed
+        # Peer-attributed drop notification: called with the source
+        # address every time an rx datagram is dropped unprocessed
+        # (junk, unknown cid, bad token, conn-cap overflow). The quic
+        # tile's abuse breaker scores peers on this — the endpoint
+        # itself stays policy-free.
+        self._on_rx_drop = on_rx_drop
         self._conns_by_cid: Dict[bytes, QuicConn] = {}
         self.conns: List[QuicConn] = []
         # Endpoint-static secrets: the token key binds retry tokens to
@@ -92,7 +105,8 @@ class Quic:
     # ------------------------------------------------------------- client --
 
     def connect(self, peer_addr, now: float = 0.0) -> QuicConn:
-        assert not self.cfg.is_server
+        if self.cfg.is_server:
+            raise ValueError("connect() is a client-endpoint operation")
         conn = QuicConn(
             is_server=False,
             identity_seed=self.cfg.identity_seed,
@@ -107,6 +121,14 @@ class Quic:
         return conn
 
     # ----------------------------------------------------------------- rx --
+
+    def _drop(self, peer_addr) -> None:
+        """Count + attribute one unprocessable rx datagram (every
+        rx_dropped increment routes through here so the tile's abuse
+        breaker sees the peer address)."""
+        self.metrics["rx_dropped"] += 1
+        if self._on_rx_drop is not None:
+            self._on_rx_drop(peer_addr)
 
     def rx(self, peer_addr, datagram: bytes, now: float) -> None:
         """Feed one received UDP datagram into the endpoint."""
@@ -142,22 +164,22 @@ class Quic:
                 # (§10.3.3, the reset-loop guard), so tiny datagrams
                 # get nothing.
                 self._maybe_stateless_reset(peer_addr, datagram, now)
-                self.metrics["rx_dropped"] += 1
+                self._drop(peer_addr)
                 return
             if not self.cfg.is_server:
-                self.metrics["rx_dropped"] += 1
+                self._drop(peer_addr)
                 return
             try:
                 hdr = wire.parse_long_header(datagram)
             except wire.QuicWireError:
-                self.metrics["rx_dropped"] += 1
+                self._drop(peer_addr)
                 return
             if (
                 hdr.pkt_type != wire.PKT_INITIAL
                 or hdr.version != wire.QUIC_VERSION_1
                 or len(self.conns) >= self.cfg.max_conns
             ):
-                self.metrics["rx_dropped"] += 1
+                self._drop(peer_addr)
                 return
             token_odcid = None
             addr_validated = None
@@ -177,7 +199,7 @@ class Quic:
                 token_odcid = self._check_token(hdr.token, peer_addr, now)
                 if token_odcid is None:
                     self.metrics["tokens_rejected"] += 1
-                    self.metrics["rx_dropped"] += 1
+                    self._drop(peer_addr)
                     return
                 self.metrics["tokens_accepted"] += 1
                 addr_validated = True
@@ -227,8 +249,16 @@ class Quic:
     # ------------------------------------------------------------ service --
 
     def service(self, now: float) -> None:
-        """Drive timers on every connection; reap closed conns."""
+        """Drive timers on every connection; reap closed conns — and
+        enforce the handshake deadline: a server conn still
+        unestablished past cfg.hs_timeout is closed here (half-open
+        flood defense; see QuicConfig.hs_timeout)."""
         for conn in list(self.conns):
+            if (self.cfg.hs_timeout and self.cfg.is_server
+                    and not conn.established and not conn.closed
+                    and now - conn.created > self.cfg.hs_timeout):
+                conn.closed = True
+                conn.close_reason = "handshake timeout"
             for dg in conn.service(now):
                 self._tx(conn.peer_addr, dg)
                 self.metrics["tx_datagrams"] += 1

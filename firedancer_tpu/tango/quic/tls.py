@@ -29,6 +29,20 @@ from firedancer_tpu.ballet.hkdf import hkdf_expand_label, hkdf_extract
 from firedancer_tpu.ballet.hmac import hmac_sha256
 from firedancer_tpu.ballet import x509
 
+
+def _ed_verify(msg: bytes, sig: bytes, pub: bytes) -> int:
+    """Ed25519 verify via the native backend when built (bit-exact vs
+    the oracle — differentially pinned), else the Python oracle: the
+    CertificateVerify check is on the per-connection handshake path."""
+    from firedancer_tpu.ballet.ed25519 import native
+
+    if native.available():
+        try:
+            return native.verify(msg, sig, pub)
+        except Exception:
+            pass
+    return oracle.verify(msg, sig, pub)
+
 # encryption levels (== reference's fd_quic_crypto enc levels)
 LEVEL_INITIAL = 0
 LEVEL_HANDSHAKE = 1
@@ -332,9 +346,13 @@ class TlsEndpoint:
         entry = _u24(len(self._cert)) + self._cert + _u16(0)
         cert_body = b"\x00" + _u24(len(entry)) + entry
         self._send(LEVEL_HANDSHAKE, _hs_msg(HS_CERTIFICATE, cert_body))
-        # CertificateVerify over transcript-to-here
+        # CertificateVerify over transcript-to-here. Sign via the
+        # native ed25519 backend when built (bit-exact vs the oracle;
+        # ballet/x509._ed_sign) — the Python oracle's ~180 ms here was
+        # a dominant term of the handshake rate the fd_siege
+        # connection-churn profile measures.
         th = self._transcript.digest()
-        sig = oracle.sign(_CV_SERVER_CTX + th, self.cfg.identity_seed)
+        sig = x509._ed_sign(_CV_SERVER_CTX + th, self.cfg.identity_seed)
         cv_body = _u16(SIGALG_ED25519) + _u16(len(sig)) + sig
         self._send(LEVEL_HANDSHAKE, _hs_msg(HS_CERTIFICATE_VERIFY, cv_body))
         # Finished
@@ -408,7 +426,7 @@ class TlsEndpoint:
         slen = struct.unpack(">H", body[2:4])[0]
         sig = bytes(body[4 : 4 + slen])
         ctx = _CV_CLIENT_CTX if self.is_server else _CV_SERVER_CTX
-        if oracle.verify(ctx + th, sig, self.peer_pubkey) != 0:
+        if _ed_verify(ctx + th, sig, self.peer_pubkey) != 0:
             raise TlsError("CV: signature verification failed")
 
     def _client_finish(self) -> None:

@@ -301,6 +301,12 @@ def parse_frames(buf: bytes) -> List[Frame]:
             f = Frame(ftype=ftype)
             f.fields["seq"], off = varint_decode(buf, off)
             f.fields["retire_prior_to"], off = varint_decode(buf, off)
+            if off >= n:
+                # buf[off] past the end would IndexError out of the
+                # parser — an UNTYPED escape the conn layer's
+                # QuicWireError handler cannot catch (attacker-
+                # controlled bytes must only ever produce typed rejects).
+                raise QuicWireError("NEW_CONNECTION_ID truncated")
             cil = buf[off]
             off += 1
             if cil == 0 or cil > 20 or off + cil + 16 > n:
@@ -333,6 +339,11 @@ def parse_frames(buf: bytes) -> List[Frame]:
             if kind == "v":
                 f.fields[name], off = varint_decode(buf, off)
             elif kind == "b8":
+                if off + 8 > n:
+                    # int.from_bytes over a short slice would silently
+                    # accept a truncated PATH_CHALLENGE/RESPONSE as a
+                    # smaller integer — a typed reject, never laxity.
+                    raise QuicWireError("frame 8-byte field truncated")
                 f.fields[name] = int.from_bytes(buf[off : off + 8], "big")
                 off += 8
             elif kind == "lv":
@@ -410,7 +421,10 @@ def encode_stateless_reset(token16: bytes, size: int = 41) -> bytes:
     reset token in the last 16 bytes. Minimum 21 bytes total."""
     import os as _os
 
-    assert len(token16) == 16
+    if len(token16) != 16:
+        raise QuicWireError(
+            f"stateless reset token must be 16 bytes, got {len(token16)}"
+        )
     size = max(21, size)
     rand = bytearray(_os.urandom(size - 16))
     rand[0] = 0x40 | (rand[0] & 0x3F)
@@ -420,8 +434,12 @@ def encode_stateless_reset(token16: bytes, size: int = 41) -> bytes:
 def encode_path_frame(ftype: int, data8: bytes) -> bytes:
     """PATH_CHALLENGE / PATH_RESPONSE: type + 8 opaque bytes (RFC 9000
     §19.17-18)."""
-    assert ftype in (FRAME_PATH_CHALLENGE, FRAME_PATH_RESPONSE)
-    assert len(data8) == 8
+    if ftype not in (FRAME_PATH_CHALLENGE, FRAME_PATH_RESPONSE):
+        raise QuicWireError(f"not a path frame type: 0x{ftype:02x}")
+    if len(data8) != 8:
+        raise QuicWireError(
+            f"path frame payload must be 8 bytes, got {len(data8)}"
+        )
     return bytes([ftype]) + data8
 
 

@@ -133,10 +133,18 @@ class UdpBatchSock:
                         self.metrics["tx_fails"] += 1
                         continue
                     ip, port = addr
+                    try:
+                        packed = _struct.unpack(
+                            "<I", _socket.inet_aton(ip))[0]
+                    except OSError:
+                        # An unroutable/synthetic peer address (e.g. a
+                        # fault-injection placeholder) must cost one
+                        # tx_fail, never kill the sending tile.
+                        self.metrics["tx_fails"] += 1
+                        continue
                     self._tx_buf[n, : len(payload)] = bytearray(payload)
                     self._tx_lens[n] = len(payload)
-                    self._tx_addrs[2 * n] = _struct.unpack(
-                        "<I", _socket.inet_aton(ip))[0]
+                    self._tx_addrs[2 * n] = packed
                     self._tx_addrs[2 * n + 1] = port
                     n += 1
                 if not n:

@@ -143,6 +143,86 @@ def _validate_xray(x) -> List[str]:
     return errs
 
 
+# fd_siege artifact shape (SIEGE_r*.json, one per adversarial profile;
+# written by scripts/fd_siege.py, graded by fd_report). The counters
+# here are what the RUNBOOK's front-door table reads — a siege artifact
+# missing its accounting is unauditable.
+_SIEGE_REQUIRED = {
+    "profile": str,
+    "value": (int, float),
+    "unit": str,
+    "seed": int,
+    "corpus": int,
+    "elapsed_s": (int, float),
+    "ok": bool,
+}
+_SIEGE_QUIC_REQUIRED = ("offered", "admitted", "admit_shed", "queue_shed",
+                        "shed_total", "conn_quarantine", "quarantine_drop")
+
+
+def validate_siege(rec: dict) -> List[str]:
+    """Shape errors for one SIEGE_r*.json artifact ([] = valid)."""
+    errs = []
+    if not isinstance(rec, dict):
+        return ["artifact is not a JSON object"]
+    if rec.get("metric") != "quic_siege_profile":
+        errs.append(f"metric must be quic_siege_profile, got "
+                    f"{rec.get('metric')!r}")
+    sv = rec.get("schema_version")
+    if not isinstance(sv, int) or isinstance(sv, bool) \
+            or sv < SCHEMA_VERSION_MIN:
+        errs.append(f"schema_version must be an int >= "
+                    f"{SCHEMA_VERSION_MIN}, got {sv!r}")
+    ts = rec.get("ts")
+    if not isinstance(ts, str) or "T" not in ts:
+        errs.append(f"missing/odd ISO 'ts': {ts!r}")
+    for key, typ in _SIEGE_REQUIRED.items():
+        v = rec.get(key)
+        if v is None or not isinstance(v, typ) \
+                or (isinstance(v, bool) and typ is not bool):
+            errs.append(f"'{key}' missing or not {typ}: {v!r}")
+    q = rec.get("quic")
+    if not isinstance(q, dict):
+        errs.append("'quic' accounting block missing")
+    else:
+        for key in _SIEGE_QUIC_REQUIRED:
+            v = q.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"'quic.{key}' missing or not a "
+                            f"non-negative int: {v!r}")
+        if (not errs
+                and q["admitted"] + q["shed_total"] != q["offered"]):
+            errs.append(
+                f"shed-accounting parity broken in the artifact: "
+                f"admitted={q['admitted']} + shed={q['shed_total']} "
+                f"!= offered={q['offered']}")
+    slo = rec.get("slo")
+    if not isinstance(slo, dict) or not isinstance(
+            slo.get("alert_cnt"), int):
+        errs.append("'slo' block with integer alert_cnt required")
+    if not isinstance(rec.get("failures"), list):
+        errs.append("'failures' must be a list")
+    return errs
+
+
+def validate_siege_files(root: str) -> List[str]:
+    """All violations across the SIEGE_r*.json family under root."""
+    import glob
+
+    errs: List[str] = []
+    for path in sorted(glob.glob(os.path.join(root, "SIEGE_r[0-9]*.json"))):
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            errs.append(f"{name}: not JSON ({e})")
+            continue
+        for e in validate_siege(rec):
+            errs.append(f"{name}: {e}")
+    return errs
+
+
 def validate_file(path: str) -> List[str]:
     """All violations in a BENCH_LOG.jsonl file, prefixed line:N."""
     legacy = _legacy_hashes()
@@ -175,17 +255,30 @@ def validate_file(path: str) -> List[str]:
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     path = argv[0] if argv else os.path.join(REPO, "BENCH_LOG.jsonl")
-    if not os.path.exists(path):
+    errs: List[str] = []
+    n = 0
+    if os.path.exists(path):
+        errs += validate_file(path)
+        n = sum(1 for line in open(path) if line.strip())
+    else:
         print(f"bench_log_check: {path} absent (nothing to validate)")
-        return 0
-    errs = validate_file(path)
-    n = sum(1 for line in open(path) if line.strip())
+    # The fd_siege artifact family rides the same hygiene gate: a
+    # malformed SIEGE_r*.json poisons fd_report's siege table exactly
+    # like a malformed log line poisons the trend tables.
+    siege_root = os.path.dirname(os.path.abspath(path)) if argv else REPO
+    siege_errs = validate_siege_files(siege_root)
+    errs += siege_errs
     if errs:
         for e in errs:
             print(f"bench_log_check: FAIL — {e}", file=sys.stderr)
         return 1
     legacy = len(_legacy_hashes())
-    print(f"bench_log_check: OK ({n} lines; {legacy} allowlisted legacy)")
+    import glob as _glob
+
+    n_siege = len(_glob.glob(os.path.join(siege_root,
+                                          "SIEGE_r[0-9]*.json")))
+    print(f"bench_log_check: OK ({n} lines; {legacy} allowlisted legacy; "
+          f"{n_siege} siege artifacts)")
     return 0
 
 

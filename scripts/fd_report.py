@@ -169,6 +169,31 @@ def render_ledger(ledger) -> List[str]:
     return lines
 
 
+def render_siege(timeline) -> List[str]:
+    """The fd_siege scenario-suite table: one row per SIEGE_r*.json
+    profile artifact, graded on its recorded gates (zero sentinel
+    alerts, shed-accounting parity, chaos tri-counter parity, admitted-
+    content exactness — scripts/fd_siege.py writes the verdicts)."""
+    lines = ["== FD_SIEGE FRONT-DOOR SCENARIOS (QUIC under attack) =="]
+    rows = sentinel.siege_status(timeline)
+    if not rows:
+        lines.append("(no SIEGE_r*.json artifacts yet — run "
+                     "scripts/fd_siege.py)")
+        return lines
+    for r in rows:
+        verdict = "OK  " if r["ok"] else "FAIL"
+        lines.append(
+            f"  [{verdict}] {r['profile']}: {r['value']} {r['unit']} "
+            f"admitted (offered={r['offered']} admitted={r['admitted']} "
+            f"shed={r['shed']}, sentinel alerts={r['alert_cnt']}) "
+            f"[{r['source']}]")
+        for fmsg in r["failures"]:
+            lines.append(f"         - {fmsg}")
+    ok = sum(1 for r in rows if r["ok"])
+    lines.append(f"  {ok}/{len(rows)} profiles green")
+    return lines
+
+
 def render_gates(timeline) -> List[str]:
     lines = ["== THROUGHPUT GATES =="]
     best: dict = {}
@@ -204,6 +229,7 @@ def render_report(timeline, regress_pct=None) -> str:
                     render_stage_trend(timeline),
                     render_replay_trend(timeline),
                     render_gates(timeline),
+                    render_siege(timeline),
                     render_regressions(regs),
                     render_ledger(ledger)):
         parts.extend(section)

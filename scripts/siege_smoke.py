@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""siege_smoke — the fd_siege front-door gate (ci.sh lane).
+
+One fast adversarial profile end-to-end on the CPU backend (QUIC swarm
+-> quic tile -> fd_feed staging -> verify -> dedup -> pack -> sink)
+with the fd_chaos quic classes running concurrently, plus a defense
+overhead A/B. Gates (exit nonzero on any):
+
+  * the attack profile (dup_storm: admission-bucket pressure +
+    duplicate replay + concurrent quic_malformed / quic_conn_churn /
+    quic_slowloris chaos) completes with ZERO fd_sentinel burn-rate
+    alerts, shed-accounting parity (admitted + shed == offered),
+    bit-exact sink digests for admitted traffic, chaos tri-counter
+    parity, and the admission defense PROVABLY acting (admit_shed >= 1)
+    — all graded inside fd_siege.run_profile;
+  * the artifact validates against the SIEGE schema
+    (scripts/bench_log_check.validate_siege — the same gate that
+    guards the committed SIEGE_r*.json family);
+  * defenses overhead: a clean churn profile with FD_QUIC_DEFENSES on
+    stays within 5% (+ a jitter floor) of the same profile with
+    defenses disabled — protection is not allowed to tax the happy
+    path.
+
+Prints ONE JSON line. Deterministic from the seeds below.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable as `python scripts/siege_smoke.py`
+    sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+N = 320
+SEED = 1212
+
+
+def log(msg: str) -> None:
+    print(f"siege_smoke: {msg}", flush=True)
+
+
+def fail(msg: str) -> None:
+    print(f"siege_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import bench_log_check
+    import fd_siege
+
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+
+    t0 = time.perf_counter()
+    corpus = mainnet_corpus(n=N, seed=SEED, dup_rate=0.04,
+                            corrupt_rate=0.02, parse_err_rate=0.02,
+                            sign_batch_size=256, max_data_sz=180)
+    log(f"corpus ready ({len(corpus.payloads)} txns)")
+
+    with tempfile.TemporaryDirectory(prefix="fd_siege_smoke_") as tmp:
+        # -- the attack profile, chaos concurrent ----------------------
+        art = fd_siege.run_profile("dup_storm", corpus, SEED, tmp,
+                                   with_chaos=True, timeout_s=180.0)
+        if not art["ok"]:
+            fail(f"dup_storm profile gates: {art['failures']}")
+        if art["quic"]["admit_shed"] < 1:
+            fail("admission defense never shed under the dup storm "
+                 "(the profile exists to prove it acts)")
+        if art["slo"]["alert_cnt"] != 0:
+            fail(f"sentinel alerts: {art['slo']['alerts']}")
+        for cls, c in art["chaos_counters"].items():
+            if not (c["injected"] == c["detected"] == c["healed"] >= 1):
+                fail(f"chaos {cls} tri-counter parity: {c}")
+        log(f"attack profile OK ({art['value']} txn/s admitted, "
+            f"shed={art['quic']['shed_total']}, "
+            f"quarantine={art['quic']['conn_quarantine']}, "
+            f"{art['elapsed_s']}s)")
+
+        # -- artifact schema gate --------------------------------------
+        path = os.path.join(tmp, "SIEGE_r01_dup_storm.json")
+        with open(path) as f:
+            rec = json.load(f)
+        errs = bench_log_check.validate_siege(rec)
+        if errs:
+            fail(f"SIEGE artifact schema: {errs}")
+        log("artifact schema OK (bench_log_check.validate_siege)")
+
+        # -- defenses overhead A/B (clean churn, no chaos) -------------
+        art_on = fd_siege.run_profile(
+            "conn_churn", corpus, SEED, tmp, with_chaos=False,
+            timeout_s=180.0)
+        art_off = fd_siege.run_profile(
+            "conn_churn", corpus, SEED, tmp, with_chaos=False,
+            timeout_s=180.0, extra_env={"FD_QUIC_DEFENSES": "0"})
+        if not art_on["ok"]:
+            fail(f"defenses-on churn gates: {art_on['failures']}")
+        if not art_off["ok"]:
+            fail(f"defenses-off churn gates: {art_off['failures']}")
+        dt_on, dt_off = art_on["elapsed_s"], art_off["elapsed_s"]
+        # 5% gate with an absolute jitter floor (the run is ~2 s on a
+        # small corpus; scheduler noise dwarfs any per-stream cost).
+        slack = max(dt_off * 0.05, 0.3)
+        if dt_on > dt_off + slack:
+            fail(f"defense overhead: {dt_on:.2f}s on vs {dt_off:.2f}s "
+                 "off (> 5% + jitter floor)")
+        log(f"overhead OK ({dt_on:.2f}s on vs {dt_off:.2f}s off)")
+
+    # The committed artifact family must stay schema-valid too.
+    errs = bench_log_check.validate_siege_files(REPO)
+    if errs:
+        fail(f"committed SIEGE artifacts: {errs}")
+
+    print(json.dumps({
+        "metric": "siege_smoke", "ok": True, "corpus": N,
+        "profile": "dup_storm",
+        "admitted_txn_s": art["value"],
+        "admit_shed": art["quic"]["admit_shed"],
+        "conn_quarantine": art["quic"]["conn_quarantine"],
+        "defense_overhead_s": round(dt_on - dt_off, 2),
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

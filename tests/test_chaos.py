@@ -266,9 +266,15 @@ def _assert_parity(res, classes):
         assert c["injected"] == c["detected"] == c["healed"], (cls, c)
 
 
+# device_lost rides dispatch ordinals 1:3 (the chaos_smoke pattern):
+# a 300-txn corpus at batch 128 GUARANTEES three dispatches, while a
+# 4th exists only when a timing-dependent partial flush happens — a
+# window at @4:6 made WHETHER the class fired depend on host load
+# (observed flaking under full-suite contention; parity held within
+# each run, only the across-run comparison diverged).
 SCHEDULE_6 = (
     "ring_ctl_err@5,ring_ctl_err@40,ring_overrun@6,credit_starve@50:80,"
-    "stager_kill@4,slot_corrupt@3,backend_raise@2,device_lost@4:6"
+    "stager_kill@4,slot_corrupt@3,backend_raise@2,device_lost@1:3"
 )
 CLASSES_6 = ("ring_ctl_err", "ring_overrun", "credit_starve",
              "stager_kill", "slot_corrupt", "backend_raise", "device_lost")

@@ -1,0 +1,231 @@
+"""Malformed-input corpus for the QUIC wire parser (fd_siege satellite).
+
+Every byte the tango/quic codecs touch is attacker-controlled wire
+input from the public ingest port. The contract pinned here: the
+parser NEVER throws an unhandled exception class — malformed input
+always produces a typed reject (QuicWireError) or a clean parse, and
+the connection/endpoint layers absorb garbage without raising at all.
+Two of the cases were live escapes before this corpus existed: a
+truncated NEW_CONNECTION_ID IndexError'd out of parse_frames (past the
+conn layer's QuicWireError handler — a remote tile-thread kill), and a
+truncated PATH_CHALLENGE parsed its short slice as a smaller integer
+instead of rejecting.
+"""
+
+import os
+
+import pytest
+
+from firedancer_tpu.tango.quic import wire
+from firedancer_tpu.tango.quic.conn import QuicConn
+from firedancer_tpu.tango.quic.quic import Quic, QuicConfig
+from firedancer_tpu.utils.rng import Rng
+
+
+def _assert_typed(buf: bytes) -> None:
+    """parse_frames(buf) either parses or raises QuicWireError — any
+    other exception class is the bug this corpus exists to catch."""
+    try:
+        wire.parse_frames(buf)
+    except wire.QuicWireError:
+        pass
+
+
+# ------------------------------------------------------------- headers ----
+
+def test_truncated_long_header_every_prefix():
+    full = wire.encode_long_header(
+        wire.PKT_INITIAL, b"D" * 8, b"S" * 8, pn=1, pn_len=2,
+        payload_len=64, token=b"tok")
+    for cut in range(len(full)):
+        try:
+            wire.parse_long_header(full[:cut])
+        except wire.QuicWireError:
+            pass
+
+
+def test_truncated_short_header_every_prefix():
+    full = wire.encode_short_header(b"C" * 8, pn=7, pn_len=2)
+    for cut in range(len(full)):
+        try:
+            wire.parse_short_header(full[:cut], dcid_len=8)
+        except wire.QuicWireError:
+            pass
+
+
+def test_absurd_cid_lengths_rejected():
+    # dcid length byte 21..255: must be a typed reject, never a slice
+    # of adjacent header bytes.
+    for dcil in (21, 0x7F, 0xFF):
+        buf = bytes([0xC0]) + (1).to_bytes(4, "big") + bytes([dcil]) + bytes(64)
+        with pytest.raises(wire.QuicWireError):
+            wire.parse_long_header(buf)
+
+
+# ------------------------------------------------------------- varints ----
+
+def test_truncated_varints():
+    for first in (0x40, 0x80, 0xC0):  # 2/4/8-byte prefixes, body cut
+        with pytest.raises(wire.QuicWireError):
+            wire.varint_decode(bytes([first]), 0)
+    with pytest.raises(wire.QuicWireError):
+        wire.varint_decode(b"", 0)
+    with pytest.raises(wire.QuicWireError):
+        wire.varint_encode(1 << 62)
+
+
+# -------------------------------------------------------------- frames ----
+
+def test_oversized_frame_lengths_rejected():
+    # Every length-carrying frame with a length past the buffer end.
+    cases = [
+        wire.encode_crypto(0, b"x" * 8)[:-4],            # crypto cut
+        bytes([wire.FRAME_CRYPTO]) + wire.varint_encode(0)
+        + wire.varint_encode(1 << 20),                    # huge len
+        wire.encode_stream(2, 0, b"y" * 8, fin=True)[:-4],
+        bytes([wire.FRAME_NEW_TOKEN]) + wire.varint_encode(1 << 30),
+        wire.encode_conn_close(1, 2, b"reason")[:-3],
+    ]
+    for buf in cases:
+        with pytest.raises(wire.QuicWireError):
+            wire.parse_frames(buf)
+
+
+def test_truncated_path_frames_rejected():
+    # The b8 fixed-width fields must reject short slices, not parse
+    # them as smaller integers.
+    full = wire.encode_path_frame(wire.FRAME_PATH_CHALLENGE, b"8bytes!!")
+    for cut in range(1, 9):
+        with pytest.raises(wire.QuicWireError):
+            wire.parse_frames(full[:cut])
+
+
+def test_truncated_new_connection_id_rejected():
+    # Regression pin: `cil = buf[off]` past the end IndexError'd out of
+    # the parser — an UNTYPED escape the conn layer cannot catch.
+    full = (bytes([wire.FRAME_NEW_CONNECTION_ID])
+            + wire.varint_encode(1) + wire.varint_encode(0)
+            + bytes([8]) + b"C" * 8 + bytes(16))
+    for cut in range(1, len(full)):
+        with pytest.raises(wire.QuicWireError):
+            wire.parse_frames(full[:cut])
+    wire.parse_frames(full)  # the untruncated frame still parses
+
+
+def test_unknown_frame_type_rejected():
+    for ftype in (0x21, 0x3F, 0x7E, 0xFF):
+        with pytest.raises(wire.QuicWireError):
+            wire.parse_frames(bytes([ftype]) + bytes(16))
+
+
+def test_ack_with_huge_range_count_is_bounded():
+    # range count 2^40: the loop must die on a typed truncation, fast,
+    # not iterate toward the claimed count.
+    buf = (bytes([wire.FRAME_ACK]) + wire.varint_encode(100)
+           + wire.varint_encode(0)
+           + wire.varint_encode(1 << 40)
+           + wire.varint_encode(1))
+    with pytest.raises(wire.QuicWireError):
+        wire.parse_frames(buf)
+
+
+def test_mutation_corpus_only_typed_rejects():
+    """Seeded mutation sweep: valid frame sequences with truncations,
+    byte flips, and splices never raise anything but QuicWireError."""
+    rng = Rng(seq=0xADF0)
+    base = (
+        wire.encode_crypto(5, b"hello world")
+        + wire.encode_stream(2, 10, b"payload" * 5, fin=True)
+        + wire.encode_ack(100, 3, 10, [(1, 2), (0, 4)])
+        + bytes([wire.FRAME_PING])
+        + wire.encode_path_frame(wire.FRAME_PATH_CHALLENGE, b"chal||ng")
+        + wire.encode_simple(wire.FRAME_MAX_STREAM_DATA, 4, 1 << 20)
+        + wire.encode_conn_close(7, 2, b"bye", app=True)
+    )
+    wire.parse_frames(base)  # sanity: the base corpus parses
+    for _ in range(600):
+        buf = bytearray(base)
+        for _ in range(1 + rng.roll(4)):
+            op = rng.roll(3)
+            if op == 0 and len(buf) > 2:          # truncate
+                del buf[len(buf) - 1 - rng.roll(len(buf) - 1):]
+            elif op == 1 and buf:                  # flip a byte
+                buf[rng.roll(len(buf))] ^= 1 + rng.roll(255)
+            else:                                  # splice junk
+                at = rng.roll(len(buf) + 1)
+                junk = bytes(rng.roll(256) for _ in range(1 + rng.roll(8)))
+                buf[at:at] = junk
+        _assert_typed(bytes(buf))
+
+
+# ----------------------------------------------- replayed packet numbers ---
+
+def test_replayed_packet_numbers_are_duplicates():
+    conn = QuicConn(is_server=True, identity_seed=b"\x05" * 32,
+                    peer_addr=("p", 1), orig_dcid=b"O" * 8)
+    space = conn.spaces[0]
+    assert space.record_rx(7) is True
+    assert space.record_rx(7) is False          # exact replay
+    assert space.record_rx(5) is True
+    for pn in range(8, 48):
+        space.record_rx(pn)
+    assert space.record_rx(7) is False          # replay across ranges
+    assert len(space.rx_ranges) <= 32           # state stays bounded
+
+
+# ------------------------------------------- conn / endpoint absorption ----
+
+def test_conn_recv_garbage_never_raises():
+    rng = Rng(seq=0xBEEF)
+    conn = QuicConn(is_server=True, identity_seed=b"\x05" * 32,
+                    peer_addr=("p", 1), orig_dcid=b"O" * 8)
+    for i in range(300):
+        ln = 1 + rng.roll(200)
+        dg = bytes(rng.roll(256) for _ in range(ln))
+        conn.recv_datagram(dg, now=float(i) * 0.001)
+    # And garbage that wears a plausible long-header coat:
+    hdr = wire.encode_long_header(wire.PKT_INITIAL, b"O" * 8, b"S" * 8,
+                                  pn=0, pn_len=2, payload_len=40)
+    conn.recv_datagram(hdr + bytes(rng.roll(256) for _ in range(40)), 1.0)
+
+
+def test_endpoint_rx_garbage_never_raises_and_counts_drops():
+    sent = []
+    server = Quic(QuicConfig(is_server=True, identity_seed=b"\x01" * 32),
+                  tx=lambda a, d: sent.append(d))
+    rng = Rng(seq=0xF10D)
+    for i in range(300):
+        ln = 1 + rng.roll(180)
+        dg = bytes(rng.roll(256) for _ in range(ln))
+        server.rx(("atk", i & 7), dg, now=i * 0.001)
+        server.service(i * 0.001)
+    assert server.metrics["rx_dropped"] > 0
+    # Zero state allocated for any of it (no Initial ever decrypted).
+    assert all(not c.established for c in server.conns)
+
+
+def test_endpoint_attributes_drops_to_peers():
+    drops = []
+    server = Quic(QuicConfig(is_server=True, identity_seed=b"\x01" * 32),
+                  tx=lambda a, d: None,
+                  on_rx_drop=lambda addr: drops.append(addr))
+    server.rx(("atk", 1), b"\x40" + os.urandom(30), 0.0)
+    assert drops == [("atk", 1)]
+
+
+def test_handshake_deadline_reaps_half_open_conns():
+    """A garbage Initial allocates a conn that can never complete its
+    handshake; the hs_timeout reaper must retire it (the half-open
+    flood defense the quic_conn_churn chaos class audits)."""
+    server = Quic(QuicConfig(is_server=True, identity_seed=b"\x01" * 32,
+                             hs_timeout=0.5),
+                  tx=lambda a, d: None)
+    hdr = wire.encode_long_header(wire.PKT_INITIAL, b"Z" * 8, b"S" * 8,
+                                  pn=0, pn_len=2, payload_len=48)
+    server.rx(("atk", 9), hdr + os.urandom(48), now=0.0)
+    assert len(server.conns) == 1 and not server.conns[0].established
+    server.service(0.2)
+    assert len(server.conns) == 1   # inside the deadline: kept
+    server.service(0.6)
+    assert len(server.conns) == 0   # past it: reaped
+    assert server.metrics["conns_closed"] == 1
