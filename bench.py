@@ -140,6 +140,19 @@ def _rlc_fallbacks(res) -> int:
     return sum(v.get("rlc_fallback", 0) or 0 for v in res.verify_stats)
 
 
+def _rung_hist(res) -> "dict | None":
+    """fd_engine per-rung dispatch histogram merged across verify lanes
+    ({str(B): batches}; None when no lane ran the rung scheduler) — the
+    artifact block that lets the sentinel's edge-histogram story be
+    attributed to scheduling (scripts/bench_log_check.py pins the
+    shape)."""
+    merged: dict = {}
+    for v in res.verify_stats:
+        for b, n in (v.get("rung_hist") or {}).items():
+            merged[b] = merged.get(b, 0) + n
+    return merged or None
+
+
 def _schema_version() -> int:
     from firedancer_tpu.disco.flight import ARTIFACT_SCHEMA_VERSION
 
@@ -209,6 +222,7 @@ def _replay_artifact(metric: str, corpus, res, run_s: float, gen_s: float,
         "feed_fallback_reason": getattr(res, "feed_fallback_reason", None),
         "verify_stats": res.verify_stats,
         "rlc_fallbacks": _rlc_fallbacks(res),
+        "rung_hist": _rung_hist(res),
         "stage_latency_ms": _stage_latency_ms(res),
         "stage_hist": getattr(res, "stage_hist", None),
         # fd_xray summary (behind the schema_version gate like every
@@ -402,8 +416,6 @@ def worker(cpu: bool) -> int:
         jax.config.update("jax_platforms", "cpu")
     _configure_jax_cache(jax)
 
-    from firedancer_tpu.ops.verify import verify_batch
-
     mode = flags.get_str("FD_BENCH_VERIFY")
     if mode not in ("rlc", "direct"):
         print(json.dumps({"metric": "ed25519_verify_throughput", "value": 0,
@@ -421,28 +433,27 @@ def worker(cpu: bool) -> int:
         jax.device_put(jnp.asarray(a), dev) for a in (msgs, lens, sigs, pubs)
     )
 
-    fn = jax.jit(verify_batch)
+    # fd_engine registry resolution (PR 13): the worker's verify graph
+    # is a registry entry — the SAME build path VerifyTile's prewarm
+    # uses (rlc = direct jit + make_async_verifier wrap, all inside
+    # disco/engine.py) — built UNWARMED so the compile is paid (and
+    # timed) on the real inputs below and every timed rep stays one
+    # execution. B-sweep rungs each resolve through this lookup too, so
+    # compile_cache_hit_est comes from flight's one heuristic instead
+    # of a bench-local copy drifting against the tile prewarm's.
+    from firedancer_tpu.disco import engine as fd_engine
+
+    entry, _ = fd_engine.registry().acquire(
+        fd_engine.EngineSpec(mode, batch, 0, fd_engine.current_frontend()),
+        warm=False)
+    fn = entry.fn
     fell_back = False
-    if mode == "rlc":
-        # RLC batch verification (ops/verify_rlc.py) — the PRIMARY
-        # production mode (round-6): one Pippenger-MSM pass on the VMEM
-        # Pallas engine plus the randomized torsion certification for a
-        # clean batch, per-lane fallback otherwise. The wrapper returns
-        # a lazy result object; np.asarray forces it. The rlc graph is
-        # still the largest compile in the ladder, so main() budgets
-        # this rung to always leave `direct` a full attempt.
-        from firedancer_tpu.ops.verify_rlc import make_async_verifier
-
-        direct = fn
-        rlc_fn = make_async_verifier(direct)
-
-        def fn(*a):  # noqa: F811 - intentional mode shadow
-            return rlc_fn(*a)
 
     t0 = time.perf_counter()
     out = fn(*args)
     res0 = np.asarray(out)
     compile_s = time.perf_counter() - t0
+    entry.account_first_call(compile_s, msg_len=msg_len)
     if mode == "rlc":
         fell_back = bool(getattr(out, "used_fallback", False))
     if not bool((res0 == 0).all()) or fell_back:
@@ -451,15 +462,6 @@ def worker(cpu: bool) -> int:
                           "error": "correctness check failed"
                                    + (" (rlc fell back)" if fell_back else "")}))
         return 1
-
-    # fd_flight: per-engine compile accounting (mode x B x shards=0 x
-    # frontend) — the registry record the engine-registry refactor
-    # (ROADMAP direction 3) will key on.
-    from firedancer_tpu.disco import flight
-
-    ekey = flight.engine_key(
-        mode, batch, 0, flags.get_str("FD_FRONTEND_IMPL") or "auto")
-    flight.record_compile(ekey, compile_s)
 
     # Opt-in jax.profiler capture around the timed reps (device-side
     # attribution for the ROOFLINE budget; the trace perturbs timing,
@@ -509,8 +511,8 @@ def worker(cpu: bool) -> int:
         "mode": mode,
         "device": str(dev),
         "compile_s": round(compile_s, 1),
-        "engine_key": ekey,
-        "compile_cache_hit_est": compile_s < 1.0,
+        "engine_key": entry.key,
+        "compile_cache_hit_est": entry.cache_hit_est,
         "jax_trace_dir": trace_dir if (trace_dir and not cpu) else None,
         "ms_per_batch": round(1e3 * dt / reps, 2),
         "rlc_fallbacks": fallback_cnt,

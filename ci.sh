@@ -140,6 +140,19 @@ echo "== fd_siege smoke (QUIC front door under attack, CPU) =="
 # with the defenses on vs off on a clean churn profile.
 JAX_PLATFORMS=cpu python scripts/siege_smoke.py
 
+echo "== fd_engine smoke (registry parity + rung-scheduler profiles) =="
+# The PR-13 continuous-batching gate: engine resolution must equal the
+# legacy dispatch contract (one registry authority; a real registry-
+# built engine matches the oracle lane by lane), synthetic low-load /
+# saturation profiles driven through the RungScheduler must show the
+# acceptance shape on flight edge histograms (low-load p99 drops to
+# the small-rung latency AND beats fixed-top-rung; saturation
+# throughput >= 0.9x fixed with the top rung carrying >= 90% of
+# lanes), the cpu feed pipeline must be digest-bit-exact sched vs
+# fixed-B, and the artifact's rung histogram must validate against
+# bench_log_check's schema gate.
+JAX_PLATFORMS=cpu python scripts/engine_smoke.py
+
 echo "== RLC verify smoke (CPU backend, FD_BENCH_VERIFY=rlc) =="
 # The production verify mode's dispatch contract (round-6 promotion):
 # tiny batch through the tile-facing RLC wrapper — no fallback on clean

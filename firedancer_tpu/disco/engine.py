@@ -1,0 +1,769 @@
+"""fd_engine — verify-graph engine registry + latency-adaptive rung
+scheduler (ROADMAP direction 3: continuous batching).
+
+Two halves, both pure host-side (stdlib + the flight/msm_plan helpers;
+jax is imported lazily only when a device graph is actually built, so
+disco/tiles.py's jax-import-free contract for host-backend tiles
+holds):
+
+  REGISTRY   every verify graph is a typed, cached EngineEntry keyed by
+             the flight ``engine_key`` (mode x B x shards x frontend).
+             The entry carries the built async verify callable (and the
+             per-lane fallback graph for rlc), its prewarm state
+             (cold/warming/warm/failed), the measured compile cost
+             (booked through flight.record_compile — the same per-engine
+             compile accounting fd_flight introduced), the analytic
+             fill-efficiency / executed-madds cost from msm_plan, and a
+             measured service-time EMA. Before fd_engine this dispatch
+             logic was smeared across disco/tiles.py (VerifyTile's
+             backend=='tpu' branch), ops/backend.py
+             (default_verify_mode) and bench.py (the worker's
+             jit+rlc-wrap block); all three now resolve through the
+             registry, so the compile-cache-hit accounting between
+             bench workers and VerifyTile prewarm comes from ONE
+             heuristic instead of three hand-rolled copies.
+             ``prewarm_ladder`` warms the configured rung ladder on a
+             background thread (FD_ENGINE_PREWARM policy) so a tile can
+             switch rungs without paying a mid-run compile.
+
+  SCHEDULER  RungScheduler promotes AdaptiveFlush (disco/feed/policy.py)
+             into an ONLINE continuous-batching scheduler, inference-
+             serving style: pick the dispatch B from the FD_ENGINE_LADDER
+             rung ladder using queue depth (staged lanes + ring
+             backlog), deadline slack, and each rung's registry-attached
+             cost model. Low offered load takes the small-rung latency
+             (the batch "fills" at the small rung and ships early);
+             saturation takes the big-rung throughput (fill efficiency
+             is monotone in B — msm_plan, BENCH r05: 0.63 -> 0.76 from
+             8k to 32k). Pure decision logic, AdaptiveFlush pattern:
+             the caller passes now_ns, no clock reads, so the policy is
+             property-testable without a device — the deadline
+             invariant (a partial batch is never starved past the
+             deadline) is inherited verbatim because the flush verdict
+             still comes from the embedded AdaptiveFlush, just with the
+             chosen rung as the batch bound.
+
+Thread discipline (docs/OWNERSHIP.md, fdlint pass 6): the registry's
+entry map is lock-guarded; per-entry builds/warms serialize on the
+entry's own build lock (never the registry lock — compiles take
+minutes); the prewarm thread only calls the same lock-guarded acquire
+path. A RungScheduler instance is single-threaded by contract (the
+feed stager owns the tile's instance).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, replace
+from typing import Callable, Dict, List, Optional, Tuple
+
+from firedancer_tpu import flags, msm_plan
+from firedancer_tpu.disco import flight
+from firedancer_tpu.disco.feed.policy import AdaptiveFlush
+
+# EngineEntry prewarm states.
+ENGINE_COLD = "cold"         # record exists; no graph built yet
+ENGINE_WARMING = "warming"   # a warm pass (compile) is in flight
+ENGINE_WARM = "warm"         # compiled + warmed: dispatchable now
+ENGINE_FAILED = "failed"     # last warm attempt raised (err recorded)
+
+# Host engines (no device graph to compile): the registry still tracks
+# them so every dispatch site keys its accounting the same way.
+_HOST_MODES = ("cpu", "oracle")
+
+
+def current_frontend() -> str:
+    """The frontend half of the engine key (FD_FRONTEND_IMPL)."""
+    return flags.get_str("FD_FRONTEND_IMPL") or "auto"
+
+
+@dataclass(frozen=True)
+class EngineSpec:
+    """The typed engine identity behind flight.engine_key — mode x B x
+    shards x frontend. Hashable, so it is also the registry map key."""
+
+    mode: str            # rlc | direct (device) or cpu | oracle (host)
+    batch: int
+    shards: int = 0      # mesh_devices of the sharded verify step
+    frontend: str = "auto"
+
+    @property
+    def key(self) -> str:
+        return flight.engine_key(self.mode, self.batch, self.shards,
+                                 self.frontend)
+
+    def with_batch(self, batch: int) -> "EngineSpec":
+        return replace(self, batch=batch)
+
+    @classmethod
+    def for_tile(cls, backend: str, verify_mode: str, batch: int,
+                 mesh_devices: int) -> "EngineSpec":
+        """The spec a VerifyTile's dispatches are keyed by: device
+        backends key on the resolved verify mode, host backends on the
+        backend name (the long-standing engine_key convention)."""
+        return cls(verify_mode if backend == "tpu" else backend,
+                   batch, mesh_devices, current_frontend())
+
+
+def parse_key(key: str) -> EngineSpec:
+    """Inverse of EngineSpec.key ("mode:B<batch>:shards<n>:fe<impl>")
+    for artifact/readback tooling; raises ValueError on junk."""
+    parts = key.split(":")
+    if (len(parts) != 4 or not parts[1].startswith("B")
+            or not parts[2].startswith("shards")
+            or not parts[3].startswith("fe")):
+        raise ValueError(f"not an engine key: {key!r}")
+    return EngineSpec(parts[0], int(parts[1][1:]), int(parts[2][6:]),
+                      parts[3][2:])
+
+
+# --------------------------------------------------------------------------
+# Mode resolution — moved here from disco/tiles.py + ops/backend.py so
+# ONE module owns every engine-resolution decision (the dispatch sites
+# are registry lookups).
+# --------------------------------------------------------------------------
+
+
+def default_verify_mode() -> str:
+    """Verify-tile mode when the config says 'auto' (round-6 RLC
+    promotion): 'rlc' — batch RLC verification over the VMEM Pallas
+    Pippenger MSM (ops/verify_rlc.py) — on TPU platforms; 'direct'
+    per-lane on host-jax backends (no VMEM engine to amortize, and the
+    CPU-jax RLC graph is a CI/parity path, not a production one).
+    FD_VERIFY_MODE forces either explicitly; an unrecognized value is
+    an error, not a silent fall-through to the platform default (a
+    typo'd force must never masquerade as a measurement of the mode
+    the operator asked for)."""
+    forced = flags.get_raw("FD_VERIFY_MODE")
+    if forced:
+        if forced not in ("rlc", "direct"):
+            raise ValueError(
+                f"unknown FD_VERIFY_MODE {forced!r} (want rlc|direct)"
+            )
+        return forced
+    from firedancer_tpu.ops.backend import _platform_is_tpu
+
+    return "rlc" if _platform_is_tpu() else "direct"
+
+
+def resolve_verify_mode(backend: str, verify_mode: str,
+                        mesh_devices: int) -> str:
+    """Resolve a VerifyTile's verify mode (module-level so the
+    contract is unit-testable without a workspace).
+
+    'auto' resolves by the ATTACHED PLATFORM (default_verify_mode
+    above): rlc on TPU families — including mesh_devices, now that the
+    Pippenger MSM shards across the mesh (round-10) — direct on
+    host-jax backends. FD_VERIFY_MODE forces either explicitly; an
+    unknown value raises. The GENUINELY unsupported combination is rlc
+    on a non-jax backend ('cpu'/'oracle' host verifiers have no batch
+    engine for the RLC graph to run on) — that is the only remaining
+    blanket rejection. FD_MSM_SHARD=0 is the bisection hatch that
+    restores the pre-round-10 rlc+mesh rejection (a silent downgrade
+    to direct would masquerade as a measurement of the sharded path).
+
+    The env force is validated HERE as well as at the platform default:
+    host-backend tiles must stay jax-import-free, so they cannot probe
+    the platform, but an explicit force — or a typo'd one — must still
+    fail loudly instead of being silently dropped."""
+    if verify_mode not in ("auto", "direct", "rlc"):
+        raise ValueError(
+            f"unknown verify_mode {verify_mode!r} (want auto|direct|rlc)"
+        )
+    shard_ok = flags.get_bool("FD_MSM_SHARD")
+    if verify_mode == "auto":
+        forced = flags.get_raw("FD_VERIFY_MODE")
+        if forced and forced not in ("rlc", "direct"):
+            raise ValueError(
+                f"unknown FD_VERIFY_MODE {forced!r} (want rlc|direct)"
+            )
+        if backend != "tpu":
+            if forced == "rlc":
+                raise ValueError(
+                    "FD_VERIFY_MODE=rlc requires backend='tpu' (the "
+                    "host cpu|oracle verifiers have no batch engine "
+                    "for the RLC graph — the one genuinely "
+                    "unsupported combination)"
+                )
+            return "direct"
+        verify_mode = default_verify_mode()
+        if verify_mode == "rlc" and mesh_devices and not shard_ok:
+            # The FD_MSM_SHARD=0 hatch: a platform auto-pick quietly
+            # stays direct, but an EXPLICIT FD_VERIFY_MODE=rlc force
+            # must fail loudly, not be silently dropped.
+            if forced == "rlc":
+                raise ValueError(
+                    "FD_VERIFY_MODE=rlc with mesh_devices needs the "
+                    "sharded MSM, which FD_MSM_SHARD=0 disabled"
+                )
+            verify_mode = "direct"
+        return verify_mode
+    if verify_mode == "rlc" and backend != "tpu":
+        # Silently running the oracle path while the operator believes
+        # RLC is on would be indistinguishable from "no fallbacks".
+        raise ValueError(
+            "verify_mode='rlc' requires backend='tpu' (the host "
+            "cpu|oracle verifiers have no batch engine for the RLC "
+            "graph — the one genuinely unsupported combination)"
+        )
+    if verify_mode == "rlc" and mesh_devices and not shard_ok:
+        raise ValueError(
+            "verify_mode='rlc' with mesh_devices needs the sharded "
+            "MSM, which FD_MSM_SHARD=0 disabled"
+        )
+    return verify_mode
+
+
+# --------------------------------------------------------------------------
+# Engine entries + registry.
+# --------------------------------------------------------------------------
+
+
+class EngineEntry:
+    """One prepared verify engine. Mutation discipline: ``state`` /
+    ``fn`` / compile fields change only under the entry's build lock
+    (held by whichever thread builds or warms it — a tile constructor,
+    a bench worker, or the registry prewarm thread); the dispatch-side
+    counters (dispatches/lanes/service EMA) are written by the single
+    dispatching tile thread that owns the engine at runtime."""
+
+    __slots__ = (
+        "spec", "key", "state", "fn", "direct_fn", "compile_s",
+        "fallback_compile_s", "cache_hit_est", "err", "dispatches",
+        "lanes", "service_ns", "fill_efficiency", "madds_per_lane",
+        "built_ts", "_warmed", "_build_lock",
+    )
+
+    def __init__(self, spec: EngineSpec):
+        self.spec = spec
+        self.key = spec.key
+        self.state = ENGINE_WARM if spec.mode in _HOST_MODES \
+            else ENGINE_COLD
+        self.fn: Optional[Callable] = None        # async verify callable
+        self.direct_fn: Optional[Callable] = None  # rlc per-lane fallback
+        self.compile_s = 0.0
+        self.fallback_compile_s = 0.0
+        self.cache_hit_est = False
+        self.err: Optional[str] = None
+        self.dispatches = 0
+        self.lanes = 0
+        self.service_ns = 0        # EMA of dispatch->complete wall ns
+        # Analytic cost model (msm_plan): meaningful for the rlc MSM
+        # engine; the direct/host engines scale ~linearly in lanes, so
+        # their per-lane proxy is flat.
+        if spec.mode == "rlc":
+            self.fill_efficiency = msm_plan.fill_efficiency(
+                spec.batch)["total"]
+            self.madds_per_lane = msm_plan.executed_madds_per_lane(
+                spec.batch)
+        else:
+            self.fill_efficiency = None
+            self.madds_per_lane = None
+        self.built_ts = 0.0
+        self._warmed: set = set()   # (batch, max_msg_len) shapes warmed
+        self._build_lock = threading.Lock()
+
+    def note_dispatch(self, lanes: int) -> None:
+        self.dispatches += 1
+        self.lanes += lanes
+
+    def note_service(self, ns: int) -> None:
+        """Measured dispatch->complete wall time: EMA(1/8) so the cost
+        model tracks the device without chasing single-batch noise."""
+        self.service_ns = (ns if not self.service_ns
+                           else (7 * self.service_ns + ns) // 8)
+
+    def service_est_ns(self) -> int:
+        """Best service-time estimate for one batch on this engine:
+        the measured EMA, 0 while unmeasured (callers treat 0 as "no
+        cost information — do not cap on it")."""
+        return self.service_ns
+
+    def account_first_call(self, seconds: float,
+                           msg_len: int = 0) -> None:
+        """Book a caller-measured first-call compile (the bench worker
+        path: it warms on its REAL inputs so the timed reps stay
+        one-execution-per-rep) through the same flight accounting the
+        warm path uses. Pass the executed msg_len so the shape is
+        registered as warmed — a later acquire(warm=True) at the SAME
+        shape must not re-warm and double-book the compile record
+        (jit retraces genuinely different shapes, so those still
+        warm). Takes the build lock: these fields are build-phase
+        state."""
+        with self._build_lock:
+            rec = flight.record_compile(self.key, seconds)
+            self.compile_s = seconds
+            self.cache_hit_est = bool(rec["cache_hit_est"])
+            self.state = ENGINE_WARM
+            self.built_ts = time.time()
+            if msg_len:
+                self._warmed.add((self.spec.batch, msg_len))
+
+    def snapshot(self) -> dict:
+        return {
+            "key": self.key,
+            "mode": self.spec.mode,
+            "batch": self.spec.batch,
+            "shards": self.spec.shards,
+            "frontend": self.spec.frontend,
+            "state": self.state,
+            "compile_s": round(self.compile_s, 3),
+            "fallback_compile_s": round(self.fallback_compile_s, 3),
+            "cache_hit_est": self.cache_hit_est,
+            "dispatches": self.dispatches,
+            "lanes": self.lanes,
+            "service_est_ns": self.service_est_ns(),
+            "fill_efficiency": (round(self.fill_efficiency, 4)
+                                if self.fill_efficiency is not None
+                                else None),
+            "err": self.err,
+        }
+
+
+class EngineRegistry:
+    """The process-wide map engine_key -> EngineEntry. ``acquire`` is
+    the ONE dispatch-site API: get-or-create the entry, build its
+    graph, optionally warm (compile) it — idempotent per (spec, warm
+    shape), so N call sites resolving the same engine pay one compile
+    and share one accounting record."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[EngineSpec, EngineEntry] = {}
+        self._prewarm_q: deque = deque()   # (spec, max_msg_len)
+        self._prewarm_wake = threading.Event()
+        self._prewarm_stop = threading.Event()
+        self._prewarm_thread: Optional[threading.Thread] = None
+        # Guarded by _lock: True while a prewarm thread has committed
+        # to draining the queue. The exit decision and this flag flip
+        # happen under ONE lock hold, so a producer appending specs
+        # either sees running=False (and starts a fresh thread) or is
+        # seen by the draining loop before it breaks — is_alive() alone
+        # races a thread that decided to exit but hasn't died yet.
+        self._prewarm_running = False
+
+    # -- entry map -------------------------------------------------------
+
+    def entry(self, spec: EngineSpec) -> EngineEntry:
+        """Get-or-create the record WITHOUT building anything (cost
+        model / accounting handles for schedulers and artifacts)."""
+        with self._lock:
+            e = self._entries.get(spec)
+            if e is None:
+                e = EngineEntry(spec)
+                self._entries[spec] = e
+            return e
+
+    def entries(self) -> List[EngineEntry]:
+        with self._lock:
+            return list(self._entries.values())
+
+    def snapshot(self) -> List[dict]:
+        """Artifact view of every known engine (bench/replay records,
+        flight dumps)."""
+        return [e.snapshot() for e in self.entries()]
+
+    # -- build + warm ----------------------------------------------------
+
+    def acquire(self, spec: EngineSpec, warm: bool = True,
+                max_msg_len: int = 1232) -> Tuple[EngineEntry, bool]:
+        """Resolve an engine for dispatch. Returns (entry, warmed_now):
+        warmed_now is True when THIS call paid a warm pass (the caller
+        books it into its own tile lane; flight.record_compile is
+        already booked by the registry). warm=False builds the callable
+        without compiling — the bench worker warms on its real inputs
+        and books via entry.account_first_call."""
+        e = self.entry(spec)
+        if spec.mode in _HOST_MODES:
+            return e, False
+        with e._build_lock:
+            if e.fn is None:
+                try:
+                    self._build(e)
+                except BaseException as exc:
+                    # Build failures must be observable too (a rung
+                    # whose shape can't build, e.g. not divisible over
+                    # the mesh): state=failed + err, like a failed warm
+                    # — snapshot readers can tell "broken" from "never
+                    # attempted", and warm_entry keeps returning None.
+                    e.state = ENGINE_FAILED
+                    e.err = repr(exc)[:200]
+                    raise
+            warmed_now = False
+            if warm:
+                warmed_now = self._warm_locked(e, max_msg_len)
+            return e, warmed_now
+
+    def _build(self, e: EngineEntry) -> None:
+        """Construct the async verify callable(s) for a device engine —
+        the dispatch logic formerly inlined in VerifyTile.__init__ and
+        bench.worker. Cheap (graph wrapping only); the compile happens
+        at the warm pass / first call."""
+        spec = e.spec
+        import jax
+
+        from firedancer_tpu.ops.verify import verify_batch
+
+        rlc_sharded = None
+        if spec.shards:
+            if spec.batch % spec.shards:
+                raise ValueError(
+                    f"batch {spec.batch} must divide over {spec.shards} "
+                    "mesh devices"
+                )
+            from firedancer_tpu.parallel.mesh import (
+                make_mesh,
+                verify_step_sharded,
+            )
+
+            mesh = make_mesh(spec.shards)
+            _sharded = verify_step_sharded(mesh)
+
+            def direct_fn(msgs, lens, sigs, pubs):
+                return _sharded(msgs, lens, sigs, pubs)[0]
+
+            if spec.mode == "rlc":
+                from firedancer_tpu.parallel.mesh import (
+                    verify_rlc_step_sharded,
+                )
+
+                rlc_sharded = verify_rlc_step_sharded(mesh)
+        else:
+            direct_fn = jax.jit(verify_batch)
+        fn = direct_fn
+        if spec.mode == "rlc":
+            # RLC batch-verify fast pass with lazy per-lane fallback
+            # (ops/verify_rlc.py); clean batches cost one MSM pass.
+            from firedancer_tpu.ops.verify_rlc import make_async_verifier
+
+            fn = make_async_verifier(direct_fn, rlc_fn=rlc_sharded)
+        e.direct_fn = direct_fn
+        e.fn = fn
+
+    def _warm_locked(self, e: EngineEntry, max_msg_len: int) -> bool:
+        """Warm (compile) the engine at (batch, max_msg_len) — caller
+        holds the entry build lock. Returns True when a warm pass ran.
+        The rlc fallback graph is warmed too: the zero-lane warm batch
+        resolves on the RLC pass alone, and the per-lane fallback would
+        otherwise compile mid-run on the first salted batch."""
+        shape = (e.spec.batch, max_msg_len)
+        if shape in e._warmed:
+            return False
+        import jax.numpy as jnp
+        import numpy as np
+
+        e.state = ENGINE_WARMING
+        warm_args = (
+            jnp.zeros(shape, jnp.uint8),
+            jnp.zeros((e.spec.batch,), jnp.int32),
+            jnp.zeros((e.spec.batch, 64), jnp.uint8),
+            jnp.zeros((e.spec.batch, 32), jnp.uint8),
+        )
+        try:
+            t0 = time.perf_counter()
+            np.asarray(e.fn(*warm_args))
+            e.compile_s = time.perf_counter() - t0
+            rec = flight.record_compile(e.key, e.compile_s)
+            e.cache_hit_est = bool(rec["cache_hit_est"])
+            if e.spec.mode == "rlc":
+                t0 = time.perf_counter()
+                np.asarray(e.direct_fn(*warm_args))
+                e.fallback_compile_s = time.perf_counter() - t0
+                flight.record_compile(e.key + ":fallback",
+                                      e.fallback_compile_s)
+        except BaseException as exc:
+            e.state = ENGINE_FAILED
+            e.err = repr(exc)[:200]
+            raise
+        e._warmed.add(shape)
+        e.state = ENGINE_WARM
+        e.err = None
+        e.built_ts = time.time()
+        return True
+
+    def warm_entry(self, spec: EngineSpec) -> Optional[EngineEntry]:
+        """The dispatch-time lookup for a rung switch: the entry iff it
+        is WARM and dispatchable right now, else None (the caller keeps
+        the engine it already holds — a rung switch must never stall a
+        hot loop on a compile)."""
+        with self._lock:
+            e = self._entries.get(spec)
+        if e is not None and e.state == ENGINE_WARM and e.fn is not None:
+            return e
+        return None
+
+    # -- background prewarm ---------------------------------------------
+
+    def prewarm_ladder(self, specs, max_msg_len: int = 1232,
+                       policy: Optional[str] = None) -> None:
+        """Warm a rung ladder per the FD_ENGINE_PREWARM policy:
+        'background' queues the specs for the registry prewarm thread
+        (started on first use; rung switches pick each engine up as it
+        turns WARM), 'sync' warms inline before returning, 'off' does
+        nothing (every rung but the primary stays cold — the scheduler
+        then effectively pins the primary engine)."""
+        policy = policy or flags.get_str("FD_ENGINE_PREWARM")
+        if policy not in ("background", "sync", "off"):
+            raise ValueError(
+                f"unknown FD_ENGINE_PREWARM {policy!r} "
+                "(want background|sync|off)"
+            )
+        if policy == "off":
+            return
+        if policy == "sync":
+            for spec in specs:
+                self.acquire(spec, warm=True, max_msg_len=max_msg_len)
+            return
+        with self._lock:
+            for spec in specs:
+                self._prewarm_q.append((spec, max_msg_len))
+            if not self._prewarm_running:
+                self._prewarm_running = True
+                self._prewarm_stop.clear()
+                t = threading.Thread(
+                    target=self._prewarm_loop, name="fd_engine.prewarm",
+                    daemon=True,
+                )
+                self._prewarm_thread = t
+                t.start()
+        self._prewarm_wake.set()
+
+    def _prewarm_loop(self) -> None:
+        # Single consumer of the prewarm queue; every mutation it
+        # performs goes through the same lock-guarded acquire path the
+        # foreground callers use (docs/OWNERSHIP.md row). A failed warm
+        # is recorded on the entry (state=failed, err) and the loop
+        # moves on — a broken rung must not kill prewarm for the rest
+        # of the ladder.
+        while not self._prewarm_stop.is_set():
+            with self._lock:
+                item = (self._prewarm_q.popleft()
+                        if self._prewarm_q else None)
+            if item is None:
+                self._prewarm_wake.wait(timeout=0.2)
+                self._prewarm_wake.clear()
+                with self._lock:
+                    if not self._prewarm_q:
+                        # Exit decision + running-flag flip under ONE
+                        # lock hold (see _prewarm_running): a producer
+                        # can never enqueue into a thread that already
+                        # chose to die.
+                        self._prewarm_running = False
+                        break
+                continue
+            spec, max_msg_len = item
+            try:
+                self.acquire(spec, warm=True, max_msg_len=max_msg_len)
+            except BaseException:
+                pass  # entry carries state=failed + err for observers
+        with self._lock:
+            self._prewarm_running = False  # stop-Event exits too
+
+    def prewarm_idle(self) -> bool:
+        """True when no background prewarm work is queued or running
+        (tests + the engine smoke synchronize on this)."""
+        with self._lock:
+            return not self._prewarm_q and not self._prewarm_running
+
+    def stop_prewarm(self, timeout: float = 10.0) -> None:
+        """Stop background prewarm: the queue is DROPPED (stop means
+        stop — leaving specs queued would strand them behind a dead
+        thread) and the thread joined. A later prewarm_ladder call
+        starts fresh (the running flag flips off at thread exit)."""
+        with self._lock:
+            self._prewarm_q.clear()
+        self._prewarm_stop.set()
+        self._prewarm_wake.set()
+        t = self._prewarm_thread
+        if t is not None:
+            t.join(timeout=timeout)
+
+
+_registry: Optional[EngineRegistry] = None
+_registry_lock = threading.Lock()
+
+
+def registry() -> EngineRegistry:
+    """The process-wide registry (tiles, bench workers and smokes all
+    resolve through this one instance, so engine accounting has one
+    authority per process)."""
+    global _registry
+    with _registry_lock:
+        if _registry is None:
+            _registry = EngineRegistry()
+        return _registry
+
+
+# --------------------------------------------------------------------------
+# Rung ladder + scheduler.
+# --------------------------------------------------------------------------
+
+
+def rung_ladder(cap: Optional[int] = None, floor: int = 0) -> List[int]:
+    """The FD_ENGINE_LADDER rung list: parsed, deduped, ascending.
+    `cap` drops rungs above the tile's staging batch (arenas are sized
+    to the largest rung); `floor` drops rungs too small to stage a
+    whole txn (MAX_SIG_CNT). A malformed entry raises — a typo'd
+    ladder must never silently schedule on the wrong rungs."""
+    raw = flags.get_str("FD_ENGINE_LADDER")
+    rungs = set()
+    for part in (raw or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            b = int(part)
+        except ValueError:
+            raise ValueError(
+                f"bad FD_ENGINE_LADDER entry {part!r} (want a "
+                "comma-separated list of batch sizes)"
+            ) from None
+        if b <= 0:
+            raise ValueError(
+                f"FD_ENGINE_LADDER rung {b} must be positive")
+        rungs.add(b)
+    out = sorted(r for r in rungs
+                 if r >= floor and (cap is None or r <= cap))
+    return out
+
+
+class RungScheduler:
+    """Latency-adaptive rung selection over a B ladder — AdaptiveFlush
+    promoted into an online continuous-batching scheduler.
+
+    Decision shape (all pure in the passed clock; single caller thread
+    by contract — the feed stager):
+
+      pick(now_ns, lanes, first_ns, backlog)  ->  target rung
+          the largest rung the present queue depth (staged lanes +
+          ring backlog) can fill — monotone rung-up in depth, the
+          property test pins it — capped by deadline slack: a rung
+          whose measured service estimate exceeds the staged batch's
+          remaining latency budget cannot meet the deadline, so the
+          pick steps down (floor: the smallest rung). Unmeasured rungs
+          (cost 0) are never capped — prewarm hasn't seen them yet and
+          guessing would pin the ladder small forever.
+
+      due(...)  ->  AdaptiveFlush verdict with the CURRENT rung as the
+          batch bound: the deadline/starve invariants are inherited
+          verbatim (same policy object, same hwm clock hardening).
+
+      dispatch_rung(lanes)  ->  the smallest rung that covers a staged
+          lane count (engines are compiled per rung; a partial pads up
+          to the chosen rung's shape).
+
+    `cost_ns(rung)` is the registry-attached service model (EngineEntry
+    service EMA); None disables slack capping (host engines, whose
+    service scales with lanes rather than the padded rung)."""
+
+    def __init__(self, rungs, deadline_ns: int,
+                 cost_ns: Optional[Callable[[int], int]] = None):
+        rungs = sorted(set(int(r) for r in rungs))
+        if not rungs:
+            raise ValueError("RungScheduler needs at least one rung")
+        if any(r <= 0 for r in rungs):
+            raise ValueError(f"rungs must be positive, got {rungs}")
+        self.rungs = rungs
+        self.deadline_ns = deadline_ns
+        self.cost_ns = cost_ns
+        self.flush = AdaptiveFlush(deadline_ns)
+        self.cur = rungs[0]
+        self.switches = 0
+        self.decisions = 0
+        self.last_inputs: Tuple[int, int, int] = (0, 0, 0)
+
+    # -- pure selection --------------------------------------------------
+
+    def pick_rung(self, depth: int, slack_ns: Optional[int] = None) -> int:
+        """Stateless rung choice: largest rung fully coverable by
+        `depth`, capped by the deadline slack via the cost model.
+        Monotone non-decreasing in depth for fixed slack."""
+        i = 0
+        for j, rung in enumerate(self.rungs):
+            if depth >= rung:
+                i = j
+        if slack_ns is not None and self.cost_ns is not None:
+            while i > 0:
+                c = self.cost_ns(self.rungs[i])
+                if not c or c <= slack_ns:
+                    break
+                i -= 1
+        return self.rungs[i]
+
+    def dispatch_rung(self, lanes: int) -> int:
+        """Smallest rung that covers `lanes` staged lanes (a multisig
+        txn can overshoot the commit threshold); the top rung bounds
+        everything by construction (arenas are sized to it)."""
+        for rung in self.rungs:
+            if lanes <= rung:
+                return rung
+        return self.rungs[-1]
+
+    # -- online decision (stateful: switch tracking) ---------------------
+
+    def pick(self, now_ns: int, lanes: int, first_ns: int,
+             backlog: int, backlog_full: bool = False) -> int:
+        """The stager-facing decision: target rung for the batch being
+        staged. Queue depth = staged lanes + ring backlog (backlog is
+        in txns — a lower bound on lanes, so depth under-counts and the
+        rung-up errs toward latency, never toward a padded monster
+        batch). Slack = the staged batch's remaining deadline budget
+        (full budget while nothing is staged).
+
+        ``backlog_full`` is the caller's saturation signal: the in-ring
+        backlog is at (half of) its structural cap, i.e. the producer
+        is ahead of the stager as fast as the ring can express it —
+        the ring is depth-bounded, so raw backlog alone cannot reach
+        big-rung territory. Saturation means the pipeline is
+        queueing-bound and NO rung meets the deadline: depth is lifted
+        to the top rung and the slack cap is dropped, because capping
+        by service cost there shrinks batches exactly when big-rung
+        fill efficiency matters most (the small-rung death spiral the
+        engine smoke pins: worse throughput -> deeper backlog -> still
+        capped). Monotonicity survives: backlog_full only ever lifts
+        the pick."""
+        depth = max(0, lanes) + max(0, backlog)
+        if backlog_full or backlog >= self.rungs[-1]:
+            depth = max(depth, self.rungs[-1])
+            slack = None
+        elif lanes > 0 and first_ns:
+            slack = max(0, self.deadline_ns - max(0, now_ns - first_ns))
+        else:
+            slack = self.deadline_ns
+        rung = self.pick_rung(depth, slack_ns=slack)
+        self.decisions += 1
+        self.last_inputs = (depth, slack, lanes)
+        if rung != self.cur:
+            self.switches += 1
+            self.cur = rung
+        return rung
+
+    def due(self, now_ns: int, lanes: int, first_ns: int, *,
+            starved: bool = False, device_idle: bool = False,
+            backpressured: bool = False):
+        """AdaptiveFlush verdict at the current rung (FLUSH_FULL when
+        lanes filled the rung, FLUSH_DEADLINE at deadline expiry — the
+        invariant the property test pins — FLUSH_STARVED on the idle
+        early-out), or None to keep filling."""
+        return self.flush.due(
+            now_ns, lanes, self.cur, first_ns, starved=starved,
+            device_idle=device_idle, backpressured=backpressured,
+        )
+
+    def decide(self, now_ns: int, lanes: int, first_ns: int,
+               backlog: int, *, starved: bool = False,
+               device_idle: bool = False, backpressured: bool = False,
+               backlog_full: bool = False):
+        """pick + due in one call (the property-test surface): returns
+        (verdict_or_None, rung)."""
+        rung = self.pick(now_ns, lanes, first_ns, backlog,
+                         backlog_full=backlog_full)
+        verdict = None
+        if lanes > 0:
+            verdict = self.due(
+                now_ns, lanes, first_ns, starved=starved,
+                device_idle=device_idle, backpressured=backpressured,
+            )
+        return verdict, rung

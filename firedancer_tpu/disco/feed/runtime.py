@@ -102,6 +102,17 @@ def verify_tile_stats(v) -> Dict[str, object]:
         "compile_cnt": m["compile_cnt"],
         "compile_ms": round(m["compile_ns"] / 1e6, 1),
         "compile_cache_hit": m["compile_cache_hit"],
+        # fd_engine rung scheduler (disco/engine.py): the per-rung
+        # dispatch histogram (JSON-keyed by str(B)), the ladder in
+        # force, and the switch count — {} / [] / 0 with the scheduler
+        # off, so artifact consumers see ONE shape either way.
+        "rung_hist": {str(k): v for k, v in
+                      sorted(getattr(v, "stat_rung_hist", {}).items())},
+        "rung_ladder": (list(v.rung_sched.rungs)
+                        if getattr(v, "rung_sched", None) is not None
+                        else []),
+        "rung_switches": m["rung_switches"],
+        "rung_cur": m["rung_cur"],
     }
     if getattr(v, "_feed", False):
         st["slot_stall"] = v.feed_pool.slot_stall

@@ -44,7 +44,7 @@ class Slot:
         "idx", "state", "msgs", "lens", "sigs", "pubs", "pay", "offs",
         "plens", "psigs", "tlanes", "tsorigs", "tspubs", "hashes",
         "ha_mask", "n_txn", "n_lane", "pay_fill", "t_first", "drain_end",
-        "flush_verdict",
+        "flush_verdict", "rung", "rung_depth",
     )
 
     def __init__(self, idx: int, batch: int, max_msg_len: int):
@@ -80,6 +80,12 @@ class Slot:
         # fd_xray's exemplar batch context can attribute the flush
         # decision per dispatched batch.
         self.flush_verdict = "full"
+        # fd_engine rung context: the scheduler's target B for this
+        # batch and the queue depth it decided from (0/0 = scheduler
+        # off) — stamped by the stager, read by the dispatcher's
+        # exemplar capture.
+        self.rung = 0
+        self.rung_depth = 0
 
     def reset(self) -> None:
         self.ha_mask[: max(self.n_txn, 1)] = False
@@ -89,6 +95,8 @@ class Slot:
         self.t_first = 0
         self.drain_end = 0
         self.flush_verdict = "full"
+        self.rung = 0
+        self.rung_depth = 0
 
 
 class SlotPool:

@@ -126,6 +126,15 @@ TILE_METRICS: Tuple[Metric, ...] = (
     Metric("compile_cache_hit", "counter",
            "(pre)compiles that resolved fast enough to be persistent-"
            "cache hits (< 1 s heuristic)"),
+    # fd_engine rung scheduler (disco/engine.py): target-B changes and
+    # the current target, mirrored from the stager's decisions; the
+    # per-rung dispatch histogram lives in verify_stats.rung_hist (the
+    # ladder is config-sized, so it cannot be a fixed metric row).
+    Metric("rung_switches", "counter",
+           "fd_engine rung-scheduler target-B changes (ladder moves "
+           "between the 8k/16k/32k-style rungs)"),
+    Metric("rung_cur", "gauge",
+           "current fd_engine scheduler target B (0 = scheduler off)"),
     Metric("breaker_state", "gauge",
            "verify failover breaker state: 0 closed, 1 open, 2 half_open, "
            "3 disabled/absent"),
@@ -567,6 +576,15 @@ def verify_stats_view(wksp, label: str, batch: int) -> Optional[dict]:
         "compile_cnt": t["compile_cnt"],
         "compile_ms": round(t["compile_ns"] / 1e6, 1),
         "compile_cache_hit": t["compile_cache_hit"],
+        # fd_engine rung scheduler: the shared lane carries the switch
+        # counter + current-target gauge; the per-rung histogram is
+        # tile-object state (config-sized), so the cross-process view
+        # reports the same keys with the shape the artifact schema
+        # allows for "unknown" ({}).
+        "rung_switches": t["rung_switches"],
+        "rung_cur": t["rung_cur"],
+        "rung_hist": {},
+        "rung_ladder": [],
     }
 
 
@@ -656,11 +674,19 @@ def engine_key(mode: str, batch: int, shards: int, frontend: str) -> str:
     return f"{mode}:B{batch}:shards{shards}:fe{frontend}"
 
 
+def compile_cache_hit_est(seconds: float) -> bool:
+    """THE persistent-cache-hit heuristic: one predicate shared by the
+    compile records, the bench artifacts, and the fd_engine registry
+    entries, so 'cache hit' can never mean two different thresholds at
+    two dispatch sites (the PR-13 bench/prewarm consistency fix)."""
+    return seconds < _CACHE_HIT_S
+
+
 def record_compile(engine: str, seconds: float) -> dict:
     rec = {
         "engine": engine,
         "seconds": round(seconds, 3),
-        "cache_hit_est": seconds < _CACHE_HIT_S,
+        "cache_hit_est": compile_cache_hit_est(seconds),
         "ts": time.time(),
     }
     with _compile_lock:

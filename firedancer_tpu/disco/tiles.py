@@ -803,6 +803,11 @@ class _InflightBatch:
     # the feed cpu executor): its outcome feeds the failover circuit
     # breaker. CPU-failover and quarantine re-verifies set False.
     device: bool = False
+    # fd_engine accounting: the dispatch rung (B the engine ran at; 0 =
+    # scheduler off / legacy path) and the registry entry whose service
+    # EMA the completion feeds.
+    rung: int = 0
+    entry: object = None
 
 
 class _ReadyBatch:
@@ -821,75 +826,11 @@ class _ReadyBatch:
         return _np.asarray(self._s, dtype=dtype)
 
 
-def resolve_verify_mode(backend: str, verify_mode: str,
-                        mesh_devices: int) -> str:
-    """Resolve a VerifyTile's verify mode (module-level so the
-    contract is unit-testable without a workspace).
-
-    'auto' resolves by the ATTACHED PLATFORM (ops.backend policy): rlc
-    on TPU families — including mesh_devices, now that the Pippenger
-    MSM shards across the mesh (round-10) — direct on host-jax
-    backends. FD_VERIFY_MODE forces either explicitly; an unknown
-    value raises. The GENUINELY unsupported combination is rlc on a
-    non-jax backend ('cpu'/'oracle' host verifiers have no batch
-    engine for the RLC graph to run on) — that is the only remaining
-    blanket rejection. FD_MSM_SHARD=0 is the bisection hatch that
-    restores the pre-round-10 rlc+mesh rejection (a silent downgrade
-    to direct would masquerade as a measurement of the sharded path).
-
-    The env force is validated HERE as well as in ops.backend
-    (default_verify_mode): host-backend tiles must stay
-    jax-import-free, so they cannot call into ops.backend, but an
-    explicit force — or a typo'd one — must still fail loudly instead
-    of being silently dropped."""
-    if verify_mode not in ("auto", "direct", "rlc"):
-        raise ValueError(
-            f"unknown verify_mode {verify_mode!r} (want auto|direct|rlc)"
-        )
-    shard_ok = flags.get_bool("FD_MSM_SHARD")
-    if verify_mode == "auto":
-        forced = flags.get_raw("FD_VERIFY_MODE")
-        if forced and forced not in ("rlc", "direct"):
-            raise ValueError(
-                f"unknown FD_VERIFY_MODE {forced!r} (want rlc|direct)"
-            )
-        if backend != "tpu":
-            if forced == "rlc":
-                raise ValueError(
-                    "FD_VERIFY_MODE=rlc requires backend='tpu' (the "
-                    "host cpu|oracle verifiers have no batch engine "
-                    "for the RLC graph — the one genuinely "
-                    "unsupported combination)"
-                )
-            return "direct"
-        from firedancer_tpu.ops.backend import default_verify_mode
-
-        verify_mode = default_verify_mode()
-        if verify_mode == "rlc" and mesh_devices and not shard_ok:
-            # The FD_MSM_SHARD=0 hatch: a platform auto-pick quietly
-            # stays direct, but an EXPLICIT FD_VERIFY_MODE=rlc force
-            # must fail loudly, not be silently dropped.
-            if forced == "rlc":
-                raise ValueError(
-                    "FD_VERIFY_MODE=rlc with mesh_devices needs the "
-                    "sharded MSM, which FD_MSM_SHARD=0 disabled"
-                )
-            verify_mode = "direct"
-        return verify_mode
-    if verify_mode == "rlc" and backend != "tpu":
-        # Silently running the oracle path while the operator believes
-        # RLC is on would be indistinguishable from "no fallbacks".
-        raise ValueError(
-            "verify_mode='rlc' requires backend='tpu' (the host "
-            "cpu|oracle verifiers have no batch engine for the RLC "
-            "graph — the one genuinely unsupported combination)"
-        )
-    if verify_mode == "rlc" and mesh_devices and not shard_ok:
-        raise ValueError(
-            "verify_mode='rlc' with mesh_devices needs the sharded "
-            "MSM, which FD_MSM_SHARD=0 disabled"
-        )
-    return verify_mode
+# Verify-mode resolution lives in the fd_engine registry module since
+# PR 13 (ONE owner for every engine-resolution decision); re-exported
+# here because the tile construction sites and a decade of tests spell
+# it tiles.resolve_verify_mode.
+from firedancer_tpu.disco.engine import resolve_verify_mode  # noqa: E402
 
 
 class _FutureBatch:
@@ -1036,9 +977,20 @@ class VerifyTile(Tile):
         self._xr_on = xray.enabled()
         self.xr = xray.ring(f"tile:{self.flight_label}")
         self._xr_thr = xray.sample_threshold() if self._xr_on else 0
-        self._engine_key = flight.engine_key(
-            verify_mode if backend == "tpu" else backend, batch,
-            mesh_devices, flags.get_str("FD_FRONTEND_IMPL") or "auto")
+        # fd_engine identity: the registry spec this tile's dispatches
+        # are keyed by (mode x B x shards x frontend — the flight
+        # engine_key, now a typed registry key).
+        from firedancer_tpu.disco import engine as fd_engine
+
+        self._engine_spec = fd_engine.EngineSpec.for_tile(
+            backend, verify_mode, batch, mesh_devices)
+        self._engine_key = self._engine_spec.key
+        # The registry record exists for host engines too (cpu/oracle
+        # have nothing to compile, but their dispatch/service
+        # accounting keys the same way); the tpu branch below replaces
+        # this with the acquire()'d (built + warmed) entry.
+        self._registry = fd_engine.registry()
+        self._engine_entry = self._registry.entry(self._engine_spec)
         # Per-mesh-shard metric lanes (round-12 distributed aggregation:
         # populated only when mesh_devices > 1 — one row per shard,
         # booked at dispatch with the lanes that shard's slice of the
@@ -1100,10 +1052,7 @@ class VerifyTile(Tile):
         elif nd_ok:
             self._nd_setup()
         if backend == "tpu":
-            import jax
             import jax.numpy as jnp
-
-            from firedancer_tpu.ops.verify import verify_batch
 
             self._jnp = jnp
             if mesh_devices:
@@ -1113,78 +1062,92 @@ class VerifyTile(Tile):
                 # The shim is unchanged: the sharded step returns one
                 # global statuses array whose .is_ready()/np.asarray
                 # surface matches the single-device path.
-                if batch % mesh_devices:
-                    raise ValueError(
-                        f"batch {batch} must divide over {mesh_devices} "
-                        "mesh devices"
-                    )
-                from firedancer_tpu.parallel.mesh import (
-                    make_mesh,
-                    verify_step_sharded,
-                )
-
-                self._mesh = make_mesh(mesh_devices)
                 self.fl_shards = [
                     flight.tile_lane(wksp,
                                      f"{self.flight_label}.shard{i}")
                     for i in range(mesh_devices)
                 ]
-                _sharded = verify_step_sharded(self._mesh)
-
-                def _mesh_fn(msgs, lens, sigs, pubs):
-                    return _sharded(msgs, lens, sigs, pubs)[0]
-
-                self._verify_batch_fn = _mesh_fn
-            else:
-                self._verify_batch_fn = jax.jit(verify_batch)
-            direct_fn = self._verify_batch_fn
-            if verify_mode == "rlc":
-                # RLC batch-verify fast pass with lazy per-lane fallback
-                # (ops/verify_rlc.py); clean batches cost one MSM pass.
-                # On a mesh the RLC pass itself shards: local bucket
-                # fills, one cross-mesh window-partial combine, the
-                # per-lane fallback staying the sharded direct graph.
-                from firedancer_tpu.ops.verify_rlc import make_async_verifier
-
-                rlc_fn = None
-                if mesh_devices:
-                    from firedancer_tpu.parallel.mesh import (
-                        verify_rlc_step_sharded,
-                    )
-
-                    rlc_fn = verify_rlc_step_sharded(self._mesh)
-                self._verify_batch_fn = make_async_verifier(
-                    direct_fn, rlc_fn=rlc_fn)
-            # Pre-warm: compile the fixed (batch, max_msg_len) shape now
-            # so the run loop never stalls on first-flush compilation.
-            # This can take minutes (cold jit, or even a compile-cache
+            # fd_engine registry resolution: build + pre-warm the
+            # engine (compile the fixed (batch, max_msg_len) shape now
+            # so the run loop never stalls on first-flush compilation;
+            # rlc additionally warms its per-lane fallback graph). The
+            # warm can take minutes (cold jit, or even a compile-cache
             # LOAD on a small host); in the supervised path worker.py's
             # boot-heartbeat thread keeps the cnc alive throughout, so
             # the wedge detector does not fire on a compiling tile.
-            warm_args = (
-                jnp.zeros((batch, max_msg_len), jnp.uint8),
-                jnp.zeros((batch,), jnp.int32),
-                jnp.zeros((batch, 64), jnp.uint8),
-                jnp.zeros((batch, 32), jnp.uint8),
-            )
             # Per-engine compile accounting (mode x B x shards x
-            # frontend impl) into the flight registry: the respawn-
-            # storm class of failure is a COMPILE-TIME pathology, and
-            # before fd_flight it was invisible until it had destroyed
-            # throughput.
-            ekey = self._engine_key
-            t_c = time.perf_counter()
-            np.asarray(self._verify_batch_fn(*warm_args))
-            self._account_compile(ekey, time.perf_counter() - t_c)
-            if verify_mode == "rlc":
-                # The zero-lane warm batch resolves on the RLC pass
-                # alone, so the per-lane FALLBACK graph would otherwise
-                # compile mid-run on the first salted batch — warm it
-                # explicitly (one extra device pass at boot).
-                t_c = time.perf_counter()
-                np.asarray(direct_fn(*warm_args))
-                self._account_compile(ekey + ":fallback",
-                                      time.perf_counter() - t_c)
+            # frontend impl) is booked by the registry into the flight
+            # compile records and mirrored into this tile's lane below:
+            # the respawn-storm class of failure is a COMPILE-TIME
+            # pathology, and before fd_flight it was invisible until it
+            # had destroyed throughput.
+            entry, warmed_now = self._registry.acquire(
+                self._engine_spec, warm=True, max_msg_len=max_msg_len)
+            self._engine_entry = entry
+            self._verify_batch_fn = entry.fn
+            if warmed_now:
+                self._account_compile(entry.key, entry.compile_s)
+                if verify_mode == "rlc":
+                    self._account_compile(entry.key + ":fallback",
+                                          entry.fallback_compile_s)
+        # fd_engine rung scheduler (feed mode): pick the dispatch B from
+        # the FD_ENGINE_LADDER rungs by queue depth + deadline slack
+        # (disco/engine.py). Needs >= 2 usable rungs at or below the
+        # staging batch (arenas are sized to the batch, which always
+        # tops the ladder); anything else — including every
+        # legacy/non-feed topology — keeps the fixed-B behavior, and
+        # FD_ENGINE_SCHED=0 is the bisection hatch.
+        self.rung_sched = None
+        self.stat_rung_hist: dict = {}
+        self._rung_entries: dict = {}
+        self._rung_last = 0
+        if self._feed and flags.get_bool("FD_ENGINE_SCHED"):
+            rungs = fd_engine.rung_ladder(cap=batch, floor=MAX_SIG_CNT)
+            if mesh_devices:
+                # A rung that does not divide the mesh cannot build its
+                # sharded engine (the same check the tile's own batch
+                # passed) — drop it rather than letting prewarm crash
+                # the boot (sync) or silently fail the rung (background).
+                rungs = [r for r in rungs if r % mesh_devices == 0]
+            if batch not in rungs:
+                rungs.append(batch)
+                rungs.sort()
+            if len(rungs) >= 2:
+                cost = None
+                if backend == "tpu":
+                    # Per-rung engines: registry entries (cost model =
+                    # each rung's measured service EMA) + background
+                    # prewarm of the non-primary rungs, so a rung
+                    # switch picks up a WARM engine instead of paying
+                    # a mid-run compile (a cold rung falls back to the
+                    # primary engine at dispatch).
+                    self._rung_entries = {
+                        r: self._registry.entry(
+                            self._engine_spec.with_batch(r))
+                        for r in rungs
+                    }
+                    ents = self._rung_entries
+
+                    def cost(r, _e=ents):
+                        return _e[r].service_est_ns()
+
+                    self._registry.prewarm_ladder(
+                        [self._engine_spec.with_batch(r)
+                         for r in rungs if r != batch],
+                        max_msg_len=max_msg_len)
+                self.rung_sched = fd_engine.RungScheduler(
+                    rungs, self.max_wait_ns, cost_ns=cost)
+                # ONE flush policy object: the stager's verdict calls
+                # go through the scheduler's embedded AdaptiveFlush, so
+                # the property-tested decide()/due() surface and the
+                # shipped wiring share state (hwm clock hardening
+                # included) instead of drifting as two instances.
+                self.flush_policy = self.rung_sched.flush
+                self.fl.set_gauge("rung_cur", rungs[0])
+                self._rung_last = rungs[0]
+                self.flightrec.record(
+                    "rung_ladder", rungs=list(rungs),
+                    prewarm=flags.get_str("FD_ENGINE_PREWARM"))
 
     # -- fd_flight views: the registry lane is the ONE authority for
     # dispatch/healing stats; these read-only properties keep the
@@ -1239,7 +1202,8 @@ class VerifyTile(Tile):
         return self.fl.get("ctl_err_drop")
 
     def _xr_batch(self, tsorigs, n: int, verdict: str, device: bool,
-                  slot_idx=None, tlanes=None) -> None:
+                  slot_idx=None, tlanes=None, rung=None,
+                  rung_target: int = 0, rung_depth: int = 0) -> None:
         """fd_xray batch-context exemplars: one span per HEAD-SAMPLED
         txn of a dispatched batch, carrying the batch ordinal, engine
         key (mode x B x shards x frontend), flush verdict, and — on a
@@ -1259,7 +1223,10 @@ class VerifyTile(Tile):
             lane_start = np.zeros(n, np.int64)
             np.cumsum(np.asarray(tlanes[:n], np.int64)[:-1],
                       out=lane_start[1:])
-        per = self.batch // shards if shards else 0
+        # Shard attribution partitions the DISPATCHED shape: a reduced
+        # rung on a mesh engine splits `rung` lanes over the shards,
+        # not the tile's staging batch.
+        per = ((rung or self.batch) // shards) if shards else 0
         for i in idxs[:16]:
             extra = {
                 "batch": batch_no,
@@ -1269,6 +1236,16 @@ class VerifyTile(Tile):
             }
             if slot_idx is not None:
                 extra["slot"] = slot_idx
+            if rung is not None:
+                # fd_engine rung context: the B this batch actually
+                # dispatched at, plus the stager's TARGET rung and the
+                # queue depth behind that decision — a deadline/starved
+                # flush or a cold-rung fallback can dispatch a B other
+                # than the target, and the exemplar must not pair one
+                # rung with the other's inputs.
+                extra["rung"] = rung
+                extra["rung_target"] = rung_target
+                extra["rung_depth"] = rung_depth
             if lane_start is not None:
                 extra["shard"] = int(lane_start[i]) // per
             t = int(ids[i])
@@ -1290,10 +1267,14 @@ class VerifyTile(Tile):
                        dict(extra, traces=ids, engine=self._engine_key))
 
     def _account_compile(self, engine: str, seconds: float) -> None:
-        rec = flight.record_compile(engine, seconds)
+        """Book one engine (pre)compile into the tile lane. The
+        process-level flight compile record was already appended by the
+        fd_engine registry's warm pass — this mirror is the per-tile
+        accounting (compile counters + the boot flight event)."""
+        hit = flight.compile_cache_hit_est(seconds)
         self.fl.inc("compile_cnt")
         self.fl.inc("compile_ns", int(seconds * 1e9))
-        if rec["cache_hit_est"]:
+        if hit:
             self.fl.inc("compile_cache_hit")
         self.flightrec.record("compile", engine=engine,
                               s=round(seconds, 3))
@@ -1749,7 +1730,14 @@ class VerifyTile(Tile):
                 self._feed_slot = slot
             seq_before = il.seq
             n = self._stager_drain(slot)
-            if slot.n_lane >= self.batch:
+            # fd_engine rung target: the scheduler's pick (staged lanes
+            # + ring backlog + deadline slack) bounds the batch this
+            # slot fills toward; self.batch with the scheduler off. Low
+            # offered load makes a small rung "full" early (small-rung
+            # latency); a deep backlog targets the top rung (big-rung
+            # fill efficiency).
+            rung = self._sched_rung(slot)
+            if slot.n_lane >= rung:
                 self._feed_commit(slot, FLUSH_FULL)
                 idle_spins = 0
                 continue
@@ -1774,7 +1762,7 @@ class VerifyTile(Tile):
                     self._feed_commit(slot, "ring_starved")
                     continue
                 verdict = self.flush_policy.due(
-                    tempo.tickcount(), slot.n_lane, self.batch,
+                    tempo.tickcount(), slot.n_lane, rung,
                     slot.t_first, starved=True,
                     device_idle=(not self._inflight
                                  and pool.ready_cnt() == 0),
@@ -1800,6 +1788,37 @@ class VerifyTile(Tile):
             idle_spins += 1
             time.sleep(20e-6 if idle_spins <= 8 else 100e-6)
 
+    def _sched_rung(self, slot) -> int:
+        """Target rung for the batch being staged (stager thread): the
+        fd_engine scheduler's pick from staged lanes + ring backlog +
+        deadline slack, stamped on the slot for the xray batch-context
+        exemplars; self.batch with the scheduler off. Rung changes book
+        a flight `rung` event (with the decision inputs) and the
+        rung_switches counter, so a sentinel p99 win or regression can
+        be attributed to scheduling from the event trail alone."""
+        if self.rung_sched is None:
+            return self.batch
+        il = self.in_link
+        backlog = max(0, il.mcache.seq_next() - il.seq)
+        # Saturation signal: the ring backlog at half its structural
+        # cap means the producer is ahead as fast as the depth-bounded
+        # ring can express it — the scheduler drops its latency
+        # protections and goes for big-rung fill efficiency.
+        rung = self.rung_sched.pick(
+            tempo.tickcount(), slot.n_lane, slot.t_first, backlog,
+            backlog_full=backlog * 2 >= il.mcache.depth)
+        if rung != self._rung_last:
+            depth, slack, lanes = self.rung_sched.last_inputs
+            self.fl.inc("rung_switches")
+            self.fl.set_gauge("rung_cur", rung)
+            self.flightrec.record("rung", b=rung, prev=self._rung_last,
+                                  depth=depth, slack_ns=slack,
+                                  lanes=lanes)
+            self._rung_last = rung
+        slot.rung = rung
+        slot.rung_depth = self.rung_sched.last_inputs[0]
+        return rung
+
     def _feed_commit(self, slot, verdict: str = FLUSH_FULL) -> None:
         slot.flush_verdict = verdict  # fd_xray batch-context exemplars
         self._feed_slot = None
@@ -1811,14 +1830,32 @@ class VerifyTile(Tile):
         retires — the completion publishes straight out of its sidecar
         arrays (fd_frag_publish_bulk) — so the stager refills OTHER
         slots while this one verifies."""
-        if slot.n_lane < self.batch:
+        # fd_engine dispatch rung: the smallest rung covering the
+        # staged lanes (engines are compiled per rung; a partial pads
+        # up to the rung's shape). A rung whose engine is not WARM yet
+        # falls back to the always-warm primary engine rather than
+        # stalling the dispatcher on a compile.
+        rung = self.batch
+        entry = self._engine_entry
+        fn = self._verify_batch_fn
+        if self.rung_sched is not None:
+            rung = self.rung_sched.dispatch_rung(slot.n_lane)
+            if self.backend == "tpu" and rung != self.batch:
+                e = self._registry.warm_entry(
+                    self._engine_spec.with_batch(rung))
+                if e is None:
+                    rung = self.batch
+                else:
+                    entry, fn = e, e.fn
+        if slot.n_lane < rung:
             # Zero the stale tail rows exactly like _dispatch_py's pad
             # lanes (zero sig/pub/len): a previous batch's leftovers in
             # the arena must never verify — and under rlc they would
             # poison the batch equation into a permanent fallback.
-            slot.lens[slot.n_lane:] = 0
-            slot.sigs[slot.n_lane:] = 0
-            slot.pubs[slot.n_lane:] = 0
+            # Only the rows the chosen rung's engine reads need it.
+            slot.lens[slot.n_lane:rung] = 0
+            slot.sigs[slot.n_lane:rung] = 0
+            slot.pubs[slot.n_lane:rung] = 0
         out = None
         via_device = False
         c = chaos.active()
@@ -1841,11 +1878,11 @@ class VerifyTile(Tile):
                     ))
                 else:
                     jnp = self._jnp
-                    out = self._verify_batch_fn(
-                        jnp.asarray(slot.msgs),
-                        jnp.asarray(slot.lens.astype(np.int32)),
-                        jnp.asarray(slot.sigs),
-                        jnp.asarray(slot.pubs),
+                    out = fn(
+                        jnp.asarray(slot.msgs[:rung]),
+                        jnp.asarray(slot.lens[:rung].astype(np.int32)),
+                        jnp.asarray(slot.sigs[:rung]),
+                        jnp.asarray(slot.pubs[:rung]),
                     )
                 via_device = True
             except Exception as e:
@@ -1867,13 +1904,26 @@ class VerifyTile(Tile):
         self._inflight.append(_InflightBatch(
             out=out, todo=[], oversize=[False] * self.batch,
             t_dispatch=tempo.tickcount(), slot=slot, device=via_device,
+            rung=rung if self.rung_sched is not None else 0,
+            entry=entry if via_device else None,
         ))
         self.fl.inc("batches")
         self.fl.inc("lanes", slot.n_lane)
-        self.flightrec.record("dispatch", lanes=slot.n_lane,
-                              device=via_device)
+        ev = {"lanes": slot.n_lane, "device": via_device}
+        if self.rung_sched is not None:
+            # Per-rung dispatch accounting: the histogram the replay
+            # artifact carries (verify_stats.rung_hist) + the registry
+            # entry's own dispatch counters.
+            self.stat_rung_hist[rung] = self.stat_rung_hist.get(rung, 0) + 1
+            if entry is not None:
+                entry.note_dispatch(slot.n_lane)
+            ev["b"] = rung
+        self.flightrec.record("dispatch", **ev)
         self._xr_batch(slot.tsorigs, slot.n_txn, slot.flush_verdict,
-                       via_device, slot_idx=slot.idx, tlanes=slot.tlanes)
+                       via_device, slot_idx=slot.idx, tlanes=slot.tlanes,
+                       rung=rung if self.rung_sched is not None else None,
+                       rung_target=getattr(slot, "rung", 0),
+                       rung_depth=getattr(slot, "rung_depth", 0))
 
     def _verify_slot_cpu(self, slot):
         """The CPU oracle lane over a staged slot: the failover target
@@ -2557,6 +2607,13 @@ class VerifyTile(Tile):
                     self._breaker.record_success()
                 if getattr(ib.out, "used_fallback", False):
                     self.fl.inc("rlc_fallback")
+                if ib.entry is not None:
+                    # fd_engine cost model: feed the engine's service
+                    # EMA (dispatch -> clean completion wall time) so
+                    # the rung scheduler's slack capping tracks the
+                    # device instead of a guess.
+                    ib.entry.note_service(
+                        tempo.tickcount() - ib.t_dispatch)
             if ib.slot is not None:
                 # fd_feed batch: verdicts + publishes straight off the
                 # slot's sidecar arrays (one bulk native call).

@@ -297,6 +297,43 @@ _register(
 )
 
 # --------------------------------------------------------------------------
+# fd_engine — verify-graph engine registry + latency-adaptive rung
+# scheduler (disco/engine.py; all read per run at tile/registry
+# construction, never inside traced code).
+# --------------------------------------------------------------------------
+
+_register(
+    "FD_ENGINE_LADDER", str, "8192,16384,32768",
+    "fd_engine B rung ladder (comma-separated batch sizes): the rungs "
+    "the continuous-batching scheduler picks between and the prewarm "
+    "set the registry warms. Rungs above a tile's staging batch are "
+    "dropped (arenas are sized to the batch, which always tops the "
+    "ladder); a malformed entry raises. The default matches the bench "
+    "B-sweep (fill efficiency 0.63 -> 0.76 from 8k to 32k).",
+)
+_register(
+    "FD_ENGINE_SCHED", bool, True,
+    "Latency-adaptive rung scheduler on the fd_feed verify path: pick "
+    "the dispatch B from the FD_ENGINE_LADDER rungs by queue depth + "
+    "deadline slack + the registry's per-rung cost model, so low "
+    "offered load takes the small-rung latency and saturation takes "
+    "the big-rung throughput. '0' is the bisection hatch that pins "
+    "the fixed staging batch (the pre-PR-13 behavior); topologies "
+    "with fewer than two usable rungs pin it automatically.",
+)
+_register(
+    "FD_ENGINE_PREWARM", str, "background",
+    "Registry prewarm policy for the non-primary ladder rungs: "
+    "'background' compiles them on the fd_engine prewarm thread "
+    "(rung switches pick each engine up as it turns warm; a cold "
+    "rung dispatches on the primary engine meanwhile), 'sync' warms "
+    "inline at tile construction (boot pays every compile up front), "
+    "'off' skips prewarm (the scheduler effectively pins the primary "
+    "engine on device backends).",
+    choices=("background", "sync", "off"),
+)
+
+# --------------------------------------------------------------------------
 # fd_siege QUIC front-door defenses + scenario-suite knobs (disco/
 # quic_tile.py admission/shedding/quarantine, disco/siege.py swarm; all
 # read per run — the quic tile resolves them at construction).

@@ -132,6 +132,19 @@ THREAD_TABLE: Tuple[ThreadSite, ...] = (
         "rows directly",
     ),
     ThreadSite(
+        "firedancer_tpu/disco/engine.py",
+        "EngineRegistry.prewarm_ladder:self._prewarm_loop",
+        "fd_engine background prewarm: compiles the non-primary rung "
+        "ladder engines so scheduler rung switches never pay a mid-run "
+        "compile",
+        "drains the lock-guarded prewarm queue then exits (restarted "
+        "on the next prewarm_ladder call); stop_prewarm Event-stops + "
+        "joins it",
+        "touches only the registry's lock-guarded entry map and jax "
+        "compile state, never workspace rows — no leave-guard "
+        "interaction by construction",
+    ),
+    ThreadSite(
         "firedancer_tpu/disco/xray.py", "AutopsyFlusher.start:self._loop",
         "fd_xray alert-time autopsy writer (sentinel poll() only "
         "enqueues; this thread bundles exemplars + waterfall + "
@@ -218,6 +231,21 @@ WRITER_TABLE: Dict[str, Tuple[str, ...]] = {
     "xray.span_ctx": ("firedancer_tpu/disco/tiles.py",),
     "xray.ring": ("firedancer_tpu/disco/tiles.py",
                   "firedancer_tpu/disco/quic_tile.py"),
+    # fd_engine registry rows (disco/engine.py): the entry map is
+    # lock-guarded and mutated only inside the registry module
+    # (acquire/_build/_warm, foreground callers and the prewarm thread
+    # alike go through it); an EngineEntry's build/compile fields
+    # change only under the entry's own build lock, and its dispatch
+    # counters + service EMA are written by the single dispatching
+    # tile thread that owns the engine at runtime. The tile-side rung
+    # scheduler state (RungScheduler instance, rung_hist,
+    # rung_switches/rung_cur lane slots) belongs to the owning
+    # VerifyTile (stager picks, dispatcher books).
+    "engine.EngineRegistry._entries": ("firedancer_tpu/disco/engine.py",),
+    "engine.EngineEntry.build_fields": ("firedancer_tpu/disco/engine.py",),
+    "engine.EngineEntry.dispatch_counters": (
+        "firedancer_tpu/disco/tiles.py",),
+    "engine.RungScheduler": ("firedancer_tpu/disco/tiles.py",),
     # fd_sentinel SLO rows: one sentinel per run, in the runner
     # process, is the single writer.
     "SLO_EVALS": ("firedancer_tpu/disco/sentinel.py",),

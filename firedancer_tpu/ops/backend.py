@@ -47,24 +47,16 @@ def use_pallas(env_var: str) -> bool:
 
 
 def default_verify_mode() -> str:
-    """Verify-tile mode when the config says 'auto' (round-6 RLC
-    promotion): 'rlc' — batch RLC verification over the VMEM Pallas
-    Pippenger MSM (ops/verify_rlc.py), one shared doubling chain per
-    batch with exact per-lane fallback — on TPU platforms; 'direct'
-    per-lane on host-jax backends (no VMEM engine to amortize, and the
-    CPU-jax RLC graph is a CI/parity path, not a production one).
-    FD_VERIFY_MODE forces either explicitly; an unrecognized value is
-    an error, not a silent fall-through to the platform default (a
-    typo'd force must never masquerade as a measurement of the mode
-    the operator asked for)."""
-    forced = flags.get_raw("FD_VERIFY_MODE")
-    if forced:
-        if forced not in ("rlc", "direct"):
-            raise ValueError(
-                f"unknown FD_VERIFY_MODE {forced!r} (want rlc|direct)"
-            )
-        return forced
-    return "rlc" if _platform_is_tpu() else "direct"
+    """Verify-tile mode when the config says 'auto': a pure fd_engine
+    registry lookup since PR 13 — disco/engine.py owns every
+    engine-resolution decision (this delegation stays because ops-layer
+    callers spell it backend.default_verify_mode, and the platform
+    probe itself still lives here as _platform_is_tpu)."""
+    from firedancer_tpu.disco.engine import (
+        default_verify_mode as _engine_default,
+    )
+
+    return _engine_default()
 
 
 def kernel_mul_impl() -> str:

@@ -109,6 +109,29 @@ def validate_entry(rec: dict) -> List[str]:
         if not isinstance(rec.get("unit"), str):
             errs.append(f"'{metric}' record missing 'unit'")
     errs.extend(_validate_xray(rec.get("xray")))
+    errs.extend(_validate_rung_hist(rec.get("rung_hist")))
+    return errs
+
+
+def _validate_rung_hist(h) -> List[str]:
+    """Shape of the optional fd_engine rung histogram (None is valid —
+    scheduler-off runs / legacy lines; a present block must map
+    str(B) -> dispatched-batch count so fd_report and the sentinel
+    attribution can read it without guessing types)."""
+    if h is None:
+        return []
+    if not isinstance(h, dict) or not h:
+        return ["'rung_hist' must be a non-empty object or null"]
+    errs: List[str] = []
+    for k, v in h.items():
+        if not isinstance(k, str) or not k.isdigit() or int(k) <= 0:
+            errs.append(f"'rung_hist' key {k!r} is not a positive "
+                        "batch-size string")
+            break
+        if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
+            errs.append(f"'rung_hist[{k}]' must be a positive int, "
+                        f"got {v!r}")
+            break
     return errs
 
 
@@ -140,6 +163,70 @@ def _validate_xray(x) -> List[str]:
                 errs.append(
                     "'xray.top_slowest' entries need trace/lat_ns/stages")
                 break
+    return errs
+
+
+# fd_engine scheduler-profile artifact shape (the engine_smoke lane's
+# record: synthetic load profiles driven through the RungScheduler with
+# latencies read off flight edge histograms — the PR-13 acceptance
+# surface). The rung histogram is the load-bearing block: it is what
+# lets a p99 story be attributed to scheduling.
+_ENGINE_REQUIRED = {
+    "value": (int, float),       # saturation throughput ratio vs fixed-B
+    "unit": str,
+    "ok": bool,
+    "ladder": list,
+    "low_load": dict,            # {p99_ns_le_sched, p99_ns_le_fixed, ...}
+    "saturation": dict,          # {throughput_sched, throughput_fixed, ...}
+}
+
+
+def validate_engine(rec: dict) -> List[str]:
+    """Shape errors for one fd_engine scheduler-profile artifact
+    ([] = valid)."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return ["artifact is not a JSON object"]
+    if rec.get("metric") != "engine_sched_profile":
+        errs.append(f"metric must be engine_sched_profile, got "
+                    f"{rec.get('metric')!r}")
+    sv = rec.get("schema_version")
+    if not isinstance(sv, int) or isinstance(sv, bool) \
+            or sv < SCHEMA_VERSION_MIN:
+        errs.append(f"schema_version must be an int >= "
+                    f"{SCHEMA_VERSION_MIN}, got {sv!r}")
+    ts = rec.get("ts")
+    if not isinstance(ts, str) or "T" not in ts:
+        errs.append(f"missing/odd ISO 'ts': {ts!r}")
+    for key, typ in _ENGINE_REQUIRED.items():
+        v = rec.get(key)
+        if v is None or not isinstance(v, typ) \
+                or (isinstance(v, bool) and typ is not bool):
+            errs.append(f"'{key}' missing or not {typ}: {v!r}")
+    h = rec.get("rung_hist")
+    if h is None:
+        errs.append("'rung_hist' block required in an engine artifact")
+    else:
+        errs.extend(_validate_rung_hist(h))
+    lad = rec.get("ladder")
+    if isinstance(lad, list) and (
+            not lad or any(not isinstance(b, int) or b <= 0
+                           for b in lad)
+            or lad != sorted(lad)):
+        errs.append(f"'ladder' must be an ascending list of positive "
+                    f"batch sizes, got {lad!r}")
+    for block, need in (("low_load", ("p99_ns_le_sched",
+                                     "p99_ns_le_fixed")),
+                        ("saturation", ("throughput_sched",
+                                        "throughput_fixed"))):
+        d = rec.get(block)
+        if isinstance(d, dict):
+            for k in need:
+                v = d.get(k)
+                if not isinstance(v, (int, float)) \
+                        or isinstance(v, bool) or v <= 0:
+                    errs.append(f"'{block}.{k}' missing or not a "
+                                f"positive number: {v!r}")
     return errs
 
 

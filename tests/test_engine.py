@@ -1,0 +1,374 @@
+"""fd_engine — engine registry + latency-adaptive rung scheduler.
+
+Three layers, matching the subsystem's pieces: EngineSpec/registry unit
+tests (key round-trips, get-or-create caching, host-mode entries,
+ladder parsing), RungScheduler property tests (the PR-13 acceptance
+invariants: a partial batch is NEVER starved past the deadline —
+AdaptiveFlush's bound, inherited verbatim — and rung selection is
+monotone non-decreasing in queue depth), and a pipeline-level test
+that the scheduler changes WHEN batches ship but never WHAT the sink
+receives (bit-exact digests across any rung sequence vs fixed-B).
+"""
+
+import os
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from firedancer_tpu.disco import engine as fd_engine
+from firedancer_tpu.disco.engine import (
+    ENGINE_WARM,
+    EngineRegistry,
+    EngineSpec,
+    RungScheduler,
+)
+from firedancer_tpu.disco.feed.policy import (
+    FLUSH_DEADLINE,
+    FLUSH_FULL,
+    FLUSH_STARVED,
+)
+# ------------------------------------------------------------- specs -----
+
+
+def test_engine_spec_key_roundtrip():
+    spec = EngineSpec("rlc", 32768, 2, "pallas")
+    assert spec.key == "rlc:B32768:shards2:fepallas"
+    assert fd_engine.parse_key(spec.key) == spec
+    assert spec.with_batch(8192).key == "rlc:B8192:shards2:fepallas"
+
+
+def test_engine_spec_for_tile_matches_flight_convention():
+    from firedancer_tpu.disco import flight
+
+    # Device backends key on the resolved mode, host backends on the
+    # backend name — the engine_key convention fd_flight introduced.
+    assert (EngineSpec.for_tile("tpu", "rlc", 8192, 0).key
+            == flight.engine_key("rlc", 8192, 0,
+                                 fd_engine.current_frontend()))
+    assert (EngineSpec.for_tile("cpu", "direct", 128, 0).key
+            == flight.engine_key("cpu", 128, 0,
+                                 fd_engine.current_frontend()))
+
+
+def test_parse_key_rejects_junk():
+    for junk in ("", "rlc", "rlc:8192:shards0:feauto",
+                 "rlc:B8192:0:feauto", "rlc:B8192:shards0"):
+        with pytest.raises(ValueError):
+            fd_engine.parse_key(junk)
+
+
+def test_resolution_has_one_owner():
+    """The tiles/backend spellings are re-exports of the registry
+    module's resolver — one authority, no drift possible."""
+    from firedancer_tpu.disco import tiles
+    from firedancer_tpu.ops import backend
+
+    assert tiles.resolve_verify_mode is fd_engine.resolve_verify_mode
+    # backend.default_verify_mode delegates (same result either way).
+    assert backend.default_verify_mode() == fd_engine.default_verify_mode()
+
+
+# ----------------------------------------------------------- registry ----
+
+
+def test_registry_entry_caching_and_host_modes():
+    reg = EngineRegistry()
+    spec = EngineSpec("cpu", 128)
+    a = reg.entry(spec)
+    b = reg.entry(spec)
+    assert a is b
+    # Host engines have no graph to compile: born WARM, acquire never
+    # claims to have warmed anything.
+    assert a.state == ENGINE_WARM
+    entry, warmed_now = reg.acquire(spec)
+    assert entry is a and warmed_now is False
+    assert reg.entry(EngineSpec("cpu", 256)) is not a
+
+
+def test_registry_entry_analytic_cost_model():
+    reg = EngineRegistry()
+    from firedancer_tpu import msm_plan
+
+    e = reg.entry(EngineSpec("rlc", 8192))
+    assert e.fill_efficiency == pytest.approx(
+        msm_plan.fill_efficiency(8192)["total"])
+    big = reg.entry(EngineSpec("rlc", 32768))
+    # The analytic model the scheduler trades on: fill efficiency is
+    # monotone in B (the bench-measured 0.63 -> 0.76 shape).
+    assert big.fill_efficiency > e.fill_efficiency
+    assert reg.entry(EngineSpec("direct", 8192)).fill_efficiency is None
+
+
+def test_registry_service_ema_and_snapshot():
+    reg = EngineRegistry()
+    e = reg.entry(EngineSpec("cpu", 128))
+    assert e.service_est_ns() == 0  # unmeasured: never capped on
+    e.note_service(8_000_000)
+    assert e.service_est_ns() == 8_000_000
+    e.note_service(16_000_000)
+    assert 8_000_000 < e.service_est_ns() < 16_000_000
+    e.note_dispatch(100)
+    snap = reg.snapshot()
+    assert len(snap) == 1 and snap[0]["dispatches"] == 1
+    assert snap[0]["key"] == e.key and snap[0]["state"] == ENGINE_WARM
+
+
+def test_registry_prewarm_policy_validates():
+    reg = EngineRegistry()
+    with pytest.raises(ValueError):
+        reg.prewarm_ladder([EngineSpec("cpu", 128)], policy="bogus")
+    # 'off' and host-mode 'sync' are both no-ops that must not spawn
+    # threads or raise.
+    reg.prewarm_ladder([EngineSpec("cpu", 128)], policy="off")
+    reg.prewarm_ladder([EngineSpec("cpu", 128)], policy="sync")
+    assert reg.prewarm_idle()
+
+
+def test_registry_prewarm_background_drains_and_restarts():
+    """The background thread drains the queue to idle (host specs:
+    no compile), stop_prewarm drops anything queued and joins, and a
+    later prewarm_ladder call starts a FRESH thread — the running-flag
+    handoff is lock-coupled, so specs can never be enqueued behind a
+    thread that already chose to die."""
+    import time as _time
+
+    reg = EngineRegistry()
+    for round_ in range(2):   # second round exercises the restart
+        reg.prewarm_ladder([EngineSpec("cpu", 128 + round_)],
+                           policy="background")
+        deadline = _time.monotonic() + 10.0
+        while not reg.prewarm_idle():
+            assert _time.monotonic() < deadline, "prewarm never drained"
+            _time.sleep(0.01)
+        reg.stop_prewarm()
+        assert reg.prewarm_idle()
+
+
+def test_registry_account_first_call_marks_shape_warm():
+    """The bench path (acquire unwarmed + real-input first call) must
+    leave the executed shape registered, so a later warm acquire at
+    the SAME shape cannot re-warm and double-book the compile."""
+    reg = EngineRegistry()
+    e = reg.entry(EngineSpec("cpu", 128))
+    e.account_first_call(2.0, msg_len=64)
+    assert e.state == ENGINE_WARM and e.compile_s == 2.0
+    assert not e.cache_hit_est            # 2 s is no cache hit
+    assert (128, 64) in e._warmed
+
+
+# ------------------------------------------------------------- ladder ----
+
+
+def test_rung_ladder_default_and_filters(monkeypatch):
+    assert fd_engine.rung_ladder() == [8192, 16384, 32768]
+    assert fd_engine.rung_ladder(cap=16384) == [8192, 16384]
+    assert fd_engine.rung_ladder(cap=128, floor=19) == []
+    monkeypatch.setenv("FD_ENGINE_LADDER", "64, 32,128,64")
+    assert fd_engine.rung_ladder() == [32, 64, 128]
+    monkeypatch.setenv("FD_ENGINE_LADDER", "32,abc")
+    with pytest.raises(ValueError):
+        fd_engine.rung_ladder()
+    monkeypatch.setenv("FD_ENGINE_LADDER", "0,32")
+    with pytest.raises(ValueError):
+        fd_engine.rung_ladder()
+
+
+# ---------------------------------------------------------- scheduler ----
+
+LADDER = (8192, 16384, 32768)
+DEADLINE = 25_000_000
+
+
+def test_scheduler_ctor_validates():
+    with pytest.raises(ValueError):
+        RungScheduler([], DEADLINE)
+    with pytest.raises(ValueError):
+        RungScheduler([0, 8192], DEADLINE)
+    with pytest.raises(ValueError):
+        RungScheduler(LADDER, 0)  # AdaptiveFlush's own deadline check
+
+
+def test_scheduler_monotone_rung_up_in_depth():
+    """The acceptance property: for a fixed slack, the picked rung is
+    non-decreasing in queue depth — deeper queues can only rung UP."""
+    s = RungScheduler(LADDER, DEADLINE)
+    rng = np.random.RandomState(0xE1)
+    for slack in (None, DEADLINE, DEADLINE // 4, 0):
+        prev = 0
+        for depth in sorted(int(rng.randint(0, 200_000))
+                            for _ in range(200)):
+            rung = s.pick_rung(depth, slack_ns=slack)
+            assert rung >= prev, (depth, slack)
+            prev = rung
+        # and the endpoints are exact
+        assert s.pick_rung(0, slack_ns=slack) == LADDER[0]
+    assert s.pick_rung(10**9) == LADDER[-1]
+
+
+def test_scheduler_slack_caps_rung():
+    """A rung whose measured service estimate exceeds the staged
+    batch's remaining deadline budget cannot meet the deadline: the
+    pick steps down. Unmeasured rungs (cost 0) are never capped."""
+    cost = {8192: 5_000_000, 16384: 10_000_000, 32768: 40_000_000}
+    s = RungScheduler(LADDER, DEADLINE, cost_ns=lambda r: cost[r])
+    deep = 10**6
+    assert s.pick_rung(deep, slack_ns=DEADLINE) == 16384  # 40ms > 25ms
+    assert s.pick_rung(deep, slack_ns=7_000_000) == 8192
+    assert s.pick_rung(deep, slack_ns=None) == 32768      # no slack info
+    # floor: even with no budget left, the smallest rung is picked
+    # (the DEADLINE verdict then ships it immediately).
+    assert s.pick_rung(deep, slack_ns=0) == 8192
+    # unmeasured rungs are never capped down
+    s0 = RungScheduler(LADDER, DEADLINE, cost_ns=lambda r: 0)
+    assert s0.pick_rung(deep, slack_ns=1) == 32768
+
+
+def test_scheduler_saturation_bypass_lifts_slack_cap():
+    """The ring-full signal: a depth-bounded ring cannot express
+    big-rung backlog in txn counts, so backlog_full lifts depth to the
+    top rung and drops the slack cap — at saturation no rung meets the
+    deadline and big-rung fill efficiency is the whole game."""
+    cost = {8192: 5_000_000, 16384: 10_000_000, 32768: 40_000_000}
+    s = RungScheduler(LADDER, DEADLINE, cost_ns=lambda r: cost[r])
+    assert s.pick(1_000_000, 2000, 500_000, 3000,
+                  backlog_full=True) == 32768
+    # same state without the signal stays latency-protected
+    assert s.pick(1_000_000, 2000, 500_000, 3000) == 8192
+
+
+def test_scheduler_dispatch_rung_covers_lanes():
+    s = RungScheduler(LADDER, DEADLINE)
+    assert s.dispatch_rung(0) == 8192
+    assert s.dispatch_rung(8192) == 8192
+    assert s.dispatch_rung(8193) == 16384
+    assert s.dispatch_rung(40_000) == 32768  # top rung bounds all
+
+
+def test_scheduler_never_starves_past_deadline():
+    """The AdaptiveFlush invariant, inherited verbatim: whatever rung
+    sequence the queue-depth schedule drives, a staged partial batch
+    observed past its deadline ALWAYS flushes — clock stutters, depth
+    spikes and rung switches included."""
+    rng = np.random.RandomState(0x5EED)
+    for trial in range(50):
+        deadline = int(rng.randint(1_000, 50_000_000))
+        s = RungScheduler(LADDER, deadline)
+        first = int(rng.randint(0, 1 << 40))
+        lanes = int(rng.randint(1, 32_768))
+        # arbitrary pre-deadline polls with arbitrary depths/backlogs
+        for _ in range(int(rng.randint(0, 8))):
+            t = first + int(rng.randint(0, deadline))
+            s.decide(t, min(lanes, 8191), first,
+                     int(rng.randint(0, 100_000)),
+                     starved=bool(rng.randint(2)),
+                     device_idle=bool(rng.randint(2)),
+                     backpressured=bool(rng.randint(2)))
+        late = first + deadline + int(rng.randint(0, 1 << 30))
+        verdict, rung = s.decide(
+            late, lanes, first, int(rng.randint(0, 100_000)),
+            starved=bool(rng.randint(2)),
+            device_idle=bool(rng.randint(2)),
+            backpressured=bool(rng.randint(2)),
+        )
+        assert rung in LADDER
+        assert verdict in (FLUSH_DEADLINE, FLUSH_FULL), trial
+        if verdict == FLUSH_DEADLINE:
+            # a backward clock jump after an OBSERVED expiry cannot
+            # un-expire it (AdaptiveFlush's hwm hardening, inherited;
+            # a FULL verdict returns before the hwm sees the clock)
+            verdict2, _ = s.decide(first + 1, min(lanes, 8191), first, 0)
+            assert verdict2 in (FLUSH_DEADLINE, FLUSH_FULL)
+
+
+def test_scheduler_starved_early_out_and_switch_tracking():
+    s = RungScheduler(LADDER, DEADLINE)
+    # low load: tiny depth -> smallest rung; starved+idle flushes after
+    # the debounce instead of burning the full deadline
+    v, rung = s.decide(1_000_000 + s.flush.starve_ns, 100, 1_000_000, 0,
+                       starved=True, device_idle=True)
+    assert rung == 8192 and v == FLUSH_STARVED
+    switches0 = s.switches
+    # a deep backlog rungs up, and the switch is counted exactly once
+    v, rung = s.decide(2_000_000, 100, 1_000_000, 200_000)
+    assert rung == 32768 and s.switches == switches0 + 1
+    v, rung = s.decide(2_100_000, 100, 1_000_000, 200_000)
+    assert rung == 32768 and s.switches == switches0 + 1
+
+
+# ----------------------------------------------------------- pipeline ----
+
+
+def _corpus(n=96, seed=5):
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+
+    return mainnet_corpus(
+        n=n, seed=seed, dup_rate=0.1, corrupt_rate=0.06,
+        parse_err_rate=0.04, sign_batch_size=128, max_data_sz=140,
+    )
+
+
+def _native_ready() -> bool:
+    from firedancer_tpu.ballet.ed25519 import native as ed_native
+    from firedancer_tpu.tango.rings import feed_abi_ok, native_available
+
+    return native_available() and feed_abi_ok() and ed_native.available()
+
+
+@pytest.mark.skipif(not _native_ready(),
+                    reason="needs the native ring + ed25519 libs")
+def test_rung_scheduler_sink_digests_bit_exact(tmp_path, monkeypatch):
+    """The acceptance gate: whatever rung sequence the scheduler
+    drives, the sink receives EXACTLY the fixed-B content (bit-exact
+    digest multiset) — scheduling changes when batches ship, never
+    what verifies."""
+    from firedancer_tpu.disco.corpus import expected_sink_digests
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    monkeypatch.setenv("FD_ENGINE_LADDER", "32,64,128")
+    corpus = _corpus()
+    results = {}
+    for name, sched in (("sched", "1"), ("fixed", "0")):
+        monkeypatch.setenv("FD_ENGINE_SCHED", sched)
+        topo = build_topology(str(tmp_path / f"{name}.wksp"), depth=256)
+        results[name] = run_pipeline(
+            topo, corpus.payloads, verify_backend="cpu",
+            verify_batch=128, timeout_s=240.0,
+            record_digests=True, feed=True,
+        )
+    want = expected_sink_digests(corpus)
+    assert Counter(results["sched"].sink_digests) == want
+    assert Counter(results["fixed"].sink_digests) == want
+    # scheduler accounting: the sched run reports its ladder + per-rung
+    # dispatch histogram; the fixed run reports the off-shape.
+    vs = results["sched"].verify_stats[0]
+    assert vs["rung_ladder"] == [32, 64, 128]
+    assert vs["rung_hist"] and sum(vs["rung_hist"].values()) \
+        == vs["batches"]
+    assert set(vs["rung_hist"]) <= {"32", "64", "128"}
+    assert vs["rung_cur"] in (32, 64, 128)
+    off = results["fixed"].verify_stats[0]
+    assert off["rung_hist"] == {} and off["rung_ladder"] == []
+    assert off["rung_switches"] == 0
+
+
+@pytest.mark.skipif(not _native_ready(),
+                    reason="needs the native ring + ed25519 libs")
+def test_rung_scheduler_default_ladder_is_inert_at_small_batch(
+        tmp_path):
+    """With the production 8k/16k/32k ladder and a small test batch,
+    no rung fits under the batch cap -> the scheduler pins off and the
+    run is byte-identical to the pre-PR-13 feeder (the default-config
+    safety property every existing test leans on)."""
+    assert os.environ.get("FD_ENGINE_LADDER") is None
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    corpus = _corpus(n=48, seed=11)
+    topo = build_topology(str(tmp_path / "inert.wksp"), depth=256)
+    res = run_pipeline(
+        topo, corpus.payloads, verify_backend="cpu", verify_batch=128,
+        timeout_s=240.0, record_digests=True, feed=True,
+    )
+    vs = res.verify_stats[0]
+    assert vs["rung_ladder"] == [] and vs["rung_hist"] == {}
+    assert vs["rung_cur"] == 0

@@ -437,7 +437,11 @@ def test_queue_sample_stride_zero_clamps(tmp_path, monkeypatch):
     il = InLink(wksp, _link_names(topo.pod, "replay_verify"),
                 edge="replay_verify")
     assert il.xq_every == 1
-    il.dwell_sample(123)          # no ZeroDivisionError, observes
+    # Pass the hoisted clock explicitly: with now=0 the sampled dwell
+    # is (tickcount32 - 123) mod 2^32, which lands past the ~4 s
+    # wrap-artifact rejection for ~7% of wall-clock instants — a
+    # time-dependent flake, not a sampling property.
+    il.dwell_sample(123, now=124)  # no ZeroDivisionError, observes
     assert il.xq.hist.count() == 1
 
 
