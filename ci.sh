@@ -173,6 +173,21 @@ echo "== fused front-end smoke (CPU, interpret-kernel arithmetic) =="
 # cached compile — same opt-in as the kernel test tier).
 JAX_PLATFORMS=cpu python scripts/fused_smoke.py
 
+echo "== Montgomery-batched decompress smoke (CPU, PR-14 engines) =="
+# The batched decompress gate: kernel-body arithmetic (in-tile
+# prefix-product tree + squaring ladder + vectorized masks — what
+# pallas interpret executes) bit-exact vs the staged per-lane-chain
+# oracle AND the python oracle on a mixed B=1024 batch with planted
+# zero/torsion/non-canonical lanes; the FD_DECOMPRESS_IMPL dispatch
+# and 1024-multiple eligibility contract (fallbacks bit-exact, typos
+# raise); the fdcert certificate must carry the new decompress-block
+# and canonicalizer proofs with zero violations; and the
+# stage-attribution record (decompress_batched / analytic
+# decompress_inversions == 2B/64 / certified sched) must validate
+# under bench_log_check's stage_ms schema with the batched engine
+# measurably ahead of the staged one.
+JAX_PLATFORMS=cpu python scripts/decompress_smoke.py
+
 echo "== fuzz smoke (10k iters/target) =="
 python fuzz/run_fuzz.py --iters 10000
 

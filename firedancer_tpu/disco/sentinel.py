@@ -35,7 +35,7 @@ This module is the judgment layer, in three parts:
                rolling best-of baseline. scripts/fd_report.py renders
                per-mode/per-B/per-stage trend reports from it.
 
-  PREDICTION   the nine ROOFLINE.md falsifiable predictions for the
+  PREDICTION   the ten ROOFLINE.md falsifiable predictions for the
   LEDGER       next hardware run (BENCH_r06), each with a MACHINE-
                CHECKABLE match rule over the timeline: the ledger lists
                every prediction as pending until a matching artifact
@@ -166,6 +166,12 @@ STAGE_BUDGETS_MS: Dict[str, float] = {
     "msm": 8.5,          # B=16k K=32 per 8192-equiv
     "total": 20.5,       # => >= 400k/s
 }
+
+# The PR-14 Montgomery-batched decompress raises the bar below the
+# round-10 budget (prediction 7 keeps grading the 5.0 ms budget; this
+# one grades the batched engine specifically — ROADMAP direction 4's
+# "<= 2.5 ms and a raised ladder headline").
+DECOMPRESS_BATCHED_BUDGET_MS = 2.5
 
 THROUGHPUT_GATES: Dict[str, Dict[str, object]] = {
     "verify_device": {
@@ -765,7 +771,7 @@ def siege_status(timeline: List[TimelineEntry]) -> List[dict]:
 
 
 # --------------------------------------------------------------------------
-# The prediction ledger: the nine ROOFLINE.md falsifiable predictions,
+# The prediction ledger: the ten ROOFLINE.md falsifiable predictions,
 # each with a machine-checkable match rule over the timeline. A rule
 # matches only schema_version >= 2, on-device, non-stale records — the
 # fused-front-end era — so the pre-round-10 history can neither confirm
@@ -895,6 +901,22 @@ def _check_p9(timeline):
     return "pending", None, None
 
 
+def _check_p10(timeline):
+    for e in _sv2_verify(timeline, "rlc"):
+        sm = e.rec.get("stage_ms") or {}
+        v = sm.get("decompress")
+        if v is None or not sm.get("decompress_batched"):
+            continue
+        inv = sm.get("decompress_inversions")
+        verdict = ("confirmed"
+                   if float(v) <= DECOMPRESS_BATCHED_BUDGET_MS
+                   else "falsified")
+        return (verdict,
+                f"stage_ms.decompress = {float(v):.2f} ms batched "
+                f"(analytic inversions {inv})", e.source)
+    return "pending", None, None
+
+
 @dataclass(frozen=True)
 class Prediction:
     pid: int
@@ -956,6 +978,13 @@ PREDICTIONS: Tuple[Prediction, ...] = (
                "b_sweep_measured covering 8192/16384/32768 — strictly "
                "increasing in B",
                _check_p9),
+    Prediction(10, "Montgomery-batched decompress <= 2.5 ms/8192",
+               "stage_ms.decompress <= 2.5 ms with decompress_batched: "
+               "true (one fe_invert chain per 64 of the 2B stacked "
+               "lanes)",
+               "first sv>=2 device rlc record whose stage_ms has "
+               "decompress_batched: true — decompress <= 2.5 ms",
+               _check_p10),
 )
 
 

@@ -137,9 +137,45 @@ _register(
 )
 _register(
     "FD_DECOMPRESS_IMPL", str, "auto",
-    "Point-decompress backend: pallas (fused sqrt-chain kernel with "
-    "niels emission) | xla | auto (pallas iff TPU).",
-    trace_time=True, choices=("auto", "xla", "pallas"),
+    "Point-decompress backend: pallas (Montgomery-batched VMEM kernel "
+    "with niels emission) | xla (the cache-blocked batched host "
+    "graph) | interpret (the kernels under the Pallas interpreter — "
+    "CPU CI parity) | auto (pallas iff TPU). Shapes an engine cannot "
+    "serve fall back bit-exactly to the staged per-lane-chain "
+    "composition: the host graph needs whole 1024-lane blocks, the "
+    "kernel path folds whole padded 512-lane tiles (sub-tile batches "
+    "take the staged chain).",
+    trace_time=True, choices=("auto", "xla", "pallas", "interpret"),
+)
+_register(
+    "FD_DECOMPRESS_BATCH", int, 6,
+    "log2 of the Montgomery inversion group in the batched decompress "
+    "(lanes per fe_invert chain; 6 = one chain per 64 lanes, the "
+    "2B -> 2B/64 analytic inversion-count drop recorded in bench "
+    "artifacts). 0 disables the batched math entirely — the staged "
+    "per-lane power-chain path runs (the A/B bisection hatch).",
+    trace_time=True,
+)
+_register(
+    "FD_DECOMPRESS_SQ_SCHED", str, "auto",
+    "Squaring schedule for the decompress ladder's 252 repeated "
+    "squarings on the XLA path: l3 (lean scatter-add construction, "
+    "lazy-reduction depth 3), l4 (lean, full 4-pass carry), f32 "
+    "(exact-f32-product half-triangle). auto = l3, the certifier-"
+    "gated search winner (scripts/fe_schedule_search.py); every "
+    "choice here is fdcert-proved int32-wrap-free — rejected "
+    "candidates (int32x2 wraps, f32fold leaves the mantissa-exact "
+    "window) are not registrable.",
+    trace_time=True, choices=("auto", "l3", "l4", "f32"),
+)
+_register(
+    "FD_DECOMPRESS_CHUNK", int, 1024,
+    "Lane-block width the batched decompress host graph serializes "
+    "through lax.map (cache-blocking: the ~252-squaring ladder's "
+    "working set stays L2-resident — measured 2.9x the flat graph's "
+    "per-squaring rate on the CI host). 0 = one block over the whole "
+    "batch. Kernel path ignores this (VMEM tiles are the blocks).",
+    trace_time=True,
 )
 _register(
     "FD_FRONTEND_IMPL", str, "auto",

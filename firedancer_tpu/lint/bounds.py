@@ -30,7 +30,12 @@ Entry contracts are declared next to the code as a module-level
     }
 
 Input spec grammar (see _make_input):
-    limbs:<rows>:<bound>   (rows, 1) int32, |limb| <= bound
+    limbs:<rows>:<bound>[:<lanes>]
+                           (rows, lanes=1) int32, |limb| <= bound
+                           (lanes > 1 exercises lane-axis idioms: the
+                           Montgomery prefix-product tree's pair
+                           reshapes and half-split sweeps)
+    mask:<rows>:<lanes>    (rows, lanes) int32 in {0, 1}
     bytes:<cols>           (1, cols) uint8 in [0, 255]
     bytes2:<rows>:<cols>   (rows, cols) uint8 (batched byte matrix)
     blocks:<n>:<bound>     (n*SUB, 1) int32 in [0, bound] (fold layout)
@@ -75,6 +80,7 @@ CERT_MODULES = (
     "firedancer_tpu/ops/fe25519.py",
     "firedancer_tpu/ops/sc25519.py",
     "firedancer_tpu/ops/frontend_pallas.py",
+    "firedancer_tpu/ops/decompress_pallas.py",
 )
 
 # Lane limits. F32_WINDOW is the mantissa-exact integer window: every
@@ -311,6 +317,25 @@ class Abs:
         if self.lo.min() >= 0 and self.hi.max() <= 1:
             return _checked(1 - self.hi, 1 - self.lo, self.dtype)
         raise CertError(RULE_UNPROVABLE, "~ outside the {0,1} lattice")
+
+    def __xor__(self, other):
+        # {0,1} lattice xor, element-precise where both sides are
+        # decided (the decompress sign fix-up `parity ^ sign` idiom;
+        # the arithmetic spelling a+b-2ab books [-2, 2] and poisons
+        # the downstream _sel01 mask proof).
+        lo2, hi2, _ = _as_interval(other)
+        if (self.lo.min() < 0 or self.hi.max() > 1
+                or lo2.min() < 0 or hi2.max() > 1):
+            raise CertError(RULE_UNPROVABLE, "^ outside the {0,1} lattice")
+        fixed = (self.lo == self.hi) & (lo2 == hi2)
+        v = self.lo ^ lo2
+        shape = np.broadcast_shapes(self.lo.shape, lo2.shape)
+        z = np.zeros(shape, object)
+        lo = np.where(fixed, v, 0) + z
+        hi = np.where(fixed, v, 1) + z
+        return _checked(lo, hi, self.dtype)
+
+    __rxor__ = __xor__
 
     def __rshift__(self, k):
         k = int(k)
@@ -550,6 +575,98 @@ def _unprovable_fn(name):
     return fn
 
 
+# -- inductive fori_loop transfer (PR 14) ----------------------------------
+# A loop body is provable iff it admits an inductive interval invariant:
+# widen the carry by joining successive abstract iterates; once
+# body(J) ⊆ J, every concrete iterate (any trip count) stays inside J,
+# so J is a sound bound for the loop result. The loop index is passed as
+# the FULL [lower, upper-1] interval — a body that uses i arithmetically
+# is still covered. This is what makes the repeated-squaring ladders
+# (fe_sqn_sched, the _pow_ladder sqn runs) and therefore fe_invert /
+# fe_pow22523 / the Montgomery prefix-product tree certifiable.
+
+_FORI_WIDEN_MAX = 12
+
+
+def _iv_join(a, b):
+    if isinstance(a, (tuple, list)):
+        if not isinstance(b, type(a)) or len(a) != len(b):
+            raise CertError(RULE_UNPROVABLE,
+                            "fori_loop carry pytree shape changed")
+        return type(a)(_iv_join(x, y) for x, y in zip(a, b))
+    alo, ahi, _ = _as_interval(a)
+    blo, bhi, _ = _as_interval(b)
+    dtype = (a.dtype if isinstance(a, Abs)
+             else b.dtype if isinstance(b, Abs) else "int32")
+    return Abs(np.minimum(alo, blo), np.maximum(ahi, bhi), dtype)
+
+
+def _iv_contains(outer, inner) -> bool:
+    if isinstance(outer, (tuple, list)):
+        return (isinstance(inner, type(outer))
+                and len(outer) == len(inner)
+                and all(_iv_contains(o, i)
+                        for o, i in zip(outer, inner)))
+    olo, ohi, _ = _as_interval(outer)
+    ilo, ihi, _ = _as_interval(inner)
+    if olo.shape != ilo.shape:
+        return False
+    return bool(np.all(olo <= ilo) and np.all(ohi >= ihi))
+
+
+def _shim_fori_loop(lower, upper, body, init):
+    lower_i, upper_i = int(lower), int(upper)
+    if upper_i <= lower_i:
+        return init
+    idx = Abs(np.asarray(lower_i, object),
+              np.asarray(upper_i - 1, object), "int32")
+    inv = init
+    for _ in range(_FORI_WIDEN_MAX):
+        out = body(idx, inv)
+        if _iv_contains(inv, out):
+            return inv
+        inv = _iv_join(inv, out)
+    raise CertError(
+        RULE_UNPROVABLE,
+        "fori_loop body reached no inductive interval invariant after "
+        f"{_FORI_WIDEN_MAX} widening rounds — the carry grows every "
+        "iteration (a lazy-reduction depth too shallow to be "
+        "ladder-closed fails exactly here)",
+    )
+
+
+# -- precise per-function transfers (applied by name after module load) ----
+# _sel01(m, a, b) = m*a + (1-m)*b with m in {0,1} selects one of a/b
+# exactly; the hull of the branches is therefore a TIGHT sound bound,
+# where the raw interval product books m*a in [0, hi(a)] and the sum in
+# [0, hi(a)+hi(b)] (the retired _canonicalize_k 803-vs-255 gap).
+
+
+def _transfer_sel01(m, a, b):
+    mlo, mhi, _ = _as_interval(m)
+    if mlo.min() < 0 or mhi.max() > 1:
+        raise CertError(
+            RULE_UNPROVABLE,
+            "_sel01 mask is not provably {0,1} — the precise select "
+            f"transfer does not apply (mask in [{int(mlo.min())}, "
+            f"{int(mhi.max())}])",
+        )
+    alo, ahi, _ = _as_interval(a)
+    blo, bhi, _ = _as_interval(b)
+    dtype = (a.dtype if isinstance(a, Abs)
+             else b.dtype if isinstance(b, Abs) else "int32")
+    shape = np.broadcast_shapes(mlo.shape, alo.shape, blo.shape)
+    z = np.zeros(shape, object)
+    lo = np.minimum(alo + z, blo + z)
+    hi = np.maximum(ahi + z, bhi + z)
+    return _checked(lo, hi, dtype)
+
+
+_PRECISE_TRANSFERS = {
+    "_sel01": _transfer_sel01,
+}
+
+
 def _broadcasted_iota(dtype, shape, dim):
     n = shape[dim]
     view = [1] * len(shape)
@@ -578,13 +695,14 @@ def make_shims() -> Tuple[SimpleNamespace, SimpleNamespace]:
         broadcast_to=_shim_broadcast_to,
         all=_shim_all,
         full=_shim_full,
+        abs=lambda x: abs(x) if isinstance(x, Abs) else np.abs(x),
         minimum=_unprovable_fn("jnp.minimum"),
         maximum=_unprovable_fn("jnp.maximum"),
         dot=_unprovable_fn("jnp.dot"),
     )
     lax = SimpleNamespace(
         broadcasted_iota=_broadcasted_iota,
-        fori_loop=_unprovable_fn("lax.fori_loop"),
+        fori_loop=_shim_fori_loop,
         scan=_unprovable_fn("lax.scan"),
         cond=_unprovable_fn("lax.cond"),
         while_loop=_unprovable_fn("lax.while_loop"),
@@ -661,10 +779,15 @@ def _extract_sub(root: str) -> int:
 def _make_input(spec: str, sub: int):
     kind, _, rest = spec.partition(":")
     if kind == "limbs":
-        rows_s, _, bound_s = rest.partition(":")
-        rows, bound = int(rows_s), int(bound_s)
-        lo = np.full((rows, 1), -bound, object)
-        hi = np.full((rows, 1), bound, object)
+        parts = rest.split(":")
+        rows, bound = int(parts[0]), int(parts[1])
+        # Optional lane count (limbs:<rows>:<bound>:<lanes>) — the
+        # prefix-product tree idiom reshapes/pairs along the lane
+        # axis, so its abstract input needs real width to exercise
+        # the fold/sweep dataflow (default stays 1).
+        lanes = int(parts[2]) if len(parts) > 2 else 1
+        lo = np.full((rows, lanes), -bound, object)
+        hi = np.full((rows, lanes), bound, object)
         return Abs(lo, hi, "int32")
     if kind == "bytes":
         cols = int(rest)
@@ -680,6 +803,11 @@ def _make_input(spec: str, sub: int):
         n, bound = int(n_s), int(bound_s)
         return Abs(np.zeros((n * sub, 1), object),
                    np.full((n * sub, 1), bound, object), "int32")
+    if kind == "mask":
+        rows_s, _, lanes_s = rest.partition(":")
+        rows, lanes = int(rows_s), int(lanes_s)
+        return Abs(np.zeros((rows, lanes), object),
+                   np.full((rows, lanes), 1, object), "int32")
     if kind == "digest_state":
         word = lambda: Abs(np.zeros((sub, 1), object),  # noqa: E731
                            np.full((sub, 1), (1 << 32) - 1, object),
@@ -734,11 +862,20 @@ def certify_module(
     # Certification must be environment-independent: the runtime belt
     # (concrete-operand checks) stays off while Abs operands drive the
     # bodies, and trace-time impl selectors take their defaults.
-    _pinned = ("FD_FE_DEBUG_BOUNDS", "FD_CANON_IMPL")
+    _pinned = ("FD_FE_DEBUG_BOUNDS", "FD_CANON_IMPL",
+               "FD_DECOMPRESS_SQ_SCHED", "FD_DECOMPRESS_BATCH",
+               "FD_DECOMPRESS_CHUNK", "FD_DECOMPRESS_IMPL")
     saved = {k: os.environ.pop(k) for k in _pinned if k in os.environ}
     try:
         try:
             g = load_abstract_module(path, externs)
+            # Swap in the precise per-function transfers (by name):
+            # contract bodies resolve these through the module globals
+            # at call time, and the extracted namespace handed to
+            # later CERT_MODULES carries the same override.
+            for _name, _impl in _PRECISE_TRANSFERS.items():
+                if _name in g:
+                    g[_name] = _impl
         except CertError as e:
             out.append(Violation(
                 rule=e.rule, path=rpath, line=_fault_line(path),
@@ -833,6 +970,10 @@ def _default_externs(root: str, done: Dict[str, dict]) -> Dict[str, dict]:
             "_pack_schedule": _stub("_pack_schedule"),
             "_sha512_rounds": _stub("_sha512_rounds"),
             "_vmem_estimate": _stub("_vmem_estimate"),
+        },
+        "firedancer_tpu/ops/decompress_pallas.py": {
+            "fe": SimpleNamespace(**fe_ns) if fe_ns else _stub("fe"),
+            "flags": real_flags,
         },
     }
     return ext

@@ -60,22 +60,17 @@ def decompress_xla(y_bytes: jnp.ndarray, want_x_zero: bool = False):
 
 def decompress_auto(y_bytes: jnp.ndarray, want_x_zero: bool = False,
                     want_niels: bool = False):
-    """Backend-dispatched decompress: fused Pallas kernel on TPU
-    (ops/curve_pallas.py), the XLA graph elsewhere. want_x_zero=True
-    appends an x==0-mod-p lane mask (in-VMEM on the kernel path; a
-    canonicalize chain on the XLA path), meaningful only for ok lanes
-    (see decompress_xla). want_niels (kernel path only) appends the
-    (yp, ym, t2d, t2dn) niels-form arrays for the MSM fills."""
-    from .backend import use_pallas
+    """Backend-dispatched decompress — since PR 14 a thin delegate to
+    decompress_pallas.decompress_batched_auto (FD_DECOMPRESS_IMPL =
+    auto|pallas|xla|interpret): the Montgomery-batched kernels/graph
+    on eligible shapes, the staged per-lane-chain composition
+    otherwise, bit-exact. want_x_zero appends the x==0-mod-p lane
+    mask; want_niels (kernel path only) appends the (yp, ym, t2d,
+    t2dn) niels-form arrays for the MSM fills."""
+    from .decompress_pallas import decompress_batched_auto
 
-    if use_pallas("FD_DECOMPRESS_IMPL"):
-        from .curve_pallas import decompress_pallas
-
-        return decompress_pallas(y_bytes, want_x_zero=want_x_zero,
-                                 want_niels=want_niels)
-    if want_niels:
-        raise ValueError("want_niels requires the kernel backend")
-    return decompress_xla(y_bytes, want_x_zero)
+    return decompress_batched_auto(y_bytes, want_x_zero=want_x_zero,
+                                   want_niels=want_niels)
 
 
 def small_order_mask(p):
@@ -100,19 +95,14 @@ def point_eq_affine_xla(aff, proj):
 
 
 def decompress_so_auto(y_bytes: jnp.ndarray):
-    """Decompress + small-order lane mask, backend-dispatched. On the
-    kernel path the mask is computed in-VMEM on the just-decompressed
-    point (3 doublings, no extra HBM traffic); failed lanes carry the
-    identity poison and so read small_order=True — callers must gate on
-    ok first (the verify status ladder does)."""
-    from .backend import use_pallas
+    """Decompress + small-order lane mask, backend-dispatched (the
+    batched engines compute the mask on the just-decompressed point
+    while it is VMEM/cache-resident). Failed lanes carry the identity
+    poison and so read small_order=True — callers must gate on ok
+    first (the verify status ladder does)."""
+    from .decompress_pallas import decompress_batched_auto
 
-    if use_pallas("FD_DECOMPRESS_IMPL"):
-        from .curve_pallas import decompress_pallas
-
-        return decompress_pallas(y_bytes, want_small_order=True)
-    pt, ok = decompress_xla(y_bytes)
-    return pt, ok, small_order_mask(pt)
+    return decompress_batched_auto(y_bytes, want_small_order=True)
 
 
 def point_eq_affine_auto(aff, proj):

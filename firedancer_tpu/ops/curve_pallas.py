@@ -120,6 +120,27 @@ def _decompress_kernel(yin, sign, consts, ox, oy, oz, ot, ook, oxz):
 def _decompress_body(yin, sign, consts, ox, oy, oz, ot, ook, oxz):
     y = yin[...]
     lanes = y.shape[1]
+    # PR 14: the Montgomery-batched body (one invert chain per
+    # FD_DECOMPRESS_BATCH-group via the in-tile half-split tree, a
+    # pure-squaring ladder for the sqrt ratio) replaces the per-lane
+    # pow22523 chain whenever the tile can fold; FD_DECOMPRESS_BATCH=0
+    # or a narrow test tile keeps the staged chain below — decided at
+    # trace time like every *_IMPL selector, bit-exact either way.
+    from .decompress_pallas import (
+        _decompress_batched_body,
+        use_batched_kernel,
+    )
+
+    if use_batched_kernel(lanes):
+        x, yv, z, t, ok, xz = _decompress_batched_body(
+            y, sign[...], consts)
+        ox[...] = x
+        oy[...] = yv
+        oz[...] = z
+        ot[...] = t
+        ook[...] = ok
+        oxz[...] = xz
+        return ok
     d_c = jnp.broadcast_to(consts[:, 0:1], (NLIMBS, lanes))
     sqrtm1 = jnp.broadcast_to(consts[:, 1:2], (NLIMBS, lanes))
     one = (jax.lax.broadcasted_iota(jnp.int32, (NLIMBS, lanes), 0) == 0)
@@ -182,6 +203,9 @@ def decompress_pallas(y_bytes: jnp.ndarray, interpret: bool = False,
         if want_niels:
             raise ValueError("want_niels requires a kernel-tile batch")
         if want_small_order:
+            if want_x_zero:
+                pt, ok, xz = ge.decompress_xla(y_bytes, True)
+                return pt, ok, xz, ge.small_order_mask(pt)
             pt, ok = ge.decompress_xla(y_bytes)
             return pt, ok, ge.small_order_mask(pt)
         return ge.decompress_xla(y_bytes, want_x_zero)

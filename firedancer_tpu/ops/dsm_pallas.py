@@ -60,7 +60,7 @@ def _fe_sq(a):
 
     impl = kernel_mul_impl()
     if impl == "rolled" and not use_specialized_square():
-        # Probe finding (kernel_probe3): fe_sq's 528-product half-
+        # Probe finding (kernel_probe.py --suspect align, r5): fe_sq's 528-product half-
         # triangle is MOVEMENT-bound (~fe_mul cost despite half the
         # products) — rolled(a, a) and fe_sq measure within noise of
         # each other, so FD_SQ_IMPL picks (A/B'd at the DSM level).

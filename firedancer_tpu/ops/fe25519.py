@@ -86,24 +86,59 @@ FDCERT_CONTRACTS = {
                    "doc": "exact-f32-product conv; window <= 2^23"},
     "fe_sq_f32": {"inputs": ["limbs:32:512"], "out_abs": 512,
                   "doc": "exact-f32 half-triangle; window <= 2^23"},
-    # Canonicalizers: bytes-boundary reductions. The Kogge-Stone forms
-    # end in an arithmetic lane select (keep*a + (1-keep)*b) the
-    # interval domain over-approximates to [0, 510]; digits are
-    # canonical [0, 255] at runtime (the seq twin proves the tight
-    # bound for the identical math).
+    # Canonicalizers: bytes-boundary reductions. Their conditional
+    # subtracts route through the named _sel01 arithmetic select, which
+    # the certifier replaces with its precise hull transfer (m proven
+    # in {0,1} -> result in hull(a, b)). That retires the PR-8
+    # 803/765 interval-product over-approximation: the seq form now
+    # proves the runtime-canonical 255 exactly; the Kogge-Stone form
+    # proves 255 + 38 = 293 — the one residual gap is the final KS
+    # round's carry-out (x38 on limb 0), which is 0 at runtime but
+    # undecidable in a non-relational interval domain.
     "_canonicalize": {"inputs": ["limbs:32:1024"], "out_abs": 255,
                       "doc": "sequential ripple + cond-subtract p"},
     "_canonicalize_k_seq": {"inputs": ["limbs:32:16777216"],
-                            "out_abs": 765,
+                            "out_abs": 255,
                             "doc": "kernel-safe ripple form (2^24 in)"},
-    "_canonicalize_k": {"inputs": ["limbs:32:16777216"], "out_abs": 803,
-                        "doc": "Kogge-Stone form (2^24 in)"},
+    "_canonicalize_k": {"inputs": ["limbs:32:16777216"], "out_abs": 293,
+                        "doc": "Kogge-Stone form (2^24 in); 255 + one "
+                               "undecidable 38-weighted carry-out"},
     "fe_is_zero_k": {"inputs": ["limbs:32:16777216"], "out_abs": 1,
                      "doc": "canonical-zero mask"},
     "fe_parity_k": {"inputs": ["limbs:32:16777216"], "out_abs": 1,
                     "doc": "canonical parity bit"},
     "fe_from_bytes": {"inputs": ["bytes2:1:32"], "out_abs": 255,
                       "doc": "byte unpack (+ high-bit mask)"},
+    # Lean XLA-graph squaring schedules (the Montgomery-batched
+    # decompress ladder; scripts/fe_schedule_search.py sweeps this
+    # space and only certified+parity-clean points become flag
+    # choices). fe_sq_l3 deliberately exceeds the |limb| <= 512
+    # public-op invariant: it is closed under its OWN contract, and
+    # fe_sqn_sched's fori body is proved by the inductive-invariant
+    # transfer before one closing carry pass restores the invariant.
+    "fe_sq_l4": {"inputs": ["limbs:32:1024"], "out_abs": 512,
+                 "doc": "lean schedule (scatter-add conv), full carry"},
+    "fe_sq_l3": {"inputs": ["limbs:32:1024"], "out_abs": 521,
+                 "doc": "lean schedule, lazy depth 3 — ladder-only "
+                        "(521 > 512: outside the public-op invariant, "
+                        "closed under its own contract)"},
+    "fe_sqn_sched": {"inputs": ["limbs:32:512", "int:252"],
+                     "out_abs": 512,
+                     "doc": "z^(2^252) ladder: the fori body maps "
+                            "[-512, 512] into itself (inductive "
+                            "invariant), so the chain needs no "
+                            "closing reduction"},
+    # Power chains + the grouped Montgomery inversion tree (the
+    # prefix-product idiom): provable since the fori_loop inductive
+    # transfer landed — sqn's body maps the invariant into itself.
+    "fe_invert": {"inputs": ["limbs:32:1024"], "out_abs": 512,
+                  "doc": "z^(p-2) addition chain"},
+    "fe_pow22523": {"inputs": ["limbs:32:1024"], "out_abs": 512,
+                    "doc": "z^((p-5)/8) addition chain"},
+    "fe_invert_batch": {"inputs": ["limbs:32:512:8"], "out_abs": 512,
+                        "doc": "grouped Montgomery prefix-product "
+                               "tree + backward sweep (8 abstract "
+                               "lanes, 3 tree levels)"},
 }
 
 # d = -121665/121666 mod p (twisted Edwards constant), sqrt(-1) mod p.
@@ -155,6 +190,17 @@ def _carry_pass(x: jnp.ndarray, passes: int) -> jnp.ndarray:
         hi = x >> LIMB_BITS  # arithmetic shift: exact for signed limbs
         x = lo + jnp.concatenate([38 * hi[NLIMBS - 1:], hi[:NLIMBS - 1]], axis=0)
     return x
+
+
+def _sel01(m: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Arithmetic lane select: m in {0, 1} -> m*a + (1-m)*b (the
+    kernel-safe select every canonicalizer ends in — Mosaic-friendly,
+    no jnp.where). Named so the bounds certifier can replace it with
+    its precise transfer function (result = hull(a, b) when m is a
+    proven {0,1} mask) instead of the interval-product over-
+    approximation that used to book _canonicalize_k at 803 when the
+    runtime digits are canonical 255 (the PR-8 table note)."""
+    return m * a + (1 - m) * b
 
 
 def fe_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -291,7 +337,7 @@ def fe_mul_karatsuba(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 def fe_mul_rolled(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """fe_mul_unrolled with the sublane-rotation count cut 32 -> 7.
 
-    Round-5 probe finding (scripts/kernel_probe3.py, v5e): a plain
+    Round-5 probe finding (scripts/kernel_probe.py --suspect align, v5e): a plain
     mul+add on a (32, 1024) tile costs ~2.2 ns, but the same op reading
     a sublane-MISALIGNED slice costs ~23 ns, and fe_mul_unrolled's 32
     bext[32-i : 64-i] slices are misaligned for every i not = 0 mod 8 —
@@ -534,6 +580,82 @@ def fe_sq(a: jnp.ndarray) -> jnp.ndarray:
     return _carry_pass(c, 4)
 
 
+def _sq_conv_lean(a: jnp.ndarray) -> jnp.ndarray:
+    """fe_sq's half-triangle convolution + 38-fold in the LEAN op
+    schedule: scatter-adds (dynamic-update-slice) instead of
+    zeros+concat pads, and ONE stack+reshape interleave instead of 32
+    single-row concats — ~2x fewer XLA ops than fe_sq's construction
+    at identical arithmetic. XLA-graph only (scatter/stack/reshape are
+    not in the Mosaic-safe primitive set fe_sq restricts itself to);
+    this is the schedule the Montgomery-batched decompress ladder
+    spends ~250 squarings per batch in, where op dispatch — not
+    multiplies — dominates the host-side cost (see
+    scripts/fe_schedule_search.py for the measured sweep)."""
+    batch = a.shape[1:]
+    ad = a + a
+    ev = a * a                                  # d=0: a_q^2 at k=2q
+    for e in range(1, NLIMBS // 2):             # d = 2e
+        ev = ev.at[e:NLIMBS - e].add(a[: NLIMBS - 2 * e] * ad[2 * e:])
+    od = jnp.zeros((NLIMBS - 1,) + batch, jnp.int32)
+    for e in range(NLIMBS // 2):                # d = 2e + 1
+        od = od.at[e:NLIMBS - 1 - e].add(
+            a[: NLIMBS - 1 - 2 * e] * ad[2 * e + 1:])
+    half = NLIMBS // 2
+    ce = ev[:half] + 38 * ev[half:]
+    co = od[:half] + 38 * jnp.concatenate(
+        [od[half:], jnp.zeros((1,) + batch, jnp.int32)], axis=0)
+    return jnp.stack([ce, co], axis=1).reshape((NLIMBS,) + batch)
+
+
+def fe_sq_l4(a: jnp.ndarray) -> jnp.ndarray:
+    """Lean-schedule squaring, full 4-pass carry: bit-exact fe_sq at
+    the same |limb| <= 1024 -> <= 512 contract (fdcert re-proves it on
+    the lean dataflow independently)."""
+    return _carry_pass(_sq_conv_lean(a), 4)
+
+
+def fe_sq_l3(a: jnp.ndarray) -> jnp.ndarray:
+    """Lean-schedule squaring at lazy-reduction depth 3 — one carry
+    pass fewer than the public-op invariant needs, sound ONLY inside a
+    repeated-squaring ladder: the output bound (see FDCERT_CONTRACTS)
+    can exceed 512 but re-enters this function's own input contract,
+    so chains of fe_sq_l3 are closed under it (the fdcert fori_loop
+    inductive transfer proves exactly that containment). Do NOT feed
+    the result to the f32 kernels (|limb| <= 512 there). Depth 2 and
+    the f32-fold variant are certifier-REJECTED points of the same
+    search space — scripts/fe_schedule_search.py keeps the receipts."""
+    return _carry_pass(_sq_conv_lean(a), 3)
+
+
+_SQ_SCHEDULES = {
+    "l3": fe_sq_l3,
+    "l4": fe_sq_l4,
+    "f32": fe_sq_f32,
+}
+
+
+def fe_sq_sched():
+    """The FD_DECOMPRESS_SQ_SCHED-selected ladder squaring (trace
+    time). 'auto' is the schedule-search winner on this image: l3
+    (lean construction, lazy depth 3). Every registered choice is
+    fdcert-certified; rejected candidates never get a flag value."""
+    from firedancer_tpu import flags
+
+    sched = flags.get_str("FD_DECOMPRESS_SQ_SCHED", "auto")
+    return _SQ_SCHEDULES.get(sched, fe_sq_l3)
+
+
+def fe_sqn_sched(z: jnp.ndarray, n: int) -> jnp.ndarray:
+    """z^(2^n) by n repeated squarings of the flag-selected lean
+    schedule, rolled through lax.fori_loop so the traced graph stays
+    one squaring body regardless of n (the decompress ladder's n=252
+    would otherwise unroll ~28k ops). The fori body is certified by
+    the fdcert inductive transfer: one abstract iteration must map the
+    input interval into itself."""
+    sq = fe_sq_sched()
+    return jax.lax.fori_loop(0, n, lambda i, v: sq(v), z)
+
+
 def fe_mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
     """Multiply by a small nonneg python int k < 2^17.
 
@@ -619,7 +741,7 @@ def _canonicalize_k_seq(x: jnp.ndarray) -> jnp.ndarray:
     for _ in range(2):
         d, borrow = _seq_carry_k(lo - p_col)
         keep = (borrow < 0).astype(jnp.int32)              # (1, *batch)
-        lo = keep * lo + (1 - keep) * d
+        lo = _sel01(keep, lo, d)
     return lo
 
 
@@ -707,7 +829,7 @@ def _canonicalize_k(x: jnp.ndarray) -> jnp.ndarray:
     for _ in range(2):
         sub, borrow = _ks_borrow_sub_k(d, p_col)
         keep = borrow                          # borrow==1 -> d < p: keep
-        d = keep * d + (1 - keep) * sub
+        d = _sel01(keep, d, sub)
     return d
 
 

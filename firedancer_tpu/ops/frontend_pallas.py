@@ -386,3 +386,28 @@ def frontend_rlc_auto(msgs: jnp.ndarray, lengths: jnp.ndarray,
     h_bytes = sc.sc_reduce64_auto(sha512_batch_auto(msgs, lengths))
     m_bytes, zs = staged_coeff_muls(z_bytes, h_bytes, s_bytes)
     return h_bytes, m_bytes, zs
+
+
+# PR 14: the stacked (A, R) point decompression is part of the verify
+# front half — bytes -> validated extended coordinates, Montgomery-
+# batched, VMEM-resident on the kernel path — so its engine dispatch
+# lives behind this module's surface next to the scalar dispatch
+# (FD_DECOMPRESS_IMPL mirrors FD_FRONTEND_IMPL's auto|pallas|xla|
+# interpret shape). verify_batch_rlc routes its decompress here; the
+# direct path takes the whole-front-half composition below.
+from .decompress_pallas import (  # noqa: E402  (re-export, post-defs)
+    decompress_batched_auto as frontend_decompress_auto,
+)
+
+
+def frontend_direct_auto(msgs: jnp.ndarray, lengths: jnp.ndarray,
+                         ar_bytes: jnp.ndarray):
+    """The ENTIRE direct-mode verify front half in one dispatch:
+    h = SHA-512(msgs) mod L through the fused kernel when active and
+    eligible, plus the stacked (A, R) Montgomery-batched decompress
+    with its in-engine small-order mask. Returns (h_bytes, ar_pt,
+    ar_ok, ar_so) — everything verify_batch needs before the DSM."""
+    h_bytes = sha512_mod_l_auto(msgs, lengths)
+    ar_pt, ar_ok, ar_so = frontend_decompress_auto(
+        ar_bytes, want_small_order=True)
+    return h_bytes, ar_pt, ar_ok, ar_so

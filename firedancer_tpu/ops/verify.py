@@ -23,7 +23,7 @@ from . import sc25519 as sc
 # Top-level, not trace-time: frontend_pallas transitively materializes
 # sha512/sign's module-scope jnp constants; importing inside the traced
 # body would leak tracers into those globals on the first call.
-from .frontend_pallas import sha512_mod_l_auto
+from .frontend_pallas import frontend_direct_auto
 
 FD_ED25519_SUCCESS = 0
 FD_ED25519_ERR_SIG = -1
@@ -76,8 +76,15 @@ def verify_batch(
     # reject small-order A (ERR_PUBKEY) / R (ERR_SIG), and compare
     # h*(-A)+s*B against the DECODED R as group elements — which also
     # deletes the compress inversion chain from the graph.
+    # The verify front half as ONE dispatch (ops/frontend_pallas.py):
+    # h = SHA-512(r || pub || msg) mod L through the fused kernel when
+    # active and eligible, and the stacked (A, R) Montgomery-batched
+    # decompress (one inversion chain per FD_DECOMPRESS_BATCH group,
+    # small-order mask computed while the points are engine-resident).
     ar = jnp.concatenate([pubkeys, r_bytes], axis=0)       # (2B, 32)
-    ar_pt, ar_ok, ar_so = ge.decompress_so_auto(ar)
+    hash_in = jnp.concatenate([r_bytes, pubkeys, msgs], axis=1)
+    h_bytes, ar_pt, ar_ok, ar_so = frontend_direct_auto(
+        hash_in, msg_lengths.astype(jnp.int32) + 64, ar)
     a_point = tuple(c[:, :bsz] for c in ar_pt)
     rd_point = tuple(c[:, bsz:] for c in ar_pt)
     pub_ok = ar_ok[:bsz]
@@ -85,15 +92,6 @@ def verify_batch(
     a_small = ar_so[:bsz]
     r_small = ar_so[bsz:]
     neg_a = ge.point_neg(a_point)
-
-    # h = SHA-512(r || pub || msg) mod L. One batched hash over the
-    # concatenated buffer; lengths shift by the 64-byte prefix. The
-    # fused front-end (ops/frontend_pallas.py) chains the Barrett
-    # reduction onto the compression in VMEM when active and the shape
-    # is eligible; otherwise the staged sha512_batch_auto +
-    # sc_reduce64_auto composition runs as before.
-    hash_in = jnp.concatenate([r_bytes, pubkeys, msgs], axis=1)
-    h_bytes = sha512_mod_l_auto(hash_in, msg_lengths.astype(jnp.int32) + 64)
 
     r_prime = _dsm_auto()(h_bytes, neg_a, s_bytes)
     # Rd is affine (decompress emits Z=1): projective cross-compare.

@@ -80,7 +80,7 @@ from . import sc25519 as sc
 # Top-level, not trace-time: frontend_pallas transitively materializes
 # sha512/sign's module-scope jnp constants; importing inside the traced
 # body would leak tracers into those globals on the first call.
-from .frontend_pallas import frontend_rlc_auto
+from .frontend_pallas import frontend_decompress_auto, frontend_rlc_auto
 from .verify import (
     FD_ED25519_ERR_PUBKEY,
     FD_ED25519_ERR_SIG,
@@ -208,7 +208,10 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits,
 
     want_niels = (on_tpu and use_pallas("FD_DECOMPRESS_IMPL")
                   and 2 * bsz >= MIN_KERNEL_BATCH)
-    dec = ge.decompress_auto(
+    # Engine dispatch lives with the rest of the front half
+    # (frontend_pallas): the Montgomery-batched decompress on eligible
+    # shapes, staged composition otherwise — bit-exact either way.
+    dec = frontend_decompress_auto(
         jnp.concatenate([pubkeys, r_bytes], axis=0),
         want_niels=want_niels,
     )

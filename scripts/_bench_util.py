@@ -1,7 +1,7 @@
 """Shared on-chip timing helper for the probe scripts.
 
 One dispatch-then-block methodology for every probe
-(profile_stages / decompress_probe / mxu_probe), so a fix to the
+(profile_stages / kernel_probe / mxu_probe), so a fix to the
 timing discipline lands everywhere at once. The host pull
 (np.asarray of one leaf) defeats any tunnel-side dispatch laziness —
 block_until_ready alone mis-measured ~0.02 ms for a 250-square chain

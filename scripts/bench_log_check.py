@@ -110,6 +110,46 @@ def validate_entry(rec: dict) -> List[str]:
             errs.append(f"'{metric}' record missing 'unit'")
     errs.extend(_validate_xray(rec.get("xray")))
     errs.extend(_validate_rung_hist(rec.get("rung_hist")))
+    errs.extend(_validate_stage_ms(rec.get("stage_ms")))
+    return errs
+
+
+# Pinned to scripts/profile_stages.STAGE_KEYS (this validator stays
+# stdlib-only, so the tuple is restated; tests/test_decompress_batch.py
+# pins the two against each other).
+_STAGE_KEYS = ("sha", "decompress", "sc", "rlc_combine", "msm", "glue")
+
+
+def _validate_stage_ms(sm) -> List[str]:
+    """Shape of the optional per-stage attribution block (None is
+    valid — FD_BENCH_STAGE_ATTRIB=0 runs / legacy lines). A present
+    block must carry every STAGE_KEYS entry + total as numbers and
+    the fused marker, plus the PR-14 decompress attribution fields
+    (engine-resolved batched flag, the ANALYTIC inversion count the
+    2B -> 2B/64 Montgomery drop is gated on, and the certified ladder
+    schedule) when they are present."""
+    if sm is None:
+        return []
+    if not isinstance(sm, dict):
+        return ["'stage_ms' must be an object or null"]
+    errs: List[str] = []
+    for k in _STAGE_KEYS + ("total",):
+        v = sm.get(k)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            errs.append(f"'stage_ms.{k}' missing or not a number: {v!r}")
+    if not isinstance(sm.get("fused"), bool):
+        errs.append("'stage_ms.fused' missing or not a bool")
+    if "decompress_batched" in sm \
+            and not isinstance(sm["decompress_batched"], bool):
+        errs.append("'stage_ms.decompress_batched' must be a bool")
+    if "decompress_inversions" in sm:
+        v = sm["decompress_inversions"]
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append("'stage_ms.decompress_inversions' must be a "
+                        "non-negative int")
+    if "decompress_sched" in sm \
+            and not isinstance(sm["decompress_sched"], str):
+        errs.append("'stage_ms.decompress_sched' must be a string")
     return errs
 
 

@@ -1,0 +1,294 @@
+"""Montgomery-batched decompress CPU smoke lane (ci.sh, PR 14).
+
+The batched decompress (ops/decompress_pallas.py) is the default
+engine behind curve25519.decompress_auto on every eligible shape. This
+lane keeps it honest on every CI run:
+
+  1. KERNEL-BODY parity (always, seconds): the exact arithmetic the
+     VMEM kernel executes — _decompress_batched_body (in-tile
+     half-split Montgomery tree + the pow_pallas squaring ladder +
+     vectorized masks) — run eagerly as jax ops (precisely what
+     pallas interpret mode lowers to) over a mixed B=1024 batch with
+     planted edge lanes (y == +-1 in all three byte encodings, the
+     order-4 y=0 point, torsion points, corrupted non-points),
+     bit-exact vs the staged per-lane-chain oracle AND the per-lane
+     python oracle.
+  2. DISPATCH/ELIGIBILITY contract: FD_DECOMPRESS_IMPL typos raise at
+     the registry; B=1 / non-1024-multiple batches fall back to the
+     staged composition bit-exactly; FD_DECOMPRESS_BATCH=0 disables
+     the batched math; the analytic inversion count is 2B/64 exactly
+     when batched and 2B when staged.
+  3. FDCERT drift gate on the NEW contracts: the committed
+     lint_bounds_cert.json must carry the decompress module's entries
+     (full-block proof included) and the retired canonicalizer
+     over-approximation, and the live certifier must prove the tree
+     with zero violations/waivers.
+  4. BENCH ARTIFACT schema: stage_attribution's record must carry the
+     decompress_batched / decompress_inversions / decompress_sched
+     fields and validate under scripts/bench_log_check's stage_ms
+     gate; a staged-vs-batched A/B at the smoke shape must show the
+     batched engine ahead (the 8192-lane measurement lives in
+     docs/ROOFLINE.md — this is the regression tripwire, not the
+     headline).
+
+  FD_RUN_PALLAS_TESTS=1 additionally runs the REAL pallas_call
+  interpret path at B=1024 (the same opt-in the kernel test tier
+  uses).
+
+Exits nonzero with a JSON error line on any divergence.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+B = 1024
+P = 2**255 - 19
+# Regression tripwire, not the headline (that is the B=8192 3.07x in
+# docs/ROOFLINE.md): best-of-two steady-state at this small smoke shape
+# measures ~1.4x, and a batched engine that lost its edge reads ~1.0.
+SPEEDUP_MIN = 1.2
+
+
+def _fail(err, **kw):
+    print(json.dumps({"lane": "decompress_smoke", "ok": False,
+                      "error": err, **kw}))
+    return 1
+
+
+def _mixed_batch(np, oracle):
+    """(B, 32) uint8: random candidates + planted edge lanes."""
+    rng = np.random.RandomState(7)
+    yb = rng.randint(0, 256, (B, 32), dtype=np.uint8)
+
+    def enc(val, sign=0):
+        b = bytearray((val % 2**256).to_bytes(32, "little"))
+        b[31] |= sign << 7
+        return np.frombuffer(bytes(b), np.uint8)
+
+    yb[0] = enc(1)                  # x == 0, ok
+    yb[1] = enc(P - 1)              # x == 0 via -1
+    yb[2] = enc(P + 1)              # non-canonical +1 encoding
+    yb[3] = enc(1, sign=1)          # x == 0 with the sign bit set
+    yb[4] = enc(0)                  # order-4 torsion point (y = 0)
+    yb[5] = enc(0, sign=1)
+    # an order-8 torsion point: y of 8-torsion from the oracle's
+    # curve arithmetic (compress a small-order point if one decodes).
+    for cand in range(2, 50):
+        pt = oracle.point_decompress(bytes(enc(cand)))
+        if pt is not None and oracle.is_small_order(pt):
+            yb[6] = enc(cand)
+            break
+    # valid curve points: compress multiples of the basepoint.
+    pt = oracle.B
+    for i in range(7, 64):
+        yb[i] = np.frombuffer(oracle.point_compress(pt), np.uint8)
+        pt = oracle.point_add(pt, oracle.B)
+    return yb
+
+
+def main() -> int:
+    t0 = time.perf_counter()
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                       os.path.expanduser("~/.cache/jax_smoke")),
+    )
+
+    from firedancer_tpu import flags
+    from firedancer_tpu.ballet.ed25519 import oracle
+    from firedancer_tpu.ops import curve25519 as ge
+    from firedancer_tpu.ops import decompress_pallas as dp
+    from firedancer_tpu.ops import fe25519 as fe
+
+    yb_np = _mixed_batch(np, oracle)
+    yb = jnp.asarray(yb_np)
+
+    # -- 1a. batched XLA graph vs the staged oracle, bit-exact --------
+    os.environ["FD_DECOMPRESS_BATCH"] = "0"
+    pt_s, ok_s, so_s = jax.jit(
+        lambda y: dp.decompress_batched_auto(y, want_small_order=True)
+    )(yb)
+    os.environ.pop("FD_DECOMPRESS_BATCH", None)
+    if not dp.batch_eligible(B):
+        return _fail("B=1024 must be batched-eligible by default")
+    pt_b, ok_b, so_b = jax.jit(
+        lambda y: dp.decompress_batched_auto(y, want_small_order=True)
+    )(yb)
+    if not bool((np.asarray(ok_s) == np.asarray(ok_b)).all()):
+        return _fail("ok mask mismatch batched vs staged")
+    if not bool((np.asarray(so_s) == np.asarray(so_b)).all()):
+        return _fail("small-order mask mismatch batched vs staged")
+    for c in range(4):
+        if fe.limbs_to_int(np.asarray(pt_s[c])) != \
+                fe.limbs_to_int(np.asarray(pt_b[c])):
+            return _fail(f"coordinate {c} mismatch batched vs staged")
+
+    # -- 1b. the KERNEL BODY's arithmetic, eager (== interpret) -------
+    from firedancer_tpu.ops.curve_pallas import _const_cols
+
+    sign = (yb[:, 31] >> 7).astype(jnp.int32)[None, :]
+    ylimbs = fe.fe_from_bytes(yb, mask_high_bit=True)
+    kx, ky, kz, kt, kok, kxz = dp._decompress_batched_body(
+        ylimbs, sign, jnp.asarray(_const_cols()))
+    if not bool(((np.asarray(kok)[0] != 0) == np.asarray(ok_s)).all()):
+        return _fail("kernel-body ok mask diverges from staged oracle")
+    for name, got, want in (("x", kx, pt_s[0]), ("y", ky, pt_s[1]),
+                            ("t", kt, pt_s[3])):
+        if fe.limbs_to_int(np.asarray(got)) != \
+                fe.limbs_to_int(np.asarray(want)):
+            return _fail(f"kernel-body {name} diverges from staged")
+
+    # -- 1c. per-lane python oracle on the planted + valid lanes ------
+    ok_np = np.asarray(ok_b)
+    xs = fe.limbs_to_int(np.asarray(pt_b[0]))
+    ys = fe.limbs_to_int(np.asarray(pt_b[1]))
+    for i in range(64):
+        want = oracle.point_decompress(bytes(yb_np[i]))
+        if (want is not None) != bool(ok_np[i]):
+            return _fail(f"lane {i}: ok diverges from python oracle")
+        if want is not None and (xs[i], ys[i]) != want:
+            return _fail(f"lane {i}: point diverges from python oracle")
+
+    # -- 2. dispatch / eligibility contract ---------------------------
+    if dp.decompress_impl() != "xla":
+        return _fail("FD_DECOMPRESS_IMPL auto must resolve xla off-TPU")
+    os.environ["FD_DECOMPRESS_IMPL"] = "bogus"
+    try:
+        dp.decompress_impl()
+        return _fail("bogus FD_DECOMPRESS_IMPL did not raise")
+    except ValueError:
+        pass
+    finally:
+        os.environ.pop("FD_DECOMPRESS_IMPL", None)
+    if dp.batch_eligible(1000) or dp.batch_eligible(1) \
+            or dp.batch_eligible(0):
+        return _fail("eligibility accepted a non-1024-multiple batch")
+    if dp.inversion_count(2 * B) != (2 * B) >> 6:
+        return _fail("analytic inversion count != 2B/64 when batched")
+    os.environ["FD_DECOMPRESS_BATCH"] = "0"
+    try:
+        if dp.inversion_count(2 * B) != 2 * B:
+            return _fail("staged inversion count != 2B")
+    finally:
+        os.environ.pop("FD_DECOMPRESS_BATCH", None)
+    # odd shapes take the staged path, bit-exact
+    for odd in (1, 3, 1000):
+        pt_o, ok_o = jax.jit(dp.decompress_batched_auto)(yb[:odd])
+        pt_w, ok_w = jax.jit(ge.decompress_xla)(yb[:odd])
+        if not bool((np.asarray(ok_o) == np.asarray(ok_w)).all()):
+            return _fail(f"fallback ok mismatch at B={odd}")
+        if fe.limbs_to_int(np.asarray(pt_o[0])) != \
+                fe.limbs_to_int(np.asarray(pt_w[0])):
+            return _fail(f"fallback x mismatch at B={odd}")
+
+    # -- 3. fdcert drift gate on the new contracts --------------------
+    with open(os.path.join(REPO, "lint_bounds_cert.json")) as f:
+        cert = json.load(f)
+    dmod = cert["modules"].get("firedancer_tpu/ops/decompress_pallas.py")
+    if not dmod or "_decompress_block" not in dmod:
+        return _fail("certificate missing the decompress-block proof")
+    canon = cert["modules"]["firedancer_tpu/ops/fe25519.py"] \
+        .get("_canonicalize_k", {})
+    if canon.get("proved_out_abs", 9999) > 293:
+        return _fail("_canonicalize_k over-approximation regressed",
+                     proved=canon.get("proved_out_abs"))
+    from firedancer_tpu.lint import bounds as fdbounds
+
+    vs, _live = fdbounds.certify_all(REPO)
+    if vs:
+        return _fail("live certifier violations",
+                     violations=[v.format() for v in vs])
+
+    # -- 4. artifact schema + the A/B tripwire ------------------------
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import bench_log_check
+    from profile_stages import decompress_stage_ms
+
+    # Best-of-two measurements per engine (the bench ladder's best-of-
+    # log convention): bench_fn averages its reps, so one transient
+    # host-contention spike would otherwise eat the tripwire's margin.
+    def _best_of(n=2, **env):
+        for k, v in env.items():
+            os.environ[k] = v
+        try:
+            recs = [decompress_stage_ms(B // 2, reps=2, warmup=1)
+                    for _ in range(n)]
+        finally:
+            for k in env:
+                os.environ.pop(k, None)
+        return min(recs, key=lambda r: r["decompress_ms"])
+
+    staged = _best_of(FD_DECOMPRESS_BATCH="0")
+    batched = _best_of()
+    if not batched["decompress_batched"] or staged["decompress_batched"]:
+        return _fail("decompress_batched flag wrong in stage record",
+                     staged=staged, batched=batched)
+    if batched["decompress_inversions"] != B >> 6:
+        return _fail("artifact inversion count wrong", rec=batched)
+    rec = {
+        "metric": "ed25519_verify_throughput", "schema_version": 2,
+        "ts": "2026-08-04T00:00:00", "value": 1.0, "unit": "verifies/s",
+        "vs_baseline": 1.0, "mode": "rlc", "batch": B // 2, "reps": 1,
+        "msg_len": 64, "ms_per_batch": 1.0, "device": "cpu",
+        "rlc_fallbacks": 0,
+        "stage_ms": {"sha": 0.0, "decompress": batched["decompress_ms"],
+                     "sc": 0.0, "rlc_combine": 0.0, "msm": 0.0,
+                     "glue": 0.0, "total": 0.0, "fused": False,
+                     "decompress_batched": True,
+                     "decompress_inversions":
+                         batched["decompress_inversions"],
+                     "decompress_sched": batched["decompress_sched"]},
+    }
+    errs = bench_log_check.validate_entry(rec)
+    if errs:
+        return _fail("stage_ms schema gate rejected the record",
+                     errors=errs)
+    speedup = staged["decompress_ms"] / max(batched["decompress_ms"],
+                                            1e-9)
+    if speedup < SPEEDUP_MIN:
+        return _fail("batched decompress lost its edge at the smoke "
+                     "shape", staged_ms=staged["decompress_ms"],
+                     batched_ms=batched["decompress_ms"],
+                     speedup=round(speedup, 2), floor=SPEEDUP_MIN)
+
+    # -- opt-in: the real pallas_call interpret path ------------------
+    interp = None
+    if flags.get_bool("FD_RUN_PALLAS_TESTS"):
+        os.environ["FD_DECOMPRESS_IMPL"] = "interpret"
+        try:
+            pt_i, ok_i = jax.jit(dp.decompress_batched_auto)(yb)
+            if not bool((np.asarray(ok_i) == ok_np).all()):
+                return _fail("interpret kernel ok mask diverges")
+            if fe.limbs_to_int(np.asarray(pt_i[0])) != xs:
+                return _fail("interpret kernel x diverges")
+            interp = True
+        finally:
+            os.environ.pop("FD_DECOMPRESS_IMPL", None)
+
+    print(json.dumps({
+        "lane": "decompress_smoke", "ok": True, "batch": B,
+        "staged_ms": staged["decompress_ms"],
+        "batched_ms": batched["decompress_ms"],
+        "speedup": round(speedup, 2),
+        "inversions_batched": batched["decompress_inversions"],
+        "inversions_staged": staged["decompress_inversions"],
+        "sched": batched["decompress_sched"],
+        "interpret_parity": interp,
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,369 @@
+#!/usr/bin/env python
+"""fe_schedule_search — certifier-gated sweep of the decompress-ladder
+squaring schedules (PR 14; the verified-X25519 workflow from PAPERS.md
+2012.09919, mechanized).
+
+The Montgomery-batched decompress spends ~252 repeated squarings per
+batch in one schedule; on the host graph that schedule's carry depth
+and datapath (int32 vs exact-f32 products, where the 38-fold runs)
+trade wall time against wrap headroom the dtype cannot express. This
+script makes aggressive scheduling safe to shop for:
+
+  for each candidate (generated source, build/sched_cand_*.py):
+    1. fdcert PROOF — the candidate module carries FDCERT_CONTRACTS
+       for one squaring AND the full 252-step fori ladder; the
+       abstract interpreter (lint/bounds.py, incl. the inductive
+       fori_loop transfer) must prove every intermediate int32-wrap-
+       free / inside the f32 mantissa-exact window. Rejections keep
+       the violation text — docs/RUNBOOK.md shows how to read one.
+    2. ORACLE PARITY — 64 chained squarings over random lanes vs
+       python-int pow, then (for candidates registered as
+       FD_DECOMPRESS_SQ_SCHED choices) a full RFC 8032 verify_batch
+       over a mixed good/bad batch against the per-lane oracle.
+    3. TIMING — ms/squaring of the jitted chunked ladder at the
+       requested batch.
+
+A candidate ships (becomes a flag choice / the auto default) ONLY if
+1 and 2 pass; the report (build/fe_schedule_search.json) records every
+candidate's verdict either way, so a rejection is an artifact, not a
+shrug. Run: python scripts/fe_schedule_search.py [--batch N] [--reps R]
+"""
+
+import argparse
+import json
+import os
+import platform
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+)
+
+P = 2**255 - 19
+
+# candidate name -> (datapath, carry passes, f32 fold?) — the swept
+# space. int32x2 and f32fold are the known-unsound points (conv wrap /
+# mantissa window); they stay in the sweep as the certifier's negative
+# controls.
+CANDIDATES = {
+    "int32x2": ("int32", 2, False),
+    "int32x3": ("int32", 3, False),
+    "int32x4": ("int32", 4, False),
+    "f32x3": ("f32", 3, False),
+    "f32x4": ("f32", 4, False),
+    "f32fold": ("f32", 4, True),
+}
+
+# candidate -> registered FD_DECOMPRESS_SQ_SCHED choice (shipping
+# schedules only; certifier-rejected candidates must never appear
+# here — test_decompress_batch pins that).
+REGISTERED = {"int32x3": "l3", "int32x4": "l4", "f32x4": "f32"}
+
+
+def _candidate_source(name: str) -> str:
+    dtype, passes, f32fold = CANDIDATES[name]
+    # Each candidate's honest standalone input contract: the f32
+    # datapath is only mantissa-exact up to the |limb| <= 512 public-op
+    # invariant (fe_sq_f32's shipped bound); int32 takes the generic
+    # kernel-multiply 1024. The LADDER entry always starts at 512 and
+    # must close inductively from there.
+    in_bound = 512 if dtype == "f32" else 1024
+    if dtype == "int32":
+        conv = """\
+    ad = a + a
+    ev = a * a
+    for e in range(1, 16):
+        ev = ev.at[e:32 - e].add(a[:32 - 2 * e] * ad[2 * e:])
+    od = jnp.zeros((31,) + batch, jnp.int32)
+    for e in range(16):
+        od = od.at[e:31 - e].add(a[:31 - 2 * e] * ad[2 * e + 1:])
+    ce = ev[:16] + 38 * ev[16:]
+    co = od[:16] + 38 * jnp.concatenate(
+        [od[16:], jnp.zeros((1,) + batch, jnp.int32)], axis=0)
+"""
+    elif not f32fold:
+        conv = """\
+    af = a.astype(jnp.float32)
+    ad = af + af
+    ev = af * af
+    for e in range(1, 16):
+        ev = ev.at[e:32 - e].add(af[:32 - 2 * e] * ad[2 * e:])
+    od = jnp.zeros((31,) + batch, jnp.float32)
+    for e in range(16):
+        od = od.at[e:31 - e].add(af[:31 - 2 * e] * ad[2 * e + 1:])
+    evi = ev.astype(jnp.int32)
+    odi = od.astype(jnp.int32)
+    ce = evi[:16] + 38 * evi[16:]
+    co = odi[:16] + 38 * jnp.concatenate(
+        [odi[16:], jnp.zeros((1,) + batch, jnp.int32)], axis=0)
+"""
+    else:
+        # The unsound "stay in f32 through the fold" variant: 38 * a
+        # f32 conv row exceeds the 2^24 mantissa-exact window — the
+        # schedule this host MEASURED wrong before the gate existed.
+        conv = """\
+    af = a.astype(jnp.float32)
+    ad = af + af
+    ev = af * af
+    for e in range(1, 16):
+        ev = ev.at[e:32 - e].add(af[:32 - 2 * e] * ad[2 * e:])
+    od = jnp.zeros((31,) + batch, jnp.float32)
+    for e in range(16):
+        od = od.at[e:31 - e].add(af[:31 - 2 * e] * ad[2 * e + 1:])
+    ce = (ev[:16] + 38.0 * ev[16:]).astype(jnp.int32)
+    co = (od[:16] + 38.0 * jnp.concatenate(
+        [od[16:], jnp.zeros((1,) + batch, jnp.float32)],
+        axis=0)).astype(jnp.int32)
+"""
+    # A generous self-contract: the certifier's job is to prove (or
+    # refute) that the ladder admits an inductive invariant inside the
+    # lanes at all — out_abs just has to be >= the invariant it finds.
+    return (
+        f'"""fe_schedule_search candidate {name} (generated — never '
+        'shipped; the shipping twins live in ops/fe25519.py)."""\n'
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "NLIMBS = 32\n"
+        "\n"
+        "\n"
+        "def _carry_pass(x, passes):\n"
+        "    for _ in range(passes):\n"
+        "        lo = x & 255\n"
+        "        hi = x >> 8\n"
+        "        x = lo + jnp.concatenate(\n"
+        "            [38 * hi[31:], hi[:31]], axis=0)\n"
+        "    return x\n"
+        "\n"
+        "\n"
+        "def cand_sq(a):\n"
+        "    batch = a.shape[1:]\n"
+        f"{conv}"
+        "    c = jnp.stack([ce, co], axis=1).reshape((32,) + batch)\n"
+        f"    return _carry_pass(c, {passes})\n"
+        "\n"
+        "\n"
+        "def cand_ladder(w):\n"
+        "    return jax.lax.fori_loop(\n"
+        "        0, 252, lambda i, v: cand_sq(v), w)\n"
+        "\n"
+        "\n"
+        "FDCERT_CONTRACTS = {\n"
+        f'    "cand_sq": {{"inputs": ["limbs:32:{in_bound}"],\n'
+        '                "out_abs": 4096,\n'
+        f'                "doc": "one {name} squaring"}},\n'
+        '    "cand_ladder": {"inputs": ["limbs:32:512"],\n'
+        '                    "out_abs": 4096,\n'
+        f'                    "doc": "252-step {name} ladder '
+        '(inductive fori proof)"},\n'
+        "}\n"
+    )
+
+
+def certify(name: str, build_dir: str):
+    """(certified: bool, violations: [str]) for one candidate."""
+    from firedancer_tpu.lint import bounds
+
+    path = os.path.join(build_dir, f"sched_cand_{name}.py")
+    with open(path, "w") as f:
+        f.write(_candidate_source(name))
+    vs = bounds.check_file(path)
+    return not vs, [v.format() for v in vs]
+
+
+def parity(name: str, rng) -> bool:
+    """64 chained squarings vs python-int pow over random lanes."""
+    import numpy as np
+
+    build_dir = os.path.join(REPO, "build")
+    path = os.path.join(build_dir, f"sched_cand_{name}.py")
+    ns = {}
+    with open(path) as f:
+        exec(compile(f.read(), path, "exec"), ns)  # noqa: S102
+    import jax
+    import jax.numpy as jnp
+
+    from firedancer_tpu.ops import fe25519 as fe
+
+    lanes = 64
+    vals = [(int.from_bytes(rng.bytes(32), "little") % (P - 1)) + 1
+            for _ in range(lanes)]
+    limbs = np.zeros((32, lanes), np.int32)
+    for b, v in enumerate(vals):
+        for i in range(32):
+            limbs[i, b] = (v >> (8 * i)) & 0xFF
+    got = jnp.asarray(limbs)
+    f = jax.jit(lambda z: jax.lax.fori_loop(
+        0, 64, lambda i, v: ns["cand_sq"](v), z))
+    got = f(got)
+    want = [pow(v, 2**64, P) for v in vals]
+    return fe.limbs_to_int(np.asarray(got)) == want
+
+
+def rfc8032_parity(choice: str) -> bool:
+    """Full verify_batch under the candidate schedule vs the per-lane
+    oracle on a mixed good/bad batch (B=512 -> the stacked 1024-lane
+    decompress is batched-eligible, so the ladder really runs)."""
+    import subprocess
+
+    code = (
+        "import numpy as np, jax, jax.numpy as jnp\n"
+        "from firedancer_tpu.ops.verify import verify_batch\n"
+        "from firedancer_tpu.ballet.ed25519 import oracle\n"
+        "rng = np.random.default_rng(5)\n"
+        "B = 512\n"
+        "seeds = rng.integers(0, 256, (B, 32), dtype=np.uint8)\n"
+        "msgs = rng.integers(0, 256, (B, 48), dtype=np.uint8)\n"
+        "lens = np.full((B,), 48, np.int32)\n"
+        "pubs = np.stack([np.frombuffer("
+        "oracle.keypair_from_seed(bytes(k))[2], np.uint8)"
+        " for k in seeds])\n"
+        "sigs = np.stack([np.frombuffer(oracle.sign(bytes(m), bytes(k)),"
+        " np.uint8) for m, k in zip(msgs, seeds)])\n"
+        "sigs = sigs.copy(); pubs = pubs.copy()\n"
+        "sigs[::7, 3] ^= 0x40\n"
+        "pubs[::11, 5] ^= 0x01\n"
+        "got = np.asarray(jax.jit(verify_batch)("
+        "jnp.asarray(msgs), jnp.asarray(lens), jnp.asarray(sigs),"
+        " jnp.asarray(pubs)))\n"
+        "want = [oracle.verify(bytes(m[:l]), bytes(s), bytes(p))"
+        " for m, l, s, p in zip(msgs, lens, sigs, pubs)]\n"
+        "ok = [int(g) for g in got] == [int(w) for w in want]\n"
+        "print('PARITY_OK' if ok else 'PARITY_FAIL')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               FD_DECOMPRESS_SQ_SCHED=choice)
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         env=env, capture_output=True, text=True)
+    return "PARITY_OK" in out.stdout
+
+
+def time_ladder(choice: str, batch: int, reps: int) -> float:
+    """ms per squaring of the jitted chunked ladder under `choice`
+    (fresh subprocess: the schedule is trace-time)."""
+    import subprocess
+
+    code = (
+        "import time, numpy as np, jax, jax.numpy as jnp\n"
+        "from firedancer_tpu.ops import fe25519 as fe\n"
+        "from firedancer_tpu.ops import decompress_pallas as dp\n"
+        f"B = {batch}\n"
+        "rng = np.random.RandomState(0)\n"
+        "z = jnp.asarray(rng.randint(0, 256, (32, B), dtype=np.int32))\n"
+        "n = 64\n"
+        "ck = dp.chunk_lanes() or B\n"
+        "ck = B if (ck > B or B % ck) else ck\n"
+        "def ladder(z):\n"
+        "    zc = jnp.moveaxis(z.reshape(32, B // ck, ck), 1, 0)\n"
+        "    return jax.lax.map(lambda c: jax.lax.fori_loop(\n"
+        "        0, n, lambda i, v: fe.fe_sq_sched()(v), c), zc)\n"
+        "f = jax.jit(ladder)\n"
+        "f(z)[0].block_until_ready()\n"
+        "ts = []\n"
+        f"for _ in range({reps}):\n"
+        "    t0 = time.perf_counter()\n"
+        "    f(z)[0].block_until_ready()\n"
+        "    ts.append(time.perf_counter() - t0)\n"
+        "print('MS_PER_SQ', min(ts) / n * 1e3)\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               FD_DECOMPRESS_SQ_SCHED=choice)
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         env=env, capture_output=True, text=True)
+    for line in out.stdout.splitlines():
+        if line.startswith("MS_PER_SQ"):
+            return round(float(line.split()[1]), 4)
+    return float("nan")
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--skip-timing", action="store_true",
+                    help="certify + parity only (CI-speed)")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    build_dir = os.path.join(REPO, "build")
+    os.makedirs(build_dir, exist_ok=True)
+    rng = np.random.default_rng(7)
+
+    report = {
+        "host": platform.node() or "unknown",
+        "batch": args.batch,
+        "ladder_squarings": 252,
+        "candidates": [],
+    }
+    for name in CANDIDATES:
+        t0 = time.perf_counter()
+        certified, violations = certify(name, build_dir)
+        entry = {
+            "name": name,
+            "registered_as": REGISTERED.get(name),
+            "certified": certified,
+            "violations": violations,
+            "parity": None,
+            "rfc8032_parity": None,
+            "ms_per_sq": None,
+        }
+        if certified:
+            entry["parity"] = bool(parity(name, rng))
+            choice = REGISTERED.get(name)
+            if choice and entry["parity"]:
+                entry["rfc8032_parity"] = rfc8032_parity(choice)
+                if not args.skip_timing:
+                    entry["ms_per_sq"] = time_ladder(
+                        choice, args.batch, args.reps)
+        entry["wall_s"] = round(time.perf_counter() - t0, 2)
+        report["candidates"].append(entry)
+        status = ("CERTIFIED" if certified else "REJECTED")
+        print(f"{name:10s} {status:10s} parity={entry['parity']} "
+              f"rfc8032={entry['rfc8032_parity']} "
+              f"ms/sq={entry['ms_per_sq']}", flush=True)
+        for v in violations:
+            print(f"    {v}", flush=True)
+
+    shippable = [c for c in report["candidates"]
+                 if c["certified"] and c["parity"]
+                 and c["registered_as"]
+                 and c["rfc8032_parity"] is not False]
+    if not args.skip_timing and any(
+            c["ms_per_sq"] is not None for c in shippable):
+        winner = min((c for c in shippable
+                      if c["ms_per_sq"] is not None),
+                     key=lambda c: c["ms_per_sq"])
+        report["winner"] = winner["name"]
+        print(f"winner: {winner['name']} "
+              f"({winner['ms_per_sq']} ms/sq as "
+              f"FD_DECOMPRESS_SQ_SCHED={winner['registered_as']})")
+    out_path = os.path.join(build_dir, "fe_schedule_search.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"report: {out_path}")
+    # Gate invariants: negative controls must be rejected, every
+    # registered choice must certify + hold BOTH parities (the full
+    # RFC 8032 run included — a crashed parity subprocess reads False
+    # and fails here loudly rather than shipping unexercised).
+    by_name = {c["name"]: c for c in report["candidates"]}
+    if by_name["int32x2"]["certified"] or by_name["f32fold"]["certified"]:
+        print("ERROR: a known-unsound schedule certified", file=sys.stderr)
+        return 1
+    for name, choice in REGISTERED.items():
+        c = by_name[name]
+        if not (c["certified"] and c["parity"]
+                and c["rfc8032_parity"] is True):
+            print(f"ERROR: registered schedule {choice} ({name}) failed "
+                  "the gate", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,18 +1,45 @@
-"""In-kernel VPU cost probes: int32 mul vs add vs carry vs fe_mul.
+"""kernel_probe — ONE kernel-suspect cost probe CLI.
 
-Times Pallas kernels that run N dependent ops on a VMEM-resident
-(32, LANES) int32 tile, serialized across reps (output feeds input) so
-queue overlap cannot flatter the numbers. Decides where the field-op
-mul budget actually goes on this chip:
-    python scripts/kernel_probe.py [lanes] [reps]
+PR-14 consolidation of the four one-off probes the RUNBOOK used to
+point at (kernel_probe.py, kernel_probe2.py, kernel_probe3.py,
+decompress_probe.py) into a single tool:
+
+    python scripts/kernel_probe.py --suspect <name> [args]
+
+  vpu         in-kernel VPU op costs on a VMEM tile: int32 mul vs add
+              vs carry_pass vs fe_mul/fe_sq vs bare conv (the original
+              kernel_probe) — where the field-op mul budget goes.
+  mulsched    slope-method schedule probe (old kernel_probe2): int32
+              vs f32 multiply, convert cost, fe_mul int32 vs exact-f32
+              — slopes between two op counts cancel dispatch exactly.
+  align       data-movement suspects (old kernel_probe3): aligned mul
+              vs sublane broadcast vs misaligned rotate vs carry, at
+              128 and 1024 lanes — spill and relayout attribution.
+  decompress  the decompress stage's suspects at batch size: staged
+              per-lane-chain vs Montgomery-batched engines, plus the
+              mask-kernel and pow-chain micro-probes that localized
+              the round-4 gap (old decompress_probe).
+  sched       the PR-14 ladder-schedule sweep on the host graph: flat
+              vs FD_DECOMPRESS_CHUNK-blocked lax.map x {l3, l4, f32}
+              squaring schedules, ms/squaring (the numbers behind the
+              ROOFLINE per-suspect table; certification lives in
+              scripts/fe_schedule_search.py, not here).
+  dsm         the DSM mul-impl x LANES sweep (old decompress_probe
+              tail).
+  fused       end-to-end fused verify_batch timing at batch.
+
+Every measurement pulls to host (np.asarray) so tunnel-side laziness
+cannot flatter a number (the round-4 lesson).
 """
 
-import functools
+import argparse
 import os
 import sys
 import time
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir))
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+)
 
 import numpy as np
 import jax
@@ -20,96 +47,473 @@ import jax.numpy as jnp
 
 from firedancer_tpu.ops import fe25519 as fe
 
-LANES = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
-REPS = int(sys.argv[2]) if len(sys.argv) > 2 else 8
-N_OPS = 256
+NL = fe.NLIMBS
 
 
-def _mk(kern_body, n_in=2):
+def _pull_time(fn, args, reps=8, warmup=1):
+    # One timing discipline for every probe: _bench_util.bench owns
+    # the dispatch-then-host-pull methodology (a fix there must land
+    # in all seven suspects at once, not fork here).
+    from _bench_util import bench
+
+    return bench(fn, args, reps=reps, warmup=warmup)
+
+
+# --------------------------------------------------------------------------
+# vpu — in-kernel dependent-chain op costs (original kernel_probe).
+# --------------------------------------------------------------------------
+
+
+def suspect_vpu(args):
     from jax.experimental import pallas as pl
 
-    def kern(*refs):
-        ins = [r[...] for r in refs[:-1]]
-        refs[-1][...] = kern_body(*ins)
+    lanes, reps, n_ops = args.lanes, args.reps, 256
 
-    spec = pl.BlockSpec((32, LANES), lambda: (0, 0))
-    return pl.pallas_call(
-        kern,
-        in_specs=[spec] * n_in,
-        out_specs=spec,
-        out_shape=jax.ShapeDtypeStruct((32, LANES), jnp.int32),
-    )
+    def _mk(kern_body, n_in=2):
+        def kern(*refs):
+            ins = [r[...] for r in refs[:-1]]
+            refs[-1][...] = kern_body(*ins)
 
+        spec = pl.BlockSpec((NL, lanes), lambda: (0, 0))
+        return pl.pallas_call(
+            kern, in_specs=[spec] * n_in, out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((NL, lanes), jnp.int32),
+        )
 
-def body_mul(x, y):
-    for _ in range(N_OPS):
-        x = x * y + y
-    return x
+    def body_mul(x, y):
+        for _ in range(n_ops):
+            x = x * y + y
+        return x
 
+    def body_add(x, y):
+        for _ in range(n_ops):
+            x = (x + y) ^ y
+        return x
 
-def body_add(x, y):
-    for _ in range(N_OPS):
-        x = (x + y) ^ y
-    return x
+    def body_carry(x, y):
+        for _ in range(n_ops // 8):
+            x = fe._carry_pass(x + y, 1)
+        return x
 
+    def body_femul(x, y):
+        for _ in range(16):
+            x = fe.fe_mul_unrolled(x, y)
+        return x
 
-def body_carry(x, y):
-    for _ in range(N_OPS // 8):
-        x = fe._carry_pass(x + y, 1)
-    return x
+    def body_fesq(x, y):
+        x = x + y
+        for _ in range(16):
+            x = fe.fe_sq(x)
+        return x
 
+    def body_conv_nocarry(x, y):
+        # fe_mul's convolution without the 4 carry passes (cost probe;
+        # values wrap int32 harmlessly).
+        for _ in range(16):
+            bext = jnp.concatenate([38 * y, y], axis=0)
+            acc = x[0:1] * bext[32:64]
+            for i in range(1, 32):
+                acc = acc + x[i:i + 1] * bext[32 - i:64 - i]
+            x = acc
+        return x
 
-def body_femul(x, y):
-    for _ in range(16):
-        x = fe.fe_mul_unrolled(x, y)
-    return x
-
-
-def body_fesq(x, y):
-    x = x + y
-    for _ in range(16):
-        x = fe.fe_sq(x)
-    return x
-
-
-def body_conv_nocarry(x, y):
-    # fe_mul's convolution without the 4 carry passes (bounds ignored —
-    # this is a cost probe, values wrap int32 harmlessly).
-    for _ in range(16):
-        bext = jnp.concatenate([38 * y, y], axis=0)
-        acc = x[0:1] * bext[32:64]
-        for i in range(1, 32):
-            acc = acc + x[i:i + 1] * bext[32 - i:64 - i]
-        x = acc
-    return x
-
-
-def main():
-    dev = jax.devices()[0]
-    print(f"device={dev} lanes={LANES}")
     rng = np.random.RandomState(0)
-    x0 = jnp.asarray(rng.randint(0, 256, (32, LANES), dtype=np.int32))
-    y = jnp.asarray(rng.randint(1, 256, (32, LANES), dtype=np.int32))
-
+    x0 = jnp.asarray(rng.randint(0, 256, (NL, lanes), dtype=np.int32))
+    y = jnp.asarray(rng.randint(1, 256, (NL, lanes), dtype=np.int32))
     for name, body, per_call in [
-        ("mul+add x256", body_mul, N_OPS),
-        ("add+xor x256", body_add, N_OPS),
-        ("carry_pass x32", body_carry, N_OPS // 8),
+        ("mul+add x256", body_mul, n_ops),
+        ("add+xor x256", body_add, n_ops),
+        ("carry_pass x32", body_carry, n_ops // 8),
         ("fe_mul x16", body_femul, 16),
         ("fe_sq x16", body_fesq, 16),
         ("conv-only x16", body_conv_nocarry, 16),
     ]:
         fn = jax.jit(_mk(body))
         x = fn(x0, y)
-        x.block_until_ready()
+        np.asarray(x)  # host pull, not block_until_ready (round-4 lesson)
         t0 = time.perf_counter()
-        for _ in range(REPS):
+        for _ in range(reps):
             x = fn(x, y)
-        x.block_until_ready()
-        dt = (time.perf_counter() - t0) / REPS
+        np.asarray(x)
+        dt = (time.perf_counter() - t0) / reps
         unit = dt / per_call * 1e6
         print(f"{name:18s} {dt*1e3:8.3f} ms/call  {unit:8.2f} us/op "
-              f"({32 * LANES * per_call / dt / 1e9:.1f} Gop-lanes/s)")
+              f"({NL * lanes * per_call / dt / 1e9:.1f} Gop-lanes/s)",
+              flush=True)
+
+
+# --------------------------------------------------------------------------
+# mulsched — slope-method int32 vs f32 probes (old kernel_probe2).
+# --------------------------------------------------------------------------
+
+
+def suspect_mulsched(args):
+    from jax.experimental import pallas as pl
+
+    lanes = args.lanes
+
+    def _mk(body, n_in=2, dtype=jnp.int32):
+        def kern(*refs):
+            ins = [r[...] for r in refs[:-1]]
+            refs[-1][...] = body(*ins)
+
+        spec = pl.BlockSpec((NL, lanes), lambda: (0, 0))
+        return jax.jit(pl.pallas_call(
+            kern, in_specs=[spec] * n_in, out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((NL, lanes), dtype),
+        ))
+
+    def slope(make_body, n_lo, n_hi, n_in=2, dtype=jnp.int32, args_=None):
+        f_lo = _mk(make_body(n_lo), n_in, dtype)
+        f_hi = _mk(make_body(n_hi), n_in, dtype)
+        t_lo = _pull_time(f_lo, args_)
+        t_hi = _pull_time(f_hi, args_)
+        return (t_hi - t_lo) / (n_hi - n_lo) * 1e6, t_hi
+
+    rng = np.random.RandomState(0)
+    xi = jnp.asarray(rng.randint(1, 256, (NL, lanes), dtype=np.int32))
+    yi = jnp.asarray(rng.randint(1, 256, (NL, lanes), dtype=np.int32))
+    xf, yf = xi.astype(jnp.float32), yi.astype(jnp.float32)
+
+    f0 = _mk(lambda x, y: x + y)
+    print(f"dispatch+1op:        {_pull_time(f0, (xi, yi))*1e6:9.1f} us",
+          flush=True)
+
+    def mk_mul(n):
+        def body(x, y):
+            for _ in range(n):
+                x = x * y + y
+            return x
+        return body
+
+    def mk_add(n):
+        def body(x, y):
+            for _ in range(n):
+                x = (x + y) ^ y
+            return x
+        return body
+
+    def mk_cvt(n):
+        def body(x, y):
+            for _ in range(n // 2):
+                x = (x.astype(jnp.float32) + 1.0).astype(jnp.int32)
+            return x
+        return body
+
+    us, t = slope(mk_mul, 1024, 4096, args_=(xi, yi))
+    print(f"int32 mul+add:       {us*1000:9.3f} ns/op", flush=True)
+    us, t = slope(mk_add, 1024, 4096, args_=(xi, yi))
+    print(f"int32 add+xor:       {us*1000:9.3f} ns/op", flush=True)
+    us, t = slope(mk_mul, 1024, 4096, dtype=jnp.float32, args_=(xf, yf))
+    print(f"f32   mul+add:       {us*1000:9.3f} ns/op", flush=True)
+    us, t = slope(mk_cvt, 1024, 4096, args_=(xi, yi))
+    print(f"cvt i2f+f2i pair:    {us*1000:9.3f} ns/op", flush=True)
+
+    def mk_femul_i(n):
+        def body(x, y):
+            for _ in range(n):
+                x = fe.fe_mul_unrolled(x, y)
+            return x
+        return body
+
+    def mk_femul_f(n):
+        def body(x, y):
+            for _ in range(n):
+                x = fe.fe_mul_f32(x, y)
+            return x
+        return body
+
+    def mk_fesq(n):
+        def body(x, y):
+            for _ in range(n):
+                x = fe.fe_sq(x)
+            return x
+        return body
+
+    us_i, _ = slope(mk_femul_i, 8, 40, args_=(xi, yi))
+    print(f"fe_mul int32:        {us_i:9.2f} us/mul", flush=True)
+    us_f, _ = slope(mk_femul_f, 8, 40, args_=(xi, yi))
+    print(f"fe_mul f32conv:      {us_f:9.2f} us/mul", flush=True)
+    us_s, _ = slope(mk_fesq, 8, 40, args_=(xi, yi))
+    print(f"fe_sq  int32:        {us_s:9.2f} us/sq", flush=True)
+    if us_f > 0:
+        print(f"f32/int32 fe_mul speedup: {us_i/us_f:.2f}x", flush=True)
+    fi = _mk(mk_femul_i(8))
+    ff = _mk(mk_femul_f(8))
+    gi = fe.limbs_to_int(np.asarray(fi(xi, yi))[:, :8])
+    gf = fe.limbs_to_int(np.asarray(ff(xi, yi))[:, :8])
+    print(f"fe_mul f32 == int32: {gi == gf}", flush=True)
+
+
+# --------------------------------------------------------------------------
+# align — movement suspects at two tile widths (old kernel_probe3).
+# --------------------------------------------------------------------------
+
+
+def suspect_align(args):
+    from jax.experimental import pallas as pl
+
+    grid = 64
+
+    def _mk(body, lanes):
+        def kern(x_ref, y_ref, o_ref):
+            o_ref[...] = body(x_ref[...], y_ref[...])
+
+        spec = pl.BlockSpec((NL, lanes), lambda i: (0, 0))
+        return jax.jit(pl.pallas_call(
+            kern, grid=(grid,), in_specs=[spec, spec], out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((NL, lanes), jnp.int32),
+        ))
+
+    def _rot5(y):
+        return jnp.concatenate([y[5:], y[:5]], axis=0)
+
+    def _chain(kind, n):
+        def body(x, y):
+            for _ in range(n):
+                if kind == "mul":
+                    x = x * y + y
+                elif kind == "bcast":
+                    x = x[0:1] * y + y
+                elif kind == "shift":
+                    x = x * _rot5(y) + y
+                elif kind == "bshift":
+                    x = x[7:8] * _rot5(y) + y
+                elif kind == "carry":
+                    x = fe._carry_pass(x + y, 1)
+                elif kind == "fe_mul":
+                    x = fe.fe_mul_unrolled(x, y)
+                elif kind == "fe_sq":
+                    x = fe.fe_sq(x)
+                else:
+                    raise ValueError(kind)
+            return x
+        return body
+
+    def probe(kind, lanes, n_lo, n_hi, unit_ops):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randint(1, 256, (NL, lanes), dtype=np.int32))
+        y = jnp.asarray(rng.randint(1, 256, (NL, lanes), dtype=np.int32))
+        t_lo = _pull_time(_mk(_chain(kind, n_lo), lanes), (x, y))
+        t_hi = _pull_time(_mk(_chain(kind, n_hi), lanes), (x, y))
+        per_step = (t_hi - t_lo) / (n_hi - n_lo) / grid
+        eff = (unit_ops * NL * lanes / per_step / 1e12
+               if per_step > 0 else 0)
+        return per_step, eff, t_hi
+
+    print(f"device={jax.devices()[0]} grid={grid}", flush=True)
+    for kind, n_lo, n_hi, unit in [
+        ("mul", 512, 2048, 2),
+        ("bcast", 512, 2048, 2),
+        ("shift", 512, 2048, 2),
+        ("bshift", 512, 2048, 2),
+        ("carry", 256, 1024, 5),
+        ("fe_mul", 16, 64, 80),
+        ("fe_sq", 16, 64, 60),
+    ]:
+        for lanes in (128, 1024):
+            try:
+                us, eff, t_hi = probe(kind, lanes, n_lo, n_hi, unit)
+                print(f"{kind:7s} L={lanes:5d}: {us*1e9:9.1f} ns/step "
+                      f"eff {eff:6.2f} T elem-op/s", flush=True)
+            except Exception as e:
+                print(f"{kind:7s} L={lanes:5d}: FAILED "
+                      f"{type(e).__name__}: {str(e)[:140]}", flush=True)
+
+
+# --------------------------------------------------------------------------
+# decompress — the stage's suspects (old decompress_probe, updated for
+# the Montgomery-batched engines).
+# --------------------------------------------------------------------------
+
+
+def suspect_decompress(args):
+    from firedancer_tpu.ops import decompress_pallas as dp
+    from firedancer_tpu.ops import curve25519 as ge
+    from firedancer_tpu.ops.pow_pallas import pow22523_chain
+
+    batch = args.batch
+    print(f"device={jax.devices()[0]} batch={batch}", flush=True)
+    rng = np.random.RandomState(0)
+    ybytes = jnp.asarray(rng.randint(0, 256, (batch, 32), dtype=np.uint8))
+    limbs = jnp.asarray(rng.randint(0, 256, (NL, batch), dtype=np.int32))
+
+    # engine-level: staged per-lane chains vs the Montgomery-batched
+    # graph/kernel the dispatch actually serves.
+    t = _pull_time(jax.jit(fe.fe_pow22523), (limbs,), reps=args.reps)
+    print(f"pow22523 chain (staged):    {t*1e3:9.3f} ms", flush=True)
+    t = _pull_time(jax.jit(lambda z: fe.fe_sqn_sched(z, 252)), (limbs,),
+                   reps=args.reps)
+    print(f"sq ladder 252 (sched):      {t*1e3:9.3f} ms", flush=True)
+    t = _pull_time(jax.jit(lambda z: fe.fe_invert_batch(z)), (limbs,),
+                   reps=args.reps)
+    print(f"fe_invert_batch (tree):     {t*1e3:9.3f} ms "
+          f"({dp.inversion_count(batch)} chains analytic)", flush=True)
+    t = _pull_time(jax.jit(ge.decompress_xla), (ybytes,), reps=args.reps)
+    print(f"decompress staged XLA:      {t*1e3:9.3f} ms", flush=True)
+    if dp.batch_eligible(batch):
+        t = _pull_time(jax.jit(dp.decompress_batched_xla), (ybytes,),
+                       reps=args.reps)
+        print(f"decompress batched XLA:     {t*1e3:9.3f} ms", flush=True)
+    t = _pull_time(jax.jit(lambda y: ge.decompress_auto(y)), (ybytes,),
+                   reps=args.reps)
+    print(f"decompress_auto (dispatch): {t*1e3:9.3f} ms", flush=True)
+
+    # kernel micro-suspects on TPU-family backends (the round-4 mask
+    # localization; interpret is too slow to be a probe).
+    from firedancer_tpu.ops.backend import _platform_is_tpu
+
+    if _platform_is_tpu():
+        from jax.experimental import pallas as pl
+
+        def chain_kernel(lanes):
+            def kern(zin, out):
+                out[...] = pow22523_chain(zin[...])
+            n = batch // lanes
+            spec = pl.BlockSpec((NL, lanes), lambda i: (0, i))
+            return jax.jit(lambda z: pl.pallas_call(
+                kern, grid=(n,), in_specs=[spec], out_specs=spec,
+                out_shape=jax.ShapeDtypeStruct((NL, batch), jnp.int32))(z))
+
+        t = _pull_time(chain_kernel(512), (limbs,), reps=args.reps)
+        print(f"pow22523 kernel L=512:      {t*1e3:9.3f} ms", flush=True)
+
+        def mask_kernel(n_masks):
+            def kern(zin, out):
+                z = zin[...]
+                acc = fe.fe_is_zero_k(z)
+                for _ in range(n_masks - 1):
+                    acc = acc + fe.fe_is_zero_k(z + acc)
+                out[...] = acc
+            lanes = 512
+            n = batch // lanes
+            spec = pl.BlockSpec((NL, lanes), lambda i: (0, i))
+            ospec = pl.BlockSpec((1, lanes), lambda i: (0, i))
+            return jax.jit(lambda z: pl.pallas_call(
+                kern, grid=(n,), in_specs=[spec], out_specs=ospec,
+                out_shape=jax.ShapeDtypeStruct((1, batch), jnp.int32))(z))
+
+        for n_masks in (1, 3):
+            t = _pull_time(mask_kernel(n_masks), (limbs,), reps=args.reps)
+            print(f"fe_is_zero_k x{n_masks} kernel:     {t*1e3:9.3f} ms",
+                  flush=True)
+        from firedancer_tpu.ops.curve_pallas import decompress_pallas
+
+        t = _pull_time(jax.jit(lambda y: decompress_pallas(y)[0][0]),
+                       (ybytes,), reps=args.reps)
+        print(f"decompress kernel (512):    {t*1e3:9.3f} ms", flush=True)
+
+
+# --------------------------------------------------------------------------
+# sched — the ladder-schedule sweep behind the ROOFLINE table.
+# --------------------------------------------------------------------------
+
+
+def suspect_sched(args):
+    batch, n = args.batch, 32
+    rng = np.random.RandomState(0)
+    limbs = jnp.asarray(rng.randint(0, 256, (NL, batch), dtype=np.int32))
+    scheds = {"l3": fe.fe_sq_l3, "l4": fe.fe_sq_l4,
+              "f32": fe.fe_sq_f32, "fe_sq": fe.fe_sq}
+
+    def flat(sq):
+        return jax.jit(lambda z: jax.lax.fori_loop(
+            0, n, lambda i, v: sq(v), z))
+
+    def chunked(sq, ck):
+        def f(z):
+            zc = jnp.moveaxis(z.reshape(NL, batch // ck, ck), 1, 0)
+            return jax.lax.map(lambda c: jax.lax.fori_loop(
+                0, n, lambda i, v: sq(v), c), zc)
+        return jax.jit(f)
+
+    for name, sq in scheds.items():
+        t = _pull_time(flat(sq), (limbs,), reps=args.reps)
+        print(f"flat    {name:6s}: {t/n*1e3:7.3f} ms/sq", flush=True)
+    for ck in (512, 1024, 2048):
+        if batch % ck:
+            continue
+        for name, sq in scheds.items():
+            t = _pull_time(chunked(sq, ck), (limbs,), reps=args.reps)
+            print(f"chunk{ck:5d} {name:6s}: {t/n*1e3:7.3f} ms/sq",
+                  flush=True)
+
+
+# --------------------------------------------------------------------------
+# dsm / fused — the old decompress_probe tail.
+# --------------------------------------------------------------------------
+
+
+def suspect_dsm(args):
+    import importlib
+
+    from firedancer_tpu.ops import curve25519 as ge
+
+    batch = args.batch
+    rng = np.random.RandomState(0)
+    ybytes = jnp.asarray(rng.randint(0, 256, (batch, 32), dtype=np.uint8))
+    sbytes = jnp.asarray(rng.randint(0, 128, (batch, 32), dtype=np.uint8))
+    pt, _ = jax.jit(ge.decompress)(ybytes)
+    pt = tuple(jnp.asarray(c) for c in pt)
+    for mul_impl in ("schoolbook", "karatsuba"):
+        for lanes in (1024, 2048):
+            os.environ["FD_MUL_IMPL"] = mul_impl
+            os.environ["FD_DSM_LANES"] = str(lanes)
+            import firedancer_tpu.ops.dsm_pallas as dpm
+            importlib.reload(dpm)
+            try:
+                t = _pull_time(jax.jit(dpm.double_scalarmult_pallas),
+                               (sbytes, pt, sbytes), reps=3)
+                print(f"dsm {mul_impl:10s} L={lanes}: {t*1e3:8.3f} ms",
+                      flush=True)
+            except Exception as e:
+                print(f"dsm {mul_impl:10s} L={lanes}: FAILED "
+                      f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+    os.environ.pop("FD_MUL_IMPL", None)
+    os.environ.pop("FD_DSM_LANES", None)
+
+
+def suspect_fused(args):
+    import importlib
+
+    import firedancer_tpu.ops.dsm_pallas as dpm
+    importlib.reload(dpm)
+    from firedancer_tpu.ops.verify import verify_batch
+
+    batch = args.batch
+    rng = np.random.RandomState(0)
+    ybytes = jnp.asarray(rng.randint(0, 256, (batch, 32), dtype=np.uint8))
+    msgs = jnp.asarray(rng.randint(0, 256, (batch, 192), dtype=np.uint8))
+    lens = jnp.full((batch,), 192, jnp.int32)
+    sigs = jnp.asarray(rng.randint(0, 256, (batch, 64), dtype=np.uint8))
+    t = _pull_time(jax.jit(verify_batch), (msgs, lens, sigs, ybytes),
+                   reps=3)
+    print(f"verify_batch fused:         {t*1e3:8.3f} ms "
+          f"({batch/t:.0f} lanes/s)", flush=True)
+
+
+SUSPECTS = {
+    "vpu": suspect_vpu,
+    "mulsched": suspect_mulsched,
+    "align": suspect_align,
+    "decompress": suspect_decompress,
+    "sched": suspect_sched,
+    "dsm": suspect_dsm,
+    "fused": suspect_fused,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--suspect", action="append", required=True,
+                    choices=sorted(SUSPECTS), help="repeatable")
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--lanes", type=int, default=1024)
+    ap.add_argument("--reps", type=int, default=8)
+    args = ap.parse_args()
+    for s in args.suspect:
+        print(f"== suspect {s} ==", flush=True)
+        SUSPECTS[s](args)
 
 
 if __name__ == "__main__":

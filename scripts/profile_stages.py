@@ -205,7 +205,57 @@ def stage_attribution(msgs, lens, sigs, pubs, mode="rlc", reps=3,
     out["fused"] = bool(fused)
     out["engine"] = engine
     out["mode"] = mode
+    out.update(_decompress_attrib(2 * bsz))
     return out
+
+
+def _decompress_attrib(stacked_lanes):
+    """PR-14 decompress attribution fields for the artifact: whether
+    the Montgomery-batched engine served this shape, the ANALYTIC
+    fe_invert-chain count (the 2B -> 2B/64 acceptance number — an
+    exact function of FD_DECOMPRESS_BATCH, not a measurement), and
+    the certified ladder schedule in effect. Validated by
+    scripts/bench_log_check._validate_stage_ms."""
+    from firedancer_tpu import flags
+    from firedancer_tpu.ops import decompress_pallas as dp
+    from firedancer_tpu.ops import fe25519 as fe_mod
+
+    sched = flags.get_str("FD_DECOMPRESS_SQ_SCHED", "auto")
+    if sched == "auto":
+        for name, fn in fe_mod._SQ_SCHEDULES.items():
+            if fn is fe_mod.fe_sq_sched():
+                sched = name
+                break
+    return {
+        "decompress_batched": bool(dp.batched_active(stacked_lanes)),
+        "decompress_inversions": int(dp.inversion_count(stacked_lanes)),
+        "decompress_sched": sched,
+    }
+
+
+def decompress_stage_ms(batch, reps=3, warmup=1, seed=0):
+    """Time JUST the decompress stage at the stacked (A, R) shape the
+    verify pass presents (2*batch lanes through the flag-dispatched
+    engine) — the cheap way to grade the PR-14 >= 2x cut at B=8192 on
+    a CPU host, where a full stage_attribution would spend hours in
+    the XLA-graph MSM. RUNBOOK: 'Re-measuring the decompress stage'."""
+    from firedancer_tpu.ops import curve25519 as ge
+
+    # _bench_util.bench, not the local bench_fn: the host pull is the
+    # round-4 lesson — block_until_ready alone mis-measured a
+    # 250-square chain as ~0.02 ms on the axon tunnel, and this stage
+    # IS a ~252-square chain.
+    from _bench_util import bench as _pull_bench
+
+    rng = np.random.RandomState(seed)
+    ar = jnp.asarray(
+        rng.randint(0, 256, (2 * batch, 32), dtype=np.uint8))
+    ms = 1e3 * _pull_bench(jax.jit(lambda x: ge.decompress_auto(x)),
+                           (ar,), reps=reps, warmup=warmup)
+    rec = {"batch": batch, "stacked_lanes": 2 * batch,
+           "decompress_ms": round(ms, 3)}
+    rec.update(_decompress_attrib(2 * batch))
+    return rec
 
 
 def main():
@@ -388,8 +438,20 @@ def attrib_main():
         print(json.dumps(rec))
 
 
+def decompress_main():
+    """JSON decompress-stage-only timing:
+    python scripts/profile_stages.py --decompress [batch]."""
+    import json
+
+    argv = [a for a in sys.argv[1:] if not a.startswith("-")]
+    batch = int(argv[0]) if argv else 8192
+    print(json.dumps(decompress_stage_ms(batch)))
+
+
 if __name__ == "__main__":
     if "--attrib" in sys.argv:
         attrib_main()
+    elif "--decompress" in sys.argv:
+        decompress_main()
     else:
         main()

@@ -30,7 +30,7 @@ sys.exit(0 if any(d.platform != 'cpu' for d in ds) else 3)
 done
 
 echo "== decompress probe (round-4 KS canonicalize validation; 1500s)"
-timeout 1500 python -u scripts/decompress_probe.py 8192 || \
+timeout 1500 python -u scripts/kernel_probe.py --suspect decompress --batch 8192 || \
   echo "decompress probe failed (continuing)"
 
 echo "== bench ladder (records BENCH_LOG.jsonl)"

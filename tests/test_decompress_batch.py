@@ -1,0 +1,372 @@
+"""Montgomery-batched decompress (PR 14): engine parity, edge cases,
+the certifier-gated ladder schedules, and the fdcert transfer
+functions that make them provable.
+
+The batched engines must be BIT-EXACT against the staged per-lane
+chain composition (itself oracle-pinned by test_curve_and_verify):
+same ok mask, same canonical coordinates, same x==0 / small-order
+masks — across zero lanes (y == +-1 in every byte encoding),
+non-square candidates, small-order/torsion points, and the B=1 /
+non-1024-multiple fallback shapes.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from firedancer_tpu.ballet.ed25519 import oracle
+from firedancer_tpu.ops import curve25519 as ge
+from firedancer_tpu.ops import decompress_pallas as dp
+from firedancer_tpu.ops import fe25519 as fe
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+P = fe.P
+B = 1024  # the batched-eligibility quantum
+
+TORSION8 = bytes.fromhex(
+    "26e8958fc2b227b045c3f489f2ef98f0d5dfac05d3c63339b13802886d53fc05")
+
+
+def _enc(val, sign=0):
+    b = bytearray((val % 2**256).to_bytes(32, "little"))
+    b[31] |= sign << 7
+    return np.frombuffer(bytes(b), np.uint8)
+
+
+def _mixed_encodings():
+    rng = np.random.RandomState(11)
+    enc = rng.randint(0, 256, (B, 32), dtype=np.uint8)
+    # zero lanes (u == 0): every byte representation of y == +-1,
+    # scattered so several Montgomery groups contain one (the
+    # group-poison regression: a zero lane must not corrupt its 63
+    # group-mates' inverses).
+    enc[0] = _enc(1)
+    enc[65] = _enc(P - 1)
+    enc[130] = _enc(P + 1)
+    enc[195] = _enc(1, sign=1)
+    # torsion / small-order
+    enc[3] = _enc(0)                      # order-4 (y = 0, x^2 = -1)
+    enc[4] = np.frombuffer(TORSION8, np.uint8)
+    # non-canonical y == p (== 0 mod p)
+    enc[5] = _enc(P)
+    # valid points with both signs
+    pt = oracle.B
+    for i in range(8, 40):
+        if i % 3 == 0:
+            pt_e = (oracle.P - pt[0], pt[1])
+        else:
+            pt_e = pt
+        enc[i] = np.frombuffer(oracle.point_compress(pt_e), np.uint8)
+        pt = oracle.point_add(pt, oracle.B)
+    return enc
+
+
+@pytest.fixture(scope="module")
+def engines():
+    """(enc, staged, batched) computed once (ONE jit per engine — the
+    suite is time-bound): each is (pt_ints, ok, xz, so) with pt
+    coordinates as canonical python ints."""
+    enc_np = _mixed_encodings()
+    enc = jnp.asarray(enc_np)
+
+    def _norm(pt, ok, xz, so):
+        return ([fe.limbs_to_int(np.asarray(c)) for c in pt],
+                np.asarray(ok), np.asarray(xz), np.asarray(so))
+
+    def staged_f(y):
+        pt, ok, xz = ge.decompress_xla(y, want_x_zero=True)
+        return pt, ok, xz, ge.small_order_mask(pt)
+
+    staged = _norm(*jax.jit(staged_f)(enc))
+    assert dp.batch_eligible(B)
+    pt, ok, xz, so = jax.jit(
+        lambda y: dp.decompress_batched_xla(
+            y, want_x_zero=True, want_small_order=True)
+    )(enc)
+    batched = _norm(pt, ok, xz, so)
+    return enc_np, staged, batched
+
+
+def test_batched_bit_exact_vs_staged(engines):
+    _, staged, batched = engines
+    for c in range(4):
+        assert staged[0][c] == batched[0][c], f"coordinate {c}"
+    assert (staged[1] == batched[1]).all()      # ok
+    assert (staged[2] == batched[2]).all()      # x == 0
+    assert (staged[3] == batched[3]).all()      # small order
+
+
+def test_edge_lanes_against_python_oracle(engines):
+    enc, _, (pts, ok, xz, so) = engines
+    for i in list(range(0, 48)) + [65, 130, 195]:
+        want = oracle.point_decompress(bytes(enc[i]))
+        assert bool(ok[i]) == (want is not None), f"lane {i}"
+        if want is not None:
+            assert (pts[0][i], pts[1][i]) == want, f"lane {i}"
+            assert bool(so[i]) == oracle.is_small_order(want), f"lane {i}"
+
+
+def test_zero_lanes_and_their_group_mates(engines):
+    enc, _, (pts, ok, xz, so) = engines
+    # the planted y == +-1 lanes decode to x == 0 and flag xz
+    for i in (0, 65, 130, 195):
+        assert ok[i] and xz[i] and pts[0][i] == 0
+    # x == 0 exactly on u == 0 lanes: xz matches y == +-1 mod p
+    for i in range(B):
+        y_val = int.from_bytes(bytes(enc[i]), "little") & ((1 << 255) - 1)
+        expect = y_val % P in (1, P - 1)
+        assert bool(xz[i]) == expect, f"lane {i}"
+    # group-mates of the zero lanes (same 64-lane inversion group)
+    # decode correctly — pinned against the per-lane oracle
+    for i in (1, 2, 64, 66, 129, 131, 194, 196):
+        want = oracle.point_decompress(bytes(enc[i]))
+        assert bool(ok[i]) == (want is not None)
+        if want is not None:
+            assert (pts[0][i], pts[1][i]) == want
+
+
+def test_torsion_lanes(engines):
+    enc, _, (pts, ok, xz, so) = engines
+    assert ok[3] and so[3] and not xz[3]   # order-4 (y = 0, x = sqrt(-1))
+    assert ok[4] and so[4]                 # order-8
+    # y == p: the non-canonical encoding of y = 0 — same order-4 point
+    assert ok[5] and so[5] and not xz[5]
+
+
+def test_non_square_lanes_fail_closed(engines):
+    enc, _, (pts, ok, xz, so) = engines
+    bad = [i for i in range(B) if not ok[i]]
+    assert bad, "mixed batch should contain undecodable lanes"
+    for i in bad[:16]:
+        assert oracle.point_decompress(bytes(enc[i])) is None
+        # failed lanes carry the identity poison
+        assert (pts[0][i], pts[1][i], pts[2][i], pts[3][i]) == (0, 1, 1, 0)
+
+
+def test_fallback_shapes_bit_exact(monkeypatch):
+    enc = _mixed_encodings()[:48]
+    # B=1: full bit-exactness against the staged graph (one compile)
+    got_pt, got_ok = jax.jit(dp.decompress_batched_auto)(
+        jnp.asarray(enc[:1]))
+    want = oracle.point_decompress(bytes(enc[0]))
+    assert bool(np.asarray(got_ok)[0]) == (want is not None)
+    if want is not None:
+        assert (fe.limbs_to_int(np.asarray(got_pt[0]))[0],
+                fe.limbs_to_int(np.asarray(got_pt[1]))[0]) == want
+    # non-1024-multiple: the dispatch must take the staged path (the
+    # fallback IS ge.decompress_xla — pin the routing, not a second
+    # compile of the same graph)
+    calls = []
+    real = ge.decompress_xla
+    monkeypatch.setattr(ge, "decompress_xla",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    batched_calls = []
+    real_b = dp.decompress_batched_xla
+    monkeypatch.setattr(
+        dp, "decompress_batched_xla",
+        lambda *a, **k: batched_calls.append(1) or real_b(*a, **k))
+    dp.decompress_batched_auto(jnp.asarray(enc))  # B=48, eager
+    assert calls and not batched_calls
+    assert not dp.batch_eligible(48)
+    assert not dp.batch_eligible(0)
+    assert not dp.batch_eligible(1000)
+    assert dp.batch_eligible(2048)
+
+
+def test_dispatch_contract(monkeypatch):
+    monkeypatch.setenv("FD_DECOMPRESS_IMPL", "bogus")
+    with pytest.raises(ValueError):
+        dp.decompress_impl()
+    monkeypatch.setenv("FD_DECOMPRESS_IMPL", "interpret")
+    assert dp.decompress_impl() == "interpret"
+    monkeypatch.setenv("FD_DECOMPRESS_IMPL", "xla")
+    assert dp.decompress_impl() == "xla"
+    monkeypatch.delenv("FD_DECOMPRESS_IMPL", raising=False)
+    assert dp.decompress_impl() == "xla"  # auto off-TPU
+    with pytest.raises(ValueError):
+        dp.decompress_batched_auto(jnp.zeros((2048, 32), jnp.uint8),
+                                   want_niels=True)
+
+
+def test_analytic_inversion_count(monkeypatch):
+    assert dp.inversion_count(16384) == 256       # 2B/64 at B=8192
+    assert dp.inversion_count(2048) == 32
+    assert dp.inversion_count(1000) == 1000       # ineligible: per-lane
+    monkeypatch.setenv("FD_DECOMPRESS_BATCH", "0")
+    assert dp.inversion_count(16384) == 16384
+    monkeypatch.setenv("FD_DECOMPRESS_BATCH", "4")
+    assert dp.inversion_count(16384) == 1024
+
+
+def test_lean_squaring_schedules_bit_exact():
+    rng = np.random.RandomState(5)
+    a = jnp.asarray(rng.randint(-512, 513, (32, 64), dtype=np.int32))
+    want = fe.limbs_to_int(fe.fe_sq(a))
+    for sq in (fe.fe_sq_l3, fe.fe_sq_l4):
+        got = sq(a)
+        assert fe.limbs_to_int(got) == want
+        assert int(jnp.abs(got).max()) <= 521  # the certified bound
+    # self-sustaining chain: 40 squarings stay inside the contract
+    x = a
+    for _ in range(40):
+        x = fe.fe_sq_l3(x)
+        assert int(jnp.abs(x).max()) <= 521
+    want_chain = a
+    for _ in range(40):
+        want_chain = fe.fe_sq(want_chain)
+    assert fe.limbs_to_int(x) == fe.limbs_to_int(want_chain)
+
+
+def test_sqn_sched_all_registered_choices(monkeypatch):
+    rng = np.random.RandomState(6)
+    a = jnp.asarray(rng.randint(-512, 513, (32, 32), dtype=np.int32))
+    want = a
+    for _ in range(16):
+        want = fe.fe_sq(want)
+    want = fe.limbs_to_int(want)
+    for choice in ("l3", "l4", "f32", "auto"):
+        monkeypatch.setenv("FD_DECOMPRESS_SQ_SCHED", choice)
+        got = jax.jit(lambda z: fe.fe_sqn_sched(z, 16))(a)
+        assert fe.limbs_to_int(got) == want, choice
+
+
+def test_mont_tree_matches_per_lane_invert():
+    rng = np.random.RandomState(8)
+    z_np = rng.randint(1, 256, (32, 16), dtype=np.int32)
+    z = jnp.asarray(z_np)
+    vals = fe.limbs_to_int(z_np)
+    want = [pow(v, P - 2, P) for v in vals]
+    got = fe.limbs_to_int(dp._mont_inv_tree(z, 6))
+    assert got == want
+    # kernel-side half-split tree, eager
+    got_k = fe.limbs_to_int(dp._mont_inv_tree_k(z, dp._tree_levels(16)))
+    assert got_k == want
+
+
+def test_stage_keys_pinned_across_tools():
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import bench_log_check
+    from profile_stages import STAGE_KEYS
+
+    assert tuple(bench_log_check._STAGE_KEYS) == tuple(STAGE_KEYS)
+
+
+def test_schedule_flag_choices_are_all_shipped():
+    from firedancer_tpu import flags
+
+    choices = flags.REGISTRY["FD_DECOMPRESS_SQ_SCHED"].choices
+    assert set(choices) == {"auto"} | set(fe._SQ_SCHEDULES)
+    # and the search script's REGISTERED map agrees (rejected
+    # candidates can never become flag values)
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import fe_schedule_search as search
+
+    assert set(search.REGISTERED.values()) == set(fe._SQ_SCHEDULES)
+    assert "int32x2" not in search.REGISTERED
+    assert "f32fold" not in search.REGISTERED
+
+
+def test_committed_certificate_carries_the_new_proofs():
+    with open(os.path.join(REPO, "lint_bounds_cert.json")) as f:
+        cert = json.load(f)
+    dmod = cert["modules"]["firedancer_tpu/ops/decompress_pallas.py"]
+    assert set(dmod) >= {"_decompress_block", "_mont_inv_tree",
+                         "_y_pm1_mask"}
+    femod = cert["modules"]["firedancer_tpu/ops/fe25519.py"]
+    # the retired PR-8 over-approximation (803 -> 293 / 255)
+    assert femod["_canonicalize_k"]["proved_out_abs"] <= 293
+    assert femod["_canonicalize_k_seq"]["proved_out_abs"] == 255
+    # the ladder + prefix-product proofs exist
+    for fn in ("fe_sq_l3", "fe_sq_l4", "fe_sqn_sched", "fe_invert",
+               "fe_pow22523", "fe_invert_batch"):
+        assert femod[fn]["proved_out_abs"] <= femod[fn]["out_abs"], fn
+
+
+# ---------------------------------------------------------------------------
+# fdcert transfer functions (lint/bounds.py) — the machinery that makes
+# the ladder/tree provable, pinned at the fixture level.
+# ---------------------------------------------------------------------------
+
+
+def _check_src(tmp_path, src):
+    from firedancer_tpu.lint import bounds
+
+    p = tmp_path / "cand.py"
+    p.write_text(src)
+    return bounds.check_file(str(p))
+
+
+def test_fori_inductive_transfer_accepts_closed_body(tmp_path):
+    vs = _check_src(tmp_path, (
+        "import jax\nimport jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    return jax.lax.fori_loop(0, 100, lambda i, v: (v >> 1), x)\n"
+        "FDCERT_CONTRACTS = {'f': {'inputs': ['limbs:4:512'],"
+        " 'out_abs': 512}}\n"
+    ))
+    assert vs == []
+
+
+def test_fori_inductive_transfer_rejects_growing_body(tmp_path):
+    vs = _check_src(tmp_path, (
+        "import jax\nimport jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    return jax.lax.fori_loop(0, 100, lambda i, v: v + 1, x)\n"
+        "FDCERT_CONTRACTS = {'f': {'inputs': ['limbs:4:512'],"
+        " 'out_abs': 100000}}\n"
+    ))
+    assert len(vs) == 1
+    assert "inductive" in vs[0].message
+
+
+def test_sel01_precise_transfer_requires_01_mask(tmp_path):
+    # with the override, _sel01 proves the hull; a wide mask refuses
+    vs = _check_src(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "def _sel01(m, a, b):\n"
+        "    return m * a + (1 - m) * b\n"
+        "def f(x):\n"
+        "    m = (x >= 0).astype(jnp.int32)\n"
+        "    return _sel01(m, x, -x)\n"
+        "def g(x):\n"
+        "    return _sel01(x, x, -x)\n"  # mask not provably {0,1}
+        "FDCERT_CONTRACTS = {\n"
+        " 'f': {'inputs': ['limbs:4:512'], 'out_abs': 512},\n"
+        " 'g': {'inputs': ['limbs:4:512'], 'out_abs': 512},\n"
+        "}\n"
+    ))
+    assert len(vs) == 1 and vs[0].key == "g"
+    assert "_sel01" in vs[0].message
+
+
+def test_xor_transfer_stays_on_01_lattice(tmp_path):
+    vs = _check_src(tmp_path, (
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    a = (x >= 0).astype(jnp.int32)\n"
+        "    b = (x >= 1).astype(jnp.int32)\n"
+        "    return a ^ b\n"
+        "def g(x):\n"
+        "    return x ^ 1\n"
+        "FDCERT_CONTRACTS = {\n"
+        " 'f': {'inputs': ['limbs:4:512'], 'out_abs': 1},\n"
+        " 'g': {'inputs': ['limbs:4:512'], 'out_abs': 1},\n"
+        "}\n"
+    ))
+    assert len(vs) == 1 and vs[0].key == "g"
+
+
+def test_lane_extended_input_spec():
+    from firedancer_tpu.lint import bounds
+
+    x = bounds._make_input("limbs:32:512:8", 8)
+    assert x.shape == (32, 8)
+    m = bounds._make_input("mask:1:8", 8)
+    assert m.shape == (1, 8)
+    assert m.lo.min() == 0 and m.hi.max() == 1
