@@ -114,7 +114,7 @@ echo "== fd_sentinel SLO smoke (burn-rate asymmetry + report/ledger) =="
 # latency rule), a seeded hb_stall + credit_starve chaos schedule
 # trips EXACTLY the matching SLOs (fault class <-> SLO name pinned in
 # the flight dump), fd_report ingests the repo's real BENCH_LOG.jsonl
-# + artifact family without error with all nine ROOFLINE predictions
+# + artifact family without error with all eleven ROOFLINE predictions
 # pending, and flight+sentinel overhead stays <= 5% vs both disabled.
 JAX_PLATFORMS=cpu python scripts/slo_smoke.py
 
@@ -187,6 +187,19 @@ echo "== Montgomery-batched decompress smoke (CPU, PR-14 engines) =="
 # under bench_log_check's stage_ms schema with the batched engine
 # measurably ahead of the staged one.
 JAX_PLATFORMS=cpu python scripts/decompress_smoke.py
+
+echo "== fd_pod smoke (8-device virtual mesh, split-step service) =="
+# The round-18 pod-scale gate: the forced FD_MESH_DEVICES-device CPU
+# mesh runs the full feed pipeline with the mesh-sharded SPLIT-STEP
+# rlc engine (local_fill / combine_tail double-buffer) — zero
+# fd_sentinel alerts (incl. the new shard_balance SLO), sink digests
+# bit-exact vs the single-shard pipeline, the PodVerifyService's
+# backlog-aware placement within 1.5x occupancy, the 2-batch overlap
+# probe under its core-scaled gate basis, and POD_r01.json validated
+# by bench_log_check's pod schema. Sentinel prediction 11 (8-shard
+# aggregate >= 1.04M verifies/s on device) stays pending until a real
+# pod session writes the on_device variant.
+JAX_PLATFORMS=cpu python scripts/pod_smoke.py
 
 echo "== fuzz smoke (10k iters/target) =="
 python fuzz/run_fuzz.py --iters 10000

@@ -234,6 +234,11 @@ class EngineEntry:
         "fallback_compile_s", "cache_hit_est", "err", "dispatches",
         "lanes", "service_ns", "fill_efficiency", "madds_per_lane",
         "built_ts", "_warmed", "_build_lock",
+        # fd_pod split-step pair (mesh rlc engines under FD_POD_SPLIT):
+        # the two separately-jitted graphs + their own service EMAs, so
+        # the cost model can be overlap-aware (combine_tail hides
+        # behind the next batch's local_fill when double-buffered).
+        "fn_local", "fn_tail", "service_local_ns", "service_tail_ns",
     )
 
     def __init__(self, spec: EngineSpec):
@@ -243,6 +248,12 @@ class EngineEntry:
             else ENGINE_COLD
         self.fn: Optional[Callable] = None        # async verify callable
         self.direct_fn: Optional[Callable] = None  # rlc per-lane fallback
+        # fd_pod split-step graphs (None unless spec.shards + rlc +
+        # FD_POD_SPLIT built this engine as a local/tail pair).
+        self.fn_local: Optional[Callable] = None
+        self.fn_tail: Optional[Callable] = None
+        self.service_local_ns = 0   # EMA: dispatch -> local_fill ready
+        self.service_tail_ns = 0    # EMA: local ready -> combine ready
         self.compile_s = 0.0
         self.fallback_compile_s = 0.0
         self.cache_hit_est = False
@@ -275,11 +286,46 @@ class EngineEntry:
         self.service_ns = (ns if not self.service_ns
                            else (7 * self.service_ns + ns) // 8)
 
+    def note_service_split(self, local_ns: int, tail_ns: int) -> None:
+        """fd_pod split-step cost samples: separate EMAs for the two
+        graphs, same 1/8 smoothing as note_service. The whole-batch
+        EMA is fed too (local + tail) so consumers that predate the
+        split keep reading a sane number."""
+        self.service_local_ns = (local_ns if not self.service_local_ns
+                                 else (7 * self.service_local_ns
+                                       + local_ns) // 8)
+        self.service_tail_ns = (tail_ns if not self.service_tail_ns
+                                else (7 * self.service_tail_ns
+                                      + tail_ns) // 8)
+        self.note_service(local_ns + tail_ns)
+
     def service_est_ns(self) -> int:
         """Best service-time estimate for one batch on this engine:
         the measured EMA, 0 while unmeasured (callers treat 0 as "no
-        cost information — do not cap on it")."""
+        cost information — do not cap on it").
+
+        OVERLAP-AWARE when the split EMAs are populated: a
+        double-buffered dispatcher retires one batch per
+        max(local_fill, combine_tail) at steady state — the classic
+        two-stage pipeline bound — because batch k's tail executes
+        while batch k+1's fill is already dispatched. The estimate is
+        that bound, never less than either stage (a scheduler capping
+        deadline slack on the serialized sum would step down exactly
+        when pipelining has already hidden the tail)."""
+        if self.service_local_ns and self.service_tail_ns:
+            return max(self.service_local_ns, self.service_tail_ns)
         return self.service_ns
+
+    def overlap_hidden_est(self) -> float:
+        """Fraction of combine_tail the double-buffer hides at steady
+        state, per the measured EMAs: 1.0 while the tail fits inside
+        the next fill entirely, shrinking as the tail dominates. 0.0
+        until both split EMAs are measured (monolithic engines stay
+        0.0 — nothing is split, nothing hides)."""
+        lo, tl = self.service_local_ns, self.service_tail_ns
+        if not lo or not tl:
+            return 0.0
+        return min(1.0, lo / tl)
 
     def account_first_call(self, seconds: float,
                            msg_len: int = 0) -> None:
@@ -315,6 +361,12 @@ class EngineEntry:
             "dispatches": self.dispatches,
             "lanes": self.lanes,
             "service_est_ns": self.service_est_ns(),
+            # fd_pod split-step accounting ({} = monolithic engine):
+            "split": ({
+                "service_local_ns": self.service_local_ns,
+                "service_tail_ns": self.service_tail_ns,
+                "overlap_hidden_est": round(self.overlap_hidden_est(), 3),
+            } if self.fn_local is not None else {}),
             "fill_efficiency": (round(self.fill_efficiency, 4)
                                 if self.fill_efficiency is not None
                                 else None),
@@ -425,11 +477,33 @@ class EngineRegistry:
                 return _sharded(msgs, lens, sigs, pubs)[0]
 
             if spec.mode == "rlc":
-                from firedancer_tpu.parallel.mesh import (
-                    verify_rlc_step_sharded,
-                )
+                if flags.get_bool("FD_POD_SPLIT"):
+                    # fd_pod split-step pair: local_fill + combine_tail
+                    # as two jitted graphs, composed here into the
+                    # rlc_fn contract (status, definite, batch_ok).
+                    # Dispatching through the composition enqueues BOTH
+                    # graphs asynchronously, so with inflight >= 2 the
+                    # tile's dispatcher already double-buffers: batch
+                    # k+1's local_fill is on the queue while batch k's
+                    # combine_tail executes.
+                    from firedancer_tpu.parallel.mesh import (
+                        verify_rlc_split_sharded,
+                    )
 
-                rlc_sharded = verify_rlc_step_sharded(mesh)
+                    local_fn, tail_fn = verify_rlc_split_sharded(mesh)
+                    e.fn_local = local_fn
+                    e.fn_tail = tail_fn
+
+                    def rlc_sharded(msgs, lens, sigs, pubs, z, u):
+                        status, definite, parts = local_fn(
+                            msgs, lens, sigs, pubs, z, u)
+                        return status, definite, tail_fn(parts)
+                else:
+                    from firedancer_tpu.parallel.mesh import (
+                        verify_rlc_step_sharded,
+                    )
+
+                    rlc_sharded = verify_rlc_step_sharded(mesh)
         else:
             direct_fn = jax.jit(verify_batch)
         fn = direct_fn
@@ -656,15 +730,30 @@ class RungScheduler:
 
     `cost_ns(rung)` is the registry-attached service model (EngineEntry
     service EMA); None disables slack capping (host engines, whose
-    service scales with lanes rather than the padded rung)."""
+    service scales with lanes rather than the padded rung).
+
+    `shards` (fd_pod): on a mesh engine every rung is a GLOBAL batch
+    split contiguously over the shards, so rungs must divide the mesh
+    (a non-dividing rung raises — the tile drops them before
+    construction) and `shard_rung` exposes the per-shard lane count a
+    feeder lane should stage toward for a given global rung."""
 
     def __init__(self, rungs, deadline_ns: int,
-                 cost_ns: Optional[Callable[[int], int]] = None):
+                 cost_ns: Optional[Callable[[int], int]] = None,
+                 shards: int = 1):
         rungs = sorted(set(int(r) for r in rungs))
         if not rungs:
             raise ValueError("RungScheduler needs at least one rung")
         if any(r <= 0 for r in rungs):
             raise ValueError(f"rungs must be positive, got {rungs}")
+        self.shards = max(1, int(shards))
+        bad = [r for r in rungs if r % self.shards]
+        if bad:
+            raise ValueError(
+                f"rungs {bad} do not divide over {self.shards} mesh "
+                "shards (every rung is a global batch split "
+                "contiguously across the mesh)"
+            )
         self.rungs = rungs
         self.deadline_ns = deadline_ns
         self.cost_ns = cost_ns
@@ -673,6 +762,11 @@ class RungScheduler:
         self.switches = 0
         self.decisions = 0
         self.last_inputs: Tuple[int, int, int] = (0, 0, 0)
+
+    def shard_rung(self, rung: int) -> int:
+        """Per-shard lane count of a global rung (the commit threshold
+        one fd_pod feeder lane stages toward)."""
+        return max(1, rung // self.shards)
 
     # -- pure selection --------------------------------------------------
 

@@ -113,7 +113,20 @@ def verify_tile_stats(v) -> Dict[str, object]:
                         else []),
         "rung_switches": m["rung_switches"],
         "rung_cur": m["rung_cur"],
+        # fd_pod per-shard occupancy (round-18): the mesh shard lanes'
+        # dispatched-lane counts + the busiest/laziest balance ratio —
+        # [] / 0.0 off-mesh so artifact consumers see ONE shape. The
+        # same verify.shardN flight rows feed the sentinel's
+        # shard_balance SLO; this is the artifact-facing mirror.
+        "shard_lanes": [sh.get("lanes") for sh in
+                        (s.as_dict() for s in v.fl_shards)],
+        "shard_balance": 0.0,
     }
+    if st["shard_lanes"]:
+        # lo==0 (a starved shard) degrades to max/1 — a huge but
+        # FINITE ratio, so the artifact stays strict-JSON.
+        lo = max(1, min(st["shard_lanes"]))
+        st["shard_balance"] = round(max(st["shard_lanes"]) / lo, 3)
     if getattr(v, "_feed", False):
         st["slot_stall"] = v.feed_pool.slot_stall
         st["slot_stall_ms"] = round(v.feed_pool.stall_ns / 1e6, 2)

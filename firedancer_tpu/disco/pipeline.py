@@ -454,9 +454,18 @@ def _feed_fallback_reason(pod: Pod, verify_backend: str, verify_batch: int,
     if verify_opts and verify_opts.get("native_drain") is False:
         return "verify_opts disabled the native drain"
     if verify_opts and verify_opts.get("mesh_devices"):
-        # The sharded verify step stays on the legacy runner until the
-        # feeder learns to keep several device shards full.
-        return "mesh_devices sharded verify (legacy runner only)"
+        # fd_pod (round 18): the feeder serves mesh tiles — the stager
+        # stages global-batch arenas, dispatch rungs divide the mesh
+        # (contiguous shard slices), the engine is the split-step
+        # local_fill/combine_tail pair double-buffered by the
+        # inflight window, and per-shard occupancy is booked into the
+        # verify.shardN flight rows. The one structural precondition
+        # left is divisibility: a batch that cannot split over the
+        # mesh has no sharded engine to dispatch to.
+        md = int(verify_opts["mesh_devices"])
+        if md and verify_batch % md:
+            return (f"verify_batch={verify_batch} does not divide over "
+                    f"mesh_devices={md} (no sharded engine shape)")
     if verify_backend == "cpu":
         from firedancer_tpu.ballet.ed25519 import native as ed_native
 

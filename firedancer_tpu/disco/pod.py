@@ -1,0 +1,588 @@
+"""fd_pod — pod-scale sharded verify service (ROADMAP direction 1).
+
+The rlc×mesh composition was proven at 2 shards (round 10), every
+shard books its own flight lane (round 12), and the engine registry
+keys on shard count (round 16) — this module composes them into a
+SERVICE: N feeder lanes (one SlotPool staging arena per mesh shard,
+the fd_feed slot machinery) drain one work stream into ONE shard_map'd
+RLC verify graph over an 8+ device mesh, with the step split into two
+separately-jitted graphs (parallel/mesh.verify_rlc_split_sharded):
+
+    local_fill     per-shard SHA / decompress / status ladder /
+                   Pippenger bucket fill+aggregation — no collectives
+    combine_tail   the window-partial all_gather + unified adds + the
+                   doubling-chain tails — the only cross-shard traffic
+
+so the dispatcher can DOUBLE-BUFFER the way wiredancer double-buffers
+DMA slots (wd_f1.c:327-408): batch k's combine_tail executes while
+batch k+1's local_fill is already dispatched. SZKP (arXiv 2408.05890)
+and ZK-Flex (2606.03046) teach the same dataflow at the accelerator
+level — aggregate MSM throughput is won by scheduling many bucket-fill
+units against one work stream and hiding the cross-unit reduction
+behind the next batch's fill; this is that schedule on the mesh.
+
+Shard placement is BACKLOG-AWARE round-robin: a transaction's
+signature lanes land together on the least-backlogged shard lane
+(round-robin among ties), so a burst of multisig transactions cannot
+starve one device while another pads. Per-shard occupancy is booked
+into `<label>.shardN` flight rows — the sentinel's shard-balance SLO
+(docs/SLO.md) and the pod smoke's 1.5x gate read those rows, and
+flight.merge_tile_metrics over them reproduces the service totals.
+
+The hardware headline (8-shard aggregate >= 1.04M verifies/s, beating
+wiredancer's 1.04M/s reference point) stays a LEDGERED PREDICTION
+(sentinel prediction 11) that auto-grades when an on-device
+MULTICHIP_r06+/POD artifact lands; on the virtual CPU mesh this module
+gates what CAN be gated there — bit-exact digests vs single-shard,
+split == monolithic, occupancy balance, and measured fill/tail overlap
+(pipelined 2-batch wall < serialized split-step sum).
+
+Host-side: numpy + the flight/engine/feed helpers; jax is imported
+lazily when the service actually builds its graphs.
+"""
+
+from __future__ import annotations
+
+import time
+from hashlib import sha256 as _sha256
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from firedancer_tpu import flags
+from firedancer_tpu.disco import flight
+from firedancer_tpu.disco.feed.slots import SlotPool
+
+FD_POD_MTU = 1232
+
+
+class ShardLane:
+    """One per-shard feeder lane: a SlotPool staging arena plus the
+    shard's flight row. The service's placement loop stages whole
+    transactions into the lane's FILLING slot; a slot commits (READY)
+    when it reaches the per-shard rung, and the dispatcher assembles
+    one global batch from one READY slot per lane."""
+
+    def __init__(self, idx: int, per_shard: int, max_msg_len: int,
+                 wksp=None, label: str = "verify.pod",
+                 n_slots: Optional[int] = None):
+        self.idx = idx
+        self.per_shard = per_shard
+        self.max_msg_len = max_msg_len
+        self.pool = SlotPool(n_slots or flags.get_int("FD_FEED_SLOTS"),
+                             per_shard, max_msg_len)
+        self.fl = flight.tile_lane(wksp, f"{label}.shard{idx}")
+        self.cur = None               # FILLING slot (service-owned)
+        # Per-slot txn metadata ((psig, payload digest) in stage
+        # order), keyed by slot index: a slot is exclusively filled,
+        # dispatched, then retired before reuse, so the retire pops
+        # its list before release. Keying by psig instead would
+        # collide on corrupted copies sharing the first 8 sig bytes.
+        self._slot_meta: Dict[int, list] = {}
+
+    # -- staging ---------------------------------------------------------
+
+    def room(self) -> int:
+        """Lane room left in the FILLING slot (per_shard with none)."""
+        if self.cur is None:
+            return self.per_shard
+        return self.per_shard - self.cur.n_lane
+
+    def backlog(self) -> int:
+        """Staged-but-undispatched lanes: the FILLING slot's fill plus
+        the READY queue, the placement signal (least-backlogged shard
+        wins a new transaction)."""
+        cur = self.cur.n_lane if self.cur is not None else 0
+        return cur + self.pool.ready_cnt() * self.per_shard
+
+    def _acquire(self):
+        slot = self.pool.acquire(5.0)
+        if slot is None:
+            raise RuntimeError(
+                f"fd_pod shard {self.idx}: no FREE staging slot within "
+                "5 s — the dispatcher stopped retiring batches"
+            )
+        return slot
+
+    def stage(self, items, psig: int, tsorig: int = 0,
+              digest: Optional[bytes] = None) -> None:
+        """Stage one transaction's (sig, pub, msg) lanes contiguously
+        into the FILLING slot (committing it first when the txn cannot
+        fit the remaining room — a txn's lanes never straddle slots,
+        so per-txn verdict folding stays self-contained)."""
+        n = len(items)
+        if n > self.per_shard:
+            raise ValueError(
+                f"txn with {n} signature lanes exceeds the per-shard "
+                f"batch {self.per_shard}"
+            )
+        if self.cur is not None and self.cur.n_lane + n > self.per_shard:
+            self.commit("capacity")
+        if self.cur is None:
+            self.cur = self._acquire()
+        slot = self.cur
+        for (sig, pub, msg) in items:
+            i = slot.n_lane
+            m = np.frombuffer(msg, np.uint8)[: self.max_msg_len]
+            slot.msgs[i, : len(m)] = m
+            slot.msgs[i, len(m):] = 0
+            slot.lens[i] = len(m)
+            slot.sigs[i] = np.frombuffer(sig, np.uint8)
+            slot.pubs[i] = np.frombuffer(pub, np.uint8)
+            slot.n_lane += 1
+        t = slot.n_txn
+        slot.tlanes[t] = n
+        slot.psigs[t] = psig
+        slot.tsorigs[t] = tsorig
+        if t == 0:
+            slot.t_first = time.monotonic_ns()
+        slot.n_txn += 1
+        self._slot_meta.setdefault(slot.idx, []).append((psig, digest))
+
+    def pop_meta(self, slot) -> list:
+        return self._slot_meta.pop(slot.idx, [])
+
+    def commit(self, verdict: str = "full") -> None:
+        if self.cur is None:
+            return
+        self.cur.flush_verdict = verdict
+        slot, self.cur = self.cur, None
+        self.pool.commit(slot)
+
+    def pop_ready(self):
+        return self.pool.pop_ready()
+
+    def release(self, slot) -> None:
+        self.pool.release(slot)
+
+
+class _PodInflight:
+    """One double-buffered batch: the async local_fill outputs, the
+    async combine_tail verdict, and the shard slots whose arenas the
+    global batch was assembled from."""
+
+    __slots__ = ("status", "definite", "ok", "slots", "arrays",
+                 "t_dispatch", "lanes")
+
+    def __init__(self, status, definite, ok, slots, arrays,
+                 t_dispatch: int, lanes: int):
+        self.status = status
+        self.definite = definite
+        self.ok = ok
+        self.slots = slots          # one per shard; None = padded shard
+        self.arrays = arrays        # (msgs, lens, sigs, pubs) jnp globals
+        self.t_dispatch = t_dispatch
+        self.lanes = lanes
+
+
+class PodVerifyService:
+    """The pod-scale sharded verify service: N ShardLanes feeding the
+    split-step mesh engine through a double-buffered dispatcher.
+
+    Single-threaded by contract (one placement/dispatch loop owns the
+    service — the fd_feed stager-thread split is the tile integration,
+    disco/tiles.py); every graph call is ASYNC, so the pipeline depth
+    comes from FD_POD_INFLIGHT, not host threads."""
+
+    def __init__(self, batch: int, n_shards: Optional[int] = None,
+                 max_msg_len: int = 256, wksp=None,
+                 label: str = "verify.pod",
+                 torsion_k: Optional[int] = None,
+                 inflight: Optional[int] = None,
+                 n_slots: Optional[int] = None,
+                 warm: bool = False):
+        import jax
+
+        from firedancer_tpu.disco import engine as fd_engine
+
+        self.n_shards = n_shards or flags.get_int("FD_MESH_DEVICES")
+        if batch % self.n_shards:
+            raise ValueError(
+                f"global batch {batch} must divide over {self.n_shards} "
+                "shards"
+            )
+        if not flags.get_bool("FD_POD_SPLIT"):
+            raise ValueError(
+                "PodVerifyService needs the split-step engine pair; "
+                "FD_POD_SPLIT=0 disables it (use the monolithic "
+                "verify_rlc_step_sharded path instead)"
+            )
+        self.batch = batch
+        self.per_shard = batch // self.n_shards
+        self.max_msg_len = max_msg_len
+        self.label = label
+        self.inflight_max = max(1, inflight
+                                or flags.get_int("FD_POD_INFLIGHT"))
+        self._torsion_k = torsion_k or flags.get_int("FD_RLC_TORSION_K")
+        self._jax = jax
+
+        # ONE registry engine (mode x B x shards x frontend): the split
+        # pair + the sharded per-lane fallback, with compile accounting
+        # booked where every other dispatch site books it.
+        self.spec = fd_engine.EngineSpec(
+            "rlc", batch, self.n_shards, fd_engine.current_frontend())
+        self.registry = fd_engine.registry()
+        self.entry, _ = self.registry.acquire(
+            self.spec, warm=warm, max_msg_len=max_msg_len)
+        if self.entry.fn_local is None or self.entry.fn_tail is None:
+            raise RuntimeError(
+                "engine build did not produce the fd_pod split pair "
+                f"for {self.spec.key} (FD_POD_SPLIT raced off?)"
+            )
+        self.fl = flight.tile_lane(wksp, label)
+        self.lanes = [
+            ShardLane(i, self.per_shard, max_msg_len, wksp=wksp,
+                      label=label, n_slots=n_slots)
+            for i in range(self.n_shards)
+        ]
+        self._rr = 0                  # round-robin tiebreak cursor
+        self._inflight: List[_PodInflight] = []
+        self.stat_batches = 0
+        self.stat_lanes = 0
+        self.stat_fallbacks = 0
+        self.stat_pad_slots = 0
+        self._results: List[Tuple[int, bool]] = []  # (psig, ok) folds
+        self._digests: List[bytes] = []
+
+    # -- placement -------------------------------------------------------
+
+    def place(self, n_lanes: int) -> int:
+        """Backlog-aware round-robin shard choice for a transaction
+        with n_lanes signature lanes: the least-backlogged lane that
+        can hold the txn wins; ties resolve round-robin so a quiet pod
+        still interleaves shards instead of piling on shard 0."""
+        order = [(self._rr + i) % self.n_shards
+                 for i in range(self.n_shards)]
+        fit = [i for i in order
+               if self.lanes[i].room() >= n_lanes] or order
+        best = min(fit, key=lambda i: self.lanes[i].backlog())
+        self._rr = (best + 1) % self.n_shards
+        return best
+
+    def stage_txn(self, payload: bytes, tsorig: int = 0) -> bool:
+        """Parse + place one transaction; False = parse reject (never
+        staged). The whole txn lands on one shard lane."""
+        from firedancer_tpu.ballet.txn import TxnParseError, parse_txn
+        from firedancer_tpu.disco.tiles import meta_sig
+
+        try:
+            txn = parse_txn(payload)
+            items = list(txn.verify_items(payload))
+        except TxnParseError:
+            return False
+        if not items or any(len(m) > self.max_msg_len
+                            for (_, _, m) in items):
+            return False
+        psig = meta_sig(payload)
+        shard = self.place(len(items))
+        self.lanes[shard].stage(items, psig, tsorig,
+                                digest=_sha256(payload).digest())
+        if self.lanes[shard].room() == 0:
+            self.lanes[shard].commit("full")
+        return True
+
+    # -- dispatch --------------------------------------------------------
+
+    def _assemble(self):
+        """One READY slot per shard -> the global batch arrays (shards
+        with nothing READY contribute a zero pad region — pad lanes
+        resolve definite exactly like the feed path's zeroed tail
+        rows). Returns None when NO shard has anything READY."""
+        slots = [lane.pop_ready() for lane in self.lanes]
+        if all(s is None for s in slots):
+            return None
+        jnp = self._jax.numpy
+        per, mml = self.per_shard, self.max_msg_len
+        msgs = np.zeros((self.batch, mml), np.uint8)
+        lens = np.zeros(self.batch, np.int32)
+        sigs = np.zeros((self.batch, 64), np.uint8)
+        pubs = np.zeros((self.batch, 32), np.uint8)
+        n_lanes = 0
+        for i, s in enumerate(slots):
+            if s is None:
+                self.stat_pad_slots += 1
+                continue
+            lo = i * per
+            n = s.n_lane
+            msgs[lo:lo + n] = s.msgs[:n]
+            lens[lo:lo + n] = s.lens[:n]
+            sigs[lo:lo + n] = s.sigs[:n]
+            pubs[lo:lo + n] = s.pubs[:n]
+            n_lanes += n
+            self.lanes[i].fl.inc("batches")
+            self.lanes[i].fl.inc("lanes", n)
+        arrays = (jnp.asarray(msgs), jnp.asarray(lens),
+                  jnp.asarray(sigs), jnp.asarray(pubs))
+        return slots, arrays, n_lanes
+
+    def dispatch_ready(self, force: bool = False) -> bool:
+        """Assemble + double-buffer-dispatch one global batch when the
+        pod has READY work (force commits every FILLING slot first —
+        the flush/drain path). Returns True when a batch went out."""
+        if force:
+            for lane in self.lanes:
+                if lane.cur is not None and lane.cur.n_txn:
+                    lane.commit("deadline")
+        asm = self._assemble()
+        if asm is None:
+            return False
+        slots, arrays, n_lanes = asm
+        # Enforce the window BEFORE enqueueing: at most inflight_max
+        # batch pairs live after this call, and FD_POD_INFLIGHT=1
+        # genuinely serializes (retire blocks on batch k's tail before
+        # batch k+1's fill is dispatched — the bisection behavior).
+        while len(self._inflight) >= self.inflight_max:
+            self._retire(self._inflight.pop(0))
+        from firedancer_tpu.ops.verify_rlc import fresh_u, fresh_z
+
+        jnp = self._jax.numpy
+        z = jnp.asarray(fresh_z(self.batch))
+        u = jnp.asarray(fresh_u(self._torsion_k, 2 * self.batch))
+        t0 = time.monotonic_ns()
+        # The double buffer: BOTH graphs enqueue asynchronously, so by
+        # the time this returns, batch k+1's local_fill can be
+        # dispatched while this batch's combine_tail still executes.
+        status, definite, parts = self.entry.fn_local(*arrays, z, u)
+        ok = self.entry.fn_tail(parts)
+        self._inflight.append(_PodInflight(
+            status, definite, ok, slots, arrays, t0, n_lanes))
+        self.entry.note_dispatch(n_lanes)
+        self.stat_batches += 1
+        self.stat_lanes += n_lanes
+        self.fl.inc("batches")
+        self.fl.inc("lanes", n_lanes)
+        return True
+
+    def _retire(self, ib: _PodInflight) -> None:
+        """Block on one batch's verdict, fall back per-lane when the
+        batch equation fails, fold per-txn results, release slots."""
+        ok = bool(np.asarray(ib.ok))
+        if ok:
+            statuses = np.asarray(ib.status)
+        else:
+            self.stat_fallbacks += 1
+            self.fl.inc("rlc_fallback")
+            statuses = np.asarray(self.entry.direct_fn(*ib.arrays))
+        # Deliberately NOT fed into entry.note_service: retirement is
+        # deferred until the inflight window overflows, so
+        # now - t_dispatch includes host staging/dwell of later batches
+        # — polluting the engine's shared cost model would make a
+        # VerifyTile RungScheduler on the same spec cap slack on queue
+        # dwell. The split EMAs come from measure_overlap's serialized
+        # halves, the only place the stages are individually observable.
+        per = self.per_shard
+        for i, s in enumerate(ib.slots):
+            if s is None:
+                continue
+            meta = self.lanes[i].pop_meta(s)
+            lo = i * per
+            off = lo
+            for t in range(s.n_txn):
+                cnt = int(s.tlanes[t])
+                lane_ok = bool(
+                    (statuses[off:off + cnt] == 0).all()) and cnt > 0
+                psig, digest = (meta[t] if t < len(meta)
+                                else (int(s.psigs[t]), None))
+                self._results.append((psig, lane_ok))
+                if lane_ok and digest is not None:
+                    self._digests.append(digest)
+                off += cnt
+            self.lanes[i].release(s)
+
+    def drain(self) -> None:
+        """Flush every staged txn and retire every in-flight batch."""
+        while True:
+            progressed = self.dispatch_ready(force=True)
+            while self._inflight:
+                self._retire(self._inflight.pop(0))
+            if not progressed:
+                if any(lane.cur is not None and lane.cur.n_txn
+                       for lane in self.lanes) or any(
+                           lane.pool.ready_cnt()
+                           for lane in self.lanes):
+                    continue
+                break
+
+    # -- results / stats -------------------------------------------------
+
+    def replay(self, payloads: List[bytes]) -> dict:
+        """The service driver: place + stage + dispatch the whole
+        payload list through the double-buffered pipeline, then drain.
+        Returns verdicts, sha256 digests of verified txns (sink-digest
+        parity material), and the occupancy/overlap stats."""
+        t0 = time.perf_counter()
+        parse_rejects = 0
+        for p in payloads:
+            if not self.stage_txn(p):
+                parse_rejects += 1
+            # Ship as soon as every shard can contribute — the
+            # steady-state cadence that keeps the double buffer full.
+            if all(lane.pool.ready_cnt() > 0 for lane in self.lanes):
+                self.dispatch_ready()
+        self.drain()
+        elapsed = time.perf_counter() - t0
+        ok_cnt = sum(1 for _, ok in self._results if ok)
+        return {
+            "n": len(payloads),
+            "parse_rejects": parse_rejects,
+            "verified_ok": ok_cnt,
+            "verified_fail": len(self._results) - ok_cnt,
+            "digests": list(self._digests),
+            "elapsed_s": elapsed,
+            "stats": self.stats(),
+        }
+
+    def shard_occupancy(self) -> List[int]:
+        return [lane.fl.get("lanes") for lane in self.lanes]
+
+    def balance_ratio(self) -> float:
+        """Busiest/laziest shard dispatched-lane ratio (the 1.5x
+        acceptance gate; inf when a shard never saw a lane)."""
+        occ = self.shard_occupancy()
+        lo = min(occ)
+        return float(max(occ)) / lo if lo else float("inf")
+
+    def stats(self) -> dict:
+        return {
+            "engine": self.spec.key,
+            "shards": self.n_shards,
+            "batch": self.batch,
+            "batches": self.stat_batches,
+            "lanes": self.stat_lanes,
+            "fill_ratio": round(
+                self.stat_lanes / float(self.stat_batches * self.batch),
+                4) if self.stat_batches else 0.0,
+            "rlc_fallbacks": self.stat_fallbacks,
+            "pad_slots": self.stat_pad_slots,
+            "shard_lanes": self.shard_occupancy(),
+            "shard_balance": (round(self.balance_ratio(), 3)
+                              if self.stat_lanes else 0.0),
+            "split": {
+                "service_local_ns": self.entry.service_local_ns,
+                "service_tail_ns": self.entry.service_tail_ns,
+                "overlap_hidden_est": round(
+                    self.entry.overlap_hidden_est(), 3),
+            },
+        }
+
+    # -- the overlap probe (the acceptance measurement) ------------------
+
+    def measure_overlap(self, payloads: List[bytes],
+                        rounds: int = 2) -> dict:
+        """Pipelined vs serialized split-step wall time over TWO global
+        batches assembled from `payloads` (best-of-`rounds` each, the
+        bench discipline for jittery hosts).
+
+        serialized  = lf(1); BLOCK; ct(1); BLOCK; lf(2); BLOCK; ct(2); BLOCK
+        pipelined   = lf(1); ct(1); lf(2); ct(2); BLOCK — the double
+                      buffer: batch 2's fill is dispatched while batch
+                      1's tail executes, so any overlap the runtime
+                      finds (host dispatch under device execution, and
+                      on real hardware the collective under the next
+                      fill) shows up as pipelined < serialized.
+
+        Feeds the engine's split service EMAs from the serialized
+        halves (the only place the two stages are individually
+        observable). Returns the measured walls + overlap."""
+        jax, jnp = self._jax, self._jax.numpy
+        from firedancer_tpu.ops.verify_rlc import fresh_u, fresh_z
+
+        batches = []
+        for k in range(2):
+            svc_slice = payloads[k::2]
+            msgs = np.zeros((self.batch, self.max_msg_len), np.uint8)
+            lens = np.zeros(self.batch, np.int32)
+            sigs = np.zeros((self.batch, 64), np.uint8)
+            pubs = np.zeros((self.batch, 32), np.uint8)
+            i = 0
+            from firedancer_tpu.ballet.txn import (
+                TxnParseError,
+                parse_txn,
+            )
+
+            for p in svc_slice:
+                try:
+                    items = list(parse_txn(p).verify_items(p))
+                except TxnParseError:
+                    continue
+                # Whole txns only, stage_txn's rule: a truncated
+                # multisig would time a batch shape the service never
+                # produces.
+                if (i + len(items) > self.batch
+                        or any(len(m) > self.max_msg_len
+                               for (_, _, m) in items)):
+                    continue
+                for (sg, pb, m) in items:
+                    mm = np.frombuffer(m, np.uint8)
+                    msgs[i, : len(mm)] = mm
+                    lens[i] = len(mm)
+                    sigs[i] = np.frombuffer(sg, np.uint8)
+                    pubs[i] = np.frombuffer(pb, np.uint8)
+                    i += 1
+            rng = np.random.default_rng(0xF1D0 + k)
+            batches.append((
+                (jnp.asarray(msgs), jnp.asarray(lens),
+                 jnp.asarray(sigs), jnp.asarray(pubs)),
+                jnp.asarray(fresh_z(self.batch, rng)),
+                jnp.asarray(fresh_u(self._torsion_k, 2 * self.batch,
+                                    rng)),
+            ))
+
+        lf, ct = self.entry.fn_local, self.entry.fn_tail
+        # Warm both graphs on the real shapes first (compile must not
+        # pollute either measurement).
+        for arrays, z, u in batches:
+            out = lf(*arrays, z, u)
+            jax.block_until_ready(ct(out[2]))
+
+        best_serial = best_pipe = float("inf")
+        local_ns = tail_ns = 0
+        for _ in range(max(1, rounds)):
+            t0 = time.perf_counter()
+            l_ns = t_ns = 0
+            for arrays, z, u in batches:
+                ta = time.monotonic_ns()
+                out = jax.block_until_ready(lf(*arrays, z, u))
+                tb = time.monotonic_ns()
+                jax.block_until_ready(ct(out[2]))
+                tc = time.monotonic_ns()
+                l_ns += tb - ta
+                t_ns += tc - tb
+            serial = time.perf_counter() - t0
+            if serial < best_serial:
+                best_serial, local_ns, tail_ns = serial, l_ns // 2, \
+                    t_ns // 2
+
+            t0 = time.perf_counter()
+            pending = []
+            for arrays, z, u in batches:
+                out = lf(*arrays, z, u)
+                pending.append(ct(out[2]))
+            jax.block_until_ready(pending)
+            best_pipe = min(best_pipe, time.perf_counter() - t0)
+
+        self.entry.note_service_split(local_ns, tail_ns)
+        overlap_s = best_serial - best_pipe
+        return {
+            "serialized_ms": round(best_serial * 1e3, 3),
+            "pipelined_ms": round(best_pipe * 1e3, 3),
+            "overlap_ms": round(overlap_s * 1e3, 3),
+            "overlap_frac": round(overlap_s / best_serial, 4)
+            if best_serial else 0.0,
+            "local_fill_ms": round(local_ns / 1e6, 3),
+            "combine_tail_ms": round(tail_ns / 1e6, 3),
+            "tail_hidden_est": round(self.entry.overlap_hidden_est(), 3),
+        }
+
+
+def pod_replay(payloads: List[bytes], batch: int,
+               n_shards: Optional[int] = None, max_msg_len: int = 256,
+               wksp=None, **kw) -> dict:
+    """One-call service replay (the smoke/test surface): build a
+    PodVerifyService, run the payload list through the double-buffered
+    pipeline, return the result dict with the service attached."""
+    svc = PodVerifyService(batch, n_shards=n_shards,
+                           max_msg_len=max_msg_len, wksp=wksp, **kw)
+    out = svc.replay(payloads)
+    out["service"] = svc
+    return out

@@ -1136,7 +1136,8 @@ class VerifyTile(Tile):
                          for r in rungs if r != batch],
                         max_msg_len=max_msg_len)
                 self.rung_sched = fd_engine.RungScheduler(
-                    rungs, self.max_wait_ns, cost_ns=cost)
+                    rungs, self.max_wait_ns, cost_ns=cost,
+                    shards=mesh_devices or 1)
                 # ONE flush policy object: the stager's verdict calls
                 # go through the scheduler's embedded AdaptiveFlush, so
                 # the property-tested decide()/due() surface and the
@@ -1909,6 +1910,13 @@ class VerifyTile(Tile):
         ))
         self.fl.inc("batches")
         self.fl.inc("lanes", slot.n_lane)
+        # fd_pod occupancy: the feed path books per-shard lanes too
+        # (the legacy dispatchers always did), over the DISPATCHED
+        # shape — a reduced rung splits `rung` lanes over the mesh,
+        # not the tile's staging batch. The shard rows are what the
+        # sentinel's shard-balance SLO and the smoke's 1.5x occupancy
+        # gate read.
+        self._book_shard_lanes(slot.n_lane, shape=rung)
         ev = {"lanes": slot.n_lane, "device": via_device}
         if self.rung_sched is not None:
             # Per-rung dispatch accounting: the histogram the replay
@@ -2350,16 +2358,18 @@ class VerifyTile(Tile):
         self._flush_if_due()
         self._complete(block=False)
 
-    def _book_shard_lanes(self, n_lane: int) -> None:
+    def _book_shard_lanes(self, n_lane: int, shape: int = 0) -> None:
         """Per-mesh-shard dispatch accounting: shard_map partitions the
         batch axis contiguously over 'dp', so shard i owns lanes
         [i*per, (i+1)*per) — book each shard's slice of the real (non-
         pad) lanes into its flight row. The slices sum to n_lane by
         construction, so the merged (sum-of-shards) snapshot equals
-        this tile's own lanes counter (test-pinned)."""
+        this tile's own lanes counter (test-pinned). `shape` is the
+        dispatched batch when it differs from the staging batch (a
+        reduced fd_engine rung on the feed path)."""
         if not self.fl_shards:
             return
-        per = self.batch // len(self.fl_shards)
+        per = (shape or self.batch) // len(self.fl_shards)
         for i, lane in enumerate(self.fl_shards):
             lane.inc("batches")
             lane.inc("lanes", min(max(n_lane - i * per, 0), per))
@@ -2666,7 +2676,21 @@ class VerifyTile(Tile):
 
 
 class DedupTile(Tile):
-    """tcache dedup on the frag meta sig (disco/dedup/fd_dedup.c)."""
+    """tcache dedup on the frag meta sig (disco/dedup/fd_dedup.c).
+
+    The hot path is VECTORIZED over the bulk fd_frag_drain rounds
+    (round-18, the REPLAY_CPU lever): one C drain call per round, the
+    membership test batched through TCache.insert_batch (numpy
+    unique/scatter instead of a per-frag Python probe), the CTL_ERR
+    and duplicate masks folded with numpy, diag counters published as
+    per-round sums, and every surviving frag forwarded with ONE
+    fd_frag_publish_bulk call per credit window — the per-frag Python
+    (Frag construction, on_frag dispatch, per-frag dcache/mcache
+    ctypes round-trips) that made dedup the host pipeline's widest
+    per-frag stage is gone from the steady state. on_frag keeps the
+    exact legacy semantics for the pure-Python poll path (no native
+    .so) and is the behavior oracle the bulk path is content-pinned
+    against."""
 
     name = "dedup"
 
@@ -2677,6 +2701,140 @@ class DedupTile(Tile):
         super().__init__(wksp, cnc_name, in_link=in_link, out_link=out_link,
                          in_links=in_links, **kw)
         self.tcache = TCache(tcache_depth)
+
+    def poll_inputs(self):
+        if Tile._bulk_ok is None:
+            from firedancer_tpu.tango.rings import native_available
+
+            Tile._bulk_ok = native_available()
+        if not Tile._bulk_ok or self.out_link is None:
+            return super().poll_inputs()
+        progressed = False
+        overrun = False
+        for il in self.in_links:
+            st = self._bulk_state(il)
+            ct = st["ct"]
+            seq = ct.c_uint64(il.seq)
+            ovr0 = int(st["ctr"][1])
+            args = [
+                il.mcache._mem, ct.addressof(il.dcache._buf),
+                ct.byref(seq), self.BULK_FRAGS, st["cap"],
+                st["pay"].ctypes.data, st["pay"].nbytes,
+                st["offs"].ctypes.data, st["lens"].ctypes.data,
+                st["sigs"].ctypes.data, st["ts"].ctypes.data,
+                st["seqs"].ctypes.data,
+            ]
+            if st["has_ctl"]:
+                args.append(st["ctls"].ctypes.data)
+            if st["has_tspub"]:
+                args.append(st["tspubs"].ctypes.data)
+            args.append(st["ctr"].ctypes.data)
+            n = st["lib"].fd_frag_drain(*args)
+            d_ovr = int(st["ctr"][1]) - ovr0
+            if d_ovr:
+                il.fseq.diag_add(DIAG_OVRNR_CNT, d_ovr)
+                overrun = True
+            if n > 0:
+                self.in_cur = il
+                self._dedup_round(il, st, n)
+                progressed = True
+            # Cursor semantics match the base bulk path: il.seq
+            # advances only after the round is fully processed, so a
+            # crash mid-round replays it (dedup itself absorbs the
+            # replays downstream of a respawn).
+            il.seq = seq.value
+        return progressed, overrun
+
+    def _dedup_round(self, il, st, n: int) -> None:
+        """One vectorized dedup round: masks + counters + bulk publish
+        — per-frag semantics (CTL_ERR drop before the tcache insert,
+        whole-payload order preserved, tsorig carried through, sampled
+        xray dwell) exactly as on_frag, minus the per-frag Python."""
+        lens = st["lens"][:n]
+        sigs = st["sigs"][:n]
+        err = ((st["ctls"][:n] & CTL_ERR) != 0) if st["has_ctl"] \
+            else np.zeros(n, np.bool_)
+        # CTL_ERR frags (quarantine audit trail) are counted + dropped
+        # BEFORE the tcache probe — a poisoned copy must never shadow
+        # the valid same-sig txn out of the dedup window — so only the
+        # clean frags' sigs enter the batched membership test.
+        clean = ~err
+        dup = np.zeros(n, np.bool_)
+        if clean.any():
+            dup[clean] = self.tcache.insert_batch(sigs[clean])
+        filt = err | dup
+        n_filt = int(filt.sum())
+        if n_filt:
+            il.fseq.diag_add(DIAG_FILT_CNT, n_filt)
+            il.fseq.diag_add(DIAG_FILT_SZ, int(lens[filt].sum()))
+        if il.xq is not None and st["has_tspub"]:
+            # Stride-sampled queue dwell, same cadence as the per-frag
+            # path (every xq_every'th drained frag).
+            now32 = tempo.tickcount() & 0xFFFFFFFF
+            sel = np.nonzero((il.xq_cnt + 1 + np.arange(n))
+                             % il.xq_every == 0)[0]
+            il.xq_cnt += n
+            for i in sel.tolist():
+                tspub = int(st["tspubs"][i])
+                if tspub:
+                    il.xq.observe_dwell((now32 - tspub) & 0xFFFFFFFF)
+        mask8 = (~filt).astype(np.uint8)
+        n_ok = int(mask8.sum())
+        if not n_ok:
+            return
+        ol = self.out_link
+        ct = st["ct"]
+        seqv = ct.c_uint64(ol.seq)
+        chunkv = ct.c_uint32(ol.chunk)
+        cursor = ct.c_uint32(0)
+        bytes_out = np.zeros(1, np.uint64)
+        now32 = tempo.tickcount() & 0xFFFFFFFF
+        published = 0
+        halted = False
+        while published < n_ok and not halted:
+            # Credit-windowed bulk publish: publish_backp's fctl
+            # discipline (spin through backpressure, drop on HALT),
+            # amortized over the window instead of paid per frag.
+            t_stall = 0
+            while not ol.can_publish():
+                if self.cnc.signal_query() == CNC_HALT:
+                    halted = True  # drop the rest, like publish_backp
+                    break
+                if not t_stall:
+                    t_stall = tempo.tickcount()
+                self.cnc.diag_add(CNC_DIAG_BACKP_CNT, 1)
+                time.sleep(20e-6)
+            if t_stall and ol.xq_tx is not None:
+                ol.xq_tx.add_stall(tempo.tickcount() - t_stall)
+            if halted:
+                break
+            pub = st["lib"].fd_frag_publish_bulk(
+                ol.mcache._mem, ct.addressof(ol.dcache._buf),
+                ol.dcache.chunk_cnt, ol.mtu,
+                ct.byref(seqv), ct.byref(chunkv),
+                st["pay"].ctypes.data,
+                st["offs"].ctypes.data, st["lens"].ctypes.data,
+                st["sigs"].ctypes.data, st["ts"].ctypes.data,
+                mask8.ctypes.data, ct.byref(cursor), n,
+                min(ol.cr_avail, n_ok - published), now32,
+                bytes_out.ctypes.data,
+            )
+            ol.seq = seqv.value
+            ol.chunk = chunkv.value
+            ol.cr_avail = max(0, ol.cr_avail - pub)
+            published += pub
+            if pub <= 0:
+                break  # defensive: cursor exhausted without publishes
+        il.fseq.diag_add(DIAG_PUB_CNT, published)
+        il.fseq.diag_add(DIAG_PUB_SZ, int(bytes_out[0]))
+        # Stage-latency samples (OutLink.publish is bypassed on the
+        # bulk path): vectorized histogram + reservoir, the
+        # _publish_feed_batch pattern.
+        ts = st["ts"][:n][~filt]
+        ts = ts[ts != 0]
+        if ts.size:
+            lats = (now32 - ts.astype(np.int64)) & 0xFFFFFFFF
+            ol.lat_sample_many(lats, ts)
 
     def on_frag(self, frag: Frag, payload: bytes) -> None:
         if frag.ctl & CTL_ERR:

@@ -134,15 +134,17 @@ def main(argv=None) -> int:
 
         _os.environ["JAX_PLATFORMS"] = plat
         if plat == "cpu":
-            # Match the test conftest's 8-device virtual CPU config so
+            # Match the test conftest's virtual CPU device config so
             # the worker's jit compiles HIT the same persistent cache
             # (the compile key covers the device topology; a 1-device
             # worker would re-pay multi-minute compiles every boot).
-            flags = _os.environ.get("XLA_FLAGS", "")
-            if "xla_force_host_platform_device_count" not in flags:
-                _os.environ["XLA_FLAGS"] = (
-                    flags + " --xla_force_host_platform_device_count=8"
-                ).strip()
+            # Count + env dance live in ONE place (FD_MESH_DEVICES via
+            # parallel/multihost.patch_host_device_count; default 8).
+            from firedancer_tpu.parallel.multihost import (
+                patch_host_device_count,
+            )
+
+            patch_host_device_count()
         import jax
 
         try:

@@ -242,6 +242,47 @@ _register(
     "measurement). Read at tile construction, not inside traced code.",
 )
 _register(
+    "FD_POD_SPLIT", bool, True,
+    "fd_pod split-step dispatch for the mesh-sharded RLC pass: build "
+    "the verify engine as TWO jitted graphs — local_fill (per-shard "
+    "SHA/decompress/bucket fill, no collectives) and combine_tail "
+    "(the window-partial all_gather + unified adds + doubling-chain "
+    "tails) — so the dispatcher double-buffers batch k's combine_tail "
+    "against batch k+1's local_fill (parallel/mesh."
+    "verify_rlc_split_sharded). '0' is the bisection hatch that keeps "
+    "the monolithic single-graph sharded step (bit-exact either way). "
+    "Read at engine build, not inside traced code.",
+)
+_register(
+    "FD_POD_INFLIGHT", int, 2,
+    "fd_pod dispatcher depth: how many (local_fill, combine_tail) "
+    "batch pairs may be in flight before the pod service blocks on "
+    "the oldest completion — 2 is classic double-buffering "
+    "(wiredancer's DMA slot pair).",
+)
+_register(
+    "FD_POD_SMOKE_N", int, 140,
+    "pod_smoke corpus size (txns). The default keeps the forced "
+    "8-device CPU-mesh lane's wall time bounded on 1-core CI hosts "
+    "while still dispatching several full global batches.",
+)
+_register(
+    "FD_POD_SMOKE_BATCH", int, 32,
+    "pod_smoke global batch (lanes; must be divisible by "
+    "FD_MESH_DEVICES so it splits over the shards): the "
+    "sharded verify graphs compile at this shape, so the smoke keeps "
+    "it small — production rungs come from FD_ENGINE_LADDER instead.",
+)
+_register(
+    "FD_MESH_DEVICES", int, 8,
+    "Virtual host-platform device count for CPU mesh runs: the value "
+    "patched into XLA_FLAGS --xla_force_host_platform_device_count by "
+    "worker boot and parallel/multihost when no explicit count is "
+    "given. Must match across processes sharing a persistent compile "
+    "cache (the compile key covers the device topology). Real TPU "
+    "hosts ignore it (the plugin enumerates hardware).",
+)
+_register(
     "FD_VERIFY_MODE", str, None,
     "Force the verify tile's device mode: 'rlc' (batch RLC over the "
     "Pippenger MSM) or 'direct' (per-lane). Unset = platform auto "
@@ -666,6 +707,16 @@ _register(
     "defenses exist to keep shallow: a breach means completed "
     "transactions are stalling INSIDE the front door instead of being "
     "admitted or shed.",
+)
+_register(
+    "FD_SLO_SHARD_BALANCE_PCT", int, 150,
+    "fd_pod shard-occupancy balance budget, percent: on a mesh run "
+    "the busiest shard lane's dispatched-lane count may exceed the "
+    "laziest's by at most this ratio x100 (150 = within 1.5x) once "
+    "every shard has seen real volume. A breach means shard placement "
+    "is starving a device — aggregate throughput degrades to the "
+    "slowest shard's. Evaluated over the per-shard flight rows "
+    "(verify.shardN), so it works cross-process like every other SLO.",
 )
 # --------------------------------------------------------------------------
 # fd_xray — tail-sampled exemplar traces, per-edge queue attribution,

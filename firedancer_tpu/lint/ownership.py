@@ -217,7 +217,13 @@ WRITER_TABLE: Dict[str, Tuple[str, ...]] = {
     # fd_siege admit_shed/queue_shed/quarantine counters); regions are
     # created once by build_topology.
     "flight.tile_lane": ("firedancer_tpu/disco/tiles.py",
-                         "firedancer_tpu/disco/quic_tile.py"),
+                         "firedancer_tpu/disco/quic_tile.py",
+                         # fd_pod service rows (verify.pod +
+                         # verify.pod.shardN): written by the ONE
+                         # placement/dispatch loop that owns the
+                         # PodVerifyService (single-threaded by
+                         # contract, see the class docstring).
+                         "firedancer_tpu/disco/pod.py"),
     "flight.create_regions": ("firedancer_tpu/disco/pipeline.py",),
     # fd_xray: queue-region creation (build_topology, once), the
     # per-edge rx/tx telemetry rows (consumer/producer tile of the

@@ -93,6 +93,19 @@ def _default_rounds(bsz: int, n_buckets: int = N_BUCKETS) -> int:
     return default_rounds(bsz, n_buckets)
 
 
+def combine_stacked(pt):
+    """Fold a leading-axis stack of point partials ((N, ...) limb
+    arrays per coordinate) into their group sum with unified adds, in
+    stack order — the one folding rule every cross-shard combine path
+    (monolithic all_gather and the fd_pod split tail alike) goes
+    through, so the two compositions can never drift bit-wise."""
+    n = pt[0].shape[0]
+    acc = tuple(c[0] for c in pt)
+    for d in range(1, n):
+        acc = ge.point_add(acc, tuple(c[d] for c in pt))
+    return acc
+
+
 def _gather_point_sum(pt, axis_name: str):
     """Combine per-device point partials into the global sum, on every
     device: all_gather the (X, Y, Z, T) limb arrays over the mesh axis
@@ -103,11 +116,7 @@ def _gather_point_sum(pt, axis_name: str):
     work they summarize. This is the only cross-device traffic in the
     sharded MSM."""
     g = tuple(jax.lax.all_gather(c, axis_name) for c in pt)  # (N, ...)
-    n = g[0].shape[0]
-    acc = tuple(c[0] for c in g)
-    for d in range(1, n):
-        acc = ge.point_add(acc, tuple(c[d] for c in g))
-    return acc
+    return combine_stacked(g)
 
 
 def _all_shards_ok(ok, axis_name: str):
@@ -175,16 +184,37 @@ def msm(scalars_bytes: jnp.ndarray, points, n_windows: int,
       () bool — False iff a bucket overflowed max_rounds (result then
       invalid; caller must use the exact path).
     """
+    w_res, ok = msm_partial(scalars_bytes, points, n_windows,
+                            max_rounds=max_rounds)
+    return msm_combine(w_res, ok, n_windows, axis_name=axis_name)
+
+
+def msm_partial(scalars_bytes: jnp.ndarray, points, n_windows: int,
+                max_rounds: int | None = None):
+    """The LOCAL half of msm(): digit staging + bucket fill + per-window
+    bucket aggregation over this shard's lanes only — no collectives, no
+    doubling-chain tails. Returns (w_res, ok): w_res a (32, n_windows)-
+    limb point per window (W_t = sum over local lanes), ok the local
+    fill verdict. msm_combine finishes the job; fd_pod's split-step
+    dispatcher jits the two halves separately so batch k's combine can
+    execute while batch k+1's fill is already dispatched."""
     bsz = points[0].shape[1]
     if max_rounds is None:
         max_rounds = _default_rounds(bsz)
-    nw = n_windows
-    idx, ok = _staging_indices(scalars_bytes, nw, bsz, max_rounds)
-    w_res = _fill_and_aggregate(idx, points, max_rounds, nw)
+    idx, ok = _staging_indices(scalars_bytes, n_windows, bsz, max_rounds)
+    return _fill_and_aggregate(idx, points, max_rounds, n_windows), ok
+
+
+def msm_combine(w_res, ok, n_windows: int, axis_name: str | None = None):
+    """The TAIL half of msm(): combine per-shard window partials across
+    the mesh (axis_name; identity when None) and run the cross-window
+    Horner doubling chain. msm() == msm_combine(*msm_partial(...)) by
+    construction — the composition is the exact op sequence the
+    monolithic path always ran, so the split is bit-exact."""
     if axis_name is not None:
         w_res = _gather_point_sum(w_res, axis_name)
         ok = _all_shards_ok(ok, axis_name)
-    return _window_horner(w_res, nw), ok
+    return _window_horner(w_res, n_windows), ok
 
 
 def _fill_and_aggregate(idx, points, max_rounds: int, nw: int):
@@ -305,6 +335,16 @@ def subgroup_check(points, u_digits: jnp.ndarray,
     overflowed max_rounds (trials then unusable; the caller must treat
     the set as uncertified and take its exact path).
     """
+    agg, ok_fill = subgroup_partial(points, u_digits,
+                                    max_rounds=max_rounds)
+    return subgroup_combine(agg, ok_fill, axis_name=axis_name)
+
+
+def subgroup_partial(points, u_digits: jnp.ndarray,
+                     max_rounds: int | None = None):
+    """Local half of subgroup_check: the K per-trial aggregates over
+    THIS shard's lanes only ((32, K)-limb coords) + the local fill
+    verdict — no collectives, no [L] ladder."""
     bsz = points[0].shape[1]
     if max_rounds is None:
         max_rounds = _default_rounds(bsz)
@@ -313,6 +353,14 @@ def subgroup_check(points, u_digits: jnp.ndarray,
         u_digits.astype(jnp.int32), bsz, max_rounds
     )
     agg = _fill_and_aggregate(idx, points, max_rounds, k)  # (32, K) coords
+    return agg, ok_fill
+
+
+def subgroup_combine(agg, ok_fill, axis_name: str | None = None):
+    """Tail half of subgroup_check: cross-mesh per-trial combine (when
+    axis_name), the [L] doubling ladder, and the identity test.
+    subgroup_check == subgroup_combine(*subgroup_partial(...)) — same
+    op sequence, so the split is bit-exact."""
     if axis_name is not None:
         agg = _gather_point_sum(agg, axis_name)
         ok_fill = _all_shards_ok(ok_fill, axis_name)
@@ -372,6 +420,21 @@ def msm_fast(scalars_bytes: jnp.ndarray, points, n_windows: int,
     aggregation running sums live in VMEM (ops/msm_pallas.py); the
     sort/gather staging and final Horner remain XLA.
     """
+    w_res, ok = msm_fast_partial(scalars_bytes, points, n_windows,
+                                 max_rounds=max_rounds,
+                                 interpret=interpret, niels=niels)
+    return msm_fast_combine(w_res, ok, n_windows, interpret=interpret,
+                            axis_name=axis_name)
+
+
+def msm_fast_partial(scalars_bytes: jnp.ndarray, points, n_windows: int,
+                     max_rounds: int | None = None,
+                     interpret: bool = False, niels=None):
+    """Local half of msm_fast: niels staging + VMEM bucket fill +
+    running-sum aggregation over this shard's lanes — no collectives,
+    no Horner. Returns (w_res, ok) exactly like msm_partial (the kernel
+    aggregation's nw padding is trimmed here, so the partial's shape is
+    engine-independent and the fd_pod split tail can gather it)."""
     from . import msm_pallas as mp
 
     bsz = points[0].shape[1]
@@ -403,12 +466,21 @@ def msm_fast(scalars_bytes: jnp.ndarray, points, n_windows: int,
         fe.FE_D2.astype(jnp.int32),
         interpret=interpret,
     )
-    w_res = tuple(c[:, :nw] for c in w_res)
+    return tuple(c[:, :nw] for c in w_res), ok
+
+
+def msm_fast_combine(w_res, ok, n_windows: int, interpret: bool = False,
+                     axis_name: str | None = None):
+    """Tail half of msm_fast: cross-mesh window-partial combine + the
+    VMEM Horner doubling chain. msm_fast == the composition, bit-exact
+    (same op order the monolithic path always ran)."""
+    from . import msm_pallas as mp
+
     if axis_name is not None:
         w_res = _gather_point_sum(w_res, axis_name)
         ok = _all_shards_ok(ok, axis_name)
     res = mp.window_horner_pallas(
-        w_res, fe.FE_D2.astype(jnp.int32), nw, interpret=interpret,
+        w_res, fe.FE_D2.astype(jnp.int32), n_windows, interpret=interpret,
         w_bits=W_BITS,
     )
     return res, ok
@@ -447,6 +519,25 @@ def subgroup_check_fast(points, u_digits: jnp.ndarray,
       kernels (the XLA ladder alone cost more than the entire direct
       verify at production batch sizes).
     """
+    agg, ok_fill = subgroup_fast_partial(
+        points, u_digits, bucket_bits=bucket_bits, max_rounds=max_rounds,
+        interpret=interpret, niels=niels,
+    )
+    return subgroup_fast_combine(agg, ok_fill, k=u_digits.shape[0],
+                                 interpret=interpret, axis_name=axis_name)
+
+
+def subgroup_fast_partial(points, u_digits: jnp.ndarray,
+                          bucket_bits: int = 5,
+                          max_rounds: int | None = None,
+                          interpret: bool = False, niels=None):
+    """Local half of subgroup_check_fast: masked-digit staging + VMEM
+    fill + per-trial aggregation over this shard's lanes. Returns
+    (agg, ok_fill) with agg at the kernel's Mosaic-padded trial width
+    (k_pad = k rounded up to 128); the pad lanes are ZERO coordinate
+    limbs, which every downstream group op maps to zero and the final
+    identity test trivially passes — so a combine that does not know k
+    can evaluate all k_pad lanes and reach the same verdict."""
     from . import msm_pallas as mp
 
     bsz = points[0].shape[1]
@@ -477,12 +568,25 @@ def subgroup_check_fast(points, u_digits: jnp.ndarray,
         fe.FE_D2.astype(jnp.int32),
         interpret=interpret,
     )
+    return agg, ok_fill
+
+
+def subgroup_fast_combine(agg, ok_fill, k: int | None = None,
+                          interpret: bool = False,
+                          axis_name: str | None = None):
+    """Tail half of subgroup_check_fast: cross-mesh per-trial combine,
+    the VMEM [L] ladder, and the identity test over the first k trial
+    lanes (k=None evaluates every padded lane — sound, see
+    subgroup_fast_partial's zero-pad note)."""
+    from . import msm_pallas as mp
+
     if axis_name is not None:
         agg = _gather_point_sum(agg, axis_name)
         ok_fill = _all_shards_ok(ok_fill, axis_name)
     la = mp.mul_by_group_order_pallas(
         agg, fe.FE_D2.astype(jnp.int32), _l_bits_col(), interpret=interpret
     )
-    la = tuple(c[:, :k] for c in la)
+    if k is not None:
+        la = tuple(c[:, :k] for c in la)
     ok = fe.fe_is_zero(la[0]) & fe.fe_eq(la[1], la[2])     # (K,) identity
     return jnp.all(ok), ok_fill

@@ -66,8 +66,6 @@ analysis that motivates the promotion.
 
 from __future__ import annotations
 
-import functools
-
 import jax.numpy as jnp
 import numpy as np
 
@@ -187,6 +185,34 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits,
                 live lane's A and R are certified torsion-free, i.e.
                 every non-definite lane is genuinely SUCCESS. On False
                 the caller re-runs the per-lane path.
+
+    fd_pod split (round-18): the body is verify_rlc_local (per-lane
+    stages + local bucket fills, no collectives) composed with
+    verify_rlc_combine (the cross-mesh gathers + doubling-chain tails)
+    — the exact op sequence the monolithic step always ran, so this
+    single-graph path stays bit-exact while parallel/mesh.py can jit
+    the two halves separately and double-buffer them.
+    """
+    status, definite, parts = verify_rlc_local(
+        msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits)
+    batch_ok = verify_rlc_combine(parts, axis_name=axis_name)
+    return status, definite, batch_ok
+
+
+def verify_rlc_local(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
+    """The LOCAL half of one RLC pass: s-range, stacked decompression,
+    the fused SHA/mod-L front half, the status ladder, and the three
+    Pippenger bucket fills/aggregations over THIS shard's lanes — no
+    collectives, no doubling-chain tails.
+
+    Returns (status, definite, parts): status/definite as
+    verify_batch_rlc; parts the pytree of per-shard partials
+    verify_rlc_combine consumes —
+      w_r / ok_r    window partials + fill verdict of the z*(-R) MSM
+      w_m / ok_m    same for the [m*(-A), u*B] 253-bit MSM
+      sub / sub_ok  per-trial torsion aggregates + fill verdict
+    Every leaf is a small array ((32, nw)-limb coords, () bools), so
+    shipping parts between two jitted graphs costs microseconds.
     """
     r_bytes = sigs[:, :32]
     s_bytes = sigs[:, 32:]
@@ -297,38 +323,75 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits,
         )}
         kw_sub = {"niels": (yp, ym, t2d)}
     # Decompressed points have Z == 1, so the niels fast path applies.
-    # axis_name threads through to the engines: local bucket work, one
-    # cross-mesh window-partial combine before each doubling-chain tail.
-    if engine == "xla":
-        msm_impl = functools.partial(msm_mod.msm, axis_name=axis_name)
-        sub_impl = functools.partial(
-            msm_mod.subgroup_check, axis_name=axis_name
-        )
-    else:
-        interp = engine == "interpret"
-        msm_impl = functools.partial(msm_mod.msm_fast, interpret=interp,
-                                     axis_name=axis_name)
-        sub_impl = functools.partial(
-            msm_mod.subgroup_check_fast, interpret=interp,
-            axis_name=axis_name,
-        )
-    t1, ok1 = msm_impl(z_live, neg_r, n_windows=msm_mod.WINDOWS_Z,
-                       **kw_r)
-    t2, ok2 = msm_impl(m_all, pts_all, n_windows=msm_mod.WINDOWS_253,
-                       **kw_m)
-    # T = u*B + sum z(-R) + sum m(-A); identity <=> X == 0 and Y == Z.
-    t = ge.point_add(t1, t2, need_t=False)
-    # Torsion certification over the live lanes' A and R (the stacked
-    # decompression output `both` is already in that column order). Dead
-    # lanes get zero trial weights — unweighted, identity contribution.
+    # Torsion certification is over the live lanes' A and R (the
+    # stacked decompression output `both` is already in that column
+    # order); dead lanes get zero trial weights — unweighted, identity
+    # contribution.
     live2 = jnp.concatenate([live, live], axis=0)
     u_live = jnp.where(live2[None, :], u_digits, 0)
-    sub_ok, sub_fill_ok = sub_impl(both, u_live, **kw_sub)
+    if engine == "xla":
+        w_r, ok_r = msm_mod.msm_partial(
+            z_live, neg_r, msm_mod.WINDOWS_Z)
+        w_m, ok_m = msm_mod.msm_partial(
+            m_all, pts_all, msm_mod.WINDOWS_253)
+        sub_agg, sub_okf = msm_mod.subgroup_partial(both, u_live)
+    else:
+        interp = engine == "interpret"
+        w_r, ok_r = msm_mod.msm_fast_partial(
+            z_live, neg_r, msm_mod.WINDOWS_Z, interpret=interp, **kw_r)
+        w_m, ok_m = msm_mod.msm_fast_partial(
+            m_all, pts_all, msm_mod.WINDOWS_253, interpret=interp,
+            **kw_m)
+        sub_agg, sub_okf = msm_mod.subgroup_fast_partial(
+            both, u_live, interpret=interp, **kw_sub)
+    parts = {
+        "w_r": w_r, "ok_r": ok_r,
+        "w_m": w_m, "ok_m": ok_m,
+        "sub": sub_agg, "sub_ok": sub_okf,
+    }
+    return status, definite, parts
+
+
+def verify_rlc_combine(parts, axis_name: str | None = None):
+    """The TAIL half of one RLC pass: combine the per-shard partials
+    across the mesh (axis_name; identity when None), run the three
+    doubling-chain tails (two window Horners + the [L] torsion ladder),
+    and fold the global batch verdict.
+
+    The engine is re-resolved from the same trace-time flag the local
+    half read, so a (local, combine) pair traced under one environment
+    always agrees on partial shapes. The kernel-path torsion combine
+    evaluates every Mosaic-padded trial lane — sound, because the pad
+    lanes carry zero coordinates that trivially pass the identity test
+    (msm.subgroup_fast_partial documents the argument)."""
+    engine = msm_engine()
+    if engine == "xla":
+        t1, ok1 = msm_mod.msm_combine(
+            parts["w_r"], parts["ok_r"], msm_mod.WINDOWS_Z,
+            axis_name=axis_name)
+        t2, ok2 = msm_mod.msm_combine(
+            parts["w_m"], parts["ok_m"], msm_mod.WINDOWS_253,
+            axis_name=axis_name)
+        sub_ok, sub_fill_ok = msm_mod.subgroup_combine(
+            parts["sub"], parts["sub_ok"], axis_name=axis_name)
+    else:
+        interp = engine == "interpret"
+        t1, ok1 = msm_mod.msm_fast_combine(
+            parts["w_r"], parts["ok_r"], msm_mod.WINDOWS_Z,
+            interpret=interp, axis_name=axis_name)
+        t2, ok2 = msm_mod.msm_fast_combine(
+            parts["w_m"], parts["ok_m"], msm_mod.WINDOWS_253,
+            interpret=interp, axis_name=axis_name)
+        sub_ok, sub_fill_ok = msm_mod.subgroup_fast_combine(
+            parts["sub"], parts["sub_ok"], interpret=interp,
+            axis_name=axis_name)
+    # T = u*B + sum z(-R) + sum m(-A); identity <=> X == 0 and Y == Z.
+    t = ge.point_add(t1, t2, need_t=False)
     batch_ok = (
         fe.fe_is_zero(t[0]) & fe.fe_eq(t[1], t[2]) & ok1 & ok2
         & sub_ok & sub_fill_ok
     )
-    return status, definite, batch_ok
+    return batch_ok
 
 
 class RlcAsyncResult:

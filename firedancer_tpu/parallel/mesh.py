@@ -142,3 +142,94 @@ def verify_rlc_step_sharded(mesh: Mesh):
         return jitted(msgs, lens, sigs, pubs, z, u.reshape(k, 2, bsz))
 
     return fn
+
+
+def verify_rlc_split_sharded(mesh: Mesh):
+    """The fd_pod double-buffer pair: the mesh-sharded RLC pass as TWO
+    separately-jitted graphs (round-18, ROADMAP direction 1) —
+
+      local_fill(msgs, lens, sigs, pubs, z, u)
+          -> (status, definite, parts)
+          per-shard SHA/decompress/status ladder + the three Pippenger
+          bucket fills, NO collectives (ops/verify_rlc.verify_rlc_local
+          under shard_map). status/definite are the global per-lane
+          arrays; parts is the pytree of per-shard window/trial
+          partials, stacked on a leading mesh axis ((N, 32, nw)-limb
+          coords, (N,) fill flags).
+
+      combine_tail(parts) -> batch_ok
+          ONE all_gather of the tiny partials + unified adds + the
+          doubling-chain tails (verify_rlc_combine under shard_map,
+          axis_name threaded) — the replicated global verdict.
+
+    Why two graphs: the collectives (and the serial doubling chains
+    they feed) live entirely in combine_tail, so a dispatcher can have
+    batch k's combine_tail executing while batch k+1's local_fill is
+    already dispatched — wiredancer's DMA-slot double-buffering, stolen
+    for the mesh (SZKP/ZK-Flex schedule many bucket-fill units against
+    one work stream the same way). Composition is bit-exact with
+    verify_rlc_step_sharded: local/combine are the monolithic step's
+    own body factored at the collective boundary, and the cross-shard
+    fold goes through the one msm.combine_stacked rule either way.
+
+    Both callables take/produce global arrays with the exact
+    verify_batch_rlc argument convention (u is (K, 2B); the A/R-half
+    resharding happens inside, as in the monolithic builder).
+    """
+    from ..ops.verify_rlc import verify_rlc_combine, verify_rlc_local
+
+    axis = mesh.axis_names[0]
+
+    def local_step(msgs, lens, sigs, pubs, z, u3):
+        u = u3.reshape(u3.shape[0], -1)
+        status, definite, parts = verify_rlc_local(
+            msgs, lens, sigs, pubs, z, u)
+        # Stack each partial on a fresh leading mesh axis so the
+        # out_spec can concatenate shards: global shape (N, ...).
+        stacked = jax.tree_util.tree_map(lambda c: c[None], parts)
+        return status, definite, stacked
+
+    def combine_step(parts):
+        # Each shard holds its own (1, ...) slice; drop the carrier
+        # axis and let the combine's all_gather rebuild the (N, ...)
+        # stack in mesh order — the collective lives HERE, not in
+        # local_fill.
+        own = jax.tree_util.tree_map(lambda c: c[0], parts)
+        return verify_rlc_combine(own, axis_name=axis)
+
+    spec = P(axis)
+    parts_spec = _rlc_parts_spec(axis)
+    local_sharded = shard_map_nocheck(
+        local_step,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec, P(None, None, axis)),
+        out_specs=(spec, spec, parts_spec),
+    )
+    combine_sharded = shard_map_nocheck(
+        combine_step,
+        mesh=mesh,
+        in_specs=(parts_spec,),
+        out_specs=P(),
+    )
+    local_jit = jax.jit(local_sharded)
+    combine_jit = jax.jit(combine_sharded)
+
+    def local_fill(msgs, lens, sigs, pubs, z, u):
+        k = u.shape[0]
+        bsz = msgs.shape[0]
+        return local_jit(msgs, lens, sigs, pubs, z,
+                         u.reshape(k, 2, bsz))
+
+    return local_fill, combine_jit
+
+
+def _rlc_parts_spec(axis: str):
+    """The shard_map spec pytree for verify_rlc_local's partials: every
+    leaf (point-coord stacks and fill flags alike) shards its leading
+    mesh axis."""
+    coord = P(axis)
+    return {
+        "w_r": (coord, coord, coord, coord), "ok_r": P(axis),
+        "w_m": (coord, coord, coord, coord), "ok_m": P(axis),
+        "sub": (coord, coord, coord, coord), "sub_ok": P(axis),
+    }

@@ -28,6 +28,29 @@ from typing import Optional
 import numpy as np
 
 
+def patch_host_device_count(n: Optional[int] = None) -> None:
+    """Patch XLA_FLAGS with --xla_force_host_platform_device_count for
+    a virtual CPU mesh, BEFORE any jax backend initializes.
+
+    The ONE owner of the device-count env dance: worker boot
+    (disco/worker.py), init_multihost below, and the pod smoke all
+    route here, and the count comes from the FD_MESH_DEVICES flag when
+    the caller does not pass one — the count must agree across every
+    process sharing a persistent compile cache (the compile key covers
+    the device topology; a 1-device worker would re-pay multi-minute
+    compiles every boot). An existing count in XLA_FLAGS wins: an
+    operator's explicit topology is never silently overridden."""
+    from firedancer_tpu import flags as fd_flags
+
+    if n is None:
+        n = fd_flags.get_int("FD_MESH_DEVICES")
+    xf = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xf:
+        os.environ["XLA_FLAGS"] = (
+            f"{xf} --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
 def init_multihost(
     coordinator: str,
     num_processes: int,
@@ -42,12 +65,7 @@ def init_multihost(
     device count (testing / CPU fleets); leave None on real TPU hosts.
     """
     if local_device_count is not None:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count="
-                f"{local_device_count}"
-            ).strip()
+        patch_host_device_count(local_device_count)
     import jax
 
     if platform is not None:

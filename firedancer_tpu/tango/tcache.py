@@ -34,6 +34,68 @@ class TCache:
         self._map.add(tag)
         return False
 
+    def insert_batch(self, tags) -> "object":
+        """Vectorized insert over a drain round's tag array: returns a
+        numpy bool array, True where the tag was a duplicate —
+        BIT-IDENTICAL to calling insert() per tag in order (the bulk
+        dedup paths are gated on content parity with the per-frag
+        loop).
+
+        Fast path: one np.unique collapses in-batch repeats, membership
+        is probed once per unique tag, and the verdict scatters back
+        through the inverse index — O(uniq) Python instead of O(frags).
+        The one sequential behavior this cannot express is a MID-BATCH
+        EVICTION changing a later probe's verdict (a member among the
+        next len(tags) ring slots gets evicted by this batch's inserts
+        and then probed again); the guard detects exactly that overlap
+        (two tiny set ops) and falls back to the exact loop, so the
+        fast path is bit-identical whenever it runs."""
+        import numpy as np
+
+        tags = np.asarray(tags, np.uint64)
+        n = len(tags)
+        out = np.zeros(n, np.bool_)
+        if n == 0:
+            return out
+        probe = set(int(t) for t in tags.tolist())
+        # Eviction window: the next n ring slots (an upper bound on
+        # this batch's inserts). Overlap with the probe set means a
+        # verdict could depend on mid-batch eviction order.
+        window = set()
+        for i in range(min(n, self.depth)):
+            t = self._ring[(self._next + i) % self.depth]
+            if t is not None:
+                window.add(t)
+        if window & probe or n >= self.depth:
+            for i, t in enumerate(tags.tolist()):
+                out[i] = self.insert(int(t))
+            return out
+        uniq, first_idx, inverse = np.unique(
+            tags, return_index=True, return_inverse=True)
+        m = self._map
+        hit_u = np.fromiter((int(t) in m for t in uniq.tolist()),
+                            np.bool_, len(uniq))
+        out = hit_u[inverse]
+        # A repeat of ANY tag is a duplicate (its first occurrence
+        # either already was one or just inserted it).
+        out |= np.arange(n) != first_idx[inverse]
+        # Ring/map surgery only for the genuinely new tags, in
+        # first-occurrence order so ring age matches the loop.
+        new = uniq[~hit_u]
+        new_first = first_idx[~hit_u]
+        for t in new[np.argsort(new_first, kind="stable")].tolist():
+            t = int(t)
+            old = self._ring[self._next]
+            if old is not None:
+                m.discard(old)
+            self._ring[self._next] = t
+            self._next = (self._next + 1) % self.depth
+            m.add(t)
+        hits = int(out.sum())
+        self.hit_cnt += hits
+        self.miss_cnt += n - hits
+        return out
+
     def reset(self):
         self._ring = [None] * self.depth
         self._next = 0

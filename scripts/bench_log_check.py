@@ -332,6 +332,127 @@ def validate_siege(rec: dict) -> List[str]:
     return errs
 
 
+# fd_pod artifact shape (POD_r*.json, written by scripts/pod_smoke.py;
+# sentinel prediction 11 grades the on-device variant). The overlap
+# block is the load-bearing part: it is what lets the double-buffer
+# claim (combine_tail hidden behind the next local_fill) be audited
+# from the artifact alone.
+_POD_REQUIRED = {
+    "value": (int, float),        # aggregate verifies/s
+    "unit": str,
+    "devices": int,
+    "on_device": bool,
+    "batch": int,
+    "corpus": int,
+    "elapsed_s": (int, float),
+    "ok": bool,
+    "digest_parity": bool,
+    "alert_cnt": int,
+    "rlc_fallbacks": int,
+    "shard_balance": (int, float),
+}
+_POD_OVERLAP_REQUIRED = ("serialized_ms", "pipelined_ms", "overlap_ms",
+                         "local_fill_ms", "combine_tail_ms",
+                         "tail_hidden_est")
+_POD_BALANCE_MAX = 1.5   # FD_SLO_SHARD_BALANCE_PCT default / 100
+
+
+def validate_pod(rec: dict) -> List[str]:
+    """Shape errors for one POD_r*.json artifact ([] = valid)."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return ["artifact is not a JSON object"]
+    if rec.get("metric") != "pod_aggregate_throughput":
+        errs.append(f"metric must be pod_aggregate_throughput, got "
+                    f"{rec.get('metric')!r}")
+    sv = rec.get("schema_version")
+    if not isinstance(sv, int) or isinstance(sv, bool) \
+            or sv < SCHEMA_VERSION_MIN:
+        errs.append(f"schema_version must be an int >= "
+                    f"{SCHEMA_VERSION_MIN}, got {sv!r}")
+    ts = rec.get("ts")
+    if not isinstance(ts, str) or "T" not in ts:
+        errs.append(f"missing/odd ISO 'ts': {ts!r}")
+    for key, typ in _POD_REQUIRED.items():
+        v = rec.get(key)
+        if v is None or not isinstance(v, typ) \
+                or (isinstance(v, bool) and typ is not bool):
+            errs.append(f"'{key}' missing or not {typ}: {v!r}")
+    lanes = rec.get("shard_lanes")
+    if (not isinstance(lanes, list) or len(lanes) < 2
+            or any(not isinstance(x, int) or isinstance(x, bool)
+                   or x < 0 for x in lanes)):
+        errs.append("'shard_lanes' must list >= 2 non-negative ints")
+    elif isinstance(rec.get("devices"), int) \
+            and len(lanes) != rec["devices"]:
+        errs.append(f"'shard_lanes' has {len(lanes)} entries but "
+                    f"devices={rec['devices']}")
+    ov = rec.get("overlap")
+    if not isinstance(ov, dict):
+        errs.append("'overlap' block missing")
+    else:
+        for key in _POD_OVERLAP_REQUIRED:
+            v = ov.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                errs.append(f"'overlap.{key}' missing or not a number: "
+                            f"{v!r}")
+        # The gate basis is load-bearing for the ok-consistency rules
+        # below: a missing/typo'd gate must fail loudly, not skip the
+        # overlap audit (a hand-marked hardware artifact is exactly
+        # what prediction 11 grades).
+        if ov.get("gate") not in ("measured", "non-degradation"):
+            errs.append(f"'overlap.gate' must be measured|"
+                        f"non-degradation, got {ov.get('gate')!r}")
+    if not isinstance(rec.get("failures"), list):
+        errs.append("'failures' must be a list")
+    if not errs and rec["ok"]:
+        # An artifact that SAYS the gates passed must carry evidence
+        # consistent with them: bit-exact digests, zero sentinel
+        # alerts, measured positive overlap, balance within the SLO.
+        if not rec["digest_parity"]:
+            errs.append("ok: true but digest_parity: false")
+        if rec["alert_cnt"] != 0:
+            errs.append(f"ok: true but alert_cnt={rec['alert_cnt']}")
+        # The overlap clause honors the artifact's recorded gate basis
+        # (pod_smoke's core-scaled discipline, the feed_smoke
+        # precedent): on multi-core/device hosts the double buffer
+        # must hide SOMETHING; a 1-core virtual mesh timeshares
+        # execution under dispatch, so only non-degradation is
+        # measurable there.
+        if ov.get("gate") == "measured" and ov["overlap_ms"] <= 0:
+            errs.append("ok: true but overlap_ms <= 0 under the "
+                        "measured gate (the double buffer hid nothing)")
+        elif ov.get("gate") == "non-degradation" \
+                and ov["pipelined_ms"] > 1.15 * ov["serialized_ms"]:
+            errs.append("ok: true but pipelined dispatch degraded "
+                        ">15% vs serialized on the 1-core basis")
+        # _POD_BALANCE_MAX restates FD_SLO_SHARD_BALANCE_PCT/100 (this
+        # validator stays stdlib-only, the _STAGE_KEYS precedent);
+        # tests/test_pod.py pins the two against the flag registry.
+        if rec["shard_balance"] > _POD_BALANCE_MAX:
+            errs.append(f"ok: true but shard_balance="
+                        f"{rec['shard_balance']} > {_POD_BALANCE_MAX}")
+    return errs
+
+
+def validate_pod_files(root: str) -> List[str]:
+    """All violations across the POD_r*.json family under root."""
+    import glob
+
+    errs: List[str] = []
+    for path in sorted(glob.glob(os.path.join(root, "POD_r[0-9]*.json"))):
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            errs.append(f"{name}: not JSON ({e})")
+            continue
+        for e in validate_pod(rec):
+            errs.append(f"{name}: {e}")
+    return errs
+
+
 def validate_siege_files(root: str) -> List[str]:
     """All violations across the SIEGE_r*.json family under root."""
     import glob
@@ -395,6 +516,9 @@ def main(argv=None) -> int:
     siege_root = os.path.dirname(os.path.abspath(path)) if argv else REPO
     siege_errs = validate_siege_files(siege_root)
     errs += siege_errs
+    # The fd_pod artifact family rides the same gate (prediction 11
+    # reads these; a malformed one poisons the ledger).
+    errs += validate_pod_files(siege_root)
     if errs:
         for e in errs:
             print(f"bench_log_check: FAIL — {e}", file=sys.stderr)
@@ -404,8 +528,9 @@ def main(argv=None) -> int:
 
     n_siege = len(_glob.glob(os.path.join(siege_root,
                                           "SIEGE_r[0-9]*.json")))
+    n_pod = len(_glob.glob(os.path.join(siege_root, "POD_r[0-9]*.json")))
     print(f"bench_log_check: OK ({n} lines; {legacy} allowlisted legacy; "
-          f"{n_siege} siege artifacts)")
+          f"{n_siege} siege artifacts; {n_pod} pod artifacts)")
     return 0
 
 

@@ -7,7 +7,7 @@ plus the BENCH/REPLAY/MULTICHIP/PACK/HOSTFEED artifact family are
 parsed into one schema-normalized timeline (pre-schema_version legacy
 lines included), rendered as per-mode/per-B/per-stage trend tables,
 checked against the rolling best-of baseline (FD_REPORT_REGRESS_PCT),
-and reconciled against the ten ROOFLINE.md falsifiable predictions —
+and reconciled against the eleven ROOFLINE.md falsifiable predictions —
 each listed pending until a matching schema_version-2 artifact lands,
 then auto-graded confirmed/falsified (the BENCH_r06 hardware session
 self-grades).
@@ -194,6 +194,31 @@ def render_siege(timeline) -> List[str]:
     return lines
 
 
+def render_pod(timeline) -> List[str]:
+    """The fd_pod service table: one row per POD_r*.json artifact —
+    aggregate rate, shard balance, the overlap probe under its
+    recorded gate basis, and whether the row is on-device (only those
+    can grade prediction 11)."""
+    lines = ["== FD_POD SHARDED VERIFY SERVICE =="]
+    rows = sentinel.pod_status(timeline)
+    if not rows:
+        lines.append("(no POD_r*.json artifacts yet — run "
+                     "scripts/pod_smoke.py)")
+        return lines
+    for r in rows:
+        verdict = "OK  " if r["ok"] else "FAIL"
+        where = "DEVICE" if r["on_device"] else "virtual-cpu"
+        lines.append(
+            f"  [{verdict}] {r['value']} {r['unit']} @ {r['devices']} "
+            f"shards ({where}); balance {r['shard_balance']}x, "
+            f"overlap {r['overlap_ms']} ms ({r['gate']}), tail hidden "
+            f"{r['tail_hidden_est']}, alerts {r['alert_cnt']} "
+            f"[{r['source']}]")
+        for fmsg in r["failures"]:
+            lines.append(f"         - {fmsg}")
+    return lines
+
+
 def render_gates(timeline) -> List[str]:
     lines = ["== THROUGHPUT GATES =="]
     best: dict = {}
@@ -230,6 +255,7 @@ def render_report(timeline, regress_pct=None) -> str:
                     render_replay_trend(timeline),
                     render_gates(timeline),
                     render_siege(timeline),
+                    render_pod(timeline),
                     render_regressions(regs),
                     render_ledger(ledger)):
         parts.extend(section)

@@ -1,0 +1,306 @@
+#!/usr/bin/env python
+"""pod_smoke — the fd_pod sharded-verify-service gate (ci.sh lane).
+
+Forced FD_MESH_DEVICES-device virtual CPU mesh (the make_mesh error
+message's own recipe), one mainnet-shaped corpus, four checks:
+
+  1. END-TO-END REPLAY, 8-shard mesh — the full feed pipeline
+     (replay -> stager -> sharded split-step rlc verify -> dedup ->
+     pack -> sink) with FD_VERIFY_MODE=rlc and mesh_devices=N: zero
+     fd_sentinel alerts (liveness + the new shard_balance SLO, with
+     the latency budgets scaled for a timeshared 1-core virtual mesh
+     and the scaling recorded as gate_basis), and per-shard flight
+     lanes within 1.5x of each other.
+
+  2. DIGEST PARITY — the same corpus through the single-shard
+     (mesh_devices=0) pipeline: sink digest multisets bit-exact, so
+     sharding + the split-step graphs changed NOTHING about verdicts.
+
+  3. SERVICE REPLAY — disco/pod.PodVerifyService (per-shard feeder
+     lanes, backlog-aware placement, double-buffered local_fill /
+     combine_tail dispatch) over the same corpus: its verified-txn
+     digest multiset matches the pipeline sinks, occupancy balance
+     within 1.5x, per-lane fallback only where the corpus is salted.
+
+  4. OVERLAP — measure_overlap: 2-batch pipelined wall vs the
+     serialized split-step sum, best-of-N. On hosts with >= 2 usable
+     cores the gate is overlap_ms > 0 (the double buffer must hide
+     SOMETHING); on a 1-core host genuine overlap is structurally
+     impossible (device "execution" timeshares the dispatch core), so
+     the gate degrades to non-degradation (pipelined <= 1.15x
+     serialized) — the feed_smoke core-scaled precedent, gate_basis
+     recorded in the artifact.
+
+Writes POD_r01.json (metric pod_aggregate_throughput, on_device:
+false — sentinel prediction 11 only ever grades on-device pod
+artifacts) and validates it with scripts/bench_log_check.validate_pod.
+Exits nonzero on any violation; prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+from datetime import datetime, timezone
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# Env BEFORE any jax import: CPU platform + the virtual mesh, routed
+# through the one FD_MESH_DEVICES owner (satellite: worker.py and
+# multihost.py patch through the same helper).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from firedancer_tpu.parallel.multihost import patch_host_device_count  # noqa: E402
+
+patch_host_device_count()
+
+from firedancer_tpu import flags as _flags  # noqa: E402
+
+N = _flags.get_int("FD_POD_SMOKE_N")
+BATCH = _flags.get_int("FD_POD_SMOKE_BATCH")
+SEED = 2026
+MAX_MSG = 256
+# One budget owner: the sentinel's shard-balance SLO flag (percent).
+BALANCE_MAX = _flags.get_int("FD_SLO_SHARD_BALANCE_PCT") / 100.0
+# Latency budgets scaled for the timeshared virtual mesh: an 8-device
+# shard_map step on ONE core runs minutes per wall-clock batch, so ms
+# budgets tuned for real hosts would alert on scheduling, not on the
+# pipeline. Liveness stays armed (scaled), shard_balance is
+# ratio-based and unscaled — the gate this smoke adds.
+SLO_ENV = {
+    "FD_SLO_E2E_BUDGET_MS": "900000",
+    "FD_SLO_SOURCE_BUDGET_MS": "900000",
+    "FD_SLO_QUIC_INGEST_MS": "900000",
+    "FD_SLO_STALL_MS": "300000",
+    "FD_SLO_HB_MS": "120000",
+}
+# Torsion-certification trials: 8 instead of the production 64 — the
+# smoke gates DATAFLOW (parity/balance/overlap), not the soundness
+# margin, and K scales the trial-aggregate graphs this 1-core lane
+# compiles. Recorded in gate_basis.
+os.environ.setdefault("FD_RLC_TORSION_K", "8")
+
+
+def log(msg: str) -> None:
+    print(f"pod_smoke: {msg}", flush=True)
+
+
+def fail(msg: str) -> None:
+    print(f"pod_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _usable_cores() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except AttributeError:
+        return os.cpu_count() or 1
+
+
+def _corpus():
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+
+    # dup_rate 0 so the pipeline sinks (which dedup) and the pod
+    # service (which does not) see the same multiset; corruption +
+    # parse errors stay in to exercise the fallback + reject paths.
+    return mainnet_corpus(n=N, seed=SEED, dup_rate=0.0,
+                          corrupt_rate=0.03, parse_err_rate=0.02,
+                          sign_batch_size=256, max_data_sz=60)
+
+
+def _run_pipeline(tmp, corpus, name, mesh_devices: int):
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    env = dict(SLO_ENV)
+    env["FD_VERIFY_MODE"] = "rlc"
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        topo = build_topology(os.path.join(tmp, f"{name}.wksp"),
+                              depth=2048, wksp_sz=1 << 26,
+                              verify_shards=mesh_devices)
+        t0 = time.perf_counter()
+        res = run_pipeline(
+            topo, corpus.payloads, verify_backend="tpu",
+            verify_batch=BATCH, verify_max_msg_len=MAX_MSG,
+            timeout_s=2400.0, tcache_depth=1 << 16,
+            record_digests=True, feed=True,
+            verify_opts={"mesh_devices": mesh_devices}
+            if mesh_devices else None,
+        )
+        return res, time.perf_counter() - t0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from firedancer_tpu import flags
+
+    n_shards = flags.get_int("FD_MESH_DEVICES")
+    if len(jax.devices()) < n_shards:
+        fail(f"virtual mesh did not come up: {len(jax.devices())} "
+             f"devices < {n_shards} (XLA_FLAGS patching broken?)")
+    cores = _usable_cores()
+    failures = []
+    corpus = _corpus()
+    tmp = tempfile.mkdtemp(prefix="fd_pod_smoke_")
+
+    # -- 1. end-to-end sharded replay -----------------------------------
+    res_mesh, dt_mesh = _run_pipeline(tmp, corpus, "mesh", n_shards)
+    vs = res_mesh.verify_stats[0]
+    if res_mesh.slo is None:
+        fail("mesh run carried no sentinel summary (FD_SENTINEL on?)")
+    if res_mesh.slo["alert_cnt"]:
+        failures.append(f"mesh run booked SLO alerts: "
+                        f"{res_mesh.slo['alerts']}")
+    shard_lanes = vs.get("shard_lanes") or []
+    if len(shard_lanes) != n_shards:
+        failures.append(f"expected {n_shards} shard lanes, got "
+                        f"{shard_lanes}")
+    balance = vs.get("shard_balance") or 0.0
+    if not shard_lanes or min(shard_lanes) == 0:
+        failures.append(f"a shard lane never dispatched: {shard_lanes}")
+    elif balance > BALANCE_MAX:
+        failures.append(f"shard occupancy imbalance {balance} > "
+                        f"{BALANCE_MAX}: {shard_lanes}")
+    if sum(shard_lanes) != vs["lanes"]:
+        failures.append(f"shard lanes {shard_lanes} do not sum to the "
+                        f"tile's {vs['lanes']}")
+    log(f"mesh replay: {res_mesh.recv_cnt} sunk in {dt_mesh:.1f}s, "
+        f"shard lanes {shard_lanes} (balance {balance}), "
+        f"alerts {res_mesh.slo['alert_cnt']}")
+
+    # -- 2. single-shard digest parity ----------------------------------
+    res_one, dt_one = _run_pipeline(tmp, corpus, "one", 0)
+    d_mesh = sorted(d.hex() for d in (res_mesh.sink_digests or []))
+    d_one = sorted(d.hex() for d in (res_one.sink_digests or []))
+    digest_parity = bool(d_mesh) and d_mesh == d_one
+    if not digest_parity:
+        failures.append(
+            f"sink digest parity broke: mesh {len(d_mesh)} vs "
+            f"single {len(d_one)} (first diff: "
+            f"{next((a for a, b in zip(d_mesh, d_one) if a != b), '?')})")
+    log(f"single-shard replay: {res_one.recv_cnt} sunk in {dt_one:.1f}s; "
+        f"digest parity {'OK' if digest_parity else 'BROKEN'} "
+        f"({len(d_mesh)} digests)")
+
+    # -- 3. the pod service ----------------------------------------------
+    from firedancer_tpu.disco.pod import pod_replay
+
+    out = pod_replay(corpus.payloads, batch=BATCH, n_shards=n_shards,
+                     max_msg_len=MAX_MSG)
+    svc = out["service"]
+    d_svc = sorted(d.hex() for d in out["digests"])
+    if d_svc != d_one:
+        failures.append(
+            f"service digest parity broke: service {len(d_svc)} vs "
+            f"pipeline {len(d_one)}")
+    sbal = svc.balance_ratio()
+    if sbal > BALANCE_MAX:
+        failures.append(f"service shard balance {sbal:.3f} > "
+                        f"{BALANCE_MAX}: {svc.shard_occupancy()}")
+    agg = (out["verified_ok"] and out["elapsed_s"]
+           and out["verified_ok"] / out["elapsed_s"]) or 0.0
+    log(f"service replay: {out['verified_ok']} ok / "
+        f"{out['verified_fail']} fail / {out['parse_rejects']} rejects "
+        f"in {out['elapsed_s']:.1f}s; balance {sbal:.3f}; "
+        f"fallbacks {svc.stat_fallbacks}")
+
+    # -- 4. the overlap gate ---------------------------------------------
+    ov = svc.measure_overlap(corpus.payloads, rounds=3)
+    if cores >= 2:
+        ov_gate = "measured"
+        if ov["overlap_ms"] <= 0:
+            failures.append(
+                f"double buffer hid nothing on a {cores}-core host: "
+                f"{ov}")
+    else:
+        # 1 usable core: execution and dispatch timeshare one CPU, so
+        # pipelined == serialized up to scheduler noise. Gate on
+        # non-degradation; the measured gate re-arms on real hosts.
+        ov_gate = "non-degradation"
+        if ov["pipelined_ms"] > 1.15 * ov["serialized_ms"]:
+            failures.append(
+                f"pipelined dispatch degraded >15% on 1 core: {ov}")
+    ov["gate"] = ov_gate
+    log(f"overlap ({ov_gate}, best-of-3): serialized "
+        f"{ov['serialized_ms']:.0f} ms vs pipelined "
+        f"{ov['pipelined_ms']:.0f} ms (overlap {ov['overlap_ms']:.0f} "
+        f"ms; tail hidden est {ov['tail_hidden_est']})")
+
+    # -- artifact ---------------------------------------------------------
+    rec = {
+        "metric": "pod_aggregate_throughput",
+        "schema_version": 2,
+        "ts": datetime.now(timezone.utc).isoformat(),
+        "value": round(agg, 3),
+        "unit": "verifies/s",
+        "devices": n_shards,
+        "on_device": False,
+        "platform": "cpu-virtual-mesh",
+        "batch": BATCH,
+        "corpus": N,
+        "elapsed_s": round(out["elapsed_s"], 3),
+        "ok": not failures,
+        "digest_parity": digest_parity,
+        "alert_cnt": int(res_mesh.slo["alert_cnt"]),
+        "rlc_fallbacks": int(svc.stat_fallbacks),
+        "shard_lanes": [int(x) for x in svc.shard_occupancy()],
+        "shard_balance": round(sbal, 3),
+        "pipeline_shard_lanes": [int(x) for x in shard_lanes],
+        "overlap": ov,
+        "engine": svc.stats()["split"],
+        "gate_basis": (f"usable_cores={cores}; overlap gate "
+                       f"{ov_gate}; latency budgets scaled for the "
+                       "timeshared virtual mesh "
+                       + json.dumps(SLO_ENV)),
+        "failures": failures,
+    }
+    # On-device pod sessions (MULTICHIP_r06+) write the same schema
+    # with on_device: true — that record is what grades prediction 11.
+    art = os.path.join(REPO, "POD_r01.json")
+    with open(art, "w") as f:
+        json.dump(rec, f, indent=1)
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import bench_log_check
+
+    errs = bench_log_check.validate_pod(rec)
+    # ok:false artifacts are allowed by the validator only as evidence;
+    # the smoke itself still fails below.
+    if errs and not failures:
+        failures.extend(f"artifact schema: {e}" for e in errs)
+
+    print(json.dumps({
+        "metric": "pod_smoke",
+        "ok": not failures,
+        "value": rec["value"],
+        "shard_balance": rec["shard_balance"],
+        "overlap_ms": ov["overlap_ms"],
+        "overlap_gate": ov_gate,
+        "digests": len(d_mesh),
+        "failures": failures,
+    }))
+    if failures:
+        for msg in failures:
+            print(f"pod_smoke: FAIL — {msg}", file=sys.stderr)
+        return 1
+    log(f"OK — artifact {art}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

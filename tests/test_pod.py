@@ -1,0 +1,408 @@
+"""fd_pod — pod-scale sharded verify service (round 18).
+
+Coverage per the issue checklist:
+  - split-step == monolithic == single-graph bit-exactness on the
+    8-virtual-device mesh, clean and salted batches, with a torsion
+    forgery on a NON-ZERO shard (the cross-shard certification must
+    see it);
+  - shard placement is backlog-aware and never starves a lane;
+  - per-shard flight lanes sum to the service's merged row;
+  - TCache.insert_batch (the dedup bulk path's membership test) is
+    bit-identical to the sequential loop, evictions included;
+  - the shard-balance SLO evaluator and the POD artifact schema;
+  - RungScheduler's per-shard rung arithmetic and the engine entry's
+    overlap-aware split cost model.
+
+Cost discipline follows test_verify_rlc: the heavy graphs stick to the
+(16, 64) shape the persistent compile cache already carries; the
+8-device split compile is paid once, in the slow lane.
+"""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from firedancer_tpu.ballet import ed25519 as oracle
+
+N = 16
+MAX_LEN = 64
+K = 8
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ logic --
+
+
+def test_rung_scheduler_per_shard_rungs():
+    from firedancer_tpu.disco.engine import RungScheduler
+
+    rs = RungScheduler([64, 128, 256], 1_000_000, shards=8)
+    assert rs.shard_rung(256) == 32
+    assert rs.shard_rung(64) == 8
+    # a rung that cannot split over the mesh is a construction error,
+    # not a silent mis-shard
+    with pytest.raises(ValueError, match="do not divide"):
+        RungScheduler([64, 100], 1_000_000, shards=8)
+    # shards=1 (the default) keeps the old behavior verbatim
+    rs1 = RungScheduler([64, 100], 1_000_000)
+    assert rs1.shard_rung(100) == 100
+
+
+def test_engine_entry_split_cost_model():
+    from firedancer_tpu.disco.engine import EngineEntry, EngineSpec
+
+    e = EngineEntry(EngineSpec("rlc", 64, 8))
+    assert e.service_est_ns() == 0 and e.overlap_hidden_est() == 0.0
+    # fill-dominated: the tail hides entirely; steady-state cost is
+    # the fill (the two-stage pipeline bound)
+    e.note_service_split(1000, 400)
+    assert e.service_est_ns() == 1000
+    assert e.overlap_hidden_est() == 1.0
+    # tail-dominated: only local/tail of the tail hides
+    e2 = EngineEntry(EngineSpec("rlc", 64, 8))
+    e2.note_service_split(400, 1000)
+    assert e2.service_est_ns() == 1000
+    assert e2.overlap_hidden_est() == 0.4
+    # the whole-batch EMA keeps feeding for pre-split consumers
+    assert e2.service_ns == 1400
+    snap = e2.snapshot()
+    assert snap["split"] == {}  # no fn_local: monolithic shape
+
+
+def test_tcache_insert_batch_matches_sequential():
+    """Property: insert_batch == per-tag insert(), bit-identical —
+    including in-batch repeats and ring evictions (small depth forces
+    the mid-batch-eviction guard's fallback path)."""
+    from firedancer_tpu.tango.tcache import TCache
+
+    rng = np.random.RandomState(7)
+    for depth in (2, 5, 64):
+        a, b = TCache(depth), TCache(depth)
+        for _ in range(120):
+            n = int(rng.randint(1, 14))
+            tags = rng.randint(0, 12, n).astype(np.uint64)
+            got = a.insert_batch(tags)
+            want = np.array([b.insert(int(t)) for t in tags], np.bool_)
+            assert (got == want).all(), (depth, tags.tolist())
+            assert a._ring == b._ring and a._next == b._next
+            assert a._map == b._map
+            assert (a.hit_cnt, a.miss_cnt) == (b.hit_cnt, b.miss_cnt)
+
+
+def test_pod_placement_backlog_aware():
+    """place() prefers the least-backlogged shard lane and round-robins
+    among ties, so a multisig burst cannot starve a shard."""
+    pytest.importorskip("jax")
+    from firedancer_tpu.disco.pod import PodVerifyService
+
+    svc = PodVerifyService(32, n_shards=2, max_msg_len=64)
+    item = (b"\x00" * 64, b"\x00" * 32, b"m")
+    # balanced start: ties resolve round-robin across both shards
+    picks = [svc.place(1) for _ in range(4)]
+    assert set(picks) == {0, 1}
+    # load shard 0 heavily -> every subsequent pick goes to shard 1
+    svc.lanes[0].stage([item] * 8, psig=1)
+    assert all(svc.place(1) == 1 for _ in range(3))
+    svc.lanes[1].stage([item] * 12, psig=2)
+    assert svc.place(1) == 0
+    # when NO lane has room for the txn, placement degrades to plain
+    # least-backlog (stage() then commits the full slot and rotates)
+    assert svc.lanes[0].room() == 8
+    assert svc.place(10) == 0   # room 8 vs 4: neither fits 10 lanes,
+    #                             so the lighter lane (8 < 12) wins
+
+
+def test_pod_shard_lane_commit_rotates_slots():
+    pytest.importorskip("jax")
+    from firedancer_tpu.disco.pod import PodVerifyService
+
+    svc = PodVerifyService(32, n_shards=2, max_msg_len=64)
+    lane = svc.lanes[0]
+    item = (b"\x00" * 64, b"\x00" * 32, b"msg")
+    # a txn that does not fit the remaining room commits the FILLING
+    # slot (whole-txn placement: lanes never straddle slots)
+    lane.stage([item] * 10, psig=1)
+    lane.stage([item] * 10, psig=2)
+    assert lane.pool.ready_cnt() == 1       # first slot committed at 10
+    assert lane.cur.n_lane == 10
+    assert lane.backlog() == 10 + svc.per_shard
+
+
+def test_sentinel_shard_balance_slo():
+    from firedancer_tpu.disco import sentinel
+
+    rows = {}
+    snt = sentinel.Sentinel(edges_fn=lambda: {}, tiles_fn=lambda: {},
+                            metrics_fn=lambda: rows)
+    slo = sentinel.SLO_BY_NAME["shard_balance"]
+    # unarmed: no rows, then below-volume rows
+    assert snt._eval_balance(slo, 0.0) == (False, 0)
+    rows.update({f"verify.shard{i}": {"lanes": 4} for i in range(8)})
+    assert snt._eval_balance(slo, 0.0)[0] is False   # < MIN_SHARD_LANES
+    # armed + balanced: no breach, ratio reported in milli-x
+    rows.update({f"verify.shard{i}": {"lanes": 100 + i} for i in range(8)})
+    breach, milli = snt._eval_balance(slo, 0.0)
+    assert breach is False and 1000 <= milli <= 1100
+    # busiest > 1.5x laziest: breach
+    rows["verify.shard7"] = {"lanes": 200}
+    assert snt._eval_balance(slo, 0.0)[0] is True
+    # a starved shard under load is the worst signature
+    rows["verify.shard7"] = {"lanes": 0}
+    breach, milli = snt._eval_balance(slo, 0.0)
+    assert breach is True and milli >= 1 << 20
+    # non-shard rows never group
+    assert "shard_balance" in sentinel.SLO_NAMES
+
+
+def test_pod_artifact_schema():
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import bench_log_check
+
+    good = {
+        "metric": "pod_aggregate_throughput", "schema_version": 2,
+        "ts": "2026-08-04T00:00:00Z", "value": 12.5,
+        "unit": "verifies/s", "devices": 8, "on_device": False,
+        "batch": 32, "corpus": 140, "elapsed_s": 10.0, "ok": True,
+        "digest_parity": True, "alert_cnt": 0, "rlc_fallbacks": 1,
+        "shard_lanes": [10] * 8, "shard_balance": 1.1,
+        "overlap": {"serialized_ms": 100.0, "pipelined_ms": 90.0,
+                    "overlap_ms": 10.0, "local_fill_ms": 40.0,
+                    "combine_tail_ms": 10.0, "tail_hidden_est": 1.0,
+                    "gate": "measured"},
+        "failures": [],
+    }
+    assert bench_log_check.validate_pod(good) == []
+    bad = dict(good, shard_lanes=[10] * 4)
+    assert any("devices" in e for e in bench_log_check.validate_pod(bad))
+    bad = dict(good, overlap=dict(good["overlap"], overlap_ms=-5.0))
+    assert any("hid nothing" in e
+               for e in bench_log_check.validate_pod(bad))
+    # the 1-core gate basis accepts noise-negative overlap but never
+    # real degradation
+    ok1core = dict(good, overlap=dict(good["overlap"], overlap_ms=-5.0,
+                                      pipelined_ms=105.0,
+                                      gate="non-degradation"))
+    assert bench_log_check.validate_pod(ok1core) == []
+    bad1core = dict(good, overlap=dict(good["overlap"],
+                                       pipelined_ms=130.0,
+                                       overlap_ms=-30.0,
+                                       gate="non-degradation"))
+    assert any("degraded" in e
+               for e in bench_log_check.validate_pod(bad1core))
+    bad = dict(good, shard_balance=2.0)
+    assert any("shard_balance" in e
+               for e in bench_log_check.validate_pod(bad))
+    # a missing/typo'd gate basis fails loudly (it arms the ok rules)
+    bad = dict(good, overlap={k: v for k, v in good["overlap"].items()
+                              if k != "gate"})
+    assert any("overlap.gate" in e
+               for e in bench_log_check.validate_pod(bad))
+    # an ok:false artifact is evidence, not a schema violation
+    sad = dict(good, ok=False, digest_parity=False, shard_balance=9.0)
+    assert bench_log_check.validate_pod(sad) == []
+    # the stdlib-only validator's restated balance budget pins the
+    # sentinel flag (one owner; the _STAGE_KEYS precedent)
+    from firedancer_tpu import flags
+
+    assert bench_log_check._POD_BALANCE_MAX \
+        == flags.REGISTRY["FD_SLO_SHARD_BALANCE_PCT"].default / 100.0
+
+
+def test_prediction_11_grades_on_device_only():
+    from firedancer_tpu.disco import sentinel
+
+    ov = {"tail_hidden_est": 0.9, "overlap_ms": 12.0,
+          "gate": "measured"}
+    base = {"metric": "pod_aggregate_throughput", "schema_version": 2,
+            "unit": "verifies/s", "devices": 8,
+            "ts": "2026-08-04T00:00:00Z", "overlap": ov}
+    mk = lambda **kw: sentinel._classify(dict(base, **kw), "s")
+    led = sentinel.prediction_ledger
+    # the virtual-mesh smoke artifact can never grade it
+    assert led([mk(value=2e6, on_device=False)])[10]["verdict"] \
+        == "pending"
+    assert led([mk(value=2e6, on_device=True)])[10]["verdict"] \
+        == "confirmed"
+    assert led([mk(value=9e5, on_device=True)])[10]["verdict"] \
+        == "falsified"
+    # the hidden-fraction RATIO alone is not pipelining evidence: a
+    # broken double buffer (no measured overlap) falsifies even with
+    # tail_hidden_est = 1.0
+    broken = mk(value=2e6, on_device=True,
+                overlap=dict(ov, overlap_ms=-3.0, tail_hidden_est=1.0))
+    assert led([broken])[10]["verdict"] == "falsified"
+    # a non-measured gate basis cannot grade (no such host is a pod)
+    ungated = mk(value=2e6, on_device=True,
+                 overlap=dict(ov, gate="non-degradation"))
+    assert led([ungated])[10]["verdict"] == "pending"
+    hidden_low = mk(value=2e6, on_device=True,
+                    overlap=dict(ov, tail_hidden_est=0.5))
+    assert led([hidden_low])[10]["verdict"] == "falsified"
+
+
+def test_parts_spec_covers_local_partials():
+    """The shard_map spec pytree and verify_rlc_local's parts dict must
+    agree structurally (a drifted key silently unshards a partial)."""
+    from firedancer_tpu.parallel.mesh import _rlc_parts_spec
+
+    spec = _rlc_parts_spec("dp")
+    assert set(spec) == {"w_r", "ok_r", "w_m", "ok_m", "sub", "sub_ok"}
+    for key in ("w_r", "w_m", "sub"):
+        assert isinstance(spec[key], tuple) and len(spec[key]) == 4
+
+
+# ----------------------------------------------------------------- heavy --
+
+
+def _signed_batch(n=N, salt_lane=None):
+    rng = np.random.RandomState(77)
+    msgs = np.zeros((n, MAX_LEN), np.uint8)
+    lens = np.zeros(n, np.int32)
+    sigs = np.zeros((n, 64), np.uint8)
+    pubs = np.zeros((n, 32), np.uint8)
+    for i in range(n):
+        seed = bytes([i + 1, 77]) + bytes(30)
+        _, _, pub = oracle.keypair_from_seed(seed)
+        m = rng.randint(0, 256, rng.randint(1, MAX_LEN), dtype=np.uint8)
+        sig = oracle.sign(m.tobytes(), seed)
+        msgs[i, : len(m)] = m
+        lens[i] = len(m)
+        sigs[i] = np.frombuffer(sig, np.uint8)
+        pubs[i] = np.frombuffer(pub, np.uint8)
+    if salt_lane is not None:
+        # Flip a MESSAGE byte: the lane stays live (decodable R, valid
+        # s range) with a guaranteed batch-equation defect — an R-byte
+        # flip could instead make the encoding undecodable, turning
+        # the lane definite and leaving batch_ok True.
+        msgs[salt_lane, 0] ^= 0xFF
+    return msgs, lens, sigs, pubs
+
+
+def _torsion_lane(msgs, lens, sigs, pubs, lane):
+    """Forge lane `lane` with an order-2 torsion offset: passes every
+    per-lane format check, defeats the bare RLC equation half the
+    time, and only the cross-shard subgroup certification reliably
+    forces the fallback (test_verify_rlc._torsion_batch)."""
+    t2 = (0, oracle.P - 1)
+    assert oracle.scalarmult(2, t2) == (0, 1)
+    seed = bytes([lane + 1, 77]) + bytes(30)
+    a, _, pub = oracle.keypair_from_seed(seed)
+    m = msgs[lane, : lens[lane]].tobytes()
+    r = 987_654_321 + lane
+    big_r = oracle.point_add(oracle.scalarmult(r, oracle.B), t2)
+    r_bytes = oracle.point_compress(big_r)
+    from firedancer_tpu.ballet.ed25519.oracle import _sha512_mod_l
+
+    h = _sha512_mod_l(r_bytes, pub, m)
+    s = (r + h * a) % oracle.L
+    sig = r_bytes + s.to_bytes(32, "little")
+    assert oracle.verify(m, sig, pub) != 0
+    sigs = sigs.copy()
+    sigs[lane] = np.frombuffer(sig, np.uint8)
+    pubs = pubs.copy()
+    pubs[lane] = np.frombuffer(pub, np.uint8)
+    return msgs, lens, sigs, pubs
+
+
+@pytest.mark.slow
+def test_split_step_8dev_parity():
+    """8-virtual-device mesh: the split pair (local_fill +
+    combine_tail) == the monolithic sharded step == the single-graph
+    verify_batch_rlc, bit-exact on status/definite and agreeing on
+    batch_ok — clean batch, salted batch, and a torsion forgery landed
+    on a NON-ZERO shard (lane 12 of 16 -> shard 6), which only the
+    cross-shard certification can see."""
+    import jax
+
+    from firedancer_tpu.ops.verify_rlc import (
+        fresh_u,
+        fresh_z,
+        verify_batch_rlc,
+    )
+    from firedancer_tpu.parallel.mesh import (
+        make_mesh,
+        verify_rlc_split_sharded,
+        verify_rlc_step_sharded,
+    )
+
+    mesh = make_mesh(8)
+    mono = verify_rlc_step_sharded(mesh)
+    lf, ct = verify_rlc_split_sharded(mesh)
+    single = jax.jit(verify_batch_rlc)
+    rng = np.random.default_rng(99)
+
+    cases = {
+        "clean": _signed_batch(),
+        "salted": _signed_batch(salt_lane=5),
+        "torsion_shard6": _torsion_lane(*_signed_batch(), lane=12),
+    }
+    for name, (msgs, lens, sigs, pubs) in cases.items():
+        args = (jnp.asarray(msgs), jnp.asarray(lens),
+                jnp.asarray(sigs), jnp.asarray(pubs))
+        z = jnp.asarray(fresh_z(N, rng))
+        u = jnp.asarray(fresh_u(K, 2 * N, rng))
+        ref = [np.asarray(x) for x in single(*args, z, u)]
+        got_m = [np.asarray(x) for x in mono(*args, z, u)]
+        st, de, parts = lf(*args, z, u)
+        got_s = [np.asarray(st), np.asarray(de), np.asarray(ct(parts))]
+        for got, label in ((got_m, "mono"), (got_s, "split")):
+            assert (got[0] == ref[0]).all(), (name, label)
+            assert (got[1] == ref[1]).all(), (name, label)
+            assert bool(got[2]) == bool(ref[2]), (name, label)
+        if name == "clean":
+            assert bool(ref[2])
+        else:
+            assert not bool(ref[2])
+        if name == "torsion_shard6":
+            # live lane (format-valid), caught only by certification
+            assert not bool(ref[1][12])
+
+
+@pytest.mark.slow
+def test_pod_service_replay_parity_and_balance(monkeypatch):
+    """The double-buffered service over a mixed corpus at 2 shards:
+    verdict parity with the per-txn oracle, occupancy within 1.5x,
+    per-shard flight lanes summing to the merged row, and at least one
+    whole-batch fallback from the salted traffic."""
+    monkeypatch.setenv("FD_RLC_TORSION_K", "8")
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+    from firedancer_tpu.disco.pod import pod_replay
+
+    c = mainnet_corpus(n=60, seed=5, dup_rate=0.0, corrupt_rate=0.05,
+                       parse_err_rate=0.05, sign_batch_size=64,
+                       max_data_sz=40)
+    out = pod_replay(c.payloads, batch=32, n_shards=2, max_msg_len=256)
+    svc = out["service"]
+    assert out["verified_ok"] > 0
+    # oracle parity: every payload's service verdict == the RFC 8032
+    # per-txn truth
+    from hashlib import sha256
+
+    from firedancer_tpu.ballet.txn import TxnParseError, parse_txn
+
+    want_ok = []
+    for p in c.payloads:
+        try:
+            items = list(parse_txn(p).verify_items(p))
+        except TxnParseError:
+            continue
+        if not items or any(len(m) > 256 for (_, _, m) in items):
+            continue
+        good = all(oracle.verify(m, sig, pub) == 0
+                   for (sig, pub, m) in items)
+        if good:
+            want_ok.append(sha256(p).digest())
+    assert sorted(out["digests"]) == sorted(want_ok)
+    assert out["verified_ok"] == len(want_ok)
+    # occupancy: balanced, and the shard rows sum to the merged row
+    assert svc.balance_ratio() <= 1.5
+    assert sum(svc.shard_occupancy()) == svc.stat_lanes
+    assert svc.fl.get("lanes") == svc.stat_lanes
+    # the salted lanes forced at least one whole-batch fallback
+    assert svc.stat_fallbacks >= 1
+    assert svc.fl.get("rlc_fallback") == svc.stat_fallbacks

@@ -28,7 +28,7 @@ def test_slo_table_typed_and_unique():
     names = [s.name for s in sentinel.SLO_TABLE]
     assert len(names) == len(set(names))
     for s in sentinel.SLO_TABLE:
-        assert s.kind in ("latency", "liveness"), s.name
+        assert s.kind in ("latency", "liveness", "balance"), s.name
         assert s.objective, s.name
         assert s.budget_flag in __import__(
             "firedancer_tpu.flags", fromlist=["REGISTRY"]).REGISTRY, s.name
@@ -298,10 +298,10 @@ def test_timeline_ingests_repo_history_without_error():
     assert any(e.legacy for e in timeline)
 
 
-def test_prediction_ledger_all_ten_pending_on_repo_history():
+def test_prediction_ledger_all_eleven_pending_on_repo_history():
     ledger = sentinel.prediction_ledger(sentinel.load_timeline(REPO))
-    assert len(ledger) == 10
-    assert [p["id"] for p in ledger] == list(range(1, 11))
+    assert len(ledger) == 11
+    assert [p["id"] for p in ledger] == list(range(1, 12))
     for p in ledger:
         assert p["verdict"] == "pending", p
         assert p["rule"] and p["predicted"], p
@@ -335,6 +335,15 @@ def test_prediction_ledger_autogrades_synthetic_r06():
         _sv2({"mode": "rlc", "batch": 16384, "value": 455_000.0}),
         sentinel._classify({"metric": "rlc_mesh_scaling", "speedup": 1.9,
                             "devices": 2}, "synthetic"),
+        sentinel._classify({"metric": "pod_aggregate_throughput",
+                            "value": 1_100_000.0, "unit": "verifies/s",
+                            "devices": 8, "on_device": True,
+                            "schema_version": 2,
+                            "ts": "2026-08-09T00:00:00Z",
+                            "overlap": {"tail_hidden_est": 0.9,
+                                        "overlap_ms": 14.0,
+                                        "gate": "measured"}},
+                           "synthetic"),
     ]
     ledger = sentinel.prediction_ledger(timeline)
     assert all(p["verdict"] == "confirmed" for p in ledger), ledger
