@@ -114,7 +114,7 @@ echo "== fd_sentinel SLO smoke (burn-rate asymmetry + report/ledger) =="
 # latency rule), a seeded hb_stall + credit_starve chaos schedule
 # trips EXACTLY the matching SLOs (fault class <-> SLO name pinned in
 # the flight dump), fd_report ingests the repo's real BENCH_LOG.jsonl
-# + artifact family without error with all eleven ROOFLINE predictions
+# + artifact family without error with all twelve ROOFLINE predictions
 # pending, and flight+sentinel overhead stays <= 5% vs both disabled.
 JAX_PLATFORMS=cpu python scripts/slo_smoke.py
 
@@ -187,6 +187,20 @@ echo "== Montgomery-batched decompress smoke (CPU, PR-14 engines) =="
 # under bench_log_check's stage_ms schema with the batched engine
 # measurably ahead of the staged one.
 JAX_PLATFORMS=cpu python scripts/decompress_smoke.py
+
+echo "== fd_msm2 smoke (signed-digit Pippenger schedule gate, CPU) =="
+# The PR-16 MSM-schedule gate: the certified borrow-propagating recode
+# (ops/msm_recode.py) bit-exact vs a python-int reference at every
+# shippable width with the signed-digit expansion reconstructing the
+# scalar; the FD_MSM_* dispatch contract (typos raise, default is the
+# u7 baseline, explicit BASELINE_PLAN bit-identical, signed lazy plan
+# point-equal); the committed fdcert certificate carrying every
+# msm_recode entry with the live certifier clean AND the msm_search
+# recode_deep negative control (deferred base-2^w borrow) provably
+# rejected; and bench_log_check's msm_schedule_search schema accepting
+# a well-formed artifact while rejecting one whose negative controls
+# passed (with the EngineRegistry grammar-gating rung-plan installs).
+JAX_PLATFORMS=cpu python scripts/msm_smoke.py
 
 echo "== fd_pod smoke (8-device virtual mesh, split-step service) =="
 # The round-18 pod-scale gate: the forced FD_MESH_DEVICES-device CPU

@@ -82,41 +82,74 @@ def current_frontend() -> str:
 @dataclass(frozen=True)
 class EngineSpec:
     """The typed engine identity behind flight.engine_key — mode x B x
-    shards x frontend. Hashable, so it is also the registry map key."""
+    shards x frontend x msm plan. Hashable, so it is also the registry
+    map key. ``msm`` is the fd_msm2 schedule token ("auto" = resolve
+    active_plan() from the FD_MSM_* flags at build time); a pinned
+    token forces that exact schedule into the built verify graph, so
+    two engines at the same rung but different MSM plans are DISTINCT
+    registry entries with separate compile/service accounting."""
 
     mode: str            # rlc | direct (device) or cpu | oracle (host)
     batch: int
     shards: int = 0      # mesh_devices of the sharded verify step
     frontend: str = "auto"
+    msm: str = "auto"    # fd_msm2 plan token (msm_plan.parse_plan)
 
     @property
     def key(self) -> str:
         return flight.engine_key(self.mode, self.batch, self.shards,
-                                 self.frontend)
+                                 self.frontend, self.msm)
 
     def with_batch(self, batch: int) -> "EngineSpec":
         return replace(self, batch=batch)
+
+    def with_msm(self, msm: str) -> "EngineSpec":
+        return replace(self, msm=msm)
+
+    def resolved_msm(self) -> str:
+        """The plan token this spec's graph would bake in NOW: the
+        pinned token verbatim, else the FD_MSM_* flag resolution
+        (msm_plan.plan_from_flags — jax-free, so host-side registry
+        bookkeeping can call this; meaningful for rlc engines only,
+        direct/host engines run no Pippenger MSM)."""
+        if self.msm != "auto":
+            return self.msm
+        return msm_plan.plan_token(msm_plan.plan_from_flags())
 
     @classmethod
     def for_tile(cls, backend: str, verify_mode: str, batch: int,
                  mesh_devices: int) -> "EngineSpec":
         """The spec a VerifyTile's dispatches are keyed by: device
         backends key on the resolved verify mode, host backends on the
-        backend name (the long-standing engine_key convention)."""
-        return cls(verify_mode if backend == "tpu" else backend,
-                   batch, mesh_devices, current_frontend())
+        backend name (the long-standing engine_key convention). The
+        msm field comes from the registry's per-rung plan table
+        (msm_search winners), falling back to "auto" (the FD_MSM_*
+        flags) for rungs no search has certified."""
+        mode = verify_mode if backend == "tpu" else backend
+        msm = "auto"
+        if mode == "rlc":
+            msm = registry().rung_plan(batch)
+        return cls(mode, batch, mesh_devices, current_frontend(), msm)
 
 
 def parse_key(key: str) -> EngineSpec:
-    """Inverse of EngineSpec.key ("mode:B<batch>:shards<n>:fe<impl>")
-    for artifact/readback tooling; raises ValueError on junk."""
+    """Inverse of EngineSpec.key
+    ("mode:B<batch>:shards<n>:fe<impl>[:msm<plan>]") for
+    artifact/readback tooling; raises ValueError on junk. The msm
+    segment is optional — every pre-fd_msm2 key parses to msm="auto",
+    so old artifacts keep round-tripping."""
     parts = key.split(":")
-    if (len(parts) != 4 or not parts[1].startswith("B")
+    if (len(parts) not in (4, 5) or not parts[1].startswith("B")
             or not parts[2].startswith("shards")
             or not parts[3].startswith("fe")):
         raise ValueError(f"not an engine key: {key!r}")
+    msm = "auto"
+    if len(parts) == 5:
+        if not parts[4].startswith("msm") or len(parts[4]) <= 3:
+            raise ValueError(f"not an engine key: {key!r}")
+        msm = parts[4][3:]
     return EngineSpec(parts[0], int(parts[1][1:]), int(parts[2][6:]),
-                      parts[3][2:])
+                      parts[3][2:], msm)
 
 
 # --------------------------------------------------------------------------
@@ -233,7 +266,7 @@ class EngineEntry:
         "spec", "key", "state", "fn", "direct_fn", "compile_s",
         "fallback_compile_s", "cache_hit_est", "err", "dispatches",
         "lanes", "service_ns", "fill_efficiency", "madds_per_lane",
-        "built_ts", "_warmed", "_build_lock",
+        "msm_token", "built_ts", "_warmed", "_build_lock",
         # fd_pod split-step pair (mesh rlc engines under FD_POD_SPLIT):
         # the two separately-jitted graphs + their own service EMAs, so
         # the cost model can be overlap-aware (combine_tail hides
@@ -269,9 +302,13 @@ class EngineEntry:
                 spec.batch)["total"]
             self.madds_per_lane = msm_plan.executed_madds_per_lane(
                 spec.batch)
+            # fd_msm2: the schedule token this engine's graph bakes in
+            # (re-resolved at _build, where the bake actually happens).
+            self.msm_token = spec.resolved_msm()
         else:
             self.fill_efficiency = None
             self.madds_per_lane = None
+            self.msm_token = None
         self.built_ts = 0.0
         self._warmed: set = set()   # (batch, max_msg_len) shapes warmed
         self._build_lock = threading.Lock()
@@ -370,6 +407,12 @@ class EngineEntry:
             "fill_efficiency": (round(self.fill_efficiency, 4)
                                 if self.fill_efficiency is not None
                                 else None),
+            # fd_msm2: the MSM schedule token the graph bakes in
+            # (None = not an MSM engine). "auto" never appears here —
+            # the entry records the RESOLVED plan, so an artifact
+            # reader can tell which schedule a service EMA measured
+            # even when the spec deferred to the FD_MSM_* flags.
+            "msm": self.msm_token,
             "err": self.err,
         }
 
@@ -384,6 +427,11 @@ class EngineRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._entries: Dict[EngineSpec, EngineEntry] = {}
+        # fd_msm2: per-rung MSM schedule winners (batch -> plan token),
+        # installed by scripts/msm_search.py's certify+parity+bench
+        # pipeline; EngineSpec.for_tile consults this so a tile's rlc
+        # dispatches pick up the searched plan without env plumbing.
+        self._rung_plans: Dict[int, str] = {}
         self._prewarm_q: deque = deque()   # (spec, max_msg_len)
         self._prewarm_wake = threading.Event()
         self._prewarm_stop = threading.Event()
@@ -395,6 +443,29 @@ class EngineRegistry:
         # seen by the draining loop before it breaks — is_alive() alone
         # races a thread that decided to exit but hasn't died yet.
         self._prewarm_running = False
+
+    # -- per-rung MSM plans (fd_msm2) ------------------------------------
+
+    def set_rung_plan(self, batch: int, token: str) -> None:
+        """Install the msm_search winner for a B rung. The token is
+        validated through msm_plan.parse_plan — a plan the grammar
+        rejects (and so the certifier never admitted) cannot be
+        registered, which is the registry half of the negative-control
+        contract. "auto" clears the pin (the rung falls back to the
+        FD_MSM_* flags)."""
+        if token != "auto":
+            msm_plan.parse_plan(token)   # raises on junk/unshippable
+        with self._lock:
+            if token == "auto":
+                self._rung_plans.pop(int(batch), None)
+            else:
+                self._rung_plans[int(batch)] = token
+
+    def rung_plan(self, batch: int) -> str:
+        """The pinned MSM schedule token for a B rung ("auto" when no
+        search winner is installed)."""
+        with self._lock:
+            return self._rung_plans.get(int(batch), "auto")
 
     # -- entry map -------------------------------------------------------
 
@@ -458,6 +529,16 @@ class EngineRegistry:
 
         from firedancer_tpu.ops.verify import verify_batch
 
+        # fd_msm2: the MSM schedule this graph bakes in. A pinned spec
+        # token forces that exact plan; "auto" passes plan=None so the
+        # builders resolve active_plan() from the FD_MSM_* flags at
+        # trace time (the pre-fd_msm2 behavior when all flags default).
+        plan = None
+        if spec.mode == "rlc":
+            if spec.msm != "auto":
+                plan = msm_plan.parse_plan(spec.msm)
+            e.msm_token = spec.resolved_msm()
+
         rlc_sharded = None
         if spec.shards:
             if spec.batch % spec.shards:
@@ -490,7 +571,8 @@ class EngineRegistry:
                         verify_rlc_split_sharded,
                     )
 
-                    local_fn, tail_fn = verify_rlc_split_sharded(mesh)
+                    local_fn, tail_fn = verify_rlc_split_sharded(
+                        mesh, plan=plan)
                     e.fn_local = local_fn
                     e.fn_tail = tail_fn
 
@@ -503,15 +585,26 @@ class EngineRegistry:
                         verify_rlc_step_sharded,
                     )
 
-                    rlc_sharded = verify_rlc_step_sharded(mesh)
+                    rlc_sharded = verify_rlc_step_sharded(mesh, plan=plan)
         else:
             direct_fn = jax.jit(verify_batch)
         fn = direct_fn
         if spec.mode == "rlc":
             # RLC batch-verify fast pass with lazy per-lane fallback
             # (ops/verify_rlc.py); clean batches cost one MSM pass.
-            from firedancer_tpu.ops.verify_rlc import make_async_verifier
+            from firedancer_tpu.ops.verify_rlc import (
+                make_async_verifier,
+                verify_batch_rlc,
+            )
 
+            if rlc_sharded is None and plan is not None:
+                # Single-device engine with a pinned plan: bake it into
+                # the jitted RLC graph here (make_async_verifier's
+                # default jit would re-resolve from the flags).
+                import functools
+
+                rlc_sharded = jax.jit(
+                    functools.partial(verify_batch_rlc, plan=plan))
             fn = make_async_verifier(direct_fn, rlc_fn=rlc_sharded)
         e.direct_fn = direct_fn
         e.fn = fn

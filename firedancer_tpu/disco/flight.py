@@ -670,8 +670,17 @@ _COMPILE_CAP = 256
 _CACHE_HIT_S = 1.0   # persistent-cache loads come back well under this
 
 
-def engine_key(mode: str, batch: int, shards: int, frontend: str) -> str:
-    return f"{mode}:B{batch}:shards{shards}:fe{frontend}"
+def engine_key(mode: str, batch: int, shards: int, frontend: str,
+               msm: str = "auto") -> str:
+    """mode:B<batch>:shards<n>:fe<impl>[:msm<plan>] — the msm segment
+    (fd_msm2 schedule token, e.g. s7l3) appears ONLY when a non-auto
+    plan is pinned, so every pre-fd_msm2 key (and every auto-plan
+    engine) keeps its exact historical spelling and compile records
+    stay comparable across rounds."""
+    key = f"{mode}:B{batch}:shards{shards}:fe{frontend}"
+    if msm and msm != "auto":
+        key += f":msm{msm}"
+    return key
 
 
 def compile_cache_hit_est(seconds: float) -> bool:

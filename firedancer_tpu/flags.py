@@ -117,6 +117,40 @@ _register(
     trace_time=True, choices=("auto", "xla", "pallas", "interpret"),
 )
 _register(
+    "FD_MSM_PLAN", str, "auto",
+    "fd_msm2 Pippenger schedule token: sign char ('u' unsigned / 's' "
+    "signed-digit), window width w in {6,7,8}, optional 'l3' suffix "
+    "for the lazy-reduction-depth-3 niels-madd fill (signed REQUIRES "
+    "l3 — the balanced recode only exists on that engine; lazy plans "
+    "require Z==1 points, which every production call site feeds). "
+    "'auto' composes a plan from FD_MSM_SIGNED/FD_MSM_WINDOW (all-"
+    "default == the historical u7 engine, bit-identical). A concrete "
+    "token here OVERRIDES both. Candidates are certifier-gated: only "
+    "tokens that pass scripts/msm_search.py's cert+parity gate are "
+    "registrable per rung (see build/msm_search.json).",
+    trace_time=True,
+    choices=("auto", "u6", "u7", "u8", "u6l3", "u7l3", "u8l3",
+             "s6l3", "s7l3", "s8l3"),
+)
+_register(
+    "FD_MSM_WINDOW", int, 7,
+    "fd_msm2 window width in bits (6, 7, or 8) when FD_MSM_PLAN is "
+    "'auto'. Non-default widths imply the lazy niels fill (the only "
+    "engine with width-generic grids). 7 + FD_MSM_SIGNED unset == the "
+    "historical engine.",
+    trace_time=True,
+)
+_register(
+    "FD_MSM_SIGNED", bool, False,
+    "fd_msm2 signed-digit (balanced w-NAF-style) recoding when "
+    "FD_MSM_PLAN is 'auto': halves live buckets per window (magnitude "
+    "grid 2^(w-1)+1 wide, sign folded into the gather as a niels "
+    "yp<->ym swap + t2d negation), shrinking the Poisson static-round "
+    "bound and the reduction width. Implies the lazy fill; the borrow "
+    "recode is certified int32-wrap-free (ops/msm_recode.py).",
+    trace_time=True,
+)
+_register(
     "FD_DSM_IMPL", str, "auto",
     "Double-scalar-mult backend: 'pallas' forces the VMEM kernel, 'xla' "
     "the graph; 'auto' = pallas iff the backend is a TPU family.",

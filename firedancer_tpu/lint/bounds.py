@@ -81,6 +81,7 @@ CERT_MODULES = (
     "firedancer_tpu/ops/sc25519.py",
     "firedancer_tpu/ops/frontend_pallas.py",
     "firedancer_tpu/ops/decompress_pallas.py",
+    "firedancer_tpu/ops/msm_recode.py",
 )
 
 # Lane limits. F32_WINDOW is the mantissa-exact integer window: every
@@ -662,8 +663,42 @@ def _transfer_sel01(m, a, b):
     return _checked(lo, hi, dtype)
 
 
+# _recode_step(v, w_bits) of ops/msm_recode.py: the borrow-propagating
+# signed-window wrap. The shipping body computes
+# digit = v - (v > 2^(w-1)) * 2^w, whose raw interval hull books
+# [-2^w, 2^w] (the undecided borrow multiplies the full 2^w) and fails
+# the [-(2^(w-1)-1), 2^(w-1)] digit contract the magnitude-bucket
+# staging indexes with. The branch-precise hull is tight AND sound:
+# lanes with v <= 2^(w-1) pass through unchanged, lanes with
+# v > 2^(w-1) wrap by exactly 2^w, and an undecided lane takes the
+# union of the two branch images.
+
+
+def _transfer_recode_step(v, w_bits):
+    w = int(w_bits)
+    half = 1 << (w - 1)
+    two_w = 1 << w
+    vlo, vhi, _ = _as_interval(v)
+    passes = vlo <= half   # pass branch reachable on the lane
+    wraps = vhi > half     # wrap branch reachable on the lane
+    pass_lo, pass_hi = vlo, np.minimum(vhi, half)
+    wrap_lo = np.maximum(vlo, half + 1) - two_w
+    wrap_hi = vhi - two_w
+    lo = np.minimum(np.where(passes, pass_lo, wrap_lo),
+                    np.where(wraps, wrap_lo, pass_lo))
+    hi = np.maximum(np.where(passes, pass_hi, wrap_hi),
+                    np.where(wraps, wrap_hi, pass_hi))
+    digit = _checked(np.asarray(lo, object), np.asarray(hi, object),
+                     "int32")
+    borrow = _checked(np.where(vlo > half, 1, 0).astype(object),
+                      np.where(vhi > half, 1, 0).astype(object),
+                      "int32")
+    return digit, borrow
+
+
 _PRECISE_TRANSFERS = {
     "_sel01": _transfer_sel01,
+    "_recode_step": _transfer_recode_step,
 }
 
 
@@ -864,7 +899,8 @@ def certify_module(
     # bodies, and trace-time impl selectors take their defaults.
     _pinned = ("FD_FE_DEBUG_BOUNDS", "FD_CANON_IMPL",
                "FD_DECOMPRESS_SQ_SCHED", "FD_DECOMPRESS_BATCH",
-               "FD_DECOMPRESS_CHUNK", "FD_DECOMPRESS_IMPL")
+               "FD_DECOMPRESS_CHUNK", "FD_DECOMPRESS_IMPL",
+               "FD_MSM_SIGNED", "FD_MSM_WINDOW", "FD_MSM_PLAN")
     saved = {k: os.environ.pop(k) for k in _pinned if k in os.environ}
     try:
         try:
@@ -974,6 +1010,9 @@ def _default_externs(root: str, done: Dict[str, dict]) -> Dict[str, dict]:
         "firedancer_tpu/ops/decompress_pallas.py": {
             "fe": SimpleNamespace(**fe_ns) if fe_ns else _stub("fe"),
             "flags": real_flags,
+        },
+        "firedancer_tpu/ops/msm_recode.py": {
+            "fe": SimpleNamespace(**fe_ns) if fe_ns else _stub("fe"),
         },
     }
     return ext

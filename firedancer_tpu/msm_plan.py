@@ -19,11 +19,17 @@ The quantities:
   the expected nonzero-digit placements. This is the structural cost
   the B in {8k, 16k, 32k} sweep trades against latency: lam grows with
   B, so R(lam)/lam — the fill's overhead factor — shrinks.
+- ``MsmPlan`` / ``plan_cost`` / ``pareto_candidates`` — the fd_msm2
+  schedule-search front end: an executed-adds model over window width,
+  signed (balanced) digit recoding and lazy-reduction niels fills, so
+  only Pareto candidates reach the certify/parity/bench pipeline
+  (scripts/msm_search.py, the fe_schedule_search playbook).
 """
 
 from __future__ import annotations
 
 import math
+from typing import NamedTuple
 
 W_BITS = 7
 N_BUCKETS = 1 << W_BITS          # 7-bit MSM windows
@@ -31,11 +37,122 @@ WINDOWS_Z = 18                   # RLC z weights: uniform < 2^126
 WINDOWS_253 = 37                 # scalars mod L
 TORSION_BUCKET_BITS = 5          # subgroup_check_fast's masked digits
 
+# Scalar widths behind the two public window counts (the z weights are
+# drawn < 2^126; everything else is mod L, 253 bits). ops/msm.py keys
+# its signed-window derivation off the SAME table — a test pins it.
+SCALAR_BITS_Z = 126
+SCALAR_BITS_253 = 253
 
-def default_rounds(bsz: int, n_buckets: int = N_BUCKETS) -> int:
+
+class MsmPlan(NamedTuple):
+    """One MSM execution schedule: window width ``w`` (bits), balanced
+    signed-digit recoding (``signed`` — digits in [-(2^(w-1)-1),
+    2^(w-1)], negation folded into the gather), and the lazy-reduction
+    niels-madd fill (``lazy`` — the 7-mul extended+niels add with
+    uncarried operand sums, certified by ops/msm_recode.py). Hashable
+    and static, so it can ride a jit closure or an EngineSpec field.
+    The shipping invariant: signed recoding only exists on the lazy
+    fill path (parse_plan enforces it), so ``MsmPlan()`` — unsigned,
+    non-lazy, w=7 — is bit-identical to the pre-fd_msm2 engine."""
+
+    w: int = W_BITS
+    signed: bool = False
+    lazy: bool = False
+
+
+BASELINE_PLAN = MsmPlan()
+PLAN_WIDTHS = (6, 7, 8)
+
+
+def plan_token(plan: MsmPlan) -> str:
+    """Canonical token: 'u7', 'u8l3', 's8l3', ... ('s' = signed digits,
+    'l3' = the lazy-reduction-depth-3 niels fill)."""
+    return (("s" if plan.signed else "u") + str(plan.w)
+            + ("l3" if plan.lazy else ""))
+
+
+def parse_plan(token: str) -> MsmPlan:
+    """Inverse of plan_token; raises ValueError on junk and on the
+    unshippable combinations (signed without the lazy fill, widths
+    outside PLAN_WIDTHS) so a rejected search candidate can never be
+    spelled as a registrable plan."""
+    tok = str(token).strip()
+    sign_ch, rest = tok[:1], tok[1:]
+    if sign_ch not in ("u", "s") or not rest:
+        raise ValueError(f"unknown msm plan token {token!r}")
+    lazy = rest.endswith("l3")
+    if lazy:
+        rest = rest[:-2]
+    if not rest.isdigit():
+        raise ValueError(f"unknown msm plan token {token!r}")
+    w = int(rest)
+    if w not in PLAN_WIDTHS:
+        raise ValueError(
+            f"msm plan width {w} outside {PLAN_WIDTHS} ({token!r})")
+    signed = sign_ch == "s"
+    if signed and not lazy:
+        raise ValueError(
+            f"signed msm plan {token!r} requires the lazy fill "
+            "(signed recoding only exists on the niels-madd path)")
+    return MsmPlan(w=w, signed=signed, lazy=lazy)
+
+
+def plan_from_flags() -> MsmPlan:
+    """The MsmPlan selected by the FD_MSM_* flags (trace-time: the plan
+    is baked into the traced graph). FD_MSM_PLAN wins when set to a
+    concrete token; otherwise FD_MSM_WINDOW / FD_MSM_SIGNED compose one
+    (signed or non-default widths imply the lazy niels fill — the only
+    engine those shapes exist on). All-default == BASELINE_PLAN, which
+    dispatches to the exact pre-fd_msm2 code paths. Lives HERE (not in
+    ops/msm.py, which re-exports it as ``active_plan``) so jax-free
+    host code — the engine registry, the bench orchestrator — can
+    resolve the active schedule without importing the device ops."""
+    from firedancer_tpu import flags
+
+    token = flags.get_str("FD_MSM_PLAN")
+    if token and token != "auto":
+        return parse_plan(token)
+    w = flags.get_int("FD_MSM_WINDOW")
+    signed = flags.get_bool("FD_MSM_SIGNED")
+    if w not in PLAN_WIDTHS:
+        raise ValueError(
+            f"FD_MSM_WINDOW={w} not in {PLAN_WIDTHS} (see docs/FLAGS.md)"
+        )
+    return MsmPlan(w=w, signed=signed, lazy=bool(signed or w != W_BITS))
+
+
+def plan_windows(scalar_bits: int, w: int = W_BITS,
+                 signed: bool = False) -> int:
+    """Window count for scalar_bits-wide scalars at width w. Signed
+    recoding borrows upward, so when w divides scalar_bits exactly one
+    extra all-carry window absorbs the final borrow; otherwise the top
+    partial window has headroom (top digit <= 2^(scalar_bits mod w)
+    <= 2^(w-1)) and the count matches unsigned."""
+    nw = -(-scalar_bits // w)
+    if signed and scalar_bits % w == 0:
+        nw += 1
+    return nw
+
+
+def plan_buckets(plan: MsmPlan) -> int:
+    """Bucket-grid height per window: 2^w unsigned (bucket 0 dead),
+    2^(w-1)+1 signed (magnitude buckets |d| in 1..2^(w-1); bucket 0
+    dead) — the signed halving of live bucket state."""
+    if plan.signed:
+        return (1 << (plan.w - 1)) + 1
+    return 1 << plan.w
+
+
+def default_rounds(bsz: int, n_buckets: int = N_BUCKETS,
+                   signed: bool = False) -> int:
     """Static fill rounds for bsz points over n_buckets buckets (must
-    stay bit-identical to ops/msm._default_rounds — a test pins it)."""
-    lam = bsz / (n_buckets - 1)
+    stay bit-identical to ops/msm._default_rounds — a test pins it).
+    Unsigned grids have n_buckets-1 live buckets (bucket 0 is never
+    filled) at rate lam = bsz/(n_buckets-1) each; signed magnitude
+    grids pass n_buckets = 2^(w-1) LIVE buckets whose busiest bucket
+    (any magnitude below 2^(w-1), fed from +m and -m) runs at
+    lam = bsz/n_buckets."""
+    lam = bsz / n_buckets if signed else bsz / (n_buckets - 1)
     return min(int(lam + 7.0 * lam ** 0.5 + 8.0) + 1, bsz)
 
 
@@ -91,3 +208,129 @@ def executed_madds_per_lane(batch: int, torsion_k: int = 64) -> float:
     _, e_m = _fill(batch + 1, WINDOWS_253, N_BUCKETS)
     _, e_t = _fill(2 * batch, torsion_k, tb)
     return (e_z + e_m + e_t) / batch
+
+
+# --------------------------------------------------------------------------
+# fd_msm2: the executed-adds plan model and the Pareto pruner.
+# --------------------------------------------------------------------------
+
+# Per-fill-lane cost units, in field-mul equivalents. The legacy fill
+# runs the full extended+extended add (9 muls) plus a 4-coordinate
+# point_select and a 4-coordinate gather per round-lane; the lazy fill
+# runs the 7-mul extended+niels madd with NO output select (empty slots
+# gather the identity niels (1,1,0), which is projectively exact) and a
+# 3-coordinate gather. These weights rank candidates; the bench lane of
+# scripts/msm_search.py measures the survivors for real.
+COST_ADD_LEGACY = 11.0
+COST_MADD_LAZY = 8.0
+# Aggregation runs the full 9-mul add over the (windows x buckets)
+# reduce tree, w_bits doubling passes per window.
+COST_ADD_AGG = 9.0
+
+
+def _plan_grid(npts: int, scalar_bits: int, plan: MsmPlan) -> dict:
+    """One bucket grid's static schedule under a plan: window count,
+    grid height, live-bucket count, fill rounds, executed fill lanes
+    and the aggregation-tree lanes."""
+    nw = plan_windows(scalar_bits, plan.w, plan.signed)
+    nb = plan_buckets(plan)
+    if plan.signed:
+        live = 1 << (plan.w - 1)
+        rounds = default_rounds(npts, live, signed=True)
+    else:
+        live = nb - 1
+        rounds = default_rounds(npts, nb)
+    return {
+        "windows": nw,
+        "buckets": nb,
+        "live_buckets": live,
+        "rounds": rounds,
+        "fill_lanes": rounds * nw * nb,
+        "agg_lanes": nw * plan.w * nb,
+    }
+
+
+def _torsion_grid(npts: int, torsion_k: int, plan: MsmPlan) -> dict:
+    """The subgroup-certification grid. Digits are pre-masked random
+    trial weights, never recoded: unsigned always. The lazy plans run
+    the XLA torsion fill at the kernel engine's 5-bit masked grid
+    (subgroup_check_fast's TORSION_BUCKET_BITS); the legacy XLA path
+    keeps its historical full 7-bit grid."""
+    bits = TORSION_BUCKET_BITS if plan.lazy else W_BITS
+    nb = 1 << bits
+    rounds = default_rounds(npts, nb)
+    return {
+        "windows": torsion_k,
+        "buckets": nb,
+        "live_buckets": nb - 1,
+        "rounds": rounds,
+        "fill_lanes": rounds * torsion_k * nb,
+        "agg_lanes": torsion_k * bits * nb,
+    }
+
+
+def plan_cost(batch: int, plan: MsmPlan, torsion_k: int = 64) -> dict:
+    """Executed-adds cost model of one full RLC verify pass's MSM work
+    (z fill + 253-bit fill + torsion trials + reduce trees) under a
+    plan, in field-mul-equivalent units. Pure arithmetic — this is the
+    pruner's ranking metric, not a timing claim. The engine actually
+    runs a plan's narrow TOP window (fewer than w significant bits —
+    every signed grid has one) as an exact bit-plane tree sum instead
+    of a bucket-grid window (msm._top_window_sum: planes * B
+    add-lanes, ~1% of the fill); the model prices it as a grid window,
+    an overstatement that falls on every signed plan alike, so the
+    ranking the pruner exists for is unaffected."""
+    grids = {
+        "z": _plan_grid(batch, SCALAR_BITS_Z, plan),
+        "msm253": _plan_grid(batch + 1, SCALAR_BITS_253, plan),
+        "torsion": _torsion_grid(2 * batch, torsion_k, plan),
+    }
+    fill_lanes = sum(g["fill_lanes"] for g in grids.values())
+    agg_lanes = sum(g["agg_lanes"] for g in grids.values())
+    per_add = COST_MADD_LAZY if plan.lazy else COST_ADD_LEGACY
+    cost = fill_lanes * per_add + agg_lanes * COST_ADD_AGG
+    return {
+        "token": plan_token(plan),
+        "grids": grids,
+        "fill_lanes": fill_lanes,
+        "agg_lanes": agg_lanes,
+        "rounds_total": sum(g["rounds"] for g in grids.values()),
+        "cost": cost,
+    }
+
+
+def all_plans() -> list:
+    """Every spellable plan (parse_plan-valid), baseline first."""
+    plans = [MsmPlan(w=w, signed=False, lazy=False) for w in PLAN_WIDTHS]
+    plans += [MsmPlan(w=w, signed=False, lazy=True) for w in PLAN_WIDTHS]
+    plans += [MsmPlan(w=w, signed=True, lazy=True) for w in PLAN_WIDTHS]
+    plans.sort(key=lambda p: (p != BASELINE_PLAN,))
+    return plans
+
+
+def pareto_candidates(batch: int = 8192, torsion_k: int = 64) -> list:
+    """The analytic pruner: model every spellable plan and keep the
+    Pareto frontier over (modeled cost, total static rounds — the
+    serial-depth/overflow-slack proxy). The baseline plan always
+    survives (it is the A/B anchor the acceptance gate measures
+    against). Returns the full modeled list, cheapest first, each entry
+    carrying a 'pareto' verdict — only pareto=True candidates reach the
+    certify/parity/bench pipeline."""
+    models = [plan_cost(batch, p, torsion_k) for p in all_plans()]
+    base = plan_token(BASELINE_PLAN)
+    base_cost = next(m["cost"] for m in models if m["token"] == base)
+    for m in models:
+        m["pareto"] = not any(
+            o["cost"] <= m["cost"] and o["rounds_total"] <= m["rounds_total"]
+            and (o["cost"] < m["cost"]
+                 or o["rounds_total"] < m["rounds_total"])
+            for o in models)
+        # A candidate costlier than the baseline anchor can never
+        # displace it — dominated by definition, whatever its depth.
+        if m["cost"] > base_cost:
+            m["pareto"] = False
+    for m in models:
+        if m["token"] == base:
+            m["pareto"] = True
+    models.sort(key=lambda m: m["cost"])
+    return models

@@ -40,6 +40,11 @@ import numpy as np
 
 from . import curve25519 as ge
 from . import fe25519 as fe
+from .msm_recode import madd_niels_lazy, recode_signed
+from firedancer_tpu.msm_plan import (
+    BASELINE_PLAN, MsmPlan, PLAN_WIDTHS, parse_plan, plan_buckets,
+    plan_windows,
+)
 
 W_BITS = 7
 N_BUCKETS = 1 << W_BITS
@@ -54,43 +59,163 @@ WINDOWS_128 = 19   # any 128-bit scalar (window 18 in {0..3})
 WINDOWS_Z = 18     # RLC z weights: uniform < 2^126
 WINDOWS_253 = 37   # scalars mod L
 
+# Scalar bit-widths keyed by the BASELINE (w=7) window count callers
+# pass — the public n_windows argument stays the u7 vocabulary
+# (WINDOWS_Z / WINDOWS_128 / WINDOWS_253) and a non-default MsmPlan
+# re-derives its own window count from the underlying scalar width via
+# msm_plan.plan_windows. Unknown counts fall back to 7 * n_windows.
+SCALAR_BITS = {WINDOWS_Z: 126, WINDOWS_128: 128, WINDOWS_253: 253}
 
-def _digits(scalars_bytes: jnp.ndarray, n_windows: int) -> jnp.ndarray:
-    """(B, 32) uint8 -> (n_windows, B) int32 7-bit windows, LSB first."""
+
+def active_plan() -> MsmPlan:
+    """The MsmPlan selected by the FD_MSM_* flags — the device-ops
+    alias for msm_plan.plan_from_flags (one resolution rule; the
+    jax-free engine registry calls the msm_plan spelling)."""
+    from firedancer_tpu.msm_plan import plan_from_flags
+
+    return plan_from_flags()
+
+
+def _digits(scalars_bytes: jnp.ndarray, n_windows: int,
+            w_bits: int = W_BITS) -> jnp.ndarray:
+    """(B, 32) uint8 -> (n_windows, B) int32 w_bits-wide windows, LSB
+    first. Any w_bits <= 8 works: a window spans at most two bytes
+    (sh + w_bits <= 15), so the two-byte splice below covers it."""
     b = jnp.moveaxis(scalars_bytes.astype(jnp.int32), -1, 0)  # (32, B)
     zero = jnp.zeros_like(b[0])
     outs = []
     for w in range(n_windows):
-        bit = 7 * w
+        bit = w_bits * w
         i, sh = bit >> 3, bit & 7
         lo = b[i] if i < 32 else zero
         hi = b[i + 1] if i + 1 < 32 else zero
-        outs.append(((lo + (hi << 8)) >> sh) & (N_BUCKETS - 1))
+        outs.append(((lo + (hi << 8)) >> sh) & ((1 << w_bits) - 1))
     return jnp.stack(outs)
 
 
 def _reduce_pairs(pt, n):
-    """Tree-reduce a (..., n) bucket axis by pairwise point_add."""
+    """Tree-reduce a (..., n) bucket axis by pairwise point_add. Odd
+    widths (signed-magnitude grids are 2^(w-1)+1 wide) split off their
+    leading element into a carry folded back at the end — for powers of
+    two the op sequence is exactly the historical halving tree."""
+    carry = None
     while n > 1:
-        half = n // 2
+        if n % 2:
+            head = tuple(c[..., :1] for c in pt)
+            carry = head if carry is None else ge.point_add(carry, head)
+            pt = tuple(c[..., 1:] for c in pt)
+            n -= 1
         a = tuple(c[..., 0::2] for c in pt)
         b = tuple(c[..., 1::2] for c in pt)
         pt = ge.point_add(a, b)
-        n = half
-    return pt
+        n //= 2
+    return pt if carry is None else ge.point_add(pt, carry)
 
 
-def _default_rounds(bsz: int, n_buckets: int = N_BUCKETS) -> int:
+def _default_rounds(bsz: int, n_buckets: int = N_BUCKETS,
+                    signed: bool = False) -> int:
     # Poisson tail bound: with uniform digits each nonzero bucket holds
     # ~lam = B/(n_buckets-1) points; lam + 7*sqrt(lam) + 8 puts the
     # per-batch overflow probability below ~1e-7 even across thousands
     # of buckets. Adversarially-biased digits only cost the fallback.
-    # The formula lives in firedancer_tpu/msm_plan.py (stdlib-only) so
-    # the bench orchestrator's fill-efficiency predictions can never
-    # drift from the engine's actual round count.
+    # Signed callers pass the LIVE magnitude count 2^(w-1) (bucket 0 is
+    # dead; each live bucket catches digit rate 2/2^w). The formula
+    # lives in firedancer_tpu/msm_plan.py (stdlib-only) so the bench
+    # orchestrator's fill-efficiency predictions can never drift from
+    # the engine's actual round count.
     from firedancer_tpu.msm_plan import default_rounds
 
-    return default_rounds(bsz, n_buckets)
+    return default_rounds(bsz, n_buckets, signed=signed)
+
+
+def _plan_dims(n_windows: int, bsz: int, plan: MsmPlan,
+               _force_windows: int | None = None):
+    """(nw, n_buckets, default max_rounds) for a non-baseline plan,
+    re-derived from the scalar width behind the caller's baseline
+    window count. _force_windows is the search harness's parity-control
+    knob ONLY (a signed plan at the unsigned count drops the carry
+    window — the negative control the gate must catch)."""
+    scalar_bits = SCALAR_BITS.get(n_windows, W_BITS * n_windows)
+    nw = plan_windows(scalar_bits, plan.w, plan.signed)
+    if _force_windows is not None:
+        nw = _force_windows
+    nb = plan_buckets(plan)
+    live = (1 << (plan.w - 1)) if plan.signed else nb
+    return nw, nb, _default_rounds(bsz, live, signed=plan.signed)
+
+
+def _neg_table(neg_flags: jnp.ndarray, idx: jnp.ndarray,
+               bsz: int) -> jnp.ndarray:
+    """Gather per-lane sign flags through the slot table: neg[t, b, r]
+    is True iff slot (t, b, r) holds a lane whose signed digit was
+    negative (empty slots are False — identity has no sign)."""
+    nw, nb, rounds = idx.shape
+    safe = jnp.clip(idx.reshape(nw, -1), 0, bsz - 1)
+    neg = jnp.take_along_axis(neg_flags, safe, axis=1).reshape(
+        nw, nb, rounds
+    )
+    return neg & (idx >= 0)
+
+
+def _top_tree_planes(n_windows: int, nw: int, plan: MsmPlan) -> int:
+    """Bit planes for the plan's TOP window when it must bypass the
+    bucket grid, else 0. The static-round Poisson bound prices UNIFORM
+    w-bit digits; a top window covering r < w significant scalar bits
+    concentrates its mass on 2^r values (signed recode is the worst
+    case: the final borrow lands ~B/2 lanes on magnitude 1), so at
+    production B that one window deterministically overflows a round
+    count the other windows never approach. Such windows are instead
+    summed directly (_top_window_sum) — digits there are in [0, 2^r]
+    (signed; the borrow can add 1) or [0, 2^r) (unsigned), so r+1 / r
+    bit planes suffice. r >= w means the top window is a full uniform
+    digit and the grid handles it (the baseline geometry); r < 0 only
+    under the search harness's _force_windows truncation control,
+    which must keep the plain (wrong-by-construction) grid path."""
+    scalar_bits = SCALAR_BITS.get(n_windows, W_BITS * n_windows)
+    r = scalar_bits - plan.w * (nw - 1)
+    if r < 0 or r >= plan.w:
+        return 0
+    return r + 1 if plan.signed else r
+
+
+def _top_window_sum(top_digits, points, planes: int):
+    """W_top = sum_i top_i * P_i by MSB-first bit-plane masked tree
+    reduction over the LANE axis: planes x (select + pairwise point_add
+    tree) + one tiny doubling ladder — exact for any digit values, no
+    round bound to overflow. O(planes * B) add-lanes in O(planes *
+    log B) sequential depth; at the shapes that need it (planes <= 7)
+    this is ~1% of the bucket fill's lane count."""
+    bsz = points[0].shape[1]
+    ident_b = ge.identity((bsz,))
+    acc = ge.identity((1,))
+    for k in range(planes - 1, -1, -1):
+        m = ((top_digits >> k) & 1) == 1
+        masked = ge.point_select(m, points, ident_b)
+        t_k = _reduce_pairs(masked, bsz)
+        acc = ge.point_add(ge.point_double(acc), t_k)
+    return acc                                             # (32, 1)
+
+
+def _plan_staging(scalars_bytes, bsz: int, max_rounds: int, nw: int,
+                  n_buckets: int, plan: MsmPlan, tree_planes: int = 0):
+    """Digit extraction + (for signed plans) balanced recode + magnitude
+    bucketing: returns (idx, neg, ok, top) with neg None on unsigned
+    plans. Signed digits route |d| into bucket |d| (dead bucket 0, live
+    magnitudes 1..2^(w-1)) and fold the sign into the gather — the
+    certified recode (ops/msm_recode.py) guarantees |d| <= 2^(w-1), so
+    the magnitude grid is exactly plan_buckets wide. When tree_planes >
+    0 the top window's digit row is split off for _top_window_sum (top)
+    and the grid stages only the nw-1 uniform windows."""
+    d = _digits(scalars_bytes, nw, plan.w)
+    s = recode_signed(d, plan.w) if plan.signed else d
+    top = None
+    if tree_planes:
+        top, s = s[nw - 1], s[:nw - 1]
+    if not plan.signed:
+        idx, ok = _staging_from_digits(s, bsz, max_rounds, n_buckets)
+        return idx, None, ok, top
+    idx, ok = _staging_from_digits(jnp.abs(s), bsz, max_rounds, n_buckets)
+    return idx, _neg_table(s < 0, idx, bsz), ok, top
 
 
 def combine_stacked(pt):
@@ -171,7 +296,8 @@ def _staging_from_digits(d: jnp.ndarray, bsz: int, max_rounds: int,
 
 
 def msm(scalars_bytes: jnp.ndarray, points, n_windows: int,
-        max_rounds: int | None = None, axis_name: str | None = None):
+        max_rounds: int | None = None, axis_name: str | None = None,
+        plan: MsmPlan | None = None):
     """sum_i scalars_i * P_i (XLA reference path).
 
     scalars_bytes: (B, 32) uint8 little-endian (windows beyond
@@ -180,48 +306,118 @@ def msm(scalars_bytes: jnp.ndarray, points, n_windows: int,
       per-window bucket sums are combined across the mesh before the
       Horner tail, so the returned point is the global MSM over all
       shards' lanes (replicated), and ok is the global fill verdict.
+    plan (None = active_plan()): the fd_msm2 schedule. BASELINE_PLAN
+      runs the historical u7 path bit-identically; lazy plans require
+      points with Z == 1 (decompress output / affine constants — the
+      niels fill's mixed add assumes it, exactly like msm_fast).
     Returns (point, ok): point is (X, Y, Z, T) of (32, 1) limbs; ok is a
       () bool — False iff a bucket overflowed max_rounds (result then
       invalid; caller must use the exact path).
     """
+    if plan is None:
+        plan = active_plan()
     w_res, ok = msm_partial(scalars_bytes, points, n_windows,
-                            max_rounds=max_rounds)
-    return msm_combine(w_res, ok, n_windows, axis_name=axis_name)
+                            max_rounds=max_rounds, plan=plan)
+    return msm_combine(w_res, ok, n_windows, axis_name=axis_name,
+                       plan=plan)
 
 
 def msm_partial(scalars_bytes: jnp.ndarray, points, n_windows: int,
-                max_rounds: int | None = None):
+                max_rounds: int | None = None,
+                plan: MsmPlan | None = None,
+                _force_windows: int | None = None):
     """The LOCAL half of msm(): digit staging + bucket fill + per-window
     bucket aggregation over this shard's lanes only — no collectives, no
-    doubling-chain tails. Returns (w_res, ok): w_res a (32, n_windows)-
-    limb point per window (W_t = sum over local lanes), ok the local
-    fill verdict. msm_combine finishes the job; fd_pod's split-step
+    doubling-chain tails. Returns (w_res, ok): w_res a (32, nw)-limb
+    point per window (W_t = sum over local lanes; nw is the PLAN's
+    window count — n_windows for the baseline), ok the local fill
+    verdict. msm_combine finishes the job; fd_pod's split-step
     dispatcher jits the two halves separately so batch k's combine can
     execute while batch k+1's fill is already dispatched."""
+    if plan is None:
+        plan = active_plan()
     bsz = points[0].shape[1]
+    if plan == BASELINE_PLAN and _force_windows is None:
+        if max_rounds is None:
+            max_rounds = _default_rounds(bsz)
+        idx, ok = _staging_indices(scalars_bytes, n_windows, bsz,
+                                   max_rounds)
+        return _fill_and_aggregate(idx, points, max_rounds,
+                                   n_windows), ok
+    nw, nb, rounds = _plan_dims(n_windows, bsz, plan, _force_windows)
     if max_rounds is None:
-        max_rounds = _default_rounds(bsz)
-    idx, ok = _staging_indices(scalars_bytes, n_windows, bsz, max_rounds)
-    return _fill_and_aggregate(idx, points, max_rounds, n_windows), ok
+        max_rounds = rounds
+    planes = _top_tree_planes(n_windows, nw, plan)
+    idx, neg, ok, top = _plan_staging(scalars_bytes, bsz, max_rounds, nw,
+                                      nb, plan, tree_planes=planes)
+    nw_grid = nw - 1 if planes else nw
+    if plan.lazy:
+        w_res = _fill_and_aggregate_lazy(idx, neg, points, max_rounds,
+                                         nw_grid, nb, plan.w)
+    else:
+        w_res = _fill_and_aggregate(idx, points, max_rounds, nw_grid,
+                                    n_buckets=nb, w_bits=plan.w)
+    if planes:
+        w_top = _top_window_sum(top, points, planes)
+        w_res = tuple(jnp.concatenate([c, ct], axis=1)
+                      for c, ct in zip(w_res, w_top))
+    return w_res, ok
 
 
-def msm_combine(w_res, ok, n_windows: int, axis_name: str | None = None):
+def msm_combine(w_res, ok, n_windows: int, axis_name: str | None = None,
+                plan: MsmPlan | None = None):
     """The TAIL half of msm(): combine per-shard window partials across
     the mesh (axis_name; identity when None) and run the cross-window
-    Horner doubling chain. msm() == msm_combine(*msm_partial(...)) by
-    construction — the composition is the exact op sequence the
-    monolithic path always ran, so the split is bit-exact."""
+    Horner doubling chain (plan.w doublings per window — the window
+    count itself is read off w_res, so both halves agree by shape).
+    msm() == msm_combine(*msm_partial(...)) by construction — the
+    composition is the exact op sequence the monolithic path always
+    ran, so the split is bit-exact."""
+    if plan is None:
+        plan = active_plan()
     if axis_name is not None:
         w_res = _gather_point_sum(w_res, axis_name)
         ok = _all_shards_ok(ok, axis_name)
-    return _window_horner(w_res, n_windows), ok
+    return _window_horner(w_res, w_res[0].shape[1], w_bits=plan.w), ok
 
 
-def _fill_and_aggregate(idx, points, max_rounds: int, nw: int):
+def _aggregate_windows(acc, nw: int, n_buckets: int, w_bits: int):
+    """Per-window bucket aggregation over a filled (32, nw*nb) lane
+    accumulator: W_t = sum_b b * S_{t,b} = sum_k 2^k * (sum_{b: bit k
+    set} S_b). A lax.scan over the bit masks keeps the traced graph
+    ~w_bits x smaller than unrolling (this path must stay compilable on
+    CPU test hosts). Works for any bucket-index range < 2^w_bits —
+    signed-magnitude grids (max index 2^(w-1)) included."""
+    s_buckets = tuple(
+        c.reshape(fe.NLIMBS, nw, n_buckets) for c in acc
+    )
+    buckets = jnp.arange(n_buckets, dtype=jnp.int32)
+    ident_nb = ge.identity((nw, n_buckets))
+    bit_masks = jnp.stack([
+        jnp.broadcast_to((((buckets >> k) & 1) == 1)[None, :],
+                         (nw, n_buckets))
+        for k in range(w_bits - 1, -1, -1)
+    ])                                                     # (w_bits, nw, nb)
+
+    def agg_step(carry, bit):
+        masked = ge.point_select(bit, s_buckets, ident_nb)
+        t_k = _reduce_pairs(masked, n_buckets)             # (32, nw, 1)
+        t_k = tuple(c[..., 0] for c in t_k)                # (32, nw)
+        out = ge.point_add(ge.point_double(carry), t_k)
+        return out, None
+
+    w_res, _ = jax.lax.scan(agg_step, ge.identity((nw,)), bit_masks)
+    return w_res
+
+
+def _fill_and_aggregate(idx, points, max_rounds: int, nw: int,
+                        n_buckets: int = N_BUCKETS,
+                        w_bits: int = W_BITS):
     """Bucket fill + per-window bucket aggregation (XLA path): returns
-    w_res, a (32, nw)-limb point per window, W_t = sum_b b * S_{t,b}."""
+    w_res, a (32, nw)-limb point per window, W_t = sum_b b * S_{t,b}.
+    Defaults are the historical u7 grid — bit-identical graph."""
     bsz = points[0].shape[1]
-    lanes = nw * N_BUCKETS
+    lanes = nw * n_buckets
     ident = ge.identity((lanes,))
 
     def fill_round(r, acc):
@@ -237,33 +433,57 @@ def _fill_and_aggregate(idx, points, max_rounds: int, nw: int):
         return ge.point_select(m, ge.point_add(acc, q), acc)
 
     acc = jax.lax.fori_loop(0, max_rounds, fill_round, ident)
-    s_buckets = tuple(
-        c.reshape(fe.NLIMBS, nw, N_BUCKETS) for c in acc
-    )
-
-    # sum_b b * S_b = sum_k 2^k * (sum_{b: bit k set} S_b). A lax.scan
-    # over the bit masks keeps the traced graph ~W_BITS x smaller than
-    # unrolling (this path must stay compilable on CPU test hosts).
-    buckets = jnp.arange(N_BUCKETS, dtype=jnp.int32)
-    ident_nb = ge.identity((nw, N_BUCKETS))
-    bit_masks = jnp.stack([
-        jnp.broadcast_to((((buckets >> k) & 1) == 1)[None, :],
-                         (nw, N_BUCKETS))
-        for k in range(W_BITS - 1, -1, -1)
-    ])                                                     # (W_BITS, nw, 256)
-
-    def agg_step(carry, bit):
-        masked = ge.point_select(bit, s_buckets, ident_nb)
-        t_k = _reduce_pairs(masked, N_BUCKETS)             # (32, nw, 1)
-        t_k = tuple(c[..., 0] for c in t_k)                # (32, nw)
-        out = ge.point_add(ge.point_double(carry), t_k)
-        return out, None
-
-    w_res, _ = jax.lax.scan(agg_step, ge.identity((nw,)), bit_masks)
-    return w_res
+    return _aggregate_windows(acc, nw, n_buckets, w_bits)
 
 
-def _window_horner(w_res, nw: int):
+def _fill_and_aggregate_lazy(idx, neg, points, max_rounds: int, nw: int,
+                             n_buckets: int, w_bits: int):
+    """The fd_msm2 lazy niels fill (XLA path): 7-mul mixed adds through
+    the certified madd_niels_lazy (ops/msm_recode.py) instead of the
+    9-mul unified extended add, with the sign of a signed digit folded
+    into the gather (yp <-> ym swap + t2d negation — one elementwise
+    select, no extra field ops). Empty slots gather the identity niels
+    (1, 1, 0), which scales the accumulator's representation
+    projectively (same group element) — NO per-round point_select, so
+    the whole round is madd-only. REQUIRES points with Z == 1 (the
+    mixed add assumes it). neg: (nw, nb, R) bool from _neg_table, or
+    None for unsigned plans."""
+    bsz = points[0].shape[1]
+    lanes = nw * n_buckets
+    x, y, z, t = points
+    yp = fe.fe_add(y, x)
+    ym = fe.fe_sub(y, x)
+    t2d = fe.fe_mul(t, fe.FE_D2)
+    one0 = (jnp.arange(fe.NLIMBS, dtype=jnp.int32) == 0)[:, None]
+    one0 = one0.astype(jnp.int32)
+
+    idx_r = jnp.transpose(idx, (2, 0, 1)).reshape(max_rounds, lanes)
+    neg_r = (jnp.transpose(neg, (2, 0, 1)).reshape(max_rounds, lanes)
+             if neg is not None else None)
+
+    def fill_round(r, acc):
+        sel = jax.lax.dynamic_index_in_dim(idx_r, r, axis=0,
+                                           keepdims=False)
+        m = (sel >= 0)[None, :]
+        safe = jnp.clip(sel, 0, bsz - 1)
+        gyp = jnp.where(m, yp[:, safe], one0)
+        gym = jnp.where(m, ym[:, safe], one0)
+        gtd = jnp.where(m, t2d[:, safe], 0)
+        if neg_r is not None:
+            ng = jax.lax.dynamic_index_in_dim(
+                neg_r, r, axis=0, keepdims=False
+            )[None, :]
+            gyp, gym = (jnp.where(ng, gym, gyp),
+                        jnp.where(ng, gyp, gym))
+            gtd = jnp.where(ng, -gtd, gtd)
+        return madd_niels_lazy(*acc, gyp, gym, gtd)
+
+    acc = jax.lax.fori_loop(0, max_rounds, fill_round,
+                            ge.identity((lanes,)))
+    return _aggregate_windows(acc, nw, n_buckets, w_bits)
+
+
+def _window_horner(w_res, nw: int, w_bits: int = W_BITS):
     """Combine per-window sums: sum_t 2^(w t) W_t, MSB-first Horner as a
     lax.scan over windows (graph stays small; lanes are (32, 1))."""
     res = tuple(c[:, nw - 1:nw] for c in w_res)            # (32, 1)
@@ -275,7 +495,7 @@ def _window_horner(w_res, nw: int):
     )
 
     def horner_step(carry, wt):
-        for _ in range(W_BITS):
+        for _ in range(w_bits):
             carry = ge.point_double(carry)
         return ge.point_add(carry, wt), None
 
@@ -305,7 +525,8 @@ def _mul_by_group_order(pt):
 
 def subgroup_check(points, u_digits: jnp.ndarray,
                    max_rounds: int | None = None,
-                   axis_name: str | None = None):
+                   axis_name: str | None = None,
+                   bucket_bits: int = W_BITS, lazy: bool = False):
     """Randomized prime-subgroup (torsion-freeness) certification.
 
     points: (X, Y, Z, T) of (32, B) limbs. u_digits: (K, B) int32 in
@@ -336,24 +557,41 @@ def subgroup_check(points, u_digits: jnp.ndarray,
     the set as uncertified and take its exact path).
     """
     agg, ok_fill = subgroup_partial(points, u_digits,
-                                    max_rounds=max_rounds)
+                                    max_rounds=max_rounds,
+                                    bucket_bits=bucket_bits, lazy=lazy)
     return subgroup_combine(agg, ok_fill, axis_name=axis_name)
 
 
 def subgroup_partial(points, u_digits: jnp.ndarray,
-                     max_rounds: int | None = None):
+                     max_rounds: int | None = None,
+                     bucket_bits: int = W_BITS, lazy: bool = False):
     """Local half of subgroup_check: the K per-trial aggregates over
     THIS shard's lanes only ((32, K)-limb coords) + the local fill
-    verdict — no collectives, no [L] ladder."""
+    verdict — no collectives, no [L] ladder.
+
+    bucket_bits < W_BITS masks the trial digits (soundness preserved —
+    subgroup_check_fast's 5-bit argument: the catch probability is
+    governed by the digit distribution mod 8) and shrinks the lane
+    grid; lazy routes the fill through the certified 7-mul niels madd
+    (REQUIRES Z == 1 points, like msm_fast). Defaults are the
+    historical 7-bit unified-add path, bit-identical."""
     bsz = points[0].shape[1]
+    n_buckets = 1 << bucket_bits
     if max_rounds is None:
-        max_rounds = _default_rounds(bsz)
+        max_rounds = _default_rounds(bsz, n_buckets)
     k = u_digits.shape[0]
-    idx, ok_fill = _staging_from_digits(
-        u_digits.astype(jnp.int32), bsz, max_rounds
-    )
-    agg = _fill_and_aggregate(idx, points, max_rounds, k)  # (32, K) coords
-    return agg, ok_fill
+    d = u_digits.astype(jnp.int32)
+    if bucket_bits != W_BITS:
+        d = d & (n_buckets - 1)
+    idx, ok_fill = _staging_from_digits(d, bsz, max_rounds, n_buckets)
+    if lazy:
+        agg = _fill_and_aggregate_lazy(idx, None, points, max_rounds, k,
+                                       n_buckets, bucket_bits)
+    else:
+        agg = _fill_and_aggregate(idx, points, max_rounds, k,
+                                  n_buckets=n_buckets,
+                                  w_bits=bucket_bits)
+    return agg, ok_fill                                    # (32, K) coords
 
 
 def subgroup_combine(agg, ok_fill, axis_name: str | None = None):
@@ -378,12 +616,17 @@ _STAGE_DTYPE = jnp.int16
 
 
 def _stage_niels(points, idx, max_rounds: int, lanes: int, bsz: int,
-                 niels=None):
-    """Gather per-round niels operands: (R, 32, L) x3, identity-staged
-    ((1, 1, 0) niels form) where a slot is empty. points must have
-    Z == 1 (decompress output / affine constants). niels, if given, is
-    the precomputed (yp, ym, t2d) from the decompress kernel — skips
-    three XLA field ops over the whole point set."""
+                 niels=None, neg=None, lane_pad: int = 0):
+    """Gather per-round niels operands: (R, 32, L + lane_pad) x3,
+    identity-staged ((1, 1, 0) niels form) where a slot is empty.
+    points must have Z == 1 (decompress output / affine constants).
+    niels, if given, is the precomputed (yp, ym, t2d) from the
+    decompress kernel — skips three XLA field ops over the whole point
+    set. neg ((nw, nb, R) bool, signed plans) folds each negative
+    digit's point negation into the gather: -P in niels form is just
+    (ym, yp, -t2d), one elementwise select. lane_pad appends identity
+    columns so non-power-of-two signed grids meet the kernel's lane
+    alignment."""
     if niels is not None:
         yp, ym, t2d = niels
     else:
@@ -397,22 +640,36 @@ def _stage_niels(points, idx, max_rounds: int, lanes: int, bsz: int,
     safe = jnp.clip(sel, 0, bsz - 1)
     one0 = (jnp.arange(fe.NLIMBS, dtype=jnp.int32) == 0)[:, None]
 
-    def stage(src, ident_col):
-        g = jnp.where(m, src[:, safe], ident_col)          # (32, R*L)
-        return jnp.transpose(
+    gyp = jnp.where(m, yp[:, safe], one0.astype(jnp.int32))
+    gym = jnp.where(m, ym[:, safe], one0.astype(jnp.int32))
+    gtd = jnp.where(m, t2d[:, safe], 0)                    # (32, R*L)
+    if neg is not None:
+        ng = jnp.transpose(neg, (2, 0, 1)).reshape(
+            max_rounds * lanes
+        )[None, :]
+        gyp, gym = jnp.where(ng, gym, gyp), jnp.where(ng, gyp, gym)
+        gtd = jnp.where(ng, -gtd, gtd)
+
+    def stage(g, ident_one):
+        g = jnp.transpose(
             g.reshape(fe.NLIMBS, max_rounds, lanes), (1, 0, 2)
         ).astype(_STAGE_DTYPE)                             # (R, 32, L)
+        if lane_pad:
+            g = jnp.pad(g, ((0, 0), (0, 0), (0, lane_pad)))
+            if ident_one:
+                g = g.at[:, 0, lanes:].set(1)
+        return g
 
-    return (stage(yp, one0.astype(jnp.int32)),
-            stage(ym, one0.astype(jnp.int32)),
-            stage(t2d, 0))
+    return stage(gyp, True), stage(gym, True), stage(gtd, False)
 
 
 def msm_fast(scalars_bytes: jnp.ndarray, points, n_windows: int,
              max_rounds: int | None = None, interpret: bool = False,
-             niels=None, axis_name: str | None = None):
+             niels=None, axis_name: str | None = None,
+             plan: MsmPlan | None = None):
     """Kernel-backed msm (same contract as msm(), including axis_name's
-    cross-mesh window-partial combine before the Horner tail).
+    cross-mesh window-partial combine before the Horner tail and the
+    plan argument's schedule selection).
 
     REQUIRES points with Z == 1 (decompress output / affine constants) —
     the bucket fill uses precomputed niels form (y+x, y-x, 2d*t) with
@@ -420,45 +677,71 @@ def msm_fast(scalars_bytes: jnp.ndarray, points, n_windows: int,
     aggregation running sums live in VMEM (ops/msm_pallas.py); the
     sort/gather staging and final Horner remain XLA.
     """
+    if plan is None:
+        plan = active_plan()
     w_res, ok = msm_fast_partial(scalars_bytes, points, n_windows,
                                  max_rounds=max_rounds,
-                                 interpret=interpret, niels=niels)
+                                 interpret=interpret, niels=niels,
+                                 plan=plan)
     return msm_fast_combine(w_res, ok, n_windows, interpret=interpret,
-                            axis_name=axis_name)
+                            axis_name=axis_name, plan=plan)
 
 
 def msm_fast_partial(scalars_bytes: jnp.ndarray, points, n_windows: int,
                      max_rounds: int | None = None,
-                     interpret: bool = False, niels=None):
+                     interpret: bool = False, niels=None,
+                     plan: MsmPlan | None = None,
+                     _force_windows: int | None = None):
     """Local half of msm_fast: niels staging + VMEM bucket fill +
     running-sum aggregation over this shard's lanes — no collectives,
     no Horner. Returns (w_res, ok) exactly like msm_partial (the kernel
     aggregation's nw padding is trimmed here, so the partial's shape is
-    engine-independent and the fd_pod split tail can gather it)."""
+    engine-independent and the fd_pod split tail can gather it). A
+    signed plan folds digit signs into the niels staging (yp <-> ym
+    swap + t2d negation), so the kernels themselves are untouched —
+    magnitude grids just change the lane count, padded to the kernel's
+    lane alignment with identity slots."""
     from . import msm_pallas as mp
 
+    if plan is None:
+        plan = active_plan()
     bsz = points[0].shape[1]
-    if max_rounds is None:
-        max_rounds = _default_rounds(bsz)
-    nw = n_windows
-    idx, ok = _staging_indices(scalars_bytes, nw, bsz, max_rounds)
+    if plan == BASELINE_PLAN and _force_windows is None:
+        if max_rounds is None:
+            max_rounds = _default_rounds(bsz)
+        nw, nb = n_windows, N_BUCKETS
+        idx, ok = _staging_indices(scalars_bytes, nw, bsz, max_rounds)
+        neg, top, planes = None, None, 0
+    else:
+        nw, nb, rounds = _plan_dims(n_windows, bsz, plan, _force_windows)
+        if max_rounds is None:
+            max_rounds = rounds
+        planes = _top_tree_planes(n_windows, nw, plan)
+        idx, neg, ok, top = _plan_staging(scalars_bytes, bsz, max_rounds,
+                                          nw, nb, plan,
+                                          tree_planes=planes)
+    nw_grid = nw - 1 if planes else nw
 
-    lanes = nw * N_BUCKETS
+    lanes = nw_grid * nb
+    lane_pad = (-lanes) % 256 if nb != N_BUCKETS else 0
     s_yp, s_ym, s_t2d = _stage_niels(points, idx, max_rounds, lanes, bsz,
-                                     niels=niels)
+                                     niels=niels, neg=neg,
+                                     lane_pad=lane_pad)
 
     bx, by, bz, bt = mp.fill_buckets_pallas(
         s_yp, s_ym, s_t2d, interpret=interpret
     )
+    if lane_pad:
+        bx, by, bz, bt = (c[:, :lanes] for c in (bx, by, bz, bt))
 
-    # (32, L) -> bucket-major (256, 32, nw_pad) for the aggregation walk.
-    nw_pad = max(128, nw)
+    # (32, L) -> bucket-major (nb, 32, nw_pad) for the aggregation walk.
+    nw_pad = max(128, nw_grid)
     def to_bucket_major(c):
         c = jnp.transpose(
-            c.reshape(fe.NLIMBS, nw, N_BUCKETS), (2, 0, 1)
+            c.reshape(fe.NLIMBS, nw_grid, nb), (2, 0, 1)
         )
-        if nw_pad != nw:
-            c = jnp.pad(c, ((0, 0), (0, 0), (0, nw_pad - nw)))
+        if nw_pad != nw_grid:
+            c = jnp.pad(c, ((0, 0), (0, 0), (0, nw_pad - nw_grid)))
         return c
 
     w_res = mp.aggregate_buckets_pallas(
@@ -466,22 +749,34 @@ def msm_fast_partial(scalars_bytes: jnp.ndarray, points, n_windows: int,
         fe.FE_D2.astype(jnp.int32),
         interpret=interpret,
     )
-    return tuple(c[:, :nw] for c in w_res), ok
+    w_res = tuple(c[:, :nw_grid] for c in w_res)
+    if planes:
+        # The tree-summed top window is XLA-side on both engines — it is
+        # ~1% of the fill's lane count and keeps the kernels untouched.
+        w_top = _top_window_sum(top, points, planes)
+        w_res = tuple(jnp.concatenate([c, ct], axis=1)
+                      for c, ct in zip(w_res, w_top))
+    return w_res, ok
 
 
 def msm_fast_combine(w_res, ok, n_windows: int, interpret: bool = False,
-                     axis_name: str | None = None):
+                     axis_name: str | None = None,
+                     plan: MsmPlan | None = None):
     """Tail half of msm_fast: cross-mesh window-partial combine + the
-    VMEM Horner doubling chain. msm_fast == the composition, bit-exact
-    (same op order the monolithic path always ran)."""
+    VMEM Horner doubling chain (plan.w doublings per window; the window
+    count is read off w_res so both halves agree by shape). msm_fast ==
+    the composition, bit-exact (same op order the monolithic path
+    always ran)."""
     from . import msm_pallas as mp
 
+    if plan is None:
+        plan = active_plan()
     if axis_name is not None:
         w_res = _gather_point_sum(w_res, axis_name)
         ok = _all_shards_ok(ok, axis_name)
     res = mp.window_horner_pallas(
-        w_res, fe.FE_D2.astype(jnp.int32), n_windows, interpret=interpret,
-        w_bits=W_BITS,
+        w_res, fe.FE_D2.astype(jnp.int32), w_res[0].shape[1],
+        interpret=interpret, w_bits=plan.w,
     )
     return res, ok
 

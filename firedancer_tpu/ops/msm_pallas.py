@@ -103,7 +103,16 @@ def fill_buckets_pallas(yp, ym, t2d, lane_tile: int = 2048,
 
     n_rounds, _, n_lanes = yp.shape
     if n_lanes % lane_tile:
-        lane_tile = n_lanes
+        # fd_msm2 plan grids (windows x buckets, buckets not a power of
+        # two for signed-magnitude plans) are staged to a multiple of
+        # 256 lanes but rarely divide 2048: pick the largest divisor of
+        # n_lanes that is a multiple of 128 and <= the requested tile,
+        # falling back to the whole array as one tile (interpret/CPU).
+        lane_tile = max(
+            (t for t in range(128, min(lane_tile, n_lanes) + 1, 128)
+             if n_lanes % t == 0),
+            default=n_lanes,
+        )
     n_tiles = n_lanes // lane_tile
 
     def kern(ypr, ymr, t2dr, ox, oy, oz, ot, xs, ys, zs, ts):

@@ -159,7 +159,7 @@ def fresh_u(k: int, batch: int,
 
 
 def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits,
-                     axis_name: str | None = None):
+                     axis_name: str | None = None, plan=None):
     """One RLC pass over a batch.
 
     Args are as ops.verify.verify_batch, plus z_bytes (B, 32) uint8
@@ -192,14 +192,22 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits,
     — the exact op sequence the monolithic step always ran, so this
     single-graph path stays bit-exact while parallel/mesh.py can jit
     the two halves separately and double-buffer them.
+
+    plan (None = msm.active_plan()): the fd_msm2 MSM schedule, threaded
+    to both halves so a (local, combine) pair always agrees on window
+    counts and Horner stride (disco/engine.py resolves the per-rung
+    winner from the EngineRegistry).
     """
+    if plan is None:
+        plan = msm_mod.active_plan()
     status, definite, parts = verify_rlc_local(
-        msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits)
-    batch_ok = verify_rlc_combine(parts, axis_name=axis_name)
+        msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits, plan=plan)
+    batch_ok = verify_rlc_combine(parts, axis_name=axis_name, plan=plan)
     return status, definite, batch_ok
 
 
-def verify_rlc_local(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
+def verify_rlc_local(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits,
+                     plan=None):
     """The LOCAL half of one RLC pass: s-range, stacked decompression,
     the fused SHA/mod-L front half, the status ladder, and the three
     Pippenger bucket fills/aggregations over THIS shard's lanes — no
@@ -213,7 +221,14 @@ def verify_rlc_local(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
       sub / sub_ok  per-trial torsion aggregates + fill verdict
     Every leaf is a small array ((32, nw)-limb coords, () bools), so
     shipping parts between two jitted graphs costs microseconds.
+
+    plan: the fd_msm2 schedule for all three fills. A lazy plan routes
+    the XLA torsion fill through the 5-bit masked-digit grid (the same
+    soundness argument subgroup_check_fast has always shipped) — the
+    baseline keeps the historical 7-bit unified-add fill bit-identical.
     """
+    if plan is None:
+        plan = msm_mod.active_plan()
     r_bytes = sigs[:, :32]
     s_bytes = sigs[:, 32:]
 
@@ -331,17 +346,29 @@ def verify_rlc_local(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     u_live = jnp.where(live2[None, :], u_digits, 0)
     if engine == "xla":
         w_r, ok_r = msm_mod.msm_partial(
-            z_live, neg_r, msm_mod.WINDOWS_Z)
+            z_live, neg_r, msm_mod.WINDOWS_Z, plan=plan)
         w_m, ok_m = msm_mod.msm_partial(
-            m_all, pts_all, msm_mod.WINDOWS_253)
-        sub_agg, sub_okf = msm_mod.subgroup_partial(both, u_live)
+            m_all, pts_all, msm_mod.WINDOWS_253, plan=plan)
+        if plan.lazy:
+            # The lazy engine's torsion grid: 5-bit masked trial digits
+            # (subgroup_check_fast's shipping soundness argument) over
+            # the certified niels madd — the fill that dominates the
+            # whole MSM stage's lane count at production batch sizes.
+            from firedancer_tpu.msm_plan import TORSION_BUCKET_BITS
+
+            sub_agg, sub_okf = msm_mod.subgroup_partial(
+                both, u_live, bucket_bits=TORSION_BUCKET_BITS,
+                lazy=True)
+        else:
+            sub_agg, sub_okf = msm_mod.subgroup_partial(both, u_live)
     else:
         interp = engine == "interpret"
         w_r, ok_r = msm_mod.msm_fast_partial(
-            z_live, neg_r, msm_mod.WINDOWS_Z, interpret=interp, **kw_r)
+            z_live, neg_r, msm_mod.WINDOWS_Z, interpret=interp,
+            plan=plan, **kw_r)
         w_m, ok_m = msm_mod.msm_fast_partial(
             m_all, pts_all, msm_mod.WINDOWS_253, interpret=interp,
-            **kw_m)
+            plan=plan, **kw_m)
         sub_agg, sub_okf = msm_mod.subgroup_fast_partial(
             both, u_live, interpret=interp, **kw_sub)
     parts = {
@@ -352,7 +379,7 @@ def verify_rlc_local(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits):
     return status, definite, parts
 
 
-def verify_rlc_combine(parts, axis_name: str | None = None):
+def verify_rlc_combine(parts, axis_name: str | None = None, plan=None):
     """The TAIL half of one RLC pass: combine the per-shard partials
     across the mesh (axis_name; identity when None), run the three
     doubling-chain tails (two window Horners + the [L] torsion ladder),
@@ -364,24 +391,26 @@ def verify_rlc_combine(parts, axis_name: str | None = None):
     evaluates every Mosaic-padded trial lane — sound, because the pad
     lanes carry zero coordinates that trivially pass the identity test
     (msm.subgroup_fast_partial documents the argument)."""
+    if plan is None:
+        plan = msm_mod.active_plan()
     engine = msm_engine()
     if engine == "xla":
         t1, ok1 = msm_mod.msm_combine(
             parts["w_r"], parts["ok_r"], msm_mod.WINDOWS_Z,
-            axis_name=axis_name)
+            axis_name=axis_name, plan=plan)
         t2, ok2 = msm_mod.msm_combine(
             parts["w_m"], parts["ok_m"], msm_mod.WINDOWS_253,
-            axis_name=axis_name)
+            axis_name=axis_name, plan=plan)
         sub_ok, sub_fill_ok = msm_mod.subgroup_combine(
             parts["sub"], parts["sub_ok"], axis_name=axis_name)
     else:
         interp = engine == "interpret"
         t1, ok1 = msm_mod.msm_fast_combine(
             parts["w_r"], parts["ok_r"], msm_mod.WINDOWS_Z,
-            interpret=interp, axis_name=axis_name)
+            interpret=interp, axis_name=axis_name, plan=plan)
         t2, ok2 = msm_mod.msm_fast_combine(
             parts["w_m"], parts["ok_m"], msm_mod.WINDOWS_253,
-            interpret=interp, axis_name=axis_name)
+            interpret=interp, axis_name=axis_name, plan=plan)
         sub_ok, sub_fill_ok = msm_mod.subgroup_fast_combine(
             parts["sub"], parts["sub_ok"], interpret=interp,
             axis_name=axis_name)

@@ -91,7 +91,7 @@ def verify_step_sharded(mesh: Mesh):
     return jax.jit(sharded)
 
 
-def verify_rlc_step_sharded(mesh: Mesh):
+def verify_rlc_step_sharded(mesh: Mesh, plan=None):
     """Build the jitted, mesh-sharded RLC batch-verify pass (round-10:
     the primary verify mode finally composes with multi-chip).
 
@@ -110,10 +110,18 @@ def verify_rlc_step_sharded(mesh: Mesh):
     verdict. z is (B, 32) per-lane weights; u is (K, 2B) with columns
     0..B-1 weighting the pubkey points and B..2B-1 the R points —
     i.e. a drop-in rlc_fn for verify_rlc.make_async_verifier.
+
+    plan (None = msm.active_plan()): the fd_msm2 MSM schedule, resolved
+    ONCE at build time so every shard traces the identical window
+    grid — the per-window partials the mesh gathers must agree in
+    shape across devices by construction.
     """
+    from ..ops.msm import active_plan
     from ..ops.verify_rlc import verify_batch_rlc
 
     axis = mesh.axis_names[0]
+    if plan is None:
+        plan = active_plan()
 
     def step(msgs, lens, sigs, pubs, z, u3):
         # u3: (K, 2, B_local) — axis 1 separates A-weights from
@@ -123,7 +131,7 @@ def verify_rlc_step_sharded(mesh: Mesh):
         # expects.
         u = u3.reshape(u3.shape[0], -1)
         return verify_batch_rlc(msgs, lens, sigs, pubs, z, u,
-                                axis_name=axis)
+                                axis_name=axis, plan=plan)
 
     spec = P(axis)
     sharded = shard_map_nocheck(
@@ -144,7 +152,7 @@ def verify_rlc_step_sharded(mesh: Mesh):
     return fn
 
 
-def verify_rlc_split_sharded(mesh: Mesh):
+def verify_rlc_split_sharded(mesh: Mesh, plan=None):
     """The fd_pod double-buffer pair: the mesh-sharded RLC pass as TWO
     separately-jitted graphs (round-18, ROADMAP direction 1) —
 
@@ -174,16 +182,21 @@ def verify_rlc_split_sharded(mesh: Mesh):
 
     Both callables take/produce global arrays with the exact
     verify_batch_rlc argument convention (u is (K, 2B); the A/R-half
-    resharding happens inside, as in the monolithic builder).
+    resharding happens inside, as in the monolithic builder). plan is
+    resolved once at build time, like verify_rlc_step_sharded — both
+    jitted halves bake the same window grid.
     """
+    from ..ops.msm import active_plan
     from ..ops.verify_rlc import verify_rlc_combine, verify_rlc_local
 
     axis = mesh.axis_names[0]
+    if plan is None:
+        plan = active_plan()
 
     def local_step(msgs, lens, sigs, pubs, z, u3):
         u = u3.reshape(u3.shape[0], -1)
         status, definite, parts = verify_rlc_local(
-            msgs, lens, sigs, pubs, z, u)
+            msgs, lens, sigs, pubs, z, u, plan=plan)
         # Stack each partial on a fresh leading mesh axis so the
         # out_spec can concatenate shards: global shape (N, ...).
         stacked = jax.tree_util.tree_map(lambda c: c[None], parts)
@@ -195,7 +208,7 @@ def verify_rlc_split_sharded(mesh: Mesh):
         # stack in mesh order — the collective lives HERE, not in
         # local_fill.
         own = jax.tree_util.tree_map(lambda c: c[0], parts)
-        return verify_rlc_combine(own, axis_name=axis)
+        return verify_rlc_combine(own, axis_name=axis, plan=plan)
 
     spec = P(axis)
     parts_spec = _rlc_parts_spec(axis)

@@ -150,7 +150,37 @@ def _validate_stage_ms(sm) -> List[str]:
     if "decompress_sched" in sm \
             and not isinstance(sm["decompress_sched"], str):
         errs.append("'stage_ms.decompress_sched' must be a string")
+    # fd_msm2 MSM attribution fields (optional — pre-fd_msm2 lines):
+    # the schedule token the stage_ms.msm number was measured under,
+    # and its signed-digit bit. A present token must spell a plan the
+    # grammar admits ("auto" never appears in an artifact — the
+    # attribution records the RESOLVED plan).
+    if "msm_plan" in sm:
+        v = sm["msm_plan"]
+        if not isinstance(v, str) or v == "auto" or not _MSM_TOKEN_RE(v):
+            errs.append("'stage_ms.msm_plan' must be a concrete plan "
+                        f"token ([us][678][l3]), got {v!r}")
+    if "msm_signed" in sm and not isinstance(sm["msm_signed"], bool):
+        errs.append("'stage_ms.msm_signed' must be a bool")
     return errs
+
+
+def _MSM_TOKEN_RE(tok) -> bool:
+    """The msm_plan.parse_plan grammar, restated stdlib-only (the
+    _STAGE_KEYS precedent; tests/test_msm_plan.py pins the two against
+    each other): [us] + width in {6,7,8} + optional 'l3', with signed
+    requiring the lazy suffix."""
+    if not isinstance(tok, str) or len(tok) < 2:
+        return False
+    sign, rest = tok[0], tok[1:]
+    if sign not in ("u", "s"):
+        return False
+    lazy = rest.endswith("l3")
+    if lazy:
+        rest = rest[:-2]
+    if rest not in ("6", "7", "8"):
+        return False
+    return not (sign == "s" and not lazy)
 
 
 def _validate_rung_hist(h) -> List[str]:
@@ -435,6 +465,123 @@ def validate_pod(rec: dict) -> List[str]:
     return errs
 
 
+# fd_msm2 schedule-search artifact shape (build/msm_search.json,
+# written by scripts/msm_search.py). The negative-control clauses are
+# the load-bearing part: an artifact claiming ok must carry PROOF that
+# the uncertifiable recode was rejected with violation evidence and
+# that the parity-breaking window plan failed the RFC 8032 gate —
+# otherwise "certifier-gated" is just a word in a docstring.
+_MSM_SEARCH_CAND_REQUIRED = {
+    "token": str,
+    "kind": str,            # pareto | anchor | control
+    "certified": bool,
+    "violations": list,
+}
+_MSM_SEARCH_CONTROLS = ("recode_deep", "short_window")
+
+
+def validate_msm_search(rec: dict) -> List[str]:
+    """Shape errors for one build/msm_search.json artifact
+    ([] = valid)."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return ["artifact is not a JSON object"]
+    if rec.get("metric") != "msm_schedule_search":
+        errs.append(f"metric must be msm_schedule_search, got "
+                    f"{rec.get('metric')!r}")
+    sv = rec.get("schema_version")
+    if not isinstance(sv, int) or isinstance(sv, bool) \
+            or sv < SCHEMA_VERSION_MIN:
+        errs.append(f"schema_version must be an int >= "
+                    f"{SCHEMA_VERSION_MIN}, got {sv!r}")
+    ts = rec.get("ts")
+    if not isinstance(ts, str) or "T" not in ts:
+        errs.append(f"missing/odd ISO 'ts': {ts!r}")
+    if not isinstance(rec.get("batch"), int) \
+            or isinstance(rec.get("batch"), bool) or rec.get("batch", 0) <= 0:
+        errs.append(f"'batch' missing or not a positive int: "
+                    f"{rec.get('batch')!r}")
+    if not isinstance(rec.get("ok"), bool):
+        errs.append("'ok' missing or not a bool")
+    cands = rec.get("candidates")
+    if not isinstance(cands, list) or not cands:
+        errs.append("'candidates' must be a non-empty list")
+        return errs
+    by_token = {}
+    for c in cands:
+        if not isinstance(c, dict):
+            errs.append("candidate entries must be objects")
+            continue
+        for key, typ in _MSM_SEARCH_CAND_REQUIRED.items():
+            v = c.get(key)
+            if v is None or not isinstance(v, typ) \
+                    or (isinstance(v, bool) and typ is not bool):
+                errs.append(f"candidate '{key}' missing or not {typ}: "
+                            f"{v!r}")
+        tok = c.get("token")
+        if isinstance(tok, str):
+            by_token[tok] = c
+        if c.get("kind") not in ("pareto", "anchor", "control"):
+            errs.append(f"candidate kind must be pareto|anchor|control, "
+                        f"got {c.get('kind')!r}")
+        # A non-control candidate must spell a grammar-valid plan —
+        # controls deliberately may not (recode_deep is not a plan).
+        if c.get("kind") in ("pareto", "anchor") and isinstance(tok, str) \
+                and not _MSM_TOKEN_RE(tok):
+            errs.append(f"non-control candidate token {tok!r} outside "
+                        "the plan grammar")
+        if c.get("certified") is False and not c.get("violations"):
+            errs.append(f"candidate {tok!r} rejected without violation "
+                        "evidence")
+    # Negative controls: both present; recode_deep REJECTED by the
+    # certifier with violations; short_window certifies but FAILS the
+    # RFC 8032 parity gate (and is never marked registrable).
+    for name in _MSM_SEARCH_CONTROLS:
+        c = by_token.get(name) or next(
+            (x for x in cands if isinstance(x, dict)
+             and x.get("control") == name), None)
+        if c is None:
+            errs.append(f"negative control {name!r} missing")
+            continue
+        if c.get("kind") != "control" or c.get("registrable"):
+            errs.append(f"negative control {name!r} must be "
+                        "kind=control and never registrable")
+        if name == "recode_deep":
+            if c.get("certified") is not False or not c.get("violations"):
+                errs.append("recode_deep control must be REJECTED with "
+                            "violation evidence")
+        else:
+            if c.get("certified") is not True \
+                    or c.get("rfc8032_parity") is not False:
+                errs.append("short_window control must certify but fail "
+                            "RFC 8032 parity")
+    w = rec.get("winner")
+    if w is not None:
+        if not isinstance(w, dict) or not isinstance(w.get("token"), str):
+            errs.append("'winner' must be an object with a token")
+        else:
+            wc = by_token.get(w["token"])
+            if wc is None or wc.get("kind") == "control" \
+                    or wc.get("certified") is not True \
+                    or wc.get("rfc8032_parity") is not True:
+                errs.append(f"winner {w['token']!r} is not a certified, "
+                            "parity-clean non-control candidate")
+    return errs
+
+
+def validate_msm_search_files(root: str) -> List[str]:
+    """Violations in build/msm_search.json under root (absent = [])."""
+    path = os.path.join(root, "build", "msm_search.json")
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"msm_search.json: not JSON ({e})"]
+    return [f"msm_search.json: {e}" for e in validate_msm_search(rec)]
+
+
 def validate_pod_files(root: str) -> List[str]:
     """All violations across the POD_r*.json family under root."""
     import glob
@@ -519,6 +666,11 @@ def main(argv=None) -> int:
     # The fd_pod artifact family rides the same gate (prediction 11
     # reads these; a malformed one poisons the ledger).
     errs += validate_pod_files(siege_root)
+    # The fd_msm2 schedule-search artifact rides it too (prediction 12
+    # reads the winner; the negative-control invariants are part of the
+    # schema, so a search run that lost its controls fails HERE even if
+    # the search script's own gate was bypassed).
+    errs += validate_msm_search_files(siege_root)
     if errs:
         for e in errs:
             print(f"bench_log_check: FAIL — {e}", file=sys.stderr)

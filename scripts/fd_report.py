@@ -7,7 +7,7 @@ plus the BENCH/REPLAY/MULTICHIP/PACK/HOSTFEED artifact family are
 parsed into one schema-normalized timeline (pre-schema_version legacy
 lines included), rendered as per-mode/per-B/per-stage trend tables,
 checked against the rolling best-of baseline (FD_REPORT_REGRESS_PCT),
-and reconciled against the eleven ROOFLINE.md falsifiable predictions —
+and reconciled against the twelve ROOFLINE.md falsifiable predictions —
 each listed pending until a matching schema_version-2 artifact lands,
 then auto-graded confirmed/falsified (the BENCH_r06 hardware session
 self-grades).

@@ -1,0 +1,513 @@
+#!/usr/bin/env python
+"""msm_search — certifier-gated sweep of fd_msm2 Pippenger schedules
+(PR 16; the fe_schedule_search playbook applied to the MSM core).
+
+The RLC verify pass spends its milliseconds in three bucket-fill grids
+whose shape is one schedule decision: window width w, signed (balanced)
+digit recoding, lazy-reduction niels fill. The analytic pruner
+(msm_plan.pareto_candidates — an executed-adds model over w in {6,7,8}
+x signed x lazy) keeps only the Pareto frontier over (modeled cost,
+total static rounds); each survivor then runs the gate:
+
+  1. fdcert PROOF — a plan's new arithmetic lives in the certified
+     ops/msm_recode.py module (the borrow-propagating recode at its
+     width, the 7-mul lazy niels madd). The committed certificate
+     (lint_bounds_cert.json) must carry those entries AND the live
+     abstract interpreter must re-prove the module with zero
+     violations. Rejections keep the violation text — docs/RUNBOOK.md
+     'Reading an msm-search rejection' shows how to read one.
+  2. ORACLE PARITY — the full XLA msm() under the plan, bit-exact vs
+     the python-int Edwards oracle at WINDOWS_253 and WINDOWS_Z
+     shapes; then a full RFC 8032 verify_batch_rlc subprocess
+     (FD_MSM_PLAN=token) over a mixed good/bad/torsion-salted batch
+     against the per-lane oracle.
+  3. TIMING — scripts/profile_stages.msm_stage_ms (_bench_util.bench
+     host-pull timing) at --rank-batch picks the winner; a final
+     best-of-two A/B at --batch records the headline vs the u7 anchor.
+
+Two NEGATIVE CONTROLS ride every run and must FAIL their gate (the
+script exits 1 if either passes — the gate itself is under test):
+
+  * recode_deep — a generated recode (build/msm_cand_recode_deep.py)
+    that retires its borrows in base-2^w at the top instead of into
+    the next window: the carry accumulator's interval grows by 2^w per
+    window and escapes int32 long before window 37. The certifier must
+    REJECT it with bounds-overflow evidence.
+  * short_window — the certified signed recode run at the UNSIGNED
+    window count (msm_partial's _force_windows search knob): the final
+    borrow window is dropped, so the recode no longer represents the
+    scalar. It certifies (the per-window arithmetic is fine) but must
+    FAIL oracle parity — the parity gate, not the certifier, is what
+    catches a mis-planned window grid.
+
+The winner is installed per B rung via EngineRegistry.set_rung_plan
+(disco/engine.py) and the whole run is recorded in
+build/msm_search.json (schema: scripts/bench_log_check.
+validate_msm_search). Run:
+    python scripts/msm_search.py [--batch N] [--rank-batch N]
+                                 [--skip-timing]
+"""
+
+import argparse
+import json
+import os
+import platform
+import sys
+import time
+from datetime import datetime
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+L = 2**252 + 27742317777372353535851937790883648493
+
+
+def _deep_candidate_source() -> str:
+    """The recode_deep negative control: borrows retired in base-2^w at
+    the top of the chain instead of into the next window. Genuinely
+    uncertifiable — the accumulator interval multiplies by 2^w per
+    window — and genuinely wrong at runtime too (the deferred borrow
+    never reaches the digits). Never shipped; exists to prove the
+    certifier rejects carry depth past int32."""
+    return (
+        '"""msm_search negative control recode_deep (generated — never\n'
+        "shipped; the certified recode lives in ops/msm_recode.py).\"\"\"\n"
+        "import jax.numpy as jnp\n"
+        "\n"
+        "\n"
+        "def cand_recode_deep(d):\n"
+        "    w_bits = 7\n"
+        "    half = 1 << (w_bits - 1)\n"
+        "    d = jnp.asarray(d).astype(jnp.int32) & ((1 << w_bits) - 1)\n"
+        "    c = jnp.zeros(d.shape[1:], jnp.int32)\n"
+        "    outs = []\n"
+        "    for t in range(d.shape[0]):\n"
+        "        v = d[t]\n"
+        "        borrow = (v > half).astype(jnp.int32)\n"
+        "        outs.append(v - (borrow << w_bits))\n"
+        "        # deferred borrow: accumulate in base-2^w, retire once\n"
+        "        # at the top — the interval grows 2^w-fold per window.\n"
+        "        c = c * (1 << w_bits) + borrow\n"
+        "    outs[-1] = outs[-1] + c\n"
+        "    return jnp.stack(outs, axis=0)\n"
+        "\n"
+        "\n"
+        "FDCERT_CONTRACTS = {\n"
+        '    "cand_recode_deep": {"inputs": ["bytes2:37:8"],\n'
+        '                         "out_abs": 64,\n'
+        '                         "doc": "deferred-borrow recode '
+        '(negative control)"},\n'
+        "}\n"
+    )
+
+
+_LIVE_RECODE_VS = None
+
+
+def _live_recode_violations():
+    """Live re-prove of the certified-module chain up to msm_recode
+    (check_repo's dependency closure: the recode execs against the
+    extracted fe25519 namespace, so certifying it alone would
+    false-fail as unprovable), once per run."""
+    global _LIVE_RECODE_VS
+    if _LIVE_RECODE_VS is None:
+        from firedancer_tpu.lint import bounds
+
+        _LIVE_RECODE_VS = bounds.check_repo(REPO, py_paths=[
+            os.path.join(REPO, "firedancer_tpu", "ops", "msm_recode.py")])
+    return _LIVE_RECODE_VS
+
+
+def certify(token):
+    """(certified, violations, evidence) for one plan token. A plan's
+    new arithmetic is the certified msm_recode module's entries —
+    recode_signed_w{w} when signed, madd_niels_lazy when lazy; the
+    committed certificate must carry them and the live interpreter
+    must re-prove the module clean. Unsigned non-lazy plans run the
+    legacy engine (no fd_msm2 contracts in the graph)."""
+    from firedancer_tpu.msm_plan import parse_plan
+
+    plan = parse_plan(token)
+    needed = []
+    if plan.lazy:
+        needed.append("madd_niels_lazy")
+    if plan.signed:
+        needed.append(f"recode_signed_w{plan.w}")
+    if not needed:
+        return True, [], ["legacy engine: no fd_msm2 contracts traced"]
+    with open(os.path.join(REPO, "lint_bounds_cert.json")) as f:
+        cert = json.load(f)
+    mod = cert["modules"].get("firedancer_tpu/ops/msm_recode.py", {})
+    missing = [n for n in needed if n not in mod]
+    if missing:
+        return False, [f"committed certificate missing {n}"
+                       for n in missing], needed
+    vs = _live_recode_violations()
+    return not vs, [v.format() for v in vs], needed
+
+
+def certify_deep_control(build_dir):
+    """(certified, violations) for the recode_deep control — certified
+    MUST come back False."""
+    from firedancer_tpu.lint import bounds
+
+    path = os.path.join(build_dir, "msm_cand_recode_deep.py")
+    with open(path, "w") as f:
+        f.write(_deep_candidate_source())
+    vs = bounds.check_file(path)
+    return not vs, [v.format() for v in vs]
+
+
+def _oracle_fixture(bsz, seed):
+    """(scalars_bytes, points, expected_affine_253, z_bytes,
+    expected_affine_z) — random curve points and scalars with the
+    python-int Edwards oracle's answers for both public window
+    shapes."""
+    import random as pyrandom
+
+    import numpy as np
+
+    from firedancer_tpu.ballet import ed25519 as oracle
+    from firedancer_tpu.ops import fe25519 as fe
+
+    rng = pyrandom.Random(seed)
+    pts_aff = [oracle.scalarmult(rng.randint(1, 2**200), oracle.B)
+               for _ in range(bsz)]
+    coords = [np.zeros((32, bsz), np.int32) for _ in range(4)]
+    for i, p in enumerate(pts_aff):
+        for j, v in enumerate((p[0], p[1], 1, p[0] * p[1] % fe.P)):
+            for k in range(32):
+                coords[j][k, i] = (v >> (8 * k)) & 0xFF
+    scal253 = np.zeros((bsz, 32), np.uint8)
+    scalz = np.zeros((bsz, 32), np.uint8)
+    for i in range(bsz):
+        c = rng.randint(0, L - 1)
+        scal253[i] = np.frombuffer(c.to_bytes(32, "little"), np.uint8)
+        cz = rng.randint(0, 2**126 - 1)
+        scalz[i] = np.frombuffer(cz.to_bytes(32, "little"), np.uint8)
+
+    def fold(scal):
+        want = (0, 1)
+        for i in range(bsz):
+            c = int.from_bytes(scal[i].tobytes(), "little")
+            want = oracle.point_add(want, oracle.scalarmult(c, pts_aff[i]))
+        return want
+
+    return scal253, scalz, tuple(coords), fold(scal253), fold(scalz)
+
+
+_FIXTURE = None
+
+
+def _fixture(bsz=21, seed=11):
+    global _FIXTURE
+    if _FIXTURE is None:
+        _FIXTURE = _oracle_fixture(bsz, seed)
+    return _FIXTURE
+
+
+def _affine(pt):
+    from firedancer_tpu.ops import fe25519 as fe
+
+    import numpy as np
+
+    x, y, z = (fe.limbs_to_int(np.asarray(c))[0] for c in pt[:3])
+    zi = pow(z, fe.P - 2, fe.P)
+    return (x * zi % fe.P, y * zi % fe.P)
+
+
+def msm_parity(token) -> bool:
+    """Full XLA msm() under the plan vs the python-int oracle, both
+    public window shapes, fill-ok required."""
+    import jax.numpy as jnp
+
+    from firedancer_tpu.msm_plan import parse_plan
+    from firedancer_tpu.ops import msm as msm_mod
+
+    plan = parse_plan(token)
+    scal253, scalz, coords, want253, wantz = _fixture()
+    pts = tuple(jnp.asarray(c) for c in coords)
+    res, ok = msm_mod.msm(jnp.asarray(scal253), pts,
+                          n_windows=msm_mod.WINDOWS_253, plan=plan)
+    if not (bool(ok) and _affine(res) == want253):
+        return False
+    res, ok = msm_mod.msm(jnp.asarray(scalz), pts,
+                          n_windows=msm_mod.WINDOWS_Z, plan=plan)
+    return bool(ok) and _affine(res) == wantz
+
+
+def short_window_parity() -> bool:
+    """The short_window control: the certified signed recode driven at
+    the UNSIGNED window count via msm_partial's _force_windows knob —
+    the dropped borrow window makes the recode stop representing the
+    scalar, so this MUST return False (parity broken)."""
+    import jax.numpy as jnp
+
+    from firedancer_tpu.msm_plan import MsmPlan, plan_windows
+    from firedancer_tpu.ops import msm as msm_mod
+
+    plan = MsmPlan(w=7, signed=True, lazy=True)
+    scal253, _, coords, want253, _ = _fixture()
+    pts = tuple(jnp.asarray(c) for c in coords)
+    # unsigned window count at w=7 for 253-bit scalars: one fewer than
+    # the signed plan needs (253 % 7 != 0 keeps them equal — so force
+    # an explicit drop of the top window instead).
+    nw_forced = plan_windows(253, 7, True) - 1
+    w_res, ok = msm_mod.msm_partial(
+        jnp.asarray(scal253), pts, n_windows=msm_mod.WINDOWS_253,
+        plan=plan, _force_windows=nw_forced)
+    res, ok = msm_mod.msm_combine(w_res, ok, msm_mod.WINDOWS_253,
+                                  plan=plan)
+    return bool(ok) and _affine(res) == want253
+
+
+def rfc8032_parity(token) -> bool:
+    """Full RFC 8032 verify under the plan in a fresh subprocess
+    (FD_MSM_PLAN is trace-time): verify_batch_rlc over a mixed
+    good/bad/torsion-salted batch — clean batch_ok True, salted
+    batch_ok False, definite lanes matching the per-lane oracle."""
+    import subprocess
+
+    code = (
+        "import numpy as np, jax, jax.numpy as jnp\n"
+        "from firedancer_tpu.ballet.ed25519 import oracle\n"
+        "from firedancer_tpu.ops.verify_rlc import (\n"
+        "    fresh_u, fresh_z, verify_batch_rlc)\n"
+        "rng = np.random.default_rng(5)\n"
+        "B = 16\n"
+        "seeds = rng.integers(0, 256, (B, 32), dtype=np.uint8)\n"
+        "msgs = rng.integers(0, 256, (B, 48), dtype=np.uint8)\n"
+        "lens = np.full((B,), 48, np.int32)\n"
+        "pubs = np.stack([np.frombuffer("
+        "oracle.keypair_from_seed(bytes(k))[2], np.uint8)"
+        " for k in seeds])\n"
+        "sigs = np.stack([np.frombuffer(oracle.sign(bytes(m), bytes(k)),"
+        " np.uint8) for m, k in zip(msgs, seeds)])\n"
+        "f = jax.jit(verify_batch_rlc)\n"
+        "host = np.random.default_rng(9)\n"
+        "def run(sg, pb):\n"
+        "    z = jnp.asarray(fresh_z(B, host))\n"
+        "    u = jnp.asarray(fresh_u(8, 2 * B, host))\n"
+        "    s, d, ok = f(jnp.asarray(msgs), jnp.asarray(lens),"
+        " jnp.asarray(sg), jnp.asarray(pb), z, u)\n"
+        "    return np.asarray(s), np.asarray(d), bool(ok)\n"
+        "_, _, ok_clean = run(sigs, pubs)\n"
+        "bad_s = sigs.copy(); bad_p = pubs.copy()\n"
+        "bad_s[2, 2] ^= 0x40\n"             # corrupted R
+        "bad_s[5, 40] ^= 0x01\n"            # corrupted s
+        "bad_p[7, 5] ^= 0x01\n"             # corrupted pubkey
+        "bad_s[11, :32] = 0\n"              # R <- order-4 torsion point
+        "st, de, ok_bad = run(bad_s, bad_p)\n"
+        "want = [oracle.verify(bytes(m[:l]), bytes(s), bytes(p)) == 0"
+        " for m, l, s, p in zip(msgs, lens, bad_s, bad_p)]\n"
+        "lane_ok = all((st[i] == 0) == want[i]"
+        " for i in range(B) if de[i])\n"
+        "bad_caught = all(not want[i] or de[i] or st[i] != 0"
+        " for i in (2, 5, 7, 11))\n"
+        "ok = ok_clean and not ok_bad and lane_ok and bad_caught\n"
+        "print('PARITY_OK' if ok else 'PARITY_FAIL',"
+        " ok_clean, ok_bad, lane_ok)\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", FD_MSM_PLAN=token)
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         env=env, capture_output=True, text=True)
+    return "PARITY_OK" in out.stdout
+
+
+def time_plan(token, batch, reps, warmup, best_of=2):
+    """Best-of-N msm_stage_ms under the plan (host-pull timing)."""
+    from profile_stages import msm_stage_ms
+
+    from firedancer_tpu.msm_plan import parse_plan
+
+    plan = parse_plan(token)
+    best = None
+    for _ in range(best_of):
+        rec = msm_stage_ms(batch, reps=reps, warmup=warmup, plan=plan)
+        if best is None or rec["msm_ms"] < best["msm_ms"]:
+            best = rec
+    return best
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8192,
+                    help="headline A/B shape (the acceptance gate)")
+    ap.add_argument("--rank-batch", type=int, default=1024,
+                    help="candidate-ranking timing shape")
+    ap.add_argument("--reps", type=int, default=1)
+    ap.add_argument("--warmup", type=int, default=1)
+    ap.add_argument("--skip-timing", action="store_true",
+                    help="certify + parity + controls only (CI-speed)")
+    ap.add_argument("--skip-headline", action="store_true",
+                    help="rank at --rank-batch but skip the --batch A/B")
+    args = ap.parse_args()
+
+    from firedancer_tpu import msm_plan
+
+    build_dir = os.path.join(REPO, "build")
+    os.makedirs(build_dir, exist_ok=True)
+
+    report = {
+        "metric": "msm_schedule_search",
+        "schema_version": 2,
+        "ts": datetime.now().isoformat(timespec="seconds"),
+        "host": platform.node() or "unknown",
+        "batch": args.batch,
+        "rank_batch": args.rank_batch,
+        "candidates": [],
+        "ok": False,
+    }
+
+    models = msm_plan.pareto_candidates(args.batch)
+    by_tok = {m["token"]: m for m in models}
+    base_tok = msm_plan.plan_token(msm_plan.BASELINE_PLAN)
+
+    # -- pareto candidates through the gate ---------------------------
+    for m in models:
+        if not m["pareto"]:
+            continue
+        tok = m["token"]
+        t0 = time.perf_counter()
+        certified, violations, evidence = certify(tok)
+        entry = {
+            "token": tok,
+            "kind": "anchor" if tok == base_tok else "pareto",
+            "certified": certified,
+            "violations": violations,
+            "cert_evidence": evidence,
+            "cost_model": round(m["cost"]),
+            "rounds_total": m["rounds_total"],
+            "parity": None,
+            "rfc8032_parity": None,
+            "msm_ms": None,
+            "registrable": False,
+        }
+        if certified:
+            entry["parity"] = bool(msm_parity(tok))
+            if entry["parity"]:
+                entry["rfc8032_parity"] = bool(rfc8032_parity(tok))
+            entry["registrable"] = bool(entry["parity"]
+                                        and entry["rfc8032_parity"])
+            if entry["registrable"] and not args.skip_timing:
+                rec = time_plan(tok, args.rank_batch, args.reps,
+                                args.warmup)
+                entry["msm_ms"] = rec["msm_ms"]
+        entry["wall_s"] = round(time.perf_counter() - t0, 2)
+        report["candidates"].append(entry)
+        print(f"{tok:6s} {'CERTIFIED' if certified else 'REJECTED':10s} "
+              f"parity={entry['parity']} rfc8032={entry['rfc8032_parity']} "
+              f"msm_ms={entry['msm_ms']}", flush=True)
+        for v in violations:
+            print(f"    {v}", flush=True)
+
+    # -- negative controls --------------------------------------------
+    t0 = time.perf_counter()
+    deep_cert, deep_vs = certify_deep_control(build_dir)
+    report["candidates"].append({
+        "token": "recode_deep", "kind": "control", "control": "recode_deep",
+        "certified": deep_cert, "violations": deep_vs,
+        "parity": None, "rfc8032_parity": None, "msm_ms": None,
+        "registrable": False,
+        "wall_s": round(time.perf_counter() - t0, 2),
+    })
+    print(f"recode_deep control: "
+          f"{'REJECTED (want)' if not deep_cert else 'CERTIFIED (BUG)'}",
+          flush=True)
+    for v in deep_vs[:3]:
+        print(f"    {v}", flush=True)
+
+    t0 = time.perf_counter()
+    sw_cert, sw_vs, _ = certify("s7l3")   # same certified recode
+    sw_parity = bool(short_window_parity())
+    report["candidates"].append({
+        "token": "short_window", "kind": "control",
+        "control": "short_window",
+        "certified": sw_cert, "violations": sw_vs,
+        "parity": sw_parity, "rfc8032_parity": sw_parity,
+        "msm_ms": None, "registrable": False,
+        "forced_windows": msm_plan.plan_windows(253, 7, True) - 1,
+        "wall_s": round(time.perf_counter() - t0, 2),
+    })
+    print(f"short_window control: certified={sw_cert} "
+          f"parity={'BROKEN (want)' if not sw_parity else 'HELD (BUG)'}",
+          flush=True)
+
+    # -- winner + headline + registry install -------------------------
+    timed = [c for c in report["candidates"]
+             if c.get("registrable") and c["msm_ms"] is not None]
+    if timed:
+        win = min(timed, key=lambda c: c["msm_ms"])
+        report["winner"] = {"token": win["token"],
+                            "msm_ms": win["msm_ms"],
+                            "rank_batch": args.rank_batch}
+        print(f"winner @B{args.rank_batch}: {win['token']} "
+              f"({win['msm_ms']} ms)", flush=True)
+        if not args.skip_headline:
+            base = time_plan(base_tok, args.batch, args.reps, args.warmup)
+            head = (base if win["token"] == base_tok else
+                    time_plan(win["token"], args.batch, args.reps,
+                              args.warmup))
+            report["headline"] = {
+                "batch": args.batch,
+                "baseline": base_tok,
+                "baseline_msm_ms": base["msm_ms"],
+                "winner": win["token"],
+                "winner_msm_ms": head["msm_ms"],
+                "speedup": round(base["msm_ms"]
+                                 / max(head["msm_ms"], 1e-9), 3),
+            }
+            print(f"headline @B{args.batch}: {base_tok} "
+                  f"{base['msm_ms']} ms -> {win['token']} "
+                  f"{head['msm_ms']} ms "
+                  f"({report['headline']['speedup']}x)", flush=True)
+        from firedancer_tpu.disco import engine as fd_engine
+
+        fd_engine.registry().set_rung_plan(args.batch, win["token"])
+        report["registered_rungs"] = {
+            str(args.batch): fd_engine.registry().rung_plan(args.batch)}
+    else:
+        report["winner"] = None
+
+    # -- gate invariants ----------------------------------------------
+    fail = None
+    if deep_cert:
+        fail = "recode_deep control CERTIFIED (carry-depth gate broken)"
+    elif sw_parity:
+        fail = "short_window control held parity (window-plan gate broken)"
+    else:
+        for c in report["candidates"]:
+            if c["kind"] == "control":
+                continue
+            if c["certified"] and c["parity"] is False:
+                fail = f"certified plan {c['token']} failed oracle parity"
+                break
+            if c["certified"] and c["rfc8032_parity"] is False:
+                fail = f"certified plan {c['token']} failed RFC 8032 parity"
+                break
+    report["ok"] = fail is None
+
+    import bench_log_check
+
+    errs = bench_log_check.validate_msm_search(report)
+    out_path = os.path.join(build_dir, "msm_search.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(f"report: {out_path}")
+    if errs:
+        for e in errs:
+            print(f"ERROR: schema: {e}", file=sys.stderr)
+        return 1
+    if fail:
+        print(f"ERROR: {fail}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,310 @@
+#!/usr/bin/env python
+"""fd_msm2 smoke — the signed-digit Pippenger schedule's CI gate.
+
+Four blocking sections, each printing one PASS line (any failure prints
+a JSON evidence line and exits 1):
+
+  1. RECODE PARITY — recode_signed_w{6,7,8} (the certified
+     borrow-propagating balanced recode in ops/msm_recode.py) vs a
+     python-int reference on random 253-bit scalars at the
+     plan_windows window counts: bit-exact digits, every digit inside
+     the certified [-(2^(w-1)-1), 2^(w-1)] hull, and the signed-digit
+     expansion sum(d_t * 2^(w*t)) reconstructing the scalar exactly.
+  2. PLAN DISPATCH CONTRACT — the FD_MSM_* resolution rule
+     (msm_plan.plan_from_flags, re-exported as ops.msm.active_plan):
+     FD_MSM_PLAN typos and off-grammar tokens raise, FD_MSM_WINDOW
+     outside PLAN_WIDTHS raises, the resolved default is the u7
+     baseline; msm() under an explicit BASELINE_PLAN is bit-identical
+     to the default path; a signed lazy plan agrees with the baseline
+     on the same batch (both are proven against the oracle in tests —
+     here the cheap cross-check keeps the dispatch from rotting).
+  3. CERT DRIFT GATE — the committed lint_bounds_cert.json must carry
+     every ops/msm_recode.py contract entry, the live certifier must
+     re-prove the module with zero violations, and the msm_search
+     recode_deep negative control (deferred base-2^w borrow) must be
+     REJECTED with violation evidence — the carry-depth gate itself is
+     exercised on every CI run, not only in full searches.
+  4. SEARCH-REPORT SCHEMA — bench_log_check.validate_msm_search
+     accepts a well-formed synthetic artifact and rejects one whose
+     short_window control held parity (a search run that lost its
+     controls must not be recordable); EngineRegistry.set_rung_plan
+     refuses off-grammar tokens and round-trips valid ones ("auto"
+     clears the pin).
+
+Run:  JAX_PLATFORMS=cpu python scripts/msm_smoke.py
+"""
+
+import json
+import os
+import random
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fail(err: str, **kw) -> int:
+    rec = {"smoke": "msm", "error": err}
+    rec.update(kw)
+    print(json.dumps(rec))
+    print(f"FAIL: {err}", file=sys.stderr)
+    return 1
+
+
+def _recode_ref(scalar: int, w: int, nw: int):
+    """Python-int reference of the balanced recode (the spec the jax
+    path is pinned against)."""
+    half = 1 << (w - 1)
+    digs, c = [], 0
+    for t in range(nw):
+        v = ((scalar >> (w * t)) & ((1 << w) - 1)) + c
+        c = 1 if v > half else 0
+        digs.append(v - (c << w))
+    return digs, c
+
+
+def check_recode() -> int:
+    import numpy as np
+
+    from firedancer_tpu.msm_plan import PLAN_WIDTHS, plan_windows
+    from firedancer_tpu.ops import msm_recode
+
+    rng = random.Random(20160)
+    fns = {6: msm_recode.recode_signed_w6, 7: msm_recode.recode_signed_w7,
+           8: msm_recode.recode_signed_w8}
+    for w in PLAN_WIDTHS:
+        nw = plan_windows(253, w, signed=True)
+        contract = msm_recode.FDCERT_CONTRACTS[f"recode_signed_w{w}"]
+        if contract["inputs"] != [f"bytes2:{nw}:8"]:
+            return _fail("recode contract window count drifted from "
+                         "plan_windows", w=w, nw=nw,
+                         contract=contract["inputs"])
+        scalars = [rng.getrandbits(253) for _ in range(64)]
+        d = np.zeros((nw, len(scalars)), np.int32)
+        for i, s in enumerate(scalars):
+            for t in range(nw):
+                d[t, i] = (s >> (w * t)) & ((1 << w) - 1)
+        got = np.asarray(fns[w](d))
+        half = 1 << (w - 1)
+        if got.min() < -(half - 1) or got.max() > half:
+            return _fail("signed digit escaped the certified hull",
+                         w=w, lo=int(got.min()), hi=int(got.max()),
+                         hull=[-(half - 1), half])
+        for i, s in enumerate(scalars):
+            ref, carry = _recode_ref(s, w, nw)
+            if carry != 0:
+                return _fail("reference recode leaked a top borrow "
+                             "(plan_windows bound wrong)", w=w)
+            if list(got[:, i]) != ref:
+                return _fail("recode digits diverge from python-int "
+                             "reference", w=w, lane=i)
+            if sum(int(got[t, i]) << (w * t) for t in range(nw)) != s:
+                return _fail("signed-digit expansion does not "
+                             "reconstruct the scalar", w=w, lane=i)
+    print(f"PASS: recode parity — w in {PLAN_WIDTHS}, 64 scalars each, "
+          "bit-exact vs python-int reference, hull held, "
+          "expansion exact")
+    return 0
+
+
+def check_dispatch() -> int:
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    from firedancer_tpu.msm_plan import (
+        BASELINE_PLAN, MsmPlan, parse_plan, plan_from_flags, plan_token,
+    )
+    from firedancer_tpu.ops import curve25519 as ge
+    from firedancer_tpu.ops import msm as msm_mod
+
+    for junk in ("x7", "s7", "u9", "s6", "u7l2", "7", "sl3", "u7l3x"):
+        try:
+            parse_plan(junk)
+            return _fail("off-grammar plan token accepted", token=junk)
+        except ValueError:
+            pass
+    saved = {k: os.environ.get(k)
+             for k in ("FD_MSM_PLAN", "FD_MSM_WINDOW", "FD_MSM_SIGNED")}
+    try:
+        for k in saved:
+            os.environ.pop(k, None)
+        if plan_from_flags() != BASELINE_PLAN:
+            return _fail("default flag resolution is not the u7 baseline",
+                         got=plan_token(plan_from_flags()))
+        os.environ["FD_MSM_PLAN"] = "s9l3"
+        try:
+            plan_from_flags()
+            return _fail("FD_MSM_PLAN typo resolved instead of raising",
+                         token="s9l3")
+        except ValueError:
+            pass
+        os.environ.pop("FD_MSM_PLAN", None)
+        os.environ["FD_MSM_WINDOW"] = "5"
+        try:
+            plan_from_flags()
+            return _fail("FD_MSM_WINDOW outside PLAN_WIDTHS resolved "
+                         "instead of raising", w=5)
+        except ValueError:
+            pass
+        os.environ.pop("FD_MSM_WINDOW", None)
+        os.environ["FD_MSM_SIGNED"] = "1"
+        p = plan_from_flags()
+        if not (p.signed and p.lazy):
+            return _fail("FD_MSM_SIGNED=1 did not resolve a signed "
+                         "lazy plan", got=plan_token(p))
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # Tiny batch through msm(): explicit BASELINE_PLAN bit-identical to
+    # the default path; the signed lazy plan lands on the same point.
+    rng = np.random.default_rng(3)
+    ybytes = jnp.asarray(rng.integers(0, 256, (8, 32), dtype=np.uint8))
+    pts, _dok = jax.jit(ge.decompress)(ybytes)
+    scal = np.zeros((8, 32), np.uint8)
+    scal[:, :16] = rng.integers(0, 256, (8, 16), dtype=np.uint8)
+    scal[:, 15] &= 0x3F   # < 2^126: the WINDOWS_Z shape
+    scal = jnp.asarray(scal)
+    res_def, ok_def = jax.jit(
+        lambda s, p: msm_mod.msm(s, p, msm_mod.WINDOWS_Z))(scal, pts)
+    res_base, ok_base = jax.jit(
+        lambda s, p: msm_mod.msm(s, p, msm_mod.WINDOWS_Z,
+                                 plan=BASELINE_PLAN))(scal, pts)
+    if not (bool(ok_def) and bool(ok_base)):
+        return _fail("baseline msm fill overflowed at B=8")
+    if any(not np.array_equal(np.asarray(a), np.asarray(b))
+           for a, b in zip(res_def, res_base)):
+        return _fail("explicit BASELINE_PLAN is not bit-identical to "
+                     "the default path")
+
+    def _aff(res):
+        from firedancer_tpu.ops import fe25519 as fe
+        x, y, z = (fe.limbs_to_int(np.asarray(c))[0] for c in res[:3])
+        zi = pow(z, fe.P - 2, fe.P)
+        return (x * zi % fe.P, y * zi % fe.P)
+
+    s7 = MsmPlan(w=7, signed=True, lazy=True)
+    res_s, ok_s = jax.jit(
+        lambda s, p: msm_mod.msm(s, p, msm_mod.WINDOWS_Z, plan=s7))(
+            scal, pts)
+    if not bool(ok_s) or _aff(res_s) != _aff(res_def):
+        return _fail("signed lazy plan disagrees with the baseline "
+                     "point at B=8")
+    print("PASS: plan dispatch — typos raise, default is u7 baseline, "
+          "BASELINE_PLAN bit-identical, s7l3 point-equal at B=8")
+    return 0
+
+
+def check_cert() -> int:
+    from firedancer_tpu.lint import bounds
+    from firedancer_tpu.ops import msm_recode
+
+    with open(os.path.join(REPO, "lint_bounds_cert.json")) as f:
+        cert = json.load(f)
+    mod = cert["modules"].get("firedancer_tpu/ops/msm_recode.py")
+    if not mod:
+        return _fail("committed certificate has no msm_recode module")
+    missing = [n for n in msm_recode.FDCERT_CONTRACTS if n not in mod]
+    if missing:
+        return _fail("committed certificate missing msm_recode entries",
+                     missing=missing)
+    vs = bounds.check_repo(REPO, py_paths=[
+        os.path.join(REPO, "firedancer_tpu", "ops", "msm_recode.py")])
+    if vs:
+        return _fail("live certifier found msm_recode violations",
+                     violations=[v.format() for v in vs])
+    # The carry-depth gate itself, exercised every CI run: the
+    # msm_search deferred-borrow control must be rejected.
+    import msm_search
+
+    build_dir = os.path.join(REPO, "build")
+    os.makedirs(build_dir, exist_ok=True)
+    deep_ok, deep_vs = msm_search.certify_deep_control(build_dir)
+    if deep_ok or not deep_vs:
+        return _fail("recode_deep negative control CERTIFIED — the "
+                     "carry-depth gate is broken")
+    print(f"PASS: cert drift — {len(mod)} committed msm_recode entries, "
+          f"live certifier clean, recode_deep rejected "
+          f"({len(deep_vs)} violations)")
+    return 0
+
+
+def check_schema() -> int:
+    import bench_log_check
+
+    from firedancer_tpu.disco import engine as fd_engine
+
+    good = {
+        "metric": "msm_schedule_search", "schema_version": 2,
+        "ts": "2026-08-06T00:00:00", "batch": 8192, "ok": True,
+        "candidates": [
+            {"token": "u7", "kind": "anchor", "certified": True,
+             "violations": [], "parity": True, "rfc8032_parity": True,
+             "msm_ms": 10.0, "registrable": True},
+            {"token": "s7l3", "kind": "pareto", "certified": True,
+             "violations": [], "parity": True, "rfc8032_parity": True,
+             "msm_ms": 7.0, "registrable": True},
+            {"token": "recode_deep", "kind": "control",
+             "control": "recode_deep", "certified": False,
+             "violations": ["carry interval escapes int32"],
+             "parity": None, "rfc8032_parity": None,
+             "registrable": False},
+            {"token": "short_window", "kind": "control",
+             "control": "short_window", "certified": True,
+             "violations": [], "parity": False,
+             "rfc8032_parity": False, "registrable": False},
+        ],
+        "winner": {"token": "s7l3", "msm_ms": 7.0},
+    }
+    errs = bench_log_check.validate_msm_search(good)
+    if errs:
+        return _fail("well-formed synthetic search record rejected",
+                     errs=errs)
+    bad = json.loads(json.dumps(good))
+    bad["candidates"][3]["rfc8032_parity"] = True   # control held parity
+    if not bench_log_check.validate_msm_search(bad):
+        return _fail("search record whose short_window control held "
+                     "parity was accepted")
+    bad2 = json.loads(json.dumps(good))
+    bad2["winner"] = {"token": "recode_deep"}
+    if not bench_log_check.validate_msm_search(bad2):
+        return _fail("search record with a control winner was accepted")
+
+    reg = fd_engine.registry()
+    try:
+        reg.set_rung_plan(4096, "x7")
+        return _fail("registry accepted an off-grammar rung plan",
+                     token="x7")
+    except ValueError:
+        pass
+    reg.set_rung_plan(4096, "s7l3")
+    if reg.rung_plan(4096) != "s7l3":
+        return _fail("rung plan did not round-trip",
+                     got=reg.rung_plan(4096))
+    reg.set_rung_plan(4096, "auto")
+    if reg.rung_plan(4096) != "auto":
+        return _fail("'auto' did not clear the rung pin")
+    print("PASS: search-report schema — synthetic record validates, "
+          "lost controls rejected, registry grammar-gates rung plans")
+    return 0
+
+
+def main() -> int:
+    for step in (check_recode, check_dispatch, check_cert, check_schema):
+        rc = step()
+        if rc:
+            return rc
+    print("msm smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -166,11 +166,14 @@ def stage_attribution(msgs, lens, sigs, pubs, mode="rlc", reps=3,
     if mode == "rlc":
         import functools
 
+        plan = msm_mod.active_plan()
         if engine == "xla":
-            msm_impl, sub_impl = msm_mod.msm, msm_mod.subgroup_check
+            msm_impl = functools.partial(msm_mod.msm, plan=plan)
+            sub_impl = msm_mod.subgroup_check
         else:
             interp = engine == "interpret"
-            msm_impl = functools.partial(msm_mod.msm_fast, interpret=interp)
+            msm_impl = functools.partial(msm_mod.msm_fast,
+                                         interpret=interp, plan=plan)
             sub_impl = functools.partial(
                 msm_mod.subgroup_check_fast, interpret=interp)
         neg_r = ge.point_neg(r_point)
@@ -206,6 +209,8 @@ def stage_attribution(msgs, lens, sigs, pubs, mode="rlc", reps=3,
     out["engine"] = engine
     out["mode"] = mode
     out.update(_decompress_attrib(2 * bsz))
+    if mode == "rlc":
+        out.update(_msm_attrib())
     return out
 
 
@@ -231,6 +236,91 @@ def _decompress_attrib(stacked_lanes):
         "decompress_inversions": int(dp.inversion_count(stacked_lanes)),
         "decompress_sched": sched,
     }
+
+
+def _msm_attrib(plan=None):
+    """fd_msm2 MSM attribution fields for the artifact: the ACTIVE
+    Pippenger schedule token (FD_MSM_PLAN / FD_MSM_WINDOW /
+    FD_MSM_SIGNED resolution, or an explicit plan) and its signed-digit
+    bit — so a stage_ms.msm number can never be read without knowing
+    which schedule produced it. Validated by
+    scripts/bench_log_check._validate_stage_ms."""
+    from firedancer_tpu.msm_plan import plan_from_flags, plan_token
+
+    if plan is None:
+        plan = plan_from_flags()
+    return {"msm_plan": plan_token(plan), "msm_signed": bool(plan.signed)}
+
+
+def msm_stage_ms(batch, reps=1, warmup=1, seed=0, plan=None,
+                 torsion_k=None):
+    """Time JUST the MSM stage at the rlc verify shape — the two
+    Pippenger MSMs (z*(-R) over WINDOWS_Z, h*(-A) over WINDOWS_253)
+    plus the torsion certification, each as its own jitted launch under
+    `plan` (None = the FD_MSM_* flags) — the cheap way to grade the
+    fd_msm2 signed-digit cut at B=8192 on a CPU host, where a full
+    stage_attribution re-times every other stage too. Engine dispatch
+    follows FD_MSM_IMPL exactly like verify_rlc (xla graph off-TPU).
+    RUNBOOK: 'Reading an msm-search rejection'.
+
+    Uses _bench_util.bench (host-pull timing): the MSM tail is a
+    doubling chain, and block_until_ready alone mis-measures chained
+    graphs on remote backends (the round-4 lesson)."""
+    import functools
+
+    from _bench_util import bench as _pull_bench
+    from firedancer_tpu import flags
+    from firedancer_tpu.msm_plan import TORSION_BUCKET_BITS
+    from firedancer_tpu.ops import curve25519 as ge
+    from firedancer_tpu.ops import msm as msm_mod
+    from firedancer_tpu.ops.verify_rlc import fresh_u, fresh_z, msm_engine
+
+    if plan is None:
+        plan = msm_mod.active_plan()
+    if torsion_k is None:
+        torsion_k = flags.get_int("FD_RLC_TORSION_K")
+    rng = np.random.RandomState(seed)
+    host = np.random.default_rng(seed)
+    z = jnp.asarray(fresh_z(batch, host))
+    u = jnp.asarray(fresh_u(torsion_k, 2 * batch, host))
+    scal253 = jnp.asarray(
+        rng.randint(0, 128, (batch, 32), dtype=np.uint8))
+    ybytes = jnp.asarray(
+        rng.randint(0, 256, (batch, 32), dtype=np.uint8))
+    pt, _ = jax.jit(ge.decompress)(ybytes)[:2]   # Z == 1 by construction
+    both = tuple(jnp.concatenate([c, c], axis=1) for c in pt)
+
+    engine = msm_engine()
+    if engine == "xla":
+        msm_impl = functools.partial(msm_mod.msm, plan=plan)
+        if plan.lazy:
+            sub_impl = functools.partial(
+                msm_mod.subgroup_check,
+                bucket_bits=TORSION_BUCKET_BITS, lazy=True)
+        else:
+            sub_impl = msm_mod.subgroup_check
+    else:
+        interp = engine == "interpret"
+        msm_impl = functools.partial(msm_mod.msm_fast,
+                                     interpret=interp, plan=plan)
+        sub_impl = functools.partial(
+            msm_mod.subgroup_check_fast, interpret=interp)
+
+    def _t(fn, args):
+        return 1e3 * _pull_bench(jax.jit(fn), args, reps=reps,
+                                 warmup=warmup)
+
+    ms = (
+        _t(lambda s, p: msm_impl(s, p, n_windows=msm_mod.WINDOWS_Z)[0],
+           (z, pt))
+        + _t(lambda s, p: msm_impl(
+            s, p, n_windows=msm_mod.WINDOWS_253)[0], (scal253, pt))
+        + _t(lambda p, u_: sub_impl(p, u_)[0], (both, u))
+    )
+    rec = {"batch": batch, "torsion_k": int(torsion_k),
+           "engine": engine, "msm_ms": round(ms, 3)}
+    rec.update(_msm_attrib(plan))
+    return rec
 
 
 def decompress_stage_ms(batch, reps=3, warmup=1, seed=0):
@@ -448,10 +538,27 @@ def decompress_main():
     print(json.dumps(decompress_stage_ms(batch)))
 
 
+def msm_main():
+    """JSON MSM-stage-only timing under the active (or given) plan:
+    python scripts/profile_stages.py --msm [batch [plan_token]]."""
+    import json
+
+    argv = [a for a in sys.argv[1:] if not a.startswith("-")]
+    batch = int(argv[0]) if argv else 8192
+    plan = None
+    if len(argv) > 1:
+        from firedancer_tpu.msm_plan import parse_plan
+
+        plan = parse_plan(argv[1])
+    print(json.dumps(msm_stage_ms(batch, plan=plan)))
+
+
 if __name__ == "__main__":
     if "--attrib" in sys.argv:
         attrib_main()
     elif "--decompress" in sys.argv:
         decompress_main()
+    elif "--msm" in sys.argv:
+        msm_main()
     else:
         main()

@@ -58,6 +58,65 @@ def test_parse_key_rejects_junk():
             fd_engine.parse_key(junk)
 
 
+def test_engine_spec_msm_key_roundtrip():
+    # fd_msm2: "auto" keeps the legacy 4-part key, so every pre-PR-16
+    # artifact keeps round-tripping byte-identically.
+    spec = EngineSpec("rlc", 8192, 0, "pallas")
+    assert spec.key == "rlc:B8192:shards0:fepallas"
+    assert fd_engine.parse_key(spec.key).msm == "auto"
+    pinned = spec.with_msm("s7l3")
+    assert pinned.key == "rlc:B8192:shards0:fepallas:msms7l3"
+    assert fd_engine.parse_key(pinned.key) == pinned
+    for junk in ("rlc:B8192:shards0:fepallas:msm",
+                 "rlc:B8192:shards0:fepallas:s7l3"):
+        with pytest.raises(ValueError):
+            fd_engine.parse_key(junk)
+
+
+def test_engine_spec_resolved_msm(monkeypatch):
+    monkeypatch.delenv("FD_MSM_PLAN", raising=False)
+    monkeypatch.delenv("FD_MSM_WINDOW", raising=False)
+    monkeypatch.delenv("FD_MSM_SIGNED", raising=False)
+    spec = EngineSpec("rlc", 8192)
+    assert spec.resolved_msm() == "u7"            # flag default = baseline
+    assert spec.with_msm("s7l3").resolved_msm() == "s7l3"  # pin wins
+    monkeypatch.setenv("FD_MSM_PLAN", "s6l3")
+    assert spec.resolved_msm() == "s6l3"          # auto follows the flags
+
+
+def test_registry_snapshot_reports_msm_token(monkeypatch):
+    monkeypatch.delenv("FD_MSM_PLAN", raising=False)
+    monkeypatch.delenv("FD_MSM_WINDOW", raising=False)
+    monkeypatch.delenv("FD_MSM_SIGNED", raising=False)
+    reg = EngineRegistry()
+    rlc = reg.entry(EngineSpec("rlc", 8192).with_msm("s7l3"))
+    host = reg.entry(EngineSpec("cpu", 128))
+    by_key = {s["key"]: s for s in reg.snapshot()}
+    assert by_key[rlc.key]["msm"] == "s7l3"
+    # Host engines run no Pippenger MSM — no schedule to report.
+    assert by_key[host.key]["msm"] is None
+
+
+def test_for_tile_picks_up_rung_plan(monkeypatch):
+    """The msm_search -> registry -> dispatch-key path: an installed
+    rung winner changes WHICH engine a VerifyTile keys on, and clearing
+    it restores the legacy key."""
+    monkeypatch.delenv("FD_MSM_PLAN", raising=False)
+    monkeypatch.delenv("FD_MSM_WINDOW", raising=False)
+    monkeypatch.delenv("FD_MSM_SIGNED", raising=False)
+    reg = fd_engine.registry()
+    try:
+        reg.set_rung_plan(4096, "s7l3")
+        spec = EngineSpec.for_tile("tpu", "rlc", 4096, 0)
+        assert spec.msm == "s7l3"
+        assert spec.key.endswith(":msms7l3")
+        # Non-rlc dispatches never consult the plan table.
+        assert EngineSpec.for_tile("cpu", "direct", 4096, 0).msm == "auto"
+    finally:
+        reg.set_rung_plan(4096, "auto")
+    assert EngineSpec.for_tile("tpu", "rlc", 4096, 0).msm == "auto"
+
+
 def test_resolution_has_one_owner():
     """The tiles/backend spellings are re-exports of the registry
     module's resolver — one authority, no drift possible."""

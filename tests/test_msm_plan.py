@@ -1,0 +1,225 @@
+"""fd_msm2 schedule layer: plan grammar, analytic cost model, flag
+resolution, and the certified balanced recode vs a python-int
+reference.
+
+Everything here is host-side or eager-jnp cheap — the heavyweight
+oracle parity of the signed engine itself lives in test_verify_rlc.py
+(one cached compile per shape, like the baseline msm tests).
+"""
+
+import numpy as np
+import pytest
+
+from firedancer_tpu import msm_plan
+from firedancer_tpu.msm_plan import (
+    BASELINE_PLAN,
+    MsmPlan,
+    PLAN_WIDTHS,
+    all_plans,
+    default_rounds,
+    pareto_candidates,
+    parse_plan,
+    plan_buckets,
+    plan_cost,
+    plan_from_flags,
+    plan_token,
+    plan_windows,
+)
+
+
+def test_plan_token_roundtrip():
+    for p in all_plans():
+        assert parse_plan(plan_token(p)) == p
+    assert plan_token(BASELINE_PLAN) == "u7"
+    assert parse_plan("s7l3") == MsmPlan(w=7, signed=True, lazy=True)
+
+
+@pytest.mark.parametrize(
+    "junk", ["", "x7", "s7", "u9", "s5l3", "u7l2", "7", "sl3", "u7l3x"])
+def test_plan_grammar_rejects(junk):
+    with pytest.raises(ValueError):
+        parse_plan(junk)
+
+
+def test_plan_windows_pins():
+    # 253-bit scalars: every shippable width fits the borrow in the
+    # top partial window — signed costs NO extra window.
+    assert plan_windows(253, 7, False) == 37
+    assert plan_windows(253, 7, True) == 37
+    assert plan_windows(253, 6, True) == 43
+    assert plan_windows(253, 8, True) == 32
+    # 126-bit z weights: both 6 and 7 divide 126, so the balanced
+    # recode needs the extra all-carry window at BOTH widths — the
+    # shapes where signed pays a window.
+    assert plan_windows(126, 6, False) == 21
+    assert plan_windows(126, 6, True) == 22
+    assert plan_windows(126, 7, False) == 18
+    assert plan_windows(126, 7, True) == 19
+    assert plan_windows(126, 8, True) == 16
+
+
+def test_plan_buckets_pins():
+    # Signed halves the bucket table: magnitudes 0..2^(w-1) vs 0..2^w-1.
+    assert plan_buckets(MsmPlan(w=7, signed=False, lazy=False)) == 128
+    assert plan_buckets(MsmPlan(w=7, signed=True, lazy=True)) == 65
+    assert plan_buckets(MsmPlan(w=6, signed=True, lazy=True)) == 33
+    assert plan_buckets(MsmPlan(w=8, signed=True, lazy=True)) == 129
+
+
+def test_default_rounds_single_source():
+    """ops/msm._default_rounds IS msm_plan.default_rounds — the engine
+    round count and the bench orchestrator's fill-efficiency analytics
+    must never drift (PR-16 re-pins this after the signed-digit bound
+    change)."""
+    from firedancer_tpu.ops.msm import _default_rounds
+
+    for bsz in (64, 1024, 8192, 16384):
+        for nb, signed in ((128, False), (64, True), (32, True)):
+            assert _default_rounds(bsz, nb, signed=signed) == \
+                default_rounds(bsz, nb, signed=signed)
+
+
+def test_default_rounds_signed_rate_pin():
+    """The signed Poisson bound: live buckets catch rate B/nb (bucket 0
+    is dead, each magnitude absorbs two digit values), unsigned catch
+    B/(nb-1). At the SAME live-bucket count the signed lam is the
+    unsigned lam of nb+1 — pin the exact formula relationship so a
+    silent rate change cannot hide."""
+    for bsz in (1024, 8192):
+        s = default_rounds(bsz, 64, signed=True)
+        u = default_rounds(bsz, 65, signed=False)
+        assert s == u
+    # And the headline geometry: the s7 grid (64 live buckets) runs
+    # MORE rounds per bucket than the u7 grid (127 live) but over HALF
+    # the buckets — the product (fill lanes) is what shrinks, pinned
+    # in test_pareto_cost_pins below.
+    assert default_rounds(8192, 64, signed=True) > \
+        default_rounds(8192, 128, signed=False)
+
+
+def test_plan_cost_monotone_in_batch():
+    for tok in ("u7", "s7l3", "u8l3"):
+        plan = parse_plan(tok)
+        costs = [plan_cost(b, plan)["cost"] for b in
+                 (1024, 2048, 4096, 8192, 16384)]
+        assert costs == sorted(costs)
+        assert len(set(costs)) == len(costs)
+
+
+def test_pareto_cost_pins():
+    """The analytic pruner's load-bearing orderings at the headline
+    batch: signed beats unsigned at the same width (halved buckets
+    shrink both the fill grid and the aggregation tree), the baseline
+    is always kept as the A/B anchor, and nothing costlier than the
+    baseline survives to the (expensive) certify/parity/timing
+    pipeline."""
+    cands = pareto_candidates(8192)
+    by_tok = {c["token"]: c for c in cands}
+    assert set(by_tok) == {plan_token(p) for p in all_plans()}
+
+    base = by_tok["u7"]
+    assert base["pareto"] is True          # the anchor is never pruned
+    assert by_tok["s7l3"]["cost"] < by_tok["u7l3"]["cost"]
+    assert by_tok["s8l3"]["cost"] < by_tok["u8l3"]["cost"]
+    assert by_tok["s7l3"]["cost"] < base["cost"]
+    # cheapest-first ordering, and the signed w=7 plan leads at B=8192
+    assert cands[0]["token"] == "s7l3"
+    for c in cands:
+        if c["cost"] > base["cost"]:
+            assert c["pareto"] is False, c["token"]
+
+
+def test_plan_from_flags_resolution(monkeypatch):
+    monkeypatch.delenv("FD_MSM_PLAN", raising=False)
+    monkeypatch.delenv("FD_MSM_WINDOW", raising=False)
+    monkeypatch.delenv("FD_MSM_SIGNED", raising=False)
+    assert plan_from_flags() == BASELINE_PLAN
+
+    monkeypatch.setenv("FD_MSM_PLAN", "s7l3")
+    assert plan_from_flags() == MsmPlan(w=7, signed=True, lazy=True)
+
+    monkeypatch.setenv("FD_MSM_PLAN", "u9")
+    with pytest.raises(ValueError):
+        plan_from_flags()
+
+    monkeypatch.delenv("FD_MSM_PLAN")
+    monkeypatch.setenv("FD_MSM_WINDOW", "5")
+    with pytest.raises(ValueError):
+        plan_from_flags()
+
+    monkeypatch.setenv("FD_MSM_WINDOW", "8")
+    monkeypatch.setenv("FD_MSM_SIGNED", "1")
+    p = plan_from_flags()
+    assert p == MsmPlan(w=8, signed=True, lazy=True)
+
+    # ops.msm.active_plan is the same resolution rule, re-exported.
+    from firedancer_tpu.ops.msm import active_plan
+
+    assert active_plan() == p
+
+
+def _recode_ref(scalar, w, nw):
+    half = 1 << (w - 1)
+    digs, c = [], 0
+    for t in range(nw):
+        v = ((scalar >> (w * t)) & ((1 << w) - 1)) + c
+        c = 1 if v > half else 0
+        digs.append(v - (c << w))
+    return digs, c
+
+
+@pytest.mark.parametrize("w", PLAN_WIDTHS)
+def test_recode_signed_bit_exact_vs_reference(w):
+    """The certified borrow-propagating recode vs the python-int spec:
+    bit-exact digits, the proven magnitude hull, and the signed-digit
+    expansion reconstructing the scalar (edge scalars included — the
+    all-ones pattern drives the longest carry chain)."""
+    import random as pyrandom
+
+    from firedancer_tpu.ops import msm_recode
+
+    fn = getattr(msm_recode, f"recode_signed_w{w}")
+    nw = plan_windows(253, w, signed=True)
+    rng = pyrandom.Random(160 + w)
+    scalars = [rng.getrandbits(253) for _ in range(12)]
+    scalars += [0, 1, (1 << 253) - 1, (1 << (w * (nw - 1))) - 1]
+    d = np.zeros((nw, len(scalars)), np.int32)
+    for i, s in enumerate(scalars):
+        for t in range(nw):
+            d[t, i] = (s >> (w * t)) & ((1 << w) - 1)
+    got = np.asarray(fn(d))
+    half = 1 << (w - 1)
+    assert got.min() >= -(half - 1) and got.max() <= half
+    for i, s in enumerate(scalars):
+        ref, carry = _recode_ref(s, w, nw)
+        assert carry == 0
+        assert list(got[:, i]) == ref
+        assert sum(int(got[t, i]) << (w * t) for t in range(nw)) == s
+
+
+def test_recode_contract_windows_track_plan_windows():
+    """The fdcert contract's input window count is plan geometry — if
+    plan_windows changes, the proof obligation must change with it."""
+    from firedancer_tpu.ops import msm_recode
+
+    for w in PLAN_WIDTHS:
+        nw = plan_windows(253, w, signed=True)
+        contract = msm_recode.FDCERT_CONTRACTS[f"recode_signed_w{w}"]
+        assert contract["inputs"] == [f"bytes2:{nw}:8"]
+
+
+def test_search_controls_never_registrable():
+    """The negative-control contract, pinned from the registry side:
+    grammar-rejected tokens can never be installed as a rung plan, and
+    the msm_search control names are not grammar tokens."""
+    from firedancer_tpu.disco.engine import EngineRegistry
+
+    reg = EngineRegistry()
+    for tok in ("recode_deep", "short_window", "u9", "s7"):
+        with pytest.raises(ValueError):
+            reg.set_rung_plan(8192, tok)
+        assert reg.rung_plan(8192) == "auto"
+    reg.set_rung_plan(8192, "s7l3")
+    assert reg.rung_plan(8192) == "s7l3"
+    reg.set_rung_plan(8192, "auto")
+    assert reg.rung_plan(8192) == "auto"

@@ -298,10 +298,10 @@ def test_timeline_ingests_repo_history_without_error():
     assert any(e.legacy for e in timeline)
 
 
-def test_prediction_ledger_all_eleven_pending_on_repo_history():
+def test_prediction_ledger_all_twelve_pending_on_repo_history():
     ledger = sentinel.prediction_ledger(sentinel.load_timeline(REPO))
-    assert len(ledger) == 11
-    assert [p["id"] for p in ledger] == list(range(1, 12))
+    assert len(ledger) == 12
+    assert [p["id"] for p in ledger] == list(range(1, 13))
     for p in ledger:
         assert p["verdict"] == "pending", p
         assert p["rule"] and p["predicted"], p
@@ -325,7 +325,8 @@ def test_prediction_ledger_autogrades_synthetic_r06():
         _sv2({"mode": "rlc", "batch": 8192, "value": 410_000.0,
               "torsion_k": 64,
               "stage_ms": {"sha": 3.2, "glue": 1.9, "decompress": 2.2,
-                           "msm": 9.0, "fused": True,
+                           "msm": 5.9, "fused": True,
+                           "msm_signed": True, "msm_plan": "s7l3",
                            "decompress_batched": True,
                            "decompress_inversions": 256},
               "b_sweep_measured": {"8192": 410_000, "16384": 455_000,

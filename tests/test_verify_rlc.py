@@ -87,6 +87,139 @@ def test_msm_matches_oracle():
     assert _affine(res) == want
 
 
+def test_msm_signed_plan_matches_oracle_single_and_8_shards():
+    """The fd_msm2 signed lazy schedule (s7l3) vs the affine oracle at
+    the full 253-bit window shape — single-shard msm() AND the 8-shard
+    slice-partial composition (ONE jitted partial shape over eight
+    3-lane slices, combine_stacked fold + msm_combine tail: the exact
+    folding rule the pod mesh's all_gather path shares, so this pins
+    the sharded halves without needing a device mesh)."""
+    import functools
+    import random as pyrandom
+
+    import jax
+
+    from firedancer_tpu.msm_plan import MsmPlan
+
+    plan = MsmPlan(w=7, signed=True, lazy=True)
+    rng = pyrandom.Random(11)
+    bsz = 24
+    pts_aff = [oracle.scalarmult(rng.randint(1, 2**60), oracle.B)
+               for _ in range(bsz)]
+    scal = np.zeros((bsz, 32), np.uint8)
+    for i in range(bsz):
+        c = rng.randint(0, 2**252 - 1)
+        scal[i] = np.frombuffer(c.to_bytes(32, "little"), np.uint8)
+    want = (0, 1)
+    for i in range(bsz):
+        c = int.from_bytes(scal[i].tobytes(), "little")
+        want = oracle.point_add(want, oracle.scalarmult(c, pts_aff[i]))
+
+    pts = _mkpts(pts_aff)   # Z == 1: the lazy niels fill's contract
+    scal = jnp.asarray(scal)
+    f = jax.jit(functools.partial(
+        msm_mod.msm, n_windows=msm_mod.WINDOWS_253, plan=plan))
+    res, ok = f(scal, pts)
+    assert bool(ok)
+    assert _affine(res) == want
+
+    fp = jax.jit(functools.partial(
+        msm_mod.msm_partial, n_windows=msm_mod.WINDOWS_253, plan=plan))
+    parts, oks = [], []
+    for s in range(8):
+        sl = slice(3 * s, 3 * (s + 1))
+        w_res, okp = fp(scal[sl], tuple(c[:, sl] for c in pts))
+        parts.append(w_res)
+        oks.append(okp)
+    stacked = tuple(jnp.stack([p[i] for p in parts]) for i in range(4))
+    w_sum = msm_mod.combine_stacked(stacked)
+    fc = jax.jit(functools.partial(
+        msm_mod.msm_combine, n_windows=msm_mod.WINDOWS_253, plan=plan))
+    res8, ok8 = fc(w_sum, jnp.all(jnp.stack(oks)))
+    assert bool(ok8)
+    assert _affine(res8) == want
+
+
+def test_msm_signed_carry_window_concentration():
+    """The top-window regression behind _top_window_sum: scalars whose
+    top full window digit exceeds 2^(w-1) ALL borrow into the carry
+    window, so that window's magnitude-1 bucket catches every lane at
+    once — under the uniform-digit Poisson round bound the old grid
+    path deterministically overflowed (ok=False false-reject) for any
+    batch larger than the round count. The carry window now bypasses
+    the grid via the exact bit-plane tree sum: the fill verdict must
+    hold and the result must still match the affine oracle."""
+    import functools
+    import random as pyrandom
+
+    import jax
+
+    from firedancer_tpu.msm_plan import MsmPlan, default_rounds
+
+    plan = MsmPlan(w=7, signed=True, lazy=True)
+    rng = pyrandom.Random(19)
+    bsz = 24
+    # Every lane borrows: the bucket grid alone would need >= bsz rounds
+    # for window 18's bucket 1, far past the Poisson bound it runs.
+    assert bsz > default_rounds(bsz, 64, signed=True)
+    pts_aff = [oracle.scalarmult(rng.randint(1, 2**60), oracle.B)
+               for _ in range(bsz)]
+    scal = np.zeros((bsz, 32), np.uint8)
+    for i in range(bsz):
+        c = (0x7F << 119) | rng.randint(0, 2**119 - 1)
+        scal[i] = np.frombuffer(c.to_bytes(32, "little"), np.uint8)
+    want = (0, 1)
+    for i in range(bsz):
+        c = int.from_bytes(scal[i].tobytes(), "little")
+        want = oracle.point_add(want, oracle.scalarmult(c, pts_aff[i]))
+
+    f = jax.jit(functools.partial(
+        msm_mod.msm, n_windows=msm_mod.WINDOWS_Z, plan=plan))
+    res, ok = f(jnp.asarray(scal), _mkpts(pts_aff))
+    assert bool(ok)          # the old grid path returned False here
+    assert _affine(res) == want
+
+
+def test_msm_signed_short_window_breaks_parity():
+    """The search harness's window-grid negative control, test-pinned:
+    the certified signed recode driven at one window short of
+    plan_windows (msm_partial's _force_windows knob) drops the final
+    borrow window, so the recode stops representing the scalar — the
+    certifier cannot see plan geometry, the oracle-parity gate must be
+    what catches it (scripts/msm_search.py ships the same control in
+    every run's build/msm_search.json)."""
+    import functools
+    import random as pyrandom
+
+    import jax
+
+    from firedancer_tpu.msm_plan import MsmPlan, plan_windows
+
+    plan = MsmPlan(w=7, signed=True, lazy=True)
+    rng = pyrandom.Random(11)
+    bsz = 24
+    pts_aff = [oracle.scalarmult(rng.randint(1, 2**60), oracle.B)
+               for _ in range(bsz)]
+    scal = np.zeros((bsz, 32), np.uint8)
+    for i in range(bsz):
+        c = rng.randint(0, 2**252 - 1)
+        scal[i] = np.frombuffer(c.to_bytes(32, "little"), np.uint8)
+    want = (0, 1)
+    for i in range(bsz):
+        c = int.from_bytes(scal[i].tobytes(), "little")
+        want = oracle.point_add(want, oracle.scalarmult(c, pts_aff[i]))
+
+    nw_forced = plan_windows(253, 7, True) - 1
+    fp = jax.jit(functools.partial(
+        msm_mod.msm_partial, n_windows=msm_mod.WINDOWS_253, plan=plan,
+        _force_windows=nw_forced))
+    w_res, ok = fp(jnp.asarray(scal), _mkpts(pts_aff))
+    fc = jax.jit(functools.partial(
+        msm_mod.msm_combine, n_windows=msm_mod.WINDOWS_253, plan=plan))
+    res, ok = fc(w_res, ok)
+    assert _affine(res) != want
+
+
 def test_msm_fast_interpret_matches_oracle():
     """Kernel-path msm (interpret mode) vs the affine oracle: niels
     staging, bucket fill, running-sum aggregation, Horner."""
@@ -324,6 +457,42 @@ def test_subgroup_check_mixed_and_small_order():
     small = list(clean)
     small[0] = t2
     u = jnp.asarray(fresh_u(K, 6, np.random.default_rng(34)))
+    ok, _ = f(_mkpts(small), u)
+    assert not bool(ok)
+
+
+def test_subgroup_check_lazy_mixed_and_small_order():
+    """The fd_msm2 lazy-fill torsion grid (5-bit trial digits, niels
+    madd fill — what a lazy verify plan routes the certification
+    through): same contract as the legacy path — clean prime-order
+    sets certify, mixed-order and small-order points are caught."""
+    import functools
+
+    import jax
+
+    from firedancer_tpu.msm_plan import TORSION_BUCKET_BITS
+
+    t2 = (0, oracle.P - 1)
+    t4 = oracle.point_decompress(bytes(32))
+    clean = [oracle.scalarmult(3 + i, oracle.B) for i in range(6)]
+    f = jax.jit(functools.partial(
+        msm_mod.subgroup_check, bucket_bits=TORSION_BUCKET_BITS,
+        lazy=True))
+    u = jnp.asarray(fresh_u(K, 6, np.random.default_rng(61)))
+    ok, fill_ok = f(_mkpts(clean), u)
+    assert bool(fill_ok) and bool(ok)
+
+    mixed = list(clean)
+    mixed[2] = oracle.point_add(clean[2], t4)
+    for seed in (62, 63):
+        u = jnp.asarray(fresh_u(K, 6, np.random.default_rng(seed)))
+        ok, fill_ok = f(_mkpts(mixed), u)
+        assert bool(fill_ok)
+        assert not bool(ok)
+
+    small = list(clean)
+    small[0] = t2
+    u = jnp.asarray(fresh_u(K, 6, np.random.default_rng(64)))
     ok, _ = f(_mkpts(small), u)
     assert not bool(ok)
 
