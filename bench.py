@@ -517,6 +517,15 @@ def worker(cpu: bool) -> int:
         "ms_per_batch": round(1e3 * dt / reps, 2),
         "rlc_fallbacks": fallback_cnt,
     }
+    try:
+        from scripts.bench_log_check import graph_cert_stamp
+
+        # fdgraph era (schema_version >= 3): the headline record names
+        # the proved graph contract set it ran under.
+        rec["graph_cert"] = graph_cert_stamp(
+            os.path.dirname(os.path.abspath(__file__)))
+    except ImportError:
+        pass
     # Round-10 artifact fields. The analytic fill-efficiency of the
     # Pippenger bucket grids at this batch plus the predicted B-sweep
     # winner (firedancer_tpu/msm_plan.py — stdlib math, free; the
@@ -675,10 +684,22 @@ def _log_measurement(rec: dict) -> None:
     entry.setdefault("schema_version", _schema_version())
     entry["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     try:
-        from scripts.bench_log_check import validate_entry
+        from scripts.bench_log_check import (graph_cert_stamp,
+                                             validate_entry)
     except ImportError:
         validate_entry = None  # validator missing is a repo-layout bug,
         # but must not void a real measurement round.
+        graph_cert_stamp = None
+    if (graph_cert_stamp is not None
+            and entry.get("metric") == "ed25519_verify_throughput"
+            and entry.get("graph_cert") is None):
+        # fdgraph era (schema_version >= 3): every verify number is
+        # attributable to the proved graph contract set it ran under —
+        # the sha of the committed certificate plus its per-rung MSM
+        # cost-drift. No committed cert -> stamp stays absent and the
+        # validator below refuses the append.
+        entry["graph_cert"] = graph_cert_stamp(
+            os.path.dirname(os.path.abspath(__file__)))
     if validate_entry is not None:
         errs = validate_entry(entry)
         if errs:

@@ -37,6 +37,24 @@ diff -u lint_bounds_cert.json build/lint_bounds_cert.json || {
   exit 1
 }
 
+echo "== fdgraph audit (blocking pass-7 lane + graph certificate gate) =="
+# The PR-17 jaxpr-level auditor: every FD_ENGINE_LADDER registry graph
+# traced on CPU and walked against its declared GRAPH_CONTRACTS —
+# collective inventory (collective-free local fills, exactly one
+# all_gather in the pod combine tail), purity/placement (no host
+# callbacks or pinned transfers), the closed dtype lattice (f64 never),
+# walked MSM madd counts reconciled against the msm_plan analytic at
+# every rung, and per-kernel VMEM residency vs budget. Unknown
+# primitives fail LOUD (graph-unmodeled). The lane also runs the same
+# regenerate-and-diff discipline as the fdcert gate above ON THE SAME
+# TRACE (a second certify run would double the lane past its <60s
+# budget): the committed lint_graph_cert.json must match what the
+# auditor proves against the CURRENT source, with the fresh copy kept
+# at build/lint_graph_cert.json for reviewers to diff (--dump-graph-cert
+# refuses while violations are open, so a drifted cert can never be
+# laundered by regeneration).
+JAX_PLATFORMS=cpu python scripts/fdlint.py --check-graphs
+
 echo "== BENCH_LOG hygiene (schema_version-2 shape + legacy allowlist) =="
 # The measurement history feeds fd_report's trend tables and the
 # prediction ledger; a malformed line poisons every future read-back.

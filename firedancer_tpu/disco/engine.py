@@ -954,3 +954,38 @@ class RungScheduler:
                 device_idle=device_idle, backpressured=backpressured,
             )
         return verdict, rung
+
+
+# --------------------------------------------------------------------- #
+# fdlint pass 7 (graph-audit) contracts — literals, read with
+# ast.literal_eval by firedancer_tpu/lint/graphs.py, never imported.
+# These cover the registry's engine classes: the direct (non-RLC)
+# verify graph, its psum-carrying sharded wrapper, and the fused
+# frontend / batched decompress front-end engines.  RLC and MSM stage
+# contracts live next to their builders in ops/verify_rlc.py and
+# ops/msm.py.
+# --------------------------------------------------------------------- #
+
+GRAPH_CONTRACTS = {
+    "direct": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["bool", "int32", "uint32", "uint8"],
+    },
+    "direct_sharded": {
+        "collectives": {"psum": 3},
+        "axes": ["dp"],
+        "dtypes": ["bool", "int32", "uint32", "uint8"],
+        "derived_from": ["direct"],
+    },
+    "frontend": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["bool", "int32", "uint32", "uint8"],
+    },
+    "decompress": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["bool", "int32", "uint32", "uint8"],
+    },
+}

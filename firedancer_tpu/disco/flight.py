@@ -63,8 +63,12 @@ from firedancer_tpu import flags
 
 # Artifact schema (BENCH/REPLAY/PACK artifacts + BENCH_LOG.jsonl lines
 # + flight dumps). 2 = the fd_flight era: schema_version itself,
-# stage_hist, engine_key/compile accounting.
-ARTIFACT_SCHEMA_VERSION = 2
+# stage_hist, engine_key/compile accounting. 3 = the fdgraph era:
+# verify/engine artifacts carry a graph_cert block (sha256 of the
+# committed lint_graph_cert.json + per-rung MSM cost-drift pct), so a
+# bench number is always attributable to the proved graph contract set
+# it ran under.
+ARTIFACT_SCHEMA_VERSION = 3
 
 _U64 = (1 << 64) - 1
 

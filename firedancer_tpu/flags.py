@@ -317,6 +317,26 @@ _register(
     "hosts ignore it (the plugin enumerates hardware).",
 )
 _register(
+    "FD_GRAPH_SHARDS", int, 8,
+    "Shard count fdlint pass 7 (graph-audit) traces the mesh graphs "
+    "at: the virtual CPU device count for the shard_map combine-tail "
+    "and sharded-wrapper traces. Matches FD_MESH_DEVICES' default so "
+    "the audited topology is the one CI's pod lanes actually run.",
+)
+_register(
+    "FD_GRAPH_TIMING", bool, False,
+    "Print per-graph trace wall time to stderr during fdlint pass 7 "
+    "(graph-audit) — the knob for re-budgeting the <60s CI lane when "
+    "the graph set grows.",
+)
+_register(
+    "FD_GRAPH_RUNGS", str, None,
+    "Comma-separated batch rungs for fdlint pass 7's per-rung MSM "
+    "cost-reconciliation traces. Unset = the FD_ENGINE_LADDER rungs, "
+    "so the audit covers exactly the registry's prewarmed graph "
+    "shapes; the smallest rung doubles as the structural audit rung.",
+)
+_register(
     "FD_VERIFY_MODE", str, None,
     "Force the verify tile's device mode: 'rlc' (batch RLC over the "
     "Pippenger MSM) or 'direct' (per-lane). Unset = platform auto "

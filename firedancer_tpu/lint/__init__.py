@@ -1,6 +1,6 @@
 """fdlint — the repo-native static-analysis suite.
 
-Six passes, each a machine-checked contract for a bug class the
+Seven passes, each a machine-checked contract for a bug class the
 Python/JAX port only surfaces at runtime (see each module's docstring):
 
   1. trace_safety   — host-sync/retrace hazards inside jitted/pallas/
@@ -15,10 +15,19 @@ Python/JAX port only surfaces at runtime (see each module's docstring):
   6. ownership      — fdcert: single-writer / registered-thread /
                       blessed-channel discipline for the concurrency
                       surface (tables rendered into docs/OWNERSHIP.md)
+  7. graphs         — fdgraph: jaxpr-level audit of every registry
+                      engine graph (collectives, callbacks, dtypes,
+                      msm_plan cost reconciliation, pallas residency;
+                      emits lint_graph_cert.json). NOT part of
+                      run_all(): pass 7 traces on CPU and imports jax,
+                      so it runs as its own ci.sh lane
+                      (`fdlint --check-graphs`) and, under
+                      `--check --changed`, only when a touched file is
+                      inside the graph import closure.
 
 Driven by scripts/fdlint.py (the CLI and the blocking ci.sh lane);
 pre-existing debt resolves against lint_baseline.json (common.Baseline).
-docs/LINT.md catalogs all six passes, the waiver grammar, and how to
+docs/LINT.md catalogs all seven passes, the waiver grammar, and how to
 add a pass.
 """
 
@@ -27,8 +36,8 @@ from __future__ import annotations
 import os
 from typing import List, Optional, Sequence
 
-from . import boundary, bounds, flag_registry, native_atomics, ownership, \
-    trace_safety
+from . import boundary, bounds, flag_registry, graphs, native_atomics, \
+    ownership, trace_safety
 from .common import Baseline, Violation, iter_files, rel, repo_root
 
 # Default scan scope, repo-relative. tests/ is deliberately excluded:
@@ -94,6 +103,7 @@ __all__ = [
     "boundary",
     "bounds",
     "flag_registry",
+    "graphs",
     "native_atomics",
     "ownership",
     "trace_safety",

@@ -885,3 +885,29 @@ def subgroup_fast_combine(agg, ok_fill, k: int | None = None,
         la = tuple(c[:, :k] for c in la)
     ok = fe.fe_is_zero(la[0]) & fe.fe_eq(la[1], la[2])     # (K,) identity
     return jnp.all(ok), ok_fill
+
+
+# --------------------------------------------------------------------- #
+# fdlint pass 7 (graph-audit) contracts — literals, read with
+# ast.literal_eval by firedancer_tpu/lint/graphs.py, never imported.
+# The msm_stage graphs are the three fill partials of one RLC verify
+# (z-MSM, 253-bit MSM, torsion certification) traced standalone at
+# EVERY ladder rung; their walked fill madds must reconcile with
+# msm_plan's analytic executed-madd count within the tolerance.
+# --------------------------------------------------------------------- #
+
+GRAPH_CONTRACTS = {
+    "msm_stage_xla": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["bool", "int32", "uint32", "uint8"],
+        "madds": {"engine": "xla", "tolerance_pct": 2.0},
+    },
+    "msm_stage_kernel": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["bool", "int16", "int32", "uint32", "uint8"],
+        "madds": {"engine": "kernel", "tolerance_pct": 2.0},
+        "vmem_mb": 64.0,
+    },
+}

@@ -66,6 +66,7 @@ analysis that motivates the promotion.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -207,7 +208,7 @@ def verify_batch_rlc(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits,
 
 
 def verify_rlc_local(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits,
-                     plan=None):
+                     plan=None, engine=None):
     """The LOCAL half of one RLC pass: s-range, stacked decompression,
     the fused SHA/mod-L front half, the status ladder, and the three
     Pippenger bucket fills/aggregations over THIS shard's lanes — no
@@ -226,6 +227,11 @@ def verify_rlc_local(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits,
     the XLA torsion fill through the 5-bit masked-digit grid (the same
     soundness argument subgroup_check_fast has always shipped) — the
     baseline keeps the historical 7-bit unified-add fill bit-identical.
+
+    engine (None = msm_engine(), i.e. the trace-time flag): explicit
+    MSM engine override. fdlint pass 7 traces the kernel-schedule graph
+    on CPU by passing 'interpret' here — same dispatch the flag drives,
+    no environment mutation inside the auditor.
     """
     if plan is None:
         plan = msm_mod.active_plan()
@@ -241,7 +247,8 @@ def verify_rlc_local(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits,
     from .backend import use_pallas
 
     bsz = pubkeys.shape[0]
-    engine = msm_engine()
+    if engine is None:
+        engine = msm_engine()
     on_tpu = engine == "pallas"
     # niels outputs are only consumed by the kernel MSM path, so both
     # backends must be on (a split config would compute and drop them).
@@ -379,21 +386,64 @@ def verify_rlc_local(msgs, msg_lengths, sigs, pubkeys, z_bytes, u_digits,
     return status, definite, parts
 
 
-def verify_rlc_combine(parts, axis_name: str | None = None, plan=None):
+def _gather_parts(parts, axis_name: str):
+    """ONE all_gather for the whole combine tail (round-17).
+
+    The per-leaf gather path (msm._gather_point_sum + _all_shards_ok,
+    once per grid) issued 15 collectives per combine; every partial
+    leaf is tiny ((32, nw) limb planes, () verdicts), so the tail was
+    latency-bound on collective COUNT, not bytes. Ravel every leaf
+    into one flat int32 vector (verdict bools widen to int32), gather
+    the (n_shards, N) table once, then rebuild the per-leaf shard
+    stacks and fold them through the SAME rules the per-leaf path
+    always used — combine_stacked in mesh order for coordinate stacks,
+    AND across shards for verdicts — so every folded value is
+    bit-identical to the historical path and only the data movement is
+    fused. Returns GLOBAL parts; the caller runs the per-grid combines
+    with axis_name=None. fdlint pass 7 proves the 'exactly one
+    all_gather in the combine tail' contract against this graph."""
+    leaves, treedef = jax.tree_util.tree_flatten(parts)
+    flat = jnp.concatenate(
+        [jnp.ravel(leaf).astype(jnp.int32) for leaf in leaves])
+    table = jax.lax.all_gather(flat, axis_name)          # (n_shards, N)
+    stacks = []
+    off = 0
+    for leaf in leaves:
+        n = int(np.prod(leaf.shape, dtype=np.int64))
+        stacks.append(
+            table[:, off:off + n].reshape((-1,) + leaf.shape)
+            .astype(leaf.dtype))
+        off += n
+    stacked = jax.tree_util.tree_unflatten(treedef, stacks)
+    out = {k: msm_mod.combine_stacked(stacked[k])
+           for k in ("w_r", "w_m", "sub")}
+    out.update({k: jnp.all(stacked[k], axis=0)
+                for k in ("ok_r", "ok_m", "sub_ok")})
+    return out
+
+
+def verify_rlc_combine(parts, axis_name: str | None = None, plan=None,
+                       engine=None):
     """The TAIL half of one RLC pass: combine the per-shard partials
-    across the mesh (axis_name; identity when None), run the three
-    doubling-chain tails (two window Horners + the [L] torsion ladder),
-    and fold the global batch verdict.
+    across the mesh (ONE fused all_gather via _gather_parts when
+    axis_name; identity when None), run the three doubling-chain tails
+    (two window Horners + the [L] torsion ladder), and fold the global
+    batch verdict.
 
     The engine is re-resolved from the same trace-time flag the local
-    half read, so a (local, combine) pair traced under one environment
-    always agrees on partial shapes. The kernel-path torsion combine
+    half read (or forced via the engine parameter, as verify_rlc_local),
+    so a (local, combine) pair traced under one environment always
+    agrees on partial shapes. The kernel-path torsion combine
     evaluates every Mosaic-padded trial lane — sound, because the pad
     lanes carry zero coordinates that trivially pass the identity test
     (msm.subgroup_fast_partial documents the argument)."""
     if plan is None:
         plan = msm_mod.active_plan()
-    engine = msm_engine()
+    if engine is None:
+        engine = msm_engine()
+    if axis_name is not None:
+        parts = _gather_parts(parts, axis_name)
+        axis_name = None
     if engine == "xla":
         t1, ok1 = msm_mod.msm_combine(
             parts["w_r"], parts["ok_r"], msm_mod.WINDOWS_Z,
@@ -500,3 +550,56 @@ def make_async_verifier(fallback_fn, rng: np.random.Generator | None = None,
         return RlcAsyncResult(out, fallback_fn, (msgs, lens, sigs, pubs))
 
     return fn
+
+
+# --------------------------------------------------------------------- #
+# fdlint pass 7 (graph-audit) contracts — literals, read with
+# ast.literal_eval by firedancer_tpu/lint/graphs.py, never imported.
+# Grammar + rules: docs/GRAPHS.md.  `rlc_mono`/`pod_local`/`rlc_sharded`
+# are derived graphs: thin wrappers proved by AST witness over the
+# traced halves (lint/graphs.py:DERIVED_WITNESS), so their collective
+# inventory is the composition of the halves' inventories.
+# --------------------------------------------------------------------- #
+
+GRAPH_CONTRACTS = {
+    "rlc_local": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["bool", "int32", "uint32", "uint8"],
+        "madds": {"engine": "xla", "tolerance_pct": 2.0},
+    },
+    "rlc_tail": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["bool", "int32", "uint32", "uint8"],
+    },
+    "pod_tail": {
+        "collectives": {"all_gather": 1},
+        "axes": ["dp"],
+        "dtypes": ["bool", "int32", "uint32", "uint8"],
+    },
+    "kernel_tail": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["bool", "int16", "int32", "uint32", "uint8"],
+        "vmem_mb": 64.0,
+    },
+    "rlc_mono": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["bool", "int32", "uint32", "uint8"],
+        "derived_from": ["rlc_local", "rlc_tail"],
+    },
+    "rlc_sharded": {
+        "collectives": {"all_gather": 1},
+        "axes": ["dp"],
+        "dtypes": ["bool", "int32", "uint32", "uint8"],
+        "derived_from": ["rlc_local", "pod_tail"],
+    },
+    "pod_local": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["bool", "int32", "uint32", "uint8"],
+        "derived_from": ["rlc_local"],
+    },
+}

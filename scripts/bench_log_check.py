@@ -41,6 +41,14 @@ _LEGACY_PATH = os.path.join(_HERE, "bench_log_legacy.json")
 # that the current writer version stays accepted).
 SCHEMA_VERSION_MIN = 2
 
+# First schema version whose verify/engine artifacts must carry the
+# fdgraph certificate stamp (sha256 of the committed
+# lint_graph_cert.json + the per-rung MSM cost-drift percentages read
+# off the cert). Gated on >= so every schema_version-2 line in the log
+# and in the test fixtures stays valid forever — the stamp is a
+# requirement of the fdgraph ERA, not a retrofit.
+GRAPH_CERT_SCHEMA_VERSION = 3
+
 # Verify-ladder records: the rung measurements bench.py's workers print
 # and _log_measurement appends (CPU-fallback rungs carry cpu_fallback +
 # error on top of the same core shape).
@@ -111,6 +119,82 @@ def validate_entry(rec: dict) -> List[str]:
     errs.extend(_validate_xray(rec.get("xray")))
     errs.extend(_validate_rung_hist(rec.get("rung_hist")))
     errs.extend(_validate_stage_ms(rec.get("stage_ms")))
+    if metric == "ed25519_verify_throughput" and isinstance(sv, int) \
+            and not isinstance(sv, bool) \
+            and sv >= GRAPH_CERT_SCHEMA_VERSION:
+        errs.extend(_validate_graph_cert(rec.get("graph_cert"),
+                                         required=True))
+    else:
+        errs.extend(_validate_graph_cert(rec.get("graph_cert"),
+                                         required=False))
+    return errs
+
+
+# Restates firedancer_tpu.lint.graphs.CERT_FILE (this validator stays
+# stdlib-only, the _STAGE_KEYS precedent; tests/test_fdgraph.py pins
+# the two against each other).
+_GRAPH_CERT_FILE = "lint_graph_cert.json"
+
+
+def graph_cert_stamp(root: str = None) -> dict:
+    """The ``graph_cert`` block writers stamp into verify/engine
+    artifacts: the sha256 of the committed lint_graph_cert.json plus
+    the per-rung MSM cost-drift percentages read off it — so a bench
+    number is always attributable to the proved graph contract set it
+    ran under. Returns None when no certificate is committed (the
+    writer then refuses to stamp, and a >=3 artifact fails HERE)."""
+    path = os.path.join(root or REPO, _GRAPH_CERT_FILE)
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+        cert = json.loads(raw.decode("utf-8"))
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return None
+    drift = {}
+    for rung in cert.get("rungs", []):
+        g = cert.get("graphs", {}).get(f"msm_stage_kernel@{rung}", {})
+        pct = g.get("traced", {}).get("drift_pct")
+        if isinstance(pct, (int, float)) and not isinstance(pct, bool):
+            drift[str(rung)] = pct
+    if not drift:
+        return None
+    return {"sha256": hashlib.sha256(raw).hexdigest(),
+            "cost_drift_pct": drift}
+
+
+def _validate_graph_cert(gc, required: bool) -> List[str]:
+    """Shape of the graph_cert stamp. Required in schema_version >= 3
+    verify/engine artifacts; a PRESENT block in an older line must
+    still be well-formed (a malformed stamp is never grandfathered)."""
+    if gc is None:
+        if required:
+            return ["'graph_cert' block required at schema_version >= "
+                    f"{GRAPH_CERT_SCHEMA_VERSION} (sha256 of "
+                    f"{_GRAPH_CERT_FILE} + per-rung cost-drift pct)"]
+        return []
+    if not isinstance(gc, dict):
+        return ["'graph_cert' must be an object"]
+    errs: List[str] = []
+    sha = gc.get("sha256")
+    if not isinstance(sha, str) or len(sha) != 64 \
+            or any(c not in "0123456789abcdef" for c in sha):
+        errs.append(f"'graph_cert.sha256' must be a 64-char lowercase "
+                    f"hex digest, got {sha!r}")
+    drift = gc.get("cost_drift_pct")
+    if not isinstance(drift, dict) or not drift:
+        errs.append("'graph_cert.cost_drift_pct' must be a non-empty "
+                    "object mapping rung -> drift pct")
+    else:
+        for k, v in drift.items():
+            if not isinstance(k, str) or not k.isdigit() or int(k) <= 0:
+                errs.append(f"'graph_cert.cost_drift_pct' key {k!r} is "
+                            "not a positive batch-rung string")
+                break
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v < 0:
+                errs.append(f"'graph_cert.cost_drift_pct[{k}]' must be "
+                            f"a non-negative number, got {v!r}")
+                break
     return errs
 
 
@@ -297,6 +381,10 @@ def validate_engine(rec: dict) -> List[str]:
                         or isinstance(v, bool) or v <= 0:
                     errs.append(f"'{block}.{k}' missing or not a "
                                 f"positive number: {v!r}")
+    required = isinstance(sv, int) and not isinstance(sv, bool) \
+        and sv >= GRAPH_CERT_SCHEMA_VERSION
+    errs.extend(_validate_graph_cert(rec.get("graph_cert"),
+                                     required=required))
     return errs
 
 

@@ -333,9 +333,39 @@ def _pipeline_parity(failures: list) -> dict:
             "rung_switches": vs.get("rung_switches")}
 
 
+def _graph_cert_parity(failures: list) -> None:
+    """fdgraph cross-check (pass 7 subsumes this lane's resolution
+    parity): the rung ladder this profile schedules over must be
+    exactly the rung set the committed graph certificate proves, with
+    the production MSM engine graph proved ok at every rung — so the
+    runtime scheduler and the static auditor can never diverge
+    silently (ISSUE 17's smoke-invariant audit)."""
+    path = os.path.join(REPO, "lint_graph_cert.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            cert = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        failures.append(f"graph cert parity: {path} unreadable ({e}) — "
+                        "regenerate with `python scripts/fdlint.py "
+                        "--dump-graph-cert`")
+        return
+    if cert.get("rungs") != LADDER:
+        failures.append(
+            f"graph cert parity: scheduler ladder {LADDER} != certified "
+            f"rung set {cert.get('rungs')} — the profile runs rungs the "
+            "auditor never proved")
+    for r in LADDER:
+        g = (cert.get("graphs") or {}).get(f"msm_stage_kernel@{r}")
+        if not (isinstance(g, dict) and g.get("ok")):
+            failures.append(
+                f"graph cert parity: msm_stage_kernel@{r} missing or "
+                "not proved ok in the committed certificate")
+
+
 def main() -> int:
     failures: list = []
     t0 = time.perf_counter()
+    _graph_cert_parity(failures)
     _resolution_parity(failures)
     parity = _dispatch_parity(failures)
     pipeline = _pipeline_parity(failures)
@@ -417,7 +447,14 @@ def main() -> int:
         "elapsed_s": round(time.perf_counter() - t0, 1),
         "failures": failures,
     }
-    from scripts.bench_log_check import validate_engine
+    from scripts.bench_log_check import graph_cert_stamp, validate_engine
+
+    # fdgraph era (schema_version >= 3): the artifact carries the sha
+    # of the committed graph certificate + its per-rung MSM cost drift,
+    # so this profile is attributable to the proved contract set. A
+    # missing cert leaves the stamp absent and validate_engine below
+    # fails the artifact.
+    rec["graph_cert"] = graph_cert_stamp(REPO)
 
     errs = validate_engine(rec)
     if errs:

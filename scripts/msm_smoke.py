@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """fd_msm2 smoke — the signed-digit Pippenger schedule's CI gate.
 
-Four blocking sections, each printing one PASS line (any failure prints
+Five blocking sections, each printing one PASS line (any failure prints
 a JSON evidence line and exits 1):
 
   1. RECODE PARITY — recode_signed_w{6,7,8} (the certified
@@ -24,7 +24,13 @@ a JSON evidence line and exits 1):
      recode_deep negative control (deferred base-2^w borrow) must be
      REJECTED with violation evidence — the carry-depth gate itself is
      exercised on every CI run, not only in full searches.
-  4. SEARCH-REPORT SCHEMA — bench_log_check.validate_msm_search
+  4. GRAPH-CERT PARITY — the committed lint_graph_cert.json (fdlint
+     pass 7) must reconcile the production MSM engine's walked madd
+     count at every certified rung within its declared tolerance, with
+     expected counts matching a LIVE msm_plan computation — the static
+     auditor and this smoke's schedule parity can never diverge
+     silently.
+  5. SEARCH-REPORT SCHEMA — bench_log_check.validate_msm_search
      accepts a well-formed synthetic artifact and rejects one whose
      short_window control held parity (a search run that lost its
      controls must not be recordable); EngineRegistry.set_rung_plan
@@ -34,6 +40,7 @@ a JSON evidence line and exits 1):
 Run:  JAX_PLATFORMS=cpu python scripts/msm_smoke.py
 """
 
+import hashlib
 import json
 import os
 import random
@@ -237,6 +244,46 @@ def check_cert() -> int:
     return 0
 
 
+def check_graph_cert() -> int:
+    """fdgraph cross-check (ISSUE 17's smoke-invariant audit): the
+    schedule parity this smoke proves at runtime must agree with the
+    committed graph certificate's static view — every certified rung's
+    walked MSM madd count reconciled within its declared tolerance, and
+    the cert's expected counts matching a LIVE msm_plan computation (a
+    cert regenerated against a stale analytic model fails here, not
+    silently)."""
+    from firedancer_tpu import msm_plan as mp
+    from firedancer_tpu.lint import graphs
+
+    with open(os.path.join(REPO, graphs.CERT_FILE)) as f:
+        cert = json.load(f)
+    rungs = cert.get("rungs") or []
+    if not rungs:
+        return _fail("graph certificate carries no rung set")
+    for rung in rungs:
+        g = cert["graphs"].get(f"msm_stage_kernel@{rung}")
+        if not g:
+            return _fail("graph certificate missing the production MSM "
+                         "engine at a ladder rung", rung=rung)
+        t = g["traced"]
+        tol = g["contract"]["madds"]["tolerance_pct"]
+        if not g.get("ok") or t["drift_pct"] > tol:
+            return _fail("certified MSM cost drifted past its declared "
+                         "tolerance", rung=rung,
+                         drift_pct=t.get("drift_pct"), tolerance=tol)
+        live = round(mp.executed_madds_per_lane(rung) * rung)
+        if t["expected_madds"] != live \
+                or graphs.expected_madds(rung, "kernel") != live:
+            return _fail("cert expected madds diverge from the live "
+                         "msm_plan analytic", rung=rung,
+                         cert=t.get("expected_madds"), live=live)
+    with open(os.path.join(REPO, graphs.CERT_FILE), "rb") as f:
+        stamp_sha = hashlib.sha256(f.read()).hexdigest()
+    print(f"PASS: graph cert parity — {len(rungs)} rungs reconciled "
+          f"against live msm_plan, cert sha {stamp_sha[:12]}…")
+    return 0
+
+
 def check_schema() -> int:
     import bench_log_check
 
@@ -298,7 +345,8 @@ def check_schema() -> int:
 
 
 def main() -> int:
-    for step in (check_recode, check_dispatch, check_cert, check_schema):
+    for step in (check_recode, check_dispatch, check_cert,
+                 check_graph_cert, check_schema):
         rc = step()
         if rc:
             return rc

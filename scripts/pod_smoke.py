@@ -198,6 +198,39 @@ def main() -> int:
         f"digest parity {'OK' if digest_parity else 'BROKEN'} "
         f"({len(d_mesh)} digests)")
 
+    # -- 2b. graph-cert parity (fdgraph, ISSUE 17) -----------------------
+    # The runtime split==mono digest check above has a static
+    # counterpart: the committed graph certificate must prove the
+    # collective story the split path relies on — a collective-free
+    # local fill and EXACTLY one all_gather on the dp axis in the
+    # combine tail. If the cert says otherwise, the static auditor and
+    # this smoke have diverged and neither can be trusted alone.
+    try:
+        with open(os.path.join(REPO, "lint_graph_cert.json"),
+                  encoding="utf-8") as f:
+            gcert = json.load(f)
+        rung = gcert["audit_rung"]
+        local = gcert["graphs"][f"rlc_local@{rung}"]["traced"]
+        tail = gcert["graphs"][f"pod_tail@{rung}"]["traced"]
+        if local["collectives"] != {}:
+            failures.append(
+                f"graph cert parity: rlc_local@{rung} is not "
+                f"collective-free in the cert: {local['collectives']}")
+        if tail["collectives"] != {"all_gather": 1} \
+                or tail["axes"] != ["dp"]:
+            failures.append(
+                f"graph cert parity: pod_tail@{rung} does not prove "
+                f"one all_gather on dp: {tail['collectives']} on "
+                f"{tail['axes']}")
+        log(f"graph cert parity: rlc_local@{rung} collective-free, "
+            f"pod_tail@{rung} = one all_gather on dp (static view "
+            "agrees with the digest parity above)")
+    except (OSError, json.JSONDecodeError, KeyError) as e:
+        failures.append(
+            f"graph cert parity: lint_graph_cert.json unreadable or "
+            f"missing the pod graphs ({e!r}) — regenerate with "
+            "`python scripts/fdlint.py --dump-graph-cert`")
+
     # -- 3. the pod service ----------------------------------------------
     from firedancer_tpu.disco.pod import pod_replay
 

@@ -1,0 +1,136 @@
+"""fdlint pass 7 (graph-audit) MUST-FLAG fixture.
+
+Five planted mutations, each of which must be rejected by EXACTLY its
+rule (tests/test_fdgraph.py asserts the rule sets):
+
+  planted_all_gather  — a collective smuggled into a "collective-free"
+                        local-fill body            -> graph-collective
+  planted_callback    — a host pure_callback in a hot graph
+                                                   -> graph-callback
+  planted_f64         — a float64 upcast (traced under x64 so jax
+                        cannot silently coerce it) -> graph-dtype
+  planted_tolerance   — an msm_plan drift tolerance widened past
+                        TOLERANCE_CAP_PCT          -> graph-cost-drift
+  planted_fill_drift  — a bucket-fill loop whose walked madd count
+                        disagrees with the model   -> graph-cost-drift
+
+Lives under tests/fixtures/lint/ — OUTSIDE the fdlint scan scope; this
+module is imported (exec'd) by graphs.check_fixture, unlike the
+passes-1-6 fixtures which are only parsed.
+"""
+
+import numpy as np
+
+
+GRAPH_CONTRACTS = {
+    "planted_all_gather": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["float32", "int32"],
+    },
+    "planted_callback": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["float32"],
+    },
+    "planted_f64": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["float32"],
+    },
+    "planted_tolerance": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["int32"],
+        "madds": {"engine": "xla", "tolerance_pct": 50.0},
+    },
+    "planted_fill_drift": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["int32"],
+        "madds": {"engine": "xla", "tolerance_pct": 2.0},
+    },
+}
+
+FIXTURE_GRAPHS = {
+    "planted_all_gather": {"build": "build_all_gather"},
+    "planted_callback": {"build": "build_callback"},
+    "planted_f64": {"build": "build_f64", "x64": True},
+    "planted_tolerance": {"build": "build_tolerance", "rung": 127},
+    "planted_fill_drift": {"build": "build_fill_drift", "rung": 127},
+}
+
+
+def build_all_gather():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices("cpu")[:1]), ("dp",))
+
+    def body(x):
+        return jnp.sum(jax.lax.all_gather(x, "dp"), axis=0)
+
+    fn = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P(),
+                   check_rep=False)
+    return fn, (jax.ShapeDtypeStruct((8,), jnp.float32),)
+
+
+def build_callback():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x):
+        return jax.pure_callback(
+            lambda v: v, jax.ShapeDtypeStruct((8,), jnp.float32), x)
+
+    return fn, (jax.ShapeDtypeStruct((8,), jnp.float32),)
+
+
+def build_f64():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x):
+        return (x.astype(jnp.float64) * 2.0).astype(jnp.float32)
+
+    return fn, (jax.ShapeDtypeStruct((8,), jnp.float32),)
+
+
+def _fill_stage(shorten_z_by=0):
+    """A function whose recognizable XLA bucket fills (lengthless-xs
+    scans carrying four (32, L) int32 planes) replay msm_plan's exact
+    grid triple at rung 127 — optionally with the z-fill cut short so
+    the walked count can no longer reconcile."""
+    import jax
+    import jax.numpy as jnp
+    from firedancer_tpu.lint.graphs import expected_fills
+
+    fills = expected_fills(127, "xla")
+    fills[0] = (fills[0][0] - shorten_z_by, fills[0][1])
+
+    def fn(seed):
+        outs = []
+        for rounds, lanes in fills:
+            def round_fn(carry, _):
+                return tuple(c + seed for c in carry), None
+
+            init = tuple(jnp.zeros((32, lanes), jnp.int32)
+                         for _ in range(4))
+            out, _ = jax.lax.scan(round_fn, init, None, length=rounds)
+            outs.append(out)
+        return outs
+
+    return fn, (jax.ShapeDtypeStruct((), jnp.int32),)
+
+
+def build_tolerance():
+    # The fills reconcile EXACTLY — the only defect is the 50% drift
+    # tolerance, far past TOLERANCE_CAP_PCT.
+    return _fill_stage(shorten_z_by=0)
+
+
+def build_fill_drift():
+    # The z-fill runs 10 rounds short of the analytic schedule.
+    return _fill_stage(shorten_z_by=10)

@@ -1,0 +1,108 @@
+"""fdlint pass 7 (graph-audit) MUST-NOT-FLAG fixture.
+
+The clean twins of graphs_bad.py: the same graph shapes with the
+mutation removed (or the contract telling the truth), proving each rule
+fires on the plant and not on the pattern — a shard_map body is fine
+when its contract declares its collectives, f32 compute is fine when
+declared, a benign ALIAS device_put is not a callback violation, and an
+honestly-declared in-cap tolerance passes.
+"""
+
+import numpy as np
+
+
+GRAPH_CONTRACTS = {
+    "honest_all_gather": {
+        "collectives": {"all_gather": 1},
+        "axes": ["dp"],
+        "dtypes": ["float32", "int32"],
+    },
+    "no_callback": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["float32"],
+    },
+    "stays_f32": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["float32"],
+    },
+    "honest_tolerance": {
+        "collectives": {},
+        "axes": [],
+        "dtypes": ["int32"],
+        "madds": {"engine": "xla", "tolerance_pct": 2.0},
+    },
+}
+
+FIXTURE_GRAPHS = {
+    "honest_all_gather": {"build": "build_all_gather"},
+    "no_callback": {"build": "build_no_callback"},
+    "stays_f32": {"build": "build_f32", "x64": True},
+    "honest_tolerance": {"build": "build_tolerance", "rung": 127},
+}
+
+
+def build_all_gather():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices("cpu")[:1]), ("dp",))
+
+    def body(x):
+        return jnp.sum(jax.lax.all_gather(x, "dp"), axis=0)
+
+    fn = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P(),
+                   check_rep=False)
+    return fn, (jax.ShapeDtypeStruct((8,), jnp.float32),)
+
+
+def build_no_callback():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x):
+        # A benign ALIAS device_put (no pinned device) must NOT trip
+        # graph-callback — only host round-trips do.
+        return jax.device_put(x) * 2.0
+
+    return fn, (jax.ShapeDtypeStruct((8,), jnp.float32),)
+
+
+def build_f32():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x):
+        # Traced under x64 like the bad twin, but the compute honestly
+        # stays in the declared f32 lattice.
+        return x * jnp.float32(2.0)
+
+    return fn, (jax.ShapeDtypeStruct((8,), jnp.float32),)
+
+
+def build_tolerance():
+    import jax
+    import jax.numpy as jnp
+    from firedancer_tpu.lint.graphs import expected_fills
+
+    # The bad twin's exact fill stage, un-mutated: the walked madds
+    # replay msm_plan's grid triple to the lane, and the declared
+    # tolerance sits inside the cap — nothing to flag.
+    fills = expected_fills(127, "xla")
+
+    def fn(seed):
+        outs = []
+        for rounds, lanes in fills:
+            def round_fn(carry, _):
+                return tuple(c + seed for c in carry), None
+
+            init = tuple(jnp.zeros((32, lanes), jnp.int32)
+                         for _ in range(4))
+            out, _ = jax.lax.scan(round_fn, init, None, length=rounds)
+            outs.append(out)
+        return outs
+
+    return fn, (jax.ShapeDtypeStruct((), jnp.int32),)

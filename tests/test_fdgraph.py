@@ -1,0 +1,271 @@
+"""fdlint pass 7 (graph-audit) self-tests.
+
+Three tiers, cheapest first:
+
+  * stdlib-only: contract grammar, docs/GRAPHS.md pin, the committed
+    lint_graph_cert.json schema/coverage pin, import-closure gating —
+    no jax, milliseconds.
+  * fixture traces: the five planted mutations each rejected by
+    exactly their rule, the clean twins silent — tiny jaxpr traces,
+    seconds.
+  * the full audit pin (regenerate certify_all and diff against the
+    committed certificate) — the real <60s trace set, @slow, also run
+    by the blocking ci.sh lane.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from firedancer_tpu.lint import graphs
+from firedancer_tpu.lint.graphs import (
+    ALL_RULES,
+    CERT_FILE,
+    GRAPH_PLAN,
+    TOLERANCE_CAP_PCT,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+
+def _fx(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def _committed_cert() -> dict:
+    with open(os.path.join(REPO, CERT_FILE), encoding="utf-8") as f:
+        return json.load(f)
+
+
+# ----------------------------------------------------------- contracts
+
+
+def test_contracts_parse_and_cover_the_plan():
+    contracts = graphs.read_contracts(REPO)
+    planned = {name for name, _, _ in GRAPH_PLAN}
+    assert planned <= set(contracts), (
+        f"missing contracts for {sorted(planned - set(contracts))}")
+    for name, info in contracts.items():
+        c = info["contract"]
+        assert isinstance(c.get("collectives"), dict), name
+        assert isinstance(c.get("axes"), list), name
+        assert isinstance(c.get("dtypes"), list), name
+        forbidden = set(c["dtypes"]) & graphs.FORBIDDEN_DTYPES
+        assert not forbidden, (
+            f"{name} declares never-declarable dtypes {forbidden}")
+        if "madds" in c:
+            assert c["madds"]["tolerance_pct"] <= TOLERANCE_CAP_PCT, name
+
+
+def test_every_derived_graph_has_a_witness():
+    derived = {name for name, kind, _ in GRAPH_PLAN if kind == "derive"}
+    assert derived == set(graphs.DERIVED_WITNESS)
+    for name, w in graphs.DERIVED_WITNESS.items():
+        err, _coll = graphs._wrapper_witness(
+            REPO, w["wrapper"][0], w["wrapper"][1], w["must_call"])
+        assert err is None, f"{name}: {err}"
+
+
+# ------------------------------------------------------ committed cert
+
+
+def test_committed_cert_covers_every_engine_graph_with_zero_waivers():
+    cert = _committed_cert()
+    assert cert["version"] == graphs.CERT_VERSION
+    assert cert["rules"] == list(ALL_RULES)
+    covered = {k.split("@")[0] for k in cert["graphs"]}
+    assert covered == {name for name, _, _ in GRAPH_PLAN}
+    # every entry proved, none waived
+    assert all(g["ok"] for g in cert["graphs"].values())
+    baseline_path = os.path.join(REPO, "lint_baseline.json")
+    with open(baseline_path, encoding="utf-8") as f:
+        baseline = json.load(f)
+    graph_waivers = [e for e in baseline.get("entries", [])
+                     if str(e.get("rule", "")).startswith("graph-")]
+    assert graph_waivers == [], "graph audit must ship with zero waivers"
+
+
+def test_committed_cert_reconciles_msm_cost_at_every_rung():
+    cert = _committed_cert()
+    rungs = cert["rungs"]
+    kernel_keys = {f"msm_stage_kernel@{r}" for r in rungs}
+    assert kernel_keys <= set(cert["graphs"]), (
+        "the production MSM engine must be cost-audited at every rung")
+    for key, g in cert["graphs"].items():
+        if g.get("derived"):
+            continue
+        t = g["traced"]
+        if "drift_pct" in t:
+            tol = g["contract"]["madds"]["tolerance_pct"]
+            assert t["drift_pct"] <= tol, key
+            assert t["fill_madds"] > 0, key
+
+
+def test_committed_cert_matches_declared_contracts():
+    cert = _committed_cert()
+    contracts = graphs.read_contracts(REPO)
+    for key, g in cert["graphs"].items():
+        name = key.split("@")[0]
+        assert g["contract"] == contracts[name]["contract"], key
+
+
+def test_committed_cert_proves_the_collective_story():
+    cert = _committed_cert()
+    rung = cert["audit_rung"]
+    local = cert["graphs"][f"rlc_local@{rung}"]["traced"]
+    assert local["collectives"] == {}
+    assert local["callbacks"] == 0 and local["device_put_pinned"] == 0
+    tail = cert["graphs"][f"pod_tail@{rung}"]["traced"]
+    assert tail["collectives"] == {"all_gather": 1}
+    assert tail["axes"] == ["dp"]
+    assert "float64" not in " ".join(
+        d for g in cert["graphs"].values()
+        for d in g.get("traced", {}).get("dtypes", []))
+
+
+def test_graphs_md_pin():
+    rendered = graphs.render_contracts_markdown(REPO)
+    with open(os.path.join(REPO, "docs", "GRAPHS.md"),
+              encoding="utf-8") as f:
+        committed = f.read()
+    assert committed == rendered, (
+        "docs/GRAPHS.md is stale — regenerate with "
+        "`python scripts/fdlint.py --dump-graph-contracts`")
+
+
+# ------------------------------------------------- artifact stamping
+
+
+def test_graph_cert_stamp_matches_committed_cert():
+    """The graph_cert block bench.py/engine_smoke stamp into artifacts
+    (satellite: bench_log_check behind the schema_version >= 3 gate)
+    must be derived from the committed certificate: its sha is the
+    file hash, its per-rung drift is the cert's msm_stage_kernel
+    drift, and the validator accepts it. Also pins bench_log_check's
+    stdlib-restated cert filename against graphs.CERT_FILE."""
+    import hashlib
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import bench_log_check as blc
+
+    assert blc._GRAPH_CERT_FILE == CERT_FILE
+    stamp = blc.graph_cert_stamp(REPO)
+    assert stamp is not None
+    with open(os.path.join(REPO, CERT_FILE), "rb") as f:
+        assert stamp["sha256"] == hashlib.sha256(f.read()).hexdigest()
+    cert = _committed_cert()
+    assert set(stamp["cost_drift_pct"]) == {str(r) for r in cert["rungs"]}
+    for r in cert["rungs"]:
+        want = cert["graphs"][f"msm_stage_kernel@{r}"]["traced"]["drift_pct"]
+        assert stamp["cost_drift_pct"][str(r)] == want
+    assert blc._validate_graph_cert(stamp, required=True) == []
+    # absent stamp: required only from the fdgraph schema era on
+    assert blc._validate_graph_cert(None, required=True) != []
+    assert blc._validate_graph_cert(None, required=False) == []
+    assert blc.GRAPH_CERT_SCHEMA_VERSION == 3
+
+
+def test_verify_entry_requires_stamp_at_schema_v3():
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import bench_log_check as blc
+
+    rec = {
+        "metric": "ed25519_verify_throughput", "value": 1.0,
+        "unit": "verifies/s", "vs_baseline": 0.001, "mode": "direct",
+        "batch": 256, "reps": 1, "msg_len": 192, "ms_per_batch": 1.0,
+        "device": "cpu", "rlc_fallbacks": 0,
+        "ts": "2026-08-07T00:00:00Z",
+    }
+    # sv2 lines (the whole existing log + fixtures) stay valid unstamped
+    assert blc.validate_entry(dict(rec, schema_version=2)) == []
+    errs = blc.validate_entry(dict(rec, schema_version=3))
+    assert any("graph_cert" in e for e in errs)
+    stamp = blc.graph_cert_stamp(REPO)
+    assert blc.validate_entry(
+        dict(rec, schema_version=3, graph_cert=stamp)) == []
+    # engine artifacts ride the same gate
+    eng = {
+        "metric": "engine_sched_profile", "value": 1.0, "unit": "x",
+        "ok": True, "ladder": [8192], "rung_hist": {"8192": 1},
+        "low_load": {"p99_ns_le_sched": 1, "p99_ns_le_fixed": 2},
+        "saturation": {"throughput_sched": 1.0, "throughput_fixed": 1.0},
+        "ts": "2026-08-07T00:00:00Z",
+    }
+    assert any("graph_cert" in e for e in
+               blc.validate_engine(dict(eng, schema_version=3)))
+    assert blc.validate_engine(
+        dict(eng, schema_version=3, graph_cert=stamp)) == []
+
+
+# ----------------------------------------------------- closure gating
+
+
+def test_import_closure_gates_pass7():
+    closure = graphs.import_closure(REPO)
+    # every contract module and the certificate itself re-trigger
+    for rel in graphs.GRAPH_MODULES:
+        assert rel in closure, rel
+    assert CERT_FILE in closure
+    assert "firedancer_tpu/ops/fe25519.py" in closure  # transitive
+    assert graphs.touches_graphs(REPO, ["firedancer_tpu/ops/msm.py"])
+    assert graphs.touches_graphs(REPO, [CERT_FILE])
+    # edits outside the closure must NOT pay for a re-trace
+    assert not graphs.touches_graphs(REPO, ["docs/LINT.md"])
+    assert not graphs.touches_graphs(REPO, ["scripts/fdlint.py"])
+    assert not graphs.touches_graphs(
+        REPO, ["firedancer_tpu/lint/bounds.py"])
+
+
+# ------------------------------------------------------ cost model
+
+
+def test_expected_madds_matches_msm_plan_analytic():
+    from firedancer_tpu import msm_plan as mp
+
+    for batch in (8192, 16384, 32768):
+        want = round(mp.executed_madds_per_lane(batch) * batch)
+        assert graphs.expected_madds(batch, "kernel") == want
+
+
+# ------------------------------------------------------- fixtures
+
+
+def test_mutations_rejected_by_exactly_their_rule():
+    vs = graphs.check_fixture(_fx("graphs_bad.py"))
+    by_graph = {}
+    for v in vs:
+        by_graph.setdefault(v.key.split("@")[0], set()).add(v.rule)
+    assert by_graph == {
+        "planted_all_gather": {"graph-collective"},
+        "planted_callback": {"graph-callback"},
+        "planted_f64": {"graph-dtype"},
+        "planted_tolerance": {"graph-cost-drift"},
+        "planted_fill_drift": {"graph-cost-drift"},
+    }
+    keys = {v.key for v in vs}
+    # the tolerance widening trips the CAP check, not the drift check
+    assert "planted_tolerance@127:tolerance" in keys
+    assert "planted_fill_drift@127:madds" in keys
+
+
+def test_clean_twins_not_flagged():
+    assert graphs.check_fixture(_fx("graphs_ok.py")) == []
+
+
+# ------------------------------------------------------- full audit
+
+
+@pytest.mark.slow
+def test_full_audit_matches_committed_cert():
+    violations, cert = graphs.certify_all(REPO)
+    assert violations == []
+    assert cert == _committed_cert(), (
+        f"{CERT_FILE} is stale — regenerate with "
+        "`python scripts/fdlint.py --dump-graph-cert`")
