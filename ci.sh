@@ -132,8 +132,9 @@ echo "== fd_sentinel SLO smoke (burn-rate asymmetry + report/ledger) =="
 # latency rule), a seeded hb_stall + credit_starve chaos schedule
 # trips EXACTLY the matching SLOs (fault class <-> SLO name pinned in
 # the flight dump), fd_report ingests the repo's real BENCH_LOG.jsonl
-# + artifact family without error with all twelve ROOFLINE predictions
-# pending, and flight+sentinel overhead stays <= 5% vs both disabled.
+# + artifact family without error with all thirteen ROOFLINE
+# predictions pending, and flight+sentinel overhead stays <= 5% vs both
+# disabled.
 JAX_PLATFORMS=cpu python scripts/slo_smoke.py
 
 echo "== fd_xray smoke (exemplars / waterfall / autopsy / overhead) =="
@@ -232,6 +233,23 @@ echo "== fd_pod smoke (8-device virtual mesh, split-step service) =="
 # aggregate >= 1.04M verifies/s on device) stays pending until a real
 # pod session writes the on_device variant.
 JAX_PLATFORMS=cpu python scripts/pod_smoke.py
+
+echo "== fd_drain smoke (post-verify dedup filter + pack fusion, CPU) =="
+# The round-20 drain gate: the SAME mainnet-shaped corpus replayed
+# FD_DRAIN=off (zero claims, every clean txn exactly-probed) then
+# FD_DRAIN=auto — sink digest multisets bit-exact between the two, the
+# one-sided filter contract live (probe_skips + probed == novel + maybe
+# claims, false_novel == 0 on the TCache tripwire, >= 1 probe provably
+# skipped), zero fd_sentinel alerts with the drain_filter_effectiveness
+# SLO armed; then a write-conflict corpus through the gc scheduler with
+# FD_DRAIN_PACK=1 where every device wave schedule passes
+# ballet.pack.validate_schedule or lands in the exact fallback ledger
+# (blocks_device + fallbacks == blocks), and DRAIN_r01.json validates
+# against bench_log_check's drain schema. Sentinel prediction 13 (the
+# fused device drain >= 1.5x REPLAY_CPU with pack rewards/CU >= CPU
+# greedy at 64k) stays pending until a real device session writes the
+# on_device variant.
+JAX_PLATFORMS=cpu python scripts/drain_smoke.py
 
 echo "== fuzz smoke (10k iters/target) =="
 python fuzz/run_fuzz.py --iters 10000

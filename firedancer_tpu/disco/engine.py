@@ -159,6 +159,22 @@ def parse_key(key: str) -> EngineSpec:
 # --------------------------------------------------------------------------
 
 
+def drain_mode() -> str:
+    """FD_DRAIN resolution: 'auto' arms the device-resident post-verify
+    drain (dedup pre-filter + optional pack coloring fused behind
+    verify) wherever the substrate supports it — the fd_feed staging
+    path plus the ctl-carrying bulk publisher
+    (tango.rings.frag_publish_has_ctl); anywhere else it degrades to
+    exactly the 'off' behavior, never to an error. 'off' disables the
+    drain stage outright (the A/B and bisection hatch). An unknown
+    value raises — a typo'd force must never masquerade as a
+    measurement of either arm."""
+    mode = flags.get_str("FD_DRAIN") or "auto"
+    if mode not in ("auto", "off"):
+        raise ValueError(f"unknown FD_DRAIN {mode!r} (want auto|off)")
+    return mode
+
+
 def default_verify_mode() -> str:
     """Verify-tile mode when the config says 'auto' (round-6 RLC
     promotion): 'rlc' — batch RLC verification over the VMEM Pallas
@@ -272,6 +288,11 @@ class EngineEntry:
         # the cost model can be overlap-aware (combine_tail hides
         # behind the next batch's local_fill when double-buffered).
         "fn_local", "fn_tail", "service_local_ns", "service_tail_ns",
+        # fd_drain post-verify stage (None unless FD_DRAIN armed this
+        # build): the dedup-filter aux graph, dispatched back-to-back
+        # with fn on the same device queue so statuses + novel-mask
+        # come home in one completion.
+        "fn_drain",
     )
 
     def __init__(self, spec: EngineSpec):
@@ -285,6 +306,8 @@ class EngineEntry:
         # FD_POD_SPLIT built this engine as a local/tail pair).
         self.fn_local: Optional[Callable] = None
         self.fn_tail: Optional[Callable] = None
+        # fd_drain aux stage (None unless FD_DRAIN armed this build).
+        self.fn_drain: Optional[Callable] = None
         self.service_local_ns = 0   # EMA: dispatch -> local_fill ready
         self.service_tail_ns = 0    # EMA: local ready -> combine ready
         self.compile_s = 0.0
@@ -413,6 +436,9 @@ class EngineEntry:
             # reader can tell which schedule a service EMA measured
             # even when the spec deferred to the FD_MSM_* flags.
             "msm": self.msm_token,
+            # fd_drain: whether this build attached the post-verify
+            # drain stage (FD_DRAIN at build time).
+            "drain": self.fn_drain is not None,
             "err": self.err,
         }
 
@@ -608,6 +634,14 @@ class EngineRegistry:
             fn = make_async_verifier(direct_fn, rlc_fn=rlc_sharded)
         e.direct_fn = direct_fn
         e.fn = fn
+        # fd_drain: attach the dedup-filter aux graph (built like the
+        # FD_POD_SPLIT pair — a separately-jitted stage the dispatcher
+        # enqueues right behind fn, so the novel-mask rides home in the
+        # same completion sync). Gated at build, like FD_POD_SPLIT.
+        if drain_mode() != "off":
+            from firedancer_tpu.disco import drain as drain_mod
+
+            e.fn_drain = drain_mod.make_filter_fn()
 
     def _warm_locked(self, e: EngineEntry, max_msg_len: int) -> bool:
         """Warm (compile) the engine at (batch, max_msg_len) — caller
@@ -640,6 +674,19 @@ class EngineRegistry:
                 e.fallback_compile_s = time.perf_counter() - t0
                 flight.record_compile(e.key + ":fallback",
                                       e.fallback_compile_s)
+            if e.fn_drain is not None:
+                # fd_drain aux graph: warm at the same batch shape so
+                # the first drain dispatch never compiles mid-run.
+                from firedancer_tpu.ops.dedup_filter import filter_words
+
+                w = filter_words(flags.get_int("FD_DRAIN_FILTER_BITS"))
+                for out in e.fn_drain(
+                        jnp.zeros((e.spec.batch,), jnp.uint32),
+                        jnp.zeros((e.spec.batch,), jnp.uint32),
+                        jnp.zeros((e.spec.batch,), jnp.bool_),
+                        jnp.zeros((w,), jnp.uint32),
+                        jnp.zeros((w,), jnp.uint32)):
+                    np.asarray(out)
         except BaseException as exc:
             e.state = ENGINE_FAILED
             e.err = repr(exc)[:200]

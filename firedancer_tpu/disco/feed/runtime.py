@@ -121,6 +121,13 @@ def verify_tile_stats(v) -> Dict[str, object]:
         "shard_lanes": [sh.get("lanes") for sh in
                         (s.as_dict() for s in v.fl_shards)],
         "shard_balance": 0.0,
+        # fd_drain (round-20): the fused dedup pre-filter's claim split
+        # over published clean txns + window rotations — all zero with
+        # FD_DRAIN=off so artifact consumers see ONE shape either way.
+        "drain_batches": m["drain_batches"],
+        "drain_novel": m["drain_novel"],
+        "drain_maybe": m["drain_maybe"],
+        "drain_rot": m["drain_rot"],
     }
     if st["shard_lanes"]:
         # lo==0 (a starved shard) degrades to max/1 — a huge but

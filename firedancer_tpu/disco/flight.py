@@ -161,6 +161,44 @@ TILE_METRICS: Tuple[Metric, ...] = (
     Metric("quarantine_drop", "counter",
            "datagrams dropped at the socket from quarantined peers "
            "(cooldown window; half-open re-admit after it)"),
+    # fd_drain device-resident post-verify pipeline (disco/drain.py).
+    # Verify-tile rows: the filter aux dispatch + novel/maybe claim
+    # split over PUBLISHED clean txns (CTL_ERR and quarantine-dropped
+    # lanes excluded, so at quiescence drain_novel + drain_maybe ==
+    # drain_probe_skip + drain_probed on the dedup lane).
+    Metric("drain_batches", "counter",
+           "verify batches dispatched with the fused fd_drain dedup "
+           "pre-filter aux graph"),
+    Metric("drain_novel", "counter",
+           "published clean txns the device filter claimed DEFINITELY "
+           "novel (ctl CTL_NOVEL set)"),
+    Metric("drain_maybe", "counter",
+           "published clean txns left maybe-dup (host TCache stays the "
+           "authority)"),
+    Metric("drain_rot", "counter",
+           "fd_drain filter window rotations (bank B <- A after the "
+           "eviction-covering publish quota)"),
+    # Dedup-tile rows: what the novel claims bought downstream.
+    Metric("drain_probe_skip", "counter",
+           "clean frags whose dup verdict came from the device novel "
+           "claim — the TCache probe skipped as decision authority"),
+    Metric("drain_probed", "counter",
+           "clean frags probed against the host TCache (maybe-dup "
+           "lanes)"),
+    Metric("drain_false_novel", "counter",
+           "tripwire: novel claims the TCache contradicted (one-sided "
+           "contract breach; frag dropped as duplicate, ~0 always)"),
+    # Pack-tile rows: device pack_gc wave schedules vs the CPU greedy
+    # oracle. Exact accounting gate: pack_block_device +
+    # pack_sched_fallback == blocks scheduled.
+    Metric("pack_wave_device", "counter",
+           "pack waves published from device pack_gc wave colors"),
+    Metric("pack_block_device", "counter",
+           "pack blocks whose device schedule validated and beat (or "
+           "tied) CPU greedy rewards/CU"),
+    Metric("pack_sched_fallback", "counter",
+           "pack blocks that fell back to the exact CPU greedy "
+           "schedule (validation miss or losing rewards/CU)"),
 )
 
 TILE_IDX: Dict[str, int] = {m.name: i for i, m in enumerate(TILE_METRICS)}
@@ -589,6 +627,11 @@ def verify_stats_view(wksp, label: str, batch: int) -> Optional[dict]:
         "rung_cur": t["rung_cur"],
         "rung_hist": {},
         "rung_ladder": [],
+        # fd_drain: filter claim split over published clean txns.
+        "drain_batches": t["drain_batches"],
+        "drain_novel": t["drain_novel"],
+        "drain_maybe": t["drain_maybe"],
+        "drain_rot": t["drain_rot"],
     }
 
 

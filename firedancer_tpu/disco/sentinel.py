@@ -35,7 +35,7 @@ This module is the judgment layer, in three parts:
                rolling best-of baseline. scripts/fd_report.py renders
                per-mode/per-B/per-stage trend reports from it.
 
-  PREDICTION   the ten ROOFLINE.md falsifiable predictions for the
+  PREDICTION   the thirteen ROOFLINE.md falsifiable predictions for the
   LEDGER       next hardware run (BENCH_r06), each with a MACHINE-
                CHECKABLE match rule over the timeline: the ledger lists
                every prediction as pending until a matching artifact
@@ -83,10 +83,14 @@ class SLO:
     kind: str            # "latency" (edge histogram burn rate) |
                          # "liveness" (progress / heartbeat stall) |
                          # "balance" (per-shard occupancy ratio over
-                         # the fd_pod verify.shardN flight rows)
+                         # the fd_pod verify.shardN flight rows) |
+                         # "effectiveness" (fd_drain definitely-novel
+                         # share of published claims)
     edge_or_stage: str   # edge label (lane variants aggregate in), or
                          # "progress" / "heartbeat" for liveness SLOs,
-                         # or the shard-row suffix for balance SLOs
+                         # or the shard-row suffix for balance SLOs,
+                         # or "drain_claims" for the drain
+                         # effectiveness SLO
     objective: str       # human statement of the objective
     budget_flag: str     # FD_SLO_* flag naming the budget (ms)
     target: float = 0.99       # latency: quantile target (error budget
@@ -133,6 +137,16 @@ SLO_TABLE: Tuple[SLO, ...] = (
         "— a breach means shard placement is starving a device and "
         "aggregate throughput has degraded to the slowest shard",
         "FD_SLO_SHARD_BALANCE_PCT"),
+    SLO("drain_filter_effectiveness", "effectiveness", "drain_claims",
+        "fd_drain dedup pre-filter effectiveness: once the verify "
+        "tiles have published real claim volume, at least "
+        "FD_SLO_DRAIN_EFF_PCT percent of published clean txns must "
+        "carry a definitely-novel claim (drain_novel / (drain_novel + "
+        "drain_maybe)) — a collapse means the filter window is "
+        "undersized or bank rotation is wedged and DedupTile has "
+        "degraded to probing everything (an FD_DRAIN=off run "
+        "publishes no claims and never arms this)",
+        "FD_SLO_DRAIN_EFF_PCT"),
     SLO("pipeline_progress", "liveness", "progress",
         "some pipeline edge advances at least every FD_SLO_STALL_MS "
         "while the run is live (armed after the first frag)",
@@ -163,6 +177,13 @@ MIN_WINDOW_N = 16
 # SLO arms (the first partial batch of a run is structurally lopsided;
 # judging it would cry wolf at every boot).
 MIN_SHARD_LANES = 16
+
+# Minimum published fd_drain claims (novel + maybe) before the filter-
+# effectiveness SLO arms: the first batches of a run publish against
+# empty banks (everything claims novel — fine) but a tiny sample must
+# not grade the window, and an FD_DRAIN=off run (zero claims) must
+# never arm it at all.
+MIN_DRAIN_CLAIMS = 256
 
 # --------------------------------------------------------------------------
 # The ROOFLINE per-stage ms budgets (round-10 >=400k/s gate arithmetic,
@@ -434,6 +455,25 @@ class Sentinel:
                 breach = True
         return breach, worst_milli
 
+    def _eval_drain_eff(self, slo: SLO, now: float) -> Tuple[bool, int]:
+        """fd_drain filter effectiveness over the verify tiles' claim
+        counters (the drain_novel / drain_maybe flight rows, summed
+        across lanes and shards): armed once MIN_DRAIN_CLAIMS claims
+        have published, breaches when the definitely-novel share of
+        published clean txns falls below the budget percentage
+        (FD_SLO_DRAIN_EFF_PCT). Returns (breach, effectiveness in
+        milli — novel per mille of all claims)."""
+        rows = self._metrics_fn() or {}
+        novel = maybe = 0
+        for m in rows.values():
+            novel += int(m.get("drain_novel", 0))
+            maybe += int(m.get("drain_maybe", 0))
+        total = novel + maybe
+        if total < MIN_DRAIN_CLAIMS:
+            return False, 0   # not armed: off-run or early transient
+        pct = self.budgets_ms[slo.name]   # percent, not ms
+        return novel * 100 < pct * total, int(novel * 1000 / total)
+
     def _eval_progress(self, slo: SLO, now: float, cur) -> Tuple[bool, int]:
         total = sum(int(row[1:].sum()) for row in cur.values())
         if self._progress_totals is None or total != self._progress_totals:
@@ -474,6 +514,8 @@ class Sentinel:
                 breach, burn_milli = self._eval_latency(slo, now, cur)
             elif slo.kind == "balance":
                 breach, burn_milli = self._eval_balance(slo, now)
+            elif slo.kind == "effectiveness":
+                breach, burn_milli = self._eval_drain_eff(slo, now)
             elif slo.edge_or_stage == "progress":
                 breach, burn_milli = self._eval_progress(slo, now, cur)
             else:
@@ -638,7 +680,7 @@ def evaluate_edges_summary(edges: Dict[str, dict],
 ARTIFACT_GLOBS = (
     "BENCH_r[0-9]*.json", "REPLAY_r[0-9]*.json", "REPLAY_CPU_r[0-9]*.json",
     "MULTICHIP_r[0-9]*.json", "PACK_r[0-9]*.json", "HOSTFEED_r[0-9]*.json",
-    "SIEGE_r[0-9]*.json", "POD_r[0-9]*.json",
+    "SIEGE_r[0-9]*.json", "POD_r[0-9]*.json", "DRAIN_r[0-9]*.json",
 )
 
 _METRIC_KIND = {
@@ -650,6 +692,7 @@ _METRIC_KIND = {
     "feed_replay_smoke": "feed_smoke",
     "quic_siege_profile": "siege",
     "pod_aggregate_throughput": "pod",
+    "drain_pipeline_throughput": "drain",
     "note": "note",
 }
 
@@ -834,6 +877,38 @@ def pod_status(timeline: List[TimelineEntry]) -> List[dict]:
     return out
 
 
+def drain_status(timeline: List[TimelineEntry]) -> List[dict]:
+    """Every fd_drain artifact (DRAIN_r*.json) with its graded gates:
+    drain on/off digest parity, probe-skip accounting parity (skipped
+    + probed == novel-claims + maybe-dups), device-pack admissibility
+    with exact fallback accounting, zero sentinel alerts.
+    scripts/drain_smoke.py writes the verdicts; fd_report renders this
+    table and prediction 13 grades the on-device rows."""
+    out = []
+    for e in timeline:
+        if e.kind != "drain":
+            continue
+        r = e.rec
+        pack = r.get("pack") or {}
+        out.append({
+            "source": e.source,
+            "ts": e.ts,
+            "value": r.get("value"),
+            "unit": r.get("unit"),
+            "on_device": bool(r.get("on_device")),
+            "ok": bool(r.get("ok")),
+            "digest_parity": bool(r.get("digest_parity")),
+            "probe_skips": r.get("probe_skips"),
+            "false_novel": r.get("false_novel"),
+            "drain_speedup": r.get("drain_speedup"),
+            "pack_blocks_device": pack.get("blocks_device"),
+            "pack_fallbacks": pack.get("fallbacks"),
+            "alert_cnt": r.get("alert_cnt"),
+            "failures": list(r.get("failures") or []),
+        })
+    return out
+
+
 def siege_status(timeline: List[TimelineEntry]) -> List[dict]:
     """Every fd_siege profile artifact (SIEGE_r*.json) with its graded
     gates: zero sentinel burn-rate alerts, shed-accounting parity
@@ -862,7 +937,7 @@ def siege_status(timeline: List[TimelineEntry]) -> List[dict]:
 
 
 # --------------------------------------------------------------------------
-# The prediction ledger: the ten ROOFLINE.md falsifiable predictions,
+# The prediction ledger: the thirteen ROOFLINE.md falsifiable predictions,
 # each with a machine-checkable match rule over the timeline. A rule
 # matches only schema_version >= 2, on-device, non-stale records — the
 # fused-front-end era — so the pre-round-10 history can neither confirm
@@ -1069,6 +1144,36 @@ def _check_p11(timeline):
     return "pending", None, None
 
 
+def _check_p13(timeline):
+    """fd_drain device headline: matches ON-DEVICE drain artifacts
+    only (metric drain_pipeline_throughput, on_device true) that carry
+    BOTH halves of the prediction — the replay speedup over the PR-13
+    host-drain baseline AND the device pack rewards/CU ratio at a
+    >= 65536-txn block. The CPU-backend DRAIN_r* smokes carry
+    on_device: false and can never grade this; a device record missing
+    either half stays pending rather than grading on partial
+    evidence."""
+    for e in timeline:
+        r = e.rec
+        if (r.get("metric") != "drain_pipeline_throughput"
+                or e.schema_version < 2 or not r.get("on_device")):
+            continue
+        speedup = r.get("drain_speedup")
+        pack = r.get("pack") or {}
+        ratio = pack.get("rewards_per_cu_ratio")
+        try:
+            batch = int(pack.get("batch") or 0)
+        except (TypeError, ValueError):
+            continue
+        if speedup is None or ratio is None or batch < 65536:
+            continue   # partial record: keep pending
+        ok = float(speedup) >= 1.5 and float(ratio) >= 1.0
+        return (("confirmed" if ok else "falsified"),
+                f"drain speedup {float(speedup):.2f}x, pack rewards/CU "
+                f"ratio {float(ratio):.2f} @ B={batch}", e.source)
+    return "pending", None, None
+
+
 @dataclass(frozen=True)
 class Prediction:
     pid: int
@@ -1156,6 +1261,16 @@ PREDICTIONS: Tuple[Prediction, ...] = (
                "(unsigned-baseline records never grade this; the "
                "candidate evidence is build/msm_search.json)",
                _check_p12),
+    Prediction(13, "fd_drain device drain lifts the host pipeline",
+               ">= 1.5x REPLAY_CPU throughput over the PR-13 "
+               "host-drain baseline, with device pack schedules "
+               "matching CPU greedy rewards/CU at B=65536",
+               "first sv>=2 drain_pipeline_throughput record with "
+               "on_device: true carrying drain_speedup and "
+               "pack.rewards_per_cu_ratio at pack.batch >= 65536 — "
+               "speedup >= 1.5 AND ratio >= 1.0 (CPU-backend DRAIN_r* "
+               "smokes carry on_device: false and never grade this)",
+               _check_p13),
 )
 
 
@@ -1213,13 +1328,18 @@ def dump_slo_markdown() -> str:
         "occupancy across the `<tile>.shardN` flight rows: armed once",
         "every shard has real volume, breached when the busiest/laziest",
         "ratio exceeds the budget (stated in percent, not ms).",
+        "Effectiveness SLOs (fd_drain) watch the verify tiles'",
+        "published claim counters: armed once real claim volume has",
+        "published (an `FD_DRAIN=off` run publishes none and stays",
+        "silent), breached when the definitely-novel share falls below",
+        "the budget percentage.",
         "",
         "| SLO | kind | edge / stage | budget (default) | target |"
         " trips on (chaos class) | objective |",
         "|---|---|---|---|---|---|---|",
     ]
     for s in SLO_TABLE:
-        unit = "%" if s.kind == "balance" else "ms"
+        unit = "%" if s.kind in ("balance", "effectiveness") else "ms"
         budget = f"`{s.budget_flag}` = {_budget_default_ms(s)} {unit}"
         target = f"p{int(s.target * 100)}" if s.kind == "latency" else "—"
         faults = ", ".join(s.fault_classes) if s.fault_classes else "—"

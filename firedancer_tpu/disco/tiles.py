@@ -808,6 +808,10 @@ class _InflightBatch:
     # EMA the completion feeds.
     rung: int = 0
     entry: object = None
+    # fd_drain: the dedup pre-filter aux dispatch riding the same
+    # round trip — (novel jax.Array, novel_cnt jax.Array) or None when
+    # the drain stage is off / disarmed for this batch.
+    drain: object = None
 
 
 class _ReadyBatch:
@@ -1407,6 +1411,144 @@ class VerifyTile(Tile):
         # registry next to the publish edges.
         if flight.enabled() and flags.get_bool("FD_TRACE_SPANS"):
             self._dwell_span = flight.edge_hist(self.wksp, "verify_drain")
+        self._drain_setup()
+
+    def _drain_setup(self) -> None:
+        """fd_drain arming (feed mode only): the dedup pre-filter graph
+        rides every verify dispatch on the same queue, and its
+        novel-mask (+ pack colors under FD_DRAIN_PACK) travels
+        downstream in the frag ctl word. Disarms silently — behavior
+        then bit-identical to FD_DRAIN=off — when the native .so
+        predates fd_frag_publish_bulk_ctl or jax is unavailable."""
+        from firedancer_tpu.disco import engine as fd_engine
+        from firedancer_tpu.tango import rings
+
+        self._drain = None
+        self._drain_fn = None
+        self._drain_pack_fn = None
+        self._drain_block = 0
+        if fd_engine.drain_mode() == "off" or self.out_link is None:
+            return
+        if not rings.frag_publish_has_ctl():
+            return
+        try:
+            import jax.numpy as jnp
+
+            from firedancer_tpu.disco import drain as drain_mod
+        except Exception:
+            return
+        self._drain_jnp = jnp
+        self._drain_mod = drain_mod
+        quota = flags.get_int("FD_DRAIN_ROT_QUOTA")
+        if quota <= 0:
+            # Auto quota: the disco/drain.py eviction proof with the
+            # DEFAULT downstream tcache depth. Operators running a
+            # deeper dedup tcache must set FD_DRAIN_ROT_QUOTA.
+            quota = drain_mod.rot_quota(
+                4096, self.out_link.mcache.depth, self.batch)
+        self._drain = drain_mod.DrainWindow(
+            flags.get_int("FD_DRAIN_FILTER_BITS"), quota)
+        self._drain_fn = drain_mod.make_filter_fn()
+        if flags.get_bool("FD_DRAIN_PACK"):
+            from firedancer_tpu.ballet.pack import CuEstimator
+            from firedancer_tpu.ops.pack_gc import (
+                H_BITS_DEFAULT,
+                MAX_COLORS_DEFAULT,
+            )
+
+            self._drain_est = CuEstimator()
+            self._drain_pack_fn = drain_mod.make_pack_fn(
+                n_colors=min(MAX_COLORS_DEFAULT,
+                             drain_mod.MAX_CTL_COLORS),
+                h_bits=H_BITS_DEFAULT, cu_cap=12_000_000)
+
+    def _drain_pack_arrays(self, slot):
+        """Hashed account-lock arrays for the FD_DRAIN_PACK coloring
+        graph, straight off the slot's payload sidecar. Unparseable /
+        budget-less rows become lock-free zero-score placeholders (they
+        color freely and their colors are ignored downstream — PackTile
+        re-parses and validates, so a hint here is never authority)."""
+        from firedancer_tpu.ballet.compute_budget import (
+            estimate_rewards_and_compute,
+        )
+        from firedancer_tpu.ballet.pack import PackTxn
+        from firedancer_tpu.ballet.txn import MAX_ACCT_CNT
+        from firedancer_tpu.ops.pack_gc import PackTxnPad, build_arrays
+
+        txns: list = [PackTxnPad] * self.batch
+        for t in range(slot.n_txn):
+            off = int(slot.offs[t])
+            ln = int(slot.plens[t])
+            payload = slot.pay[off:off + ln].tobytes()
+            try:
+                txn = parse_txn(payload)
+            except TxnParseError:
+                continue
+            rce = estimate_rewards_and_compute(
+                txn, payload, lamports_per_signature=5000,
+                estimator=self._drain_est)
+            if rce is None:
+                continue
+            rewards, est_cus, _cu_limit = rce
+            txns[t] = PackTxn(
+                txn_id=t, rewards=rewards, est_cus=est_cus,
+                writable=frozenset(
+                    txn.account(payload, i)
+                    for i in range(txn.acct_cnt) if txn.is_writable(i)),
+                readonly=frozenset(
+                    txn.account(payload, i)
+                    for i in range(txn.acct_cnt)
+                    if not txn.is_writable(i)),
+            )
+        return build_arrays(txns, max_w=MAX_ACCT_CNT, max_r=MAX_ACCT_CNT)
+
+    def _drain_dispatch(self, slot):
+        """Ship the fd_drain aux graph for a staged slot right behind
+        its verify dispatch (same device queue, one completion sync —
+        the PR-13 split-pair discipline). Banks commit immediately: jax
+        chains the still-in-flight bank array, so consecutive batches
+        filter against each other's inserts with no host sync. Returns
+        (novel, colors, block) device handles, or None on any failure —
+        which disarms THIS batch only (no claims = all maybe-dup =
+        exactly the drain-off behavior) and resets the window to empty
+        banks (safe: emptier banks only widen maybe-dup)."""
+        jnp = self._drain_jnp
+        drain_mod = self._drain_mod
+        try:
+            from firedancer_tpu.ops.dedup_filter import split_tags
+
+            hi, lo = split_tags(slot.psigs)
+            valid = np.zeros(self.batch, np.bool_)
+            valid[: slot.n_txn] = True
+            bits_a, bits_b = self._drain.banks()
+            colors = None
+            block = 0
+            if self._drain_pack_fn is not None:
+                w_idx, r_idx, scores, cus = self._drain_pack_arrays(slot)
+                novel, bits_new, _cnt, colors = self._drain_pack_fn(
+                    jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(valid),
+                    bits_a, bits_b, jnp.asarray(w_idx),
+                    jnp.asarray(r_idx), jnp.asarray(scores),
+                    jnp.asarray(cus))
+                block = self._drain_block
+                self._drain_block = (self._drain_block + 1) \
+                    % (drain_mod.CTL_BLOCK_MASK + 1)
+            else:
+                novel, bits_new, _cnt = self._drain_fn(
+                    jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(valid),
+                    bits_a, bits_b)
+            self._drain.commit(bits_new)
+            return novel, colors, block
+        except Exception:
+            # A failed aux dispatch may have poisoned the chained bank
+            # array: start a fresh window (empty banks claim nothing,
+            # so the one-sided contract holds trivially).
+            try:
+                self._drain = drain_mod.DrainWindow(
+                    self._drain.h_bits, self._drain.rot_quota)
+            except Exception:
+                self._drain = None  # jax gone entirely: stay disarmed
+            return None
 
     def _nd_account(self, il) -> bool:
         """Fold one native drain round's counter deltas into the diag
@@ -1902,11 +2044,21 @@ class VerifyTile(Tile):
             self.flightrec.record("cpu_failover", lanes=slot.n_lane)
             if fault_cls is not None and c is not None:
                 c.note(fault_cls, "healed")
+        # fd_drain: the dedup pre-filter (+ optional pack coloring) aux
+        # dispatch rides the same round trip — even behind a CPU
+        # failover verify, the filter verdict is orthogonal to the
+        # verify result.
+        drain_out = None
+        if self._drain is not None and slot.n_txn:
+            drain_out = self._drain_dispatch(slot)
+            if drain_out is not None:
+                self.fl.inc("drain_batches")
         self._inflight.append(_InflightBatch(
             out=out, todo=[], oversize=[False] * self.batch,
             t_dispatch=tempo.tickcount(), slot=slot, device=via_device,
             rung=rung if self.rung_sched is not None else 0,
             entry=entry if via_device else None,
+            drain=drain_out,
         ))
         self.fl.inc("batches")
         self.fl.inc("lanes", slot.n_lane)
@@ -2026,7 +2178,8 @@ class VerifyTile(Tile):
         self.fl.inc("quarantine_err_txn")
 
     def _publish_feed_batch(self, slot, statuses,
-                            quarantined: bool = False) -> int:
+                            quarantined: bool = False,
+                            drain=None) -> int:
         """Completion half of the feeder: fold per-lane statuses to
         per-txn verdicts (numpy reduceat over the slot's lane counts)
         and publish every passing, non-HA-duplicate txn downstream with
@@ -2067,6 +2220,25 @@ class VerifyTile(Tile):
         n_ok = int(ok.sum())
         if not n_ok:
             return slot.drain_end
+        # fd_drain claims: fetch the aux graph's novel-mask (+ colors)
+        # at completion — dispatched alongside the verify, so this is a
+        # ready device array, not a sync. Any fetch failure simply
+        # publishes claim-free (all maybe-dup — the off behavior).
+        novel_t = None
+        colors_t = None
+        ctls = None
+        block = 0
+        if drain is not None:
+            try:
+                novel_dev, colors_dev, block = drain
+                novel_t = np.asarray(novel_dev)[:n]
+                if colors_dev is not None:
+                    colors_t = np.asarray(colors_dev)[:n].astype(np.int32)
+                ctls = self._drain_mod.encode_ctl(
+                    CTL_SOM_EOM, novel_t, colors_t, block)
+            except Exception:
+                novel_t = None
+                ctls = None
         mask8 = ok.astype(np.uint8)
         ol = self.out_link
         ct = self._nd_ct
@@ -2077,6 +2249,8 @@ class VerifyTile(Tile):
         now32 = tempo.tickcount() & 0xFFFFFFFF
         published = 0
         halted = False
+        novel_pub = 0
+        maybe_pub = 0
         while published < n_ok and not halted:
             # Credit-windowed bulk publish: same fctl discipline as
             # publish_backp (spin through backpressure, drop on HALT),
@@ -2094,23 +2268,54 @@ class VerifyTile(Tile):
                 ol.xq_tx.add_stall(tempo.tickcount() - t_stall)
             if halted:
                 break
-            pub = self._nd_lib.fd_frag_publish_bulk(
-                ol.mcache._mem, ct.addressof(ol.dcache._buf),
-                ol.dcache.chunk_cnt, ol.mtu,
-                ct.byref(seqv), ct.byref(chunkv),
-                slot.pay.ctypes.data,
-                slot.offs.ctypes.data, slot.plens.ctypes.data,
-                slot.psigs.ctypes.data, slot.tsorigs.ctypes.data,
-                mask8.ctypes.data, ct.byref(cursor), n,
-                min(ol.cr_avail, n_ok - published), now32,
-                bytes_out.ctypes.data,
-            )
+            cur0 = cursor.value
+            if ctls is not None:
+                pub = self._nd_lib.fd_frag_publish_bulk_ctl(
+                    ol.mcache._mem, ct.addressof(ol.dcache._buf),
+                    ol.dcache.chunk_cnt, ol.mtu,
+                    ct.byref(seqv), ct.byref(chunkv),
+                    slot.pay.ctypes.data,
+                    slot.offs.ctypes.data, slot.plens.ctypes.data,
+                    slot.psigs.ctypes.data, slot.tsorigs.ctypes.data,
+                    ctls.ctypes.data,
+                    mask8.ctypes.data, ct.byref(cursor), n,
+                    min(ol.cr_avail, n_ok - published), now32,
+                    bytes_out.ctypes.data,
+                )
+            else:
+                pub = self._nd_lib.fd_frag_publish_bulk(
+                    ol.mcache._mem, ct.addressof(ol.dcache._buf),
+                    ol.dcache.chunk_cnt, ol.mtu,
+                    ct.byref(seqv), ct.byref(chunkv),
+                    slot.pay.ctypes.data,
+                    slot.offs.ctypes.data, slot.plens.ctypes.data,
+                    slot.psigs.ctypes.data, slot.tsorigs.ctypes.data,
+                    mask8.ctypes.data, ct.byref(cursor), n,
+                    min(ol.cr_avail, n_ok - published), now32,
+                    bytes_out.ctypes.data,
+                )
             ol.seq = seqv.value
             ol.chunk = chunkv.value
             ol.cr_avail = max(0, ol.cr_avail - pub)
             published += pub
+            if novel_t is not None:
+                # Per-window claim accounting over the cursor range the
+                # C call actually examined: only mask-selected lanes in
+                # [cur0, cursor) were published (HALT-dropped tails
+                # never count — the rotation quota is over PUBLISHES).
+                w = slice(cur0, cursor.value)
+                novel_pub += int((novel_t[w] & ok[w]).sum())
+                maybe_pub += int((~novel_t[w] & ok[w]).sum())
             if pub <= 0:
                 break  # defensive: cursor exhausted without publishes
+        if novel_t is not None:
+            self.fl.inc("drain_novel", novel_pub)
+            self.fl.inc("drain_maybe", maybe_pub)
+            if self._drain is not None:
+                self._drain.note_published(novel_pub)
+                if self._drain.maybe_rotate(
+                        blocked=chaos.active() is not None):
+                    self.fl.inc("drain_rot")
         il = self.in_link
         il.fseq.diag_add(DIAG_PUB_CNT, published)
         il.fseq.diag_add(DIAG_PUB_SZ, int(bytes_out[0]))
@@ -2626,9 +2831,13 @@ class VerifyTile(Tile):
                         tempo.tickcount() - ib.t_dispatch)
             if ib.slot is not None:
                 # fd_feed batch: verdicts + publishes straight off the
-                # slot's sidecar arrays (one bulk native call).
+                # slot's sidecar arrays (one bulk native call). A
+                # quarantined batch publishes claim-free: its drain aux
+                # dispatch shares the poisoned queue, so its claims are
+                # untrusted too (all maybe-dup = exact downstream).
                 batch_ack = self._publish_feed_batch(
-                    ib.slot, statuses, quarantined=quarantined)
+                    ib.slot, statuses, quarantined=quarantined,
+                    drain=None if quarantined else ib.drain)
             else:
                 off = 0
                 batch_ack = 0
@@ -2701,6 +2910,14 @@ class DedupTile(Tile):
         super().__init__(wksp, cnc_name, in_link=in_link, out_link=out_link,
                          in_links=in_links, **kw)
         self.tcache = TCache(tcache_depth)
+        # fd_drain consumption: device novel claims arrive in the ctl
+        # word (CTL_NOVEL); a claimed frag's dup verdict is owed to the
+        # filter (probe skip), the map lookup downgrades to a contract
+        # tripwire, and the claim bit is stripped before forwarding
+        # (pack color/block bits pass through untouched). The lane rows
+        # here are the dedup half of the smoke's probe parity gate:
+        # drain_probe_skip + drain_probed == verify's novel + maybe.
+        self.fl = flight.tile_lane(wksp, self.flight_label)
 
     def poll_inputs(self):
         if Tile._bulk_ok is None:
@@ -2759,9 +2976,29 @@ class DedupTile(Tile):
         # the valid same-sig txn out of the dedup window — so only the
         # clean frags' sigs enter the batched membership test.
         clean = ~err
+        novel = np.zeros(n, np.bool_)
+        if st["has_ctl"]:
+            from firedancer_tpu.disco.drain import CTL_NOVEL
+
+            novel = ((st["ctls"][:n] & CTL_NOVEL) != 0) & clean
         dup = np.zeros(n, np.bool_)
         if clean.any():
-            dup[clean] = self.tcache.insert_batch(sigs[clean])
+            fn0 = self.tcache.false_novel_cnt
+            dup[clean] = self.tcache.insert_batch(
+                sigs[clean],
+                novel=novel[clean] if novel.any() else None)
+            n_novel = int(novel.sum())
+            if n_novel:
+                self.fl.inc("drain_probe_skip", n_novel)
+            self.fl.inc("drain_probed", int(clean.sum()) - n_novel)
+            d_fn = self.tcache.false_novel_cnt - fn0
+            if d_fn:
+                # One-sided contract breach: ledger it loudly (the
+                # offending frags already got the exact dup verdict, so
+                # correctness held — but a nonzero here means the
+                # filter/rotation proof is broken upstream).
+                self.fl.inc("drain_false_novel", d_fn)
+                self.flightrec.record("drain_false_novel", n=d_fn)
         filt = err | dup
         n_filt = int(filt.sum())
         if n_filt:
@@ -2780,8 +3017,20 @@ class DedupTile(Tile):
                     il.xq.observe_dwell((now32 - tspub) & 0xFFFFFFFF)
         mask8 = (~filt).astype(np.uint8)
         n_ok = int(mask8.sum())
+        self.fl.publish()
         if not n_ok:
             return
+        # Forward ctl: strip the consumed NOVEL claim, pass the pack
+        # color/block hints through to PackTile. Needs the ctl-capable
+        # bulk publisher; without it the plain publisher writes ctl=3
+        # (colors lost -> PackTile schedules those txns itself — safe).
+        ctls_fwd = None
+        if st["has_ctl"]:
+            from firedancer_tpu.disco.drain import CTL_NOVEL
+            from firedancer_tpu.tango.rings import frag_publish_has_ctl
+
+            if frag_publish_has_ctl():
+                ctls_fwd = st["ctls"][:n] & np.uint16(0xFFFF ^ CTL_NOVEL)
         ol = self.out_link
         ct = st["ct"]
         seqv = ct.c_uint64(ol.seq)
@@ -2808,17 +3057,31 @@ class DedupTile(Tile):
                 ol.xq_tx.add_stall(tempo.tickcount() - t_stall)
             if halted:
                 break
-            pub = st["lib"].fd_frag_publish_bulk(
-                ol.mcache._mem, ct.addressof(ol.dcache._buf),
-                ol.dcache.chunk_cnt, ol.mtu,
-                ct.byref(seqv), ct.byref(chunkv),
-                st["pay"].ctypes.data,
-                st["offs"].ctypes.data, st["lens"].ctypes.data,
-                st["sigs"].ctypes.data, st["ts"].ctypes.data,
-                mask8.ctypes.data, ct.byref(cursor), n,
-                min(ol.cr_avail, n_ok - published), now32,
-                bytes_out.ctypes.data,
-            )
+            if ctls_fwd is not None:
+                pub = st["lib"].fd_frag_publish_bulk_ctl(
+                    ol.mcache._mem, ct.addressof(ol.dcache._buf),
+                    ol.dcache.chunk_cnt, ol.mtu,
+                    ct.byref(seqv), ct.byref(chunkv),
+                    st["pay"].ctypes.data,
+                    st["offs"].ctypes.data, st["lens"].ctypes.data,
+                    st["sigs"].ctypes.data, st["ts"].ctypes.data,
+                    ctls_fwd.ctypes.data,
+                    mask8.ctypes.data, ct.byref(cursor), n,
+                    min(ol.cr_avail, n_ok - published), now32,
+                    bytes_out.ctypes.data,
+                )
+            else:
+                pub = st["lib"].fd_frag_publish_bulk(
+                    ol.mcache._mem, ct.addressof(ol.dcache._buf),
+                    ol.dcache.chunk_cnt, ol.mtu,
+                    ct.byref(seqv), ct.byref(chunkv),
+                    st["pay"].ctypes.data,
+                    st["offs"].ctypes.data, st["lens"].ctypes.data,
+                    st["sigs"].ctypes.data, st["ts"].ctypes.data,
+                    mask8.ctypes.data, ct.byref(cursor), n,
+                    min(ol.cr_avail, n_ok - published), now32,
+                    bytes_out.ctypes.data,
+                )
             ol.seq = seqv.value
             ol.chunk = chunkv.value
             ol.cr_avail = max(0, ol.cr_avail - pub)
@@ -2837,6 +3100,8 @@ class DedupTile(Tile):
             ol.lat_sample_many(lats, ts)
 
     def on_frag(self, frag: Frag, payload: bytes) -> None:
+        from firedancer_tpu.disco.drain import CTL_NOVEL
+
         if frag.ctl & CTL_ERR:
             # Quarantine audit frags (verify's CTL_ERR offenders) end
             # here: counted + dropped BEFORE the tcache insert — a
@@ -2845,6 +3110,21 @@ class DedupTile(Tile):
             self.in_cur.fseq.diag_add(DIAG_FILT_CNT, 1)
             self.in_cur.fseq.diag_add(DIAG_FILT_SZ, frag.sz)
             return
+        if frag.ctl & CTL_NOVEL:
+            # fd_drain claim on the per-frag path: verdict owed to the
+            # device filter; the insert keeps exact ring order and the
+            # tripwire restores the dup verdict on a contract breach.
+            self.fl.inc("drain_probe_skip")
+            breach = self.tcache.insert_novel_batch([frag.sig])
+            if not breach[0]:
+                self.publish_backp(payload, frag.sig, tsorig=frag.tsorig)
+                return
+            self.fl.inc("drain_false_novel")
+            self.flightrec.record("drain_false_novel", n=1)
+            self.in_cur.fseq.diag_add(DIAG_FILT_CNT, 1)
+            self.in_cur.fseq.diag_add(DIAG_FILT_SZ, frag.sz)
+            return
+        self.fl.inc("drain_probed")
         if self.tcache.insert(frag.sig):
             self.in_cur.fseq.diag_add(DIAG_FILT_CNT, 1)
             self.in_cur.fseq.diag_add(DIAG_FILT_SZ, frag.sz)
@@ -2881,6 +3161,16 @@ class PackTile(Tile):
         self._payloads: dict = {}
         self._tsorig: dict = {}
         self._rr_bank = 0
+        # fd_drain device wave schedules: txns arriving with a ctl
+        # color hint accumulate per device block id and publish as the
+        # device's waves once the block closes — IF the block passes
+        # ballet.pack.validate_schedule AND beats CPU greedy rewards/CU
+        # (else exact ledgered fallback to the greedy waves). The lane
+        # rows carry the accounting gate: pack_block_device +
+        # pack_sched_fallback == blocks scheduled.
+        self._dev_block: list = []          # [(color, PackTxn)]
+        self._dev_block_id: Optional[int] = None
+        self.fl = flight.tile_lane(wksp, self.flight_label)
 
     def on_frag(self, frag: Frag, payload: bytes) -> None:
         from firedancer_tpu.ballet.pack import PackTxn
@@ -2933,6 +3223,23 @@ class PackTile(Tile):
         self._payloads[tid] = payload
         self._tsorig[tid] = frag.tsorig
         if self.scheduler == "gc":
+            from firedancer_tpu.disco.drain import ctl_block, ctl_color
+
+            color = ctl_color(frag.ctl)
+            if color >= 0:
+                # fd_drain device color: collect into the current
+                # device block (block id changes close the previous
+                # one — frags arrive in publish order, so a block's
+                # txns are contiguous).
+                blk = ctl_block(frag.ctl)
+                if self._dev_block_id is not None \
+                        and blk != self._dev_block_id:
+                    self._close_dev_block()
+                self._dev_block_id = blk
+                self._dev_block.append((color, pt))
+                if len(self._dev_block) >= self.gc_block:
+                    self._close_dev_block()
+                return
             self._gc_pending.append(pt)
             if len(self._gc_pending) >= self.gc_block:
                 self._drain_gc()
@@ -2942,6 +3249,8 @@ class PackTile(Tile):
 
     def on_idle(self) -> None:
         if self.scheduler == "gc":
+            if self._dev_block:
+                self._close_dev_block()
             if self._gc_pending:
                 self._drain_gc()
             return
@@ -2968,6 +3277,61 @@ class PackTile(Tile):
         waves, leftover = schedule_block(
             txns, pad_to=self.gc_block,
             max_w=MAX_ACCT_CNT, max_r=MAX_ACCT_CNT)
+        waves, leftover = self._gate_device_waves(txns, waves, leftover)
+        self._publish_waves(waves)
+        # CU-capped leftovers stay pending; the next round has fresh wave
+        # budgets, so the set strictly shrinks (unschedulably large txns
+        # were rejected at insert time).
+        self._gc_pending = list(leftover)
+        self.fl.publish()
+
+    def _gate_device_waves(self, txns, dev_waves, dev_left):
+        """The fd_drain schedule gate: a device-emitted wave schedule
+        publishes only if it is ADMISSIBLE (ballet.pack.
+        validate_schedule — the exact lock-set authority, immune to the
+        device's hash collisions) and at least matches the CPU greedy
+        baseline on rewards/CU; otherwise the block falls back to the
+        greedy waves with exact accounting (pack_block_device +
+        pack_sched_fallback == blocks)."""
+        from firedancer_tpu.ballet.pack import validate_schedule
+        from firedancer_tpu.disco import drain as drain_mod
+        from firedancer_tpu.ops.pack_gc import MAX_COLORS_DEFAULT
+
+        cpu_waves, cpu_left = drain_mod.greedy_waves(
+            txns, MAX_COLORS_DEFAULT, 12_000_000)
+        if validate_schedule(dev_waves) and drain_mod.device_beats_greedy(
+                dev_waves, dev_left, cpu_waves, cpu_left):
+            self.fl.inc("pack_block_device")
+            self.fl.inc("pack_wave_device", len(dev_waves))
+            return dev_waves, dev_left
+        self.fl.inc("pack_sched_fallback")
+        self.flightrec.record("pack_sched_fallback",
+                              txns=len(txns), waves=len(dev_waves))
+        return cpu_waves, cpu_left
+
+    def _close_dev_block(self) -> None:
+        """Close the current fd_drain device block: reassemble its ctl
+        colors into waves, gate them exactly like a locally-scheduled
+        block, and publish. Subset safety: a block's waves were colored
+        over the whole verify batch, and any subset of an admissible
+        wave is still admissible (locks and CU only shrink) — but the
+        gate re-validates the arrived subset anyway, never the hint."""
+        entries = self._dev_block
+        self._dev_block = []
+        self._dev_block_id = None
+        if not entries:
+            return
+        waves_map: dict = {}
+        for color, pt in entries:
+            waves_map.setdefault(color, []).append(pt)
+        dev_waves = [waves_map[c] for c in sorted(waves_map)]
+        txns = [pt for _color, pt in entries]
+        waves, leftover = self._gate_device_waves(txns, dev_waves, [])
+        self._publish_waves(waves)
+        self._gc_pending.extend(leftover)
+        self.fl.publish()
+
+    def _publish_waves(self, waves) -> None:
         for wave in waves:
             for txn in wave:
                 # Persistent round-robin: within a wave txns may run in
@@ -2980,10 +3344,6 @@ class PackTile(Tile):
                 sig = (bank << 48) | (txn.txn_id & 0xFFFFFFFFFFFF)
                 self.publish_backp(payload, sig, count_diag=False,
                                    tsorig=self._tsorig.pop(txn.txn_id, 0))
-        # CU-capped leftovers stay pending; the next round has fresh wave
-        # budgets, so the set strictly shrinks (unschedulably large txns
-        # were rejected at insert time).
-        self._gc_pending = list(leftover)
 
     def _drain(self) -> None:
         """Schedule as many non-conflicting txns as possible, rotating

@@ -288,6 +288,45 @@ _register(
     "Read at engine build, not inside traced code.",
 )
 _register(
+    "FD_DRAIN", str, "auto",
+    "fd_drain device-resident post-verify pipeline: 'auto' dispatches "
+    "the dedup pre-filter graph back-to-back with every feed verify "
+    "batch (novel-mask + optional pack colors ride home in the same "
+    "completion and travel downstream in the frag ctl word via "
+    "fd_frag_publish_bulk_ctl); 'off' is the bisection hatch — the "
+    "pipeline is then bit-identical to pre-drain. Silently disarms "
+    "(== off) when the native .so predates the ctl bulk publisher. "
+    "Read at engine build / tile construction, not inside traced code.",
+)
+_register(
+    "FD_DRAIN_FILTER_BITS", int, 131072,
+    "fd_drain filter bank size (buckets per bank; power of two). Two "
+    "banks of h_bits/8 device bytes each; larger banks lower the "
+    "false-maybe (hash collision) rate and so raise the probe-skip "
+    "fraction. 131072 holds ~2 full default TCache windows at <6% "
+    "collision occupancy.",
+)
+_register(
+    "FD_DRAIN_ROT_QUOTA", int, 0,
+    "fd_drain filter rotation quota: confirmed-novel PUBLISHES before "
+    "the window rotates (bank B dropped). 0 = auto: downstream tcache "
+    "depth assumed 4096 + out-ring depth + batch (the disco/drain.py "
+    "eviction proof). Set explicitly when the dedup tile runs a "
+    "non-default tcache_depth — the quota must be >= its depth plus "
+    "in-flight frags or rotation breaks the one-sided contract.",
+)
+_register(
+    "FD_DRAIN_PACK", bool, False,
+    "fd_drain pack fusion: also run the pack_gc wave-coloring graph in "
+    "the drain dispatch (account indices hashed host-side at dispatch) "
+    "and carry wave colors + block ids downstream in the ctl word. "
+    "PackTile validates every device block (ballet.pack."
+    "validate_schedule) and compares rewards/CU against CPU greedy, "
+    "falling back with exact accounting — colors are hints, never "
+    "authority. Off by default: the dispatch-side account parse costs "
+    "host CPU per txn.",
+)
+_register(
     "FD_POD_INFLIGHT", int, 2,
     "fd_pod dispatcher depth: how many (local_fill, combine_tail) "
     "batch pairs may be in flight before the pod service blocks on "
@@ -771,6 +810,15 @@ _register(
     "is starving a device — aggregate throughput degrades to the "
     "slowest shard's. Evaluated over the per-shard flight rows "
     "(verify.shardN), so it works cross-process like every other SLO.",
+)
+_register(
+    "FD_SLO_DRAIN_EFF_PCT", int, 10,
+    "fd_drain filter-effectiveness budget, percent: with the drain "
+    "stage armed and real volume through it, at least this fraction "
+    "of published clean txns must carry a definitely-novel claim "
+    "(drain_novel / (drain_novel + drain_maybe) x100). A breach means "
+    "the filter is paying its dispatch cost without skipping probes — "
+    "banks too small for the tag rate, or rotation starved.",
 )
 # --------------------------------------------------------------------------
 # fd_xray — tail-sampled exemplar traces, per-edge queue attribution,

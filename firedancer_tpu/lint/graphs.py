@@ -89,6 +89,7 @@ CONTRACT_MODULES = (
     "firedancer_tpu/disco/engine.py",
     "firedancer_tpu/ops/verify_rlc.py",
     "firedancer_tpu/ops/msm.py",
+    "firedancer_tpu/ops/dedup_filter.py",
 )
 
 #: Import-closure seeds: a git-touched file reachable from these makes
@@ -99,6 +100,7 @@ GRAPH_MODULES = CONTRACT_MODULES + (
     "firedancer_tpu/parallel/mesh.py",
     "firedancer_tpu/msm_plan.py",
     "firedancer_tpu/lint/graphs.py",
+    "firedancer_tpu/disco/drain.py",
 )
 
 # ------------------------------------------------------------------ #
@@ -174,6 +176,12 @@ GRAPH_PLAN = (
     ("pod_local", "derive", "audit"),
     ("rlc_sharded", "derive", "audit"),
     ("direct_sharded", "derive", "audit"),
+    # fd_drain: the dedup pre-filter round is traced standalone; the
+    # fused verify+filter drain step is a witnessed derivation over the
+    # traced `direct` verify graph and `drain_filter` (both
+    # collective-free, so the fused step is provably so too).
+    ("drain_filter", "trace", "audit"),
+    ("drain_pair", "derive", "audit"),
 )
 
 #: Composition witnesses for the derived graphs: the wrapper function
@@ -207,6 +215,12 @@ DERIVED_WITNESS = {
                     "verify_step_sharded"),
         "must_call": ("verify_batch",),
         "wrapper_collectives": {"psum": 3},
+    },
+    "drain_pair": {
+        "from": ("direct", "drain_filter"),
+        "wrapper": ("firedancer_tpu/disco/drain.py", "drain_pair"),
+        "must_call": ("verify_batch", "dedup_filter"),
+        "wrapper_collectives": {},
     },
 }
 
@@ -601,6 +615,17 @@ def _builders(jax, rung: int, shards: int, plan):
             parts_shapes("xla"))
         return combine8, (shapes,)
 
+    def drain_filter():
+        # The fd_drain dedup pre-filter round at its default window
+        # size: the batch dimension rides the rung ladder (it is the
+        # feed batch), the bank width is FD_DRAIN_FILTER_BITS-static.
+        from firedancer_tpu.ops import dedup_filter as df
+        w = df.filter_words(df.DEFAULT_FILTER_BITS)
+        return df.dedup_filter, (
+            sds((rung,), jnp.uint32), sds((rung,), jnp.uint32),
+            sds((rung,), jnp.bool_),
+            sds((w,), jnp.uint32), sds((w,), jnp.uint32))
+
     return {
         "direct": lambda: (verify_mod.verify_batch, direct_args),
         "frontend": lambda: (
@@ -617,6 +642,7 @@ def _builders(jax, rung: int, shards: int, plan):
                                 (parts_shapes("interpret"),)),
         "msm_stage_xla": lambda: (xla_stage, stage_args),
         "msm_stage_kernel": lambda: (kernel_stage, stage_args),
+        "drain_filter": drain_filter,
     }
 
 

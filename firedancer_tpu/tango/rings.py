@@ -166,6 +166,27 @@ def load_lib() -> ctypes.CDLL:
             ctypes.c_uint32,                                # now32
             ctypes.c_void_p,                                # bytes_out
         ]
+    if hasattr(lib, "fd_frag_publish_bulk_ctl"):
+        # Current ABI: the bulk publisher grew a per-frag ctl variant
+        # (fd_drain rides novel/color/block hints in the ctl word). A
+        # stale .so keeps the ctl-less publisher only; callers probe
+        # frag_publish_has_ctl() and fall back to the hardwired-ctl
+        # call, exactly the pre-drain behavior.
+        lib.fd_frag_publish_bulk_ctl.restype = ctypes.c_int
+        lib.fd_frag_publish_bulk_ctl.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,               # mcache, dcache
+            ctypes.c_uint32, ctypes.c_uint32,               # chunks, mtu
+            ctypes.POINTER(ctypes.c_uint64),                # seq_io
+            ctypes.POINTER(ctypes.c_uint32),                # chunk_io
+            ctypes.c_void_p, ctypes.c_void_p,               # payloads, offs
+            ctypes.c_void_p, ctypes.c_void_p,               # lens, sigs
+            ctypes.c_void_p, ctypes.c_void_p,               # tsorigs, ctls
+            ctypes.c_void_p,                                # mask
+            ctypes.POINTER(ctypes.c_uint32),                # txn_io
+            ctypes.c_uint32, ctypes.c_uint32,               # n_txn, max_pub
+            ctypes.c_uint32,                                # now32
+            ctypes.c_void_p,                                # bytes_out
+        ]
     if hasattr(lib, "fd_frag_drain"):  # absent in a stale build
         lib.fd_frag_drain.restype = ctypes.c_int
         argt = [
@@ -284,6 +305,18 @@ def frag_drain_has_tspub() -> bool:
     telemetry degrades, nothing corrupts)."""
     try:
         return hasattr(lib(), "fd_frag_drain_has_tspub")
+    except Exception:
+        return False
+
+
+def frag_publish_has_ctl() -> bool:
+    """True when the bulk publisher carries a per-frag ctl word
+    (current ABI) — the fd_drain transport for novel/color/block hints.
+    A stale .so keeps the ctl-less publisher; the drain then claims
+    nothing (every frag goes maybe-dup, PackTile keeps CPU greedy) and
+    behavior is bit-identical to FD_DRAIN=off."""
+    try:
+        return hasattr(lib(), "fd_frag_publish_bulk_ctl")
     except Exception:
         return False
 

@@ -19,6 +19,9 @@ class TCache:
         self._map: set[int] = set()
         self.hit_cnt = 0
         self.miss_cnt = 0
+        # fd_drain tripwire ledger: lanes a device pre-filter claimed
+        # DEFINITELY novel that the authoritative map contradicted.
+        self.false_novel_cnt = 0
 
     def insert(self, tag: int) -> bool:
         """Returns True if tag was a duplicate (already among last depth)."""
@@ -34,12 +37,25 @@ class TCache:
         self._map.add(tag)
         return False
 
-    def insert_batch(self, tags) -> "object":
+    def insert_batch(self, tags, novel=None) -> "object":
         """Vectorized insert over a drain round's tag array: returns a
         numpy bool array, True where the tag was a duplicate —
         BIT-IDENTICAL to calling insert() per tag in order (the bulk
         dedup paths are gated on content parity with the per-frag
         loop).
+
+        ``novel`` (optional bool array, same length) marks lanes a
+        one-sided device pre-filter (fd_drain's dedup_filter) already
+        ruled DEFINITELY novel: their dup verdict is owed to the
+        filter, not this map, so the caller ledgers them as probe
+        skips. The map lookup still runs for those lanes — but as the
+        contract TRIPWIRE, not the decision authority: a novel claim
+        the map contradicts increments ``false_novel_cnt`` and keeps
+        the exact (duplicate → dropped) verdict, so a violated filter
+        contract is observable and harmless rather than silently
+        double-inserting a member (which would leave a stale map entry
+        behind at eviction). Verdicts are therefore bit-identical with
+        and without ``novel``.
 
         Fast path: one np.unique collapses in-batch repeats, membership
         is probed once per unique tag, and the verdict scatters back
@@ -69,6 +85,9 @@ class TCache:
         if window & probe or n >= self.depth:
             for i, t in enumerate(tags.tolist()):
                 out[i] = self.insert(int(t))
+            if novel is not None:
+                self.false_novel_cnt += int(
+                    (np.asarray(novel, np.bool_) & out).sum())
             return out
         uniq, first_idx, inverse = np.unique(
             tags, return_index=True, return_inverse=True)
@@ -94,9 +113,49 @@ class TCache:
         hits = int(out.sum())
         self.hit_cnt += hits
         self.miss_cnt += n - hits
+        if novel is not None:
+            self.false_novel_cnt += int(
+                (np.asarray(novel, np.bool_) & out).sum())
         return out
+
+    def insert_novel_batch(self, tags) -> "object":
+        """Insert for tags a one-sided pre-filter (fd_drain's
+        dedup_filter) proved DEFINITELY novel: the dup-verdict
+        machinery of insert_batch (np.unique, eviction-window overlap
+        guard, verdict scatter) is skipped entirely — just ring/map
+        surgery in order, bit-identical to insert() for genuinely-new
+        tags. One O(1) map check per tag remains as a tripwire: it
+        returns a bool array, True where a "novel" tag was unexpectedly
+        already a member — all-False whenever the filter's one-sided
+        contract holds. A violated contract is thereby OBSERVABLE (the
+        caller ledgers it and drops the frag as a duplicate, restoring
+        exact semantics) instead of silently corrupting the ring (a
+        double-inserted tag would leave a stale map entry behind at
+        eviction)."""
+        import numpy as np
+
+        tl = [int(x) for x in
+              (tags if isinstance(tags, list) else tags.tolist())]
+        false_novel = np.zeros(len(tl), np.bool_)
+        m = self._map
+        for i, t in enumerate(tl):
+            if t in m:
+                # Contract breach: flag it, keep exact insert()
+                # semantics (a member stays a member, age unchanged).
+                false_novel[i] = True
+                self.hit_cnt += 1
+                continue
+            self.miss_cnt += 1
+            old = self._ring[self._next]
+            if old is not None:
+                m.discard(old)
+            self._ring[self._next] = t
+            self._next = (self._next + 1) % self.depth
+            m.add(t)
+        return false_novel
 
     def reset(self):
         self._ring = [None] * self.depth
         self._next = 0
         self._map.clear()
+        self.false_novel_cnt = 0

@@ -622,4 +622,50 @@ int fd_frag_publish_bulk(void* mcache, void* dcache_base,
   return (int)published;
 }
 
+// ABI marker: the bulk publisher grew a per-frag ctl variant — Python
+// callers probe fd_frag_publish_bulk_has_ctl before using it, so a
+// stale .so degrades to the ctl-less path instead of crashing.
+int fd_frag_publish_bulk_has_ctl(void) { return 1; }
+
+// fd_frag_publish_bulk with a per-frag ctl word instead of the
+// hardwired SOM|EOM: the fd_drain path rides novel/color/block hints
+// downstream in the mcache ctl field (bit 3 = CTL_NOVEL, bits 4..10 =
+// pack color + 1, bits 11..15 = block id), so the device verdicts
+// reach DedupTile/PackTile with zero extra shared-memory traffic.
+// Identical flow control and cursor semantics to the ctl-less call.
+int fd_frag_publish_bulk_ctl(void* mcache, void* dcache_base,
+                             uint32_t data_sz_chunks, uint32_t mtu,
+                             uint64_t* seq_io, uint32_t* chunk_io,
+                             const uint8_t* payloads, const uint32_t* offs,
+                             const uint32_t* lens, const uint64_t* sigs,
+                             const uint32_t* tsorigs, const uint16_t* ctls,
+                             const uint8_t* mask, uint32_t* txn_io,
+                             uint32_t n_txn, uint32_t max_pub,
+                             uint32_t now32, uint64_t* bytes_out) {
+  uint32_t mtu_chunks = (mtu + 63u) >> 6;
+  uint64_t seq = *seq_io;
+  uint32_t chunk = *chunk_io;
+  uint32_t i = *txn_io;
+  uint32_t published = 0;
+  uint64_t bytes = 0;
+  while (i < n_txn && published < max_pub) {
+    if (!mask[i]) { i++; continue; }
+    uint32_t sz = lens[i];
+    std::memcpy((uint8_t*)dcache_base + (uint64_t)chunk * 64,
+                payloads + offs[i], sz);
+    fd_mcache_publish(mcache, seq, sigs[i], chunk, (uint16_t)sz,
+                      ctls[i], tsorigs[i], now32);
+    chunk = fd_dcache_next_chunk(chunk, sz, mtu_chunks, data_sz_chunks);
+    seq++;
+    published++;
+    bytes += sz;
+    i++;
+  }
+  *seq_io = seq;
+  *chunk_io = chunk;
+  *txn_io = i;
+  if (bytes_out) *bytes_out += bytes;
+  return (int)published;
+}
+
 }  // extern "C"

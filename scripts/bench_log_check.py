@@ -553,6 +553,93 @@ def validate_pod(rec: dict) -> List[str]:
     return errs
 
 
+# fd_drain artifact shape (DRAIN_r*.json, written by
+# scripts/drain_smoke.py; sentinel prediction 13 grades the on-device
+# variant). The accounting clauses are the load-bearing part: an
+# artifact claiming ok must carry ledger-exact probe-skip parity
+# (skipped + probed == novel-claims + maybe-dups) and pack-gate
+# accounting (device blocks + fallbacks == blocks) — otherwise
+# "one-sided filter" and "validated device schedule" are just words.
+_DRAIN_REQUIRED = {
+    "value": (int, float),        # drain-on replay txns/s
+    "unit": str,
+    "on_device": bool,
+    "batch": int,
+    "corpus": int,
+    "elapsed_s": (int, float),
+    "ok": bool,
+    "digest_parity": bool,
+    "alert_cnt": int,
+    "probe_skips": int,           # DedupTile probes skipped on claims
+    "probed": int,                # DedupTile exact probes run
+    "claims_novel": int,          # verify-side definitely-novel claims
+    "claims_maybe": int,          # verify-side maybe-dup publishes
+    "false_novel": int,           # tcache tripwire count (must be 0)
+}
+_DRAIN_PACK_REQUIRED = ("blocks", "blocks_device", "fallbacks",
+                        "waves_device", "batch")
+
+
+def validate_drain(rec: dict) -> List[str]:
+    """Shape errors for one DRAIN_r*.json artifact ([] = valid)."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return ["artifact is not a JSON object"]
+    if rec.get("metric") != "drain_pipeline_throughput":
+        errs.append(f"metric must be drain_pipeline_throughput, got "
+                    f"{rec.get('metric')!r}")
+    sv = rec.get("schema_version")
+    if not isinstance(sv, int) or isinstance(sv, bool) \
+            or sv < SCHEMA_VERSION_MIN:
+        errs.append(f"schema_version must be an int >= "
+                    f"{SCHEMA_VERSION_MIN}, got {sv!r}")
+    ts = rec.get("ts")
+    if not isinstance(ts, str) or "T" not in ts:
+        errs.append(f"missing/odd ISO 'ts': {ts!r}")
+    for key, typ in _DRAIN_REQUIRED.items():
+        v = rec.get(key)
+        if v is None or not isinstance(v, typ) \
+                or (isinstance(v, bool) and typ is not bool):
+            errs.append(f"'{key}' missing or not {typ}: {v!r}")
+    pack = rec.get("pack")
+    if not isinstance(pack, dict):
+        errs.append("'pack' block missing")
+    else:
+        for key in _DRAIN_PACK_REQUIRED:
+            v = pack.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errs.append(f"'pack.{key}' missing or not a "
+                            f"non-negative int: {v!r}")
+    if not isinstance(rec.get("failures"), list):
+        errs.append("'failures' must be a list")
+    if not errs and rec["ok"]:
+        # An artifact that SAYS the gates passed must carry evidence
+        # consistent with them.
+        if not rec["digest_parity"]:
+            errs.append("ok: true but digest_parity: false")
+        if rec["alert_cnt"] != 0:
+            errs.append(f"ok: true but alert_cnt={rec['alert_cnt']}")
+        if rec["probe_skips"] + rec["probed"] \
+                != rec["claims_novel"] + rec["claims_maybe"]:
+            errs.append(
+                f"ok: true but probe accounting broken: "
+                f"{rec['probe_skips']} skipped + {rec['probed']} probed "
+                f"!= {rec['claims_novel']} novel + "
+                f"{rec['claims_maybe']} maybe")
+        if rec["probe_skips"] < 1:
+            errs.append("ok: true but probe_skips == 0 (the filter "
+                        "provably skipped nothing)")
+        if rec["false_novel"] != 0:
+            errs.append(f"ok: true but false_novel={rec['false_novel']} "
+                        "(the one-sided contract tripwire fired)")
+        if pack["blocks_device"] + pack["fallbacks"] != pack["blocks"]:
+            errs.append(
+                f"ok: true but pack accounting broken: "
+                f"{pack['blocks_device']} device + {pack['fallbacks']} "
+                f"fallback != {pack['blocks']} blocks")
+    return errs
+
+
 # fd_msm2 schedule-search artifact shape (build/msm_search.json,
 # written by scripts/msm_search.py). The negative-control clauses are
 # the load-bearing part: an artifact claiming ok must carry PROOF that
@@ -688,6 +775,25 @@ def validate_pod_files(root: str) -> List[str]:
     return errs
 
 
+def validate_drain_files(root: str) -> List[str]:
+    """All violations across the DRAIN_r*.json family under root."""
+    import glob
+
+    errs: List[str] = []
+    for path in sorted(glob.glob(os.path.join(root,
+                                              "DRAIN_r[0-9]*.json"))):
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            errs.append(f"{name}: not JSON ({e})")
+            continue
+        for e in validate_drain(rec):
+            errs.append(f"{name}: {e}")
+    return errs
+
+
 def validate_siege_files(root: str) -> List[str]:
     """All violations across the SIEGE_r*.json family under root."""
     import glob
@@ -754,6 +860,9 @@ def main(argv=None) -> int:
     # The fd_pod artifact family rides the same gate (prediction 11
     # reads these; a malformed one poisons the ledger).
     errs += validate_pod_files(siege_root)
+    # The fd_drain artifact family rides it too (prediction 13 reads
+    # these; the accounting invariants are part of the schema).
+    errs += validate_drain_files(siege_root)
     # The fd_msm2 schedule-search artifact rides it too (prediction 12
     # reads the winner; the negative-control invariants are part of the
     # schema, so a search run that lost its controls fails HERE even if
@@ -769,8 +878,11 @@ def main(argv=None) -> int:
     n_siege = len(_glob.glob(os.path.join(siege_root,
                                           "SIEGE_r[0-9]*.json")))
     n_pod = len(_glob.glob(os.path.join(siege_root, "POD_r[0-9]*.json")))
+    n_drain = len(_glob.glob(os.path.join(siege_root,
+                                          "DRAIN_r[0-9]*.json")))
     print(f"bench_log_check: OK ({n} lines; {legacy} allowlisted legacy; "
-          f"{n_siege} siege artifacts; {n_pod} pod artifacts)")
+          f"{n_siege} siege artifacts; {n_pod} pod artifacts; "
+          f"{n_drain} drain artifacts)")
     return 0
 
 

@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""drain_smoke — the fd_drain post-verify-pipeline gate (ci.sh lane).
+
+Two phases on the CPU feed backend, one artifact:
+
+  1. FILTER PARITY — one mainnet-shaped corpus (dups + corruption +
+     garbage in) through the feed pipeline twice: FD_DRAIN=off, then
+     FD_DRAIN=auto, both under the default greedy pack scheduler so
+     the only variable is the drain aux graph + ctl claims. Gates:
+     sink digest multisets bit-exact between the runs AND equal to the
+     corpus oracle (expected_sink_digests); the drain run provably
+     skipped >= 1 TCache probe; probe-skip accounting ledger-exact
+     (DedupTile skipped + probed == verify novel-claims + maybe-dup
+     publishes); ZERO false-novel tripwires; zero fd_sentinel alerts
+     (which also exercises the new drain_filter_effectiveness SLO —
+     armed by this run's claim volume, silent on the off run); the off
+     run carries zero claims so artifact consumers see one shape.
+
+  2. PACK FUSION — a conflict-heavy hand-built corpus through the gc
+     pack scheduler with FD_DRAIN=auto + FD_DRAIN_PACK=1: wave colors
+     ride the ctl word, PackTile reassembles device blocks and gates
+     every one through ballet.pack.validate_schedule + the
+     rewards-per-CU comparison against CPU greedy. Gates: every txn
+     sunk, >= 1 block took the device path, device blocks + fallbacks
+     == blocks closed (exact fallback accounting), both banks used.
+
+Writes DRAIN_r01.json (metric drain_pipeline_throughput, on_device:
+false — sentinel prediction 13 only ever grades on-device drain
+artifacts) and validates it with bench_log_check.validate_drain.
+Exits nonzero on any violation; prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+from datetime import datetime, timezone
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N = 1600
+SEED = 20
+PACK_N = 96
+# Latency budgets scaled way up (the pod_smoke precedent): this lane
+# gates dataflow accounting, not CPU-host scheduling jitter. Liveness
+# and the ratio-based drain effectiveness SLO stay armed unscaled.
+SLO_ENV = {
+    "FD_SLO_E2E_BUDGET_MS": "900000",
+    "FD_SLO_SOURCE_BUDGET_MS": "900000",
+    "FD_SLO_QUIC_INGEST_MS": "900000",
+    "FD_SLO_STALL_MS": "300000",
+    "FD_SLO_HB_MS": "120000",
+}
+
+
+def log(msg: str) -> None:
+    print(f"drain_smoke: {msg}", flush=True)
+
+
+def fail(msg: str) -> None:
+    print(f"drain_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _corpus():
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+
+    # Real dups in: the maybe-dup lane and the TCache authority must
+    # both carry live traffic for the parity gate to mean anything.
+    return mainnet_corpus(n=N, seed=SEED, dup_rate=0.06,
+                          corrupt_rate=0.03, parse_err_rate=0.02,
+                          sign_batch_size=256, max_data_sz=150)
+
+
+def _pack_corpus():
+    from firedancer_tpu.ballet.txn import build_txn
+
+    payloads = []
+    shared = bytes([77]) * 32   # one write-hot account forces conflicts
+    for i in range(PACK_N):
+        extra = [shared] if i % 4 == 0 else [bytes([i]) * 32]
+        payloads.append(build_txn(
+            signer_seeds=[bytes([i + 1]) + bytes(31)],
+            extra_accounts=extra + [bytes([200 + i % 30]) * 32],
+            n_readonly_unsigned=1,
+            instrs=[(2, [0], b"dr%02d" % i)],
+        ))
+    return payloads
+
+
+def _run(tmp, payloads, name, scheduler="greedy", **env):
+    from firedancer_tpu.disco.pipeline import build_topology, run_pipeline
+
+    env = {**SLO_ENV, **env}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update({k: str(v) for k, v in env.items()})
+    try:
+        topo = build_topology(os.path.join(tmp, f"{name}.wksp"),
+                              depth=2048, wksp_sz=1 << 26)
+        t0 = time.perf_counter()
+        res = run_pipeline(topo, payloads, verify_backend="cpu",
+                           timeout_s=240.0, tcache_depth=1 << 16,
+                           record_digests=True, feed=True,
+                           pack_scheduler=scheduler)
+        return res, time.perf_counter() - t0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _tile_diag(res, tile: str) -> dict:
+    """The fd_flight overlay dict for one tile out of res.diag
+    (tile.<name>; shard-suffixed lanes aggregate into the base)."""
+    out: dict = {}
+    for key, d in (res.diag or {}).items():
+        if not isinstance(d, dict):
+            continue
+        base = key.split(".", 1)[-1].split(".shard")[0]
+        if key.startswith("tile.") and base == tile:
+            for k, v in d.items():
+                if k.startswith("fl_") and isinstance(v, int):
+                    out[k] = out.get(k, 0) + v
+    return out
+
+
+def main() -> int:
+    failures = []
+    corpus = _corpus()
+    log(f"corpus ready ({len(corpus.payloads)} payloads)")
+    tmp = tempfile.mkdtemp(prefix="fd_drain_smoke_")
+
+    # -- 1a. FD_DRAIN=off baseline ---------------------------------------
+    res_off, dt_off = _run(tmp, corpus.payloads, "off", FD_DRAIN="off")
+    vs_off = res_off.verify_stats[0]
+    if vs_off["drain_batches"] or vs_off["drain_novel"] \
+            or vs_off["drain_maybe"]:
+        failures.append(
+            f"FD_DRAIN=off run carries drain claims: "
+            f"batches={vs_off['drain_batches']} "
+            f"novel={vs_off['drain_novel']} maybe={vs_off['drain_maybe']}")
+    dd_off = _tile_diag(res_off, "dedup")
+    if dd_off.get("fl_drain_probe_skip", 0):
+        failures.append(
+            f"FD_DRAIN=off dedup skipped probes: {dd_off}")
+    log(f"off run: {res_off.recv_cnt} sunk in {dt_off:.1f}s "
+        f"(0 claims, {dd_off.get('fl_drain_probed', 0)} exact probes)")
+
+    # -- 1b. FD_DRAIN=auto + parity --------------------------------------
+    res_on, dt_on = _run(tmp, corpus.payloads, "on", FD_DRAIN="auto")
+    vs = res_on.verify_stats[0]
+    dd = _tile_diag(res_on, "dedup")
+    novel = int(vs["drain_novel"])
+    maybe = int(vs["drain_maybe"])
+    skips = int(dd.get("fl_drain_probe_skip", 0))
+    probed = int(dd.get("fl_drain_probed", 0))
+    false_novel = int(dd.get("fl_drain_false_novel", 0))
+    if not vs["drain_batches"]:
+        failures.append("FD_DRAIN=auto run dispatched no drain batches "
+                        "(native ctl publisher missing? rebuild "
+                        "build/libfdtango.so)")
+    if skips < 1:
+        failures.append("no TCache probe was provably skipped "
+                        f"(novel={novel} maybe={maybe})")
+    if skips + probed != novel + maybe:
+        failures.append(
+            f"probe accounting broken: {skips} skipped + {probed} "
+            f"probed != {novel} novel + {maybe} maybe")
+    if false_novel:
+        failures.append(f"one-sided contract tripwire fired "
+                        f"{false_novel}x (false novel claims)")
+    if res_on.slo is None:
+        failures.append("drain run carried no sentinel summary")
+    elif res_on.slo["alert_cnt"]:
+        failures.append(f"drain run booked SLO alerts: "
+                        f"{res_on.slo['alerts']}")
+
+    d_off = sorted(d.hex() for d in (res_off.sink_digests or []))
+    d_on = sorted(d.hex() for d in (res_on.sink_digests or []))
+    digest_parity = bool(d_on) and d_on == d_off
+    if not digest_parity:
+        failures.append(
+            f"sink digest parity broke: on {len(d_on)} vs off "
+            f"{len(d_off)} (first diff: "
+            f"{next((a for a, b in zip(d_on, d_off) if a != b), '?')})")
+    from firedancer_tpu.disco.corpus import sink_mismatch_count
+
+    oracle_miss = sink_mismatch_count(corpus, res_on.sink_digests or [])
+    if oracle_miss:
+        failures.append(f"drain run diverged from the corpus oracle: "
+                        f"{oracle_miss} digest mismatches")
+    log(f"drain run: {res_on.recv_cnt} sunk in {dt_on:.1f}s; "
+        f"claims {novel} novel + {maybe} maybe == {skips} skipped + "
+        f"{probed} probed; {false_novel} false novel; digest parity "
+        f"{'OK' if digest_parity else 'BROKEN'} ({len(d_on)} digests)")
+
+    # -- 2. pack fusion (gc scheduler + FD_DRAIN_PACK) -------------------
+    pack_payloads = _pack_corpus()
+    res_gc, dt_gc = _run(tmp, pack_payloads, "gc", scheduler="gc",
+                         FD_DRAIN="auto", FD_DRAIN_PACK="1")
+    pk = _tile_diag(res_gc, "pack")
+    blocks_device = int(pk.get("fl_pack_block_device", 0))
+    fallbacks = int(pk.get("fl_pack_sched_fallback", 0))
+    waves_device = int(pk.get("fl_pack_wave_device", 0))
+    blocks = blocks_device + fallbacks
+    if res_gc.recv_cnt != len(pack_payloads):
+        failures.append(
+            f"pack fusion dropped txns: {res_gc.recv_cnt} sunk of "
+            f"{len(pack_payloads)}")
+    if blocks_device < 1:
+        failures.append(
+            f"no pack block took the device path: {pk}")
+    if blocks_device and not waves_device:
+        failures.append("device blocks published zero device waves")
+    if len(res_gc.bank_hist or {}) < 2:
+        failures.append(f"one bank never scheduled: {res_gc.bank_hist}")
+    log(f"pack fusion: {res_gc.recv_cnt}/{len(pack_payloads)} sunk in "
+        f"{dt_gc:.1f}s; blocks {blocks_device} device + {fallbacks} "
+        f"fallback, {waves_device} device waves, "
+        f"{len(res_gc.bank_hist or {})} banks")
+
+    # -- artifact ---------------------------------------------------------
+    value = (res_on.recv_cnt / dt_on) if dt_on else 0.0
+    rec = {
+        "metric": "drain_pipeline_throughput",
+        "schema_version": 2,
+        "ts": datetime.now(timezone.utc).isoformat(),
+        "value": round(value, 3),
+        "unit": "txns/s",
+        "on_device": False,
+        "platform": "cpu-feed",
+        "batch": 128,   # run_pipeline's verify_batch on this lane
+        "corpus": len(corpus.payloads),
+        "elapsed_s": round(dt_on, 3),
+        "ok": not failures,
+        "digest_parity": digest_parity,
+        "alert_cnt": int((res_on.slo or {}).get("alert_cnt", 0)),
+        "probe_skips": skips,
+        "probed": probed,
+        "claims_novel": novel,
+        "claims_maybe": maybe,
+        "false_novel": false_novel,
+        "drain_rotations": int(vs.get("drain_rot") or 0),
+        "pack": {
+            "blocks": blocks,
+            "blocks_device": blocks_device,
+            "fallbacks": fallbacks,
+            "waves_device": waves_device,
+            "batch": len(pack_payloads),
+        },
+        "failures": failures,
+    }
+    # On-device drain sessions write the same schema with on_device:
+    # true plus drain_speedup and pack.rewards_per_cu_ratio at B>=64k —
+    # that record is what grades prediction 13.
+    art = os.path.join(REPO, "DRAIN_r01.json")
+    with open(art, "w") as f:
+        json.dump(rec, f, indent=1)
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import bench_log_check
+
+    errs = bench_log_check.validate_drain(rec)
+    if errs and not failures:
+        failures.extend(f"artifact schema: {e}" for e in errs)
+
+    print(json.dumps({
+        "metric": "drain_smoke",
+        "ok": not failures,
+        "value": rec["value"],
+        "probe_skips": skips,
+        "claims": [novel, maybe],
+        "pack_blocks": [blocks_device, fallbacks],
+        "digests": len(d_on),
+        "failures": failures,
+    }))
+    if failures:
+        for msg in failures:
+            print(f"drain_smoke: FAIL — {msg}", file=sys.stderr)
+        return 1
+    log(f"OK — artifact {art}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,7 +7,7 @@ plus the BENCH/REPLAY/MULTICHIP/PACK/HOSTFEED artifact family are
 parsed into one schema-normalized timeline (pre-schema_version legacy
 lines included), rendered as per-mode/per-B/per-stage trend tables,
 checked against the rolling best-of baseline (FD_REPORT_REGRESS_PCT),
-and reconciled against the twelve ROOFLINE.md falsifiable predictions —
+and reconciled against the thirteen ROOFLINE.md falsifiable predictions —
 each listed pending until a matching schema_version-2 artifact lands,
 then auto-graded confirmed/falsified (the BENCH_r06 hardware session
 self-grades).
@@ -219,6 +219,32 @@ def render_pod(timeline) -> List[str]:
     return lines
 
 
+def render_drain(timeline) -> List[str]:
+    """The fd_drain post-verify pipeline table: one row per
+    DRAIN_r*.json artifact — digest parity, probe-skip accounting,
+    device pack blocks vs fallbacks, and whether the row is on-device
+    (only those can grade prediction 13)."""
+    lines = ["== FD_DRAIN POST-VERIFY PIPELINE (dedup filter + pack) =="]
+    rows = sentinel.drain_status(timeline)
+    if not rows:
+        lines.append("(no DRAIN_r*.json artifacts yet — run "
+                     "scripts/drain_smoke.py)")
+        return lines
+    for r in rows:
+        verdict = "OK  " if r["ok"] else "FAIL"
+        where = "DEVICE" if r["on_device"] else "cpu-backend"
+        lines.append(
+            f"  [{verdict}] {r['value']} {r['unit']} ({where}); "
+            f"digest parity {r['digest_parity']}, probe skips "
+            f"{r['probe_skips']}, false novel {r['false_novel']}, "
+            f"pack device/fallback {r['pack_blocks_device']}/"
+            f"{r['pack_fallbacks']}, alerts {r['alert_cnt']} "
+            f"[{r['source']}]")
+        for fmsg in r["failures"]:
+            lines.append(f"         - {fmsg}")
+    return lines
+
+
 def render_gates(timeline) -> List[str]:
     lines = ["== THROUGHPUT GATES =="]
     best: dict = {}
@@ -256,6 +282,7 @@ def render_report(timeline, regress_pct=None) -> str:
                     render_gates(timeline),
                     render_siege(timeline),
                     render_pod(timeline),
+                    render_drain(timeline),
                     render_regressions(regs),
                     render_ledger(ledger)):
         parts.extend(section)

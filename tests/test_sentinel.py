@@ -28,7 +28,8 @@ def test_slo_table_typed_and_unique():
     names = [s.name for s in sentinel.SLO_TABLE]
     assert len(names) == len(set(names))
     for s in sentinel.SLO_TABLE:
-        assert s.kind in ("latency", "liveness", "balance"), s.name
+        assert s.kind in ("latency", "liveness", "balance",
+                          "effectiveness"), s.name
         assert s.objective, s.name
         assert s.budget_flag in __import__(
             "firedancer_tpu.flags", fromlist=["REGISTRY"]).REGISTRY, s.name
@@ -298,10 +299,10 @@ def test_timeline_ingests_repo_history_without_error():
     assert any(e.legacy for e in timeline)
 
 
-def test_prediction_ledger_all_twelve_pending_on_repo_history():
+def test_prediction_ledger_all_thirteen_pending_on_repo_history():
     ledger = sentinel.prediction_ledger(sentinel.load_timeline(REPO))
-    assert len(ledger) == 12
-    assert [p["id"] for p in ledger] == list(range(1, 13))
+    assert len(ledger) == 13
+    assert [p["id"] for p in ledger] == list(range(1, 14))
     for p in ledger:
         assert p["verdict"] == "pending", p
         assert p["rule"] and p["predicted"], p
@@ -344,6 +345,14 @@ def test_prediction_ledger_autogrades_synthetic_r06():
                             "overlap": {"tail_hidden_est": 0.9,
                                         "overlap_ms": 14.0,
                                         "gate": "measured"}},
+                           "synthetic"),
+        sentinel._classify({"metric": "drain_pipeline_throughput",
+                            "value": 620_000.0, "unit": "verifies/s",
+                            "on_device": True, "schema_version": 2,
+                            "ts": "2026-08-09T00:00:00Z",
+                            "drain_speedup": 1.8,
+                            "pack": {"rewards_per_cu_ratio": 1.05,
+                                     "batch": 65536}},
                            "synthetic"),
     ]
     ledger = sentinel.prediction_ledger(timeline)
