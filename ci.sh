@@ -132,7 +132,7 @@ echo "== fd_sentinel SLO smoke (burn-rate asymmetry + report/ledger) =="
 # latency rule), a seeded hb_stall + credit_starve chaos schedule
 # trips EXACTLY the matching SLOs (fault class <-> SLO name pinned in
 # the flight dump), fd_report ingests the repo's real BENCH_LOG.jsonl
-# + artifact family without error with all thirteen ROOFLINE
+# + artifact family without error with all fourteen ROOFLINE
 # predictions pending, and flight+sentinel overhead stays <= 5% vs both
 # disabled.
 JAX_PLATFORMS=cpu python scripts/slo_smoke.py
@@ -250,6 +250,19 @@ echo "== fd_drain smoke (post-verify dedup filter + pack fusion, CPU) =="
 # greedy at 64k) stays pending until a real device session writes the
 # on_device variant.
 JAX_PLATFORMS=cpu python scripts/drain_smoke.py
+
+echo "== fd_soak smoke (compressed soak + live reconfig + tripwires) =="
+# The round-21 long-horizon gate: a 3-phase seeded drift soak (one
+# hb_stall chaos window) books zero UNEXPLAINED alerts with zero
+# dropped txns / leaked slots; a SIGALRM-driven mid-run rung-ladder
+# swap (the SIGHUP path's Event) applies at the inflight-window
+# barrier with the sink digest multiset byte-identical to a no-chaos
+# no-reconfig control run; the resource-growth tripwires arm on
+# steady-state samples with every slope (tracemalloc heap, slot pool,
+# compile cache) within the env-pinned budgets; and the record passes
+# bench_log_check.validate_soak before landing as SOAK_r01.json (the
+# committed member of the artifact family behind prediction 14).
+JAX_PLATFORMS=cpu python scripts/soak_smoke.py
 
 echo "== fuzz smoke (10k iters/target) =="
 python fuzz/run_fuzz.py --iters 10000

@@ -708,6 +708,29 @@ class EngineRegistry:
             return e
         return None
 
+    def entry_count(self) -> int:
+        """Registered engine entries (every state) — the fd_soak
+        compile-cache tripwire samples this: a flat count over hours
+        means the ladder is closed; monotone growth means shapes are
+        leaking past the prewarmed rungs."""
+        with self._lock:
+            return len(self._entries)
+
+    def retire(self, specs) -> int:
+        """Drop the given specs from the registry (live-reconfig
+        cleanup after a ladder swap: the OLD rungs' engines become
+        unreachable and their jitted callables can be collected).
+        Specs not present are ignored; returns how many were dropped.
+        Callers must not retire the engine a tile still dispatches on
+        — the reconfig barrier guarantees no inflight batch holds one.
+        """
+        dropped = 0
+        with self._lock:
+            for spec in specs:
+                if self._entries.pop(spec, None) is not None:
+                    dropped += 1
+        return dropped
+
     # -- background prewarm ---------------------------------------------
 
     def prewarm_ladder(self, specs, max_msg_len: int = 1232,

@@ -128,6 +128,10 @@ def verify_tile_stats(v) -> Dict[str, object]:
         "drain_novel": m["drain_novel"],
         "drain_maybe": m["drain_maybe"],
         "drain_rot": m["drain_rot"],
+        # fd_soak live reconfig (applied swaps vs refused requests) —
+        # both zero on a run with no control channel, one shape always.
+        "reconfigs": m["reconfigs"],
+        "reconfig_refused": m["reconfig_refused"],
     }
     if st["shard_lanes"]:
         # lo==0 (a starved shard) degrades to max/1 — a huge but
@@ -176,6 +180,7 @@ def run_feed_pipeline(
     source_tile=None,
     source_done=None,
     pre_wait=None,
+    tile_hook=None,
 ):
     """Same contract as pipeline.run_pipeline (which routes here when
     FD_FEED is on and the topology qualifies); returns a PipelineResult
@@ -359,6 +364,11 @@ def run_feed_pipeline(
                     tile_max_ns, "", tmp)
         for th in threads:
             th.start()
+        if tile_hook is not None:
+            # fd_soak's window into the live run: the hook receives the
+            # in-process VerifyTile (reconfig control channel, slot-
+            # pool/ladder probes) right after the tile threads start.
+            tile_hook(verify)
         post_wait = pre_wait() if pre_wait is not None else None
         snt = sentinel_mod.start_for_run(wksp, pod)
 

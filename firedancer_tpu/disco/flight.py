@@ -199,6 +199,16 @@ TILE_METRICS: Tuple[Metric, ...] = (
     Metric("pack_sched_fallback", "counter",
            "pack blocks that fell back to the exact CPU greedy "
            "schedule (validation miss or losing rewards/CU)"),
+    # fd_soak live-reconfig rows: ladder/flag swaps applied at the
+    # inflight-window barrier vs requests refused at validation.
+    Metric("reconfigs", "counter",
+           "live reconfigs applied at the inflight-window barrier "
+           "(ladder swap / engine-flag flip / drain-mode change, zero "
+           "dropped txns by construction)"),
+    Metric("reconfig_refused", "counter",
+           "live reconfig requests refused at validation (invalid "
+           "mode/backend combo, unusable ladder, or a swap already "
+           "pending)"),
 )
 
 TILE_IDX: Dict[str, int] = {m.name: i for i, m in enumerate(TILE_METRICS)}

@@ -35,7 +35,7 @@ This module is the judgment layer, in three parts:
                rolling best-of baseline. scripts/fd_report.py renders
                per-mode/per-B/per-stage trend reports from it.
 
-  PREDICTION   the thirteen ROOFLINE.md falsifiable predictions for the
+  PREDICTION   the fourteen ROOFLINE.md falsifiable predictions for the
   LEDGER       next hardware run (BENCH_r06), each with a MACHINE-
                CHECKABLE match rule over the timeline: the ledger lists
                every prediction as pending until a matching artifact
@@ -85,12 +85,17 @@ class SLO:
                          # "balance" (per-shard occupancy ratio over
                          # the fd_pod verify.shardN flight rows) |
                          # "effectiveness" (fd_drain definitely-novel
-                         # share of published claims)
+                         # share of published claims) |
+                         # "slope" (fd_soak long-horizon resource-
+                         # growth tripwires over the probe's fitted
+                         # trends)
     edge_or_stage: str   # edge label (lane variants aggregate in), or
                          # "progress" / "heartbeat" for liveness SLOs,
                          # or the shard-row suffix for balance SLOs,
                          # or "drain_claims" for the drain
-                         # effectiveness SLO
+                         # effectiveness SLO, or the sampled resource
+                         # ("heap" / "slot_pool" / "compile_cache")
+                         # for slope SLOs
     objective: str       # human statement of the objective
     budget_flag: str     # FD_SLO_* flag naming the budget (ms)
     target: float = 0.99       # latency: quantile target (error budget
@@ -147,6 +152,28 @@ SLO_TABLE: Tuple[SLO, ...] = (
         "degraded to probing everything (an FD_DRAIN=off run "
         "publishes no claims and never arms this)",
         "FD_SLO_DRAIN_EFF_PCT"),
+    SLO("heap_slope", "slope", "heap",
+        "fd_soak heap-growth tripwire: the least-squares slope of the "
+        "soak probe's tracemalloc samples stays under "
+        "FD_SLO_HEAP_SLOPE_KB KiB/min once MIN_SLOPE_SAMPLES have "
+        "accumulated — a breach is the multi-hour leak signature the "
+        "minutes-scale gates cannot see (armed only when a soak run "
+        "registers a slope source; ordinary runs stay silent)",
+        "FD_SLO_HEAP_SLOPE_KB"),
+    SLO("pool_occupancy_slope", "slope", "slot_pool",
+        "fd_soak slot-pool occupancy tripwire: the fitted trend of "
+        "outstanding fd_feed slots (not FREE) stays under "
+        "FD_SLO_POOL_SLOPE_MILLI milli-slots/min — a breach means "
+        "slots are leaking out of the FREE->FILLING->READY->FREE "
+        "cycle (stuck inflight windows, lost releases)",
+        "FD_SLO_POOL_SLOPE_MILLI"),
+    SLO("compile_cache_slope", "slope", "compile_cache",
+        "fd_soak compile-cache tripwire: engine-registry entries + "
+        "recorded compiles accrete no faster than FD_SLO_COMPILE_SLOPE "
+        "entries/hour past the prewarmed ladder — a breach is the "
+        "unbounded-recompile signature (shape leak, or reconfigs that "
+        "never retire old engines)",
+        "FD_SLO_COMPILE_SLOPE"),
     SLO("pipeline_progress", "liveness", "progress",
         "some pipeline edge advances at least every FD_SLO_STALL_MS "
         "while the run is live (armed after the first frag)",
@@ -184,6 +211,34 @@ MIN_SHARD_LANES = 16
 # not grade the window, and an FD_DRAIN=off run (zero claims) must
 # never arm it at all.
 MIN_DRAIN_CLAIMS = 256
+
+# Minimum resource-probe samples before a slope SLO arms: a 2-point
+# "slope" is the boot transient, not a trend (allocator warmup and the
+# first compile dominate the opening seconds of any run).
+MIN_SLOPE_SAMPLES = 8
+
+# fd_soak slope source: the soak harness registers a callable returning
+# {"samples": n, "heap_kb_min": f, "pool_milli_min": f,
+#  "compile_per_hr": f} (disco/soak.py's ResourceProbe fits); no source
+# registered (every non-soak run) means the slope SLOs never arm. A
+# module-level hook rather than a Sentinel ctor arg because
+# start_for_run() constructs the Sentinel internally — the soak sets it
+# before the pipeline boots and clears it in its finally.
+_SLOPE_SOURCE: Optional[Callable[[], dict]] = None
+
+# Maps each slope SLO's edge_or_stage to its key in the source dict.
+_SLOPE_KEYS = {
+    "heap": "heap_kb_min",
+    "slot_pool": "pool_milli_min",
+    "compile_cache": "compile_per_hr",
+}
+
+
+def set_slope_source(fn: Optional[Callable[[], dict]]) -> None:
+    """Install (or clear, with None) the process-wide slope source the
+    slope-kind SLOs evaluate against. Owned by disco/soak.py."""
+    global _SLOPE_SOURCE
+    _SLOPE_SOURCE = fn
 
 # --------------------------------------------------------------------------
 # The ROOFLINE per-stage ms budgets (round-10 >=400k/s gate arithmetic,
@@ -474,6 +529,31 @@ class Sentinel:
         pct = self.budgets_ms[slo.name]   # percent, not ms
         return novel * 100 < pct * total, int(novel * 1000 / total)
 
+    def _eval_slope(self, slo: SLO, now: float) -> Tuple[bool, int]:
+        """fd_soak resource-growth tripwire: evaluates the registered
+        slope source's fitted trend for this SLO's resource against the
+        budget (flag units: KiB/min, milli-slots/min, entries/hour).
+        Unarmed — (False, 0) — without a source (every non-soak run),
+        before MIN_SLOPE_SAMPLES probe samples, or when the source
+        omits the key. Returns (breach, slope as milli-multiples of
+        the budget, floored at 0 — a shrinking resource is not negative
+        burn)."""
+        src = _SLOPE_SOURCE
+        if src is None:
+            return False, 0
+        try:
+            d = src() or {}
+        except Exception:
+            return False, 0   # a dying probe must not take down polls
+        if int(d.get("samples") or 0) < MIN_SLOPE_SAMPLES:
+            return False, 0
+        v = d.get(_SLOPE_KEYS[slo.edge_or_stage])
+        if v is None:
+            return False, 0
+        budget = max(1, self.budgets_ms[slo.name])   # flag units, not ms
+        milli = max(0, int(float(v) * 1000 / budget))
+        return float(v) > budget, milli
+
     def _eval_progress(self, slo: SLO, now: float, cur) -> Tuple[bool, int]:
         total = sum(int(row[1:].sum()) for row in cur.values())
         if self._progress_totals is None or total != self._progress_totals:
@@ -516,6 +596,8 @@ class Sentinel:
                 breach, burn_milli = self._eval_balance(slo, now)
             elif slo.kind == "effectiveness":
                 breach, burn_milli = self._eval_drain_eff(slo, now)
+            elif slo.kind == "slope":
+                breach, burn_milli = self._eval_slope(slo, now)
             elif slo.edge_or_stage == "progress":
                 breach, burn_milli = self._eval_progress(slo, now, cur)
             else:
@@ -681,6 +763,7 @@ ARTIFACT_GLOBS = (
     "BENCH_r[0-9]*.json", "REPLAY_r[0-9]*.json", "REPLAY_CPU_r[0-9]*.json",
     "MULTICHIP_r[0-9]*.json", "PACK_r[0-9]*.json", "HOSTFEED_r[0-9]*.json",
     "SIEGE_r[0-9]*.json", "POD_r[0-9]*.json", "DRAIN_r[0-9]*.json",
+    "SOAK_r[0-9]*.json",
 )
 
 _METRIC_KIND = {
@@ -693,6 +776,7 @@ _METRIC_KIND = {
     "quic_siege_profile": "siege",
     "pod_aggregate_throughput": "pod",
     "drain_pipeline_throughput": "drain",
+    "soak_run": "soak",
     "note": "note",
 }
 
@@ -936,8 +1020,48 @@ def siege_status(timeline: List[TimelineEntry]) -> List[dict]:
     return out
 
 
+def soak_status(timeline: List[TimelineEntry]) -> List[dict]:
+    """Every fd_soak artifact (SOAK_r*.json) with its graded gates:
+    zero unexplained sentinel alerts, slope rows within budget, the
+    reconfig trail (applied swaps with digest-exact continuity),
+    respawn rate under budget, and zero dropped txns.
+    scripts/fd_soak.py / scripts/soak_smoke.py write the verdicts;
+    fd_report renders this table and prediction 14 grades the
+    on-device rows."""
+    out = []
+    for e in timeline:
+        if e.kind != "soak":
+            continue
+        r = e.rec
+        slo = r.get("slo") or {}
+        slopes = r.get("slopes") or {}
+        reconfig = r.get("reconfig") or {}
+        cont = r.get("continuity") or {}
+        out.append({
+            "source": e.source,
+            "ts": e.ts,
+            "value": r.get("value"),
+            "unit": r.get("unit"),
+            "on_device": bool(r.get("on_device")),
+            "ok": bool(r.get("ok")),
+            "duration_s": r.get("duration_s"),
+            "phases": len(r.get("phases") or []),
+            "alert_cnt": slo.get("alert_cnt"),
+            "unexplained_alerts": slo.get("unexplained_alerts"),
+            "slopes_within_budget": slopes.get("within_budget"),
+            "heap_kb_min": slopes.get("heap_kb_min"),
+            "reconfigs_applied": reconfig.get("applied"),
+            "reconfigs_refused": reconfig.get("refused"),
+            "digest_match": cont.get("digest_match"),
+            "dropped": cont.get("dropped"),
+            "respawn_ok": (r.get("respawn") or {}).get("ok"),
+            "failures": list(r.get("failures") or []),
+        })
+    return out
+
+
 # --------------------------------------------------------------------------
-# The prediction ledger: the thirteen ROOFLINE.md falsifiable predictions,
+# The prediction ledger: the fourteen ROOFLINE.md falsifiable predictions,
 # each with a machine-checkable match rule over the timeline. A rule
 # matches only schema_version >= 2, on-device, non-stale records — the
 # fused-front-end era — so the pre-round-10 history can neither confirm
@@ -1174,6 +1298,44 @@ def _check_p13(timeline):
     return "pending", None, None
 
 
+def _check_p14(timeline):
+    """fd_soak hardware headline: matches ON-DEVICE soak artifacts
+    only (metric soak_run, on_device true) that carry every judgment
+    block — duration, the sentinel's unexplained-alert count, the
+    slope verdict, the reconfig trail, and the continuity accounting.
+    The compressed CPU soak_smoke lane carries on_device: false and
+    can never grade this; a device record missing any block, or one
+    shorter than an hour, stays pending rather than grading on
+    partial evidence."""
+    for e in timeline:
+        r = e.rec
+        if (r.get("metric") != "soak_run" or e.schema_version < 2
+                or not r.get("on_device")):
+            continue
+        slo = r.get("slo") or {}
+        slopes = r.get("slopes") or {}
+        reconfig = r.get("reconfig") or {}
+        cont = r.get("continuity") or {}
+        dur = r.get("duration_s")
+        unexplained = slo.get("unexplained_alerts")
+        within = slopes.get("within_budget")
+        applied = reconfig.get("applied")
+        dropped = cont.get("dropped")
+        if (dur is None or unexplained is None or within is None
+                or applied is None or dropped is None):
+            continue   # partial record: keep pending
+        if float(dur) < 3600.0:
+            continue   # a sub-hour burst is not a soak
+        ok = (int(unexplained) == 0 and bool(within)
+              and int(applied) >= 1 and int(dropped) == 0)
+        return (("confirmed" if ok else "falsified"),
+                f"{float(dur) / 3600:.1f} h soak: {unexplained} "
+                f"unexplained alerts, slopes within budget: "
+                f"{bool(within)}, {applied} reconfig(s), "
+                f"{dropped} dropped", e.source)
+    return "pending", None, None
+
+
 @dataclass(frozen=True)
 class Prediction:
     pid: int
@@ -1271,6 +1433,19 @@ PREDICTIONS: Tuple[Prediction, ...] = (
                "speedup >= 1.5 AND ratio >= 1.0 (CPU-backend DRAIN_r* "
                "smokes carry on_device: false and never grade this)",
                _check_p13),
+    Prediction(14, "fd_soak N-hour soak survives live reconfig",
+               ">= 1 h on-device soak under drifting load + chaos "
+               "with zero unexplained sentinel alerts, flat "
+               "resource slopes, >= 1 mid-run prewarmed ladder swap, "
+               "and zero dropped txns",
+               "first sv>=2 soak_run record with on_device: true and "
+               "duration_s >= 3600 carrying slo.unexplained_alerts, "
+               "slopes.within_budget, reconfig.applied, and "
+               "continuity.dropped — unexplained == 0 AND "
+               "within_budget AND applied >= 1 AND dropped == 0 "
+               "(the compressed CPU soak_smoke lane carries "
+               "on_device: false and never grades this)",
+               _check_p14),
 )
 
 
@@ -1333,13 +1508,26 @@ def dump_slo_markdown() -> str:
         "published (an `FD_DRAIN=off` run publishes none and stays",
         "silent), breached when the definitely-novel share falls below",
         "the budget percentage.",
+        "Slope SLOs (fd_soak) are the long-horizon resource-growth",
+        "tripwires: armed only when a soak run registers a slope",
+        "source (`sentinel.set_slope_source` — ordinary runs never",
+        "arm them) with at least MIN_SLOPE_SAMPLES probe samples,",
+        "breached when the least-squares trend of the sampled",
+        "resource (tracemalloc heap, outstanding feed slots, engine-",
+        "cache entries) exceeds the budget — stated per resource in",
+        "KiB/min, milli-slots/min, and entries/hour respectively.",
         "",
         "| SLO | kind | edge / stage | budget (default) | target |"
         " trips on (chaos class) | objective |",
         "|---|---|---|---|---|---|---|",
     ]
+    _SLOPE_UNITS = {"heap": "KiB/min", "slot_pool": "milli-slots/min",
+                    "compile_cache": "entries/h"}
     for s in SLO_TABLE:
-        unit = "%" if s.kind in ("balance", "effectiveness") else "ms"
+        if s.kind == "slope":
+            unit = _SLOPE_UNITS[s.edge_or_stage]
+        else:
+            unit = "%" if s.kind in ("balance", "effectiveness") else "ms"
         budget = f"`{s.budget_flag}` = {_budget_default_ms(s)} {unit}"
         target = f"p{int(s.target * 100)}" if s.kind == "latency" else "—"
         faults = ", ".join(s.fault_classes) if s.fault_classes else "—"

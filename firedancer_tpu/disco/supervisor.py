@@ -45,6 +45,32 @@ from firedancer_tpu.utils.rng import Rng
 _U64 = (1 << 64) - 1
 
 
+def respawn_budget(restarts: int, elapsed_s: float,
+                   budget_per_h: Optional[int] = None) -> dict:
+    """fd_soak's respawn-rate judgment over either supervision layer
+    (tile-process respawns here, stager-thread restarts in the
+    feeder): pro-rates FD_SOAK_RESPAWN_BUDGET (restarts per hour)
+    over the elapsed window — with a floor of one full budget so a
+    compressed smoke lane is judged against at least the hourly
+    allowance — and verdicts the observed count against it. A storm
+    of individually-successful restarts is a soak failure even though
+    each respawn 'worked'; that is exactly the failure mode a
+    minutes-scale gate cannot see."""
+    if budget_per_h is None:
+        budget_per_h = flags.get_int("FD_SOAK_RESPAWN_BUDGET")
+    allowed = max(float(budget_per_h),
+                  budget_per_h * max(0.0, elapsed_s) / 3600.0)
+    return {
+        "restarts": int(restarts),
+        "elapsed_s": round(float(elapsed_s), 1),
+        "budget_per_h": int(budget_per_h),
+        "allowed": round(allowed, 2),
+        "rate_per_h": round(restarts * 3600.0 / elapsed_s, 2)
+        if elapsed_s > 0 else 0.0,
+        "ok": int(restarts) <= allowed,
+    }
+
+
 @dataclass
 class TileProc:
     name: str

@@ -1153,6 +1153,17 @@ class VerifyTile(Tile):
                 self.flightrec.record(
                     "rung_ladder", rungs=list(rungs),
                     prewarm=flags.get_str("FD_ENGINE_PREWARM"))
+        # fd_soak zero-downtime live reconfig: request_reconfig()
+        # validates + parks ONE pending request; _feed_poll drains the
+        # inflight window to a barrier and _apply_reconfig swaps the
+        # engine/ladder in the dispatch gap — per inflight window,
+        # never per pipeline (staging keeps running throughout).
+        import threading
+
+        self.mesh_devices = mesh_devices
+        self._reconfig_lock = threading.Lock()
+        self._reconfig_pending: Optional[dict] = None
+        self._reconfig_seq = 0
 
     # -- fd_flight views: the registry lane is the ONE authority for
     # dispatch/healing stats; these read-only properties keep the
@@ -1801,13 +1812,15 @@ class VerifyTile(Tile):
             slot.t_first = now  # deadline anchor: first STAGED txn
         # Ring dwell (producer publish -> this drain) of the round's
         # oldest frag: the feeder's input-backlog gauge (reported as
-        # stage latency). tspub is a 32-bit tick; reject absurd dwells
-        # (> ~4 s) as wrap artifacts. Dwell is NOT folded into the
-        # flush deadline: with a backlog the next round fills the batch
-        # in O(ms) anyway, and turning old-but-plentiful input into
-        # partial flushes would trade fill ratio for nothing.
-        dwell = (now - int(slot.tspubs[k0])) & 0xFFFFFFFF
-        if dwell < 4_000_000_000:
+        # stage latency). tspub is a 32-bit tick; xray.dwell32 recovers
+        # the modular difference (exact across any number of 2^32 ns
+        # clock wraps — tests/test_clock_wrap.py) and rejects absurd
+        # dwells (> ~4 s) as wrap artifacts. Dwell is NOT folded into
+        # the flush deadline: with a backlog the next round fills the
+        # batch in O(ms) anyway, and turning old-but-plentiful input
+        # into partial flushes would trade fill ratio for nothing.
+        dwell = xray.dwell32(now, int(slot.tspubs[k0]))
+        if dwell >= 0:
             if len(self.stat_ring_dwell_ns) < 65536:
                 self.stat_ring_dwell_ns.append(dwell)
             if self._dwell_span is not None:
@@ -2329,6 +2342,173 @@ class VerifyTile(Tile):
             ol.lat_sample_many(lats, ts)
         return slot.drain_end
 
+    # -- fd_soak zero-downtime live reconfig -----------------------------
+
+    def request_reconfig(self, req: dict) -> tuple:
+        """Validate + park ONE live-reconfig request (callable from any
+        thread); the dispatcher applies it at the next inflight-window
+        barrier (_feed_poll -> _apply_reconfig). Returns
+        (accepted, detail).
+
+        The request dict: 'ladder' (optional list of rung batch sizes
+        — the staging batch is always appended: arenas are sized to
+        it, so a swap replaces the ladder BELOW it), 'verify_mode'
+        (optional rlc|direct re-resolution), 'env' (optional FD_* flag
+        flips the controller has ALREADY exported — FD_FRONTEND_IMPL /
+        FD_DECOMPRESS_IMPL / FD_DRAIN — which the barrier apply
+        re-resolves through the registry). A request that cannot
+        produce a dispatchable configuration is REFUSED here,
+        atomically, with the running config untouched: an invalid
+        mode/backend combination (rlc on a host backend), a ladder
+        with fewer than 2 usable rungs, or a swap already pending (the
+        double-swap race — one barrier, one swap)."""
+        from firedancer_tpu.disco import engine as fd_engine
+
+        def refuse(reason: str) -> tuple:
+            self.fl.inc("reconfig_refused")
+            self.flightrec.record("reconfig_refused", reason=reason)
+            return False, reason
+
+        if not self._feed:
+            return refuse("reconfig requires the fd_feed staging path")
+        mode = req.get("verify_mode") or self.verify_mode
+        try:
+            mode = fd_engine.resolve_verify_mode(
+                self.backend, mode, self.mesh_devices)
+        except ValueError as e:
+            return refuse(str(e))
+        ladder = req.get("ladder")
+        rungs = None
+        if ladder is not None:
+            if not flags.get_bool("FD_ENGINE_SCHED"):
+                return refuse("ladder swap with FD_ENGINE_SCHED=0")
+            try:
+                rungs = sorted({int(r) for r in ladder})
+            except (TypeError, ValueError):
+                return refuse(f"unparseable ladder {ladder!r}")
+            rungs = [r for r in rungs if MAX_SIG_CNT <= r <= self.batch]
+            if self.mesh_devices:
+                rungs = [r for r in rungs
+                         if r % self.mesh_devices == 0]
+            if self.batch not in rungs:
+                rungs.append(self.batch)
+                rungs.sort()
+            if len(rungs) < 2:
+                return refuse(
+                    f"ladder {ladder!r} leaves < 2 usable rungs under "
+                    f"staging batch {self.batch}")
+        with self._reconfig_lock:
+            if self._reconfig_pending is not None:
+                return refuse(
+                    "a reconfig is already pending (one barrier, one "
+                    "swap)")
+            self._reconfig_seq += 1
+            pend = {"seq": self._reconfig_seq, "verify_mode": mode,
+                    "env": dict(req.get("env") or {})}
+            if rungs is not None:
+                pend["ladder"] = rungs
+            self._reconfig_pending = pend
+        self.flightrec.record("reconfig_request", seq=pend["seq"],
+                              mode=mode,
+                              ladder=list(rungs) if rungs else None)
+        return True, f"pending (seq {pend['seq']})"
+
+    def _apply_reconfig(self) -> None:
+        """Swap the engine configuration in the dispatch gap: called by
+        the dispatcher ONLY at the inflight-window barrier (zero
+        batches in flight), so no dispatch holds an engine across the
+        swap and sink continuity is digest-exact by construction —
+        staged/READY slots are untouched and simply dispatch on the
+        new engines. Old rung engines unreachable under the new
+        configuration are retired from the registry."""
+        from firedancer_tpu.disco import engine as fd_engine
+
+        with self._reconfig_lock:
+            req = self._reconfig_pending
+        if req is None:
+            return
+        t0 = time.perf_counter()
+        old_specs = {self._engine_spec}
+        if self.rung_sched is not None and self.backend == "tpu":
+            old_specs |= {self._engine_spec.with_batch(r)
+                          for r in self.rung_sched.rungs}
+        mode = req["verify_mode"]
+        spec = fd_engine.EngineSpec.for_tile(
+            self.backend, mode, self.batch, self.mesh_devices)
+        cold_primary = False
+        if self.backend == "tpu":
+            e = self._registry.warm_entry(spec)
+            if e is None:
+                # Unwarmed target (the controller prewarms before
+                # requesting; this is the cold-swap fallback): one
+                # blocking acquire — the barrier already paused
+                # dispatch, and stalling here beats dispatching on a
+                # half-built engine.
+                cold_primary = True
+                e, warmed_now = self._registry.acquire(
+                    spec, warm=True, max_msg_len=self.max_msg_len)
+                if warmed_now:
+                    self._account_compile(e.key, e.compile_s)
+                    if mode == "rlc":
+                        self._account_compile(
+                            e.key + ":fallback", e.fallback_compile_s)
+            self._engine_entry = e
+            self._verify_batch_fn = e.fn
+        else:
+            self._engine_entry = self._registry.entry(spec)
+        self._engine_spec = spec
+        self._engine_key = spec.key
+        self.verify_mode = mode
+        rungs = req.get("ladder")
+        if rungs is None and self.rung_sched is not None:
+            # Flag-flip-only reconfig under an active scheduler: keep
+            # the rung list, rebuild the per-rung engines on the new
+            # spec below.
+            rungs = list(self.rung_sched.rungs)
+        new_specs = {spec}
+        if rungs is not None and len(rungs) >= 2:
+            cost = None
+            if self.backend == "tpu":
+                self._rung_entries = {
+                    r: self._registry.entry(spec.with_batch(r))
+                    for r in rungs
+                }
+                ents = self._rung_entries
+
+                def cost(r, _e=ents):
+                    return _e[r].service_est_ns()
+
+                self._registry.prewarm_ladder(
+                    [spec.with_batch(r) for r in rungs
+                     if r != self.batch],
+                    max_msg_len=self.max_msg_len)
+                new_specs |= {spec.with_batch(r) for r in rungs}
+            self.rung_sched = fd_engine.RungScheduler(
+                rungs, self.max_wait_ns, cost_ns=cost,
+                shards=self.mesh_devices or 1)
+            self.flush_policy = self.rung_sched.flush
+            self.fl.set_gauge("rung_cur", rungs[0])
+            self._rung_last = rungs[0]
+        retired = 0
+        if self.backend == "tpu":
+            retired = self._registry.retire(
+                [s for s in old_specs if s not in new_specs])
+        drain_flip = "FD_DRAIN" in (req.get("env") or {})
+        if drain_flip:
+            # _drain_setup re-reads drain_mode() and rebuilds (or
+            # tears down) the aux graph from scratch — it is the one
+            # FD_DRAIN resolution point, so the flip routes through it.
+            self._drain_setup()
+        with self._reconfig_lock:
+            self._reconfig_pending = None
+        self.fl.inc("reconfigs")
+        self.flightrec.record(
+            "reconfig", seq=req["seq"], mode=mode, engine=spec.key,
+            rungs=list(rungs) if rungs else None, retired=retired,
+            cold_primary=cold_primary, drain=drain_flip,
+            barrier_acked=self._acked_seq,
+            apply_ms=round((time.perf_counter() - t0) * 1e3, 3))
+
     def _feed_poll(self):
         """Dispatcher round (the feed-mode poll_inputs): retire one
         completion, ship every READY slot up to the in-flight cap, and
@@ -2339,13 +2519,21 @@ class VerifyTile(Tile):
             self._feed_start()
         self._stager_supervise()
         self._complete(block=False)
+        if self._reconfig_pending is not None and not self._inflight:
+            # fd_soak live-reconfig barrier: with a swap pending, new
+            # dispatches hold until the inflight WINDOW drains (the
+            # stager keeps staging — READY slots queue and upstream
+            # rings absorb offered load), then the swap happens in the
+            # gap. Never a whole-pipeline drain.
+            self._apply_reconfig()
         progressed = False
-        while len(self._inflight) < self.inflight_max:
-            slot = self.feed_pool.pop_ready()
-            if slot is None:
-                break
-            self._feed_dispatch(slot)
-            progressed = True
+        if self._reconfig_pending is None:
+            while len(self._inflight) < self.inflight_max:
+                slot = self.feed_pool.pop_ready()
+                if slot is None:
+                    break
+                self._feed_dispatch(slot)
+                progressed = True
         now = tempo.tickcount()
         if self.stat_batches and not self._inflight \
                 and self.feed_pool.ready_cnt() == 0:

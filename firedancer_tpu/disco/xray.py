@@ -83,6 +83,27 @@ _HASH_MULT = 0x9E3779B1
 # queue waits (the stager's existing rule for the verify_drain stage).
 _DWELL_WRAP_NS = 4_000_000_000
 
+
+def dwell32(now_ns: int, ts32: int) -> int:
+    """Recover a queue dwell from a 32-bit tick stamp against a full-
+    width monotonic now, or -1 when it cannot be trusted.
+
+    The stamps (tsorig/tspub) are minted as ``tickcount() & 0xFFFFFFFF``
+    and the 32-bit window wraps every ~4.29 s, so on a multi-hour clock
+    ``now - ts32`` is meaningless unless reduced mod 2^32: the modular
+    difference is EXACT for any true dwell < 2^32 ns, however many
+    times the absolute clock has wrapped since boot. What cannot be
+    recovered is a dwell >= 2^32 ns — it aliases into [0, 2^32) and is
+    indistinguishable from a fresh sample (the pipeline_progress SLO
+    owns multi-second stalls, not the dwell histograms). Differences
+    in [_DWELL_WRAP_NS, 2^32) are rejected as wrap artifacts: they
+    arise when the producer stamped in a window the consumer's reduced
+    clock has already left, and admitting them would book phantom ~4 s
+    dwells every wrap. tests/test_clock_wrap.py pins both properties
+    across multiple wraps."""
+    d = (int(now_ns) - int(ts32)) & _U32
+    return d if d < _DWELL_WRAP_NS else -1
+
 # Trigger classes an exemplar span/event can carry.
 TRIGGERS = ("head", "tail", "quarantine", "breaker", "ctl_err", "crash")
 

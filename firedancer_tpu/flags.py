@@ -820,6 +820,30 @@ _register(
     "the filter is paying its dispatch cost without skipping probes — "
     "banks too small for the tag rate, or rotation starved.",
 )
+_register(
+    "FD_SLO_HEAP_SLOPE_KB", int, 512,
+    "fd_soak heap-growth tripwire budget, KiB per minute: the slope-"
+    "kind heap_slope SLO alerts when the least-squares fit over the "
+    "soak probe's tracemalloc samples grows faster than this. Only a "
+    "soak run registers a slope source (sentinel.set_slope_source), so "
+    "ordinary pipeline runs never arm it.",
+)
+_register(
+    "FD_SLO_POOL_SLOPE_MILLI", int, 250,
+    "fd_soak slot-pool occupancy tripwire budget, milli-slots per "
+    "minute: the pool_occupancy_slope SLO alerts when the fitted "
+    "trend of outstanding fd_feed slots (FREE excluded) grows faster "
+    "than this — the leaked-slot / stuck-inflight signature that only "
+    "shows over hours. 250 = a quarter slot per minute.",
+)
+_register(
+    "FD_SLO_COMPILE_SLOPE", int, 6,
+    "fd_soak compile-cache tripwire budget, new engine-cache entries "
+    "per hour: the compile_cache_slope SLO alerts when EngineRegistry "
+    "entries + recorded compiles keep accreting past the prewarmed "
+    "ladder — the unbounded-recompile signature (a shape leak or a "
+    "reconfig that never retires old engines).",
+)
 # --------------------------------------------------------------------------
 # fd_xray — tail-sampled exemplar traces, per-edge queue attribution,
 # and automated postmortems (disco/xray.py). All read per run; tail
@@ -874,6 +898,56 @@ _register(
     "scripts/fd_report.py regression threshold: a device measurement "
     "more than this far below its series' rolling best-of baseline "
     "(same metric x mode x batch) is flagged as a regression.",
+)
+
+# --------------------------------------------------------------------------
+# fd_soak — the long-horizon soak harness (disco/soak.py) and the
+# zero-downtime live-reconfig control channel it exercises. All read
+# per run; the slope-kind SLO budgets live in the FD_SLO_* section.
+# --------------------------------------------------------------------------
+
+_register(
+    "FD_RECONFIG", str, None,
+    "Path to the live-reconfig request file (JSON: ladder / flag "
+    "flips / drain mode). When set, the soak's reconfig controller "
+    "installs a SIGHUP handler and also polls the file's mtime: on "
+    "either signal it prewarms the requested rung ladder off-thread, "
+    "then swaps it into the running VerifyTile at the next inflight-"
+    "window barrier — zero dropped txns, digest-exact continuity. "
+    "Unset (the default) installs nothing.",
+)
+_register(
+    "FD_SOAK_SEED", int, 606,
+    "fd_soak master seed: the phase schedule, per-phase corpus/tenant "
+    "mix, offered-load drift, and chaos schedules all derive from it, "
+    "so a soak (and its failure) replays bit-identically.",
+)
+_register(
+    "FD_SOAK_PHASES", int, 6,
+    "Number of soak phases. Each phase rotates to the next siege-"
+    "derived workload profile, re-draws the corpus mix, and shifts "
+    "offered load on the deterministic schedule.",
+)
+_register(
+    "FD_SOAK_PHASE_S", float, 600.0,
+    "Wall-clock seconds per soak phase. The scripted N-hour soak is "
+    "FD_SOAK_PHASES x this; scripts/soak_smoke.py compresses it to "
+    "a ~60 s CI lane without changing the judgment layer.",
+)
+_register(
+    "FD_SOAK_PROBE_MS", int, 500,
+    "fd_soak resource-probe sampling interval: each tick samples "
+    "tracemalloc heap, slot-pool occupancy, engine-cache entries, and "
+    "flight/xray ring high-water marks for the slope fits feeding the "
+    "slope-kind sentinel SLOs.",
+)
+_register(
+    "FD_SOAK_RESPAWN_BUDGET", int, 30,
+    "fd_soak respawn-rate budget, restarts per hour (stager restarts "
+    "+ supervised tile respawns combined): a soak phase that exceeds "
+    "the pro-rated budget fails its verdict — sustained crash-respawn "
+    "storms are a failure even when every restart individually "
+    "succeeds.",
 )
 
 # --------------------------------------------------------------------------

@@ -174,6 +174,29 @@ THREAD_TABLE: Tuple[ThreadSite, ...] = (
         "operates on caller-passed arrays only, never workspace rows",
     ),
     ThreadSite(
+        "firedancer_tpu/disco/soak.py", "ResourceProbe.start:self._loop",
+        "fd_soak resource probe: fixed-cadence sampler behind the "
+        "slope-kind sentinel SLO rows (tracemalloc heap, slot-pool "
+        "occupancy, engine-registry entries, alert totals); appends "
+        "samples only — no cross-thread attribute stores",
+        "Event stopped and joined in stop(); run_soak stops it in its "
+        "finally block, before run_feed_pipeline's runner leaves",
+        "reads mapped fd_flight SLO rows (read_slos) until stop(), "
+        "which run_soak orders before the runner's wksp.leave()",
+    ),
+    ThreadSite(
+        "firedancer_tpu/disco/soak.py",
+        "ReconfigController.start:self._loop",
+        "fd_soak live-reconfig control channel: polls the FD_RECONFIG "
+        "request file's mtime + the SIGHUP Event and parks validated "
+        "swap requests on the verify tile's lock-guarded mailbox",
+        "Event stopped and joined in stop(); run_soak stops it in its "
+        "finally block, before run_feed_pipeline's runner leaves",
+        "touches os.environ (via module-level _export_env) and the "
+        "tile's _reconfig_lock-guarded request slot only, never "
+        "workspace rows",
+    ),
+    ThreadSite(
         "microbench.py", "bench_ring_pipeline_hop:replay.run",
         "replay tile driving the ring-hop microbench",
         "runs until CNC_HALT; the bench signals and joins it",

@@ -7,7 +7,7 @@ plus the BENCH/REPLAY/MULTICHIP/PACK/HOSTFEED artifact family are
 parsed into one schema-normalized timeline (pre-schema_version legacy
 lines included), rendered as per-mode/per-B/per-stage trend tables,
 checked against the rolling best-of baseline (FD_REPORT_REGRESS_PCT),
-and reconciled against the thirteen ROOFLINE.md falsifiable predictions —
+and reconciled against the fourteen ROOFLINE.md falsifiable predictions —
 each listed pending until a matching schema_version-2 artifact lands,
 then auto-graded confirmed/falsified (the BENCH_r06 hardware session
 self-grades).
@@ -245,6 +245,38 @@ def render_drain(timeline) -> List[str]:
     return lines
 
 
+def render_soak(timeline) -> List[str]:
+    """The fd_soak long-horizon table: one row per SOAK_r*.json
+    artifact — duration, sustained rate, unexplained alerts, the
+    slope-tripwire verdict, the reconfig trail, respawn budget, drop
+    count, and whether the row is on-device (only hour-scale on-device
+    rows can grade prediction 14)."""
+    lines = ["== FD_SOAK LONG-HORIZON RUNS (drift + chaos + reconfig) =="]
+    rows = sentinel.soak_status(timeline)
+    if not rows:
+        lines.append("(no SOAK_r*.json artifacts yet — run "
+                     "scripts/fd_soak.py or scripts/soak_smoke.py)")
+        return lines
+    for r in rows:
+        verdict = "OK  " if r["ok"] else "FAIL"
+        where = "DEVICE" if r["on_device"] else "cpu-backend"
+        dm = r["digest_match"]
+        dm_s = "n/a" if dm is None else ("exact" if dm else "BROKEN")
+        lines.append(
+            f"  [{verdict}] {r['duration_s']}s @ {r['value']} "
+            f"{r['unit']} ({where}); {r['phases']} phases, alerts "
+            f"{r['alert_cnt']} ({r['unexplained_alerts']} unexplained), "
+            f"slopes {'flat' if r['slopes_within_budget'] else 'OVER'} "
+            f"(heap {r['heap_kb_min']} KiB/min), reconfigs "
+            f"{r['reconfigs_applied']}/{r['reconfigs_refused']} "
+            f"applied/refused, digests {dm_s}, dropped {r['dropped']}, "
+            f"respawn {'ok' if r['respawn_ok'] else 'STORM'} "
+            f"[{r['source']}]")
+        for fmsg in r["failures"]:
+            lines.append(f"         - {fmsg}")
+    return lines
+
+
 def render_gates(timeline) -> List[str]:
     lines = ["== THROUGHPUT GATES =="]
     best: dict = {}
@@ -283,6 +315,7 @@ def render_report(timeline, regress_pct=None) -> str:
                     render_siege(timeline),
                     render_pod(timeline),
                     render_drain(timeline),
+                    render_soak(timeline),
                     render_regressions(regs),
                     render_ledger(ledger)):
         parts.extend(section)

@@ -19,7 +19,7 @@ Four checks, one small mainnet-shaped corpus on the CPU backend:
   3. REPORT / LEDGER — scripts/fd_report.py must ingest the repo's
      REAL BENCH_LOG.jsonl + artifact family without a single parse
      error, render the trajectory, and the prediction ledger must list
-     all thirteen ROOFLINE predictions with machine-checkable rules
+     all fourteen ROOFLINE predictions with machine-checkable rules
      (all currently pending — BENCH_r06 auto-grades them) and
      round-trip through JSON.
 
@@ -207,8 +207,8 @@ def check_report() -> None:
         if needle not in text:
             fail(f"fd_report render missing section {needle!r}")
     ledger = sentinel.prediction_ledger(timeline)
-    if len(ledger) != 13:
-        fail(f"prediction ledger has {len(ledger)} entries, want 13")
+    if len(ledger) != 14:
+        fail(f"prediction ledger has {len(ledger)} entries, want 14")
     for p in ledger:
         if p["verdict"] != "pending":
             fail(f"prediction {p['id']} pre-graded {p['verdict']!r} from "
@@ -217,15 +217,29 @@ def check_report() -> None:
             fail(f"prediction {p['id']} has no machine-checkable rule")
     if json.loads(json.dumps(ledger)) != ledger:
         fail("ledger does not round-trip through JSON")
-    log(f"report OK ({len(timeline)} entries ingested, 13 predictions "
+    log(f"report OK ({len(timeline)} entries ingested, 14 predictions "
         "pending)")
 
 
 def check_overhead(tmp, corpus, dt_on: float) -> None:
-    _topo, res_off, dt_off = _run(tmp, corpus, "off", FD_FLIGHT="0",
+    # The clean half's dt_on is the FIRST pipeline run in this process:
+    # it pays jax dispatch warmup and graph compilation that later runs
+    # (including the off half below) never see, so comparing it against
+    # a warm off run measures warmup, not instrumentation. Re-measure
+    # the on half now that the process is warm and take the best of the
+    # two on-samples and of two off-samples — a real always-on cost
+    # shifts the minimum, scheduler jitter (brutal on a 1-core host,
+    # where the sentinel poll thread shares the core with the pipeline)
+    # does not.
+    _topo, _res, dt_on2 = _run(tmp, corpus, "on2")
+    dt_on = min(dt_on, dt_on2)
+    dt_off = None
+    for tag in ("off", "off2"):
+        _topo, res_off, dt = _run(tmp, corpus, tag, FD_FLIGHT="0",
                                   FD_TRACE_SPANS="0", FD_SENTINEL="0")
-    if res_off.slo is not None:
-        fail("FD_SENTINEL=0 run still produced a sentinel summary")
+        if res_off.slo is not None:
+            fail("FD_SENTINEL=0 run still produced a sentinel summary")
+        dt_off = dt if dt_off is None else min(dt_off, dt)
     # 5% gate with an absolute floor (same rationale as obs_smoke: on a
     # small corpus the run is ~1 s and scheduler jitter dwarfs any real
     # always-on cost).

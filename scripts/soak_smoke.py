@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""soak_smoke — the fd_soak long-horizon-judgment gate (ci.sh lane).
+
+One compressed soak (~60 s wall total, CPU backend) proving the whole
+fd_soak machine end to end before anyone trusts an hour-scale run:
+
+  1. DRIFT + CHAOS — a 3-phase seeded drift plan (profiles rotate,
+     offered load drifts, ONE chaos class: the plan's phase-1 hb_stall
+     window) runs through the full feed pipeline under the soak
+     instrumentation. Gate: the judgment layer books ZERO unexplained
+     alerts (injected chaos is explained by class + collateral, nothing
+     else may alert) and zero dropped txns / leaked slots.
+
+  2. LIVE RECONFIG — mid-run (SIGALRM -> controller.trigger(), the same
+     Event the SIGHUP handler sets) the prewarmed rung ladder is
+     swapped and FD_DECOMPRESS_IMPL flipped, at the inflight-window
+     barrier. Gate: exactly the requested swap applied, zero refused,
+     and the sink digest MULTISET is byte-identical to a no-chaos
+     no-reconfig control run over the same payload schedule — the
+     zero-downtime claim, checked at the strongest granularity.
+
+  3. TRIPWIRES ARMED — the resource probe must collect enough
+     steady-state samples to arm the slope tripwires (>= sentinel
+     MIN_SLOPE_SAMPLES after warmup discard) and every slope must sit
+     within its (env-pinned, compressed-window) budget — a flat
+     tracemalloc heap, a flat slot pool, a quiet compile cache.
+
+  4. ARTIFACT — the record passes bench_log_check.validate_soak and is
+     written to SOAK_r01.json at the repo root (the committed member of
+     the artifact family fd_sentinel ingests for prediction 14).
+
+Exits nonzero on any violation; prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable as `python scripts/soak_smoke.py`
+    sys.path.insert(0, REPO)
+
+SEED = 23
+PHASES = 3          # drift rotation gives phase 1 the hb_stall window
+PHASE_S = 6.0
+RATE = 150.0
+SWAP_AT_S = 7.0     # mid phase 1: the swap lands with windows inflight
+LADDER = [64, 128]  # + batch appended by the reconfig validator
+ARTIFACT = os.path.join(REPO, "SOAK_r01.json")
+
+# Compressed-window SLO env (drain_smoke precedent): CPU-lane latency
+# budgets scaled out of the way, slope budgets scaled UP but finite —
+# the probe still trips on runaway growth, it just tolerates the
+# startup-heavy profile of a ~20 s window that an hour-scale run
+# amortizes away. FD_SOAK_PROBE_MS=250 arms the slope rows (~70 raw
+# samples, ~50 post warmup discard >= MIN_SLOPE_SAMPLES).
+SLO_ENV = {
+    "FD_SLO_E2E_BUDGET_MS": "900000",
+    "FD_SLO_SOURCE_BUDGET_MS": "900000",
+    "FD_SLO_QUIC_INGEST_MS": "900000",
+    "FD_SLO_HEAP_SLOPE_KB": "16384",
+    "FD_SLO_POOL_SLOPE_MILLI": "200000",
+    "FD_SLO_COMPILE_SLOPE": "36000",
+    "FD_ENGINE_SCHED": "1",
+    "FD_SOAK_PROBE_MS": "250",
+    # Cold-compile stalls (a fresh CI host's first verify-engine build)
+    # must not masquerade as liveness alerts: the chaos gate below
+    # judges the injected CLASS (rec.slo.explained), never alert
+    # presence, so scaling these budgets costs the lane nothing.
+    "FD_SLO_STALL_MS": "300000",
+    "FD_SLO_HB_MS": "120000",
+}
+
+
+def log(msg: str) -> None:
+    print(f"soak_smoke: {msg}", flush=True)
+
+
+def fail(msg: str) -> None:
+    print(f"soak_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _with_env(env, fn):
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        return fn()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_soak_half(plan, payloads, tmp):
+    """The chaos + live-reconfig run: the plan's own chaos schedule is
+    armed, and a SIGALRM at SWAP_AT_S fires the controller's SIGHUP
+    Event against a pre-written request file."""
+    from firedancer_tpu.disco import soak
+
+    req_path = os.path.join(tmp, "reconfig.json")
+    with open(req_path, "w", encoding="utf-8") as f:
+        json.dump({"ladder": LADDER,
+                   "env": {"FD_DECOMPRESS_IMPL": "xla"}}, f)
+    controller = soak.ReconfigController(path=req_path, poll_s=0.1)
+    prev = signal.signal(signal.SIGALRM,
+                         lambda _s, _f: controller.trigger())
+    signal.setitimer(signal.ITIMER_REAL, SWAP_AT_S)
+    try:
+        env = dict(SLO_ENV)
+        env.update(soak.chaos_env(plan))
+        rec, res = _with_env(env, lambda: soak.run_soak(
+            plan, payloads=payloads, verify_backend="cpu",
+            verify_batch=256, controller=controller,
+            record_digests=True, workdir=os.path.join(tmp, "soak")))
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, prev)
+        os.environ.pop("FD_DECOMPRESS_IMPL", None)  # the swap's flip
+    return rec, res
+
+
+def run_control_half(plan, payloads, tmp):
+    """The same payload schedule, no chaos, no reconfig — the digest
+    baseline the zero-downtime claim is checked against."""
+    from firedancer_tpu.disco import soak
+
+    return _with_env(dict(SLO_ENV), lambda: soak.run_soak(
+        plan, payloads=payloads, verify_backend="cpu",
+        verify_batch=256, record_digests=True,
+        workdir=os.path.join(tmp, "control")))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.perf_counter()
+
+    from firedancer_tpu.disco import sentinel, soak
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import bench_log_check
+
+    plan = soak.build_plan(seed=SEED, n_phases=PHASES, phase_s=PHASE_S,
+                           rate=RATE)
+    chaos_classes = sorted({ph.chaos for ph in plan.phases if ph.chaos})
+    if chaos_classes != ["hb_stall"]:
+        fail(f"compressed plan drifted: want exactly one chaos class "
+             f"(hb_stall), got {chaos_classes}")
+    payloads = soak.build_payloads(plan, sign_batch_size=1024)
+    log(f"plan ready: {PHASES} phases, {len(payloads)} payloads, "
+        f"chaos {plan.chaos_schedule!r}")
+
+    import tempfile
+    with tempfile.TemporaryDirectory(prefix="fd_soak_smoke_") as tmp:
+        rec, res = run_soak_half(plan, payloads, tmp)
+        ctl_rec, ctl_res = run_control_half(plan, payloads, tmp)
+
+    # 1. Judgment layer: everything the soak verdicts gate, both runs.
+    if rec["slo"]["unexplained_alerts"]:
+        fail(f"unexplained alerts on the chaos half: {rec['slo']}")
+    if "hb_stall" not in rec["slo"]["explained"]:
+        fail(f"plan's hb_stall window never injected: "
+             f"explained={rec['slo']['explained']}")
+    if ctl_rec["slo"]["alert_cnt"]:
+        fail(f"control run booked alerts: {ctl_rec['slo']}")
+    for name, r in (("soak", rec), ("control", ctl_rec)):
+        if len(r["phases"]) != PHASES:
+            fail(f"{name} run logged {len(r['phases'])} phases, "
+                 f"want {PHASES}")
+        if r["continuity"]["dropped"]:
+            fail(f"{name} run dropped {r['continuity']['dropped']} txns")
+        if r["continuity"]["slots_leaked"]:
+            fail(f"{name} run leaked slots: {r['continuity']}")
+
+    # 2. Live reconfig: exactly the one requested swap, applied at the
+    #    barrier, ladder in force, digest-exact vs the control.
+    if rec["reconfig"]["applied"] != 1 or rec["reconfig"]["refused"]:
+        fail(f"reconfig trail off: {rec['reconfig']}")
+    vs = (res.verify_stats or [{}])[0]
+    if vs.get("rung_ladder") != LADDER + [256]:
+        fail(f"swapped ladder not in force: {vs.get('rung_ladder')}")
+    match = sorted(res.sink_digests) == sorted(ctl_res.sink_digests)
+    rec["continuity"]["digest_match"] = match
+    if not match:
+        rec["ok"] = False
+        rec["failures"].append(
+            "sink digest multiset diverged from the no-reconfig control")
+        fail(f"digest continuity broken across the swap: "
+             f"{len(res.sink_digests)} vs {len(ctl_res.sink_digests)} "
+             "sink digests")
+    log(f"reconfig OK (1 applied, 0 refused, ladder {vs['rung_ladder']}, "
+        f"{len(res.sink_digests)} digests exact vs control)")
+
+    # 3. Tripwires: armed on steady-state evidence AND flat.
+    if rec["slopes"]["samples"] < sentinel.MIN_SLOPE_SAMPLES:
+        fail(f"slope tripwires never armed: {rec['slopes']['samples']} "
+             f"samples < {sentinel.MIN_SLOPE_SAMPLES}")
+    if not rec["slopes"]["within_budget"]:
+        fail(f"resource slope over budget: {rec['slopes']}")
+    if not rec["ok"]:
+        fail(f"soak judged not-ok: {rec['failures']}")
+    if not ctl_rec["ok"]:
+        fail(f"control judged not-ok: {ctl_rec['failures']}")
+
+    # 4. Artifact: schema-valid, then committed at the repo root.
+    errs = bench_log_check.validate_soak(rec)
+    if errs:
+        fail(f"SOAK record fails validate_soak: {errs}")
+    with open(ARTIFACT, "w", encoding="utf-8") as f:
+        json.dump(rec, f, indent=1, sort_keys=True)
+        f.write("\n")
+    log(f"artifact OK ({os.path.relpath(ARTIFACT, REPO)})")
+
+    print(json.dumps({
+        "metric": "soak_smoke", "ok": True,
+        "phases": PHASES, "txns": len(payloads),
+        "heap_kb_min": rec["slopes"]["heap_kb_min"],
+        "alerts": rec["slo"]["alert_cnt"],
+        "reconfigs": rec["reconfig"]["applied"],
+        "digest_match": match,
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

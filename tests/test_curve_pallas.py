@@ -9,6 +9,7 @@ undecompressable y, small-order points).
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from firedancer_tpu.ballet import ed25519 as oracle
 from firedancer_tpu.ops import curve25519 as ge
@@ -66,6 +67,9 @@ def test_decompress_pallas_matches_xla():
         assert np.array_equal(a, b)
 
 
+@pytest.mark.slow  # Pallas-interpreter kernel body (~37 s on a CPU
+# core); tier-1 keeps compress coverage on the XLA path via
+# test_curve_and_verify.py and the decompress parity tests here
 def test_compress_pallas_matches_xla():
     enc = _encodings()
     pt, ok = ge.decompress(enc)
@@ -105,6 +109,9 @@ def test_decompress_pallas_small_batch_falls_back():
         assert np.array_equal(np.asarray(c_ref), np.asarray(c_k))
 
 
+@pytest.mark.slow  # Pallas-interpreter kernel body (~25 s on a CPU
+# core); the niels output contract rides tier-1 on the XLA path via
+# test_frontend_fused.py's kernel-body parity tests
 def test_decompress_pallas_niels_outputs():
     """want_niels: kernel-emitted (yp, ym, t2d, t2dn) must equal the
     XLA niels prep on the decompressed points, canonically."""
@@ -126,6 +133,9 @@ def test_decompress_pallas_niels_outputs():
         assert np.array_equal(a, b)
 
 
+@pytest.mark.slow  # Pallas-interpreter kernel body (~45 s on a CPU
+# core); tier-1 keeps the small-order mask contract on the XLA path
+# via test_decompress_batch.py and test_frontend_fused.py
 def test_decompress_pallas_small_order_output():
     """want_small_order: the kernel's in-VMEM 8P==O mask must match the
     XLA small_order_mask AND the oracle's is_small_order on every

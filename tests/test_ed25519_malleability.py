@@ -43,6 +43,9 @@ def test_vector_counts():
     assert len(SHOULD_FAIL) == 196
 
 
+@pytest.mark.slow  # 396 per-lane oracle verifies (~50 s on a CPU core);
+# tier-1 keeps the end-to-end vector coverage via
+# test_curve_and_verify.py::test_verify_batch_rfc8032
 def test_oracle_agrees_with_vectors():
     from firedancer_tpu.ballet.ed25519 import oracle
 

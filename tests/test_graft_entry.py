@@ -13,6 +13,9 @@ import pytest
 import __graft_entry__ as graft
 
 
+@pytest.mark.slow  # one 128-lane verify compile (~26 s on a CPU core);
+# the same graph underlies every verify parity test in tier-1 and
+# ci.sh drives the entry module directly via dryrun_multichip(8)
 def test_entry_jits_and_runs():
     fn, args = graft.entry()
     out = jax.jit(fn)(*args)

@@ -52,6 +52,9 @@ def test_differential_parse_corpus():
     assert checked == 20_000 and agree_ok > 1000
 
 
+@pytest.mark.slow  # ~31 s on a CPU core; tier-1 keeps the native-drain
+# per-frag semantics via test_frag_drain_preserves_ctl and the feed
+# runtime's bulk-drain integration tests in test_drain.py
 def test_native_drain_pipeline(tmp_path):
     """Replay corpus through the pipeline with the native drain active
     (backend='tpu' single-lane enables it): same gate as test_replay_gate

@@ -76,6 +76,9 @@ def test_pipeline_end_to_end(tmp_path, backend):
             assert d["ovrnr_cnt"] == 0 and d["ovrnp_cnt"] == 0, (name, d)
 
 
+@pytest.mark.slow  # ~34 s on a CPU core; tier-1 keeps the tpu-backend
+# shim coverage via test_pipeline_end_to_end[tpu], and the feed runtime
+# covers multi-batch inflight windows in test_feed_runtime
 def test_pipeline_async_shim_multibatch(tmp_path):
     """tpu backend with a small fixed batch: several async batches go in
     flight (the wiredancer offload shim), the trailing partial batch is

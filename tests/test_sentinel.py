@@ -29,7 +29,7 @@ def test_slo_table_typed_and_unique():
     assert len(names) == len(set(names))
     for s in sentinel.SLO_TABLE:
         assert s.kind in ("latency", "liveness", "balance",
-                          "effectiveness"), s.name
+                          "effectiveness", "slope"), s.name
         assert s.objective, s.name
         assert s.budget_flag in __import__(
             "firedancer_tpu.flags", fromlist=["REGISTRY"]).REGISTRY, s.name
@@ -299,10 +299,10 @@ def test_timeline_ingests_repo_history_without_error():
     assert any(e.legacy for e in timeline)
 
 
-def test_prediction_ledger_all_thirteen_pending_on_repo_history():
+def test_prediction_ledger_all_fourteen_pending_on_repo_history():
     ledger = sentinel.prediction_ledger(sentinel.load_timeline(REPO))
-    assert len(ledger) == 13
-    assert [p["id"] for p in ledger] == list(range(1, 14))
+    assert len(ledger) == 14
+    assert [p["id"] for p in ledger] == list(range(1, 15))
     for p in ledger:
         assert p["verdict"] == "pending", p
         assert p["rule"] and p["predicted"], p
@@ -353,6 +353,14 @@ def test_prediction_ledger_autogrades_synthetic_r06():
                             "drain_speedup": 1.8,
                             "pack": {"rewards_per_cu_ratio": 1.05,
                                      "batch": 65536}},
+                           "synthetic"),
+        sentinel._classify({"metric": "soak_run", "schema_version": 2,
+                            "ts": "2026-08-09T00:00:00Z",
+                            "on_device": True, "duration_s": 4 * 3600.0,
+                            "slo": {"unexplained_alerts": 0},
+                            "slopes": {"within_budget": True},
+                            "reconfig": {"applied": 1},
+                            "continuity": {"dropped": 0}},
                            "synthetic"),
     ]
     ledger = sentinel.prediction_ledger(timeline)

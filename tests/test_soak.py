@@ -1,0 +1,468 @@
+"""fd_soak — the long-horizon soak harness + live-reconfig contract.
+
+Four layers, matching the subsystem's pieces: plan/corpus unit tests
+(one seed scripts the whole soak — profiles, drift, chaos schedule,
+phase indexing — deterministically), judgment-surface unit tests
+(slope math with the warmup discard, alert attribution, the chaos
+collateral map, the artifact validator against the committed
+SOAK_r01.json), control-channel tests (the FD_RECONFIG file/mtime
+trigger and env export), and live-tile reconfig edge cases on the real
+feed pipeline: every malformed or race-y swap request must be refused
+ATOMICALLY with the running config untouched (rlc on a host backend,
+ladder with the scheduler off, the double-swap race), an accepted swap
+must apply at the inflight-window barrier with zero dropped txns and
+zero leaked slots, and a compressed end-to-end run_soak must judge ok
+with a schema-valid record.
+"""
+
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from firedancer_tpu.disco import soak
+from firedancer_tpu.disco.soak import (
+    ReconfigController,
+    ResourceProbe,
+    _export_env,
+    _lsq_slope,
+    build_plan,
+    build_payloads,
+    chaos_env,
+    judge,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Compressed-window SLO env for the live runs (drain_smoke precedent):
+# CPU-lane latency budgets out of the way, slope budgets scaled but
+# finite, probe fast enough to arm on a seconds-scale window.
+SLO_ENV = {
+    "FD_SLO_E2E_BUDGET_MS": "900000",
+    "FD_SLO_SOURCE_BUDGET_MS": "900000",
+    "FD_SLO_QUIC_INGEST_MS": "900000",
+    # Heap budget scaled way past the startup ramp: a seconds-scale
+    # window arms the slope rows while first-allocation transients
+    # still dominate the fit (the hour-scale default stays tight).
+    "FD_SLO_HEAP_SLOPE_KB": "131072",
+    "FD_SLO_POOL_SLOPE_MILLI": "200000",
+    "FD_SLO_COMPILE_SLOPE": "36000",
+    "FD_SOAK_PROBE_MS": "100",
+    # Cold-compile stalls (fresh in-process jax cache) must not
+    # masquerade as liveness alerts on a seconds-scale window.
+    "FD_SLO_STALL_MS": "300000",
+    "FD_SLO_HB_MS": "120000",
+}
+
+
+# ---------------------------------------------------------- the plan -----
+
+
+def test_build_plan_same_seed_same_script():
+    a = build_plan(seed=41, n_phases=4, phase_s=10.0, rate=50.0)
+    b = build_plan(seed=41, n_phases=4, phase_s=10.0, rate=50.0)
+    assert a.chaos_schedule == b.chaos_schedule
+    assert [(p.name, p.profile, p.chaos, p.rate, p.n_txns)
+            for p in a.phases] == \
+           [(p.name, p.profile, p.chaos, p.rate, p.n_txns)
+            for p in b.phases]
+    # A different seed re-rolls the rotation and/or the drift.
+    c = build_plan(seed=42, n_phases=4, phase_s=10.0, rate=50.0)
+    assert [(p.profile, p.rate) for p in c.phases] != \
+           [(p.profile, p.rate) for p in a.phases]
+
+
+def test_build_plan_drift_rotates_and_caps():
+    plan = build_plan(seed=7, n_phases=6, phase_s=5.0, rate=40.0)
+    from firedancer_tpu.disco.siege import PROFILES
+
+    assert [p.profile for p in plan.phases] == [
+        PROFILES[(PROFILES.index(plan.phases[0].profile) + i)
+                 % len(PROFILES)] for i in range(6)]
+    # Seeded load drift stays inside the documented [0.6, 1.4)x band
+    # of rate * profile-factor.
+    for p in plan.phases:
+        factor = soak.PROFILE_MIX[p.profile][1]
+        assert 0.6 * 40.0 * factor <= p.rate < 1.4 * 40.0 * factor
+    # max_txns proportionally rescales the schedule, floor 32/phase.
+    capped = build_plan(seed=7, n_phases=6, phase_s=600.0, rate=400.0,
+                        max_txns=4000)
+    assert sum(p.n_txns for p in capped.phases) <= 4000 + 32 * 6
+    assert all(p.n_txns >= 32 for p in capped.phases)
+
+
+def test_build_plan_crash_storm_and_unknown_profile():
+    plan = build_plan(seed=3, n_phases=3, phase_s=4.0, rate=50.0,
+                      profile="crash_storm")
+    assert all(p.profile == "conn_churn" for p in plan.phases)
+    assert all(p.chaos == "stager_kill" for p in plan.phases)
+    assert plan.chaos_schedule.count("stager_kill@") == 3
+    with pytest.raises(ValueError, match="unknown soak profile"):
+        build_plan(seed=3, profile="quic_meteor_strike")
+
+
+def test_chaos_env_is_pure():
+    plan = build_plan(seed=11, n_phases=4, phase_s=2.0, rate=30.0)
+    before = dict(os.environ)
+    env = chaos_env(plan)
+    assert dict(os.environ) == before  # plan-time env mutation is banned
+    assert env["FD_CHAOS"] == "1"
+    assert env["FD_CHAOS_SEED"] == "11"
+    assert env["FD_CHAOS_SCHEDULE"] == plan.chaos_schedule
+    quiet = build_plan(seed=11, n_phases=1, phase_s=2.0, rate=30.0)
+    assert quiet.chaos_schedule == "" and chaos_env(quiet) == {}
+
+
+def test_build_payloads_phase_indexing_contiguous():
+    plan = build_plan(seed=5, n_phases=3, phase_s=1.0, rate=60.0)
+    payloads = build_payloads(plan, sign_batch_size=256)
+    assert plan.phases[0].start_idx == 0
+    for prev, cur in zip(plan.phases, plan.phases[1:]):
+        assert cur.start_idx == prev.end_idx
+    assert plan.phases[-1].end_idx == len(payloads)
+    for p in plan.phases:
+        assert 0 < p.n_unique_ok <= p.n_txns
+        assert p.n_txns == p.end_idx - p.start_idx
+
+
+# ----------------------------------------------- judgment surfaces -------
+
+
+def test_lsq_slope_recovers_a_line():
+    assert _lsq_slope([(0.0, 1.0), (1.0, 3.0), (2.0, 5.0)]) == \
+        pytest.approx(2.0)
+    assert _lsq_slope([(0.0, 7.0)]) == 0.0
+    assert _lsq_slope([(1.0, 7.0), (1.0, 9.0)]) == 0.0  # degenerate x
+
+
+def _fabricated_probe(samples):
+    probe = ResourceProbe(wksp=None, interval_ms=250)
+    probe.samples.extend(samples)
+    return probe
+
+
+def test_probe_source_discards_startup_transient():
+    # 40 KiB/s allocation burst for the first quarter, dead flat after:
+    # the warmup discard must keep the fitted heap slope near zero and
+    # report only the post-discard sample count (MIN_SLOPE_SAMPLES arms
+    # on steady-state evidence).
+    rows = []
+    for i in range(40):
+        t = float(i)
+        heap = 400.0 + 40.0 * min(t, 10.0)
+        rows.append({"t": t, "heap_kb": heap, "pool_out": 3,
+                     "engines": 2, "alerts": 0})
+    src = _fabricated_probe(rows).source()
+    assert src["samples"] == sum(1 for r in rows if r["t"] >= 0.25 * 39)
+    assert abs(src["heap_kb_min"]) < 1.0
+    assert src["pool_milli_min"] == pytest.approx(0.0)
+    assert src["compile_per_hr"] == pytest.approx(0.0)
+    # A genuine steady leak survives the discard.
+    leaky = [{"t": float(i), "heap_kb": 100.0 + 60.0 * i, "pool_out": 3,
+              "engines": 2, "alerts": 0} for i in range(40)]
+    assert _fabricated_probe(leaky).source()["heap_kb_min"] == \
+        pytest.approx(60.0 * 60.0, rel=1e-3)  # KiB/s -> KiB/min
+
+
+def test_probe_alerts_between_and_ring_hwm():
+    rows = [{"t": 0.0, "alerts": 0, "pool_out": 1, "inflight": 0},
+            {"t": 1.0, "alerts": 0, "pool_out": 5, "inflight": 2},
+            {"t": 2.0, "alerts": 2, "pool_out": 2, "inflight": 7},
+            {"t": 3.0, "alerts": 3, "pool_out": 0, "inflight": 1}]
+    probe = _fabricated_probe(rows)
+    assert probe.alerts_between(0.0, 3.0) == 3
+    assert probe.alerts_between(0.5, 1.5) == 0
+    assert probe.alerts_between(1.5, 2.5) == 2
+    assert probe.ring_hwm() == {"slot_pool": 5, "inflight": 7}
+
+
+def _judged(alerts, injected_counters, *, n_unique_ok=50, recv=None,
+            leaked=0, restarts=0, elapsed=60.0):
+    plan = build_plan(seed=9, n_phases=2, phase_s=1.0, rate=40.0)
+    for ph in plan.phases:
+        ph.n_unique_ok = n_unique_ok // len(plan.phases)
+    expected = sum(ph.n_unique_ok for ph in plan.phases)
+    vs = {"chaos": {"counters": injected_counters},
+          "stager_restarts": restarts, "slots_leaked": leaked,
+          "reconfigs": 0, "reconfig_refused": 0}
+    res = SimpleNamespace(
+        verify_stats=[vs],
+        slo={"alert_cnt": len(alerts), "alerts": alerts, "slos": {}},
+        recv_cnt=expected if recv is None else recv,
+        supervisor_restarts=0)
+    t0 = time.perf_counter()
+    src = SimpleNamespace(
+        payloads=[b"x"] * 64, pub_cnt=64,
+        phase_log=[{"phase": "p00", "t_start": t0, "t_end": t0 + 30.0,
+                    "n_txns": 32, "published": 32},
+                   {"phase": "p01", "t_start": t0 + 30.0,
+                    "t_end": t0 + 60.0, "n_txns": 32, "published": 32}])
+    probe = _fabricated_probe(
+        [{"t": t0 + i * 5.0, "heap_kb": 500.0, "pool_out": 1,
+          "engines": 1, "alerts": len(alerts) if i >= 6 else 0}
+         for i in range(13)])
+    return judge(plan, res, src, probe, None, elapsed)
+
+
+def test_judge_explains_chaos_collateral():
+    # Injected hb_stall legitimately trips BOTH tile_heartbeat (direct)
+    # and pipeline_progress (collateral: a stalled heartbeat stalls the
+    # edge) — the exact pair slo_smoke pins. Neither may be called
+    # unexplained; the same alerts with NO injection must both be.
+    alerts = [{"slo": "tile_heartbeat", "fault_classes": ["hb_stall"]},
+              {"slo": "pipeline_progress",
+               "fault_classes": ["credit_starve"]}]
+    rec = _judged(alerts, {"hb_stall": {"injected": 2}})
+    assert rec["slo"]["unexplained_alerts"] == 0
+    assert rec["slo"]["explained"] == ["hb_stall"]
+    assert rec["ok"], rec["failures"]
+    rec = _judged(alerts, {})
+    assert rec["slo"]["unexplained_alerts"] == 2
+    assert not rec["ok"]
+    assert any("not explained" in f for f in rec["failures"])
+
+
+def test_judge_burn_blip_excused_only_by_injected_chaos():
+    # An alert landing inside the +-2-probe-interval boundary window
+    # (probe counters jump at i>=6 ~= t0+30 s, the phase boundary): on
+    # a chaos-armed run with everything explained that is NOT a blip
+    # (pass-ordinal windows may straddle boundaries); on a chaos-free
+    # run the same counter delta is one.
+    alerts = [{"slo": "tile_heartbeat", "fault_classes": ["hb_stall"]}]
+    rec = _judged(alerts, {"hb_stall": {"injected": 1}})
+    assert rec["slo"]["burn_continuity"]["clean"]
+    rec = _judged(alerts, {})
+    assert not rec["slo"]["burn_continuity"]["clean"]
+    assert any("burn-rate blip" in f for f in rec["failures"])
+
+
+def test_judge_flags_drops_leaks_and_respawn_storms():
+    rec = _judged([], {}, recv=40)
+    assert rec["continuity"]["dropped"] == 10
+    assert not rec["ok"]
+    assert any("dropped" in f for f in rec["failures"])
+    rec = _judged([], {}, leaked=3)
+    assert rec["continuity"]["slots_leaked"] == 3
+    assert any("leaked" in f for f in rec["failures"])
+    # The hourly-budget floor forgives a few restarts on a compressed
+    # window; a storm far past the budget does not.
+    rec = _judged([], {}, restarts=3)
+    assert rec["respawn"]["ok"], rec["respawn"]
+    rec = _judged([], {}, restarts=2000, elapsed=60.0)
+    assert not rec["respawn"]["ok"]
+    assert any("respawn storm" in f for f in rec["failures"])
+
+
+def test_validate_soak_on_the_committed_artifact():
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import bench_log_check as blc
+
+    path = os.path.join(REPO, "SOAK_r01.json")
+    with open(path, encoding="utf-8") as f:
+        rec = json.load(f)
+    assert blc.validate_soak(rec) == []
+    # ok-consistency: an ok record may not hide a dropped txn, an
+    # unexplained alert, or a broken digest diff.
+    for mutilate in (
+        lambda r: r["continuity"].__setitem__("dropped", 5),
+        lambda r: r["slo"].__setitem__("unexplained_alerts", 1),
+        lambda r: r["continuity"].__setitem__("digest_match", False),
+        lambda r: r.__setitem__("metric", "bench"),
+    ):
+        bad = json.loads(json.dumps(rec))
+        mutilate(bad)
+        assert blc.validate_soak(bad), mutilate
+
+
+# ------------------------------------------------- control channel -------
+
+
+class _FakeTile:
+    def __init__(self, accept=True):
+        self.accept = accept
+        self.requests = []
+
+    def request_reconfig(self, req):
+        self.requests.append(req)
+        if self.accept:
+            return True, "pending (seq 1)"
+        return False, "refused (fake)"
+
+
+def test_reconfig_controller_file_mtime_trigger(tmp_path):
+    path = str(tmp_path / "reconfig.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"ladder": [64]}, f)
+    tile = _FakeTile()
+    ctl = ReconfigController(path=path, poll_s=0.05)
+    ctl.attach(tile)
+    ctl.start()
+    try:
+        time.sleep(0.2)
+        assert ctl.log == []  # the pre-start file must NOT auto-fire
+        os.utime(path, (time.time() + 5, time.time() + 5))
+        deadline = time.time() + 5.0
+        while not ctl.log and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        ctl.stop()
+    assert len(ctl.log) == 1
+    assert ctl.log[0]["ok"] and ctl.log[0]["ladder"] == [64]
+    assert tile.requests == [{"ladder": [64]}]
+
+
+def test_reconfig_controller_sighup_trigger_and_refusal_log(tmp_path):
+    path = str(tmp_path / "reconfig.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"verify_mode": "rlc"}, f)
+    tile = _FakeTile(accept=False)
+    ctl = ReconfigController(path=path, poll_s=0.05)
+    ctl.attach(tile)
+    ctl.start()
+    try:
+        ctl.trigger()  # the SIGHUP handler's whole job
+        deadline = time.time() + 5.0
+        while not ctl.log and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        ctl.stop()
+    assert len(ctl.log) == 1
+    assert not ctl.log[0]["ok"]  # refusals land in the trail too
+    assert ctl.log[0]["verify_mode"] == "rlc"
+
+
+def test_export_env_sets_and_pops(monkeypatch):
+    monkeypatch.setenv("FD_DECOMPRESS_IMPL", "xla")
+    _export_env({"FD_DECOMPRESS_IMPL": None, "FD_DRAIN": "off"})
+    assert "FD_DECOMPRESS_IMPL" not in os.environ
+    assert os.environ["FD_DRAIN"] == "off"
+    monkeypatch.delenv("FD_DRAIN")
+
+
+# ------------------------------------------ live-tile edge cases ---------
+
+
+def _corpus(n=72, seed=13):
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+
+    return mainnet_corpus(n=n, seed=seed, dup_rate=0.08,
+                          corrupt_rate=0.04, parse_err_rate=0.04,
+                          sign_batch_size=128, max_data_sz=140)
+
+
+def test_reconfig_refusals_are_atomic_and_swap_applies(tmp_path,
+                                                       monkeypatch):
+    """The satellite contract on a REAL feed tile: rlc on a host
+    backend refused, ladder swap with the scheduler off refused, the
+    double-swap race refused ('one barrier, one swap'), and the one
+    accepted request applied at the inflight-window barrier — with the
+    full corpus still digest-complete and zero slots leaked."""
+    from collections import Counter
+
+    from firedancer_tpu.disco.corpus import expected_sink_digests
+    from firedancer_tpu.disco.feed.runtime import run_feed_pipeline
+    from firedancer_tpu.disco.pipeline import build_topology
+
+    for k, v in SLO_ENV.items():
+        monkeypatch.setenv(k, v)
+    corpus = _corpus()
+    topo = build_topology(str(tmp_path / "reconfig.wksp"), depth=256)
+    verdicts = {}
+
+    def hook(v):
+        verdicts["rlc"] = v.request_reconfig({"verify_mode": "rlc"})
+        monkeypatch.setenv("FD_ENGINE_SCHED", "0")
+        verdicts["sched_off"] = v.request_reconfig({"ladder": [64]})
+        monkeypatch.setenv("FD_ENGINE_SCHED", "1")
+        verdicts["swap"] = v.request_reconfig({"ladder": [64]})
+        verdicts["double"] = v.request_reconfig({"ladder": [96]})
+
+    res = run_feed_pipeline(topo, corpus.payloads, verify_backend="cpu",
+                            verify_batch=128, timeout_s=240.0,
+                            record_digests=True, tile_hook=hook)
+    ok, detail = verdicts["rlc"]
+    assert not ok and "requires backend='tpu'" in detail
+    ok, detail = verdicts["sched_off"]
+    assert not ok and "FD_ENGINE_SCHED=0" in detail
+    ok, detail = verdicts["swap"]
+    assert ok and "pending" in detail
+    ok, detail = verdicts["double"]
+    assert not ok and "already pending" in detail
+    vs = res.verify_stats[0]
+    assert vs["reconfigs"] == 1
+    assert vs["reconfig_refused"] == 3
+    assert vs["rung_ladder"] == [64, 128]  # swap in force, batch kept
+    assert vs["slots_leaked"] == 0
+    assert Counter(res.sink_digests) == expected_sink_digests(corpus)
+
+
+def test_reconfig_cold_ladder_unusable_rungs_refused(tmp_path,
+                                                     monkeypatch):
+    """A ladder whose rungs all fall outside [MAX_SIG_CNT, batch] (or
+    fail mesh divisibility) leaves < 2 usable rungs after the batch is
+    appended -> refused atomically; a COLD but usable rung (never
+    prewarmed) is accepted and built on first dispatch."""
+    from firedancer_tpu.disco.feed.runtime import run_feed_pipeline
+    from firedancer_tpu.disco.pipeline import build_topology
+
+    for k, v in SLO_ENV.items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.setenv("FD_ENGINE_SCHED", "1")
+    corpus = _corpus(n=48, seed=21)
+    topo = build_topology(str(tmp_path / "cold.wksp"), depth=256)
+    verdicts = {}
+
+    def hook(v):
+        verdicts["oversize"] = v.request_reconfig({"ladder": [4096]})
+        verdicts["tiny"] = v.request_reconfig({"ladder": [4]})
+        verdicts["cold"] = v.request_reconfig({"ladder": [96]})
+
+    res = run_feed_pipeline(topo, corpus.payloads, verify_backend="cpu",
+                            verify_batch=128, timeout_s=240.0,
+                            record_digests=True, tile_hook=hook)
+    for key in ("oversize", "tiny"):
+        ok, detail = verdicts[key]
+        assert not ok and "usable rungs" in detail, (key, detail)
+    ok, _detail = verdicts["cold"]
+    assert ok
+    vs = res.verify_stats[0]
+    assert vs["reconfigs"] == 1 and vs["reconfig_refused"] == 2
+    assert vs["rung_ladder"] == [96, 128]
+    assert vs["slots_leaked"] == 0
+    assert len(res.sink_digests) == corpus.n_unique_ok
+
+
+def test_run_soak_compressed_end_to_end(tmp_path, monkeypatch):
+    """A seconds-scale run_soak must come back judged ok: every phase
+    entered and logged, zero dropped vs the corpus expectation, slope
+    tripwires armed on steady-state samples, and the record
+    schema-valid under bench_log_check.validate_soak."""
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import bench_log_check as blc
+
+    for k, v in SLO_ENV.items():
+        monkeypatch.setenv(k, v)
+    plan = build_plan(seed=17, n_phases=2, phase_s=1.5, rate=80.0)
+    assert all(p.chaos is None for p in plan.phases[:1])
+    rec, res = soak.run_soak(plan, verify_backend="cpu",
+                             verify_batch=128, record_digests=True,
+                             workdir=str(tmp_path / "soak"))
+    assert rec["ok"], (rec["failures"], rec["slo"]["alerts"])
+    assert len(rec["phases"]) == 2
+    assert rec["continuity"]["dropped"] == 0
+    assert rec["continuity"]["slots_leaked"] == 0
+    assert rec["continuity"]["received"] == \
+        sum(p.n_unique_ok for p in plan.phases) == len(res.sink_digests)
+    assert rec["reconfig"] == {"requested": 0, "applied": 0,
+                               "refused": 0, "events": []}
+    from firedancer_tpu.disco import sentinel
+
+    assert rec["slopes"]["samples"] >= sentinel.MIN_SLOPE_SAMPLES
+    assert rec["slopes"]["within_budget"]
+    assert blc.validate_soak(rec) == []

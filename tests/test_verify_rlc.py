@@ -220,6 +220,9 @@ def test_msm_signed_short_window_breaks_parity():
     assert _affine(res) != want
 
 
+@pytest.mark.slow  # Pallas-interpreter kernel body (~40 s on a CPU
+# core); tier-1 keeps msm oracle coverage via test_msm_matches_oracle
+# and test_msm_signed_plan_matches_oracle_single_and_8_shards
 def test_msm_fast_interpret_matches_oracle():
     """Kernel-path msm (interpret mode) vs the affine oracle: niels
     staging, bucket fill, running-sum aggregation, Horner."""
@@ -266,6 +269,10 @@ def _batch(bad=()):
             jnp.asarray(pubs))
 
 
+@pytest.mark.slow  # same compiled graph as test_rlc_detects_bad_lane
+# (~45 s on a CPU core), which also covers the all-valid lanes; clean
+# traffic further rides test_async_verifier_clean_and_dirty and the
+# pipeline e2e digests
 def test_rlc_all_valid():
     args = _batch()
     z, u = _zu(1)
@@ -497,6 +504,9 @@ def test_subgroup_check_lazy_mixed_and_small_order():
     assert not bool(ok)
 
 
+@pytest.mark.slow  # Pallas-interpreter kernel body (~43 s on a CPU
+# core); the same contract runs in tier-1 on the XLA/lazy paths via
+# test_subgroup_check_mixed_and_small_order and the lazy variant
 def test_subgroup_check_fast_interpret_mixed_and_small_order():
     """Kernel-path torsion certification (interpret mode): same
     contract as test_subgroup_check_mixed_and_small_order — clean
