@@ -264,6 +264,24 @@ echo "== fd_soak smoke (compressed soak + live reconfig + tripwires) =="
 # committed member of the artifact family behind prediction 14).
 JAX_PLATFORMS=cpu python scripts/soak_smoke.py
 
+echo "== fd_fabric smoke (2-process mesh, tenant admission, scaling) =="
+# The round-22 multi-host gate: TWO real OS processes join one
+# jax.distributed CPU mesh (gloo collectives over loopback — the DCN
+# analog) and run the split-pair rlc graphs in lockstep, each process
+# owning its own tenant front door (token-bucket admission under the
+# starved_tenant siege: the 4x attacker is shed, honest tenants never
+# are, admitted + shed == offered exactly), its own fd_feed staging
+# lanes, and its own flight workspace; the coordinator merges the
+# per-process dumps (flight.merge_snapshots) and judges ONE record —
+# merged verified-digest multiset bit-exact vs the 1-process control,
+# per-host lane balance within 1.5x, zero merged sentinel alerts, and
+# the aggregate-vs-control scaling under the recorded gate basis
+# (core-scaled 1.6x with >= 2 usable cores, non-degradation on 1).
+# FABRIC_r01.json validates against bench_log_check's fabric schema;
+# sentinel prediction 15 (2-host on-device aggregate >= 1.9x) stays
+# pending until a real pod session writes the on_device variant.
+JAX_PLATFORMS=cpu python scripts/fabric_smoke.py
+
 echo "== fuzz smoke (10k iters/target) =="
 python fuzz/run_fuzz.py --iters 10000
 

@@ -1036,6 +1036,38 @@ class RungScheduler:
 # ops/msm.py.
 # --------------------------------------------------------------------- #
 
+def fabric_split_pair(mesh, batch: int, plan=None):
+    """fd_fabric entry: the split rlc pair (local_fill + combine_tail)
+    on a caller-provided MULTI-AXIS mesh, plus its compile-ledger key.
+
+    The registry cannot serve this: EngineSpec keys on a flat shard
+    count and _build constructs its own single-axis 'dp' mesh via
+    make_mesh, but a fabric's (host, dp) topology comes from
+    jax.distributed — the mesh is the caller's. So the fabric builds
+    the pair here and books its own warm pass via
+    flight.record_compile(key, seconds), the same ledger every
+    registry engine books into (fd_report's compile table and the
+    fd_soak compile tripwires see fabric compiles like any other).
+
+    Returns (local_jit, combine_jit, key): the u3-native pair
+    (parallel/mesh.verify_rlc_split_global — u is the global (K, 2, B)
+    block layout, no host-side reshape, because a (K, 2B) reshape
+    cannot cross processes) and the key
+    "rlc:B<batch>:fabric<hosts>x<dp>:fe<frontend>:msm<plan>".
+    """
+    from firedancer_tpu.parallel.mesh import verify_rlc_split_global
+
+    if plan is not None:
+        token = msm_plan.plan_token(plan)
+    else:
+        token = EngineSpec("rlc", batch).resolved_msm()
+    shape = "x".join(str(int(s)) for s in mesh.devices.shape)
+    key = (f"rlc:B{batch}:fabric{shape}:fe{current_frontend()}"
+           f":msm{token}")
+    local_jit, combine_jit = verify_rlc_split_global(mesh, plan=plan)
+    return local_jit, combine_jit, key
+
+
 GRAPH_CONTRACTS = {
     "direct": {
         "collectives": {},

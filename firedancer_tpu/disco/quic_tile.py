@@ -68,6 +68,7 @@ from typing import Deque, Dict, Optional, Tuple
 
 from firedancer_tpu import flags
 from firedancer_tpu.disco import chaos, flight, xray
+from firedancer_tpu.disco.feed import policy
 from firedancer_tpu.disco.tiles import (
     CNC_DIAG_BACKP_CNT,
     CNC_DIAG_SV_FILT_CNT,
@@ -323,20 +324,16 @@ class QuicTile(Tile):
     def _admit(self, conn) -> bool:
         """Per-connection token-bucket admission (FD_QUIC_ADMIT_RATE /
         _BURST). Bucket state rides on the connection object — state
-        dies with the conn, exactly the lifetime it governs."""
-        now = self._now()
-        tokens = getattr(conn, "_admit_tokens", None)
-        if tokens is None:
-            tokens, at = self._admit_burst, now
-        else:
-            at = conn._admit_at
-            tokens = min(self._admit_burst,
-                         tokens + (now - at) * self._admit_rate)
-        if tokens < 1.0:
-            conn._admit_tokens, conn._admit_at = tokens, now
-            return False
-        conn._admit_tokens, conn._admit_at = tokens - 1.0, now
-        return True
+        dies with the conn, exactly the lifetime it governs. The bucket
+        itself is policy.TokenBucket — the SAME decision logic the
+        fd_fabric per-tenant front door runs, so one property suite
+        covers both admission layers (rate is per second here because
+        self._now() ticks seconds)."""
+        bucket = getattr(conn, "_admit_bucket", None)
+        if bucket is None:
+            bucket = conn._admit_bucket = policy.TokenBucket(
+                self._admit_rate, self._admit_burst)
+        return bucket.admit(self._now())
 
     def _on_stream(self, conn, stream_id: int, data: bytes) -> None:
         self.streams_seen += 1

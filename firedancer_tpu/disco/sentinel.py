@@ -35,7 +35,7 @@ This module is the judgment layer, in three parts:
                rolling best-of baseline. scripts/fd_report.py renders
                per-mode/per-B/per-stage trend reports from it.
 
-  PREDICTION   the fourteen ROOFLINE.md falsifiable predictions for the
+  PREDICTION   the fifteen ROOFLINE.md falsifiable predictions for the
   LEDGER       next hardware run (BENCH_r06), each with a MACHINE-
                CHECKABLE match rule over the timeline: the ledger lists
                every prediction as pending until a matching artifact
@@ -88,7 +88,10 @@ class SLO:
                          # share of published claims) |
                          # "slope" (fd_soak long-horizon resource-
                          # growth tripwires over the probe's fitted
-                         # trends)
+                         # trends) |
+                         # "fairness" (fd_fabric per-tenant admission:
+                         # honest-tenant shed fraction over the
+                         # registered tenant source)
     edge_or_stage: str   # edge label (lane variants aggregate in), or
                          # "progress" / "heartbeat" for liveness SLOs,
                          # or the shard-row suffix for balance SLOs,
@@ -174,6 +177,16 @@ SLO_TABLE: Tuple[SLO, ...] = (
         "unbounded-recompile signature (shape leak, or reconfigs that "
         "never retire old engines)",
         "FD_SLO_COMPILE_SLOPE"),
+    SLO("tenant_fairness", "fairness", "tenants",
+        "fd_fabric multi-tenant admission fairness: once real tenant "
+        "volume has offered (MIN_TENANT_OFFERED), every HONEST tenant "
+        "(offering within its FD_TENANT_RATE bucket) keeps its shed "
+        "fraction under FD_SLO_TENANT_SHED_PCT percent — a breach "
+        "means admission is starving a within-rate tenant while an "
+        "over-offering attacker should be the only one shed (armed "
+        "only when a fabric run registers a tenant source; ordinary "
+        "runs stay silent)",
+        "FD_SLO_TENANT_SHED_PCT"),
     SLO("pipeline_progress", "liveness", "progress",
         "some pipeline edge advances at least every FD_SLO_STALL_MS "
         "while the run is live (armed after the first frag)",
@@ -239,6 +252,74 @@ def set_slope_source(fn: Optional[Callable[[], dict]]) -> None:
     slope-kind SLOs evaluate against. Owned by disco/soak.py."""
     global _SLOPE_SOURCE
     _SLOPE_SOURCE = fn
+
+
+# Minimum total offered transactions across tenants before the
+# tenant-fairness SLO arms: the opening instants of a run (every bucket
+# still on its burst allowance) carry no fairness signal, and a tiny
+# sample must not grade the shed percentage.
+MIN_TENANT_OFFERED = 64
+
+# fd_fabric tenant source: the fabric front door registers a callable
+# returning {tenant_name: {"offered": n, "admitted": n, "shed": n,
+# "honest": bool}} (disco/fabric.py's TenantAdmission.fairness_view);
+# no source registered (every non-fabric run) means the fairness SLO
+# never arms. Same module-hook shape as the slope source, for the same
+# reason: start_for_run() constructs the Sentinel internally.
+_TENANT_SOURCE: Optional[Callable[[], Dict[str, dict]]] = None
+
+
+def set_tenant_source(fn: Optional[Callable[[], Dict[str, dict]]]) -> None:
+    """Install (or clear, with None) the process-wide per-tenant
+    admission source the fairness SLO evaluates against. Owned by
+    disco/fabric.py."""
+    global _TENANT_SOURCE
+    _TENANT_SOURCE = fn
+
+
+def evaluate_tenant_summary(tenants: Dict[str, dict],
+                            budget_pct: Optional[int] = None) -> List[dict]:
+    """Standalone fairness judgment over a (merged) per-tenant ledger —
+    the same rule Sentinel._eval_fairness applies live, exposed for the
+    fabric coordinator judging N processes' merged dumps (the
+    evaluate_edges_summary analog for the fairness kind). Returns one
+    violation dict per honest tenant over budget; an empty list is the
+    green gate. Ledger-parity (admitted + shed == offered) is checked
+    here too: a ledger that does not reconcile is itself a violation —
+    judgment over corrupt accounting would be vacuous."""
+    if budget_pct is None:
+        budget_pct = flags.get_int("FD_SLO_TENANT_SHED_PCT")
+    out: List[dict] = []
+    total_offered = 0
+    for name, row in sorted(tenants.items()):
+        offered = int(row.get("offered", 0))
+        admitted = int(row.get("admitted", 0))
+        shed = int(row.get("shed", 0))
+        total_offered += offered
+        if admitted + shed != offered:
+            out.append({
+                "slo": "tenant_fairness", "tenant": name,
+                "kind": "parity",
+                "detail": f"admitted {admitted} + shed {shed} != "
+                          f"offered {offered}",
+            })
+    if total_offered < MIN_TENANT_OFFERED:
+        return out  # unarmed: no fairness judgment on a cold ledger
+    for name, row in sorted(tenants.items()):
+        if not row.get("honest", False):
+            continue  # an attacker being shed is the defense working
+        offered = int(row.get("offered", 0))
+        shed = int(row.get("shed", 0))
+        if offered > 0 and shed * 100 > budget_pct * offered:
+            out.append({
+                "slo": "tenant_fairness", "tenant": name,
+                "kind": "starved",
+                "shed": shed, "offered": offered,
+                "budget_pct": budget_pct,
+                "detail": f"honest tenant shed {shed}/{offered} "
+                          f"(> {budget_pct}%)",
+            })
+    return out
 
 # --------------------------------------------------------------------------
 # The ROOFLINE per-stage ms budgets (round-10 >=400k/s gate arithmetic,
@@ -554,6 +635,37 @@ class Sentinel:
         milli = max(0, int(float(v) * 1000 / budget))
         return float(v) > budget, milli
 
+    def _eval_fairness(self, slo: SLO, now: float) -> Tuple[bool, int]:
+        """fd_fabric per-tenant admission fairness over the registered
+        tenant source (evaluate_tenant_summary's live twin). Unarmed —
+        (False, 0) — without a source (every non-fabric run) or before
+        MIN_TENANT_OFFERED total offered txns. Returns (breach, worst
+        honest-tenant shed per-mille of its offered)."""
+        src = _TENANT_SOURCE
+        if src is None:
+            return False, 0
+        try:
+            tenants = src() or {}
+        except Exception:
+            return False, 0   # a dying source must not take down polls
+        total = sum(int(r.get("offered", 0)) for r in tenants.values())
+        if total < MIN_TENANT_OFFERED:
+            return False, 0
+        budget_pct = self.budgets_ms[slo.name]   # percent, not ms
+        breach = False
+        worst_milli = 0
+        for row in tenants.values():
+            if not row.get("honest", False):
+                continue
+            offered = int(row.get("offered", 0))
+            shed = int(row.get("shed", 0))
+            if offered <= 0:
+                continue
+            worst_milli = max(worst_milli, int(shed * 1000 / offered))
+            if shed * 100 > budget_pct * offered:
+                breach = True
+        return breach, worst_milli
+
     def _eval_progress(self, slo: SLO, now: float, cur) -> Tuple[bool, int]:
         total = sum(int(row[1:].sum()) for row in cur.values())
         if self._progress_totals is None or total != self._progress_totals:
@@ -598,6 +710,8 @@ class Sentinel:
                 breach, burn_milli = self._eval_drain_eff(slo, now)
             elif slo.kind == "slope":
                 breach, burn_milli = self._eval_slope(slo, now)
+            elif slo.kind == "fairness":
+                breach, burn_milli = self._eval_fairness(slo, now)
             elif slo.edge_or_stage == "progress":
                 breach, burn_milli = self._eval_progress(slo, now, cur)
             else:
@@ -763,7 +877,7 @@ ARTIFACT_GLOBS = (
     "BENCH_r[0-9]*.json", "REPLAY_r[0-9]*.json", "REPLAY_CPU_r[0-9]*.json",
     "MULTICHIP_r[0-9]*.json", "PACK_r[0-9]*.json", "HOSTFEED_r[0-9]*.json",
     "SIEGE_r[0-9]*.json", "POD_r[0-9]*.json", "DRAIN_r[0-9]*.json",
-    "SOAK_r[0-9]*.json",
+    "SOAK_r[0-9]*.json", "FABRIC_r[0-9]*.json",
 )
 
 _METRIC_KIND = {
@@ -777,6 +891,7 @@ _METRIC_KIND = {
     "pod_aggregate_throughput": "pod",
     "drain_pipeline_throughput": "drain",
     "soak_run": "soak",
+    "fabric_aggregate_throughput": "fabric",
     "note": "note",
 }
 
@@ -961,6 +1076,40 @@ def pod_status(timeline: List[TimelineEntry]) -> List[dict]:
     return out
 
 
+def fabric_status(timeline: List[TimelineEntry]) -> List[dict]:
+    """Every fd_fabric artifact (FABRIC_r*.json) with its graded gates:
+    merged sink digests bit-exact vs the single-process control, exact
+    per-tenant ledger parity, cross-host balance, zero sentinel/
+    fairness alerts, and the aggregate-vs-control scaling under its
+    recorded gate basis. scripts/fabric_smoke.py writes the verdicts;
+    fd_report renders this table and prediction 15 grades the
+    on-device rows."""
+    out = []
+    for e in timeline:
+        if e.kind != "fabric":
+            continue
+        r = e.rec
+        control = r.get("control") or {}
+        out.append({
+            "source": e.source,
+            "ts": e.ts,
+            "value": r.get("value"),
+            "unit": r.get("unit"),
+            "hosts": r.get("hosts"),
+            "devices": r.get("devices"),
+            "on_device": bool(r.get("on_device")),
+            "ok": bool(r.get("ok")),
+            "digest_parity": bool(r.get("digest_parity")),
+            "alert_cnt": r.get("alert_cnt"),
+            "balance_ratio": r.get("balance_ratio"),
+            "control_value": control.get("value"),
+            "gate_basis": r.get("gate_basis"),
+            "profile": r.get("profile"),
+            "failures": list(r.get("failures") or []),
+        })
+    return out
+
+
 def drain_status(timeline: List[TimelineEntry]) -> List[dict]:
     """Every fd_drain artifact (DRAIN_r*.json) with its graded gates:
     drain on/off digest parity, probe-skip accounting parity (skipped
@@ -1061,7 +1210,7 @@ def soak_status(timeline: List[TimelineEntry]) -> List[dict]:
 
 
 # --------------------------------------------------------------------------
-# The prediction ledger: the fourteen ROOFLINE.md falsifiable predictions,
+# The prediction ledger: the fifteen ROOFLINE.md falsifiable predictions,
 # each with a machine-checkable match rule over the timeline. A rule
 # matches only schema_version >= 2, on-device, non-stale records — the
 # fused-front-end era — so the pre-round-10 history can neither confirm
@@ -1336,6 +1485,27 @@ def _check_p14(timeline):
     return "pending", None, None
 
 
+def _check_p15(timeline):
+    for e in timeline:
+        r = e.rec
+        if (r.get("metric") != "fabric_aggregate_throughput"
+                or e.schema_version < 2 or not r.get("on_device")):
+            continue
+        control = (r.get("control") or {}).get("value")
+        v = r.get("value")
+        try:
+            hosts = int(r.get("hosts") or 0)
+        except (TypeError, ValueError):
+            continue
+        if hosts < 2 or v is None or control is None or float(control) <= 0:
+            continue   # partial record: keep pending
+        ratio = float(v) / float(control)
+        return (("confirmed" if ratio >= 1.9 else "falsified"),
+                f"aggregate/control = {ratio:.2f}x at {hosts} hosts",
+                e.source)
+    return "pending", None, None
+
+
 @dataclass(frozen=True)
 class Prediction:
     pid: int
@@ -1446,6 +1616,16 @@ PREDICTIONS: Tuple[Prediction, ...] = (
                "(the compressed CPU soak_smoke lane carries "
                "on_device: false and never grades this)",
                _check_p14),
+    Prediction(15, "fd_fabric 2-host aggregate scales near-linearly",
+               ">= 1.9x the single-process control at 2 hosts (per-"
+               "host ingest stays host-local; only the tiny rlc "
+               "window/trial partials cross DCN)",
+               "first sv>=2 fabric_aggregate_throughput record with "
+               "on_device: true, hosts >= 2, and a control block — "
+               "value / control.value >= 1.9 (the 2-process CPU-mesh "
+               "FABRIC_r* smokes carry on_device: false and never "
+               "grade this)",
+               _check_p15),
 )
 
 
@@ -1516,6 +1696,13 @@ def dump_slo_markdown() -> str:
         "resource (tracemalloc heap, outstanding feed slots, engine-",
         "cache entries) exceeds the budget — stated per resource in",
         "KiB/min, milli-slots/min, and entries/hour respectively.",
+        "The fairness SLO (fd_fabric) watches the per-tenant admission",
+        "ledger: armed only when a fabric run registers a tenant source",
+        "(`sentinel.set_tenant_source` — ordinary runs never arm it)",
+        "with at least MIN_TENANT_OFFERED offered transactions,",
+        "breached when any HONEST tenant's shed fraction exceeds the",
+        "budget percentage (an over-offering attacker being shed is",
+        "the defense working, never a breach).",
         "",
         "| SLO | kind | edge / stage | budget (default) | target |"
         " trips on (chaos class) | objective |",
@@ -1527,7 +1714,8 @@ def dump_slo_markdown() -> str:
         if s.kind == "slope":
             unit = _SLOPE_UNITS[s.edge_or_stage]
         else:
-            unit = "%" if s.kind in ("balance", "effectiveness") else "ms"
+            unit = ("%" if s.kind in ("balance", "effectiveness",
+                                      "fairness") else "ms")
         budget = f"`{s.budget_flag}` = {_budget_default_ms(s)} {unit}"
         target = f"p{int(s.target * 100)}" if s.kind == "latency" else "—"
         faults = ", ".join(s.fault_classes) if s.fault_classes else "—"

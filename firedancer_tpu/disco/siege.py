@@ -284,6 +284,104 @@ def build_profile(name: str, corpus, seed: int = 0,
 
 
 # --------------------------------------------------------------------------
+# fd_fabric tenant profiles: multi-tenant admission shapes over a
+# corpus. A SEPARATE registry from PROFILES — fd_siege runs every
+# PROFILES entry as a QUIC swarm by default, and these are not swarm
+# shapes: they drive the fabric front door's per-tenant token buckets
+# through a deterministic VIRTUAL arrival clock, so admission is a pure
+# function of each tenant's own stream (host placement cannot change
+# which txns are shed — the bit-exact-vs-control law depends on it).
+# --------------------------------------------------------------------------
+
+TENANT_PROFILES = (
+    "multi_tenant",     # honest tenants only, all within rate: zero shed
+    "starved_tenant",   # + an attacker offering at 4x its bucket rate
+)
+
+# The starved_tenant attacker offers at this multiple of its bucket
+# rate — the satellite's ">= 4x over-offer" bound, restated once.
+ATTACKER_OVER_OFFER = 4
+
+
+@dataclass
+class TenantSpec:
+    """One tenant's admission contract and offered stream: corpus
+    indices `txn_idx` arriving at virtual times `arrival_ns` against a
+    (rate_tps, burst) token bucket. honest == offers within its rate
+    (the fairness SLO only covers honest tenants; an attacker being
+    shed is the defense working)."""
+
+    name: str
+    rate_tps: int
+    burst: int
+    offered_tps: int
+    txn_idx: List[int]
+    arrival_ns: List[int]
+
+    @property
+    def honest(self) -> bool:
+        return self.offered_tps <= self.rate_tps
+
+
+@dataclass
+class TenantPlan:
+    name: str
+    tenants: List[TenantSpec]
+    note: str = ""
+
+
+def build_tenant_plan(name: str, n_txns: int, seed: int = 0,
+                      rate_tps: int = 2000, burst: int = 64,
+                      n_honest: int = 4) -> TenantPlan:
+    """One named tenant-admission profile over corpus indices 0..n-1.
+
+    Honest tenants split their share round-robin and offer at HALF
+    their bucket rate (inter-arrival refill >= 1 token, so zero shed is
+    a bucket invariant, not a tuning accident). The starved_tenant
+    attacker takes the same per-tenant share but offers it at
+    ATTACKER_OVER_OFFER x its rate — beyond its burst + refill it MUST
+    be shed, while every honest bucket never dips. Deterministic in
+    (seed, name) like build_profile: the rng only rotates which corpus
+    indices land on which tenant, so two runs with one seed replay
+    bit-identically.
+    """
+    if name not in TENANT_PROFILES:
+        raise ValueError(
+            f"unknown tenant profile {name!r} (want one of "
+            f"{', '.join(TENANT_PROFILES)})"
+        )
+    import zlib
+
+    rng = Rng(seq=seed ^ (zlib.crc32(name.encode()) & 0xFFFF) ^ 0x51E6E)
+    n_tenants = n_honest + (1 if name == "starved_tenant" else 0)
+    rot = rng.roll(max(1, n_tenants))
+    by_tenant: List[List[int]] = [[] for _ in range(n_tenants)]
+    for i in range(n_txns):
+        by_tenant[(i + rot) % n_tenants].append(i)
+
+    def spec(label: str, idx: List[int], offered_tps: int) -> TenantSpec:
+        gap = int(1e9 // max(1, offered_tps))
+        return TenantSpec(
+            name=label, rate_tps=rate_tps, burst=burst,
+            offered_tps=offered_tps, txn_idx=idx,
+            arrival_ns=[j * gap for j in range(len(idx))],
+        )
+
+    honest_tps = max(1, rate_tps // 2)
+    tenants = [spec(f"tenant{i}", by_tenant[i], honest_tps)
+               for i in range(n_honest)]
+    if name == "starved_tenant":
+        tenants.append(spec("mallory", by_tenant[n_honest],
+                            rate_tps * ATTACKER_OVER_OFFER))
+        note = (f"{n_honest} honest tenants at rate/2 + attacker "
+                f"'mallory' over-offering at {ATTACKER_OVER_OFFER}x "
+                f"its {rate_tps}/s bucket")
+    else:
+        note = f"{n_honest} honest tenants, all at rate/2 (zero shed)"
+    return TenantPlan(name=name, tenants=tenants, note=note)
+
+
+# --------------------------------------------------------------------------
 # The swarm: worker threads multiplexing client connections.
 # --------------------------------------------------------------------------
 

@@ -134,17 +134,26 @@ def main(argv=None) -> int:
 
         _os.environ["JAX_PLATFORMS"] = plat
         if plat == "cpu":
+            from firedancer_tpu.parallel import multihost
+
+            # fd_fabric: join the multi-process mesh FIRST when the
+            # FD_FABRIC_* flags ask for one — init_multihost pins the
+            # fabric's own local device count into XLA_FLAGS, and the
+            # single-process patch below then no-ops ("existing count
+            # wins"). Without fabric flags this records
+            # single_process_config and the worker boots exactly as
+            # before. A DeviceCountMismatchError here is deliberate
+            # and fatal: half a fabric silently degrading to N
+            # independent workers is the failure mode the typed error
+            # exists to kill.
+            multihost.ensure_multihost()
             # Match the test conftest's virtual CPU device config so
             # the worker's jit compiles HIT the same persistent cache
             # (the compile key covers the device topology; a 1-device
             # worker would re-pay multi-minute compiles every boot).
             # Count + env dance live in ONE place (FD_MESH_DEVICES via
             # parallel/multihost.patch_host_device_count; default 8).
-            from firedancer_tpu.parallel.multihost import (
-                patch_host_device_count,
-            )
-
-            patch_host_device_count()
+            multihost.patch_host_device_count()
         import jax
 
         try:
@@ -191,6 +200,15 @@ def main(argv=None) -> int:
     from firedancer_tpu.disco import flight as _flight
 
     _flight.install_dump_signal(wksp)  # SIGUSR1 -> live postmortem dump
+    # fd_fabric satellite: the worker's multihost join outcome is a
+    # one-line flight lookup (fabric_fallback_reason in the postmortem
+    # dump), not a debugging session.
+    from firedancer_tpu.parallel import multihost as _mh
+
+    _fab_active, _fab_reason = _mh.fabric_state()
+    _flight.recorder(f"fabric:{args.tile}").record(
+        "fabric_boot", active=_fab_active,
+        fallback_reason=_fab_reason or "")
     with open(args.pod, "rb") as f:
         pod = Pod.deserialize(f.read())
     opts = json.loads(args.opts)

@@ -596,6 +596,69 @@ _register(
 )
 
 # --------------------------------------------------------------------------
+# fd_fabric — the multi-host, multi-tenant verify fabric
+# (disco/fabric.py + parallel/multihost.ensure_multihost). The four
+# FD_FABRIC_{COORD,PROCS,PROC_ID,DIR} flags are per-PROCESS: the
+# fd_fabric launcher sets them differently in each child's environment.
+# --------------------------------------------------------------------------
+
+_register(
+    "FD_FABRIC_COORD", str, None,
+    "jax.distributed coordinator address (host:port of process 0) for "
+    "the fd_fabric multi-process mesh. Unset = single-process operation "
+    "(ensure_multihost records fallback_reason instead of failing).",
+)
+_register(
+    "FD_FABRIC_PROCS", int, 1,
+    "Number of processes in the fd_fabric mesh (the 'host' axis, DCN). "
+    "1 (default) = single-process: worker boot skips jax.distributed "
+    "entirely and behaves exactly as before fd_fabric existed.",
+)
+_register(
+    "FD_FABRIC_PROC_ID", int, 0,
+    "This process's rank in the fd_fabric mesh, 0-based; process 0 is "
+    "both the jax.distributed coordinator and the cross-host judgment "
+    "coordinator (merges per-process flight dumps into FABRIC_r*.json).",
+)
+_register(
+    "FD_FABRIC_DIR", str, None,
+    "Shared directory for per-process fabric dumps (flight snapshots, "
+    "tenant ledgers, sink digests): every process writes "
+    "fabric_proc<id>.json here at drain and process 0 collects them. "
+    "Required when FD_FABRIC_PROCS > 1; a shared filesystem path on "
+    "real pods.",
+)
+_register(
+    "FD_FABRIC_RUN", str, None,
+    "JSON run config for a scripts/fd_fabric.py --child process "
+    "(corpus size/seed, per_shard, tenant profile/rate/burst, dump "
+    "dir): the launcher serializes ONE dict into every child's "
+    "environment so all processes regenerate identical corpus bytes "
+    "and tenant plans from the same seed. Unset outside child mode.",
+)
+_register(
+    "FD_FABRIC_LOCAL_DEVICES", int, 1,
+    "Virtual CPU devices per fabric process (the 'dp' axis, ICI). "
+    "Routed through init_multihost's mismatch check: a stale "
+    "XLA_FLAGS count that disagrees raises DeviceCountMismatchError "
+    "instead of silently diverging the compile-cache key across the "
+    "fabric. Real TPU hosts ignore it.",
+)
+_register(
+    "FD_TENANT_RATE", int, 2000,
+    "Per-TENANT token-bucket admission rate at the fabric front door, "
+    "transactions/second of the (virtual) arrival clock: a tenant "
+    "offering beyond its bucket is shed at admission, sha256-ledgered, "
+    "and counted per tenant — the multi-tenant analog of the per-"
+    "connection FD_QUIC_ADMIT_RATE (same policy.TokenBucket).",
+)
+_register(
+    "FD_TENANT_BURST", int, 64,
+    "Per-tenant admission bucket depth (burst allowance) at the fabric "
+    "front door; FD_QUIC_ADMIT_BURST's tenant-level analog.",
+)
+
+# --------------------------------------------------------------------------
 # fd_chaos fault injection + the self-healing machinery it proves out
 # (disco/chaos.py; all read per run).
 # --------------------------------------------------------------------------
@@ -843,6 +906,15 @@ _register(
     "entries + recorded compiles keep accreting past the prewarmed "
     "ladder — the unbounded-recompile signature (a shape leak or a "
     "reconfig that never retires old engines).",
+)
+_register(
+    "FD_SLO_TENANT_SHED_PCT", int, 1,
+    "fd_fabric tenant-fairness budget, percent: once real multi-tenant "
+    "volume has offered, an HONEST tenant (one offering within its "
+    "FD_TENANT_RATE bucket) may have at most this fraction of its "
+    "offered transactions shed. A breach means admission is starving a "
+    "within-rate tenant — the starved_tenant siege profile exists to "
+    "prove an over-offering attacker is shed WITHOUT tripping this.",
 )
 # --------------------------------------------------------------------------
 # fd_xray — tail-sampled exemplar traces, per-edge queue attribution,

@@ -197,8 +197,12 @@ DERIVED_WITNESS = {
     },
     "pod_local": {
         "from": ("rlc_local",),
+        # _rlc_split_jits is the shared split-pair builder since
+        # fd_fabric: verify_rlc_split_sharded (pod) and
+        # verify_rlc_split_global (fabric) are both thin wrappers over
+        # it, so the composition witness lives on the builder.
         "wrapper": ("firedancer_tpu/parallel/mesh.py",
-                    "verify_rlc_split_sharded"),
+                    "_rlc_split_jits"),
         "must_call": ("verify_rlc_local", "verify_rlc_combine"),
         "wrapper_collectives": {},
     },

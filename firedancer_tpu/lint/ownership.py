@@ -246,8 +246,21 @@ WRITER_TABLE: Dict[str, Tuple[str, ...]] = {
                          # placement/dispatch loop that owns the
                          # PodVerifyService (single-threaded by
                          # contract, see the class docstring).
-                         "firedancer_tpu/disco/pod.py"),
-    "flight.create_regions": ("firedancer_tpu/disco/pipeline.py",),
+                         "firedancer_tpu/disco/pod.py",
+                         # fd_fabric host rows (fabric.host +
+                         # fabric.host.shardN + the per-tenant front
+                         # door): written by the one single-threaded
+                         # FabricHost loop of this process — other
+                         # processes' rows live in their OWN workspace
+                         # files and only ever meet in the
+                         # coordinator's merge_snapshots.
+                         "firedancer_tpu/disco/fabric.py"),
+    "flight.create_regions": ("firedancer_tpu/disco/pipeline.py",
+                              # fd_fabric: each fabric process creates
+                              # the registry of its own per-process
+                              # workspace (the fabric analog of
+                              # build_topology, one creator per file).
+                              "firedancer_tpu/disco/fabric.py"),
     # fd_xray: queue-region creation (build_topology, once), the
     # per-edge rx/tx telemetry rows (consumer/producer tile of the
     # edge — tiles.py holds both call sites: InLink/OutLink

@@ -62,6 +62,17 @@ def make_mesh(n_devices: int | None = None, axis: str = "dp") -> Mesh:
     return Mesh(np.asarray(devs), (axis,))
 
 
+def _mesh_axis(mesh: Mesh):
+    """The collective axis for a verify mesh: the bare axis NAME on the
+    classic single-axis 'dp' mesh (so every audited single-axis graph —
+    and its lint_graph_cert.json certificate — is bit-identical to
+    before), the axis-name TUPLE on a multi-axis fd_fabric mesh
+    (('host', 'dp')): jax.lax collectives and PartitionSpecs both accept
+    the tuple, sharding/reducing over host x dp jointly."""
+    names = mesh.axis_names
+    return names[0] if len(names) == 1 else tuple(names)
+
+
 def verify_step_sharded(mesh: Mesh):
     """Build the jitted, mesh-sharded verify step.
 
@@ -119,7 +130,7 @@ def verify_rlc_step_sharded(mesh: Mesh, plan=None):
     from ..ops.msm import active_plan
     from ..ops.verify_rlc import verify_batch_rlc
 
-    axis = mesh.axis_names[0]
+    axis = _mesh_axis(mesh)
     if plan is None:
         plan = active_plan()
 
@@ -186,10 +197,26 @@ def verify_rlc_split_sharded(mesh: Mesh, plan=None):
     resolved once at build time, like verify_rlc_step_sharded — both
     jitted halves bake the same window grid.
     """
+    local_jit, combine_jit = _rlc_split_jits(mesh, plan)
+
+    def local_fill(msgs, lens, sigs, pubs, z, u):
+        k = u.shape[0]
+        bsz = msgs.shape[0]
+        return local_jit(msgs, lens, sigs, pubs, z,
+                         u.reshape(k, 2, bsz))
+
+    return local_fill, combine_jit
+
+
+def _rlc_split_jits(mesh: Mesh, plan=None):
+    """The shared split-pair builder: (local_jit, combine_jit) taking
+    the native (K, 2, B) u3 layout. verify_rlc_split_sharded wraps
+    local_jit with the host-side (K, 2B) reshape; verify_rlc_split_global
+    hands the raw pair to the fabric."""
     from ..ops.msm import active_plan
     from ..ops.verify_rlc import verify_rlc_combine, verify_rlc_local
 
-    axis = mesh.axis_names[0]
+    axis = _mesh_axis(mesh)
     if plan is None:
         plan = active_plan()
 
@@ -226,20 +253,39 @@ def verify_rlc_split_sharded(mesh: Mesh, plan=None):
     )
     local_jit = jax.jit(local_sharded)
     combine_jit = jax.jit(combine_sharded)
-
-    def local_fill(msgs, lens, sigs, pubs, z, u):
-        k = u.shape[0]
-        bsz = msgs.shape[0]
-        return local_jit(msgs, lens, sigs, pubs, z,
-                         u.reshape(k, 2, bsz))
-
-    return local_fill, combine_jit
+    return local_jit, combine_jit
 
 
-def _rlc_parts_spec(axis: str):
+def verify_rlc_split_global(mesh: Mesh, plan=None):
+    """The split pair with the NATIVE (K, 2, B) u layout — the
+    fd_fabric entry point.
+
+    verify_rlc_split_sharded's convenience wrapper reshapes a host
+    (K, 2B) u into the (K, 2, B) block layout before handing it to the
+    jitted graph. A multi-process fabric cannot do that: every batch
+    input is a global jax.Array assembled with
+    jax.make_array_from_process_local_data (each host contributes only
+    its own lane block), and reshaping a (K, 2B) global array across
+    processes is a cross-host relayout, not a view. So the fabric
+    builds each host's (K, 2, B_local) block directly and calls the
+    raw jitted pair returned here:
+
+      local_jit(msgs, lens, sigs, pubs, z, u3) -> (status, definite,
+          parts)        u3 global (K, 2, B), sharded P(None, None, axes)
+      combine_jit(parts) -> batch_ok
+
+    Trace-identical to verify_rlc_split_sharded's graphs (same
+    local_step/combine_step bodies, same specs); only the host-side
+    reshape convenience is dropped.
+    """
+    local_jit, combine_jit = _rlc_split_jits(mesh, plan)
+    return local_jit, combine_jit
+
+
+def _rlc_parts_spec(axis):
     """The shard_map spec pytree for verify_rlc_local's partials: every
     leaf (point-coord stacks and fill flags alike) shards its leading
-    mesh axis."""
+    mesh axis. `axis` is a name or a name-tuple (_mesh_axis)."""
     coord = P(axis)
     return {
         "w_r": (coord, coord, coord, coord), "ok_r": P(axis),

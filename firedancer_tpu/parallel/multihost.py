@@ -23,9 +23,47 @@ devices, gloo collectives) in tests/test_multihost.py.
 from __future__ import annotations
 
 import os
-from typing import Optional
+import re
+from typing import Optional, Tuple
 
 import numpy as np
+
+
+class DeviceCountMismatchError(RuntimeError):
+    """XLA_FLAGS already pins a host-platform device count that differs
+    from the one this fabric process was asked to join with.
+
+    patch_host_device_count deliberately lets an existing operator
+    override win — but across a multi-process fabric that silently
+    diverges the compile-cache key (the key covers device topology), so
+    one stale host re-pays multi-minute compiles every boot and the
+    mesh build fails with an opaque device-total error. Detect it at
+    init_multihost time instead and name both counts."""
+
+    def __init__(self, existing: int, requested: int):
+        self.existing = existing
+        self.requested = requested
+        super().__init__(
+            f"XLA_FLAGS already forces "
+            f"--xla_force_host_platform_device_count={existing} but this "
+            f"fabric process was asked to join with {requested} local "
+            f"devices; the counts must agree on every process (the "
+            f"compile-cache key covers device topology). Clear the stale "
+            f"XLA_FLAGS override or start with matching FD_MESH_DEVICES/"
+            f"FD_FABRIC_LOCAL_DEVICES."
+        )
+
+
+_DEVICE_COUNT_RE = re.compile(
+    r"--?xla_force_host_platform_device_count=(\d+)")
+
+
+def existing_host_device_count() -> Optional[int]:
+    """The host-platform device count already pinned in XLA_FLAGS, or
+    None when no override is present (last occurrence wins, matching
+    XLA's own flag parsing)."""
+    hits = _DEVICE_COUNT_RE.findall(os.environ.get("XLA_FLAGS", ""))
+    return int(hits[-1]) if hits else None
 
 
 def patch_host_device_count(n: Optional[int] = None) -> None:
@@ -63,8 +101,18 @@ def init_multihost(
     Must run before any JAX backend initializes. coordinator is
     "host:port" of process 0. local_device_count forces a virtual CPU
     device count (testing / CPU fleets); leave None on real TPU hosts.
+
+    Raises DeviceCountMismatchError when XLA_FLAGS already pins a
+    DIFFERENT host device count than `local_device_count`: the
+    "existing count wins" rule of patch_host_device_count is right for
+    a lone process honouring an operator's topology, but across fabric
+    processes a stale override silently diverges the compile-cache key
+    and the global mesh shape — fail loudly, naming both counts.
     """
     if local_device_count is not None:
+        existing = existing_host_device_count()
+        if existing is not None and existing != local_device_count:
+            raise DeviceCountMismatchError(existing, local_device_count)
         patch_host_device_count(local_device_count)
     import jax
 
@@ -74,6 +122,15 @@ def init_multihost(
             jax.config.update("jax_platforms", platform)
         except Exception:
             pass
+    # The CPU backend refuses cross-process computations outright
+    # ("Multiprocess computations aren't implemented") unless a
+    # collectives implementation is selected BEFORE the client is
+    # created — the default is 'none'. Gloo is the TCP implementation
+    # the fd_fabric CPU fleet rides; TPU backends ignore the flag.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older/newer jax without the option: let init proceed
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,
@@ -161,3 +218,68 @@ def host_local_batch(global_batch_fn, mesh):
         return tuple(out)
 
     return build
+
+
+# --------------------------------------------------------------------------
+# Fabric boot: flag-driven init with graceful single-process fallback.
+# --------------------------------------------------------------------------
+
+# (active, fallback_reason) of the last ensure_multihost() call — worker
+# boot records it in flight, feed runtime stats surface it, tests reset it.
+_FABRIC_STATE: Tuple[bool, Optional[str]] = (False, "not_attempted")
+
+
+def fabric_state() -> Tuple[bool, Optional[str]]:
+    """(multihost_active, fallback_reason) from the last
+    ensure_multihost(); reason is None when the mesh is live."""
+    return _FABRIC_STATE
+
+
+def ensure_multihost() -> Tuple[bool, Optional[str]]:
+    """Join the fd_fabric distributed runtime when the FD_FABRIC_*
+    flags ask for one; otherwise (or on failure) fall back to
+    single-process and RECORD why.
+
+    Returns (active, fallback_reason). active means jax.distributed is
+    initialized and jax.devices() is the global set; fallback_reason is
+    None then. Single-process operation is never an error — a worker
+    booted without fabric flags must come up exactly as before — but
+    the reason string makes "why is this worker alone?" a one-line
+    flight/stats lookup instead of a debugging session (the satellite's
+    `fabric_fallback_reason`). Must run before any JAX backend
+    initializes, like init_multihost itself.
+    """
+    global _FABRIC_STATE
+    from firedancer_tpu import flags as fd_flags
+
+    procs = fd_flags.get_int("FD_FABRIC_PROCS")
+    coord = fd_flags.get_str("FD_FABRIC_COORD")
+    if procs <= 1:
+        _FABRIC_STATE = (False, "single_process_config")
+        return _FABRIC_STATE
+    if not coord:
+        _FABRIC_STATE = (False, "no_coordinator:FD_FABRIC_COORD unset")
+        return _FABRIC_STATE
+    proc_id = fd_flags.get_int("FD_FABRIC_PROC_ID")
+    if not (0 <= proc_id < procs):
+        _FABRIC_STATE = (
+            False, f"bad_proc_id:{proc_id} not in [0,{procs})")
+        return _FABRIC_STATE
+    try:
+        init_multihost(
+            coord, procs, proc_id,
+            local_device_count=fd_flags.get_int("FD_FABRIC_LOCAL_DEVICES"),
+            platform=os.environ.get("JAX_PLATFORMS") or None,
+        )
+    except DeviceCountMismatchError:
+        # An operator topology conflict is a config BUG, not a reason
+        # to quietly run alone — half a fabric silently degrading to N
+        # independent workers is the failure mode this satellite exists
+        # to kill.
+        _FABRIC_STATE = (False, "device_count_mismatch")
+        raise
+    except Exception as e:  # pragma: no cover - runtime-dependent
+        _FABRIC_STATE = (False, f"init_failed:{type(e).__name__}:{e}")
+        return _FABRIC_STATE
+    _FABRIC_STATE = (True, None)
+    return _FABRIC_STATE

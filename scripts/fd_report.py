@@ -7,7 +7,7 @@ plus the BENCH/REPLAY/MULTICHIP/PACK/HOSTFEED artifact family are
 parsed into one schema-normalized timeline (pre-schema_version legacy
 lines included), rendered as per-mode/per-B/per-stage trend tables,
 checked against the rolling best-of baseline (FD_REPORT_REGRESS_PCT),
-and reconciled against the fourteen ROOFLINE.md falsifiable predictions —
+and reconciled against the fifteen ROOFLINE.md falsifiable predictions —
 each listed pending until a matching schema_version-2 artifact lands,
 then auto-graded confirmed/falsified (the BENCH_r06 hardware session
 self-grades).
@@ -277,6 +277,36 @@ def render_soak(timeline) -> List[str]:
     return lines
 
 
+def render_fabric(timeline) -> List[str]:
+    """The fd_fabric multi-host table: one row per FABRIC_r*.json
+    artifact — merged aggregate rate vs the 1-process control, digest
+    parity, per-host balance, the scaling verdict under its recorded
+    gate basis, and whether the row is on-device (only those can grade
+    prediction 15)."""
+    lines = ["== FD_FABRIC MULTI-HOST VERIFY FABRIC =="]
+    rows = sentinel.fabric_status(timeline)
+    if not rows:
+        lines.append("(no FABRIC_r*.json artifacts yet — run "
+                     "scripts/fabric_smoke.py)")
+        return lines
+    for r in rows:
+        verdict = "OK  " if r["ok"] else "FAIL"
+        where = "DEVICE" if r["on_device"] else "cpu-multiprocess"
+        ctl = r["control_value"]
+        ratio = (f"{r['value'] / ctl:.2f}x"
+                 if ctl else "n/a")
+        basis = (r["gate_basis"] or "?").split(";")[0]
+        lines.append(
+            f"  [{verdict}] {r['value']} {r['unit']} @ {r['hosts']} "
+            f"hosts ({where}); control {ctl}, scaling {ratio} "
+            f"({basis}), balance {r['balance_ratio']}x, digest parity "
+            f"{r['digest_parity']}, alerts {r['alert_cnt']} "
+            f"[{r['source']}]")
+        for fmsg in r["failures"]:
+            lines.append(f"         - {fmsg}")
+    return lines
+
+
 def render_gates(timeline) -> List[str]:
     lines = ["== THROUGHPUT GATES =="]
     best: dict = {}
@@ -316,6 +346,7 @@ def render_report(timeline, regress_pct=None) -> str:
                     render_pod(timeline),
                     render_drain(timeline),
                     render_soak(timeline),
+                    render_fabric(timeline),
                     render_regressions(regs),
                     render_ledger(ledger)):
         parts.extend(section)

@@ -19,7 +19,7 @@ Four checks, one small mainnet-shaped corpus on the CPU backend:
   3. REPORT / LEDGER — scripts/fd_report.py must ingest the repo's
      REAL BENCH_LOG.jsonl + artifact family without a single parse
      error, render the trajectory, and the prediction ledger must list
-     all fourteen ROOFLINE predictions with machine-checkable rules
+     all fifteen ROOFLINE predictions with machine-checkable rules
      (all currently pending — BENCH_r06 auto-grades them) and
      round-trip through JSON.
 
@@ -207,8 +207,8 @@ def check_report() -> None:
         if needle not in text:
             fail(f"fd_report render missing section {needle!r}")
     ledger = sentinel.prediction_ledger(timeline)
-    if len(ledger) != 14:
-        fail(f"prediction ledger has {len(ledger)} entries, want 14")
+    if len(ledger) != 15:
+        fail(f"prediction ledger has {len(ledger)} entries, want 15")
     for p in ledger:
         if p["verdict"] != "pending":
             fail(f"prediction {p['id']} pre-graded {p['verdict']!r} from "
@@ -217,7 +217,7 @@ def check_report() -> None:
             fail(f"prediction {p['id']} has no machine-checkable rule")
     if json.loads(json.dumps(ledger)) != ledger:
         fail("ledger does not round-trip through JSON")
-    log(f"report OK ({len(timeline)} entries ingested, 14 predictions "
+    log(f"report OK ({len(timeline)} entries ingested, 15 predictions "
         "pending)")
 
 

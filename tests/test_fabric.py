@@ -1,0 +1,447 @@
+"""fd_fabric unit gates — the coordinator-side (jax-free) half.
+
+The multi-process mesh itself is exercised by scripts/fabric_smoke.py
+(the ci.sh lane) and tests/test_multihost.py (slow); everything here
+runs in-process: tenant admission parity/fairness, deterministic
+whole-tenant placement, the N-dump merge against a single-process
+union (the merge_snapshots property the cross-host judgment stands
+on), merge_and_judge's artifact core, the FABRIC_r* validator, the
+fabric fallback-reason ladder, and prediction 15's grading rule.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import bench_log_check  # noqa: E402
+
+from firedancer_tpu.disco import fabric, flight, sentinel  # noqa: E402
+from firedancer_tpu.disco.siege import build_tenant_plan  # noqa: E402
+from firedancer_tpu.parallel import multihost  # noqa: E402
+
+PLAN = build_tenant_plan("starved_tenant", 160, seed=2026,
+                         rate_tps=2000, burst=8)
+
+
+# --------------------------------------------------------------------------
+# Tenant admission.
+# --------------------------------------------------------------------------
+
+
+def _replay_all(plan):
+    adm = fabric.TenantAdmission(plan.tenants)
+    for t in plan.tenants:
+        for j, ns in enumerate(t.arrival_ns):
+            adm.admit(t.name, ns, payload=b"p%d" % t.txn_idx[j])
+    return adm
+
+
+def test_admission_parity_is_exact():
+    adm = _replay_all(PLAN)
+    assert adm.parity_ok()
+    view = adm.fairness_view()
+    for name, row in view.items():
+        assert row["admitted"] + row["shed"] == row["offered"], row
+    total_offered = sum(r["offered"] for r in view.values())
+    assert total_offered == sum(len(t.txn_idx) for t in PLAN.tenants)
+
+
+def test_honest_tenants_never_shed_attacker_always_shed():
+    view = _replay_all(PLAN).fairness_view()
+    for name, row in view.items():
+        if row["honest"]:
+            # Offering at rate/2 against a (rate, burst) bucket: zero
+            # shed is a bucket invariant, not a tuning accident.
+            assert row["shed"] == 0, (name, row)
+            assert row["admitted"] == row["offered"]
+        else:
+            # The 4x over-offerer must overflow burst + refill.
+            assert row["shed"] > 0, (name, row)
+            assert row["admitted"] < row["offered"]
+
+
+def test_shed_payloads_are_accounted_not_silent():
+    adm = _replay_all(PLAN)
+    shed_total = sum(r["shed"] for r in adm.ledger.values())
+    assert shed_total > 0
+    assert len(adm.shed_sha256) == shed_total
+    assert len({d for d in adm.shed_sha256}) == shed_total
+
+
+def test_admission_is_pure_function_of_the_stream():
+    a = _replay_all(PLAN).fairness_view()
+    b = _replay_all(PLAN).fairness_view()
+    assert a == b
+
+
+def test_owned_filter_restricts_the_front_door():
+    adm = fabric.TenantAdmission(PLAN.tenants, owned=["tenant0"])
+    assert set(adm.buckets) == {"tenant0"}
+    with pytest.raises(KeyError):
+        adm.admit("mallory", 0)
+
+
+# --------------------------------------------------------------------------
+# Placement: deterministic, whole-tenant, load-balanced.
+# --------------------------------------------------------------------------
+
+
+def test_assign_tenants_partitions_every_tenant_once():
+    for n_hosts in (1, 2, 3, 5):
+        hosts = fabric.assign_tenants(PLAN, n_hosts)
+        assert len(hosts) == n_hosts
+        names = [n for h in hosts for n in h]
+        assert sorted(names) == sorted(t.name for t in PLAN.tenants)
+        assert fabric.assign_tenants(PLAN, n_hosts) == hosts
+
+
+def test_assign_tenants_balances_simulated_admitted_load():
+    loads = fabric.admitted_counts(PLAN)
+    hosts = fabric.assign_tenants(PLAN, 2)
+    totals = [sum(loads[n] for n in h) for h in hosts]
+    # Greedy largest-first over 5 near-equal tenants: within one
+    # tenant's load of each other.
+    assert abs(totals[0] - totals[1]) <= max(loads.values())
+
+
+def test_admitted_union_is_placement_invariant():
+    """The digest-parity keystone: the union of admitted txn indices is
+    identical however the tenants are split across hosts."""
+    def admitted_idx(owned):
+        adm = fabric.TenantAdmission(PLAN.tenants, owned=owned)
+        out = []
+        for t in PLAN.tenants:
+            if t.name not in adm.specs:
+                continue
+            for j, ns in enumerate(t.arrival_ns):
+                if adm.admit(t.name, ns):
+                    out.append(t.txn_idx[j])
+        return out
+
+    single = sorted(admitted_idx(None))
+    for n_hosts in (2, 3):
+        parts = fabric.assign_tenants(PLAN, n_hosts)
+        union = sorted(i for owned in parts for i in admitted_idx(owned))
+        assert union == single, n_hosts
+
+
+# --------------------------------------------------------------------------
+# The N-dump merge vs the single-process union.
+# --------------------------------------------------------------------------
+
+
+def _synthetic_snap(rng, labels=("fabric.host", "fabric.host.shard0")):
+    metrics = {}
+    for lbl in labels:
+        metrics[lbl] = {m.name: int(rng.integers(0, 50))
+                        for m in flight.TILE_METRICS}
+        metrics[lbl]["breaker_state"] = int(rng.integers(0, 4))
+    edges = {"sink": rng.integers(
+        0, 100, flight.EDGE_SLOTS, dtype=np.int64).astype(np.uint64)}
+    return {"metrics": metrics, "edges": edges}
+
+
+def test_merge_snapshots_equals_single_process_union():
+    """Property over N per-process snapshots: merged counters are the
+    exact per-label sums, merged histograms the elementwise sums, and
+    breaker_state the most-severe — judging N dumps is judging the one
+    big run."""
+    rng = np.random.default_rng(7)
+    severity = {1: 3, 2: 2, 0: 1, 3: 0}  # open > half_open > closed
+    for n in (1, 2, 4):
+        snaps = [_synthetic_snap(rng) for _ in range(n)]
+        merged = flight.merge_snapshots(snaps)
+        for lbl in snaps[0]["metrics"]:
+            for m in flight.TILE_METRICS:
+                rows = [int(s["metrics"][lbl][m.name]) for s in snaps]
+                got = merged["metrics"][lbl][m.name]
+                if m.name == "breaker_state":
+                    assert got == max(rows, key=lambda v: severity[v])
+                else:
+                    assert got == sum(rows), (lbl, m.name)
+        # histogram buckets (slots 1..) sum elementwise; slot 0 is the
+        # wrapping sum_ns counter
+        want = np.zeros(flight.EDGE_SLOTS, np.uint64)
+        for s in snaps:
+            want[1:] += s["edges"]["sink"][1:]
+            want[0] += s["edges"]["sink"][0]
+        assert (merged["edges_raw"]["sink"] == want).all()
+        # and the summaries grade the merged histogram, not a copy
+        assert merged["edges"]["sink"]["n"] == int(want[1:].sum())
+        assert merged["edges"]["sink"]["sum_ns"] == int(want[0])
+
+
+def _mk_dump(proc_id, n_hosts, *, ok=40, lanes=50, elapsed=10.0,
+             digests=(), tenants=None, rng=None):
+    rng = rng or np.random.default_rng(proc_id)
+    return {
+        "schema_version": 2,
+        "proc_id": proc_id,
+        "n_hosts": n_hosts,
+        "dp": 1,
+        "per_shard": 8,
+        "global_batch": 16,
+        "elapsed_s": elapsed,
+        "verified_ok": ok,
+        "verified_fail": 1,
+        "parse_rejects": 2,
+        "steps": 5,
+        "lanes": lanes,
+        "batches": 5,
+        "rlc_fallbacks": 0,
+        "shard_lanes": [lanes],
+        "fabric_fallback_reason": None,
+        "digests": sorted(digests),
+        "tenants": tenants or {},
+        "snapshot": _synthetic_snap(rng),
+    }
+
+
+def test_merge_and_judge_core_record():
+    t0 = {"tenant0": {"offered": 10, "admitted": 10, "shed": 0,
+                      "honest": True}}
+    t1 = {"mallory": {"offered": 20, "admitted": 12, "shed": 8,
+                      "honest": False}}
+    dumps = [
+        _mk_dump(0, 2, ok=40, lanes=50, elapsed=10.0,
+                 digests=["aa", "bb"], tenants=t0),
+        _mk_dump(1, 2, ok=44, lanes=60, elapsed=11.0,
+                 digests=["cc"], tenants=t1),
+    ]
+    control = {"verified_ok": 84, "elapsed_s": 20.0,
+               "digests": ["aa", "bb", "cc"]}
+    rec = fabric.merge_and_judge(dumps, control=control,
+                                 budgets_ms=None)
+    assert rec["metric"] == "fabric_aggregate_throughput"
+    assert rec["hosts"] == 2 and rec["devices"] == 2
+    assert rec["verified_ok"] == 84
+    assert rec["wall_s"] == 11.0
+    assert rec["value"] == round(84 / 11.0, 3)
+    assert rec["balance_ratio"] == round(60 / 50, 3)
+    assert rec["tenant_parity"] is True
+    assert rec["digests"] == 3
+    assert rec["digest_parity"] is True
+    assert rec["control"]["value"] == round(84 / 20.0, 3)
+    assert rec["scaling_ratio"] == round(
+        rec["value"] / rec["control"]["value"], 3)
+    # merged tenant ledger keeps the honest flag per tenant
+    assert rec["tenants"]["mallory"]["honest"] is False
+    # order-invariant: dumps sorted by proc_id inside
+    assert fabric.merge_and_judge(dumps[::-1], control=control)[
+        "per_host"] == rec["per_host"]
+
+
+def test_merge_and_judge_flags_digest_mismatch_and_parity_break():
+    bad_tenants = {"t": {"offered": 10, "admitted": 7, "shed": 2,
+                         "honest": True}}
+    dumps = [_mk_dump(0, 1, digests=["aa"], tenants=bad_tenants)]
+    rec = fabric.merge_and_judge(
+        dumps, control={"verified_ok": 40, "elapsed_s": 10.0,
+                        "digests": ["zz"]})
+    assert rec["digest_parity"] is False
+    assert rec["tenant_parity"] is False
+    # the parity break must also surface as a sentinel alert
+    assert any(a.get("kind") == "parity" for a in rec["alerts"]
+               if isinstance(a, dict))
+
+
+# --------------------------------------------------------------------------
+# The FABRIC_r* validator.
+# --------------------------------------------------------------------------
+
+
+def _valid_rec():
+    return {
+        "metric": "fabric_aggregate_throughput",
+        "schema_version": 2,
+        "ts": "2026-08-07T12:00:00+00:00",
+        "value": 8.0,
+        "unit": "verifies/s",
+        "hosts": 2,
+        "devices": 2,
+        "on_device": False,
+        "ok": True,
+        "digest_parity": True,
+        "tenant_parity": True,
+        "alert_cnt": 0,
+        "balance_ratio": 1.2,
+        "gate_basis": "non-degradation; usable_cores=1",
+        "wall_s": 11.0,
+        "per_host": [{"proc_id": 0, "lanes": 50},
+                     {"proc_id": 1, "lanes": 60}],
+        "tenants": {
+            "tenant0": {"offered": 10, "admitted": 10, "shed": 0,
+                        "honest": True},
+            "mallory": {"offered": 20, "admitted": 12, "shed": 8,
+                        "honest": False},
+        },
+        "control": {"hosts": 1, "verified_ok": 84, "elapsed_s": 20.0,
+                    "value": 4.2},
+        "failures": [],
+    }
+
+
+def test_validate_fabric_accepts_the_reference_record():
+    assert bench_log_check.validate_fabric(_valid_rec()) == []
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    (lambda r: r.update(metric="bogus"), "metric"),
+    (lambda r: r.update(digest_parity=False), "digest_parity"),
+    (lambda r: r.update(tenant_parity=False), "tenant_parity"),
+    (lambda r: r.update(alert_cnt=3), "alert_cnt"),
+    (lambda r: r.update(balance_ratio=2.0), "balance_ratio"),
+    (lambda r: r.update(gate_basis="vibes"), "gate_basis"),
+    (lambda r: r["tenants"]["tenant0"].update(shed=1),
+     "parity"),
+    (lambda r: r["tenants"]["mallory"].update(shed=0, admitted=20),
+     "never shed"),
+    (lambda r: r.pop("per_host"), "per_host"),
+    (lambda r: r.pop("control"), "control"),
+])
+def test_validate_fabric_rejects(mutate, needle):
+    rec = _valid_rec()
+    mutate(rec)
+    errs = bench_log_check.validate_fabric(rec)
+    assert errs and any(needle in e for e in errs), errs
+
+
+def test_validate_fabric_scaling_gate_by_basis():
+    # non-degradation basis: 8.0 / 4.2 ~ 1.9x passes trivially; drop
+    # the aggregate below 0.4x the control and it must fail.
+    rec = _valid_rec()
+    rec["value"] = 1.5
+    errs = bench_log_check.validate_fabric(rec)
+    assert any("non-degradation" in e for e in errs), errs
+    # core-scaled basis demands the 1.6x floor.
+    rec = _valid_rec()
+    rec["gate_basis"] = "core-scaled; usable_cores=8"
+    rec["value"] = 5.0   # 5.0/4.2 = 1.19x < 1.6
+    errs = bench_log_check.validate_fabric(rec)
+    assert any("core-scaled" in e for e in errs), errs
+    rec["value"] = 8.0   # 1.9x >= 1.6
+    assert bench_log_check.validate_fabric(rec) == []
+
+
+def test_validate_fabric_ok_false_is_evidence_not_error():
+    rec = _valid_rec()
+    rec["ok"] = False
+    rec["digest_parity"] = False
+    rec["failures"] = ["digest parity broke"]
+    assert bench_log_check.validate_fabric(rec) == []
+
+
+# --------------------------------------------------------------------------
+# Fallback-reason ladder + the typed device-count error.
+# --------------------------------------------------------------------------
+
+
+def test_ensure_multihost_single_process_reason(monkeypatch):
+    for k in ("FD_FABRIC_PROCS", "FD_FABRIC_COORD",
+              "FD_FABRIC_PROC_ID"):
+        monkeypatch.delenv(k, raising=False)
+    active, reason = multihost.ensure_multihost()
+    assert active is False
+    assert reason == "single_process_config"
+    assert multihost.fabric_state() == (False, "single_process_config")
+
+
+def test_ensure_multihost_missing_coordinator(monkeypatch):
+    monkeypatch.setenv("FD_FABRIC_PROCS", "2")
+    monkeypatch.delenv("FD_FABRIC_COORD", raising=False)
+    active, reason = multihost.ensure_multihost()
+    assert active is False
+    assert reason.startswith("no_coordinator")
+
+
+def test_ensure_multihost_bad_proc_id(monkeypatch):
+    monkeypatch.setenv("FD_FABRIC_PROCS", "2")
+    monkeypatch.setenv("FD_FABRIC_COORD", "127.0.0.1:1")
+    monkeypatch.setenv("FD_FABRIC_PROC_ID", "7")
+    active, reason = multihost.ensure_multihost()
+    assert active is False
+    assert reason.startswith("bad_proc_id")
+
+
+def test_device_count_mismatch_is_typed_and_fatal(monkeypatch):
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+    with pytest.raises(multihost.DeviceCountMismatchError) as ei:
+        multihost.init_multihost("127.0.0.1:1", 2, 0,
+                                 local_device_count=8)
+    msg = str(ei.value)
+    assert "4" in msg and "8" in msg
+    # ensure_multihost records the reason BEFORE re-raising
+    monkeypatch.setenv("FD_FABRIC_PROCS", "2")
+    monkeypatch.setenv("FD_FABRIC_COORD", "127.0.0.1:1")
+    monkeypatch.setenv("FD_FABRIC_PROC_ID", "0")
+    monkeypatch.setenv("FD_FABRIC_LOCAL_DEVICES", "8")
+    with pytest.raises(multihost.DeviceCountMismatchError):
+        multihost.ensure_multihost()
+    assert multihost.fabric_state() == (False, "device_count_mismatch")
+
+
+def test_matching_pin_is_not_a_mismatch(monkeypatch):
+    import jax
+
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    assert multihost.existing_host_device_count() == 8
+    # Same count: the guard passes and init proceeds to the
+    # distributed join (stubbed — joining a real coordinator is the
+    # smoke's job, not a unit test's).
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    multihost.init_multihost("127.0.0.1:9", 2, 0,
+                             local_device_count=8)
+    assert calls and calls[0]["num_processes"] == 2
+
+
+# --------------------------------------------------------------------------
+# Sentinel: fairness summary, fabric_status, prediction 15.
+# --------------------------------------------------------------------------
+
+
+def test_evaluate_tenant_summary_parity_and_starvation():
+    good = {"a": {"offered": 100, "admitted": 100, "shed": 0,
+                  "honest": True}}
+    assert sentinel.evaluate_tenant_summary(good) == []
+    broken = {"a": {"offered": 100, "admitted": 90, "shed": 5,
+                    "honest": True}}
+    alerts = sentinel.evaluate_tenant_summary(broken)
+    assert alerts, "parity break must alert"
+
+
+def _entry(rec, sv=2):
+    return sentinel.TimelineEntry(
+        source="FABRIC_r01.json", kind="fabric", rec=rec,
+        ts=rec.get("ts"), schema_version=sv, legacy=False)
+
+
+def test_fabric_status_renders_artifact_rows():
+    rows = sentinel.fabric_status([_entry(_valid_rec())])
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["hosts"] == 2 and r["ok"] is True
+    assert r["control_value"] == 4.2
+    assert r["digest_parity"] is True
+
+
+def test_prediction_15_grades_only_on_device_records():
+    rec = _valid_rec()
+    # off-device: pending regardless of ratio
+    verdict, _, _ = sentinel._check_p15([_entry(rec)])
+    assert verdict == "pending"
+    on = dict(rec, on_device=True)        # 8.0 / 4.2 = 1.90x
+    verdict, why, src = sentinel._check_p15([_entry(on)])
+    assert verdict == "confirmed", why
+    slow = dict(on, value=6.0)            # 1.43x < 1.9
+    verdict, why, _ = sentinel._check_p15([_entry(slow)])
+    assert verdict == "falsified", why

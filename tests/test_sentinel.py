@@ -29,7 +29,7 @@ def test_slo_table_typed_and_unique():
     assert len(names) == len(set(names))
     for s in sentinel.SLO_TABLE:
         assert s.kind in ("latency", "liveness", "balance",
-                          "effectiveness", "slope"), s.name
+                          "effectiveness", "slope", "fairness"), s.name
         assert s.objective, s.name
         assert s.budget_flag in __import__(
             "firedancer_tpu.flags", fromlist=["REGISTRY"]).REGISTRY, s.name
@@ -299,10 +299,10 @@ def test_timeline_ingests_repo_history_without_error():
     assert any(e.legacy for e in timeline)
 
 
-def test_prediction_ledger_all_fourteen_pending_on_repo_history():
+def test_prediction_ledger_all_fifteen_pending_on_repo_history():
     ledger = sentinel.prediction_ledger(sentinel.load_timeline(REPO))
-    assert len(ledger) == 14
-    assert [p["id"] for p in ledger] == list(range(1, 15))
+    assert len(ledger) == 15
+    assert [p["id"] for p in ledger] == list(range(1, 16))
     for p in ledger:
         assert p["verdict"] == "pending", p
         assert p["rule"] and p["predicted"], p
@@ -361,6 +361,13 @@ def test_prediction_ledger_autogrades_synthetic_r06():
                             "slopes": {"within_budget": True},
                             "reconfig": {"applied": 1},
                             "continuity": {"dropped": 0}},
+                           "synthetic"),
+        sentinel._classify({"metric": "fabric_aggregate_throughput",
+                            "value": 2_100_000.0, "unit": "verifies/s",
+                            "hosts": 2, "devices": 16,
+                            "on_device": True, "schema_version": 2,
+                            "ts": "2026-08-09T00:00:00Z",
+                            "control": {"value": 1_050_000.0}},
                            "synthetic"),
     ]
     ledger = sentinel.prediction_ledger(timeline)
